@@ -1,0 +1,18 @@
+"""mxnet_tpu — a TPU-native deep-learning framework with the MXNet-0.9.5
+capability surface (see SURVEY.md for the blueprint).
+
+Import as ``import mxnet_tpu as mx`` — the namespaces mirror the reference's
+``python/mxnet/__init__.py``: ``mx.nd``, ``mx.sym``, ``mx.mod``, ``mx.io``,
+``mx.kv``, ``mx.optimizer``, ``mx.init``, ``mx.metric``, ``mx.rnn``, …
+"""
+
+__version__ = "0.1.0"
+
+from .base import MXNetError
+from .context import Context, cpu, gpu, tpu, current_context, num_tpus
+
+from . import ops
+from . import ndarray
+from . import ndarray as nd
+from . import random
+from . import random as rnd

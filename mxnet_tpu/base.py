@@ -1,0 +1,116 @@
+"""Core shared plumbing: errors, registries, name management.
+
+TPU-native re-design of the reference's ``python/mxnet/base.py`` (ctypes lib
+loading, handle types, error translation — reference ``python/mxnet/base.py:1-258``).
+There is no C handle layer here: the "backend" is JAX/XLA, so this module only
+keeps the pieces that are real API surface — the exception type, the generic
+registry used by optimizers/initializers/metrics/iterators, and name management
+for auto-generated symbol names (reference ``python/mxnet/name.py``).
+"""
+
+from __future__ import annotations
+
+import threading
+
+__all__ = ["MXNetError", "Registry", "NameManager", "Prefix", "string_types"]
+
+string_types = (str,)
+
+
+class MXNetError(RuntimeError):
+    """Error raised by the framework (reference: ``base.py:42`` MXNetError)."""
+
+
+class Registry:
+    """A named registry of classes/functions with alias support.
+
+    Single replacement for the reference's many ad-hoc registries
+    (optimizer ``optimizer.py:71``, metric ``metric.py``, initializer,
+    image augmenters, io iterators).
+    """
+
+    def __init__(self, kind):
+        self._kind = kind
+        self._entries = {}
+
+    def register(self, obj=None, name=None):
+        def _do(o):
+            key = (name or getattr(o, "__name__", None) or str(o)).lower()
+            self._entries[key] = o
+            return o
+
+        if obj is None:
+            return _do
+        return _do(obj)
+
+    def alias(self, obj, *names):
+        for n in names:
+            self._entries[n.lower()] = obj
+        return obj
+
+    def get(self, name):
+        key = str(name).lower()
+        if key not in self._entries:
+            raise MXNetError(
+                "%s %r is not registered (known: %s)"
+                % (self._kind, name, sorted(self._entries))
+            )
+        return self._entries[key]
+
+    def create(self, name, *args, **kwargs):
+        return self.get(name)(*args, **kwargs)
+
+    def __contains__(self, name):
+        return str(name).lower() in self._entries
+
+    def keys(self):
+        return sorted(self._entries)
+
+
+class NameManager:
+    """Auto-naming for symbols (reference ``python/mxnet/name.py:6-60``).
+
+    Thread-local stack so `with NameManager():` scopes compose; the current
+    manager assigns ``op_name + running count`` names to anonymous symbols.
+    """
+
+    _tls = threading.local()
+
+    def __init__(self):
+        self._counter = {}
+        self._old = None
+
+    def get(self, name, hint):
+        if name:
+            return name
+        if hint not in self._counter:
+            self._counter[hint] = 0
+        name = "%s%d" % (hint, self._counter[hint])
+        self._counter[hint] += 1
+        return name
+
+    def __enter__(self):
+        if not hasattr(NameManager._tls, "stack"):
+            NameManager._tls.stack = [NameManager()]
+        NameManager._tls.stack.append(self)
+        return self
+
+    def __exit__(self, *exc):
+        NameManager._tls.stack.pop()
+
+    @staticmethod
+    def current():
+        if not hasattr(NameManager._tls, "stack"):
+            NameManager._tls.stack = [NameManager()]
+        return NameManager._tls.stack[-1]
+
+
+class Prefix(NameManager):
+    """NameManager that prepends a fixed prefix (reference ``name.py:63``)."""
+
+    def __init__(self, prefix):
+        super().__init__()
+        self._prefix = prefix
+
+    def get(self, name, hint):
+        return self._prefix + super().get(name, hint)

@@ -1,0 +1,525 @@
+"""Imperative NDArray API (``mx.nd``).
+
+Reference: ``include/mxnet/ndarray.h`` + ``src/ndarray/ndarray.cc`` +
+``python/mxnet/ndarray.py`` (SURVEY §2.1/§2.6).
+
+TPU-native design: an NDArray owns a ``jax.Array`` (a PJRT buffer on the
+context's device).  The reference's async engine semantics map 1:1 onto
+JAX/PJRT async dispatch — every op returns immediately with a future-backed
+buffer, and ``asnumpy()``/``wait_to_read()`` are the sync points (reference
+``NDArray::WaitToRead`` ``ndarray.h:126``; here ``block_until_ready``).
+Dependency ordering needs no engine: data dependencies ARE the XLA/PJRT
+dataflow.  Mutation (``a[:] = x``, ``+=``) rebinds the underlying buffer,
+which matches the reference's write-var semantics for every reader that goes
+through the NDArray object.
+
+The ``mx.nd.<op>`` functions are generated from the op registry at import —
+the analog of ``_init_ndarray_module`` (``python/mxnet/_ctypes/ndarray.py:155``)
+generating functions from the C op registry.  Each call dispatches through a
+jit-cached XLA computation (``ops/registry.py:jitted_apply``).
+"""
+
+from __future__ import annotations
+
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import random as _random
+from .base import MXNetError
+from .context import Context, current_context
+from .ops import registry as _reg
+
+__all__ = ["NDArray", "array", "zeros", "ones", "empty", "full", "arange",
+           "concatenate", "load", "save", "imdecode", "onehot_encode", "waitall"]
+
+# generated op functions shadow some builtins at module level (nd.slice,
+# nd.sum, ...) — keep safe references for use inside this module
+_py_slice = slice
+
+
+def _np_dtype(dtype):
+    if dtype is None:
+        return np.float32
+    if str(dtype) == "bfloat16":
+        return jnp.bfloat16
+    return np.dtype(dtype)
+
+
+class NDArray:
+    """A tensor on a device context, with async-dispatch semantics."""
+
+    __slots__ = ["_jx", "_ctx"]
+    # numpy should defer to our reflected ops
+    __array_priority__ = 100.0
+
+    def __init__(self, data, ctx=None, dtype=None):
+        if isinstance(data, NDArray):
+            self._jx = data._jx
+            self._ctx = ctx or data._ctx
+            return
+        ctx = ctx or current_context()
+        arr = np.asarray(data, dtype=_np_dtype(dtype) if dtype else None)
+        if arr.dtype == np.float64:
+            arr = arr.astype(np.float32)
+        self._jx = jax.device_put(arr, ctx.jax_device())
+        self._ctx = ctx
+
+    @staticmethod
+    def _from_jax(jx, ctx=None):
+        out = NDArray.__new__(NDArray)
+        out._jx = jx
+        if ctx is None:
+            plat = jx.devices().pop().platform if hasattr(jx, "devices") else "cpu"
+            ctx = Context("cpu" if plat == "cpu" else "tpu", 0)
+        out._ctx = ctx
+        return out
+
+    # -- properties -------------------------------------------------------
+    @property
+    def shape(self):
+        return tuple(self._jx.shape)
+
+    @property
+    def dtype(self):
+        dt = self._jx.dtype
+        return dt.type if hasattr(dt, "type") and dt.names is None else dt
+
+    @property
+    def size(self):
+        return int(np.prod(self.shape)) if self.shape else 1
+
+    @property
+    def ndim(self):
+        return self._jx.ndim
+
+    @property
+    def context(self):
+        return self._ctx
+
+    ctx = context
+
+    @property
+    def T(self):
+        return NDArray._from_jax(self._jx.T, self._ctx)
+
+    # -- sync points ------------------------------------------------------
+    def asnumpy(self):
+        """Blocking copy to host (reference ``ndarray.py`` asnumpy; the sync
+        point, like WaitToRead + CopyDeviceToCPU)."""
+        return np.asarray(self._jx)
+
+    def asscalar(self):
+        if self.size != 1:
+            raise MXNetError("The current array is not a scalar")
+        return self.asnumpy().reshape(())[()]
+
+    def wait_to_read(self):
+        self._jx.block_until_ready()
+
+    wait_to_write = wait_to_read
+
+    # -- conversions / movement ------------------------------------------
+    def astype(self, dtype):
+        return NDArray._from_jax(self._jx.astype(_np_dtype(dtype)), self._ctx)
+
+    def copy(self):
+        return NDArray._from_jax(self._jx + 0, self._ctx)
+
+    def copyto(self, other):
+        """reference ``ndarray.py`` copyto(Context|NDArray)"""
+        if isinstance(other, Context):
+            return NDArray._from_jax(
+                jax.device_put(self._jx, other.jax_device()), other)
+        if isinstance(other, NDArray):
+            if other.shape != self.shape:
+                raise MXNetError("copyto: shape mismatch %s vs %s"
+                                 % (self.shape, other.shape))
+            other._jx = jax.device_put(self._jx.astype(other._jx.dtype),
+                                       other._ctx.jax_device())
+            return other
+        raise TypeError("copyto does not support type " + str(type(other)))
+
+    def as_in_context(self, context):
+        if context == self._ctx:
+            return self
+        return self.copyto(context)
+
+    def detach(self):
+        return NDArray._from_jax(jax.lax.stop_gradient(self._jx), self._ctx)
+
+    # -- shape ops --------------------------------------------------------
+    def reshape(self, shape, **kwargs):
+        if isinstance(shape, int):
+            shape = (shape,)
+        from .ops.matrix import _infer_reshape
+
+        return NDArray._from_jax(
+            self._jx.reshape(_infer_reshape(tuple(shape), self.shape)), self._ctx)
+
+    def broadcast_to(self, shape):
+        return NDArray._from_jax(jnp.broadcast_to(self._jx, shape), self._ctx)
+
+    def expand_dims(self, axis):
+        return NDArray._from_jax(jnp.expand_dims(self._jx, axis), self._ctx)
+
+    def flatten(self):
+        return NDArray._from_jax(self._jx.reshape(self.shape[0], -1), self._ctx)
+
+    def transpose(self, axes=None):
+        return NDArray._from_jax(jnp.transpose(self._jx, axes), self._ctx)
+
+    def slice_axis(self, axis, begin, end):
+        idx = [_py_slice(None)] * self.ndim
+        idx[axis] = _py_slice(begin, end)
+        return NDArray._from_jax(self._jx[tuple(idx)], self._ctx)
+
+    # -- indexing ---------------------------------------------------------
+    def _idx(self, key):
+        if isinstance(key, NDArray):
+            return key._jx
+        if isinstance(key, tuple):
+            return tuple(k._jx if isinstance(k, NDArray) else k for k in key)
+        return key
+
+    def __getitem__(self, key):
+        return NDArray._from_jax(self._jx[self._idx(key)], self._ctx)
+
+    def __setitem__(self, key, value):
+        v = value._jx if isinstance(value, NDArray) else value
+        if isinstance(key, _py_slice) and key == _py_slice(None):
+            if np.isscalar(v):
+                self._jx = jnp.full_like(self._jx, v)
+            else:
+                self._jx = jnp.broadcast_to(
+                    jnp.asarray(v, self._jx.dtype), self.shape)
+                self._jx = jax.device_put(self._jx, self._ctx.jax_device())
+        else:
+            self._jx = self._jx.at[self._idx(key)].set(v)
+
+    def __len__(self):
+        return self.shape[0]
+
+    def __iter__(self):
+        for i in range(self.shape[0]):
+            yield self[i]
+
+    # -- arithmetic -------------------------------------------------------
+    def _binop(self, other, fn):
+        o = other._jx if isinstance(other, NDArray) else other
+        return NDArray._from_jax(fn(self._jx, o), self._ctx)
+
+    def __add__(self, o):
+        return self._binop(o, jnp.add)
+
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        return self._binop(o, jnp.subtract)
+
+    def __rsub__(self, o):
+        return self._binop(o, lambda a, b: jnp.subtract(b, a))
+
+    def __mul__(self, o):
+        return self._binop(o, jnp.multiply)
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, o):
+        return self._binop(o, jnp.divide)
+
+    __div__ = __truediv__
+
+    def __rtruediv__(self, o):
+        return self._binop(o, lambda a, b: jnp.divide(b, a))
+
+    __rdiv__ = __rtruediv__
+
+    def __pow__(self, o):
+        return self._binop(o, jnp.power)
+
+    def __mod__(self, o):
+        return self._binop(o, jnp.mod)
+
+    def __neg__(self):
+        return NDArray._from_jax(-self._jx, self._ctx)
+
+    def __abs__(self):
+        return NDArray._from_jax(jnp.abs(self._jx), self._ctx)
+
+    def __iadd__(self, o):
+        self._jx = self._binop(o, jnp.add)._jx
+        return self
+
+    def __isub__(self, o):
+        self._jx = self._binop(o, jnp.subtract)._jx
+        return self
+
+    def __imul__(self, o):
+        self._jx = self._binop(o, jnp.multiply)._jx
+        return self
+
+    def __itruediv__(self, o):
+        self._jx = self._binop(o, jnp.divide)._jx
+        return self
+
+    def _cmp(self, o, fn):
+        return self._binop(o, lambda a, b: fn(a, b).astype(a.dtype))
+
+    def __eq__(self, o):
+        if o is None:
+            return False
+        return self._cmp(o, jnp.equal)
+
+    def __ne__(self, o):
+        if o is None:
+            return True
+        return self._cmp(o, jnp.not_equal)
+
+    def __gt__(self, o):
+        return self._cmp(o, jnp.greater)
+
+    def __ge__(self, o):
+        return self._cmp(o, jnp.greater_equal)
+
+    def __lt__(self, o):
+        return self._cmp(o, jnp.less)
+
+    def __le__(self, o):
+        return self._cmp(o, jnp.less_equal)
+
+    __hash__ = object.__hash__
+
+    def __bool__(self):
+        if self.size == 1:
+            return bool(self.asscalar())
+        raise MXNetError("ambiguous truth value of multi-element NDArray")
+
+    def __repr__(self):
+        return "<NDArray %s @%s>\n%s" % (
+            "x".join(str(s) for s in self.shape), self._ctx, self.asnumpy())
+
+    # -- persistence hooks ------------------------------------------------
+    def __getstate__(self):
+        return {"data": self.asnumpy(), "ctx_type": self._ctx.device_typeid,
+                "ctx_id": self._ctx.device_id}
+
+    def __setstate__(self, st):
+        ctx = Context(st["ctx_type"], st["ctx_id"])
+        try:
+            dev = ctx.jax_device()
+        except Exception:
+            ctx = Context("cpu", 0)
+            dev = ctx.jax_device()
+        self._jx = jax.device_put(st["data"], dev)
+        self._ctx = ctx
+
+
+# ---------------------------------------------------------------------------
+# creation functions (reference python/mxnet/ndarray.py factory fns)
+# ---------------------------------------------------------------------------
+def array(source_array, ctx=None, dtype=None):
+    return NDArray(source_array, ctx=ctx, dtype=dtype)
+
+
+def empty(shape, ctx=None, dtype=None):
+    return zeros(shape, ctx=ctx, dtype=dtype)
+
+
+def zeros(shape, ctx=None, dtype=None):
+    ctx = ctx or current_context()
+    if isinstance(shape, int):
+        shape = (shape,)
+    return NDArray._from_jax(
+        jax.device_put(jnp.zeros(shape, _np_dtype(dtype)), ctx.jax_device()), ctx)
+
+
+def ones(shape, ctx=None, dtype=None):
+    ctx = ctx or current_context()
+    if isinstance(shape, int):
+        shape = (shape,)
+    return NDArray._from_jax(
+        jax.device_put(jnp.ones(shape, _np_dtype(dtype)), ctx.jax_device()), ctx)
+
+
+def full(shape, val, ctx=None, dtype=None):
+    ctx = ctx or current_context()
+    if isinstance(shape, int):
+        shape = (shape,)
+    return NDArray._from_jax(
+        jax.device_put(jnp.full(shape, val, _np_dtype(dtype)), ctx.jax_device()),
+        ctx)
+
+
+def arange(start, stop=None, step=1.0, repeat=1, ctx=None, dtype="float32"):
+    ctx = ctx or current_context()
+    a = jnp.arange(start, stop, step, dtype=_np_dtype(dtype))
+    if repeat > 1:
+        a = jnp.repeat(a, repeat)
+    return NDArray._from_jax(jax.device_put(a, ctx.jax_device()), ctx)
+
+
+def concatenate(arrays, axis=0, always_copy=True):
+    return NDArray._from_jax(
+        jnp.concatenate([a._jx for a in arrays], axis=axis), arrays[0]._ctx)
+
+
+def onehot_encode(indices, out):
+    """legacy ``_onehot_encode`` (``ndarray.cc:748-867``)"""
+    depth = out.shape[1]
+    out._jx = jax.nn.one_hot(indices._jx.astype(jnp.int32), depth,
+                             dtype=out._jx.dtype)
+    return out
+
+
+def imdecode(str_img, clip_rect=(0, 0, 0, 0), out=None, index=0, channels=3,
+             mean=None):
+    """Decode an image buffer (reference ``_imdecode``). Uses PIL if present."""
+    import io as _io
+
+    try:
+        from PIL import Image
+    except ImportError as e:
+        raise MXNetError("imdecode requires PIL") from e
+    img = Image.open(_io.BytesIO(str_img if isinstance(str_img, bytes)
+                                 else str_img.encode()))
+    arr = np.asarray(img.convert("RGB" if channels == 3 else "L"),
+                     dtype=np.float32)
+    if arr.ndim == 2:
+        arr = arr[:, :, None]
+    arr = arr.transpose(2, 0, 1)[None]
+    if mean is not None:
+        arr = arr - mean.asnumpy()
+    return array(arr)
+
+
+def waitall():
+    """reference MXNDArrayWaitAll — barrier on all async work."""
+    (jax.device_put(0.0) + 0).block_until_ready()
+
+
+# ---------------------------------------------------------------------------
+# save / load — same API as reference ``nd.save/load`` (``ndarray.py:1740``).
+# Container format is ours (npz), not the dmlc magic-header stream.
+# ---------------------------------------------------------------------------
+def save(fname, data):
+    if isinstance(data, NDArray):
+        data = [data]
+    if isinstance(data, dict):
+        payload = {"d:" + k: v.asnumpy() for k, v in data.items()}
+    elif isinstance(data, (list, tuple)):
+        payload = {"l:%09d" % i: v.asnumpy() for i, v in enumerate(data)}
+    else:
+        raise MXNetError("save: need NDArray, list, or dict")
+    np.savez(fname if str(fname).endswith(".npz") else str(fname), **payload)
+
+
+def _load_path(fname):
+    import os
+
+    # np.savez appends .npz; accept either spelling on load
+    for cand in (fname, str(fname) + ".npz"):
+        if os.path.exists(cand):
+            return cand
+    raise IOError("no such file: %r" % fname)
+
+
+def load(fname):
+    with np.load(_load_path(fname)) as f:
+        keys = sorted(f.files)
+        if not keys:
+            return {}
+        if keys[0].startswith("l:"):
+            return [array(f[k]) for k in keys]
+        return {k[2:]: array(f[k]) for k in keys}
+
+
+# ---------------------------------------------------------------------------
+# op-function generation (the _init_ndarray_module analog)
+# ---------------------------------------------------------------------------
+def _invoke(op, args, kwargs):
+    out = kwargs.pop("out", None)
+    kwargs.pop("name", None)
+    ctx = kwargs.pop("ctx", None)
+    # split NDArray kwargs (named inputs) from attr kwargs
+    named_inputs = {k: v for k, v in kwargs.items() if isinstance(v, NDArray)}
+    attr_kwargs = {k: v for k, v in kwargs.items() if not isinstance(v, NDArray)}
+    pos_inputs = [a for a in args if isinstance(a, NDArray)]
+    attr_args = [a for a in args if not isinstance(a, NDArray)]
+    if attr_args:
+        raise MXNetError("%s: non-NDArray positional args not supported; "
+                         "pass params by keyword" % op.name)
+    if op.key_var_num_args and op.key_var_num_args not in attr_kwargs:
+        attr_kwargs[op.key_var_num_args] = len(pos_inputs) + len(named_inputs)
+    attrs = op.canonicalize_attrs(attr_kwargs)
+    arg_names = op.list_arguments(attrs)
+    aux_names = op.list_aux_states(attrs)
+
+    inputs = []
+    aux_arrays = []
+    pi = iter(pos_inputs)
+    consumed_pos = 0
+    for nm in arg_names:
+        if nm in named_inputs:
+            inputs.append(named_inputs.pop(nm))
+        else:
+            try:
+                inputs.append(next(pi))
+                consumed_pos += 1
+            except StopIteration:
+                raise MXNetError("%s: missing input %r" % (op.name, nm))
+    for nm in aux_names:
+        if nm in named_inputs:
+            aux_arrays.append(named_inputs.pop(nm))
+        else:
+            try:
+                aux_arrays.append(next(pi))
+            except StopIteration:
+                raise MXNetError("%s: missing aux state %r" % (op.name, nm))
+    if named_inputs:
+        raise MXNetError("%s: unknown input kwargs %s"
+                         % (op.name, sorted(named_inputs)))
+
+    rng = _random.next_key() if op.needs_rng else None
+    fn = _reg.jitted_apply(op.name, _reg.attrs_key(attrs), True)
+    if inputs:
+        octx = inputs[0]._ctx
+        outs, aux_up = fn([x._jx for x in inputs],
+                          [x._jx for x in aux_arrays], rng)
+    else:
+        octx = ctx or current_context()
+        with jax.default_device(octx.jax_device()):
+            outs, aux_up = fn([], [], rng)
+    # write aux updates back (reference mutates aux NDArrays in the op)
+    for arr, new in zip(aux_arrays, aux_up or []):
+        arr._jx = new
+    results = [NDArray._from_jax(o, octx) for o in outs]
+    if out is not None:
+        outs_list = [out] if isinstance(out, NDArray) else list(out)
+        for dst, src in zip(outs_list, results):
+            dst._jx = src._jx
+        return out
+    return results[0] if len(results) == 1 else results
+
+
+def _make_op_func(op_name):
+    op = _reg.get(op_name)
+
+    def fn(*args, **kwargs):
+        return _invoke(op, args, kwargs)
+
+    fn.__name__ = op_name
+    fn.__doc__ = op.doc or ("TPU-native op %r (see mxnet_tpu.ops)" % op_name)
+    return fn
+
+
+def _init_ndarray_module():
+    mod = sys.modules[__name__]
+    for name in _reg.list_ops():
+        if not hasattr(mod, name):
+            setattr(mod, name, _make_op_func(name))
+
+
+_init_ndarray_module()

@@ -1,0 +1,19 @@
+"""Operator registry + implementations (single registration system).
+
+Importing this package registers every op (SURVEY §2.3 census).  Both
+``mx.nd`` and ``mx.sym`` namespaces are generated from this registry.
+"""
+
+from . import registry
+from .registry import OpDef, get, list_ops, register
+
+# registration side effects
+from . import elemwise  # noqa: F401
+from . import broadcast_reduce  # noqa: F401
+from . import matrix  # noqa: F401
+from . import indexing  # noqa: F401
+from . import nn  # noqa: F401
+from . import loss  # noqa: F401
+from . import optimizer_op  # noqa: F401
+
+__all__ = ["registry", "OpDef", "get", "list_ops", "register"]

@@ -1,0 +1,133 @@
+"""Broadcasting binary ops and reductions.
+
+Census source: reference ``src/operator/tensor/elemwise_binary_broadcast_op*``
+and ``broadcast_reduce_op_value.cc`` / ``broadcast_reduce_op_index.cc``
+(SURVEY §2.3).  XLA broadcasts/reduces natively; the reference's explicit
+broadcast-shape machinery collapses into jnp semantics.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from .helpers import binary, simple
+from .registry import REQUIRED, pbool, pfloat, pint, ptuple, register
+
+
+def _axis_param(v):
+    """axis: None | int | tuple-of-int; () means 'reduce all' (reference
+    convention for the default axis=())"""
+    if v is None or v == "None":
+        return None
+    if isinstance(v, str):
+        import ast
+
+        v = ast.literal_eval(v)
+    if isinstance(v, (int, np.integer)):
+        return (int(v),)
+    t = tuple(int(x) for x in v)
+    return t if t else None  # () -> reduce over everything
+
+
+def _f(fn):
+    def g(a, b):
+        return fn(a, b).astype(a.dtype)
+
+    return g
+
+
+# -- broadcast binary -------------------------------------------------------
+binary("broadcast_add", jnp.add, aliases=("broadcast_plus",))
+binary("broadcast_sub", jnp.subtract, aliases=("broadcast_minus",))
+binary("broadcast_mul", jnp.multiply)
+binary("broadcast_div", jnp.divide)
+binary("broadcast_power", jnp.power)
+binary("broadcast_maximum", jnp.maximum)
+binary("broadcast_minimum", jnp.minimum)
+binary("broadcast_hypot", jnp.hypot)
+binary("broadcast_equal", _f(jnp.equal))
+binary("broadcast_not_equal", _f(jnp.not_equal))
+binary("broadcast_greater", _f(jnp.greater))
+binary("broadcast_greater_equal", _f(jnp.greater_equal))
+binary("broadcast_lesser", _f(jnp.less))
+binary("broadcast_lesser_equal", _f(jnp.less_equal))
+
+
+# -- broadcast shape ops ----------------------------------------------------
+def _broadcast_to(data, shape):
+    # reference semantics: 0 in target shape keeps the input dim
+    tgt = tuple(int(s) if int(s) != 0 else int(d) for s, d in zip(shape, data.shape))
+    return jnp.broadcast_to(data, tgt)
+
+
+simple("broadcast_to", _broadcast_to, params={"shape": (ptuple, REQUIRED)})
+
+
+def _broadcast_axis(data, axis, size):
+    tgt = list(data.shape)
+    for ax, s in zip(axis, size):
+        if data.shape[ax] != 1:
+            raise ValueError("broadcast_axis: input dim %d must be 1" % ax)
+        tgt[ax] = s
+    return jnp.broadcast_to(data, tuple(tgt))
+
+
+simple("broadcast_axis", _broadcast_axis,
+       params={"axis": (ptuple, REQUIRED), "size": (ptuple, REQUIRED)},
+       aliases=("broadcast_axes",))
+
+
+# -- reductions -------------------------------------------------------------
+def _reduce(fn, nan_to_num=None):
+    def g(data, axis, keepdims, exclude=False):
+        ax = axis
+        if ax is not None and exclude:
+            ax = tuple(i for i in range(data.ndim) if i not in
+                       tuple(a % data.ndim for a in ax))
+        x = data
+        if nan_to_num is not None:
+            x = jnp.where(jnp.isnan(x), jnp.asarray(nan_to_num, x.dtype), x)
+        return fn(x, axis=ax, keepdims=keepdims)
+
+    return g
+
+
+_REDUCE_PARAMS = {
+    "axis": (_axis_param, None),
+    "keepdims": (pbool, False),
+    "exclude": (pbool, False),
+}
+
+simple("sum", _reduce(jnp.sum), params=_REDUCE_PARAMS, aliases=("sum_axis",))
+simple("mean", _reduce(jnp.mean), params=_REDUCE_PARAMS)
+simple("prod", _reduce(jnp.prod), params=_REDUCE_PARAMS)
+simple("nansum", _reduce(jnp.sum, nan_to_num=0.0), params=_REDUCE_PARAMS)
+simple("nanprod", _reduce(jnp.prod, nan_to_num=1.0), params=_REDUCE_PARAMS)
+simple("max", _reduce(jnp.max), params=_REDUCE_PARAMS, aliases=("max_axis",))
+simple("min", _reduce(jnp.min), params=_REDUCE_PARAMS, aliases=("min_axis",))
+
+# norm: reference 0.9.5 reduces ALL elements to shape (1,) L2 norm
+# (``broadcast_reduce_op_value.cc`` norm).
+simple("norm", lambda data: jnp.sqrt(jnp.sum(jnp.square(data))).reshape((1,)))
+
+
+def _arg_reduce(fn):
+    def g(data, axis, keepdims):
+        if axis is None:
+            res = fn(data.reshape(-1), axis=0)
+            res = res.reshape((1,) * data.ndim) if keepdims else res
+        else:
+            res = fn(data, axis=axis)
+            if keepdims:
+                res = jnp.expand_dims(res, axis)
+        return res.astype(data.dtype)
+
+    return g
+
+
+_ARG_PARAMS = {"axis": (lambda v: None if v in (None, "None") else pint(v), None),
+               "keepdims": (pbool, False)}
+simple("argmax", _arg_reduce(jnp.argmax), params=_ARG_PARAMS)
+simple("argmin", _arg_reduce(jnp.argmin), params=_ARG_PARAMS)
+simple("argmax_channel", lambda data: jnp.argmax(data, axis=1).astype(data.dtype))

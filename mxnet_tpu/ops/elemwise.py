@@ -1,0 +1,178 @@
+"""Elementwise unary/binary/scalar ops.
+
+Census source: reference ``src/operator/tensor/elemwise_unary_op.cc``,
+``elemwise_binary_op.cc``, ``elemwise_binary_scalar_op.cc``,
+``elemwise_binary_broadcast_op*`` registration lists (SURVEY §2.3).  All of
+these lower to single XLA HLO elementwise ops and fuse into neighbours; no
+hand-written kernels needed on TPU.
+
+Binary elemwise ops here require identical shapes (the reference's elemwise
+set is non-broadcasting; ``broadcast_*`` variants live in
+``broadcast_reduce.py``) — but like the reference's mshadow exprs we don't
+enforce it beyond what XLA checks.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .helpers import binary, simple, unary
+from .registry import REQUIRED, pdtype, pfloat, np_dtype, register
+
+try:
+    from jax.scipy.special import gamma as _gamma_fn
+except ImportError:  # older jax: build from gammaln (positive-arg domain)
+    from jax.scipy.special import gammaln
+
+    def _gamma_fn(x):
+        return jnp.exp(gammaln(x))
+
+from jax.scipy.special import gammaln as _gammaln_fn
+
+
+def _f(fn):
+    """Comparison results come back as the input float dtype (reference
+    convention: logic ops emit 0/1 in real_t)."""
+
+    def g(*args):
+        return fn(*args).astype(args[0].dtype)
+
+    return g
+
+
+# -- unary math (nnvm census: elemwise_unary_op.cc) -------------------------
+unary("_copy", lambda x: x, aliases=("identity",))
+unary("BlockGrad", jax.lax.stop_gradient, aliases=("stop_gradient",))
+unary("negative", jnp.negative)
+unary("abs", jnp.abs)
+unary("sign", jnp.sign)
+unary("round", jnp.round)
+unary("ceil", jnp.ceil)
+unary("floor", jnp.floor)
+unary("fix", jnp.trunc)
+unary("rint", jnp.rint)
+unary("square", jnp.square)
+unary("sqrt", jnp.sqrt)
+unary("rsqrt", jax.lax.rsqrt)
+unary("exp", jnp.exp)
+unary("log", jnp.log)
+unary("log2", jnp.log2)
+unary("log10", jnp.log10)
+unary("log1p", jnp.log1p)
+unary("expm1", jnp.expm1)
+unary("sin", jnp.sin)
+unary("cos", jnp.cos)
+unary("tan", jnp.tan)
+unary("arcsin", jnp.arcsin)
+unary("arccos", jnp.arccos)
+unary("arctan", jnp.arctan)
+unary("sinh", jnp.sinh)
+unary("cosh", jnp.cosh)
+unary("tanh", jnp.tanh)
+unary("arcsinh", jnp.arcsinh)
+unary("arccosh", jnp.arccosh)
+unary("arctanh", jnp.arctanh)
+unary("gamma", _gamma_fn)
+unary("gammaln", _gammaln_fn)
+unary("degrees", jnp.degrees)
+unary("radians", jnp.radians)
+unary("sigmoid", jax.nn.sigmoid)
+unary("relu", jax.nn.relu)
+
+simple("Cast", lambda data, dtype: data.astype(np_dtype(dtype)),
+       params={"dtype": (pdtype, REQUIRED)}, aliases=("cast",))
+
+simple(
+    "smooth_l1",
+    lambda data, scalar: jnp.where(
+        jnp.abs(data) < 1.0 / (scalar * scalar),
+        0.5 * jnp.square(scalar * data),
+        jnp.abs(data) - 0.5 / (scalar * scalar),
+    ),
+    params={"scalar": (pfloat, 1.0)},
+)
+
+
+# make_loss (nnvm version): identity forward, unit gradient scaled into the
+# graph — reference ``elemwise_unary_op.cc`` make_loss.
+@jax.custom_vjp
+def _make_loss(x):
+    return x
+
+
+def _make_loss_fwd(x):
+    return x, None
+
+
+def _make_loss_bwd(_, g):
+    return (jnp.ones_like(g),)
+
+
+_make_loss.defvjp(_make_loss_fwd, _make_loss_bwd)
+unary("make_loss", _make_loss)
+
+
+# -- binary elemwise (elemwise_binary_op.cc) --------------------------------
+binary("elemwise_add", jnp.add, aliases=("_plus", "_add"))
+binary("elemwise_sub", jnp.subtract, aliases=("_minus", "_sub"))
+binary("elemwise_mul", jnp.multiply, aliases=("_mul",))
+binary("elemwise_div", jnp.divide, aliases=("_div",))
+binary("_power", jnp.power)
+binary("_maximum", jnp.maximum)
+binary("_minimum", jnp.minimum)
+binary("_hypot", jnp.hypot)
+# _grad_add: same as add; exists so gradient accumulation is a distinct node
+# (reference uses it when two paths write one grad).
+binary("_grad_add", jnp.add)
+
+binary("_equal", _f(jnp.equal))
+binary("_not_equal", _f(jnp.not_equal))
+binary("_greater", _f(jnp.greater))
+binary("_greater_equal", _f(jnp.greater_equal))
+binary("_lesser", _f(jnp.less))
+binary("_lesser_equal", _f(jnp.less_equal))
+
+
+# -- scalar ops (elemwise_binary_scalar_op.cc) ------------------------------
+def _scalar_op(name, fn, aliases=()):
+    simple(name, lambda data, scalar: fn(data, jnp.asarray(scalar, data.dtype)),
+           params={"scalar": (pfloat, REQUIRED)}, aliases=aliases)
+
+
+_scalar_op("_plus_scalar", jnp.add)
+_scalar_op("_minus_scalar", jnp.subtract)
+_scalar_op("_rminus_scalar", lambda x, s: s - x)
+_scalar_op("_mul_scalar", jnp.multiply)
+_scalar_op("_div_scalar", jnp.divide)
+_scalar_op("_rdiv_scalar", lambda x, s: s / x)
+_scalar_op("_power_scalar", jnp.power)
+_scalar_op("_rpower_scalar", lambda x, s: jnp.power(s, x))
+_scalar_op("_maximum_scalar", jnp.maximum)
+_scalar_op("_minimum_scalar", jnp.minimum)
+_scalar_op("_hypot_scalar", jnp.hypot)
+_scalar_op("_equal_scalar", _f(jnp.equal))
+_scalar_op("_not_equal_scalar", _f(jnp.not_equal))
+_scalar_op("_greater_scalar", _f(jnp.greater))
+_scalar_op("_greater_equal_scalar", _f(jnp.greater_equal))
+_scalar_op("_lesser_scalar", _f(jnp.less))
+_scalar_op("_lesser_equal_scalar", _f(jnp.less_equal))
+
+
+# -- add_n / ElementWiseSum (variable arity) --------------------------------
+def _add_n_apply(attrs, inputs, aux, is_train, rng):
+    out = inputs[0]
+    for x in inputs[1:]:
+        out = out + x
+    return [out]
+
+
+register(
+    "add_n", _add_n_apply,
+    arguments=lambda attrs: ["arg%d" % i for i in range(attrs["num_args"])],
+    params={"num_args": (int, REQUIRED)},
+    key_var_num_args="num_args",
+    aliases=("ElementWiseSum", "_sum"),
+)

@@ -1,0 +1,26 @@
+"""Small adapters for registering plain jax functions as ops."""
+
+from __future__ import annotations
+
+from .registry import register
+
+
+def simple(name, fn, *, arguments=("data",), params=None, outputs=("output",),
+           aliases=(), **kw):
+    """Register ``fn(*inputs, **attrs) -> array`` as a single-output op."""
+
+    def apply(attrs, inputs, aux, is_train, rng):
+        return [fn(*inputs, **attrs)]
+
+    register(name, apply, arguments=arguments, params=params, outputs=outputs,
+             aliases=aliases, **kw)
+    return fn
+
+
+def unary(name, fn, aliases=(), **kw):
+    return simple(name, lambda x: fn(x), arguments=("data",), aliases=aliases, **kw)
+
+
+def binary(name, fn, aliases=(), **kw):
+    return simple(name, lambda lhs, rhs: fn(lhs, rhs), arguments=("lhs", "rhs"),
+                  aliases=aliases, **kw)

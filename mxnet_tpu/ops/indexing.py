@@ -1,0 +1,182 @@
+"""Indexing, ordering, sampling and init ops.
+
+Census source: reference ``src/operator/tensor/indexing_op.cc`` (Embedding/
+take/batch_take/one_hot), ``ordering_op.cc`` (topk/sort/argsort),
+``sample_op.cc`` (uniform/normal), ``init_op.cc`` (zeros/ones/arange/
+ones_like) — SURVEY §2.3.
+
+Sampling ops are the only rng consumers here: they take the rng key the
+runtime threads through (imperative: global `mx.random` state; symbolic:
+per-call key from the executor).  Gather/one-hot stay XLA-native so they fuse;
+sort/topk lower to XLA's sort HLO.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .helpers import simple
+from .registry import (REQUIRED, np_dtype, pbool, pdtype, pfloat, pint, pstr,
+                       ptuple, register)
+
+
+def _opt_int(v):
+    return None if v in (None, "None") else pint(v)
+
+
+# -- indexing ---------------------------------------------------------------
+def _embedding(data, weight, input_dim, output_dim, dtype):
+    return jnp.take(weight, data.astype(jnp.int32), axis=0)
+
+
+simple("Embedding", _embedding, arguments=("data", "weight"),
+       params={"input_dim": (pint, REQUIRED), "output_dim": (pint, REQUIRED),
+               "dtype": (pdtype, "float32")})
+
+simple("take", lambda a, indices, axis, mode: jnp.take(
+    a, indices.astype(jnp.int32), axis=axis,
+    mode={"clip": "clip", "wrap": "wrap"}.get(mode, "clip")),
+    arguments=("a", "indices"),
+    params={"axis": (pint, 0), "mode": (pstr, "clip")})
+
+
+def _batch_take(a, indices):
+    return jnp.take_along_axis(
+        a, indices.astype(jnp.int32).reshape(-1, 1), axis=1).reshape(-1)
+
+
+simple("batch_take", _batch_take, arguments=("a", "indices"))
+
+
+def _one_hot(indices, depth, on_value, off_value, dtype):
+    dt = np_dtype(dtype)
+    oh = jax.nn.one_hot(indices.astype(jnp.int32), depth, dtype=dt)
+    return oh * jnp.asarray(on_value, dt) + (1 - oh) * jnp.asarray(off_value, dt)
+
+
+simple("one_hot", _one_hot, arguments=("indices",),
+       params={"depth": (pint, REQUIRED), "on_value": (pfloat, 1.0),
+               "off_value": (pfloat, 0.0), "dtype": (pdtype, "float32")})
+
+
+def _fill_element_0index(lhs, mhs, rhs):
+    """lhs[i, rhs[i]] = mhs[i] (legacy NDArray fn, ``ndarray.cc:748-867``)."""
+    idx = rhs.astype(jnp.int32)
+    rows = jnp.arange(lhs.shape[0])
+    return lhs.at[rows, idx].set(mhs)
+
+
+simple("fill_element_0index", _fill_element_0index, arguments=("lhs", "mhs", "rhs"))
+
+
+# -- ordering ---------------------------------------------------------------
+def _topk(data, axis, k, ret_typ, is_ascend):
+    ax = axis if axis is not None else data.ndim - 1
+    k = k if k > 0 else data.shape[ax]
+    src = data if not is_ascend else -data
+    moved = jnp.moveaxis(src, ax, -1)
+    vals, idxs = jax.lax.top_k(moved, k)
+    if is_ascend:
+        vals = -vals
+    vals = jnp.moveaxis(vals, -1, ax)
+    idxs = jnp.moveaxis(idxs, -1, ax)
+    if ret_typ == "value":
+        return vals
+    if ret_typ == "indices":
+        return idxs.astype(data.dtype)
+    if ret_typ == "mask":
+        onehots = jax.nn.one_hot(jnp.moveaxis(idxs, ax, -1), moved.shape[-1],
+                                 dtype=data.dtype).sum(-2)
+        return jnp.moveaxis(onehots, -1, ax)
+    raise ValueError("topk: bad ret_typ %r" % ret_typ)
+
+
+def _topk_apply(attrs, inputs, aux, is_train, rng):
+    res = _topk(inputs[0], attrs["axis"], attrs["k"], attrs["ret_typ"],
+                attrs["is_ascend"])
+    if attrs["ret_typ"] == "both":
+        ax = attrs["axis"] if attrs["axis"] is not None else inputs[0].ndim - 1
+        # recompute both halves
+        vals = _topk(inputs[0], attrs["axis"], attrs["k"], "value", attrs["is_ascend"])
+        idxs = _topk(inputs[0], attrs["axis"], attrs["k"], "indices", attrs["is_ascend"])
+        return [vals, idxs]
+    return [res]
+
+
+register("topk", _topk_apply,
+         outputs=lambda attrs: ["output", "indices"] if attrs["ret_typ"] == "both"
+         else ["output"],
+         params={"axis": (_opt_int, -1), "k": (pint, 1),
+                 "ret_typ": (pstr, "indices"), "is_ascend": (pbool, False)})
+
+
+def _sort(data, axis, is_ascend):
+    s = jnp.sort(data, axis=axis)
+    return s if is_ascend else jnp.flip(s, axis=axis if axis is not None else 0)
+
+
+simple("sort", _sort, params={"axis": (_opt_int, -1), "is_ascend": (pbool, True)})
+
+
+def _argsort(data, axis, is_ascend):
+    s = jnp.argsort(data, axis=axis)
+    if not is_ascend:
+        s = jnp.flip(s, axis=axis if axis is not None else 0)
+    return s.astype(data.dtype)
+
+
+simple("argsort", _argsort, params={"axis": (_opt_int, -1), "is_ascend": (pbool, True)})
+
+
+# -- sampling ---------------------------------------------------------------
+def _sample_uniform(attrs, inputs, aux, is_train, rng):
+    dt = np_dtype(attrs["dtype"])
+    return [jax.random.uniform(rng, attrs["shape"], dtype=dt,
+                               minval=attrs["low"], maxval=attrs["high"])]
+
+
+register("_sample_uniform", _sample_uniform, arguments=(), needs_rng=True,
+         params={"low": (pfloat, 0.0), "high": (pfloat, 1.0),
+                 "shape": (ptuple, (1,)), "dtype": (pdtype, "float32")},
+         aliases=("uniform", "_random_uniform"))
+
+
+def _sample_normal(attrs, inputs, aux, is_train, rng):
+    dt = np_dtype(attrs["dtype"])
+    return [attrs["loc"] + attrs["scale"]
+            * jax.random.normal(rng, attrs["shape"], dtype=dt)]
+
+
+register("_sample_normal", _sample_normal, arguments=(), needs_rng=True,
+         params={"loc": (pfloat, 0.0), "scale": (pfloat, 1.0),
+                 "shape": (ptuple, (1,)), "dtype": (pdtype, "float32")},
+         aliases=("normal", "_random_normal"))
+
+
+# -- init ops ---------------------------------------------------------------
+def _init_params():
+    return {"shape": (ptuple, REQUIRED), "dtype": (pdtype, "float32")}
+
+
+simple("_zeros", lambda shape, dtype: jnp.zeros(shape, np_dtype(dtype)),
+       arguments=(), params=_init_params())
+simple("_ones", lambda shape, dtype: jnp.ones(shape, np_dtype(dtype)),
+       arguments=(), params=_init_params())
+
+
+def _arange(start, stop, step, repeat, dtype):
+    a = jnp.arange(start, stop, step, dtype=np_dtype(dtype))
+    return jnp.repeat(a, repeat) if repeat > 1 else a
+
+
+simple("_arange", _arange, arguments=(),
+       params={"start": (pfloat, 0.0),
+               "stop": (lambda v: None if v in (None, "None") else pfloat(v), None),
+               "step": (pfloat, 1.0), "repeat": (pint, 1),
+               "dtype": (pdtype, "float32")})
+
+simple("ones_like", jnp.ones_like)
+simple("zeros_like", jnp.zeros_like)
+simple("_identity_with_attr_like_rhs", lambda lhs, rhs: lhs,
+       arguments=("lhs", "rhs"))

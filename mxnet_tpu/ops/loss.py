@@ -1,0 +1,265 @@
+"""Loss/output ops with the reference's bespoke backward semantics.
+
+Reference kernels: ``src/operator/softmax_output-inl.h`` (SoftmaxOutput:
+forward=softmax, backward=p-onehot(label), never d(softmax)),
+``regression_output-inl.h`` (Linear/Logistic/MAERegressionOutput),
+``make_loss-inl.h``, ``svm_output-inl.h``,
+``src/operator/tensor/loss_binary_op.cc`` (softmax_cross_entropy),
+``src/operator/nn/softmax.cc``.
+
+These backward rules are NOT the autodiff gradients of the forward function —
+each is wired in with ``jax.custom_vjp`` so ``Executor.backward`` (plain
+jax.vjp over the whole graph) reproduces the reference semantics exactly.
+The custom-vjp callables are cached per attr-set so repeated jit traces reuse
+one primitive.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+
+import jax
+import jax.numpy as jnp
+
+from .helpers import simple
+from .registry import REQUIRED, pbool, pfloat, pint, pstr, register
+
+
+def _opt_int(v):
+    return None if v in (None, "None") else pint(v)
+
+
+# -- plain softmax family (autodiff backward is correct for these) ----------
+simple("softmax", lambda data, axis, temperature: jax.nn.softmax(
+    data / (temperature or 1.0), axis=axis),
+    params={"axis": (pint, -1), "temperature": (pfloat, 1.0)})
+simple("log_softmax", lambda data, axis, temperature: jax.nn.log_softmax(
+    data / (temperature or 1.0), axis=axis),
+    params={"axis": (pint, -1), "temperature": (pfloat, 1.0)})
+
+
+def _softmax_cross_entropy(data, label):
+    logp = jax.nn.log_softmax(data, axis=-1)
+    picked = jnp.take_along_axis(
+        logp, jax.lax.stop_gradient(label).astype(jnp.int32)[:, None], axis=1)
+    return -jnp.sum(picked).reshape((1,))
+
+
+simple("softmax_cross_entropy", _softmax_cross_entropy,
+       arguments=("data", "label"))
+
+
+# -- SoftmaxOutput ----------------------------------------------------------
+@lru_cache(maxsize=None)
+def _softmax_output_fn(grad_scale, ignore_label, multi_output, use_ignore,
+                       preserve_shape, normalization, out_grad):
+    """Build the custom-vjp softmax-output for one attr set."""
+
+    def _softmax(data):
+        if multi_output:
+            return jax.nn.softmax(data, axis=1)
+        if preserve_shape:
+            return jax.nn.softmax(data, axis=-1)
+        flat = data.reshape(data.shape[0], -1)
+        return jax.nn.softmax(flat, axis=-1).reshape(data.shape)
+
+    @jax.custom_vjp
+    def f(data, label):
+        return _softmax(data)
+
+    def fwd(data, label):
+        p = _softmax(data)
+        return p, (p, label)
+
+    def bwd(res, g):
+        p, label = res
+        lab = label.astype(jnp.int32)
+        axis = 1 if multi_output else (p.ndim - 1)
+        onehot = jax.nn.one_hot(lab, p.shape[axis], dtype=p.dtype, axis=axis)
+        grad = p - onehot
+        valid = jnp.ones_like(label, dtype=p.dtype)
+        if use_ignore:
+            valid = (label != ignore_label).astype(p.dtype)
+            vshape = list(label.shape)
+            vshape.insert(axis, 1) if multi_output or p.ndim != label.ndim + 1 \
+                else None
+            grad = grad * jnp.expand_dims(valid, axis)
+        scale = grad_scale
+        if normalization == "batch":
+            scale = scale / p.shape[0]
+        elif normalization == "valid":
+            scale = scale / jnp.maximum(jnp.sum(valid), 1.0)
+        grad = grad * scale
+        if out_grad:
+            grad = grad * g
+        return grad.astype(p.dtype), jnp.zeros_like(label)
+
+    f.defvjp(fwd, bwd)
+    return f
+
+
+def _softmax_output(attrs, inputs, aux, is_train, rng):
+    f = _softmax_output_fn(attrs["grad_scale"], attrs["ignore_label"],
+                           attrs["multi_output"], attrs["use_ignore"],
+                           attrs["preserve_shape"], attrs["normalization"],
+                           attrs["out_grad"])
+    return [f(inputs[0], inputs[1])]
+
+
+register("SoftmaxOutput", _softmax_output, arguments=("data", "label"),
+         params={"grad_scale": (pfloat, 1.0), "ignore_label": (pfloat, -1.0),
+                 "multi_output": (pbool, False), "use_ignore": (pbool, False),
+                 "preserve_shape": (pbool, False),
+                 "normalization": (pstr, "null"), "out_grad": (pbool, False)},
+         aliases=("Softmax",), hint="softmaxoutput")
+
+
+# -- regression outputs -----------------------------------------------------
+@lru_cache(maxsize=None)
+def _regression_fn(kind, grad_scale):
+    def _fwd_val(data):
+        return jax.nn.sigmoid(data) if kind == "logistic" else data
+
+    @jax.custom_vjp
+    def f(data, label):
+        return _fwd_val(data)
+
+    def fwd(data, label):
+        out = _fwd_val(data)
+        return out, (out, label)
+
+    def bwd(res, g):
+        out, label = res
+        lab = label.reshape(out.shape).astype(out.dtype)
+        # reference scale: grad_scale / num_output  (outputs per sample)
+        num_output = 1
+        for d in out.shape[1:]:
+            num_output *= d
+        if kind == "mae":
+            grad = jnp.sign(out - lab)
+        else:  # linear & logistic share (out - label)
+            grad = out - lab
+        return (grad * (grad_scale / num_output)).astype(out.dtype), \
+            jnp.zeros_like(label)
+
+    f.defvjp(fwd, bwd)
+    return f
+
+
+def _make_regression(name, kind):
+    def apply(attrs, inputs, aux, is_train, rng):
+        f = _regression_fn(kind, attrs["grad_scale"])
+        return [f(inputs[0], inputs[1])]
+
+    register(name, apply, arguments=("data", "label"),
+             params={"grad_scale": (pfloat, 1.0)}, hint=name.lower())
+
+
+_make_regression("LinearRegressionOutput", "linear")
+_make_regression("LogisticRegressionOutput", "logistic")
+_make_regression("MAERegressionOutput", "mae")
+
+
+# -- MakeLoss (legacy op) ---------------------------------------------------
+@lru_cache(maxsize=None)
+def _make_loss_fn(grad_scale, valid_thresh, normalization):
+    @jax.custom_vjp
+    def f(data):
+        return data
+
+    def fwd(data):
+        return data, data
+
+    def bwd(data, g):
+        scale = grad_scale
+        if normalization == "batch":
+            scale = scale / data.shape[0]
+        elif normalization == "valid":
+            valid = jnp.sum((data > valid_thresh).astype(data.dtype))
+            scale = scale / jnp.maximum(valid, 1.0)
+        return (jnp.full_like(data, 1.0) * scale,)
+
+    f.defvjp(fwd, bwd)
+    return f
+
+
+def _make_loss_op(attrs, inputs, aux, is_train, rng):
+    f = _make_loss_fn(attrs["grad_scale"], attrs["valid_thresh"],
+                      attrs["normalization"])
+    return [f(inputs[0])]
+
+
+register("MakeLoss", _make_loss_op,
+         params={"grad_scale": (pfloat, 1.0), "valid_thresh": (pfloat, 0.0),
+                 "normalization": (pstr, "null")}, hint="makeloss")
+
+
+# -- SVMOutput --------------------------------------------------------------
+@lru_cache(maxsize=None)
+def _svm_fn(margin, reg_coef, use_linear):
+    @jax.custom_vjp
+    def f(data, label):
+        return data
+
+    def fwd(data, label):
+        return data, (data, label)
+
+    def bwd(res, g):
+        scores, label = res
+        lab = label.astype(jnp.int32)
+        true_score = jnp.take_along_axis(scores, lab[:, None], axis=1)
+        viol = jnp.maximum(0.0, margin - (true_score - scores))
+        onehot = jax.nn.one_hot(lab, scores.shape[1], dtype=scores.dtype)
+        if use_linear:
+            gother = (viol > 0).astype(scores.dtype) * reg_coef
+        else:
+            gother = 2.0 * viol * reg_coef
+        gother = gother * (1.0 - onehot)
+        gtrue = -jnp.sum(gother, axis=1, keepdims=True)
+        grad = gother + onehot * gtrue
+        return grad.astype(scores.dtype), jnp.zeros_like(label)
+
+    f.defvjp(fwd, bwd)
+    return f
+
+
+def _svm_output(attrs, inputs, aux, is_train, rng):
+    f = _svm_fn(attrs["margin"], attrs["regularization_coefficient"],
+                attrs["use_linear"])
+    return [f(inputs[0], inputs[1])]
+
+
+register("SVMOutput", _svm_output, arguments=("data", "label"),
+         params={"margin": (pfloat, 1.0),
+                 "regularization_coefficient": (pfloat, 1.0),
+                 "use_linear": (pbool, False)}, hint="svmoutput")
+
+
+# -- IdentityAttachKLSparseReg ---------------------------------------------
+@lru_cache(maxsize=None)
+def _kl_sparse_fn(sparseness_target, penalty):
+    @jax.custom_vjp
+    def f(data):
+        return data
+
+    def fwd(data):
+        return data, data
+
+    def bwd(data, g):
+        rho_hat = jnp.mean(jax.nn.sigmoid(data), axis=0, keepdims=True)
+        rho = sparseness_target
+        grad_kl = penalty * (-rho / rho_hat + (1 - rho) / (1 - rho_hat))
+        return (g + jnp.broadcast_to(grad_kl, data.shape).astype(data.dtype),)
+
+    f.defvjp(fwd, bwd)
+    return f
+
+
+def _kl_sparse(attrs, inputs, aux, is_train, rng):
+    f = _kl_sparse_fn(attrs["sparseness_target"], attrs["penalty"])
+    return [f(inputs[0])]
+
+
+register("IdentityAttachKLSparseReg", _kl_sparse,
+         params={"sparseness_target": (pfloat, 0.1), "penalty": (pfloat, 0.001),
+                 "momentum": (pfloat, 0.9)}, hint="identityattachklsparsereg")

@@ -1,0 +1,173 @@
+"""Matrix/shape-manipulation ops.
+
+Census source: reference ``src/operator/tensor/matrix_op.cc`` (SURVEY §2.3):
+transpose/reshape/dot/batch_dot/slice/flip/clip/repeat/tile + expand_dims,
+Flatten, SwapAxis, where, pick.  ``dot``/``batch_dot`` are the MXU ops — they
+lower straight to XLA dot_general and inherit bf16 MXU tiling.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from .helpers import simple
+from .registry import REQUIRED, pbool, pfloat, pint, ptuple, register
+
+
+def _opt_tuple(v):
+    if v in (None, "None"):
+        return None
+    return ptuple(v)
+
+
+def _opt_int(v):
+    if v in (None, "None"):
+        return None
+    return pint(v)
+
+
+# -- dot family (MXU path) --------------------------------------------------
+def _dot(lhs, rhs, transpose_a, transpose_b):
+    a = lhs.T if transpose_a else lhs
+    b = rhs.T if transpose_b else rhs
+    # preferred_element_type keeps f32 accumulation for bf16 inputs (MXU native)
+    return jax.lax.dot(a, b) if a.ndim == 2 and b.ndim == 2 else jnp.dot(a, b)
+
+
+simple("dot", _dot, arguments=("lhs", "rhs"),
+       params={"transpose_a": (pbool, False), "transpose_b": (pbool, False)})
+
+
+def _batch_dot(lhs, rhs, transpose_a, transpose_b):
+    a = jnp.swapaxes(lhs, -1, -2) if transpose_a else lhs
+    b = jnp.swapaxes(rhs, -1, -2) if transpose_b else rhs
+    return jnp.matmul(a, b)
+
+
+simple("batch_dot", _batch_dot, arguments=("lhs", "rhs"),
+       params={"transpose_a": (pbool, False), "transpose_b": (pbool, False)})
+
+
+# -- shape ops --------------------------------------------------------------
+def _transpose(data, axes):
+    return jnp.transpose(data, axes if axes else None)
+
+
+simple("transpose", _transpose, params={"axes": (_opt_tuple, None)})
+
+simple("expand_dims", lambda data, axis: jnp.expand_dims(data, axis),
+       params={"axis": (pint, REQUIRED)})
+
+simple("Flatten", lambda data: data.reshape(data.shape[0], -1),
+       aliases=("flatten",))
+
+
+def _infer_reshape(shape, src):
+    """MXNet reshape codes (reference matrix_op ReshapeParam): 0=keep dim,
+    -1=infer, -2=copy rest, -3=merge next two, -4=split (next 2 entries)."""
+    out, i = [], 0
+    src = list(src)
+    it = iter(range(len(shape)))
+    k = 0
+    while k < len(shape):
+        s = shape[k]
+        if s > 0:
+            out.append(s)
+            i += 1
+        elif s == 0:
+            out.append(src[i])
+            i += 1
+        elif s == -1:
+            out.append(-1)
+            i += 1
+        elif s == -2:
+            out.extend(src[i:])
+            i = len(src)
+        elif s == -3:
+            out.append(src[i] * src[i + 1])
+            i += 2
+        elif s == -4:
+            d1, d2 = shape[k + 1], shape[k + 2]
+            if d1 == -1:
+                d1 = src[i] // d2
+            if d2 == -1:
+                d2 = src[i] // d1
+            out.extend([d1, d2])
+            i += 1
+            k += 2
+        else:
+            raise MXNetError("reshape: bad code %d" % s)
+        k += 1
+    return tuple(out)
+
+
+def _reshape(data, shape, target_shape, keep_highest, reverse):
+    if not shape and target_shape:
+        # deprecated legacy path (reference ReshapeParam.target_shape)
+        tgt = list(target_shape)
+        if keep_highest:
+            tgt[0] = data.shape[0]
+        return data.reshape(tuple(tgt))
+    if reverse:
+        rs = _infer_reshape(tuple(reversed(shape)), tuple(reversed(data.shape)))
+        return data.reshape(tuple(reversed(rs)))
+    return data.reshape(_infer_reshape(shape, data.shape))
+
+
+simple("Reshape", _reshape,
+       params={"shape": (ptuple, ()), "target_shape": (ptuple, ()),
+               "keep_highest": (pbool, False), "reverse": (pbool, False)},
+       aliases=("reshape",))
+
+
+def _slice(data, begin, end):
+    idx = tuple(slice(b, e if e != 0 or b != 0 else None)
+                for b, e in zip(begin, end))
+    return data[idx]
+
+
+simple("slice", _slice, params={"begin": (ptuple, REQUIRED), "end": (ptuple, REQUIRED)},
+       aliases=("crop",))
+
+
+def _slice_axis(data, axis, begin, end):
+    end = end if end is not None else data.shape[axis]
+    idx = [slice(None)] * data.ndim
+    idx[axis] = slice(begin, end)
+    return data[tuple(idx)]
+
+
+simple("slice_axis", _slice_axis,
+       params={"axis": (pint, REQUIRED), "begin": (pint, REQUIRED),
+               "end": (_opt_int, None)})
+
+simple("clip", lambda data, a_min, a_max: jnp.clip(data, a_min, a_max),
+       params={"a_min": (pfloat, REQUIRED), "a_max": (pfloat, REQUIRED)})
+
+simple("repeat", lambda data, repeats, axis: jnp.repeat(data, repeats, axis=axis),
+       params={"repeats": (pint, REQUIRED), "axis": (_opt_int, None)})
+
+simple("tile", lambda data, reps: jnp.tile(data, reps),
+       params={"reps": (ptuple, REQUIRED)})
+
+simple("reverse", lambda data, axis: jnp.flip(data, axis),
+       params={"axis": (ptuple, REQUIRED)}, aliases=("flip",))
+
+simple("SwapAxis", lambda data, dim1, dim2: jnp.swapaxes(data, dim1, dim2),
+       params={"dim1": (pint, 0), "dim2": (pint, 0)}, aliases=("swapaxes",))
+
+simple("where", lambda condition, x, y: jnp.where(condition != 0, x, y),
+       arguments=("condition", "x", "y"))
+
+
+def _pick(data, index, axis, keepdims):
+    idx = index.astype(jnp.int32)
+    res = jnp.take_along_axis(data, jnp.expand_dims(idx, axis), axis=axis)
+    return res if keepdims else jnp.squeeze(res, axis)
+
+
+simple("pick", _pick, arguments=("data", "index"),
+       params={"axis": (_opt_int, -1), "keepdims": (pbool, False)},
+       aliases=("choose_element_0index",))

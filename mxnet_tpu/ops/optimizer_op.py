@@ -1,0 +1,109 @@
+"""Fused optimizer-update ops.
+
+Reference: ``src/operator/tensor/optimizer_op.cc`` — sgd_update,
+sgd_mom_update, adam_update, rmsprop_update, rmspropalex_update kernels
+(SURVEY §2.3).  The reference mutates state NDArrays in place; in this
+functional design each op RETURNS updated state as extra outputs and the
+``mx.nd`` wrapper / Optimizer class writes them back — one fused XLA
+computation per parameter either way (and the Module path fuses the whole
+multi-tensor update into the train step).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import REQUIRED, pfloat, register
+
+
+def _prep(grad, wd, weight, rescale_grad, clip_gradient):
+    g = grad * rescale_grad
+    if clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    return g + wd * weight
+
+
+_COMMON = {"lr": (pfloat, REQUIRED), "wd": (pfloat, 0.0),
+           "rescale_grad": (pfloat, 1.0), "clip_gradient": (pfloat, -1.0)}
+
+
+def _sgd_update(attrs, inputs, aux, is_train, rng):
+    weight, grad = inputs
+    g = _prep(grad, attrs["wd"], weight, attrs["rescale_grad"],
+              attrs["clip_gradient"])
+    return [weight - attrs["lr"] * g]
+
+
+register("sgd_update", _sgd_update, arguments=("weight", "grad"),
+         params=dict(_COMMON))
+
+
+def _sgd_mom_update(attrs, inputs, aux, is_train, rng):
+    weight, grad, mom = inputs
+    g = _prep(grad, attrs["wd"], weight, attrs["rescale_grad"],
+              attrs["clip_gradient"])
+    new_mom = attrs["momentum"] * mom - attrs["lr"] * g
+    return [weight + new_mom, new_mom]
+
+
+register("sgd_mom_update", _sgd_mom_update, arguments=("weight", "grad", "mom"),
+         outputs=("output", "mom"),
+         params={**_COMMON, "momentum": (pfloat, 0.0)})
+
+
+def _adam_update(attrs, inputs, aux, is_train, rng):
+    weight, grad, mean, var = inputs
+    g = _prep(grad, attrs["wd"], weight, attrs["rescale_grad"],
+              attrs["clip_gradient"])
+    b1, b2 = attrs["beta1"], attrs["beta2"]
+    new_mean = b1 * mean + (1 - b1) * g
+    new_var = b2 * var + (1 - b2) * jnp.square(g)
+    upd = attrs["lr"] * new_mean / (jnp.sqrt(new_var) + attrs["epsilon"])
+    return [weight - upd, new_mean, new_var]
+
+
+register("adam_update", _adam_update,
+         arguments=("weight", "grad", "mean", "var"),
+         outputs=("output", "mean", "var"),
+         params={**_COMMON, "beta1": (pfloat, 0.9), "beta2": (pfloat, 0.999),
+                 "epsilon": (pfloat, 1e-8)})
+
+
+def _rmsprop_update(attrs, inputs, aux, is_train, rng):
+    weight, grad, n = inputs
+    g = _prep(grad, attrs["wd"], weight, attrs["rescale_grad"],
+              attrs["clip_gradient"])
+    g1 = attrs["gamma1"]
+    new_n = (1 - g1) * jnp.square(g) + g1 * n
+    new_w = weight - attrs["lr"] * g / jnp.sqrt(new_n + attrs["epsilon"])
+    if attrs["clip_weights"] > 0:
+        new_w = jnp.clip(new_w, -attrs["clip_weights"], attrs["clip_weights"])
+    return [new_w, new_n]
+
+
+register("rmsprop_update", _rmsprop_update, arguments=("weight", "grad", "n"),
+         outputs=("output", "n"),
+         params={**_COMMON, "gamma1": (pfloat, 0.95), "epsilon": (pfloat, 1e-8),
+                 "clip_weights": (pfloat, -1.0)})
+
+
+def _rmspropalex_update(attrs, inputs, aux, is_train, rng):
+    weight, grad, n, g_state, delta = inputs
+    g = _prep(grad, attrs["wd"], weight, attrs["rescale_grad"],
+              attrs["clip_gradient"])
+    g1, g2 = attrs["gamma1"], attrs["gamma2"]
+    new_n = (1 - g1) * jnp.square(g) + g1 * n
+    new_g = (1 - g1) * g + g1 * g_state
+    new_delta = g2 * delta - attrs["lr"] * g / jnp.sqrt(
+        new_n - jnp.square(new_g) + attrs["epsilon"])
+    new_w = weight + new_delta
+    if attrs["clip_weights"] > 0:
+        new_w = jnp.clip(new_w, -attrs["clip_weights"], attrs["clip_weights"])
+    return [new_w, new_n, new_g, new_delta]
+
+
+register("rmspropalex_update", _rmspropalex_update,
+         arguments=("weight", "grad", "n", "g", "delta"),
+         outputs=("output", "n", "g", "delta"),
+         params={**_COMMON, "gamma1": (pfloat, 0.95), "gamma2": (pfloat, 0.9),
+                 "epsilon": (pfloat, 1e-8), "clip_weights": (pfloat, -1.0)})

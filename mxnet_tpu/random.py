@@ -1,0 +1,60 @@
+"""Global random state (``mx.random``).
+
+Reference: ``python/mxnet/random.py`` + ``MXRandomSeed`` (seed is global,
+per-device generators live in the resource manager, ``src/resource.cc:66``).
+JAX PRNG is explicit-key, so the framework keeps one global key and splits
+off a subkey per imperative sampling call; symbolic executors fold a per-call
+key in as a hidden input (see ``executor.py``).  ``mx.random.seed(n)`` makes
+everything reproducible exactly like the reference's global seed.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import jax
+
+__all__ = ["seed", "next_key", "uniform", "normal", "randint"]
+
+_lock = threading.Lock()
+_key = jax.random.PRNGKey(0)
+
+
+def seed(seed_state):
+    """reference ``random.py:40`` / MXRandomSeed"""
+    global _key
+    with _lock:
+        _key = jax.random.PRNGKey(int(seed_state))
+
+
+def next_key():
+    """Split off a fresh subkey from the global state."""
+    global _key
+    with _lock:
+        _key, sub = jax.random.split(_key)
+    return sub
+
+
+def uniform(low=0, high=1, shape=None, ctx=None, dtype="float32", out=None):
+    from . import ndarray as nd
+
+    return nd.uniform(low=low, high=high,
+                      shape=(1,) if shape is None else shape,
+                      dtype=dtype, ctx=ctx, out=out)
+
+
+def normal(loc=0, scale=1, shape=None, ctx=None, dtype="float32", out=None):
+    from . import ndarray as nd
+
+    return nd.normal(loc=loc, scale=scale,
+                     shape=(1,) if shape is None else shape,
+                     dtype=dtype, ctx=ctx, out=out)
+
+
+def randint(low, high, shape=(1,), ctx=None, dtype="int32"):
+    from . import ndarray as nd
+    import numpy as np
+
+    k = next_key()
+    arr = jax.random.randint(k, shape, low, high, dtype=np.dtype(dtype))
+    return nd.NDArray._from_jax(arr, ctx)
