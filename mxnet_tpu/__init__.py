@@ -16,3 +16,11 @@ from . import ndarray
 from . import ndarray as nd
 from . import random
 from . import random as rnd
+
+from . import attribute
+from .attribute import AttrScope
+from . import symbol
+from . import symbol as sym
+from .symbol import Symbol, Group, Variable
+from . import executor
+from .executor import Executor

@@ -1,0 +1,46 @@
+"""AttrScope — scoped symbol attributes (reference ``python/mxnet/attribute.py``).
+
+Carries ``ctx_group`` for model parallelism (reference
+``example/model-parallel-lstm/lstm.py:48-99``) plus arbitrary ``__key__``
+attributes like lr_mult/wd_mult consumed by the optimizer.
+"""
+
+from __future__ import annotations
+
+import threading
+
+__all__ = ["AttrScope"]
+
+
+class AttrScope:
+    _tls = threading.local()
+
+    def __init__(self, **kwargs):
+        self._attr = {str(k): str(v) for k, v in kwargs.items()}
+
+    def get(self, attr):
+        out = dict(self._attr)
+        if attr:
+            out.update(attr)
+        return out
+
+    def __enter__(self):
+        stack = AttrScope._stack()
+        merged = dict(stack[-1]._attr)
+        merged.update(self._attr)
+        new = AttrScope(**merged)
+        stack.append(new)
+        return new
+
+    def __exit__(self, *exc):
+        AttrScope._stack().pop()
+
+    @staticmethod
+    def _stack():
+        if not hasattr(AttrScope._tls, "stack"):
+            AttrScope._tls.stack = [AttrScope()]
+        return AttrScope._tls.stack
+
+    @staticmethod
+    def current():
+        return AttrScope._stack()[-1]

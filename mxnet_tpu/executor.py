@@ -1,0 +1,351 @@
+"""Executor — bind a Symbol and run forward/backward.
+
+Reference: ``src/executor/graph_executor.cc`` + ``python/mxnet/executor.py``
+(SURVEY §3.1).  The reference builds a full fwd+bwd nnvm graph, plans memory,
+and pushes one engine op per node.  TPU-native collapse: the WHOLE symbol
+traces into ONE jitted XLA computation —
+
+* Gradient pass (``graph_executor.cc:219``)      -> ``jax.vjp``
+* InferShape/InferType (``:413``)                -> ``jax.eval_shape`` tracing
+* PlanMemory / InitDataEntryMemory (``:425``)    -> XLA buffer assignment
+* InitCachedOps / bulk segments (``:544,678``)   -> the jit cache itself
+* engine var-dependency scheduling               -> XLA dataflow + PJRT async
+
+``forward(is_train=True)`` runs a jitted function that returns outputs, aux
+updates AND the vjp residuals (as a ``jax.tree_util.Partial`` pytree), so
+``backward()`` is a second jitted call on saved residuals — the same
+fwd/bwd split as the reference, without storing a graph.
+
+grad_req semantics ('write'/'add'/'null') follow ``include/mxnet/op_attr_types.h``
+kWriteTo/kAddTo/kNullOp; 'add' accumulates into the bound grad arrays.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import random as _random
+from .base import MXNetError
+from .context import Context
+from .ndarray import NDArray, zeros as nd_zeros
+
+__all__ = ["Executor"]
+
+
+def _graph_forward(symbol, arg_vals, aux_vals, is_train, rng):
+    """Trace the symbol DAG; returns (outputs list, new_aux dict)."""
+    entry_val = {}
+    new_aux = {}
+    nodes = symbol._nodes()
+    for ni, node in enumerate(nodes):
+        if node.is_variable:
+            if node.name in arg_vals:
+                entry_val[(id(node), 0)] = arg_vals[node.name]
+            elif node.name in aux_vals:
+                entry_val[(id(node), 0)] = aux_vals[node.name]
+            else:
+                raise MXNetError("unbound variable %r" % node.name)
+            continue
+        op = node.op
+        na = node.num_args()
+        ins = [entry_val[(id(c), ci)] for c, ci in node.inputs[:na]]
+        auxs = [entry_val[(id(c), ci)] for c, ci in node.inputs[na:]]
+        key = jax.random.fold_in(rng, ni) if op.needs_rng else None
+        outs, aux_up = op.apply(node.attrs, ins, auxs, is_train, key)
+        for i, o in enumerate(outs):
+            entry_val[(id(node), i)] = o
+        if aux_up is not None:
+            for (child, _ci), new in zip(node.inputs[na:], aux_up):
+                new_aux[child.name] = new
+    outputs = [entry_val[(id(n), i)] for n, i in symbol._outputs]
+    return outputs, new_aux
+
+
+class Executor:
+    """reference ``python/mxnet/executor.py:25``"""
+
+    def __init__(self, symbol, ctx, arg_dict, grad_dict, grad_req, aux_dict,
+                 group2ctx=None):
+        self._symbol = symbol
+        self._ctx = ctx
+        self.arg_names = symbol.list_arguments()
+        self.aux_names = symbol.list_auxiliary_states()
+        self.output_names = symbol.list_outputs()
+        self.arg_dict = arg_dict
+        self.grad_dict = grad_dict
+        self.aux_dict = aux_dict
+        if isinstance(grad_req, str):
+            grad_req = {n: grad_req for n in self.arg_names}
+        elif isinstance(grad_req, (list, tuple)):
+            grad_req = dict(zip(self.arg_names, grad_req))
+        self.grad_req = {n: grad_req.get(n, "null") for n in self.arg_names}
+        self._group2ctx = group2ctx or {}
+        self.outputs = []
+        self._monitor_callback = None
+        self._residuals = None
+        self._rng_step = 0
+        self._fns = {}
+
+    arg_arrays = property(lambda s: [s.arg_dict[n] for n in s.arg_names])
+    grad_arrays = property(lambda s: [s.grad_dict.get(n) for n in s.arg_names])
+    aux_arrays = property(lambda s: [s.aux_dict[n] for n in s.aux_names])
+
+    # -- jitted graph functions ------------------------------------------
+    def _diff_names(self):
+        return [n for n in self.arg_names if self.grad_req[n] != "null"]
+
+    def _get_fn(self, kind):
+        if kind in self._fns:
+            return self._fns[kind]
+        symbol = self._symbol
+        arg_names = list(self.arg_names)
+        aux_names = list(self.aux_names)
+        diff_names = self._diff_names()
+
+        if kind == "predict":
+            def f(args, aux, rng):
+                outs, _ = _graph_forward(
+                    symbol, dict(zip(arg_names, args)),
+                    dict(zip(aux_names, aux)), False, rng)
+                return outs
+
+            fn = jax.jit(f)
+        elif kind == "train":
+            def f(args, aux, rng):
+                amap = dict(zip(arg_names, args))
+                axmap = dict(zip(aux_names, aux))
+                nondiff = {n: v for n, v in amap.items()
+                           if n not in diff_names}
+
+                def g(diff_args):
+                    vals = dict(nondiff)
+                    vals.update(diff_args)
+                    outs, new_aux = _graph_forward(symbol, vals, axmap,
+                                                   True, rng)
+                    return tuple(outs), new_aux
+
+                outs, vjp_fn, new_aux = jax.vjp(
+                    g, {n: amap[n] for n in diff_names}, has_aux=True)
+                new_aux_list = [new_aux.get(n, axmap[n]) for n in aux_names]
+                return list(outs), new_aux_list, vjp_fn
+
+            fn = jax.jit(f)
+        elif kind == "backward":
+            def f(vjp_fn, out_grads):
+                (grads,) = vjp_fn(tuple(out_grads))
+                return grads
+
+            fn = jax.jit(f)
+        else:
+            raise ValueError(kind)
+        self._fns[kind] = fn
+        return fn
+
+    # -- API --------------------------------------------------------------
+    def forward(self, is_train=False, **kwargs):
+        """reference ``executor.py:86``"""
+        for k, v in kwargs.items():
+            if k not in self.arg_dict:
+                raise MXNetError("forward: unknown input %r" % k)
+            dst = self.arg_dict[k]
+            if isinstance(v, NDArray):
+                dst._jx = v._jx.astype(dst._jx.dtype) if v._jx.dtype != dst._jx.dtype else v._jx
+            else:
+                dst[:] = v
+        args = [a._jx for a in self.arg_arrays]
+        aux = [a._jx for a in self.aux_arrays]
+        rng = _random.next_key()
+        self._rng_step += 1
+        if is_train:
+            outs, new_aux, vjp_fn = self._get_fn("train")(args, aux, rng)
+            self._residuals = vjp_fn
+            for arr, new in zip(self.aux_arrays, new_aux):
+                arr._jx = new
+        else:
+            outs = self._get_fn("predict")(args, aux, rng)
+            self._residuals = None
+        self.outputs = [NDArray._from_jax(o, self._ctx) for o in outs]
+        if self._monitor_callback is not None:
+            for name, arr in zip(self.output_names, self.outputs):
+                self._monitor_callback(name, arr)
+        return self.outputs
+
+    def backward(self, out_grads=None):
+        """reference ``executor.py:134`` — computes grads into grad arrays
+        honoring grad_req."""
+        if self._residuals is None:
+            raise MXNetError("backward called before forward(is_train=True)")
+        if out_grads is None:
+            out_grads = [jnp.ones_like(o._jx) for o in self.outputs]
+        else:
+            if isinstance(out_grads, NDArray):
+                out_grads = [out_grads]
+            out_grads = [g._jx if isinstance(g, NDArray) else jnp.asarray(g)
+                         for g in out_grads]
+        grads = self._get_fn("backward")(self._residuals, out_grads)
+        for name in self._diff_names():
+            g = grads[name]
+            dst = self.grad_dict.get(name)
+            if dst is None:
+                continue
+            if self.grad_req[name] == "add":
+                dst._jx = dst._jx + g
+            else:
+                dst._jx = g
+
+    def set_monitor_callback(self, callback):
+        """reference MXExecutorSetMonitorCallback (outputs-level monitor)."""
+        self._monitor_callback = callback
+
+    def copy_params_from(self, arg_params, aux_params=None,
+                         allow_extra_params=False):
+        """reference ``executor.py`` copy_params_from"""
+        for k, v in arg_params.items():
+            if k in self.arg_dict:
+                v.copyto(self.arg_dict[k])
+            elif not allow_extra_params:
+                raise MXNetError("unknown arg %r" % k)
+        if aux_params:
+            for k, v in aux_params.items():
+                if k in self.aux_dict:
+                    v.copyto(self.aux_dict[k])
+                elif not allow_extra_params:
+                    raise MXNetError("unknown aux %r" % k)
+
+    def reshape(self, partial_shaping=False, allow_up_sizing=False, **kwargs):
+        """Rebind with new shapes; params with unchanged shapes are shared
+        (reference executor.py reshape → shared-pool rebind; here the jit
+        cache keys on shape so each shape compiles once)."""
+        new_shapes = dict(kwargs)
+        var_shape, var_dtype, _ = self._symbol._infer_shapes_full(new_shapes)
+        arg_dict, grad_dict = {}, {}
+        for n in self.arg_names:
+            s = var_shape[n]
+            if s == self.arg_dict[n].shape:
+                arg_dict[n] = self.arg_dict[n]
+                if self.grad_dict.get(n) is not None:
+                    grad_dict[n] = self.grad_dict[n]
+            else:
+                if not (partial_shaping or n in kwargs or allow_up_sizing):
+                    raise MXNetError(
+                        "reshape: arg %r changes shape %s->%s without "
+                        "partial_shaping" % (n, self.arg_dict[n].shape, s))
+                arg_dict[n] = nd_zeros(s, ctx=self._ctx,
+                                       dtype=self.arg_dict[n].dtype)
+                if self.grad_req[n] != "null":
+                    grad_dict[n] = nd_zeros(s, ctx=self._ctx,
+                                            dtype=self.arg_dict[n].dtype)
+        aux_dict = {}
+        for n in self.aux_names:
+            s = var_shape[n]
+            aux_dict[n] = self.aux_dict[n] if s == self.aux_dict[n].shape \
+                else nd_zeros(s, ctx=self._ctx, dtype=self.aux_dict[n].dtype)
+        return Executor(self._symbol, self._ctx, arg_dict, grad_dict,
+                        dict(self.grad_req), aux_dict, self._group2ctx)
+
+    def debug_str(self):
+        lines = ["Symbol outputs: %s" % self.output_names]
+        for node in self._symbol._nodes():
+            lines.append("%s %s" % (node.op.name if node.op else "var",
+                                    node.name))
+        return "\n".join(lines)
+
+    # -- binding constructors --------------------------------------------
+    @staticmethod
+    def _bind(symbol, ctx, args, args_grad=None, grad_req="write",
+              aux_states=None, group2ctx=None, shared_exec=None):
+        """reference ``Executor::Bind`` ``graph_executor.cc:917``"""
+        if isinstance(ctx, (list, tuple)):
+            if len(ctx) != 1:
+                raise MXNetError("Executor binds one context; use Module "
+                                 "for multi-device data parallelism")
+            ctx = ctx[0]
+        arg_names = symbol.list_arguments()
+        aux_names = symbol.list_auxiliary_states()
+        if isinstance(args, (list, tuple)):
+            arg_dict = dict(zip(arg_names, args))
+        else:
+            arg_dict = dict(args)
+        if aux_states is None:
+            aux_dict = {}
+        elif isinstance(aux_states, (list, tuple)):
+            aux_dict = dict(zip(aux_names, aux_states))
+        else:
+            aux_dict = dict(aux_states)
+        missing_aux = [n for n in aux_names if n not in aux_dict]
+        if missing_aux:
+            # allocate zero-init aux (shapes inferred from bound args)
+            shapes = {n: a.shape for n, a in arg_dict.items()}
+            var_shape, _vd, _ = symbol._infer_shapes_full(shapes)
+            for n in missing_aux:
+                aux_dict[n] = nd_zeros(var_shape[n], ctx=ctx)
+        if args_grad is None:
+            grad_dict = {}
+        elif isinstance(args_grad, (list, tuple)):
+            grad_dict = {n: g for n, g in zip(arg_names, args_grad)
+                         if g is not None}
+        else:
+            grad_dict = dict(args_grad)
+        return Executor(symbol, ctx, arg_dict, grad_dict, grad_req, aux_dict,
+                        group2ctx)
+
+    @staticmethod
+    def _simple_bind(symbol, ctx, grad_req="write", type_dict=None,
+                     shared_exec=None, group2ctx=None, **kwargs):
+        """reference ``symbol.py:837`` simple_bind — infer + allocate."""
+        if isinstance(ctx, (list, tuple)):
+            ctx = ctx[0]
+        type_dict = dict(type_dict or {})
+        # __shape__ attrs on variables participate (reference Variable(shape=))
+        shapes = dict(kwargs)
+        for node in symbol._nodes():
+            if node.is_variable and "__shape__" in node.misc_attr \
+                    and node.name not in shapes:
+                import ast
+
+                shapes[node.name] = ast.literal_eval(
+                    node.misc_attr["__shape__"])
+            if node.is_variable and "__dtype__" in node.misc_attr \
+                    and node.name not in type_dict:
+                type_dict[node.name] = node.misc_attr["__dtype__"]
+        var_shape, var_dtype, _ = symbol._infer_shapes_full(shapes, type_dict)
+        arg_names = symbol.list_arguments()
+        aux_names = symbol.list_auxiliary_states()
+        unknown = [n for n in arg_names + aux_names
+                   if var_shape.get(n) is None]
+        if unknown:
+            raise MXNetError("simple_bind: cannot infer shapes for %s — "
+                             "provide them as kwargs" % unknown)
+        arg_dict = {}
+        grad_dict = {}
+        if isinstance(grad_req, str):
+            req = {n: grad_req for n in arg_names}
+        elif isinstance(grad_req, (list, tuple)):
+            req = dict(zip(arg_names, grad_req))
+        else:
+            req = {n: grad_req.get(n, "null") for n in arg_names}
+        for n in arg_names:
+            dt = type_dict.get(n) or var_dtype.get(n) or np.float32
+            arg_dict[n] = nd_zeros(var_shape[n], ctx=ctx, dtype=dt)
+            if req.get(n, "null") != "null":
+                grad_dict[n] = nd_zeros(var_shape[n], ctx=ctx, dtype=dt)
+        aux_dict = {n: nd_zeros(var_shape[n], ctx=ctx,
+                                dtype=var_dtype.get(n) or np.float32)
+                    for n in aux_names}
+        # shared_exec (bucketing): share parameter arrays with the shared
+        # executor (reference shared data_pool_, graph_executor.cc:336-340)
+        if shared_exec is not None:
+            for n in arg_names:
+                src = shared_exec.arg_dict.get(n)
+                if src is not None and src.shape == arg_dict[n].shape:
+                    arg_dict[n] = src
+                    if n in shared_exec.grad_dict and n in grad_dict:
+                        grad_dict[n] = shared_exec.grad_dict[n]
+            for n in aux_names:
+                src = shared_exec.aux_dict.get(n)
+                if src is not None and src.shape == aux_dict[n].shape:
+                    aux_dict[n] = src
+        return Executor(symbol, ctx, arg_dict, grad_dict, req, aux_dict,
+                        group2ctx)

@@ -1,0 +1,145 @@
+"""Backward (argument) shape inference rules.
+
+The reference infers parameter shapes from data shapes inside each op's
+``InferShape`` (e.g. ``fully_connected-inl.h``: weight = (num_hidden,
+input_dim)); ``simple_bind`` depends on it.  Forward inference here is free
+(``jax.eval_shape`` through the graph); these rules supply the missing
+*input*-filling direction for ops with learnable parameters.
+
+Each rule: ``(attrs, in_shapes, in_dtypes, aux_shapes) -> (in_shapes,
+aux_shapes)`` filling ``None`` entries; shapes are tuples or None.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .registry import get
+
+_RULES = {}
+
+
+def rule(name):
+    def _do(fn):
+        _RULES[name] = fn
+        get(name).infer_inputs = fn
+        return fn
+
+    return _do
+
+
+def _prod(t):
+    out = 1
+    for x in t:
+        out *= x
+    return out
+
+
+@rule("FullyConnected")
+def _fc(attrs, ins, dts, auxs):
+    data = ins[0]
+    if data is not None:
+        in_dim = _prod(data[1:]) if attrs["flatten"] else data[-1]
+        if ins[1] is None:
+            ins[1] = (attrs["num_hidden"], in_dim)
+        if not attrs["no_bias"] and ins[2] is None:
+            ins[2] = (attrs["num_hidden"],)
+    return ins, auxs
+
+
+@rule("Convolution")
+def _conv(attrs, ins, dts, auxs):
+    data = ins[0]
+    if data is not None:
+        c = data[1]
+        if ins[1] is None:
+            ins[1] = (attrs["num_filter"], c // attrs["num_group"]) + tuple(attrs["kernel"])
+        if not attrs["no_bias"] and ins[2] is None:
+            ins[2] = (attrs["num_filter"],)
+    return ins, auxs
+
+
+@rule("Deconvolution")
+def _deconv(attrs, ins, dts, auxs):
+    data = ins[0]
+    if data is not None:
+        c = data[1]
+        if ins[1] is None:
+            ins[1] = (c, attrs["num_filter"] // attrs["num_group"]) + tuple(attrs["kernel"])
+        if not attrs["no_bias"] and ins[2] is None:
+            ins[2] = (attrs["num_filter"],)
+    return ins, auxs
+
+
+@rule("BatchNorm")
+def _bn(attrs, ins, dts, auxs):
+    data = ins[0]
+    if data is not None:
+        c = (data[1],)
+        for i in (1, 2):
+            if ins[i] is None:
+                ins[i] = c
+        for i in (0, 1):
+            if auxs[i] is None:
+                auxs[i] = c
+    return ins, auxs
+
+
+@rule("InstanceNorm")
+def _in(attrs, ins, dts, auxs):
+    data = ins[0]
+    if data is not None:
+        for i in (1, 2):
+            if ins[i] is None:
+                ins[i] = (data[1],)
+    return ins, auxs
+
+
+@rule("Embedding")
+def _emb(attrs, ins, dts, auxs):
+    if ins[1] is None:
+        ins[1] = (attrs["input_dim"], attrs["output_dim"])
+    return ins, auxs
+
+
+@rule("LeakyReLU")
+def _lrelu(attrs, ins, dts, auxs):
+    if attrs["act_type"] == "prelu" and ins[0] is not None and len(ins) > 1 \
+            and ins[1] is None:
+        ins[1] = (ins[0][1],)
+    return ins, auxs
+
+
+def _same_shape(attrs, ins, dts, auxs):
+    known = next((s for s in ins if s is not None), None)
+    if known is not None:
+        for i, s in enumerate(ins):
+            if s is None:
+                ins[i] = known
+    return ins, auxs
+
+
+for _n in ("elemwise_add", "elemwise_sub", "elemwise_mul", "elemwise_div",
+           "_power", "_maximum", "_minimum", "_hypot", "_grad_add",
+           "LinearRegressionOutput", "LogisticRegressionOutput",
+           "MAERegressionOutput"):
+    get(_n).infer_inputs = _same_shape
+
+
+@rule("SoftmaxOutput")
+def _softmax_out(attrs, ins, dts, auxs):
+    data = ins[0]
+    if data is not None and ins[1] is None:
+        if attrs["multi_output"]:
+            ins[1] = (data[0],) + tuple(data[2:])
+        else:
+            ins[1] = (data[0],)
+    return ins, auxs
+
+
+@rule("SVMOutput")
+def _svm_out(attrs, ins, dts, auxs):
+    data = ins[0]
+    if data is not None and ins[1] is None:
+        ins[1] = (data[0],)
+    return ins, auxs

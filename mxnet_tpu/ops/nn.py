@@ -163,13 +163,13 @@ def _pooling(attrs, inputs, aux, is_train, rng):
     padding = ((0, 0), (0, 0)) + tuple((p, e) for p, e in zip(pad, extra))
     pt = attrs["pool_type"]
     if pt == "max":
-        init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) else \
-            jnp.iinfo(data.dtype).min
-        out = jax.lax.reduce_window(data, jnp.asarray(init, data.dtype),
-                                    jax.lax.max, window, strides, padding)
+        # literal -inf init so JAX recognises the differentiable
+        # reduce-window-max pattern (select-and-scatter transpose)
+        out = jax.lax.reduce_window(data, -jnp.inf, jax.lax.max,
+                                    window, strides, padding)
     elif pt in ("avg", "sum"):
-        out = jax.lax.reduce_window(data, jnp.asarray(0, data.dtype),
-                                    jax.lax.add, window, strides, padding)
+        out = jax.lax.reduce_window(data, 0.0, jax.lax.add,
+                                    window, strides, padding)
         if pt == "avg":
             # reference counts the full window incl. padding (mshadow pool)
             out = out / float(np.prod(kernel))
@@ -268,18 +268,20 @@ def _batch_norm(attrs, inputs, aux, is_train, rng):
     shift = (beta.astype(jnp.float32)
              - mean * scale.astype(jnp.float32)).astype(x.dtype)
     out = x * scale.reshape(bshape) + shift.reshape(bshape)
+    outs = [out, mean, var] if attrs["output_mean_var"] else [out]
     if use_batch:
         m = attrs["momentum"]
         new_mean = moving_mean * m + jax.lax.stop_gradient(mean) * (1 - m)
         new_var = moving_var * m + jax.lax.stop_gradient(var) * (1 - m)
-        return [out, mean, var], [new_mean, new_var]
-    return [out, mean, var], None
+        return outs, [new_mean, new_var]
+    return outs, None
 
 
 register("BatchNorm", _batch_norm,
          arguments=("data", "gamma", "beta"),
          aux_states=("moving_mean", "moving_var"),
-         outputs=("output", "mean", "var"),
+         outputs=lambda a: ["output", "mean", "var"] if a["output_mean_var"]
+         else ["output"],
          params={"eps": (pfloat, 1e-3), "momentum": (pfloat, 0.9),
                  "fix_gamma": (pbool, True), "use_global_stats": (pbool, False),
                  "output_mean_var": (pbool, False)},
@@ -327,7 +329,7 @@ def _lrn(attrs, inputs, aux, is_train, rng):
     half = n // 2
     win = (1, n) + (1,) * (x.ndim - 2)
     pad = ((0, 0), (half, n - 1 - half)) + ((0, 0),) * (x.ndim - 2)
-    ssum = jax.lax.reduce_window(sq, jnp.asarray(0, x.dtype), jax.lax.add,
+    ssum = jax.lax.reduce_window(sq, 0.0, jax.lax.add,
                                  win, (1,) * x.ndim, pad)
     scale = attrs["knorm"] + (attrs["alpha"] / n) * ssum
     return [x * jnp.power(scale, -attrs["beta"])]

@@ -132,6 +132,9 @@ class OpDef:
         self.key_var_num_args = key_var_num_args
         self.hint = hint or name.lower().lstrip("_")
         self.doc = doc
+        # optional backward shape-inference rule, attached by ops/infer.py:
+        # (attrs, in_shapes, in_dtypes, aux_shapes) -> (in_shapes, aux_shapes)
+        self.infer_inputs = None
 
     # -- I/O names --------------------------------------------------------
     def list_arguments(self, attrs):

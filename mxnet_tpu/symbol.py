@@ -1,0 +1,528 @@
+"""Symbolic graph API (``mx.sym``).
+
+Reference: nnvm Graph IR + ``python/mxnet/symbol.py`` (SURVEY §2.2/§2.6).
+
+TPU-native design: a Symbol is a lightweight DAG of op nodes over the single
+op registry.  There are no nnvm passes — the whole graph is *traced into one
+XLA computation* at bind time (``executor.py``), so InferShape/InferType are
+``jax.eval_shape`` over the trace, PlanMemory is XLA buffer assignment, and
+the Gradient pass is ``jax.vjp``.  What remains here is exactly the graph
+*construction* surface the reference exposes: composition via generated
+``sym.<op>`` functions, ``Variable``/``Group``, ``list_arguments/
+list_auxiliary_states/list_outputs``, ``infer_shape/infer_type``, attrs
+(``AttrScope``, ctx_group, lr_mult), JSON save/load, and
+``simple_bind``/``bind``.
+
+Aux states (e.g. BatchNorm moving stats) are modelled as trailing inputs of
+the op node, like nnvm does — auto-created as variables at composition time
+(missing args likewise, matching ``sym.Convolution(data)`` auto-creating
+``convolution0_weight``).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+import numpy as np
+
+from .attribute import AttrScope
+from .base import MXNetError, NameManager
+from .ops import registry as _reg
+
+__all__ = ["Symbol", "Variable", "var", "Group", "load", "load_json", "pow",
+           "maximum", "minimum"]
+
+
+class _Node:
+    __slots__ = ["op", "name", "attrs", "inputs", "misc_attr", "_id"]
+    _counter = [0]
+
+    def __init__(self, op, name, attrs, inputs, misc_attr=None):
+        self.op = op  # OpDef or None for variables
+        self.name = name
+        self.attrs = attrs or {}
+        self.inputs = inputs or []  # list of (node, out_index)
+        self.misc_attr = dict(misc_attr or {})  # user attrs (ctx_group, ...)
+        self._id = _Node._counter[0]
+        _Node._counter[0] += 1
+
+    @property
+    def is_variable(self):
+        return self.op is None
+
+    def num_args(self):
+        return len(self.op.list_arguments(self.attrs)) if self.op else 0
+
+
+def _topo(nodes_out):
+    """Post-order DFS over entry heads — nnvm IndexedGraph order."""
+    order, seen = [], set()
+    stack = [(n, False) for n, _ in reversed(nodes_out)]
+    while stack:
+        node, done = stack.pop()
+        if done:
+            order.append(node)
+            continue
+        if id(node) in seen:
+            continue
+        seen.add(id(node))
+        stack.append((node, True))
+        for child, _ in reversed(node.inputs):
+            if id(child) not in seen:
+                stack.append((child, False))
+    return order
+
+
+class Symbol:
+    """A multi-output symbolic graph handle (reference ``symbol.py:52``)."""
+
+    __slots__ = ["_outputs"]
+
+    def __init__(self, outputs):
+        self._outputs = list(outputs)  # list of (node, out_index)
+
+    # -- composition helpers ---------------------------------------------
+    def _entry(self):
+        if len(self._outputs) != 1:
+            raise MXNetError("operation requires a single-output symbol; "
+                             "use sym[i] to pick an output")
+        return self._outputs[0]
+
+    def __getitem__(self, index):
+        if isinstance(index, str):
+            names = self.list_outputs()
+            if index not in names:
+                raise MXNetError("no output named %r (have %s)" % (index, names))
+            index = names.index(index)
+        return Symbol([self._outputs[index]])
+
+    def __len__(self):
+        return len(self._outputs)
+
+    def __iter__(self):
+        for i in range(len(self._outputs)):
+            yield self[i]
+
+    @property
+    def name(self):
+        node, idx = self._outputs[0] if len(self._outputs) == 1 else (None, 0)
+        return node.name if node is not None else None
+
+    # -- introspection ----------------------------------------------------
+    def _nodes(self):
+        return _topo(self._outputs)
+
+    def _arg_aux_vars(self):
+        """Variables split into (args, auxs) by which op slot consumes them."""
+        aux_ids = set()
+        for node in self._nodes():
+            if node.is_variable:
+                continue
+            na = node.num_args()
+            for child, _ in node.inputs[na:]:
+                aux_ids.add(id(child))
+        args, auxs = [], []
+        for node in self._nodes():
+            if node.is_variable:
+                (auxs if id(node) in aux_ids else args).append(node)
+        return args, auxs
+
+    def list_arguments(self):
+        return [n.name for n in self._arg_aux_vars()[0]]
+
+    def list_auxiliary_states(self):
+        return [n.name for n in self._arg_aux_vars()[1]]
+
+    def list_outputs(self):
+        names = []
+        for node, idx in self._outputs:
+            if node.is_variable:
+                names.append(node.name)
+            else:
+                outs = node.op.list_outputs(node.attrs)
+                names.append("%s_%s" % (node.name, outs[idx]))
+        return names
+
+    def list_attr(self, recursive=False):
+        if recursive:
+            out = {}
+            for node in self._nodes():
+                for k, v in node.misc_attr.items():
+                    out["%s_%s" % (node.name, k)] = v
+            return out
+        node, _ = self._entry()
+        return dict(node.misc_attr)
+
+    def attr(self, key):
+        node, _ = self._entry()
+        return node.misc_attr.get(key)
+
+    def _set_attr(self, **kwargs):
+        node, _ = self._entry()
+        node.misc_attr.update({k: str(v) for k, v in kwargs.items()})
+
+    def attr_dict(self):
+        out = {}
+        for node in self._nodes():
+            d = dict(node.misc_attr)
+            if not node.is_variable:
+                d.update({k: _attr_str(v) for k, v in node.attrs.items()})
+            if d:
+                out[node.name] = d
+        return out
+
+    def get_internals(self):
+        """All intermediate outputs as a Group (reference symbol.py
+        get_internals, used for feature extraction / fine-tune)."""
+        entries = []
+        for node in self._nodes():
+            if node.is_variable:
+                entries.append((node, 0))
+            else:
+                for i in range(len(node.op.list_outputs(node.attrs))):
+                    entries.append((node, i))
+        return Symbol(entries)
+
+    # -- shape / type inference ------------------------------------------
+    def _infer_shapes_full(self, shape_kwargs, type_kwargs=None, partial=False):
+        """Topological forward propagation with per-op backward filling.
+
+        Returns dicts: var_shapes, var_dtypes, out_shapes, out_dtypes,
+        entry->aval map.
+        """
+        import jax
+
+        type_kwargs = type_kwargs or {}
+        args, auxs = self._arg_aux_vars()
+        var_shape = {}
+        var_dtype = {}
+        for n in args + auxs:
+            s = shape_kwargs.get(n.name)
+            var_shape[n.name] = tuple(s) if s is not None else None
+            var_dtype[n.name] = type_kwargs.get(n.name)
+        entry_aval = {}
+
+        def _known(nm):
+            return var_shape.get(nm) is not None
+
+        for node in self._nodes():
+            if node.is_variable:
+                if _known(node.name):
+                    dt = var_dtype.get(node.name) or np.float32
+                    entry_aval[(id(node), 0)] = jax.ShapeDtypeStruct(
+                        var_shape[node.name], dt)
+                continue
+            op = node.op
+            na = node.num_args()
+            in_entries = node.inputs[:na]
+            aux_entries = node.inputs[na:]
+            in_shapes = []
+            in_dtypes = []
+            for child, ci in in_entries:
+                av = entry_aval.get((id(child), ci))
+                in_shapes.append(tuple(av.shape) if av is not None else None)
+                in_dtypes.append(av.dtype if av is not None else None)
+            aux_shapes = []
+            for child, ci in aux_entries:
+                av = entry_aval.get((id(child), ci))
+                aux_shapes.append(tuple(av.shape) if av is not None else None)
+            if op.infer_inputs is not None and (
+                    any(s is None for s in in_shapes)
+                    or any(s is None for s in aux_shapes)):
+                in_shapes, aux_shapes = op.infer_inputs(
+                    node.attrs, list(in_shapes), list(in_dtypes),
+                    list(aux_shapes))
+            # write back newly-filled variable shapes
+            base_dt = next((d for d in in_dtypes if d is not None), None) \
+                or np.float32
+            for (child, ci), s in zip(in_entries, in_shapes):
+                if s is not None and (id(child), ci) not in entry_aval \
+                        and child.is_variable:
+                    dt = var_dtype.get(child.name) or base_dt
+                    var_shape[child.name] = tuple(s)
+                    var_dtype[child.name] = dt
+                    entry_aval[(id(child), ci)] = jax.ShapeDtypeStruct(
+                        tuple(s), dt)
+            for (child, ci), s in zip(aux_entries, aux_shapes):
+                if s is not None and (id(child), ci) not in entry_aval \
+                        and child.is_variable:
+                    dt = var_dtype.get(child.name) or np.float32
+                    var_shape[child.name] = tuple(s)
+                    var_dtype[child.name] = dt
+                    entry_aval[(id(child), ci)] = jax.ShapeDtypeStruct(
+                        tuple(s), dt)
+            ins = [entry_aval.get((id(c), ci)) for c, ci in in_entries]
+            auxs_av = [entry_aval.get((id(c), ci)) for c, ci in aux_entries]
+            if any(a is None for a in ins) or any(a is None for a in auxs_av):
+                if partial:
+                    continue
+                missing = [c.name for (c, ci), a in
+                           zip(node.inputs, ins + auxs_av) if a is None]
+                raise MXNetError(
+                    "infer_shape: cannot infer inputs %s of node %s"
+                    % (missing, node.name))
+            out_avals, _aux_up = op.infer(node.attrs, ins, auxs_av)
+            for i, av in enumerate(out_avals):
+                entry_aval[(id(node), i)] = av
+        return var_shape, var_dtype, entry_aval
+
+    def infer_shape(self, *args, **kwargs):
+        """reference ``symbol.py`` infer_shape -> (arg_shapes, out_shapes,
+        aux_shapes), each ordered like the respective list_*() call."""
+        if args:
+            kwargs = dict(zip(self.list_arguments(), args), **kwargs)
+        shape_kwargs = {k: v for k, v in kwargs.items() if v is not None}
+        var_shape, _vd, entry_aval = self._infer_shapes_full(shape_kwargs)
+        arg_shapes = [var_shape.get(n) for n in self.list_arguments()]
+        aux_shapes = [var_shape.get(n) for n in self.list_auxiliary_states()]
+        out_shapes = [tuple(entry_aval[(id(n), i)].shape)
+                      for n, i in self._outputs]
+        return arg_shapes, out_shapes, aux_shapes
+
+    def infer_shape_partial(self, **kwargs):
+        var_shape, _vd, entry_aval = self._infer_shapes_full(kwargs, partial=True)
+        arg_shapes = [var_shape.get(n) for n in self.list_arguments()]
+        aux_shapes = [var_shape.get(n) for n in self.list_auxiliary_states()]
+        out_shapes = [
+            tuple(entry_aval[(id(n), i)].shape)
+            if (id(n), i) in entry_aval else None
+            for n, i in self._outputs]
+        return arg_shapes, out_shapes, aux_shapes
+
+    def infer_type(self, **kwargs):
+        """Needs shapes too in this design; used with Module's type_dict."""
+        raise MXNetError("infer_type: use infer_shape with type_dict via "
+                         "simple_bind (dtype inference is joint on TPU)")
+
+    # -- binding ----------------------------------------------------------
+    def simple_bind(self, ctx, grad_req="write", type_dict=None,
+                    shared_exec=None, group2ctx=None, **kwargs):
+        from .executor import Executor
+
+        return Executor._simple_bind(self, ctx, grad_req=grad_req,
+                                     type_dict=type_dict,
+                                     shared_exec=shared_exec,
+                                     group2ctx=group2ctx, **kwargs)
+
+    def bind(self, ctx, args, args_grad=None, grad_req="write",
+             aux_states=None, group2ctx=None, shared_exec=None):
+        from .executor import Executor
+
+        return Executor._bind(self, ctx, args, args_grad=args_grad,
+                              grad_req=grad_req, aux_states=aux_states,
+                              group2ctx=group2ctx, shared_exec=shared_exec)
+
+    # -- eval convenience -------------------------------------------------
+    def eval(self, ctx=None, **kwargs):
+        from .context import current_context
+
+        ctx = ctx or current_context()
+        ex = self.bind(ctx, kwargs)
+        return ex.forward()
+
+    # -- serialization ----------------------------------------------------
+    def tojson(self):
+        nodes = self._nodes()
+        nid = {id(n): i for i, n in enumerate(nodes)}
+        jnodes = []
+        for n in nodes:
+            jnodes.append({
+                "op": "null" if n.is_variable else n.op.name,
+                "name": n.name,
+                "attrs": {k: _attr_str(v) for k, v in n.attrs.items()}
+                if n.attrs else {},
+                "misc_attrs": n.misc_attr,
+                "inputs": [[nid[id(c)], ci] for c, ci in n.inputs],
+            })
+            if n.is_variable:
+                jnodes[-1].pop("attrs")
+        payload = {
+            "nodes": jnodes,
+            "arg_nodes": [i for i, n in enumerate(nodes) if n.is_variable],
+            "heads": [[nid[id(n)], i] for n, i in self._outputs],
+            "mxnet_tpu_version": 1,
+        }
+        return json.dumps(payload, indent=2)
+
+    def save(self, fname):
+        with open(fname, "w") as f:
+            f.write(self.tojson())
+
+    def __repr__(self):
+        return "<Symbol %s>" % (self.name or self.list_outputs())
+
+    # -- operators --------------------------------------------------------
+    def __add__(self, other):
+        return _sym_binop(self, other, "elemwise_add", "_plus_scalar")
+
+    __radd__ = __add__
+
+    def __sub__(self, other):
+        return _sym_binop(self, other, "elemwise_sub", "_minus_scalar")
+
+    def __rsub__(self, other):
+        return _sym_binop(self, other, None, "_rminus_scalar")
+
+    def __mul__(self, other):
+        return _sym_binop(self, other, "elemwise_mul", "_mul_scalar")
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, other):
+        return _sym_binop(self, other, "elemwise_div", "_div_scalar")
+
+    __div__ = __truediv__
+
+    def __rtruediv__(self, other):
+        return _sym_binop(self, other, None, "_rdiv_scalar")
+
+    __rdiv__ = __rtruediv__
+
+    def __pow__(self, other):
+        return _sym_binop(self, other, "_power", "_power_scalar")
+
+    def __neg__(self):
+        return _sym_binop(self, -1.0, None, "_mul_scalar")
+
+    def __copy__(self):
+        return Symbol(list(self._outputs))
+
+
+def _attr_str(v):
+    if isinstance(v, (tuple, list)):
+        return str(tuple(v))
+    return str(v)
+
+
+def _sym_binop(lhs, rhs, arr_op, scalar_op):
+    mod = sys.modules[__name__]
+    if isinstance(rhs, Symbol):
+        if arr_op is None:
+            raise MXNetError("unsupported symbol-symbol op")
+        return getattr(mod, arr_op)(lhs, rhs)
+    return getattr(mod, scalar_op)(lhs, scalar=float(rhs))
+
+
+def Variable(name, attr=None, shape=None, lr_mult=None, wd_mult=None,
+             dtype=None, init=None, **kwargs):
+    """reference ``symbol.py`` Variable"""
+    misc = AttrScope.current().get(attr)
+    if shape is not None:
+        misc["__shape__"] = str(tuple(shape))
+    if lr_mult is not None:
+        misc["__lr_mult__"] = str(lr_mult)
+    if wd_mult is not None:
+        misc["__wd_mult__"] = str(wd_mult)
+    if dtype is not None:
+        misc["__dtype__"] = str(dtype)
+    if init is not None:
+        misc["__init__"] = init if isinstance(init, str) else init.dumps()
+    misc.update({k: str(v) for k, v in kwargs.items()})
+    return Symbol([(_Node(None, name, {}, [], misc), 0)])
+
+
+var = Variable
+
+
+def Group(symbols):
+    """reference ``symbol.py`` Group — concat output lists."""
+    entries = []
+    for s in symbols:
+        entries.extend(s._outputs)
+    return Symbol(entries)
+
+
+def load_json(json_str):
+    payload = json.loads(json_str)
+    nodes = []
+    for jn in payload["nodes"]:
+        if jn["op"] == "null":
+            nodes.append(_Node(None, jn["name"], {}, [],
+                               jn.get("misc_attrs", {})))
+        else:
+            op = _reg.get(jn["op"])
+            attrs = op.canonicalize_attrs(jn.get("attrs", {}))
+            inputs = [(nodes[i], ci) for i, ci in jn["inputs"]]
+            nodes.append(_Node(op, jn["name"], attrs, inputs,
+                               jn.get("misc_attrs", {})))
+    return Symbol([(nodes[i], ci) for i, ci in payload["heads"]])
+
+
+def load(fname):
+    with open(fname) as f:
+        return load_json(f.read())
+
+
+# ---------------------------------------------------------------------------
+# generated sym.<op> composition functions (the _init_symbol_module analog,
+# reference ``symbol.py:1244``)
+# ---------------------------------------------------------------------------
+def _compose(op, args, kwargs):
+    name = kwargs.pop("name", None)
+    attr = kwargs.pop("attr", None)
+    sym_kwargs = {k: v for k, v in kwargs.items() if isinstance(v, Symbol)}
+    attr_kwargs = {k: v for k, v in kwargs.items()
+                   if not isinstance(v, Symbol)}
+    pos = []
+    for a in args:
+        if not isinstance(a, Symbol):
+            raise MXNetError("%s: positional args must be Symbols" % op.name)
+        pos.append(a)
+    if op.key_var_num_args and op.key_var_num_args not in attr_kwargs:
+        attr_kwargs[op.key_var_num_args] = len(pos) + len(sym_kwargs)
+    attrs = op.canonicalize_attrs(attr_kwargs)
+    name = NameManager.current().get(name, op.hint)
+    arg_names = op.list_arguments(attrs)
+    aux_names = op.list_aux_states(attrs)
+
+    inputs = []
+    pi = iter(pos)
+    for nm in arg_names:
+        if nm in sym_kwargs:
+            inputs.append(sym_kwargs.pop(nm)._entry())
+        else:
+            try:
+                inputs.append(next(pi)._entry())
+            except StopIteration:
+                # auto-create variable (reference comp. semantics)
+                inputs.append(Variable("%s_%s" % (name, nm))._outputs[0])
+    for nm in aux_names:
+        if nm in sym_kwargs:
+            inputs.append(sym_kwargs.pop(nm)._entry())
+        else:
+            inputs.append(Variable("%s_%s" % (name, nm))._outputs[0])
+    if sym_kwargs:
+        raise MXNetError("%s: unknown symbol inputs %s"
+                         % (op.name, sorted(sym_kwargs)))
+    misc = AttrScope.current().get(attr)
+    node = _Node(op, name, attrs, inputs, misc)
+    return Symbol([(node, i)
+                   for i in range(len(op.list_outputs(attrs)))]
+                  if len(op.list_outputs(attrs)) > 1 else [(node, 0)])
+
+
+def _make_sym_func(op_name):
+    op = _reg.get(op_name)
+
+    def fn(*args, **kwargs):
+        return _compose(op, args, kwargs)
+
+    fn.__name__ = op_name
+    fn.__doc__ = op.doc or ("Symbolic op %r" % op_name)
+    return fn
+
+
+def _init_symbol_module():
+    mod = sys.modules[__name__]
+    for op_name in _reg.list_ops():
+        if not hasattr(mod, op_name):
+            setattr(mod, op_name, _make_sym_func(op_name))
+
+
+_init_symbol_module()
+
+# aliases matching reference sym namespace
+pow = sys.modules[__name__].__dict__["_power"]  # noqa: A001
+maximum = sys.modules[__name__].__dict__["_maximum"]
+minimum = sys.modules[__name__].__dict__["_minimum"]
