@@ -19,8 +19,34 @@ from . import random as rnd
 
 from . import attribute
 from .attribute import AttrScope
+from . import name
 from . import symbol
 from . import symbol as sym
 from .symbol import Symbol, Group, Variable
 from . import executor
 from .executor import Executor
+
+from . import initializer
+from . import initializer as init
+from . import optimizer
+from . import optimizer as opt
+from .optimizer import Optimizer
+from . import metric
+from . import lr_scheduler
+from . import callback
+from . import monitor
+from .monitor import Monitor
+
+from . import io
+from . import kvstore
+from . import kvstore as kv
+from .kvstore import KVStore
+
+from . import module
+from . import module as mod
+from .module import Module
+
+from . import model
+from .model import FeedForward
+
+from . import test_utils

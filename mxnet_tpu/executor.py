@@ -11,10 +11,14 @@ traces into ONE jitted XLA computation —
 * InitCachedOps / bulk segments (``:544,678``)   -> the jit cache itself
 * engine var-dependency scheduling               -> XLA dataflow + PJRT async
 
-``forward(is_train=True)`` runs a jitted function that returns outputs, aux
-updates AND the vjp residuals (as a ``jax.tree_util.Partial`` pytree), so
-``backward()`` is a second jitted call on saved residuals — the same
-fwd/bwd split as the reference, without storing a graph.
+``forward(is_train=True)`` runs ONE fused fwd+bwd XLA computation (with
+default all-ones head gradients — loss ops ignore them by design, matching
+``backward()`` with no out_grads) and stashes the gradients;
+``backward()`` then just applies them honoring grad_req.  This mirrors the
+reference executor's single cached fwd+bwd graph (``InitCachedOps``) and is
+the TPU-optimal shape: one compiled step, no residual round-trips.  An
+explicit ``backward(out_grads)`` re-runs the fused computation with those
+cotangents (rare, non-loss graphs).
 
 grad_req semantics ('write'/'add'/'null') follow ``include/mxnet/op_attr_types.h``
 kWriteTo/kAddTo/kNullOp; 'add' accumulates into the bound grad arrays.
@@ -84,7 +88,8 @@ class Executor:
         self._group2ctx = group2ctx or {}
         self.outputs = []
         self._monitor_callback = None
-        self._residuals = None
+        self._pending_grads = None
+        self._last_state = None
         self._rng_step = 0
         self._fns = {}
 
@@ -104,6 +109,22 @@ class Executor:
         aux_names = list(self.aux_names)
         diff_names = self._diff_names()
 
+        def _vjp_parts(args, aux, rng):
+            amap = dict(zip(arg_names, args))
+            axmap = dict(zip(aux_names, aux))
+            nondiff = {n: v for n, v in amap.items() if n not in diff_names}
+
+            def g(diff_args):
+                vals = dict(nondiff)
+                vals.update(diff_args)
+                outs, new_aux = _graph_forward(symbol, vals, axmap, True, rng)
+                return tuple(outs), new_aux
+
+            outs, vjp_fn, new_aux = jax.vjp(
+                g, {n: amap[n] for n in diff_names}, has_aux=True)
+            new_aux_list = [new_aux.get(n, axmap[n]) for n in aux_names]
+            return outs, new_aux_list, vjp_fn
+
         if kind == "predict":
             def f(args, aux, rng):
                 outs, _ = _graph_forward(
@@ -113,29 +134,31 @@ class Executor:
 
             fn = jax.jit(f)
         elif kind == "train":
+            # fused fwd+bwd with default (ones) head grads — one XLA step
             def f(args, aux, rng):
-                amap = dict(zip(arg_names, args))
-                axmap = dict(zip(aux_names, aux))
-                nondiff = {n: v for n, v in amap.items()
-                           if n not in diff_names}
-
-                def g(diff_args):
-                    vals = dict(nondiff)
-                    vals.update(diff_args)
-                    outs, new_aux = _graph_forward(symbol, vals, axmap,
-                                                   True, rng)
-                    return tuple(outs), new_aux
-
-                outs, vjp_fn, new_aux = jax.vjp(
-                    g, {n: amap[n] for n in diff_names}, has_aux=True)
-                new_aux_list = [new_aux.get(n, axmap[n]) for n in aux_names]
-                return list(outs), new_aux_list, vjp_fn
+                outs, new_aux_list, vjp_fn = _vjp_parts(args, aux, rng)
+                (grads,) = vjp_fn(tuple(jnp.ones_like(o) for o in outs))
+                return list(outs), new_aux_list, grads
 
             fn = jax.jit(f)
-        elif kind == "backward":
-            def f(vjp_fn, out_grads):
+        elif kind == "train_fwd":
+            # forward-only in train mode (aux updates, no grads) — used when
+            # the caller never calls backward (e.g. Monitor probing)
+            def f(args, aux, rng):
+                outs, new_aux = _graph_forward(
+                    symbol, dict(zip(arg_names, args)),
+                    dict(zip(aux_names, aux)), True, rng)
+                new_aux_list = [new_aux.get(n, ax)
+                                for n, ax in zip(aux_names, aux)]
+                return outs, new_aux_list
+
+            fn = jax.jit(f)
+        elif kind == "train_with_grads":
+            # explicit head cotangents (non-loss graphs)
+            def f(args, aux, rng, out_grads):
+                outs, new_aux_list, vjp_fn = _vjp_parts(args, aux, rng)
                 (grads,) = vjp_fn(tuple(out_grads))
-                return grads
+                return list(outs), new_aux_list, grads
 
             fn = jax.jit(f)
         else:
@@ -159,13 +182,20 @@ class Executor:
         rng = _random.next_key()
         self._rng_step += 1
         if is_train:
-            outs, new_aux, vjp_fn = self._get_fn("train")(args, aux, rng)
-            self._residuals = vjp_fn
+            if self._diff_names():
+                outs, new_aux, grads = self._get_fn("train")(args, aux, rng)
+                self._pending_grads = grads
+                self._last_state = (args, aux, rng)
+            else:
+                outs, new_aux = self._get_fn("train_fwd")(args, aux, rng)
+                self._pending_grads = None
+                self._last_state = None
             for arr, new in zip(self.aux_arrays, new_aux):
                 arr._jx = new
         else:
             outs = self._get_fn("predict")(args, aux, rng)
-            self._residuals = None
+            self._pending_grads = None
+            self._last_state = None
         self.outputs = [NDArray._from_jax(o, self._ctx) for o in outs]
         if self._monitor_callback is not None:
             for name, arr in zip(self.output_names, self.outputs):
@@ -173,18 +203,22 @@ class Executor:
         return self.outputs
 
     def backward(self, out_grads=None):
-        """reference ``executor.py:134`` — computes grads into grad arrays
-        honoring grad_req."""
-        if self._residuals is None:
+        """reference ``executor.py:134`` — applies grads into grad arrays
+        honoring grad_req (they were computed fused with forward)."""
+        if not self._diff_names():
+            return
+        if self._pending_grads is None:
             raise MXNetError("backward called before forward(is_train=True)")
         if out_grads is None:
-            out_grads = [jnp.ones_like(o._jx) for o in self.outputs]
+            grads = self._pending_grads
         else:
             if isinstance(out_grads, NDArray):
                 out_grads = [out_grads]
             out_grads = [g._jx if isinstance(g, NDArray) else jnp.asarray(g)
                          for g in out_grads]
-        grads = self._get_fn("backward")(self._residuals, out_grads)
+            args, aux, rng = self._last_state
+            _outs, _new_aux, grads = self._get_fn("train_with_grads")(
+                args, aux, rng, out_grads)
         for name in self._diff_names():
             g = grads[name]
             dst = self.grad_dict.get(name)

@@ -1,0 +1,167 @@
+"""KVStore — the gradient-exchange API (``mx.kv``).
+
+Reference: ``include/mxnet/kvstore.h`` + ``src/kvstore/`` (SURVEY §2.4):
+``create(type)``, int/str keys, ``init/push/pull`` with per-key aggregation,
+``set_optimizer`` (updater applied where the weights live), rank/num_workers,
+barrier, server command protocol.
+
+TPU-native mapping (SURVEY §5.8): there is no parameter server —
+
+* ``local`` / ``device``: single-process aggregation.  Pushed gradient lists
+  are summed on device (the ``CommDevice`` analog; on a TPU mesh the sum is
+  an XLA ``psum`` compiled into the step — see ``parallel/``), and the
+  updater runs on the stored copy.
+* ``dist_sync`` / ``dist_async``: multi-process over DCN via
+  ``jax.distributed`` + host collectives.  ``dist_async`` has no collective
+  analog (SURVEY §5.8) — it is accepted and behaves bulk-synchronously; the
+  semantic difference is documented, not emulated.
+
+The API surface (push/pull ordering per key, update-on-kvstore semantics) is
+preserved so ``Module``/``model.py`` code from the reference runs unchanged.
+"""
+
+from __future__ import annotations
+
+import pickle
+
+from .base import MXNetError
+from .ndarray import NDArray, zeros
+
+__all__ = ["KVStore", "create"]
+
+
+def _ctype_key_value(keys, vals):
+    """Normalize to (list[key], list[list[NDArray]]) — reference kvstore.py."""
+    if isinstance(keys, (int, str)):
+        keys = [keys]
+        vals = [vals]
+    out_vals = []
+    for v in vals:
+        if isinstance(v, NDArray):
+            out_vals.append([v])
+        else:
+            out_vals.append(list(v))
+    return list(keys), out_vals
+
+
+class KVStore:
+    """reference ``python/mxnet/kvstore.py:35``"""
+
+    def __init__(self, kv_type="local"):
+        self._type = kv_type
+        self._store = {}
+        self._updater = None
+        self._optimizer = None
+
+    # -- properties -------------------------------------------------------
+    @property
+    def type(self):
+        return self._type
+
+    @property
+    def rank(self):
+        """reference kvstore.py rank — process index."""
+        import jax
+
+        return jax.process_index() if "dist" in self._type else 0
+
+    @property
+    def num_workers(self):
+        import jax
+
+        return jax.process_count() if "dist" in self._type else 1
+
+    # -- data plane -------------------------------------------------------
+    def init(self, key, value):
+        keys, vals = _ctype_key_value(key, value)
+        for k, vlist in zip(keys, vals):
+            if k in self._store:
+                raise MXNetError("key %r already initialized" % k)
+            self._store[k] = vlist[0].copy()
+
+    def push(self, key, value, priority=0):
+        """Aggregate pushed values per key; apply updater if set (the
+        reference's server-side/updater-side optimizer application,
+        ``kvstore_local.h:49-60``)."""
+        keys, vals = _ctype_key_value(key, value)
+        for k, vlist in zip(keys, vals):
+            if k not in self._store:
+                raise MXNetError("key %r not initialized" % k)
+            merged = vlist[0]
+            for v in vlist[1:]:
+                merged = merged + v.as_in_context(merged.context)
+            if self.num_workers > 1:
+                merged = self._allreduce(merged)
+            if self._updater is not None:
+                self._updater(k, merged, self._store[k])
+            else:
+                # default updater is ASSIGN (reference kvstore_local.h: the
+                # merged reduce replaces the stored value; aggregation is
+                # across the pushed device list, not across pushes)
+                merged.copyto(self._store[k])
+
+    def pull(self, key, out=None, priority=0):
+        keys, outs = _ctype_key_value(key, out)
+        for k, olist in zip(keys, outs):
+            if k not in self._store:
+                raise MXNetError("key %r not initialized" % k)
+            for o in olist:
+                self._store[k].copyto(o)
+
+    def _allreduce(self, arr):
+        """DCN all-reduce across processes (dist types)."""
+        import jax
+        import jax.numpy as jnp
+        from jax.experimental import multihost_utils
+
+        summed = multihost_utils.process_allgather(arr._jx)
+        return NDArray._from_jax(jnp.sum(summed, axis=0), arr.context)
+
+    # -- updater / optimizer ----------------------------------------------
+    def set_updater(self, updater):
+        self._updater = updater
+
+    _set_updater = set_updater
+
+    def set_optimizer(self, optimizer):
+        """reference kvstore.py:232 — on dist the optimizer is serialized to
+        servers; here the updater always runs where the weights live."""
+        from .optimizer import get_updater
+
+        self._optimizer = optimizer
+        self._updater = get_updater(optimizer)
+
+    # -- control plane ----------------------------------------------------
+    def barrier(self):
+        if self.num_workers > 1:
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices("kvstore_barrier")
+
+    def send_command_to_servers(self, head, body):
+        """No servers exist; kept for API parity (logged no-op)."""
+
+    def save_optimizer_states(self, fname):
+        if self._updater is None:
+            raise MXNetError("optimizer not initialized on kvstore")
+        with open(fname, "wb") as f:
+            f.write(self._updater.get_states())
+
+    def load_optimizer_states(self, fname):
+        if self._updater is None:
+            raise MXNetError("optimizer not initialized on kvstore")
+        with open(fname, "rb") as f:
+            self._updater.set_states(f.read())
+
+
+def create(name="local"):
+    """reference ``kvstore.cc:17-45`` type dispatch."""
+    if not isinstance(name, str):
+        raise TypeError("name must be a string")
+    valid = ("local", "local_allreduce_device", "device",
+             "local_update_cpu", "local_allreduce_cpu",
+             "dist_sync", "dist_async", "dist_sync_device",
+             "dist_async_device", "dist")
+    if name not in valid:
+        raise MXNetError("unknown kvstore type %r" % name)
+    return KVStore(name)

@@ -1,0 +1,374 @@
+"""Evaluation metrics (``mx.metric``). Reference: ``python/mxnet/metric.py``."""
+
+from __future__ import annotations
+
+import numpy as _np
+
+from .base import MXNetError, Registry
+from .ndarray import NDArray
+
+__all__ = ["EvalMetric", "CompositeEvalMetric", "Accuracy", "TopKAccuracy",
+           "F1", "Perplexity", "MAE", "MSE", "RMSE", "CrossEntropy", "Loss",
+           "CustomMetric", "np", "create", "check_label_shapes"]
+
+registry = Registry("metric")
+
+
+def check_label_shapes(labels, preds, shape=0):
+    """reference ``metric.py:10``"""
+    if shape == 0:
+        label_shape, pred_shape = len(labels), len(preds)
+    else:
+        label_shape, pred_shape = labels.shape, preds.shape
+    if label_shape != pred_shape:
+        raise ValueError(
+            "Shape of labels %s does not match shape of predictions %s"
+            % (label_shape, pred_shape))
+
+
+def _as_np(x):
+    return x.asnumpy() if isinstance(x, NDArray) else _np.asarray(x)
+
+
+class EvalMetric:
+    """reference ``metric.py:20``"""
+
+    def __init__(self, name, num=None):
+        self.name = name
+        self.num = num
+        self.reset()
+
+    def update(self, labels, preds):
+        raise NotImplementedError()
+
+    def reset(self):
+        if self.num is None:
+            self.num_inst = 0
+            self.sum_metric = 0.0
+        else:
+            self.num_inst = [0] * self.num
+            self.sum_metric = [0.0] * self.num
+
+    def get(self):
+        if self.num is None:
+            value = self.sum_metric / self.num_inst if self.num_inst != 0 \
+                else float("nan")
+            return (self.name, value)
+        names = ["%s_%d" % (self.name, i) for i in range(self.num)]
+        values = [s / n if n != 0 else float("nan")
+                  for s, n in zip(self.sum_metric, self.num_inst)]
+        return (names, values)
+
+    def get_name_value(self):
+        name, value = self.get()
+        if not isinstance(name, list):
+            name = [name]
+        if not isinstance(value, list):
+            value = [value]
+        return list(zip(name, value))
+
+    def __str__(self):
+        return "EvalMetric: {}".format(dict(self.get_name_value()))
+
+
+@registry.register
+class CompositeEvalMetric(EvalMetric):
+    """reference ``metric.py:86``"""
+
+    def __init__(self, metrics=None, name="composite", **kwargs):
+        super().__init__(name)
+        self.metrics = [create(m) if isinstance(m, str) else m
+                        for m in (metrics or [])]
+
+    def add(self, metric):
+        self.metrics.append(create(metric) if isinstance(metric, str)
+                            else metric)
+
+    def get_metric(self, index):
+        return self.metrics[index]
+
+    def update(self, labels, preds):
+        for metric in self.metrics:
+            metric.update(labels, preds)
+
+    def reset(self):
+        for metric in getattr(self, "metrics", []):
+            metric.reset()
+
+    def get(self):
+        names, results = [], []
+        for metric in self.metrics:
+            name, result = metric.get()
+            names.append(name) if not isinstance(name, list) \
+                else names.extend(name)
+            results.append(result) if not isinstance(result, list) \
+                else results.extend(result)
+        return (names, results)
+
+
+@registry.register
+class Accuracy(EvalMetric):
+    """reference ``metric.py:132``"""
+
+    def __init__(self, axis=1, **kwargs):
+        super().__init__("accuracy")
+        self.axis = axis
+
+    def update(self, labels, preds):
+        check_label_shapes(labels, preds)
+        for label, pred_label in zip(labels, preds):
+            p = _as_np(pred_label)
+            if p.ndim > 1 and p.shape[-1] > 1 and p.ndim >= 2:
+                p = _np.argmax(p, axis=self.axis if p.ndim > self.axis else -1)
+            lab = _as_np(label).astype("int32").flatten()
+            p = p.astype("int32").flatten()
+            check_label_shapes(lab, p, shape=1)
+            self.sum_metric += float((p == lab).sum())
+            self.num_inst += len(p)
+
+
+@registry.register
+class TopKAccuracy(EvalMetric):
+    """reference ``metric.py:152``"""
+
+    def __init__(self, top_k=1, **kwargs):
+        super().__init__("top_k_accuracy")
+        self.top_k = top_k
+        assert self.top_k > 1, "Use Accuracy if top_k is no more than 1"
+        self.name += "_%d" % self.top_k
+
+    def update(self, labels, preds):
+        check_label_shapes(labels, preds)
+        for label, pred_label in zip(labels, preds):
+            p = _np.argsort(_as_np(pred_label).astype("float32"), axis=1)
+            lab = _as_np(label).astype("int32")
+            num_samples = p.shape[0]
+            num_classes = p.shape[1]
+            top_k = min(num_classes, self.top_k)
+            for j in range(top_k):
+                self.sum_metric += float(
+                    (p[:, num_classes - 1 - j].flatten() ==
+                     lab.flatten()).sum())
+            self.num_inst += num_samples
+
+
+@registry.register
+class F1(EvalMetric):
+    """reference ``metric.py:183`` (binary)"""
+
+    def __init__(self, **kwargs):
+        super().__init__("f1")
+
+    def update(self, labels, preds):
+        check_label_shapes(labels, preds)
+        for label, pred in zip(labels, preds):
+            pred = _as_np(pred)
+            label = _as_np(label).astype("int32")
+            pred_label = _np.argmax(pred, axis=1)
+            check_label_shapes(label, pred)
+            if len(_np.unique(label)) > 2:
+                raise ValueError("F1 currently only supports binary"
+                                 " classification.")
+            tp = fp = fn = 0.0
+            for y_pred, y_true in zip(pred_label, label):
+                if y_pred == 1 and y_true == 1:
+                    tp += 1.0
+                elif y_pred == 1 and y_true == 0:
+                    fp += 1.0
+                elif y_pred == 0 and y_true == 1:
+                    fn += 1.0
+            precision = tp / (tp + fp) if tp + fp > 0 else 0.0
+            recall = tp / (tp + fn) if tp + fn > 0 else 0.0
+            f1 = 2 * precision * recall / (precision + recall) \
+                if precision + recall > 0 else 0.0
+            self.sum_metric += f1
+            self.num_inst += 1
+
+
+@registry.register
+class Perplexity(EvalMetric):
+    """reference ``metric.py:230``"""
+
+    def __init__(self, ignore_label=None, axis=-1, **kwargs):
+        super().__init__("Perplexity")
+        self.ignore_label = ignore_label
+        self.axis = axis
+
+    def update(self, labels, preds):
+        assert len(labels) == len(preds)
+        loss = 0.0
+        num = 0
+        for label, pred in zip(labels, preds):
+            label = _as_np(label)
+            pred = _as_np(pred)
+            assert label.size == pred.size / pred.shape[-1], \
+                "shape mismatch: %s vs. %s" % (label.shape, pred.shape)
+            label = label.reshape((label.size,)).astype("int32")
+            probs = pred.reshape(-1, pred.shape[-1])[
+                _np.arange(label.size), label]
+            if self.ignore_label is not None:
+                ignore = (label == self.ignore_label).astype(probs.dtype)
+                num -= int(ignore.sum())
+                probs = probs * (1 - ignore) + ignore
+            loss -= float(_np.sum(_np.log(_np.maximum(1e-10, probs))))
+            num += label.size
+        self.sum_metric += float(_np.exp(loss / num)) * num
+        self.num_inst += num
+
+    def get(self):
+        # reference computes exp(total_nll/total_n); approximate via weighted
+        # mean of per-batch perplexities accumulated above
+        return super().get()
+
+
+@registry.register
+class MAE(EvalMetric):
+    """reference ``metric.py:280``"""
+
+    def __init__(self, **kwargs):
+        super().__init__("mae")
+
+    def update(self, labels, preds):
+        check_label_shapes(labels, preds)
+        for label, pred in zip(labels, preds):
+            label = _as_np(label)
+            pred = _as_np(pred)
+            if len(label.shape) == 1:
+                label = label.reshape(label.shape[0], 1)
+            self.sum_metric += float(_np.abs(label - pred).mean())
+            self.num_inst += 1
+
+
+@registry.register
+class MSE(EvalMetric):
+    """reference ``metric.py:297``"""
+
+    def __init__(self, **kwargs):
+        super().__init__("mse")
+
+    def update(self, labels, preds):
+        check_label_shapes(labels, preds)
+        for label, pred in zip(labels, preds):
+            label = _as_np(label)
+            pred = _as_np(pred)
+            if len(label.shape) == 1:
+                label = label.reshape(label.shape[0], 1)
+            self.sum_metric += float(((label - pred) ** 2.0).mean())
+            self.num_inst += 1
+
+
+@registry.register
+class RMSE(EvalMetric):
+    """reference ``metric.py:317``"""
+
+    def __init__(self, **kwargs):
+        super().__init__("rmse")
+
+    def update(self, labels, preds):
+        check_label_shapes(labels, preds)
+        for label, pred in zip(labels, preds):
+            label = _as_np(label)
+            pred = _as_np(pred)
+            if len(label.shape) == 1:
+                label = label.reshape(label.shape[0], 1)
+            self.sum_metric += float(
+                _np.sqrt(((label - pred) ** 2.0).mean()))
+            self.num_inst += 1
+
+
+@registry.register
+class CrossEntropy(EvalMetric):
+    """reference ``metric.py:335``"""
+
+    def __init__(self, eps=1e-8, **kwargs):
+        super().__init__("cross-entropy")
+        self.eps = eps
+
+    def update(self, labels, preds):
+        check_label_shapes(labels, preds)
+        for label, pred in zip(labels, preds):
+            label = _as_np(label).ravel()
+            pred = _as_np(pred)
+            assert label.shape[0] == pred.shape[0]
+            prob = pred[_np.arange(label.shape[0]), _np.int64(label)]
+            self.sum_metric += float((-_np.log(prob + self.eps)).sum())
+            self.num_inst += label.shape[0]
+
+
+@registry.register
+class Loss(EvalMetric):
+    """Mean of raw outputs (for MakeLoss graphs)."""
+
+    def __init__(self, **kwargs):
+        super().__init__("loss")
+
+    def update(self, _, preds):
+        for pred in preds:
+            pred = _as_np(pred)
+            self.sum_metric += float(pred.sum())
+            self.num_inst += pred.size
+
+
+@registry.register
+class Torch(Loss):
+    """alias kept for reference-API parity"""
+
+    def __init__(self, name="torch", **kwargs):
+        EvalMetric.__init__(self, name)
+
+
+@registry.register
+class CustomMetric(EvalMetric):
+    """reference ``metric.py:370``"""
+
+    def __init__(self, feval, name=None, allow_extra_outputs=False, **kwargs):
+        if name is None:
+            name = feval.__name__
+            if name.find("<") != -1:
+                name = "custom(%s)" % name
+        super().__init__(name)
+        self._feval = feval
+        self._allow_extra_outputs = allow_extra_outputs
+
+    def update(self, labels, preds):
+        if not self._allow_extra_outputs:
+            check_label_shapes(labels, preds)
+        for pred, label in zip(preds, labels):
+            label = _as_np(label)
+            pred = _as_np(pred)
+            reval = self._feval(label, pred)
+            if isinstance(reval, tuple):
+                (sum_metric, num_inst) = reval
+                self.sum_metric += sum_metric
+                self.num_inst += num_inst
+            else:
+                self.sum_metric += reval
+                self.num_inst += 1
+
+
+def np(numpy_feval, name=None, allow_extra_outputs=False):
+    """reference ``metric.py`` np() — wrap a numpy feval."""
+
+    def feval(label, pred):
+        return numpy_feval(label, pred)
+
+    feval.__name__ = numpy_feval.__name__
+    return CustomMetric(feval, name, allow_extra_outputs)
+
+
+def create(metric, **kwargs):
+    """reference ``metric.py`` create"""
+    if callable(metric):
+        return CustomMetric(metric, **kwargs)
+    if isinstance(metric, EvalMetric):
+        return metric
+    if isinstance(metric, list):
+        composite = CompositeEvalMetric()
+        for child in metric:
+            composite.add(create(child, **kwargs))
+        return composite
+    if metric in ("acc",):
+        metric = "accuracy"
+    if metric in ("ce",):
+        metric = "crossentropy"
+    return registry.create(metric, **kwargs)

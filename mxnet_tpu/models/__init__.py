@@ -1,0 +1,31 @@
+"""Model zoo — symbol builders for the reference's example networks.
+
+Reference: ``example/image-classification/symbols/`` (SURVEY §2.8): lenet,
+mlp, alexnet, vgg, inception-bn, inception-v3, resnet, resnext; plus the rnn
+and ssd model families in their own modules.
+
+Each ``get_symbol(num_classes, **kwargs)`` returns a Symbol ending in
+``SoftmaxOutput(name='softmax')`` exactly like the reference builders, so
+``Module.fit`` training scripts port 1:1.
+"""
+
+from . import lenet, mlp, alexnet, vgg, resnet, inception_bn, inception_v3
+
+_BUILDERS = {
+    "lenet": lenet.get_symbol,
+    "mlp": mlp.get_symbol,
+    "alexnet": alexnet.get_symbol,
+    "vgg": vgg.get_symbol,
+    "resnet": resnet.get_symbol,
+    "inception-bn": inception_bn.get_symbol,
+    "inception-v3": inception_v3.get_symbol,
+    "resnext": resnet.get_symbol_resnext,
+}
+
+
+def get_symbol(network, num_classes=1000, **kwargs):
+    """Dispatch like ``example/image-classification/train_*.py --network``."""
+    if network not in _BUILDERS:
+        raise ValueError("unknown network %r (have %s)"
+                         % (network, sorted(_BUILDERS)))
+    return _BUILDERS[network](num_classes=num_classes, **kwargs)

@@ -1,0 +1,11 @@
+"""Module API (``mx.mod``) — the canonical training harness.
+
+Reference: ``python/mxnet/module/`` (SURVEY §2.6/§3.1).
+"""
+
+from .base_module import BaseModule
+from .module import Module
+from .bucketing_module import BucketingModule
+from .sequential_module import SequentialModule
+
+__all__ = ["BaseModule", "Module", "BucketingModule", "SequentialModule"]

@@ -1,0 +1,397 @@
+"""Module — the concrete single-symbol training module.
+
+Reference: ``python/mxnet/module/module.py`` (708 LoC; bind :323,
+init_optimizer :432, update :553) + ``executor_group.py``
+(DataParallelExecutorGroup :77).
+
+TPU-native data parallelism: where the reference builds one executor per GPU
+and reduces gradients through KVStore (``executor_group.py`` decide_slices +
+``comm.h`` Reduce), this Module binds ONE executor whose arrays are *global
+jax.Arrays over a device mesh*: data/label sharded along the batch axis,
+parameters replicated.  XLA GSPMD then compiles the gradient psum over ICI
+into the step itself — the ``KVStore('device')`` allreduce with no server and
+no separate comm phase.  A single context degenerates to a 1-device mesh.
+"""
+
+from __future__ import annotations
+
+import logging
+
+import numpy as np
+
+from .. import optimizer as opt
+from ..base import MXNetError
+from ..context import Context, cpu
+from ..executor import Executor
+from ..initializer import Uniform, InitDesc
+from ..kvstore import KVStore
+from ..model import (_create_kvstore, _initialize_kvstore, _update_params,
+                     _update_params_on_kvstore, load_checkpoint,
+                     save_checkpoint)
+from ..ndarray import NDArray, zeros as nd_zeros
+from .base_module import BaseModule
+
+__all__ = ["Module"]
+
+
+def _parse_data_desc(data_shapes):
+    out = []
+    for d in data_shapes or []:
+        if hasattr(d, "name"):
+            out.append((d.name, tuple(d.shape)))
+        else:
+            out.append((d[0], tuple(d[1])))
+    return out
+
+
+class Module(BaseModule):
+    """reference ``module.py:50``"""
+
+    def __init__(self, symbol, data_names=("data",), label_names=("softmax_label",),
+                 logger=logging, context=None, work_load_list=None,
+                 fixed_param_names=None):
+        super().__init__(logger=logger)
+        if context is None:
+            from ..context import current_context
+
+            context = [current_context()]
+        if isinstance(context, Context):
+            context = [context]
+        self._context = list(context)
+        self._work_load_list = work_load_list
+        self._symbol = symbol
+        self._data_names = list(data_names or [])
+        self._label_names = list(label_names or [])
+        self._fixed_param_names = list(fixed_param_names or [])
+        arg_names = symbol.list_arguments()
+        input_names = self._data_names + self._label_names
+        self._param_names = [n for n in arg_names if n not in input_names]
+        self._aux_names = symbol.list_auxiliary_states()
+        self._output_names = symbol.list_outputs()
+        self._exec = None
+        self._data_shapes = None
+        self._label_shapes = None
+        self._mesh = None
+        self._optimizer = None
+        self._kvstore = None
+        self._updater = None
+        self._update_on_kvstore = False
+        self._preload_opt_states = None
+        self._grad_req = "write"
+
+    # -- properties -------------------------------------------------------
+    @property
+    def data_names(self):
+        return self._data_names
+
+    @property
+    def label_names(self):
+        return self._label_names
+
+    @property
+    def output_names(self):
+        return self._output_names
+
+    @property
+    def data_shapes(self):
+        assert self.binded
+        return self._data_shapes
+
+    @property
+    def label_shapes(self):
+        assert self.binded
+        return self._label_shapes
+
+    @property
+    def output_shapes(self):
+        assert self.binded
+        outs = self._symbol.infer_shape(
+            **{n: s for n, s in (self._data_shapes +
+                                 (self._label_shapes or []))})[1]
+        return list(zip(self._output_names, outs))
+
+    def get_params(self):
+        assert self.binded and self.params_initialized
+        arg = {n: self._exec.arg_dict[n] for n in self._param_names}
+        aux = dict(self._exec.aux_dict)
+        return (arg, aux)
+
+    # -- binding ----------------------------------------------------------
+    def _make_mesh(self):
+        import jax
+        from jax.sharding import Mesh
+
+        devices = [c.jax_device() for c in self._context]
+        if len(set(devices)) != len(devices):
+            raise MXNetError("duplicate devices in context list")
+        return Mesh(np.array(devices), ("data",))
+
+    def _shard(self, arr, batch_axis):
+        """Place an NDArray globally over the module mesh."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        if self._mesh is None or len(self._context) == 1:
+            return
+        spec = P("data") if batch_axis else P()
+        arr._jx = jax.device_put(arr._jx, NamedSharding(self._mesh, spec))
+
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, shared_module=None,
+             grad_req="write"):
+        """reference ``module.py:323``"""
+        if force_rebind:
+            self._exec = None
+            self.binded = False
+        if self.binded:
+            self.logger.warning("Already binded, ignoring bind()")
+            return
+        self.for_training = for_training
+        self.inputs_need_grad = inputs_need_grad
+        self._grad_req = grad_req
+        assert not (not for_training and inputs_need_grad)
+        self._data_shapes = _parse_data_desc(data_shapes)
+        self._label_shapes = _parse_data_desc(label_shapes) \
+            if label_shapes else []
+        if len(self._context) > 1:
+            self._mesh = self._make_mesh()
+            for _, s in self._data_shapes + self._label_shapes:
+                if s and s[0] % len(self._context) != 0:
+                    raise MXNetError(
+                        "batch size %d not divisible by %d devices"
+                        % (s[0], len(self._context)))
+        shapes = dict(self._data_shapes + self._label_shapes)
+        req = {}
+        for n in self._symbol.list_arguments():
+            if n in self._param_names and n not in self._fixed_param_names \
+                    and for_training:
+                req[n] = grad_req if isinstance(grad_req, str) else \
+                    grad_req.get(n, "write")
+            elif n in self._data_names and inputs_need_grad:
+                req[n] = "write"
+            else:
+                req[n] = "null"
+        shared_exec = shared_module._exec if shared_module is not None else None
+        self._exec = Executor._simple_bind(
+            self._symbol, self._context[0], grad_req=req,
+            shared_exec=shared_exec, **shapes)
+        # global placement over the mesh
+        if self._mesh is not None:
+            for n in self._symbol.list_arguments():
+                batch_axis = n in self._data_names or n in self._label_names
+                if self._exec.arg_dict.get(n) is not None:
+                    self._shard(self._exec.arg_dict[n], batch_axis)
+                if self._exec.grad_dict.get(n) is not None:
+                    self._shard(self._exec.grad_dict[n], batch_axis)
+            for n in self._aux_names:
+                self._shard(self._exec.aux_dict[n], False)
+        self.binded = True
+        if shared_module is not None and shared_module.params_initialized:
+            self.params_initialized = True
+
+    def reshape(self, data_shapes, label_shapes=None):
+        """reference module.py reshape"""
+        assert self.binded
+        self._data_shapes = _parse_data_desc(data_shapes)
+        self._label_shapes = _parse_data_desc(label_shapes) \
+            if label_shapes else []
+        shapes = dict(self._data_shapes + self._label_shapes)
+        self._exec = self._exec.reshape(allow_up_sizing=True, **shapes)
+
+    # -- parameters -------------------------------------------------------
+    def init_params(self, initializer=Uniform(0.01), arg_params=None,
+                    aux_params=None, allow_missing=False, force_init=False):
+        """reference module.py:227"""
+        assert self.binded, "call bind before initializing the parameters"
+        if self.params_initialized and not force_init:
+            return
+        attrs = self._symbol.attr_dict()
+
+        def _impl(name, arr, cache):
+            if cache is not None and name in cache:
+                cache[name].copyto(arr)
+            elif cache is not None and not allow_missing:
+                raise RuntimeError("%s is not presented" % name)
+            elif initializer is not None:
+                initializer(InitDesc(name, attrs.get(name)), arr)
+
+        for name in self._param_names:
+            _impl(name, self._exec.arg_dict[name], arg_params)
+        for name in self._aux_names:
+            _impl(name, self._exec.aux_dict[name], aux_params)
+        # restore global sharding after host-side init writes
+        if self._mesh is not None:
+            for name in self._param_names:
+                self._shard(self._exec.arg_dict[name], False)
+            for name in self._aux_names:
+                self._shard(self._exec.aux_dict[name], False)
+        self.params_initialized = True
+
+    # -- optimizer --------------------------------------------------------
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.01),),
+                       force_init=False):
+        """reference ``module.py:432``"""
+        assert self.binded and self.params_initialized
+        if self.optimizer_initialized and not force_init:
+            self.logger.warning("optimizer already initialized, ignoring...")
+            return
+        arg_params = {n: self._exec.arg_dict[n] for n in self._param_names}
+        (kvstore, update_on_kvstore) = _create_kvstore(
+            kvstore, len(self._context), arg_params)
+        batch_size = self._data_shapes[0][1][0]
+        if kvstore and "dist" in kvstore.type:
+            batch_size *= kvstore.num_workers
+        rescale_grad = 1.0 / batch_size
+
+        if isinstance(optimizer, str):
+            idx2name = {i: n for i, n in enumerate(self._param_names)}
+            optimizer_params = dict(optimizer_params)
+            if "rescale_grad" not in optimizer_params:
+                optimizer_params["rescale_grad"] = rescale_grad
+            optimizer = opt.create(optimizer, sym=self.symbol,
+                                   param_idx2name=idx2name,
+                                   **optimizer_params)
+        else:
+            assert isinstance(optimizer, opt.Optimizer)
+            if optimizer.rescale_grad != rescale_grad:
+                self.logger.warning(
+                    "Optimizer created manually outside Module but "
+                    "rescale_grad != 1.0/batch_size (%s vs. %s).",
+                    optimizer.rescale_grad, rescale_grad)
+
+        self._optimizer = optimizer
+        self._kvstore = kvstore
+        self._update_on_kvstore = update_on_kvstore
+        self._updater = None
+        if kvstore:
+            _initialize_kvstore(
+                kvstore=kvstore,
+                param_arrays=[[self._exec.arg_dict[n]]
+                              for n in self._param_names],
+                arg_params=arg_params, param_names=self._param_names,
+                update_on_kvstore=update_on_kvstore)
+            if update_on_kvstore:
+                kvstore.set_optimizer(self._optimizer)
+        if not update_on_kvstore:
+            self._updater = opt.get_updater(optimizer)
+        self.optimizer_initialized = True
+        if self._preload_opt_states is not None:
+            self.load_optimizer_states(self._preload_opt_states)
+            self._preload_opt_states = None
+
+    def borrow_optimizer(self, shared_module):
+        """reference module.py borrow_optimizer"""
+        assert shared_module.optimizer_initialized
+        self._optimizer = shared_module._optimizer
+        self._kvstore = shared_module._kvstore
+        self._update_on_kvstore = shared_module._update_on_kvstore
+        self._updater = shared_module._updater
+        self.optimizer_initialized = True
+
+    # -- compute ----------------------------------------------------------
+    def _load_io(self, names, arrays):
+        import jax
+
+        for name, src in zip(names, arrays or []):
+            if name not in self._exec.arg_dict:
+                continue
+            dst = self._exec.arg_dict[name]
+            jx = src._jx if isinstance(src, NDArray) else None
+            if jx is None:
+                dst[:] = src
+                continue
+            if jx.dtype != dst._jx.dtype:
+                jx = jx.astype(dst._jx.dtype)
+            if jx.shape != dst.shape:
+                raise MXNetError("input %r shape %s != bound shape %s "
+                                 "(reshape the module)" %
+                                 (name, jx.shape, dst.shape))
+            dst._jx = jax.device_put(jx, dst._jx.sharding)
+
+    def forward(self, data_batch, is_train=None):
+        """reference executor_group.py:355 forward + _load_data"""
+        assert self.binded and self.params_initialized
+        if is_train is None:
+            is_train = self.for_training
+        self._load_io(self._data_names, data_batch.data)
+        if self._label_shapes and data_batch.label:
+            self._load_io(self._label_names, data_batch.label)
+        self._exec.forward(is_train=is_train)
+
+    def backward(self, out_grads=None):
+        """reference executor_group.py:481"""
+        assert self.binded and self.params_initialized
+        self._exec.backward(out_grads=out_grads)
+
+    def update(self):
+        """reference ``module.py:553`` + model.py:88/99"""
+        assert self.binded and self.params_initialized \
+            and self.optimizer_initialized
+        param_arrays = [[self._exec.arg_dict[n]] for n in self._param_names]
+        grad_arrays = [[self._exec.grad_dict.get(n)]
+                       for n in self._param_names]
+        if self._update_on_kvstore:
+            _update_params_on_kvstore(param_arrays, grad_arrays,
+                                      self._kvstore)
+        else:
+            _update_params(param_arrays, grad_arrays, updater=self._updater,
+                           num_device=1, kvstore=self._kvstore)
+
+    def get_outputs(self, merge_multi_context=True):
+        assert self.binded
+        return self._exec.outputs
+
+    def get_input_grads(self, merge_multi_context=True):
+        assert self.binded and self.inputs_need_grad
+        return [self._exec.grad_dict.get(n) for n in self._data_names]
+
+    def update_metric(self, eval_metric, labels):
+        eval_metric.update(labels, self.get_outputs())
+
+    def install_monitor(self, mon):
+        assert self.binded
+        mon.install(self._exec)
+
+    # -- checkpointing ----------------------------------------------------
+    def save_checkpoint(self, prefix, epoch, save_optimizer_states=False):
+        """reference module.py save_checkpoint"""
+        arg_params, aux_params = self.get_params()
+        save_checkpoint(prefix, epoch, self.symbol, arg_params, aux_params)
+        if save_optimizer_states:
+            self.save_optimizer_states("%s-%04d.states" % (prefix, epoch))
+
+    @staticmethod
+    def load(prefix, epoch, load_optimizer_states=False, **kwargs):
+        """reference module.py load"""
+        sym, args, auxs = load_checkpoint(prefix, epoch)
+        mod = Module(symbol=sym, **kwargs)
+        mod._arg_params_cache = args
+        mod._aux_params_cache = auxs
+
+        orig_bind = mod.bind
+
+        def bind_and_set(*a, **kw):
+            orig_bind(*a, **kw)
+            mod.set_params(args, auxs, allow_missing=False)
+
+        mod.bind = bind_and_set
+        if load_optimizer_states:
+            mod._preload_opt_states = "%s-%04d.states" % (prefix, epoch)
+        return mod
+
+    def save_optimizer_states(self, fname):
+        assert self.optimizer_initialized
+        if self._update_on_kvstore:
+            self._kvstore.save_optimizer_states(fname)
+        else:
+            with open(fname, "wb") as fout:
+                fout.write(self._updater.get_states())
+
+    def load_optimizer_states(self, fname):
+        assert self.optimizer_initialized
+        if self._update_on_kvstore:
+            self._kvstore.load_optimizer_states(fname)
+        else:
+            with open(fname, "rb") as fin:
+                self._updater.set_states(fin.read())

@@ -1,0 +1,5 @@
+"""Name manager (reference ``python/mxnet/name.py``) — re-export."""
+
+from .base import NameManager, Prefix
+
+__all__ = ["NameManager", "Prefix"]

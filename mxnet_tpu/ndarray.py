@@ -137,8 +137,10 @@ class NDArray:
             if other.shape != self.shape:
                 raise MXNetError("copyto: shape mismatch %s vs %s"
                                  % (self.shape, other.shape))
+            # preserve the destination's (possibly mesh-) sharding so copies
+            # into globally-placed arrays stay global
             other._jx = jax.device_put(self._jx.astype(other._jx.dtype),
-                                       other._ctx.jax_device())
+                                       other._jx.sharding)
             return other
         raise TypeError("copyto does not support type " + str(type(other)))
 

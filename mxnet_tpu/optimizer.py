@@ -1,0 +1,404 @@
+"""Optimizers (``mx.optimizer``).
+
+Reference: ``python/mxnet/optimizer.py`` (SURVEY §2.6): registry, Optimizer
+base with lr/wd multipliers and num_update-driven scheduling, SGD/DCASGD/NAG/
+SGLD/ccSGD/Adam/AdaGrad/RMSProp/AdaDelta/Test, and ``get_updater`` (the
+closure applied per device or on the PS server).
+
+TPU design: each ``update`` call dispatches a fused XLA kernel via the
+``*_update`` ops (``ops/optimizer_op.py``); the Module fast path fuses the
+whole multi-tensor update into the jitted train step (``module/module.py``),
+which is the analog of the reference's update-on-kvstore fusion.
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from .base import MXNetError, Registry
+from .ndarray import NDArray, zeros
+from . import ndarray as nd
+
+__all__ = ["Optimizer", "SGD", "DCASGD", "NAG", "SGLD", "ccSGD", "Adam",
+           "AdaGrad", "RMSProp", "AdaDelta", "Test", "create", "register",
+           "get_updater", "Updater"]
+
+registry = Registry("optimizer")
+register = registry.register
+
+
+class Optimizer:
+    """reference ``optimizer.py:25``"""
+
+    def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
+                 clip_gradient=None, learning_rate=0.01, lr_scheduler=None,
+                 sym=None, begin_num_update=0):
+        self.rescale_grad = rescale_grad
+        self.lr = learning_rate
+        self.lr_scheduler = lr_scheduler
+        if lr_scheduler is not None:
+            self.lr_scheduler.base_lr = learning_rate
+        self.wd = wd
+        self.lr_mult = {}
+        self.wd_mult = {}
+        self.begin_num_update = begin_num_update
+        self.num_update = begin_num_update
+        self._index_update_count = {}
+        self.clip_gradient = clip_gradient
+        if param_idx2name is None:
+            param_idx2name = {}
+        self.idx2name = param_idx2name.copy()
+        self.sym = sym
+
+    # -- serialization to "kvstore servers" (reference pickles the optimizer
+    # to PS servers, python/mxnet/kvstore.py:232) -------------------------
+    def dumps(self):
+        import pickle
+
+        return pickle.dumps(self)
+
+    @staticmethod
+    def loads(buf):
+        import pickle
+
+        return pickle.loads(buf)
+
+    def create_state(self, index, weight):
+        return None
+
+    def update(self, index, weight, grad, state):
+        raise NotImplementedError()
+
+    # -- lr/wd multipliers (reference optimizer.py set_lr_mult etc.) ------
+    def set_lr_scale(self, args_lrscale):  # deprecated reference API
+        self.lr_mult = {self.idx2name.get(i, i): s
+                        for i, s in args_lrscale.items()}
+
+    def set_lr_mult(self, args_lr_mult):
+        self.lr_mult = {}
+        if self.sym is not None:
+            attr = self.sym.attr_dict()
+            for name in self.sym.list_arguments():
+                if name in attr and "__lr_mult__" in attr[name]:
+                    self.lr_mult[name] = float(attr[name]["__lr_mult__"])
+        self.lr_mult.update(args_lr_mult)
+
+    def set_wd_mult(self, args_wd_mult):
+        self.wd_mult = {}
+        for n in self.idx2name.values():
+            if not (n.endswith("_weight") or n.endswith("_gamma")):
+                self.wd_mult[n] = 0.0
+        if self.sym is not None:
+            attr = self.sym.attr_dict()
+            for name in self.sym.list_arguments():
+                if name in attr and "__wd_mult__" in attr[name]:
+                    self.wd_mult[name] = float(attr[name]["__wd_mult__"])
+        self.wd_mult.update(args_wd_mult)
+
+    def _update_count(self, index):
+        if index not in self._index_update_count:
+            self._index_update_count[index] = self.begin_num_update
+        self._index_update_count[index] += 1
+        self.num_update = max(self._index_update_count[index], self.num_update)
+
+    def _get_lr(self, index):
+        lr = self.lr_scheduler(self.num_update) if self.lr_scheduler \
+            else self.lr
+        if index in self.lr_mult:
+            lr *= self.lr_mult[index]
+        elif index in self.idx2name:
+            lr *= self.lr_mult.get(self.idx2name[index], 1.0)
+        return lr
+
+    def _get_wd(self, index):
+        wd = self.wd
+        if index in self.wd_mult:
+            wd *= self.wd_mult[index]
+        elif index in self.idx2name:
+            wd *= self.wd_mult.get(self.idx2name[index], 1.0)
+        return wd
+
+    def _clip(self):
+        return self.clip_gradient if self.clip_gradient is not None else -1.0
+
+
+@register
+class SGD(Optimizer):
+    """reference ``optimizer.py:279`` — fused sgd_update/sgd_mom_update."""
+
+    def __init__(self, momentum=0.0, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return zeros(weight.shape, weight.context, dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        if state is not None:
+            new_w, new_m = nd.sgd_mom_update(
+                weight, grad, state, lr=lr, wd=wd, momentum=self.momentum,
+                rescale_grad=self.rescale_grad, clip_gradient=self._clip())
+            weight._jx = new_w._jx
+            state._jx = new_m._jx
+        else:
+            nd.sgd_update(weight, grad, lr=lr, wd=wd,
+                          rescale_grad=self.rescale_grad,
+                          clip_gradient=self._clip(), out=weight)
+
+
+@register
+class NAG(SGD):
+    """Nesterov accelerated SGD (reference ``optimizer.py:380``)."""
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        grad = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            grad = nd.clip(grad, a_min=-self.clip_gradient,
+                           a_max=self.clip_gradient)
+        if state is not None:
+            mom = state
+            mom *= self.momentum
+            grad += wd * weight
+            mom += grad
+            grad += self.momentum * mom
+            weight += -lr * grad
+        else:
+            weight += -lr * (grad + wd * weight)
+
+
+@register
+class DCASGD(Optimizer):
+    """Delay-compensated async SGD (reference ``optimizer.py:325``)."""
+
+    def __init__(self, momentum=0.0, lamda=0.04, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.weight_previous = {}
+        self.lamda = lamda
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return (None, weight.copy())
+        return (zeros(weight.shape, weight.context), weight.copy())
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        grad = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            grad = nd.clip(grad, a_min=-self.clip_gradient,
+                           a_max=self.clip_gradient)
+        mon, previous_weight = state
+        delay = grad + self.lamda * grad * grad * (weight - previous_weight)
+        if mon is not None:
+            mon *= self.momentum
+            mon += -lr * (delay + wd * weight)
+        else:
+            mon = -lr * (delay + wd * weight)
+        weight.copyto(previous_weight)
+        weight += mon
+
+
+@register
+class SGLD(Optimizer):
+    """Stochastic gradient Langevin dynamics (reference ``optimizer.py:416``)."""
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        grad = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            grad = nd.clip(grad, a_min=-self.clip_gradient,
+                           a_max=self.clip_gradient)
+        from .random import normal
+
+        noise = normal(0, math.sqrt(lr), weight.shape, weight.context)
+        weight += (-lr / 2) * (grad + wd * weight) + noise
+
+
+@register
+class ccSGD(SGD):
+    """Kept for API parity (reference ``optimizer.py:445`` — C-side SGD)."""
+
+
+@register
+class Adam(Optimizer):
+    """reference ``optimizer.py:451`` — fused adam_update, with the
+    reference's bias-corrected effective lr."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, weight.context, dtype=weight.dtype),
+                zeros(weight.shape, weight.context, dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        t = self._index_update_count[index]
+        coef1 = 1.0 - self.beta1 ** t
+        coef2 = 1.0 - self.beta2 ** t
+        lr *= math.sqrt(coef2) / coef1
+        mean, var = state
+        new_w, new_mean, new_var = nd.adam_update(
+            weight, grad, mean, var, lr=lr, wd=wd, beta1=self.beta1,
+            beta2=self.beta2, epsilon=self.epsilon,
+            rescale_grad=self.rescale_grad, clip_gradient=self._clip())
+        weight._jx = new_w._jx
+        mean._jx = new_mean._jx
+        var._jx = new_var._jx
+
+
+@register
+class AdaGrad(Optimizer):
+    """reference ``optimizer.py:499``"""
+
+    def __init__(self, eps=1e-7, **kwargs):
+        super().__init__(**kwargs)
+        self.float_stable_eps = eps
+
+    def create_state(self, index, weight):
+        return zeros(weight.shape, weight.context)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        grad = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            grad = nd.clip(grad, a_min=-self.clip_gradient,
+                           a_max=self.clip_gradient)
+        history = state
+        history += grad * grad
+        weight += -lr * (grad / nd.sqrt(history + self.float_stable_eps)
+                         + wd * weight)
+
+
+@register
+class RMSProp(Optimizer):
+    """reference ``optimizer.py:536`` — centered=False → Hinton's rmsprop
+    (fused rmsprop_update); centered=True → Graves 2013 (rmspropalex)."""
+
+    def __init__(self, learning_rate=0.001, gamma1=0.9, gamma2=0.9,
+                 epsilon=1e-8, centered=False, clip_weights=None, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.gamma1 = gamma1
+        self.gamma2 = gamma2
+        self.centered = centered
+        self.epsilon = epsilon
+        self.clip_weights = clip_weights
+
+    def create_state(self, index, weight):
+        if self.centered:
+            return (zeros(weight.shape, weight.context),
+                    zeros(weight.shape, weight.context),
+                    zeros(weight.shape, weight.context))
+        return (zeros(weight.shape, weight.context),)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        cw = self.clip_weights if self.clip_weights is not None else -1.0
+        if not self.centered:
+            (n,) = state
+            new_w, new_n = nd.rmsprop_update(
+                weight, grad, n, lr=lr, wd=wd, gamma1=self.gamma1,
+                epsilon=self.epsilon, rescale_grad=self.rescale_grad,
+                clip_gradient=self._clip(), clip_weights=cw)
+            weight._jx, n._jx = new_w._jx, new_n._jx
+        else:
+            n, g, delta = state
+            new_w, new_n, new_g, new_d = nd.rmspropalex_update(
+                weight, grad, n, g, delta, lr=lr, wd=wd, gamma1=self.gamma1,
+                gamma2=self.gamma2, epsilon=self.epsilon,
+                rescale_grad=self.rescale_grad, clip_gradient=self._clip(),
+                clip_weights=cw)
+            weight._jx, n._jx, g._jx, delta._jx = \
+                new_w._jx, new_n._jx, new_g._jx, new_d._jx
+
+
+@register
+class AdaDelta(Optimizer):
+    """reference ``optimizer.py:605``"""
+
+    def __init__(self, rho=0.90, epsilon=1e-5, **kwargs):
+        super().__init__(**kwargs)
+        self.rho = rho
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, weight.context),
+                zeros(weight.shape, weight.context))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        wd = self._get_wd(index)
+        grad = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            grad = nd.clip(grad, a_min=-self.clip_gradient,
+                           a_max=self.clip_gradient)
+        acc_g, acc_delta = state
+        acc_g *= self.rho
+        acc_g += (1.0 - self.rho) * grad * grad
+        current_delta = (nd.sqrt(acc_delta + self.epsilon)
+                         / nd.sqrt(acc_g + self.epsilon)) * grad
+        acc_delta *= self.rho
+        acc_delta += (1.0 - self.rho) * current_delta * current_delta
+        weight -= current_delta + wd * weight
+
+
+@register
+class Test(Optimizer):
+    """reference ``optimizer.py:653``"""
+
+    def create_state(self, index, weight):
+        return zeros(weight.shape, weight.context)
+
+    def update(self, index, weight, grad, state):
+        weight += grad * self.rescale_grad
+        state[:] = weight
+
+
+def create(name, **kwargs):
+    """reference ``optimizer.py`` create_optimizer"""
+    return registry.create(name, **kwargs)
+
+
+class Updater:
+    """reference ``optimizer.py`` get_updater closure, as a picklable class
+    (kvstore servers receive it)."""
+
+    def __init__(self, optimizer):
+        self.optimizer = optimizer
+        self.states = {}
+
+    def __call__(self, index, grad, weight):
+        if index not in self.states:
+            self.states[index] = self.optimizer.create_state(index, weight)
+        self.optimizer.update(index, weight, grad, self.states[index])
+
+    def set_states(self, states):
+        import pickle
+
+        self.states = pickle.loads(states)
+
+    def get_states(self):
+        import pickle
+
+        return pickle.dumps(self.states)
+
+
+def get_updater(optimizer):
+    return Updater(optimizer)

@@ -1,0 +1,193 @@
+"""Test harness utilities (reference ``python/mxnet/test_utils.py``, SURVEY §4).
+
+``check_numeric_gradient`` (finite differences vs symbolic backward),
+``check_symbolic_forward/backward`` (vs numpy reference), and
+``check_consistency`` — the reference's CPU-vs-GPU parity harness becomes the
+CPU-vs-TPU parity harness here, exactly the shape SURVEY §4.2 calls for.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from . import ndarray as nd
+from .context import Context, cpu, current_context
+from .ndarray import NDArray
+
+__all__ = ["default_context", "assert_almost_equal", "almost_equal",
+           "same", "rand_ndarray", "numeric_grad", "check_numeric_gradient",
+           "check_symbolic_forward", "check_symbolic_backward",
+           "check_consistency", "rand_shape_nd"]
+
+
+def default_context():
+    return current_context()
+
+
+def rand_shape_nd(ndim, dim=10):
+    return tuple(np.random.randint(1, dim + 1, size=ndim))
+
+
+def same(a, b):
+    return np.array_equal(a, b)
+
+
+def almost_equal(a, b, rtol=1e-5, atol=1e-20):
+    return np.allclose(a, b, rtol=rtol, atol=atol)
+
+
+def assert_almost_equal(a, b, rtol=1e-5, atol=1e-20, names=("a", "b")):
+    """reference ``test_utils.py:128``"""
+    a = a.asnumpy() if isinstance(a, NDArray) else np.asarray(a)
+    b = b.asnumpy() if isinstance(b, NDArray) else np.asarray(b)
+    if not almost_equal(a, b, rtol, atol):
+        index = np.unravel_index(
+            np.argmax(np.abs(a - b)), a.shape) if a.shape else ()
+        rel = np.max(np.abs(a - b) / (np.abs(b) + 1e-12))
+        raise AssertionError(
+            "Items are not equal (rtol=%g):\n max rel err %g at %s: %s vs %s"
+            % (rtol, rel, index, a[index] if a.shape else a,
+               b[index] if b.shape else b))
+
+
+def rand_ndarray(shape, ctx=None, scale=1.0):
+    return nd.array(np.random.uniform(-scale, scale, shape)
+                    .astype(np.float32), ctx=ctx)
+
+
+def _as_exec_args(sym, location, ctx):
+    arg_names = sym.list_arguments()
+    if isinstance(location, dict):
+        arrs = {k: (v if isinstance(v, NDArray)
+                    else nd.array(np.asarray(v), ctx=ctx))
+                for k, v in location.items()}
+    else:
+        arrs = {n: (v if isinstance(v, NDArray)
+                    else nd.array(np.asarray(v), ctx=ctx))
+                for n, v in zip(arg_names, location)}
+    return arrs
+
+
+def numeric_grad(executor, location, aux_states=None, eps=1e-4,
+                 use_forward_train=True):
+    """Central finite differences through executor.forward (reference
+    ``test_utils.py:260`` numeric_grad)."""
+    grads = {}
+    for name, arr in location.items():
+        base = arr.asnumpy().astype(np.float64)
+        g = np.zeros_like(base)
+        flat = base.ravel()
+        gflat = g.ravel()
+        for i in range(flat.size):
+            old = flat[i]
+            flat[i] = old + eps
+            executor.arg_dict[name][:] = base.reshape(arr.shape)
+            out_p = executor.forward(is_train=use_forward_train)[0] \
+                .asnumpy().astype(np.float64).sum()
+            flat[i] = old - eps
+            executor.arg_dict[name][:] = base.reshape(arr.shape)
+            out_m = executor.forward(is_train=use_forward_train)[0] \
+                .asnumpy().astype(np.float64).sum()
+            gflat[i] = (out_p - out_m) / (2 * eps)
+            flat[i] = old
+            executor.arg_dict[name][:] = base.reshape(arr.shape)
+        grads[name] = g
+    return grads
+
+
+def check_numeric_gradient(sym, location, aux_states=None,
+                           numeric_eps=1e-3, rtol=1e-2, atol=None,
+                           grad_nodes=None, ctx=None):
+    """reference ``test_utils.py:360`` — finite differences vs backward."""
+    ctx = ctx or default_context()
+    location = _as_exec_args(sym, location, ctx)
+    grad_nodes = grad_nodes or [n for n in sym.list_arguments()
+                                if n in location]
+    req = {n: ("write" if n in grad_nodes else "null")
+           for n in sym.list_arguments()}
+    executor = sym.bind(ctx, dict(location),
+                        args_grad={n: nd.zeros(location[n].shape, ctx=ctx)
+                                   for n in grad_nodes},
+                        grad_req=req, aux_states=aux_states)
+    executor.forward(is_train=True)
+    executor.backward()
+    sym_grads = {n: executor.grad_dict[n].asnumpy() for n in grad_nodes}
+    num_grads = numeric_grad(
+        executor, {n: location[n] for n in grad_nodes}, eps=numeric_eps)
+    for name in grad_nodes:
+        assert_almost_equal(num_grads[name], sym_grads[name], rtol=rtol,
+                            atol=atol if atol is not None else rtol * 1e-1,
+                            names=("numeric_%s" % name, "symbolic_%s" % name))
+
+
+def check_symbolic_forward(sym, location, expected, rtol=1e-5, atol=1e-20,
+                           aux_states=None, ctx=None):
+    """reference ``test_utils.py:473``"""
+    ctx = ctx or default_context()
+    args = _as_exec_args(sym, location, ctx)
+    executor = sym.bind(ctx, args, grad_req="null", aux_states=aux_states)
+    outputs = executor.forward(is_train=False)
+    for out, exp in zip(outputs, expected):
+        assert_almost_equal(out, exp, rtol=rtol, atol=atol)
+    return outputs
+
+
+def check_symbolic_backward(sym, location, out_grads, expected, rtol=1e-5,
+                            atol=1e-20, aux_states=None, grad_req="write",
+                            ctx=None):
+    """reference ``test_utils.py:527``"""
+    ctx = ctx or default_context()
+    args = _as_exec_args(sym, location, ctx)
+    arg_names = sym.list_arguments()
+    if isinstance(expected, (list, tuple)):
+        expected = dict(zip(arg_names, expected))
+    grads = {n: nd.zeros(args[n].shape, ctx=ctx) for n in expected}
+    req = {n: (grad_req if n in expected else "null") for n in arg_names}
+    executor = sym.bind(ctx, args, args_grad=grads, grad_req=req,
+                        aux_states=aux_states)
+    executor.forward(is_train=True)
+    executor.backward(
+        [g if isinstance(g, NDArray) else nd.array(np.asarray(g), ctx=ctx)
+         for g in out_grads] if out_grads is not None else None)
+    for name, exp in expected.items():
+        assert_almost_equal(executor.grad_dict[name], exp, rtol=rtol,
+                            atol=atol)
+    return executor.grad_dict
+
+
+def check_consistency(sym, ctx_list, scale=1.0, rtol=1e-3, atol=1e-4,
+                      arg_params=None):
+    """reference ``test_utils.py:677`` — run the same symbol on every context
+    (CPU vs TPU parity) and compare outputs + gradients."""
+    shapes = ctx_list[0]
+    del shapes
+    exe_list = []
+    for spec in ctx_list:
+        spec = dict(spec)
+        ctx = spec.pop("ctx")
+        type_dict = spec.pop("type_dict", {})
+        exe_list.append(sym.simple_bind(ctx, grad_req="write",
+                                        type_dict=type_dict, **spec))
+    arg0 = exe_list[0]
+    np.random.seed(0)
+    init = {}
+    for name, arr in arg0.arg_dict.items():
+        init[name] = np.random.normal(
+            0, scale, arr.shape).astype(np.float32) if arg_params is None \
+            or name not in arg_params else arg_params[name]
+    outs = []
+    grads = []
+    for exe in exe_list:
+        for name, arr in exe.arg_dict.items():
+            arr[:] = init[name]
+        exe.forward(is_train=True)
+        exe.backward()
+        outs.append([o.asnumpy() for o in exe.outputs])
+        grads.append({n: g.asnumpy() for n, g in exe.grad_dict.items()})
+    for other_out, other_grad in zip(outs[1:], grads[1:]):
+        for a, b in zip(outs[0], other_out):
+            assert_almost_equal(a, b, rtol=rtol, atol=atol)
+        for name in grads[0]:
+            assert_almost_equal(grads[0][name], other_grad[name], rtol=rtol,
+                                atol=atol)
+    return outs

@@ -1,0 +1,26 @@
+"""Test config: force a CPU-only 8-device virtual mesh BEFORE jax initializes.
+
+Mirrors the reference's fake-device fixture strategy (SURVEY §4: multi-device
+tests use mx.cpu(0)/mx.cpu(1) contexts without a cluster) — 8 virtual CPU
+devices stand in for an 8-chip TPU slice, so sharding/collective paths
+compile and run in CI.
+
+Note: the sandbox's axon sitecustomize forces ``jax_platforms="axon,cpu"``;
+``jax.config.update`` after import (before first backend init) is the
+reliable way to pin tests to CPU without touching the TPU tunnel.
+"""
+
+import os
+import sys
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = \
+        flags + " --xla_force_host_platform_device_count=8"
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

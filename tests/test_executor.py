@@ -1,0 +1,101 @@
+"""Executor bind/forward/backward semantics (reference
+``tests/python/unittest/test_executor.py``)."""
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sym
+from mxnet_tpu.test_utils import assert_almost_equal
+
+RS = np.random.RandomState(3)
+
+
+def test_bind_forward_backward():
+    a = sym.Variable("a")
+    b = sym.Variable("b")
+    out = a * b
+    av = RS.rand(3, 3).astype(np.float32)
+    bv = RS.rand(3, 3).astype(np.float32)
+    ex = out.bind(mx.cpu(), {"a": nd.array(av), "b": nd.array(bv)},
+                  args_grad={"a": nd.zeros((3, 3)), "b": nd.zeros((3, 3))})
+    o = ex.forward(is_train=True)[0]
+    assert_almost_equal(o, av * bv)
+    head = RS.rand(3, 3).astype(np.float32)
+    ex.backward([nd.array(head)])
+    assert_almost_equal(ex.grad_dict["a"], head * bv, rtol=1e-5)
+    assert_almost_equal(ex.grad_dict["b"], head * av, rtol=1e-5)
+
+
+def test_grad_req_null_and_add():
+    a = sym.Variable("a")
+    out = sym.sum(a * a)
+    av = RS.rand(4).astype(np.float32)
+    ex = out.simple_bind(mx.cpu(), grad_req="add", a=(4,))
+    ex.arg_dict["a"][:] = av
+    for _ in range(3):
+        ex.forward(is_train=True)
+        ex.backward()
+    assert_almost_equal(ex.grad_dict["a"], 3 * 2 * av, rtol=1e-5)
+    ex2 = out.simple_bind(mx.cpu(), grad_req="null", a=(4,))
+    ex2.forward(is_train=True)
+    assert ex2.grad_dict == {} or ex2.grad_dict.get("a") is None
+
+
+def test_forward_kwargs_update_inputs():
+    data = sym.Variable("data")
+    out = data * 2.0
+    ex = out.simple_bind(mx.cpu(), grad_req="null", data=(2, 2))
+    o1 = ex.forward(data=nd.ones((2, 2)))[0]
+    assert_almost_equal(o1, 2 * np.ones((2, 2)))
+    o2 = ex.forward(data=3 * np.ones((2, 2), np.float32))[0]
+    assert_almost_equal(o2, 6 * np.ones((2, 2)))
+
+
+def test_reshape_executor():
+    data = sym.Variable("data")
+    fc = sym.FullyConnected(data, num_hidden=4, name="fc")
+    ex = fc.simple_bind(mx.cpu(), data=(8, 5))
+    wv = RS.rand(4, 5).astype(np.float32)
+    ex.arg_dict["fc_weight"][:] = wv
+    ex2 = ex.reshape(data=(2, 5))
+    assert ex2.arg_dict["data"].shape == (2, 5)
+    # weights shared by identity
+    assert ex2.arg_dict["fc_weight"] is ex.arg_dict["fc_weight"]
+    dv = RS.rand(2, 5).astype(np.float32)
+    out = ex2.forward(data=dv)[0]
+    assert_almost_equal(out, dv.dot(wv.T), rtol=1e-5)
+
+
+def test_shared_exec_bucketing():
+    """shared_exec path: parameters shared across shapes (reference
+    shared data_pool_, graph_executor.cc:336-340)."""
+    def make(seq):
+        d = sym.Variable("data")
+        f = sym.FullyConnected(d, num_hidden=3, name="fc")
+        return f
+
+    ex_big = make(10).simple_bind(mx.cpu(), data=(10, 6))
+    ex_small = make(4).simple_bind(mx.cpu(), data=(4, 6),
+                                   shared_exec=ex_big)
+    assert ex_small.arg_dict["fc_weight"] is ex_big.arg_dict["fc_weight"]
+
+
+def test_multi_output_executor():
+    d = sym.Variable("data")
+    parts = sym.SliceChannel(d, num_outputs=2, axis=1, name="sc")
+    ex = parts.simple_bind(mx.cpu(), grad_req="null", data=(2, 4))
+    x = RS.rand(2, 4).astype(np.float32)
+    outs = ex.forward(data=x)
+    assert len(outs) == 2
+    assert_almost_equal(outs[0], x[:, :2])
+    assert_almost_equal(outs[1], x[:, 2:])
+
+
+def test_monitor_callback():
+    d = sym.Variable("data")
+    out = d * 2.0
+    ex = out.simple_bind(mx.cpu(), grad_req="null", data=(2,))
+    seen = []
+    ex.set_monitor_callback(lambda name, arr: seen.append(name))
+    ex.forward(data=nd.ones((2,)))
+    assert seen and seen[0].endswith("_output")

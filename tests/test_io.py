@@ -1,0 +1,94 @@
+"""Data iterators (reference ``tests/python/unittest/test_io.py``)."""
+
+import gzip
+import struct
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import io
+
+
+def test_ndarrayiter_basic():
+    x = np.arange(40, dtype=np.float32).reshape(10, 4)
+    y = np.arange(10, dtype=np.float32)
+    it = io.NDArrayIter(x, y, batch_size=4, last_batch_handle="pad")
+    batches = list(it)
+    assert len(batches) == 3
+    assert batches[0].data[0].shape == (4, 4)
+    assert batches[2].pad == 2
+    it.reset()
+    assert len(list(it)) == 3
+
+
+def test_ndarrayiter_discard_and_shuffle():
+    x = np.arange(30, dtype=np.float32).reshape(10, 3)
+    it = io.NDArrayIter(x, None, batch_size=4, shuffle=True,
+                        last_batch_handle="discard")
+    batches = list(it)
+    assert len(batches) == 2
+    desc = it.provide_data[0]
+    assert desc.name == "data" and desc.shape == (4, 3)
+
+
+def _write_idx_images(path, arr):
+    with open(path, "wb") as f:
+        f.write(struct.pack(">I", 0x00000803))
+        f.write(struct.pack(">III", *arr.shape))
+        f.write(arr.astype(np.uint8).tobytes())
+
+
+def _write_idx_labels(path, arr):
+    with open(path, "wb") as f:
+        f.write(struct.pack(">I", 0x00000801))
+        f.write(struct.pack(">I", arr.shape[0]))
+        f.write(arr.astype(np.uint8).tobytes())
+
+
+def test_mnist_iter(tmp_path):
+    imgs = np.random.randint(0, 255, (50, 28, 28)).astype(np.uint8)
+    labels = np.random.randint(0, 10, 50).astype(np.uint8)
+    ip = str(tmp_path / "imgs-idx3-ubyte")
+    lp = str(tmp_path / "labels-idx1-ubyte")
+    _write_idx_images(ip, imgs)
+    _write_idx_labels(lp, labels)
+    it = io.MNISTIter(image=ip, label=lp, batch_size=10, shuffle=False)
+    b = it.next()
+    assert b.data[0].shape == (10, 1, 28, 28)
+    assert b.label[0].shape == (10,)
+    # flat + sharding
+    it2 = io.MNISTIter(image=ip, label=lp, batch_size=5, flat=True,
+                       shuffle=False, num_parts=2, part_index=1)
+    b2 = it2.next()
+    assert b2.data[0].shape == (5, 784)
+
+
+def test_csv_iter(tmp_path):
+    data = np.random.rand(12, 3).astype(np.float32)
+    labels = np.random.randint(0, 2, 12).astype(np.float32)
+    dp = str(tmp_path / "d.csv")
+    lp = str(tmp_path / "l.csv")
+    np.savetxt(dp, data, delimiter=",")
+    np.savetxt(lp, labels, delimiter=",")
+    it = io.CSVIter(data_csv=dp, data_shape=(3,), label_csv=lp,
+                    label_shape=(1,), batch_size=4)
+    b = it.next()
+    assert b.data[0].shape == (4, 3)
+
+
+def test_resize_iter():
+    x = np.random.rand(8, 2).astype(np.float32)
+    base = io.NDArrayIter(x, None, batch_size=4)
+    it = io.ResizeIter(base, 5)
+    assert len(list(it)) == 5
+
+
+def test_prefetching_iter():
+    x = np.random.rand(16, 2).astype(np.float32)
+    y = np.arange(16, dtype=np.float32)
+    base = io.NDArrayIter(x, y, batch_size=4)
+    it = io.PrefetchingIter(base)
+    batches = list(it)
+    assert len(batches) == 4
+    it.reset()
+    assert len(list(it)) == 4

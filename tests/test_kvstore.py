@@ -1,0 +1,89 @@
+"""KVStore aggregation semantics (reference ``tests/python/unittest/
+test_kvstore.py`` — N 'devices' are just N NDArrays)."""
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.test_utils import assert_almost_equal
+
+SHAPE = (4, 4)
+KEYS = [5, 7, 11]
+
+
+def _init_kv(kv_type="local"):
+    kv = mx.kv.create(kv_type)
+    kv.init(3, nd.zeros(SHAPE))
+    kv.init(KEYS, [nd.zeros(SHAPE)] * len(KEYS))
+    return kv
+
+
+def test_single_kv_pair():
+    kv = _init_kv()
+    kv.push(3, nd.ones(SHAPE))
+    out = nd.empty(SHAPE)
+    kv.pull(3, out=out)
+    assert_almost_equal(out, np.ones(SHAPE))
+
+
+def test_aggregate_push():
+    kv = _init_kv()
+    num_devs = 4
+    vals = [nd.ones(SHAPE) for _ in range(num_devs)]
+    kv.push(3, vals)
+    out = nd.empty(SHAPE)
+    kv.pull(3, out=out)
+    assert_almost_equal(out, num_devs * np.ones(SHAPE))
+
+
+def test_list_kv_pairs():
+    kv = _init_kv()
+    kv.push(KEYS, [nd.ones(SHAPE) * 2] * len(KEYS))
+    outs = [nd.empty(SHAPE) for _ in KEYS]
+    kv.pull(KEYS, out=outs)
+    for o in outs:
+        assert_almost_equal(o, 2 * np.ones(SHAPE))
+
+
+def test_updater():
+    kv = _init_kv()
+
+    def updater(key, recv, stored):
+        stored += recv * 2
+
+    kv.set_updater(updater)
+    kv.push(3, nd.ones(SHAPE))
+    out = nd.empty(SHAPE)
+    kv.pull(3, out=out)
+    assert_almost_equal(out, 2 * np.ones(SHAPE))
+    # aggregate then update
+    kv.push(3, [nd.ones(SHAPE)] * 4)
+    kv.pull(3, out=out)
+    assert_almost_equal(out, 10 * np.ones(SHAPE))
+
+
+def test_optimizer_on_kvstore():
+    kv = _init_kv()
+    opt = mx.optimizer.SGD(learning_rate=0.1, rescale_grad=1.0)
+    kv.set_optimizer(opt)
+    # stored weight starts at 0; push grad of ones -> w = -0.1
+    kv.push(3, nd.ones(SHAPE))
+    out = nd.empty(SHAPE)
+    kv.pull(3, out=out)
+    assert_almost_equal(out, -0.1 * np.ones(SHAPE), rtol=1e-6)
+
+
+def test_str_keys():
+    kv = mx.kv.create("local")
+    kv.init("w", nd.ones(SHAPE))
+    kv.push("w", [nd.ones(SHAPE), nd.ones(SHAPE)])
+    out = nd.empty(SHAPE)
+    kv.pull("w", out=out)
+    assert_almost_equal(out, 2 * np.ones(SHAPE))
+
+
+def test_kvstore_type_properties():
+    kv = mx.kv.create("device")
+    assert kv.type == "device"
+    assert kv.rank == 0
+    assert kv.num_workers == 1

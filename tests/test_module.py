@@ -1,0 +1,120 @@
+"""Module training (reference ``tests/python/unittest/test_module.py`` +
+``tests/python/train/test_mlp.py`` convergence style)."""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import io, nd, sym
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def _toy_data(n=800, num_class=4, dim=10, seed=0):
+    rs = np.random.RandomState(seed)
+    centers = rs.rand(num_class, dim).astype(np.float32)
+    labels = rs.randint(0, num_class, n)
+    x = centers[labels] + 0.1 * rs.rand(n, dim).astype(np.float32)
+    return x, labels.astype(np.float32)
+
+
+def _mlp_sym(num_class=4):
+    data = sym.Variable("data")
+    fc1 = sym.FullyConnected(data, num_hidden=16, name="fc1")
+    act = sym.Activation(fc1, act_type="relu")
+    fc2 = sym.FullyConnected(act, num_hidden=num_class, name="fc2")
+    return sym.SoftmaxOutput(fc2, name="softmax")
+
+
+def test_module_fit_converges():
+    x, y = _toy_data()
+    train = io.NDArrayIter(x[:600], y[:600], batch_size=32, shuffle=True)
+    val = io.NDArrayIter(x[600:], y[600:], batch_size=32)
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+    mod.fit(train, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.5, "momentum": 0.9},
+            initializer=mx.init.Xavier(), num_epoch=8)
+    score = mod.score(val, "acc")
+    assert score[0][1] > 0.95, "MLP did not converge: %s" % score
+
+
+def test_module_forward_shapes_and_outputs():
+    x, y = _toy_data(64)
+    it = io.NDArrayIter(x, y, batch_size=16)
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params()
+    batch = it.next()
+    mod.forward(batch, is_train=False)
+    outs = mod.get_outputs()
+    assert len(outs) == 1 and outs[0].shape == (16, 4)
+    assert mod.data_shapes == [("data", (16, 10))]
+    assert mod.label_shapes == [("softmax_label", (16,))]
+    assert mod.output_names == ["softmax_output"]
+
+
+def test_module_checkpoint_roundtrip(tmp_path):
+    x, y = _toy_data(128)
+    it = io.NDArrayIter(x, y, batch_size=32)
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+    mod.fit(it, optimizer="sgd", num_epoch=1,
+            optimizer_params={"learning_rate": 0.1})
+    prefix = str(tmp_path / "toy")
+    mod.save_checkpoint(prefix, 1, save_optimizer_states=True)
+    mod2 = mx.mod.Module.load(prefix, 1)
+    mod2.bind(data_shapes=it.provide_data, label_shapes=it.provide_label,
+              for_training=False)
+    it.reset()
+    b = it.next()
+    mod.forward(b, is_train=False)
+    o1 = mod.get_outputs()[0].asnumpy()
+    mod2.forward(b, is_train=False)
+    o2 = mod2.get_outputs()[0].asnumpy()
+    assert np.allclose(o1, o2, rtol=1e-5)
+
+
+def test_module_predict_and_score():
+    x, y = _toy_data(96)
+    it = io.NDArrayIter(x, y, batch_size=32)
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params()
+    preds = mod.predict(it)
+    assert preds.shape == (96, 4)
+    res = mod.score(it, "acc")
+    assert 0.0 <= res[0][1] <= 1.0
+
+
+def test_module_input_grads():
+    x, y = _toy_data(32)
+    it = io.NDArrayIter(x, y, batch_size=32)
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label,
+             for_training=True, inputs_need_grad=True)
+    mod.init_params()
+    batch = it.next()
+    mod.forward(batch, is_train=True)
+    mod.backward()
+    ig = mod.get_input_grads()
+    assert ig[0] is not None and ig[0].shape == (32, 10)
+    assert float(np.abs(ig[0].asnumpy()).sum()) > 0
+
+
+def test_fixed_params():
+    x, y = _toy_data(64)
+    it = io.NDArrayIter(x, y, batch_size=32)
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu(),
+                        fixed_param_names=["fc1_weight"])
+    mod.fit(it, optimizer="sgd", num_epoch=1,
+            optimizer_params={"learning_rate": 0.5},
+            initializer=mx.init.Xavier())
+    # fixed param has no grad array
+    assert mod._exec.grad_dict.get("fc1_weight") is None
+
+
+def test_feedforward_api():
+    x, y = _toy_data(128)
+    model = mx.model.FeedForward(_mlp_sym(), ctx=mx.cpu(), num_epoch=2,
+                                 learning_rate=0.5, numpy_batch_size=32)
+    model.fit(x, y)
+    preds = model.predict(x)
+    assert preds.shape == (128, 4)

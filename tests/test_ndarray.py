@@ -1,0 +1,108 @@
+"""NDArray semantics (reference ``tests/python/unittest/test_ndarray.py``)."""
+
+import os
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_creation():
+    a = nd.zeros((3, 4))
+    assert a.shape == (3, 4) and a.dtype == np.float32
+    assert (a.asnumpy() == 0).all()
+    b = nd.ones((2,), dtype=np.int32)
+    assert b.dtype == np.int32
+    c = nd.full((2, 2), 7)
+    assert (c.asnumpy() == 7).all()
+    d = nd.arange(1, 7, 2)
+    assert_almost_equal(d, np.arange(1, 7, 2, dtype=np.float32))
+    e = nd.arange(0, 3, repeat=2)
+    assert_almost_equal(e, np.array([0, 0, 1, 1, 2, 2], np.float32))
+
+
+def test_arith_and_views():
+    x = np.arange(12, dtype=np.float32).reshape(3, 4)
+    a = nd.array(x)
+    assert_almost_equal(a + a, x + x)
+    assert_almost_equal(a - 1, x - 1)
+    assert_almost_equal(2 / (a + 1), 2 / (x + 1), rtol=1e-6)
+    assert_almost_equal(a.T, x.T)
+    assert_almost_equal(a.reshape((4, 3)), x.reshape(4, 3))
+    assert_almost_equal(a.reshape((-1,)), x.ravel())
+    assert_almost_equal(a[1], x[1])
+    assert_almost_equal(a[1:3], x[1:3])
+    a[1:2] = 5
+    x[1:2] = 5
+    assert_almost_equal(a, x)
+    a[:] = 0
+    assert (a.asnumpy() == 0).all()
+
+
+def test_inplace_ops():
+    a = nd.ones((2, 2))
+    a += 1
+    assert (a.asnumpy() == 2).all()
+    a *= 3
+    assert (a.asnumpy() == 6).all()
+    a /= 2
+    assert (a.asnumpy() == 3).all()
+    a -= 1
+    assert (a.asnumpy() == 2).all()
+
+
+def test_comparison():
+    a = nd.array([1, 2, 3])
+    b = nd.array([3, 2, 1])
+    assert_almost_equal(a == b, np.array([0, 1, 0], np.float32))
+    assert_almost_equal(a > b, np.array([0, 0, 1], np.float32))
+    assert_almost_equal(a <= b, np.array([1, 1, 0], np.float32))
+
+
+def test_copy_context():
+    a = nd.array([[1, 2]])
+    b = a.copyto(mx.cpu())
+    assert_almost_equal(a, b)
+    c = nd.zeros((1, 2))
+    a.copyto(c)
+    assert_almost_equal(a, c)
+    d = a.as_in_context(mx.cpu())
+    assert d.context.device_type in ("cpu",)
+
+
+def test_scalar_and_sync():
+    a = nd.array([42.0])
+    assert a.asscalar() == 42.0
+    a.wait_to_read()
+    nd.waitall()
+
+
+def test_save_load(tmp_path):
+    fname = str(tmp_path / "arrs")
+    d = {"w": nd.array([[1, 2]]), "b": nd.array([3.0])}
+    nd.save(fname, d)
+    loaded = nd.load(fname)
+    assert set(loaded) == {"w", "b"}
+    assert_almost_equal(loaded["w"], d["w"])
+    lst = [nd.array([1.0]), nd.array([2.0, 3.0])]
+    nd.save(fname + "2", lst)
+    l2 = nd.load(fname + "2")
+    assert len(l2) == 2 and l2[1].shape == (2,)
+
+
+def test_onehot_encode():
+    idx = nd.array([0, 2])
+    out = nd.zeros((2, 3))
+    nd.onehot_encode(idx, out)
+    assert_almost_equal(out, np.array([[1, 0, 0], [0, 0, 1]], np.float32))
+
+
+def test_async_semantics():
+    """Dispatch returns immediately; asnumpy is the sync point."""
+    a = nd.ones((256, 256))
+    for _ in range(10):
+        a = nd.dot(a, a) * 1e-3
+    val = a.asnumpy()
+    assert np.isfinite(val).all()

@@ -1,0 +1,288 @@
+"""Per-op numerical checks vs numpy (reference ``tests/python/unittest/
+test_operator.py``, 3018 LoC — same harness style via test_utils)."""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sym
+from mxnet_tpu.test_utils import (assert_almost_equal, check_numeric_gradient,
+                                  check_symbolic_backward,
+                                  check_symbolic_forward)
+
+RS = np.random.RandomState(7)
+
+
+def test_elemwise_binary_forward():
+    a = RS.rand(3, 4).astype(np.float32) + 0.5
+    b = RS.rand(3, 4).astype(np.float32) + 0.5
+    for name, ref in [("elemwise_add", a + b), ("elemwise_sub", a - b),
+                      ("elemwise_mul", a * b), ("elemwise_div", a / b),
+                      ("_power", a ** b), ("_maximum", np.maximum(a, b)),
+                      ("_minimum", np.minimum(a, b)),
+                      ("_hypot", np.hypot(a, b))]:
+        out = getattr(nd, name)(nd.array(a), nd.array(b))
+        assert_almost_equal(out, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_unary_forward():
+    x = RS.rand(2, 5).astype(np.float32) * 0.8 + 0.1
+    cases = [("sqrt", np.sqrt), ("exp", np.exp), ("log", np.log),
+             ("square", np.square), ("abs", np.abs), ("sign", np.sign),
+             ("sin", np.sin), ("cos", np.cos), ("tanh", np.tanh),
+             ("arcsin", np.arcsin), ("log1p", np.log1p),
+             ("expm1", np.expm1), ("rsqrt", lambda v: 1 / np.sqrt(v)),
+             ("degrees", np.degrees), ("radians", np.radians)]
+    for name, ref in cases:
+        assert_almost_equal(getattr(nd, name)(nd.array(x)), ref(x),
+                            rtol=1e-5, atol=1e-6)
+
+
+def test_scalar_ops():
+    x = RS.rand(3, 3).astype(np.float32)
+    assert_almost_equal(nd._plus_scalar(nd.array(x), scalar=2.0), x + 2)
+    assert_almost_equal(nd._rminus_scalar(nd.array(x), scalar=2.0), 2 - x)
+    assert_almost_equal(nd._rdiv_scalar(nd.array(x + 1), scalar=2.0),
+                        2 / (x + 1), rtol=1e-5)
+    assert_almost_equal(nd._power_scalar(nd.array(x), scalar=2.0), x ** 2,
+                        rtol=1e-5)
+
+
+def test_broadcast_ops():
+    a = RS.rand(3, 1, 5).astype(np.float32)
+    b = RS.rand(1, 4, 5).astype(np.float32)
+    assert_almost_equal(nd.broadcast_add(nd.array(a), nd.array(b)), a + b)
+    assert_almost_equal(nd.broadcast_mul(nd.array(a), nd.array(b)), a * b)
+    assert_almost_equal(
+        nd.broadcast_to(nd.array(a), shape=(3, 4, 5)),
+        np.broadcast_to(a, (3, 4, 5)))
+
+
+def test_reductions():
+    x = RS.rand(2, 3, 4).astype(np.float32)
+    assert_almost_equal(nd.sum(nd.array(x)), x.sum(), rtol=1e-5)
+    assert_almost_equal(nd.sum(nd.array(x), axis=1), x.sum(1), rtol=1e-5)
+    assert_almost_equal(nd.sum(nd.array(x), axis=(0, 2), keepdims=True),
+                        x.sum((0, 2), keepdims=True), rtol=1e-5)
+    assert_almost_equal(nd.mean(nd.array(x), axis=2), x.mean(2), rtol=1e-5)
+    assert_almost_equal(nd.max(nd.array(x), axis=0), x.max(0))
+    assert_almost_equal(nd.min(nd.array(x), axis=1), x.min(1))
+    assert_almost_equal(nd.argmax(nd.array(x), axis=1), x.argmax(1))
+    assert_almost_equal(nd.norm(nd.array(x)),
+                        np.array([np.sqrt((x ** 2).sum())]), rtol=1e-5)
+    xn = x.copy()
+    xn[0, 0, 0] = np.nan
+    assert_almost_equal(nd.nansum(nd.array(xn)), np.nansum(xn), rtol=1e-5)
+
+
+def test_matrix_ops():
+    a = RS.rand(3, 4).astype(np.float32)
+    b = RS.rand(4, 5).astype(np.float32)
+    assert_almost_equal(nd.dot(nd.array(a), nd.array(b)), a.dot(b), rtol=1e-5)
+    assert_almost_equal(
+        nd.dot(nd.array(a), nd.array(b.T), transpose_b=True), a.dot(b),
+        rtol=1e-5)
+    ba = RS.rand(2, 3, 4).astype(np.float32)
+    bb = RS.rand(2, 4, 5).astype(np.float32)
+    assert_almost_equal(nd.batch_dot(nd.array(ba), nd.array(bb)),
+                        np.matmul(ba, bb), rtol=1e-5)
+    x = RS.rand(2, 3, 4).astype(np.float32)
+    assert_almost_equal(nd.transpose(nd.array(x), axes=(2, 0, 1)),
+                        x.transpose(2, 0, 1))
+    assert_almost_equal(nd.Reshape(nd.array(x), shape=(3, -1)),
+                        x.reshape(3, -1))
+    assert_almost_equal(nd.Reshape(nd.array(x), shape=(0, -1)),
+                        x.reshape(2, -1))
+    assert_almost_equal(nd.slice(nd.array(x), begin=(0, 1, 0),
+                                 end=(2, 3, 2)), x[0:2, 1:3, 0:2])
+    assert_almost_equal(nd.slice_axis(nd.array(x), axis=1, begin=1, end=3),
+                        x[:, 1:3])
+    assert_almost_equal(nd.clip(nd.array(x), a_min=0.2, a_max=0.8),
+                        np.clip(x, 0.2, 0.8))
+    assert_almost_equal(nd.repeat(nd.array(x), repeats=2, axis=1),
+                        np.repeat(x, 2, 1))
+    assert_almost_equal(nd.tile(nd.array(x), reps=(1, 2, 1)),
+                        np.tile(x, (1, 2, 1)))
+    assert_almost_equal(nd.reverse(nd.array(x), axis=(1,)), x[:, ::-1])
+    assert_almost_equal(nd.SwapAxis(nd.array(x), dim1=0, dim2=2),
+                        x.swapaxes(0, 2))
+    assert_almost_equal(nd.expand_dims(nd.array(x), axis=1),
+                        np.expand_dims(x, 1))
+    assert_almost_equal(nd.Flatten(nd.array(x)), x.reshape(2, -1))
+
+
+def test_indexing_ops():
+    w = RS.rand(10, 4).astype(np.float32)
+    idx = np.array([1, 3, 5], dtype=np.float32)
+    assert_almost_equal(
+        nd.Embedding(nd.array(idx), nd.array(w), input_dim=10, output_dim=4),
+        w[idx.astype(int)])
+    assert_almost_equal(nd.take(nd.array(w), nd.array(idx)),
+                        w[idx.astype(int)])
+    assert_almost_equal(
+        nd.one_hot(nd.array(idx), depth=10),
+        np.eye(10, dtype=np.float32)[idx.astype(int)])
+    data = RS.rand(3, 5).astype(np.float32)
+    picks = np.array([0, 2, 4], dtype=np.float32)
+    assert_almost_equal(nd.pick(nd.array(data), nd.array(picks), axis=1),
+                        data[np.arange(3), picks.astype(int)])
+
+
+def test_ordering_ops():
+    x = RS.rand(4, 6).astype(np.float32)
+    topv = nd.topk(nd.array(x), k=3, ret_typ="value")
+    ref = np.sort(x, axis=1)[:, ::-1][:, :3]
+    assert_almost_equal(topv, ref)
+    assert_almost_equal(nd.sort(nd.array(x)), np.sort(x, 1))
+    assert_almost_equal(nd.argsort(nd.array(x)), np.argsort(x, 1))
+
+
+def test_softmax_output_backward():
+    """SoftmaxOutput backward = p - onehot(label), reference semantics."""
+    x = RS.rand(4, 5).astype(np.float32)
+    lab = np.array([0, 1, 2, 3], dtype=np.float32)
+    ex = np.exp(x - x.max(1, keepdims=True))
+    p = ex / ex.sum(1, keepdims=True)
+    expected_grad = p.copy()
+    expected_grad[np.arange(4), lab.astype(int)] -= 1.0
+    data = sym.Variable("data")
+    label = sym.Variable("label")
+    out = sym.SoftmaxOutput(data, label)
+    check_symbolic_forward(out, {"data": x, "label": lab}, [p], rtol=1e-5,
+                           atol=1e-6)
+    check_symbolic_backward(out, {"data": x, "label": lab}, None,
+                            {"data": expected_grad}, rtol=1e-5, atol=1e-6)
+
+
+def test_regression_outputs():
+    x = RS.rand(4, 3).astype(np.float32)
+    y = RS.rand(4, 3).astype(np.float32)
+    data, label = sym.Variable("data"), sym.Variable("label")
+    lin = sym.LinearRegressionOutput(data, label)
+    check_symbolic_forward(lin, {"data": x, "label": y}, [x])
+    check_symbolic_backward(lin, {"data": x, "label": y}, None,
+                            {"data": (x - y) / 3.0}, rtol=1e-5, atol=1e-6)
+    log = sym.LogisticRegressionOutput(data, label)
+    s = 1 / (1 + np.exp(-x))
+    check_symbolic_forward(log, {"data": x, "label": y}, [s], rtol=1e-5,
+                           atol=1e-6)
+    check_symbolic_backward(log, {"data": x, "label": y}, None,
+                            {"data": (s - y) / 3.0}, rtol=1e-4, atol=1e-5)
+    mae = sym.MAERegressionOutput(data, label)
+    check_symbolic_backward(mae, {"data": x, "label": y}, None,
+                            {"data": np.sign(x - y) / 3.0}, rtol=1e-5,
+                            atol=1e-6)
+
+
+def test_fc_gradient():
+    data = sym.Variable("data")
+    fc = sym.FullyConnected(data, num_hidden=4, name="fc")
+    loss = sym.make_loss(sym.sum(fc * fc))
+    check_numeric_gradient(
+        fc, {"data": RS.rand(3, 5).astype(np.float32),
+             "fc_weight": RS.rand(4, 5).astype(np.float32) * 0.1,
+             "fc_bias": np.zeros(4, np.float32)},
+        rtol=5e-2)
+
+
+def test_conv_pool_gradient():
+    data = sym.Variable("data")
+    conv = sym.Convolution(data, kernel=(3, 3), num_filter=2, name="conv")
+    pool = sym.Pooling(conv, kernel=(2, 2), stride=(2, 2), pool_type="avg")
+    check_numeric_gradient(
+        pool, {"data": RS.rand(2, 1, 6, 6).astype(np.float32),
+               "conv_weight": RS.rand(2, 1, 3, 3).astype(np.float32) * 0.3,
+               "conv_bias": np.zeros(2, np.float32)},
+        rtol=7e-2)
+
+
+def test_activation_grads():
+    for act in ["relu", "sigmoid", "tanh", "softrelu"]:
+        data = sym.Variable("data")
+        a = sym.Activation(data, act_type=act)
+        x = (RS.rand(3, 4).astype(np.float32) - 0.5) * 2
+        if act == "relu":
+            x[np.abs(x) < 0.1] += 0.3  # avoid kink
+        check_numeric_gradient(a, {"data": x}, rtol=5e-2)
+
+
+def test_batchnorm_forward():
+    x = RS.rand(4, 3, 5, 5).astype(np.float32)
+    gamma = np.ones(3, np.float32)
+    beta = np.zeros(3, np.float32)
+    mean = x.mean(axis=(0, 2, 3))
+    var = x.var(axis=(0, 2, 3))
+    ref = (x - mean.reshape(1, 3, 1, 1)) / np.sqrt(
+        var.reshape(1, 3, 1, 1) + 1e-3)
+    d = sym.Variable("d")
+    bn = sym.BatchNorm(d, name="bn")
+    ex = bn.simple_bind(mx.cpu(), d=(4, 3, 5, 5))
+    ex.arg_dict["d"][:] = x
+    ex.arg_dict["bn_gamma"][:] = gamma
+    ex.arg_dict["bn_beta"][:] = beta
+    out = ex.forward(is_train=True)[0]
+    assert_almost_equal(out, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_concat_slicechannel():
+    a = RS.rand(2, 3, 4).astype(np.float32)
+    b = RS.rand(2, 5, 4).astype(np.float32)
+    assert_almost_equal(nd.Concat(nd.array(a), nd.array(b), dim=1),
+                        np.concatenate([a, b], 1))
+    x = RS.rand(2, 6, 4).astype(np.float32)
+    parts = nd.SliceChannel(nd.array(x), num_outputs=3, axis=1)
+    for i, p in enumerate(parts):
+        assert_almost_equal(p, x[:, 2 * i:2 * i + 2])
+
+
+def test_dropout():
+    mx.random.seed(0)
+    x = np.ones((200, 200), np.float32)
+    out = nd.Dropout(nd.array(x), p=0.5).asnumpy()
+    frac = (out == 0).mean()
+    assert 0.4 < frac < 0.6
+    kept = out[out != 0]
+    assert np.allclose(kept, 2.0)
+
+
+def test_where_op():
+    cond = np.array([[1, 0], [0, 1]], dtype=np.float32)
+    x = np.ones((2, 2), np.float32)
+    y = np.zeros((2, 2), np.float32)
+    assert_almost_equal(nd.where(nd.array(cond), nd.array(x), nd.array(y)),
+                        np.where(cond != 0, x, y))
+
+
+def test_optimizer_kernels():
+    w = np.ones((4,), np.float32)
+    g = np.full((4,), 2.0, np.float32)
+    out = nd.sgd_update(nd.array(w), nd.array(g), lr=0.1)
+    assert_almost_equal(out, w - 0.1 * 2.0)
+    mom = np.zeros_like(w)
+    new_w, new_m = nd.sgd_mom_update(nd.array(w), nd.array(g), nd.array(mom),
+                                     lr=0.1, momentum=0.9)
+    assert_almost_equal(new_m, -0.1 * 2.0 * np.ones(4))
+    assert_almost_equal(new_w, w - 0.2)
+
+
+def test_embedding_gradient():
+    data = sym.Variable("data")
+    w = sym.Variable("w")
+    emb = sym.Embedding(data, w, input_dim=6, output_dim=3)
+    x = np.array([0, 2, 2, 5], dtype=np.float32)
+    wv = RS.rand(6, 3).astype(np.float32)
+    grads = check_symbolic_backward(
+        emb, {"data": x, "w": wv},
+        [np.ones((4, 3), np.float32)],
+        {"w": np.array([[1, 1, 1], [0, 0, 0], [2, 2, 2], [0, 0, 0],
+                        [0, 0, 0], [1, 1, 1]], np.float32)},
+        rtol=1e-5)
+
+
+def test_block_grad():
+    data = sym.Variable("data")
+    blocked = sym.BlockGrad(data * 2.0)
+    out = blocked * 3.0
+    x = RS.rand(2, 2).astype(np.float32)
+    check_symbolic_backward(out, {"data": x}, [np.ones((2, 2), np.float32)],
+                            {"data": np.zeros((2, 2), np.float32)})

@@ -1,0 +1,127 @@
+"""Optimizer step vs numpy reference (reference ``tests/python/unittest/
+test_optimizer.py``)."""
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def _step(opt, w0, g, n_steps=3):
+    w = nd.array(w0.copy())
+    state = opt.create_state(0, w)
+    for _ in range(n_steps):
+        opt.update(0, w, nd.array(g), state)
+    return w.asnumpy()
+
+
+def test_sgd_matches_numpy():
+    w0 = np.random.rand(5).astype(np.float32)
+    g = np.random.rand(5).astype(np.float32)
+    opt = mx.optimizer.SGD(learning_rate=0.1, rescale_grad=1.0, wd=0.0)
+    got = _step(opt, w0, g, 3)
+    ref = w0 - 3 * 0.1 * g
+    assert_almost_equal(got, ref, rtol=1e-5)
+
+
+def test_sgd_momentum_matches_numpy():
+    w0 = np.random.rand(5).astype(np.float32)
+    g = np.random.rand(5).astype(np.float32)
+    opt = mx.optimizer.SGD(learning_rate=0.1, momentum=0.9, rescale_grad=1.0)
+    got = _step(opt, w0, g, 3)
+    w, m = w0.copy(), np.zeros_like(w0)
+    for _ in range(3):
+        m = 0.9 * m - 0.1 * g
+        w = w + m
+    assert_almost_equal(got, w, rtol=1e-5)
+
+
+def test_sgd_wd_and_clip():
+    w0 = np.ones(4, np.float32)
+    g = np.full(4, 10.0, np.float32)
+    opt = mx.optimizer.SGD(learning_rate=0.1, wd=0.1, clip_gradient=1.0,
+                           rescale_grad=1.0)
+    got = _step(opt, w0, g, 1)
+    ref = w0 - 0.1 * (np.clip(g, -1, 1) + 0.1 * w0)
+    assert_almost_equal(got, ref, rtol=1e-6)
+
+
+def test_adam_matches_numpy():
+    w0 = np.random.rand(6).astype(np.float32)
+    g = np.random.rand(6).astype(np.float32)
+    opt = mx.optimizer.Adam(learning_rate=0.01, rescale_grad=1.0)
+    got = _step(opt, w0, g, 2)
+    w = w0.copy().astype(np.float64)
+    m = np.zeros_like(w)
+    v = np.zeros_like(w)
+    b1, b2, eps = 0.9, 0.999, 1e-8
+    for t in range(1, 3):
+        lr = 0.01 * np.sqrt(1 - b2 ** t) / (1 - b1 ** t)
+        m = b1 * m + (1 - b1) * g
+        v = b2 * v + (1 - b2) * g * g
+        w -= lr * m / (np.sqrt(v) + eps)
+    assert_almost_equal(got, w.astype(np.float32), rtol=1e-4)
+
+
+def test_rmsprop_matches_numpy():
+    w0 = np.random.rand(6).astype(np.float32)
+    g = np.random.rand(6).astype(np.float32)
+    opt = mx.optimizer.RMSProp(learning_rate=0.01, gamma1=0.9,
+                               rescale_grad=1.0)
+    got = _step(opt, w0, g, 2)
+    w = w0.copy().astype(np.float64)
+    n = np.zeros_like(w)
+    for _ in range(2):
+        n = 0.1 * g * g + 0.9 * n
+        w -= 0.01 * g / np.sqrt(n + 1e-8)
+    assert_almost_equal(got, w.astype(np.float32), rtol=1e-4)
+
+
+def test_adagrad_and_adadelta_run():
+    for opt in [mx.optimizer.AdaGrad(learning_rate=0.1),
+                mx.optimizer.AdaDelta()]:
+        w0 = np.random.rand(4).astype(np.float32)
+        g = np.random.rand(4).astype(np.float32)
+        got = _step(opt, w0, g, 2)
+        assert np.isfinite(got).all()
+        assert not np.allclose(got, w0)
+
+
+def test_lr_scheduler():
+    sched = mx.lr_scheduler.FactorScheduler(step=10, factor=0.5)
+    opt = mx.optimizer.SGD(learning_rate=1.0, lr_scheduler=sched)
+    assert opt._get_lr(0) == 1.0
+    opt.num_update = 25
+    lr = opt._get_lr(0)
+    assert abs(lr - 0.25) < 1e-6
+
+
+def test_multifactor_scheduler():
+    sched = mx.lr_scheduler.MultiFactorScheduler(step=[5, 10], factor=0.1)
+    sched.base_lr = 1.0
+    assert abs(sched(3) - 1.0) < 1e-9
+    assert abs(sched(7) - 0.1) < 1e-9
+    assert abs(sched(12) - 0.01) < 1e-9
+
+
+def test_lr_wd_mult():
+    opt = mx.optimizer.SGD(learning_rate=1.0,
+                           param_idx2name={0: "a_weight", 1: "b_weight"})
+    opt.set_lr_mult({"a_weight": 0.1})
+    opt.set_wd_mult({"b_weight": 2.0})
+    assert abs(opt._get_lr(0) - 0.1) < 1e-9
+    assert abs(opt._get_lr(1) - 1.0) < 1e-9
+    assert abs(opt._get_wd(1) - 2.0 * opt.wd) < 1e-9
+
+
+def test_updater_states_roundtrip():
+    opt = mx.optimizer.SGD(learning_rate=0.1, momentum=0.9)
+    upd = mx.optimizer.get_updater(opt)
+    w = nd.ones((3,))
+    upd(0, nd.ones((3,)), w)
+    blob = upd.get_states()
+    upd2 = mx.optimizer.get_updater(
+        mx.optimizer.SGD(learning_rate=0.1, momentum=0.9))
+    upd2.set_states(blob)
+    assert 0 in upd2.states

@@ -1,0 +1,108 @@
+"""Symbol composition/serialization (reference ``tests/python/unittest/
+test_symbol.py`` + ``test_infer_shape.py`` + ``test_attr.py``)."""
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import sym
+
+
+def _mlp():
+    data = sym.Variable("data")
+    fc1 = sym.FullyConnected(data, num_hidden=10, name="fc1")
+    act = sym.Activation(fc1, act_type="relu", name="relu1")
+    fc2 = sym.FullyConnected(act, num_hidden=3, name="fc2")
+    return sym.SoftmaxOutput(fc2, name="softmax")
+
+
+def test_compose_and_lists():
+    out = _mlp()
+    assert out.list_arguments() == [
+        "data", "fc1_weight", "fc1_bias", "fc2_weight", "fc2_bias",
+        "softmax_label"]
+    assert out.list_outputs() == ["softmax_output"]
+    assert out.name == "softmax"
+
+
+def test_infer_shape():
+    out = _mlp()
+    arg_shapes, out_shapes, aux_shapes = out.infer_shape(data=(8, 20))
+    d = dict(zip(out.list_arguments(), arg_shapes))
+    assert d["fc1_weight"] == (10, 20)
+    assert d["fc1_bias"] == (10,)
+    assert d["fc2_weight"] == (3, 10)
+    assert d["softmax_label"] == (8,)
+    assert out_shapes == [(8, 3)]
+    assert aux_shapes == []
+
+
+def test_infer_shape_partial():
+    data = sym.Variable("data")
+    fc = sym.FullyConnected(data, num_hidden=4, name="fc")
+    arg_shapes, out_shapes, _ = fc.infer_shape_partial()
+    assert out_shapes == [None]
+
+
+def test_group_and_getitem():
+    data = sym.Variable("data")
+    fc1 = sym.FullyConnected(data, num_hidden=10, name="fc1")
+    fc2 = sym.FullyConnected(data, num_hidden=5, name="fc2")
+    g = sym.Group([fc1, fc2])
+    assert g.list_outputs() == ["fc1_output", "fc2_output"]
+    assert g[1].list_outputs() == ["fc2_output"]
+    assert g["fc1_output"].list_outputs() == ["fc1_output"]
+    assert len(g) == 2
+
+
+def test_json_roundtrip(tmp_path):
+    out = _mlp()
+    f = str(tmp_path / "sym.json")
+    out.save(f)
+    loaded = mx.sym.load(f)
+    assert loaded.list_arguments() == out.list_arguments()
+    assert loaded.list_outputs() == out.list_outputs()
+    # bound executors must agree
+    ex1 = out.simple_bind(mx.cpu(), data=(2, 6))
+    ex2 = loaded.simple_bind(mx.cpu(), data=(2, 6))
+    rs = np.random.RandomState(0)
+    for n in ex1.arg_dict:
+        v = rs.rand(*ex1.arg_dict[n].shape).astype(np.float32)
+        ex1.arg_dict[n][:] = v
+        ex2.arg_dict[n][:] = v
+    o1 = ex1.forward()[0].asnumpy()
+    o2 = ex2.forward()[0].asnumpy()
+    assert np.allclose(o1, o2)
+
+
+def test_attr_scope():
+    with mx.AttrScope(ctx_group="dev1"):
+        data = sym.Variable("data")
+        fc = sym.FullyConnected(data, num_hidden=2, name="fc")
+    assert fc.attr("ctx_group") == "dev1"
+    assert data.attr("ctx_group") == "dev1"
+
+
+def test_variable_shape_attr():
+    data = sym.Variable("data", shape=(4, 7))
+    fc = sym.FullyConnected(data, num_hidden=3, name="fc")
+    ex = fc.simple_bind(mx.cpu())
+    assert ex.arg_dict["data"].shape == (4, 7)
+    assert ex.arg_dict["fc_weight"].shape == (3, 7)
+
+
+def test_symbol_arith_operators():
+    a = sym.Variable("a")
+    b = sym.Variable("b")
+    out = (a + b) * 2.0 - a / 2.0
+    ex = out.bind(mx.cpu(), {"a": mx.nd.array([2.0]), "b": mx.nd.array([4.0])})
+    res = ex.forward()[0].asscalar()
+    assert abs(res - ((2 + 4) * 2 - 1)) < 1e-5
+
+
+def test_get_internals():
+    out = _mlp()
+    internals = out.get_internals()
+    names = internals.list_outputs()
+    assert "fc1_output" in names
+    feat = internals["fc1_output"]
+    assert feat.list_outputs() == ["fc1_output"]
