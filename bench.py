@@ -1,0 +1,90 @@
+#!/usr/bin/env python
+"""Benchmark: ResNet-50 training throughput, batch 32, one TPU chip.
+
+Baseline (BASELINE.md): reference MXNet trains ResNet-50/ImageNet at 45.52
+images/sec on one K80 (``docs/how_to/perf.md:108-117``).  This harness is the
+analog of ``example/image-classification/common/fit.py --benchmark 1``:
+synthetic data, full fwd+bwd+SGD-momentum update through ``Module``.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+BATCH = int(os.environ.get("BENCH_BATCH", "32"))
+WARMUP = int(os.environ.get("BENCH_WARMUP", "3"))
+STEPS = int(os.environ.get("BENCH_STEPS", "20"))
+BASELINE_IPS = 45.52  # K80 ResNet-50 train, docs/how_to/perf.md:108-117
+DTYPE = os.environ.get("BENCH_DTYPE", "bfloat16")
+
+
+def main():
+    # honor an explicit CPU request even under the axon sitecustomize,
+    # which force-registers the TPU platform regardless of JAX_PLATFORMS
+    if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import io as mxio
+    from mxnet_tpu.models import resnet
+
+    ctx = mx.tpu() if mx.num_tpus() > 0 else mx.cpu()
+
+    net = resnet.get_symbol(num_classes=1000, num_layers=50,
+                            image_shape=(3, 224, 224))
+    rs = np.random.RandomState(0)
+    data = rs.rand(BATCH, 3, 224, 224).astype(np.float32)
+    label = rs.randint(0, 1000, BATCH).astype(np.float32)
+    batch = mxio.DataBatch(
+        data=[mx.nd.array(data, ctx=ctx, dtype=DTYPE)],
+        label=[mx.nd.array(label, ctx=ctx)])
+
+    mod = mx.mod.Module(net, context=ctx)
+    mod.bind(data_shapes=[("data", (BATCH, 3, 224, 224))],
+             label_shapes=[("softmax_label", (BATCH,))])
+    # bf16 params/activations; BatchNorm stats stay f32 inside the op
+    if DTYPE != "float32":
+        import jax
+
+        for n, a in mod._exec.arg_dict.items():
+            if n not in ("softmax_label",):
+                a._jx = a._jx.astype(DTYPE)
+    mod.init_params(mx.init.Xavier(rnd_type="gaussian", factor_type="in",
+                                   magnitude=2))
+    mod.init_optimizer(kvstore=None, optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.05,
+                                         "momentum": 0.9, "wd": 1e-4})
+
+    def step():
+        mod.forward_backward(batch)
+        mod.update()
+
+    for _ in range(WARMUP):
+        step()
+    mod._exec.arg_dict["fc1_weight"].wait_to_read()
+
+    t0 = time.time()
+    for _ in range(STEPS):
+        step()
+    mod._exec.arg_dict["fc1_weight"].wait_to_read()
+    dt = time.time() - t0
+
+    ips = BATCH * STEPS / dt
+    print(json.dumps({
+        "metric": "resnet50_train_imgs_per_sec_b%d" % BATCH,
+        "value": round(ips, 2),
+        "unit": "images/sec",
+        "vs_baseline": round(ips / BASELINE_IPS, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

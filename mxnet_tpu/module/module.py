@@ -78,6 +78,7 @@ class Module(BaseModule):
         self._update_on_kvstore = False
         self._preload_opt_states = None
         self._grad_req = "write"
+        self._fused_step = None
 
     # -- properties -------------------------------------------------------
     @property
@@ -325,9 +326,21 @@ class Module(BaseModule):
         self._exec.backward(out_grads=out_grads)
 
     def update(self):
-        """reference ``module.py:553`` + model.py:88/99"""
+        """reference ``module.py:553`` + model.py:88/99.
+
+        Fast path: for plain/momentum SGD with no kvstore, ONE jitted
+        multi-tensor update over all parameters with donated buffers — the
+        TPU analog of the reference's fused ``sgd_mom_update`` kernels
+        without per-parameter dispatch.  Everything else goes through the
+        kvstore/updater path for exact reference semantics.
+        """
         assert self.binded and self.params_initialized \
             and self.optimizer_initialized
+        local_kv = self._kvstore is None or (
+            not self._update_on_kvstore and "dist" not in self._kvstore.type)
+        if local_kv and self._updater is not None \
+                and self._try_fused_update():
+            return
         param_arrays = [[self._exec.arg_dict[n]] for n in self._param_names]
         grad_arrays = [[self._exec.grad_dict.get(n)]
                        for n in self._param_names]
@@ -337,6 +350,64 @@ class Module(BaseModule):
         else:
             _update_params(param_arrays, grad_arrays, updater=self._updater,
                            num_device=1, kvstore=self._kvstore)
+
+    def _try_fused_update(self):
+        import jax
+        import jax.numpy as jnp
+
+        optimizer = self._optimizer
+        if type(optimizer) is not opt.SGD:
+            return False
+        names = [n for n in self._param_names
+                 if self._exec.grad_dict.get(n) is not None]
+        if not names:
+            return True
+        updater = self._updater
+        if self._fused_step is None:
+            momentum = optimizer.momentum
+            rescale = optimizer.rescale_grad
+            clip = optimizer.clip_gradient if optimizer.clip_gradient \
+                is not None else -1.0
+            # momentum state lives in the Updater so save/load_optimizer
+            # _states keeps working
+            for idx, n in enumerate(names):
+                if idx not in updater.states:
+                    updater.states[idx] = optimizer.create_state(
+                        idx, self._exec.arg_dict[n])
+
+            def step(params, grads, moms, lrs, wds):
+                new_p, new_m = [], []
+                for i, (p, g) in enumerate(zip(params, grads)):
+                    g = g * rescale
+                    if clip > 0:
+                        g = jnp.clip(g, -clip, clip)
+                    g = g + wds[i] * p
+                    if momentum != 0.0:
+                        m = momentum * moms[i] - lrs[i] * g
+                        new_m.append(m)
+                        new_p.append(p + m)
+                    else:
+                        new_p.append(p - lrs[i] * g)
+                return new_p, new_m
+
+            self._fused_step = jax.jit(step, donate_argnums=(0, 2))
+        # per-index bookkeeping keeps num_update/scheduler semantics
+        for idx in range(len(names)):
+            optimizer._update_count(idx)
+        lrs = jnp.asarray([optimizer._get_lr(i) for i in range(len(names))],
+                          jnp.float32)
+        wds = jnp.asarray([optimizer._get_wd(i) for i in range(len(names))],
+                          jnp.float32)
+        params = [self._exec.arg_dict[n]._jx for n in names]
+        grads = [self._exec.grad_dict[n]._jx for n in names]
+        moms = [updater.states[i]._jx for i in range(len(names))] \
+            if optimizer.momentum != 0.0 else []
+        new_p, new_m = self._fused_step(params, grads, moms, lrs, wds)
+        for n, p in zip(names, new_p):
+            self._exec.arg_dict[n]._jx = p
+        for i, m in enumerate(new_m):
+            updater.states[i]._jx = m
+        return True
 
     def get_outputs(self, merge_multi_context=True):
         assert self.binded

@@ -1,0 +1,19 @@
+"""Parallelism toolkit — mesh construction + SPMD train steps.
+
+This is the component that replaces the reference's distributed stack most
+radically (SURVEY §2.4/§5.8): instead of parameter servers (``ps-lite``) and
+device-comm trees (``src/kvstore/comm.h``), parallelism is expressed as
+shardings over a ``jax.sharding.Mesh`` and XLA GSPMD compiles the
+collectives (psum/all-gather/reduce-scatter) into the training step itself,
+riding ICI inside a slice and DCN across slices.
+
+Axes convention (the scaling-book recipe):
+  ``data``  — batch (data parallelism; the KVStore('device') analog)
+  ``model`` — tensor parallelism (weight shards; layer in/out features)
+  ``seq``   — sequence/context parallelism (ring attention; SURVEY §5.7)
+"""
+
+from .mesh import make_mesh, named_sharding
+from .trainer import SPMDTrainer
+
+__all__ = ["make_mesh", "named_sharding", "SPMDTrainer"]

@@ -1,0 +1,40 @@
+"""Mesh construction helpers."""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+__all__ = ["make_mesh", "named_sharding", "PartitionSpec"]
+
+
+def make_mesh(n_devices=None, axis_names=("data", "model"), shape=None,
+              devices=None):
+    """Build a Mesh over the first ``n_devices`` JAX devices.
+
+    ``shape`` defaults to putting everything on the first axis except a
+    factor-2 (or given) model axis when the count allows it.
+    """
+    if devices is None:
+        devices = jax.devices()
+        if n_devices is not None:
+            devices = devices[:n_devices]
+    n = len(devices)
+    if shape is None:
+        if len(axis_names) == 1:
+            shape = (n,)
+        elif len(axis_names) == 2:
+            model = 2 if (n % 2 == 0 and n >= 4) else 1
+            shape = (n // model, model)
+        else:
+            shape = (n,) + (1,) * (len(axis_names) - 1)
+    if int(np.prod(shape)) != n:
+        raise ValueError("mesh shape %s != %d devices" % (shape, n))
+    arr = np.array(devices).reshape(shape)
+    return Mesh(arr, axis_names)
+
+
+def named_sharding(mesh, *spec):
+    return NamedSharding(mesh, PartitionSpec(*spec))

@@ -1,0 +1,135 @@
+"""SPMDTrainer — a fully-fused sharded training step over a device mesh.
+
+One jitted function per (symbol, mesh, shardings): forward + backward +
+SGD-momentum update, with parameter/optimizer-state buffers donated.  This
+is the ``Module.fit`` hot path distilled to its TPU-native core: the
+reference needs engine scheduling + kvstore push/pull per step
+(SURVEY §3.1); here the whole step including the gradient allreduce is one
+XLA program.
+
+Sharding rules:
+* data/label: ``P('data', ...)`` — batch split (DP).
+* parameters: replicated by default; a ``tp_rules`` list of
+  ``(name_regex, PartitionSpec)`` shards chosen weights over ``model`` (TP).
+  XLA inserts the all-gathers/reduce-scatters those shards imply.
+"""
+
+from __future__ import annotations
+
+import re
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..executor import _graph_forward
+
+__all__ = ["SPMDTrainer"]
+
+
+class SPMDTrainer:
+    def __init__(self, symbol, mesh, data_names=("data",),
+                 label_names=("softmax_label",), tp_rules=(),
+                 lr=0.01, momentum=0.9, wd=0.0, dtype=np.float32):
+        self.symbol = symbol
+        self.mesh = mesh
+        self.data_names = list(data_names)
+        self.label_names = list(label_names)
+        self.tp_rules = [(re.compile(p), spec) for p, spec in tp_rules]
+        self.lr = lr
+        self.momentum = momentum
+        self.wd = wd
+        self.dtype = dtype
+        self.arg_names = symbol.list_arguments()
+        self.aux_names = symbol.list_auxiliary_states()
+        self.param_names = [n for n in self.arg_names
+                            if n not in self.data_names + self.label_names]
+        self._step = None
+        self.params = None
+        self.aux = None
+        self.moms = None
+
+    # -- placement --------------------------------------------------------
+    def param_spec(self, name):
+        for prog, spec in self.tp_rules:
+            if prog.match(name):
+                return spec
+        return P()
+
+    def _put(self, value, spec):
+        return jax.device_put(value, NamedSharding(self.mesh, spec))
+
+    def init(self, data_shapes, seed=0):
+        """Infer shapes, initialize and place parameters over the mesh."""
+        var_shape, _vd, _ = self.symbol._infer_shapes_full(dict(data_shapes))
+        rs = np.random.RandomState(seed)
+        self.params = {}
+        self.moms = {}
+        for n in self.param_names:
+            s = var_shape[n]
+            if n.endswith("_bias") or n.endswith("_beta") \
+                    or n.endswith("moving_mean"):
+                v = np.zeros(s, self.dtype)
+            elif n.endswith("_gamma") or n.endswith("moving_var"):
+                v = np.ones(s, self.dtype)
+            else:
+                fan_in = int(np.prod(s[1:])) or 1
+                v = (rs.normal(0, np.sqrt(2.0 / fan_in), s)
+                     .astype(self.dtype))
+            spec = self.param_spec(n)
+            self.params[n] = self._put(v, spec)
+            self.moms[n] = self._put(np.zeros(s, self.dtype), spec)
+        self.aux = {}
+        for n in self.aux_names:
+            s = var_shape[n]
+            v = np.ones(s, self.dtype) if n.endswith("moving_var") \
+                else np.zeros(s, self.dtype)
+            self.aux[n] = self._put(v, P())
+        return self
+
+    def place_batch(self, arrays, names=None):
+        names = names or (self.data_names + self.label_names)
+        return {n: self._put(np.asarray(a), P("data"))
+                for n, a in zip(names, arrays)}
+
+    # -- the fused step ----------------------------------------------------
+    def _build(self):
+        symbol = self.symbol
+        lr, momentum, wd = self.lr, self.momentum, self.wd
+        aux_names = list(self.aux_names)
+
+        def step(params, aux, moms, batch, rng):
+            def g(p):
+                vals = dict(batch)
+                vals.update(p)
+                outs, new_aux = _graph_forward(symbol, vals, aux, True, rng)
+                return tuple(outs), new_aux
+
+            outs, vjp_fn, new_aux = jax.vjp(g, params, has_aux=True)
+            (grads,) = vjp_fn(tuple(jnp.ones_like(o) for o in outs))
+            new_params, new_moms = {}, {}
+            for n, p in params.items():
+                gr = grads[n] + wd * p
+                if momentum != 0.0:
+                    m = momentum * moms[n] - lr * gr
+                    new_moms[n] = m
+                    new_params[n] = p + m
+                else:
+                    new_moms[n] = moms[n]
+                    new_params[n] = p - lr * gr
+            new_aux_full = {n: new_aux.get(n, aux[n]) for n in aux_names}
+            return outs, new_params, new_aux_full, new_moms
+
+        return jax.jit(step, donate_argnums=(0, 1, 2))
+
+    def train_step(self, batch, rng=None):
+        """Run one fused step; updates self.params/aux/moms in place."""
+        if self._step is None:
+            self._step = self._build()
+        if rng is None:
+            rng = jax.random.PRNGKey(0)
+        outs, self.params, self.aux, self.moms = self._step(
+            self.params, self.aux, self.moms, batch, rng)
+        return outs
