@@ -42,6 +42,8 @@ from . import kvstore
 from . import kvstore as kv
 from .kvstore import KVStore
 
+from . import rnn
+
 from . import module
 from . import module as mod
 from .module import Module

@@ -126,6 +126,24 @@ for _n in ("elemwise_add", "elemwise_sub", "elemwise_mul", "elemwise_div",
     get(_n).infer_inputs = _same_shape
 
 
+@rule("RNN")
+def _rnn_infer(attrs, ins, dts, auxs):
+    from .rnn import rnn_param_size
+
+    data = ins[0]
+    if data is not None:
+        h = attrs["state_size"]
+        d = 2 if attrs["bidirectional"] else 1
+        n_states = attrs["num_layers"] * d
+        if ins[1] is None:
+            ins[1] = (rnn_param_size(data[2], h, attrs["num_layers"],
+                                     attrs["mode"], attrs["bidirectional"]),)
+        for i in range(2, len(ins)):
+            if ins[i] is None:
+                ins[i] = (n_states, data[1], h)
+    return ins, auxs
+
+
 @rule("SoftmaxOutput")
 def _softmax_out(attrs, ins, dts, auxs):
     data = ins[0]

@@ -1,0 +1,15 @@
+"""RNN toolkit (``mx.rnn``) — reference ``python/mxnet/rnn/``.
+
+Symbolic RNN cells plus the fused multi-layer cell backed by the TPU-native
+``RNN`` op (``ops/rnn.py``), and the bucketing data iterator.
+"""
+
+from .rnn_cell import (BaseRNNCell, RNNCell, LSTMCell, GRUCell, FusedRNNCell,
+                       SequentialRNNCell, BidirectionalCell, DropoutCell,
+                       ModifierCell, ZoneoutCell, RNNParams)
+from .io import BucketSentenceIter, encode_sentences
+
+__all__ = ["BaseRNNCell", "RNNCell", "LSTMCell", "GRUCell", "FusedRNNCell",
+           "SequentialRNNCell", "BidirectionalCell", "DropoutCell",
+           "ModifierCell", "ZoneoutCell", "RNNParams",
+           "BucketSentenceIter", "encode_sentences"]

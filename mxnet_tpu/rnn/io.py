@@ -1,0 +1,135 @@
+"""Bucketing data iterator for variable-length sequences.
+
+Reference: ``python/mxnet/rnn/io.py`` (``encode_sentences`` :13,
+``BucketSentenceIter`` :61) — the data side of the PTB LM baseline
+(SURVEY §2.9 config 3).  Each batch carries a ``bucket_key`` so
+``BucketingModule`` can pick (or trace+compile) the executor for that
+sequence length.
+"""
+
+from __future__ import annotations
+
+import bisect
+import logging
+import random
+
+import numpy as np
+
+from .. import ndarray
+from ..io import DataBatch, DataIter, DataDesc
+
+__all__ = ["encode_sentences", "BucketSentenceIter"]
+
+
+def encode_sentences(sentences, vocab=None, invalid_label=-1,
+                     invalid_key="\n", start_label=0):
+    """Encode tokenized sentences into integer id lists, building (or
+    extending) ``vocab``.  Returns (encoded, vocab)."""
+    idx = start_label
+    if vocab is None:
+        vocab = {invalid_key: invalid_label}
+        new_vocab = True
+    else:
+        new_vocab = False
+    res = []
+    for sent in sentences:
+        coded = []
+        for word in sent:
+            if word not in vocab:
+                assert new_vocab, "Unknown token %s" % word
+                if idx == invalid_label:
+                    idx += 1
+                vocab[word] = idx
+                idx += 1
+            coded.append(vocab[word])
+        res.append(coded)
+    return res, vocab
+
+
+class BucketSentenceIter(DataIter):
+    """Bucketed LM iterator: pads each sentence up to its bucket length;
+    label is the input shifted one step left (next-token prediction)."""
+
+    def __init__(self, sentences, batch_size, buckets=None, invalid_label=-1,
+                 data_name="data", label_name="softmax_label",
+                 dtype="float32", layout="NTC"):
+        super().__init__(batch_size)
+        if not buckets:
+            counts = np.bincount([len(s) for s in sentences])
+            buckets = [i for i, j in enumerate(counts) if j >= batch_size]
+        buckets = sorted(buckets)
+
+        ndiscard = 0
+        self.data = [[] for _ in buckets]
+        for sent in sentences:
+            buck = bisect.bisect_left(buckets, len(sent))
+            if buck == len(buckets):
+                ndiscard += 1
+                continue
+            buff = np.full((buckets[buck],), invalid_label, dtype=dtype)
+            buff[:len(sent)] = sent
+            self.data[buck].append(buff)
+        self.data = [np.asarray(b, dtype=dtype) for b in self.data]
+        if ndiscard:
+            logging.warning("discarded %d sentences longer than the largest "
+                            "bucket.", ndiscard)
+
+        self.buckets = buckets
+        self.data_name = data_name
+        self.label_name = label_name
+        self.dtype = dtype
+        self.invalid_label = invalid_label
+        self.major_axis = layout.find("N")
+        self.layout = layout
+        self.default_bucket_key = max(buckets)
+
+        if self.major_axis == 0:
+            shape = (batch_size, self.default_bucket_key)
+        elif self.major_axis == 1:
+            shape = (self.default_bucket_key, batch_size)
+        else:
+            raise ValueError("invalid layout %s" % layout)
+        self.provide_data = [DataDesc(data_name, shape, layout=layout)]
+        self.provide_label = [DataDesc(label_name, shape, layout=layout)]
+
+        self.idx = []
+        for i, buck in enumerate(self.data):
+            self.idx.extend(
+                (i, j) for j in range(0, len(buck) - batch_size + 1,
+                                      batch_size))
+        self.curr_idx = 0
+        self.nddata = []
+        self.ndlabel = []
+        self.reset()
+
+    def reset(self):
+        self.curr_idx = 0
+        random.shuffle(self.idx)
+        for buck in self.data:
+            np.random.shuffle(buck)
+        self.nddata = []
+        self.ndlabel = []
+        for buck in self.data:
+            label = np.empty_like(buck)
+            label[:, :-1] = buck[:, 1:]
+            label[:, -1] = self.invalid_label
+            self.nddata.append(ndarray.array(buck, dtype=self.dtype))
+            self.ndlabel.append(ndarray.array(label, dtype=self.dtype))
+
+    def next(self):
+        if self.curr_idx == len(self.idx):
+            raise StopIteration
+        i, j = self.idx[self.curr_idx]
+        self.curr_idx += 1
+        if self.major_axis == 1:
+            data = self.nddata[i][j:j + self.batch_size].T
+            label = self.ndlabel[i][j:j + self.batch_size].T
+        else:
+            data = self.nddata[i][j:j + self.batch_size]
+            label = self.ndlabel[i][j:j + self.batch_size]
+        return DataBatch(
+            [data], [label], pad=0, bucket_key=self.buckets[i],
+            provide_data=[DataDesc(self.data_name, data.shape,
+                                   layout=self.layout)],
+            provide_label=[DataDesc(self.label_name, label.shape,
+                                    layout=self.layout)])

@@ -1,0 +1,210 @@
+"""RNN toolkit tests — reference ``tests/python/unittest/test_rnn.py``:
+cell unroll shapes, fused-vs-unfused numerical consistency via
+pack/unpack_weights, bucketing iterator semantics."""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def _eval_sym(sym, arg_arrays):
+    ex = sym.bind(mx.cpu(), arg_arrays)
+    return [o.asnumpy() for o in ex.forward(is_train=False)]
+
+
+def test_rnn_cell_unroll_shapes():
+    cell = mx.rnn.RNNCell(10, prefix="rnn_")
+    outputs, states = cell.unroll(3, inputs=mx.sym.Variable("data"),
+                                  merge_outputs=True)
+    _, outs, _ = outputs.infer_shape(data=(2, 3, 7))
+    assert outs == [(2, 3, 10)]
+    assert sorted(cell.params._params) == [
+        "rnn_h2h_bias", "rnn_h2h_weight", "rnn_i2h_bias", "rnn_i2h_weight"]
+
+
+def test_lstm_cell_unroll_shapes():
+    cell = mx.rnn.LSTMCell(10, prefix="lstm_")
+    outputs, states = cell.unroll(3, inputs=mx.sym.Variable("data"),
+                                  merge_outputs=True)
+    _, outs, _ = outputs.infer_shape(data=(2, 3, 7))
+    assert outs == [(2, 3, 10)]
+    assert len(states) == 2
+
+
+def test_gru_cell_unroll_shapes():
+    cell = mx.rnn.GRUCell(10, prefix="gru_")
+    outputs, _ = cell.unroll(3, inputs=mx.sym.Variable("data"),
+                             merge_outputs=True)
+    _, outs, _ = outputs.infer_shape(data=(2, 3, 7))
+    assert outs == [(2, 3, 10)]
+
+
+def test_unroll_list_inputs():
+    cell = mx.rnn.LSTMCell(10, prefix="lstm_")
+    seq = [mx.sym.Variable("t%d" % i) for i in range(3)]
+    outputs, _ = cell.unroll(3, inputs=seq, merge_outputs=False)
+    assert len(outputs) == 3
+    _, outs, _ = outputs[2].infer_shape(t0=(2, 7), t1=(2, 7), t2=(2, 7))
+    assert outs == [(2, 10)]
+
+
+@pytest.mark.parametrize("mode", ["rnn_relu", "rnn_tanh", "lstm", "gru"])
+def test_fused_matches_unfused(mode):
+    """The lax.scan fused RNN and the per-step unrolled cells must produce
+    identical outputs from the same parameter blob (reference
+    test_rnn.py consistency checks)."""
+    T, N, I, H, L = 4, 3, 5, 6, 2
+    fused = mx.rnn.FusedRNNCell(H, num_layers=L, mode=mode, prefix="f_",
+                                get_next_state=False)
+    data = mx.sym.Variable("data")
+    fsym, _ = fused.unroll(T, inputs=data, merge_outputs=True)
+
+    stack = fused.unfuse()
+    usym, _ = stack.unroll(T, inputs=data, merge_outputs=True)
+
+    from mxnet_tpu.ops.rnn import rnn_param_size
+
+    rs = np.random.RandomState(0)
+    blob = mx.nd.array(rs.uniform(-0.5, 0.5,
+                                  rnn_param_size(I, H, L, mode)).astype("f"))
+    x = mx.nd.array(rs.randn(N, T, I).astype("f"))
+
+    fout = _eval_sym(fsym, {"data": x, "f_parameters": blob})[0]
+    uargs = fused.unpack_weights({"f_parameters": blob})
+    uout = _eval_sym(usym, dict(uargs, data=x))[0]
+    assert fout.shape == uout.shape == (N, T, H)
+    np.testing.assert_allclose(fout, uout, rtol=1e-4, atol=1e-5)
+
+
+def test_fused_bidirectional_matches_unfused():
+    T, N, I, H = 4, 3, 5, 6
+    fused = mx.rnn.FusedRNNCell(H, num_layers=1, mode="lstm", prefix="f_",
+                                bidirectional=True)
+    data = mx.sym.Variable("data")
+    fsym, _ = fused.unroll(T, inputs=data, merge_outputs=True)
+    stack = fused.unfuse()
+    usym, _ = stack.unroll(T, inputs=data, merge_outputs=True)
+
+    from mxnet_tpu.ops.rnn import rnn_param_size
+
+    rs = np.random.RandomState(1)
+    blob = mx.nd.array(rs.uniform(
+        -0.5, 0.5, rnn_param_size(I, H, 1, "lstm", True)).astype("f"))
+    x = mx.nd.array(rs.randn(N, T, I).astype("f"))
+    fout = _eval_sym(fsym, {"data": x, "f_parameters": blob})[0]
+    uargs = fused.unpack_weights({"f_parameters": blob})
+    uout = _eval_sym(usym, dict(uargs, data=x))[0]
+    assert fout.shape == uout.shape == (N, T, 2 * H)
+    np.testing.assert_allclose(fout, uout, rtol=1e-4, atol=1e-5)
+
+
+def test_pack_unpack_roundtrip():
+    from mxnet_tpu.ops.rnn import rnn_param_size
+
+    fused = mx.rnn.FusedRNNCell(6, num_layers=2, mode="gru", prefix="f_",
+                                bidirectional=True)
+    rs = np.random.RandomState(2)
+    blob = rs.randn(rnn_param_size(5, 6, 2, "gru", True)).astype("f")
+    unpacked = fused.unpack_weights({"f_parameters": mx.nd.array(blob)})
+    assert "f_parameters" not in unpacked
+    assert "f_l0_i2h_weight" in unpacked and "f_r1_h2h_bias" in unpacked
+    packed = fused.pack_weights(unpacked)
+    np.testing.assert_allclose(packed["f_parameters"].asnumpy(), blob,
+                               rtol=1e-6)
+
+
+def test_bidirectional_cell_unroll():
+    cell = mx.rnn.BidirectionalCell(mx.rnn.LSTMCell(4, prefix="l_"),
+                                    mx.rnn.LSTMCell(4, prefix="r_"))
+    outputs, states = cell.unroll(3, inputs=mx.sym.Variable("data"),
+                                  merge_outputs=True)
+    _, outs, _ = outputs.infer_shape(data=(2, 3, 5))
+    assert outs == [(2, 3, 8)]
+    assert len(states) == 4
+
+
+def test_zoneout_and_dropout_cells():
+    cell = mx.rnn.ZoneoutCell(mx.rnn.RNNCell(4, prefix="z_"),
+                              zoneout_outputs=0.3, zoneout_states=0.2)
+    outputs, _ = cell.unroll(3, inputs=mx.sym.Variable("data"),
+                             merge_outputs=True)
+    _, outs, _ = outputs.infer_shape(data=(2, 3, 5))
+    assert outs == [(2, 3, 4)]
+
+    stack = mx.rnn.SequentialRNNCell()
+    stack.add(mx.rnn.LSTMCell(4, prefix="s0_"))
+    stack.add(mx.rnn.DropoutCell(0.5, prefix="d_"))
+    stack.add(mx.rnn.LSTMCell(4, prefix="s1_"))
+    outputs, _ = stack.unroll(3, inputs=mx.sym.Variable("data"),
+                              merge_outputs=True)
+    _, outs, _ = outputs.infer_shape(data=(2, 3, 5))
+    assert outs == [(2, 3, 4)]
+
+
+def test_encode_sentences():
+    sents = [["the", "cat", "sat"], ["the", "dog"]]
+    coded, vocab = mx.rnn.encode_sentences(sents, start_label=1)
+    assert len(coded) == 2 and coded[0][0] == coded[1][0] == vocab["the"]
+
+
+def test_bucket_sentence_iter():
+    rs = np.random.RandomState(0)
+    sentences = [list(rs.randint(1, 20, size=n))
+                 for n in rs.randint(2, 9, size=100)]
+    it = mx.rnn.BucketSentenceIter(sentences, batch_size=4, buckets=[4, 8],
+                                   invalid_label=0)
+    assert it.default_bucket_key == 8
+    seen = set()
+    for batch in it:
+        assert batch.bucket_key in (4, 8)
+        assert batch.data[0].shape == (4, batch.bucket_key)
+        d = batch.data[0].asnumpy()
+        lab = batch.label[0].asnumpy()
+        np.testing.assert_array_equal(d[:, 1:], lab[:, :-1])
+        seen.add(batch.bucket_key)
+    assert seen == {4, 8}
+
+
+def test_lstm_bucketing_end_to_end():
+    """PTB-baseline shape (SURVEY §2.9 config 3): BucketingModule +
+    Embedding + stacked LSTM + SoftmaxOutput + Perplexity, tiny scale."""
+    vocab = 16
+    rs = np.random.RandomState(3)
+    sentences = [list(rs.randint(1, vocab, size=n))
+                 for n in rs.randint(3, 9, size=64)]
+    it = mx.rnn.BucketSentenceIter(sentences, batch_size=4, buckets=[4, 8],
+                                   invalid_label=0)
+
+    def sym_gen(seq_len):
+        data = mx.sym.Variable("data")
+        label = mx.sym.Variable("softmax_label")
+        embed = mx.sym.Embedding(data, input_dim=vocab, output_dim=8,
+                                 name="embed")
+        stack = mx.rnn.SequentialRNNCell()
+        for i in range(2):
+            stack.add(mx.rnn.LSTMCell(num_hidden=8, prefix="lstm_l%d_" % i))
+        outputs, _ = stack.unroll(seq_len, inputs=embed, merge_outputs=True)
+        pred = mx.sym.Reshape(outputs, shape=(-1, 8))
+        pred = mx.sym.FullyConnected(pred, num_hidden=vocab, name="pred")
+        label_f = mx.sym.Reshape(label, shape=(-1,))
+        return mx.sym.SoftmaxOutput(pred, label_f, name="softmax"), \
+            ("data",), ("softmax_label",)
+
+    mod = mx.mod.BucketingModule(sym_gen,
+                                 default_bucket_key=it.default_bucket_key)
+    metric = mx.metric.Perplexity(invalid_label=0)
+    mod.fit(it, eval_metric=metric, num_epoch=2,
+            optimizer="sgd", optimizer_params={"learning_rate": 0.5},
+            initializer=mx.init.Xavier())
+    name, val = metric.get()
+    assert np.isfinite(val) and val < vocab * 2
+
+
+def test_bucket_iter_time_major():
+    sentences = [[1, 2, 3, 4]] * 8
+    it = mx.rnn.BucketSentenceIter(sentences, batch_size=4, buckets=[4],
+                                   invalid_label=0, layout="TNC")
+    batch = next(iter(it))
+    assert batch.data[0].shape == (4, 4)
+    assert it.provide_data[0].shape == (4, 4)
