@@ -332,19 +332,12 @@ class Executor:
         if isinstance(ctx, (list, tuple)):
             ctx = ctx[0]
         type_dict = dict(type_dict or {})
-        # __shape__ attrs on variables participate (reference Variable(shape=))
-        shapes = dict(kwargs)
+        # __shape__ attrs are consumed inside _infer_shapes_full
         for node in symbol._nodes():
-            if node.is_variable and "__shape__" in node.misc_attr \
-                    and node.name not in shapes:
-                import ast
-
-                shapes[node.name] = ast.literal_eval(
-                    node.misc_attr["__shape__"])
             if node.is_variable and "__dtype__" in node.misc_attr \
                     and node.name not in type_dict:
                 type_dict[node.name] = node.misc_attr["__dtype__"]
-        var_shape, var_dtype, _ = symbol._infer_shapes_full(shapes, type_dict)
+        var_shape, var_dtype, _ = symbol._infer_shapes_full(kwargs, type_dict)
         arg_names = symbol.list_arguments()
         aux_names = symbol.list_auxiliary_states()
         unknown = [n for n in arg_names + aux_names

@@ -10,6 +10,7 @@ Each ``get_symbol(num_classes, **kwargs)`` returns a Symbol ending in
 """
 
 from . import lenet, mlp, alexnet, vgg, resnet, inception_bn, inception_v3
+from . import ssd_vgg16
 
 _BUILDERS = {
     "lenet": lenet.get_symbol,

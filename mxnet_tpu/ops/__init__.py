@@ -16,6 +16,8 @@ from . import nn  # noqa: F401
 from . import loss  # noqa: F401
 from . import optimizer_op  # noqa: F401
 from . import rnn  # noqa: F401
+from . import spatial  # noqa: F401
+from . import contrib  # noqa: F401
 from . import infer  # noqa: F401  (attaches backward shape-inference rules)
 
 __all__ = ["registry", "OpDef", "get", "list_ops", "register"]

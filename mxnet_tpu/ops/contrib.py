@@ -1,0 +1,398 @@
+"""Contrib detection ops: SSD (MultiBoxPrior/Target/Detection) and
+Faster-RCNN Proposal.
+
+Reference kernels: ``src/operator/contrib/multibox_prior.cc``,
+``multibox_target.cc``, ``multibox_detection.cc``, ``proposal.cc``.
+
+TPU design: everything is static-shape and batched.  The reference's
+per-batch dynamic loops (greedy bipartite matching, NMS with early exits)
+become fixed-trip-count ``lax.fori_loop``s over masked dense tensors, so
+the whole loss graph (SURVEY §2.9 config 4) stays inside one XLA
+computation.  Output layouts match the reference exactly.
+
+Known reference divergence (intentional): ``multibox_target.cc:141``
+declares ``int max_iou`` so its overlap-threshold matching truncates every
+IoU to 0 and never fires; we implement the documented float semantics
+(anchor joins a GT when best-IoU > overlap_threshold).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import (REQUIRED, pbool, pfloat, pint, ptuple, register)
+
+
+def _pftuple(v):
+    """Tuple-of-floats attr parser (ptuple coerces to int)."""
+    import ast
+
+    if isinstance(v, str):
+        v = ast.literal_eval(v.strip())
+    if isinstance(v, (int, float)):
+        return (float(v),)
+    return tuple(float(x) for x in v)
+
+
+def _iou_matrix(a, b):
+    """a (A, 4), b (G, 4) corner boxes -> (A, G) IoU."""
+    ix1 = jnp.maximum(a[:, None, 0], b[None, :, 0])
+    iy1 = jnp.maximum(a[:, None, 1], b[None, :, 1])
+    ix2 = jnp.minimum(a[:, None, 2], b[None, :, 2])
+    iy2 = jnp.minimum(a[:, None, 3], b[None, :, 3])
+    inter = jnp.clip(ix2 - ix1, 0) * jnp.clip(iy2 - iy1, 0)
+    area_a = (a[:, 2] - a[:, 0]) * (a[:, 3] - a[:, 1])
+    area_b = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+    union = area_a[:, None] + area_b[None, :] - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+# ---------------------------------------------------------------------------
+# MultiBoxPrior — anchors over the feature-map grid
+# (``multibox_prior.cc:12-51``): for each cell, len(sizes) boxes at
+# ratio 1 then len(ratios)-1 boxes at sizes[0].
+# ---------------------------------------------------------------------------
+def _multibox_prior(attrs, inputs, aux, is_train, rng):
+    data = inputs[0]
+    h, w = data.shape[2], data.shape[3]
+    sizes = [float(s) for s in attrs["sizes"]]
+    ratios = [float(r) for r in attrs["ratios"]]
+    cy = (jnp.arange(h, dtype=jnp.float32) + 0.5) / h
+    cx = (jnp.arange(w, dtype=jnp.float32) + 0.5) / w
+    gy, gx = jnp.meshgrid(cy, cx, indexing="ij")  # (h, w)
+    half = []
+    for s in sizes:
+        half.append((s / 2.0, s / 2.0))
+    for r in ratios[1:]:
+        sr = float(np.sqrt(r))
+        half.append((sizes[0] * sr / 2.0, sizes[0] / sr / 2.0))
+    hw = jnp.asarray(half, jnp.float32)  # (K, 2) = (w/2, h/2)
+    boxes = jnp.stack([
+        gx[:, :, None] - hw[None, None, :, 0],
+        gy[:, :, None] - hw[None, None, :, 1],
+        gx[:, :, None] + hw[None, None, :, 0],
+        gy[:, :, None] + hw[None, None, :, 1],
+    ], axis=-1)  # (h, w, K, 4)
+    out = boxes.reshape(1, -1, 4)
+    if attrs["clip"]:
+        out = jnp.clip(out, 0.0, 1.0)
+    return [out]
+
+
+register("_contrib_MultiBoxPrior", _multibox_prior,
+         params={"sizes": (_pftuple, (1.0,)), "ratios": (_pftuple, (1.0,)),
+                 "clip": (pbool, False)},
+         aliases=("MultiBoxPrior",), hint="multiboxprior")
+
+
+# ---------------------------------------------------------------------------
+# MultiBoxTarget — anchor matching + target encoding + hard negative
+# mining (``multibox_target.cc:53-262``).
+# ---------------------------------------------------------------------------
+def _encode_loc(anchors, gt):
+    """anchors (A, 4), gt (A, 4) matched corner boxes -> (A, 4) encoded."""
+    aw = anchors[:, 2] - anchors[:, 0]
+    ah = anchors[:, 3] - anchors[:, 1]
+    ax = (anchors[:, 0] + anchors[:, 2]) * 0.5
+    ay = (anchors[:, 1] + anchors[:, 3]) * 0.5
+    gw = gt[:, 2] - gt[:, 0]
+    gh = gt[:, 3] - gt[:, 1]
+    gx = (gt[:, 0] + gt[:, 2]) * 0.5
+    gy = (gt[:, 1] + gt[:, 3]) * 0.5
+    return jnp.stack([(gx - ax) / aw, (gy - ay) / ah,
+                      jnp.log(jnp.maximum(gw / aw, 1e-12)),
+                      jnp.log(jnp.maximum(gh / ah, 1e-12))], axis=1)
+
+
+def _multibox_target(attrs, inputs, aux, is_train, rng):
+    anchors, labels, cls_preds = inputs
+    anchors = anchors.reshape(-1, 4)
+    A = anchors.shape[0]
+    thresh = attrs["overlap_threshold"]
+    ignore = attrs["ignore_label"]
+    mine_ratio = attrs["negative_mining_ratio"]
+    mine_thresh = attrs["negative_mining_thresh"]
+    min_neg = attrs["minimum_negative_samples"]
+    var = attrs["variances"]
+
+    def one_batch(label, cls_pred):
+        # label (G, 5) [cls, x1, y1, x2, y2], padded with -1 rows
+        G = label.shape[0]
+        valid = label[:, 0] >= 0  # (G,)
+        iou = _iou_matrix(anchors, label[:, 1:5])  # (A, G)
+        iou = jnp.where(valid[None, :], iou, 0.0)
+
+        # --- greedy bipartite matching (one anchor per GT, descending IoU)
+        def bi_step(state, _):
+            matched_gt, anchor_pos, gt_done = state
+            m = jnp.where(anchor_pos[:, None] | gt_done[None, :],
+                          -1.0, iou)
+            flat = jnp.argmax(m)
+            aj, gk = flat // G, flat % G
+            ok = m[aj, gk] > 1e-6
+            matched_gt = jnp.where(ok & (jnp.arange(A) == aj), gk,
+                                   matched_gt)
+            anchor_pos = anchor_pos | (ok & (jnp.arange(A) == aj))
+            gt_done = gt_done | (ok & (jnp.arange(G) == gk))
+            return (matched_gt, anchor_pos, gt_done), None
+
+        init = (jnp.full((A,), -1, jnp.int32),
+                jnp.zeros((A,), bool), ~valid)
+        (matched_gt, anchor_pos, _), _ = jax.lax.scan(
+            bi_step, init, None, length=G)
+
+        # --- threshold matching for the rest (float semantics; see module
+        # docstring for the reference's int-truncation divergence)
+        best_gt = jnp.argmax(iou, axis=1).astype(jnp.int32)  # (A,)
+        best_iou = jnp.max(iou, axis=1)
+        has_gt = jnp.any(valid)
+        thr_pos = (~anchor_pos) & (best_iou > thresh) & (thresh > 0) & has_gt
+        matched_gt = jnp.where(anchor_pos, matched_gt,
+                               jnp.where(thr_pos, best_gt, -1))
+        pos = anchor_pos | thr_pos
+        num_pos = pos.sum()
+
+        # --- negatives: mining by best non-background softmax prob, or all
+        if mine_ratio > 0:
+            # cls_pred (num_classes, A) raw scores -> prob of best fg class
+            logits = cls_pred.T  # (A, C)
+            prob = jax.nn.softmax(logits, axis=-1)
+            fg_score = jnp.max(prob[:, 1:], axis=-1)
+            cand = (~pos) & (best_iou < mine_thresh) & has_gt
+            num_neg = jnp.minimum(
+                jnp.maximum((num_pos * mine_ratio).astype(jnp.int32),
+                            min_neg), (cand.sum()).astype(jnp.int32))
+            score = jnp.where(cand, fg_score, -jnp.inf)
+            order = jnp.argsort(-score)
+            rank = jnp.zeros((A,), jnp.int32).at[order].set(jnp.arange(A))
+            neg = cand & (rank < num_neg)
+        else:
+            neg = (~pos) & has_gt
+        # no-GT batches: everything background (reference zero-fills)
+        neg = jnp.where(has_gt, neg, True)
+
+        safe_gt = jnp.clip(matched_gt, 0, G - 1)
+        gt_cls = label[safe_gt, 0]
+        cls_target = jnp.where(
+            pos, gt_cls + 1.0,
+            jnp.where(neg, 0.0, ignore))
+        loc = _encode_loc(anchors, label[safe_gt, 1:5])
+        loc = loc / jnp.asarray(var, loc.dtype)[None, :]
+        mask4 = jnp.repeat(pos, 4).astype(loc.dtype)
+        loc_target = (loc.reshape(-1) * mask4)
+        return loc_target, mask4, cls_target
+
+    loc_t, loc_m, cls_t = jax.vmap(one_batch)(labels, cls_preds)
+    return [loc_t, loc_m, cls_t]
+
+
+register("_contrib_MultiBoxTarget", _multibox_target,
+         arguments=("anchor", "label", "cls_pred"),
+         outputs=("loc_target", "loc_mask", "cls_target"),
+         params={"overlap_threshold": (pfloat, 0.5),
+                 "ignore_label": (pfloat, -1.0),
+                 "negative_mining_ratio": (pfloat, -1.0),
+                 "negative_mining_thresh": (pfloat, 0.5),
+                 "minimum_negative_samples": (pint, 0),
+                 "variances": (_pftuple, (0.1, 0.1, 0.2, 0.2))},
+         aliases=("MultiBoxTarget",), hint="multiboxtarget")
+
+
+# ---------------------------------------------------------------------------
+# MultiBoxDetection — decode + NMS (``multibox_detection.cc:27-143``).
+# Output (B, A, 6) rows [cls_id, score, x1, y1, x2, y2]; suppressed or
+# invalid rows have cls_id = -1.
+# ---------------------------------------------------------------------------
+def _decode_boxes(anchors, loc_pred, var, clip):
+    aw = anchors[:, 2] - anchors[:, 0]
+    ah = anchors[:, 3] - anchors[:, 1]
+    ax = (anchors[:, 0] + anchors[:, 2]) * 0.5
+    ay = (anchors[:, 1] + anchors[:, 3]) * 0.5
+    p = loc_pred.reshape(-1, 4)
+    ox = p[:, 0] * var[0] * aw + ax
+    oy = p[:, 1] * var[1] * ah + ay
+    ow = jnp.exp(p[:, 2] * var[2]) * aw * 0.5
+    oh = jnp.exp(p[:, 3] * var[3]) * ah * 0.5
+    out = jnp.stack([ox - ow, oy - oh, ox + ow, oy + oh], axis=1)
+    if clip:
+        out = jnp.clip(out, 0.0, 1.0)
+    return out
+
+
+def _greedy_nms(boxes, cls_id, order, nms_thresh, force):
+    """Greedy NMS over boxes visited in `order`; returns keep mask."""
+    A = boxes.shape[0]
+    iou = _iou_matrix(boxes, boxes)
+    pos = jnp.zeros((A,), jnp.int32).at[order].set(jnp.arange(A))
+
+    def body(i, keep):
+        j = order[i]
+        alive = keep[j] & (cls_id[j] >= 0)
+        sup = (iou[j] >= nms_thresh) & (pos > i) & \
+            (force | (cls_id == cls_id[j])) & (cls_id >= 0)
+        return jnp.where(alive & sup, False, keep)
+
+    return jax.lax.fori_loop(0, A, body, jnp.ones((A,), bool))
+
+
+def _multibox_detection(attrs, inputs, aux, is_train, rng):
+    cls_prob, loc_pred, anchors = inputs
+    anchors = anchors.reshape(-1, 4)
+    var = attrs["variances"]
+    thr = attrs["threshold"]
+    nms_thresh = attrs["nms_threshold"]
+    force = attrs["force_suppress"]
+    topk = attrs["nms_topk"]
+
+    def one_batch(probs, locs):
+        # probs (C, A): class 0 is background
+        score = jnp.max(probs[1:], axis=0)
+        cid = jnp.argmax(probs[1:], axis=0).astype(jnp.float32)
+        keep = score >= thr
+        cid = jnp.where(keep, cid, -1.0)
+        boxes = _decode_boxes(anchors, locs, var, attrs["clip"])
+        order = jnp.argsort(-jnp.where(keep, score, -jnp.inf))
+        if 0 < nms_thresh <= 1:
+            kmask = _greedy_nms(boxes, cid, order, nms_thresh, force)
+            cid = jnp.where(kmask, cid, -1.0)
+        if topk > 0:
+            rank = jnp.zeros_like(order).at[order].set(
+                jnp.arange(order.shape[0]))
+            cid = jnp.where(rank < topk, cid, -1.0)
+        rows = jnp.concatenate(
+            [cid[:, None], score[:, None], boxes], axis=1)
+        # sort output rows by score desc like the reference
+        return rows[order]
+
+    return [jax.vmap(one_batch)(cls_prob, loc_pred)]
+
+
+register("_contrib_MultiBoxDetection", _multibox_detection,
+         arguments=("cls_prob", "loc_pred", "anchor"),
+         params={"clip": (pbool, True), "threshold": (pfloat, 0.01),
+                 "background_id": (pint, 0),
+                 "nms_threshold": (pfloat, 0.5),
+                 "force_suppress": (pbool, False),
+                 "variances": (_pftuple, (0.1, 0.1, 0.2, 0.2)),
+                 "nms_topk": (pint, -1)},
+         aliases=("MultiBoxDetection",), hint="multiboxdetection")
+
+
+# ---------------------------------------------------------------------------
+# Proposal — Faster-RCNN RPN proposals (``proposal.cc``): anchors at
+# feature_stride, bbox-delta decode, clip to image, min-size filter,
+# pre-NMS top-N, greedy NMS, post-NMS top-N rois.
+# ---------------------------------------------------------------------------
+def _gen_base_anchors(base_size, scales, ratios):
+    """Standard RPN base anchors around (0,0,base-1,base-1)."""
+    out = []
+    w = h = float(base_size)
+    cx = (w - 1) * 0.5
+    cy = (h - 1) * 0.5
+    size = w * h
+    for r in ratios:
+        ws = round(np.sqrt(size / r))
+        hs = round(ws * r)
+        for s in scales:
+            wss, hss = ws * s, hs * s
+            out.append([cx - 0.5 * (wss - 1), cy - 0.5 * (hss - 1),
+                        cx + 0.5 * (wss - 1), cy + 0.5 * (hss - 1)])
+    return np.asarray(out, np.float32)
+
+
+def _proposal(attrs, inputs, aux, is_train, rng):
+    cls_prob, bbox_pred, im_info = inputs
+    B, _, H, W = cls_prob.shape
+    stride = attrs["feature_stride"]
+    scales = attrs["scales"]
+    ratios = attrs["ratios"]
+    pre_n = attrs["rpn_pre_nms_top_n"]
+    post_n = attrs["rpn_post_nms_top_n"]
+    nms_thresh = attrs["threshold"]
+    min_size = attrs["rpn_min_size"]
+
+    base = _gen_base_anchors(stride, scales, ratios)  # (K, 4)
+    K = base.shape[0]
+    sy = jnp.arange(H, dtype=jnp.float32) * stride
+    sx = jnp.arange(W, dtype=jnp.float32) * stride
+    gy, gx = jnp.meshgrid(sy, sx, indexing="ij")
+    shift = jnp.stack([gx, gy, gx, gy], axis=-1)  # (H, W, 4)
+    anchors = (shift[:, :, None, :] + base[None, None]) \
+        .reshape(-1, 4)  # (H*W*K, 4)
+    A = anchors.shape[0]
+
+    def one_batch(probs, deltas, info):
+        # probs (2K, H, W): first K background, last K foreground
+        fg = probs[K:].transpose(1, 2, 0).reshape(-1)  # (H*W*K,)
+        d = deltas.reshape(K, 4, H, W).transpose(2, 3, 0, 1).reshape(-1, 4)
+        # decode (x1y1x2y2 with +1 widths like the reference)
+        aw = anchors[:, 2] - anchors[:, 0] + 1.0
+        ah = anchors[:, 3] - anchors[:, 1] + 1.0
+        ax = anchors[:, 0] + aw * 0.5
+        ay = anchors[:, 1] + ah * 0.5
+        cx = d[:, 0] * aw + ax
+        cy = d[:, 1] * ah + ay
+        w = jnp.exp(jnp.clip(d[:, 2], -10, 10)) * aw
+        h = jnp.exp(jnp.clip(d[:, 3], -10, 10)) * ah
+        boxes = jnp.stack([cx - 0.5 * w, cy - 0.5 * h,
+                           cx + 0.5 * w - 1.0, cy + 0.5 * h - 1.0], axis=1)
+        # clip to image
+        imh, imw = info[0], info[1]
+        boxes = jnp.stack([
+            jnp.clip(boxes[:, 0], 0, imw - 1.0),
+            jnp.clip(boxes[:, 1], 0, imh - 1.0),
+            jnp.clip(boxes[:, 2], 0, imw - 1.0),
+            jnp.clip(boxes[:, 3], 0, imh - 1.0)], axis=1)
+        ms = min_size * info[2]
+        ok = ((boxes[:, 2] - boxes[:, 0] + 1.0) >= ms) & \
+             ((boxes[:, 3] - boxes[:, 1] + 1.0) >= ms)
+        score = jnp.where(ok, fg, -jnp.inf)
+        n_pre = min(pre_n, A) if pre_n > 0 else A
+        top_score, top_idx = jax.lax.top_k(score, n_pre)
+        top_boxes = boxes[top_idx]
+        cls0 = jnp.where(jnp.isfinite(top_score), 0.0, -1.0)
+        kmask = _greedy_nms(top_boxes, cls0, jnp.arange(n_pre),
+                            nms_thresh, True)
+        kmask = kmask & jnp.isfinite(top_score)
+        # compact the kept rows to the front (gather-only — stable argsort
+        # on a kept-first key; scatters here trip TPU fusion)
+        pos = jnp.arange(n_pre)
+        key = jnp.where(kmask, pos, n_pre + pos)
+        sel = jnp.argsort(key)[:post_n] if n_pre >= post_n else \
+            jnp.concatenate([jnp.argsort(key),
+                             jnp.zeros((post_n - n_pre,), jnp.int32)])
+        out_boxes = top_boxes[sel]
+        out_score = jnp.where(jnp.isfinite(top_score[sel]),
+                              top_score[sel], 0.0)
+        # pad rows repeat the first proposal (reference pads with samples)
+        filled = jnp.arange(post_n) < kmask.sum()
+        out_boxes = jnp.where(filled[:, None], out_boxes, out_boxes[0])
+        out_score = jnp.where(filled, out_score, out_score[0])
+        return out_boxes, out_score
+
+    boxes, scores = jax.vmap(one_batch)(cls_prob, bbox_pred, im_info)
+    bidx = jnp.broadcast_to(
+        jnp.arange(B, dtype=boxes.dtype)[:, None], (B, post_n))
+    rois = jnp.concatenate([bidx[..., None], boxes], axis=-1) \
+        .reshape(B * post_n, 5)
+    outs = [rois]
+    if attrs["output_score"]:
+        outs.append(scores.reshape(B * post_n, 1))
+    return outs
+
+
+register("_contrib_Proposal", _proposal,
+         arguments=("cls_prob", "bbox_pred", "im_info"),
+         outputs=lambda a: (["output", "score"] if a["output_score"]
+                            else ["output"]),
+         params={"rpn_pre_nms_top_n": (pint, 6000),
+                 "rpn_post_nms_top_n": (pint, 300),
+                 "threshold": (pfloat, 0.7), "rpn_min_size": (pint, 16),
+                 "scales": (_pftuple, (4.0, 8.0, 16.0, 32.0)),
+                 "ratios": (_pftuple, (0.5, 1.0, 2.0)),
+                 "feature_stride": (pint, 16),
+                 "output_score": (pbool, False),
+                 "iou_loss": (pbool, False)},
+         aliases=("Proposal",), hint="proposal")

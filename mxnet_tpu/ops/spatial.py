@@ -1,0 +1,275 @@
+"""Spatial / warping ops.
+
+Reference kernels: ``src/operator/roi_pooling-inl.h``,
+``bilinear_sampler-inl.h`` (+cudnn), ``spatial_transformer-inl.h`` (+cudnn),
+``grid_generator-inl.h``, ``correlation-inl.h``, ``crop-inl.h``.
+
+TPU design: all of these become dense gather/where/conv compositions with
+static shapes — no per-ROI dynamic loops.  ROIPooling turns the dynamic
+bin extents into bin×pixel membership masks contracted on the MXU;
+Correlation enumerates its (static) displacement grid as shifted
+elementwise products reduced per patch.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..base import MXNetError
+from .registry import REQUIRED, pbool, pfloat, pint, pstr, ptuple, register
+
+
+# ---------------------------------------------------------------------------
+# ROIPooling — reference ``roi_pooling-inl.h`` (Fast-RCNN max pooling over
+# regions).  rois: (R, 5) = [batch_idx, x1, y1, x2, y2] in image coords.
+# ---------------------------------------------------------------------------
+def _roi_pooling(attrs, inputs, aux, is_train, rng):
+    data, rois = inputs
+    ph, pw = attrs["pooled_size"]
+    scale = attrs["spatial_scale"]
+    B, C, H, W = data.shape
+
+    def one_roi(roi):
+        bidx = roi[0].astype(jnp.int32)
+        # C round() semantics (half away from zero), not jnp.round's
+        # half-to-even — half-integer coords are routine with 2^-k scales
+        _round = lambda v: jnp.floor(v + 0.5)  # noqa: E731
+        x1 = _round(roi[1] * scale)
+        y1 = _round(roi[2] * scale)
+        x2 = _round(roi[3] * scale)
+        y2 = _round(roi[4] * scale)
+        rh = jnp.maximum(y2 - y1 + 1.0, 1.0)
+        rw = jnp.maximum(x2 - x1 + 1.0, 1.0)
+        bin_h = rh / ph
+        bin_w = rw / pw
+        img = data[bidx]  # (C, H, W)
+
+        iy = jnp.arange(ph, dtype=data.dtype)
+        ix = jnp.arange(pw, dtype=data.dtype)
+        hstart = jnp.clip(jnp.floor(iy * bin_h) + y1, 0, H)
+        hend = jnp.clip(jnp.ceil((iy + 1.0) * bin_h) + y1, 0, H)
+        wstart = jnp.clip(jnp.floor(ix * bin_w) + x1, 0, W)
+        wend = jnp.clip(jnp.ceil((ix + 1.0) * bin_w) + x1, 0, W)
+        rows = jnp.arange(H, dtype=data.dtype)
+        cols = jnp.arange(W, dtype=data.dtype)
+        # (ph, H) / (pw, W) membership masks
+        rmask = (rows[None, :] >= hstart[:, None]) & \
+                (rows[None, :] < hend[:, None])
+        cmask = (cols[None, :] >= wstart[:, None]) & \
+                (cols[None, :] < wend[:, None])
+        # (ph, pw, H, W) -> masked max per bin
+        m = rmask[:, None, :, None] & cmask[None, :, None, :]
+        neg = jnp.asarray(-np.inf, data.dtype)
+        vals = jnp.where(m[None], img[:, None, None, :, :], neg)
+        out = jnp.max(vals, axis=(3, 4))
+        # empty bins (hstart>=hend) -> 0 like the reference
+        return jnp.where(jnp.isfinite(out), out, 0.0)
+
+    return [jax.vmap(one_roi)(rois)]
+
+
+register("ROIPooling", _roi_pooling, arguments=("data", "rois"),
+         params={"pooled_size": (ptuple, REQUIRED),
+                 "spatial_scale": (pfloat, REQUIRED)},
+         hint="roipooling")
+
+
+# ---------------------------------------------------------------------------
+# BilinearSampler — reference ``bilinear_sampler-inl.h``; grid in [-1, 1],
+# grid shape (B, 2, Ho, Wo) with channel 0 = x, 1 = y.
+# ---------------------------------------------------------------------------
+def _bilinear_sample(img, gx, gy):
+    """img (C, H, W); gx, gy (Ho, Wo) in [-1, 1] -> (C, Ho, Wo).
+    Out-of-boundary reads contribute 0 (reference pads with zeros)."""
+    C, H, W = img.shape
+    x = (gx + 1.0) * (W - 1) / 2.0
+    y = (gy + 1.0) * (H - 1) / 2.0
+    x0 = jnp.floor(x)
+    y0 = jnp.floor(y)
+    dx = x - x0
+    dy = y - y0
+
+    def gather(yy, xx):
+        inb = (yy >= 0) & (yy < H) & (xx >= 0) & (xx < W)
+        yc = jnp.clip(yy, 0, H - 1).astype(jnp.int32)
+        xc = jnp.clip(xx, 0, W - 1).astype(jnp.int32)
+        v = img[:, yc, xc]  # (C, Ho, Wo)
+        return jnp.where(inb[None], v, 0.0)
+
+    v00 = gather(y0, x0)
+    v01 = gather(y0, x0 + 1)
+    v10 = gather(y0 + 1, x0)
+    v11 = gather(y0 + 1, x0 + 1)
+    w00 = ((1 - dy) * (1 - dx))[None]
+    w01 = ((1 - dy) * dx)[None]
+    w10 = (dy * (1 - dx))[None]
+    w11 = (dy * dx)[None]
+    return v00 * w00 + v01 * w01 + v10 * w10 + v11 * w11
+
+
+def _bilinear_sampler(attrs, inputs, aux, is_train, rng):
+    data, grid = inputs
+
+    def one(img, g):
+        return _bilinear_sample(img, g[0], g[1])
+
+    return [jax.vmap(one)(data, grid)]
+
+
+register("BilinearSampler", _bilinear_sampler, arguments=("data", "grid"),
+         params={}, hint="bilinearsampler")
+
+
+# ---------------------------------------------------------------------------
+# GridGenerator — reference ``grid_generator-inl.h``: 'affine' (6-param
+# theta -> sampling grid) or 'warp' (optical flow -> grid).
+# ---------------------------------------------------------------------------
+def _identity_grid(h, w, dtype):
+    """(2, h, w) normalized target coords (x, y) in [-1, 1]."""
+    ys = jnp.linspace(-1.0, 1.0, h, dtype=dtype)
+    xs = jnp.linspace(-1.0, 1.0, w, dtype=dtype)
+    gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+    return gx, gy
+
+
+def _grid_generator(attrs, inputs, aux, is_train, rng):
+    data = inputs[0]
+    tt = attrs["transform_type"]
+    if tt == "affine":
+        h, w = attrs["target_shape"]
+        if h <= 0 or w <= 0:
+            raise MXNetError("GridGenerator: target_shape must be set for "
+                             "affine mode (got %r)" % (attrs["target_shape"],))
+        theta = data.reshape(data.shape[0], 2, 3)
+        gx, gy = _identity_grid(h, w, data.dtype)
+        ones = jnp.ones_like(gx)
+        coords = jnp.stack([gx, gy, ones], axis=0).reshape(3, -1)
+        out = jnp.einsum("bij,jk->bik", theta, coords)
+        return [out.reshape(data.shape[0], 2, h, w)]
+    if tt == "warp":
+        # data = flow (B, 2, H, W) in pixels; grid = identity + normalized flow
+        B, _, H, W = data.shape
+        gx, gy = _identity_grid(H, W, data.dtype)
+        fx = data[:, 0] * 2.0 / max(W - 1, 1)
+        fy = data[:, 1] * 2.0 / max(H - 1, 1)
+        return [jnp.stack([gx[None] + fx, gy[None] + fy], axis=1)]
+    raise MXNetError("GridGenerator: bad transform_type %r" % tt)
+
+
+register("GridGenerator", _grid_generator,
+         params={"transform_type": (pstr, REQUIRED),
+                 "target_shape": (ptuple, (0, 0))},
+         hint="gridgenerator")
+
+
+# ---------------------------------------------------------------------------
+# SpatialTransformer — reference ``spatial_transformer-inl.h``: localization
+# output -> affine grid -> bilinear sampling, in one op.
+# ---------------------------------------------------------------------------
+def _spatial_transformer(attrs, inputs, aux, is_train, rng):
+    data, loc = inputs
+    if attrs["transform_type"] != "affine":
+        raise MXNetError("SpatialTransformer: only 'affine' supported")
+    if attrs["sampler_type"] != "bilinear":
+        raise MXNetError("SpatialTransformer: only 'bilinear' supported")
+    h, w = attrs["target_shape"]
+    if h <= 0 or w <= 0:
+        raise MXNetError("SpatialTransformer: target_shape must be set "
+                         "(got %r)" % (attrs["target_shape"],))
+    theta = loc.reshape(loc.shape[0], 2, 3)
+    gx, gy = _identity_grid(h, w, data.dtype)
+    ones = jnp.ones_like(gx)
+    coords = jnp.stack([gx, gy, ones], axis=0).reshape(3, -1)
+    grid = jnp.einsum("bij,jk->bik", theta, coords).reshape(
+        loc.shape[0], 2, h, w)
+
+    def one(img, g):
+        return _bilinear_sample(img, g[0], g[1])
+
+    return [jax.vmap(one)(data, grid)]
+
+
+register("SpatialTransformer", _spatial_transformer,
+         arguments=("data", "loc"),
+         params={"target_shape": (ptuple, (0, 0)),
+                 "transform_type": (pstr, "affine"),
+                 "sampler_type": (pstr, "bilinear")},
+         hint="spatialtransformer")
+
+
+# ---------------------------------------------------------------------------
+# Correlation — reference ``correlation-inl.h`` (FlowNet).  The displacement
+# grid is static, so each displacement is a shifted elementwise product
+# reduced over the kernel patch — XLA fuses the whole stack.
+# ---------------------------------------------------------------------------
+def _correlation(attrs, inputs, aux, is_train, rng):
+    d1, d2 = inputs
+    k = attrs["kernel_size"]
+    md = attrs["max_displacement"]
+    s1 = attrs["stride1"]
+    s2 = attrs["stride2"]
+    pad = attrs["pad_size"]
+    B, C, H, W = d1.shape
+    pd1 = jnp.pad(d1, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    pd2 = jnp.pad(d2, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    Hp, Wp = H + 2 * pad, W + 2 * pad
+    border = md + (k - 1) // 2
+    out_h = int(np.ceil((Hp - 2 * border) / float(s1)))
+    out_w = int(np.ceil((Wp - 2 * border) / float(s1)))
+    d_range = (2 * md // s2) + 1
+    kr = (k - 1) // 2
+
+    rows = border + jnp.arange(out_h) * s1
+    cols = border + jnp.arange(out_w) * s1
+    maps = []
+    for dy in range(-md, md + 1, s2):
+        for dx in range(-md, md + 1, s2):
+            if attrs["is_multiply"]:
+                prod = pd1 * jnp.roll(pd2, (-dy, -dx), axis=(2, 3))
+            else:
+                prod = jnp.abs(pd1 - jnp.roll(pd2, (-dy, -dx), axis=(2, 3)))
+            # sum over kernel patch: box filter via reduce_window
+            if k > 1:
+                prod = jax.lax.reduce_window(
+                    prod, 0.0, jax.lax.add, (1, 1, k, k), (1, 1, 1, 1),
+                    [(0, 0), (0, 0), (kr, kr), (kr, kr)])
+            m = prod.sum(axis=1)  # (B, Hp, Wp)
+            maps.append(m[:, rows[:, None], cols[None, :]])
+    out = jnp.stack(maps, axis=1) / float(k * k * C)
+    assert out.shape[1] == d_range * d_range
+    return [out]
+
+
+register("Correlation", _correlation, arguments=("data1", "data2"),
+         params={"kernel_size": (pint, 1), "max_displacement": (pint, 1),
+                 "stride1": (pint, 1), "stride2": (pint, 1),
+                 "pad_size": (pint, 0), "is_multiply": (pbool, True)},
+         hint="correlation")
+
+
+# ---------------------------------------------------------------------------
+# Crop — reference ``crop-inl.h``: crop spatial dims to h_w (or to the
+# second input's spatial dims), at offset or centered.
+# ---------------------------------------------------------------------------
+def _crop(attrs, inputs, aux, is_train, rng):
+    data = inputs[0]
+    if attrs["num_args"] == 2:
+        ch, cw = inputs[1].shape[2], inputs[1].shape[3]
+    else:
+        ch, cw = attrs["h_w"]
+    if attrs["center_crop"]:
+        oy = (data.shape[2] - ch) // 2
+        ox = (data.shape[3] - cw) // 2
+    else:
+        oy, ox = attrs["offset"]
+    return [data[:, :, oy:oy + ch, ox:ox + cw]]
+
+
+register("Crop", _crop,
+         arguments=lambda a: ["data", "crop_like"] if a["num_args"] == 2
+         else ["data"],
+         params={"num_args": (pint, 1), "offset": (ptuple, (0, 0)),
+                 "h_w": (ptuple, (0, 0)), "center_crop": (pbool, False)},
+         key_var_num_args="num_args", hint="crop_op")

@@ -21,6 +21,7 @@ the op node, like nnvm does — auto-created as variables at composition time
 
 from __future__ import annotations
 
+import ast
 import json
 import sys
 
@@ -199,6 +200,8 @@ class Symbol:
         var_dtype = {}
         for n in args + auxs:
             s = shape_kwargs.get(n.name)
+            if s is None and "__shape__" in n.misc_attr:
+                s = ast.literal_eval(n.misc_attr["__shape__"])
             var_shape[n.name] = tuple(s) if s is not None else None
             var_dtype[n.name] = type_kwargs.get(n.name)
         entry_aval = {}

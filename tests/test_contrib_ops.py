@@ -1,0 +1,230 @@
+"""Spatial + contrib detection op tests (reference test_operator.py style:
+numpy reference implementations checked against the op outputs)."""
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def test_multibox_prior_matches_reference_formula():
+    """multibox_prior.cc:12-51: per cell — len(sizes) boxes at ratio 1,
+    then len(ratios)-1 boxes at sizes[0]."""
+    h, w = 2, 3
+    sizes = (0.4, 0.2)
+    ratios = (1.0, 2.0, 0.5)
+    out = mx.nd._contrib_MultiBoxPrior(
+        mx.nd.zeros((1, 3, h, w)), sizes=sizes, ratios=ratios).asnumpy()
+    k = len(sizes) + len(ratios) - 1
+    assert out.shape == (1, h * w * k, 4)
+    ref = []
+    for r in range(h):
+        cy = (r + 0.5) / h
+        for c in range(w):
+            cx = (c + 0.5) / w
+            for s in sizes:
+                ref.append([cx - s / 2, cy - s / 2, cx + s / 2, cy + s / 2])
+            for rt in ratios[1:]:
+                sr = np.sqrt(rt)
+                wd, ht = sizes[0] * sr / 2, sizes[0] / sr / 2
+                ref.append([cx - wd, cy - ht, cx + wd, cy + ht])
+    np.testing.assert_allclose(out[0], np.array(ref, np.float32),
+                               rtol=1e-5, atol=1e-6)
+
+
+def _one_anchor_setup():
+    anchors = np.array([[0.1, 0.1, 0.4, 0.4],
+                        [0.5, 0.5, 0.9, 0.9],
+                        [0.0, 0.0, 0.2, 0.2]], np.float32)[None]
+    # GT matches anchor 0 exactly; padded rows are -1
+    labels = np.array([[[1, 0.1, 0.1, 0.4, 0.4],
+                        [-1, -1, -1, -1, -1]]], np.float32)
+    cls_preds = np.zeros((1, 3, 3), np.float32)
+    return anchors, labels, cls_preds
+
+
+def test_multibox_target_matching_and_encoding():
+    anchors, labels, cls_preds = _one_anchor_setup()
+    lt, lm, ct = mx.nd._contrib_MultiBoxTarget(
+        mx.nd.array(anchors), mx.nd.array(labels), mx.nd.array(cls_preds))
+    ct = ct.asnumpy()[0]
+    lm = lm.asnumpy()[0].reshape(-1, 4)
+    lt = lt.asnumpy()[0].reshape(-1, 4)
+    assert ct[0] == 2.0  # gt class 1 -> target 2 (0 is background)
+    assert ct[1] == 0.0 and ct[2] == 0.0  # negatives (no mining -> all neg)
+    assert lm[0].all() and not lm[1].any()
+    np.testing.assert_allclose(lt[0], 0.0, atol=1e-5)  # perfect match
+
+
+def test_multibox_target_negative_mining_counts():
+    anchors, labels, cls_preds = _one_anchor_setup()
+    # make anchor-2 the most confidently-wrong negative
+    cls_preds[0, 1, 2] = 5.0
+    lt, lm, ct = mx.nd._contrib_MultiBoxTarget(
+        mx.nd.array(anchors), mx.nd.array(labels), mx.nd.array(cls_preds),
+        negative_mining_ratio=1.0, negative_mining_thresh=0.5)
+    ct = ct.asnumpy()[0]
+    assert ct[0] == 2.0
+    # 1 positive * ratio 1.0 -> exactly one mined negative: the loud one
+    assert ct[2] == 0.0
+    assert ct[1] == -1.0  # ignored
+
+
+def test_multibox_target_no_gt_all_background():
+    anchors = np.array([[[0.1, 0.1, 0.4, 0.4]]], np.float32)
+    labels = -np.ones((1, 2, 5), np.float32)
+    cls_preds = np.zeros((1, 2, 1), np.float32)
+    lt, lm, ct = mx.nd._contrib_MultiBoxTarget(
+        mx.nd.array(anchors), mx.nd.array(labels), mx.nd.array(cls_preds))
+    assert ct.asnumpy()[0, 0] == 0.0
+    assert not lm.asnumpy().any()
+
+
+def test_multibox_detection_decode_and_nms():
+    anchors = np.array([[0.1, 0.1, 0.4, 0.4],
+                        [0.11, 0.11, 0.41, 0.41],
+                        [0.5, 0.5, 0.9, 0.9]], np.float32)[None]
+    # class 1 confident on anchors 0, 1 (overlapping); class 2 on anchor 2
+    cls_prob = np.array([[[0.1, 0.2, 0.1],
+                          [0.8, 0.7, 0.1],
+                          [0.1, 0.1, 0.8]]], np.float32)
+    loc_pred = np.zeros((1, 12), np.float32)
+    out = mx.nd._contrib_MultiBoxDetection(
+        mx.nd.array(cls_prob), mx.nd.array(loc_pred), mx.nd.array(anchors),
+        nms_threshold=0.5).asnumpy()[0]
+    assert out.shape == (3, 6)
+    # rows sorted by score desc: anchor0 (0.8 cls0), anchor2 (0.8 cls1),
+    # anchor1 suppressed by NMS
+    kept = out[out[:, 0] >= 0]
+    assert len(kept) == 2
+    assert set(kept[:, 0]) == {0.0, 1.0}
+    np.testing.assert_allclose(kept[0, 2:], anchors[0][0], atol=1e-5)
+
+
+def test_multibox_detection_decode_formula():
+    anchors = np.array([[0.2, 0.2, 0.6, 0.8]], np.float32)[None]
+    cls_prob = np.array([[[0.1], [0.9]]], np.float32)
+    loc = np.array([[1.0, -0.5, 0.2, 0.1]], np.float32)
+    var = (0.1, 0.1, 0.2, 0.2)
+    out = mx.nd._contrib_MultiBoxDetection(
+        mx.nd.array(cls_prob), mx.nd.array(loc.reshape(1, -1)),
+        mx.nd.array(anchors), clip=False).asnumpy()[0, 0]
+    aw, ah = 0.4, 0.6
+    ax, ay = 0.4, 0.5
+    ox = loc[0, 0] * var[0] * aw + ax
+    oy = loc[0, 1] * var[1] * ah + ay
+    ow = np.exp(loc[0, 2] * var[2]) * aw / 2
+    oh = np.exp(loc[0, 3] * var[3]) * ah / 2
+    np.testing.assert_allclose(out[2:], [ox - ow, oy - oh, ox + ow, oy + oh],
+                               rtol=1e-5)
+
+
+def test_proposal_shapes_and_bounds():
+    K = 12  # 3 ratios x 4 scales
+    H, W = 4, 5
+    rs = np.random.RandomState(0)
+    cp = rs.uniform(size=(2, 2 * K, H, W)).astype(np.float32)
+    bp = (rs.randn(2, 4 * K, H, W) * 0.1).astype(np.float32)
+    info = np.array([[64, 80, 1.0], [64, 80, 1.0]], np.float32)
+    rois = mx.nd._contrib_Proposal(
+        mx.nd.array(cp), mx.nd.array(bp), mx.nd.array(info),
+        rpn_pre_nms_top_n=60, rpn_post_nms_top_n=8).asnumpy()
+    assert rois.shape == (16, 5)
+    assert set(rois[:, 0]) == {0.0, 1.0}
+    assert (rois[:, 1] >= 0).all() and (rois[:, 3] <= 79).all()
+    assert (rois[:, 2] >= 0).all() and (rois[:, 4] <= 63).all()
+
+
+def test_roi_pooling_vs_numpy():
+    rs = np.random.RandomState(1)
+    data = rs.randn(1, 2, 6, 6).astype(np.float32)
+    rois = np.array([[0, 0, 0, 11, 11], [0, 4, 4, 11, 11]], np.float32)
+    out = mx.nd.ROIPooling(mx.nd.array(data), mx.nd.array(rois),
+                           pooled_size=(2, 2), spatial_scale=0.5).asnumpy()
+    assert out.shape == (2, 2, 2, 2)
+
+    def ref_roi(img, roi):
+        x1, y1, x2, y2 = [int(round(v * 0.5)) for v in roi[1:]]
+        rh, rw = max(y2 - y1 + 1, 1), max(x2 - x1 + 1, 1)
+        res = np.zeros((img.shape[0], 2, 2), np.float32)
+        for i in range(2):
+            for j in range(2):
+                hs = int(np.floor(i * rh / 2.0)) + y1
+                he = int(np.ceil((i + 1) * rh / 2.0)) + y1
+                ws = int(np.floor(j * rw / 2.0)) + x1
+                we = int(np.ceil((j + 1) * rw / 2.0)) + x1
+                hs, he = max(hs, 0), min(he, 6)
+                ws, we = max(ws, 0), min(we, 6)
+                if he > hs and we > ws:
+                    res[:, i, j] = img[:, hs:he, ws:we].max(axis=(1, 2))
+        return res
+
+    for r in range(2):
+        np.testing.assert_allclose(out[r], ref_roi(data[0], rois[r]),
+                                   rtol=1e-5)
+
+
+def test_bilinear_sampler_shift():
+    data = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    # shift sampling one pixel right: x_src = x_dst + 1
+    xs = (np.arange(4) + 0.5 * 0) / 1.0
+    gx, gy = np.meshgrid(np.linspace(-1, 1, 4), np.linspace(-1, 1, 4))
+    gx_shift = gx + 2.0 / 3.0  # one pixel in [-1,1] coords of width 4
+    grid = np.stack([gx_shift, gy], axis=0)[None].astype(np.float32)
+    out = mx.nd.BilinearSampler(mx.nd.array(data),
+                                mx.nd.array(grid)).asnumpy()
+    np.testing.assert_allclose(out[0, 0, :, :3], data[0, 0, :, 1:],
+                               atol=1e-4)
+    # rightmost column samples outside -> 0 contribution partially
+    assert out.shape == (1, 1, 4, 4)
+
+
+def test_spatial_transformer_identity_and_grad():
+    rs = np.random.RandomState(2)
+    data = rs.randn(2, 3, 5, 5).astype(np.float32)
+    loc = np.tile(np.array([[1, 0, 0, 0, 1, 0]], np.float32), (2, 1))
+    out = mx.nd.SpatialTransformer(mx.nd.array(data), mx.nd.array(loc),
+                                   target_shape=(5, 5)).asnumpy()
+    np.testing.assert_allclose(out, data, atol=1e-5)
+
+    # gradient flows through the sampler to both data and loc
+    d = mx.sym.Variable("data")
+    l = mx.sym.Variable("loc")
+    s = mx.sym.SpatialTransformer(d, l, target_shape=(5, 5))
+    s = mx.sym.sum(s)
+    ex = s.simple_bind(mx.cpu(), data=(2, 3, 5, 5), loc=(2, 6))
+    ex.arg_dict["data"][:] = data
+    ex.arg_dict["loc"][:] = loc
+    ex.forward(is_train=True)
+    ex.backward()
+    assert np.abs(ex.grad_dict["loc"].asnumpy()).sum() > 0
+    assert np.abs(ex.grad_dict["data"].asnumpy()).sum() > 0
+
+
+def test_grid_generator_warp():
+    flow = np.zeros((1, 2, 3, 3), np.float32)
+    grid = mx.nd.GridGenerator(mx.nd.array(flow), transform_type="warp")
+    g = grid.asnumpy()
+    np.testing.assert_allclose(g[0, 0, 0], [-1, 0, 1], atol=1e-6)
+    np.testing.assert_allclose(g[0, 1, :, 0], [-1, 0, 1], atol=1e-6)
+
+
+def test_correlation_zero_displacement():
+    rs = np.random.RandomState(3)
+    a = rs.randn(1, 4, 6, 6).astype(np.float32)
+    b = rs.randn(1, 4, 6, 6).astype(np.float32)
+    out = mx.nd.Correlation(mx.nd.array(a), mx.nd.array(b), kernel_size=1,
+                            max_displacement=1, pad_size=1).asnumpy()
+    assert out.shape == (1, 9, 6, 6)
+    # center channel (displacement 0,0) = mean over C of a*b
+    np.testing.assert_allclose(out[0, 4], (a[0] * b[0]).mean(axis=0),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_crop_op():
+    data = np.arange(2 * 3 * 6 * 6, dtype=np.float32).reshape(2, 3, 6, 6)
+    out = mx.nd.Crop(mx.nd.array(data), offset=(1, 2), h_w=(3, 3)).asnumpy()
+    np.testing.assert_array_equal(out, data[:, :, 1:4, 2:5])
+    like = mx.nd.zeros((2, 3, 4, 4))
+    out2 = mx.nd.Crop(mx.nd.array(data), like, num_args=2,
+                      center_crop=True).asnumpy()
+    np.testing.assert_array_equal(out2, data[:, :, 1:5, 1:5])

@@ -1,0 +1,63 @@
+"""SSD-VGG16 model tests (BASELINE config 4): multi-loss training step +
+detection path, tiny scale for CI."""
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu.models import ssd_vgg16
+
+
+def _toy_batch(batch=1, size=96, num_gt=2):
+    rs = np.random.RandomState(0)
+    data = rs.uniform(0, 1, (batch, 3, size, size)).astype(np.float32)
+    label = -np.ones((batch, num_gt, 5), np.float32)
+    label[:, 0] = [1, 0.2, 0.2, 0.6, 0.6]
+    return data, label
+
+
+def test_ssd_train_step_runs_and_learns():
+    data, label = _toy_batch()
+    net = ssd_vgg16.get_symbol_train(num_classes=2)
+    mod = mx.mod.Module(net, data_names=("data",), label_names=("label",))
+    it = mx.io.NDArrayIter({"data": data}, {"label": label}, batch_size=1,
+                           label_name="label")
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(mx.init.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.001})
+    metric = ssd_vgg16.MultiBoxMetric()
+    losses = []
+    for _ in range(3):
+        it.reset()
+        batch = next(iter(it))
+        mod.forward_backward(batch)
+        mod.update()
+        metric.reset()
+        mod.update_metric(metric, batch.label)
+        names, vals = metric.get()
+        losses.append(vals[0])
+    assert all(np.isfinite(v) for v in losses)
+    assert losses[-1] < losses[0]  # cls loss decreases on one batch
+
+
+def test_ssd_detection_shapes():
+    data, label = _toy_batch()
+    det = ssd_vgg16.get_symbol(num_classes=2, nms_thresh=0.5)
+    args = {n: None for n in det.list_arguments()}
+    ex = det.simple_bind(mx.cpu(), data=(1, 3, 96, 96), label=(1, 2, 5),
+                         grad_req="null")
+    for name, arr in ex.arg_dict.items():
+        if name not in ("data", "label"):
+            arr[:] = np.random.RandomState(1).uniform(
+                -0.1, 0.1, arr.shape).astype(np.float32)
+    ex.arg_dict["data"][:] = data
+    out = ex.forward(is_train=False)[0].asnumpy()
+    assert out.ndim == 3 and out.shape[2] == 6
+    # all rows either invalid (-1) or valid class ids in range
+    cls = out[0, :, 0]
+    assert ((cls == -1) | ((cls >= 0) & (cls < 2))).all()
+    scores = out[0, :, 1]
+    valid = cls >= 0
+    if valid.any():
+        s = scores[valid]
+        assert (s[:-1] >= s[1:]).all() or len(s) == 1  # sorted desc
