@@ -1,0 +1,354 @@
+"""Attention ops: flash attention (Pallas TPU kernel + blockwise-scan core).
+
+The 2017 reference has NO attention operator (SURVEY §5.7: long-sequence
+support there is bucketing + cuDNN fused RNN only).  This module is the
+new-capability half of the long-context story; the other half is ring
+attention / context parallelism in ``mxnet_tpu.parallel.ring`` which reuses
+the same blockwise online-softmax core over an ICI ring.
+
+Design:
+
+* ``_attn_reference`` — O(L^2)-memory softmax(QK^T)V, the numerics oracle.
+* ``_flash_scan`` — blockwise online softmax as a ``lax.scan`` over K/V
+  blocks: O(L) memory, pure JAX, runs on any backend, fully differentiable.
+* ``_flash_pallas`` — the TPU kernel: grid (batch*heads, q_blocks, k_blocks),
+  K innermost ("arbitrary" dimension semantics) with VMEM scratch carrying
+  (m, l, acc) across K steps — the canonical TPU flash-attention schedule
+  (MXU for the two dots, VPU for the online-softmax rescale).
+* ``flash_attention`` — ``jax.custom_vjp``: forward picks the Pallas kernel
+  on TPU (tile-aligned shapes) else the scan; backward recomputes blockwise
+  from the saved (o, lse) residuals — the standard FA2 backward, written as
+  plain JAX matmuls per K block so XLA schedules them on the MXU.
+
+Shapes follow (batch, heads, seq, head_dim) throughout.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import REQUIRED, pbool, pfloat, pint, register
+
+NEG_INF = -1e30
+
+
+def _attn_reference(q, k, v, causal=False, scale=None, kv_offset=0):
+    """Quadratic-memory reference attention (numerics oracle for tests)."""
+    if scale is None:
+        scale = 1.0 / np.sqrt(q.shape[-1])
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        qi = jnp.arange(q.shape[2])[:, None]
+        ki = jnp.arange(k.shape[2])[None, :] + kv_offset
+        s = jnp.where(qi >= ki, s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32)).astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# blockwise scan core (shared by CPU path, backward pass, and ring attention)
+# ---------------------------------------------------------------------------
+
+def _flash_scan(q, k, v, causal, scale, block_k=512):
+    """Blockwise attention as lax.scan over K blocks. Returns (out, lse).
+
+    O(Lq·D + block_k·D) live memory per (batch, head); the scan is the
+    XLA-native analog of the flash-attention loop.
+    """
+    orig_dtype = q.dtype
+    qf = q.astype(jnp.float32)
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    lk = k.shape[2]
+    block_k = min(block_k, lk)
+    nb = (lk + block_k - 1) // block_k
+    pad = nb * block_k - lk
+    if pad:
+        kf = jnp.pad(kf, ((0, 0), (0, 0), (0, pad), (0, 0)))
+        vf = jnp.pad(vf, ((0, 0), (0, 0), (0, pad), (0, 0)))
+    kb = kf.reshape(kf.shape[0], kf.shape[1], nb, block_k, kf.shape[3])
+    vb = vf.reshape(*kb.shape)
+    kb = jnp.moveaxis(kb, 2, 0)  # (nb, B, H, block_k, D)
+    vb = jnp.moveaxis(vb, 2, 0)
+
+    b, h, lq, d = q.shape
+    o0 = jnp.zeros((b, h, lq, d), jnp.float32)
+    m0 = jnp.full((b, h, lq), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, lq), jnp.float32)
+
+    def step_masked(carry, kv):
+        i, k_blk, v_blk = kv
+        o, m, l = carry
+        s = jnp.einsum("bhqd,bhkd->bhqk", qf, k_blk) * scale
+        kpos = i * block_k + jnp.arange(block_k)
+        valid = kpos < lk
+        if causal:
+            qi = jnp.arange(lq)[:, None]
+            valid = valid[None, :] & (qi >= kpos[None, :])
+        else:
+            valid = jnp.broadcast_to(valid[None, :], (lq, block_k))
+        s = jnp.where(valid, s, NEG_INF)
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new[..., None])
+        l_new = l * alpha + p.sum(axis=-1)
+        o_new = o * alpha[..., None] + jnp.einsum("bhqk,bhkd->bhqd", p, v_blk)
+        return (o_new, m_new, l_new), None
+
+    (o, m, l), _ = jax.lax.scan(
+        step_masked, (o0, m0, l0),
+        (jnp.arange(nb), kb, vb))
+    l = jnp.maximum(l, 1e-30)
+    out = (o / l[..., None]).astype(orig_dtype)
+    lse = m + jnp.log(l)
+    return out, lse
+
+
+# ---------------------------------------------------------------------------
+# Pallas TPU forward kernel
+# ---------------------------------------------------------------------------
+
+def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
+               scale, causal, block_q, block_k, num_kb):
+    """Online-softmax flash attention body; grid = (BH, num_qb, num_kb),
+    K innermost with scratch (m, l, acc) carried across K steps."""
+    from jax.experimental import pallas as pl
+
+    qb = pl.program_id(1)
+    kb = pl.program_id(2)
+
+    @pl.when(kb == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    def _body():
+        q = q_ref[0].astype(jnp.float32)          # (block_q, d)
+        k = k_ref[0].astype(jnp.float32)          # (block_k, d)
+        v = v_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            qi = qb * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            ki = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(qi >= ki, s, NEG_INF)
+        m_prev = m_scr[:, 0]                       # (block_q,)
+        l_prev = l_scr[:, 0]
+        m_cur = jnp.maximum(m_prev, s.max(axis=-1))
+        alpha = jnp.exp(m_prev - m_cur)
+        p = jnp.exp(s - m_cur[:, None])
+        l_cur = l_prev * alpha + p.sum(axis=-1)
+        m_scr[:, 0] = m_cur
+        l_scr[:, 0] = l_cur
+        acc_scr[:] = acc_scr[:] * alpha[:, None] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+
+    if causal:
+        # skip fully-masked K blocks (block above the diagonal)
+        @pl.when(kb * block_k <= qb * block_q + (block_q - 1))
+        def _():
+            _body()
+    else:
+        _body()
+
+    @pl.when(kb == num_kb - 1)
+    def _finish():
+        l = jnp.maximum(l_scr[:, 0], 1e-30)
+        o_ref[0] = (acc_scr[:] / l[:, None]).astype(o_ref.dtype)
+        lse_ref[0] = (m_scr[:, 0] + jnp.log(l))
+
+
+def _flash_pallas(q, k, v, causal, scale, block_q=256, block_k=512,
+                  interpret=False):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, lq, d = q.shape
+    lk = k.shape[2]
+    block_q = min(block_q, lq)
+    block_k = min(block_k, lk)
+    num_qb = lq // block_q
+    num_kb = lk // block_k
+    bh = b * h
+    qr = q.reshape(bh, lq, d)
+    kr = k.reshape(bh, lk, d)
+    vr = v.reshape(bh, lk, d)
+
+    kernel = functools.partial(
+        _fa_kernel, scale=scale, causal=causal,
+        block_q=block_q, block_k=block_k, num_kb=num_kb)
+
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=(bh, num_qb, num_kb),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b_, q_, k_: (b_, q_, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b_, q_, k_: (b_, k_, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b_, q_, k_: (b_, k_, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b_, q_, k_: (b_, q_, 0)),
+            pl.BlockSpec((1, block_q), lambda b_, q_, k_: (b_, q_)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, lq, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, lq), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 128), jnp.float32),   # m
+            pltpu.VMEM((block_q, 128), jnp.float32),   # l
+            pltpu.VMEM((block_q, d), jnp.float32),     # acc
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(qr, kr, vr)
+    return out.reshape(b, h, lq, d), lse.reshape(b, h, lq)
+
+
+def _use_pallas(q, k, block_q, block_k):
+    if jax.default_backend() != "tpu":
+        return False
+    lq, lk = q.shape[2], k.shape[2]
+    d = q.shape[3]
+    return (lq % min(block_q, lq) == 0 and lk % min(block_k, lk) == 0
+            and min(lq, block_q) % 8 == 0 and min(lk, block_k) % 128 == 0
+            and d % 128 == 0)
+
+
+# ---------------------------------------------------------------------------
+# custom-vjp flash attention (public functional API)
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, causal, scale, block_q, block_k):
+    out, _ = _flash_fwd(q, k, v, causal, scale, block_q, block_k)
+    return out
+
+
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k):
+    if _use_pallas(q, k, block_q, block_k):
+        out, lse = _flash_pallas(q, k, v, causal, scale, block_q, block_k)
+    else:
+        out, lse = _flash_scan(q, k, v, causal, scale, block_k)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bwd(causal, scale, block_q, block_k, res, do):
+    """FA2 backward: blockwise over K, plain-JAX matmuls (MXU via XLA)."""
+    q, k, v, out, lse = res
+    qf = q.astype(jnp.float32)
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    dof = do.astype(jnp.float32)
+    of = out.astype(jnp.float32)
+    delta = (dof * of).sum(axis=-1)                  # (B,H,Lq)
+
+    lk = k.shape[2]
+    bk = min(block_k, lk)
+    nb = (lk + bk - 1) // bk
+    pad = nb * bk - lk
+    if pad:
+        kf = jnp.pad(kf, ((0, 0), (0, 0), (0, pad), (0, 0)))
+        vf = jnp.pad(vf, ((0, 0), (0, 0), (0, pad), (0, 0)))
+    kb = jnp.moveaxis(kf.reshape(kf.shape[0], kf.shape[1], nb, bk, kf.shape[3]), 2, 0)
+    vb = jnp.moveaxis(vf.reshape(vf.shape[0], vf.shape[1], nb, bk, vf.shape[3]), 2, 0)
+
+    lq = q.shape[2]
+
+    def step(dq, kv):
+        i, k_blk, v_blk = kv
+        s = jnp.einsum("bhqd,bhkd->bhqk", qf, k_blk) * scale
+        kpos = i * bk + jnp.arange(bk)
+        valid = kpos < lk
+        if causal:
+            qi = jnp.arange(lq)[:, None]
+            valid = valid[None, :] & (qi >= kpos[None, :])
+        else:
+            valid = jnp.broadcast_to(valid[None, :], (lq, bk))
+        p = jnp.where(valid, jnp.exp(s - lse[..., None]), 0.0)
+        dv_blk = jnp.einsum("bhqk,bhqd->bhkd", p, dof)
+        dp = jnp.einsum("bhqd,bhkd->bhqk", dof, v_blk)
+        ds = p * (dp - delta[..., None]) * scale
+        dq = dq + jnp.einsum("bhqk,bhkd->bhqd", ds, k_blk)
+        dk_blk = jnp.einsum("bhqk,bhqd->bhkd", ds, qf)
+        return dq, (dk_blk, dv_blk)
+
+    dq0 = jnp.zeros_like(qf)
+    dq, (dk_b, dv_b) = jax.lax.scan(step, dq0, (jnp.arange(nb), kb, vb))
+    dk = jnp.moveaxis(dk_b, 0, 2).reshape(kf.shape)[:, :, :lk]
+    dv = jnp.moveaxis(dv_b, 0, 2).reshape(vf.shape)[:, :, :lk]
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, causal=False, softmax_scale=None,
+                    block_q=256, block_k=512):
+    """Memory-efficient attention. q/k/v: (batch, heads, seq, head_dim)."""
+    if softmax_scale is None:
+        softmax_scale = float(1.0 / np.sqrt(q.shape[-1]))
+    return _flash(q, k, v, bool(causal), float(softmax_scale),
+                  int(block_q), int(block_k))
+
+
+# ---------------------------------------------------------------------------
+# registered ops
+# ---------------------------------------------------------------------------
+
+def _flash_attention_op(attrs, inputs, aux, is_train, rng):
+    q, k, v = inputs
+    return [flash_attention(q, k, v, causal=attrs["causal"],
+                            softmax_scale=attrs["softmax_scale"] or None,
+                            block_q=attrs["block_q"], block_k=attrs["block_k"])]
+
+
+register("_contrib_FlashAttention", _flash_attention_op,
+         arguments=("query", "key", "value"),
+         params={"causal": (pbool, False),
+                 "softmax_scale": (pfloat, 0.0),
+                 "block_q": (pint, 256), "block_k": (pint, 512)},
+         aliases=("FlashAttention",), hint="flashattention")
+
+
+def _mha_op(attrs, inputs, aux, is_train, rng):
+    """MultiHeadAttention: (B, L, E) inputs, fused qkv projection weights."""
+    x_q, x_kv, w_qkv, w_out = inputs[:4]
+    b_qkv = inputs[4] if len(inputs) > 4 else None
+    b_out = inputs[5] if len(inputs) > 5 else None
+    num_heads = attrs["num_heads"]
+    e = x_q.shape[-1]
+    hd = e // num_heads
+    wq, wk, wv = jnp.split(w_qkv, 3, axis=0)  # each (E, E)
+    q = jnp.einsum("ble,fe->blf", x_q, wq)
+    kk = jnp.einsum("ble,fe->blf", x_kv, wk)
+    vv = jnp.einsum("ble,fe->blf", x_kv, wv)
+    if b_qkv is not None:
+        bq, bk_, bv = jnp.split(b_qkv, 3)
+        q, kk, vv = q + bq, kk + bk_, vv + bv
+
+    def heads(t):
+        return t.reshape(t.shape[0], t.shape[1], num_heads, hd).transpose(0, 2, 1, 3)
+
+    o = flash_attention(heads(q), heads(kk), heads(vv), causal=attrs["causal"])
+    o = o.transpose(0, 2, 1, 3).reshape(x_q.shape[0], x_q.shape[1], e)
+    out = jnp.einsum("ble,fe->blf", o, w_out)
+    if b_out is not None:
+        out = out + b_out
+    return [out]
+
+
+register("_contrib_MultiHeadAttention", _mha_op,
+         arguments=lambda a: (["query", "key_value", "qkv_weight", "out_weight"]
+                              + ([] if a["no_bias"] else ["qkv_bias", "out_bias"])),
+         params={"num_heads": (pint, REQUIRED), "causal": (pbool, False),
+                 "no_bias": (pbool, False)},
+         aliases=("MultiHeadAttention",), hint="multiheadattention")

@@ -14,6 +14,8 @@ Axes convention (the scaling-book recipe):
 """
 
 from .mesh import make_mesh, named_sharding
+from .ring import ring_attention, ring_self_attention
 from .trainer import SPMDTrainer
 
-__all__ = ["make_mesh", "named_sharding", "SPMDTrainer"]
+__all__ = ["make_mesh", "named_sharding", "SPMDTrainer",
+           "ring_attention", "ring_self_attention"]

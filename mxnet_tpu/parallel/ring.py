@@ -1,0 +1,80 @@
+"""Ring attention: context/sequence parallelism over the ICI ring.
+
+New capability beyond the 2017 reference (SURVEY §5.7: it has only bucketing
+and model-parallel LSTM for long sequences).  Sequence dimension is sharded
+over a mesh axis; each device holds (B, H, L/n, D) shards of Q/K/V.  K/V
+shards rotate around the ring with ``lax.ppermute`` while every device folds
+each visiting block into a running online-softmax accumulator — the same
+blockwise core as ``ops.attention``, so memory stays O(L/n) per device and
+the sequence length scales linearly with the ring size.
+
+XLA overlaps the ppermute (ICI transfer) with the block's two matmuls (MXU),
+which is the whole point of the ring schedule: compute hides communication.
+
+Differentiable end-to-end (scan + ppermute have transposable VJPs), so the
+same code path serves training — no separate backward kernel needed.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+NEG_INF = -1e30
+
+
+def ring_attention(q, k, v, axis_name, causal=False, softmax_scale=None):
+    """Blockwise ring attention over ``axis_name``.  Must run inside
+    ``shard_map``; q/k/v are the local sequence shards (B, H, Lc, D)."""
+    n = jax.lax.psum(1, axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    b, h, lc, d = q.shape
+    if softmax_scale is None:
+        softmax_scale = float(1.0 / np.sqrt(d))
+
+    qf = q.astype(jnp.float32)
+    perm = [(j, (j + 1) % n) for j in range(n)]
+    q_pos = idx * lc + jnp.arange(lc)[:, None]            # global q positions
+
+    def step(carry, s):
+        o, m, l, kc, vc = carry
+        owner = (idx - s) % n                              # shard origin
+        kpos = owner * lc + jnp.arange(lc)[None, :]
+        sc = jnp.einsum("bhqd,bhkd->bhqk", qf, kc.astype(jnp.float32))
+        sc = sc * softmax_scale
+        if causal:
+            sc = jnp.where(q_pos >= kpos, sc, NEG_INF)
+        m_new = jnp.maximum(m, sc.max(axis=-1))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(sc - m_new[..., None])
+        l_new = l * alpha + p.sum(axis=-1)
+        o_new = o * alpha[..., None] + jnp.einsum(
+            "bhqk,bhkd->bhqd", p, vc.astype(jnp.float32))
+        kc = jax.lax.ppermute(kc, axis_name, perm)
+        vc = jax.lax.ppermute(vc, axis_name, perm)
+        return (o_new, m_new, l_new, kc, vc), None
+
+    o0 = jnp.zeros((b, h, lc, d), jnp.float32)
+    m0 = jnp.full((b, h, lc), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, lc), jnp.float32)
+    (o, m, l, _, _), _ = jax.lax.scan(step, (o0, m0, l0, k, v),
+                                      jnp.arange(n))
+    # with causal masking the first tokens of rank 0 always see >=1 key,
+    # so l>0 everywhere; the maximum is a guard for empty-ring edge cases
+    l = jnp.maximum(l, 1e-30)
+    return (o / l[..., None]).astype(q.dtype)
+
+
+def ring_self_attention(q, k, v, mesh, seq_axis="data", causal=False,
+                        softmax_scale=None):
+    """shard_map wrapper: shard (B, H, L, D) tensors over ``seq_axis`` on
+    the sequence dimension and run ring attention across it."""
+    spec = P(None, None, seq_axis, None)
+    fn = functools.partial(ring_attention, axis_name=seq_axis, causal=causal,
+                           softmax_scale=softmax_scale)
+    return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec, check_vma=False)(q, k, v)
