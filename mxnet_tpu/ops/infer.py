@@ -161,3 +161,20 @@ def _svm_out(attrs, ins, dts, auxs):
     if data is not None and ins[1] is None:
         ins[1] = (data[0],)
     return ins, auxs
+
+
+@rule("_contrib_MultiHeadAttention")
+def _mha(attrs, ins, dts, auxs):
+    data = ins[0]
+    if data is not None:
+        e = data[-1]
+        if ins[2] is None:
+            ins[2] = (3 * e, e)
+        if ins[3] is None:
+            ins[3] = (e, e)
+        if not attrs["no_bias"]:
+            if len(ins) > 4 and ins[4] is None:
+                ins[4] = (3 * e,)
+            if len(ins) > 5 and ins[5] is None:
+                ins[5] = (e,)
+    return ins, auxs
