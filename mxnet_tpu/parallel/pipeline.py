@@ -1,0 +1,112 @@
+"""SPMD pipeline parallelism over a mesh axis.
+
+The reference's only model parallelism is manual ``group2ctx`` device
+placement with ``_CrossDeviceCopy`` nodes inserted between GPUs
+(SURVEY §2.4.3: ``graph_executor.cc:305``, ``example/model-parallel-lstm``)
+— a pipeline in spirit (LSTM layers staged across devices) but scheduled
+by the dependency engine.  The TPU-native design is the GPipe/SPMD schedule:
+every device runs the SAME jitted program for its own stage, activations hop
+stage→stage over ICI with ``lax.ppermute``, and microbatches fill the
+pipeline so bubbles shrink as ``n_micro / (n_micro + n_stages - 1)``.
+
+``spmd_pipeline`` is differentiable end-to-end (scan + ppermute + where all
+have VJPs), so the same schedule serves fwd+bwd — XLA interleaves the
+backward ppermutes with compute exactly like the forward.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+def spmd_pipeline(stage_fn, params, xs, axis_name, with_aux=False):
+    """Run ``stage_fn`` as a pipeline over ``axis_name``.
+
+    Must be called inside ``shard_map``.  Each device holds its stage's
+    params (``params`` pytree leaves have a leading local stage axis of 1).
+    ``xs``: (n_micro, mb, ...) microbatched input, replicated across the
+    pipeline axis.  Returns (n_micro, mb, ...) outputs, replicated.
+
+    With ``with_aux=True``, ``stage_fn`` returns ``(out, aux_scalar)`` and
+    the result is ``(outputs, aux)`` where aux sums each stage's
+    per-microbatch mean contribution (fill/drain steps, where a stage holds
+    no real microbatch, are masked out).
+
+    Activations must have the same shape/dtype at every stage boundary
+    (the ``_CrossDeviceCopy`` contract, made explicit).
+    """
+    stage = jax.lax.axis_index(axis_name)
+    n = jax.lax.psum(1, axis_name)
+    n_micro = xs.shape[0]
+    steps = n_micro + n - 1
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    local_params = jax.tree_util.tree_map(lambda p: p[0], params)
+
+    state0 = jnp.zeros_like(xs[0])
+    out0 = jnp.zeros_like(xs)
+
+    def body(carry, t):
+        state, outputs, aux_acc = carry
+        inject = xs[jnp.clip(t, 0, n_micro - 1)]
+        state = jnp.where(stage == 0, inject, state)
+        if with_aux:
+            out, aux = stage_fn(local_params, state)
+            # stage s holds microbatch t-s at step t; mask fill/drain steps
+            mb_idx = t - stage
+            valid = (mb_idx >= 0) & (mb_idx < n_micro)
+            aux_acc = aux_acc + jnp.where(valid, aux, 0.0)
+        else:
+            out = stage_fn(local_params, state)
+        widx = t - (n - 1)
+        write = (stage == n - 1) & (widx >= 0)
+        outputs = jnp.where(
+            write,
+            outputs.at[jnp.clip(widx, 0, n_micro - 1)].set(out),
+            outputs)
+        state = jax.lax.ppermute(out, axis_name, perm)
+        return (state, outputs, aux_acc), None
+
+    (_, outputs, aux_acc), _ = jax.lax.scan(
+        body, (state0, out0, jnp.zeros((), jnp.float32)), jnp.arange(steps))
+    # outputs are valid only on the last stage; mask-psum broadcasts them
+    # back to every stage (replicated out_spec)
+    outputs = jax.lax.psum(jnp.where(stage == n - 1, outputs, 0.0), axis_name)
+    if with_aux:
+        # sum over stages, mean over microbatches
+        return outputs, jax.lax.psum(aux_acc, axis_name) / n_micro
+    return outputs
+
+
+def pipeline_apply(stage_fn, params, x, mesh, n_microbatches,
+                   axis_name="pipe", param_specs=None):
+    """shard_map wrapper.  ``params`` pytree leaves have a leading stage
+    axis of size ``mesh.shape[axis_name]``; ``x``: (batch, ...) is split
+    into ``n_microbatches`` along batch.  Returns (batch, ...) outputs."""
+    n_stages = mesh.shape[axis_name]
+    batch = x.shape[0]
+    if batch % n_microbatches:
+        raise ValueError("batch %d not divisible by n_microbatches %d"
+                         % (batch, n_microbatches))
+    xs = x.reshape(n_microbatches, batch // n_microbatches, *x.shape[1:])
+
+    if param_specs is None:
+        param_specs = jax.tree_util.tree_map(
+            lambda p: P(axis_name), params)
+
+    fn = functools.partial(spmd_pipeline, stage_fn, axis_name=axis_name)
+    outs = jax.shard_map(
+        fn, mesh=mesh,
+        in_specs=(param_specs, P()),
+        out_specs=P(),
+        check_vma=False)(params, xs)
+    return outs.reshape(batch, *outs.shape[2:])
+
+
+def stack_stage_params(per_stage_params):
+    """[pytree per stage] -> single pytree with leading stage axis."""
+    return jax.tree_util.tree_map(
+        lambda *leaves: jnp.stack(leaves), *per_stage_params)
