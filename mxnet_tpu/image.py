@@ -1,0 +1,422 @@
+"""Host-side image decode / augment / iterate pipeline.
+
+Reference: ``python/mxnet/image.py`` (python aug pipeline) and the C++
+``ImageRecordIter`` stack (``src/io/iter_image_recordio.cc``,
+``src/io/image_aug_default.cc`` — crop/resize/mirror/HSL jitter under
+``MXNET_REGISTER_IMAGE_AUGMENTER``).
+
+TPU design: decode + augment stay on host CPU (numpy/OpenCV) exactly like
+the reference — the chip never sees JPEGs — and the batch is shipped once
+per step; ``io.PrefetchingIter`` provides the background-thread double
+buffering of the reference's ``PrefetcherIter`` (``iter_prefetcher.h:49``).
+Images flow as HWC uint8 RGB between augmenters, NCHW float32 out of the
+iterator (the ``Module`` input layout).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import random as pyrandom
+
+import numpy as np
+
+from . import recordio
+from .base import MXNetError
+from .io import DataBatch, DataDesc, DataIter
+
+__all__ = ["imdecode", "imresize", "resize_short", "fixed_crop",
+           "random_crop", "center_crop", "color_normalize", "HorizontalFlipAug",
+           "ResizeAug", "ForceResizeAug", "RandomCropAug", "CenterCropAug",
+           "BrightnessJitterAug", "ContrastJitterAug", "SaturationJitterAug",
+           "ColorJitterAug", "LightingAug", "ColorNormalizeAug", "CastAug",
+           "CreateAugmenter", "ImageIter"]
+
+
+def _cv2():
+    try:
+        import cv2
+        return cv2
+    except ImportError as e:  # pragma: no cover
+        raise MXNetError("OpenCV is required for image ops: %s" % e)
+
+
+def imdecode(buf, flag=1, to_rgb=True):
+    """Decode encoded image bytes -> HWC uint8 (RGB by default)."""
+    cv2 = _cv2()
+    img = cv2.imdecode(np.frombuffer(buf, dtype=np.uint8), flag)
+    if img is None:
+        raise MXNetError("cannot decode image")
+    if to_rgb and img.ndim == 3 and img.shape[2] == 3:
+        img = img[:, :, ::-1]
+    return np.ascontiguousarray(img)
+
+
+def imresize(src, w, h, interp=1):
+    cv2 = _cv2()
+    return cv2.resize(src, (w, h), interpolation=interp)
+
+
+def resize_short(src, size, interp=1):
+    """Resize so the shorter edge equals ``size`` (aspect preserved)."""
+    h, w = src.shape[:2]
+    if h > w:
+        new_w, new_h = size, int(h * size / w)
+    else:
+        new_w, new_h = int(w * size / h), size
+    return imresize(src, new_w, new_h, interp)
+
+
+def fixed_crop(src, x0, y0, w, h, size=None, interp=1):
+    out = src[y0:y0 + h, x0:x0 + w]
+    if size is not None and (w, h) != size:
+        out = imresize(out, size[0], size[1], interp)
+    return out
+
+
+def random_crop(src, size, interp=1):
+    h, w = src.shape[:2]
+    new_w, new_h = min(size[0], w), min(size[1], h)
+    x0 = pyrandom.randint(0, w - new_w)
+    y0 = pyrandom.randint(0, h - new_h)
+    out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
+    return out, (x0, y0, new_w, new_h)
+
+
+def center_crop(src, size, interp=1):
+    h, w = src.shape[:2]
+    new_w, new_h = min(size[0], w), min(size[1], h)
+    x0 = (w - new_w) // 2
+    y0 = (h - new_h) // 2
+    out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
+    return out, (x0, y0, new_w, new_h)
+
+
+def color_normalize(src, mean, std=None):
+    src = src.astype(np.float32) - mean
+    if std is not None:
+        src = src / std
+    return src
+
+
+# ---------------------------------------------------------------------------
+# augmenters: callables image -> image, composed in a list (the
+# MXNET_REGISTER_IMAGE_AUGMENTER analog is plain python composition)
+# ---------------------------------------------------------------------------
+
+class Augmenter:
+    def __call__(self, src):
+        raise NotImplementedError
+
+
+class ResizeAug(Augmenter):
+    def __init__(self, size, interp=1):
+        self.size, self.interp = size, interp
+
+    def __call__(self, src):
+        return resize_short(src, self.size, self.interp)
+
+
+class ForceResizeAug(Augmenter):
+    def __init__(self, size, interp=1):
+        self.size, self.interp = size, interp
+
+    def __call__(self, src):
+        return imresize(src, self.size[0], self.size[1], self.interp)
+
+
+class RandomCropAug(Augmenter):
+    def __init__(self, size, interp=1):
+        self.size, self.interp = size, interp
+
+    def __call__(self, src):
+        return random_crop(src, self.size, self.interp)[0]
+
+
+class CenterCropAug(Augmenter):
+    def __init__(self, size, interp=1):
+        self.size, self.interp = size, interp
+
+    def __call__(self, src):
+        return center_crop(src, self.size, self.interp)[0]
+
+
+class HorizontalFlipAug(Augmenter):
+    def __init__(self, p=0.5):
+        self.p = p
+
+    def __call__(self, src):
+        if pyrandom.random() < self.p:
+            return np.ascontiguousarray(src[:, ::-1])
+        return src
+
+
+class BrightnessJitterAug(Augmenter):
+    def __init__(self, brightness):
+        self.brightness = brightness
+
+    def __call__(self, src):
+        alpha = 1.0 + pyrandom.uniform(-self.brightness, self.brightness)
+        return (src.astype(np.float32) * alpha)
+
+
+class ContrastJitterAug(Augmenter):
+    _coef = np.array([[[0.299, 0.587, 0.114]]], np.float32)
+
+    def __init__(self, contrast):
+        self.contrast = contrast
+
+    def __call__(self, src):
+        alpha = 1.0 + pyrandom.uniform(-self.contrast, self.contrast)
+        src = src.astype(np.float32)
+        gray = (src * self._coef).sum(axis=2, keepdims=True)
+        return src * alpha + gray.mean() * (1.0 - alpha)
+
+
+class SaturationJitterAug(Augmenter):
+    _coef = np.array([[[0.299, 0.587, 0.114]]], np.float32)
+
+    def __init__(self, saturation):
+        self.saturation = saturation
+
+    def __call__(self, src):
+        alpha = 1.0 + pyrandom.uniform(-self.saturation, self.saturation)
+        src = src.astype(np.float32)
+        gray = (src * self._coef).sum(axis=2, keepdims=True)
+        return src * alpha + gray * (1.0 - alpha)
+
+
+class ColorJitterAug(Augmenter):
+    def __init__(self, brightness, contrast, saturation):
+        self.augs = []
+        if brightness > 0:
+            self.augs.append(BrightnessJitterAug(brightness))
+        if contrast > 0:
+            self.augs.append(ContrastJitterAug(contrast))
+        if saturation > 0:
+            self.augs.append(SaturationJitterAug(saturation))
+
+    def __call__(self, src):
+        augs = list(self.augs)
+        pyrandom.shuffle(augs)
+        for a in augs:
+            src = a(src)
+        return src
+
+
+class LightingAug(Augmenter):
+    """PCA lighting noise (AlexNet-style), reference image_aug_default."""
+
+    def __init__(self, alphastd, eigval, eigvec):
+        self.alphastd = alphastd
+        self.eigval = np.asarray(eigval, np.float32)
+        self.eigvec = np.asarray(eigvec, np.float32)
+
+    def __call__(self, src):
+        alpha = np.random.normal(0, self.alphastd, size=(3,)).astype(np.float32)
+        rgb = (self.eigvec * alpha * self.eigval).sum(axis=1)
+        return src.astype(np.float32) + rgb
+
+
+class ColorNormalizeAug(Augmenter):
+    def __init__(self, mean, std):
+        self.mean = None if mean is None else np.asarray(mean, np.float32)
+        self.std = None if std is None else np.asarray(std, np.float32)
+
+    def __call__(self, src):
+        return color_normalize(src, self.mean, self.std)
+
+
+class CastAug(Augmenter):
+    def __call__(self, src):
+        return src.astype(np.float32)
+
+
+def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
+                    rand_mirror=False, mean=None, std=None, brightness=0,
+                    contrast=0, saturation=0, pca_noise=0, inter_method=1):
+    """Reference ``python/mxnet/image.py`` CreateAugmenter: standard
+    training/eval augmentation chain for (C, H, W) ``data_shape``."""
+    auglist = []
+    if resize > 0:
+        auglist.append(ResizeAug(resize, inter_method))
+    crop_size = (data_shape[2], data_shape[1])
+    if rand_crop:
+        auglist.append(RandomCropAug(crop_size, inter_method))
+    else:
+        auglist.append(CenterCropAug(crop_size, inter_method))
+    if rand_mirror:
+        auglist.append(HorizontalFlipAug(0.5))
+    auglist.append(CastAug())
+    if brightness or contrast or saturation:
+        auglist.append(ColorJitterAug(brightness, contrast, saturation))
+    if pca_noise > 0:
+        eigval = np.array([55.46, 4.794, 1.148])
+        eigvec = np.array([[-0.5675, 0.7192, 0.4009],
+                           [-0.5808, -0.0045, -0.8140],
+                           [-0.5836, -0.6948, 0.4203]])
+        auglist.append(LightingAug(pca_noise, eigval, eigvec))
+    if mean is True:
+        mean = np.array([123.68, 116.28, 103.53], np.float32)
+    if std is True:
+        std = np.array([58.395, 57.12, 57.375], np.float32)
+    if mean is not None or std is not None:
+        auglist.append(ColorNormalizeAug(mean, std))
+    return auglist
+
+
+class ImageIter(DataIter):
+    """Image iterator over a RecordIO shard or an image list.
+
+    Reference: python ``ImageIter`` (``python/mxnet/image.py``) and the C++
+    ``ImageRecordIter`` (``src/io/iter_image_recordio.cc``), including its
+    distributed sharding (``part_index``/``num_parts``) and shuffle.
+    Produces NCHW float32 batches; wrap in ``io.PrefetchingIter`` for
+    background decode (the C++ prefetcher analog).
+    """
+
+    def __init__(self, batch_size, data_shape, label_width=1,
+                 path_imgrec=None, path_imglist=None, path_root=None,
+                 path_imgidx=None, shuffle=False, part_index=0, num_parts=1,
+                 aug_list=None, imglist=None, data_name="data",
+                 label_name="softmax_label", last_batch_handle="pad", **kwargs):
+        super().__init__(batch_size)
+        assert path_imgrec or path_imglist or imglist is not None, \
+            "one of path_imgrec / path_imglist / imglist is required"
+        self.data_shape = tuple(data_shape)
+        self.label_width = label_width
+        self.shuffle = shuffle
+        self.data_name, self.label_name = data_name, label_name
+        self.imgrec = None
+        self.imglist = None
+        self.path_root = path_root
+
+        if path_imgrec:
+            idx_path = path_imgidx or os.path.splitext(path_imgrec)[0] + ".idx"
+            if os.path.isfile(idx_path):
+                self.imgrec = recordio.MXIndexedRecordIO(
+                    idx_path, path_imgrec, "r")
+                self.seq = list(self.imgrec.keys)
+            else:
+                if shuffle or num_parts > 1:
+                    raise MXNetError(
+                        "shuffle/num_parts>1 require an index file (%s); "
+                        "build it with tools/im2rec.py" % idx_path)
+                # no index: sequential-only access
+                self.imgrec = recordio.MXRecordIO(path_imgrec, "r")
+                self.seq = None
+        elif path_imglist:
+            self.imglist = {}
+            seq = []
+            with open(path_imglist) as f:
+                for line in f:
+                    parts = line.strip().split("\t")
+                    label = np.array(parts[1:-1], dtype=np.float32)
+                    key = int(parts[0])
+                    self.imglist[key] = (label, parts[-1])
+                    seq.append(key)
+            self.seq = seq
+        else:
+            self.imglist = {}
+            seq = []
+            for i, (label, fname) in enumerate(imglist):
+                self.imglist[i] = (np.array(label, ndmin=1,
+                                            dtype=np.float32), fname)
+                seq.append(i)
+            self.seq = seq
+
+        if self.seq is not None and num_parts > 1:
+            # distributed shard (iter_mnist.cc-style part_index/num_parts)
+            n = len(self.seq)
+            per = n // num_parts
+            self.seq = self.seq[part_index * per:
+                                (part_index + 1) * per if part_index
+                                < num_parts - 1 else n]
+        self.aug_list = (CreateAugmenter(data_shape, **{
+            k: v for k, v in kwargs.items()
+            if k in ("resize", "rand_crop", "rand_resize", "rand_mirror",
+                     "mean", "std", "brightness", "contrast", "saturation",
+                     "pca_noise", "inter_method")})
+            if aug_list is None else aug_list)
+        self.cursor = 0
+        self.reset()
+
+    @property
+    def provide_data(self):
+        return [DataDesc(self.data_name,
+                         (self.batch_size,) + self.data_shape, np.float32)]
+
+    @property
+    def provide_label(self):
+        shape = ((self.batch_size,) if self.label_width == 1
+                 else (self.batch_size, self.label_width))
+        return [DataDesc(self.label_name, shape, np.float32)]
+
+    def reset(self):
+        if self.seq is not None and self.shuffle:
+            pyrandom.shuffle(self.seq)
+        if self.imgrec is not None and self.seq is None:
+            self.imgrec.reset()
+        self.cursor = 0
+
+    def _read_one(self):
+        if self.imgrec is not None:
+            if self.seq is not None:
+                if self.cursor >= len(self.seq):
+                    return None
+                rec = self.imgrec.read_idx(self.seq[self.cursor])
+            else:
+                rec = self.imgrec.read()
+                if rec is None:
+                    return None
+            self.cursor += 1
+            header, img_bytes = recordio.unpack(rec)
+            img = imdecode(img_bytes)
+            label = header.label
+        else:
+            if self.cursor >= len(self.seq):
+                return None
+            label, fname = self.imglist[self.seq[self.cursor]]
+            self.cursor += 1
+            path = os.path.join(self.path_root, fname) if self.path_root \
+                else fname
+            with open(path, "rb") as f:
+                img = imdecode(f.read())
+        for aug in self.aug_list:
+            img = aug(img)
+        return img, label
+
+    def next(self):
+        c, h, w = self.data_shape
+        data = np.zeros((self.batch_size, c, h, w), np.float32)
+        if self.label_width == 1:
+            label = np.zeros((self.batch_size,), np.float32)
+        else:
+            label = np.zeros((self.batch_size, self.label_width), np.float32)
+        i = 0
+        try:
+            while i < self.batch_size:
+                item = self._read_one()
+                if item is None:
+                    raise StopIteration
+                img, lbl = item
+                if img.ndim == 2:
+                    img = img[:, :, None]
+                data[i] = np.asarray(img, np.float32).transpose(2, 0, 1)
+                lbl = np.asarray(lbl).reshape(-1)
+                if self.label_width == 1:
+                    label[i] = lbl[0]
+                else:
+                    label[i] = lbl[:self.label_width]
+                i += 1
+        except StopIteration:
+            if i == 0:
+                raise
+        pad = self.batch_size - i
+        if pad:  # pad with the last valid sample (reference pad semantics)
+            for j in range(i, self.batch_size):
+                data[j] = data[i - 1]
+                label[j] = label[i - 1]
+        return DataBatch(data=[data], label=[label], pad=pad,
+                         provide_data=self.provide_data,
+                         provide_label=self.provide_label)

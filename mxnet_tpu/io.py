@@ -23,7 +23,7 @@ from .base import MXNetError
 from .ndarray import NDArray, array
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "MNISTIter",
-           "CSVIter", "ResizeIter", "PrefetchingIter"]
+           "CSVIter", "ResizeIter", "PrefetchingIter", "ImageRecordIter"]
 
 
 class DataDesc:
@@ -437,3 +437,42 @@ class PrefetchingIter(DataIter):
 
     def getpad(self):
         return self.current_batch.pad
+
+
+def ImageRecordIter(path_imgrec, data_shape, batch_size, shuffle=False,
+                    part_index=0, num_parts=1, rand_crop=False,
+                    rand_mirror=False, mean_r=0.0, mean_g=0.0, mean_b=0.0,
+                    std_r=1.0, std_g=1.0, std_b=1.0, scale=1.0, resize=0,
+                    path_imgidx=None, prefetch=True, data_name="data",
+                    label_name="softmax_label", label_width=1, **kwargs):
+    """C-iter-style facade over ``image.ImageIter`` (+ prefetch thread).
+
+    Reference: ``ImageRecordIter`` registered at
+    ``src/io/iter_image_recordio.cc:458`` with the decode→augment→batch→
+    prefetch decorator chain of §3.5; kwargs mirror its dmlc params
+    (``mean_r``..., ``rand_crop``, ``part_index``/``num_parts``...).
+    """
+    from .image import CreateAugmenter, ImageIter
+
+    known = ("brightness", "contrast", "saturation", "pca_noise",
+             "inter_method")
+    unknown = set(kwargs) - set(known)
+    if unknown:
+        raise TypeError("ImageRecordIter: unsupported parameters %s"
+                        % sorted(unknown))
+    mean = np.array([mean_r, mean_g, mean_b], np.float32)
+    std = np.array([std_r, std_g, std_b], np.float32)
+    aug_list = CreateAugmenter(
+        data_shape, resize=resize, rand_crop=rand_crop,
+        rand_mirror=rand_mirror,
+        mean=mean if mean.any() else None,
+        std=std if (std != 1.0).any() else None,
+        **kwargs)
+    if scale != 1.0:
+        aug_list.append(lambda img: img * scale)
+    it = ImageIter(batch_size, data_shape, label_width=label_width,
+                   path_imgrec=path_imgrec, path_imgidx=path_imgidx,
+                   shuffle=shuffle, part_index=part_index,
+                   num_parts=num_parts, aug_list=aug_list,
+                   data_name=data_name, label_name=label_name)
+    return PrefetchingIter(it) if prefetch else it

@@ -1,0 +1,273 @@
+"""RecordIO: binary-compatible dmlc record format + image record packing.
+
+Reference: ``python/mxnet/recordio.py`` (API) over dmlc-core's C++
+``RecordIOWriter/Reader`` (behavior recovered from call sites; the submodule
+is empty — SURVEY preamble).  Format, preserved bit-for-bit so ``.rec``
+shards interchange with the reference:
+
+  record := uint32 magic (0xced7230a)
+            uint32 lrec   (upper 3 bits: cflag, lower 29 bits: length)
+            payload[length]
+            pad to 4-byte boundary
+
+Payloads containing the magic are split at each occurrence into a chain of
+parts with cflag 1 (start) / 2 (middle) / 3 (end); cflag 0 marks a whole
+record.  ``MXIndexedRecordIO`` keeps a ``key\\tposition`` text index for
+random access (the reference's ``.idx`` files).
+
+The TPU angle: RecordIO is the host-side half of the input pipeline — packed
+shards are read/decoded/augmented on host CPU (``image.py``) and batches are
+fed to the chip asynchronously (``io.PrefetchingIter``), the analog of the
+reference's ``PrefetcherIter`` pinned-memory double buffering
+(``src/io/iter_prefetcher.h:49``).
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+from collections import namedtuple
+
+import numpy as np
+
+from .base import MXNetError
+
+__all__ = ["MXRecordIO", "MXIndexedRecordIO", "IRHeader",
+           "pack", "unpack", "pack_img", "unpack_img"]
+
+_KMAGIC = 0xced7230a
+_STRUCT_U32 = struct.Struct("<I")
+
+
+def _encode_lrec(cflag, length):
+    return (cflag << 29) | length
+
+
+def _decode_lrec(lrec):
+    return lrec >> 29, lrec & ((1 << 29) - 1)
+
+
+class MXRecordIO:
+    """Sequential RecordIO reader/writer (``flag`` = 'r' or 'w')."""
+
+    def __init__(self, uri, flag):
+        self.uri = uri
+        self.flag = flag
+        self.record = None
+        self.open()
+
+    def open(self):
+        if self.flag == "w":
+            self.record = open(self.uri, "wb")
+            self.writable = True
+        elif self.flag == "r":
+            self.record = open(self.uri, "rb")
+            self.writable = False
+        else:
+            raise MXNetError("invalid flag %r" % self.flag)
+        self.is_open = True
+
+    def close(self):
+        if self.is_open:
+            self.record.close()
+            self.is_open = False
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def __getstate__(self):
+        d = dict(self.__dict__)
+        d["record"] = None
+        d["_pos"] = self.record.tell() if self.is_open else 0
+        d["is_open"] = False
+        return d
+
+    def __setstate__(self, d):
+        pos = d.pop("_pos", 0)
+        self.__dict__.update(d)
+        self.open()
+        self.record.seek(pos)
+
+    def reset(self):
+        self.close()
+        self.open()
+
+    def tell(self):
+        return self.record.tell()
+
+    def write(self, buf):
+        """Write one record (bytes), splitting at embedded magics."""
+        assert self.writable
+        if not isinstance(buf, bytes):
+            buf = bytes(buf)
+        magic_bytes = _STRUCT_U32.pack(_KMAGIC)
+        # find magic occurrences to escape
+        parts = []
+        start = 0
+        while True:
+            i = buf.find(magic_bytes, start)
+            if i < 0:
+                parts.append(buf[start:])
+                break
+            parts.append(buf[start:i])
+            start = i + 4
+        n = len(parts)
+        for j, part in enumerate(parts):
+            if n == 1:
+                cflag = 0
+            elif j == 0:
+                cflag = 1
+            elif j == n - 1:
+                cflag = 3
+            else:
+                cflag = 2
+            self.record.write(magic_bytes)
+            self.record.write(_STRUCT_U32.pack(_encode_lrec(cflag, len(part))))
+            self.record.write(part)
+            pad = (4 - len(part) % 4) % 4
+            if pad:
+                self.record.write(b"\x00" * pad)
+
+    def _read_part(self):
+        head = self.record.read(4)
+        if len(head) < 4:
+            return None, None
+        magic, = _STRUCT_U32.unpack(head)
+        if magic != _KMAGIC:
+            raise MXNetError("invalid record magic %x at %d"
+                             % (magic, self.record.tell() - 4))
+        lrec, = _STRUCT_U32.unpack(self.record.read(4))
+        cflag, length = _decode_lrec(lrec)
+        data = self.record.read(length)
+        pad = (4 - length % 4) % 4
+        if pad:
+            self.record.read(pad)
+        return cflag, data
+
+    def read(self):
+        """Read one record; None at EOF."""
+        assert not self.writable
+        cflag, data = self._read_part()
+        if cflag is None:
+            return None
+        if cflag == 0:
+            return data
+        if cflag != 1:
+            raise MXNetError("corrupt record chain (cflag=%d)" % cflag)
+        magic_bytes = _STRUCT_U32.pack(_KMAGIC)
+        out = [data]
+        while True:
+            cflag, data = self._read_part()
+            if cflag is None:
+                raise MXNetError("EOF inside multi-part record")
+            out.append(magic_bytes)  # each split consumed one magic
+            out.append(data)
+            if cflag == 3:
+                break
+            if cflag != 2:
+                raise MXNetError("corrupt record chain (cflag=%d)" % cflag)
+        return b"".join(out)
+
+
+class MXIndexedRecordIO(MXRecordIO):
+    """RecordIO with a ``key\\tposition`` index for random access
+    (reference ``python/mxnet/recordio.py`` ``MXIndexedRecordIO``)."""
+
+    def __init__(self, idx_path, uri, flag, key_type=int):
+        self.idx_path = idx_path
+        self.idx = {}
+        self.keys = []
+        self.key_type = key_type
+        super().__init__(uri, flag)
+        if not self.writable and os.path.isfile(idx_path):
+            with open(idx_path) as f:
+                for line in f:
+                    parts = line.strip().split("\t")
+                    if len(parts) != 2:
+                        continue
+                    key = key_type(parts[0])
+                    self.idx[key] = int(parts[1])
+                    self.keys.append(key)
+
+    def close(self):
+        if self.is_open and self.writable:
+            with open(self.idx_path, "w") as f:
+                for key in self.keys:
+                    f.write("%s\t%d\n" % (key, self.idx[key]))
+        super().close()
+
+    def seek(self, idx):
+        assert not self.writable
+        self.record.seek(self.idx[idx])
+
+    def read_idx(self, idx):
+        self.seek(idx)
+        return self.read()
+
+    def write_idx(self, idx, buf):
+        key = self.key_type(idx)
+        pos = self.tell()
+        self.write(buf)
+        self.idx[key] = pos
+        self.keys.append(key)
+
+
+# ---------------------------------------------------------------------------
+# image record header (reference IRHeader: uint32 flag, float label,
+# uint64 id, uint64 id2 → '<IfQQ'; flag>0 appends flag extra label floats)
+# ---------------------------------------------------------------------------
+
+IRHeader = namedtuple("HEADER", ["flag", "label", "id", "id2"])
+_IR_FORMAT = "<IfQQ"
+_IR_SIZE = struct.calcsize(_IR_FORMAT)
+
+
+def pack(header, s):
+    """Pack IRHeader + byte payload into one record buffer."""
+    header = IRHeader(*header)
+    if isinstance(header.label, (int, float)):
+        hdr = struct.pack(_IR_FORMAT, header.flag, float(header.label),
+                          header.id, header.id2)
+        return hdr + s
+    label = np.asarray(header.label, dtype=np.float32)
+    hdr = struct.pack(_IR_FORMAT, label.size, 0.0, header.id, header.id2)
+    return hdr + label.tobytes() + s
+
+
+def unpack(s):
+    """Unpack a record buffer into (IRHeader, payload bytes)."""
+    flag, label, id_, id2 = struct.unpack(_IR_FORMAT, s[:_IR_SIZE])
+    s = s[_IR_SIZE:]
+    if flag > 0:
+        label = np.frombuffer(s[:flag * 4], dtype=np.float32)
+        s = s[flag * 4:]
+    header = IRHeader(flag, label, id_, id2)
+    return header, s
+
+
+def pack_img(header, img, quality=95, img_fmt=".jpg"):
+    """Encode HWC uint8 image (BGR, as OpenCV) and pack with header."""
+    import cv2
+
+    if img_fmt in (".jpg", ".jpeg"):
+        encode_params = [cv2.IMWRITE_JPEG_QUALITY, quality]
+    elif img_fmt == ".png":
+        encode_params = [cv2.IMWRITE_PNG_COMPRESSION, quality]
+    else:
+        encode_params = None
+    ret, buf = cv2.imencode(img_fmt, img, encode_params)
+    if not ret:
+        raise MXNetError("failed to encode image")
+    return pack(header, buf.tobytes())
+
+
+def unpack_img(s, iscolor=1):
+    """Unpack a record into (IRHeader, decoded HWC uint8 BGR image)."""
+    import cv2
+
+    header, img_bytes = unpack(s)
+    img = cv2.imdecode(np.frombuffer(img_bytes, dtype=np.uint8), iscolor)
+    return header, img

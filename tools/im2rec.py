@@ -1,0 +1,112 @@
+#!/usr/bin/env python
+"""Build RecordIO shards from an image list/directory.
+
+Reference: ``tools/im2rec.py`` / ``tools/im2rec.cc`` — packs (label, jpeg)
+records into ``.rec`` + ``.idx`` for ``ImageRecordIter``.
+
+Usage:
+  python tools/im2rec.py --list prefix root     # make prefix.lst from root/
+  python tools/im2rec.py prefix root            # pack prefix.lst -> .rec/.idx
+List lines: ``index\\tlabel[\\tlabel2...]\\trelative_path``.
+"""
+
+import argparse
+import os
+import random
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from mxnet_tpu import recordio  # noqa: E402
+
+_EXTS = (".jpg", ".jpeg", ".png")
+
+
+def make_list(prefix, root, recursive=True, train_ratio=1.0, shuffle=True):
+    image_list = []
+    label_map = {}
+    for dirpath, dirnames, filenames in sorted(os.walk(root)):
+        if not recursive and dirpath != root:
+            continue
+        for fname in sorted(filenames):
+            if os.path.splitext(fname)[1].lower() not in _EXTS:
+                continue
+            rel = os.path.relpath(os.path.join(dirpath, fname), root)
+            cat = os.path.dirname(rel) or "."
+            label = label_map.setdefault(cat, len(label_map))
+            image_list.append((label, rel))
+    if shuffle:
+        random.seed(407)
+        random.shuffle(image_list)
+    n_train = int(len(image_list) * train_ratio)
+    chunks = [("", image_list[:n_train])]
+    if train_ratio < 1.0:
+        chunks = [("_train", image_list[:n_train]),
+                  ("_val", image_list[n_train:])]
+    for suffix, chunk in chunks:
+        with open(prefix + suffix + ".lst", "w") as f:
+            for i, (label, rel) in enumerate(chunk):
+                f.write("%d\t%d\t%s\n" % (i, label, rel))
+    return label_map
+
+
+def read_list(path_in):
+    with open(path_in) as f:
+        for line in f:
+            parts = line.strip().split("\t")
+            if len(parts) < 3:
+                continue
+            yield int(parts[0]), [float(x) for x in parts[1:-1]], parts[-1]
+
+
+def pack(prefix, root, quality=95, resize=0, color=1):
+    import cv2
+
+    rec = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "w")
+    count = 0
+    for idx, labels, rel in read_list(prefix + ".lst"):
+        path = os.path.join(root, rel)
+        img = cv2.imread(path, color)
+        if img is None:
+            print("imread failed: %s" % path, file=sys.stderr)
+            continue
+        if resize:
+            h, w = img.shape[:2]
+            if h > w:
+                img = cv2.resize(img, (resize, int(h * resize / w)))
+            else:
+                img = cv2.resize(img, (int(w * resize / h), resize))
+        label = labels[0] if len(labels) == 1 else labels
+        header = recordio.IRHeader(0, label, idx, 0)
+        rec.write_idx(idx, recordio.pack_img(header, img, quality=quality))
+        count += 1
+    rec.close()
+    print("packed %d records -> %s.rec" % (count, prefix))
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("prefix")
+    p.add_argument("root")
+    p.add_argument("--list", action="store_true",
+                   help="make the .lst file instead of packing")
+    p.add_argument("--no-recursive", action="store_true")
+    p.add_argument("--train-ratio", type=float, default=1.0)
+    p.add_argument("--no-shuffle", action="store_true")
+    p.add_argument("--quality", type=int, default=95)
+    p.add_argument("--resize", type=int, default=0)
+    p.add_argument("--color", type=int, default=1)
+    args = p.parse_args()
+    if args.list:
+        label_map = make_list(args.prefix, args.root,
+                              recursive=not args.no_recursive,
+                              train_ratio=args.train_ratio,
+                              shuffle=not args.no_shuffle)
+        print("labels:", label_map)
+    else:
+        pack(args.prefix, args.root, quality=args.quality,
+             resize=args.resize, color=args.color)
+
+
+if __name__ == "__main__":
+    main()
