@@ -279,8 +279,21 @@ class ImageIter(DataIter):
                  path_imgrec=None, path_imglist=None, path_root=None,
                  path_imgidx=None, shuffle=False, part_index=0, num_parts=1,
                  aug_list=None, imglist=None, data_name="data",
-                 label_name="softmax_label", last_batch_handle="pad", **kwargs):
+                 label_name="softmax_label", last_batch_handle="pad",
+                 preprocess_threads=1, **kwargs):
         super().__init__(batch_size)
+        # parallel decode/augment on the native engine's worker pool
+        # (the C++ ImageRecordIter's preprocess_threads,
+        # iter_image_recordio.cc) — cv2 releases the GIL during decode
+        self._engine = None
+        if preprocess_threads > 1:
+            try:
+                from .native import Engine
+
+                self._engine = Engine(num_workers=preprocess_threads)
+            except RuntimeError:
+                logging.warning("native engine unavailable; "
+                                "decoding on one thread")
         assert path_imgrec or path_imglist or imglist is not None, \
             "one of path_imgrec / path_imglist / imglist is required"
         self.data_shape = tuple(data_shape)
@@ -293,16 +306,19 @@ class ImageIter(DataIter):
 
         if path_imgrec:
             idx_path = path_imgidx or os.path.splitext(path_imgrec)[0] + ".idx"
-            if os.path.isfile(idx_path):
-                self.imgrec = recordio.MXIndexedRecordIO(
-                    idx_path, path_imgrec, "r")
+            # MXIndexedRecordIO rebuilds a positional index via the native
+            # scanner when the .idx file is missing
+            self.imgrec = recordio.MXIndexedRecordIO(
+                idx_path, path_imgrec, "r")
+            if self.imgrec.keys:
                 self.seq = list(self.imgrec.keys)
             else:
                 if shuffle or num_parts > 1:
                     raise MXNetError(
-                        "shuffle/num_parts>1 require an index file (%s); "
-                        "build it with tools/im2rec.py" % idx_path)
-                # no index: sequential-only access
+                        "shuffle/num_parts>1 require an index (missing %s "
+                        "and the native scanner is unavailable); build one "
+                        "with tools/im2rec.py" % idx_path)
+                # no index at all: sequential-only access
                 self.imgrec = recordio.MXRecordIO(path_imgrec, "r")
                 self.seq = None
         elif path_imglist:
@@ -359,7 +375,8 @@ class ImageIter(DataIter):
             self.imgrec.reset()
         self.cursor = 0
 
-    def _read_one(self):
+    def _read_raw(self):
+        """Fetch one (encoded bytes, label) — file IO only, main thread."""
         if self.imgrec is not None:
             if self.seq is not None:
                 if self.cursor >= len(self.seq):
@@ -371,20 +388,27 @@ class ImageIter(DataIter):
                     return None
             self.cursor += 1
             header, img_bytes = recordio.unpack(rec)
-            img = imdecode(img_bytes)
-            label = header.label
-        else:
-            if self.cursor >= len(self.seq):
-                return None
-            label, fname = self.imglist[self.seq[self.cursor]]
-            self.cursor += 1
-            path = os.path.join(self.path_root, fname) if self.path_root \
-                else fname
-            with open(path, "rb") as f:
-                img = imdecode(f.read())
+            return img_bytes, header.label
+        if self.cursor >= len(self.seq):
+            return None
+        label, fname = self.imglist[self.seq[self.cursor]]
+        self.cursor += 1
+        path = os.path.join(self.path_root, fname) if self.path_root \
+            else fname
+        with open(path, "rb") as f:
+            return f.read(), label
+
+    def _decode_augment(self, img_bytes):
+        img = imdecode(img_bytes)
         for aug in self.aug_list:
             img = aug(img)
-        return img, label
+        return img
+
+    def _read_one(self):
+        item = self._read_raw()
+        if item is None:
+            return None
+        return self._decode_augment(item[0]), item[1]
 
     def next(self):
         c, h, w = self.data_shape
@@ -393,25 +417,52 @@ class ImageIter(DataIter):
             label = np.zeros((self.batch_size,), np.float32)
         else:
             label = np.zeros((self.batch_size, self.label_width), np.float32)
+        def fill(i, img, lbl):
+            if img.ndim == 2:
+                img = img[:, :, None]
+            data[i] = np.asarray(img, np.float32).transpose(2, 0, 1)
+            lbl = np.asarray(lbl).reshape(-1)
+            if self.label_width == 1:
+                label[i] = lbl[0]
+            else:
+                label[i] = lbl[:self.label_width]
+
         i = 0
-        try:
-            while i < self.batch_size:
-                item = self._read_one()
+        if self._engine is not None:
+            # raw reads on this thread, decode+augment fanned out to the
+            # native engine workers; slots are disjoint → no mutable deps
+            raws = []
+            while len(raws) < self.batch_size:
+                item = self._read_raw()
                 if item is None:
-                    raise StopIteration
-                img, lbl = item
-                if img.ndim == 2:
-                    img = img[:, :, None]
-                data[i] = np.asarray(img, np.float32).transpose(2, 0, 1)
-                lbl = np.asarray(lbl).reshape(-1)
-                if self.label_width == 1:
-                    label[i] = lbl[0]
-                else:
-                    label[i] = lbl[:self.label_width]
-                i += 1
-        except StopIteration:
-            if i == 0:
-                raise
+                    break
+                raws.append(item)
+            if not raws:
+                raise StopIteration
+            errors = []
+            for j, (img_bytes, lbl) in enumerate(raws):
+                def work(j=j, img_bytes=img_bytes, lbl=lbl):
+                    try:
+                        fill(j, self._decode_augment(img_bytes), lbl)
+                    except Exception as e:  # surfaced after wait
+                        errors.append(e)
+                self._engine.push(work)
+            self._engine.wait_for_all()
+            if errors:
+                raise errors[0]
+            i = len(raws)
+        else:
+            try:
+                while i < self.batch_size:
+                    item = self._read_one()
+                    if item is None:
+                        raise StopIteration
+                    img, lbl = item
+                    fill(i, img, lbl)
+                    i += 1
+            except StopIteration:
+                if i == 0:
+                    raise
         pad = self.batch_size - i
         if pad:  # pad with the last valid sample (reference pad semantics)
             for j in range(i, self.batch_size):

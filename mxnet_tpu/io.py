@@ -444,7 +444,8 @@ def ImageRecordIter(path_imgrec, data_shape, batch_size, shuffle=False,
                     rand_mirror=False, mean_r=0.0, mean_g=0.0, mean_b=0.0,
                     std_r=1.0, std_g=1.0, std_b=1.0, scale=1.0, resize=0,
                     path_imgidx=None, prefetch=True, data_name="data",
-                    label_name="softmax_label", label_width=1, **kwargs):
+                    label_name="softmax_label", label_width=1,
+                    preprocess_threads=1, **kwargs):
     """C-iter-style facade over ``image.ImageIter`` (+ prefetch thread).
 
     Reference: ``ImageRecordIter`` registered at
@@ -474,5 +475,6 @@ def ImageRecordIter(path_imgrec, data_shape, batch_size, shuffle=False,
                    path_imgrec=path_imgrec, path_imgidx=path_imgidx,
                    shuffle=shuffle, part_index=part_index,
                    num_parts=num_parts, aug_list=aug_list,
-                   data_name=data_name, label_name=label_name)
+                   data_name=data_name, label_name=label_name,
+                   preprocess_threads=preprocess_threads)
     return PrefetchingIter(it) if prefetch else it

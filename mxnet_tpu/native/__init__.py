@@ -1,0 +1,238 @@
+"""ctypes bindings for the native host runtime (src/native.cc).
+
+The reference reaches its C++ runtime through a C ABI + ctypes
+(``python/mxnet/base.py`` loads libmxnet.so; ``include/mxnet/c_api.h``).
+Same shape here: ``src/native.cc`` is compiled once into
+``libmxnet_tpu_native.so`` (lazy, cached) and loaded with ctypes — no
+pybind11 dependency.
+
+Exposes:
+  * :class:`Engine` — host-side async var-dependency scheduler
+    (``MXNET_ENGINE_TYPE=NaiveEngine`` selects synchronous dispatch, the
+    reference's debugging story — SURVEY §5.2).
+  * :class:`PooledStorage` — size-bucketed host buffer pool.
+  * :func:`recordio_scan` — fast .rec boundary scan for .idx rebuilds.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(os.path.dirname(os.path.dirname(_HERE)), "src",
+                    "native.cc")
+_LIB_PATH = os.path.join(_HERE, "libmxnet_tpu_native.so")
+
+_lib = None
+_lib_lock = threading.Lock()
+
+_ENGINE_FN = ctypes.CFUNCTYPE(None, ctypes.c_void_p)
+
+
+def _build():
+    # build to a per-pid temp path and rename atomically: concurrent
+    # processes (SPMD workers) may race on the first build
+    tmp = "%s.%d.tmp" % (_LIB_PATH, os.getpid())
+    cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
+           _SRC, "-o", tmp]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, text=True)
+        os.replace(tmp, _LIB_PATH)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+
+
+def get_lib():
+    """Load (building if needed) the native library; None if unavailable."""
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        try:
+            if (not os.path.isfile(_LIB_PATH)
+                    or os.path.getmtime(_LIB_PATH) < os.path.getmtime(_SRC)):
+                _build()
+            lib = ctypes.CDLL(_LIB_PATH)
+        except (OSError, subprocess.CalledProcessError):
+            return None
+        lib.EngineCreate.restype = ctypes.c_void_p
+        lib.EngineCreate.argtypes = [ctypes.c_int, ctypes.c_int]
+        lib.EngineFree.argtypes = [ctypes.c_void_p]
+        lib.EngineNewVar.restype = ctypes.c_void_p
+        lib.EngineNewVar.argtypes = [ctypes.c_void_p]
+        lib.EnginePush.argtypes = [
+            ctypes.c_void_p, _ENGINE_FN, ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_void_p), ctypes.c_int,
+            ctypes.POINTER(ctypes.c_void_p), ctypes.c_int]
+        lib.EngineWaitForVar.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
+        lib.EngineWaitForAll.argtypes = [ctypes.c_void_p]
+        lib.StorageCreate.restype = ctypes.c_void_p
+        lib.StorageFree.argtypes = [ctypes.c_void_p]
+        lib.StorageAlloc.restype = ctypes.c_void_p
+        lib.StorageAlloc.argtypes = [ctypes.c_void_p, ctypes.c_size_t]
+        lib.StorageRelease.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                       ctypes.c_size_t]
+        lib.StorageDirectFree.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                          ctypes.c_size_t]
+        lib.StorageReleaseAll.argtypes = [ctypes.c_void_p]
+        lib.StorageUsedBytes.restype = ctypes.c_size_t
+        lib.StorageUsedBytes.argtypes = [ctypes.c_void_p]
+        lib.StoragePooledBytes.restype = ctypes.c_size_t
+        lib.StoragePooledBytes.argtypes = [ctypes.c_void_p]
+        lib.MXRecordIOScan.restype = ctypes.c_long
+        lib.MXRecordIOScan.argtypes = [ctypes.c_char_p,
+                                       ctypes.POINTER(ctypes.c_int64),
+                                       ctypes.c_long]
+        _lib = lib
+        return _lib
+
+
+class Engine:
+    """Async host scheduler with read/write var dependencies.
+
+    ``push(fn, const_vars, mutable_vars)`` — fn() runs on a worker thread
+    once all prior writers of const_vars and all prior ops on mutable_vars
+    finished; writers of a var are serialized, readers run concurrently.
+    """
+
+    def __init__(self, num_workers=None, engine_type=None):
+        lib = get_lib()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        if engine_type is None:
+            engine_type = os.environ.get("MXNET_ENGINE_TYPE",
+                                         "ThreadedEngine")
+        naive = 1 if engine_type == "NaiveEngine" else 0
+        if num_workers is None:
+            num_workers = int(os.environ.get("MXNET_CPU_WORKER_NTHREADS",
+                                             "4"))
+        self._lib = lib
+        self._h = lib.EngineCreate(num_workers, naive)
+        # keep callbacks alive until executed
+        self._cbs = {}
+        self._cb_lock = threading.Lock()
+        self._cb_id = 0
+
+    def new_var(self):
+        return self._lib.EngineNewVar(self._h)
+
+    def push(self, fn, const_vars=(), mutable_vars=()):
+        with self._cb_lock:
+            self._cb_id += 1
+            cid = self._cb_id
+
+        def run(_ctx, _cid=cid, _fn=fn):
+            try:
+                _fn()
+            finally:
+                with self._cb_lock:
+                    self._cbs.pop(_cid, None)
+
+        cb = _ENGINE_FN(run)
+        with self._cb_lock:
+            self._cbs[cid] = cb
+        nc, nm = len(const_vars), len(mutable_vars)
+        carr = (ctypes.c_void_p * max(nc, 1))(*const_vars)
+        marr = (ctypes.c_void_p * max(nm, 1))(*mutable_vars)
+        self._lib.EnginePush(self._h, cb, None, carr, nc, marr, nm)
+
+    def wait_for_var(self, var):
+        self._lib.EngineWaitForVar(self._h, var)
+
+    def wait_for_all(self):
+        self._lib.EngineWaitForAll(self._h)
+
+    def close(self):
+        if self._h:
+            self._lib.EngineWaitForAll(self._h)
+            self._lib.EngineFree(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class PooledStorage:
+    """Size-bucketed host memory pool (GPUPooledStorageManager analog)."""
+
+    def __init__(self):
+        lib = get_lib()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        self._lib = lib
+        self._h = lib.StorageCreate()
+
+    def alloc(self, size):
+        p = self._lib.StorageAlloc(self._h, size)
+        if not p:
+            raise MemoryError("native alloc of %d bytes failed" % size)
+        return p
+
+    def free(self, ptr, size):
+        """Return buffer to the pool for reuse."""
+        self._lib.StorageRelease(self._h, ptr, size)
+
+    def direct_free(self, ptr, size):
+        self._lib.StorageDirectFree(self._h, ptr, size)
+
+    def release_all(self):
+        self._lib.StorageReleaseAll(self._h)
+
+    @property
+    def used_bytes(self):
+        return self._lib.StorageUsedBytes(self._h)
+
+    @property
+    def pooled_bytes(self):
+        return self._lib.StoragePooledBytes(self._h)
+
+    def close(self):
+        if self._h:
+            self._lib.StorageFree(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def recordio_scan(path):
+    """Return record start offsets of a .rec file (native scan); None if
+    the native library is unavailable."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    # counting pass (offsets=NULL), then an exact-size offsets pass
+    n = lib.MXRecordIOScan(path.encode(), None, 0)
+    if n < 0:
+        raise IOError("corrupt RecordIO file: %s" % path)
+    if n == 0:
+        return []
+    arr = (ctypes.c_int64 * n)()
+    n2 = lib.MXRecordIOScan(path.encode(), arr, n)
+    if n2 != n:
+        raise IOError("RecordIO file changed during scan: %s" % path)
+    return list(arr)
+
+
+_default_engine = None
+_default_engine_lock = threading.Lock()
+
+
+def default_engine():
+    """Process-wide engine singleton (Engine::Get analog); None if the
+    native toolchain is unavailable."""
+    global _default_engine
+    with _default_engine_lock:
+        if _default_engine is None and get_lib() is not None:
+            _default_engine = Engine()
+        return _default_engine

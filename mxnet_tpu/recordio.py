@@ -182,15 +182,32 @@ class MXIndexedRecordIO(MXRecordIO):
         self.keys = []
         self.key_type = key_type
         super().__init__(uri, flag)
-        if not self.writable and os.path.isfile(idx_path):
-            with open(idx_path) as f:
-                for line in f:
-                    parts = line.strip().split("\t")
-                    if len(parts) != 2:
-                        continue
-                    key = key_type(parts[0])
-                    self.idx[key] = int(parts[1])
-                    self.keys.append(key)
+        if not self.writable:
+            if os.path.isfile(idx_path):
+                with open(idx_path) as f:
+                    for line in f:
+                        parts = line.strip().split("\t")
+                        if len(parts) != 2:
+                            continue
+                        key = key_type(parts[0])
+                        self.idx[key] = int(parts[1])
+                        self.keys.append(key)
+            else:
+                # no .idx: rebuild positions with the native boundary
+                # scanner (src/native.cc MXRecordIOScan); keys become 0..n-1
+                from .native import recordio_scan
+
+                try:
+                    offsets = recordio_scan(uri)
+                except IOError:
+                    # corrupt/truncated shard: leave keys empty so callers
+                    # fall back to sequential MXRecordIO access
+                    offsets = None
+                if offsets is not None:
+                    for i, off in enumerate(offsets):
+                        key = key_type(i)
+                        self.idx[key] = off
+                        self.keys.append(key)
 
     def close(self):
         if self.is_open and self.writable:

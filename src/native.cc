@@ -1,0 +1,414 @@
+// Native host runtime: dependency engine + pooled storage + RecordIO scanner.
+//
+// TPU-native re-design of the reference's C++ runtime trio:
+//  * engine  — the async var-dependency scheduler (reference
+//    src/engine/threaded_engine.{h,cc}: ThreadedVar pending-read queues +
+//    single pending write; src/engine/threaded_engine_perdevice.cc worker
+//    pools).  On TPU the *device* schedule belongs to XLA/PJRT async
+//    dispatch; this engine orders the HOST side — decode/augment tasks,
+//    checkpoint writes, callback execution — with the same read/write-var
+//    semantics, so io pipelines overlap with device steps.
+//  * storage — size-bucketed pooled host allocator (reference
+//    src/storage/pooled_storage_manager.h: free-list pool, release-all on
+//    pressure) for staging buffers that feed device transfers.
+//  * recordio — dmlc RecordIO boundary scanner (reference dmlc-core reader;
+//    format: magic 0xced7230a + cflag/len word) for fast .idx rebuilds.
+//
+// Exposed as a minimal C ABI (the include/mxnet/c_api.h analog) consumed by
+// ctypes in mxnet_tpu/native/__init__.py.
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <deque>
+#include <map>
+#include <mutex>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+extern "C" {
+typedef void (*EngineFnPtr)(void* ctx);
+}
+
+namespace {
+
+// ---------------------------------------------------------------------------
+// Engine
+// ---------------------------------------------------------------------------
+
+struct Opr;
+
+struct VarQueueEntry {
+  Opr* opr;
+  bool is_write;
+};
+
+struct Var {
+  std::mutex mu;
+  std::deque<VarQueueEntry> queue;
+  int running_reads = 0;
+  bool running_write = false;
+  uint64_t version = 0;  // bumped per completed write (debug/fence aid)
+};
+
+struct Opr {
+  EngineFnPtr fn;
+  void* ctx;
+  std::vector<Var*> const_vars;
+  std::vector<Var*> mut_vars;
+  std::atomic<int> wait{0};
+};
+
+class Engine {
+ public:
+  explicit Engine(int num_workers, bool naive)
+      : naive_(naive), stop_(false), outstanding_(0) {
+    if (!naive_) {
+      if (num_workers <= 0) num_workers = 4;
+      for (int i = 0; i < num_workers; ++i) {
+        workers_.emplace_back([this]() { WorkerLoop(); });
+      }
+    }
+  }
+
+  ~Engine() {
+    WaitForAll();
+    {
+      std::unique_lock<std::mutex> lk(task_mu_);
+      stop_ = true;
+    }
+    task_cv_.notify_all();
+    for (auto& t : workers_) t.join();
+    for (Var* v : all_vars_) delete v;
+  }
+
+  Var* NewVar() {
+    std::lock_guard<std::mutex> lk(vars_mu_);
+    Var* v = new Var();
+    all_vars_.push_back(v);
+    return v;
+  }
+
+  void Push(EngineFnPtr fn, void* ctx, Var** cvars, int nc, Var** mvars,
+            int nm) {
+    if (naive_) {
+      fn(ctx);
+      return;
+    }
+    Opr* op = new Opr();
+    op->fn = fn;
+    op->ctx = ctx;
+    op->const_vars.assign(cvars, cvars + nc);
+    op->mut_vars.assign(mvars, mvars + nm);
+    outstanding_.fetch_add(1);
+    // each dependency appends to its var's queue; grant count tracked in
+    // op->wait (reference ThreadedVar::AppendReadDependency semantics).
+    // The append phase is serialized so every var sees pushes in the same
+    // global order — without this, two concurrent pushers could enqueue
+    // {A before B} on var X but {B before A} on var Y: a dependency cycle.
+    std::lock_guard<std::mutex> push_lk(push_mu_);
+    op->wait.store(nc + nm + 1);
+    for (Var* v : op->const_vars) {
+      std::lock_guard<std::mutex> lk(v->mu);
+      if (!v->running_write && v->queue.empty()) {
+        v->running_reads++;
+        op->wait.fetch_sub(1);
+      } else {
+        v->queue.push_back({op, false});
+      }
+    }
+    for (Var* v : op->mut_vars) {
+      std::lock_guard<std::mutex> lk(v->mu);
+      if (!v->running_write && v->running_reads == 0 && v->queue.empty()) {
+        v->running_write = true;
+        op->wait.fetch_sub(1);
+      } else {
+        v->queue.push_back({op, true});
+      }
+    }
+    if (op->wait.fetch_sub(1) == 1) Enqueue(op);
+  }
+
+  void WaitForVar(Var* var) {
+    if (naive_) return;
+    std::mutex done_mu;
+    std::condition_variable done_cv;
+    bool done = false;
+    struct WaitCtx {
+      std::mutex* mu;
+      std::condition_variable* cv;
+      bool* done;
+    } wctx{&done_mu, &done_cv, &done};
+    auto fn = [](void* p) {
+      WaitCtx* w = static_cast<WaitCtx*>(p);
+      std::lock_guard<std::mutex> lk(*w->mu);
+      *w->done = true;
+      w->cv->notify_all();
+    };
+    Var* vars[1] = {var};
+    Push(fn, &wctx, vars, 1, nullptr, 0);
+    std::unique_lock<std::mutex> lk(done_mu);
+    done_cv.wait(lk, [&]() { return done; });
+  }
+
+  void WaitForAll() {
+    if (naive_) return;
+    std::unique_lock<std::mutex> lk(idle_mu_);
+    idle_cv_.wait(lk, [this]() { return outstanding_.load() == 0; });
+  }
+
+ private:
+  void Enqueue(Opr* op) {
+    {
+      std::lock_guard<std::mutex> lk(task_mu_);
+      tasks_.push_back(op);
+    }
+    task_cv_.notify_one();
+  }
+
+  void WorkerLoop() {
+    while (true) {
+      Opr* op;
+      {
+        std::unique_lock<std::mutex> lk(task_mu_);
+        task_cv_.wait(lk, [this]() { return stop_ || !tasks_.empty(); });
+        if (stop_ && tasks_.empty()) return;
+        op = tasks_.front();
+        tasks_.pop_front();
+      }
+      op->fn(op->ctx);
+      OnComplete(op);
+    }
+  }
+
+  void OnComplete(Opr* op) {
+    std::vector<Opr*> ready;
+    for (Var* v : op->const_vars) {
+      std::lock_guard<std::mutex> lk(v->mu);
+      if (--v->running_reads == 0 && !v->queue.empty() &&
+          v->queue.front().is_write) {
+        VarQueueEntry e = v->queue.front();
+        v->queue.pop_front();
+        v->running_write = true;
+        if (e.opr->wait.fetch_sub(1) == 1) ready.push_back(e.opr);
+      }
+    }
+    for (Var* v : op->mut_vars) {
+      std::lock_guard<std::mutex> lk(v->mu);
+      v->running_write = false;
+      v->version++;
+      // grant: either one writer, or every leading reader
+      while (!v->queue.empty()) {
+        VarQueueEntry e = v->queue.front();
+        if (e.is_write) {
+          if (v->running_reads == 0) {
+            v->queue.pop_front();
+            v->running_write = true;
+            if (e.opr->wait.fetch_sub(1) == 1) ready.push_back(e.opr);
+          }
+          break;
+        }
+        v->queue.pop_front();
+        v->running_reads++;
+        if (e.opr->wait.fetch_sub(1) == 1) ready.push_back(e.opr);
+      }
+    }
+    for (Opr* r : ready) Enqueue(r);
+    delete op;
+    if (outstanding_.fetch_sub(1) == 1) {
+      std::lock_guard<std::mutex> lk(idle_mu_);
+      idle_cv_.notify_all();
+    }
+  }
+
+  bool naive_;
+  bool stop_;
+  std::vector<std::thread> workers_;
+  std::deque<Opr*> tasks_;
+  std::mutex task_mu_;
+  std::condition_variable task_cv_;
+  std::mutex idle_mu_;
+  std::condition_variable idle_cv_;
+  std::atomic<long> outstanding_;
+  std::mutex push_mu_;
+  std::mutex vars_mu_;
+  std::vector<Var*> all_vars_;
+};
+
+// ---------------------------------------------------------------------------
+// Pooled storage
+// ---------------------------------------------------------------------------
+
+class PooledStorage {
+ public:
+  ~PooledStorage() { ReleaseAll(); }
+
+  void* Alloc(size_t size) {
+    size_t bucket = RoundUp(size);
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      auto it = pool_.find(bucket);
+      if (it != pool_.end() && !it->second.empty()) {
+        void* p = it->second.back();
+        it->second.pop_back();
+        pooled_bytes_ -= bucket;
+        used_bytes_ += bucket;
+        return p;
+      }
+    }
+    void* p = std::malloc(bucket);
+    if (p == nullptr) {
+      // reference GPUPooledStorageManager: on OOM, free the whole pool
+      // and retry once (pooled_storage_manager.h:79)
+      ReleaseAll();
+      p = std::malloc(bucket);
+      if (p == nullptr) return nullptr;
+    }
+    std::lock_guard<std::mutex> lk(mu_);
+    used_bytes_ += bucket;
+    return p;
+  }
+
+  void Free(void* ptr, size_t size) {
+    size_t bucket = RoundUp(size);
+    std::lock_guard<std::mutex> lk(mu_);
+    pool_[bucket].push_back(ptr);
+    pooled_bytes_ += bucket;
+    used_bytes_ -= bucket;
+  }
+
+  void DirectFree(void* ptr, size_t size) {
+    std::free(ptr);
+    std::lock_guard<std::mutex> lk(mu_);
+    used_bytes_ -= RoundUp(size);
+  }
+
+  void ReleaseAll() {
+    std::lock_guard<std::mutex> lk(mu_);
+    for (auto& kv : pool_)
+      for (void* p : kv.second) std::free(p);
+    pool_.clear();
+    pooled_bytes_ = 0;
+  }
+
+  size_t used_bytes() {
+    std::lock_guard<std::mutex> lk(mu_);
+    return used_bytes_;
+  }
+  size_t pooled_bytes() {
+    std::lock_guard<std::mutex> lk(mu_);
+    return pooled_bytes_;
+  }
+
+ private:
+  static size_t RoundUp(size_t size) {
+    if (size < 32) return 32;
+    size_t b = 32;
+    while (b < size) b <<= 1;
+    return b;
+  }
+
+  std::mutex mu_;
+  std::map<size_t, std::vector<void*>> pool_;
+  size_t used_bytes_ = 0;
+  size_t pooled_bytes_ = 0;
+};
+
+// ---------------------------------------------------------------------------
+// RecordIO scanner
+// ---------------------------------------------------------------------------
+
+constexpr uint32_t kMagic = 0xced7230a;
+
+// Scans record boundaries; writes up to max_n offsets of record STARTS
+// (multi-part chains count once).  Returns count, or -1 on format error.
+long RecordIOScan(const char* path, int64_t* offsets, long max_n) {
+  FILE* f = std::fopen(path, "rb");
+  if (f == nullptr) return -1;
+  long count = 0;
+  int64_t pos = 0;
+  bool in_chain = false;
+  while (true) {
+    uint32_t magic, lrec;
+    if (std::fread(&magic, 4, 1, f) != 1) break;
+    if (magic != kMagic) {
+      std::fclose(f);
+      return -1;
+    }
+    if (std::fread(&lrec, 4, 1, f) != 1) {
+      std::fclose(f);
+      return -1;
+    }
+    uint32_t cflag = lrec >> 29;
+    uint32_t len = lrec & ((1u << 29) - 1);
+    if (!in_chain) {
+      if (count < max_n && offsets != nullptr) offsets[count] = pos;
+      ++count;
+      if (cflag == 1) in_chain = true;
+    } else if (cflag == 3) {
+      in_chain = false;
+    }
+    uint32_t padded = len + ((4 - len % 4) % 4);
+    if (std::fseek(f, padded, SEEK_CUR) != 0) {
+      std::fclose(f);
+      return -1;
+    }
+    pos = std::ftell(f);
+  }
+  std::fclose(f);
+  return count;
+}
+
+}  // namespace
+
+// ---------------------------------------------------------------------------
+// C ABI
+// ---------------------------------------------------------------------------
+
+extern "C" {
+
+void* EngineCreate(int num_workers, int naive) {
+  return new Engine(num_workers, naive != 0);
+}
+void EngineFree(void* h) { delete static_cast<Engine*>(h); }
+void* EngineNewVar(void* h) { return static_cast<Engine*>(h)->NewVar(); }
+void EnginePush(void* h, EngineFnPtr fn, void* ctx, void** cvars, int nc,
+                void** mvars, int nm) {
+  static_cast<Engine*>(h)->Push(fn, ctx, reinterpret_cast<Var**>(cvars), nc,
+                                reinterpret_cast<Var**>(mvars), nm);
+}
+void EngineWaitForVar(void* h, void* var) {
+  static_cast<Engine*>(h)->WaitForVar(static_cast<Var*>(var));
+}
+void EngineWaitForAll(void* h) { static_cast<Engine*>(h)->WaitForAll(); }
+
+void* StorageCreate() { return new PooledStorage(); }
+void StorageFree(void* h) { delete static_cast<PooledStorage*>(h); }
+void* StorageAlloc(void* h, size_t size) {
+  return static_cast<PooledStorage*>(h)->Alloc(size);
+}
+void StorageRelease(void* h, void* ptr, size_t size) {
+  static_cast<PooledStorage*>(h)->Free(ptr, size);
+}
+void StorageDirectFree(void* h, void* ptr, size_t size) {
+  static_cast<PooledStorage*>(h)->DirectFree(ptr, size);
+}
+void StorageReleaseAll(void* h) {
+  static_cast<PooledStorage*>(h)->ReleaseAll();
+}
+size_t StorageUsedBytes(void* h) {
+  return static_cast<PooledStorage*>(h)->used_bytes();
+}
+size_t StoragePooledBytes(void* h) {
+  return static_cast<PooledStorage*>(h)->pooled_bytes();
+}
+
+long MXRecordIOScan(const char* path, int64_t* offsets, long max_n) {
+  return RecordIOScan(path, offsets, max_n);
+}
+
+}  // extern "C"
