@@ -52,3 +52,9 @@ from . import model
 from .model import FeedForward
 
 from . import test_utils
+
+# DMLC_ROLE=server processes become parameter servers on import (reference
+# python/mxnet/kvstore_server.py _init_kvstore_server_module)
+from .kvstore_server import _init_kvstore_server_module as _srv_init
+_srv_init()
+del _srv_init

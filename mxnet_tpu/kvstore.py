@@ -11,10 +11,13 @@ TPU-native mapping (SURVEY §5.8): there is no parameter server —
   are summed on device (the ``CommDevice`` analog; on a TPU mesh the sum is
   an XLA ``psum`` compiled into the step — see ``parallel/``), and the
   updater runs on the stored copy.
-* ``dist_sync`` / ``dist_async``: multi-process over DCN via
-  ``jax.distributed`` + host collectives.  ``dist_async`` has no collective
-  analog (SURVEY §5.8) — it is accepted and behaves bulk-synchronously; the
-  semantic difference is documented, not emulated.
+* ``dist_sync`` / ``dist_async``: multi-process parameter server
+  (``kvstore_server.py`` — the ``KVStoreDist``/``KVStoreDistServer`` pair,
+  ``src/kvstore/kvstore_dist.h``), wired by the same ``DMLC_*`` env
+  protocol and ``tools/launch.py``.  Sync mode gives the reference's
+  per-key merge-round barrier + server-side optimizer; on TPU pods the
+  gradient plane should instead be in-graph DCN collectives (``parallel/``)
+  — the PS covers the update-on-server semantics collectives can't express.
 
 The API surface (push/pull ordering per key, update-on-kvstore semantics) is
 preserved so ``Module``/``model.py`` code from the reference runs unchanged.
@@ -22,12 +25,14 @@ preserved so ``Module``/``model.py`` code from the reference runs unchanged.
 
 from __future__ import annotations
 
+import os
 import pickle
+import time as _time
 
 from .base import MXNetError
 from .ndarray import NDArray, zeros
 
-__all__ = ["KVStore", "create"]
+__all__ = ["KVStore", "KVStoreDist", "create"]
 
 
 def _ctype_key_value(keys, vals):
@@ -42,6 +47,15 @@ def _ctype_key_value(keys, vals):
         else:
             out_vals.append(list(v))
     return list(keys), out_vals
+
+
+def _merge_devices(vlist):
+    """Sum a pushed per-device NDArray list onto the first device (the
+    CommDevice reduce, ``src/kvstore/comm.h:200``)."""
+    merged = vlist[0]
+    for v in vlist[1:]:
+        merged = merged + v.as_in_context(merged.context)
+    return merged
 
 
 class KVStore:
@@ -61,15 +75,11 @@ class KVStore:
     @property
     def rank(self):
         """reference kvstore.py rank — process index."""
-        import jax
-
-        return jax.process_index() if "dist" in self._type else 0
+        return 0
 
     @property
     def num_workers(self):
-        import jax
-
-        return jax.process_count() if "dist" in self._type else 1
+        return 1
 
     # -- data plane -------------------------------------------------------
     def init(self, key, value):
@@ -87,11 +97,7 @@ class KVStore:
         for k, vlist in zip(keys, vals):
             if k not in self._store:
                 raise MXNetError("key %r not initialized" % k)
-            merged = vlist[0]
-            for v in vlist[1:]:
-                merged = merged + v.as_in_context(merged.context)
-            if self.num_workers > 1:
-                merged = self._allreduce(merged)
+            merged = _merge_devices(vlist)
             if self._updater is not None:
                 self._updater(k, merged, self._store[k])
             else:
@@ -107,15 +113,6 @@ class KVStore:
                 raise MXNetError("key %r not initialized" % k)
             for o in olist:
                 self._store[k].copyto(o)
-
-    def _allreduce(self, arr):
-        """DCN all-reduce across processes (dist types)."""
-        import jax
-        import jax.numpy as jnp
-        from jax.experimental import multihost_utils
-
-        summed = multihost_utils.process_allgather(arr._jx)
-        return NDArray._from_jax(jnp.sum(summed, axis=0), arr.context)
 
     # -- updater / optimizer ----------------------------------------------
     def set_updater(self, updater):
@@ -133,10 +130,7 @@ class KVStore:
 
     # -- control plane ----------------------------------------------------
     def barrier(self):
-        if self.num_workers > 1:
-            from jax.experimental import multihost_utils
-
-            multihost_utils.sync_global_devices("kvstore_barrier")
+        pass
 
     def send_command_to_servers(self, head, body):
         """No servers exist; kept for API parity (logged no-op)."""
@@ -154,6 +148,143 @@ class KVStore:
             self._updater.set_states(f.read())
 
 
+class KVStoreDist(KVStore):
+    """Parameter-server worker (reference ``src/kvstore/kvstore_dist.h``).
+
+    Connects to the ``kvstore_server`` over TCP using the reference's env
+    wire protocol (``DMLC_PS_ROOT_URI``/``DMLC_PS_ROOT_PORT``).  Per-key
+    push/pull ordering is version-gated: each sync push returns the round
+    it lands in and subsequent pulls block server-side until that round is
+    applied — the recv-buffer var-dependency of ``kvstore_dist.h:93-121``
+    expressed as versions instead of engine vars.
+    """
+
+    def __init__(self, kv_type="dist_sync"):
+        super().__init__(kv_type)
+        import socket as _socket
+
+        from . import kvstore_server as ps
+
+        host = os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1")
+        port = int(os.environ.get("DMLC_PS_ROOT_PORT", "9090"))
+        self._ps = ps
+        # the server process imports jax before binding; retry with backoff
+        deadline = _time.time() + float(
+            os.environ.get("MXNET_KVSTORE_CONNECT_TIMEOUT", "120"))
+        while True:
+            try:
+                self._sock = _socket.create_connection((host, port),
+                                                       timeout=300)
+                break
+            except OSError:
+                if _time.time() > deadline:
+                    raise
+                _time.sleep(0.2)
+        self._versions = {}
+        reply = self._rpc({"cmd": "register", "role": "worker"})
+        self._rank = reply["rank"]
+        self._num_workers = reply["num_workers"]
+        self._update_on_kvstore = True
+        # command the server into the mode this type implies (reference
+        # kvstore.cc:32-35: sync unless the type carries _async)
+        self._rpc({"cmd": "sync_mode", "value": "_async" not in kv_type})
+
+    def _rpc(self, msg):
+        self._ps.send_msg(self._sock, msg)
+        reply = self._ps.recv_msg(self._sock)
+        if reply is None:
+            raise MXNetError("kvstore server connection lost")
+        if "error" in reply:
+            raise MXNetError(reply["error"])
+        return reply
+
+    @property
+    def rank(self):
+        return self._rank
+
+    @property
+    def num_workers(self):
+        return self._num_workers
+
+    def init(self, key, value):
+        keys, vals = _ctype_key_value(key, value)
+        for k, vlist in zip(keys, vals):
+            # first init wins on the server (rank-0 broadcast semantics,
+            # kvstore_dist.h:58-76)
+            self._rpc({"cmd": "init", "key": k,
+                       "value": vlist[0].asnumpy()})
+        self.barrier()
+
+    def push(self, key, value, priority=0):
+        keys, vals = _ctype_key_value(key, value)
+        for k, vlist in zip(keys, vals):
+            merged = _merge_devices(vlist)
+            reply = self._rpc({"cmd": "push", "key": k,
+                               "value": merged.asnumpy(),
+                               "rank": self._rank})
+            self._versions[k] = max(self._versions.get(k, 0),
+                                    reply["version"])
+
+    def pull(self, key, out=None, priority=0):
+        from .ndarray import array
+
+        keys, outs = _ctype_key_value(key, out)
+        for k, olist in zip(keys, outs):
+            reply = self._rpc({"cmd": "pull", "key": k,
+                               "version": self._versions.get(k, 0)})
+            val = array(reply["value"])
+            for o in olist:
+                val.copyto(o)
+
+    def set_optimizer(self, optimizer):
+        """Serialize the optimizer to the server (reference
+        ``python/mxnet/kvstore.py:232`` pickles it to servers)."""
+        self._optimizer = optimizer
+        self._rpc({"cmd": "set_optimizer", "bytes": pickle.dumps(optimizer)})
+
+    def set_updater(self, updater):
+        # dist mode: updates happen on the server; a locally-set updater
+        # is ignored (update_on_kvstore semantics)
+        self._updater = None
+
+    _set_updater = set_updater
+
+    def barrier(self):
+        self._rpc({"cmd": "barrier"})
+
+    def send_command_to_servers(self, head, body):
+        self._rpc({"cmd": "user_command", "head": head, "body": body})
+
+    def save_optimizer_states(self, fname):
+        reply = self._rpc({"cmd": "get_updater_states"})
+        with open(fname, "wb") as f:
+            f.write(reply["states"])
+
+    def load_optimizer_states(self, fname):
+        with open(fname, "rb") as f:
+            self._rpc({"cmd": "set_updater_states", "states": f.read()})
+
+    def close(self):
+        """Rank 0 stops the server after a final barrier (the reference's
+        kStopServer + barrier_before_exit, ``kvstore_dist.h:44-55``)."""
+        if self._sock is None:
+            return
+        try:
+            self.barrier()
+            if self._rank == 0:
+                self._rpc({"cmd": "stop"})
+        finally:
+            self._sock.close()
+            self._sock = None
+
+    def __del__(self):
+        try:
+            if self._sock is not None:
+                self._sock.close()
+        except Exception:
+            pass
+
+
 def create(name="local"):
     """reference ``kvstore.cc:17-45`` type dispatch."""
     if not isinstance(name, str):
@@ -164,4 +295,6 @@ def create(name="local"):
              "dist_async_device", "dist")
     if name not in valid:
         raise MXNetError("unknown kvstore type %r" % name)
+    if name.startswith("dist"):
+        return KVStoreDist(name)
     return KVStore(name)

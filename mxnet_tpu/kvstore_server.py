@@ -1,0 +1,302 @@
+"""Parameter-server process for ``dist_*`` KVStore types.
+
+Reference: ``src/kvstore/kvstore_dist_server.h`` (sync-mode per-key merge
+rounds + server-side optimizer; async-mode apply-on-arrival) and
+``python/mxnet/kvstore_server.py`` (auto server loop when
+``DMLC_ROLE=server``).  The ps-lite ZMQ transport is replaced by
+length-prefixed pickles over TCP — the host-side control/parameter plane.
+On TPU pods the *gradient* plane should be in-graph ICI/DCN collectives
+(``parallel/``); this PS preserves the reference's update-on-server
+semantics (optimizer state lives on the server, workers only push/pull),
+which collectives alone cannot express.
+
+Wire protocol (all messages are pickled dicts, ``<u64 length><payload>``):
+
+  register(role)                -> {rank, num_workers}
+  init(key, value)              -> {version}        (first init wins)
+  push(key, value, rank)        -> {version}        (version the push lands in)
+  pull(key, version)            -> {value, version} (blocks until >= version)
+  barrier()                     -> {}               (blocks for num_workers)
+  set_optimizer(bytes)          -> {}               (pickled optimizer)
+  stop()                        -> {}               (terminates the server)
+
+Sync mode: pushes for a key accumulate per round (a worker's n-th push for
+a key belongs to round n); when all ``num_workers`` land, the merged sum is
+applied (updater if set, else assigned) and the key's version increments —
+the per-key barrier of ``kvstore_dist_server.h:164``.  Async mode applies
+every push immediately.
+"""
+
+from __future__ import annotations
+
+import importlib
+import io as _io
+import os
+import pickle
+import socket
+import socketserver
+import struct
+import sys
+import threading
+from collections import defaultdict
+
+import numpy as np
+
+__all__ = ["KVStoreServer", "run_server", "_init_kvstore_server_module"]
+
+_LEN = struct.Struct("<Q")
+
+
+def _pkg_mod(name):
+    """Resolve a sibling package module WITHOUT the import system.
+
+    When the auto server loop runs during ``import mxnet_tpu`` (reference
+    semantics: a DMLC_ROLE=server process blocks on import), the package's
+    import lock is held by the blocked main thread — handler threads doing
+    ``from .optimizer import ...`` (or unpickling package classes, which
+    __import__s their module) would deadlock on it.  All needed modules are
+    already in sys.modules by the time the loop starts, so plain dict
+    lookup is both safe and sufficient.
+    """
+    full = "%s.%s" % (__package__, name)
+    mod = sys.modules.get(full)
+    if mod is None:
+        mod = importlib.import_module(full)
+    return mod
+
+
+class _SysUnpickler(pickle.Unpickler):
+    """Unpickler that prefers sys.modules over __import__ (deadlock-safe
+    inside handler threads; see _pkg_mod)."""
+
+    def find_class(self, module, name):
+        mod = sys.modules.get(module)
+        if mod is not None:
+            return getattr(mod, name)
+        return super().find_class(module, name)
+
+
+def _loads(b):
+    return _SysUnpickler(_io.BytesIO(b)).load()
+
+
+def send_msg(sock, obj):
+    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(_LEN.pack(len(payload)) + payload)
+
+
+def recv_msg(sock):
+    head = b""
+    while len(head) < _LEN.size:
+        chunk = sock.recv(_LEN.size - len(head))
+        if not chunk:
+            return None
+        head += chunk
+    n, = _LEN.unpack(head)
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(min(1 << 20, n - len(buf)))
+        if not chunk:
+            return None
+        buf += chunk
+    return _loads(bytes(buf))
+
+
+class _KeyState:
+    __slots__ = ("value", "version", "rounds", "pushed")
+
+    def __init__(self, value):
+        self.value = value
+        self.version = 0
+        self.rounds = defaultdict(lambda: [None, 0])  # round -> [sum, count]
+        self.pushed = defaultdict(int)                # rank -> push count
+
+
+class KVStoreServer:
+    """Threaded PS: one handler thread per connection."""
+
+    def __init__(self, num_workers, sync_mode=True, host="127.0.0.1",
+                 port=0):
+        self.num_workers = num_workers
+        self.sync_mode = sync_mode
+        self.keys = {}
+        self.lock = threading.Condition()
+        self.updater = None
+        self.next_rank = 0
+        self.barrier_count = 0
+        self.barrier_gen = 0
+        self.stopped = threading.Event()
+
+        outer = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                while True:
+                    msg = recv_msg(self.request)
+                    if msg is None:
+                        return
+                    reply = outer.dispatch(msg)
+                    send_msg(self.request, reply)
+                    if msg["cmd"] == "stop":
+                        return
+
+        class Server(socketserver.ThreadingTCPServer):
+            daemon_threads = True
+            allow_reuse_address = True
+
+        self.server = Server((host, port), Handler)
+        self.port = self.server.server_address[1]
+
+    # -- command dispatch --------------------------------------------------
+    def dispatch(self, msg):
+        cmd = msg["cmd"]
+        if cmd == "register":
+            with self.lock:
+                rank = self.next_rank
+                self.next_rank += 1
+            return {"rank": rank, "num_workers": self.num_workers}
+        if cmd == "init":
+            with self.lock:
+                if msg["key"] not in self.keys:
+                    self.keys[msg["key"]] = _KeyState(
+                        np.array(msg["value"], copy=True))
+                return {"version": self.keys[msg["key"]].version}
+        if cmd == "push":
+            return self._push(msg["key"], msg["value"], msg["rank"])
+        if cmd == "pull":
+            return self._pull(msg["key"], msg.get("version", 0))
+        if cmd == "set_optimizer":
+            get_updater = _pkg_mod("optimizer").get_updater
+            with self.lock:
+                self.updater = get_updater(_loads(msg["bytes"]))
+            return {}
+        if cmd == "barrier":
+            return self._barrier()
+        if cmd == "sync_mode":
+            # reference kvstore.cc:32-35 — rank 0 commands kSyncMode to
+            # servers when the type lacks _async
+            with self.lock:
+                self.sync_mode = bool(msg.get("value", True))
+            return {}
+        if cmd == "get_updater_states":
+            with self.lock:
+                if self.updater is None:
+                    return {"error": "optimizer not initialized on server"}
+                return {"states": pickle.dumps(self.updater.states)}
+        if cmd == "set_updater_states":
+            with self.lock:
+                if self.updater is None:
+                    return {"error": "optimizer not initialized on server"}
+                # deadlock-safe unpickle (see _pkg_mod)
+                self.updater.states = _loads(msg["states"])
+            return {}
+        if cmd == "user_command":
+            # SendCommandToServers parity: unknown app-level commands are
+            # accepted and ignored
+            return {}
+        if cmd == "stop":
+            self.stopped.set()
+            threading.Thread(target=self.server.shutdown,
+                             daemon=True).start()
+            return {}
+        return {"error": "unknown command %r" % cmd}
+
+    def _apply(self, st, key, merged):
+        if self.updater is not None:
+            # optimizers operate on NDArrays; round-trip through one
+            array = _pkg_mod("ndarray").array
+
+            weight = array(st.value)
+            self.updater(key, array(merged), weight)
+            st.value = weight.asnumpy()
+        else:
+            st.value = np.array(merged, copy=True)
+
+    def _push(self, key, value, rank):
+        value = np.asarray(value)
+        with self.lock:
+            st = self.keys.get(key)
+            if st is None:
+                return {"error": "key %r not initialized" % key}
+            if not self.sync_mode:
+                self._apply(st, key, value)
+                st.version += 1
+                self.lock.notify_all()
+                return {"version": st.version}
+            rnd = st.pushed[rank]
+            st.pushed[rank] += 1
+            slot = st.rounds[rnd]
+            slot[0] = value if slot[0] is None else slot[0] + value
+            slot[1] += 1
+            if slot[1] == self.num_workers:
+                assert st.version == rnd, "round applied out of order"
+                self._apply(st, key, slot[0])
+                del st.rounds[rnd]
+                st.version += 1
+                self.lock.notify_all()
+            return {"version": rnd + 1}
+
+    def _pull(self, key, version):
+        with self.lock:
+            st = self.keys.get(key)
+            if st is None:
+                return {"error": "key %r not initialized" % key}
+            while st.version < version:
+                self.lock.wait()
+            return {"value": st.value, "version": st.version}
+
+    def _barrier(self):
+        with self.lock:
+            gen = self.barrier_gen
+            self.barrier_count += 1
+            if self.barrier_count == self.num_workers:
+                self.barrier_count = 0
+                self.barrier_gen += 1
+                self.lock.notify_all()
+            else:
+                while self.barrier_gen == gen:
+                    self.lock.wait()
+            return {}
+
+    # -- lifecycle ---------------------------------------------------------
+    def serve_forever(self):
+        self.server.serve_forever()
+
+    def start_background(self):
+        t = threading.Thread(target=self.serve_forever, daemon=True)
+        t.start()
+        return t
+
+    def close(self):
+        self.server.shutdown()
+        self.server.server_close()
+
+
+def run_server():
+    """Blocking server main (the reference ``KVStoreServer.run`` loop)."""
+    # honor an explicit CPU pin before jax's backend initializes: the axon
+    # sitecustomize force-registers the TPU platform regardless of the
+    # JAX_PLATFORMS env var, and the server's optimizer applies (NDArray
+    # math) must not grab the single TPU out from under the workers
+    if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    num_workers = int(os.environ["DMLC_NUM_WORKER"])
+    port = int(os.environ.get("DMLC_PS_ROOT_PORT", "9090"))
+    host = os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1")
+    # mode is commanded by the workers (kSyncMode); start async
+    srv = KVStoreServer(num_workers, sync_mode=False, host=host, port=port)
+    srv.serve_forever()
+
+
+def _init_kvstore_server_module():
+    """Reference ``python/mxnet/kvstore_server.py`` auto-loop: a process
+    started with DMLC_ROLE=server becomes a server and never returns."""
+    if os.environ.get("DMLC_ROLE") == "server":
+        run_server()
+        os._exit(0)
+
+
+if __name__ == "__main__":
+    run_server()

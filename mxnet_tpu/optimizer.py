@@ -54,6 +54,15 @@ class Optimizer:
 
     # -- serialization to "kvstore servers" (reference pickles the optimizer
     # to PS servers, python/mxnet/kvstore.py:232) -------------------------
+    def __getstate__(self):
+        # drop the symbol: it holds OpDef closures that can't (and needn't)
+        # travel to a kvstore server.  Behavior-preserving: sym is only read
+        # inside explicit set_lr_mult/set_wd_mult calls, never by
+        # _get_lr/_get_wd, so a pickled copy computes identical updates.
+        d = dict(self.__dict__)
+        d["sym"] = None
+        return d
+
     def dumps(self):
         import pickle
 

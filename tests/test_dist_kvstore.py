@@ -1,0 +1,200 @@
+"""Distributed kvstore exactness tests.
+
+Models the reference's ``tests/nightly/dist_sync_kvstore.py`` (launched
+multi-process arithmetic identities) and ``tests/nightly/test_kvstore.py``
+(aggregation exactness): a real PS process/thread + N workers asserting
+exact sums, server-side optimizer application, versioned pull ordering,
+barrier, and the local launcher end-to-end.
+"""
+
+import os
+import subprocess
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import kvstore, kvstore_server
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _with_server(num_workers, sync_mode=True):
+    srv = kvstore_server.KVStoreServer(num_workers, sync_mode=sync_mode)
+    srv.start_background()
+    os.environ["DMLC_PS_ROOT_URI"] = "127.0.0.1"
+    os.environ["DMLC_PS_ROOT_PORT"] = str(srv.port)
+    return srv
+
+
+def _run_workers(n, fn, kv_type="dist_sync"):
+    """Run fn(kv, rank) in n threads, each with its own KVStoreDist."""
+    errors = []
+
+    def worker():
+        try:
+            kv = kvstore.KVStoreDist(kv_type)
+            fn(kv, kv.rank)
+        except Exception as e:
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, daemon=True)
+               for _ in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert not any(t.is_alive() for t in threads), "worker hung/deadlocked"
+    assert not errors, errors
+
+
+def test_dist_sync_push_pull_exact():
+    """Sum-across-workers exactness over multiple rounds and shapes."""
+    n = 4
+    srv = _with_server(n)
+    shapes = {3: (4, 5), 9: (7,), 11: (2, 3, 4)}
+    rounds = 3
+    results = {}
+    lock = threading.Lock()
+
+    def body(kv, rank):
+        for k, shp in shapes.items():
+            kv.init(k, mx.nd.zeros(shp))
+        for r in range(rounds):
+            for k, shp in shapes.items():
+                val = mx.nd.array(np.full(shp, (rank + 1) * (r + 1),
+                                          np.float32))
+                kv.push(k, val)
+            for k, shp in shapes.items():
+                out = mx.nd.zeros(shp)
+                kv.pull(k, out=out)
+                with lock:
+                    results[(rank, r, k)] = out.asnumpy()
+        kv.barrier()
+
+    _run_workers(n, body)
+    srv.close()
+    assert len(results) == n * rounds * len(shapes)
+    for (rank, r, k), got in results.items():
+        # sync round r: sum over ranks of (rank+1)*(r+1)
+        expect = sum(w + 1 for w in range(n)) * (r + 1)
+        assert (got == expect).all(), (rank, r, k, got)
+
+
+def test_dist_sync_server_side_optimizer():
+    """Optimizer runs on the server: w' = w - lr * sum(grads)."""
+    n = 3
+    srv = _with_server(n)
+    got = {}
+    lock = threading.Lock()
+
+    def body(kv, rank):
+        if rank == 0:
+            from mxnet_tpu import optimizer
+
+            kv.set_optimizer(optimizer.SGD(learning_rate=0.1,
+                                           rescale_grad=1.0, wd=0.0))
+        kv.barrier()
+        kv.init(0, mx.nd.array(np.ones((4,), np.float32)))
+        kv.push(0, mx.nd.array(np.full((4,), rank + 1.0, np.float32)))
+        out = mx.nd.zeros((4,))
+        kv.pull(0, out=out)
+        with lock:
+            got[rank] = out.asnumpy()
+
+    _run_workers(n, body)
+    srv.close()
+    expect = 1.0 - 0.1 * (1 + 2 + 3)
+    for rank, arr in got.items():
+        np.testing.assert_allclose(arr, expect, rtol=1e-6)
+
+
+def test_dist_async_applies_immediately():
+    srv = _with_server(1, sync_mode=False)
+
+    def body(kv, rank):
+        kv.init(5, mx.nd.zeros((3,)))
+        kv.push(5, mx.nd.array(np.array([1.0, 2.0, 3.0], np.float32)))
+        out = mx.nd.zeros((3,))
+        kv.pull(5, out=out)
+        np.testing.assert_array_equal(out.asnumpy(), [1, 2, 3])
+        kv.push(5, mx.nd.array(np.array([9.0, 9.0, 9.0], np.float32)))
+        kv.pull(5, out=out)
+        np.testing.assert_array_equal(out.asnumpy(), [9, 9, 9])
+
+    _run_workers(1, body, kv_type="dist_async")
+    srv.close()
+
+
+def test_rank_assignment_and_barrier():
+    n = 4
+    srv = _with_server(n)
+    ranks = []
+    lock = threading.Lock()
+
+    def body(kv, rank):
+        assert kv.num_workers == n
+        with lock:
+            ranks.append(rank)
+        kv.barrier()
+
+    _run_workers(n, body)
+    srv.close()
+    assert sorted(ranks) == list(range(n))
+
+
+_LAUNCH_SCRIPT = r"""
+import os, sys
+import numpy as np
+import jax; jax.config.update("jax_platforms", "cpu")
+import mxnet_tpu as mx
+kv = mx.kv.create("dist_sync")
+rank, n = kv.rank, kv.num_workers
+kv.init(7, mx.nd.zeros((3, 3)))
+kv.push(7, mx.nd.array(np.full((3, 3), rank + 1.0, np.float32)))
+out = mx.nd.zeros((3, 3))
+kv.pull(7, out=out)
+expect = sum(r + 1 for r in range(n))
+assert (out.asnumpy() == expect).all(), out.asnumpy()
+open(os.path.join(os.environ["OUT_DIR"], "ok.%d" % rank), "w").write("1")
+kv.close()
+"""
+
+
+def test_launcher_end_to_end(tmp_path):
+    """tools/launch.py -n 2: the reference nightly pattern
+    (test_all.sh:37) as a subprocess test."""
+    script = tmp_path / "worker.py"
+    script.write_text(_LAUNCH_SCRIPT)
+    env = dict(os.environ, OUT_DIR=str(tmp_path), JAX_PLATFORMS="cpu")
+    env.pop("DMLC_PS_ROOT_PORT", None)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+         "-n", "2", sys.executable, str(script)],
+        env=env, timeout=300, capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert (tmp_path / "ok.0").exists() and (tmp_path / "ok.1").exists()
+
+
+def test_dist_optimizer_state_roundtrip(tmp_path):
+    """save/load_optimizer_states against the server-side updater."""
+    srv = _with_server(1)
+    fname = str(tmp_path / "opt.states")
+
+    def body(kv, rank):
+        from mxnet_tpu import optimizer
+
+        kv.set_optimizer(optimizer.SGD(learning_rate=0.1, momentum=0.9,
+                                       rescale_grad=1.0, wd=0.0))
+        kv.init(0, mx.nd.zeros((4,)))
+        kv.push(0, mx.nd.array(np.ones((4,), np.float32)))
+        out = mx.nd.zeros((4,))
+        kv.pull(0, out=out)
+        kv.save_optimizer_states(fname)
+        kv.load_optimizer_states(fname)
+
+    _run_workers(1, body)
+    srv.close()
+    assert os.path.getsize(fname) > 0

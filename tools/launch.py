@@ -1,0 +1,85 @@
+#!/usr/bin/env python
+"""Local cluster launcher for dist_* training.
+
+Reference: ``tools/launch.py`` (dmlc-tracker; local/ssh/mpi/sge/yarn
+backends).  This implements the ``local`` backend — the one the reference's
+nightly distributed tests use (``tests/nightly/test_all.sh:37``:
+``launch.py -n 4 python dist_sync_kvstore.py``) — spawning 1 parameter
+server + N workers on this machine, wired by the same ``DMLC_*`` env
+protocol.  Multi-host TPU launches should instead use the platform's pod
+runtime (one process per host + ``jax.distributed``); this launcher covers
+the PS-semantics path and single-host multi-process testing.
+
+Usage: python tools/launch.py -n 2 [--sync-dst-dir ignored] CMD...
+"""
+
+import argparse
+import os
+import socket
+import subprocess
+import sys
+import time
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("-n", "--num-workers", type=int, required=True)
+    p.add_argument("-s", "--num-servers", type=int, default=1,
+                   help="kept for reference CLI parity; the TPU PS is a "
+                        "single threaded server process")
+    p.add_argument("--launcher", default="local", choices=["local"])
+    p.add_argument("--env", action="append", default=[],
+                   help="extra VAR=VALUE to pass to all processes")
+    p.add_argument("command", nargs=argparse.REMAINDER)
+    args = p.parse_args()
+    if not args.command:
+        p.error("no command given")
+
+    port = _free_port()
+    base_env = dict(os.environ)
+    for kv in args.env:
+        k, v = kv.split("=", 1)
+        base_env[k] = v
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    pypath = base_env.get("PYTHONPATH", "")
+    base_env.update({
+        "DMLC_PS_ROOT_URI": "127.0.0.1",
+        "DMLC_PS_ROOT_PORT": str(port),
+        "DMLC_NUM_WORKER": str(args.num_workers),
+        "DMLC_NUM_SERVER": "1",
+        "PYTHONPATH": here + (os.pathsep + pypath if pypath else ""),
+    })
+
+    server = subprocess.Popen(
+        [sys.executable, "-m", "mxnet_tpu.kvstore_server"],
+        env=dict(base_env, DMLC_ROLE="server"),
+    )
+    time.sleep(0.3)
+
+    workers = []
+    for rank in range(args.num_workers):
+        workers.append(subprocess.Popen(
+            args.command,
+            env=dict(base_env, DMLC_ROLE="worker",
+                     DMLC_WORKER_ID=str(rank))))
+    rc = 0
+    for w in workers:
+        rc |= w.wait()
+    # rank-0's KVStoreDist.close() stops the server; reap or kill
+    try:
+        server.wait(timeout=10)
+    except subprocess.TimeoutExpired:
+        server.terminate()
+    sys.exit(rc)
+
+
+if __name__ == "__main__":
+    main()
