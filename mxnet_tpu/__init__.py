@@ -51,6 +51,10 @@ from .module import Module
 from . import model
 from .model import FeedForward
 
+from . import operator
+from . import profiler
+from . import rtc
+from . import visualization
 from . import test_utils
 
 # DMLC_ROLE=server processes become parameter servers on import (reference

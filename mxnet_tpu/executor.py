@@ -98,6 +98,10 @@ class Executor:
     aux_arrays = property(lambda s: [s.aux_dict[n] for n in s.aux_names])
 
     # -- jitted graph functions ------------------------------------------
+    def _symbol_name(self):
+        outs = self.output_names
+        return outs[0].rsplit("_output", 1)[0] if outs else "exec"
+
     def _diff_names(self):
         return [n for n in self.arg_names if self.grad_req[n] != "null"]
 
@@ -181,21 +185,28 @@ class Executor:
         aux = [a._jx for a in self.aux_arrays]
         rng = _random.next_key()
         self._rng_step += 1
-        if is_train:
-            if self._diff_names():
-                outs, new_aux, grads = self._get_fn("train")(args, aux, rng)
-                self._pending_grads = grads
-                self._last_state = (args, aux, rng)
+        from . import profiler as _profiler
+
+        fused_bwd = is_train and bool(self._diff_names())
+        with _profiler.span(
+                "%s_forward%s" % (self._symbol_name(),
+                                  "_backward" if fused_bwd else ""),
+                "symbolic"):
+            if is_train:
+                if self._diff_names():
+                    outs, new_aux, grads = self._get_fn("train")(args, aux, rng)
+                    self._pending_grads = grads
+                    self._last_state = (args, aux, rng)
+                else:
+                    outs, new_aux = self._get_fn("train_fwd")(args, aux, rng)
+                    self._pending_grads = None
+                    self._last_state = None
+                for arr, new in zip(self.aux_arrays, new_aux):
+                    arr._jx = new
             else:
-                outs, new_aux = self._get_fn("train_fwd")(args, aux, rng)
+                outs = self._get_fn("predict")(args, aux, rng)
                 self._pending_grads = None
                 self._last_state = None
-            for arr, new in zip(self.aux_arrays, new_aux):
-                arr._jx = new
-        else:
-            outs = self._get_fn("predict")(args, aux, rng)
-            self._pending_grads = None
-            self._last_state = None
         self.outputs = [NDArray._from_jax(o, self._ctx) for o in outs]
         if self._monitor_callback is not None:
             for name, arr in zip(self.output_names, self.outputs):
@@ -217,8 +228,12 @@ class Executor:
             out_grads = [g._jx if isinstance(g, NDArray) else jnp.asarray(g)
                          for g in out_grads]
             args, aux, rng = self._last_state
-            _outs, _new_aux, grads = self._get_fn("train_with_grads")(
-                args, aux, rng, out_grads)
+            from . import profiler as _profiler
+
+            with _profiler.span("%s_backward" % self._symbol_name(),
+                                "symbolic"):
+                _outs, _new_aux, grads = self._get_fn("train_with_grads")(
+                    args, aux, rng, out_grads)
         for name in self._diff_names():
             g = grads[name]
             dst = self.grad_dict.get(name)

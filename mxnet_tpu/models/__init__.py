@@ -10,7 +10,8 @@ Each ``get_symbol(num_classes, **kwargs)`` returns a Symbol ending in
 """
 
 from . import lenet, mlp, alexnet, vgg, resnet, inception_bn, inception_v3
-from . import ssd_vgg16
+from . import googlenet, inception_resnet_v2
+from . import ssd_vgg16, rcnn
 
 _BUILDERS = {
     "lenet": lenet.get_symbol,
@@ -21,6 +22,8 @@ _BUILDERS = {
     "inception-bn": inception_bn.get_symbol,
     "inception-v3": inception_v3.get_symbol,
     "resnext": resnet.get_symbol_resnext,
+    "googlenet": googlenet.get_symbol,
+    "inception-resnet-v2": inception_resnet_v2.get_symbol,
 }
 
 

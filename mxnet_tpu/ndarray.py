@@ -486,14 +486,17 @@ def _invoke(op, args, kwargs):
 
     rng = _random.next_key() if op.needs_rng else None
     fn = _reg.jitted_apply(op.name, _reg.attrs_key(attrs), True)
-    if inputs:
-        octx = inputs[0]._ctx
-        outs, aux_up = fn([x._jx for x in inputs],
-                          [x._jx for x in aux_arrays], rng)
-    else:
-        octx = ctx or current_context()
-        with jax.default_device(octx.jax_device()):
-            outs, aux_up = fn([], [], rng)
+    from . import profiler as _profiler
+
+    with _profiler.span(op.name, "imperative"):
+        if inputs:
+            octx = inputs[0]._ctx
+            outs, aux_up = fn([x._jx for x in inputs],
+                              [x._jx for x in aux_arrays], rng)
+        else:
+            octx = ctx or current_context()
+            with jax.default_device(octx.jax_device()):
+                outs, aux_up = fn([], [], rng)
     # write aux updates back (reference mutates aux NDArrays in the op)
     for arr, new in zip(aux_arrays, aux_up or []):
         arr._jx = new

@@ -1,0 +1,215 @@
+"""Custom operators authored in Python.
+
+Reference: ``python/mxnet/operator.py`` — ``CustomOp`` :396 / ``CustomOpProp``
+:442 / ``register`` :576 (the modern path, dispatched through the C ``Custom``
+op at ``src/operator/custom/custom.cc:183``), plus the legacy numpy callback
+paths ``NumpyOp`` :126 (``_Native``, ``src/operator/native_op.cc``) and
+``NDArrayOp`` :226 (``_NDArray``, ``src/operator/ndarray_op.cc``).
+
+TPU-native design: the reference runs custom-op callbacks on an engine CPU
+thread via C function pointers; here the callback is staged into the traced
+XLA computation with ``jax.pure_callback`` (host callback with declared
+result shapes), and the backward pass is wired through ``jax.custom_vjp`` so
+``jax.grad`` through the whole fused graph calls the user's ``backward``.
+Shape/type inference comes from the prop's ``infer_shape``/``infer_type``
+exactly as the reference's ``CustomOpProp`` callbacks do.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .base import MXNetError
+
+__all__ = ["CustomOp", "CustomOpProp", "register", "get_prop",
+           "NumpyOp", "NDArrayOp", "PythonOp"]
+
+_CUSTOM_PROPS: dict[str, type] = {}
+
+
+class CustomOp:
+    """Base class for stateful custom operators (ref ``operator.py:396``)."""
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        raise NotImplementedError
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        raise NotImplementedError
+
+    def assign(self, dst, req, src):
+        """Write ``src`` into ``dst`` honoring grad_req (ref :420)."""
+        if req in ("write", "inplace"):
+            dst[...] = src
+        elif req == "add":
+            dst[...] += src
+        elif req == "null":
+            pass
+        else:
+            raise MXNetError("unknown req %r" % req)
+
+
+class CustomOpProp:
+    """Shape/type/IO metadata + operator factory (ref ``operator.py:442``)."""
+
+    def __init__(self, need_top_grad=True):
+        self.need_top_grad_ = need_top_grad
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def list_auxiliary_states(self):
+        return []
+
+    def infer_shape(self, in_shape):
+        """-> (in_shapes, out_shapes, aux_shapes); default: all like in[0]."""
+        return in_shape, [in_shape[0]] * len(self.list_outputs()), \
+            [in_shape[0]] * len(self.list_auxiliary_states())
+
+    def infer_type(self, in_type):
+        return in_type, [in_type[0]] * len(self.list_outputs()), \
+            [in_type[0]] * len(self.list_auxiliary_states())
+
+    def need_top_grad(self):
+        return self.need_top_grad_
+
+    def declare_backward_dependency(self, out_grad, in_data, out_data):
+        deps = []
+        if self.need_top_grad():
+            deps.extend(out_grad)
+        deps.extend(in_data)
+        deps.extend(out_data)
+        return deps
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        return CustomOp()
+
+
+def register(reg_name):
+    """Class decorator: register a ``CustomOpProp`` under ``op_type``
+    (ref ``operator.py:576`` → ``MXCustomOpRegister``)."""
+
+    def do_register(prop_cls):
+        if not issubclass(prop_cls, CustomOpProp):
+            raise MXNetError("register %r: expected CustomOpProp subclass"
+                             % reg_name)
+        _CUSTOM_PROPS[reg_name] = prop_cls
+        return prop_cls
+
+    return do_register
+
+
+def get_prop(op_type):
+    if op_type not in _CUSTOM_PROPS:
+        raise MXNetError("custom op type %r not registered (use "
+                         "mx.operator.register)" % op_type)
+    return _CUSTOM_PROPS[op_type]
+
+
+# ---------------------------------------------------------------------------
+# Legacy numpy callback ops (ref ``operator.py:19-226``): PythonOp/NumpyOp/
+# NDArrayOp.  Instances are process-local (like the reference's C function
+# pointers — they do not survive symbol JSON round-trips) and dispatch through
+# the same Custom machinery via a per-process instance table.
+# ---------------------------------------------------------------------------
+
+_LEGACY_TABLE: dict[int, "PythonOp"] = {}
+_LEGACY_NEXT = [0]
+
+
+class PythonOp:
+    """Base for legacy numpy ops (ref ``operator.py:19``)."""
+
+    def __init__(self, need_top_grad=True):
+        self.info_ = None
+        self.need_top_grad_ = need_top_grad
+        _LEGACY_NEXT[0] += 1
+        self._legacy_id = _LEGACY_NEXT[0]
+        _LEGACY_TABLE[self._legacy_id] = self
+
+    def __call__(self, *args, **kwargs):
+        return self.get_symbol(*args, **kwargs)
+
+    def get_symbol(self, *args, **kwargs):
+        from . import symbol as sym
+
+        kwargs["op_type"] = "_legacy"
+        kwargs["legacy_id"] = self._legacy_id
+        return sym.Custom(*args, **kwargs)
+
+    # numpy-callback interface (ref :60-125)
+    def forward(self, in_data, out_data):
+        raise NotImplementedError
+
+    def backward(self, out_grad, in_data, out_data, in_grad):
+        raise NotImplementedError
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]]
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def need_top_grad(self):
+        return self.need_top_grad_
+
+
+class NumpyOp(PythonOp):
+    """ref ``operator.py:126`` — callbacks receive numpy arrays."""
+
+
+class NDArrayOp(PythonOp):
+    """ref ``operator.py:226`` — same interface here (host arrays)."""
+
+
+class _LegacyProp(CustomOpProp):
+    """Adapts a PythonOp instance to the CustomOpProp interface."""
+
+    def __init__(self, legacy_id):
+        self._py_op = _LEGACY_TABLE[int(legacy_id)]
+        super().__init__(need_top_grad=self._py_op.need_top_grad())
+
+    def list_arguments(self):
+        return list(self._py_op.list_arguments())
+
+    def list_outputs(self):
+        return list(self._py_op.list_outputs())
+
+    def infer_shape(self, in_shape):
+        ins, outs = self._py_op.infer_shape(in_shape)
+        return ins, outs, []
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        py_op = self._py_op
+
+        class _Wrapped(CustomOp):
+            def forward(self, is_train, req, in_data, out_data, aux):
+                py_op.forward(in_data=in_data, out_data=out_data)
+
+            def backward(self, req, out_grad, in_data, out_data, in_grad,
+                         aux):
+                py_op.backward(out_grad=out_grad, in_data=in_data,
+                               out_data=out_data, in_grad=in_grad)
+
+        return _Wrapped()
+
+
+_CUSTOM_PROPS["_legacy"] = _LegacyProp
+
+
+def _make_prop(attrs):
+    """Instantiate the prop for a Custom node's attrs (kwargs as strings,
+    matching the reference's string-kwarg C protocol)."""
+    kwargs = {k: v for k, v in attrs.items() if k != "op_type"}
+    op_type = attrs.get("op_type")
+    if not op_type:
+        raise MXNetError("Custom op requires op_type attr")
+    cls = get_prop(op_type)
+    if cls is _LegacyProp:
+        return cls(kwargs["legacy_id"])
+    return cls(**kwargs)

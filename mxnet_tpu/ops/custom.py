@@ -1,0 +1,101 @@
+"""The ``Custom`` op — Python-authored operators inside the traced graph.
+
+Reference: ``src/operator/custom/custom.cc:183`` registers ``Custom`` whose
+forward/backward dispatch to Python ``CustomOp``/``CustomOpProp`` callbacks
+via C function pointers (``MXCustomOpRegister``); legacy ``_Native``
+(``native_op.cc``) and ``_NDArray`` (``ndarray_op.cc``) are the older numpy
+callback paths.
+
+TPU-native: the Python callback is staged into the XLA computation with
+``jax.pure_callback`` (result shapes declared up front from the prop's
+``infer_shape``/``infer_type``), and ``jax.custom_vjp`` routes ``jax.grad``
+of the fused graph into the user's ``backward``.  The op therefore composes
+with jit, the executor's single fused fwd+bwd computation, and eval_shape
+inference like any native op.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+from . import registry as _reg
+from .registry import REQUIRED, pstr, register
+
+
+def _prop_for(attrs):
+    from .. import operator as _operator
+
+    return _operator._make_prop(attrs)
+
+
+def _custom_apply(attrs, inputs, aux, is_train, rng):
+    prop = _prop_for(attrs)
+    n_in = len(inputs)
+    n_aux = len(aux)
+    in_shapes = [tuple(x.shape) for x in inputs]
+    in_dtypes = [x.dtype for x in inputs]
+    _, out_shapes, _ = prop.infer_shape([list(s) for s in in_shapes])
+    _, out_dtypes, _ = prop.infer_type(list(in_dtypes))
+    out_specs = [jax.ShapeDtypeStruct(tuple(s), d)
+                 for s, d in zip(out_shapes, out_dtypes)]
+    aux_specs = [jax.ShapeDtypeStruct(tuple(a.shape), a.dtype) for a in aux]
+    in_specs = [jax.ShapeDtypeStruct(s, d)
+                for s, d in zip(in_shapes, in_dtypes)]
+    # one stateful operator instance per trace — each executor bind traces
+    # its own graph, so this matches the reference's per-bind
+    # `create_operator` (``python/mxnet/operator.py:674``)
+    op = prop.create_operator("tpu", list(in_shapes), list(in_dtypes))
+
+    def host_forward(*tensors):
+        ins = [np.asarray(t) for t in tensors[:n_in]]
+        auxs = [np.array(t) for t in tensors[n_in:]]
+        outs = [np.zeros(tuple(s), d) for s, d in zip(out_shapes, out_dtypes)]
+        op.forward(is_train, ["write"] * len(outs), ins, outs, auxs)
+        return tuple(outs) + tuple(auxs)
+
+    def host_backward(*tensors):
+        grads = [np.asarray(t) for t in tensors[:len(out_specs)]]
+        ins = [np.asarray(t) for t in tensors[len(out_specs):
+                                             len(out_specs) + n_in]]
+        auxs = [np.array(t) for t in
+                tensors[len(out_specs) + n_in:
+                        len(out_specs) + n_in + n_aux]]
+        outs = [np.asarray(t) for t in tensors[len(out_specs) + n_in + n_aux:]]
+        in_grads = [np.zeros(s, d) for s, d in zip(in_shapes, in_dtypes)]
+        op.backward(["write"] * n_in, grads, ins, outs, in_grads, auxs)
+        return tuple(in_grads)
+
+    @jax.custom_vjp
+    def run(ins, auxs):
+        res = jax.pure_callback(host_forward, tuple(out_specs + aux_specs),
+                                *ins, *auxs)
+        return list(res[:len(out_specs)]), list(res[len(out_specs):])
+
+    def run_fwd(ins, auxs):
+        outs, new_aux = run(ins, auxs)
+        return (outs, new_aux), (ins, auxs, outs)
+
+    def run_bwd(resid, cots):
+        ins, auxs, outs = resid
+        out_cots, _aux_cots = cots
+        in_grads = jax.pure_callback(host_backward, tuple(in_specs),
+                                     *out_cots, *ins, *auxs, *outs)
+        return (list(in_grads), [jax.numpy.zeros_like(a) for a in auxs])
+
+    run.defvjp(run_fwd, run_bwd)
+
+    outs, new_aux = run(list(inputs), list(aux))
+    return outs, (new_aux if n_aux else None)
+
+
+register(
+    "Custom", _custom_apply,
+    arguments=lambda attrs: _prop_for(attrs).list_arguments(),
+    aux_states=lambda attrs: _prop_for(attrs).list_auxiliary_states(),
+    outputs=lambda attrs: _prop_for(attrs).list_outputs(),
+    params={"op_type": (pstr, REQUIRED)},
+    open_params=True,
+    aliases=("_Native", "_NDArray"),
+    doc="Custom Python operator (reference src/operator/custom/custom.cc:183)",
+)

@@ -119,7 +119,7 @@ def _as_fn(x):
 class OpDef:
     def __init__(self, name, apply_fn, *, arguments=("data",), aux_states=(),
                  outputs=("output",), params=None, needs_rng=False,
-                 hint=None, key_var_num_args=None, doc=""):
+                 hint=None, key_var_num_args=None, doc="", open_params=False):
         self.name = name
         self._apply = apply_fn
         self._arguments = _as_fn(arguments)
@@ -127,6 +127,9 @@ class OpDef:
         self._outputs = _as_fn(outputs)
         self.params = params or {}
         self.needs_rng = needs_rng
+        # accept arbitrary extra string kwargs (the Custom op's string-kwarg
+        # protocol, reference ``src/operator/custom/custom.cc:183``)
+        self.open_params = open_params
         # attr naming the variable-arity input count (reference nnvm
         # `key_var_num_args`, e.g. Concat's num_args)
         self.key_var_num_args = key_var_num_args
@@ -159,7 +162,12 @@ class OpDef:
                 out[k] = default
         unknown = set(kwargs) - set(self.params)
         if unknown:
-            raise MXNetError("op %s: unknown params %s" % (self.name, sorted(unknown)))
+            if self.open_params:
+                for k in unknown:
+                    out[k] = str(kwargs[k])
+            else:
+                raise MXNetError(
+                    "op %s: unknown params %s" % (self.name, sorted(unknown)))
         return out
 
     # -- compute ----------------------------------------------------------

@@ -1,0 +1,135 @@
+"""Profiler — per-op execution spans dumped as chrome://tracing JSON.
+
+Reference: ``src/engine/profiler.{h,cc}`` (``Profiler`` singleton, per-device
+``OprExecStat`` arrays, engine brackets every op with ``SetOprStart/End``,
+``DumpProfile`` emits chrome://tracing JSON) + the Python veneer
+``python/mxnet/profiler.py:10-38`` (``profiler_set_config`` /
+``profiler_set_state`` / ``dump_profile``).
+
+TPU-native: the "engine" is XLA/PJRT, so spans bracket (a) imperative op
+dispatches (mode ``all``/``imperative``) and (b) executor fused forward/
+backward computations (mode ``symbolic``) — the analog of the reference's
+symbolic-ops-only default.  Device-side kernel timing comes from the XLA
+profiler: ``profiler_set_config(trace_dir=...)`` additionally starts a
+``jax.profiler`` trace viewable in TensorBoard/Perfetto, the analog of the
+reference's chrome tracing of GPU worker threads.
+
+Env: ``MXNET_PROFILER_AUTOSTART=1`` starts profiling at import
+(``docs/how_to/env_var.md:64-67``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+from .base import MXNetError
+
+__all__ = ["profiler_set_config", "profiler_set_state", "dump_profile",
+           "State", "Mode"]
+
+
+class Mode:
+    SYMBOLIC = "symbolic"
+    IMPERATIVE = "imperative"
+    ALL = "all"
+
+
+class State:
+    STOP = "stop"
+    RUN = "run"
+
+
+_lock = threading.Lock()
+_state = State.STOP
+_mode = Mode.SYMBOLIC
+_filename = "profile.json"
+_trace_dir = None
+_events = []  # chrome trace event dicts
+_t0 = time.perf_counter()
+
+
+def _now_us():
+    return (time.perf_counter() - _t0) * 1e6
+
+
+def profiler_set_config(mode="symbolic", filename="profile.json",
+                        trace_dir=None):
+    """reference ``python/mxnet/profiler.py:10`` (``MXSetProfilerConfig``)."""
+    global _mode, _filename, _trace_dir
+    if mode not in (Mode.SYMBOLIC, Mode.IMPERATIVE, Mode.ALL):
+        raise MXNetError("profiler mode must be symbolic/imperative/all")
+    _mode = mode
+    _filename = filename
+    _trace_dir = trace_dir
+
+
+def profiler_set_state(state="stop"):
+    """reference ``python/mxnet/profiler.py:25`` (``MXSetProfilerState``)."""
+    global _state
+    if state not in (State.RUN, State.STOP):
+        raise MXNetError("profiler state must be 'run' or 'stop'")
+    prev = _state
+    _state = state
+    if _trace_dir:
+        import jax
+
+        if state == State.RUN and prev == State.STOP:
+            jax.profiler.start_trace(_trace_dir)
+        elif state == State.STOP and prev == State.RUN:
+            jax.profiler.stop_trace()
+
+
+def running():
+    return _state == State.RUN
+
+
+def record(name, cat, start_us, end_us, tid=0):
+    """Append one completed span (the ``OprExecStat`` analog)."""
+    with _lock:
+        _events.append({
+            "name": name, "cat": cat, "ph": "X",
+            "ts": start_us, "dur": end_us - start_us,
+            "pid": 0, "tid": tid,
+        })
+
+
+class span:
+    """Context manager bracketing one op execution (``SetOprStart/End``)."""
+
+    __slots__ = ["name", "cat", "_t"]
+
+    def __init__(self, name, cat):
+        self.name = name
+        self.cat = cat
+
+    def __enter__(self):
+        self._t = _now_us()
+        return self
+
+    def __exit__(self, *exc):
+        if _state == State.RUN:
+            want = (_mode == Mode.ALL
+                    or (_mode == Mode.SYMBOLIC and self.cat == "symbolic")
+                    or (_mode == Mode.IMPERATIVE and self.cat == "imperative"))
+            if want:
+                record(self.name, self.cat, self._t, _now_us(),
+                       tid=threading.get_ident() % 100000)
+        return False
+
+
+def dump_profile():
+    """Write accumulated events as chrome://tracing JSON (reference
+    ``Profiler::DumpProfile`` ``src/engine/profiler.cc:88``)."""
+    with _lock:
+        payload = {"traceEvents": list(_events), "displayTimeUnit": "ms"}
+        with open(_filename, "w") as f:
+            json.dump(payload, f)
+        _events.clear()
+    return _filename
+
+
+if os.environ.get("MXNET_PROFILER_AUTOSTART", "0") == "1":  # pragma: no cover
+    profiler_set_state(State.RUN)

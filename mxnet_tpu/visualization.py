@@ -1,0 +1,159 @@
+"""Network visualization — ``plot_network`` + ``print_summary``.
+
+Reference: ``python/mxnet/visualization.py`` (316 LoC): ``plot_network``
+builds a graphviz ``Digraph`` of the symbol DAG with per-op-type node styling;
+``print_summary`` prints a Keras-style layer table with output shapes and
+parameter counts.
+"""
+
+from __future__ import annotations
+
+from .base import MXNetError
+from .symbol import Symbol
+
+__all__ = ["plot_network", "print_summary"]
+
+
+def _param_count(shape):
+    n = 1
+    for s in shape or ():
+        n *= s
+    return n if shape else 0
+
+
+def print_summary(symbol, shape=None, line_length=120, positions=None):
+    """Print a layer table (reference ``visualization.py:17``).
+
+    Parameters mirror the reference: ``shape`` is a dict of input shapes
+    (e.g. ``{'data': (1, 3, 224, 224)}``).
+    """
+    if not isinstance(symbol, Symbol):
+        raise MXNetError("symbol must be a Symbol")
+    entry_shapes = {}
+    var_shape = {}
+    if shape is not None:
+        var_shape, _vd, entry_aval = symbol._infer_shapes_full(dict(shape))
+        entry_shapes = {k: tuple(v.shape) for k, v in entry_aval.items()}
+
+    positions = positions or [0.44, 0.64, 0.74, 1.0]
+    if positions[-1] <= 1:
+        positions = [int(line_length * p) for p in positions]
+    headers = ["Layer (type)", "Output Shape", "Param #", "Previous Layer"]
+
+    def print_row(fields):
+        line = ""
+        for i, f in enumerate(fields):
+            line = (line + str(f))[: positions[i] - 1]
+            line += " " * (positions[i] - len(line))
+        print(line)
+
+    print("_" * line_length)
+    print_row(headers)
+    print("=" * line_length)
+
+    total_params = 0
+    nodes = symbol._nodes()
+    for node in nodes:
+        if node.is_variable:
+            continue
+        out_shape = entry_shapes.get((id(node), 0), "")
+        params = 0
+        prevs = []
+        for child, _ci in node.inputs:
+            if child.is_variable:
+                if child.name in ("data",) or child.name.endswith("label"):
+                    prevs.append(child.name)
+                else:
+                    params += _param_count(var_shape.get(child.name))
+            else:
+                prevs.append(child.name)
+        total_params += params
+        print_row(["%s (%s)" % (node.name, node.op.name), out_shape, params,
+                   ", ".join(prevs)])
+        print("_" * line_length)
+    print("Total params: {:,}".format(total_params))
+    print("_" * line_length)
+    return total_params
+
+
+# per-op-type fill colors (reference ``visualization.py:176-220``)
+_NODE_STYLE = {
+    "FullyConnected": "#fb8072",
+    "Convolution": "#fb8072",
+    "Deconvolution": "#fb8072",
+    "Activation": "#ffffb3",
+    "LeakyReLU": "#ffffb3",
+    "BatchNorm": "#bebada",
+    "Pooling": "#80b1d3",
+    "Concat": "#fdb462",
+    "Flatten": "#fdb462",
+    "Reshape": "#fdb462",
+    "SoftmaxOutput": "#b3de69",
+}
+
+
+def plot_network(symbol, title="plot", save_format="pdf", shape=None,
+                 node_attrs=None, hide_weights=True):
+    """Build a graphviz ``Digraph`` of the symbol (reference
+    ``visualization.py:110``).  Returns the Digraph; caller renders it."""
+    try:
+        from graphviz import Digraph
+    except ImportError as e:  # pragma: no cover
+        raise ImportError("plot_network requires the graphviz package") from e
+    if not isinstance(symbol, Symbol):
+        raise MXNetError("symbol must be a Symbol")
+
+    entry_shapes = {}
+    if shape is not None:
+        _vs, _vd, entry_aval = symbol._infer_shapes_full(dict(shape))
+        entry_shapes = {k: tuple(v.shape) for k, v in entry_aval.items()}
+
+    node_attrs = node_attrs or {}
+    base_attrs = {"shape": "box", "fixedsize": "false", "style": "filled"}
+    base_attrs.update(node_attrs)
+    dot = Digraph(name=title, format=save_format)
+
+    nodes = symbol._nodes()
+    drawn = set()
+    for node in nodes:
+        if node.is_variable:
+            looks_weight = not (node.name == "data"
+                                or node.name.endswith("label")
+                                or node.name.endswith("data"))
+            if hide_weights and looks_weight:
+                continue
+            attrs = dict(base_attrs, shape="oval", fillcolor="#8dd3c7")
+            dot.node(name=node.name, label=node.name, **attrs)
+        else:
+            label = node.op.name
+            if node.op.name == "Convolution":
+                label = "Convolution\n%s/%s, %s" % (
+                    "x".join(str(x) for x in node.attrs.get("kernel", ())),
+                    "x".join(str(x) for x in node.attrs.get("stride", (1,))),
+                    node.attrs.get("num_filter", ""))
+            elif node.op.name == "FullyConnected":
+                label = "FullyConnected\n%s" % node.attrs.get("num_hidden", "")
+            elif node.op.name == "Activation":
+                label = "Activation\n%s" % node.attrs.get("act_type", "")
+            elif node.op.name == "Pooling":
+                label = "Pooling\n%s, %s/%s" % (
+                    node.attrs.get("pool_type", ""),
+                    "x".join(str(x) for x in node.attrs.get("kernel", ())),
+                    "x".join(str(x) for x in node.attrs.get("stride", (1,))))
+            color = _NODE_STYLE.get(node.op.name, "#fccde5")
+            attrs = dict(base_attrs, fillcolor=color)
+            dot.node(name=node.name, label=label, **attrs)
+        drawn.add(node.name)
+
+    for node in nodes:
+        if node.is_variable or node.name not in drawn:
+            continue
+        for child, ci in node.inputs:
+            if child.name not in drawn:
+                continue
+            edge_attrs = {"dir": "back", "arrowtail": "open"}
+            shp = entry_shapes.get((id(child), ci))
+            if shp is not None:
+                edge_attrs["label"] = "x".join(str(x) for x in shp)
+            dot.edge(tail_name=node.name, head_name=child.name, **edge_attrs)
+    return dot

@@ -1,0 +1,129 @@
+"""Profiler (chrome tracing), visualization, and runtime kernels (rtc).
+
+References: src/engine/profiler.cc DumpProfile, python/mxnet/profiler.py,
+python/mxnet/visualization.py, python/mxnet/rtc.py + tests
+test_profiler.py / test_viz.py / test_rtc.py in the reference suite.
+"""
+
+import json
+import os
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def _lenet():
+    data = mx.sym.Variable("data")
+    c1 = mx.sym.Convolution(data, kernel=(5, 5), num_filter=8, name="c1")
+    a1 = mx.sym.Activation(c1, act_type="relu", name="a1")
+    p1 = mx.sym.Pooling(a1, kernel=(2, 2), stride=(2, 2), pool_type="max",
+                        name="p1")
+    f1 = mx.sym.Flatten(p1, name="flat")
+    fc = mx.sym.FullyConnected(f1, num_hidden=10, name="fc")
+    return mx.sym.SoftmaxOutput(fc, name="softmax")
+
+
+def test_profiler_dump(tmp_path):
+    fname = str(tmp_path / "profile.json")
+    mx.profiler.profiler_set_config(mode="all", filename=fname)
+    mx.profiler.profiler_set_state("run")
+
+    a = mx.nd.ones((16, 16))
+    b = mx.nd.ones((16, 16))
+    (a + b).asnumpy()
+    mx.nd.dot(a, b).asnumpy()
+
+    net = _lenet()
+    exe = net.simple_bind(mx.cpu(), data=(2, 1, 28, 28))
+    exe.forward(is_train=True)
+    exe.backward()
+
+    mx.profiler.profiler_set_state("stop")
+    out = mx.profiler.dump_profile()
+    assert out == fname
+    with open(fname) as f:
+        trace = json.load(f)
+    events = trace["traceEvents"]
+    names = {e["name"] for e in events}
+    assert any("dot" in n for n in names)
+    assert any("forward" in n for n in names)
+    for e in events:
+        assert e["ph"] == "X" and e["dur"] >= 0
+
+
+def test_profiler_symbolic_mode_filters_imperative(tmp_path):
+    fname = str(tmp_path / "p2.json")
+    mx.profiler.profiler_set_config(mode="symbolic", filename=fname)
+    mx.profiler.profiler_set_state("run")
+    (mx.nd.ones((4, 4)) * 2).asnumpy()
+    mx.profiler.profiler_set_state("stop")
+    mx.profiler.dump_profile()
+    with open(fname) as f:
+        trace = json.load(f)
+    assert all(e["cat"] != "imperative" for e in trace["traceEvents"])
+
+
+def test_print_summary(capsys):
+    net = _lenet()
+    total = mx.visualization.print_summary(
+        net, shape={"data": (1, 1, 28, 28)})
+    outp = capsys.readouterr().out
+    assert "fc (FullyConnected)" in outp
+    # c1: 8*1*5*5 + 8; fc: (8*12*12)*10 + 10
+    assert total == (8 * 25 + 8) + (8 * 12 * 12 * 10 + 10)
+
+
+def test_plot_network():
+    net = _lenet()
+    dot = mx.visualization.plot_network(
+        net, shape={"data": (1, 1, 28, 28)}, title="lenet")
+    src = dot.source
+    assert "c1" in src and "fc" in src
+    # edge labels carry shapes
+    assert "label" in src
+
+
+def test_rtc_jax_kernel():
+    rtc = mx.rtc.Rtc("axpy", ["x", "y"], ["out"], """
+    def axpy(x, y):
+        return 2.0 * x + y
+    """)
+    x = mx.nd.ones((4, 4))
+    y = mx.nd.full((4, 4), 3.0)
+    out = mx.nd.zeros((4, 4))
+    rtc.push([x, y], [out])
+    np.testing.assert_allclose(out.asnumpy(), 5.0 * np.ones((4, 4)))
+
+
+def test_rtc_pallas_kernel():
+    """Author a Pallas kernel at runtime (the NVRTC-analog path).  Uses
+    interpret mode so it runs on any backend; on TPU the same source lowers
+    through Mosaic."""
+    rtc = mx.rtc.Rtc("scale2", ["x"], ["out"], """
+    def _kernel(x_ref, o_ref):
+        o_ref[...] = x_ref[...] * 2.0
+
+    def scale2(x):
+        return pl.pallas_call(
+            _kernel,
+            out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+            interpret=True,
+        )(x)
+    """)
+    x = mx.nd.full((8, 128), 1.5)
+    out = mx.nd.zeros((8, 128))
+    rtc.push([x], [out])
+    np.testing.assert_allclose(out.asnumpy(), 3.0 * np.ones((8, 128)))
+
+
+def test_rtc_cache_reuse():
+    src = """
+    def f(x):
+        return x + 1.0
+    """
+    r1 = mx.rtc.Rtc("f", ["x"], ["y"], src)
+    r2 = mx.rtc.Rtc("f", ["x"], ["y"], src)
+    out = mx.nd.zeros((2, 2))
+    r2.push([mx.nd.ones((2, 2))], [out])
+    np.testing.assert_allclose(out.asnumpy(), 2.0)

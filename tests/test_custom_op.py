@@ -1,0 +1,160 @@
+"""Custom Python operators (reference python/mxnet/operator.py,
+tests/python/unittest/test_operator.py custom-op cases, example/numpy-ops)."""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+@mx.operator.register("test_sigmoid")
+class SigmoidProp(mx.operator.CustomOpProp):
+    def __init__(self):
+        super().__init__(need_top_grad=True)
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        class Sigmoid(mx.operator.CustomOp):
+            def forward(self, is_train, req, in_data, out_data, aux):
+                y = 1.0 / (1.0 + np.exp(-in_data[0]))
+                self.assign(out_data[0], req[0], y)
+
+            def backward(self, req, out_grad, in_data, out_data, in_grad,
+                         aux):
+                y = out_data[0]
+                self.assign(in_grad[0], req[0], out_grad[0] * y * (1.0 - y))
+
+        return Sigmoid()
+
+
+@mx.operator.register("test_softmax_loss")
+class SoftmaxLossProp(mx.operator.CustomOpProp):
+    """example/numpy-ops/custom_softmax.py pattern: loss op, no top grad."""
+
+    def __init__(self):
+        super().__init__(need_top_grad=False)
+
+    def list_arguments(self):
+        return ["data", "label"]
+
+    def infer_shape(self, in_shape):
+        data_shape = in_shape[0]
+        label_shape = [in_shape[0][0]]
+        return [data_shape, label_shape], [data_shape], []
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        class Softmax(mx.operator.CustomOp):
+            def forward(self, is_train, req, in_data, out_data, aux):
+                x = in_data[0]
+                e = np.exp(x - x.max(axis=1, keepdims=True))
+                self.assign(out_data[0], req[0],
+                            e / e.sum(axis=1, keepdims=True))
+
+            def backward(self, req, out_grad, in_data, out_data, in_grad,
+                         aux):
+                label = in_data[1].astype(np.int64)
+                y = out_data[0].copy()
+                y[np.arange(y.shape[0]), label] -= 1.0
+                self.assign(in_grad[0], req[0], y)
+                self.assign(in_grad[1], req[1], np.zeros_like(in_data[1]))
+
+        return Softmax()
+
+
+def test_custom_forward_matches_native():
+    x = np.random.uniform(-3, 3, (4, 5)).astype(np.float32)
+    data = mx.sym.Variable("data")
+    csym = mx.sym.Custom(data, op_type="test_sigmoid")
+    exe = csym.bind(mx.cpu(), {"data": mx.nd.array(x)})
+    out = exe.forward()[0].asnumpy()
+    np.testing.assert_allclose(out, 1 / (1 + np.exp(-x)), rtol=1e-5)
+
+
+def test_custom_backward():
+    x = np.random.uniform(-2, 2, (3, 4)).astype(np.float32)
+    data = mx.sym.Variable("data")
+    csym = mx.sym.sum(mx.sym.Custom(data, op_type="test_sigmoid"))
+    xnd = mx.nd.array(x)
+    g = mx.nd.zeros(x.shape)
+    exe = csym.bind(mx.cpu(), {"data": xnd}, args_grad={"data": g})
+    exe.forward(is_train=True)
+    exe.backward()
+    s = 1 / (1 + np.exp(-x))
+    np.testing.assert_allclose(g.asnumpy(), s * (1 - s), rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_custom_loss_op_end_to_end():
+    """Custom softmax trains a tiny classifier (numpy-ops example)."""
+    rs = np.random.RandomState(0)
+    x = rs.normal(size=(8, 6)).astype(np.float32)
+    lab = rs.randint(0, 3, (8,)).astype(np.float32)
+
+    data = mx.sym.Variable("data")
+    w = mx.sym.Variable("w")
+    fc = mx.sym.dot(data, w)
+    out = mx.sym.Custom(fc, mx.sym.Variable("label"),
+                        op_type="test_softmax_loss", name="softmax")
+
+    wv = mx.nd.array(rs.normal(scale=0.1, size=(6, 3)).astype(np.float32))
+    gw = mx.nd.zeros((6, 3))
+    exe = out.bind(mx.cpu(), {"data": mx.nd.array(x), "w": wv,
+                               "label": mx.nd.array(lab)},
+                    args_grad={"w": gw})
+    first = None
+    for _ in range(5):
+        y = exe.forward(is_train=True)[0].asnumpy()
+        loss = -np.log(y[np.arange(8), lab.astype(int)] + 1e-8).mean()
+        if first is None:
+            first = loss
+        exe.backward()
+        wv[:] = wv.asnumpy() - 0.02 * gw.asnumpy()
+    assert loss < first
+
+
+def test_custom_symbol_json_roundtrip():
+    data = mx.sym.Variable("data")
+    csym = mx.sym.Custom(data, op_type="test_sigmoid")
+    s2 = mx.sym.load_json(csym.tojson())
+    assert s2.list_arguments() == csym.list_arguments()
+    x = np.ones((2, 2), np.float32)
+    out = s2.bind(mx.cpu(), {"data": mx.nd.array(x)}).forward()[0]
+    np.testing.assert_allclose(out.asnumpy(), 1 / (1 + np.exp(-x)),
+                               rtol=1e-5)
+
+
+def test_legacy_numpy_op():
+    class Square(mx.operator.NumpyOp):
+        def forward(self, in_data, out_data):
+            out_data[0][...] = in_data[0] ** 2
+
+        def backward(self, out_grad, in_data, out_data, in_grad):
+            in_grad[0][...] = 2 * in_data[0] * out_grad[0]
+
+    sq = Square()
+    data = mx.sym.Variable("data")
+    s = mx.sym.sum(sq(data))
+    x = np.arange(6, dtype=np.float32).reshape(2, 3)
+    g = mx.nd.zeros(x.shape)
+    exe = s.bind(mx.cpu(), {"data": mx.nd.array(x)},
+                  args_grad={"data": g})
+    exe.forward(is_train=True)
+    exe.backward()
+    np.testing.assert_allclose(g.asnumpy(), 2 * x, rtol=1e-5)
+
+
+def test_custom_in_module_fit():
+    """Custom op inside Module.fit (the SSD/rcnn usage pattern)."""
+    rs = np.random.RandomState(1)
+    x = rs.normal(size=(16, 5)).astype(np.float32)
+    y = (x.sum(axis=1) > 0).astype(np.float32)
+
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data, num_hidden=2, name="fc")
+    act = mx.sym.Custom(fc, op_type="test_sigmoid", name="cact")
+    net = mx.sym.SoftmaxOutput(act, name="softmax")
+
+    it = mx.io.NDArrayIter(x, y, batch_size=8, label_name="softmax_label")
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.fit(it, num_epoch=2,
+            optimizer_params={"learning_rate": 0.1})
+    assert mod.score(it, mx.metric.Accuracy())[0][1] >= 0.4

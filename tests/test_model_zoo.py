@@ -1,0 +1,63 @@
+"""Model-zoo symbol builders: shape inference + tiny forward checks.
+
+Reference capability checklist: example/image-classification/symbols/
+(SURVEY §2.8) + example/rcnn.
+"""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import models
+
+
+@pytest.mark.parametrize("network", [
+    "lenet", "mlp", "alexnet", "googlenet", "inception-bn",
+])
+def test_classification_symbols_shape(network):
+    num_classes = 10 if network in ("lenet", "mlp") else 1000
+    net = models.get_symbol(network, num_classes=num_classes)
+    dshape = (2, 1, 28, 28) if network in ("lenet", "mlp") \
+        else (2, 3, 224, 224)
+    if network == "mlp":
+        dshape = (2, 784)
+    _, out_shapes, _ = net.infer_shape(data=dshape)
+    assert out_shapes[0] == (2, num_classes)
+
+
+def test_inception_resnet_v2_shape():
+    # trimmed repeats: full repeat counts only change depth, not shapes
+    net = models.inception_resnet_v2.get_symbol(
+        num_classes=1000, num_35=1, num_17=1, num_8=1)
+    _, out_shapes, _ = net.infer_shape(data=(1, 3, 299, 299))
+    assert out_shapes[0] == (1, 1000)
+
+
+def test_resnext_shape():
+    net = models.get_symbol("resnext", num_classes=1000, num_layers=50)
+    _, out_shapes, _ = net.infer_shape(data=(1, 3, 224, 224))
+    assert out_shapes[0] == (1, 1000)
+
+
+def test_rcnn_test_symbol_forward():
+    """Faster R-CNN inference graph runs end-to-end on a tiny image."""
+    net = models.rcnn.get_symbol_test(num_classes=4)
+    exe = net.simple_bind(mx.cpu(), data=(1, 3, 64, 64), im_info=(1, 3))
+    exe.arg_dict["data"][:] = np.random.uniform(
+        0, 1, (1, 3, 64, 64)).astype(np.float32)
+    exe.arg_dict["im_info"][:] = np.array([[64, 64, 1.0]], np.float32)
+    rois, cls_prob, bbox_pred = exe.forward()
+    assert rois.shape[1] == 5
+    n_roi = rois.shape[0]
+    assert cls_prob.shape == (n_roi, 4)
+    assert bbox_pred.shape == (n_roi, 16)
+    p = cls_prob.asnumpy()
+    np.testing.assert_allclose(p.sum(axis=1), 1.0, rtol=1e-4)
+
+
+def test_rcnn_rpn_train_symbol_shapes():
+    net = models.rcnn.get_symbol_rpn()
+    arg_shapes, out_shapes, _ = net.infer_shape(
+        data=(1, 3, 64, 64), label=(1, 2 * 4 * 4 * 9 // 2),
+        bbox_target=(1, 36, 4, 4), bbox_weight=(1, 36, 4, 4))
+    assert out_shapes[0][0] == 1
