@@ -50,15 +50,13 @@ def main():
     mod = mx.mod.Module(net, context=ctx)
     mod.bind(data_shapes=[("data", (BATCH, 3, 224, 224))],
              label_shapes=[("softmax_label", (BATCH,))])
+    mod.init_params(mx.init.Xavier(rnd_type="gaussian", factor_type="in",
+                                   magnitude=2))
     # bf16 params/activations; BatchNorm stats stay f32 inside the op
     if DTYPE != "float32":
-        import jax
-
         for n, a in mod._exec.arg_dict.items():
             if n not in ("softmax_label",):
                 a._jx = a._jx.astype(DTYPE)
-    mod.init_params(mx.init.Xavier(rnd_type="gaussian", factor_type="in",
-                                   magnitude=2))
     mod.init_optimizer(kvstore=None, optimizer="sgd",
                        optimizer_params={"learning_rate": 0.05,
                                          "momentum": 0.9, "wd": 1e-4})

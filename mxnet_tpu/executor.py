@@ -188,15 +188,16 @@ class Executor:
         from . import profiler as _profiler
 
         fused_bwd = is_train and bool(self._diff_names())
-        with _profiler.span(
-                "%s_forward%s" % (self._symbol_name(),
-                                  "_backward" if fused_bwd else ""),
-                "symbolic"):
+        name = ("%s_forward%s" % (self._symbol_name(),
+                                  "_backward" if fused_bwd else "")) \
+            if _profiler.running() else ""
+        with _profiler.span(name, "symbolic") as sp:
             if is_train:
                 if self._diff_names():
                     outs, new_aux, grads = self._get_fn("train")(args, aux, rng)
                     self._pending_grads = grads
                     self._last_state = (args, aux, rng)
+                    sp.sync(grads)
                 else:
                     outs, new_aux = self._get_fn("train_fwd")(args, aux, rng)
                     self._pending_grads = None
@@ -207,6 +208,7 @@ class Executor:
                 outs = self._get_fn("predict")(args, aux, rng)
                 self._pending_grads = None
                 self._last_state = None
+            sp.sync(outs)
         self.outputs = [NDArray._from_jax(o, self._ctx) for o in outs]
         if self._monitor_callback is not None:
             for name, arr in zip(self.output_names, self.outputs):
@@ -230,10 +232,12 @@ class Executor:
             args, aux, rng = self._last_state
             from . import profiler as _profiler
 
-            with _profiler.span("%s_backward" % self._symbol_name(),
-                                "symbolic"):
+            bname = ("%s_backward" % self._symbol_name()) \
+                if _profiler.running() else ""
+            with _profiler.span(bname, "symbolic") as sp:
                 _outs, _new_aux, grads = self._get_fn("train_with_grads")(
                     args, aux, rng, out_grads)
+                sp.sync(grads)
         for name in self._diff_names():
             g = grads[name]
             dst = self.grad_dict.get(name)

@@ -376,18 +376,23 @@ class Module(BaseModule):
                         idx, self._exec.arg_dict[n])
 
             def step(params, grads, moms, lrs, wds):
+                # math in f32, results cast back to the stored dtypes so
+                # bf16 params stay bf16 across steps (weights never promote)
                 new_p, new_m = [], []
                 for i, (p, g) in enumerate(zip(params, grads)):
-                    g = g * rescale
+                    g = g.astype(jnp.float32) * rescale
                     if clip > 0:
                         g = jnp.clip(g, -clip, clip)
-                    g = g + wds[i] * p
+                    g = g + wds[i] * p.astype(jnp.float32)
                     if momentum != 0.0:
-                        m = momentum * moms[i] - lrs[i] * g
-                        new_m.append(m)
-                        new_p.append(p + m)
+                        m = momentum * moms[i].astype(jnp.float32) \
+                            - lrs[i] * g
+                        new_m.append(m.astype(moms[i].dtype))
+                        new_p.append((p.astype(jnp.float32) + m)
+                                     .astype(p.dtype))
                     else:
-                        new_p.append(p - lrs[i] * g)
+                        new_p.append((p.astype(jnp.float32) - lrs[i] * g)
+                                     .astype(p.dtype))
                 return new_p, new_m
 
             self._fused_step = jax.jit(step, donate_argnums=(0, 2))

@@ -488,7 +488,7 @@ def _invoke(op, args, kwargs):
     fn = _reg.jitted_apply(op.name, _reg.attrs_key(attrs), True)
     from . import profiler as _profiler
 
-    with _profiler.span(op.name, "imperative"):
+    with _profiler.span(op.name, "imperative") as sp:
         if inputs:
             octx = inputs[0]._ctx
             outs, aux_up = fn([x._jx for x in inputs],
@@ -497,6 +497,7 @@ def _invoke(op, args, kwargs):
             octx = ctx or current_context()
             with jax.default_device(octx.jax_device()):
                 outs, aux_up = fn([], [], rng)
+        sp.sync(outs)
     # write aux updates back (reference mutates aux NDArrays in the op)
     for arr, new in zip(aux_arrays, aux_up or []):
         arr._jx = new

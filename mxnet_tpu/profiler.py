@@ -97,26 +97,44 @@ def record(name, cat, start_us, end_us, tid=0):
 
 
 class span:
-    """Context manager bracketing one op execution (``SetOprStart/End``)."""
+    """Context manager bracketing one op execution (``SetOprStart/End``).
 
-    __slots__ = ["name", "cat", "_t"]
+    When the profiler is stopped (the default), spans are no-ops: the
+    enabled check happens once in ``__init__`` and nothing else is paid.
+    When recording, callers must pass their jax result through ``sync()``
+    so the duration covers real device execution, not just JAX's async
+    dispatch (the engine analog syncs the CUDA stream before
+    ``SetOprEnd`` — ``threaded_engine.h:296-307``).
+    """
+
+    __slots__ = ["name", "cat", "_t", "_on"]
 
     def __init__(self, name, cat):
-        self.name = name
-        self.cat = cat
+        self._on = _state == State.RUN and (
+            _mode == Mode.ALL
+            or (_mode == Mode.SYMBOLIC and cat == "symbolic")
+            or (_mode == Mode.IMPERATIVE and cat == "imperative"))
+        if self._on:
+            self.name = name
+            self.cat = cat
 
     def __enter__(self):
-        self._t = _now_us()
+        if self._on:
+            self._t = _now_us()
         return self
 
+    def sync(self, val):
+        """Block until ``val``'s device work is done iff recording."""
+        if self._on:
+            import jax
+
+            jax.block_until_ready(val)
+        return val
+
     def __exit__(self, *exc):
-        if _state == State.RUN:
-            want = (_mode == Mode.ALL
-                    or (_mode == Mode.SYMBOLIC and self.cat == "symbolic")
-                    or (_mode == Mode.IMPERATIVE and self.cat == "imperative"))
-            if want:
-                record(self.name, self.cat, self._t, _now_us(),
-                       tid=threading.get_ident() % 100000)
+        if self._on:
+            record(self.name, self.cat, self._t, _now_us(),
+                   tid=threading.get_ident() % 100000)
         return False
 
 

@@ -94,6 +94,11 @@ class Rtc:
         for dst, src in zip(outs, res):
             if not isinstance(dst, NDArray):
                 raise MXNetError("rtc outputs must be NDArrays")
+            if tuple(src.shape) != tuple(dst._jx.shape):
+                raise MXNetError(
+                    "rtc %s: kernel output shape %s != output NDArray "
+                    "shape %s" % (self.name, tuple(src.shape),
+                                  tuple(dst._jx.shape)))
             dst._jx = src.astype(dst._jx.dtype) \
                 if src.dtype != dst._jx.dtype else src
         return outs

@@ -52,6 +52,9 @@ def print_summary(symbol, shape=None, line_length=120, positions=None):
     print("=" * line_length)
 
     total_params = 0
+    # auxiliary states (e.g. BatchNorm moving_mean/var) are not trainable
+    # parameters and must not be counted (reference visualization.py:64-76)
+    aux_names = set(symbol.list_auxiliary_states())
     nodes = symbol._nodes()
     for node in nodes:
         if node.is_variable:
@@ -61,7 +64,8 @@ def print_summary(symbol, shape=None, line_length=120, positions=None):
         prevs = []
         for child, _ci in node.inputs:
             if child.is_variable:
-                if child.name in ("data",) or child.name.endswith("label"):
+                if child.name in ("data",) or child.name.endswith("label") \
+                        or child.name in aux_names:
                     prevs.append(child.name)
                 else:
                     params += _param_count(var_shape.get(child.name))
