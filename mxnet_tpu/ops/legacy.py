@@ -1,0 +1,103 @@
+"""Legacy NDArray-function registry ops + plugin-analog ops.
+
+Reference: the ``MXNET_REGISTER_NDARRAY_FUN`` census
+(``src/ndarray/ndarray.cc:748-867``: ``_set_value``, ``_onehot_encode``,
+``_copyto``, ``_broadcast``, ``_imdecode``; ``choose_element_0index`` and
+``fill_element_0index`` live in ``mxnet_tpu.ops.matrix``/``indexing``),
+the NNVM slice-assign pair (``src/operator/tensor/matrix_op.cc``:
+``_slice_assign``/``_crop_assign_scalar``), ``Convolution_v1``
+(``src/operator/convolution_v1.cc`` — same math as Convolution), and the
+WarpCTC plugin (``plugin/warpctc/warpctc-inl.h``) whose TPU-native analog
+is a CTC loss lowered through XLA (core DP from ``optax.ctc_loss``).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .helpers import simple
+from .registry import (REQUIRED, pbool, pfloat, pint, pstr, ptuple, register,
+                       _ALIASES)
+
+
+def _region(begin, end, shape):
+    return tuple(slice(b, e if e != 0 or b != 0 else None)
+                 for b, e in zip(begin, end)) + \
+        tuple(slice(None) for _ in range(len(shape) - len(begin)))
+
+
+def _slice_assign(lhs, rhs, begin, end):
+    return lhs.at[_region(begin, end, lhs.shape)].set(rhs)
+
+
+simple("_slice_assign", _slice_assign, arguments=("lhs", "rhs"),
+       params={"begin": (ptuple, REQUIRED), "end": (ptuple, REQUIRED)},
+       aliases=("_crop_assign",))
+
+
+def _crop_assign_scalar(data, begin, end, scalar):
+    reg = _region(begin, end, data.shape)
+    return data.at[reg].set(jnp.asarray(scalar, data.dtype))
+
+
+simple("_crop_assign_scalar", _crop_assign_scalar,
+       params={"begin": (ptuple, REQUIRED), "end": (ptuple, REQUIRED),
+               "scalar": (pfloat, 0.0)},
+       aliases=("_slice_assign_scalar",))
+
+# _set_value: fill the (existing) array with a scalar (ndarray.cc:748)
+simple("_set_value", lambda data, src: jnp.full_like(data, src),
+       params={"src": (pfloat, REQUIRED)})
+
+
+def _onehot_encode(indices, out):
+    """(indices, out) -> one-hot written over ``out`` (ndarray.cc:767)."""
+    depth = out.shape[-1]
+    return jax.nn.one_hot(indices.astype(jnp.int32), depth,
+                          dtype=out.dtype)
+
+
+simple("_onehot_encode", _onehot_encode, arguments=("indices", "out"),
+       aliases=("onehot_encode",))
+
+# _broadcast: explicit broadcast of 1-dims up to a full shape (ndarray.cc:818)
+simple("_broadcast", lambda data, shape: jnp.broadcast_to(data, shape),
+       params={"shape": (ptuple, REQUIRED)})
+
+# _copyto / Convolution_v1 are pure aliases of existing ops
+_ALIASES["_copyto"] = "_copy"
+_ALIASES["Convolution_v1"] = "Convolution"
+
+
+# ---------------------------------------------------------------------------
+# CTC loss — the WarpCTC plugin analog (plugin/warpctc/warpctc-inl.h)
+# ---------------------------------------------------------------------------
+
+def _ctc_loss(attrs, inputs, aux, is_train, rng):
+    import optax
+
+    data, label = inputs[0], inputs[1]
+    # reference layout: data (seq_len, batch, alphabet), label (batch, L)
+    logits = jnp.transpose(data, (1, 0, 2)).astype(jnp.float32)
+    labels = label.astype(jnp.int32)
+    if labels.ndim == 1:
+        labels = labels[:, None]
+    blank = 0
+    if attrs["blank_label"] == "last":
+        blank = data.shape[-1] - 1
+        pad_mask = (labels == -1) | (labels >= blank)
+    else:
+        # blank_label='first': class 0 is blank, 0 also pads labels
+        pad_mask = labels <= 0
+    logit_pad = jnp.zeros(logits.shape[:2], jnp.float32)
+    loss = optax.ctc_loss(logits, logit_pad, labels,
+                          pad_mask.astype(jnp.float32), blank_id=blank)
+    return [loss.astype(data.dtype)]
+
+
+register("CTCLoss", _ctc_loss, arguments=("data", "label"),
+         params={"use_data_lengths": (pbool, False),
+                 "use_label_lengths": (pbool, False),
+                 "blank_label": (pstr, "first")},
+         aliases=("ctc_loss", "_contrib_CTCLoss", "WarpCTC"))

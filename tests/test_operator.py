@@ -286,3 +286,52 @@ def test_block_grad():
     x = RS.rand(2, 2).astype(np.float32)
     check_symbolic_backward(out, {"data": x}, [np.ones((2, 2), np.float32)],
                             {"data": np.zeros((2, 2), np.float32)})
+
+
+def test_legacy_ndarray_funs():
+    """census ops from ``src/ndarray/ndarray.cc:748-867`` + slice assign."""
+    a = nd.array(np.arange(24, dtype=np.float32).reshape(4, 6))
+    r = nd._slice_assign(a, nd.zeros((2, 3)), begin=(1, 1), end=(3, 4))
+    out = r.asnumpy()
+    assert out[1:3, 1:4].sum() == 0 and out[0].sum() > 0
+    r = nd._crop_assign_scalar(a, begin=(0, 0), end=(2, 2), scalar=7)
+    assert (r.asnumpy()[:2, :2] == 7).all()
+    assert (nd._set_value(a, src=3.5).asnumpy() == 3.5).all()
+    oh = nd._onehot_encode(nd.array(np.array([1.0, 0.0, 2.0])),
+                           nd.zeros((3, 4)))
+    assert oh.asnumpy().argmax(1).tolist() == [1, 0, 2]
+    assert nd._broadcast(nd.ones((1, 3)), shape=(5, 3)).shape == (5, 3)
+    assert_almost_equal(nd._copyto(a), a.asnumpy())
+
+
+def test_convolution_v1_alias():
+    s = sym.Convolution_v1(sym.Variable("data"), num_filter=2, kernel=(3, 3))
+    ex = s.simple_bind(mx.cpu(), data=(1, 1, 8, 8))
+    ex.forward(is_train=False)
+    assert ex.outputs[0].shape == (1, 2, 6, 6)
+
+
+def test_ctc_loss():
+    """WarpCTC plugin analog (plugin/warpctc/warpctc-inl.h)."""
+    S, B, A, L = 8, 2, 5, 3
+    lab = np.array([[1, 2, 3], [2, 4, 0]], np.float32)
+    loss = nd.ctc_loss(nd.array(np.zeros((S, B, A), np.float32)),
+                       nd.array(lab)).asnumpy()
+    assert loss.shape == (B,) and (loss > 0).all()
+    # a sharp correct path scores much better than uniform logits
+    logits = np.full((S, B, A), -10.0, np.float32)
+    path = [1, 0, 2, 0, 3, 0, 0, 0]
+    for t, c in enumerate(path):
+        logits[t, 0, c] = 10.0
+    sharp = nd.ctc_loss(nd.array(logits), nd.array(lab)).asnumpy()
+    assert sharp[0] < loss[0]
+    # gradient flows and is finite
+    d, l = sym.Variable("data"), sym.Variable("label")
+    s = sym.make_loss(sym.sum(sym.CTCLoss(d, l)))
+    ex = s.simple_bind(mx.cpu(), data=(S, B, A), label=(B, L))
+    ex.arg_dict["data"][:] = RS.rand(S, B, A).astype(np.float32)
+    ex.arg_dict["label"][:] = lab
+    ex.forward(is_train=True)
+    ex.backward()
+    g = ex.grad_dict["data"].asnumpy()
+    assert np.isfinite(g).all() and np.abs(g).sum() > 0
