@@ -1,0 +1,61 @@
+#!/usr/bin/env python
+"""Fast Gradient Sign Method adversarial examples.
+
+Reference: ``example/adversary/`` — train a classifier, then perturb inputs
+along ``sign(dL/dx)`` (via ``inputs_need_grad=True``) and watch accuracy
+collapse.
+"""
+
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                "image-classification"))
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from common import data as exdata  # noqa: E402
+from mxnet_tpu.models import lenet  # noqa: E402
+
+if __name__ == "__main__":
+    logging.basicConfig(level=logging.INFO)
+    parser = argparse.ArgumentParser(description="FGSM adversary")
+    parser.add_argument("--data-dir", type=str, default="data")
+    parser.add_argument("--batch-size", type=int, default=64)
+    parser.add_argument("--epsilon", type=float, default=0.15)
+    parser.add_argument("--num-epochs", type=int, default=2)
+    args = parser.parse_args()
+    args.num_examples = 2048
+    args.num_classes = 10
+    args.network = "lenet"
+
+    kv = mx.kvstore.create("local")
+    train, val = exdata.get_mnist_iter(args, kv)
+    ctx = mx.tpu() if mx.num_tpus() > 0 else mx.cpu()
+    net = lenet.get_symbol(num_classes=10)
+    mod = mx.mod.Module(net, context=ctx)
+    mod.fit(train, eval_data=val, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.05, "momentum": 0.9},
+            initializer=mx.init.Xavier(), num_epoch=args.num_epochs)
+    print("clean accuracy:", mod.score(val, "acc"))
+
+    # rebind for input gradients
+    amod = mx.mod.Module(net, context=ctx)
+    amod.bind(data_shapes=val.provide_data, label_shapes=val.provide_label,
+              for_training=True, inputs_need_grad=True)
+    amod.set_params(*mod.get_params())
+    metric = mx.metric.create("acc")
+    val.reset()
+    for batch in val:
+        amod.forward(batch, is_train=True)
+        amod.backward()
+        gsign = amod.get_input_grads()[0].asnumpy()
+        adv = batch.data[0].asnumpy() + args.epsilon * np.sign(gsign)
+        amod.forward(mx.io.DataBatch(data=[mx.nd.array(adv)],
+                                     label=batch.label), is_train=False)
+        metric.update(batch.label, amod.get_outputs())
+    print("adversarial accuracy (eps=%.2f):" % args.epsilon, metric.get())

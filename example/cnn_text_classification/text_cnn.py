@@ -1,0 +1,79 @@
+#!/usr/bin/env python
+"""CNN for sentence classification (Kim 2014).
+
+Reference: ``example/cnn_text_classification/text_cnn.py`` — embeddings →
+parallel convs of widths 3/4/5 → max-pool over time → concat → dropout →
+softmax.
+"""
+
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+
+
+def make_text_cnn(seq_len, vocab, embed_dim, num_filter, num_classes,
+                  filter_sizes=(3, 4, 5), dropout=0.5):
+    data = mx.sym.Variable("data")
+    embed = mx.sym.Embedding(data, input_dim=vocab, output_dim=embed_dim,
+                             name="embed")
+    conv_input = mx.sym.Reshape(embed, shape=(-1, 1, seq_len, embed_dim))
+    pooled = []
+    for fs in filter_sizes:
+        conv = mx.sym.Convolution(conv_input, kernel=(fs, embed_dim),
+                                  num_filter=num_filter,
+                                  name="conv%d" % fs)
+        act = mx.sym.Activation(conv, act_type="relu")
+        pool = mx.sym.Pooling(act, pool_type="max",
+                              kernel=(seq_len - fs + 1, 1), stride=(1, 1))
+        pooled.append(pool)
+    concat = mx.sym.Concat(*pooled, dim=1)
+    h = mx.sym.Reshape(concat, shape=(-1, num_filter * len(filter_sizes)))
+    if dropout > 0:
+        h = mx.sym.Dropout(h, p=dropout)
+    fc = mx.sym.FullyConnected(h, num_hidden=num_classes, name="fc")
+    return mx.sym.SoftmaxOutput(fc, name="softmax")
+
+
+if __name__ == "__main__":
+    logging.basicConfig(level=logging.INFO)
+    parser = argparse.ArgumentParser(description="text cnn")
+    parser.add_argument("--seq-len", type=int, default=20)
+    parser.add_argument("--vocab", type=int, default=500)
+    parser.add_argument("--embed-dim", type=int, default=32)
+    parser.add_argument("--num-filter", type=int, default=32)
+    parser.add_argument("--batch-size", type=int, default=32)
+    parser.add_argument("--num-epochs", type=int, default=5)
+    args = parser.parse_args()
+
+    rs = np.random.RandomState(0)
+    # sentiment = presence of "positive" vs "negative" token sets
+    pos_tokens = rs.choice(args.vocab, 20, replace=False)
+    neg_tokens = rs.choice(
+        [t for t in range(args.vocab) if t not in set(pos_tokens)], 20,
+        replace=False)
+    n = 2048
+    X = rs.randint(0, args.vocab, (n, args.seq_len))
+    y = rs.randint(0, 2, n)
+    for i in range(n):
+        toks = pos_tokens if y[i] else neg_tokens
+        where = rs.choice(args.seq_len, 3, replace=False)
+        X[i, where] = rs.choice(toks, 3)
+
+    it = mx.io.NDArrayIter({"data": X.astype(np.float32)},
+                           {"softmax_label": y.astype(np.float32)},
+                           batch_size=args.batch_size, shuffle=True)
+    net = make_text_cnn(args.seq_len, args.vocab, args.embed_dim,
+                        args.num_filter, 2)
+    ctx = mx.tpu() if mx.num_tpus() > 0 else mx.cpu()
+    mod = mx.mod.Module(net, context=ctx)
+    mod.fit(it, eval_metric="acc", optimizer="adam",
+            optimizer_params={"learning_rate": 0.003},
+            initializer=mx.init.Xavier(), num_epoch=args.num_epochs,
+            batch_end_callback=mx.callback.Speedometer(args.batch_size, 30))

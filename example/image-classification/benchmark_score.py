@@ -1,0 +1,67 @@
+#!/usr/bin/env python
+"""Inference throughput sweep over the model zoo — the analog of the
+reference's ``example/image-classification/benchmark_score.py`` whose
+published numbers are the SURVEY §6 inference table
+(``docs/how_to/perf.md:67-100``).
+"""
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import models  # noqa: E402
+
+
+def score(network, batch_size, image_shape=(3, 224, 224), num_batches=20,
+          dtype="float32", **net_kwargs):
+    sym = models.get_symbol(network, num_classes=1000,
+                            image_shape=image_shape, **net_kwargs)
+    ctx = mx.tpu() if mx.num_tpus() > 0 else mx.cpu()
+    mod = mx.mod.Module(symbol=sym, context=ctx,
+                        label_names=["softmax_label"])
+    data_shape = (batch_size,) + tuple(image_shape)
+    mod.bind(for_training=False, inputs_need_grad=False,
+             data_shapes=[("data", data_shape)])
+    mod.init_params(initializer=mx.init.Xavier(magnitude=2.0))
+    if dtype != "float32":
+        for n, a in mod._exec.arg_dict.items():
+            a._jx = a._jx.astype(dtype)
+    rs = np.random.RandomState(0)
+    batch = mx.io.DataBatch(
+        data=[mx.nd.array(rs.rand(*data_shape).astype(np.float32),
+                          dtype=dtype)], label=[])
+
+    for _ in range(3):  # warmup/compile
+        mod.forward(batch, is_train=False)
+    mod.get_outputs()[0].wait_to_read()
+    tic = time.time()
+    for _ in range(num_batches):
+        mod.forward(batch, is_train=False)
+    mod.get_outputs()[0].wait_to_read()
+    return num_batches * batch_size / (time.time() - tic)
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(description="inference benchmark")
+    parser.add_argument("--networks", type=str,
+                        default="alexnet,vgg,inception-bn,inception-v3,"
+                        "resnet,resnext")
+    parser.add_argument("--batch-sizes", type=str, default="32")
+    parser.add_argument("--num-layers", type=int, default=50,
+                        help="for resnet/resnext")
+    parser.add_argument("--dtype", type=str, default="float32")
+    args = parser.parse_args()
+
+    for net in args.networks.split(","):
+        kw = {"num_layers": args.num_layers} \
+            if net in ("resnet", "resnext") else {}
+        for b in (int(x) for x in args.batch_sizes.split(",")):
+            ips = score(net, b, dtype=args.dtype, **kw)
+            print("network: %s  batch: %d  dtype: %s  images/sec: %.1f"
+                  % (net, b, args.dtype, ips))

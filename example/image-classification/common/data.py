@@ -1,0 +1,174 @@
+"""Data helpers for the image-classification examples.
+
+Reference: ``example/image-classification/common/data.py`` (downloads MNIST/
+cifar10 and builds ``MNISTIter``/``ImageRecordIter``).  This environment has
+no network egress, so when the dataset files are absent we *synthesize*
+deterministic, learnable datasets in the reference's own on-disk formats
+(idx for MNIST, RecordIO-packed JPEGs for cifar/imagenet) and then read them
+back through the real iterators — the full IO path is exercised either way.
+"""
+
+import argparse
+import os
+import struct
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def add_data_args(parser):
+    data = parser.add_argument_group("Data")
+    data.add_argument("--data-dir", type=str, default="data",
+                      help="dataset location")
+    data.add_argument("--image-shape", type=str, default="3,28,28")
+    data.add_argument("--num-classes", type=int, default=10)
+    data.add_argument("--num-examples", type=int, default=2048)
+    data.add_argument("--benchmark", type=int, default=0,
+                      help="1 = synthetic in-memory data (pure-compute mode)")
+    return data
+
+
+def add_data_aug_args(parser):
+    aug = parser.add_argument_group("Augmentation")
+    aug.add_argument("--random-crop", type=int, default=0)
+    aug.add_argument("--random-mirror", type=int, default=0)
+    return aug
+
+
+# ---------------------------------------------------------------------------
+# synthetic dataset builders (no-egress stand-ins for the download helpers)
+# ---------------------------------------------------------------------------
+
+def _write_idx_images(path, images):
+    with open(path, "wb") as f:
+        f.write(struct.pack(">IIII", 0x00000803, images.shape[0],
+                            images.shape[1], images.shape[2]))
+        f.write(images.astype(np.uint8).tobytes())
+
+
+def _write_idx_labels(path, labels):
+    with open(path, "wb") as f:
+        f.write(struct.pack(">II", 0x00000801, labels.shape[0]))
+        f.write(labels.astype(np.uint8).tobytes())
+
+
+def synth_mnist(data_dir, num_train=2048, num_val=512, num_classes=10,
+                side=28, seed=7):
+    """Class-conditional patterns + noise in real idx files: learnable by
+    LeNet/MLP in an epoch or two, deterministic across runs."""
+    os.makedirs(data_dir, exist_ok=True)
+    paths = {
+        "train_img": os.path.join(data_dir, "train-images-idx3-ubyte"),
+        "train_lab": os.path.join(data_dir, "train-labels-idx1-ubyte"),
+        "val_img": os.path.join(data_dir, "t10k-images-idx3-ubyte"),
+        "val_lab": os.path.join(data_dir, "t10k-labels-idx1-ubyte"),
+    }
+    if all(os.path.exists(p) for p in paths.values()):
+        return paths
+    rs = np.random.RandomState(seed)
+    protos = (rs.rand(num_classes, side, side) > 0.5) * 200.0
+    for split, n in (("train", num_train), ("val", num_val)):
+        lab = rs.randint(0, num_classes, n)
+        img = protos[lab] * (0.6 + 0.4 * rs.rand(n, 1, 1)) \
+            + rs.rand(n, side, side) * 55.0
+        img = np.clip(img, 0, 255)
+        _write_idx_images(paths["%s_img" % ("train" if split == "train"
+                                            else "val")], img)
+        _write_idx_labels(paths["%s_lab" % ("train" if split == "train"
+                                            else "val")], lab)
+    return paths
+
+
+def synth_imagerec(data_dir, prefix, num_images, num_classes, side, seed=11):
+    """Pack class-conditional JPEGs into a real RecordIO shard (+.idx)."""
+    import cv2
+
+    from mxnet_tpu import recordio
+
+    os.makedirs(data_dir, exist_ok=True)
+    rec = os.path.join(data_dir, prefix + ".rec")
+    idx = os.path.join(data_dir, prefix + ".idx")
+    if os.path.exists(rec) and os.path.exists(idx):
+        return rec, idx
+    rs = np.random.RandomState(seed)
+    protos = rs.rand(num_classes, side, side, 3) * 200.0
+    writer = recordio.MXIndexedRecordIO(idx, rec, "w")
+    for i in range(num_images):
+        c = int(rs.randint(0, num_classes))
+        img = np.clip(protos[c] * (0.6 + 0.4 * rs.rand())
+                      + rs.rand(side, side, 3) * 55.0, 0, 255)
+        header = recordio.IRHeader(0, float(c), i, 0)
+        ok, buf = cv2.imencode(".jpg", img.astype(np.uint8))
+        assert ok
+        writer.write_idx(i, recordio.pack(header, buf.tobytes()))
+    writer.close()
+    return rec, idx
+
+
+class SyntheticDataIter(mx.io.DataIter):
+    """--benchmark 1 mode: one random device batch replayed (the reference's
+    ``common/fit.py`` synthetic path — pure compute, zero input cost)."""
+
+    def __init__(self, num_classes, data_shape, max_iter, dtype="float32"):
+        super().__init__(data_shape[0])
+        self.max_iter = max_iter
+        self.cur_iter = 0
+        rs = np.random.RandomState(0)
+        data = rs.uniform(-1, 1, data_shape).astype(dtype)
+        label = rs.randint(0, num_classes, data_shape[0]).astype(np.float32)
+        self._data = mx.nd.array(data)
+        self._label = mx.nd.array(label)
+        self.provide_data = [mx.io.DataDesc("data", data_shape, dtype)]
+        self.provide_label = [mx.io.DataDesc("softmax_label",
+                                             (data_shape[0],), "float32")]
+
+    def reset(self):
+        self.cur_iter = 0
+
+    def next(self):
+        self.cur_iter += 1
+        if self.cur_iter > self.max_iter:
+            raise StopIteration
+        return mx.io.DataBatch(data=[self._data], label=[self._label],
+                               pad=0, index=None)
+
+
+def get_mnist_iter(args, kv):
+    """(train, val) MNISTIter pair sharded by kvstore rank, as the
+    reference's ``get_mnist_iter`` does."""
+    paths = synth_mnist(args.data_dir, num_train=args.num_examples,
+                        num_classes=args.num_classes)
+    flat = getattr(args, "network", "") == "mlp"
+    train = mx.io.MNISTIter(image=paths["train_img"], label=paths["train_lab"],
+                            batch_size=args.batch_size, shuffle=True,
+                            flat=flat, num_parts=kv.num_workers,
+                            part_index=kv.rank)
+    val = mx.io.MNISTIter(image=paths["val_img"], label=paths["val_lab"],
+                          batch_size=args.batch_size, shuffle=False, flat=flat,
+                          num_parts=kv.num_workers, part_index=kv.rank)
+    return train, val
+
+
+def get_rec_iter(args, kv):
+    """(train, val) ImageRecordIter pair over (synthesized) RecordIO shards
+    — the ``get_rec_iter`` analog of the reference."""
+    shape = tuple(int(x) for x in args.image_shape.split(","))
+    if args.benchmark:
+        batch_shape = (args.batch_size,) + shape
+        return (SyntheticDataIter(args.num_classes, batch_shape, 100),
+                None)
+    side = shape[1]
+    rec, _ = synth_imagerec(args.data_dir, "train_%d" % side,
+                            args.num_examples, args.num_classes, side)
+    vrec, _ = synth_imagerec(args.data_dir, "val_%d" % side,
+                             max(args.num_examples // 4, args.batch_size),
+                             args.num_classes, side, seed=13)
+    train = mx.io.ImageRecordIter(
+        path_imgrec=rec, data_shape=shape, batch_size=args.batch_size,
+        shuffle=True, rand_mirror=bool(getattr(args, "random_mirror", 0)),
+        num_parts=kv.num_workers, part_index=kv.rank)
+    val = mx.io.ImageRecordIter(
+        path_imgrec=vrec, data_shape=shape, batch_size=args.batch_size,
+        shuffle=False, num_parts=kv.num_workers, part_index=kv.rank)
+    return train, val

@@ -1,0 +1,52 @@
+#!/usr/bin/env python
+"""Fine-tune a pretrained checkpoint on a new dataset: replace the last
+fully-connected layer and continue training.
+
+Reference: ``example/image-classification/fine-tune.py``
+(``get_fine_tune_model`` grafts a fresh ``fc`` + ``SoftmaxOutput`` onto an
+internal feature layer; lower lr, ``allow_missing=True`` init).
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from common import data, fit  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+
+
+def get_fine_tune_model(symbol, arg_params, num_classes,
+                        layer_name="flatten0"):
+    """reference fine-tune.py:30 — cut at ``layer_name``, new classifier."""
+    all_layers = symbol.get_internals()
+    net = all_layers[layer_name + "_output"]
+    net = mx.sym.FullyConnected(data=net, num_hidden=num_classes, name="fc1")
+    net = mx.sym.SoftmaxOutput(data=net, name="softmax")
+    new_args = {k: v for k, v in arg_params.items()
+                if not k.startswith("fc1")}
+    return net, new_args
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(
+        description="fine-tune a model",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    fit.add_fit_args(parser)
+    data.add_data_args(parser)
+    parser.add_argument("--pretrained-model", type=str, required=True,
+                        help="checkpoint prefix to start from")
+    parser.add_argument("--pretrained-epoch", type=int, default=0)
+    parser.add_argument("--layer-before-fullc", type=str, default="flatten0")
+    parser.set_defaults(num_epochs=2, lr=0.005, batch_size=64)
+    args = parser.parse_args()
+
+    sym, arg_params, aux_params = mx.model.load_checkpoint(
+        args.pretrained_model, args.pretrained_epoch)
+    net, new_args = get_fine_tune_model(sym, arg_params, args.num_classes,
+                                        args.layer_before_fullc)
+    fit.fit(args, net, data.get_mnist_iter,
+            arg_params=new_args, aux_params=aux_params)

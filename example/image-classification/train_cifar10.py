@@ -1,0 +1,36 @@
+#!/usr/bin/env python
+"""Train cifar10-shaped data through the RecordIO pipeline.
+
+Reference: ``example/image-classification/train_cifar10.py`` (resnet/
+inception-bn symbols over 3x28x28 crops via ``ImageRecordIter``).
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from common import data, fit  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import models  # noqa: E402
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(
+        description="train cifar10",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    fit.add_fit_args(parser)
+    data.add_data_args(parser)
+    parser.set_defaults(network="resnet", num_layers=20, batch_size=128,
+                        num_epochs=10, lr=0.05, lr_step_epochs="60,120",
+                        image_shape="3,28,28")
+    data.add_data_aug_args(parser)
+    args = parser.parse_args()
+    args.num_classes = 10
+
+    sym = models.get_symbol(args.network, num_classes=args.num_classes,
+                            num_layers=args.num_layers,
+                            image_shape=args.image_shape)
+    fit.fit(args, sym, data.get_rec_iter)

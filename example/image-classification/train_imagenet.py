@@ -1,0 +1,38 @@
+#!/usr/bin/env python
+"""Train ImageNet-shaped data — BASELINE configs #2 (single node) and #5
+(``--kv-store dist_sync`` under ``tools/launch.py``).
+
+Reference: ``example/image-classification/train_imagenet.py`` —
+``symbols/resnet.py`` / ``symbols/inception-v3.py`` over ``ImageRecordIter``
+with the ``common/fit.py`` harness.
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from common import data, fit  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import models  # noqa: E402
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(
+        description="train imagenet",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    fit.add_fit_args(parser)
+    data.add_data_args(parser)
+    parser.set_defaults(network="resnet", num_layers=50, batch_size=128,
+                        num_epochs=1, lr=0.1, lr_step_epochs="30,60",
+                        image_shape="3,224,224", num_classes=1000,
+                        num_examples=1024)
+    data.add_data_aug_args(parser)
+    args = parser.parse_args()
+
+    sym = models.get_symbol(args.network, num_classes=args.num_classes,
+                            num_layers=args.num_layers,
+                            image_shape=args.image_shape)
+    fit.fit(args, sym, data.get_rec_iter)

@@ -1,0 +1,40 @@
+#!/usr/bin/env python
+"""Train MNIST — BASELINE config #1.
+
+Reference: ``example/image-classification/train_mnist.py`` (``get_symbol``
+via ``symbols/lenet.py`` or mlp, ``common/fit.py`` harness, ``MNISTIter``).
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from common import data, fit  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+
+
+def get_symbol(network, num_classes=10, **kwargs):
+    from mxnet_tpu import models
+
+    if network == "mlp":
+        return models.mlp.get_symbol(num_classes=num_classes)
+    return models.get_symbol(network, num_classes=num_classes, **kwargs)
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(
+        description="train mnist",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    fit.add_fit_args(parser)
+    data.add_data_args(parser)
+    parser.set_defaults(network="lenet", num_epochs=5, batch_size=64,
+                        lr=0.05, lr_step_epochs="10")
+    args = parser.parse_args()
+    args.num_classes = 10
+
+    sym = get_symbol(args.network, args.num_classes)
+    fit.fit(args, sym, data.get_mnist_iter)

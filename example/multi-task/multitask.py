@@ -1,0 +1,75 @@
+#!/usr/bin/env python
+"""Multi-task training: one trunk, two softmax heads, Group output.
+
+Reference: ``example/multi-task/example_multi_task.py`` — shared conv
+trunk, two losses, a custom multi-accuracy metric.
+"""
+
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+
+
+def build_network():
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data, num_hidden=64, name="fc1")
+    act1 = mx.sym.Activation(fc1, act_type="relu")
+    fc_a = mx.sym.FullyConnected(act1, num_hidden=10, name="fc_a")
+    sm_a = mx.sym.SoftmaxOutput(fc_a, mx.sym.Variable("label_a"),
+                                name="softmax_a")
+    fc_b = mx.sym.FullyConnected(act1, num_hidden=2, name="fc_b")
+    sm_b = mx.sym.SoftmaxOutput(fc_b, mx.sym.Variable("label_b"),
+                                name="softmax_b")
+    return mx.sym.Group([sm_a, sm_b])
+
+
+class MultiAccuracy(mx.metric.EvalMetric):
+    """reference example_multi_task.py Multi_Accuracy"""
+
+    def __init__(self, num=2):
+        super().__init__("multi-accuracy", num)
+
+    def update(self, labels, preds):
+        for i in range(self.num):
+            pred = preds[i].asnumpy().argmax(axis=1)
+            label = labels[i].asnumpy().astype(int)
+            self.sum_metric[i] += (pred == label).sum()
+            self.num_inst[i] += label.shape[0]
+
+    def get(self):
+        return (["task%d-acc" % i for i in range(self.num)],
+                [s / max(1, n)
+                 for s, n in zip(self.sum_metric, self.num_inst)])
+
+
+if __name__ == "__main__":
+    logging.basicConfig(level=logging.INFO)
+    parser = argparse.ArgumentParser(description="multi-task")
+    parser.add_argument("--num-epochs", type=int, default=5)
+    parser.add_argument("--batch-size", type=int, default=32)
+    args = parser.parse_args()
+
+    rs = np.random.RandomState(0)
+    centers = rs.rand(10, 32).astype(np.float32)
+    ya = rs.randint(0, 10, 1024)
+    yb = (ya % 2).astype(np.float32)  # second task derived from first
+    X = centers[ya] + 0.1 * rs.randn(1024, 32).astype(np.float32)
+    it = mx.io.NDArrayIter({"data": X},
+                           {"label_a": ya.astype(np.float32),
+                            "label_b": yb},
+                           batch_size=args.batch_size, shuffle=True)
+
+    ctx = mx.tpu() if mx.num_tpus() > 0 else mx.cpu()
+    mod = mx.mod.Module(build_network(), data_names=("data",),
+                        label_names=("label_a", "label_b"), context=ctx)
+    mod.fit(it, eval_metric=MultiAccuracy(),
+            optimizer="sgd", optimizer_params={"learning_rate": 0.1},
+            initializer=mx.init.Xavier(), num_epoch=args.num_epochs,
+            batch_end_callback=mx.callback.Speedometer(args.batch_size, 20))

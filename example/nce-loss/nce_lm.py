@@ -1,0 +1,86 @@
+#!/usr/bin/env python
+"""Noise-contrastive estimation for large-softmax word models.
+
+Reference: ``example/nce-loss/`` (``nce.py`` — NCE as embedding dot-products
+against sampled negatives with LogisticRegressionOutput).
+"""
+
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+
+
+def nce_loss(data, label, label_weight, embed_weight, vocab_size,
+             num_hidden, num_label):
+    """reference nce-loss/nce.py nce_loss: score = h . embed(label_i)"""
+    label_embed = mx.sym.Embedding(data=label, weight=embed_weight,
+                                   input_dim=vocab_size,
+                                   output_dim=num_hidden,
+                                   name="label_embed")  # (B, num_label, H)
+    data = mx.sym.Reshape(data, shape=(-1, 1, num_hidden))
+    pred = mx.sym.broadcast_mul(data, label_embed)
+    pred = mx.sym.sum(pred, axis=2)  # (B, num_label)
+    return mx.sym.LogisticRegressionOutput(pred, label_weight, name="nce")
+
+
+if __name__ == "__main__":
+    logging.basicConfig(level=logging.INFO)
+    parser = argparse.ArgumentParser(description="NCE language model")
+    parser.add_argument("--vocab-size", type=int, default=100)
+    parser.add_argument("--num-hidden", type=int, default=32)
+    parser.add_argument("--num-label", type=int, default=6,
+                        help="1 positive + N-1 sampled negatives")
+    parser.add_argument("--batch-size", type=int, default=64)
+    parser.add_argument("--num-steps", type=int, default=200)
+    args = parser.parse_args()
+
+    V, H, L, B = (args.vocab_size, args.num_hidden, args.num_label,
+                  args.batch_size)
+    in_word = mx.sym.Variable("data")
+    label = mx.sym.Variable("label")
+    label_weight = mx.sym.Variable("label_weight")
+    embed_weight = mx.sym.Variable("embed_weight")
+    in_embed_weight = mx.sym.Variable("in_embed_weight")
+    hidden = mx.sym.Embedding(in_word, weight=in_embed_weight, input_dim=V,
+                              output_dim=H, name="in_embed")
+    net = nce_loss(hidden, label, label_weight, embed_weight, V, H, L)
+
+    ctx = mx.tpu() if mx.num_tpus() > 0 else mx.cpu()
+    mod = mx.mod.Module(net, data_names=("data",),
+                        label_names=("label", "label_weight"), context=ctx)
+    mod.bind(data_shapes=[("data", (B,))],
+             label_shapes=[("label", (B, L)), ("label_weight", (B, L))])
+    mod.init_params(mx.init.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 2.0})
+
+    rs = np.random.RandomState(0)
+    succ = rs.randint(0, V, size=(V,))  # deterministic bigram rule
+    losses = []
+    for step in range(args.num_steps):
+        w = rs.randint(0, V, B)
+        pos = succ[w]
+        neg = rs.randint(0, V, (B, L - 1))
+        lab = np.concatenate([pos[:, None], neg], axis=1)
+        lw = np.zeros((B, L), np.float32)
+        lw[:, 0] = 1.0
+        batch = mx.io.DataBatch(
+            data=[mx.nd.array(w.astype(np.float32))],
+            label=[mx.nd.array(lab.astype(np.float32)), mx.nd.array(lw)])
+        mod.forward_backward(batch)
+        mod.update()
+        p = mod.get_outputs()[0].asnumpy()
+        # NCE binary CE: positives should go to 1, negatives to 0
+        ce = -(np.log(np.maximum(p[:, 0], 1e-9)).mean()
+               + np.log(np.maximum(1 - p[:, 1:], 1e-9)).mean())
+        losses.append(ce)
+        if step % 20 == 0:
+            logging.info("step %d nce ce %.4f", step, ce)
+    print("nce ce %.4f -> %.4f" % (losses[0], losses[-1]))

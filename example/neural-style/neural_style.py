@@ -1,0 +1,99 @@
+#!/usr/bin/env python
+"""Neural style transfer: optimize the input image so its conv features
+match a content image and its Gram matrices match a style image.
+
+Reference: ``example/neural-style/nstyle.py`` — VGG features, TV
+regularization, gradient descent on the image via ``inputs_need_grad``.
+"""
+
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+
+
+def feature_net():
+    """Small VGG-ish feature extractor; two tap points."""
+    data = mx.sym.Variable("data")
+    c1 = mx.sym.Convolution(data, num_filter=16, kernel=(3, 3), pad=(1, 1),
+                            name="conv1")
+    r1 = mx.sym.Activation(c1, act_type="relu")
+    p1 = mx.sym.Pooling(r1, pool_type="avg", kernel=(2, 2), stride=(2, 2))
+    c2 = mx.sym.Convolution(p1, num_filter=32, kernel=(3, 3), pad=(1, 1),
+                            name="conv2")
+    r2 = mx.sym.Activation(c2, act_type="relu")
+    return mx.sym.Group([r1, r2])
+
+
+def gram(feat):
+    b, c = feat.shape[0], feat.shape[1]
+    f = feat.reshape(c, -1)
+    return (f @ f.T) / f.shape[1]
+
+
+if __name__ == "__main__":
+    logging.basicConfig(level=logging.INFO)
+    parser = argparse.ArgumentParser(description="neural style")
+    parser.add_argument("--size", type=int, default=64)
+    parser.add_argument("--num-steps", type=int, default=40)
+    parser.add_argument("--lr", type=float, default=0.1)
+    parser.add_argument("--style-weight", type=float, default=1.0)
+    parser.add_argument("--content-weight", type=float, default=10.0)
+    args = parser.parse_args()
+
+    rs = np.random.RandomState(0)
+    S = args.size
+    # content: centered blob; style: stripes
+    xs = np.linspace(-1, 1, S, dtype=np.float32)
+    content_img = np.exp(-(xs[None, :] ** 2 + xs[:, None] ** 2) / 0.2)
+    content_img = np.stack([content_img] * 3)[None]
+    style_img = np.stack([np.sin(8 * np.pi * xs)[None, :]
+                          * np.ones((S, 1), np.float32)] * 3)[None] * 0.5
+
+    net = feature_net()
+    ctx = mx.tpu() if mx.num_tpus() > 0 else mx.cpu()
+    ex = net.simple_bind(ctx, grad_req="write", data=(1, 3, S, S))
+    init = mx.init.Xavier()
+    for name, arr in ex.arg_dict.items():
+        if name != "data":
+            init(mx.init.InitDesc(name), arr)
+
+    def features(img):
+        ex.arg_dict["data"][:] = img
+        ex.forward(is_train=False)
+        return [o.asnumpy() for o in ex.outputs]
+
+    content_feat = features(content_img)[1]
+    style_grams = [gram(f) for f in features(style_img)]
+
+    img = rs.rand(1, 3, S, S).astype(np.float32)
+    for step in range(args.num_steps):
+        ex.arg_dict["data"][:] = img
+        ex.forward(is_train=True)
+        f1, f2 = [o.asnumpy() for o in ex.outputs]
+        # grads of style (gram) + content (L2) losses w.r.t. features
+        g2_c = args.content_weight * (f2 - content_feat) / f2.size
+        g_style = []
+        for f, sg in zip((f1, f2), style_grams):
+            c = f.shape[1]
+            fm = f.reshape(c, -1)
+            gdiff = (gram(f) - sg)
+            g_style.append(args.style_weight * (gdiff @ fm).reshape(f.shape)
+                           / fm.shape[1])
+        ex.backward([mx.nd.array(g_style[0]),
+                     mx.nd.array(g2_c + g_style[1])])
+        img -= args.lr * ex.grad_dict["data"].asnumpy()
+        img = np.clip(img, 0, 1)
+        if step % 10 == 0:
+            closs = float(((f2 - content_feat) ** 2).mean())
+            sloss = float(sum(((gram(f) - sg) ** 2).sum()
+                              for f, sg in zip((f1, f2), style_grams)))
+            logging.info("step %d content %.5f style %.5f", step, closs,
+                         sloss)
+    print("stylized image stats: min %.3f max %.3f" % (img.min(), img.max()))

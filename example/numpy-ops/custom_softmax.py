@@ -1,0 +1,78 @@
+#!/usr/bin/env python
+"""Custom operator written in Python/numpy, used inside a symbolic graph.
+
+Reference: ``example/numpy-ops/custom_softmax.py`` — ``CustomOp`` /
+``CustomOpProp`` + ``mx.operator.register`` (``python/mxnet/operator.py:
+396,442,576``); the op runs host-side exactly like the reference's engine
+CPU-thread callback.
+"""
+
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+
+
+class Softmax(mx.operator.CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        x = in_data[0].asnumpy()
+        y = np.exp(x - x.max(axis=1, keepdims=True))
+        y /= y.sum(axis=1, keepdims=True)
+        self.assign(out_data[0], req[0], mx.nd.array(y))
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        l = in_data[1].asnumpy().ravel().astype(int)
+        y = out_data[0].asnumpy()
+        y[np.arange(l.shape[0]), l] -= 1.0
+        self.assign(in_grad[0], req[0], mx.nd.array(y))
+
+
+@mx.operator.register("softmax")
+class SoftmaxProp(mx.operator.CustomOpProp):
+    def __init__(self):
+        super().__init__(need_top_grad=False)
+
+    def list_arguments(self):
+        return ["data", "label"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def infer_shape(self, in_shape):
+        data_shape = in_shape[0]
+        label_shape = (in_shape[0][0],)
+        output_shape = in_shape[0]
+        return [data_shape, label_shape], [output_shape], []
+
+    def create_operator(self, ctx, shapes, dtypes):
+        return Softmax()
+
+
+if __name__ == "__main__":
+    logging.basicConfig(level=logging.INFO)
+    parser = argparse.ArgumentParser(description="custom softmax op")
+    parser.add_argument("--num-epochs", type=int, default=3)
+    args = parser.parse_args()
+
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data, num_hidden=10, name="fc")
+    net = mx.sym.Custom(fc, mx.sym.Variable("softmax_label"),
+                        op_type="softmax", name="softmax")
+
+    rs = np.random.RandomState(0)
+    centers = rs.rand(10, 32).astype(np.float32)
+    y = rs.randint(0, 10, 512)
+    X = centers[y] + 0.1 * rs.randn(512, 32).astype(np.float32)
+    it = mx.io.NDArrayIter(X, y.astype(np.float32), batch_size=32,
+                           shuffle=True)
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.fit(it, eval_metric="acc", optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1},
+            initializer=mx.init.Xavier(), num_epoch=args.num_epochs,
+            batch_end_callback=mx.callback.Speedometer(32, 10))

@@ -1,0 +1,56 @@
+#!/usr/bin/env python
+"""Profiler demo: chrome://tracing dump of imperative + symbolic spans.
+
+Reference: ``example/profiler/profiler_executor.py`` /
+``profiler_ndarray.py`` + ``python/mxnet/profiler.py:10-38``.
+Open the JSON in chrome://tracing or Perfetto.
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(description="profiler demo")
+    parser.add_argument("--file", type=str, default="profile_output.json")
+    parser.add_argument("--mode", type=str, default="all",
+                        choices=("symbolic", "imperative", "all"))
+    args = parser.parse_args()
+
+    mx.profiler.profiler_set_config(mode=args.mode, filename=args.file)
+    mx.profiler.profiler_set_state("run")
+
+    # imperative section
+    a = mx.nd.array(np.random.rand(512, 512).astype(np.float32))
+    b = mx.nd.array(np.random.rand(512, 512).astype(np.float32))
+    for _ in range(5):
+        c = mx.nd.dot(a, b) + 1.0
+    c.wait_to_read()
+
+    # symbolic section: one executor step
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=64, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=10, name="fc2")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    ex = net.simple_bind(mx.cpu(), data=(32, 128))
+    ex.arg_dict["data"][:] = np.random.rand(32, 128).astype(np.float32)
+    for _ in range(3):
+        ex.forward(is_train=True)
+        ex.backward()
+    ex.outputs[0].wait_to_read()
+
+    mx.profiler.profiler_set_state("stop")
+    mx.profiler.dump_profile()
+    import json
+
+    ev = json.load(open(args.file))
+    ev = ev["traceEvents"] if isinstance(ev, dict) else ev
+    print("wrote %s with %d events; open in chrome://tracing"
+          % (args.file, len(ev)))

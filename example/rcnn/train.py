@@ -1,0 +1,93 @@
+#!/usr/bin/env python
+"""Faster R-CNN demo: RPN training (alternate-training phase 1) + full
+detection inference through Proposal + ROIPooling.
+
+Reference: ``example/rcnn/`` (``get_vgg_rpn`` training, ``get_vgg_test``
+inference with the Proposal op; SURVEY §2.8).
+"""
+
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu.models import rcnn  # noqa: E402
+
+if __name__ == "__main__":
+    logging.basicConfig(level=logging.INFO)
+    parser = argparse.ArgumentParser(description="Faster R-CNN demo")
+    parser.add_argument("--image-size", type=int, default=128)
+    parser.add_argument("--num-steps", type=int, default=15)
+    parser.add_argument("--lr", type=float, default=0.001)
+    parser.add_argument("--num-classes", type=int, default=4)
+    parser.add_argument("--ctx", type=str, default="cpu",
+                        choices=("cpu", "tpu"),
+                        help="cpu default: the Proposal/ROIPooling gather "
+                        "pattern currently SIGABRTs the TPU backend's "
+                        "fusion pass; detection inference is host-side in "
+                        "the reference too")
+    args = parser.parse_args()
+
+    ctx = mx.tpu() if (args.ctx == "tpu" and mx.num_tpus() > 0) \
+        else mx.cpu()
+    size = args.image_size
+    feat = size // 16
+    num_anchors = 9
+
+    # --- phase 1: RPN training on synthetic anchor targets ---------------
+    net = rcnn.get_symbol_rpn()
+    mod = mx.mod.Module(net, data_names=("data",),
+                        label_names=("label", "bbox_target", "bbox_weight"),
+                        context=ctx)
+    mod.bind(data_shapes=[("data", (1, 3, size, size))],
+             label_shapes=[("label", (1, num_anchors * feat * feat)),
+                           ("bbox_target", (1, 4 * num_anchors, feat, feat)),
+                           ("bbox_weight", (1, 4 * num_anchors, feat, feat))])
+    mod.init_params(mx.init.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": args.lr,
+                                         "momentum": 0.9})
+    rs = np.random.RandomState(0)
+    # fixed synthetic scene: objectness = bright region, so RPN can learn
+    img = rs.rand(1, 3, size, size).astype(np.float32)
+    label = (img.mean(1).reshape(1, 1, size, size)
+             [:, :, ::16, ::16] > 0.5).astype(np.float32)
+    label = np.tile(label.reshape(1, 1, -1), (1, num_anchors, 1)) \
+        .reshape(1, -1)
+    bt = np.zeros((1, 4 * num_anchors, feat, feat), np.float32)
+    bw = np.zeros_like(bt)
+    batch = mx.io.DataBatch(
+        data=[mx.nd.array(img)],
+        label=[mx.nd.array(label), mx.nd.array(bt), mx.nd.array(bw)])
+    ces = []
+    for step in range(args.num_steps):
+        mod.forward_backward(batch)
+        mod.update()
+        cls = mod.get_outputs()[0].asnumpy()  # (1, 2, A*H*W)
+        lab = label.reshape(-1).astype(int)
+        probs = cls[0].T[np.arange(lab.size), lab]
+        ces.append(-np.log(np.maximum(probs, 1e-9)).mean())
+        if step % 5 == 0:
+            logging.info("rpn step %d cls ce %.4f", step, ces[-1])
+    print("rpn ce %.4f -> %.4f" % (ces[0], ces[-1]))
+    assert ces[-1] < ces[0]
+
+    # --- phase 2: full detection inference -------------------------------
+    test_net = rcnn.get_symbol_test(num_classes=args.num_classes)
+    tmod = mx.mod.Module(test_net, data_names=("data", "im_info"),
+                         label_names=(), context=ctx)
+    tmod.bind(for_training=False,
+              data_shapes=[("data", (1, 3, size, size)),
+                           ("im_info", (1, 3))])
+    tmod.init_params(mx.init.Xavier())
+    tmod.forward(mx.io.DataBatch(
+        data=[mx.nd.array(img), mx.nd.array([[size, size, 1.0]])],
+        label=[]), is_train=False)
+    rois, cls_prob, bbox_pred = [o.asnumpy() for o in tmod.get_outputs()]
+    print("proposals %s  cls_prob %s  bbox_pred %s"
+          % (rois.shape, cls_prob.shape, bbox_pred.shape))

@@ -1,0 +1,64 @@
+#!/usr/bin/env python
+"""Matrix-factorization recommender: user/item embeddings, dot-product
+score, MSE on observed ratings.
+
+Reference: ``example/recommenders/`` (demo1-MF; SURVEY §2.8).
+"""
+
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+
+
+def matrix_fact_net(factor_size, num_users, num_items):
+    user = mx.sym.Variable("user")
+    item = mx.sym.Variable("item")
+    score = mx.sym.Variable("score")
+    u = mx.sym.Embedding(user, input_dim=num_users,
+                         output_dim=factor_size, name="user_embed")
+    v = mx.sym.Embedding(item, input_dim=num_items,
+                         output_dim=factor_size, name="item_embed")
+    pred = mx.sym.sum(u * v, axis=1)
+    return mx.sym.LinearRegressionOutput(pred, score, name="lr")
+
+
+if __name__ == "__main__":
+    logging.basicConfig(level=logging.INFO)
+    parser = argparse.ArgumentParser(description="matrix factorization")
+    parser.add_argument("--num-users", type=int, default=200)
+    parser.add_argument("--num-items", type=int, default=300)
+    parser.add_argument("--factor-size", type=int, default=8)
+    parser.add_argument("--batch-size", type=int, default=64)
+    parser.add_argument("--num-epochs", type=int, default=10)
+    args = parser.parse_args()
+
+    rs = np.random.RandomState(0)
+    # ground-truth low-rank rating matrix
+    TU = rs.randn(args.num_users, args.factor_size).astype(np.float32)
+    TV = rs.randn(args.num_items, args.factor_size).astype(np.float32)
+    n_obs = 8000
+    users = rs.randint(0, args.num_users, n_obs)
+    items = rs.randint(0, args.num_items, n_obs)
+    scores = (TU[users] * TV[items]).sum(1) \
+        + 0.1 * rs.randn(n_obs).astype(np.float32)
+
+    it = mx.io.NDArrayIter(
+        {"user": users.astype(np.float32),
+         "item": items.astype(np.float32)},
+        {"score": scores.astype(np.float32)},
+        batch_size=args.batch_size, shuffle=True, label_name="score")
+    net = matrix_fact_net(args.factor_size, args.num_users, args.num_items)
+    ctx = mx.tpu() if mx.num_tpus() > 0 else mx.cpu()
+    mod = mx.mod.Module(net, data_names=("user", "item"),
+                        label_names=("score",), context=ctx)
+    mod.fit(it, eval_metric="rmse", optimizer="adam",
+            optimizer_params={"learning_rate": 0.02},
+            initializer=mx.init.Normal(0.1), num_epoch=args.num_epochs,
+            batch_end_callback=mx.callback.Speedometer(args.batch_size, 50))

@@ -1,0 +1,103 @@
+#!/usr/bin/env python
+"""Parallel advantage actor-critic over a batch of environments.
+
+Reference: ``example/reinforcement-learning/parallel_actor_critic/`` —
+N envs stepped in lockstep, one batched policy/value network, policy
+gradient with advantage baseline.  Env here is a contextual bandit /
+1-step MDP (no gym in this image): observation encodes which arm pays.
+"""
+
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "..",
+                                ".."))
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+
+
+class Agent:
+    """Batched policy+value net: shared trunk, softmax policy head and
+    linear value head (the reference's ``Agent``)."""
+
+    def __init__(self, obs_dim, num_actions, batch, ctx, lr=0.01):
+        data = mx.sym.Variable("data")
+        adv = mx.sym.Variable("adv")  # advantage weights per sample
+        act = mx.sym.Variable("act")  # chosen actions
+        ret = mx.sym.Variable("ret")  # returns for the value head
+        fc = mx.sym.FullyConnected(data, num_hidden=32, name="fc1")
+        h = mx.sym.Activation(fc, act_type="relu")
+        logits = mx.sym.FullyConnected(h, num_hidden=num_actions,
+                                       name="policy_fc")
+        probs = mx.sym.softmax(logits)
+        value = mx.sym.FullyConnected(h, num_hidden=1, name="value_fc")
+        # losses: -adv*log pi(a|s) + 0.5*(V-ret)^2 - entropy bonus
+        logp = mx.sym.log(mx.sym.sum(probs * mx.sym.one_hot(
+            act, depth=num_actions), axis=1) + 1e-8)
+        ent = -mx.sym.sum(probs * mx.sym.log(probs + 1e-8), axis=1)
+        pg = mx.sym.MakeLoss(0.0 - adv * logp - 0.01 * ent)
+        vl = mx.sym.MakeLoss(0.5 * mx.sym.square(
+            mx.sym.Reshape(value, shape=(-1,)) - ret))
+        self.net = mx.sym.Group([pg, vl, mx.sym.BlockGrad(probs),
+                                 mx.sym.BlockGrad(value)])
+        self.mod = mx.mod.Module(
+            self.net, data_names=("data",),
+            label_names=("adv", "act", "ret"), context=ctx)
+        self.mod.bind(
+            data_shapes=[("data", (batch, obs_dim))],
+            label_shapes=[("adv", (batch,)), ("act", (batch,)),
+                          ("ret", (batch,))])
+        self.mod.init_params(mx.init.Xavier())
+        self.mod.init_optimizer(optimizer="adam",
+                                optimizer_params={"learning_rate": lr})
+
+    def act(self, obs, rs):
+        self.mod.forward(mx.io.DataBatch(
+            data=[mx.nd.array(obs)],
+            label=[mx.nd.zeros((obs.shape[0],))] * 3), is_train=False)
+        probs = self.mod.get_outputs()[2].asnumpy()
+        acts = np.array([rs.choice(probs.shape[1], p=p / p.sum())
+                         for p in probs])
+        values = self.mod.get_outputs()[3].asnumpy().reshape(-1)
+        return acts, values
+
+    def train_step(self, obs, acts, rets, values):
+        adv = rets - values
+        self.mod.forward(mx.io.DataBatch(
+            data=[mx.nd.array(obs)],
+            label=[mx.nd.array(adv), mx.nd.array(acts.astype(np.float32)),
+                   mx.nd.array(rets)]), is_train=True)
+        self.mod.backward()
+        self.mod.update()
+
+
+if __name__ == "__main__":
+    logging.basicConfig(level=logging.INFO)
+    parser = argparse.ArgumentParser(description="parallel actor-critic")
+    parser.add_argument("--num-envs", type=int, default=64)
+    parser.add_argument("--num-actions", type=int, default=4)
+    parser.add_argument("--num-updates", type=int, default=150)
+    args = parser.parse_args()
+
+    rs = np.random.RandomState(0)
+    A = args.num_actions
+    ctx = mx.tpu() if mx.num_tpus() > 0 else mx.cpu()
+    agent = Agent(A, A, args.num_envs, ctx)
+    rewards = []
+    for update in range(args.num_updates):
+        # obs one-hot encodes the paying arm
+        paying = rs.randint(0, A, args.num_envs)
+        obs = np.eye(A, dtype=np.float32)[paying]
+        acts, values = agent.act(obs, rs)
+        rew = (acts == paying).astype(np.float32)
+        agent.train_step(obs, acts, rew, values)
+        rewards.append(rew.mean())
+        if update % 50 == 0:
+            logging.info("update %d avg reward %.3f (random %.3f)",
+                         update, np.mean(rewards[-20:]), 1.0 / A)
+    print("final avg reward %.3f (random baseline %.3f)"
+          % (np.mean(rewards[-20:]), 1.0 / A))

@@ -1,0 +1,55 @@
+#!/usr/bin/env python
+"""SSD inference/detection demo: run the deploy symbol (MultiBoxDetection
+NMS head) over images and print detections.
+
+Reference: ``example/ssd/demo.py`` + ``deploy.py`` (inference graph at
+``symbol_vgg16_reduced.py:173``).
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu.models import ssd_vgg16  # noqa: E402
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(description="SSD detection demo")
+    parser.add_argument("--model-prefix", type=str, default=None,
+                        help="optional checkpoint from train.py")
+    parser.add_argument("--load-epoch", type=int, default=0)
+    parser.add_argument("--num-classes", type=int, default=3)
+    parser.add_argument("--data-shape", type=int, default=96)
+    parser.add_argument("--thresh", type=float, default=0.2)
+    args = parser.parse_args()
+
+    net = ssd_vgg16.get_symbol(num_classes=args.num_classes,
+                               nms_thresh=0.5, force_suppress=True)
+    ctx = mx.tpu() if mx.num_tpus() > 0 else mx.cpu()
+    mod = mx.mod.Module(net, data_names=("data",), label_names=(),
+                        context=ctx)
+    shape = (1, 3, args.data_shape, args.data_shape)
+    mod.bind(for_training=False, data_shapes=[("data", shape)])
+    if args.model_prefix:
+        _, arg_params, aux_params = mx.model.load_checkpoint(
+            args.model_prefix, args.load_epoch)
+        mod.set_params(arg_params, aux_params, allow_missing=True)
+    else:
+        mod.init_params(mx.init.Xavier())
+
+    rs = np.random.RandomState(0)
+    img = rs.rand(*shape).astype(np.float32)
+    mod.forward(mx.io.DataBatch(data=[mx.nd.array(img)], label=[]),
+                is_train=False)
+    det = mod.get_outputs()[0].asnumpy()[0]
+    kept = det[det[:, 0] >= 0]
+    kept = kept[kept[:, 1] >= args.thresh]
+    print("detections (class, score, xmin, ymin, xmax, ymax):")
+    for row in kept[:10]:
+        print("  %d  %.3f  [%.3f %.3f %.3f %.3f]"
+              % (int(row[0]), row[1], *row[2:6]))
+    print("%d boxes above threshold %.2f" % (len(kept), args.thresh))

@@ -1,0 +1,80 @@
+#!/usr/bin/env python
+"""SSD-VGG16 multi-loss detection training — BASELINE config #4.
+
+Reference: ``example/ssd/train/train_net.py:75,253`` (``mod.fit`` on a
+``Group`` output symbol), loss graph at
+``example/ssd/symbol/symbol_vgg16_reduced.py:121-139`` (MultiBoxTarget →
+SoftmaxOutput cls + smooth_l1→MakeLoss loc), anchors via ``MultiBoxPrior``,
+custom ``MultiBoxMetric`` (``train/metric.py:5``).
+
+No-egress note: generates a synthetic detection dataset (colored rectangles
+on noise with exact box labels) instead of Pascal VOC.
+"""
+
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu.models import ssd_vgg16  # noqa: E402
+
+
+def synth_detection_set(n, size, num_classes, max_gt=3, seed=5):
+    """Rectangles of class-specific color on noise; label rows are
+    ``(cls, xmin, ymin, xmax, ymax)`` normalized, -1-padded."""
+    rs = np.random.RandomState(seed)
+    colors = rs.rand(num_classes, 3)
+    data = np.empty((n, 3, size, size), np.float32)
+    labels = -np.ones((n, max_gt, 5), np.float32)
+    for i in range(n):
+        img = rs.rand(size, size, 3) * 0.3
+        for g in range(rs.randint(1, max_gt + 1)):
+            c = rs.randint(0, num_classes)
+            w, h = rs.randint(size // 4, size // 2, 2)
+            x0 = rs.randint(0, size - w)
+            y0 = rs.randint(0, size - h)
+            img[y0:y0 + h, x0:x0 + w] = colors[c] * (0.7 + 0.3 * rs.rand())
+            labels[i, g] = [c, x0 / size, y0 / size, (x0 + w) / size,
+                            (y0 + h) / size]
+        data[i] = img.transpose(2, 0, 1)
+    return data, labels
+
+
+if __name__ == "__main__":
+    logging.basicConfig(level=logging.INFO)
+    parser = argparse.ArgumentParser(description="train SSD")
+    parser.add_argument("--num-classes", type=int, default=3)
+    parser.add_argument("--data-shape", type=int, default=96)
+    parser.add_argument("--batch-size", type=int, default=8)
+    parser.add_argument("--num-examples", type=int, default=128)
+    parser.add_argument("--num-epochs", type=int, default=3)
+    parser.add_argument("--lr", type=float, default=0.002)
+    parser.add_argument("--wd", type=float, default=5e-4)
+    parser.add_argument("--model-prefix", type=str, default=None)
+    args = parser.parse_args()
+
+    data, labels = synth_detection_set(args.num_examples, args.data_shape,
+                                       args.num_classes)
+    it = mx.io.NDArrayIter({"data": data}, {"label": labels},
+                           batch_size=args.batch_size, shuffle=True,
+                           label_name="label")
+
+    net = ssd_vgg16.get_symbol_train(num_classes=args.num_classes)
+    ctx = mx.tpu() if mx.num_tpus() > 0 else mx.cpu()
+    mod = mx.mod.Module(net, data_names=("data",), label_names=("label",),
+                        context=ctx)
+    mod.fit(it,
+            eval_metric=ssd_vgg16.MultiBoxMetric(),
+            optimizer="sgd",
+            optimizer_params={"learning_rate": args.lr, "momentum": 0.9,
+                              "wd": args.wd},
+            initializer=mx.init.Xavier(),
+            num_epoch=args.num_epochs,
+            batch_end_callback=mx.callback.Speedometer(args.batch_size, 5),
+            epoch_end_callback=(mx.callback.do_checkpoint(args.model_prefix)
+                                if args.model_prefix else None))

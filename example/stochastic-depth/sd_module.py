@@ -1,0 +1,92 @@
+#!/usr/bin/env python
+"""Stochastic depth: residual blocks randomly dropped during training.
+
+Reference: ``example/stochastic-depth/sd_module.py`` — per-block "death
+rate"; here the random gate is a Bernoulli drawn host-side each batch and
+fed as an input (the TPU-friendly version of their custom-op gate: the
+graph stays static, the gate is data).
+"""
+
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+
+
+def sd_block(data, gate, num_filter, name):
+    """residual block scaled by the (0/1) gate: out = x + gate*F(x)."""
+    c1 = mx.sym.Convolution(data, num_filter=num_filter, kernel=(3, 3),
+                            pad=(1, 1), name=name + "_c1")
+    b1 = mx.sym.BatchNorm(c1, name=name + "_bn1")
+    a1 = mx.sym.Activation(b1, act_type="relu")
+    c2 = mx.sym.Convolution(a1, num_filter=num_filter, kernel=(3, 3),
+                            pad=(1, 1), name=name + "_c2")
+    b2 = mx.sym.BatchNorm(c2, name=name + "_bn2")
+    gated = mx.sym.broadcast_mul(b2, gate)
+    return mx.sym.Activation(data + gated, act_type="relu")
+
+
+if __name__ == "__main__":
+    logging.basicConfig(level=logging.INFO)
+    parser = argparse.ArgumentParser(description="stochastic depth")
+    parser.add_argument("--num-blocks", type=int, default=4)
+    parser.add_argument("--death-rate", type=float, default=0.3)
+    parser.add_argument("--batch-size", type=int, default=32)
+    parser.add_argument("--num-steps", type=int, default=40)
+    args = parser.parse_args()
+
+    B, NB = args.batch_size, args.num_blocks
+    data = mx.sym.Variable("data")
+    gates = [mx.sym.Variable("gate%d" % i) for i in range(NB)]
+    x = mx.sym.Convolution(data, num_filter=16, kernel=(3, 3), pad=(1, 1),
+                           name="c0")
+    x = mx.sym.Activation(x, act_type="relu")
+    for i in range(NB):
+        g = mx.sym.Reshape(gates[i], shape=(1, 1, 1, 1))
+        x = sd_block(x, g, 16, "blk%d" % i)
+    x = mx.sym.Pooling(x, pool_type="avg", kernel=(8, 8), stride=(8, 8))
+    x = mx.sym.Flatten(x)
+    fc = mx.sym.FullyConnected(x, num_hidden=10, name="fc")
+    net = mx.sym.SoftmaxOutput(fc, name="softmax")
+
+    rs = np.random.RandomState(0)
+    protos = (rs.rand(10, 8, 8) > 0.5).astype(np.float32)
+    y = rs.randint(0, 10, 1024)
+    X = (protos[y] + 0.2 * rs.randn(1024, 8, 8)).astype(np.float32)
+    X = X[:, None].repeat(1, axis=1)
+
+    ctx = mx.tpu() if mx.num_tpus() > 0 else mx.cpu()
+    mod = mx.mod.Module(net, data_names=tuple(["data"] + ["gate%d" % i
+                                                          for i in
+                                                          range(NB)]),
+                        label_names=("softmax_label",), context=ctx)
+    mod.bind(data_shapes=[("data", (B, 1, 8, 8))]
+             + [("gate%d" % i, (1,)) for i in range(NB)],
+             label_shapes=[("softmax_label", (B,))])
+    mod.init_params(mx.init.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1,
+                                         "momentum": 0.9})
+    accs = []
+    for step in range(args.num_steps):
+        idx = rs.randint(0, 1024, B)
+        # linearly increasing death rate per depth (reference schedule)
+        gates_v = [np.array([0.0 if rs.rand() <
+                             args.death_rate * (i + 1) / NB else 1.0],
+                            np.float32) for i in range(NB)]
+        batch = mx.io.DataBatch(
+            data=[mx.nd.array(X[idx])] + [mx.nd.array(g) for g in gates_v],
+            label=[mx.nd.array(y[idx].astype(np.float32))])
+        mod.forward_backward(batch)
+        mod.update()
+        acc = (mod.get_outputs()[0].asnumpy().argmax(1) == y[idx]).mean()
+        accs.append(acc)
+        if step % 10 == 0:
+            logging.info("step %d batch acc %.3f", step, acc)
+    print("train acc %.3f -> %.3f" % (accs[0], np.mean(accs[-5:])))

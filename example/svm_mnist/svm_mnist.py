@@ -1,0 +1,73 @@
+#!/usr/bin/env python
+"""MLP trained with an SVM (hinge) output layer instead of softmax.
+
+Reference: ``example/svm_mnist/svm_mnist.py`` — ``SVMOutput`` with both L2
+(default) and L1 hinge losses.
+"""
+
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                "image-classification"))
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from common import data as exdata  # noqa: E402
+
+if __name__ == "__main__":
+    logging.basicConfig(level=logging.INFO)
+    parser = argparse.ArgumentParser(description="SVM output mnist")
+    parser.add_argument("--data-dir", type=str, default="data")
+    parser.add_argument("--batch-size", type=int, default=64)
+    parser.add_argument("--num-epochs", type=int, default=3)
+    parser.add_argument("--use-linear", action="store_true",
+                        help="L1 hinge instead of squared hinge")
+    args = parser.parse_args()
+    args.num_examples = 2048
+    args.num_classes = 10
+    args.network = "mlp"  # flat input
+
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=128, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=10, name="fc2")
+    net = mx.sym.SVMOutput(net, name="svm",
+                           use_linear=args.use_linear)
+
+    kv = mx.kvstore.create("local")
+    train, val = exdata.get_mnist_iter(args, kv)
+
+    class Renamed(mx.io.DataIter):
+        """relabels softmax_label -> svm_label (SVMOutput's label name)."""
+
+        def __init__(self, inner):
+            super().__init__(inner.batch_size)
+            self._it = inner
+
+        provide_data = property(lambda s: s._it.provide_data)
+
+        @property
+        def provide_label(self):
+            return [mx.io.DataDesc("svm_label", d.shape, d.dtype)
+                    for d in self._it.provide_label]
+
+        def reset(self):
+            self._it.reset()
+
+        def next(self):
+            b = self._it.next()
+            return mx.io.DataBatch(data=b.data, label=b.label, pad=b.pad)
+
+    ctx = mx.tpu() if mx.num_tpus() > 0 else mx.cpu()
+    mod = mx.mod.Module(net, label_names=("svm_label",), context=ctx)
+    mod.fit(Renamed(train), eval_data=Renamed(val), eval_metric="acc",
+            optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9,
+                              "wd": 1e-4},
+            initializer=mx.init.Xavier(), num_epoch=args.num_epochs,
+            batch_end_callback=mx.callback.Speedometer(args.batch_size, 20))

@@ -35,6 +35,7 @@ from . import metric
 from . import lr_scheduler
 from . import callback
 from . import monitor
+from . import monitor as mon
 from .monitor import Monitor
 
 from . import io

@@ -371,4 +371,8 @@ def create(metric, **kwargs):
         metric = "accuracy"
     if metric in ("ce",):
         metric = "crossentropy"
+    # underscore spellings used throughout the reference examples
+    metric = str(metric).lower()
+    metric = {"top_k_accuracy": "topkaccuracy",
+              "cross-entropy": "crossentropy"}.get(metric, metric)
     return registry.create(metric, **kwargs)

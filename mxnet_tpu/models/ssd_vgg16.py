@@ -15,7 +15,10 @@ training path) stays inside one XLA computation.
 
 from __future__ import annotations
 
+import numpy as np
+
 from .. import symbol as sym
+from ..metric import EvalMetric as _EvalMetric
 
 __all__ = ["get_symbol_train", "get_symbol", "multibox_layer",
            "MultiBoxMetric"]
@@ -173,14 +176,12 @@ def get_symbol(num_classes=20, nms_thresh=0.5, force_suppress=True,
         variances=(0.1, 0.1, 0.2, 0.2), nms_topk=nms_topk)
 
 
-class MultiBoxMetric(object):
+class MultiBoxMetric(_EvalMetric):
     """Cross-entropy + smooth-L1 training metric for the SSD loss group
-    (reference ``example/ssd/train/metric.py:5``)."""
+    (reference ``example/ssd/train/metric.py:5`` — an ``EvalMetric``
+    subclass so ``Module.fit(eval_metric=...)`` accepts it)."""
 
     def __init__(self, eps=1e-8):
-        import numpy as np
-
-        self._np = np
         self.eps = eps
         self.name = ["CrossEntropy", "SmoothL1"]
         self.num = len(self.name)
@@ -191,7 +192,6 @@ class MultiBoxMetric(object):
         self.sum_metric = [0.0] * self.num
 
     def update(self, labels, preds):
-        np = self._np
         cls_prob = preds[0].asnumpy()
         loc_loss = preds[1].asnumpy()
         cls_label = preds[2].asnumpy()

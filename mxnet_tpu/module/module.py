@@ -315,9 +315,12 @@ class Module(BaseModule):
         assert self.binded and self.params_initialized
         if is_train is None:
             is_train = self.for_training
-        self._load_io(self._data_names, data_batch.data)
+        # zip with bind-time data_shapes order (= provide_data order), the
+        # reference's _load_data positional contract (executor_group.py:369)
+        self._load_io([n for n, _ in self._data_shapes], data_batch.data)
         if self._label_shapes and data_batch.label:
-            self._load_io(self._label_names, data_batch.label)
+            self._load_io([n for n, _ in self._label_shapes],
+                          data_batch.label)
         self._exec.forward(is_train=is_train)
 
     def backward(self, out_grads=None):

@@ -37,7 +37,15 @@ class CustomOp:
         raise NotImplementedError
 
     def assign(self, dst, req, src):
-        """Write ``src`` into ``dst`` honoring grad_req (ref :420)."""
+        """Write ``src`` into ``dst`` honoring grad_req (ref :420).
+
+        ``src`` may be numpy or an NDArray (reference custom ops build
+        ``mx.nd`` arrays host-side and assign them back).
+        """
+        import numpy as _np
+
+        if not isinstance(src, _np.ndarray) and hasattr(src, "asnumpy"):
+            src = src.asnumpy()
         if req in ("write", "inplace"):
             dst[...] = src
         elif req == "add":

@@ -23,6 +23,32 @@ from . import registry as _reg
 from .registry import REQUIRED, pstr, register
 
 
+class _HostArray(np.ndarray):
+    """numpy view that also quacks like an NDArray — reference custom ops
+    call ``.asnumpy()``/``.wait_to_read()`` on ``in_data`` and assign
+    ``mx.nd`` arrays back (``python/mxnet/operator.py:396``); written
+    against this framework they may treat the buffers as plain numpy.
+    Both styles work on this type."""
+
+    def asnumpy(self):
+        # a writable copy: callback input buffers are read-only, and the
+        # reference's asnumpy() copies off-device too
+        return np.array(self)
+
+    def wait_to_read(self):
+        return self
+
+    @property
+    def context(self):
+        from ..context import cpu
+
+        return cpu()
+
+
+def _host(arr):
+    return np.ascontiguousarray(arr).view(_HostArray)
+
+
 def _prop_for(attrs):
     from .. import operator as _operator
 
@@ -48,23 +74,26 @@ def _custom_apply(attrs, inputs, aux, is_train, rng):
     op = prop.create_operator("tpu", list(in_shapes), list(in_dtypes))
 
     def host_forward(*tensors):
-        ins = [np.asarray(t) for t in tensors[:n_in]]
-        auxs = [np.array(t) for t in tensors[n_in:]]
-        outs = [np.zeros(tuple(s), d) for s, d in zip(out_shapes, out_dtypes)]
+        ins = [_host(t) for t in tensors[:n_in]]
+        auxs = [_host(np.array(t)) for t in tensors[n_in:]]
+        outs = [_host(np.zeros(tuple(s), d))
+                for s, d in zip(out_shapes, out_dtypes)]
         op.forward(is_train, ["write"] * len(outs), ins, outs, auxs)
-        return tuple(outs) + tuple(auxs)
+        return tuple(np.asarray(o) for o in outs) \
+            + tuple(np.asarray(a) for a in auxs)
 
     def host_backward(*tensors):
-        grads = [np.asarray(t) for t in tensors[:len(out_specs)]]
-        ins = [np.asarray(t) for t in tensors[len(out_specs):
-                                             len(out_specs) + n_in]]
-        auxs = [np.array(t) for t in
+        grads = [_host(t) for t in tensors[:len(out_specs)]]
+        ins = [_host(t) for t in tensors[len(out_specs):
+                                         len(out_specs) + n_in]]
+        auxs = [_host(np.array(t)) for t in
                 tensors[len(out_specs) + n_in:
                         len(out_specs) + n_in + n_aux]]
-        outs = [np.asarray(t) for t in tensors[len(out_specs) + n_in + n_aux:]]
-        in_grads = [np.zeros(s, d) for s, d in zip(in_shapes, in_dtypes)]
+        outs = [_host(t) for t in tensors[len(out_specs) + n_in + n_aux:]]
+        in_grads = [_host(np.zeros(s, d))
+                    for s, d in zip(in_shapes, in_dtypes)]
         op.backward(["write"] * n_in, grads, ins, outs, in_grads, auxs)
-        return tuple(in_grads)
+        return tuple(np.asarray(g) for g in in_grads)
 
     @jax.custom_vjp
     def run(ins, auxs):

@@ -1,0 +1,55 @@
+"""Smoke tests for the example/ tree (SURVEY §2.8 capability checklist).
+
+Runs a fast subset end-to-end as subprocesses the way a user would, on CPU
+with tiny synthetic data (each example synthesizes its own dataset).
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+ROOT = os.path.join(os.path.dirname(__file__), "..")
+EXAMPLE = os.path.join(ROOT, "example")
+
+
+def _run(relpath, *args, timeout=420):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    code = ("import jax; jax.config.update('jax_platforms','cpu');"
+            "import sys, runpy; sys.argv=[sys.argv[1]]+sys.argv[2:];"
+            "runpy.run_path(sys.argv[0], run_name='__main__')")
+    proc = subprocess.run(
+        [sys.executable, "-c", code, os.path.join(EXAMPLE, relpath)]
+        + list(args),
+        capture_output=True, text=True, timeout=timeout, cwd=ROOT, env=env)
+    assert proc.returncode == 0, \
+        "%s failed:\n%s\n%s" % (relpath, proc.stdout[-2000:],
+                                proc.stderr[-2000:])
+    return proc.stdout + proc.stderr
+
+
+def test_train_mnist(tmp_path):
+    out = _run("image-classification/train_mnist.py", "--num-epochs", "1",
+               "--num-examples", "512", "--data-dir", str(tmp_path))
+    assert "Validation-accuracy" in out
+
+
+def test_custom_op_example(tmp_path):
+    out = _run("numpy-ops/custom_softmax.py", "--num-epochs", "2")
+    assert "Train-accuracy" in out
+
+
+def test_multi_task(tmp_path):
+    out = _run("multi-task/multitask.py", "--num-epochs", "2")
+    assert "task1-acc" in out
+
+
+def test_rl_actor_critic(tmp_path):
+    out = _run("reinforcement-learning/parallel_actor_critic/train.py",
+               "--num-updates", "80")
+    # the bandit must be essentially solved (random = 0.25)
+    final = float(out.strip().rsplit("final avg reward ", 1)[1].split()[0])
+    assert final > 0.8
