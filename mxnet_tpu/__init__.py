@@ -53,6 +53,7 @@ from . import model
 from .model import FeedForward
 
 from . import operator
+from . import predict
 from . import profiler
 from . import rtc
 from . import visualization

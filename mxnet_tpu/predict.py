@@ -1,0 +1,137 @@
+"""Standalone minimal inference API — the C predict ABI analog.
+
+Reference: ``src/c_api/c_predict_api.cc`` + ``include/mxnet/c_predict_api.h``
+(``MXPredCreate``/``MXPredSetInput``/``MXPredForward``/``MXPredGetOutput``/
+``MXPredReshape``/``MXPredGetOutputShape``/``MXPredFree``) — the deliberately
+tiny serving surface that ``amalgamation/`` ships to mobile and that the
+matlab binding sits on (SURVEY §3.4).
+
+Same contract here: construct from a saved symbol JSON string + a params
+blob (bytes or path), bind once for fixed input shapes with ``grad_req
+= null``, then ``set_input → forward → get_output``.  The whole forward is
+one cached XLA computation; ``reshape`` re-jits under the shape-keyed
+cache exactly like the reference's shared-memory rebind.
+"""
+
+from __future__ import annotations
+
+import io as _io
+
+import numpy as np
+
+from . import ndarray as nd
+from . import symbol as _symbol
+from .base import MXNetError
+from .context import Context, cpu
+
+__all__ = ["Predictor", "load_ndarray_file", "create"]
+
+
+def load_ndarray_file(blob):
+    """Parse a params blob (bytes or filename) -> dict name->numpy.
+
+    The analog of ``MXNDListCreate`` over ``NDArray::Load``'s magic-header
+    dict format (``include/mxnet/ndarray.h:333-347``); this framework's
+    container format is npz (``ndarray.save``).
+    """
+    if isinstance(blob, (bytes, bytearray)):
+        f = np.load(_io.BytesIO(bytes(blob)))
+    else:
+        f = np.load(nd._load_path(blob))
+    with f:
+        return {k[2:] if k[:2] in ("d:", "l:") else k: np.asarray(f[k])
+                for k in f.files}
+
+
+class Predictor:
+    """``MXPredCreate`` analog (c_predict_api.cc ``MXAPIPredictor``)."""
+
+    def __init__(self, symbol_json, param_blob, input_shapes, ctx=None,
+                 output_index=None):
+        if isinstance(symbol_json, _symbol.Symbol):
+            sym = symbol_json
+        else:
+            sym = _symbol.load_json(symbol_json)
+        if output_index is not None:  # MXPredCreatePartialOut
+            outs = sym.get_internals()
+            names = outs.list_outputs()
+            sym = outs[names[output_index]]
+        self._sym = sym
+        self._ctx = ctx if isinstance(ctx, Context) else cpu()
+        params = {}
+        if param_blob is not None:
+            raw = load_ndarray_file(param_blob)
+            # reference accepts both plain and arg:/aux: prefixed keys
+            for k, v in raw.items():
+                if k.startswith(("arg:", "aux:")):
+                    k = k[4:]
+                params[k] = v
+        self._params = params
+        self._bind(dict(input_shapes))
+
+    def _bind(self, input_shapes):
+        self._input_shapes = dict(input_shapes)
+        self._exec = self._sym.simple_bind(self._ctx, grad_req="null",
+                                           **self._input_shapes)
+        arg_names = set(self._exec.arg_dict)
+        aux_names = set(self._exec.aux_dict)
+        for k, v in self._params.items():
+            if k in self._input_shapes or k == "label" \
+                    or k.endswith("_label"):
+                continue
+            if k in arg_names:
+                self._exec.arg_dict[k][:] = v
+            elif k in aux_names:
+                self._exec.aux_dict[k][:] = v
+        # label inputs are dead at inference (SoftmaxOutput passes data
+        # through); anything else missing is a real error
+        missing = [k for k in arg_names
+                   if k not in self._params and k not in self._input_shapes
+                   and not (k == "label" or k.endswith("_label"))]
+        if missing and self._params:
+            raise MXNetError("predictor: params blob is missing %s"
+                             % sorted(missing))
+
+    # -- the C ABI surface -------------------------------------------------
+    def set_input(self, key, data):
+        """MXPredSetInput"""
+        if key not in self._input_shapes:
+            raise MXNetError("unknown input %r (have %s)"
+                             % (key, sorted(self._input_shapes)))
+        self._exec.arg_dict[key][:] = np.asarray(data, np.float32)
+
+    def forward(self):
+        """MXPredForward"""
+        self._exec.forward(is_train=False)
+
+    def get_output_shape(self, index=0):
+        """MXPredGetOutputShape"""
+        return tuple(self._exec.outputs[index].shape)
+
+    def get_output(self, index=0):
+        """MXPredGetOutput — returns numpy (the C API copies out)."""
+        return self._exec.outputs[index].asnumpy()
+
+    def reshape(self, new_input_shapes):
+        """MXPredReshape — rebind under the shape-keyed jit cache; params
+        are retained (c_predict_api.cc keeps the arg arrays)."""
+        shapes = dict(self._input_shapes)
+        shapes.update(new_input_shapes)
+        # current weights (possibly mutated via set_input on weights);
+        # labels are batch-shaped dead inputs, not weights
+        for k, v in self._exec.arg_dict.items():
+            if k not in self._input_shapes \
+                    and not (k == "label" or k.endswith("_label")):
+                self._params[k] = v.asnumpy()
+        for k, v in self._exec.aux_dict.items():
+            self._params[k] = v.asnumpy()
+        self._bind(shapes)
+
+    def free(self):
+        """MXPredFree"""
+        self._exec = None
+
+
+def create(symbol_json, param_blob, input_shapes, ctx=None):
+    """Functional spelling of ``MXPredCreate``."""
+    return Predictor(symbol_json, param_blob, input_shapes, ctx)

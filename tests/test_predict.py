@@ -1,0 +1,98 @@
+"""Predict API tests (reference ``tests/python/predict`` +
+``c_predict_api.cc`` semantics): json+params blob -> forward -> output,
+partial outputs, reshape."""
+
+import os
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import predict
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def _train_tiny(tmp_path):
+    rs = np.random.RandomState(0)
+    centers = rs.rand(4, 8).astype(np.float32)
+    y = rs.randint(0, 4, 256)
+    X = centers[y] + 0.05 * rs.randn(256, 8).astype(np.float32)
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=16, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu", name="relu1")
+    net = mx.sym.FullyConnected(net, num_hidden=4, name="fc2")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    it = mx.io.NDArrayIter(X, y.astype(np.float32), batch_size=32)
+    mod = mx.mod.Module(net)
+    mod.fit(it, optimizer="sgd", optimizer_params={"learning_rate": 0.5},
+            initializer=mx.init.Xavier(), num_epoch=3)
+    prefix = os.path.join(str(tmp_path), "tiny")
+    mod.save_checkpoint(prefix, 3)
+    return net, prefix, X, y
+
+
+def test_predictor_matches_module(tmp_path):
+    net, prefix, X, y = _train_tiny(tmp_path)
+    symbol_json = open(prefix + "-symbol.json").read()
+    params_path = prefix + "-0003.params"
+
+    pred = predict.Predictor(symbol_json, params_path,
+                             {"data": (8, 8)})
+    pred.set_input("data", X[:8])
+    pred.forward()
+    out = pred.get_output(0)
+    assert pred.get_output_shape(0) == (8, 4)
+
+    # must match Module forward exactly
+    sym2, args, auxs = mx.model.load_checkpoint(prefix, 3)
+    mod = mx.mod.Module(sym2)
+    mod.bind(data_shapes=[("data", (8, 8))], for_training=False)
+    mod.set_params(args, auxs)
+    mod.forward(mx.io.DataBatch(data=[mx.nd.array(X[:8])], label=[]),
+                is_train=False)
+    assert_almost_equal(out, mod.get_outputs()[0].asnumpy(), rtol=1e-5)
+    # and be a good classifier
+    assert (out.argmax(1) == y[:8]).mean() >= 0.75
+
+
+def test_predictor_params_bytes_and_reshape(tmp_path):
+    net, prefix, X, y = _train_tiny(tmp_path)
+    symbol_json = open(prefix + "-symbol.json").read()
+    blob = open(predict.nd._load_path(prefix + "-0003.params"),
+                "rb").read()
+    pred = predict.Predictor(symbol_json, blob, {"data": (4, 8)})
+    pred.set_input("data", X[:4])
+    pred.forward()
+    out4 = pred.get_output(0)
+    pred.reshape({"data": (16, 8)})
+    pred.set_input("data", X[:16])
+    pred.forward()
+    out16 = pred.get_output(0)
+    assert out16.shape == (16, 4)
+    assert_almost_equal(out4, out16[:4], rtol=1e-5)
+
+
+def test_predictor_partial_out(tmp_path):
+    net, prefix, X, y = _train_tiny(tmp_path)
+    symbol_json = open(prefix + "-symbol.json").read()
+    internals = net.get_internals().list_outputs()
+    idx = internals.index("relu1_output")
+    pred = predict.Predictor(symbol_json, prefix + "-0003.params",
+                             {"data": (4, 8)}, output_index=idx)
+    pred.set_input("data", X[:4])
+    pred.forward()
+    assert pred.get_output_shape(0) == (4, 16)
+
+
+def test_predictor_missing_params_raises(tmp_path):
+    net, prefix, X, y = _train_tiny(tmp_path)
+    symbol_json = open(prefix + "-symbol.json").read()
+    import pytest
+
+    params = predict.load_ndarray_file(prefix + "-0003.params")
+    bad = {k: v for k, v in params.items() if "fc2" not in k}
+    import io as _io
+
+    buf = _io.BytesIO()
+    np.savez(buf, **bad)
+    with pytest.raises(mx.MXNetError):
+        predict.Predictor(symbol_json, buf.getvalue(), {"data": (4, 8)})

@@ -1,0 +1,97 @@
+#!/usr/bin/env python
+"""Measure gradient-exchange bandwidth per kvstore type over real model
+shapes.
+
+Reference: ``tools/bandwidth/measure.py`` (``tools/bandwidth/README.md:
+1-28``) — times one push+pull round (reduce + broadcast) of every
+parameter of a chosen network across N simulated devices and reports GB/s.
+On TPU the ``device`` type is an in-XLA reduce; ``dist_sync`` adds the
+multi-process parameter-server hop.
+"""
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import models  # noqa: E402
+
+
+def param_shapes(network, num_layers, image_shape, num_classes, batch):
+    net = models.get_symbol(network, num_classes=num_classes,
+                            num_layers=num_layers,
+                            image_shape=image_shape)
+    shape = {"data": (batch,) + tuple(image_shape)}
+    try:
+        shape["softmax_label"] = (batch,)
+        arg_shapes, _, _ = net.infer_shape(**shape)
+    except Exception:
+        del shape["softmax_label"]
+        arg_shapes, _, _ = net.infer_shape(**shape)
+    names = net.list_arguments()
+    return [(n, s) for n, s in zip(names, arg_shapes)
+            if n not in ("data", "softmax_label")]
+
+
+def measure(kv_type, shapes, num_devices, repeat):
+    kv = mx.kvstore.create(kv_type)
+    if kv_type.startswith("dist"):
+        opt = mx.optimizer.create("test")  # identity-ish updater on server
+        kv.set_optimizer(opt)
+    rs = np.random.RandomState(0)
+    values = []
+    for i, (name, s) in enumerate(shapes):
+        init = mx.nd.array(rs.rand(*s).astype(np.float32))
+        kv.init(i, init)
+        values.append([mx.nd.array(rs.rand(*s).astype(np.float32))
+                       for _ in range(num_devices)])
+    total_bytes = sum(np.prod(s) * 4 for _, s in shapes)
+    # one warmup round
+    for i, vlist in enumerate(values):
+        kv.push(i, vlist)
+        outs = [mx.nd.zeros(vlist[0].shape) for _ in range(num_devices)]
+        kv.pull(i, outs)
+    for o in outs:
+        o.wait_to_read()
+    tic = time.time()
+    for _ in range(repeat):
+        for i, vlist in enumerate(values):
+            kv.push(i, vlist)
+            outs = [mx.nd.zeros(vlist[0].shape)
+                    for _ in range(num_devices)]
+            kv.pull(i, outs)
+        for o in outs:
+            o.wait_to_read()
+    dt = (time.time() - tic) / repeat
+    # bytes moved per round: reduce N copies + broadcast N copies
+    moved = 2.0 * num_devices * total_bytes
+    return moved / dt / 1e9, dt
+
+
+if __name__ == "__main__":
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--network", type=str, default="resnet")
+    p.add_argument("--num-layers", type=int, default=18)
+    p.add_argument("--image-shape", type=str, default="3,32,32")
+    p.add_argument("--num-classes", type=int, default=10)
+    p.add_argument("--batch-size", type=int, default=2)
+    p.add_argument("--num-devices", type=int, default=4)
+    p.add_argument("--kv-store", type=str, default="local,device")
+    p.add_argument("--repeat", type=int, default=3)
+    args = p.parse_args()
+
+    image_shape = tuple(int(x) for x in args.image_shape.split(","))
+    shapes = param_shapes(args.network, args.num_layers, image_shape,
+                          args.num_classes, args.batch_size)
+    total_mb = sum(np.prod(s) * 4 for _, s in shapes) / 1e6
+    print("%s: %d params, %.1f MB" % (args.network, len(shapes), total_mb))
+    for kv_type in args.kv_store.split(","):
+        gbs, dt = measure(kv_type, shapes, args.num_devices, args.repeat)
+        print("kvstore %-10s  %.3f s/round  %.2f GB/s" % (kv_type, dt, gbs))

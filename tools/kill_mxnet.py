@@ -1,0 +1,61 @@
+#!/usr/bin/env python
+"""Kill stray launcher-spawned training processes on this machine.
+
+Reference: ``tools/kill-mxnet.py`` (cluster cleanup after a crashed
+distributed job).  Matches processes whose environment carries the
+``DMLC_ROLE`` wire protocol (what ``tools/launch.py`` sets) or whose
+command line matches the given pattern.
+"""
+
+import argparse
+import os
+import signal
+import sys
+
+
+def iter_procs():
+    for pid in os.listdir("/proc"):
+        if not pid.isdigit():
+            continue
+        try:
+            with open("/proc/%s/cmdline" % pid, "rb") as f:
+                cmd = f.read().replace(b"\0", b" ").decode(errors="replace")
+            with open("/proc/%s/environ" % pid, "rb") as f:
+                env = f.read().decode(errors="replace")
+        except (PermissionError, FileNotFoundError, ProcessLookupError):
+            continue
+        yield int(pid), cmd, env
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("pattern", nargs="?", default=None,
+                   help="extra cmdline substring filter")
+    p.add_argument("--dry-run", action="store_true")
+    args = p.parse_args()
+
+    me = os.getpid()
+    victims = []
+    for pid, cmd, env in iter_procs():
+        if pid == me or pid == os.getppid():
+            continue
+        if "DMLC_ROLE=" not in env:
+            continue
+        if args.pattern and args.pattern not in cmd:
+            continue
+        victims.append((pid, cmd.strip()))
+
+    for pid, cmd in victims:
+        print("%s pid %d: %s" % ("would kill" if args.dry_run else "killing",
+                                 pid, cmd[:100]))
+        if not args.dry_run:
+            try:
+                os.kill(pid, signal.SIGTERM)
+            except ProcessLookupError:
+                pass
+    print("%d process(es)" % len(victims))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

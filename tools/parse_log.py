@@ -1,0 +1,74 @@
+#!/usr/bin/env python
+"""Parse training logs into a markdown table.
+
+Reference: ``tools/parse_log.py`` — extracts per-epoch train/validation
+accuracy and speed from ``common/fit.py``-style logs.
+
+Usage: python tools/parse_log.py logfile [--format markdown|csv]
+"""
+
+import argparse
+import re
+import sys
+
+EPOCH_TRAIN = re.compile(
+    r"Epoch\[(\d+)\] Train-([\w-]+)=([0-9.naninf]+)")
+EPOCH_VAL = re.compile(
+    r"Epoch\[(\d+)\] Validation-([\w-]+)=([0-9.naninf]+)")
+EPOCH_TIME = re.compile(r"Epoch\[(\d+)\] Time cost=([0-9.]+)")
+SPEED = re.compile(r"Epoch\[(\d+)\] Batch \[\d+\]\s+Speed: ([0-9.]+)")
+
+
+def parse(lines):
+    rows = {}
+    speeds = {}
+    for line in lines:
+        m = EPOCH_TRAIN.search(line)
+        if m:
+            rows.setdefault(int(m.group(1)), {})["train-" + m.group(2)] = \
+                float(m.group(3))
+        m = EPOCH_VAL.search(line)
+        if m:
+            rows.setdefault(int(m.group(1)), {})["val-" + m.group(2)] = \
+                float(m.group(3))
+        m = EPOCH_TIME.search(line)
+        if m:
+            rows.setdefault(int(m.group(1)), {})["time"] = float(m.group(2))
+        m = SPEED.search(line)
+        if m:
+            speeds.setdefault(int(m.group(1)), []).append(float(m.group(2)))
+    for e, ss in speeds.items():
+        rows.setdefault(e, {})["speed"] = sum(ss) / len(ss)
+    return rows
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("logfile")
+    p.add_argument("--format", default="markdown",
+                   choices=("markdown", "csv"))
+    args = p.parse_args()
+    with open(args.logfile) as f:
+        rows = parse(f)
+    if not rows:
+        print("no epochs found", file=sys.stderr)
+        return 1
+    cols = sorted({k for r in rows.values() for k in r})
+    if args.format == "markdown":
+        print("| epoch | " + " | ".join(cols) + " |")
+        print("|" + "---|" * (len(cols) + 1))
+        for e in sorted(rows):
+            print("| %d | " % e + " | ".join(
+                ("%.4f" % rows[e][c]) if c in rows[e] else ""
+                for c in cols) + " |")
+    else:
+        print("epoch," + ",".join(cols))
+        for e in sorted(rows):
+            print("%d," % e + ",".join(
+                ("%.4f" % rows[e][c]) if c in rows[e] else ""
+                for c in cols))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
