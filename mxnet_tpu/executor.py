@@ -38,11 +38,67 @@ from .ndarray import NDArray, zeros as nd_zeros
 __all__ = ["Executor"]
 
 
+# ops whose outputs are NOT worth recomputing under mirror mode — the
+# FLOP-heavy set the reference's mirror predicate also skips
+# (graph_executor.cc:205-219: MXNET_BACKWARD_DO_MIRROR recomputes cheap
+# activations in backward instead of storing them)
+_MIRROR_SKIP = frozenset({
+    "Convolution", "Deconvolution", "FullyConnected", "dot", "batch_dot",
+    "RNN", "MultiHeadAttention", "FlashAttention", "Correlation",
+    "Embedding", "Custom", "_Native", "_NDArray",
+})
+
+
+def _mirror_mode():
+    """0 = off; 1 = segment remat between FLOP anchors; 2 = whole-graph
+    remat saving only matmul/conv outputs (max memory savings, ~1/3 more
+    FLOPs — the deep end of the reference's mirror trade)."""
+    import os
+
+    v = os.environ.get("MXNET_BACKWARD_DO_MIRROR", "")
+    if v in ("", "0"):
+        return 0
+    if v in ("2", "dots", "full"):
+        return 2
+    return 1
+
+
+def _mirror_enabled():
+    return _mirror_mode() != 0
+
+
+def _dots_and_convs_saveable(prim, *_args, **_params):
+    return prim.name in ("dot_general", "conv_general_dilated")
+
+
 def _graph_forward(symbol, arg_vals, aux_vals, is_train, rng):
-    """Trace the symbol DAG; returns (outputs list, new_aux dict)."""
+    """Trace the symbol DAG; returns (outputs list, new_aux dict).
+
+    Under ``MXNET_BACKWARD_DO_MIRROR`` (read at trace time) training
+    forwards are traced with segment-level rematerialization: maximal runs
+    of cheap ops between FLOP-heavy anchors execute inside one
+    ``jax.checkpoint``, so only segment *inputs* stay live across
+    fwd/bwd — the activations inside a run (BN/activation/pad/... chains)
+    are recomputed during backward, exactly the reference's mirror trade
+    (``graph_executor.cc:205-219``).
+    """
+    nodes = symbol._nodes()
+    mode = _mirror_mode() if is_train else 0
+    if mode == 1:
+        return _graph_forward_mirror(symbol, nodes, arg_vals, aux_vals, rng)
+    if mode == 2:
+        def whole(av, xv):
+            return _graph_forward_plain(symbol, nodes, av, xv, True, rng)
+
+        return jax.checkpoint(whole, policy=_dots_and_convs_saveable)(
+            arg_vals, aux_vals)
+    return _graph_forward_plain(symbol, nodes, arg_vals, aux_vals,
+                                is_train, rng)
+
+
+def _graph_forward_plain(symbol, nodes, arg_vals, aux_vals, is_train, rng):
     entry_val = {}
     new_aux = {}
-    nodes = symbol._nodes()
     for ni, node in enumerate(nodes):
         if node.is_variable:
             if node.name in arg_vals:
@@ -63,6 +119,85 @@ def _graph_forward(symbol, arg_vals, aux_vals, is_train, rng):
         if aux_up is not None:
             for (child, _ci), new in zip(node.inputs[na:], aux_up):
                 new_aux[child.name] = new
+    outputs = [entry_val[(id(n), i)] for n, i in symbol._outputs]
+    return outputs, new_aux
+
+
+def _graph_forward_mirror(symbol, nodes, arg_vals, aux_vals, rng,
+                          max_seg=32):
+    """Mirror-mode trace: greedy segments of non-anchor ops under one
+    ``jax.checkpoint`` each."""
+    entry_val = {}
+    new_aux = {}
+
+    def run_nodes(node_list, local):
+        """Execute (node, ni) list against the ``local`` entry map; returns
+        (per-node outs, per-node aux_up)."""
+        outs_all, aux_all = [], []
+        for node, ni in node_list:
+            op = node.op
+            na = node.num_args()
+            ins = [local[(id(c), ci)] for c, ci in node.inputs[:na]]
+            auxs = [local[(id(c), ci)] for c, ci in node.inputs[na:]]
+            key = jax.random.fold_in(rng, ni) if op.needs_rng else None
+            outs, aux_up = op.apply(node.attrs, ins, auxs, True, key)
+            for i, o in enumerate(outs):
+                local[(id(node), i)] = o
+            outs_all.append(list(outs))
+            aux_all.append(list(aux_up) if aux_up is not None else None)
+        return outs_all, aux_all
+
+    def record(node_list, outs_all, aux_all):
+        for (node, _ni), outs, aux_up in zip(node_list, outs_all, aux_all):
+            for i, o in enumerate(outs):
+                entry_val[(id(node), i)] = o
+            if aux_up is not None:
+                na = node.num_args()
+                for (child, _ci), new in zip(node.inputs[na:], aux_up):
+                    new_aux[child.name] = new
+
+    def flush(segment):
+        if not segment:
+            return
+        in_seg = {id(n) for n, _ in segment}
+        ext = []
+        seen = set()
+        for node, _ni in segment:
+            for c, ci in node.inputs:
+                k = (id(c), ci)
+                if id(c) not in in_seg and k not in seen:
+                    seen.add(k)
+                    ext.append(k)
+        ext_vals = [entry_val[k] for k in ext]
+
+        def seg_fn(vals):
+            return run_nodes(segment, dict(zip(ext, vals)))
+
+        outs_all, aux_all = jax.checkpoint(seg_fn)(ext_vals)
+        record(segment, outs_all, aux_all)
+
+    segment = []
+    for ni, node in enumerate(nodes):
+        if node.is_variable:
+            flush(segment)
+            segment = []
+            if node.name in arg_vals:
+                entry_val[(id(node), 0)] = arg_vals[node.name]
+            elif node.name in aux_vals:
+                entry_val[(id(node), 0)] = aux_vals[node.name]
+            else:
+                raise MXNetError("unbound variable %r" % node.name)
+        elif node.op.name in _MIRROR_SKIP:
+            flush(segment)
+            segment = []
+            outs_all, aux_all = run_nodes([(node, ni)], entry_val)
+            record([(node, ni)], outs_all, aux_all)
+        else:
+            segment.append((node, ni))
+            if len(segment) >= max_seg:
+                flush(segment)
+                segment = []
+    flush(segment)
     outputs = [entry_val[(id(n), i)] for n, i in symbol._outputs]
     return outputs, new_aux
 

@@ -99,3 +99,47 @@ def test_monitor_callback():
     ex.set_monitor_callback(lambda name, arr: seen.append(name))
     ex.forward(data=nd.ones((2,)))
     assert seen and seen[0].endswith("_output")
+
+
+def test_backward_mirror_exactness(monkeypatch):
+    """MXNET_BACKWARD_DO_MIRROR trades FLOPs for memory but must be
+    bit-compatible: same outputs and gradients (SURVEY §2.4 strategy 5)."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import sym
+
+    def build_and_grad():
+        data = sym.Variable("data")
+        net = sym.Convolution(data, num_filter=4, kernel=(3, 3), pad=(1, 1),
+                              name="c1")
+        net = sym.BatchNorm(net, name="bn1")
+        net = sym.Activation(net, act_type="relu")
+        net = sym.Convolution(net, num_filter=4, kernel=(3, 3), pad=(1, 1),
+                              name="c2")
+        net = sym.Flatten(net)
+        net = sym.FullyConnected(net, num_hidden=3, name="fc")
+        net = sym.SoftmaxOutput(net, name="softmax")
+        ex = net.simple_bind(mx.cpu(), data=(2, 3, 8, 8),
+                             softmax_label=(2,))
+        rs = np.random.RandomState(0)
+        for n, a in ex.arg_dict.items():
+            a[:] = rs.rand(*a.shape).astype(np.float32)
+        ex.arg_dict["softmax_label"][:] = np.array([1.0, 2.0])
+        ex.forward(is_train=True)
+        ex.backward()
+        return (ex.outputs[0].asnumpy(),
+                {k: v.asnumpy() for k, v in ex.grad_dict.items()
+                 if v is not None})
+
+    monkeypatch.delenv("MXNET_BACKWARD_DO_MIRROR", raising=False)
+    out_off, g_off = build_and_grad()
+    for mode in ("1", "2"):
+        monkeypatch.setenv("MXNET_BACKWARD_DO_MIRROR", mode)
+        out_on, g_on = build_and_grad()
+        np.testing.assert_allclose(out_off, out_on, rtol=1e-5, atol=1e-6)
+        assert set(g_off) == set(g_on)
+        for k in g_off:
+            np.testing.assert_allclose(g_off[k], g_on[k], rtol=1e-4,
+                                       atol=1e-5, err_msg="%s/%s"
+                                       % (mode, k))
