@@ -1,0 +1,319 @@
+// C++ frontend for the TPU-native framework.
+//
+// Reference: cpp-package/include/mxnet-cpp/ (SURVEY §2.7) — a full
+// training-capable C++ API (NDArray/Symbol/Optimizer/Module) that sits on
+// the same runtime every other frontend uses.  The reference rides the C
+// ABI of libmxnet; here the runtime's compute path is XLA driven through
+// the Python package, so this frontend embeds the CPython interpreter
+// (the supported "C ABI" of CPython) and drives exactly the same objects
+// a Python user gets — one runtime, N language frontends, as in the
+// reference where Scala/R/Perl all bind the same libmxnet.so.
+//
+// Header-only. Link with: python3.12-config --includes / --ldflags +
+// -lpython3.12.
+
+#pragma once
+
+#include <Python.h>
+
+#include <cstdio>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+namespace mxnet_tpu_cpp {
+
+// RAII PyObject* handle with call/attr helpers.
+class Value {
+ public:
+  Value() : obj_(nullptr) {}
+  explicit Value(PyObject* obj) : obj_(obj) {}  // steals the reference
+  Value(const Value& o) : obj_(o.obj_) { Py_XINCREF(obj_); }
+  Value(Value&& o) noexcept : obj_(o.obj_) { o.obj_ = nullptr; }
+  Value& operator=(Value o) {
+    std::swap(obj_, o.obj_);
+    return *this;
+  }
+  ~Value() { Py_XDECREF(obj_); }
+
+  static Value borrowed(PyObject* obj) {
+    Py_XINCREF(obj);
+    return Value(obj);
+  }
+  static Value none() {
+    Py_INCREF(Py_None);
+    return Value(Py_None);
+  }
+  static Value str(const std::string& s) {
+    return Check(PyUnicode_FromString(s.c_str()));
+  }
+  static Value integer(long v) { return Check(PyLong_FromLong(v)); }
+  static Value floating(double v) { return Check(PyFloat_FromDouble(v)); }
+  static Value boolean(bool v) { return borrowed(v ? Py_True : Py_False); }
+
+  PyObject* get() const { return obj_; }
+  bool valid() const { return obj_ != nullptr; }
+
+  Value attr(const std::string& name) const {
+    return Check(PyObject_GetAttrString(obj_, name.c_str()));
+  }
+  Value item(long i) const {
+    return Check(PySequence_GetItem(obj_, i));
+  }
+  long size() const { return static_cast<long>(PySequence_Size(obj_)); }
+
+  // call with positional args only
+  template <typename... A>
+  Value operator()(const A&... args) const {
+    Value tuple = MakeTuple(args...);
+    return Check(PyObject_CallObject(obj_, tuple.get()));
+  }
+  // call with positional tuple + kwargs dict
+  Value call(const Value& args, const Value& kwargs) const {
+    return Check(PyObject_Call(obj_, args.get(), kwargs.get()));
+  }
+
+  double as_double() const { return PyFloat_AsDouble(obj_); }
+  long as_long() const { return PyLong_AsLong(obj_); }
+  std::string as_string() const {
+    Value s = Check(PyObject_Str(obj_));
+    return PyUnicode_AsUTF8(s.get());
+  }
+
+  template <typename... A>
+  static Value MakeTuple(const A&... args) {
+    PyObject* t = PyTuple_New(sizeof...(A));
+    int i = 0;
+    (void)std::initializer_list<int>{
+        (PyTuple_SetItem(t, i++, ToPy(args)), 0)...};
+    return Check(t);
+  }
+
+  static Value Check(PyObject* obj) {
+    if (obj == nullptr) {
+      PyErr_Print();
+      throw std::runtime_error("python call failed");
+    }
+    return Value(obj);
+  }
+
+ private:
+  // ToPy returns NEW references (PyTuple_SetItem steals them)
+  static PyObject* ToPy(const Value& v) {
+    Py_XINCREF(v.get());
+    return v.get();
+  }
+  static PyObject* ToPy(const std::string& s) {
+    return PyUnicode_FromString(s.c_str());
+  }
+  static PyObject* ToPy(const char* s) { return PyUnicode_FromString(s); }
+  static PyObject* ToPy(long v) { return PyLong_FromLong(v); }
+  static PyObject* ToPy(int v) { return PyLong_FromLong(v); }
+  static PyObject* ToPy(double v) { return PyFloat_FromDouble(v); }
+
+  PyObject* obj_;
+};
+
+// kwargs builder
+class Kwargs {
+ public:
+  Kwargs() : dict_(Value::Check(PyDict_New())) {}
+  Kwargs& set(const std::string& k, const Value& v) {
+    PyDict_SetItemString(dict_.get(), k.c_str(), v.get());
+    return *this;
+  }
+  Kwargs& set(const std::string& k, const std::string& v) {
+    return set(k, Value::str(v));
+  }
+  // without this, string literals would resolve to the bool overload
+  Kwargs& set(const std::string& k, const char* v) {
+    return set(k, Value::str(v));
+  }
+  Kwargs& set(const std::string& k, long v) {
+    return set(k, Value::integer(v));
+  }
+  Kwargs& set(const std::string& k, int v) {
+    return set(k, Value::integer(v));
+  }
+  Kwargs& set(const std::string& k, double v) {
+    return set(k, Value::floating(v));
+  }
+  Kwargs& set(const std::string& k, bool v) {
+    return set(k, Value::boolean(v));
+  }
+  const Value& dict() const { return dict_; }
+
+ private:
+  Value dict_;
+};
+
+// The runtime singleton: embedded interpreter + the mxnet_tpu module.
+class Runtime {
+ public:
+  // repo_root: directory containing mxnet_tpu/; extra_path: e.g. a venv's
+  // site-packages when embedding outside that venv's python binary.
+  static Runtime& Init(const std::string& repo_root,
+                       const std::string& extra_path = "") {
+    static Runtime rt(repo_root, extra_path);
+    return rt;
+  }
+
+  Value mx() const { return mx_; }
+  Value nd() const { return mx_.attr("nd"); }
+  Value sym() const { return mx_.attr("sym"); }
+  Value numpy() const { return np_; }
+
+  // numpy float32 array from a flat buffer + shape
+  Value array(const std::vector<float>& data,
+              const std::vector<long>& shape) const {
+    Value np_arr = np_.attr("array")(FloatList(data));
+    np_arr = np_arr.attr("astype")(std::string("float32"));
+    return np_arr.attr("reshape")(LongList(shape));
+  }
+
+  // NDArray from buffer+shape
+  Value ndarray(const std::vector<float>& data,
+                const std::vector<long>& shape) const {
+    return nd().attr("array")(array(data, shape));
+  }
+
+  static Value FloatList(const std::vector<float>& v) {
+    PyObject* lst = PyList_New(static_cast<Py_ssize_t>(v.size()));
+    for (size_t i = 0; i < v.size(); ++i)
+      PyList_SetItem(lst, static_cast<Py_ssize_t>(i),
+                     PyFloat_FromDouble(v[i]));
+    return Value::Check(lst);
+  }
+  static Value LongList(const std::vector<long>& v) {
+    PyObject* lst = PyList_New(static_cast<Py_ssize_t>(v.size()));
+    for (size_t i = 0; i < v.size(); ++i)
+      PyList_SetItem(lst, static_cast<Py_ssize_t>(i),
+                     PyLong_FromLong(v[i]));
+    return Value::Check(lst);
+  }
+
+  static std::vector<float> to_vector(const Value& ndarray_or_np) {
+    Value flat = ndarray_or_np;
+    if (PyObject_HasAttrString(flat.get(), "asnumpy"))
+      flat = flat.attr("asnumpy")();
+    flat = flat.attr("reshape")(Value::integer(-1));
+    Value lst = flat.attr("tolist")();
+    long n = lst.size();
+    std::vector<float> out(static_cast<size_t>(n));
+    for (long i = 0; i < n; ++i)
+      out[static_cast<size_t>(i)] = static_cast<float>(
+          lst.item(i).as_double());
+    return out;
+  }
+
+ private:
+  Runtime(const std::string& repo_root, const std::string& extra_path) {
+    Py_Initialize();
+    Value sys = Value::Check(PyImport_ImportModule("sys"));
+    Value path = sys.attr("path");
+    if (!extra_path.empty())
+      path.attr("insert")(Value::integer(0), Value::str(extra_path));
+    path.attr("insert")(Value::integer(0), Value::str(repo_root));
+    np_ = Value::Check(PyImport_ImportModule("numpy"));
+    mx_ = Value::Check(PyImport_ImportModule("mxnet_tpu"));
+  }
+  Value mx_, np_;
+};
+
+// --- typed facades (the mxnet-cpp surface) --------------------------------
+
+class Symbol {
+ public:
+  Symbol() {}
+  explicit Symbol(Value v) : v_(v) {}
+  static Symbol Variable(Runtime& rt, const std::string& name) {
+    return Symbol(rt.sym().attr("Variable")(name));
+  }
+  // generic op application: Symbol::Op(rt, "FullyConnected", {data}, kw)
+  static Symbol Op(Runtime& rt, const std::string& op,
+                   const std::vector<Symbol>& args, const Kwargs& kw) {
+    PyObject* t = PyTuple_New(static_cast<Py_ssize_t>(args.size()));
+    for (size_t i = 0; i < args.size(); ++i) {
+      Py_XINCREF(args[i].v_.get());
+      PyTuple_SetItem(t, static_cast<Py_ssize_t>(i), args[i].v_.get());
+    }
+    return Symbol(rt.sym().attr(op).call(Value::Check(t), kw.dict()));
+  }
+  Value value() const { return v_; }
+
+ private:
+  Value v_;
+};
+
+class Module {
+ public:
+  Module(Runtime& rt, const Symbol& net) : rt_(&rt) {
+    mod_ = rt.mx().attr("mod").attr("Module")(net.value());
+  }
+
+  void Bind(const std::vector<long>& data_shape,
+            const std::vector<long>& label_shape) {
+    Value ds = Value::MakeTuple(Value::MakeTuple(
+        Value::str("data"), TupleOf(data_shape)));
+    Kwargs kw;
+    if (!label_shape.empty()) {
+      kw.set("label_shapes", Value::MakeTuple(Value::MakeTuple(
+          Value::str("softmax_label"), TupleOf(label_shape))));
+    }
+    mod_.attr("bind").call(Value::MakeTuple(ds), kw.dict());
+  }
+
+  void InitParams(double xavier_magnitude = 2.0) {
+    Kwargs kw;
+    kw.set("magnitude", xavier_magnitude);
+    Value init = rt_->mx().attr("init").attr("Xavier")
+        .call(Value::MakeTuple(), kw.dict());
+    mod_.attr("init_params")(init);
+  }
+
+  void InitOptimizer(const std::string& name, double lr,
+                     double momentum = 0.0) {
+    Kwargs opt_params;
+    opt_params.set("learning_rate", lr);
+    if (momentum != 0.0) opt_params.set("momentum", momentum);
+    Kwargs kw;
+    kw.set("optimizer", name);
+    kw.set("optimizer_params", opt_params.dict());
+    mod_.attr("init_optimizer").call(Value::MakeTuple(), kw.dict());
+  }
+
+  void ForwardBackward(const Value& data, const Value& label) {
+    Value lst_d = Value::MakeTuple(data);
+    Value lst_l = Value::MakeTuple(label);
+    Kwargs kw;
+    kw.set("data", Value::Check(PySequence_List(lst_d.get())));
+    kw.set("label", Value::Check(PySequence_List(lst_l.get())));
+    Value batch = rt_->mx().attr("io").attr("DataBatch")
+        .call(Value::MakeTuple(), kw.dict());
+    mod_.attr("forward_backward")(batch);
+  }
+
+  void Update() { mod_.attr("update")(); }
+
+  std::vector<float> Outputs() {
+    Value outs = mod_.attr("get_outputs")();
+    return Runtime::to_vector(outs.item(0));
+  }
+
+  void SaveCheckpoint(const std::string& prefix, int epoch) {
+    mod_.attr("save_checkpoint")(prefix, epoch);
+  }
+
+ private:
+  static Value TupleOf(const std::vector<long>& v) {
+    PyObject* t = PyTuple_New(static_cast<Py_ssize_t>(v.size()));
+    for (size_t i = 0; i < v.size(); ++i)
+      PyTuple_SetItem(t, static_cast<Py_ssize_t>(i),
+                      PyLong_FromLong(v[i]));
+    return Value::Check(t);
+  }
+  Runtime* rt_;
+  Value mod_;
+};
+
+}  // namespace mxnet_tpu_cpp

@@ -1,0 +1,34 @@
+"""Builds and runs the C++ frontend (cpp-package analog) end-to-end:
+symbol building, Module bind/init/train loop, accuracy assertion — all
+from C++ against the embedded runtime."""
+
+import os
+import shutil
+import subprocess
+import sys
+import sysconfig
+
+import pytest
+
+ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+CPP = os.path.join(ROOT, "cpp_package")
+
+
+@pytest.mark.skipif(shutil.which("cmake") is None
+                    or shutil.which("ninja") is None,
+                    reason="cmake/ninja not available")
+def test_cpp_frontend_trains(tmp_path):
+    build = str(tmp_path / "build")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    subprocess.run(["cmake", "-B", build, "-G", "Ninja", CPP],
+                   check=True, capture_output=True, text=True)
+    subprocess.run(["ninja", "-C", build], check=True,
+                   capture_output=True, text=True)
+    site = sysconfig.get_paths()["purelib"]
+    proc = subprocess.run(
+        [os.path.join(build, "train_mlp"), ROOT, site],
+        capture_output=True, text=True, timeout=400, env=env)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "C++ frontend training OK" in proc.stdout
