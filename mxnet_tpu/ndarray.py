@@ -392,9 +392,41 @@ def imdecode(str_img, clip_rect=(0, 0, 0, 0), out=None, index=0, channels=3,
     if arr.ndim == 2:
         arr = arr[:, :, None]
     arr = arr.transpose(2, 0, 1)[None]
+    x0, y0, x1, y1 = clip_rect
+    if (x0, y0, x1, y1) != (0, 0, 0, 0):
+        height, width = arr.shape[2], arr.shape[3]
+        if not (0 <= x0 < x1 <= width and 0 <= y0 < y1 <= height):
+            raise MXNetError(
+                "imdecode: clip_rect %r out of bounds for %dx%d image"
+                % (clip_rect, width, height))
+        arr = arr[:, :, y0:y1, x0:x1]
     if mean is not None:
         arr = arr - mean.asnumpy()
-    return array(arr)
+    res = array(arr)
+    if out is not None:
+        if not 0 <= index < out.shape[0]:
+            raise MXNetError("imdecode: index %d out of range for out with "
+                             "batch %d" % (index, out.shape[0]))
+        if res.shape[1:] != out.shape[1:]:
+            raise MXNetError("imdecode: decoded shape %r does not match out "
+                             "slot shape %r" % (res.shape[1:], out.shape[1:]))
+        out[index:index + 1] = res
+        return out
+    return res
+
+
+def _imdecode(mean, index=0, x0=0, y0=0, x1=0, y1=0, n_channels=3,
+              size=0, str_img=None, out=None):
+    """Raw legacy ``_imdecode`` NDArray function (``ndarray.cc:832-867``),
+    same argument order as the reference registration (mean, index, crop
+    window, n_channels, size, image bytes): decode + crop + optional mean
+    subtract, CHW float32 output.  ``mean=None`` or an empty array is the
+    reference's dummy no-mean handle."""
+    if str_img is None:
+        raise MXNetError("_imdecode: str_img (image bytes) is required")
+    return imdecode(str_img, clip_rect=(x0, y0, x1, y1), out=out, index=index,
+                    channels=n_channels, mean=mean if (mean is not None and
+                                                       mean.size > 0) else None)
 
 
 def waitall():

@@ -69,6 +69,17 @@ simple("_broadcast", lambda data, shape: jnp.broadcast_to(data, shape),
 _ALIASES["_copyto"] = "_copy"
 _ALIASES["Convolution_v1"] = "Convolution"
 
+# _CrossDeviceCopy: the PlaceDevice pass's placeholder node
+# (src/operator/cross_device_copy.cc — carries no compute; the executor
+# performs the copy).  Under XLA the "copy" is a sharding/placement decision
+# made by the compiler, so the node lowers to identity; the group2ctx
+# machinery in executor.py owns actual placement.
+simple("_CrossDeviceCopy", lambda data: data)
+
+
+# _imdecode (``src/ndarray/ndarray.cc:832``) is a host-side decode and never
+# appears in a graph; it lives as an NDArray function in mxnet_tpu.ndarray.
+
 
 # ---------------------------------------------------------------------------
 # CTC loss — the WarpCTC plugin analog (plugin/warpctc/warpctc-inl.h)

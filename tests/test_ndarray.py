@@ -6,6 +6,7 @@ import numpy as np
 
 import mxnet_tpu as mx
 from mxnet_tpu import nd
+from mxnet_tpu.base import MXNetError
 from mxnet_tpu.test_utils import assert_almost_equal
 
 
@@ -106,3 +107,43 @@ def test_async_semantics():
         a = nd.dot(a, a) * 1e-3
     val = a.asnumpy()
     assert np.isfinite(val).all()
+
+
+def test_cross_device_copy_op():
+    """_CrossDeviceCopy (src/operator/cross_device_copy.cc) is identity."""
+    x = nd.array(np.arange(6.0).reshape(2, 3))
+    y = nd._CrossDeviceCopy(x)
+    assert_almost_equal(y, x.asnumpy())
+
+
+def test_imdecode_legacy_fn():
+    """_imdecode NDArray function (ndarray.cc:832-867): decode+crop CHW."""
+    import io as _io
+
+    from PIL import Image
+
+    from mxnet_tpu.ndarray import _imdecode
+
+    img = (np.random.RandomState(0).rand(8, 10, 3) * 255).astype(np.uint8)
+    b = _io.BytesIO()
+    Image.fromarray(img).save(b, format="PNG")
+    ref = np.transpose(img[1:6, 2:7, :].astype(np.float32), (2, 0, 1))
+    out = _imdecode(None, 0, 2, 1, 7, 6, 3, 0, str_img=b.getvalue())
+    assert out.shape == (1, 3, 5, 5)
+    assert_almost_equal(out.asnumpy()[0], ref)
+    # scalar mean is honored
+    out_m = _imdecode(nd.array([5.0]), 0, 2, 1, 7, 6, 3, 0,
+                      str_img=b.getvalue())
+    assert_almost_equal(out_m.asnumpy()[0], ref - 5.0)
+    dst = nd.zeros((4, 3, 5, 5))
+    nd.imdecode(b.getvalue(), clip_rect=(2, 1, 7, 6), out=dst, index=2)
+    assert_almost_equal(dst.asnumpy()[2], ref)
+    # bounds errors are loud: bad batch index, bad clip_rect
+    for kw in (dict(out=dst, index=9), dict(clip_rect=(2, 1, 99, 6)),
+               dict(clip_rect=(5, 1, 2, 6)),
+               dict(out=dst, index=0, channels=1)):
+        try:
+            nd.imdecode(b.getvalue(), **{"clip_rect": (2, 1, 7, 6), **kw})
+            raise AssertionError("imdecode %r should raise" % kw)
+        except MXNetError:
+            pass
