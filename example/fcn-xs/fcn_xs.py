@@ -1,0 +1,98 @@
+#!/usr/bin/env python
+"""FCN-xs semantic segmentation: conv backbone + deconv upsampling + crop.
+
+Reference: ``example/fcn-xs/`` (``symbol_fcnxs.py``, ``fcn_xs.py``) — a
+VGG-ish backbone whose score map is upsampled with ``Deconvolution``
+(bilinear-initialized), ``Crop``-aligned to the input, and trained with a
+per-pixel ``SoftmaxOutput`` (``multi_output=True``).  FCN-16s/8s fuse
+skip connections from shallower pools via ``ElementWiseSum`` + crop.
+
+No-egress: a synthetic shapes dataset (squares/disks on textured noise)
+stands in for PASCAL-VOC; per-pixel accuracy is the metric.
+"""
+
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+
+NUM_CLASSES = 3  # background / square / disk
+
+
+def make_dataset(n, side, seed):
+    rs = np.random.RandomState(seed)
+    imgs = rs.rand(n, 3, side, side).astype(np.float32) * 0.3
+    labels = np.zeros((n, side, side), np.float32)
+    yy, xx = np.mgrid[0:side, 0:side]
+    for i in range(n):
+        for _ in range(rs.randint(1, 4)):
+            cls = rs.randint(1, NUM_CLASSES)
+            cy, cx = rs.randint(8, side - 8, 2)
+            r = rs.randint(4, 8)
+            mask = ((np.abs(yy - cy) < r) & (np.abs(xx - cx) < r)) \
+                if cls == 1 else ((yy - cy) ** 2 + (xx - cx) ** 2 < r * r)
+            labels[i][mask] = cls
+            imgs[i, :, mask] += (0.5 + 0.1 * cls + 0.05 * rs.randn())
+    return imgs, labels.reshape(n, -1)
+
+
+def fcn32s(num_classes):
+    """conv stack (stride 4 total) -> score -> 4x deconv upsample -> crop."""
+    data = mx.sym.Variable("data")
+    h = mx.sym.Convolution(data, kernel=(5, 5), pad=(2, 2), num_filter=16,
+                           name="conv1")
+    h = mx.sym.Activation(h, act_type="relu")
+    h = mx.sym.Pooling(h, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    h = mx.sym.Convolution(h, kernel=(3, 3), pad=(1, 1), num_filter=32,
+                           name="conv2")
+    h = mx.sym.Activation(h, act_type="relu")
+    h = mx.sym.Pooling(h, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    score = mx.sym.Convolution(h, kernel=(1, 1), num_filter=num_classes,
+                               name="score")
+    # bilinear-initialized 4x upsampling deconvolution (fcn-xs init_fcnxs)
+    up = mx.sym.Deconvolution(score, kernel=(8, 8), stride=(4, 4),
+                              num_filter=num_classes, no_bias=True,
+                              name="bigscore_upsampling")
+    up = mx.sym.Crop(up, data, name="crop")
+    return mx.sym.SoftmaxOutput(up, multi_output=True, name="softmax")
+
+
+if __name__ == "__main__":
+    logging.basicConfig(level=logging.INFO)
+    parser = argparse.ArgumentParser(description="FCN-xs segmentation")
+    parser.add_argument("--side", type=int, default=48)
+    parser.add_argument("--batch-size", type=int, default=8)
+    parser.add_argument("--num-epochs", type=int, default=6)
+    parser.add_argument("--lr", type=float, default=0.2)
+    args = parser.parse_args()
+
+    xtr, ytr = make_dataset(256, args.side, seed=0)
+    xva, yva = make_dataset(64, args.side, seed=9)
+    # per-pixel labels: SoftmaxOutput(multi_output) wants (batch, H*W)
+    train = mx.io.NDArrayIter(xtr, ytr, batch_size=args.batch_size,
+                              shuffle=True, label_name="softmax_label")
+    val = mx.io.NDArrayIter(xva, yva, batch_size=args.batch_size,
+                            label_name="softmax_label")
+
+    net = fcn32s(NUM_CLASSES)
+    mod = mx.mod.Module(net, context=mx.cpu())
+
+    mod.fit(train, eval_data=val, num_epoch=args.num_epochs,
+            optimizer="sgd",
+            optimizer_params={"learning_rate": args.lr, "momentum": 0.9,
+                              "wd": 1e-4},
+            initializer=mx.init.Xavier(),
+            eval_metric=mx.metric.Accuracy(axis=1),
+            batch_end_callback=mx.callback.Speedometer(args.batch_size, 16))
+
+    m = mx.metric.Accuracy(axis=1)
+    val.reset()
+    mod.score(val, m)
+    logging.info("final per-pixel accuracy: %.4f", m.get()[1])
+    assert m.get()[1] > 0.8

@@ -87,12 +87,16 @@ def synth_imagerec(data_dir, prefix, num_images, num_classes, side, seed=11):
     from mxnet_tpu import recordio
 
     os.makedirs(data_dir, exist_ok=True)
-    rec = os.path.join(data_dir, prefix + ".rec")
-    idx = os.path.join(data_dir, prefix + ".idx")
+    # v2: fixed cross-split prototypes — versioned name so caches built by
+    # older generators are never silently reused
+    rec = os.path.join(data_dir, prefix + ".v2.rec")
+    idx = os.path.join(data_dir, prefix + ".v2.idx")
     if os.path.exists(rec) and os.path.exists(idx):
         return rec, idx
+    # one fixed set of class prototypes across splits — the per-split seed
+    # only controls sampling, so train and val come from the same classes
+    protos = np.random.RandomState(101).rand(num_classes, side, side, 3) * 200.0
     rs = np.random.RandomState(seed)
-    protos = rs.rand(num_classes, side, side, 3) * 200.0
     writer = recordio.MXIndexedRecordIO(idx, rec, "w")
     for i in range(num_images):
         c = int(rs.randint(0, num_classes))

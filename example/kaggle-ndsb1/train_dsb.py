@@ -1,0 +1,93 @@
+#!/usr/bin/env python
+"""National Data Science Bowl (plankton) style training.
+
+Reference: ``example/kaggle-ndsb1/train_dsb.py`` — small grayscale images,
+many classes, ImageRecordIter with augmentation, a compact convnet
+(``symbol_dsb.py``), and a per-class-probability submission file
+(``predict_dsb.py``/``submission_dsb.py``).  Synthetic RecordIO shards
+stand in for the competition data (no egress); the submission CSV writer is
+the same shape as the reference's.
+"""
+
+import argparse
+import csv
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                "image-classification"))
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from common import data as exdata  # noqa: E402
+
+
+def get_symbol(num_classes):
+    data = mx.sym.Variable("data")
+    h = mx.sym.Convolution(data, kernel=(3, 3), pad=(1, 1), num_filter=16,
+                           name="conv1")
+    h = mx.sym.Activation(h, act_type="relu")
+    h = mx.sym.Pooling(h, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    h = mx.sym.Convolution(h, kernel=(3, 3), pad=(1, 1), num_filter=32,
+                           name="conv2")
+    h = mx.sym.Activation(h, act_type="relu")
+    h = mx.sym.Pooling(h, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    h = mx.sym.Flatten(h)
+    h = mx.sym.FullyConnected(h, num_hidden=64, name="fc1")
+    h = mx.sym.Activation(h, act_type="relu")
+    h = mx.sym.Dropout(h, p=0.25)
+    h = mx.sym.FullyConnected(h, num_hidden=num_classes, name="fc2")
+    return mx.sym.SoftmaxOutput(h, name="softmax")
+
+
+if __name__ == "__main__":
+    logging.basicConfig(level=logging.INFO)
+    parser = argparse.ArgumentParser(description="NDSB-style training")
+    parser.add_argument("--data-dir", type=str, default="data")
+    parser.add_argument("--num-classes", type=int, default=12)
+    parser.add_argument("--side", type=int, default=32)
+    parser.add_argument("--batch-size", type=int, default=64)
+    parser.add_argument("--num-epochs", type=int, default=8)
+    parser.add_argument("--lr", type=float, default=0.05)
+    parser.add_argument("--submission", type=str, default="submission.csv")
+    args = parser.parse_args()
+
+    rec, _ = exdata.synth_imagerec(args.data_dir, "dsb_train", 1536,
+                                   args.num_classes, args.side)
+    vrec, _ = exdata.synth_imagerec(args.data_dir, "dsb_val", 384,
+                                    args.num_classes, args.side, seed=13)
+    shape = (3, args.side, args.side)
+    norm = dict(mean_r=128, mean_g=128, mean_b=128,
+                std_r=60, std_g=60, std_b=60)
+    train = mx.io.ImageRecordIter(path_imgrec=rec, data_shape=shape,
+                                  batch_size=args.batch_size, shuffle=True,
+                                  rand_mirror=True, **norm)
+    val = mx.io.ImageRecordIter(path_imgrec=vrec, data_shape=shape,
+                                batch_size=args.batch_size, **norm)
+
+    mod = mx.mod.Module(get_symbol(args.num_classes), context=mx.cpu())
+    mod.fit(train, eval_data=val, num_epoch=args.num_epochs,
+            optimizer="sgd",
+            optimizer_params={"learning_rate": args.lr, "momentum": 0.9,
+                              "wd": 1e-4},
+            initializer=mx.init.Xavier(),
+            batch_end_callback=mx.callback.Speedometer(args.batch_size, 10))
+
+    m = mx.metric.Accuracy()
+    val.reset()
+    mod.score(val, m)
+    logging.info("validation accuracy: %.3f", m.get()[1])
+
+    # per-class-probability submission file (reference submission_dsb.py)
+    val.reset()
+    probs = mod.predict(val).asnumpy()
+    with open(args.submission, "w", newline="") as f:
+        w = csv.writer(f)
+        w.writerow(["image"] + ["class%02d" % c
+                                for c in range(args.num_classes)])
+        for i, row in enumerate(probs):
+            w.writerow(["%d.jpg" % i] + ["%.5f" % p for p in row])
+    logging.info("wrote %s (%d rows)", args.submission, len(probs))

@@ -1,0 +1,73 @@
+#!/usr/bin/env python
+"""Time-major LSTM language model (TNC layout).
+
+Reference: ``example/rnn-time-major/rnn_cell_demo.py`` — the same bucketed
+PTB LM as ``example/rnn/`` but with (seq, batch, feature) layout, which
+avoids the per-step transpose and is the layout the fused RNN kernel wants
+(on TPU: the scan carries a (batch, hidden) state while the MXU consumes
+one (batch, feature) block per step — time-major is the natural order).
+"""
+
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "rnn"))
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from lstm_bucketing import synth_corpus  # noqa: E402
+
+if __name__ == "__main__":
+    logging.basicConfig(level=logging.INFO)
+    parser = argparse.ArgumentParser(description="time-major LSTM LM")
+    parser.add_argument("--num-hidden", type=int, default=128)
+    parser.add_argument("--num-embed", type=int, default=64)
+    parser.add_argument("--num-epochs", type=int, default=4)
+    parser.add_argument("--batch-size", type=int, default=32)
+    parser.add_argument("--vocab-size", type=int, default=100)
+    parser.add_argument("--lr", type=float, default=0.05)
+    args = parser.parse_args()
+
+    buckets = [10, 20, 30, 40, 50, 60]
+    train_sent = synth_corpus(1500, args.vocab_size)
+    val_sent = synth_corpus(400, args.vocab_size, seed=17)
+    # layout="TN": the iterator emits time-major (seq, batch) token grids
+    data_train = mx.rnn.BucketSentenceIter(train_sent, args.batch_size,
+                                           buckets=buckets, invalid_label=0,
+                                           layout="TN")
+    data_val = mx.rnn.BucketSentenceIter(val_sent, args.batch_size,
+                                         buckets=buckets, invalid_label=0,
+                                         layout="TN")
+
+    def sym_gen(seq_len):
+        data = mx.sym.Variable("data")        # (seq, batch)
+        label = mx.sym.Variable("softmax_label")
+        embed = mx.sym.Embedding(data, input_dim=args.vocab_size,
+                                 output_dim=args.num_embed, name="embed")
+        # fused RNN consumes TNC directly — no transpose on either side
+        rnn = mx.sym.RNN(embed, state_size=args.num_hidden, num_layers=1,
+                         mode="lstm", name="lstm")
+        pred = mx.sym.FullyConnected(mx.sym.Reshape(rnn, shape=(-1, args.num_hidden)),
+                                     num_hidden=args.vocab_size, name="pred")
+        label = mx.sym.Reshape(label, shape=(-1,))
+        pred = mx.sym.SoftmaxOutput(pred, label, name="softmax")
+        return pred, ("data",), ("softmax_label",)
+
+    mod = mx.mod.BucketingModule(sym_gen,
+                                 default_bucket_key=data_train.default_bucket_key,
+                                 context=mx.cpu())
+    mod.fit(data_train, eval_data=data_val,
+            eval_metric=mx.metric.Perplexity(0),
+            num_epoch=args.num_epochs,
+            optimizer="sgd",
+            optimizer_params={"learning_rate": args.lr, "momentum": 0.9},
+            initializer=mx.init.Mixed(
+                [".*parameters", ".*"],
+                [mx.init.FusedRNN(mx.init.Xavier(), args.num_hidden, 1,
+                                  "lstm"),
+                 mx.init.Xavier()]),
+            batch_end_callback=mx.callback.Speedometer(args.batch_size, 50))

@@ -44,8 +44,11 @@ START_TOKEN = 2  # 0 = pad/invalid, 1 = unk
 def synth_corpus(num_sentences, vocab, seed=3):
     """Markov-chain sentences: each token strongly prefers a few successors,
     so a real LM beats the unigram baseline by a wide margin."""
+    # one fixed "language" (transition table) for every split; the seed
+    # only controls which sentences are sampled from it
+    succ = np.random.RandomState(42).randint(START_TOKEN, vocab,
+                                             size=(vocab, 3))
     rs = np.random.RandomState(seed)
-    succ = rs.randint(START_TOKEN, vocab, size=(vocab, 3))
     sents = []
     for _ in range(num_sentences):
         n = int(rs.choice(BUCKETS)) - rs.randint(0, 5)

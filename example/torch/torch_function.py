@@ -1,0 +1,28 @@
+#!/usr/bin/env python
+"""Imperative torch tensor functions on NDArrays via ``mx.th``.
+
+Reference: ``example/torch/torch_function.py`` — call (Lua)Torch math from
+MXNet; here any ``torch.*`` function is reachable by name on the host.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+
+if __name__ == "__main__":
+    x = mx.nd.array(np.linspace(-2, 2, 5).astype(np.float32))
+    print("x        =", x.asnumpy())
+    print("sigmoid  =", mx.th.sigmoid(x).asnumpy())
+    print("tanh     =", mx.th.tanh(x).asnumpy())
+    print("erf      =", mx.th.erf(x).asnumpy())
+
+    a = mx.nd.array(np.arange(6.0, dtype=np.float32).reshape(2, 3))
+    b = mx.nd.array(np.arange(6.0, dtype=np.float32).reshape(3, 2))
+    print("matmul   =\n", mx.th.matmul(a, b).asnumpy())
+    u, s, v = mx.th.svd(a)
+    print("svd s    =", s.asnumpy())

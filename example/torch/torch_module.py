@@ -1,0 +1,58 @@
+#!/usr/bin/env python
+"""Train a net whose hidden layers are torch.nn modules.
+
+Reference: ``example/torch/torch_module.py`` — MNIST MLP built from
+``mx.symbol.TorchModule`` layers (there Lua-Torch; here PyTorch-CPU run as
+host ops inside the traced graph, trained by this framework's optimizer).
+"""
+
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                "image-classification"))
+
+import mxnet_tpu as mx  # noqa: E402
+from common import data as exdata  # noqa: E402
+
+if __name__ == "__main__":
+    logging.basicConfig(level=logging.INFO)
+    parser = argparse.ArgumentParser(description="TorchModule MLP on MNIST")
+    parser.add_argument("--data-dir", type=str, default="data")
+    parser.add_argument("--batch-size", type=int, default=100)
+    parser.add_argument("--num-epochs", type=int, default=3)
+    parser.add_argument("--lr", type=float, default=0.1)
+    args = parser.parse_args()
+
+    paths = exdata.synth_mnist(args.data_dir)
+    train = mx.io.MNISTIter(image=paths["train_img"],
+                            label=paths["train_lab"],
+                            batch_size=args.batch_size, shuffle=True,
+                            flat=True)
+    val = mx.io.MNISTIter(image=paths["val_img"], label=paths["val_lab"],
+                          batch_size=args.batch_size, flat=True)
+
+    data = mx.sym.Variable("data")
+    h = mx.sym.TorchModule(data, lua_string="nn.Linear(784, 128)",
+                           num_data=1, name="t1")
+    h = mx.sym.Activation(h, act_type="relu")
+    h = mx.sym.TorchModule(h, lua_string="nn.Linear(128, 64)",
+                           num_data=1, name="t2")
+    h = mx.sym.Activation(h, act_type="relu")
+    h = mx.sym.FullyConnected(h, num_hidden=10, name="fc3")
+    net = mx.sym.SoftmaxOutput(h, name="softmax")
+
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.fit(train, eval_data=val, num_epoch=args.num_epochs,
+            optimizer="sgd",
+            optimizer_params={"learning_rate": args.lr, "momentum": 0.9},
+            initializer=mx.init.Xavier(),
+            batch_end_callback=mx.callback.Speedometer(args.batch_size, 20))
+
+    metric = mx.metric.Accuracy()
+    val.reset()
+    mod.score(val, metric)
+    logging.info("final validation %s=%f", *metric.get())

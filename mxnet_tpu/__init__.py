@@ -56,6 +56,8 @@ from . import operator
 from . import predict
 from . import profiler
 from . import rtc
+from . import torch_bridge
+from .torch_bridge import th
 from . import visualization
 from . import test_utils
 

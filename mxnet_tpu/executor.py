@@ -318,7 +318,9 @@ class Executor:
                 dst[:] = v
         args = [a._jx for a in self.arg_arrays]
         aux = [a._jx for a in self.aux_arrays]
-        rng = _random.next_key()
+        # rng must live on the executor's device: jit rejects mixed-device
+        # args (e.g. cpu-bound module on a machine whose default is TPU)
+        rng = jax.device_put(_random.next_key(), self._ctx.jax_device())
         self._rng_step += 1
         from . import profiler as _profiler
 
@@ -362,8 +364,10 @@ class Executor:
         else:
             if isinstance(out_grads, NDArray):
                 out_grads = [out_grads]
-            out_grads = [g._jx if isinstance(g, NDArray) else jnp.asarray(g)
-                         for g in out_grads]
+            dev = self._ctx.jax_device()
+            out_grads = [jax.device_put(
+                g._jx if isinstance(g, NDArray) else jnp.asarray(g), dev)
+                for g in out_grads]
             args, aux, rng = self._last_state
             from . import profiler as _profiler
 

@@ -16,7 +16,7 @@ from .base import Registry
 
 __all__ = ["InitDesc", "Initializer", "Uniform", "Normal", "Zero", "One",
            "Constant", "Orthogonal", "Xavier", "MSRAPrelu", "Bilinear",
-           "LSTMBias", "Load", "Mixed", "registry", "create"]
+           "LSTMBias", "FusedRNN", "Load", "Mixed", "registry", "create"]
 
 registry = Registry("initializer")
 
@@ -59,6 +59,9 @@ class Initializer:
             self._init_beta(desc, arr)
         elif name.endswith("weight"):
             self._init_weight(desc, arr)
+        elif name.endswith("state") or name.endswith("state_cell"):
+            # initial hidden/cell state arguments of the fused RNN op
+            self._init_zero(desc, arr)
         elif name.endswith("moving_mean") or name.endswith("running_mean"):
             self._init_zero(desc, arr)
         elif name.endswith("moving_var") or name.endswith("running_var"):
@@ -235,6 +238,81 @@ class LSTMBias(Initializer):
         arr[:] = v
 
     _init_bias = _init_weight
+
+
+@registry.register
+class FusedRNN(Initializer):
+    """Initialize a fused RNN parameter blob (reference initializer.py
+    ``FusedRNN``): each packed weight matrix gets ``init``, biases get zero,
+    and LSTM forget-gate biases get ``forget_bias``."""
+
+    def __init__(self, init, num_hidden, num_layers, mode,
+                 bidirectional=False, forget_bias=1.0):
+        if isinstance(init, str):
+            klass, kwargs = json.loads(init)
+            init = registry.create(klass, **kwargs)
+        super().__init__(init=init.dumps() if init is not None else None,
+                         num_hidden=num_hidden, num_layers=num_layers,
+                         mode=mode, bidirectional=bidirectional,
+                         forget_bias=forget_bias)
+        self._init = init
+        self._num_hidden = num_hidden
+        self._num_layers = num_layers
+        self._mode = mode
+        self._bidirectional = bidirectional
+        self._forget_bias = forget_bias
+
+    def _init_weight(self, desc, arr):
+        from .ops.rnn import _GATES, _layer_param_slices, rnn_param_size
+
+        inner = self._init
+        if inner is None:
+            # fall back to the surrounding global initializer (reference
+            # FusedRNN does the same via desc.global_init)
+            inner = getattr(desc, "global_init", None) or Uniform(0.07)
+        h, L, mode = self._num_hidden, self._num_layers, self._mode
+        d = 2 if self._bidirectional else 1
+        # recover input_size from the blob length (layer-0 is the only
+        # layer whose width depends on it)
+        total = arr.shape[0]
+        rest = rnn_param_size(0, h, L, mode, self._bidirectional)
+        g = _GATES[mode]
+        input_size = (total - rest) // (d * g * h)
+        blob = np.zeros(total, dtype=np.float32)
+        for _layer, _direction, sl in _layer_param_slices(
+                input_size, h, L, mode, self._bidirectional):
+            for key in ("wx", "wh"):
+                off, shape = sl[key]
+                n = int(np.prod(shape))
+                mat = np.zeros(shape, dtype=np.float32)
+                inner._init_weight(desc, _NumpySlot(mat))
+                blob[off:off + n] = mat.reshape(-1)
+            for key in ("bx", "bh"):
+                off, (n,) = sl[key]
+                if mode == "lstm":
+                    b = np.zeros(n, dtype=np.float32)
+                    b[h:2 * h] = self._forget_bias
+                    blob[off:off + n] = b
+        arr[:] = blob
+
+    _init_default = _init_weight
+
+
+class _NumpySlot:
+    """Adapter so Initializer._init_weight (which assigns ``arr[:]``) can
+    fill a plain numpy array."""
+
+    def __init__(self, arr):
+        self._arr = arr
+
+    @property
+    def shape(self):
+        return self._arr.shape
+
+    def __setitem__(self, key, value):
+        np_val = value.asnumpy() if hasattr(value, "asnumpy") \
+            else np.asarray(value)
+        self._arr[key] = np_val
 
 
 @registry.register

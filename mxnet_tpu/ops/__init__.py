@@ -21,6 +21,7 @@ from . import contrib  # noqa: F401
 from . import attention  # noqa: F401
 from . import custom  # noqa: F401
 from . import legacy  # noqa: F401
+from . import torch_op  # noqa: F401
 from . import infer  # noqa: F401  (attaches backward shape-inference rules)
 
 __all__ = ["registry", "OpDef", "get", "list_ops", "register"]

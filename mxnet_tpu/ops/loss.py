@@ -75,14 +75,15 @@ def _softmax_output_fn(grad_scale, ignore_label, multi_output, use_ignore,
         p, label = res
         lab = label.astype(jnp.int32)
         axis = 1 if multi_output else (p.ndim - 1)
+        if multi_output:
+            # reference semantics: data is (n, k, x...) with label (n, x...)
+            # — accept the label flattened (n, prod(x)) too
+            lab = lab.reshape((p.shape[0],) + tuple(p.shape[2:]))
         onehot = jax.nn.one_hot(lab, p.shape[axis], dtype=p.dtype, axis=axis)
         grad = p - onehot
-        valid = jnp.ones_like(label, dtype=p.dtype)
+        valid = jnp.ones_like(lab, dtype=p.dtype)
         if use_ignore:
-            valid = (label != ignore_label).astype(p.dtype)
-            vshape = list(label.shape)
-            vshape.insert(axis, 1) if multi_output or p.ndim != label.ndim + 1 \
-                else None
+            valid = (lab != ignore_label).astype(p.dtype)
             grad = grad * jnp.expand_dims(valid, axis)
         scale = grad_scale
         if normalization == "batch":

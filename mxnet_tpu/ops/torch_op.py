@@ -1,0 +1,231 @@
+"""Torch interop ops — the ``plugin/torch`` analog.
+
+Reference: ``plugin/torch/torch_module-inl.h`` / ``torch_criterion-inl.h``
+register ``TorchModule``/``TorchCriterion`` ops whose ``lua_string`` attr
+names a (Lua)Torch module; its parameters become learnable graph arguments
+and forward/backward dispatch into the Torch runtime.
+
+TPU-native: the attr holds a **PyTorch** module expression (e.g.
+``"nn.Linear(4, 3)"`` — evaluated with ``nn``/``torch`` in scope, the same
+user-authored-code trust model as the reference's Lua string).  The module
+runs on the host CPU via ``jax.pure_callback`` (like ``Custom`` ops), its
+parameters are exposed as graph arguments so the framework's optimizers
+train them, and backward routes through torch autograd via
+``jax.custom_vjp``.  Composes with jit and the fused executor graph.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from .registry import REQUIRED, pint, pstr, register
+
+
+def _torch():
+    try:
+        import torch
+    except ImportError as e:  # pragma: no cover - torch is baked in
+        raise MXNetError("TorchModule requires pytorch") from e
+    return torch
+
+
+_MODULE_CACHE = {}  # expr string -> instantiated torch.nn.Module
+
+
+def _build(expr):
+    if expr not in _MODULE_CACHE:
+        torch = _torch()
+        import torch.nn as nn
+
+        mod = eval(expr, {"nn": nn, "torch": torch})  # noqa: S307
+        if not isinstance(mod, nn.Module):
+            raise MXNetError(
+                "TorchModule: %r did not evaluate to a torch.nn.Module" % expr)
+        _MODULE_CACHE[expr] = mod.eval().float()
+    return _MODULE_CACHE[expr]
+
+
+def _param_items(mod):
+    return [(n, p) for n, p in mod.named_parameters()]
+
+
+def _module_arguments(attrs):
+    mod = _build(attrs["lua_string"])
+    n_data = attrs["num_data"]
+    return ["data_%d" % i for i in range(n_data)] + \
+        ["param_%s" % n.replace(".", "_") for n, _ in _param_items(mod)]
+
+
+def _run_functional(mod, names, param_tensors, data_tensors, is_train=False):
+    from torch.func import functional_call
+
+    pdict = {n: t for n, t in zip(names, param_tensors)}
+    # detached buffer copies keep the call pure: train-mode modules (BN)
+    # mutate the copies, never the cached module — torch aux state is not
+    # tracked into the graph and stays at its init statistics
+    pdict.update({n: b.detach().clone() for n, b in mod.named_buffers()})
+    # honor train/eval mode (dropout etc.)
+    was_training = mod.training
+    mod.train(bool(is_train))
+    try:
+        out = functional_call(mod, pdict, tuple(data_tensors))
+    finally:
+        mod.train(was_training)
+    return list(out) if isinstance(out, (tuple, list)) else [out]
+
+
+def _torch_module_apply(attrs, inputs, aux, is_train, rng):
+    torch = _torch()
+    mod = _build(attrs["lua_string"])
+    n_data = attrs["num_data"]
+    n_out = attrs["num_outputs"]
+    names = [n for n, _ in _param_items(mod)]
+    if attrs["num_params"] >= 0 and attrs["num_params"] != len(names):
+        raise MXNetError(
+            "TorchModule %r: num_params=%d but module has %d parameters"
+            % (attrs["lua_string"], attrs["num_params"], len(names)))
+    in_specs = [jax.ShapeDtypeStruct(tuple(x.shape), jnp.float32)
+                for x in inputs]
+
+    # output shapes: run the torch module once on host zeros at trace time
+    with torch.no_grad():
+        dummy = [torch.zeros(tuple(x.shape)) for x in inputs[:n_data]]
+        params = [torch.zeros(tuple(x.shape)) for x in inputs[n_data:]]
+        douts = _run_functional(mod, names, params, dummy)
+    if len(douts) != n_out:
+        raise MXNetError("TorchModule %r: produced %d outputs, declared "
+                         "num_outputs=%d" % (attrs["lua_string"], len(douts),
+                                             n_out))
+    out_specs = [jax.ShapeDtypeStruct(tuple(o.shape), jnp.float32)
+                 for o in douts]
+
+    def host_forward(seed, *tensors):
+        # same torch seed in forward and backward: stochastic modules
+        # (dropout) draw identical masks in both passes
+        torch.manual_seed(int(np.asarray(seed).ravel()[0]))
+        with torch.no_grad():
+            data = [torch.from_numpy(np.array(t, np.float32))
+                    for t in tensors[:n_data]]
+            ps = [torch.from_numpy(np.array(t, np.float32))
+                  for t in tensors[n_data:]]
+            outs = _run_functional(mod, names, ps, data, is_train)
+        return tuple(o.numpy() for o in outs)
+
+    def host_backward(seed, *tensors):
+        torch.manual_seed(int(np.asarray(seed).ravel()[0]))
+        cots = tensors[:n_out]
+        data = [torch.from_numpy(np.array(t, np.float32))
+                .requires_grad_(True) for t in tensors[n_out:n_out + n_data]]
+        ps = [torch.from_numpy(np.array(t, np.float32))
+              .requires_grad_(True) for t in tensors[n_out + n_data:]]
+        outs = _run_functional(mod, names, ps, data, is_train=is_train)
+        torch.autograd.backward(
+            outs, [torch.from_numpy(np.array(c, np.float32))
+                   for c in cots])
+        return tuple((x.grad if x.grad is not None
+                      else torch.zeros_like(x)).numpy() for x in data + ps)
+
+    @jax.custom_vjp
+    def run(seed, ins):
+        res = jax.pure_callback(host_forward, tuple(out_specs), seed, *ins)
+        return list(res)
+
+    def run_fwd(seed, ins):
+        return run(seed, ins), (seed, ins)
+
+    def run_bwd(resid, cots):
+        seed, ins = resid
+        grads = jax.pure_callback(host_backward, tuple(in_specs),
+                                  seed, *cots, *ins)
+        return (jnp.zeros_like(seed), list(grads))
+
+    run.defvjp(run_fwd, run_bwd)
+    f32 = [x.astype(jnp.float32) for x in inputs]
+    seed = (rng if rng is not None else jnp.zeros(2, jnp.uint32))
+    return [o.astype(inputs[0].dtype) for o in run(seed, f32)]
+
+
+register(
+    "TorchModule", _torch_module_apply,
+    arguments=_module_arguments,
+    outputs=lambda attrs: ["output_%d" % i
+                           for i in range(attrs["num_outputs"])],
+    params={"lua_string": (pstr, REQUIRED), "num_data": (pint, 1),
+            "num_params": (pint, -1), "num_outputs": (pint, 1)},
+    needs_rng=True,
+    doc="Run a torch.nn module as a graph op "
+        "(reference plugin/torch/torch_module-inl.h)",
+)
+
+
+def _torch_criterion_apply(attrs, inputs, aux, is_train, rng):
+    torch = _torch()
+    crit = _build(attrs["lua_string"])
+    data_spec = jax.ShapeDtypeStruct(tuple(inputs[0].shape), jnp.float32)
+
+    # loss shape at trace time from a dummy run — scalar criteria give (1,),
+    # reduction='none' criteria keep their per-element shape
+    with torch.no_grad():
+        dummy = crit(torch.zeros(tuple(inputs[0].shape)),
+                     torch.zeros(tuple(inputs[1].shape)))
+    out_shape = tuple(dummy.shape) if dummy.dim() > 0 else (1,)
+    out_spec = jax.ShapeDtypeStruct(out_shape, jnp.float32)
+
+    def host_forward(d, l):
+        with torch.no_grad():
+            loss = crit(torch.from_numpy(np.array(d, np.float32)),
+                        torch.from_numpy(np.array(l, np.float32)))
+        return np.asarray(loss.numpy(), np.float32).reshape(out_shape)
+
+    def host_backward(cot, d, l):
+        dt = torch.from_numpy(
+            np.array(d, np.float32)).requires_grad_(True)
+        loss = crit(dt, torch.from_numpy(np.array(l, np.float32)))
+        loss.backward(torch.from_numpy(np.array(cot, np.float32))
+                      .reshape(tuple(loss.shape)))
+        return dt.grad.numpy()
+
+    @jax.custom_vjp
+    def run(d, l):
+        return jax.pure_callback(host_forward, out_spec, d, l)
+
+    def run_fwd(d, l):
+        return run(d, l), (d, l)
+
+    def run_bwd(resid, cot):
+        d, l = resid
+        g = jax.pure_callback(host_backward, data_spec, cot, d, l)
+        return (g, jnp.zeros_like(l))
+
+    run.defvjp(run_fwd, run_bwd)
+    out = run(inputs[0].astype(jnp.float32), inputs[1].astype(jnp.float32))
+    return [out.astype(inputs[0].dtype)]
+
+
+register(
+    "TorchCriterion", _torch_criterion_apply,
+    arguments=("data", "label"),
+    params={"lua_string": (pstr, REQUIRED)},
+    doc="Torch loss module as a graph op "
+        "(reference plugin/torch/torch_criterion-inl.h)",
+)
+
+
+# backward (argument) shape inference: parameter shapes come from the torch
+# module itself, so simple_bind works with only the data shape given
+def _torch_module_infer(attrs, ins, dts, auxs):
+    mod = _build(attrs["lua_string"])
+    n_data = attrs["num_data"]
+    for i, (_, p) in enumerate(_param_items(mod)):
+        if ins[n_data + i] is None:
+            ins[n_data + i] = tuple(p.shape)
+    return ins, auxs
+
+
+from .registry import get  # noqa: E402
+
+get("TorchModule").infer_inputs = _torch_module_infer

@@ -1,0 +1,59 @@
+"""Imperative torch-function bridge — the ``mx.th`` namespace.
+
+Reference: ``python/mxnet/torch.py`` generates one python function per
+registered (Lua)Torch tensor function so users can call torch math on
+NDArrays (``mx.th.sigmoid(x)`` etc.).
+
+TPU-native: PyTorch-CPU is the host math library; any ``torch.<fn>`` is
+reachable by name, NDArray arguments round-trip through host memory.  This
+is a *host* path (like the reference, where torch ran outside the MXNet
+engine's device stream) — use graph ops for anything performance-critical.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .base import MXNetError
+from .ndarray import NDArray, array
+
+__all__ = ["TorchBridge", "th"]
+
+
+class TorchBridge:
+    """Attribute access resolves torch functions lazily:
+    ``th.sigmoid(nd_array)`` -> ``torch.sigmoid`` on host, NDArray out."""
+
+    def __getattr__(self, fn_name):
+        try:
+            import torch
+        except ImportError as e:  # pragma: no cover - torch is baked in
+            raise MXNetError("mx.th requires pytorch") from e
+        fn = getattr(torch, fn_name, None)
+        if fn is None or not callable(fn):
+            raise AttributeError("torch has no function %r" % fn_name)
+
+        def wrapper(*args, **kwargs):
+            def conv(a):
+                if isinstance(a, NDArray):
+                    # copy: jax exports read-only buffers, torch wants writable
+                    return torch.from_numpy(np.array(a.asnumpy()))
+                return a
+
+            res = fn(*[conv(a) for a in args],
+                     **{k: conv(v) for k, v in kwargs.items()})
+
+            def back(r):
+                if isinstance(r, torch.Tensor):
+                    return array(np.ascontiguousarray(r.numpy()))
+                return r
+
+            if isinstance(res, (tuple, list)):
+                return type(res)(back(r) for r in res)
+            return back(res)
+
+        wrapper.__name__ = fn_name
+        return wrapper
+
+
+th = TorchBridge()

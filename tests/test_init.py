@@ -1,0 +1,59 @@
+"""Initializer tests (reference ``tests/python/unittest/test_init.py``)."""
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def test_default_init_patterns():
+    init = mx.init.Xavier()
+    w = nd.zeros((8, 4))
+    init("fc_weight", w)
+    assert np.abs(w.asnumpy()).sum() > 0
+    b = nd.ones((8,))
+    init("fc_bias", b)
+    assert (b.asnumpy() == 0).all()
+    g = nd.zeros((8,))
+    init("bn_gamma", g)
+    assert (g.asnumpy() == 1).all()
+    # fused-RNN state args are zero-initialized
+    s = nd.ones((2, 3, 4))
+    init("lstm_state", s)
+    assert (s.asnumpy() == 0).all()
+    s2 = nd.ones((2, 3, 4))
+    init("lstm_state_cell", s2)
+    assert (s2.asnumpy() == 0).all()
+
+
+def test_fused_rnn_initializer():
+    """FusedRNN fills weights via the inner init and sets LSTM forget-gate
+    biases (reference initializer.py FusedRNN)."""
+    from mxnet_tpu.ops.rnn import _layer_param_slices, rnn_param_size
+
+    H, L, I = 8, 2, 5
+    n = rnn_param_size(I, H, L, "lstm")
+    arr = nd.zeros((n,))
+    mx.init.FusedRNN(mx.init.Xavier(), num_hidden=H, num_layers=L,
+                     mode="lstm", forget_bias=2.0)("lstm_parameters", arr)
+    v = arr.asnumpy()
+    layout = _layer_param_slices(I, H, L, "lstm", False)
+    for _layer, _direction, sl in layout:
+        off, shape = sl["wx"]
+        w = v[off:off + int(np.prod(shape))]
+        assert np.abs(w).sum() > 0, "weights not initialized"
+        boff, (bn,) = sl["bx"]
+        b = v[boff:boff + bn]
+        assert (b[H:2 * H] == 2.0).all(), "forget bias not set"
+        assert (b[:H] == 0.0).all()
+
+
+def test_mixed_initializer():
+    init = mx.init.Mixed([".*special.*", ".*"],
+                         [mx.init.One(), mx.init.Constant(3.0)])
+    a = nd.zeros((4,))
+    init("my_special_weight", a)
+    assert (a.asnumpy() == 1).all()
+    b = nd.zeros((4,))
+    init("other_weight", b)
+    assert (b.asnumpy() == 3).all()

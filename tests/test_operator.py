@@ -335,3 +335,31 @@ def test_ctc_loss():
     ex.backward()
     g = ex.grad_dict["data"].asnumpy()
     assert np.isfinite(g).all() and np.abs(g).sum() > 0
+
+
+def test_softmax_output_multi_output_grad():
+    """multi_output: data (n,k,x...), label (n,x...) or flattened (n,prod) —
+    gradient is softmax - onehot laid out over axis 1 (softmax_output-inl.h)."""
+    B, C, H, W = 2, 3, 4, 4
+    rs = np.random.RandomState(3)
+    dval = rs.rand(B, C, H, W).astype(np.float32)
+    lval = rs.randint(0, C, (B, H * W)).astype(np.float32)
+    d, l = sym.Variable("data"), sym.Variable("label")
+    s = sym.SoftmaxOutput(d, l, multi_output=True)
+    ex = s.simple_bind(mx.cpu(), data=(B, C, H, W), label=(B, H * W))
+    ex.arg_dict["data"][:] = dval
+    ex.arg_dict["label"][:] = lval
+    out = ex.forward(is_train=True)[0].asnumpy()
+    ex.backward()
+    g = ex.grad_dict["data"].asnumpy()
+    # numpy reference
+    e = np.exp(dval - dval.max(1, keepdims=True))
+    p = e / e.sum(1, keepdims=True)
+    onehot = np.zeros_like(p)
+    lab = lval.reshape(B, H, W).astype(int)
+    for b in range(B):
+        for i in range(H):
+            for j in range(W):
+                onehot[b, lab[b, i, j], i, j] = 1.0
+    assert_almost_equal(out, p, rtol=1e-5, atol=1e-6)
+    assert_almost_equal(g, p - onehot, rtol=1e-5, atol=1e-6)

@@ -1,0 +1,90 @@
+"""Torch interop (reference ``plugin/torch`` + ``python/mxnet/torch.py``).
+
+``TorchModule``/``TorchCriterion`` graph ops run torch-CPU modules inside
+the traced graph (params trainable by our optimizers), and ``mx.th`` is the
+imperative torch-function bridge.
+"""
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_th_imperative_bridge():
+    x = mx.nd.array(np.array([[-1.0, 0.0, 2.0]], np.float32))
+    y = mx.th.sigmoid(x)
+    assert_almost_equal(y, 1.0 / (1.0 + np.exp(-x.asnumpy())))
+    a = mx.nd.array(np.arange(6.0, dtype=np.float32).reshape(2, 3))
+    b = mx.nd.array(np.arange(6.0, dtype=np.float32).reshape(3, 2))
+    assert_almost_equal(mx.th.matmul(a, b), a.asnumpy() @ b.asnumpy())
+
+
+def test_torch_module_forward_matches_torch():
+    import torch
+    import torch.nn as nn
+
+    data = mx.sym.Variable("data")
+    net = mx.sym.TorchModule(data, lua_string="nn.Linear(4, 3)",
+                             num_data=1, num_outputs=1, name="tl")
+    ex = net.simple_bind(mx.cpu(), data=(2, 4))
+    rs = np.random.RandomState(0)
+    vals = {n: rs.rand(*a.shape).astype(np.float32)
+            for n, a in ex.arg_dict.items()}
+    for n, a in ex.arg_dict.items():
+        a[:] = mx.nd.array(vals[n])
+    out = ex.forward(is_train=False)[0].asnumpy()
+
+    ref_mod = nn.Linear(4, 3)
+    with torch.no_grad():
+        ref_mod.weight.copy_(torch.from_numpy(vals["tl_param_weight"]))
+        ref_mod.bias.copy_(torch.from_numpy(vals["tl_param_bias"]))
+        ref = ref_mod(torch.from_numpy(vals["data"])).numpy()
+    assert_almost_equal(out, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_torch_module_trains():
+    """A TorchModule layer learns under our SGD like any native layer."""
+    rs = np.random.RandomState(0)
+    x = rs.rand(64, 4).astype(np.float32)
+    w_true = rs.rand(4, 1).astype(np.float32)
+    y = (x @ w_true > 0.5).astype(np.float32).reshape(-1)
+
+    data = mx.sym.Variable("data")
+    h = mx.sym.TorchModule(data, lua_string="nn.Linear(4, 8)", num_data=1,
+                           name="l1")
+    h = mx.sym.Activation(h, act_type="relu")
+    h = mx.sym.FullyConnected(h, num_hidden=2, name="fc")
+    net = mx.sym.SoftmaxOutput(h, name="softmax")
+
+    mod = mx.mod.Module(net, context=mx.cpu())
+    it = mx.io.NDArrayIter(x, y, batch_size=16, label_name="softmax_label")
+    mod.fit(it, num_epoch=8, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.5},
+            eval_metric="acc",
+            initializer=mx.init.Xavier())
+    it.reset()
+    m = mx.metric.Accuracy()
+    mod.score(it, m)
+    assert m.get()[1] > 0.8, m.get()
+
+
+def test_torch_criterion():
+    import torch
+
+    d = mx.sym.Variable("data")
+    l = mx.sym.Variable("label")
+    loss = mx.sym.TorchCriterion(d, l, lua_string="nn.MSELoss()")
+    ex = loss.simple_bind(mx.cpu(), data=(3, 2), label=(3, 2),
+                          grad_req="write")
+    rs = np.random.RandomState(1)
+    dv = rs.rand(3, 2).astype(np.float32)
+    lv = rs.rand(3, 2).astype(np.float32)
+    ex.arg_dict["data"][:] = mx.nd.array(dv)
+    ex.arg_dict["label"][:] = mx.nd.array(lv)
+    out = ex.forward(is_train=True)[0].asnumpy()
+    assert_almost_equal(out, np.array([((dv - lv) ** 2).mean()]),
+                        rtol=1e-5, atol=1e-6)
+    ex.backward()
+    g = ex.grad_dict["data"].asnumpy()
+    assert_almost_equal(g, 2.0 * (dv - lv) / dv.size, rtol=1e-5, atol=1e-6)
