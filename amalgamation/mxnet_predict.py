@@ -1,0 +1,381 @@
+"""Standalone predict-only runtime — the amalgamation analog.
+
+Reference: ``amalgamation/`` concatenates a predict-only MXNet build into
+one ``.cc`` (plus ``python/mxnet_predict.py``) for Android/iOS/JS deploys,
+forcing the NaiveEngine (``src/engine/engine.cc:20-29``,
+``MXNET_PREDICT_ONLY``).
+
+TPU-framework analog: inference escapes the accelerator entirely — this
+module is a **numpy-only interpreter** for saved symbol JSON + params, with
+zero dependency on jax/XLA or the rest of the package.  ``amalgamation.py``
+inlines this file together with an embedded checkpoint into ONE ``.py`` you
+can ship anywhere numpy runs (the mobile/JS-deploy equivalent).  Keep this
+file import-clean: **numpy only**.
+"""
+
+import base64
+import io
+import json
+import zlib
+
+import numpy as np
+
+
+# ---------------------------------------------------------------------------
+# attr parsing (mirrors the string attrs stored in graph JSON)
+# ---------------------------------------------------------------------------
+
+def _pt(v, default=()):
+    """'(2, 2)' -> (2, 2); '()' -> default."""
+    if v is None:
+        return default
+    if isinstance(v, (tuple, list)):
+        return tuple(int(x) for x in v)
+    v = str(v).strip()
+    if v in ("()", "[]", "None", ""):
+        return default
+    t = tuple(int(x) for x in v.strip("()[]").replace(",", " ").split())
+    return t if t else default
+
+
+def _pb(v):
+    return str(v).strip().lower() in ("true", "1", "yes")
+
+
+def _pi(v, default=0):
+    return default if v in (None, "None") else int(v)
+
+
+def _pf(v, default=0.0):
+    return default if v in (None, "None") else float(v)
+
+
+# ---------------------------------------------------------------------------
+# numpy kernels (inference semantics only)
+# ---------------------------------------------------------------------------
+
+def _pad4(x, ph, pw):
+    if ph == 0 and pw == 0:
+        return x
+    return np.pad(x, ((0, 0), (0, 0), (ph, ph), (pw, pw)))
+
+
+def _im2col(x, kh, kw, sh, sw, dh, dw):
+    n, c, h, w = x.shape
+    oh = (h - (kh - 1) * dh - 1) // sh + 1
+    ow = (w - (kw - 1) * dw - 1) // sw + 1
+    cols = np.empty((n, c, kh, kw, oh, ow), x.dtype)
+    for i in range(kh):
+        for j in range(kw):
+            cols[:, :, i, j] = x[:, :, i * dh:i * dh + sh * oh:sh,
+                                 j * dw:j * dw + sw * ow:sw]
+    return cols.reshape(n, c * kh * kw, oh * ow), oh, ow
+
+
+def _conv(attrs, x, w, b=None):
+    kh, kw = _pt(attrs.get("kernel"))
+    sh, sw = _pt(attrs.get("stride"), (1, 1)) or (1, 1)
+    ph, pw = _pt(attrs.get("pad"), (0, 0)) or (0, 0)
+    dh, dw = _pt(attrs.get("dilate"), (1, 1)) or (1, 1)
+    groups = _pi(attrs.get("num_group"), 1)
+    x = _pad4(x, ph, pw)
+    n, c, _, _ = x.shape
+    oc = w.shape[0]
+    outs = []
+    for g in range(groups):
+        xg = x[:, g * (c // groups):(g + 1) * (c // groups)]
+        wg = w[g * (oc // groups):(g + 1) * (oc // groups)]
+        cols, oh, ow = _im2col(xg, kh, kw, sh, sw, dh, dw)
+        res = np.einsum("ok,nkp->nop", wg.reshape(wg.shape[0], -1), cols)
+        outs.append(res.reshape(n, -1, oh, ow))
+    out = np.concatenate(outs, axis=1)
+    if b is not None:
+        out = out + b.reshape(1, -1, 1, 1)
+    return out
+
+
+def _pool(attrs, x):
+    global_pool = _pb(attrs.get("global_pool", "False"))
+    mode = str(attrs.get("pool_type", "max"))
+    if global_pool:
+        red = x.max(axis=(2, 3)) if mode == "max" else x.mean(axis=(2, 3))
+        return red[:, :, None, None]
+    kh, kw = _pt(attrs.get("kernel"))
+    sh, sw = _pt(attrs.get("stride"), (1, 1)) or (1, 1)
+    ph, pw = _pt(attrs.get("pad"), (0, 0)) or (0, 0)
+    # output dims per convention; 'full' (ceil) needs extra right-pad,
+    # mirroring the framework's _pooling (ops/nn.py)
+    full = str(attrs.get("pooling_convention", "valid")) == "full"
+    h, w = x.shape[2], x.shape[3]
+
+    def _odim(size, k, s, p):
+        num = size + 2 * p - k
+        return (-(-num // s) if full else num // s) + 1
+
+    oh, ow = _odim(h, kh, sh, ph), _odim(w, kw, sw, pw)
+    eh = max((oh - 1) * sh + kh - h - ph, ph)
+    ew = max((ow - 1) * sw + kw - w - pw, pw)
+    fill = -np.inf if mode == "max" else 0.0
+    xp = np.pad(x, ((0, 0), (0, 0), (ph, eh), (pw, ew)),
+                constant_values=fill)
+    n, c = xp.shape[:2]
+    win = np.empty((n, c, kh * kw, oh, ow), x.dtype)
+    k = 0
+    for i in range(kh):
+        for j in range(kw):
+            win[:, :, k] = xp[:, :, i:i + sh * oh:sh, j:j + sw * ow:sw]
+            k += 1
+    if mode == "max":
+        return win.max(2)
+    if mode == "sum":
+        return win.sum(2)
+    # avg divides by the full window incl. padding (mshadow pool semantics)
+    return win.mean(2)
+
+
+def _bn(attrs, x, gamma, beta, mean, var):
+    eps = _pf(attrs.get("eps"), 1e-3)
+    if _pb(attrs.get("fix_gamma", "True")):
+        gamma = np.ones_like(gamma)
+    shape = (1, -1) + (1,) * (x.ndim - 2)
+    return (x - mean.reshape(shape)) / np.sqrt(var.reshape(shape) + eps) \
+        * gamma.reshape(shape) + beta.reshape(shape)
+
+
+def _fc(attrs, x, w, b=None):
+    if _pb(attrs.get("flatten", "True")):
+        x = x.reshape(x.shape[0], -1)
+    out = x @ w.T
+    if b is not None:
+        out = out + b
+    return out
+
+
+def _leaky_relu(attrs, ins):
+    x = ins[0]
+    t = str(attrs.get("act_type", "leaky"))
+    if t == "leaky":
+        return np.where(x > 0, x, _pf(attrs.get("slope"), 0.25) * x)
+    if t == "elu":
+        return np.where(x > 0, x, _pf(attrs.get("slope"), 0.25)
+                        * np.expm1(x))
+    if t == "prelu":
+        gamma = ins[1].reshape((1, -1) + (1,) * (x.ndim - 2))
+        return np.where(x > 0, x, gamma * x)
+    if t == "rrelu":
+        # inference: midpoint slope
+        slope = (_pf(attrs.get("lower_bound"), 0.125)
+                 + _pf(attrs.get("upper_bound"), 0.334)) / 2.0
+        return np.where(x > 0, x, slope * x)
+    raise ValueError("LeakyReLU act_type %r" % t)
+
+
+def _act(attrs, x):
+    t = str(attrs.get("act_type", "relu"))
+    if t == "relu":
+        return np.maximum(x, 0)
+    if t == "sigmoid":
+        return 1.0 / (1.0 + np.exp(-x))
+    if t == "tanh":
+        return np.tanh(x)
+    if t == "softrelu":
+        return np.log1p(np.exp(-np.abs(x))) + np.maximum(x, 0)
+    raise ValueError("act_type %r" % t)
+
+
+def _softmax(x, axis=-1):
+    e = np.exp(x - x.max(axis=axis, keepdims=True))
+    return e / e.sum(axis=axis, keepdims=True)
+
+
+def _softmax_output(attrs, x):
+    if _pb(attrs.get("multi_output", "False")):
+        return _softmax(x, axis=1)
+    return _softmax(x.reshape(x.shape[0], -1), axis=-1).reshape(x.shape)
+
+
+def _reshape(attrs, x):
+    shape = _pt(attrs.get("shape"))
+    out, src = [], list(x.shape)
+    i = 0
+    for s in shape:
+        if s == 0:
+            out.append(src[i]); i += 1
+        elif s == -1:
+            out.append(-1); i += 1
+        elif s == -2:
+            out.extend(src[i:]); i = len(src)
+        else:
+            out.append(s); i += 1
+    return x.reshape(out)
+
+
+def _lrn(attrs, x):
+    alpha = _pf(attrs.get("alpha"), 1e-4)
+    beta = _pf(attrs.get("beta"), 0.75)
+    knorm = _pf(attrs.get("knorm"), 2.0)
+    size = _pi(attrs.get("nsize"), 5)
+    sq = x * x
+    c = x.shape[1]
+    acc = np.zeros_like(x)
+    half = size // 2
+    for i in range(c):
+        lo, hi = max(0, i - half), min(c, i + half + 1)
+        acc[:, i] = sq[:, lo:hi].sum(1)
+    return x / (knorm + alpha / size * acc) ** beta
+
+
+def _slice_channel(attrs, x):
+    n = _pi(attrs.get("num_outputs"), 1)
+    axis = _pi(attrs.get("axis"), 1)
+    outs = np.split(x, n, axis=axis)
+    if _pb(attrs.get("squeeze_axis", "False")):
+        outs = [np.squeeze(o, axis=axis) for o in outs]
+    return outs
+
+
+def _crop(attrs, *ins):
+    x = ins[0]
+    if _pi(attrs.get("num_args"), 1) == 2:
+        ch, cw = ins[1].shape[2], ins[1].shape[3]
+    else:
+        ch, cw = _pt(attrs.get("h_w"))
+    if _pb(attrs.get("center_crop", "False")):
+        oy = (x.shape[2] - ch) // 2
+        ox = (x.shape[3] - cw) // 2
+    else:
+        oy, ox = _pt(attrs.get("offset"), (0, 0))
+    return x[:, :, oy:oy + ch, ox:ox + cw]
+
+
+def _upsampling(attrs, *ins):
+    scale = _pi(attrs.get("scale"), 2)
+    if str(attrs.get("sample_type", "nearest")) != "nearest":
+        raise ValueError("amalgamation UpSampling supports nearest only")
+    x = ins[0]
+    return x.repeat(scale, axis=2).repeat(scale, axis=3)
+
+
+_OPS = {
+    "Convolution": lambda a, ins: _conv(a, *ins),
+    "FullyConnected": lambda a, ins: _fc(a, *ins),
+    "BatchNorm": lambda a, ins: _bn(a, *ins),
+    "Pooling": lambda a, ins: _pool(a, ins[0]),
+    "Activation": lambda a, ins: _act(a, ins[0]),
+    "LeakyReLU": lambda a, ins: _leaky_relu(a, ins),
+    "Dropout": lambda a, ins: ins[0],
+    "SoftmaxOutput": lambda a, ins: _softmax_output(a, ins[0]),
+    "Softmax": lambda a, ins: _softmax_output(a, ins[0]),
+    "SoftmaxActivation": lambda a, ins: _softmax(
+        ins[0], axis=1 if str(a.get("mode")) == "channel" else -1),
+    "softmax": lambda a, ins: _softmax(ins[0], axis=_pi(a.get("axis"), -1)),
+    "Flatten": lambda a, ins: ins[0].reshape(ins[0].shape[0], -1),
+    "Reshape": lambda a, ins: _reshape(a, ins[0]),
+    "Concat": lambda a, ins: np.concatenate(ins, axis=_pi(a.get("dim"), 1)),
+    "elemwise_add": lambda a, ins: ins[0] + ins[1],
+    "_plus": lambda a, ins: ins[0] + ins[1],
+    "elemwise_sub": lambda a, ins: ins[0] - ins[1],
+    "elemwise_mul": lambda a, ins: ins[0] * ins[1],
+    "add_n": lambda a, ins: sum(ins),
+    "ElementWiseSum": lambda a, ins: sum(ins),
+    "broadcast_add": lambda a, ins: ins[0] + ins[1],
+    "broadcast_mul": lambda a, ins: ins[0] * ins[1],
+    "LRN": lambda a, ins: _lrn(a, ins[0]),
+    "Embedding": lambda a, ins: ins[1][ins[0].astype(np.int64)],
+    "transpose": lambda a, ins: np.transpose(
+        ins[0], _pt(a.get("axes")) or None),
+    "expand_dims": lambda a, ins: np.expand_dims(ins[0], _pi(a.get("axis"))),
+    "clip": lambda a, ins: np.clip(ins[0], _pf(a.get("a_min")),
+                                   _pf(a.get("a_max"))),
+    "Cast": lambda a, ins: ins[0].astype(str(a.get("dtype", "float32"))),
+    "_copy": lambda a, ins: ins[0],
+    "BlockGrad": lambda a, ins: ins[0],
+    "identity": lambda a, ins: ins[0],
+    "_CrossDeviceCopy": lambda a, ins: ins[0],
+    "SliceChannel": _slice_channel,
+    "Crop": lambda a, ins: _crop(a, *ins),
+    "UpSampling": _upsampling,
+    "SwapAxis": lambda a, ins: np.swapaxes(ins[0], _pi(a.get("dim1")),
+                                           _pi(a.get("dim2"))),
+    "mean": lambda a, ins: ins[0].mean(
+        axis=_pt(a.get("axis")) or None,
+        keepdims=_pb(a.get("keepdims", "False"))),
+    "sum": lambda a, ins: ins[0].sum(
+        axis=_pt(a.get("axis")) or None,
+        keepdims=_pb(a.get("keepdims", "False"))),
+}
+
+
+class Predictor:
+    """Minimal predict API (reference ``c_predict_api.cc`` shape):
+    symbol JSON + params dict -> ``forward(data=...)`` -> outputs."""
+
+    def __init__(self, symbol_json, params):
+        graph = json.loads(symbol_json) \
+            if isinstance(symbol_json, str) else symbol_json
+        self.nodes = graph["nodes"]
+        self.heads = [tuple(h[:2]) for h in graph["heads"]]
+        self.params = dict(params)
+
+    @classmethod
+    def from_checkpoint_bytes(cls, symbol_json, param_blob):
+        """param_blob: the .params file bytes (npz with arg:/aux: keys)."""
+        with np.load(io.BytesIO(param_blob)) as z:
+            params = {}
+            for k in z.files:
+                name = k.split(":", 1)[1] if ":" in k else k
+                name = name.split(":", 1)[1] if ":" in name else name
+                params[name] = z[k]
+        return cls(symbol_json, params)
+
+    # ops that tolerate a missing (None) trailing label input at predict
+    # time — the reference predict API binds grad_req=null and never feeds
+    # labels into loss layers
+    _LABEL_OK = ("SoftmaxOutput", "Softmax", "LinearRegressionOutput",
+                 "LogisticRegressionOutput", "MAERegressionOutput",
+                 "SVMOutput")
+
+    def forward(self, **inputs):
+        var_names = {n["name"] for n in self.nodes if n["op"] == "null"}
+        unknown = set(inputs) - var_names
+        if unknown:
+            raise KeyError("forward: unknown input(s) %s; graph variables "
+                           "are %s" % (sorted(unknown),
+                                       sorted(var_names - set(self.params))))
+        vals = {}          # node id -> list of output arrays
+        names = {}         # node id -> variable name (for error messages)
+        for nid, node in enumerate(self.nodes):
+            op = node["op"]
+            name = node["name"]
+            if op == "null":
+                if name in inputs:
+                    v = np.asarray(inputs[name], np.float32)
+                elif name in self.params:
+                    v = self.params[name]
+                else:
+                    v = None
+                vals[nid] = [v]
+                names[nid] = name
+                continue
+            if op not in _OPS:
+                raise NotImplementedError(
+                    "amalgamation predict: op %r not in the minimal "
+                    "runtime (supported: %s)" % (op, sorted(_OPS)))
+            in_ids = [i for i, _k, *_ in node["inputs"]]
+            ins = [vals[i][k] for i, k, *_ in node["inputs"]]
+            for pos, v in enumerate(ins):
+                if v is None and not (op in self._LABEL_OK and pos >= 1):
+                    raise KeyError(
+                        "op %r (%s) input %r was neither fed to forward() "
+                        "nor found in params" % (op, name,
+                                                 names.get(in_ids[pos])))
+            out = _OPS[op](node.get("attrs", {}), ins)
+            vals[nid] = out if isinstance(out, list) else [out]
+        return [vals[i][k] for i, k in self.heads]
+
+
+def load_embedded(symbol_b64, params_b64):
+    """Entry for amalgamated files: base64+zlib blobs -> Predictor."""
+    sym_json = zlib.decompress(base64.b64decode(symbol_b64)).decode()
+    blob = zlib.decompress(base64.b64decode(params_b64))
+    return Predictor.from_checkpoint_bytes(sym_json, blob)

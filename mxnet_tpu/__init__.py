@@ -51,6 +51,7 @@ from .module import Module
 
 from . import model
 from .model import FeedForward
+from . import models
 
 from . import operator
 from . import predict

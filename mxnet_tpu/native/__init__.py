@@ -22,8 +22,15 @@ import subprocess
 import threading
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
-_SRC = os.path.join(os.path.dirname(os.path.dirname(_HERE)), "src",
-                    "native.cc")
+# repo checkout keeps the source at src/native.cc; installed wheels ship a
+# copy inside the package (setup.py build_py copies it here)
+_SRC_CANDIDATES = (
+    os.path.join(os.path.dirname(os.path.dirname(_HERE)), "src",
+                 "native.cc"),
+    os.path.join(_HERE, "native.cc"),
+)
+_SRC = next((p for p in _SRC_CANDIDATES if os.path.exists(p)),
+            _SRC_CANDIDATES[0])
 _LIB_PATH = os.path.join(_HERE, "libmxnet_tpu_native.so")
 
 _lib = None
@@ -53,8 +60,13 @@ def get_lib():
         if _lib is not None:
             return _lib
         try:
-            if (not os.path.isfile(_LIB_PATH)
-                    or os.path.getmtime(_LIB_PATH) < os.path.getmtime(_SRC)):
+            have_src = os.path.exists(_SRC)
+            if not os.path.isfile(_LIB_PATH):
+                _build()
+            elif (have_src
+                  and os.path.getmtime(_LIB_PATH) < os.path.getmtime(_SRC)):
+                # stale .so next to a newer source; without a source, a
+                # prebuilt .so is accepted as-is
                 _build()
             lib = ctypes.CDLL(_LIB_PATH)
         except (OSError, subprocess.CalledProcessError):

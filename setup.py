@@ -1,0 +1,63 @@
+#!/usr/bin/env python
+"""Package install — the ``tools/pip_package`` analog of the reference.
+
+``pip install .`` ships the pure-Python package; the native host runtime
+(``src/native.cc``) is compiled on demand at import by ``mxnet_tpu.native``
+(ctypes, no build-time toolchain requirement), so there is no ext_modules
+step here.
+"""
+
+import os
+import shutil
+
+from setuptools import find_packages, setup
+from setuptools.command.build_py import build_py
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def _readme():
+    with open(os.path.join(HERE, "README.md")) as f:
+        return f.read()
+
+
+class _BuildPy(build_py):
+    """Copy the native runtime source into the package so installed copies
+    can compile it on first use (mxnet_tpu/native/__init__.py falls back to
+    <pkg>/native/native.cc)."""
+
+    def run(self):
+        super().run()
+        src = os.path.join(HERE, "src", "native.cc")
+        dst_dir = os.path.join(self.build_lib, "mxnet_tpu", "native")
+        if os.path.exists(src) and os.path.isdir(dst_dir):
+            shutil.copy2(src, os.path.join(dst_dir, "native.cc"))
+        else:
+            # sdists must carry src/native.cc (MANIFEST.in); installs
+            # without it lose the native host runtime
+            import warnings
+
+            warnings.warn("src/native.cc not found — the native host "
+                          "runtime will be unavailable in this install")
+
+
+setup(
+    name="mxnet-tpu",
+    version="0.1.0",
+    description="TPU-native deep learning framework with the MXNet 0.9.5 "
+                "capability surface (NDArray/Symbol/Module/KVStore/IO)",
+    long_description=_readme(),
+    long_description_content_type="text/markdown",
+    packages=find_packages(include=["mxnet_tpu", "mxnet_tpu.*"]),
+    package_data={"mxnet_tpu.native": ["native.cc"]},
+    cmdclass={"build_py": _BuildPy},
+    python_requires=">=3.10",
+    install_requires=[
+        "numpy",
+        "jax",
+    ],
+    extras_require={
+        "full": ["optax", "opencv-python", "pillow"],
+        "test": ["pytest"],
+    },
+)
