@@ -202,6 +202,23 @@ def _graph_forward_mirror(symbol, nodes, arg_vals, aux_vals, rng,
     return outputs, new_aux
 
 
+def sgd_step_math(p, g, mom, lr, wd, momentum, rescale, clip):
+    """One SGD(-momentum) parameter step, math in f32, result cast back to
+    the stored dtype (bf16 params stay bf16).  Shared by the two-dispatch
+    fused update (Module._try_fused_update) and the single-dispatch
+    ``train_sgd`` executor kind so their numerics can never diverge.
+    Returns (new_p, new_mom_or_None)."""
+    g = g.astype(jnp.float32) * rescale
+    if clip > 0:
+        g = jnp.clip(g, -clip, clip)
+    g = g + wd * p.astype(jnp.float32)
+    if momentum != 0.0:
+        m = momentum * mom.astype(jnp.float32) - lr * g
+        return (p.astype(jnp.float32) + m).astype(p.dtype), \
+            m.astype(mom.dtype)
+    return (p.astype(jnp.float32) - lr * g).astype(p.dtype), None
+
+
 class Executor:
     """reference ``python/mxnet/executor.py:25``"""
 
@@ -300,6 +317,34 @@ class Executor:
                 return list(outs), new_aux_list, grads
 
             fn = jax.jit(f)
+        elif isinstance(kind, tuple) and kind[0] == "train_sgd":
+            # ONE dispatch for fwd+bwd+SGD(-momentum) update with donated
+            # param/momentum buffers — the whole training step is a single
+            # XLA computation (the reference's bulk-segment idea taken to
+            # its TPU conclusion).  Hyperparameters are baked into the
+            # compiled step; Module caches per hyper-tuple.
+            _, upd_names_t, momentum, rescale, clip = kind
+            upd_names = list(upd_names_t)
+            other_names = [n for n in arg_names if n not in upd_names_t]
+
+            def f(upd_vals, other_vals, aux, rng, moms, lrs, wds):
+                amap = dict(zip(upd_names, upd_vals))
+                amap.update(zip(other_names, other_vals))
+                args = [amap[n] for n in arg_names]
+                outs, new_aux_list, vjp_fn = _vjp_parts(args, aux, rng)
+                (grads,) = vjp_fn(tuple(jnp.ones_like(o) for o in outs))
+                new_p, new_m = [], []
+                for i, n in enumerate(upd_names):
+                    p, m = sgd_step_math(
+                        amap[n], grads[n], moms[i] if momentum != 0.0
+                        else None, lrs[i], wds[i], momentum, rescale, clip)
+                    new_p.append(p)
+                    if m is not None:
+                        new_m.append(m)
+                grad_list = [grads[n] for n in upd_names]
+                return list(outs), new_aux_list, new_p, new_m, grad_list
+
+            fn = jax.jit(f, donate_argnums=(0, 4))
         else:
             raise ValueError(kind)
         self._fns[kind] = fn

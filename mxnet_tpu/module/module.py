@@ -20,6 +20,7 @@ import logging
 import numpy as np
 
 from .. import optimizer as opt
+from .. import random as _random
 from ..base import MXNetError
 from ..context import Context, cpu
 from ..executor import Executor
@@ -79,6 +80,7 @@ class Module(BaseModule):
         self._preload_opt_states = None
         self._grad_req = "write"
         self._fused_step = None
+        self._pending_full = False  # staged single-dispatch train step
 
     # -- properties -------------------------------------------------------
     @property
@@ -310,9 +312,13 @@ class Module(BaseModule):
                                  (name, jx.shape, dst.shape))
             dst._jx = jax.device_put(jx, dst._jx.sharding)
 
-    def forward(self, data_batch, is_train=None):
+    def forward(self, data_batch, is_train=None, _defer=False):
         """reference executor_group.py:355 forward + _load_data"""
         assert self.binded and self.params_initialized
+        if not _defer:
+            # a staged fused step must run before its batch data is
+            # overwritten, or a later update() would apply stale grads
+            self._materialize_pending()
         if is_train is None:
             is_train = self.for_training
         # zip with bind-time data_shapes order (= provide_data order), the
@@ -321,12 +327,113 @@ class Module(BaseModule):
         if self._label_shapes and data_batch.label:
             self._load_io([n for n, _ in self._label_shapes],
                           data_batch.label)
-        self._exec.forward(is_train=is_train)
+        if not _defer:
+            self._exec.forward(is_train=is_train)
 
     def backward(self, out_grads=None):
         """reference executor_group.py:481"""
         assert self.binded and self.params_initialized
         self._exec.backward(out_grads=out_grads)
+
+    # -- single-dispatch train step ---------------------------------------
+    def _full_step_eligible(self):
+        """fwd+bwd+update as ONE jit call: plain SGD, no kvstore, no
+        monitor/profiler hooks, params-only grads all 'write'.
+
+        Opt-in via ``MXNET_FUSE_TRAIN_STEP=1``: interleaved A/B on the
+        tunneled v5e backend shows the merged computation is within noise
+        of the two-dispatch path (the tunnel's run-to-run variance
+        dominates), so the default stays on the simpler two-phase path.
+        Kept for backends where dispatch latency dominates; numerics are
+        identical either way (see
+        tests/test_module.py::test_fused_full_step_matches_two_phase).
+        """
+        import os
+
+        from .. import profiler as _profiler
+
+        if os.environ.get("MXNET_FUSE_TRAIN_STEP", "0") != "1":
+            return False
+        if not (self.binded and self.params_initialized
+                and self.optimizer_initialized):
+            return False
+        if type(self._optimizer) is not opt.SGD or self._kvstore is not None:
+            return False
+        if self.inputs_need_grad or self._exec._monitor_callback is not None:
+            return False
+        if _profiler.running():
+            return False  # unfused path keeps per-phase profiler spans
+        diff = self._exec._diff_names()
+        names = [n for n in self._param_names
+                 if self._exec.grad_dict.get(n) is not None]
+        return set(diff) == set(names) and \
+            all(self._exec.grad_req[n] == "write" for n in diff)
+
+    def forward_backward(self, data_batch):
+        """Stages the batch for a fused fwd+bwd+update dispatch when
+        eligible; ``update()`` then runs the whole step as one XLA
+        computation.  Reading outputs/grads before ``update()`` falls back
+        to the exact two-phase path."""
+        if self._full_step_eligible():
+            self.forward(data_batch, is_train=True, _defer=True)
+            self._pending_full = True
+            return
+        self.forward(data_batch, is_train=True)
+        self.backward()
+
+    def _materialize_pending(self):
+        """A staged batch is being observed before update(): run the
+        normal fwd+bwd so outputs/grads exist, then clear the stage."""
+        if self._pending_full:
+            self._pending_full = False
+            self._exec.forward(is_train=True)
+            self._exec.backward()
+
+    def _run_full_step(self):
+        import jax
+        import jax.numpy as jnp
+
+        self._pending_full = False
+        ex = self._exec
+        optimizer = self._optimizer
+        updater = self._updater
+        names = [n for n in self._param_names
+                 if ex.grad_dict.get(n) is not None]
+        if not names:
+            ex.forward(is_train=True)
+            return
+        for idx in range(len(names)):
+            if idx not in updater.states:
+                updater.states[idx] = optimizer.create_state(
+                    idx, ex.arg_dict[names[idx]])
+            optimizer._update_count(idx)
+        lrs, wds = self._get_hyper_arrays(optimizer, len(names))
+        clip = optimizer.clip_gradient \
+            if optimizer.clip_gradient is not None else -1.0
+        fn = ex._get_fn(("train_sgd", tuple(names), optimizer.momentum,
+                         optimizer.rescale_grad, clip))
+        names_set = set(names)
+        other = [n for n in ex.arg_names if n not in names_set]
+        upd_vals = [ex.arg_dict[n]._jx for n in names]
+        other_vals = [ex.arg_dict[n]._jx for n in other]
+        aux = [a._jx for a in ex.aux_arrays]
+        rng = jax.device_put(_random.next_key(), ex._ctx.jax_device())
+        moms = [updater.states[i]._jx for i in range(len(names))] \
+            if optimizer.momentum != 0.0 else []
+        outs, new_aux, new_p, new_m, grad_list = fn(
+            upd_vals, other_vals, aux, rng, moms, lrs, wds)
+        ex.outputs = [NDArray._from_jax(o, ex._ctx) for o in outs]
+        for arr, v in zip(ex.aux_arrays, new_aux):
+            arr._jx = v
+        for n, p in zip(names, new_p):
+            ex.arg_dict[n]._jx = p
+        for i, m in enumerate(new_m):
+            updater.states[i]._jx = m
+        # keep grad_dict observable exactly like the two-phase path
+        # (grad-norm logging etc. reads the current batch's gradients)
+        for n, g in zip(names, grad_list):
+            ex.grad_dict[n]._jx = g
+        ex._pending_grads = None
 
     def update(self):
         """reference ``module.py:553`` + model.py:88/99.
@@ -339,6 +446,9 @@ class Module(BaseModule):
         """
         assert self.binded and self.params_initialized \
             and self.optimizer_initialized
+        if self._pending_full:
+            self._run_full_step()
+            return
         local_kv = self._kvstore is None or (
             not self._update_on_kvstore and "dist" not in self._kvstore.type)
         if local_kv and self._updater is not None \
@@ -353,6 +463,22 @@ class Module(BaseModule):
         else:
             _update_params(param_arrays, grad_arrays, updater=self._updater,
                            num_device=1, kvstore=self._kvstore)
+
+    def _get_hyper_arrays(self, optimizer, n):
+        """Device copies of per-index lr/wd, re-uploaded only when a
+        scheduler changes the values."""
+        import jax.numpy as jnp
+
+        lr_vals = tuple(optimizer._get_lr(i) for i in range(n))
+        wd_vals = tuple(optimizer._get_wd(i) for i in range(n))
+        cached = getattr(self, "_fused_hyper_cache", None)
+        if cached is None or cached[0] != lr_vals or cached[1] != wd_vals:
+            self._fused_hyper_cache = (
+                lr_vals, wd_vals,
+                jnp.asarray(lr_vals, jnp.float32),
+                jnp.asarray(wd_vals, jnp.float32))
+            cached = self._fused_hyper_cache
+        return cached[2], cached[3]
 
     def _try_fused_update(self):
         import jax
@@ -378,34 +504,24 @@ class Module(BaseModule):
                     updater.states[idx] = optimizer.create_state(
                         idx, self._exec.arg_dict[n])
 
+            from ..executor import sgd_step_math
+
             def step(params, grads, moms, lrs, wds):
-                # math in f32, results cast back to the stored dtypes so
-                # bf16 params stay bf16 across steps (weights never promote)
                 new_p, new_m = [], []
                 for i, (p, g) in enumerate(zip(params, grads)):
-                    g = g.astype(jnp.float32) * rescale
-                    if clip > 0:
-                        g = jnp.clip(g, -clip, clip)
-                    g = g + wds[i] * p.astype(jnp.float32)
-                    if momentum != 0.0:
-                        m = momentum * moms[i].astype(jnp.float32) \
-                            - lrs[i] * g
-                        new_m.append(m.astype(moms[i].dtype))
-                        new_p.append((p.astype(jnp.float32) + m)
-                                     .astype(p.dtype))
-                    else:
-                        new_p.append((p.astype(jnp.float32) - lrs[i] * g)
-                                     .astype(p.dtype))
+                    np_, nm = sgd_step_math(
+                        p, g, moms[i] if momentum != 0.0 else None,
+                        lrs[i], wds[i], momentum, rescale, clip)
+                    new_p.append(np_)
+                    if nm is not None:
+                        new_m.append(nm)
                 return new_p, new_m
 
             self._fused_step = jax.jit(step, donate_argnums=(0, 2))
         # per-index bookkeeping keeps num_update/scheduler semantics
         for idx in range(len(names)):
             optimizer._update_count(idx)
-        lrs = jnp.asarray([optimizer._get_lr(i) for i in range(len(names))],
-                          jnp.float32)
-        wds = jnp.asarray([optimizer._get_wd(i) for i in range(len(names))],
-                          jnp.float32)
+        lrs, wds = self._get_hyper_arrays(optimizer, len(names))
         params = [self._exec.arg_dict[n]._jx for n in names]
         grads = [self._exec.grad_dict[n]._jx for n in names]
         moms = [updater.states[i]._jx for i in range(len(names))] \
@@ -419,10 +535,12 @@ class Module(BaseModule):
 
     def get_outputs(self, merge_multi_context=True):
         assert self.binded
+        self._materialize_pending()
         return self._exec.outputs
 
     def get_input_grads(self, merge_multi_context=True):
         assert self.binded and self.inputs_need_grad
+        self._materialize_pending()
         return [self._exec.grad_dict.get(n) for n in self._data_names]
 
     def update_metric(self, eval_metric, labels):

@@ -118,3 +118,82 @@ def test_feedforward_api():
     model.fit(x, y)
     preds = model.predict(x)
     assert preds.shape == (128, 4)
+
+
+def test_fused_full_step_matches_two_phase():
+    """MXNET_FUSE_TRAIN_STEP=1 runs fwd+bwd+update as one XLA dispatch;
+    the resulting params must match the two-phase path bit-for-bit-ish."""
+    import os
+
+    rs = np.random.RandomState(0)
+    x = rs.rand(32, 8).astype(np.float32)
+    y = rs.randint(0, 3, 32).astype(np.float32)
+
+    def run(fused):
+        os.environ["MXNET_FUSE_TRAIN_STEP"] = "1" if fused else "0"
+        try:
+            data = mx.sym.Variable("data")
+            h = mx.sym.FullyConnected(data, num_hidden=16, name="fc1")
+            h = mx.sym.Activation(h, act_type="relu")
+            h = mx.sym.FullyConnected(h, num_hidden=3, name="fc2")
+            net = mx.sym.SoftmaxOutput(h, name="softmax")
+            mod = mx.mod.Module(net, context=mx.cpu())
+            mod.bind(data_shapes=[("data", (32, 8))],
+                     label_shapes=[("softmax_label", (32,))])
+            mod.init_params(mx.init.Zero())
+            irs = np.random.RandomState(7)
+            mod.set_params({n: mx.nd.array(
+                irs.normal(0, 0.1, a.shape).astype(np.float32))
+                for n, a in mod.get_params()[0].items()}, {})
+            mod.init_optimizer(optimizer="sgd",
+                               optimizer_params={"learning_rate": 0.1,
+                                                 "momentum": 0.9,
+                                                 "wd": 1e-3})
+            batch = mx.io.DataBatch(data=[mx.nd.array(x)],
+                                    label=[mx.nd.array(y)])
+            for _ in range(3):
+                mod.forward_backward(batch)
+                mod.update()
+            out = mod.get_outputs()[0].asnumpy()
+            params = {k: v.asnumpy() for k, v in mod.get_params()[0].items()}
+            return out, params
+        finally:
+            os.environ.pop("MXNET_FUSE_TRAIN_STEP", None)
+
+    out_f, p_f = run(True)
+    out_n, p_n = run(False)
+    assert_almost_equal(out_f, out_n, rtol=1e-5, atol=1e-6)
+    for k in p_n:
+        assert_almost_equal(p_f[k], p_n[k], rtol=1e-5, atol=1e-6)
+
+
+def test_fused_full_step_observed_before_update():
+    """get_outputs() between a staged forward_backward and update() must
+    fall back to the exact two-phase path (outputs available, update OK)."""
+    import os
+
+    os.environ["MXNET_FUSE_TRAIN_STEP"] = "1"
+    try:
+        rs = np.random.RandomState(1)
+        data = mx.sym.Variable("data")
+        net = mx.sym.SoftmaxOutput(
+            mx.sym.FullyConnected(data, num_hidden=3, name="fc"),
+            name="softmax")
+        mod = mx.mod.Module(net, context=mx.cpu())
+        mod.bind(data_shapes=[("data", (4, 5))],
+                 label_shapes=[("softmax_label", (4,))])
+        mod.init_params(mx.init.Xavier())
+        mod.init_optimizer(optimizer="sgd",
+                           optimizer_params={"learning_rate": 0.1})
+        batch = mx.io.DataBatch(
+            data=[mx.nd.array(rs.rand(4, 5).astype(np.float32))],
+            label=[mx.nd.array(np.array([0, 1, 2, 0], np.float32))])
+        mod.forward_backward(batch)
+        out = mod.get_outputs()[0].asnumpy()   # observe BEFORE update
+        assert out.shape == (4, 3)
+        before = mod.get_params()[0]["fc_weight"].asnumpy().copy()
+        mod.update()
+        after = mod.get_params()[0]["fc_weight"].asnumpy()
+        assert np.abs(after - before).sum() > 0
+    finally:
+        os.environ.pop("MXNET_FUSE_TRAIN_STEP", None)
