@@ -483,8 +483,19 @@ def _invoke(op, args, kwargs):
     pos_inputs = [a for a in args if isinstance(a, NDArray)]
     attr_args = [a for a in args if not isinstance(a, NDArray)]
     if attr_args:
-        raise MXNetError("%s: non-NDArray positional args not supported; "
-                         "pass params by keyword" % op.name)
+        # positional scalars fill the op's params in declaration order
+        # (reference generated fns: e.g. nd.uniform(0, 1, shape=...));
+        # the auto-counted variable-arity param is never positional
+        ordered = [k for k in op.params if k != op.key_var_num_args]
+        if len(attr_args) > len(ordered):
+            raise MXNetError("%s: too many positional params (%d given, "
+                             "%d exist: %s)" % (op.name, len(attr_args),
+                                                len(ordered), ordered))
+        for k, v in zip(ordered, attr_args):
+            if k in attr_kwargs:
+                raise MXNetError("%s: got multiple values for param %r"
+                                 % (op.name, k))
+            attr_kwargs[k] = v
     if op.key_var_num_args and op.key_var_num_args not in attr_kwargs:
         attr_kwargs[op.key_var_num_args] = len(pos_inputs) + len(named_inputs)
     attrs = op.canonicalize_attrs(attr_kwargs)
@@ -515,6 +526,13 @@ def _invoke(op, args, kwargs):
     if named_inputs:
         raise MXNetError("%s: unknown input kwargs %s"
                          % (op.name, sorted(named_inputs)))
+    # NB: builtins like ``sum`` are shadowed by generated op fns here
+    leftover = len(list(pi))
+    if leftover:
+        raise MXNetError("%s: %d surplus positional NDArray input(s) "
+                         "(op takes %d inputs + %d aux)"
+                         % (op.name, leftover, len(arg_names),
+                            len(aux_names)))
 
     rng = _random.next_key() if op.needs_rng else None
     fn = _reg.jitted_apply(op.name, _reg.attrs_key(attrs), True)

@@ -39,6 +39,7 @@ def _norm_stp(kernel, stride, dilate, pad):
 def _fully_connected(attrs, inputs, aux, is_train, rng):
     data = inputs[0]
     weight = inputs[1]
+    data = _match_param_dtype(data, weight)
     if attrs["flatten"] and data.ndim > 2:
         data = data.reshape(data.shape[0], -1)
     out = jnp.dot(data, weight.T)
@@ -62,8 +63,18 @@ _CONV_DIMNUMS = {1: ("NCH", "OIH", "NCH"), 2: ("NCHW", "OIHW", "NCHW"),
                  3: ("NCDHW", "OIDHW", "NCDHW")}
 
 
+def _match_param_dtype(data, weight):
+    """Mixed precision: the parameter dtype defines the net's compute
+    precision (the reference's fp16-net pattern casts data at the input),
+    so f32 iterator data into a bf16-cast net runs — and stays — bf16."""
+    if data.dtype != weight.dtype:
+        data = data.astype(weight.dtype)
+    return data
+
+
 def _convolution(attrs, inputs, aux, is_train, rng):
     data, weight = inputs[0], inputs[1]
+    data = _match_param_dtype(data, weight)
     kernel = attrs["kernel"]
     nd = len(kernel)
     stride, dilate, pad = _norm_stp(kernel, attrs["stride"], attrs["dilate"],
@@ -98,6 +109,7 @@ register("Convolution", _convolution,
 
 def _deconvolution(attrs, inputs, aux, is_train, rng):
     data, weight = inputs[0], inputs[1]
+    data = _match_param_dtype(data, weight)
     kernel = attrs["kernel"]
     nd = len(kernel)
     stride, dilate, pad = _norm_stp(kernel, attrs["stride"], attrs["dilate"],

@@ -1,0 +1,53 @@
+"""Symbol attributes / AttrScope (reference ``tests/python/unittest/
+test_attr.py``): scoped attrs, attr queries, JSON round-trip."""
+
+import json
+
+import mxnet_tpu as mx
+
+
+def test_attr_basic():
+    data = mx.sym.Variable("data", attr={"mood": "angry"})
+    op = mx.sym.Convolution(data, name="conv", kernel=(1, 1), num_filter=1,
+                            attr={"__mood__": "so so"})
+    assert data.attr("mood") == "angry"
+    assert op.attr("__mood__") == "so so"
+
+
+def test_attr_scope_nesting():
+    with mx.AttrScope(ctx_group="stage1"):
+        a = mx.sym.Variable("a")
+        with mx.AttrScope(ctx_group="stage2", lr_mult="0.1"):
+            b = mx.sym.Variable("b")
+        c = mx.sym.Variable("c")
+    d = mx.sym.Variable("d")
+    assert a.attr("ctx_group") == "stage1"
+    assert b.attr("ctx_group") == "stage2"
+    assert b.attr("lr_mult") == "0.1"
+    assert c.attr("ctx_group") == "stage1"
+    assert d.attr("ctx_group") is None
+
+
+def test_list_attr():
+    data = mx.sym.Variable("data", attr={"mood": "angry"})
+    fc = mx.sym.FullyConnected(data, num_hidden=2, name="fc",
+                               attr={"tag": "x"})
+    shallow = fc.list_attr()
+    assert shallow.get("tag") == "x"
+    deep = fc.list_attr(recursive=True)
+    assert any("mood" in k for k in deep)
+
+
+def test_attrs_survive_json_roundtrip():
+    with mx.AttrScope(ctx_group="dev2"):
+        data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=3, name="fc",
+                                attr={"special": "yes"})
+    js = net.tojson()
+    assert "special" in js
+    loaded = mx.sym.load_json(js)
+    assert loaded.attr("special") == "yes"
+    # graph JSON is valid json with the misc attrs present
+    parsed = json.loads(js)
+    assert any(n.get("misc_attrs", {}).get("ctx_group") == "dev2"
+               for n in parsed["nodes"])

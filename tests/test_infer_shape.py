@@ -1,0 +1,84 @@
+"""Shape inference (reference ``tests/python/unittest/test_infer_shape.py``):
+forward inference via eval_shape, backward (argument-filling) rules,
+partial inference."""
+
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.base import MXNetError
+
+
+def _mlp():
+    data = mx.sym.Variable("data")
+    h = mx.sym.FullyConnected(data, num_hidden=128, name="fc1")
+    h = mx.sym.Activation(h, act_type="relu")
+    h = mx.sym.FullyConnected(h, num_hidden=10, name="fc2")
+    return mx.sym.SoftmaxOutput(h, name="sm")
+
+
+def test_mlp_infer():
+    out = _mlp()
+    arg_shapes, out_shapes, _ = out.infer_shape(data=(100, 250))
+    d = dict(zip(out.list_arguments(), arg_shapes))
+    assert d["fc1_weight"] == (128, 250)
+    assert d["fc1_bias"] == (128,)
+    assert d["fc2_weight"] == (10, 128)
+    assert out_shapes == [(100, 10)]
+
+
+def test_conv_chain_infer():
+    data = mx.sym.Variable("data")
+    c = mx.sym.Convolution(data, kernel=(3, 3), num_filter=8, pad=(1, 1),
+                           name="c1")
+    p = mx.sym.Pooling(c, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    f = mx.sym.Flatten(p)
+    fc = mx.sym.FullyConnected(f, num_hidden=5, name="fc")
+    args, outs, _ = fc.infer_shape(data=(2, 3, 8, 8))
+    d = dict(zip(fc.list_arguments(), args))
+    assert d["c1_weight"] == (8, 3, 3, 3)
+    assert d["fc_weight"] == (5, 8 * 4 * 4)
+    assert outs == [(2, 5)]
+
+
+def test_infer_shape_partial():
+    """Unknowable shapes are left unresolved, not guessed."""
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data, num_hidden=4, name="fc")
+    try:
+        arg_shapes, out_shapes, _ = fc.infer_shape_partial()
+    except AttributeError:
+        pytest.skip("infer_shape_partial not exposed")
+    d = dict(zip(fc.list_arguments(), arg_shapes))
+    assert d.get("data") in (None, ()), d
+
+
+def test_infer_shape_mismatch_raises():
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data, num_hidden=4, name="fc")
+    net = fc + mx.sym.Variable("other")
+    with pytest.raises((MXNetError, TypeError),
+                       match="broadcast|incompatible|mismatch"):
+        # other must broadcast against (2, 4); (3, 5) cannot
+        net.infer_shape(data=(2, 8), other=(3, 5))
+
+
+def test_batchnorm_aux_infer():
+    data = mx.sym.Variable("data")
+    bn = mx.sym.BatchNorm(data, name="bn")
+    args, outs, aux = bn.infer_shape(data=(4, 6, 5, 5))
+    d = dict(zip(bn.list_arguments(), args))
+    a = dict(zip(bn.list_auxiliary_states(), aux))
+    assert d["bn_gamma"] == (6,) and d["bn_beta"] == (6,)
+    assert a["bn_moving_mean"] == (6,) and a["bn_moving_var"] == (6,)
+
+
+def test_rnn_param_blob_infer():
+    data = mx.sym.Variable("data")     # (seq, batch, input)
+    rnn = mx.sym.RNN(data, state_size=7, num_layers=2, mode="lstm",
+                     name="l")
+    from mxnet_tpu.ops.rnn import rnn_param_size
+
+    args, outs, _ = rnn.infer_shape(data=(5, 3, 11))
+    d = dict(zip(rnn.list_arguments(), args))
+    assert d["l_parameters"] == (rnn_param_size(11, 7, 2, "lstm"),)
+    assert outs[0] == (5, 3, 7)

@@ -1,0 +1,68 @@
+"""Randomness (reference ``tests/python/unittest/test_random.py``):
+seed determinism, distribution moments, symbol-level samplers, dropout."""
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def test_seed_determinism():
+    mx.random.seed(128)
+    a = nd.uniform(0, 1, shape=(100,)).asnumpy()
+    mx.random.seed(128)
+    b = nd.uniform(0, 1, shape=(100,)).asnumpy()
+    assert np.array_equal(a, b)
+    mx.random.seed(129)
+    c = nd.uniform(0, 1, shape=(100,)).asnumpy()
+    assert not np.array_equal(a, c)
+
+
+def test_uniform_moments():
+    mx.random.seed(0)
+    x = nd.uniform(-10, 10, shape=(100000,)).asnumpy()
+    assert abs(x.mean()) < 0.2
+    assert abs(x.std() - 20 / np.sqrt(12)) < 0.2
+    assert x.min() >= -10 and x.max() <= 10
+
+
+def test_normal_moments():
+    mx.random.seed(0)
+    x = nd.normal(2.0, 3.0, shape=(100000,)).asnumpy()
+    assert abs(x.mean() - 2.0) < 0.1
+    assert abs(x.std() - 3.0) < 0.1
+
+
+def test_symbol_samplers():
+    u = mx.sym.uniform(low=0, high=1, shape=(1000,))
+    n = mx.sym.normal(loc=0, scale=1, shape=(1000,))
+    net = mx.sym.Group([u, n])
+    ex = net.simple_bind(mx.cpu())
+    o1, o2 = [o.asnumpy() for o in ex.forward(is_train=True)]
+    assert 0 <= o1.min() and o1.max() <= 1
+    assert abs(o2.mean()) < 0.2
+    # a second forward draws fresh samples
+    o1b = ex.forward(is_train=True)[0].asnumpy()
+    assert not np.array_equal(o1, o1b)
+
+
+def test_dropout_train_vs_eval():
+    data = mx.sym.Variable("data")
+    net = mx.sym.Dropout(data, p=0.5)
+    ex = net.simple_bind(mx.cpu(), data=(1000,))
+    ex.arg_dict["data"][:] = nd.ones((1000,))
+    train_out = ex.forward(is_train=True)[0].asnumpy()
+    frac_zero = (train_out == 0).mean()
+    assert 0.35 < frac_zero < 0.65
+    # scaled to keep the expectation: surviving values are 1/(1-p)
+    assert np.allclose(train_out[train_out != 0], 2.0)
+    eval_out = ex.forward(is_train=False)[0].asnumpy()
+    assert np.allclose(eval_out, 1.0)
+
+
+def test_mx_random_namespace():
+    """mx.rnd alias and per-call ctx/dtype args exist (reference random.py)."""
+    x = mx.rnd.uniform(0, 1, shape=(4, 4))
+    assert x.shape == (4, 4)
+    y = mx.random.normal(0, 1, shape=(3,), dtype="float32")
+    assert y.dtype == np.float32

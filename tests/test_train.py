@@ -1,0 +1,95 @@
+"""End-to-end convergence tests (reference ``tests/python/train/``:
+``test_mlp.py``, ``test_conv.py``, ``test_dtype.py``) — small real
+trainings that must hit an accuracy threshold, on synthetic datasets in
+the reference's on-disk formats."""
+
+import os
+import sys
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "example", "image-classification"))
+
+
+def _mnist_iters(tmp_path, batch_size, flat):
+    from common.data import synth_mnist
+
+    paths = synth_mnist(str(tmp_path))
+    train = mx.io.MNISTIter(image=paths["train_img"],
+                            label=paths["train_lab"],
+                            batch_size=batch_size, shuffle=True, flat=flat)
+    val = mx.io.MNISTIter(image=paths["val_img"], label=paths["val_lab"],
+                          batch_size=batch_size, flat=flat)
+    return train, val
+
+
+def _final_acc(mod, val):
+    m = mx.metric.Accuracy()
+    val.reset()
+    mod.score(val, m)
+    return m.get()[1]
+
+
+def test_mlp_convergence(tmp_path):
+    """reference train/test_mlp.py: MLP must reach high accuracy."""
+    train, val = _mnist_iters(tmp_path, 100, flat=True)
+    net = mx.models.get_symbol("mlp", num_classes=10)
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.fit(train, eval_data=val, num_epoch=3, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+            initializer=mx.init.Xavier())
+    acc = _final_acc(mod, val)
+    assert acc > 0.9, acc
+
+
+def test_conv_convergence(tmp_path):
+    """reference train/test_conv.py: LeNet on mnist-format data."""
+    train, val = _mnist_iters(tmp_path, 100, flat=False)
+    net = mx.models.get_symbol("lenet", num_classes=10)
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.fit(train, eval_data=val, num_epoch=2, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+            initializer=mx.init.Xavier())
+    acc = _final_acc(mod, val)
+    assert acc > 0.9, acc
+
+
+def test_dtype_bf16_convergence(tmp_path):
+    """reference train/test_dtype.py (fp16 cifar): training with low-precision
+    params/activations must still converge; bf16 is the TPU half type."""
+    train, val = _mnist_iters(tmp_path, 100, flat=False)
+    net = mx.models.get_symbol("lenet", num_classes=10)
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.bind(data_shapes=train.provide_data,
+             label_shapes=train.provide_label)
+    mod.init_params(mx.init.Xavier())
+    # cast params to bf16 (the fp16-variant pattern of symbols/*-fp16.py)
+    for n, a in mod._exec.arg_dict.items():
+        if n not in ("data", "softmax_label"):
+            a._jx = a._jx.astype("bfloat16")
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1,
+                                         "momentum": 0.9})
+    for _ in range(2):
+        train.reset()
+        for batch in train:
+            mod.forward_backward(batch)
+            mod.update()
+    # params stayed bf16 across updates
+    import jax.numpy as jnp
+
+    fc_weights = [n for n in mod._exec.arg_dict
+                  if "fullyconnected" in n and n.endswith("weight")]
+    assert fc_weights
+    assert all(mod._exec.arg_dict[n]._jx.dtype == jnp.bfloat16
+               for n in fc_weights)
+    # activations run in bf16 too: params define the compute precision
+    # (f32 iterator data is cast down at each conv/fc input)
+    mod.forward(next(iter(val)), is_train=False)
+    val.reset()
+    assert mod.get_outputs()[0]._jx.dtype == jnp.bfloat16
+    acc = _final_acc(mod, val)
+    assert acc > 0.85, acc
