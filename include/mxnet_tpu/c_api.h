@@ -1,0 +1,67 @@
+/*!
+ * C ABI of the native host runtime — the binding surface for non-Python
+ * frontends.
+ *
+ * Reference: include/mxnet/c_api.h (1475 lines, 116 MXNET_DLL functions) is
+ * the surface every reference language binding sits on (SURVEY §2.7).  In
+ * the TPU framework the device path is PJRT/XLA (bound per-language through
+ * each language's JAX/PJRT story), so the native C ABI covers the HOST
+ * runtime: the async dependency engine, pooled host storage, and the
+ * RecordIO scanner.  The C++ frontend (cpp_package/) and the Python ctypes
+ * layer (mxnet_tpu/native/__init__.py) both sit on exactly these symbols,
+ * compiled from src/native.cc into libmxnet_tpu_native.so.
+ *
+ * All handles are opaque void*.  Thread-safety: a handle may be used from
+ * any thread; Push is serialized internally by the engine's queues.
+ */
+#ifndef MXNET_TPU_C_API_H_
+#define MXNET_TPU_C_API_H_
+
+#include <stddef.h>
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+/*! \brief async op callback: runs on an engine worker thread. */
+typedef void (*EngineFnPtr)(void* ctx);
+
+/* ---- Engine: var-dependency async scheduler ------------------------------
+ * The reference Engine ABI (include/mxnet/engine.h: PushAsync/NewVariable/
+ * WaitForVar/WaitForAll) reduced to the host-side essentials; NaiveEngine
+ * (naive=1) executes synchronously on push — the determinism/debug mode
+ * selected by MXNET_ENGINE_TYPE=NaiveEngine. */
+void* EngineCreate(int num_workers, int naive);
+void  EngineFree(void* engine);
+void* EngineNewVar(void* engine);
+/*! \brief push fn(ctx) with read deps cvars[0..nc) and write deps
+ *  mvars[0..nm); executes when all deps clear. */
+void  EnginePush(void* engine, EngineFnPtr fn, void* ctx,
+                 void** cvars, int nc, void** mvars, int nm);
+void  EngineWaitForVar(void* engine, void* var);
+void  EngineWaitForAll(void* engine);
+
+/* ---- Storage: size-bucketed pooled host allocator ------------------------
+ * The GPUPooledStorageManager analog (src/storage/pooled_storage_manager.h)
+ * for host staging buffers: Alloc/Free round-trip the pool, DirectFree
+ * bypasses it, ReleaseAll drops the free lists. */
+void*  StorageCreate(void);
+void   StorageFree(void* storage);
+void*  StorageAlloc(void* storage, size_t size);
+void   StorageRelease(void* storage, void* ptr, size_t size);
+void   StorageDirectFree(void* storage, void* ptr, size_t size);
+void   StorageReleaseAll(void* storage);
+size_t StorageUsedBytes(void* storage);
+size_t StoragePooledBytes(void* storage);
+
+/* ---- RecordIO ------------------------------------------------------------
+ * Scan a dmlc-format .rec file for record boundaries (the fast path behind
+ * .idx rebuilds); writes up to max_n offsets, returns the count. */
+long MXRecordIOScan(const char* path, int64_t* offsets, long max_n);
+
+#ifdef __cplusplus
+}  /* extern "C" */
+#endif
+
+#endif  /* MXNET_TPU_C_API_H_ */

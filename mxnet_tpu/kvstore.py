@@ -181,9 +181,16 @@ class KVStoreDist(KVStore):
                     raise
                 _time.sleep(0.2)
         self._versions = {}
-        reply = self._rpc({"cmd": "register", "role": "worker"})
+        reg = {"cmd": "register", "role": "worker"}
+        worker_id = os.environ.get("DMLC_WORKER_ID")
+        if worker_id is not None:
+            # announce identity so a restarted worker rejoins with its old
+            # rank (the reference's ps-lite is_recovery path)
+            reg["preferred_rank"] = int(worker_id)
+        reply = self._rpc(reg)
         self._rank = reply["rank"]
         self._num_workers = reply["num_workers"]
+        self.is_recovery = bool(reply.get("is_recovery", False))
         self._update_on_kvstore = True
         # command the server into the mode this type implies (reference
         # kvstore.cc:32-35: sync unless the type carries _async)
@@ -250,7 +257,7 @@ class KVStoreDist(KVStore):
     _set_updater = set_updater
 
     def barrier(self):
-        self._rpc({"cmd": "barrier"})
+        self._rpc({"cmd": "barrier", "rank": self._rank})
 
     def send_command_to_servers(self, head, body):
         self._rpc({"cmd": "user_command", "head": head, "body": body})

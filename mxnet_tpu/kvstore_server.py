@@ -80,6 +80,20 @@ def _loads(b):
     return _SysUnpickler(_io.BytesIO(b)).load()
 
 
+class _Disconnected(Exception):
+    """Raised inside a handler whose peer socket died mid-wait."""
+
+
+def _sock_dead(sock):
+    """Non-blocking closed-peer probe (MSG_PEEK)."""
+    try:
+        return sock.recv(1, socket.MSG_PEEK | socket.MSG_DONTWAIT) == b""
+    except (BlockingIOError, InterruptedError):
+        return False
+    except OSError:
+        return True
+
+
 def send_msg(sock, obj):
     payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
     sock.sendall(_LEN.pack(len(payload)) + payload)
@@ -123,7 +137,9 @@ class KVStoreServer:
         self.lock = threading.Condition()
         self.updater = None
         self.next_rank = 0
-        self.barrier_count = 0
+        self.registered = set()   # ranks ever assigned (rejoin detection)
+        self.live = {}            # rank -> connection currently holding it
+        self.barrier_waiters = set()  # ranks arrived at the current barrier
         self.barrier_gen = 0
         self.stopped = threading.Event()
 
@@ -131,14 +147,20 @@ class KVStoreServer:
 
         class Handler(socketserver.BaseRequestHandler):
             def handle(self):
-                while True:
-                    msg = recv_msg(self.request)
-                    if msg is None:
-                        return
-                    reply = outer.dispatch(msg)
-                    send_msg(self.request, reply)
-                    if msg["cmd"] == "stop":
-                        return
+                self.rank = None
+                try:
+                    while True:
+                        msg = recv_msg(self.request)
+                        if msg is None:
+                            return
+                        reply = outer.dispatch(msg, conn=self)
+                        send_msg(self.request, reply)
+                        if msg["cmd"] == "stop":
+                            return
+                except _Disconnected:
+                    return
+                finally:
+                    outer.on_disconnect(self)
 
         class Server(socketserver.ThreadingTCPServer):
             daemon_threads = True
@@ -147,14 +169,51 @@ class KVStoreServer:
         self.server = Server((host, port), Handler)
         self.port = self.server.server_address[1]
 
+    def on_disconnect(self, conn):
+        """A worker connection dropped: release its rank and withdraw any
+        in-flight barrier contribution so the cluster cannot desync on a
+        mid-barrier death + rejoin."""
+        with self.lock:
+            rank = getattr(conn, "rank", None)
+            if rank is not None and self.live.get(rank) is conn:
+                del self.live[rank]
+                self.barrier_waiters.discard(rank)
+                self.lock.notify_all()
+
     # -- command dispatch --------------------------------------------------
-    def dispatch(self, msg):
+    def dispatch(self, msg, conn=None):
         cmd = msg["cmd"]
         if cmd == "register":
             with self.lock:
-                rank = self.next_rank
-                self.next_rank += 1
-            return {"rank": rank, "num_workers": self.num_workers}
+                preferred = msg.get("preferred_rank")
+                if preferred is not None:
+                    # restart/rejoin path (reference ps-lite is_recovery,
+                    # kvstore_dist.h:35,73): a worker that announces its
+                    # DMLC_WORKER_ID keeps that rank across restarts; the
+                    # server's weights/versions are intact so it resumes
+                    # from current state without re-running init barriers
+                    rank = int(preferred)
+                    if rank in self.live:
+                        # recovery is only for DEAD incarnations; a live
+                        # holder means a rank collision, not a restart
+                        return {"error": "rank %d is held by a live "
+                                         "worker" % rank}
+                    recovery = rank in self.registered
+                    self.registered.add(rank)
+                    if not recovery:
+                        self.next_rank = max(self.next_rank, rank + 1)
+                else:
+                    while self.next_rank in self.registered:
+                        self.next_rank += 1
+                    rank = self.next_rank
+                    self.registered.add(rank)
+                    self.next_rank += 1
+                    recovery = False
+                if conn is not None:
+                    conn.rank = rank
+                    self.live[rank] = conn
+            return {"rank": rank, "num_workers": self.num_workers,
+                    "is_recovery": recovery}
         if cmd == "init":
             with self.lock:
                 if msg["key"] not in self.keys:
@@ -164,14 +223,15 @@ class KVStoreServer:
         if cmd == "push":
             return self._push(msg["key"], msg["value"], msg["rank"])
         if cmd == "pull":
-            return self._pull(msg["key"], msg.get("version", 0))
+            return self._pull(msg["key"], msg.get("version", 0), conn)
         if cmd == "set_optimizer":
             get_updater = _pkg_mod("optimizer").get_updater
             with self.lock:
                 self.updater = get_updater(_loads(msg["bytes"]))
             return {}
         if cmd == "barrier":
-            return self._barrier()
+            return self._barrier(msg.get("rank"),
+                                 getattr(conn, "rank", None), conn)
         if cmd == "sync_mode":
             # reference kvstore.cc:32-35 — rank 0 commands kSyncMode to
             # servers when the type lacks _async
@@ -236,26 +296,44 @@ class KVStoreServer:
                 self.lock.notify_all()
             return {"version": rnd + 1}
 
-    def _pull(self, key, version):
+    def _wait_interruptible(self, conn, cond):
+        """Condition-wait (lock held) that notices a dead peer: a blocked
+        handler thread must release its rank, or the worker's restarted
+        incarnation is refused as a rank collision."""
+        while not cond():
+            self.lock.wait(timeout=1.0)
+            if cond():
+                return
+            if conn is not None and _sock_dead(conn.request):
+                raise _Disconnected()
+
+    def _pull(self, key, version, conn=None):
         with self.lock:
             st = self.keys.get(key)
             if st is None:
                 return {"error": "key %r not initialized" % key}
-            while st.version < version:
-                self.lock.wait()
+            self._wait_interruptible(conn, lambda: st.version >= version)
             return {"value": st.value, "version": st.version}
 
-    def _barrier(self):
+    def _barrier(self, rank, conn_rank, conn=None):
+        """Rank-tracked barrier: a dead worker's contribution is withdrawn
+        by on_disconnect, so a restart cannot release a generation early
+        or leave it off by one."""
         with self.lock:
             gen = self.barrier_gen
-            self.barrier_count += 1
-            if self.barrier_count == self.num_workers:
-                self.barrier_count = 0
+            r = rank if rank is not None else conn_rank
+            self.barrier_waiters.add(r)
+            if len(self.barrier_waiters) == self.num_workers:
+                self.barrier_waiters.clear()
                 self.barrier_gen += 1
                 self.lock.notify_all()
             else:
-                while self.barrier_gen == gen:
-                    self.lock.wait()
+                try:
+                    self._wait_interruptible(
+                        conn, lambda: self.barrier_gen != gen)
+                except _Disconnected:
+                    self.barrier_waiters.discard(r)
+                    raise
             return {}
 
     # -- lifecycle ---------------------------------------------------------

@@ -17,10 +17,12 @@ from .mesh import make_mesh, named_sharding
 from .moe import moe_apply, switch_moe
 from .pipeline import pipeline_apply, spmd_pipeline, stack_stage_params
 from .ring import ring_attention, ring_self_attention
+from .ulysses import ulysses_attention, ulysses_self_attention
 from .trainer import SPMDTrainer
 from . import lm
 
 __all__ = ["make_mesh", "named_sharding", "SPMDTrainer",
            "ring_attention", "ring_self_attention",
+           "ulysses_attention", "ulysses_self_attention",
            "moe_apply", "switch_moe",
            "pipeline_apply", "spmd_pipeline", "stack_stage_params", "lm"]

@@ -34,6 +34,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from .moe import switch_moe
 from .pipeline import spmd_pipeline
 from .ring import ring_attention
+from .ulysses import ulysses_attention
 
 
 def init_params(rng, vocab, embed, heads, ffn_hidden, n_experts, n_stages,
@@ -85,8 +86,13 @@ def _rmsnorm(x, g):
                                  + 1e-6).astype(x.dtype)
 
 
-def _stage_fn(params, x, *, heads, capacity_factor):
-    """One transformer block on local shards: x (mb, L_local, E)."""
+def _stage_fn(params, x, *, heads, capacity_factor, seq_impl="ring"):
+    """One transformer block on local shards: x (mb, L_local, E).
+
+    ``seq_impl``: sequence-parallel attention strategy — ``"ring"``
+    (ppermute online-softmax) or ``"ulysses"`` (all-to-all head reshard;
+    needs heads divisible by the model-axis size).
+    """
     mb, lloc, e = x.shape
     hd = e // heads
 
@@ -97,8 +103,9 @@ def _stage_fn(params, x, *, heads, capacity_factor):
     def to_heads(t):
         return t.reshape(mb, lloc, heads, hd).transpose(0, 2, 1, 3)
 
-    att = ring_attention(to_heads(q), to_heads(k), to_heads(v),
-                         axis_name="model", causal=True)
+    attn = ulysses_attention if seq_impl == "ulysses" else ring_attention
+    att = attn(to_heads(q), to_heads(k), to_heads(v),
+               axis_name="model", causal=True)
     att = att.transpose(0, 2, 1, 3).reshape(mb, lloc, e)
     x = x + jnp.einsum("ble,fe->blf", att, params["out_w"])
 
@@ -111,15 +118,26 @@ def _stage_fn(params, x, *, heads, capacity_factor):
 
 
 def make_train_step(mesh, heads, n_microbatches, lr=0.1, capacity_factor=4.0,
-                    aux_loss_coef=0.01):
+                    aux_loss_coef=0.01, seq_impl="ring"):
     """Returns jitted ``(params, tokens, labels) -> (params, loss)``.
 
     tokens/labels: (B, L) int32, B sharded over ``data``.  The Switch
     load-balancing loss (summed over stages) is added with
     ``aux_loss_coef`` — top-1 routing collapses onto few experts without it.
+    ``seq_impl`` picks the sequence-parallel attention: ``"ring"`` or
+    ``"ulysses"`` (heads must divide by the model-axis size).
     """
+    if seq_impl not in ("ring", "ulysses"):
+        raise ValueError("seq_impl must be 'ring' or 'ulysses', got %r"
+                         % (seq_impl,))
+    if seq_impl == "ulysses" and heads % mesh.shape["model"] != 0:
+        raise ValueError(
+            "seq_impl='ulysses' needs heads (%d) divisible by the model "
+            "axis size (%d); use seq_impl='ring'"
+            % (heads, mesh.shape["model"]))
     stage = functools.partial(_stage_fn, heads=heads,
-                              capacity_factor=capacity_factor)
+                              capacity_factor=capacity_factor,
+                              seq_impl=seq_impl)
 
     def pipe_body(stage_params, xs):
         out, aux = spmd_pipeline(stage, stage_params, xs, "pipe",

@@ -1,0 +1,88 @@
+"""Ulysses-style sequence parallelism: all-to-all head/sequence reshard.
+
+The second of the two context-parallel strategies (alongside
+``parallel.ring``; neither exists in the 2017 reference — SURVEY §5.7
+requires "ring attention or all-to-all sequence/context parallelism").
+
+Scheme (DeepSpeed-Ulysses, arXiv:2309.14509, re-expressed with XLA
+collectives): activations are sequence-sharded (B, L/n, H, D).  Before
+attention, one ``all_to_all`` over the mesh axis re-shards to
+head-sharded (B, L, H/n, D) — every device then holds FULL sequences for
+a SUBSET of heads, so plain (flash) attention runs locally with exact
+softmax and no ring bookkeeping.  A second ``all_to_all`` re-shards the
+context back to sequence-sharded.  Communication volume is 4·B·L·H·D/n
+per step (Q,K,V in + O out), constant in sequence length per device.
+
+Trade-off vs ring: Ulysses needs ``n_heads % n`` == 0 and moves
+activations twice, but each attention is a single dense local kernel (the
+Pallas flash path applies unchanged); ring keeps heads whole and overlaps
+transfer with compute but pays the online-softmax rescale per hop.  Both
+compose with dp/tp over other mesh axes.
+
+Differentiable end-to-end: ``lax.all_to_all`` has a transposable VJP (its
+own inverse permutation), so ``jax.grad`` through the wrapped attention
+serves training.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+__all__ = ["ulysses_attention", "ulysses_self_attention"]
+
+
+def _local_attention(q, k, v, causal, softmax_scale):
+    """Attention on full local sequences (B, Hl, L, D): the blockwise
+    flash kernel, so per-device memory stays O(L·block) and the sp memory
+    win is not given back to an L x L score matrix."""
+    from ..ops.attention import flash_attention
+
+    return flash_attention(q, k, v, causal=causal,
+                           softmax_scale=softmax_scale)
+
+
+def ulysses_attention(q, k, v, axis_name, causal=False, softmax_scale=None):
+    """All-to-all sequence parallelism.  Must run inside ``shard_map``;
+    q/k/v are LOCAL sequence shards (B, H, Lc, D) with H divisible by the
+    axis size.  Returns the local (B, H, Lc, D) context shard."""
+    n = jax.lax.psum(1, axis_name)
+    b, h, lc, d = q.shape
+    if softmax_scale is None:
+        softmax_scale = float(1.0 / np.sqrt(d))
+
+    def seq_to_head(x):
+        # (B, H, Lc, D) -> (B, H/n, n*Lc, D): gather sequence, scatter heads
+        return jax.lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                                  tiled=True)
+
+    def head_to_seq(x):
+        # inverse reshard: gather heads, scatter sequence
+        return jax.lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                                  tiled=True)
+
+    qh, kh, vh = seq_to_head(q), seq_to_head(k), seq_to_head(v)
+    o = _local_attention(qh, kh, vh, causal, softmax_scale)
+    return head_to_seq(o)
+
+
+def ulysses_self_attention(q, k, v, mesh, seq_axis="data", causal=False,
+                           softmax_scale=None):
+    """shard_map wrapper: shard (B, H, L, D) tensors over ``seq_axis`` on
+    the sequence dimension and run Ulysses attention across it (drop-in
+    alternative to ``ring_self_attention``)."""
+    axis_size = mesh.shape[seq_axis]
+    if q.shape[1] % axis_size != 0:
+        raise ValueError(
+            "ulysses: n_heads (%d) must divide by the %r axis size (%d); "
+            "use ring_self_attention for head counts that do not shard"
+            % (q.shape[1], seq_axis, axis_size))
+    spec = P(None, None, seq_axis, None)
+    fn = functools.partial(ulysses_attention, axis_name=seq_axis,
+                           causal=causal, softmax_scale=softmax_scale)
+    return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec, check_vma=False)(q, k, v)

@@ -150,3 +150,52 @@ def test_multihead_attention_op():
                           jnp.asarray(heads(vv)))
     ref = np.asarray(ref).transpose(0, 2, 1, 3).reshape(b, l, e) @ w_out.T + b_out
     np.testing.assert_allclose(out.asnumpy(), ref, rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_attention_matches_full(causal):
+    """All-to-all sequence parallelism (SURVEY §5.7 alternative to ring):
+    exact softmax, so it must match dense attention to tight tolerance."""
+    from mxnet_tpu.parallel import make_mesh, ulysses_self_attention
+
+    mesh = make_mesh(8, axis_names=("data",))
+    q, k, v = _rand_qkv(b=2, h=8, lq=64, lk=64, d=8, seed=7)
+    out = ulysses_self_attention(jnp.asarray(q), jnp.asarray(k),
+                                 jnp.asarray(v), mesh, seq_axis="data",
+                                 causal=causal)
+    ref = _attn_reference(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+                          causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ulysses_attention_grad():
+    from mxnet_tpu.parallel import make_mesh, ulysses_self_attention
+
+    mesh = make_mesh(8, axis_names=("data",))
+    q, k, v = _rand_qkv(b=1, h=8, lq=32, lk=32, d=8, seed=9)
+
+    def loss_u(q, k, v):
+        return (ulysses_self_attention(q, k, v, mesh, "data",
+                                       causal=True) ** 2).sum()
+
+    def loss_ref(q, k, v):
+        return (_attn_reference(q, k, v, causal=True) ** 2).sum()
+
+    g1 = jax.grad(loss_u, argnums=(0, 1, 2))(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+    g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-4)
+
+
+def test_ulysses_head_count_guard():
+    from mxnet_tpu.parallel import make_mesh, ulysses_self_attention
+
+    mesh = make_mesh(8, axis_names=("data",))
+    q, k, v = _rand_qkv(b=1, h=2, lq=32, lk=32, d=8, seed=3)  # 2 % 8 != 0
+    with pytest.raises(ValueError, match="n_heads"):
+        ulysses_self_attention(jnp.asarray(q), jnp.asarray(k),
+                               jnp.asarray(v), mesh, seq_axis="data")

@@ -198,3 +198,105 @@ def test_dist_optimizer_state_roundtrip(tmp_path):
     _run_workers(1, body)
     srv.close()
     assert os.path.getsize(fname) > 0
+
+
+def test_worker_restart_rejoin():
+    """Elastic recovery (reference ps-lite is_recovery, kvstore_dist.h:35,73):
+    a restarted worker reconnects with its old rank, finds the server's
+    weights intact, and subsequent sync rounds complete with the full
+    worker set."""
+    srv = _with_server(2)
+    kvs = {}
+    try:
+        def connect(wid):
+            os.environ["DMLC_WORKER_ID"] = str(wid)
+            kvs[wid] = kvstore.KVStoreDist("dist_sync")
+
+        connect(0)
+        connect(1)
+        kv0, kv1 = kvs[0], kvs[1]
+        assert (kv0.rank, kv1.rank) == (0, 1)
+        assert not kv0.is_recovery and not kv1.is_recovery
+
+        def both(fn0, fn1):
+            t = threading.Thread(target=fn1, daemon=True)
+            t.start()
+            fn0()
+            t.join(timeout=60)
+            assert not t.is_alive()
+
+        # init has a trailing barrier -> must run on both workers
+        # (all workers init the same value, as Module training does)
+        both(lambda: kv0.init(3, mx.nd.ones((4,)) * 5),
+             lambda: kv1.init(3, mx.nd.ones((4,)) * 5))
+        out = mx.nd.zeros((4,))
+        kv0.pull(3, out=out)
+        np.testing.assert_allclose(out.asnumpy(), 5.0)
+
+        # worker 0 "dies" and restarts with the same DMLC_WORKER_ID
+        kv0._sock.close()
+        connect(0)
+        kv0b = kvs[0]
+        assert kv0b.rank == 0 and kv0b.is_recovery
+        assert kv0b.num_workers == 2            # cluster size unchanged
+
+        # server state survived the worker restart
+        out = mx.nd.zeros((4,))
+        kv0b.pull(3, out=out)
+        np.testing.assert_allclose(out.asnumpy(), 5.0)
+
+        # a full sync round with the rejoined worker completes exactly
+        both(lambda: kv0b.push(3, mx.nd.ones((4,)) * 1.0),
+             lambda: kv1.push(3, mx.nd.ones((4,)) * 2.0))
+        out = mx.nd.zeros((4,))
+        kv0b.pull(3, out=out)
+        np.testing.assert_allclose(out.asnumpy(), 3.0)  # merged round: 1+2
+    finally:
+        os.environ.pop("DMLC_WORKER_ID", None)
+        srv.close()
+
+
+def test_mid_barrier_death_and_rank_collision():
+    """A worker that dies INSIDE a barrier must not desync the cluster
+    (its contribution is withdrawn on disconnect), and a live rank cannot
+    be stolen by a second registration."""
+    import socket as _socket
+
+    srv = _with_server(2)
+    try:
+        os.environ["DMLC_WORKER_ID"] = "0"
+        kv0 = kvstore.KVStoreDist("dist_sync")
+        os.environ["DMLC_WORKER_ID"] = "1"
+        kv1 = kvstore.KVStoreDist("dist_sync")
+
+        # live-rank collision is refused
+        os.environ["DMLC_WORKER_ID"] = "0"
+        with pytest.raises(mx.base.MXNetError, match="live worker"):
+            kvstore.KVStoreDist("dist_sync")
+
+        # rank 0 enters the barrier, then dies (shutdown sends FIN the way
+        # a killed process would)
+        t0 = threading.Thread(target=kv0.barrier, daemon=True)
+        t0.start()
+        import time
+
+        time.sleep(0.3)
+        kv0._sock.shutdown(_socket.SHUT_RDWR)
+        kv0._sock.close()
+        time.sleep(1.5)  # > the server's liveness-probe interval
+
+        os.environ["DMLC_WORKER_ID"] = "0"
+        kv0b = kvstore.KVStoreDist("dist_sync")
+        assert kv0b.rank == 0 and kv0b.is_recovery
+
+        # a FRESH barrier with the rejoined worker completes for both
+        done = []
+        tb = threading.Thread(
+            target=lambda: (kv1.barrier(), done.append(1)), daemon=True)
+        tb.start()
+        kv0b.barrier()
+        tb.join(timeout=60)
+        assert done, "barrier desynced after mid-barrier worker death"
+    finally:
+        os.environ.pop("DMLC_WORKER_ID", None)
+        srv.close()

@@ -6,6 +6,7 @@ The engine tests mirror the reference's ``tests/cpp/threaded_engine_test.cc``
 ABI.
 """
 
+import os
 import threading
 import time
 
@@ -214,3 +215,41 @@ def test_image_iter_parallel_decode(tmp_path):
     assert len(serial) == len(parallel) == 2
     for a, b in zip(serial, parallel):
         np.testing.assert_array_equal(a, b)
+
+
+def test_c_api_header_from_pure_c(tmp_path):
+    """include/mxnet_tpu/c_api.h is the binding surface: a pure-C program
+    compiled against it must drive the engine and storage pool (the
+    reference's c_api.h multi-language contract, SURVEY §2.7)."""
+    import subprocess
+
+    from mxnet_tpu.native import get_lib, _LIB_PATH
+
+    if get_lib() is None:
+        pytest.skip("native toolchain unavailable")
+    src = tmp_path / "t.c"
+    src.write_text(r'''
+#include "mxnet_tpu/c_api.h"
+#include <stdio.h>
+static int counter = 0;
+static void incr(void* ctx) { counter += *(int*)ctx; }
+int main(void) {
+  void* eng = EngineCreate(2, 0);
+  void* var = EngineNewVar(eng);
+  int three = 3; void* mv[1] = {var};
+  for (int i = 0; i < 10; i++) EnginePush(eng, incr, &three, 0, 0, mv, 1);
+  EngineWaitForAll(eng);
+  if (counter != 30) return 1;
+  void* st = StorageCreate();
+  void* p = StorageAlloc(st, 1024);
+  StorageRelease(st, p, 1024);
+  if (StorageAlloc(st, 1024) != p) return 2;
+  StorageFree(st); EngineFree(eng);
+  return 0;
+}
+''')
+    exe = tmp_path / "t"
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    subprocess.run(["gcc", "-I", os.path.join(repo, "include"), str(src),
+                    "-o", str(exe), _LIB_PATH, "-lpthread"], check=True)
+    subprocess.run([str(exe)], check=True)

@@ -131,3 +131,25 @@ def test_composite_lm_train_step():
         losses.append(float(loss))
     assert all(np.isfinite(l) for l in losses)
     assert losses[-1] < losses[0]
+
+
+def test_composite_lm_ulysses_seq_impl():
+    """Same composite step with Ulysses all-to-all sequence parallelism in
+    place of ring attention (heads divisible by the model axis)."""
+    from mxnet_tpu.parallel import lm
+
+    mesh = make_mesh(8, axis_names=("data", "model", "pipe"),
+                     shape=(2, 2, 2))
+    params = lm.init_params(0, vocab=64, embed=16, heads=2, ffn_hidden=32,
+                            n_experts=4, n_stages=2)
+    step = lm.make_train_step(mesh, heads=2, n_microbatches=2, lr=0.5,
+                              seq_impl="ulysses")
+    rs = np.random.RandomState(0)
+    tok = jnp.asarray(rs.randint(0, 64, (8, 16)), jnp.int32)
+    lab = jnp.asarray(rs.randint(0, 64, (8, 16)), jnp.int32)
+    losses = []
+    for _ in range(3):
+        params, loss = step(params, tok, lab)
+        losses.append(float(loss))
+    assert all(np.isfinite(l) for l in losses)
+    assert losses[-1] < losses[0]
