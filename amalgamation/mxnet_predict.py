@@ -310,12 +310,45 @@ class Predictor:
     """Minimal predict API (reference ``c_predict_api.cc`` shape):
     symbol JSON + params dict -> ``forward(data=...)`` -> outputs."""
 
+    # predict-path ops whose aux states are implicit in 0.9.x JSON:
+    # op -> (explicit arg count, aux names)
+    _LEGACY_AUX = {"BatchNorm": (3, ("moving_mean", "moving_var"))}
+
     def __init__(self, symbol_json, params):
         graph = json.loads(symbol_json) \
             if isinstance(symbol_json, str) else symbol_json
-        self.nodes = graph["nodes"]
+        # per-node copies: the legacy upgrade must not mutate a
+        # caller-owned graph dict (two Predictors may share it)
+        self.nodes = [dict(n) for n in graph["nodes"]]
         self.heads = [tuple(h[:2]) for h in graph["heads"]]
         self.params = dict(params)
+        if "mxnet_tpu_version" not in graph:
+            self._upgrade_legacy()
+
+    def _upgrade_legacy(self):
+        """Reference 0.9.x JSON: op params under 'param' (very old formats
+        mix them into 'attr'/'attrs'), aux-state inputs implicit — mirror
+        symbol.load_json's upgrade so saved reference models deploy
+        unchanged.  Unknown keys are harmless here (readers use .get), so
+        the pre-NNVM mixed dict is taken wholesale."""
+        for node in list(self.nodes):
+            if "attrs" not in node:
+                node["attrs"] = (node.pop("param", None)
+                                 or node.pop("attr", None) or {})
+            spec = self._LEGACY_AUX.get(node["op"])
+            if spec:
+                n_args, aux = spec
+                # only when the graph really left aux implicit (an explicit
+                # 0.9.x graph already lists all n_args + aux inputs)
+                if len(node["inputs"]) == n_args and \
+                        node["name"] + "_" + aux[0] in self.params:
+                    first_new = len(self.nodes)
+                    for an in aux:
+                        self.nodes.append({"op": "null", "attrs": {},
+                                           "name": node["name"] + "_" + an,
+                                           "inputs": []})
+                    node["inputs"] = list(node["inputs"]) + \
+                        [[first_new + j, 0] for j in range(len(aux))]
 
     @classmethod
     def from_checkpoint_bytes(cls, symbol_json, param_blob):
@@ -344,10 +377,11 @@ class Predictor:
                                        sorted(var_names - set(self.params))))
         vals = {}          # node id -> list of output arrays
         names = {}         # node id -> variable name (for error messages)
+        # variables first: legacy-upgrade may append aux variable nodes
+        # after their consumer, and they depend on nothing anyway
         for nid, node in enumerate(self.nodes):
-            op = node["op"]
-            name = node["name"]
-            if op == "null":
+            if node["op"] == "null":
+                name = node["name"]
                 if name in inputs:
                     v = np.asarray(inputs[name], np.float32)
                 elif name in self.params:
@@ -356,6 +390,10 @@ class Predictor:
                     v = None
                 vals[nid] = [v]
                 names[nid] = name
+        for nid, node in enumerate(self.nodes):
+            op = node["op"]
+            name = node["name"]
+            if op == "null":
                 continue
             if op not in _OPS:
                 raise NotImplementedError(

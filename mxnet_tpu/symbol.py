@@ -436,8 +436,35 @@ def Group(symbols):
     return Symbol(entries)
 
 
+def _upgrade_legacy_json(payload):
+    """Upgrade reference-MXNet symbol JSON in place (the
+    ``src/nnvm/legacy_json_util.cc`` analog): 0.9.x nodes carry op params
+    under ``"param"`` (or pre-NNVM ``"attr"``/``"attrs"`` mixing user
+    attributes in) and a ``backward_source_id`` field.  Saved reference
+    models load directly: ``mx.sym.load('ref-symbol.json')``."""
+    for jn in payload["nodes"]:
+        if "attrs" in jn and "misc_attrs" in jn:
+            continue  # native format
+        params = dict(jn.pop("param", {}) or {})
+        misc = dict(jn.pop("attr", {}) or jn.pop("attrs", {}) or {})
+        if jn["op"] != "null" and not params and misc:
+            # very old format: op params and user attrs share one dict —
+            # split by the op's declared param names
+            op = _reg.get(jn["op"])
+            params = {k: v for k, v in misc.items() if k in op.params}
+            misc = {k: v for k, v in misc.items() if k not in op.params}
+        jn["attrs"] = params
+        jn["misc_attrs"] = misc
+        jn.pop("backward_source_id", None)
+    # 0.9.x heads may be [id, index, version]; keep the first two fields
+    payload["heads"] = [h[:2] for h in payload["heads"]]
+    return payload
+
+
 def load_json(json_str):
     payload = json.loads(json_str)
+    if "mxnet_tpu_version" not in payload:
+        payload = _upgrade_legacy_json(payload)
     nodes = []
     for jn in payload["nodes"]:
         if jn["op"] == "null":
@@ -446,7 +473,15 @@ def load_json(json_str):
         else:
             op = _reg.get(jn["op"])
             attrs = op.canonicalize_attrs(jn.get("attrs", {}))
-            inputs = [(nodes[i], ci) for i, ci in jn["inputs"]]
+            inputs = [(nodes[i], ci) for i, ci, *_ in jn["inputs"]]
+            aux_names = op.list_aux_states(attrs)
+            if aux_names and len(inputs) == len(op.list_arguments(attrs)):
+                # reference 0.9.x JSON leaves aux states implicit (created
+                # at bind); our graph threads them as trailing inputs —
+                # synthesize the variables with the reference's names
+                inputs = inputs + [
+                    (_Node(None, "%s_%s" % (jn["name"], an), {}, []), 0)
+                    for an in aux_names]
             nodes.append(_Node(op, jn["name"], attrs, inputs,
                                jn.get("misc_attrs", {})))
     return Symbol([(nodes[i], ci) for i, ci in payload["heads"]])

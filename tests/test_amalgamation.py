@@ -138,3 +138,62 @@ def test_predictor_pooling_and_leakyrelu_parity():
     gamma = rs.rand(3).astype(np.float32)
     parity(mx.sym.LeakyReLU(d, act_type="prelu", name="pr"),
            {"data": x - 0.5}, params={"pr_gamma": gamma})
+
+
+def test_predictor_legacy_reference_json():
+    """0.9.x reference JSON (op params under 'param', implicit BN aux)
+    deploys through the numpy-only predictor unchanged."""
+    import json as _json
+
+    sys.path.insert(0, os.path.join(REPO, "amalgamation"))
+    try:
+        from mxnet_predict import Predictor
+    finally:
+        sys.path.pop(0)
+    legacy = {
+        "nodes": [
+            {"op": "null", "param": {}, "name": "data", "inputs": [],
+             "backward_source_id": -1},
+            {"op": "null", "param": {}, "name": "fc_weight", "inputs": [],
+             "backward_source_id": -1},
+            {"op": "null", "param": {}, "name": "fc_bias", "inputs": [],
+             "backward_source_id": -1},
+            {"op": "FullyConnected",
+             "param": {"no_bias": "False", "num_hidden": "6"},
+             "name": "fc", "inputs": [[0, 0], [1, 0], [2, 0]],
+             "backward_source_id": -1},
+            {"op": "null", "param": {}, "name": "bn_gamma", "inputs": [],
+             "backward_source_id": -1},
+            {"op": "null", "param": {}, "name": "bn_beta", "inputs": [],
+             "backward_source_id": -1},
+            {"op": "BatchNorm",
+             "param": {"eps": "0.001", "fix_gamma": "False",
+                       "momentum": "0.9", "use_global_stats": "False"},
+             "name": "bn", "inputs": [[3, 0], [4, 0], [5, 0]],
+             "backward_source_id": -1},
+            {"op": "null", "param": {}, "name": "softmax_label",
+             "inputs": [], "backward_source_id": -1},
+            {"op": "SoftmaxOutput", "param": {"grad_scale": "1"},
+             "name": "softmax", "inputs": [[6, 0], [7, 0]],
+             "backward_source_id": -1},
+        ],
+        "arg_nodes": [0, 1, 2, 4, 5, 7],
+        "heads": [[8, 0]],
+    }
+    js = _json.dumps(legacy)
+    net = mx.sym.load_json(js)
+    ex = net.simple_bind(mx.cpu(), data=(3, 4), softmax_label=(3,))
+    rs = np.random.RandomState(0)
+    for n, a in ex.arg_dict.items():
+        a[:] = rs.rand(*a.shape).astype(np.float32)
+    for n, a in ex.aux_dict.items():
+        a[:] = (np.zeros(a.shape, np.float32) if "mean" in n
+                else np.ones(a.shape, np.float32))
+    x = rs.rand(3, 4).astype(np.float32)
+    ex.arg_dict["data"][:] = x
+    ref = ex.forward(is_train=False)[0].asnumpy()
+    params = {n: a.asnumpy() for n, a in ex.arg_dict.items()
+              if n not in ("data", "softmax_label")}
+    params.update({n: a.asnumpy() for n, a in ex.aux_dict.items()})
+    got = Predictor(js, params).forward(data=x)[0]
+    assert_almost_equal(got, ref, rtol=1e-3, atol=1e-4)

@@ -106,3 +106,61 @@ def test_get_internals():
     assert "fc1_output" in names
     feat = internals["fc1_output"]
     assert feat.list_outputs() == ["fc1_output"]
+
+
+def test_load_legacy_reference_json():
+    """0.9.x reference symbol JSON loads directly (legacy_json_util.cc
+    analog): op params under 'param', user attrs under 'attr',
+    backward_source_id fields, implicit BatchNorm aux states."""
+    legacy = {
+        "nodes": [
+            {"op": "null", "param": {}, "name": "data", "inputs": [],
+             "backward_source_id": -1,
+             "attr": {"ctx_group": "stage1"}},
+            {"op": "null", "param": {}, "name": "fc_weight", "inputs": [],
+             "backward_source_id": -1},
+            {"op": "null", "param": {}, "name": "fc_bias", "inputs": [],
+             "backward_source_id": -1},
+            {"op": "FullyConnected",
+             "param": {"no_bias": "False", "num_hidden": "6"},
+             "name": "fc", "inputs": [[0, 0], [1, 0], [2, 0]],
+             "backward_source_id": -1},
+            {"op": "null", "param": {}, "name": "bn_gamma", "inputs": [],
+             "backward_source_id": -1},
+            {"op": "null", "param": {}, "name": "bn_beta", "inputs": [],
+             "backward_source_id": -1},
+            {"op": "BatchNorm",
+             "param": {"eps": "0.001", "fix_gamma": "True",
+                       "momentum": "0.9", "use_global_stats": "False"},
+             "name": "bn", "inputs": [[3, 0], [4, 0], [5, 0]],
+             "backward_source_id": -1},
+            {"op": "null", "param": {}, "name": "softmax_label",
+             "inputs": [], "backward_source_id": -1},
+            {"op": "SoftmaxOutput", "param": {"grad_scale": "1"},
+             "name": "softmax", "inputs": [[6, 0], [7, 0]],
+             "backward_source_id": -1},
+        ],
+        "arg_nodes": [0, 1, 2, 4, 5, 7],
+        "heads": [[8, 0]],
+    }
+    import json as _json
+
+    net = sym.load_json(_json.dumps(legacy))
+    assert net.list_arguments() == ["data", "fc_weight", "fc_bias",
+                                    "bn_gamma", "bn_beta", "softmax_label"]
+    # implicit aux states synthesized with reference naming
+    assert net.list_auxiliary_states() == ["bn_moving_mean",
+                                           "bn_moving_var"]
+    deep = net.list_attr(recursive=True)
+    assert any(v == "stage1" for k, v in deep.items()
+               if "ctx_group" in k), deep
+    ex = net.simple_bind(mx.cpu(), data=(2, 4), softmax_label=(2,))
+    rs = np.random.RandomState(0)
+    for n, a in ex.arg_dict.items():
+        a[:] = rs.rand(*a.shape).astype(np.float32)
+    out = ex.forward(is_train=False)[0]
+    assert out.shape == (2, 6)
+    # native round-trip stays native
+    again = sym.load_json(net.tojson())
+    assert again.list_arguments() == net.list_arguments()
+    assert again.list_auxiliary_states() == net.list_auxiliary_states()
