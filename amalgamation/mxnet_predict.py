@@ -352,13 +352,58 @@ class Predictor:
 
     @classmethod
     def from_checkpoint_bytes(cls, symbol_json, param_blob):
-        """param_blob: the .params file bytes (npz with arg:/aux: keys)."""
-        with np.load(io.BytesIO(param_blob)) as z:
-            params = {}
-            for k in z.files:
-                name = k.split(":", 1)[1] if ":" in k else k
-                name = name.split(":", 1)[1] if ":" in name else name
-                params[name] = z[k]
+        """param_blob: .params bytes — the dmlc magic-header stream
+        (reference ``ndarray.cc:650``; flag 5 = bfloat16 extension, read
+        back as f32 here) or the framework's earlier npz container."""
+        import struct
+
+        params = {}
+        if len(param_blob) >= 8 and \
+                struct.unpack("<Q", param_blob[:8])[0] == 0x112:
+            flags = {0: np.float32, 1: np.float64, 2: np.float16,
+                     3: np.uint8, 4: np.int32}
+            f = io.BytesIO(param_blob)
+
+            def rd(fmt):
+                return struct.unpack(fmt, f.read(struct.calcsize(fmt)))
+
+            rd("<QQ")
+            (count,) = rd("<Q")
+            arrays = []
+            for _ in range(count):
+                (ndim,) = rd("<I")
+                shape = rd("<%dI" % ndim) if ndim else ()
+                rd("<ii")
+                (flag,) = rd("<i")
+                if flag != 5 and flag not in flags:
+                    raise ValueError(
+                        "params file uses unsupported dtype flag %d "
+                        "(supported: f32/f64/f16/u8/i32 + 5=bfloat16 "
+                        "extension)" % flag)
+                n = 1
+                for s in shape:
+                    n *= s
+                if flag == 5:      # bfloat16 -> widen to f32 (numpy-only)
+                    raw = np.frombuffer(f.read(2 * n), np.uint16)
+                    widened = (raw.astype(np.uint32) << 16).view(np.float32)
+                    arrays.append(widened.reshape(shape))
+                else:
+                    dt = np.dtype(flags[flag])
+                    arrays.append(np.frombuffer(f.read(dt.itemsize * n),
+                                                dt).reshape(shape))
+            (n_names,) = rd("<Q")
+            names = []
+            for _ in range(n_names):
+                (ln,) = rd("<Q")
+                names.append(f.read(ln).decode())
+            for k, a in zip(names, arrays):
+                params[k.split(":", 1)[1] if ":" in k else k] = a
+        else:
+            with np.load(io.BytesIO(param_blob)) as z:
+                for k in z.files:
+                    name = k.split(":", 1)[1] if ":" in k else k
+                    name = name.split(":", 1)[1] if ":" in name else name
+                    params[name] = z[k]
         return cls(symbol_json, params)
 
     # ops that tolerate a missing (None) trailing label input at predict

@@ -435,19 +435,102 @@ def waitall():
 
 
 # ---------------------------------------------------------------------------
-# save / load — same API as reference ``nd.save/load`` (``ndarray.py:1740``).
-# Container format is ours (npz), not the dmlc magic-header stream.
+# save / load — same API as reference ``nd.save/load`` (``ndarray.py:1740``)
+# AND the same on-disk bytes: the dmlc magic-header stream
+# (``src/ndarray/ndarray.cc:650-678``: uint64 magic 0x112 + reserved,
+# vector<NDArray> [TShape(u32 ndim + u32 dims) + Context(i32 type,id) +
+# i32 dtype flag + raw bytes], vector<string> names) — params files are
+# byte-compatible with reference tooling in both directions.  Loading also
+# auto-detects this framework's earlier .npz container.
 # ---------------------------------------------------------------------------
+import struct as _struct
+
+_DMLC_MAGIC = 0x112
+# reference mshadow type flags (0.9.x); 5 is unused there — claimed here as
+# a bfloat16 extension so TPU-dtype arrays round-trip exactly
+_FLAG_TO_DTYPE = {0: "float32", 1: "float64", 2: "float16", 3: "uint8",
+                  4: "int32", 5: "bfloat16"}
+_DTYPE_TO_FLAG = {v: k for k, v in _FLAG_TO_DTYPE.items()}
+
+
+def _save_dmlc(f, names, arrays):
+    f.write(_struct.pack("<QQ", _DMLC_MAGIC, 0))
+    f.write(_struct.pack("<Q", len(arrays)))
+    for a in arrays:
+        arr = a.asnumpy() if isinstance(a, NDArray) else np.asarray(a)
+        dname = str(a._jx.dtype) if isinstance(a, NDArray) else str(arr.dtype)
+        if dname not in _DTYPE_TO_FLAG:
+            raise MXNetError("save: dtype %r has no dmlc type flag" % dname)
+        if dname == "bfloat16":
+            arr = np.asarray(a._jx).view(np.uint16) \
+                if isinstance(a, NDArray) else arr.view(np.uint16)
+        f.write(_struct.pack("<I", arr.ndim))
+        f.write(_struct.pack("<%dI" % arr.ndim, *arr.shape))
+        f.write(_struct.pack("<ii", 1, 0))           # Context: cpu(0)
+        f.write(_struct.pack("<i", _DTYPE_TO_FLAG[dname]))
+        f.write(np.ascontiguousarray(arr).tobytes())
+    f.write(_struct.pack("<Q", len(names)))
+    for n in names:
+        b = n.encode()
+        f.write(_struct.pack("<Q", len(b)) + b)
+
+
+def _load_dmlc(f):
+    def rdbytes(size):
+        buf = f.read(size)
+        if len(buf) != size:
+            raise MXNetError("truncated params file")
+        return buf
+
+    def rd(fmt):
+        return _struct.unpack(fmt, rdbytes(_struct.calcsize(fmt)))
+
+    magic, _reserved = rd("<QQ")
+    if magic != _DMLC_MAGIC:
+        raise MXNetError("bad params magic 0x%x" % magic)
+    (count,) = rd("<Q")
+    arrays = []
+    for _ in range(count):
+        (ndim,) = rd("<I")
+        shape = rd("<%dI" % ndim) if ndim else ()
+        _dev_type, _dev_id = rd("<ii")
+        (flag,) = rd("<i")
+        dname = _FLAG_TO_DTYPE.get(flag)
+        if dname is None:
+            raise MXNetError("unknown dtype flag %d" % flag)
+        if dname == "bfloat16":
+            import jax.numpy as jnp_
+
+            n = int(np.prod(shape)) if shape else 1
+            raw = np.frombuffer(rdbytes(2 * n), np.uint16).reshape(shape)
+            arrays.append(array(raw.view(jnp_.bfloat16)))
+        else:
+            dt = np.dtype(dname)
+            n = int(np.prod(shape)) if shape else 1
+            raw = np.frombuffer(rdbytes(dt.itemsize * n), dt).reshape(shape)
+            arrays.append(array(raw))
+    (n_names,) = rd("<Q")
+    if n_names and n_names != len(arrays):
+        raise MXNetError("malformed params file: %d names for %d arrays"
+                         % (n_names, len(arrays)))
+    names = []
+    for _ in range(n_names):
+        (ln,) = rd("<Q")
+        names.append(rdbytes(ln).decode())
+    return names, arrays
+
+
 def save(fname, data):
     if isinstance(data, NDArray):
         data = [data]
     if isinstance(data, dict):
-        payload = {"d:" + k: v.asnumpy() for k, v in data.items()}
+        names, arrays = list(data.keys()), list(data.values())
     elif isinstance(data, (list, tuple)):
-        payload = {"l:%09d" % i: v.asnumpy() for i, v in enumerate(data)}
+        names, arrays = [], list(data)
     else:
         raise MXNetError("save: need NDArray, list, or dict")
-    np.savez(fname if str(fname).endswith(".npz") else str(fname), **payload)
+    with open(str(fname), "wb") as f:
+        _save_dmlc(f, names, arrays)
 
 
 def _load_path(fname):
@@ -461,7 +544,19 @@ def _load_path(fname):
 
 
 def load(fname):
-    with np.load(_load_path(fname)) as f:
+    path = _load_path(fname)
+    with open(path, "rb") as f:
+        head = f.read(8)
+    if len(head) == 8 and _struct.unpack("<Q", head)[0] == _DMLC_MAGIC:
+        with open(path, "rb") as f:
+            names, arrays = _load_dmlc(f)
+        if not names:
+            # 0 names: a nameless list save — except 0 arrays, which is an
+            # empty dict save (dict-expecting callers dominate)
+            return arrays if arrays else {}
+        return dict(zip(names, arrays))
+    # back-compat: this framework's earlier .npz container
+    with np.load(path) as f:
         keys = sorted(f.files)
         if not keys:
             return {}

@@ -31,16 +31,29 @@ def load_ndarray_file(blob):
     """Parse a params blob (bytes or filename) -> dict name->numpy.
 
     The analog of ``MXNDListCreate`` over ``NDArray::Load``'s magic-header
-    dict format (``include/mxnet/ndarray.h:333-347``); this framework's
-    container format is npz (``ndarray.save``).
+    dict format (``include/mxnet/ndarray.h:333-347``); the dmlc stream is
+    the on-disk format (``ndarray.save``), with auto-detected fallback to
+    this framework's earlier npz container.
     """
+    import struct as _struct
+
     if isinstance(blob, (bytes, bytearray)):
-        f = np.load(_io.BytesIO(bytes(blob)))
+        fh = _io.BytesIO(bytes(blob))
     else:
-        f = np.load(nd._load_path(blob))
-    with f:
-        return {k[2:] if k[:2] in ("d:", "l:") else k: np.asarray(f[k])
-                for k in f.files}
+        fh = open(nd._load_path(blob), "rb")
+    with fh:
+        head = fh.read(8)
+        fh.seek(0)
+        if len(head) == 8 and \
+                _struct.unpack("<Q", head)[0] == nd._DMLC_MAGIC:
+            # stream straight from the handle: no second in-memory copy
+            names, arrays = nd._load_dmlc(fh)
+            if not names:
+                names = ["%09d" % i for i in range(len(arrays))]
+            return {k: a.asnumpy() for k, a in zip(names, arrays)}
+        with np.load(fh) as f:
+            return {k[2:] if k[:2] in ("d:", "l:") else k: np.asarray(f[k])
+                    for k in f.files}
 
 
 class Predictor:

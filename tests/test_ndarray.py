@@ -147,3 +147,44 @@ def test_imdecode_legacy_fn():
             raise AssertionError("imdecode %r should raise" % kw)
         except MXNetError:
             pass
+
+
+def test_params_dmlc_byte_format():
+    """nd.save writes the reference's magic-header stream byte-for-byte
+    (ndarray.cc:650: u64 0x112 + reserved, vector<NDArray>, vector<string>)
+    and nd.load reads reference-written files + the old npz container."""
+    import struct
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        p = os.path.join(td, "t.params")
+        nd.save(p, [nd.array(np.array([1.5], np.float32))])
+        raw = open(p, "rb").read()
+        magic, res, cnt, ndim, d0 = struct.unpack("<QQQII", raw[:32])
+        assert (magic, res, cnt, ndim, d0) == (0x112, 0, 1, 1, 1)
+        devt, _devi, flag = struct.unpack("<iii", raw[32:44])
+        assert (devt, flag) == (1, 0)
+        assert struct.unpack("<f", raw[44:48])[0] == 1.5
+
+        # a reference-style file (gpu context, arg: prefix) loads
+        buf = struct.pack("<QQQ", 0x112, 0, 1)
+        buf += struct.pack("<I", 2) + struct.pack("<II", 2, 2)
+        buf += struct.pack("<ii", 2, 0) + struct.pack("<i", 0)
+        buf += np.arange(4, dtype=np.float32).tobytes()
+        buf += struct.pack("<Q", 1) + struct.pack("<Q", 9) + b"arg:fc1_w"
+        rp = os.path.join(td, "ref.params")
+        open(rp, "wb").write(buf)
+        r = nd.load(rp)
+        assert list(r) == ["arg:fc1_w"]
+        assert np.allclose(r["arg:fc1_w"].asnumpy(),
+                           np.arange(4).reshape(2, 2))
+
+        # bfloat16 round-trips via the flag-5 extension
+        import jax.numpy as jnp
+
+        bp = os.path.join(td, "b.params")
+        nd.save(bp, {"p": nd.array(np.array([1.0, 2.5], np.float32),
+                                   dtype="bfloat16")})
+        rb = nd.load(bp)
+        assert rb["p"]._jx.dtype == jnp.bfloat16
+        assert np.allclose(np.asarray(rb["p"]._jx, np.float32), [1.0, 2.5])
