@@ -362,7 +362,14 @@ def run_server():
         jax.config.update("jax_platforms", "cpu")
     num_workers = int(os.environ["DMLC_NUM_WORKER"])
     port = int(os.environ.get("DMLC_PS_ROOT_PORT", "9090"))
-    host = os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1")
+    # bind address is separate from the advertised DMLC_PS_ROOT_URI: on
+    # multi-host launches the hostname may resolve to loopback locally
+    # (Debian's 127.0.1.1 convention), so bind all interfaces whenever the
+    # advertised address is non-loopback
+    advertised = os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1")
+    default_bind = advertised if advertised in ("127.0.0.1", "localhost") \
+        else ""
+    host = os.environ.get("MXNET_PS_BIND_HOST", default_bind)
     # mode is commanded by the workers (kSyncMode); start async
     srv = KVStoreServer(num_workers, sync_mode=False, host=host, port=port)
     srv.serve_forever()

@@ -300,3 +300,32 @@ def test_mid_barrier_death_and_rank_collision():
     finally:
         os.environ.pop("DMLC_WORKER_ID", None)
         srv.close()
+
+
+def test_launcher_ssh_mode(tmp_path):
+    """--launcher ssh spawns workers via the ssh binary with the wire env
+    inlined; a local stub standing in for ssh executes the remote command,
+    proving the full command/env construction (reference dmlc-tracker ssh
+    backend shape)."""
+    script = tmp_path / "worker.py"
+    script.write_text(_LAUNCH_SCRIPT)
+    hostfile = tmp_path / "hosts"
+    hostfile.write_text("hostA\nhostB\n")
+    # stub "ssh <host> <remote-cmd>": drops the host, runs the command
+    stub = tmp_path / "fake_ssh.sh"
+    stub.write_text("#!/bin/sh\nshift\nexec sh -c \"$@\"\n")
+    stub.chmod(0o755)
+    env = dict(os.environ, OUT_DIR=str(tmp_path), JAX_PLATFORMS="cpu",
+               MXNET_LAUNCH_SSH=str(stub))
+    env.pop("DMLC_PS_ROOT_PORT", None)
+    # exercise the real ssh addressing path (gethostname advertise +
+    # bind-all), not the 127.0.0.1 left over from earlier tests
+    env.pop("DMLC_PS_ROOT_URI", None)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+         "-n", "2", "--launcher", "ssh", "--hostfile", str(hostfile),
+         "--env", "OUT_DIR=%s" % tmp_path, "--env", "JAX_PLATFORMS=cpu",
+         sys.executable, str(script)],
+        env=env, timeout=300, capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert (tmp_path / "ok.0").exists() and (tmp_path / "ok.1").exists()

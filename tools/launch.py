@@ -1,20 +1,29 @@
 #!/usr/bin/env python
-"""Local cluster launcher for dist_* training.
+"""Cluster launcher for dist_* training.
 
 Reference: ``tools/launch.py`` (dmlc-tracker; local/ssh/mpi/sge/yarn
-backends).  This implements the ``local`` backend — the one the reference's
-nightly distributed tests use (``tests/nightly/test_all.sh:37``:
-``launch.py -n 4 python dist_sync_kvstore.py``) — spawning 1 parameter
-server + N workers on this machine, wired by the same ``DMLC_*`` env
-protocol.  Multi-host TPU launches should instead use the platform's pod
-runtime (one process per host + ``jax.distributed``); this launcher covers
-the PS-semantics path and single-host multi-process testing.
+backends).  Implemented here:
 
-Usage: python tools/launch.py -n 2 [--sync-dst-dir ignored] CMD...
+* ``local`` — the backend the reference's nightly distributed tests use
+  (``tests/nightly/test_all.sh:37``: ``launch.py -n 4 python
+  dist_sync_kvstore.py``): 1 parameter server + N workers on this machine,
+  wired by the same ``DMLC_*`` env protocol.
+* ``ssh`` — the reference's multi-host backend: one worker per line of
+  ``--hostfile`` (round-robin when hosts < workers), server on this host,
+  env forwarded inline on the remote command like dmlc-tracker does.
+  ``MXNET_LAUNCH_SSH`` overrides the ssh binary (tests substitute a local
+  stub).
+
+Multi-host TPU pods should normally use the platform's pod runtime (one
+process per host + ``jax.distributed``); these launchers cover the
+PS-semantics path and reference CLI parity.
+
+Usage: python tools/launch.py -n 2 [--launcher ssh --hostfile hosts] CMD...
 """
 
 import argparse
 import os
+import shlex
 import socket
 import subprocess
 import sys
@@ -29,19 +38,46 @@ def _free_port():
     return port
 
 
+def _spawn_local(cmd, env):
+    return subprocess.Popen(cmd, env=env)
+
+
+def _spawn_ssh(host, cmd, env, base_keys):
+    """Run cmd on host with the DMLC_*/MXNET_* env inlined (dmlc-tracker
+    forwards the wire-protocol env the same way)."""
+    ssh = os.environ.get("MXNET_LAUNCH_SSH", "ssh")
+    exports = " ".join("%s=%s" % (k, shlex.quote(str(env[k])))
+                       for k in sorted(base_keys) if k in env)
+    remote = "cd %s && env %s %s" % (
+        shlex.quote(env.get("MXNET_LAUNCH_CWD", os.getcwd())), exports,
+        " ".join(shlex.quote(c) for c in cmd))
+    return subprocess.Popen(shlex.split(ssh) + [host, remote])
+
+
 def main():
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("-n", "--num-workers", type=int, required=True)
     p.add_argument("-s", "--num-servers", type=int, default=1,
                    help="kept for reference CLI parity; the TPU PS is a "
                         "single threaded server process")
-    p.add_argument("--launcher", default="local", choices=["local"])
+    p.add_argument("--launcher", default="local", choices=["local", "ssh"])
+    p.add_argument("-H", "--hostfile", type=str, default=None,
+                   help="ssh launcher: file with one host per line")
     p.add_argument("--env", action="append", default=[],
                    help="extra VAR=VALUE to pass to all processes")
     p.add_argument("command", nargs=argparse.REMAINDER)
     args = p.parse_args()
     if not args.command:
         p.error("no command given")
+    hosts = None
+    if args.launcher == "ssh":
+        if not args.hostfile:
+            p.error("--launcher ssh requires --hostfile")
+        with open(args.hostfile) as f:
+            hosts = [h for h in (ln.strip() for ln in f)
+                     if h and not h.startswith("#")]
+        if not hosts:
+            p.error("hostfile %s is empty" % args.hostfile)
 
     port = _free_port()
     base_env = dict(os.environ)
@@ -50,14 +86,23 @@ def main():
         base_env[k] = v
     here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     pypath = base_env.get("PYTHONPATH", "")
-    base_env.update({
-        "DMLC_PS_ROOT_URI": "127.0.0.1",
+    # ssh workers must reach the server on this host's address
+    root_uri = "127.0.0.1" if args.launcher == "local" \
+        else base_env.get("DMLC_PS_ROOT_URI", socket.gethostname())
+    wire = {
+        "DMLC_PS_ROOT_URI": root_uri,
         "DMLC_PS_ROOT_PORT": str(port),
         "DMLC_NUM_WORKER": str(args.num_workers),
         "DMLC_NUM_SERVER": "1",
         "PYTHONPATH": here + (os.pathsep + pypath if pypath else ""),
-    })
+    }
+    base_env.update(wire)
+    # keys forwarded to remote hosts (wire protocol + role, per-worker id)
+    fwd_keys = set(wire) | {"DMLC_ROLE", "DMLC_WORKER_ID"} | \
+        {kv.split("=", 1)[0] for kv in args.env}
 
+    # server always runs on the launching host (reference scheduler-host
+    # convention for the single-server setup)
     server = subprocess.Popen(
         [sys.executable, "-m", "mxnet_tpu.kvstore_server"],
         env=dict(base_env, DMLC_ROLE="server"),
@@ -66,10 +111,12 @@ def main():
 
     workers = []
     for rank in range(args.num_workers):
-        workers.append(subprocess.Popen(
-            args.command,
-            env=dict(base_env, DMLC_ROLE="worker",
-                     DMLC_WORKER_ID=str(rank))))
+        env = dict(base_env, DMLC_ROLE="worker", DMLC_WORKER_ID=str(rank))
+        if args.launcher == "ssh":
+            host = hosts[rank % len(hosts)]
+            workers.append(_spawn_ssh(host, args.command, env, fwd_keys))
+        else:
+            workers.append(_spawn_local(args.command, env))
     rc = 0
     for w in workers:
         rc |= w.wait()
