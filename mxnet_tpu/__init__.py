@@ -53,6 +53,7 @@ from . import model
 from .model import FeedForward
 from . import models
 
+from . import log
 from . import operator
 from . import predict
 from . import profiler

@@ -1,0 +1,50 @@
+"""Logging helpers (reference ``python/mxnet/log.py``): a leveled,
+optionally-colored formatter and a ``get_logger`` convenience."""
+
+from __future__ import annotations
+
+import logging
+import sys
+
+__all__ = ["get_logger"]
+
+_COLORS = {"WARNING": "\x1b[0;33m", "ERROR": "\x1b[0;31m",
+           "CRITICAL": "\x1b[0;35m", "DEBUG": "\x1b[0;36m"}
+_RESET = "\x1b[0m"
+
+
+class _Formatter(logging.Formatter):
+    """Reference-style single-letter level prefix, colored on ttys."""
+
+    def __init__(self, colored):
+        # static format string: record data (e.g. a logger name containing
+        # '%') must never be interpolated into the format itself
+        super().__init__("%(levelname).1s%(asctime)s %(name)s] %(message)s",
+                         "%m%d %H:%M:%S")
+        self._colored = colored
+
+    def format(self, record):
+        out = super().format(record)
+        if self._colored and record.levelname in _COLORS:
+            head, sep, tail = out.partition("] ")
+            out = _COLORS[record.levelname] + head + _RESET + sep + tail
+        return out
+
+
+def get_logger(name=None, filename=None, filemode=None, level=logging.INFO):
+    """Create/fetch a logger with the framework formatter attached
+    (reference ``log.py`` getLogger)."""
+    logger = logging.getLogger(name)
+    if getattr(logger, "_mxnet_tpu_init", False):
+        return logger
+    if filename:
+        handler = logging.FileHandler(filename, filemode or "a")
+        colored = False
+    else:
+        handler = logging.StreamHandler(sys.stderr)
+        colored = getattr(sys.stderr, "isatty", lambda: False)()
+    handler.setFormatter(_Formatter(colored))
+    logger.addHandler(handler)
+    logger.setLevel(level)
+    logger._mxnet_tpu_init = True
+    return logger
