@@ -1,0 +1,187 @@
+#!/usr/bin/env python
+"""PTB-style LSTM LM with the fused RNN op — cuDNN-variant of BASELINE #3.
+
+Reference: ``example/rnn/cudnn_lstm_bucketing.py`` — ``FusedRNNCell``
+(cuDNN ``cudnnRNNForwardTraining`` path, here the scan-based fused ``RNN``
+op), optional per-layer stacking with dropout (``--stack-rnn``, :78-88),
+bidirectional mode, TN layout for the iterator + TNC unroll (:65,96), and
+test mode that loads a fused checkpoint into an *unfused* inference stack
+via ``cell.unfuse()`` + ``load_rnn_checkpoint`` (:131-160).
+
+No-egress note: synthesizes a Markov-chain corpus when PTB is absent (same
+scheme as ``lstm_bucketing.py``).
+"""
+
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+
+parser = argparse.ArgumentParser(
+    description="Train a fused-LSTM LM with bucketing",
+    formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+parser.add_argument("--test", default=False, action="store_true",
+                    help="evaluate an unfused copy of a saved model")
+parser.add_argument("--model-prefix", type=str, default=None)
+parser.add_argument("--load-epoch", type=int, default=0)
+parser.add_argument("--num-layers", type=int, default=2)
+parser.add_argument("--num-hidden", type=int, default=200)
+parser.add_argument("--num-embed", type=int, default=200)
+parser.add_argument("--bidirectional", default=False, action="store_true")
+parser.add_argument("--stack-rnn", default=False, action="store_true",
+                    help="one fused cell per layer with dropout between")
+parser.add_argument("--dropout", type=float, default=0.0)
+parser.add_argument("--kv-store", type=str, default="device")
+parser.add_argument("--num-epochs", type=int, default=3)
+parser.add_argument("--lr", type=float, default=0.02)
+parser.add_argument("--optimizer", type=str, default="sgd")
+parser.add_argument("--mom", type=float, default=0.0)
+parser.add_argument("--wd", type=float, default=1e-5)
+parser.add_argument("--batch-size", type=int, default=32)
+parser.add_argument("--disp-batches", type=int, default=50)
+parser.add_argument("--num-sentences", type=int, default=2000)
+parser.add_argument("--vocab-size", type=int, default=100)
+
+BUCKETS = [10, 20, 30, 40, 50, 60]
+START_TOKEN = 2  # 0 = pad/invalid, 1 = unk
+
+
+def synth_corpus(num_sentences, vocab, seed=3):
+    succ = np.random.RandomState(42).randint(START_TOKEN, vocab,
+                                             size=(vocab, 3))
+    rs = np.random.RandomState(seed)
+    sents = []
+    for _ in range(num_sentences):
+        n = int(rs.choice(BUCKETS)) - rs.randint(0, 5)
+        tok = int(rs.randint(START_TOKEN, vocab))
+        sent = [tok]
+        for _ in range(max(n, 2) - 1):
+            tok = int(succ[tok, rs.randint(0, 3)]) \
+                if rs.rand() < 0.9 else int(rs.randint(START_TOKEN, vocab))
+            sent.append(tok)
+        sents.append(sent)
+    return sents
+
+
+def get_data(args, layout):
+    """reference cudnn_lstm_bucketing.py:63-74 (TN layout for fused path)"""
+    train_sent = synth_corpus(args.num_sentences, args.vocab_size)
+    val_sent = synth_corpus(args.num_sentences // 10, args.vocab_size,
+                            seed=17)
+    data_train = mx.rnn.BucketSentenceIter(train_sent, args.batch_size,
+                                           buckets=BUCKETS, invalid_label=0,
+                                           layout=layout)
+    data_val = mx.rnn.BucketSentenceIter(val_sent, args.batch_size,
+                                         buckets=BUCKETS, invalid_label=0,
+                                         layout=layout)
+    return data_train, data_val
+
+
+def build_cell(args):
+    """reference cudnn_lstm_bucketing.py:78-90"""
+    if args.stack_rnn:
+        cell = mx.rnn.SequentialRNNCell()
+        for i in range(args.num_layers):
+            cell.add(mx.rnn.FusedRNNCell(args.num_hidden, num_layers=1,
+                                         mode="lstm", prefix="lstm_l%d_" % i,
+                                         bidirectional=args.bidirectional))
+            if args.dropout > 0 and i < args.num_layers - 1:
+                cell.add(mx.rnn.DropoutCell(args.dropout,
+                                            prefix="lstm_d%d_" % i))
+    else:
+        cell = mx.rnn.FusedRNNCell(args.num_hidden,
+                                   num_layers=args.num_layers,
+                                   mode="lstm", dropout=args.dropout,
+                                   bidirectional=args.bidirectional)
+    return cell
+
+
+def make_sym_gen(args, cell, layout="TNC"):
+    def sym_gen(seq_len):
+        data = mx.sym.Variable("data")
+        label = mx.sym.Variable("softmax_label")
+        embed = mx.sym.Embedding(data=data, input_dim=args.vocab_size,
+                                 output_dim=args.num_embed, name="embed")
+        outputs, _ = cell.unroll(seq_len, inputs=embed, merge_outputs=True,
+                                 layout=layout)
+        width = args.num_hidden * (1 + int(args.bidirectional))
+        pred = mx.sym.Reshape(outputs, shape=(-1, width))
+        pred = mx.sym.FullyConnected(data=pred, num_hidden=args.vocab_size,
+                                     name="pred")
+        label = mx.sym.Reshape(label, shape=(-1,))
+        pred = mx.sym.SoftmaxOutput(data=pred, label=label, name="softmax")
+        return pred, ("data",), ("softmax_label",)
+    return sym_gen
+
+
+def train(args, ctx):
+    data_train, data_val = get_data(args, "TN")
+    cell = build_cell(args)
+    model = mx.mod.BucketingModule(
+        sym_gen=make_sym_gen(args, cell, "TNC"),
+        default_bucket_key=data_train.default_bucket_key,
+        context=ctx)
+
+    arg_params = aux_params = None
+    if args.load_epoch and args.model_prefix:
+        _, arg_params, aux_params = mx.rnn.load_rnn_checkpoint(
+            cell, args.model_prefix, args.load_epoch)
+
+    opt_params = {"learning_rate": args.lr, "wd": args.wd}
+    if args.optimizer in ("sgd", "nag"):
+        opt_params["momentum"] = args.mom
+
+    model.fit(
+        train_data=data_train,
+        eval_data=data_val,
+        eval_metric=mx.metric.Perplexity(0),
+        kvstore=args.kv_store,
+        optimizer=args.optimizer,
+        optimizer_params=opt_params,
+        initializer=mx.init.Xavier(factor_type="in", magnitude=2.34),
+        arg_params=arg_params,
+        aux_params=aux_params,
+        begin_epoch=args.load_epoch,
+        num_epoch=args.num_epochs,
+        batch_end_callback=mx.callback.Speedometer(args.batch_size,
+                                                   args.disp_batches),
+        epoch_end_callback=(mx.rnn.do_rnn_checkpoint(cell, args.model_prefix)
+                            if args.model_prefix else None))
+
+
+def test(args, ctx):
+    """Score with an unfused stack built from the fused checkpoint
+    (reference cudnn_lstm_bucketing.py:131-160)."""
+    assert args.model_prefix, "--test requires --model-prefix"
+    _, data_val = get_data(args, "NT")
+    fused = build_cell(args)
+    stack = fused.unfuse() if not args.stack_rnn else fused
+    model = mx.mod.BucketingModule(
+        sym_gen=make_sym_gen(args, stack, "NTC"),
+        default_bucket_key=data_val.default_bucket_key,
+        context=ctx)
+    model.bind(data_val.provide_data, data_val.provide_label,
+               for_training=False)
+    _, arg_params, aux_params = mx.rnn.load_rnn_checkpoint(
+        stack, args.model_prefix, args.load_epoch or args.num_epochs)
+    model.set_params(arg_params, aux_params)
+    res = model.score(data_val, mx.metric.Perplexity(0))
+    for name, val in res:
+        logging.info("eval %s=%f", name, val)
+
+
+if __name__ == "__main__":
+    logging.basicConfig(level=logging.INFO,
+                        format="%(asctime)-15s %(message)s")
+    args = parser.parse_args()
+    ctx = mx.tpu() if mx.num_tpus() > 0 else mx.cpu()
+    if args.test:
+        test(args, ctx)
+    else:
+        train(args, ctx)

@@ -108,7 +108,7 @@ if __name__ == "__main__":
     model.fit(
         train_data=data_train,
         eval_data=data_val,
-        eval_metric=mx.metric.Perplexity(invalid_label=0),
+        eval_metric=mx.metric.Perplexity(0),
         kvstore=args.kv_store,
         optimizer="sgd",
         optimizer_params={"learning_rate": args.lr, "momentum": args.mom,

@@ -263,56 +263,48 @@ class FusedRNN(Initializer):
         self._forget_bias = forget_bias
 
     def _init_weight(self, desc, arr):
-        from .ops.rnn import _GATES, _layer_param_slices, rnn_param_size
+        # round-trip through the cell's own blob layout (reference FusedRNN
+        # does the same): unpack -> init each piece -> pack back
+        from .rnn.rnn_cell import FusedRNNCell
 
+        global_init = getattr(desc, "global_init", None)
         inner = self._init
         if inner is None:
             # fall back to the surrounding global initializer (reference
-            # FusedRNN does the same via desc.global_init)
-            inner = getattr(desc, "global_init", None) or Uniform(0.07)
-        h, L, mode = self._num_hidden, self._num_layers, self._mode
-        d = 2 if self._bidirectional else 1
-        # recover input_size from the blob length (layer-0 is the only
-        # layer whose width depends on it)
-        total = arr.shape[0]
-        rest = rnn_param_size(0, h, L, mode, self._bidirectional)
-        g = _GATES[mode]
-        input_size = (total - rest) // (d * g * h)
-        blob = np.zeros(total, dtype=np.float32)
-        for _layer, _direction, sl in _layer_param_slices(
-                input_size, h, L, mode, self._bidirectional):
-            for key in ("wx", "wh"):
-                off, shape = sl[key]
-                n = int(np.prod(shape))
-                mat = np.zeros(shape, dtype=np.float32)
-                inner._init_weight(desc, _NumpySlot(mat))
-                blob[off:off + n] = mat.reshape(-1)
-            for key in ("bx", "bh"):
-                off, (n,) = sl[key]
-                if mode == "lstm":
-                    b = np.zeros(n, dtype=np.float32)
-                    b[h:2 * h] = self._forget_bias
-                    blob[off:off + n] = b
-        arr[:] = blob
+            # FusedRNN does the same via desc.global_init).  If that is
+            # itself a FusedRNN (user passed one explicitly while the cell
+            # variable already carries the attr), use ITS inner init —
+            # re-entering blob unpacking on a per-layer piece would crash.
+            fallback = global_init
+            while isinstance(fallback, FusedRNN):
+                fallback = fallback._init
+            inner = fallback or Uniform(0.07)
+        cell = FusedRNNCell(self._num_hidden, num_layers=self._num_layers,
+                            mode=self._mode,
+                            bidirectional=self._bidirectional,
+                            forget_bias=self._forget_bias, prefix="")
+        args = cell.unpack_weights({"parameters": arr})
+        h = self._num_hidden
+        for name, value in args.items():
+            # fresh per-piece desc: name-based dispatch on the piece, no
+            # __init__ attr, so no recursion (reference FusedRNN builds
+            # InitDesc(name, global_init=desc.global_init) the same way)
+            piece = InitDesc(name, global_init=global_init)
+            if name.endswith("weight"):
+                if hasattr(inner, "_init_weight"):
+                    inner._init_weight(piece, value)
+                else:
+                    # dispatching initializer without slots (e.g. Mixed):
+                    # full call so the piece name picks the right entry
+                    inner(piece, value)
+            else:
+                bias = np.zeros(value.shape[0], dtype=np.float32)
+                if self._mode == "lstm":
+                    bias[h:2 * h] = self._forget_bias
+                value[:] = bias
+        arr[:] = cell.pack_weights(args)["parameters"]
 
     _init_default = _init_weight
-
-
-class _NumpySlot:
-    """Adapter so Initializer._init_weight (which assigns ``arr[:]``) can
-    fill a plain numpy array."""
-
-    def __init__(self, arr):
-        self._arr = arr
-
-    @property
-    def shape(self):
-        return self._arr.shape
-
-    def __setitem__(self, key, value):
-        np_val = value.asnumpy() if hasattr(value, "asnumpy") \
-            else np.asarray(value)
-        self._arr[key] = np_val
 
 
 @registry.register

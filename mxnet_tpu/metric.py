@@ -189,7 +189,7 @@ class F1(EvalMetric):
 class Perplexity(EvalMetric):
     """reference ``metric.py:230``"""
 
-    def __init__(self, ignore_label=None, axis=-1, **kwargs):
+    def __init__(self, ignore_label=None, axis=-1):
         super().__init__("Perplexity")
         self.ignore_label = ignore_label
         self.axis = axis

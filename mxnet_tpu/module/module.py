@@ -216,7 +216,8 @@ class Module(BaseModule):
             elif cache is not None and not allow_missing:
                 raise RuntimeError("%s is not presented" % name)
             elif initializer is not None:
-                initializer(InitDesc(name, attrs.get(name)), arr)
+                initializer(InitDesc(name, attrs.get(name),
+                                     global_init=initializer), arr)
 
         for name in self._param_names:
             _impl(name, self._exec.arg_dict[name], arg_params)

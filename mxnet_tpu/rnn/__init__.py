@@ -8,8 +8,12 @@ from .rnn_cell import (BaseRNNCell, RNNCell, LSTMCell, GRUCell, FusedRNNCell,
                        SequentialRNNCell, BidirectionalCell, DropoutCell,
                        ModifierCell, ZoneoutCell, RNNParams)
 from .io import BucketSentenceIter, encode_sentences
+from .rnn import (save_rnn_checkpoint, load_rnn_checkpoint,
+                  do_rnn_checkpoint)
 
 __all__ = ["BaseRNNCell", "RNNCell", "LSTMCell", "GRUCell", "FusedRNNCell",
            "SequentialRNNCell", "BidirectionalCell", "DropoutCell",
            "ModifierCell", "ZoneoutCell", "RNNParams",
-           "BucketSentenceIter", "encode_sentences"]
+           "BucketSentenceIter", "encode_sentences",
+           "save_rnn_checkpoint", "load_rnn_checkpoint",
+           "do_rnn_checkpoint"]

@@ -69,7 +69,10 @@ class BucketSentenceIter(DataIter):
             buff = np.full((buckets[buck],), invalid_label, dtype=dtype)
             buff[:len(sent)] = sent
             self.data[buck].append(buff)
-        self.data = [np.asarray(b, dtype=dtype) for b in self.data]
+        # an empty bucket would collapse to a 1-D (0,) array and break the
+        # label shift in reset(); keep every bucket 2-D
+        self.data = [np.asarray(b, dtype=dtype).reshape(-1, n)
+                     for b, n in zip(self.data, buckets)]
         if ndiscard:
             logging.warning("discarded %d sentences longer than the largest "
                             "bucket.", ndiscard)

@@ -1,0 +1,49 @@
+"""RNN checkpoint helpers (``mx.rnn.save_rnn_checkpoint`` et al.).
+
+Reference: ``python/mxnet/rnn/rnn.py:15-108`` — fused cells store their
+parameters as one packed blob per layer/direction, so checkpoints written
+from a fused-cell module must be unpacked into per-gate arrays before
+saving (portable across fused/unfused graphs) and re-packed after loading.
+"""
+
+from ..model import load_checkpoint, save_checkpoint
+from .rnn_cell import BaseRNNCell
+
+__all__ = ["save_rnn_checkpoint", "load_rnn_checkpoint",
+           "do_rnn_checkpoint"]
+
+
+def _as_cell_list(cells):
+    if isinstance(cells, BaseRNNCell):
+        return [cells]
+    return list(cells)
+
+
+def save_rnn_checkpoint(cells, prefix, epoch, symbol, arg_params, aux_params):
+    """Unpack every cell's fused blobs in ``arg_params`` then write the
+    standard ``prefix-symbol.json`` + ``prefix-%04d.params`` pair
+    (reference ``rnn/rnn.py:15``)."""
+    for cell in _as_cell_list(cells):
+        arg_params = cell.unpack_weights(arg_params)
+    save_checkpoint(prefix, epoch, symbol, arg_params, aux_params)
+
+
+def load_rnn_checkpoint(cells, prefix, epoch):
+    """Load a checkpoint and re-pack per-gate arrays into each cell's fused
+    blob layout (reference ``rnn/rnn.py:45``).  Returns
+    ``(symbol, arg_params, aux_params)``."""
+    sym, arg, aux = load_checkpoint(prefix, epoch)
+    for cell in _as_cell_list(cells):
+        arg = cell.pack_weights(arg)
+    return sym, arg, aux
+
+
+def do_rnn_checkpoint(cells, prefix, period=1):
+    """Epoch-end callback writing unpacked checkpoints every ``period``
+    epochs (reference ``rnn/rnn.py:80``)."""
+    period = int(max(1, period))
+
+    def _callback(iter_no, sym=None, arg=None, aux=None):
+        if (iter_no + 1) % period == 0:
+            save_rnn_checkpoint(cells, prefix, iter_no + 1, sym, arg, aux)
+    return _callback

@@ -57,3 +57,41 @@ def test_mixed_initializer():
     b = nd.zeros((4,))
     init("other_weight", b)
     assert (b.asnumpy() == 3).all()
+
+
+def test_fused_rnn_init_explicit_outer():
+    """An explicit FusedRNN module initializer must not re-enter blob
+    unpacking when the cell variable already carries the __init__ attr."""
+    import mxnet_tpu as mx
+    from mxnet_tpu.initializer import FusedRNN, InitDesc, Xavier
+    from mxnet_tpu.ops.rnn import rnn_param_size
+
+    fused = mx.rnn.FusedRNNCell(8, num_layers=2, mode="lstm", prefix="w_")
+    attrs = fused._parameter.attr_dict()["w_parameters"]
+    arr = mx.nd.zeros((rnn_param_size(5, 8, 2, "lstm", False),))
+    outer = FusedRNN(Xavier(), 8, 2, "lstm")
+    outer(InitDesc("w_parameters", attrs, global_init=outer), arr)
+    v = arr.asnumpy()
+    assert np.abs(v).sum() > 0
+    # forget-gate bias slot of layer 0 still 1.0
+    from mxnet_tpu.ops.rnn import _layer_param_slices
+    sl = next(iter(_layer_param_slices(5, 8, 2, "lstm", False)))[2]
+    off, (n,) = sl["bx"]
+    assert np.all(v[off + 8:off + 16] == 1.0)
+
+
+def test_fused_rnn_init_mixed_outer():
+    """A Mixed module initializer containing a FusedRNN pattern must init
+    fused blobs without crashing (pieces dispatch through Mixed)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu.initializer import FusedRNN, InitDesc, Mixed, Xavier
+    from mxnet_tpu.ops.rnn import rnn_param_size
+
+    fused = mx.rnn.FusedRNNCell(8, num_layers=2, mode="lstm", prefix="m_")
+    attrs = fused._parameter.attr_dict()["m_parameters"]
+    arr = mx.nd.zeros((rnn_param_size(5, 8, 2, "lstm", False),))
+    mixed = Mixed([".*parameters", ".*"],
+                  [FusedRNN(Xavier(), 8, 2, "lstm"), Xavier()])
+    # the cell attr path: global initializer sees the blob desc first
+    Xavier()(InitDesc("m_parameters", attrs, global_init=mixed), arr)
+    assert np.abs(arr.asnumpy()).sum() > 0

@@ -193,7 +193,7 @@ def test_lstm_bucketing_end_to_end():
 
     mod = mx.mod.BucketingModule(sym_gen,
                                  default_bucket_key=it.default_bucket_key)
-    metric = mx.metric.Perplexity(invalid_label=0)
+    metric = mx.metric.Perplexity(0)
     mod.fit(it, eval_metric=metric, num_epoch=2,
             optimizer="sgd", optimizer_params={"learning_rate": 0.5},
             initializer=mx.init.Xavier())
@@ -208,3 +208,54 @@ def test_bucket_iter_time_major():
     batch = next(iter(it))
     assert batch.data[0].shape == (4, 4)
     assert it.provide_data[0].shape == (4, 4)
+
+
+def test_rnn_checkpoint_roundtrip(tmp_path):
+    """save_rnn_checkpoint unpacks fused blobs; load_rnn_checkpoint re-packs
+    (reference rnn/rnn.py:15-78)."""
+    from mxnet_tpu.ops.rnn import rnn_param_size
+
+    H, L, V = 6, 2, 11
+    fused = mx.rnn.FusedRNNCell(H, num_layers=L, mode="lstm", prefix="lstm_")
+    data = mx.sym.Variable("data")
+    embed = mx.sym.Embedding(data, input_dim=V, output_dim=5, name="embed")
+    out, _ = fused.unroll(4, inputs=embed, merge_outputs=True, layout="NTC")
+    rs = np.random.RandomState(0)
+    blob = rs.randn(rnn_param_size(5, H, L, "lstm", False)).astype("f")
+    args = {"lstm_parameters": mx.nd.array(blob),
+            "embed_weight": mx.nd.array(rs.randn(V, 5).astype("f"))}
+    prefix = str(tmp_path / "ck")
+    mx.rnn.save_rnn_checkpoint(fused, prefix, 3, out, args, {})
+
+    sym, arg, aux = mx.rnn.load_rnn_checkpoint(fused, prefix, 3)
+    np.testing.assert_allclose(arg["lstm_parameters"].asnumpy(), blob,
+                               rtol=1e-6)
+    np.testing.assert_allclose(arg["embed_weight"].asnumpy(),
+                               args["embed_weight"].asnumpy(), rtol=1e-6)
+    # the on-disk dict is unpacked: loadable into the unfused stack as-is
+    _, arg_unf, _ = mx.rnn.load_rnn_checkpoint(fused.unfuse(), prefix, 3)
+    assert "lstm_l0_i2h_weight" in arg_unf
+    assert "lstm_parameters" not in arg_unf
+
+
+def test_fused_cell_init_attr():
+    """FusedRNNCell attaches a FusedRNN __init__ attr so Module.init_params
+    can initialize the packed blob (reference rnn_cell.py FusedRNNCell)."""
+    fused = mx.rnn.FusedRNNCell(4, num_layers=1, mode="lstm", prefix="q_")
+    attrs = fused._parameter.attr_dict().get("q_parameters", {})
+    assert "__init__" in attrs
+    from mxnet_tpu.initializer import InitDesc
+    from mxnet_tpu.ops.rnn import rnn_param_size
+    arr = mx.nd.zeros((rnn_param_size(3, 4, 1, "lstm", False),))
+    mx.init.Xavier()(InitDesc("q_parameters", attrs), arr)
+    v = arr.asnumpy()
+    assert np.abs(v).sum() > 0  # weights filled
+
+
+def test_bucket_iter_empty_bucket():
+    """Buckets with no sentences must not crash reset/iteration."""
+    sentences = [[1, 2, 3]] * 8  # only the len-4 bucket is populated
+    it = mx.rnn.BucketSentenceIter(sentences, batch_size=4,
+                                   buckets=[4, 10, 20], invalid_label=0)
+    n = sum(1 for _ in it)
+    assert n == 2
