@@ -18,10 +18,10 @@ import os
 import sys
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
-
-import numpy as np  # noqa: E402
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 import mxnet_tpu as mx  # noqa: E402
+from lstm_bucketing import BUCKETS, synth_corpus  # noqa: E402
 
 parser = argparse.ArgumentParser(
     description="Train a fused-LSTM LM with bucketing",
@@ -48,35 +48,18 @@ parser.add_argument("--disp-batches", type=int, default=50)
 parser.add_argument("--num-sentences", type=int, default=2000)
 parser.add_argument("--vocab-size", type=int, default=100)
 
-BUCKETS = [10, 20, 30, 40, 50, 60]
-START_TOKEN = 2  # 0 = pad/invalid, 1 = unk
-
-
-def synth_corpus(num_sentences, vocab, seed=3):
-    succ = np.random.RandomState(42).randint(START_TOKEN, vocab,
-                                             size=(vocab, 3))
-    rs = np.random.RandomState(seed)
-    sents = []
-    for _ in range(num_sentences):
-        n = int(rs.choice(BUCKETS)) - rs.randint(0, 5)
-        tok = int(rs.randint(START_TOKEN, vocab))
-        sent = [tok]
-        for _ in range(max(n, 2) - 1):
-            tok = int(succ[tok, rs.randint(0, 3)]) \
-                if rs.rand() < 0.9 else int(rs.randint(START_TOKEN, vocab))
-            sent.append(tok)
-        sents.append(sent)
-    return sents
-
-
-def get_data(args, layout):
-    """reference cudnn_lstm_bucketing.py:63-74 (TN layout for fused path)"""
-    train_sent = synth_corpus(args.num_sentences, args.vocab_size)
+def get_data(args, layout, train=True):
+    """reference cudnn_lstm_bucketing.py:63-74 (TN layout for fused path);
+    corpus comes from lstm_bucketing.synth_corpus (shared Markov chain)"""
+    data_train = None
+    if train:
+        train_sent = synth_corpus(args.num_sentences, args.vocab_size)
+        data_train = mx.rnn.BucketSentenceIter(train_sent, args.batch_size,
+                                               buckets=BUCKETS,
+                                               invalid_label=0,
+                                               layout=layout)
     val_sent = synth_corpus(args.num_sentences // 10, args.vocab_size,
                             seed=17)
-    data_train = mx.rnn.BucketSentenceIter(train_sent, args.batch_size,
-                                           buckets=BUCKETS, invalid_label=0,
-                                           layout=layout)
     data_val = mx.rnn.BucketSentenceIter(val_sent, args.batch_size,
                                          buckets=BUCKETS, invalid_label=0,
                                          layout=layout)
@@ -159,7 +142,7 @@ def test(args, ctx):
     """Score with an unfused stack built from the fused checkpoint
     (reference cudnn_lstm_bucketing.py:131-160)."""
     assert args.model_prefix, "--test requires --model-prefix"
-    _, data_val = get_data(args, "NT")
+    _, data_val = get_data(args, "NT", train=False)
     fused = build_cell(args)
     stack = fused.unfuse() if not args.stack_rnn else fused
     model = mx.mod.BucketingModule(

@@ -1,0 +1,47 @@
+#!/usr/bin/env python
+"""Convert a trained SSD checkpoint into a deploy (inference) model.
+
+Reference: ``example/ssd/deploy.py`` — rebuilds the network with the
+``MultiBoxDetection`` NMS head (``get_symbol`` vs ``get_symbol_train``)
+and re-saves the checkpoint under a ``deploy_`` prefix so the predict
+API / ``Detector`` can load it without the training loss graph.
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu.models import ssd_vgg16  # noqa: E402
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(
+        description="Convert a trained model to deploy model")
+    parser.add_argument("--network", type=str, default="vgg16_reduced",
+                        choices=["vgg16_reduced"])
+    parser.add_argument("--epoch", type=int, default=3)
+    parser.add_argument("--prefix", type=str,
+                        default=os.path.join(os.getcwd(), "model", "ssd_96"))
+    parser.add_argument("--num-class", dest="num_classes", type=int,
+                        default=3)
+    parser.add_argument("--nms", dest="nms_thresh", type=float, default=0.5)
+    parser.add_argument("--force", dest="force_nms", default=True,
+                        type=lambda v: str(v).lower() not in
+                        ("false", "0", "no", ""),
+                        help="force cross-class NMS (pass False to keep "
+                             "per-class suppression)")
+    args = parser.parse_args()
+
+    net = ssd_vgg16.get_symbol(args.num_classes, nms_thresh=args.nms_thresh,
+                               force_suppress=args.force_nms)
+    _, arg_params, aux_params = mx.model.load_checkpoint(args.prefix,
+                                                         args.epoch)
+    tmp = args.prefix.rsplit("/", 1)
+    save_prefix = "/deploy_".join(tmp) if len(tmp) == 2 \
+        else "deploy_" + args.prefix
+    mx.model.save_checkpoint(save_prefix, args.epoch, net, arg_params,
+                             aux_params)
+    print("Saved model: {}-{:04d}.params".format(save_prefix, args.epoch))
+    print("Saved symbol: {}-symbol.json".format(save_prefix))

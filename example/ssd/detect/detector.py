@@ -1,0 +1,86 @@
+"""SSD ``Detector`` — wraps the deploy graph behind a detection API.
+
+Reference: ``example/ssd/detect/detector.py`` — loads a trained
+checkpoint into a label-less ``Module``, runs ``Module.predict`` over a
+test iterator, and filters the ``MultiBoxDetection`` output rows
+(``[cls, score, xmin, ymin, xmax, ymax]``, cls ``-1`` = suppressed).
+"""
+
+import sys
+from os import path
+from timeit import default_timer as timer
+
+sys.path.insert(0, path.join(path.dirname(__file__), "..", "..", ".."))
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+
+
+class Detector(object):
+    """Holds a detection network and wraps the detection API
+    (reference ``detect/detector.py:8``)."""
+
+    def __init__(self, symbol, model_prefix, epoch, data_shape, mean_pixels,
+                 batch_size=1, ctx=None):
+        self.ctx = ctx if ctx is not None else mx.cpu()
+        _, args, auxs = mx.model.load_checkpoint(model_prefix, epoch)
+        self.mod = mx.mod.Module(symbol, data_names=("data",),
+                                 label_names=(), context=self.ctx)
+        self.data_shape = data_shape
+        self.batch_size = batch_size
+        self.mod.bind(for_training=False, data_shapes=[
+            ("data", (batch_size, 3, data_shape, data_shape))])
+        self.mod.set_params(args, auxs, allow_missing=True)
+        self.mean_pixels = mean_pixels
+
+    def detect(self, det_iter, show_timer=False):
+        """Detect all images in an iterator; returns one
+        ``(n_kept, 6)`` array per image (reference ``detector.py:41``)."""
+        start = timer()
+        detections = self.mod.predict(det_iter).asnumpy()
+        time_elapsed = timer() - start
+        if show_timer:
+            print("Detection time for {} images: {:.4f} sec".format(
+                detections.shape[0], time_elapsed))
+        result = []
+        for i in range(detections.shape[0]):
+            det = detections[i, :, :]
+            result.append(det[np.where(det[:, 0] >= 0)[0]])
+        return result
+
+    def _preprocess(self, img):
+        """HWC uint8/float image -> mean-subtracted CHW float32."""
+        img = np.asarray(img, dtype=np.float32)
+        if img.shape[0] != self.data_shape or \
+                img.shape[1] != self.data_shape:
+            raise ValueError("image must be %dx%d (resize upstream)"
+                             % (self.data_shape, self.data_shape))
+        img = img - np.asarray(self.mean_pixels, np.float32).reshape(1, 1, 3)
+        return img.transpose(2, 0, 1)
+
+    def im_detect(self, im_list, show_timer=False):
+        """Detect a list of in-memory HWC images (reference
+        ``detector.py:73`` — file loading happens upstream here since the
+        TPU build keeps decode in ``mx.image``)."""
+        data = np.stack([self._preprocess(im) for im in im_list])
+        pad = (-len(data)) % self.batch_size
+        if pad:
+            data = np.concatenate(
+                [data, np.zeros((pad,) + data.shape[1:], data.dtype)])
+        it = mx.io.NDArrayIter(data=data, batch_size=self.batch_size)
+        return self.detect(it, show_timer=show_timer)[:len(im_list)]
+
+    def visualize_detection(self, img, dets, classes=(), thresh=0.6):
+        """Textual detection dump (the reference plots with matplotlib)."""
+        lines = []
+        for det in dets:
+            cls, score = int(det[0]), float(det[1])
+            if score < thresh:
+                continue
+            name = classes[cls] if classes else str(cls)
+            lines.append("%s\t%.3f\t(%.3f, %.3f, %.3f, %.3f)"
+                         % ((name, score) + tuple(det[2:6])))
+        print("\n".join(lines) if lines else "(no detections >= %.2f)"
+              % thresh)
+        return lines

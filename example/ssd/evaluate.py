@@ -1,0 +1,63 @@
+#!/usr/bin/env python
+"""Evaluate a trained SSD checkpoint: VOC-style mAP on the synthetic set.
+
+Reference: ``example/ssd/evaluate.py`` + ``evaluate/evaluate_net.py`` —
+binds the deploy (MultiBoxDetection) graph, runs the test iterator
+through it, and scores detections against ground truth with VOC AP
+(``evaluate/eval_voc.py``).
+
+Usage: first ``python train.py --model-prefix /tmp/ssd``, then
+``python evaluate.py --model-prefix /tmp/ssd --load-epoch 3``.
+"""
+
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu.models import ssd_vgg16  # noqa: E402
+
+from detect.detector import Detector  # noqa: E402
+from evaluate.eval_metric import eval_detections  # noqa: E402
+from train import synth_detection_set  # noqa: E402
+
+if __name__ == "__main__":
+    logging.basicConfig(level=logging.INFO)
+    parser = argparse.ArgumentParser(description="evaluate SSD mAP")
+    parser.add_argument("--model-prefix", type=str, required=True)
+    parser.add_argument("--load-epoch", type=int, default=3)
+    parser.add_argument("--num-classes", type=int, default=3)
+    parser.add_argument("--data-shape", type=int, default=96)
+    parser.add_argument("--batch-size", type=int, default=8)
+    parser.add_argument("--num-examples", type=int, default=32)
+    parser.add_argument("--overlap-thresh", type=float, default=0.5)
+    parser.add_argument("--use-07-metric", action="store_true",
+                        help="11-point interpolated AP (VOC07)")
+    parser.add_argument("--nms", type=float, default=0.45)
+    args = parser.parse_args()
+
+    data, labels = synth_detection_set(args.num_examples, args.data_shape,
+                                       args.num_classes, seed=99)
+    net = ssd_vgg16.get_symbol(num_classes=args.num_classes,
+                               nms_thresh=args.nms, force_suppress=True)
+    ctx = mx.tpu() if mx.num_tpus() > 0 else mx.cpu()
+    det = Detector(net, args.model_prefix, args.load_epoch,
+                   args.data_shape, mean_pixels=(0, 0, 0),
+                   batch_size=args.batch_size, ctx=ctx)
+    it = mx.io.NDArrayIter(data=data, batch_size=args.batch_size)
+    results = det.detect(it, show_timer=True)[:len(data)]
+    # MultiBoxDetection emits normalized corners — labels already are
+    aps, mean_ap = eval_detections(results, list(labels),
+                                   args.num_classes,
+                                   ovp_thresh=args.overlap_thresh,
+                                   use_07_metric=args.use_07_metric)
+    for c, ap in sorted(aps.items()):
+        logging.info("class %d AP = %.4f", c, ap)
+    logging.info("mAP = %.4f", mean_ap)
+    print("mAP:", mean_ap)

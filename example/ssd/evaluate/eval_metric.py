@@ -1,0 +1,92 @@
+"""VOC-style detection mAP (reference ``example/ssd/evaluate/eval_voc.py``).
+
+``voc_ap`` implements both the VOC07 11-point interpolated AP and the
+continuous (area-under-PR) variant; ``eval_detections`` greedily matches
+detections to ground truth at an IoU threshold, exactly the reference's
+``voc_eval`` matching loop (``eval_voc.py:74-170``) minus the
+record-file parsing (labels come in as arrays here).
+"""
+
+import numpy as np
+
+
+def voc_ap(rec, prec, use_07_metric=False):
+    """AP from recall/precision points (reference ``eval_voc.py:40-72``)."""
+    if use_07_metric:
+        ap = 0.0
+        for t in np.arange(0.0, 1.1, 0.1):
+            p = np.max(prec[rec >= t]) if np.sum(rec >= t) else 0.0
+            ap += p / 11.0
+        return ap
+    mrec = np.concatenate(([0.0], rec, [1.0]))
+    mpre = np.concatenate(([0.0], prec, [0.0]))
+    for i in range(mpre.size - 1, 0, -1):
+        mpre[i - 1] = np.maximum(mpre[i - 1], mpre[i])
+    idx = np.where(mrec[1:] != mrec[:-1])[0]
+    return float(np.sum((mrec[idx + 1] - mrec[idx]) * mpre[idx + 1]))
+
+
+def _iou(box, boxes):
+    """IoU of one box against (n, 4) boxes, all (xmin, ymin, xmax, ymax)."""
+    ixmin = np.maximum(boxes[:, 0], box[0])
+    iymin = np.maximum(boxes[:, 1], box[1])
+    ixmax = np.minimum(boxes[:, 2], box[2])
+    iymax = np.minimum(boxes[:, 3], box[3])
+    iw = np.maximum(ixmax - ixmin, 0.0)
+    ih = np.maximum(iymax - iymin, 0.0)
+    inter = iw * ih
+    union = ((box[2] - box[0]) * (box[3] - box[1]) +
+             (boxes[:, 2] - boxes[:, 0]) * (boxes[:, 3] - boxes[:, 1]) -
+             inter)
+    return inter / np.maximum(union, np.finfo(np.float64).eps)
+
+
+def eval_detections(detections, labels, num_classes, ovp_thresh=0.5,
+                    use_07_metric=False):
+    """Per-class AP + mAP.
+
+    detections: list (per image) of (n, 6) arrays
+        ``[cls, score, xmin, ymin, xmax, ymax]``.
+    labels: list (per image) of (m, 5) arrays ``[cls, xmin, ymin, xmax,
+        ymax]``; rows with cls < 0 are padding.
+    Returns (aps: dict class->AP, mAP).
+    """
+    aps = {}
+    for c in range(num_classes):
+        gts = []
+        npos = 0
+        for lab in labels:
+            lab = np.asarray(lab).reshape(-1, 5)
+            boxes = lab[lab[:, 0] == c][:, 1:5]
+            gts.append({"boxes": boxes,
+                        "matched": np.zeros(len(boxes), bool)})
+            npos += len(boxes)
+        rows = []
+        for img_id, det in enumerate(detections):
+            det = np.asarray(det).reshape(-1, 6)
+            for row in det[det[:, 0] == c]:
+                rows.append((float(row[1]), img_id, row[2:6]))
+        if npos == 0:
+            aps[c] = float("nan") if not rows else 0.0
+            continue
+        rows.sort(key=lambda r: -r[0])
+        tp = np.zeros(len(rows))
+        fp = np.zeros(len(rows))
+        for i, (_score, img_id, box) in enumerate(rows):
+            gt = gts[img_id]
+            if len(gt["boxes"]) == 0:
+                fp[i] = 1.0
+                continue
+            overlaps = _iou(box, gt["boxes"])
+            j = int(np.argmax(overlaps))
+            if overlaps[j] >= ovp_thresh and not gt["matched"][j]:
+                tp[i] = 1.0
+                gt["matched"][j] = True
+            else:
+                fp[i] = 1.0
+        tp, fp = np.cumsum(tp), np.cumsum(fp)
+        rec = tp / npos
+        prec = tp / np.maximum(tp + fp, np.finfo(np.float64).eps)
+        aps[c] = voc_ap(rec, prec, use_07_metric)
+    valid = [v for v in aps.values() if not np.isnan(v)]
+    return aps, float(np.mean(valid)) if valid else float("nan")

@@ -61,3 +61,72 @@ def test_ssd_detection_shapes():
     if valid.any():
         s = scores[valid]
         assert (s[:-1] >= s[1:]).all() or len(s) == 1  # sorted desc
+
+
+def test_voc_eval_metric():
+    """eval_detections: perfect detections -> mAP 1; shifted -> lower;
+    VOC07 11-point AP formula (reference example/ssd/evaluate/eval_voc.py)."""
+    import os
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "example", "ssd"))
+    from evaluate.eval_metric import eval_detections, voc_ap
+
+    rs = np.random.RandomState(0)
+    labels, dets = [], []
+    for _ in range(6):
+        n = rs.randint(1, 4)
+        lab = np.zeros((n, 5))
+        lab[:, 0] = rs.randint(0, 3, n)
+        xy = rs.rand(n, 2) * 0.5
+        wh = rs.rand(n, 2) * 0.3 + 0.1
+        lab[:, 1:3] = xy
+        lab[:, 3:5] = xy + wh
+        labels.append(lab)
+        det = np.zeros((n, 6))
+        det[:, 0] = lab[:, 0]
+        det[:, 1] = rs.rand(n) * 0.5 + 0.5
+        det[:, 2:6] = lab[:, 1:5]
+        dets.append(det)
+    _, mean_ap = eval_detections(dets, labels, 3)
+    assert abs(mean_ap - 1.0) < 1e-9
+    for d in dets[:3]:
+        d[:, 2:6] += 0.6  # move half the detections off target
+    _, worse = eval_detections(dets, labels, 3)
+    assert worse < 1.0
+    rec = np.array([0.5, 1.0])
+    prec = np.array([1.0, 0.5])
+    assert abs(voc_ap(rec, prec, use_07_metric=True)
+               - (6 * 1.0 + 5 * 0.5) / 11) < 1e-9
+
+
+def test_detector_roundtrip(tmp_path):
+    """Detector loads a checkpoint, batches/pads images, returns per-image
+    filtered rows (reference example/ssd/detect/detector.py)."""
+    import os
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "example", "ssd"))
+    from detect.detector import Detector
+
+    num_classes, shape = 2, 64
+    train_net = ssd_vgg16.get_symbol_train(num_classes=num_classes)
+    mod = mx.mod.Module(train_net, data_names=("data",),
+                        label_names=("label",))
+    mod.bind(data_shapes=[("data", (2, 3, shape, shape))],
+             label_shapes=[("label", (2, 3, 5))])
+    mod.init_params(mx.init.Xavier())
+    prefix = str(tmp_path / "det")
+    arg, aux = mod.get_params()
+    mx.model.save_checkpoint(prefix, 1, train_net, arg, aux)
+
+    net = ssd_vgg16.get_symbol(num_classes=num_classes, nms_thresh=0.5)
+    det = Detector(net, prefix, 1, shape, mean_pixels=(0, 0, 0),
+                   batch_size=2)
+    rs = np.random.RandomState(0)
+    imgs = [rs.rand(shape, shape, 3).astype(np.float32) for _ in range(3)]
+    results = det.im_detect(imgs)  # 3 images over batch 2 -> padded batch
+    assert len(results) == 3
+    for r in results:
+        assert r.ndim == 2 and r.shape[1] == 6
+        assert np.all(r[:, 0] >= 0)
