@@ -38,9 +38,8 @@ if __name__ == "__main__":
                                force_suppress=args.force_nms)
     _, arg_params, aux_params = mx.model.load_checkpoint(args.prefix,
                                                          args.epoch)
-    tmp = args.prefix.rsplit("/", 1)
-    save_prefix = "/deploy_".join(tmp) if len(tmp) == 2 \
-        else "deploy_" + args.prefix
+    save_prefix = os.path.join(os.path.dirname(args.prefix),
+                               "deploy_" + os.path.basename(args.prefix))
     mx.model.save_checkpoint(save_prefix, args.epoch, net, arg_params,
                              aux_params)
     print("Saved model: {}-{:04d}.params".format(save_prefix, args.epoch))

@@ -31,7 +31,10 @@ class Detector(object):
         self.batch_size = batch_size
         self.mod.bind(for_training=False, data_shapes=[
             ("data", (batch_size, 3, data_shape, data_shape))])
-        self.mod.set_params(args, auxs, allow_missing=True)
+        # the deploy graph's params are a subset of the training
+        # checkpoint's: any missing key is a real symbol/checkpoint
+        # mismatch and should raise, not return garbage detections
+        self.mod.set_params(args, auxs)
         self.mean_pixels = mean_pixels
 
     def detect(self, det_iter, show_timer=False):

@@ -61,6 +61,9 @@ from . import rtc
 from . import torch_bridge
 from .torch_bridge import th
 from . import visualization
+from . import visualization as viz
+from . import image
+from . import recordio
 from . import test_utils
 
 # DMLC_ROLE=server processes become parameter servers on import (reference

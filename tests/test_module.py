@@ -197,3 +197,49 @@ def test_fused_full_step_observed_before_update():
         assert np.abs(after - before).sum() > 0
     finally:
         os.environ.pop("MXNET_FUSE_TRAIN_STEP", None)
+
+
+def test_python_loss_module_chain():
+    """SequentialModule: Symbol feature module + PythonLossModule loss head
+    (reference module/python_module.py): train a tiny softmax classifier
+    where the loss gradient comes from a python callback."""
+    rs = np.random.RandomState(0)
+    n, d, k = 64, 8, 3
+    w = rs.randn(d, k)
+    x = rs.randn(n, d).astype(np.float32)
+    y = np.argmax(x @ w + 0.1 * rs.randn(n, k), axis=1).astype(np.float32)
+
+    data = mx.sym.Variable("data")
+    feat = mx.sym.FullyConnected(data, num_hidden=k, name="fc")
+
+    def ce_grad(scores, labels):
+        s = scores.asnumpy()
+        lab = labels.asnumpy().astype(int)
+        p = np.exp(s - s.max(axis=1, keepdims=True))
+        p /= p.sum(axis=1, keepdims=True)
+        p[np.arange(len(lab)), lab] -= 1.0
+        return p / len(lab)
+
+    seq = mx.mod.SequentialModule()
+    seq.add(mx.mod.Module(feat, data_names=("data",), label_names=()))
+    seq.add(mx.mod.PythonLossModule(grad_func=ce_grad),
+            take_labels=True, auto_wiring=True)
+
+    it = mx.io.NDArrayIter(data=x, label=y, batch_size=16, shuffle=False,
+                           label_name="softmax_label")
+    metric = mx.metric.Accuracy()
+    seq.fit(it, eval_metric=metric, num_epoch=30,
+            optimizer="sgd", optimizer_params={"learning_rate": 2.0},
+            initializer=mx.init.Xavier())
+    _, acc = metric.get()
+    assert acc > 0.9, acc
+
+
+def test_python_module_root_namespace():
+    """Reference-parity namespace probes: mx.viz, mx.image, mx.recordio,
+    mx.mod.PythonModule/PythonLossModule all reachable from the root."""
+    assert mx.viz is mx.visualization
+    assert hasattr(mx.viz, "plot_network")
+    assert hasattr(mx.image, "imdecode")
+    assert hasattr(mx.recordio, "unpack_img")
+    assert issubclass(mx.mod.PythonLossModule, mx.mod.PythonModule)
