@@ -341,11 +341,13 @@ class Module(BaseModule):
         """fwd+bwd+update as ONE jit call: plain SGD, no kvstore, no
         monitor/profiler hooks, params-only grads all 'write'.
 
-        Opt-in via ``MXNET_FUSE_TRAIN_STEP=1``: interleaved A/B on the
-        tunneled v5e backend shows the merged computation is within noise
-        of the two-dispatch path (the tunnel's run-to-run variance
-        dominates), so the default stays on the simpler two-phase path.
-        Kept for backends where dispatch latency dominates; numerics are
+        Opt-in via ``MXNET_FUSE_TRAIN_STEP=1``: best-of-N A/B on the
+        tunneled v5e backend (ResNet-50 b32, bench.py) measures the merged
+        computation at ~1.8x the two-dispatch path — one tunnel round trip
+        instead of two dominates at this step time.  The library default
+        stays two-phase because the fused path restricts what get_outputs/
+        get_input_grads can observe mid-step; bench.py and throughput-
+        sensitive training loops should set the flag.  Numerics are
         identical either way (see
         tests/test_module.py::test_fused_full_step_matches_two_phase).
         """
