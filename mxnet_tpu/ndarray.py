@@ -572,11 +572,22 @@ def _invoke(op, args, kwargs):
     out = kwargs.pop("out", None)
     kwargs.pop("name", None)
     ctx = kwargs.pop("ctx", None)
-    # split NDArray kwargs (named inputs) from attr kwargs
-    named_inputs = {k: v for k, v in kwargs.items() if isinstance(v, NDArray)}
-    attr_kwargs = {k: v for k, v in kwargs.items() if not isinstance(v, NDArray)}
-    pos_inputs = [a for a in args if isinstance(a, NDArray)]
-    attr_args = [a for a in args if not isinstance(a, NDArray)]
+    # split tensor kwargs (named inputs) from attr kwargs; bare numpy
+    # arrays count as tensors too (the reference's CustomOp callbacks run
+    # mx.nd ops on the host views they are handed)
+    def _is_tensor(v):
+        # 0-d numpy arrays keep filling scalar params positionally
+        return isinstance(v, NDArray) or \
+            (isinstance(v, np.ndarray) and v.ndim > 0)
+
+    def _as_nd(v):
+        return v if isinstance(v, NDArray) else array(np.asarray(v))
+
+    named_inputs = {k: _as_nd(v) for k, v in kwargs.items()
+                    if _is_tensor(v)}
+    attr_kwargs = {k: v for k, v in kwargs.items() if not _is_tensor(v)}
+    pos_inputs = [_as_nd(a) for a in args if _is_tensor(a)]
+    attr_args = [a for a in args if not _is_tensor(a)]
     if attr_args:
         # positional scalars fill the op's params in declaration order
         # (reference generated fns: e.g. nd.uniform(0, 1, shape=...));
@@ -674,3 +685,16 @@ def _init_ndarray_module():
 
 
 _init_ndarray_module()
+
+
+def __getattr__(name):
+    # ops registered AFTER import (registry.register in user code)
+    # resolve lazily, so late registration behaves like the built-ins
+    try:
+        _reg.get(name)
+    except MXNetError:
+        raise AttributeError("module %r has no attribute %r"
+                             % (__name__, name)) from None
+    fn = _make_op_func(name)
+    setattr(sys.modules[__name__], name, fn)
+    return fn

@@ -560,6 +560,19 @@ def _init_symbol_module():
 
 _init_symbol_module()
 
+
+def __getattr__(name):
+    # late-registered ops resolve lazily (same contract as mx.nd)
+    try:
+        _reg.get(name)
+    except MXNetError:
+        raise AttributeError("module %r has no attribute %r"
+                             % (__name__, name)) from None
+    fn = _make_sym_func(name)
+    setattr(sys.modules[__name__], name, fn)
+    return fn
+
+
 # aliases matching reference sym namespace
 pow = sys.modules[__name__].__dict__["_power"]  # noqa: A001
 maximum = sys.modules[__name__].__dict__["_maximum"]

@@ -38,6 +38,10 @@ class TorchBridge:
                 if isinstance(a, NDArray):
                     # copy: jax exports read-only buffers, torch wants writable
                     return torch.from_numpy(np.array(a.asnumpy()))
+                if isinstance(a, (tuple, list)):
+                    # tensor-sequence args (torch.cat/stack/...) — convert
+                    # NDArray elements too
+                    return type(a)(conv(x) for x in a)
                 return a
 
             res = fn(*[conv(a) for a in args],

@@ -3,6 +3,7 @@
 import os
 
 import numpy as np
+import pytest
 
 import mxnet_tpu as mx
 from mxnet_tpu import nd
@@ -188,3 +189,30 @@ def test_params_dmlc_byte_format():
         rb = nd.load(bp)
         assert rb["p"]._jx.dtype == jnp.bfloat16
         assert np.allclose(np.asarray(rb["p"]._jx, np.float32), [1.0, 2.5])
+
+
+def test_late_registered_op_resolves():
+    """Ops registered after import appear on mx.nd/mx.sym lazily
+    (module __getattr__), matching the docs/how_to/new_op.md contract."""
+    from mxnet_tpu.ops.helpers import simple
+
+    simple("late_reg_op_xyz", lambda data, k: data * k,
+           params={"k": (float, 2.0)})
+    out = mx.nd.late_reg_op_xyz(mx.nd.array(np.array([1.0, 3.0])))
+    np.testing.assert_allclose(out.asnumpy(), [2.0, 6.0])
+    s = mx.sym.late_reg_op_xyz(mx.sym.Variable("d"), k=3.0)
+    ex = s.simple_bind(mx.cpu(), d=(2,))
+    ex.forward(is_train=False, d=mx.nd.array(np.array([1.0, 2.0])))
+    np.testing.assert_allclose(ex.outputs[0].asnumpy(), [3.0, 6.0])
+    with pytest.raises(AttributeError):
+        mx.nd.definitely_not_an_op_abc  # noqa: B018
+
+
+def test_numpy_inputs_coerce():
+    """Bare numpy arrays are accepted as tensor inputs by generated op
+    functions (the CustomOp host-callback pattern: mx.nd.exp(-in_data[0]))."""
+    x = np.array([0.0, 1.0], np.float32)
+    out = mx.nd.exp(-x)
+    np.testing.assert_allclose(out.asnumpy(), np.exp(-x), rtol=1e-6)
+    out2 = mx.nd.broadcast_add(x, np.ones((1,), np.float32))
+    np.testing.assert_allclose(out2.asnumpy(), x + 1, rtol=1e-6)

@@ -88,3 +88,14 @@ def test_torch_criterion():
     ex.backward()
     g = ex.grad_dict["data"].asnumpy()
     assert_almost_equal(g, 2.0 * (dv - lv) / dv.size, rtol=1e-5, atol=1e-6)
+
+
+def test_torch_sequence_args():
+    """NDArrays nested in tuple/list args convert (torch.cat/stack)."""
+    a = mx.nd.array(np.arange(6, dtype=np.float32).reshape(2, 3))
+    b = mx.nd.array(np.ones((2, 3), np.float32))
+    c = mx.th.cat((a, b), dim=1)
+    assert c.shape == (2, 6)
+    d = mx.th.stack([a, b], dim=0)
+    assert d.shape == (2, 2, 3)
+    np.testing.assert_allclose(d.asnumpy()[1], 1.0)
