@@ -183,6 +183,14 @@ class KVStoreDist(KVStore):
         self._versions = {}
         reg = {"cmd": "register", "role": "worker"}
         worker_id = os.environ.get("DMLC_WORKER_ID")
+        if worker_id is None:
+            # under an MPI/slurm launcher every rank shares one env; the
+            # process-manager rank is the worker identity (dmlc-tracker's
+            # mpi backend relies on the same variables)
+            for var in ("OMPI_COMM_WORLD_RANK", "PMI_RANK", "SLURM_PROCID"):
+                if var in os.environ:
+                    worker_id = os.environ[var]
+                    break
         if worker_id is not None:
             # announce identity so a restarted worker rejoins with its old
             # rank (the reference's ps-lite is_recovery path)

@@ -352,14 +352,14 @@ class KVStoreServer:
 
 def run_server():
     """Blocking server main (the reference ``KVStoreServer.run`` loop)."""
-    # honor an explicit CPU pin before jax's backend initializes: the axon
-    # sitecustomize force-registers the TPU platform regardless of the
-    # JAX_PLATFORMS env var, and the server's optimizer applies (NDArray
-    # math) must not grab the single TPU out from under the workers
-    if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
-        import jax
+    # a parameter server is a host-side component (reference servers are
+    # CPU processes): pin jax to CPU before any backend initializes, or
+    # the server's optimizer applies (NDArray math) grab the accelerator
+    # out from under the workers — on the tunneled single-chip backend
+    # that deadlocks the first server-side update
+    import jax
 
-        jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_platforms", "cpu")
     num_workers = int(os.environ["DMLC_NUM_WORKER"])
     port = int(os.environ.get("DMLC_PS_ROOT_PORT", "9090"))
     # bind address is separate from the advertised DMLC_PS_ROOT_URI: on

@@ -27,10 +27,12 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from . import profiler as _profiler
 from . import random as _random
 from .base import MXNetError
 from .context import Context, current_context
 from .ops import registry as _reg
+from .ops.matrix import _infer_reshape
 
 __all__ = ["NDArray", "array", "zeros", "ones", "empty", "full", "arange",
            "concatenate", "load", "save", "imdecode", "onehot_encode", "waitall"]
@@ -156,8 +158,6 @@ class NDArray:
     def reshape(self, shape, **kwargs):
         if isinstance(shape, int):
             shape = (shape,)
-        from .ops.matrix import _infer_reshape
-
         return NDArray._from_jax(
             self._jx.reshape(_infer_reshape(tuple(shape), self.shape)), self._ctx)
 
@@ -642,8 +642,6 @@ def _invoke(op, args, kwargs):
 
     rng = _random.next_key() if op.needs_rng else None
     fn = _reg.jitted_apply(op.name, _reg.attrs_key(attrs), True)
-    from . import profiler as _profiler
-
     with _profiler.span(op.name, "imperative") as sp:
         if inputs:
             octx = inputs[0]._ctx

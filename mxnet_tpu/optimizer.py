@@ -20,6 +20,7 @@ import numpy as np
 from .base import MXNetError, Registry
 from .ndarray import NDArray, zeros
 from . import ndarray as nd
+from .random import normal as _random_normal
 
 __all__ = ["Optimizer", "SGD", "DCASGD", "NAG", "SGLD", "ccSGD", "Adam",
            "AdaGrad", "RMSProp", "AdaDelta", "Test", "create", "register",
@@ -227,9 +228,8 @@ class SGLD(Optimizer):
         if self.clip_gradient is not None:
             grad = nd.clip(grad, a_min=-self.clip_gradient,
                            a_max=self.clip_gradient)
-        from .random import normal
-
-        noise = normal(0, math.sqrt(lr), weight.shape, weight.context)
+        noise = _random_normal(0, math.sqrt(lr), weight.shape,
+                               weight.context)
         weight += (-lr / 2) * (grad + wd * weight) + noise
 
 

@@ -17,7 +17,10 @@ import jax
 __all__ = ["seed", "next_key", "uniform", "normal", "randint"]
 
 _lock = threading.Lock()
-_key = jax.random.PRNGKey(0)
+# lazy: building a PRNGKey runs a jit computation, which would initialize
+# the jax backend (and the TPU tunnel) at package-import time — breaking
+# host-only processes (PS server) and any later platform pinning
+_key = None
 
 
 def seed(seed_state):
@@ -31,30 +34,41 @@ def next_key():
     """Split off a fresh subkey from the global state."""
     global _key
     with _lock:
+        if _key is None:
+            _key = jax.random.PRNGKey(0)
         _key, sub = jax.random.split(_key)
     return sub
 
 
-def uniform(low=0, high=1, shape=None, ctx=None, dtype="float32", out=None):
-    from . import ndarray as nd
+def _nd():
+    """ndarray imports this module at its top, so a top-level back-import
+    would cycle; a sys.modules lookup also avoids the package import lock
+    — kvstore-server handler threads run while ``import mxnet_tpu`` is
+    still blocked in the auto server loop, and a ``from . import`` there
+    deadlocks (see kvstore_server._pkg_mod)."""
+    import sys as _sys
 
-    return nd.uniform(low=low, high=high,
-                      shape=(1,) if shape is None else shape,
-                      dtype=dtype, ctx=ctx, out=out)
+    mod = _sys.modules.get(__package__ + ".ndarray")
+    if mod is None:  # pragma: no cover - only during partial init
+        from . import ndarray as mod
+    return mod
+
+
+def uniform(low=0, high=1, shape=None, ctx=None, dtype="float32", out=None):
+    return _nd().uniform(low=low, high=high,
+                         shape=(1,) if shape is None else shape,
+                         dtype=dtype, ctx=ctx, out=out)
 
 
 def normal(loc=0, scale=1, shape=None, ctx=None, dtype="float32", out=None):
-    from . import ndarray as nd
-
-    return nd.normal(loc=loc, scale=scale,
-                     shape=(1,) if shape is None else shape,
-                     dtype=dtype, ctx=ctx, out=out)
+    return _nd().normal(loc=loc, scale=scale,
+                        shape=(1,) if shape is None else shape,
+                        dtype=dtype, ctx=ctx, out=out)
 
 
 def randint(low, high, shape=(1,), ctx=None, dtype="int32"):
-    from . import ndarray as nd
     import numpy as np
 
     k = next_key()
     arr = jax.random.randint(k, shape, low, high, dtype=np.dtype(dtype))
-    return nd.NDArray._from_jax(arr, ctx)
+    return _nd().NDArray._from_jax(arr, ctx)

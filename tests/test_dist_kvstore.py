@@ -329,3 +329,83 @@ def test_launcher_ssh_mode(tmp_path):
         env=env, timeout=300, capture_output=True, text=True)
     assert proc.returncode == 0, proc.stderr[-2000:]
     assert (tmp_path / "ok.0").exists() and (tmp_path / "ok.1").exists()
+
+
+def test_launcher_mpi_mode(tmp_path):
+    """--launcher mpi hands all workers to one mpirun invocation; ranks
+    come from the MPI runtime's rank variable. A local stub standing in
+    for mpirun spawns N copies with OMPI_COMM_WORLD_RANK set, proving the
+    command construction and the rank-from-MPI-env identity path."""
+    script = tmp_path / "worker.py"
+    script.write_text(_LAUNCH_SCRIPT)
+    # stub "mpirun -n N cmd...": runs N copies with the rank var set
+    stub = tmp_path / "fake_mpirun.sh"
+    stub.write_text(
+        "#!/bin/sh\n"
+        "shift; N=$1; shift\n"
+        "i=0; pids=''\n"
+        "while [ $i -lt $N ]; do\n"
+        "  OMPI_COMM_WORLD_RANK=$i \"$@\" & pids=\"$pids $!\"\n"
+        "  i=$((i+1))\n"
+        "done\n"
+        "rc=0; for p in $pids; do wait $p || rc=1; done\n"
+        "exit $rc\n")
+    stub.chmod(0o755)
+    env = dict(os.environ, OUT_DIR=str(tmp_path), JAX_PLATFORMS="cpu",
+               MXNET_LAUNCH_MPIRUN=str(stub))
+    env.pop("DMLC_PS_ROOT_PORT", None)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+         "-n", "2", "--launcher", "mpi",
+         sys.executable, str(script)],
+        env=env, timeout=300, capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert (tmp_path / "ok.0").exists() and (tmp_path / "ok.1").exists()
+
+
+_FIT_SCRIPT = """
+import jax; jax.config.update("jax_platforms", "cpu")
+import os, sys
+import numpy as np
+import mxnet_tpu as mx
+
+kv = mx.kv.create("dist_sync")
+rank = kv.rank
+rs = np.random.RandomState(0)
+x = rs.randn(64, 5).astype(np.float32)
+y = (x.sum(axis=1) > 0).astype(np.float32)
+shard = slice(rank * 32, (rank + 1) * 32)
+net = mx.sym.SoftmaxOutput(mx.sym.FullyConnected(
+    mx.sym.Variable("data"), num_hidden=2, name="fc"), name="softmax")
+mod = mx.mod.Module(net)
+it = mx.io.NDArrayIter(x[shard], y[shard], batch_size=16)
+mod.fit(it, kvstore=kv, optimizer="sgd",
+        optimizer_params={"learning_rate": 0.2}, num_epoch=2,
+        initializer=mx.init.Uniform(0.05))
+arg, _ = mod.get_params()
+np.save(os.path.join(os.environ["OUT_DIR"], "w%d.npy" % rank),
+        arg["fc_weight"].asnumpy())
+kv.close()
+"""
+
+
+def test_launcher_fit_with_server_optimizer(tmp_path):
+    """Module.fit with update-on-kvstore under the subprocess launcher:
+    regression test for the server-side deadlock where the auto server
+    loop (blocked inside `import mxnet_tpu`) held the package import lock
+    and the first optimizer apply in a handler thread blocked on a lazy
+    `from . import` (ndarray._invoke's profiler import)."""
+    script = tmp_path / "worker.py"
+    script.write_text(_FIT_SCRIPT)
+    env = dict(os.environ, OUT_DIR=str(tmp_path), JAX_PLATFORMS="cpu")
+    env.pop("DMLC_PS_ROOT_PORT", None)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+         "-n", "2", sys.executable, str(script)],
+        env=env, timeout=280, capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    import numpy as np
+    w0 = np.load(tmp_path / "w0.npy")
+    w1 = np.load(tmp_path / "w1.npy")
+    np.testing.assert_allclose(w0, w1, rtol=1e-5)
+    assert np.abs(w0).sum() > 0

@@ -13,6 +13,12 @@ backends).  Implemented here:
   env forwarded inline on the remote command like dmlc-tracker does.
   ``MXNET_LAUNCH_SSH`` overrides the ssh binary (tests substitute a local
   stub).
+* ``mpi`` — one ``mpirun -n N`` job for all workers; each rank derives
+  its worker id from the process manager's rank variable
+  (``OMPI_COMM_WORLD_RANK``/``PMI_RANK``/``SLURM_PROCID``), exactly the
+  dmlc-tracker mpi convention.  ``MXNET_LAUNCH_MPIRUN`` overrides the
+  mpirun binary (also: tests substitute a local stub); ``--hostfile`` is
+  forwarded when given.
 
 Multi-host TPU pods should normally use the platform's pod runtime (one
 process per host + ``jax.distributed``); these launchers cover the
@@ -42,6 +48,22 @@ def _spawn_local(cmd, env):
     return subprocess.Popen(cmd, env=env)
 
 
+def _spawn_mpi(cmd, env, fwd_keys, num_workers, hostfile):
+    """One mpirun job covering every worker rank; wire env travels
+    inline on the command via ``env VAR=VALUE ...`` — flavor-neutral
+    (OpenMPI's ``-x`` would tie the launcher to one MPI implementation)."""
+    mpirun = os.environ.get("MXNET_LAUNCH_MPIRUN", "mpirun")
+    argv = shlex.split(mpirun) + ["-n", str(num_workers)]
+    if hostfile:
+        argv += ["--hostfile", hostfile]
+    # env forwarded inline so the same invocation works for any MPI
+    # flavor (dmlc-tracker uses -x; `env` is flavor-neutral)
+    exports = ["%s=%s" % (k, env[k]) for k in sorted(fwd_keys)
+               if k in env and k != "DMLC_WORKER_ID"]
+    argv += ["env"] + exports + list(cmd)
+    return subprocess.Popen(argv, env=env)
+
+
 def _spawn_ssh(host, cmd, env, base_keys):
     """Run cmd on host with the DMLC_*/MXNET_* env inlined (dmlc-tracker
     forwards the wire-protocol env the same way)."""
@@ -60,9 +82,11 @@ def main():
     p.add_argument("-s", "--num-servers", type=int, default=1,
                    help="kept for reference CLI parity; the TPU PS is a "
                         "single threaded server process")
-    p.add_argument("--launcher", default="local", choices=["local", "ssh"])
+    p.add_argument("--launcher", default="local",
+                   choices=["local", "ssh", "mpi"])
     p.add_argument("-H", "--hostfile", type=str, default=None,
-                   help="ssh launcher: file with one host per line")
+                   help="ssh: file with one host per line; mpi: forwarded "
+                        "to mpirun --hostfile")
     p.add_argument("--env", action="append", default=[],
                    help="extra VAR=VALUE to pass to all processes")
     p.add_argument("command", nargs=argparse.REMAINDER)
@@ -110,13 +134,21 @@ def main():
     time.sleep(0.3)
 
     workers = []
-    for rank in range(args.num_workers):
-        env = dict(base_env, DMLC_ROLE="worker", DMLC_WORKER_ID=str(rank))
-        if args.launcher == "ssh":
-            host = hosts[rank % len(hosts)]
-            workers.append(_spawn_ssh(host, args.command, env, fwd_keys))
-        else:
-            workers.append(_spawn_local(args.command, env))
+    if args.launcher == "mpi":
+        env = dict(base_env, DMLC_ROLE="worker")
+        env.pop("DMLC_WORKER_ID", None)  # ranks come from the MPI runtime
+        workers.append(_spawn_mpi(args.command, env, fwd_keys,
+                                  args.num_workers, args.hostfile))
+    else:
+        for rank in range(args.num_workers):
+            env = dict(base_env, DMLC_ROLE="worker",
+                       DMLC_WORKER_ID=str(rank))
+            if args.launcher == "ssh":
+                host = hosts[rank % len(hosts)]
+                workers.append(_spawn_ssh(host, args.command, env,
+                                          fwd_keys))
+            else:
+                workers.append(_spawn_local(args.command, env))
     rc = 0
     for w in workers:
         rc |= w.wait()
