@@ -1,0 +1,248 @@
+"""Caffe converter: prototxt -> Symbol, synthetic .caffemodel -> params.
+
+Reference: ``tools/caffe_converter/`` (+ its ``test_converter.py``, which
+downloads real models; here the caffemodel binary is synthesized with the
+wire-format writer so the test runs offline).
+"""
+
+import os
+import sys
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from tools.caffe_converter import wire  # noqa: E402
+from tools.caffe_converter.convert_model import (  # noqa: E402
+    convert, parse_caffemodel)
+from tools.caffe_converter.convert_symbol import convert_symbol  # noqa: E402
+from tools.caffe_converter.prototxt import first, parse  # noqa: E402
+
+_PROTOTXT = """
+name: "TinyNet"
+input: "data"
+input_dim: 1
+input_dim: 3
+input_dim: 8
+input_dim: 8
+layer {
+  name: "conv1"
+  type: "Convolution"
+  bottom: "data"
+  top: "conv1"
+  convolution_param { num_output: 4 kernel_size: 3 pad: 1 stride: 1 }
+}
+layer { name: "relu1" type: "ReLU" bottom: "conv1" top: "conv1" }
+layer {
+  name: "pool1"
+  type: "Pooling"
+  bottom: "conv1"
+  top: "pool1"
+  pooling_param { pool: MAX kernel_size: 2 stride: 2 }
+}
+layer {
+  name: "fc1"
+  type: "InnerProduct"
+  bottom: "pool1"
+  top: "fc1"
+  inner_product_param { num_output: 5 }
+}
+layer { name: "prob" type: "Softmax" bottom: "fc1" top: "prob" }
+"""
+
+
+def test_prototxt_parser():
+    net = parse(_PROTOTXT)
+    assert first(net, "name") == "TinyNet"
+    assert net["input_dim"] == [1, 3, 8, 8]
+    layers = net["layer"]
+    assert [first(l, "type") for l in layers] == \
+        ["Convolution", "ReLU", "Pooling", "InnerProduct", "Softmax"]
+    conv = first(layers[0], "convolution_param")
+    assert first(conv, "num_output") == 4 and first(conv, "pad") == 1
+
+
+def test_convert_symbol_forward():
+    sym, inputs = convert_symbol(_PROTOTXT)
+    assert inputs == ["data"]
+    args = sym.list_arguments()
+    for want in ("conv1_weight", "conv1_bias", "fc1_weight", "fc1_bias"):
+        assert want in args, args
+    ex = sym.simple_bind(mx.cpu(), data=(1, 3, 8, 8))
+    ex.forward(is_train=False, data=mx.nd.zeros((1, 3, 8, 8)))
+    out = ex.outputs[0].asnumpy()
+    assert out.shape == (1, 5)
+    np.testing.assert_allclose(out.sum(), 1.0, rtol=1e-5)
+
+
+def _blob(arr):
+    arr = np.asarray(arr, np.float32)
+    shape_msg = wire.ld(1, b"".join(wire.write_varint(int(d))
+                                    for d in arr.shape))
+    return wire.ld(7, shape_msg) + \
+        wire.packed_float_field(5, arr.reshape(-1).tolist())
+
+
+def _layer(name, typ, blobs):
+    msg = wire.string_field(1, name) + wire.string_field(2, typ)
+    for b in blobs:
+        msg += wire.ld(7, _blob(b))
+    return wire.ld(100, msg)
+
+
+def test_caffemodel_roundtrip(tmp_path):
+    rs = np.random.RandomState(0)
+    w_conv = rs.randn(4, 3, 3, 3).astype(np.float32)
+    b_conv = rs.randn(4).astype(np.float32)
+    w_fc = rs.randn(5, 4 * 4 * 4).astype(np.float32)
+    b_fc = rs.randn(5).astype(np.float32)
+    model = (_layer("conv1", "Convolution", [w_conv, b_conv]) +
+             _layer("fc1", "InnerProduct", [w_fc, b_fc]))
+
+    layers = parse_caffemodel(model)
+    assert [(n, t) for n, t, _ in layers] == \
+        [("conv1", "Convolution"), ("fc1", "InnerProduct")]
+    np.testing.assert_allclose(layers[0][2][0], w_conv, rtol=1e-6)
+
+    proto_path = tmp_path / "net.prototxt"
+    proto_path.write_text(_PROTOTXT)
+    model_path = tmp_path / "net.caffemodel"
+    model_path.write_bytes(model)
+    prefix = str(tmp_path / "converted")
+    sym, arg_nd, aux_nd = convert(str(proto_path), str(model_path), prefix)
+    assert os.path.exists(prefix + "-symbol.json")
+    assert os.path.exists(prefix + "-0000.params")
+
+    # forward through the converted checkpoint == numpy reference
+    x = rs.rand(1, 3, 8, 8).astype(np.float32)
+    loaded_sym, args, aux = mx.model.load_checkpoint(prefix, 0)
+    ex = loaded_sym.simple_bind(mx.cpu(), data=(1, 3, 8, 8))
+    ex.copy_params_from(args, aux)
+    ex.forward(is_train=False, data=mx.nd.array(x))
+    out = ex.outputs[0].asnumpy()
+
+    # numpy: conv(pad1) -> relu -> maxpool2 -> fc -> softmax
+    from numpy.lib.stride_tricks import sliding_window_view
+    xp = np.pad(x[0], ((0, 0), (1, 1), (1, 1)))
+    win = sliding_window_view(xp, (3, 3, 3), axis=(0, 1, 2))[0]
+    conv = np.einsum("hwcij,ocij->ohw", win, w_conv) + \
+        b_conv[:, None, None]
+    relu = np.maximum(conv, 0)
+    pool = relu.reshape(4, 4, 2, 4, 2).max(axis=(2, 4))
+    fc = w_fc @ pool.reshape(-1) + b_fc
+    e = np.exp(fc - fc.max())
+    expect = e / e.sum()
+    np.testing.assert_allclose(out[0], expect, rtol=1e-4, atol=1e-5)
+
+
+_BN_PROTOTXT = """
+name: "BNNet"
+input: "data"
+input_dim: 1
+input_dim: 2
+input_dim: 4
+input_dim: 4
+layer {
+  name: "bn1" type: "BatchNorm" bottom: "data" top: "bn1"
+  batch_norm_param { eps: 0.001 }
+}
+layer { name: "scale1" type: "Scale" bottom: "bn1" top: "scale1" }
+layer { name: "relu1" type: "ReLU" bottom: "scale1" top: "relu1" }
+"""
+
+
+def test_batchnorm_scale_pair(tmp_path):
+    rs = np.random.RandomState(1)
+    mean = rs.rand(2).astype(np.float32)
+    var = (rs.rand(2) + 0.5).astype(np.float32)
+    factor = np.array([2.0], np.float32)
+    gamma = rs.rand(2).astype(np.float32) + 0.5
+    beta = rs.rand(2).astype(np.float32)
+    model = (_layer("bn1", "BatchNorm", [mean * 2, var * 2, factor]) +
+             _layer("scale1", "Scale", [gamma, beta]))
+    proto_path = tmp_path / "bn.prototxt"
+    proto_path.write_text(_BN_PROTOTXT)
+    model_path = tmp_path / "bn.caffemodel"
+    model_path.write_bytes(model)
+    prefix = str(tmp_path / "bnconv")
+    sym, arg_nd, aux_nd = convert(str(proto_path), str(model_path), prefix)
+
+    x = rs.rand(1, 2, 4, 4).astype(np.float32)
+    ex = sym.simple_bind(mx.cpu(), data=(1, 2, 4, 4))
+    ex.copy_params_from({k: v for k, v in arg_nd.items()},
+                        {k: v for k, v in aux_nd.items()})
+    ex.forward(is_train=False, data=mx.nd.array(x))
+    out = ex.outputs[0].asnumpy()
+    norm = (x - mean[None, :, None, None]) / \
+        np.sqrt(var[None, :, None, None] + 1e-3)
+    expect = np.maximum(
+        norm * gamma[None, :, None, None] + beta[None, :, None, None], 0)
+    np.testing.assert_allclose(out, expect, rtol=1e-4, atol=1e-5)
+
+
+_MODERN_PROTOTXT = """
+name: "Modern"
+layer {
+  name: "data" type: "Input" top: "data"
+  input_param { shape { dim: 1 dim: 2 dim: 6 dim: 6 } }
+}
+layer {
+  name: "conv_asym" type: "Convolution" bottom: "data" top: "conv_asym"
+  convolution_param { num_output: 3 kernel_h: 3 kernel_w: 1
+                      pad_h: 1 pad_w: 0 }
+}
+layer { name: "lrelu" type: "ReLU" bottom: "conv_asym" top: "conv_asym"
+  relu_param { negative_slope: 0.1 } }
+layer { name: "conv_b" type: "Convolution" bottom: "data" top: "conv_b"
+  convolution_param { num_output: 3 kernel_size: 1 } }
+layer { name: "sub" type: "Eltwise" bottom: "conv_asym" bottom: "conv_b"
+  top: "sub" eltwise_param { operation: SUM coeff: 1.0 coeff: -1.0 } }
+"""
+
+
+def test_modern_input_asym_kernel_leaky_coeff():
+    """Modern Input layer, kernel_h/kernel_w split, leaky ReLU slope, and
+    Eltwise SUM coefficients all convert faithfully."""
+    sym, inputs = convert_symbol(_MODERN_PROTOTXT)
+    assert inputs == ["data"]
+    ex = sym.simple_bind(mx.cpu(), data=(1, 2, 6, 6))
+    rs = np.random.RandomState(0)
+    w_a = rs.randn(3, 2, 3, 1).astype(np.float32)
+    w_b = rs.randn(3, 2, 1, 1).astype(np.float32)
+    x = rs.randn(1, 2, 6, 6).astype(np.float32)
+    ex.arg_dict["conv_asym_weight"][:] = w_a
+    ex.arg_dict["conv_asym_bias"][:] = 0
+    ex.arg_dict["conv_b_weight"][:] = w_b
+    ex.arg_dict["conv_b_bias"][:] = 0
+    ex.forward(is_train=False, data=mx.nd.array(x))
+    out = ex.outputs[0].asnumpy()
+    assert out.shape == (1, 3, 6, 6)
+
+    # numpy reference: 3x1 conv pad (1,0), leaky relu 0.1, minus 1x1 conv
+    xp = np.pad(x[0], ((0, 0), (1, 1), (0, 0)))
+    conv_a = np.zeros((3, 6, 6), np.float32)
+    for o in range(3):
+        for i in range(6):
+            for j in range(6):
+                conv_a[o, i, j] = (xp[:, i:i + 3, j:j + 1] *
+                                   w_a[o]).sum()
+    leaky = np.where(conv_a > 0, conv_a, 0.1 * conv_a)
+    conv_b = np.einsum("chw,oc->ohw", x[0], w_b[:, :, 0, 0])
+    np.testing.assert_allclose(out[0], leaky - conv_b, rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_legacy_blob_dims_preserved():
+    """Legacy num/channels/height/width blob dims survive verbatim — a
+    num_output=1 conv weight must stay 4-D."""
+    from tools.caffe_converter.convert_model import _blob_array
+
+    w = np.arange(1 * 2 * 3 * 3, dtype=np.float32).reshape(1, 2, 3, 3)
+    legacy = (wire.varint_field(1, 1) + wire.varint_field(2, 2) +
+              wire.varint_field(3, 3) + wire.varint_field(4, 3) +
+              wire.packed_float_field(5, w.reshape(-1).tolist()))
+    arr = _blob_array(legacy)
+    assert arr.shape == (1, 2, 3, 3)
+    np.testing.assert_allclose(arr, w)

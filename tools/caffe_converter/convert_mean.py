@@ -1,0 +1,36 @@
+"""Convert a Caffe mean.binaryproto (BlobProto) to a .npy file.
+
+Reference: ``tools/caffe_converter/convert_mean.py`` (binaryproto →
+``.nd`` file); here the output is a plain ``.npy`` consumable by
+``mx.io`` mean_img options.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+from tools.caffe_converter.convert_model import _blob_array  # noqa: E402
+
+
+def convert_mean(binaryproto_path, output_path):
+    with open(binaryproto_path, "rb") as f:
+        arr = _blob_array(f.read())
+    np.save(output_path, arr.astype(np.float32))
+    return arr
+
+
+if __name__ == "__main__":
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("binaryproto")
+    ap.add_argument("output", help=".npy output path")
+    a = ap.parse_args()
+    arr = convert_mean(a.binaryproto, a.output)
+    print("Saved mean %s -> %s" % (arr.shape, a.output))

@@ -1,0 +1,172 @@
+"""Convert a Caffe .caffemodel (binary NetParameter) into params.
+
+Reference: ``tools/caffe_converter/convert_model.py``. Decoding uses the
+generic wire reader in ``wire.py`` with field numbers from the public
+BVLC ``caffe.proto``:
+
+  NetParameter:      layers(V1)=2, layer=100
+  LayerParameter:    name=1, type=2, blobs=7
+  V1LayerParameter:  bottom=2, top=3, name=4, type=5, blobs=6
+  BlobProto:         num=1, channels=2, height=3, width=4,
+                     data(packed float)=5, shape=7 (BlobShape: dim=1)
+
+Mapping to mxnet_tpu arg names (same scheme as the reference converter):
+  Convolution/InnerProduct/Deconvolution: <name>_weight, <name>_bias
+  BatchNorm: moving_mean/moving_var come from the caffe BatchNorm blobs
+  (divided by the scale factor in blob 2), gamma/beta from the paired
+  Scale layer (converted under the Scale layer's name by
+  convert_symbol).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+from tools.caffe_converter import wire  # noqa: E402
+
+
+def _blob_array(blob_bytes):
+    f = wire.collect(blob_bytes, wanted=(1, 2, 3, 4, 5, 7))
+    data = wire.packed_floats(f[5])
+    if f[7]:  # BlobShape
+        dims = wire.packed_varints(wire.collect(f[7][0], wanted=(1,))[1])
+        shape = tuple(int(d) for d in dims)
+    else:  # legacy 4-D num/channels/height/width
+        legacy = [f[1], f[2], f[3], f[4]]
+        # keep the dims exactly as stored — stripping leading 1s would
+        # corrupt e.g. a num_output=1 conv weight (1, C, k, k); consumers
+        # reshape biases/vectors themselves
+        shape = tuple(int(v[0]) for v in legacy if v)
+        if not shape:
+            shape = (data.size,)
+    return np.asarray(data, np.float32).reshape(shape)
+
+
+def parse_caffemodel(buf):
+    """Returns [(layer_name, layer_type, [blob arrays])]."""
+    net = wire.collect(buf, wanted=(2, 100))
+    out = []
+    for raw in net[100]:  # modern LayerParameter
+        f = wire.collect(raw, wanted=(1, 2, 7))
+        name = f[1][0].decode() if f[1] else ""
+        typ = f[2][0].decode() if f[2] else ""
+        out.append((name, typ, [_blob_array(b) for b in f[7]]))
+    for raw in net[2]:  # V1LayerParameter
+        f = wire.collect(raw, wanted=(4, 5, 6))
+        name = f[4][0].decode() if f[4] else ""
+        typ = int(f[5][0]) if f[5] else 0
+        out.append((name, typ, [_blob_array(b) for b in f[6]]))
+    return out
+
+
+_V1_CONV, _V1_IP, _V1_DECONV = 4, 14, 39
+_V1_BN = 41  # caffe's V1 "BN"
+
+
+def convert_model(caffemodel_bytes, flatten_fc_weights=True):
+    """caffemodel bytes -> {arg_name: np.ndarray} (+ aux moving stats)."""
+    args = {}
+    aux = {}
+    for name, typ, blobs in parse_caffemodel(caffemodel_bytes):
+        if not blobs:
+            continue
+        if typ in ("Convolution", "Deconvolution", "InnerProduct",
+                   _V1_CONV, _V1_IP, _V1_DECONV):
+            w = blobs[0]
+            if typ in ("InnerProduct", _V1_IP):
+                w = w.reshape(w.shape[-2], -1) if w.ndim > 2 else w
+            args[name + "_weight"] = w
+            if len(blobs) > 1:
+                args[name + "_bias"] = blobs[1].reshape(-1)
+        elif typ in ("BatchNorm", _V1_BN):
+            mean, var = blobs[0].reshape(-1), blobs[1].reshape(-1)
+            if len(blobs) > 2:  # scale factor blob
+                factor = float(blobs[2].reshape(-1)[0])
+                if factor != 0:
+                    mean, var = mean / factor, var / factor
+            aux[name + "_moving_mean"] = mean
+            aux[name + "_moving_var"] = var
+        elif typ == "Scale":
+            args[name + "_gamma"] = blobs[0].reshape(-1)
+            if len(blobs) > 1:
+                args[name + "_beta"] = blobs[1].reshape(-1)
+    return args, aux
+
+
+def _propagate_bn_stats(layers, args, aux):
+    """The symbol converter re-emits BatchNorm under the paired Scale
+    layer's name; copy the stats across and give the Scale layer's
+    BatchNorm its gamma/beta."""
+    prev_bn = None
+    for name, typ, blobs in layers:
+        if typ in ("BatchNorm", _V1_BN):
+            prev_bn = name
+        elif typ == "Scale" and prev_bn is not None:
+            aux[name + "_moving_mean"] = aux.get(prev_bn + "_moving_mean")
+            aux[name + "_moving_var"] = aux.get(prev_bn + "_moving_var")
+            prev_bn = None
+    return args, aux
+
+
+def convert(prototxt_path, caffemodel_path, output_prefix, epoch=0):
+    """Full conversion: writes <prefix>-symbol.json + <prefix>-%04d.params
+    (the reference converter's output contract)."""
+    import mxnet_tpu as mx
+    from tools.caffe_converter.convert_symbol import convert_symbol
+
+    with open(prototxt_path) as f:
+        sym, inputs = convert_symbol(f.read())
+    with open(caffemodel_path, "rb") as f:
+        buf = f.read()
+    layers = parse_caffemodel(buf)
+    args, aux = convert_model(buf)
+    args, aux = _propagate_bn_stats(layers, args, aux)
+
+    wanted_args = set(sym.list_arguments())
+    wanted_aux = set(sym.list_auxiliary_states())
+    arg_nd = {k: mx.nd.array(v) for k, v in args.items()
+              if k in wanted_args and v is not None}
+    aux_nd = {k: mx.nd.array(v) for k, v in aux.items()
+              if k in wanted_aux and v is not None}
+    # Scale-layer BatchNorms re-emitted with fix_gamma=False still list
+    # gamma/beta for the ORIGINAL BatchNorm layer name (fixed to 1/0)
+    for k in wanted_args - set(arg_nd):
+        if k.endswith("_gamma"):
+            base = next((a for a in sym.list_auxiliary_states()
+                         if a == k[:-6] + "_moving_var"), None)
+            if base is not None:
+                n = aux.get(base)
+                arg_nd[k] = mx.nd.ones((len(n),) if n is not None else (1,))
+        elif k.endswith("_beta"):
+            base = k[:-5] + "_moving_mean"
+            n = aux.get(base)
+            arg_nd[k] = mx.nd.zeros((len(n),) if n is not None else (1,))
+    mx.model.save_checkpoint(output_prefix, epoch, sym, arg_nd, aux_nd)
+    return sym, arg_nd, aux_nd
+
+
+def main():
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        description="Convert caffe model to mxnet_tpu checkpoint")
+    ap.add_argument("prototxt")
+    ap.add_argument("caffemodel")
+    ap.add_argument("output_prefix")
+    ap.add_argument("--epoch", type=int, default=0)
+    args = ap.parse_args()
+    sym, arg_nd, aux_nd = convert(args.prototxt, args.caffemodel,
+                                  args.output_prefix, args.epoch)
+    print("Saved %s-symbol.json and %s-%04d.params (%d args, %d aux)"
+          % (args.output_prefix, args.output_prefix, args.epoch,
+             len(arg_nd), len(aux_nd)))
+
+
+if __name__ == "__main__":
+    main()

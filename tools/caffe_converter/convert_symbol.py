@@ -1,0 +1,245 @@
+"""Convert a Caffe deploy prototxt into an mxnet_tpu Symbol.
+
+Reference: ``tools/caffe_converter/convert_symbol.py`` (prototxt →
+``mx.sym`` source text via caffe_pb2). Here the net is built directly
+from the parsed prototxt; both the modern ``layer { type: "Convolution"
+}`` form and the V1 ``layers { type: CONVOLUTION }`` enum form are
+accepted.
+
+Supported layers: Input/Data, Convolution, Deconvolution, Pooling,
+InnerProduct, ReLU, Sigmoid, TanH, Dropout, LRN, Softmax(WithLoss),
+Concat, Eltwise, Flatten, BatchNorm (+ following Scale folded in).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+from tools.caffe_converter import prototxt  # noqa: E402
+from tools.caffe_converter.prototxt import first  # noqa: E402
+
+# V1LayerParameter.LayerType enum name -> modern string type
+_V1_TYPES = {
+    "CONVOLUTION": "Convolution", "DECONVOLUTION": "Deconvolution",
+    "POOLING": "Pooling", "INNER_PRODUCT": "InnerProduct", "RELU": "ReLU",
+    "SIGMOID": "Sigmoid", "TANH": "TanH", "DROPOUT": "Dropout",
+    "LRN": "LRN", "SOFTMAX": "Softmax", "SOFTMAX_LOSS": "SoftmaxWithLoss",
+    "CONCAT": "Concat", "ELTWISE": "Eltwise", "FLATTEN": "Flatten",
+    "DATA": "Data", "BN": "BatchNorm",
+}
+
+
+def _layers(net):
+    """Normalized layer list from either 'layer' or V1 'layers' fields."""
+    out = []
+    for lay in net.get("layer", []) + net.get("layers", []):
+        typ = first(lay, "type")
+        if typ in _V1_TYPES:
+            typ = _V1_TYPES[typ]
+        out.append((first(lay, "name"), typ, lay))
+    return out
+
+
+def _pair(param, field, default=0):
+    """Caffe allows kernel_size/stride/pad as repeated or _h/_w split
+    (the split fields are kernel_h/kernel_w — no '_size' suffix)."""
+    vals = param.get(field, [])
+    if vals:
+        v = vals[0]
+        return (int(v), int(v))
+    base = field[:-5] if field.endswith("_size") else field
+    h = first(param, base + "_h")
+    w = first(param, base + "_w")
+    if h is not None or w is not None:
+        return (int(h or default), int(w or default))
+    return (int(default), int(default))
+
+
+def _skip(typ):
+    return typ in ("Data", "ImageData", "HDF5Data", "Accuracy", "Silence")
+
+
+def convert_symbol(prototxt_text):
+    """Returns (symbol, input_names). Import-light: mxnet_tpu is imported
+    here so the parser half stays usable standalone."""
+    import mxnet_tpu as mx
+
+    net = prototxt.parse(prototxt_text)
+    blobs = {}
+
+    def blob(name):
+        if name not in blobs:
+            blobs[name] = mx.sym.Variable(name)
+        return blobs[name]
+
+    inputs = list(net.get("input", []))
+    for name in inputs:
+        blob(name)
+
+    # top blob -> (input symbol, eps): BatchNorm awaiting a paired Scale
+    pending_bn = {}
+
+    for name, typ, lay in _layers(net):
+        if _skip(typ) or typ == "Input":
+            # data/Input layers declare the input blob (the modern deploy
+            # form: layer { type: "Input" input_param { shape {...} } })
+            for top in lay.get("top", []):
+                if top != "label":
+                    inputs.append(top)
+                    blob(top)
+            continue
+        bottoms = [blob(b) for b in lay.get("bottom", []) if b != "label"]
+        data = bottoms[0] if bottoms else None
+        tops = lay.get("top", [name])
+
+        if typ == "Convolution" or typ == "Deconvolution":
+            p = first(lay, "convolution_param", {})
+            kernel = _pair(p, "kernel_size")
+            stride = _pair(p, "stride", 1)
+            pad = _pair(p, "pad", 0)
+            op = mx.sym.Convolution if typ == "Convolution" \
+                else mx.sym.Deconvolution
+            out = op(data=data, name=name,
+                     num_filter=int(first(p, "num_output")),
+                     kernel=kernel, stride=stride, pad=pad,
+                     num_group=int(first(p, "group", 1)),
+                     no_bias=not _to_bool(first(p, "bias_term", True)))
+        elif typ == "Pooling":
+            p = first(lay, "pooling_param", {})
+            pool = {0: "max", "MAX": "max", 1: "avg", "AVE": "avg"}.get(
+                first(p, "pool", "MAX"), "max")
+            if _to_bool(first(p, "global_pooling", False)):
+                out = mx.sym.Pooling(data=data, name=name, kernel=(1, 1),
+                                     pool_type=pool, global_pool=True)
+            else:
+                out = mx.sym.Pooling(
+                    data=data, name=name, pool_type=pool,
+                    kernel=_pair(p, "kernel_size"),
+                    stride=_pair(p, "stride", 1), pad=_pair(p, "pad", 0),
+                    pooling_convention="full")  # caffe ceils output dims
+        elif typ == "InnerProduct":
+            p = first(lay, "inner_product_param", {})
+            out = mx.sym.FullyConnected(
+                data=mx.sym.Flatten(data), name=name,
+                num_hidden=int(first(p, "num_output")),
+                no_bias=not _to_bool(first(p, "bias_term", True)))
+        elif typ == "ReLU":
+            slope = float(first(first(lay, "relu_param", {}),
+                                "negative_slope", 0.0))
+            if slope:
+                out = mx.sym.LeakyReLU(data=data, name=name,
+                                       act_type="leaky", slope=slope)
+            else:
+                out = mx.sym.Activation(data=data, name=name,
+                                        act_type="relu")
+        elif typ == "Sigmoid":
+            out = mx.sym.Activation(data=data, name=name,
+                                    act_type="sigmoid")
+        elif typ == "TanH":
+            out = mx.sym.Activation(data=data, name=name, act_type="tanh")
+        elif typ == "Dropout":
+            p = first(lay, "dropout_param", {})
+            out = mx.sym.Dropout(data=data, name=name,
+                                 p=float(first(p, "dropout_ratio", 0.5)))
+        elif typ == "LRN":
+            p = first(lay, "lrn_param", {})
+            out = mx.sym.LRN(data=data, name=name,
+                             alpha=float(first(p, "alpha", 1e-4)),
+                             beta=float(first(p, "beta", 0.75)),
+                             knorm=float(first(p, "k", 1.0)),
+                             nsize=int(first(p, "local_size", 5)))
+        elif typ == "Softmax":
+            # caffe's inference-time Softmax is a plain softmax; using
+            # SoftmaxOutput would add an implicit <name>_label variable
+            out = mx.sym.softmax(data=data, name=name)
+        elif typ == "SoftmaxWithLoss":
+            out = mx.sym.SoftmaxOutput(data=data, name=name)
+        elif typ == "Concat":
+            p = first(lay, "concat_param", {})
+            out = mx.sym.Concat(*bottoms, name=name,
+                                num_args=len(bottoms),
+                                dim=int(first(p, "axis", 1)))
+        elif typ == "Eltwise":
+            p = first(lay, "eltwise_param", {})
+            mode = first(p, "operation", "SUM")
+            if mode in ("SUM", 1):
+                coeff = [float(c) for c in p.get("coeff", [])] or \
+                    [1.0] * len(bottoms)
+                terms = [b if c == 1.0 else b * c
+                         for b, c in zip(bottoms, coeff)]
+                out = terms[0]
+                for t in terms[1:]:
+                    out = out + t
+            elif mode in ("PROD", 0):
+                out = bottoms[0]
+                for b in bottoms[1:]:
+                    out = out * b
+            else:  # MAX
+                out = bottoms[0]
+                for b in bottoms[1:]:
+                    out = mx.sym._maximum(out, b)
+        elif typ == "Flatten":
+            out = mx.sym.Flatten(data=data, name=name)
+        elif typ == "BatchNorm":
+            p = first(lay, "batch_norm_param", {})
+            eps = float(first(p, "eps", 1e-5))
+            out = mx.sym.BatchNorm(
+                data=data, name=name, use_global_stats=True,
+                eps=eps, fix_gamma=True)
+            pending_bn[tops[0]] = (data, eps)
+        elif typ == "Scale":
+            # caffe pairs BatchNorm (normalize-only) with Scale (γ/β);
+            # our BatchNorm owns gamma/beta, so re-emit it unfused with
+            # learnable γ/β under the SCALE layer's name so conversion
+            # maps that layer's blobs directly
+            src = first(lay, "bottom")
+            if src not in pending_bn:
+                raise NotImplementedError(
+                    "standalone Scale layer %r is not supported" % name)
+            inner, eps = pending_bn.pop(src)
+            out = mx.sym.BatchNorm(
+                data=inner, name=name, use_global_stats=True,
+                eps=eps, fix_gamma=False)
+        else:
+            raise NotImplementedError("caffe layer type %r (%s)"
+                                      % (typ, name))
+
+        for top in tops:
+            blobs[top] = out
+
+    # network output = the top produced by the last non-data layer
+    last = None
+    for name, typ, lay in _layers(net):
+        if not _skip(typ):
+            last = lay.get("top", [name])[0]
+    return blobs[last], sorted(set(inputs))
+
+
+def _to_bool(v):
+    if isinstance(v, str):
+        return v.lower() == "true"
+    return bool(v)
+
+
+def main():
+    import argparse
+
+    import mxnet_tpu as mx  # noqa: F401
+
+    ap = argparse.ArgumentParser(
+        description="Convert caffe prototxt to symbol json")
+    ap.add_argument("prototxt")
+    ap.add_argument("output", help="output -symbol.json path")
+    args = ap.parse_args()
+    with open(args.prototxt) as f:
+        sym, inputs = convert_symbol(f.read())
+    sym.save(args.output)
+    print("Saved symbol to %s (inputs: %s)" % (args.output, inputs))
+
+
+if __name__ == "__main__":
+    main()

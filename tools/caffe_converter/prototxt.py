@@ -1,0 +1,103 @@
+"""Minimal Caffe prototxt (protobuf text format) parser.
+
+Reference: ``tools/caffe_converter/caffe_parser.py`` uses the compiled
+``caffe_pb2`` + ``google.protobuf.text_format``; this framework parses the
+text format directly — deploy prototxts only use nested blocks, scalar
+fields, and repeated fields, which a ~100-line recursive parser covers —
+so the converter has no protobuf/caffe build dependency.
+
+A message block parses to a dict whose values are lists (every field is
+treated as repeated; use ``first()`` for optionals).
+"""
+
+from __future__ import annotations
+
+import re
+
+_TOKEN = re.compile(
+    r"""\s*(?:
+        (?P<comment>\#[^\n]*) |
+        (?P<brace>[{}]) |
+        (?P<name>[A-Za-z_][A-Za-z0-9_]*) |
+        (?P<colon>:) |
+        (?P<string>"(?:[^"\\]|\\.)*") |
+        (?P<number>[-+]?[0-9]*\.?[0-9]+(?:[eE][-+]?[0-9]+)?) |
+        (?P<other>\S)
+    )""",
+    re.VERBOSE,
+)
+
+
+def _tokens(text):
+    pos = 0
+    while pos < len(text):
+        m = _TOKEN.match(text, pos)
+        if m is None or m.end() == pos:
+            break
+        pos = m.end()
+        kind = m.lastgroup
+        if kind == "comment":
+            continue
+        yield kind, m.group(kind)
+
+
+class _Stream:
+    def __init__(self, text):
+        self._it = list(_tokens(text))
+        self._i = 0
+
+    def peek(self):
+        return self._it[self._i] if self._i < len(self._it) else (None, None)
+
+    def next(self):
+        tok = self.peek()
+        self._i += 1
+        return tok
+
+
+_BOOL = {"true": True, "false": False}
+
+
+def _scalar(kind, value):
+    if kind == "string":
+        return value[1:-1].replace('\\"', '"')
+    if kind == "number":
+        f = float(value)
+        return int(f) if f.is_integer() and "." not in value \
+            and "e" not in value.lower() else f
+    # bare identifier: bool or enum name (kept as str)
+    return _BOOL.get(value, value)
+
+
+def _parse_message(s):
+    msg = {}
+    while True:
+        kind, value = s.next()
+        if kind is None or (kind == "brace" and value == "}"):
+            return msg
+        if kind != "name":
+            raise ValueError("prototxt: expected field name, got %r" % value)
+        field = value
+        kind, value = s.peek()
+        if kind == "brace" and value == "{":
+            s.next()
+            item = _parse_message(s)
+        elif kind == "colon":
+            s.next()
+            kind, value = s.next()
+            item = _scalar(kind, value)
+        else:
+            raise ValueError("prototxt: expected ':' or '{' after %r"
+                             % field)
+        msg.setdefault(field, []).append(item)
+
+
+def parse(text):
+    """Parse prototxt text into nested dicts-of-lists."""
+    return _parse_message(_Stream(text))
+
+
+def first(msg, field, default=None):
+    """First value of a (possibly repeated) field."""
+    vals = msg.get(field)
+    return vals[0] if vals else default

@@ -1,0 +1,118 @@
+"""Minimal protobuf wire-format codec for reading .caffemodel files.
+
+Reference: ``tools/caffe_converter/convert_model.py`` decodes models via
+the compiled ``caffe_pb2``; here a generic wire reader extracts just the
+fields the converter needs (field numbers from the public BVLC
+``caffe.proto``), so no protoc step or caffe checkout is required.
+The writer half exists for round-trip tests.
+
+Wire types: 0 varint, 1 fixed64, 2 length-delimited, 5 fixed32.
+"""
+
+from __future__ import annotations
+
+import struct
+
+
+def read_varint(buf, pos):
+    result = 0
+    shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+
+
+def write_varint(value):
+    out = bytearray()
+    while True:
+        b = value & 0x7F
+        value >>= 7
+        if value:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def fields(buf):
+    """Yield (field_number, wire_type, value) over a message buffer.
+    Length-delimited values are raw bytes; varints are ints."""
+    pos = 0
+    n = len(buf)
+    while pos < n:
+        key, pos = read_varint(buf, pos)
+        field, wt = key >> 3, key & 7
+        if wt == 0:
+            val, pos = read_varint(buf, pos)
+        elif wt == 1:
+            val = buf[pos:pos + 8]
+            pos += 8
+        elif wt == 2:
+            ln, pos = read_varint(buf, pos)
+            val = buf[pos:pos + ln]
+            pos += ln
+        elif wt == 5:
+            val = buf[pos:pos + 4]
+            pos += 4
+        else:
+            raise ValueError("unsupported wire type %d" % wt)
+        yield field, wt, val
+
+
+def collect(buf, wanted):
+    """Gather repeated fields by number: {field_number: [values]}."""
+    out = {f: [] for f in wanted}
+    for field, _wt, val in fields(buf):
+        if field in out:
+            out[field].append(val)
+    return out
+
+
+def packed_floats(chunks):
+    """Decode float data chunks — packed (length-delimited) and unpacked
+    (fixed32) values both arrive from fields() as little-endian bytes."""
+    import numpy as np
+
+    parts = [np.frombuffer(c, dtype="<f4") for c in chunks]
+    return np.concatenate(parts) if parts else np.zeros((0,), "<f4")
+
+
+def packed_varints(chunks):
+    out = []
+    for c in chunks:
+        if isinstance(c, int):
+            out.append(c)
+            continue
+        pos = 0
+        while pos < len(c):
+            v, pos = read_varint(c, pos)
+            out.append(v)
+    return out
+
+
+# -- writer (tests build synthetic .caffemodel files) ----------------------
+
+def tag(field, wiretype):
+    return write_varint((field << 3) | wiretype)
+
+
+def ld(field, payload):
+    """Length-delimited field."""
+    return tag(field, 2) + write_varint(len(payload)) + payload
+
+
+def varint_field(field, value):
+    return tag(field, 0) + write_varint(value)
+
+
+def packed_float_field(field, values):
+    payload = struct.pack("<%df" % len(values), *values)
+    return ld(field, payload)
+
+
+def string_field(field, s):
+    return ld(field, s.encode())
