@@ -246,3 +246,31 @@ def test_legacy_blob_dims_preserved():
     arr = _blob_array(legacy)
     assert arr.shape == (1, 2, 3, 3)
     np.testing.assert_allclose(arr, w)
+
+
+def test_softmax_axis_and_dilation():
+    """4-D Softmax normalizes over channels (caffe default axis=1) and
+    dilation converts to the dilate attr."""
+    p = """
+name: "FCN"
+input: "data"
+input_dim: 1
+input_dim: 2
+input_dim: 5
+input_dim: 5
+layer { name: "convd" type: "Convolution" bottom: "data" top: "convd"
+  convolution_param { num_output: 3 kernel_size: 3 pad: 2 dilation: 2 } }
+layer { name: "prob" type: "Softmax" bottom: "convd" top: "prob" }
+"""
+    sym, _ = convert_symbol(p)
+    ex = sym.simple_bind(mx.cpu(), data=(1, 2, 5, 5))
+    rs = np.random.RandomState(0)
+    for n, a in ex.arg_dict.items():
+        if n != "data":
+            a[:] = rs.randn(*a.shape).astype(np.float32)
+    ex.forward(is_train=False,
+               data=mx.nd.array(rs.rand(1, 2, 5, 5).astype(np.float32)))
+    out = ex.outputs[0].asnumpy()
+    # dilation 2, pad 2, kernel 3 keeps 5x5 spatial dims
+    assert out.shape == (1, 3, 5, 5)
+    np.testing.assert_allclose(out.sum(axis=1), 1.0, rtol=1e-5)

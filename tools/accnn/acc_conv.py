@@ -1,0 +1,105 @@
+"""Vertical-horizontal low-rank decomposition of one conv layer.
+
+Reference: ``tools/accnn/acc_conv.py`` — the Jaderberg-style scheme: a
+k_h x k_w convolution of C->N channels factorizes (via SVD of the
+(C*k_h, N*k_w) unfolding) into a k_h x 1 conv C->K followed by a
+1 x k_w conv K->N. Rank K controls the speed/accuracy trade.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from tools.accnn import utils
+from tools.accnn.utils import attr_tuple, var_node
+
+
+def decompose_weights(W, b, K):
+    """Returns (W_v, b_v, W_h, b_h) for rank K."""
+    N, C, kh, kw = W.shape
+    unfold = W.transpose(1, 2, 0, 3).reshape(C * kh, N * kw)
+    U, D, Qt = np.linalg.svd(unfold, full_matrices=False)
+    sqrt_d = np.sqrt(D[:K])
+    V = U[:, :K] * sqrt_d          # (C*kh, K)
+    H = Qt[:K].T * sqrt_d          # (N*kw, K)
+    W_v = V.T.reshape(K, C, kh, 1)
+    W_h = H.reshape(N, kw, 1, K).transpose(0, 3, 2, 1)  # (N, K, 1, kw)
+    b_v = np.zeros((K,), np.float32)
+    b_h = np.asarray(b, np.float32).reshape(-1)
+    return (W_v.astype(np.float32), b_v, W_h.astype(np.float32), b_h)
+
+
+def conv_vh_decomposition(model, layer, K):
+    """Replace ``layer`` (a conv) with its rank-K vertical/horizontal
+    pair; returns a new Model."""
+    W = model.arg_params[layer + "_weight"].asnumpy()
+    b = model.arg_params.get(layer + "_bias")
+    b = b.asnumpy() if b is not None else np.zeros(W.shape[0], np.float32)
+    W_v, b_v, W_h, b_h = decompose_weights(W, b, K)
+
+    def make_nodes(node, data_entry, base):
+        groups = int(node.get("attrs", {}).get("num_group", "1") or 1)
+        if groups != 1:
+            # the VH unfolding assumes dense channel mixing; a grouped
+            # conv would need a per-group decomposition
+            raise NotImplementedError(
+                "conv_vh_decomposition: grouped conv %r (num_group=%d) "
+                "is not supported" % (node["name"], groups))
+        kh, kw = attr_tuple(node, "kernel", (1, 1))
+        ph, pw = attr_tuple(node, "pad", (0, 0))
+        sh, sw = attr_tuple(node, "stride", (1, 1))
+        dh, dw = attr_tuple(node, "dilate", (1, 1))
+        name = node["name"]
+        common = {"misc_attrs": node.get("misc_attrs", {})}
+        # the separable structure carries the original dilation per axis
+        v_attrs = {"kernel": str((kh, 1)), "pad": str((ph, 0)),
+                   "stride": str((sh, 1)), "dilate": str((dh, 1)),
+                   "num_filter": str(W_v.shape[0])}
+        h_attrs = {"kernel": str((1, kw)), "pad": str((0, pw)),
+                   "stride": str((1, sw)), "dilate": str((1, dw)),
+                   "num_filter": str(W_h.shape[0])}
+        new = [
+            var_node(name + "_v_weight"),            # base+0
+            var_node(name + "_v_bias"),              # base+1
+            dict(op="Convolution", name=name + "_v", attrs=v_attrs,
+                 inputs=[data_entry, [base + 0, 0], [base + 1, 0]],
+                 **common),                          # base+2
+            var_node(name + "_h_weight"),            # base+3
+            var_node(name + "_h_bias"),              # base+4
+            dict(op="Convolution", name=name + "_h", attrs=h_attrs,
+                 inputs=[[base + 2, 0], [base + 3, 0], [base + 4, 0]],
+                 **common),                          # base+5
+        ]
+        return new, 5
+
+    import mxnet_tpu as mx
+
+    sym = utils.splice_node(model.symbol, layer, make_nodes)
+    arg = dict(model.arg_params)
+    arg[layer + "_v_weight"] = mx.nd.array(W_v)
+    arg[layer + "_v_bias"] = mx.nd.array(b_v)
+    arg[layer + "_h_weight"] = mx.nd.array(W_h)
+    arg[layer + "_h_bias"] = mx.nd.array(b_h)
+    arg = utils.prune_orphan_params(sym, arg)
+    return utils.Model(sym, arg, model.aux_params)
+
+
+def main():
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        description="Low-rank decompose one conv layer")
+    ap.add_argument("-m", "--model", required=True, help="model prefix")
+    ap.add_argument("--load-epoch", type=int, default=1)
+    ap.add_argument("--layer", required=True)
+    ap.add_argument("-K", "--K", type=int, required=True)
+    ap.add_argument("--save-model", default="new-model")
+    args = ap.parse_args()
+    model = utils.load_model(args.model, args.load_epoch)
+    new_model = conv_vh_decomposition(model, args.layer, args.K)
+    utils.save_model(new_model, args.save_model)
+    print("saved %s-0001.params" % args.save_model)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,81 @@
+"""SVD decomposition of one FullyConnected layer.
+
+Reference: ``tools/accnn/acc_fc.py`` — W (out, in) factorizes into
+W2 (out, K) @ W1 (K, in): the layer becomes FC(in->K, no bias) followed
+by FC(K->out, original bias).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from tools.accnn import utils
+from tools.accnn.utils import var_node
+
+
+def decompose_weights(W, K):
+    U, D, Qt = np.linalg.svd(np.asarray(W, np.float64),
+                             full_matrices=False)
+    sqrt_d = np.sqrt(D[:K])
+    W2 = (U[:, :K] * sqrt_d).astype(np.float32)        # (out, K)
+    W1 = (sqrt_d[:, None] * Qt[:K]).astype(np.float32)  # (K, in)
+    return W1, W2
+
+
+def fc_decomposition(model, layer, K):
+    W = model.arg_params[layer + "_weight"].asnumpy()
+    b = model.arg_params.get(layer + "_bias")
+    W1, W2 = decompose_weights(W, K)
+
+    def make_nodes(node, data_entry, base):
+        name = node["name"]
+        common = {"misc_attrs": node.get("misc_attrs", {})}
+        red_attrs = {"num_hidden": str(K), "no_bias": "True"}
+        rec_attrs = {"num_hidden": str(W.shape[0]),
+                     "no_bias": str(b is None)}
+        new = [
+            var_node(name + "_red_weight"),           # base+0
+            dict(op="FullyConnected", name=name + "_red",
+                 attrs=red_attrs, inputs=[data_entry, [base + 0, 0]],
+                 **common),                           # base+1
+            var_node(name + "_rec_weight"),           # base+2
+        ]
+        rec_inputs = [[base + 1, 0], [base + 2, 0]]
+        if b is not None:
+            new.append(var_node(name + "_rec_bias"))  # base+3
+            rec_inputs.append([base + 3, 0])
+        new.append(dict(op="FullyConnected", name=name + "_rec",
+                        attrs=rec_attrs, inputs=rec_inputs, **common))
+        return new, len(new) - 1
+
+    import mxnet_tpu as mx
+
+    sym = utils.splice_node(model.symbol, layer, make_nodes)
+    arg = dict(model.arg_params)
+    arg[layer + "_red_weight"] = mx.nd.array(W1)
+    arg[layer + "_rec_weight"] = mx.nd.array(W2)
+    if b is not None:
+        arg[layer + "_rec_bias"] = b
+    arg = utils.prune_orphan_params(sym, arg)
+    return utils.Model(sym, arg, model.aux_params)
+
+
+def main():
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        description="Low-rank decompose one FC layer")
+    ap.add_argument("-m", "--model", required=True, help="model prefix")
+    ap.add_argument("--load-epoch", type=int, default=1)
+    ap.add_argument("--layer", required=True)
+    ap.add_argument("-K", "--K", type=int, required=True)
+    ap.add_argument("--save-model", default="new-model")
+    args = ap.parse_args()
+    model = utils.load_model(args.model, args.load_epoch)
+    new_model = fc_decomposition(model, args.layer, args.K)
+    utils.save_model(new_model, args.save_model)
+    print("saved %s-0001.params" % args.save_model)
+
+
+if __name__ == "__main__":
+    main()

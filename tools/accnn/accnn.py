@@ -1,0 +1,63 @@
+"""Accelerate a trained CNN by low-rank decomposition.
+
+Reference: ``tools/accnn/accnn.py`` — loads a checkpoint, picks per-layer
+ranks (config json or automatic rank selection for a target speedup
+ratio), applies VH conv and SVD FC decompositions, saves the new model.
+
+Usage:
+  python accnn.py -m model-prefix --load-epoch 1 --ratio 2 \
+      --save-model new-model [--data-shape 1,3,224,224]
+  python accnn.py -m model-prefix --config my_config.json ...
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+from tools.accnn import acc_conv, acc_fc, rank_selection, utils  # noqa: E402
+
+
+def main():
+    ap = argparse.ArgumentParser(description="speed up a CNN checkpoint")
+    ap.add_argument("-m", "--model", required=True, help="model prefix")
+    ap.add_argument("--load-epoch", type=int, default=1)
+    ap.add_argument("--save-model", type=str, default="new-model")
+    ap.add_argument("--config", default=None,
+                    help="json with conv_params/fc_params {layer: rank}")
+    ap.add_argument("--ratio", type=float, default=2.0)
+    ap.add_argument("--data-shape", type=str, default="1,3,224,224")
+    args = ap.parse_args()
+
+    model = utils.load_model(args.model, args.load_epoch)
+    if args.config:
+        with open(args.config) as f:
+            config = json.load(f)
+    else:
+        data_shape = tuple(int(x) for x in args.data_shape.split(","))
+        config = {
+            "conv_params": rank_selection.get_ranksel(model, args.ratio,
+                                                      data_shape),
+            "fc_params": {},
+        }
+        out = "config-rksel-%.1f.json" % args.ratio
+        with open(out, "w") as f:
+            json.dump(config, f, indent=2)
+        print("rank selection written to", out)
+
+    new_model = model
+    for layer, K in config.get("conv_params", {}).items():
+        new_model = acc_conv.conv_vh_decomposition(new_model, layer, int(K))
+    for layer, K in config.get("fc_params", {}).items():
+        new_model = acc_fc.fc_decomposition(new_model, layer, int(K))
+    utils.save_model(new_model, args.save_model)
+    print("saved %s-0001.params" % args.save_model)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,113 @@
+"""Automatic per-layer rank selection for a target speedup ratio.
+
+Reference: ``tools/accnn/rank_selection.py`` — dynamic programming that
+maximizes retained singular-value energy across decomposable conv
+layers subject to a total-FLOPs budget of (original / ratio). Costs are
+real per-layer MAC counts (output spatial size x kernel volume), so a
+cheap early conv cannot crowd out an expensive late one; the DP is a
+knapsack over budget bins.
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+
+from tools.accnn.utils import attr_tuple
+
+
+def _conv_nodes(symbol):
+    g = json.loads(symbol.tojson())
+    out = []
+    for node in g["nodes"]:
+        if node["op"] != "Convolution":
+            continue
+        kh, kw = attr_tuple(node, "kernel", (1, 1))
+        groups = int(node.get("attrs", {}).get("num_group", "1") or 1)
+        if kh * kw > 1 and groups == 1:  # 1x1/grouped gain nothing here
+            out.append(node)
+    return out
+
+
+def _internal_shapes(symbol, data_shape):
+    ints = symbol.get_internals()
+    _, out_shapes, _ = ints.infer_shape(data=data_shape)
+    return dict(zip(ints.list_outputs(), out_shapes))
+
+
+_FRACS = (0.05, 0.1, 0.15, 0.2, 0.3, 0.4, 0.5, 0.6, 0.75, 0.9)
+
+
+def _layer_profile(model, node, out_shape):
+    """(ranks, values, costs, orig_cost): candidate ranks with retained
+    log-energy and absolute VH MAC counts."""
+    name = node["name"]
+    W = model.arg_params[name + "_weight"].asnumpy()
+    N, C, kh, kw = W.shape
+    D = np.linalg.svd(W.transpose(1, 2, 0, 3).reshape(C * kh, N * kw),
+                      compute_uv=False)
+    energy = np.cumsum(D ** 2) / np.sum(D ** 2)
+    full = len(D)
+    _, _, H, Wo = out_shape
+    orig = H * Wo * N * C * kh * kw
+    ranks, values, costs = [], [], []
+    for frac in _FRACS:
+        K = max(1, int(round(full * frac)))
+        if K >= full or K in ranks:
+            continue
+        ranks.append(K)
+        values.append(float(np.log(max(energy[K - 1], 1e-12))))
+        costs.append(H * Wo * K * (C * kh + N * kw))
+    return ranks, values, costs, orig
+
+
+def get_ranksel(model, ratio, data_shape=(1, 3, 224, 224), bins=200):
+    """{layer_name: K} with total decomposed MACs <= original/ratio over
+    the decomposable layers."""
+    nodes = _conv_nodes(model.symbol)
+    if not nodes:
+        return {}
+    shapes = _internal_shapes(model.symbol, data_shape)
+    profiles = []
+    for node in nodes:
+        out_shape = shapes.get(node["name"] + "_output")
+        if out_shape is None or len(out_shape) != 4:
+            continue
+        profiles.append((_layer_profile(model, node, out_shape), node))
+    if not profiles:
+        return {}
+    budget = sum(p[3] for p, _ in profiles) / ratio
+    step = budget / bins
+    NEG = -1e18
+    dp = np.full(bins + 1, NEG)
+    dp[0] = 0.0
+    choice = []
+    for (ranks, values, costs, _orig), _node in profiles:
+        ndp = np.full(bins + 1, NEG)
+        nch = {}
+        for b in range(bins + 1):
+            if dp[b] == NEG:
+                continue
+            for K, v, c in zip(ranks, values, costs):
+                nb = b + max(1, int(np.ceil(c / step))) if step > 0 \
+                    else bins
+                if nb > bins:
+                    continue
+                if dp[b] + v > ndp[nb]:
+                    ndp[nb] = dp[b] + v
+                    nch[nb] = (b, K)
+        dp = ndp
+        choice.append(nch)
+    best_b = int(np.argmax(dp))
+    if dp[best_b] == NEG:
+        # budget infeasible even at minimum ranks: use the smallest
+        # candidate everywhere
+        return {n["name"]: p[0][0] for p, n in profiles}
+    sel = {}
+    b = best_b
+    for li in range(len(profiles) - 1, -1, -1):
+        prev_b, K = choice[li][b]
+        sel[profiles[li][1]["name"]] = K
+        b = prev_b
+    return sel

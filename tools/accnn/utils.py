@@ -1,0 +1,106 @@
+"""Shared helpers for the accnn low-rank acceleration tool.
+
+Reference: ``tools/accnn/utils.py`` — model load/save plus JSON graph
+surgery (``replace_conv_layer``). Here the surgery edits the saved
+symbol JSON (splice a node subgraph in place, remap downstream inputs,
+prune unreachable nodes) and rebuilds through ``mx.sym.load_json``, so
+the whole op zoo keeps working without a per-op rebuild path.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import mxnet_tpu as mx  # noqa: E402
+
+Model = collections.namedtuple("Model", "symbol arg_params aux_params")
+
+
+def load_model(prefix, epoch):
+    sym, arg, aux = mx.model.load_checkpoint(prefix, epoch)
+    return Model(sym, arg, aux)
+
+
+def save_model(model, prefix, epoch=1):
+    mx.model.save_checkpoint(prefix, epoch, model.symbol,
+                             model.arg_params, model.aux_params)
+
+
+def attr_tuple(node, key, default=()):
+    """Parse a stringified tuple attr like '(3, 3)'."""
+    s = node.get("attrs", {}).get(key)
+    if not s or s == "()":
+        return tuple(default)
+    return tuple(int(x) for x in s.strip("()").split(",") if x.strip())
+
+
+def var_node(name):
+    return {"op": "null", "name": name, "misc_attrs": {}, "inputs": []}
+
+
+def splice_node(symbol, layer_name, make_nodes):
+    """Replace the op node called ``layer_name`` and rebuild the symbol.
+
+    ``make_nodes(node, data_entry, base_id)`` returns
+    ``(new_nodes, out_local_index)``: JSON node dicts whose inputs
+    reference already-remapped existing ids or new nodes at
+    ``base_id + position``. Downstream consumers of the old node are
+    rewired to the new output; nodes made unreachable (the old layer's
+    weight/bias variables) are pruned.
+    """
+    g = json.loads(symbol.tojson())
+    nodes = g["nodes"]
+    out_nodes = []
+    idmap = {}
+    found = False
+    for old_id, node in enumerate(nodes):
+        if node.get("name") == layer_name and node["op"] != "null":
+            ent = node["inputs"][0]
+            data_entry = [idmap[ent[0]], ent[1]]
+            new_nodes, out_local = make_nodes(node, data_entry,
+                                              len(out_nodes))
+            base = len(out_nodes)
+            out_nodes.extend(new_nodes)
+            idmap[old_id] = base + out_local
+            found = True
+            continue
+        new_inputs = [[idmap[e[0]], e[1]] + list(e[2:])
+                      for e in node.get("inputs", [])]
+        idmap[old_id] = len(out_nodes)
+        out_nodes.append(dict(node, inputs=new_inputs))
+    if not found:
+        raise KeyError("layer %r not found" % layer_name)
+    heads = [[idmap[h[0]], h[1]] + list(h[2:]) for h in g["heads"]]
+
+    # prune unreachable nodes (the replaced layer's orphaned params)
+    reachable = set()
+    stack = [h[0] for h in heads]
+    while stack:
+        i = stack.pop()
+        if i in reachable:
+            continue
+        reachable.add(i)
+        stack.extend(e[0] for e in out_nodes[i].get("inputs", []))
+    keep = sorted(reachable)
+    remap = {old: new for new, old in enumerate(keep)}
+    pruned = []
+    for old in keep:
+        node = out_nodes[old]
+        node = dict(node, inputs=[[remap[e[0]], e[1]] + list(e[2:])
+                                  for e in node.get("inputs", [])])
+        pruned.append(node)
+    g["nodes"] = pruned
+    g["heads"] = [[remap[h[0]], h[1]] + list(h[2:]) for h in heads]
+    g["arg_nodes"] = [i for i, n in enumerate(pruned) if n["op"] == "null"]
+    return mx.sym.load_json(json.dumps(g))
+
+
+def prune_orphan_params(symbol, arg_params):
+    wanted = set(symbol.list_arguments())
+    return {k: v for k, v in arg_params.items() if k in wanted}

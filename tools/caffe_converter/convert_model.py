@@ -69,11 +69,12 @@ _V1_CONV, _V1_IP, _V1_DECONV = 4, 14, 39
 _V1_BN = 41  # caffe's V1 "BN"
 
 
-def convert_model(caffemodel_bytes, flatten_fc_weights=True):
-    """caffemodel bytes -> {arg_name: np.ndarray} (+ aux moving stats)."""
+def convert_model(layers):
+    """Parsed layer list (from ``parse_caffemodel``) ->
+    ({arg_name: np.ndarray}, {aux_name: np.ndarray})."""
     args = {}
     aux = {}
-    for name, typ, blobs in parse_caffemodel(caffemodel_bytes):
+    for name, typ, blobs in layers:
         if not blobs:
             continue
         if typ in ("Convolution", "Deconvolution", "InnerProduct",
@@ -125,7 +126,7 @@ def convert(prototxt_path, caffemodel_path, output_prefix, epoch=0):
     with open(caffemodel_path, "rb") as f:
         buf = f.read()
     layers = parse_caffemodel(buf)
-    args, aux = convert_model(buf)
+    args, aux = convert_model(layers)
     args, aux = _propagate_bn_stats(layers, args, aux)
 
     wanted_args = set(sym.list_arguments())

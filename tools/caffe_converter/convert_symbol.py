@@ -101,11 +101,13 @@ def convert_symbol(prototxt_text):
             kernel = _pair(p, "kernel_size")
             stride = _pair(p, "stride", 1)
             pad = _pair(p, "pad", 0)
+            dilate = _pair(p, "dilation", 1)
             op = mx.sym.Convolution if typ == "Convolution" \
                 else mx.sym.Deconvolution
             out = op(data=data, name=name,
                      num_filter=int(first(p, "num_output")),
                      kernel=kernel, stride=stride, pad=pad,
+                     dilate=dilate,
                      num_group=int(first(p, "group", 1)),
                      no_bias=not _to_bool(first(p, "bias_term", True)))
         elif typ == "Pooling":
@@ -153,9 +155,12 @@ def convert_symbol(prototxt_text):
                              knorm=float(first(p, "k", 1.0)),
                              nsize=int(first(p, "local_size", 5)))
         elif typ == "Softmax":
-            # caffe's inference-time Softmax is a plain softmax; using
-            # SoftmaxOutput would add an implicit <name>_label variable
-            out = mx.sym.softmax(data=data, name=name)
+            # caffe's inference-time Softmax normalizes over CHANNELS
+            # (axis 1) by default, not the last axis; using SoftmaxOutput
+            # would also add an implicit <name>_label variable
+            p = first(lay, "softmax_param", {})
+            out = mx.sym.softmax(data=data, name=name,
+                                 axis=int(first(p, "axis", 1)))
         elif typ == "SoftmaxWithLoss":
             out = mx.sym.SoftmaxOutput(data=data, name=name)
         elif typ == "Concat":
