@@ -1,0 +1,94 @@
+#!/usr/bin/env python
+"""Convert a Caffe model and fine-tune it (reference ``example/caffe``,
+re-based on the converter instead of the compiled caffe plugin).
+
+No-egress note: a synthetic .caffemodel is generated with the wire
+writer so the example runs without downloads.
+"""
+
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from tools.caffe_converter import wire  # noqa: E402
+from tools.caffe_converter.convert_model import convert  # noqa: E402
+
+PROTOTXT = """
+name: "CaffeMLP"
+input: "data"
+input_dim: 1
+input_dim: 1
+input_dim: 8
+input_dim: 8
+layer { name: "fc1" type: "InnerProduct" bottom: "data" top: "fc1"
+  inner_product_param { num_output: 16 } }
+layer { name: "relu1" type: "ReLU" bottom: "fc1" top: "fc1" }
+layer { name: "fc2" type: "InnerProduct" bottom: "fc1" top: "fc2"
+  inner_product_param { num_output: 2 } }
+layer { name: "prob" type: "SoftmaxWithLoss" bottom: "fc2" top: "prob" }
+"""
+
+
+def make_synthetic_caffemodel(path, rs):
+    def blob(arr):
+        arr = np.asarray(arr, np.float32)
+        shape = wire.ld(1, b"".join(wire.write_varint(int(d))
+                                    for d in arr.shape))
+        return wire.ld(7, shape) + \
+            wire.packed_float_field(5, arr.reshape(-1).tolist())
+
+    def layer(name, typ, blobs):
+        msg = wire.string_field(1, name) + wire.string_field(2, typ)
+        for b in blobs:
+            msg += wire.ld(7, blob(b))
+        return wire.ld(100, msg)
+
+    model = (layer("fc1", "InnerProduct",
+                   [rs.randn(16, 64).astype("f") * 0.1,
+                    np.zeros(16, "f")]) +
+             layer("fc2", "InnerProduct",
+                   [rs.randn(2, 16).astype("f") * 0.1, np.zeros(2, "f")]))
+    with open(path, "wb") as f:
+        f.write(model)
+
+
+if __name__ == "__main__":
+    logging.basicConfig(level=logging.INFO)
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--num-epochs", type=int, default=5)
+    ap.add_argument("--lr", type=float, default=0.2)
+    ap.add_argument("--workdir", default="/tmp/caffe_example")
+    args = ap.parse_args()
+    os.makedirs(args.workdir, exist_ok=True)
+    rs = np.random.RandomState(0)
+
+    proto = os.path.join(args.workdir, "net.prototxt")
+    with open(proto, "w") as f:
+        f.write(PROTOTXT)
+    cmodel = os.path.join(args.workdir, "net.caffemodel")
+    make_synthetic_caffemodel(cmodel, rs)
+
+    prefix = os.path.join(args.workdir, "imported")
+    sym, arg_nd, aux_nd = convert(proto, cmodel, prefix)
+    logging.info("converted: args=%s", sorted(arg_nd))
+
+    # fine-tune on a synthetic task, starting from the caffe weights
+    n = 256
+    x = rs.rand(n, 1, 8, 8).astype(np.float32)
+    w_true = rs.randn(64)
+    y = (x.reshape(n, -1) @ w_true > 0).astype(np.float32)
+    mod = mx.mod.Module(sym, label_names=("prob_label",))
+    it = mx.io.NDArrayIter(x, y, batch_size=32, shuffle=True,
+                           label_name="prob_label")
+    metric = mx.metric.Accuracy()
+    mod.fit(it, eval_metric=metric, num_epoch=args.num_epochs,
+            optimizer="sgd", optimizer_params={"learning_rate": args.lr},
+            arg_params=arg_nd, aux_params=aux_nd, allow_missing=True,
+            initializer=mx.init.Xavier())
+    logging.info("fine-tuned accuracy: %s", metric.get()[1])

@@ -1,0 +1,27 @@
+#!/usr/bin/env python
+"""Single-op module with a monitor — kernel-level debugging
+(reference python-howto/debug_conv.py)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import mxnet_tpu as mx  # noqa: E402
+
+data_shape = (1, 3, 5, 5)
+data = mx.sym.Variable("data")
+conv = mx.sym.Convolution(data=data, kernel=(3, 3), pad=(1, 1),
+                          stride=(1, 1), num_filter=1)
+mon = mx.mon.Monitor(1)
+
+mod = mx.mod.Module(conv, data_names=("data",), label_names=())
+mod.bind(data_shapes=[("data", data_shape)])
+mod.install_monitor(mon)
+mod.init_params()
+
+mon.tic()
+mod.forward(mx.io.DataBatch(data=[mx.nd.ones(data_shape)], label=[]),
+            is_train=True)
+res = mod.get_outputs()[0].asnumpy()
+mon.toc_print()
+print(res)

@@ -1,0 +1,37 @@
+#!/usr/bin/env python
+"""Install a Monitor to stat every intermediate tensor during training
+(reference python-howto/monitor_weights.py)."""
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+
+logging.basicConfig(level=logging.DEBUG)
+
+data = mx.sym.Variable("data")
+fc1 = mx.sym.FullyConnected(data=data, name="fc1", num_hidden=32)
+act1 = mx.sym.Activation(data=fc1, name="relu1", act_type="relu")
+fc2 = mx.sym.FullyConnected(data=act1, name="fc2", num_hidden=4)
+mlp = mx.sym.SoftmaxOutput(data=fc2, name="softmax")
+
+rs = np.random.RandomState(0)
+x = rs.rand(200, 16).astype(np.float32)
+y = rs.randint(0, 4, 200).astype(np.float32)
+
+model = mx.model.FeedForward(ctx=mx.cpu(), symbol=mlp, num_epoch=2,
+                             learning_rate=0.1, momentum=0.9,
+                             numpy_batch_size=50)
+
+
+def norm_stat(d):
+    return mx.nd.norm(d) / np.sqrt(d.size)
+
+
+mon = mx.mon.Monitor(2, norm_stat)
+model.fit(X=x, y=y, monitor=mon,
+          batch_end_callback=mx.callback.Speedometer(50, 2))

@@ -1,0 +1,23 @@
+#!/usr/bin/env python
+"""Multi-output graphs via Group (reference python-howto)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import mxnet_tpu as mx  # noqa: E402
+
+net = mx.sym.Variable("data")
+fc1 = mx.sym.FullyConnected(data=net, name="fc1", num_hidden=128)
+net = mx.sym.Activation(data=fc1, name="relu1", act_type="relu")
+net = mx.sym.FullyConnected(data=net, name="fc2", num_hidden=64)
+out = mx.sym.SoftmaxOutput(data=net, name="softmax")
+group = mx.sym.Group([fc1, out])
+print(group.list_outputs())
+
+ex = group.simple_bind(mx.cpu(), data=(2, 32),
+                       grad_req="null")
+ex.forward(is_train=False, data=mx.nd.ones((2, 32)),
+           softmax_label=mx.nd.zeros((2,)))
+print("fc1 output:", ex.outputs[0].shape)
+print("softmax output:", ex.outputs[1].shape)

@@ -30,6 +30,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from . import profiler as _profiler
 from . import random as _random
 from .base import MXNetError
 from .context import Context
@@ -358,7 +359,12 @@ class Executor:
                 raise MXNetError("forward: unknown input %r" % k)
             dst = self.arg_dict[k]
             if isinstance(v, NDArray):
-                dst._jx = v._jx.astype(dst._jx.dtype) if v._jx.dtype != dst._jx.dtype else v._jx
+                val = v._jx.astype(dst._jx.dtype) \
+                    if v._jx.dtype != dst._jx.dtype else v._jx
+                # inputs may live on another device (reference CopyFromTo
+                # semantics): move to the executor's device; same-device
+                # put is free
+                dst._jx = jax.device_put(val, self._ctx.jax_device())
             else:
                 dst[:] = v
         args = [a._jx for a in self.arg_arrays]
@@ -367,8 +373,6 @@ class Executor:
         # args (e.g. cpu-bound module on a machine whose default is TPU)
         rng = jax.device_put(_random.next_key(), self._ctx.jax_device())
         self._rng_step += 1
-        from . import profiler as _profiler
-
         fused_bwd = is_train and bool(self._diff_names())
         name = ("%s_forward%s" % (self._symbol_name(),
                                   "_backward" if fused_bwd else "")) \
@@ -414,8 +418,6 @@ class Executor:
                 g._jx if isinstance(g, NDArray) else jnp.asarray(g), dev)
                 for g in out_grads]
             args, aux, rng = self._last_state
-            from . import profiler as _profiler
-
             bname = ("%s_backward" % self._symbol_name()) \
                 if _profiler.running() else ""
             with _profiler.span(bname, "symbolic") as sp:

@@ -21,6 +21,7 @@ import random as pyrandom
 
 import numpy as np
 
+from . import ndarray
 from . import recordio
 from .base import MXNetError
 from .io import DataBatch, DataDesc, DataIter
@@ -468,6 +469,9 @@ class ImageIter(DataIter):
             for j in range(i, self.batch_size):
                 data[j] = data[i - 1]
                 label[j] = label[i - 1]
-        return DataBatch(data=[data], label=[label], pad=pad,
+        # batches carry NDArrays like every other DataIter (reference
+        # DataBatch contract: .data/.label are NDArray lists)
+        return DataBatch(data=[ndarray.array(data)],
+                         label=[ndarray.array(label)], pad=pad,
                          provide_data=self.provide_data,
                          provide_label=self.provide_label)

@@ -13,6 +13,7 @@ copy/compute overlap the reference got from pinned-memory copy workers).
 from __future__ import annotations
 
 import gzip
+import logging
 import os
 import struct
 import threading
@@ -351,6 +352,7 @@ class PrefetchingIter(DataIter):
         self.started = True
         self.current_batch = [None for _ in range(self.n_iter)]
         self.next_batch = [None for _ in range(self.n_iter)]
+        self._errors = [None for _ in range(self.n_iter)]
 
         def prefetch_func(self, i):
             while True:
@@ -360,6 +362,11 @@ class PrefetchingIter(DataIter):
                 try:
                     self.next_batch[i] = self.iters[i].next()
                 except StopIteration:
+                    self.next_batch[i] = None
+                except BaseException as e:  # noqa: BLE001
+                    # surface producer crashes on the consumer thread —
+                    # swallowing them would deadlock iter_next's wait
+                    self._errors[i] = e
                     self.next_batch[i] = None
                 self.data_taken[i].clear()
                 self.data_ready[i].set()
@@ -396,6 +403,8 @@ class PrefetchingIter(DataIter):
             e.wait()
         for i in self.iters:
             i.reset()
+        # stale producer errors must not outlive the reset
+        self._errors = [None for _ in range(self.n_iter)]
         for e in self.data_ready:
             e.clear()
         for e in self.data_taken:
@@ -404,6 +413,10 @@ class PrefetchingIter(DataIter):
     def iter_next(self):
         for e in self.data_ready:
             e.wait()
+        for i, err in enumerate(self._errors):
+            if err is not None:
+                self._errors[i] = None
+                raise err
         if self.next_batch[0] is None:
             for i in self.next_batch:
                 assert i is None, "Number of entry mismatches between iters"
@@ -445,7 +458,8 @@ def ImageRecordIter(path_imgrec, data_shape, batch_size, shuffle=False,
                     std_r=1.0, std_g=1.0, std_b=1.0, scale=1.0, resize=0,
                     path_imgidx=None, prefetch=True, data_name="data",
                     label_name="softmax_label", label_width=1,
-                    preprocess_threads=1, **kwargs):
+                    preprocess_threads=1, prefetch_buffer=1,
+                    round_batch=None, **kwargs):
     """C-iter-style facade over ``image.ImageIter`` (+ prefetch thread).
 
     Reference: ``ImageRecordIter`` registered at
@@ -477,4 +491,16 @@ def ImageRecordIter(path_imgrec, data_shape, batch_size, shuffle=False,
                    num_parts=num_parts, aug_list=aug_list,
                    data_name=data_name, label_name=label_name,
                    preprocess_threads=preprocess_threads)
-    return PrefetchingIter(it) if prefetch else it
+    # reference knobs: prefetch_buffer=0 disables the background thread
+    # (the python prefetcher is double-buffered regardless of depth).
+    # Final-batch semantics are the reference's round_batch=0 style:
+    # the partial batch is padded and batch.pad is set — wrap-around
+    # filling (round_batch=1) is not implemented, so warn if requested.
+    if round_batch:
+        logging.warning(
+            "ImageRecordIter: round_batch=True (wrap-around final batch) "
+            "is not implemented; the final batch is padded with batch.pad "
+            "set (round_batch=False semantics)")
+    if not prefetch or not prefetch_buffer:
+        return it
+    return PrefetchingIter(it)

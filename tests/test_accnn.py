@@ -117,3 +117,26 @@ def test_grouped_conv_refused(tmp_path):
     model = utils.load_model(prefix, 1)
     with pytest.raises(NotImplementedError):
         conv_vh_decomposition(model, "gconv", 2)
+
+
+def test_rank_selection_skips_undecomposable(tmp_path):
+    """A conv whose unfolding has full rank 1 must not crash or poison
+    the DP for healthy layers."""
+    data = mx.sym.Variable("data")
+    tiny = mx.sym.Convolution(data, num_filter=4, kernel=(1, 3),
+                              pad=(0, 1), name="tiny")  # 1-ch input: rank 1
+    big = mx.sym.Convolution(tiny, num_filter=8, kernel=(3, 3),
+                             pad=(1, 1), name="big")
+    net = mx.sym.SoftmaxOutput(mx.sym.FullyConnected(big, num_hidden=2,
+                                                     name="fc"),
+                               name="softmax")
+    mod = mx.mod.Module(net)
+    mod.bind(for_training=False, data_shapes=[("data", (1, 1, 8, 8))],
+             label_shapes=[("softmax_label", (1,))])
+    mod.init_params(mx.init.Xavier())
+    prefix = str(tmp_path / "m")
+    arg, aux = mod.get_params()
+    mx.model.save_checkpoint(prefix, 1, net, arg, aux)
+    model = utils.load_model(prefix, 1)
+    sel = get_ranksel(model, ratio=1.5, data_shape=(1, 1, 8, 8))
+    assert "tiny" not in sel and "big" in sel

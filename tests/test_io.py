@@ -6,7 +6,7 @@ import struct
 import numpy as np
 
 import mxnet_tpu as mx
-from mxnet_tpu import io
+from mxnet_tpu import io, nd
 
 
 def test_ndarrayiter_basic():
@@ -92,3 +92,88 @@ def test_prefetching_iter():
     assert len(batches) == 4
     it.reset()
     assert len(list(it)) == 4
+
+
+def test_prefetching_iter_propagates_producer_error():
+    """A crash in the prefetch thread must surface on next(), not hang."""
+    import pytest
+
+    class Boom(io.DataIter):
+        def __init__(self):
+            super().__init__(batch_size=2)
+            self.n = 0
+
+        @property
+        def provide_data(self):
+            return [io.DataDesc("data", (2, 2))]
+
+        @property
+        def provide_label(self):
+            return []
+
+        def reset(self):
+            self.n = 0
+
+        def next(self):
+            self.n += 1
+            if self.n > 1:
+                raise RuntimeError("producer exploded")
+            return io.DataBatch(data=[nd.zeros((2, 2))], label=[])
+
+    it = io.PrefetchingIter(Boom())
+    next(iter(it))  # first batch fine
+    with pytest.raises(RuntimeError, match="producer exploded"):
+        it.next()
+
+
+def test_image_iter_batches_are_ndarrays(tmp_path):
+    """DataBatch contract: .data/.label hold NDArrays (not numpy)."""
+    import numpy as np
+
+    from mxnet_tpu import recordio
+
+    rec_path = str(tmp_path / "t.rec")
+    rec = recordio.MXRecordIO(rec_path, "w")
+    rs = np.random.RandomState(0)
+    for i in range(8):
+        img = (rs.rand(12, 12, 3) * 255).astype(np.uint8)
+        rec.write(recordio.pack_img(recordio.IRHeader(0, float(i), i, 0),
+                                    img))
+    rec.close()
+    it = io.ImageRecordIter(path_imgrec=rec_path, data_shape=(3, 12, 12),
+                            batch_size=4, prefetch_buffer=2,
+                            round_batch=True)
+    batch = next(iter(it))
+    assert isinstance(batch.data[0], nd.NDArray)
+    assert isinstance(batch.label[0], nd.NDArray)
+    assert batch.data[0].shape == (4, 3, 12, 12)
+
+
+def test_prefetching_iter_reset_clears_errors():
+    """A producer error before reset() must not resurface after it."""
+    class Flaky(io.DataIter):
+        def __init__(self):
+            super().__init__(batch_size=2)
+            self.fail_once = True
+
+        @property
+        def provide_data(self):
+            return [io.DataDesc("data", (2, 2))]
+
+        @property
+        def provide_label(self):
+            return []
+
+        def reset(self):
+            pass
+
+        def next(self):
+            if self.fail_once:
+                self.fail_once = False
+                raise RuntimeError("transient")
+            return io.DataBatch(data=[nd.zeros((2, 2))], label=[])
+
+    it = io.PrefetchingIter(Flaky())
+    it.reset()
+    batch = it.next()  # healthy after reset — stale error must not raise
+    assert batch.data[0].shape == (2, 2)

@@ -74,7 +74,12 @@ def get_ranksel(model, ratio, data_shape=(1, 3, 224, 224), bins=200):
         out_shape = shapes.get(node["name"] + "_output")
         if out_shape is None or len(out_shape) != 4:
             continue
-        profiles.append((_layer_profile(model, node, out_shape), node))
+        prof = _layer_profile(model, node, out_shape)
+        if not prof[0]:
+            # full rank 1 (e.g. a 1-channel 1xN conv): nothing to choose,
+            # and an empty candidate list would poison the DP
+            continue
+        profiles.append((prof, node))
     if not profiles:
         return {}
     budget = sum(p[3] for p, _ in profiles) / ratio
