@@ -1,0 +1,113 @@
+#!/usr/bin/env python
+"""Second National Data Science Bowl (cardiac MRI volume estimation).
+
+Reference: ``example/kaggle-ndsb2/Train.py`` — frame-difference LeNet on
+30-frame MRI sequences, CDF-encoded volume targets trained with
+``LogisticRegressionOutput`` (600 sigmoid outputs = P(volume < v)), and
+the CRPS metric via ``mx.metric.np`` with isotonic post-processing.
+
+No-egress note: synthesizes MRI-like sequences whose per-frame intensity
+pulse encodes the "volume" label, so CRPS genuinely falls with training.
+"""
+
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+
+NUM_FRAMES = 30
+CDF_BINS = 600
+
+
+def get_lenet(img=32):
+    """Frame-difference LeNet (reference Train.py:16-38)."""
+    source = mx.sym.Variable("data")
+    source = (source - 128) * (1.0 / 128)
+    frames = mx.sym.SliceChannel(source, num_outputs=NUM_FRAMES)
+    diffs = [frames[i + 1] - frames[i] for i in range(NUM_FRAMES - 1)]
+    source = mx.sym.Concat(*diffs)
+    net = mx.sym.Convolution(source, kernel=(5, 5), num_filter=16)
+    net = mx.sym.BatchNorm(net, fix_gamma=True)
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.Pooling(net, pool_type="max", kernel=(2, 2),
+                         stride=(2, 2))
+    net = mx.sym.Convolution(net, kernel=(3, 3), num_filter=16)
+    net = mx.sym.BatchNorm(net, fix_gamma=True)
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.Pooling(net, pool_type="max", kernel=(2, 2),
+                         stride=(2, 2))
+    flatten = mx.sym.Flatten(net)
+    flatten = mx.sym.Dropout(flatten)
+    fc1 = mx.sym.FullyConnected(data=flatten, num_hidden=CDF_BINS)
+    # sigmoid outputs = P(volume < v): CDF regression
+    return mx.sym.LogisticRegressionOutput(data=fc1, name="softmax")
+
+
+def CRPS(label, pred):
+    """Continuous Ranked Probability Score with isotonic fix-up
+    (reference Train.py:40-48)."""
+    pred = pred.copy()
+    for j in range(pred.shape[1] - 1):
+        fix = pred[:, j] > pred[:, j + 1]
+        pred[fix, j + 1] = pred[fix, j]
+    return np.sum(np.square(label - pred)) / label.size
+
+
+def encode_label(volumes):
+    """Volume scalar -> 600-bin CDF target (reference Train.py:52-63)."""
+    return np.array([(v < np.arange(CDF_BINS)) for v in volumes],
+                    dtype=np.float32)
+
+
+def synth_sequences(n, img, rs):
+    """MRI-ish sequences: a pulsing disc whose pulse amplitude encodes
+    the volume label."""
+    vol = rs.uniform(50, 550, size=n)
+    data = np.zeros((n, NUM_FRAMES, img, img), np.float32)
+    yy, xx = np.mgrid[0:img, 0:img]
+    c = img / 2
+    for i in range(n):
+        base_r = img / 6
+        amp = (vol[i] / 550.0) * img / 5
+        for t in range(NUM_FRAMES):
+            r = base_r + amp * np.sin(2 * np.pi * t / NUM_FRAMES) ** 2
+            disc = ((yy - c) ** 2 + (xx - c) ** 2 <= r * r)
+            data[i, t] = disc * 200.0 + rs.rand(img, img) * 20.0
+    return data, vol
+
+
+if __name__ == "__main__":
+    logging.basicConfig(level=logging.INFO)
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--num-examples", type=int, default=192)
+    ap.add_argument("--img", type=int, default=32)
+    ap.add_argument("--batch-size", type=int, default=16)
+    ap.add_argument("--num-epochs", type=int, default=8)
+    ap.add_argument("--lr", type=float, default=0.05)
+    args = ap.parse_args()
+    rs = np.random.RandomState(0)
+
+    data, vol = synth_sequences(args.num_examples, args.img, rs)
+    labels = encode_label(vol)
+    split = args.num_examples * 3 // 4
+    train = mx.io.NDArrayIter(data[:split], labels[:split],
+                              batch_size=args.batch_size, shuffle=True)
+    val = mx.io.NDArrayIter(data[split:], labels[split:],
+                            batch_size=args.batch_size)
+
+    ctx = mx.tpu() if mx.num_tpus() > 0 else mx.cpu()
+    net = get_lenet(args.img)
+    # the reference trains separate systole/diastole models with the same
+    # code path; one model suffices to demonstrate the pipeline
+    model = mx.model.FeedForward(
+        ctx=ctx, symbol=net, num_epoch=args.num_epochs,
+        learning_rate=args.lr, momentum=0.9, wd=1e-4,
+        initializer=mx.init.Xavier(rnd_type="gaussian"))
+    model.fit(X=train, eval_data=val, eval_metric=mx.metric.np(CRPS),
+              batch_end_callback=mx.callback.Speedometer(args.batch_size))

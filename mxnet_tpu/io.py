@@ -416,6 +416,13 @@ class PrefetchingIter(DataIter):
         for i, err in enumerate(self._errors):
             if err is not None:
                 self._errors[i] = None
+                # invalidate the half-populated round and re-arm the
+                # producers, so a caller that catches the error and calls
+                # next() again gets a clean fetch instead of None.pad
+                for j in range(self.n_iter):
+                    self.next_batch[j] = None
+                    self.data_ready[j].clear()
+                    self.data_taken[j].set()
                 raise err
         if self.next_batch[0] is None:
             for i in self.next_batch:

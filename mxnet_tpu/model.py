@@ -159,6 +159,11 @@ class FeedForward:
             eval_end_callback=None, eval_batch_end_callback=None):
         data = self._prepare_data(X, y)
         mod = self._get_module()
+        if mod.binded and [tuple(d[1]) for d in mod.data_shapes] != \
+                [tuple(d[1]) for d in data.provide_data]:
+            # the shared module may have been reshaped by predict();
+            # bring it back to the training shapes before fitting
+            mod.reshape(data.provide_data, data.provide_label or None)
         mod.fit(data, eval_data=eval_data, eval_metric=eval_metric,
                 epoch_end_callback=epoch_end_callback,
                 batch_end_callback=batch_end_callback, kvstore=kvstore,
@@ -178,6 +183,17 @@ class FeedForward:
                      for_training=False)
             mod.set_params(self.arg_params or {}, self.aux_params or {},
                            allow_missing=True)
+        elif [tuple(d[1]) for d in mod.data_shapes] != \
+                [tuple(d[1]) for d in data.provide_data]:
+            # a module bound by fit() at the training batch size serves
+            # prediction at another batch size via reshape (the reference
+            # rebuilds its _pred_exec the same way).  The training label
+            # shapes must survive at the new batch size — dropping them
+            # would make a later fit() silently train on zero labels.
+            new_batch = tuple(data.provide_data[0][1])[0]
+            label_shapes = [(d[0], (new_batch,) + tuple(d[1])[1:])
+                            for d in (mod.label_shapes or [])] or None
+            mod.reshape(data.provide_data, label_shapes)
         if reset:
             data.reset()
         outputs = mod.predict(data, num_batch=num_batch)
@@ -200,8 +216,18 @@ class FeedForward:
     def _prepare_data(self, X, y=None):
         if isinstance(X, mxio.DataIter):
             return X
-        return mxio.NDArrayIter(X, y, batch_size=self.numpy_batch_size,
-                                shuffle=False)
+        # reference model.py clamps on the SAMPLE count: small numpy
+        # inputs must not be rejected by a larger default
+        # numpy_batch_size (NDArrayIter also accepts list/dict inputs,
+        # whose len() is the number of arrays, not samples)
+        if isinstance(X, dict):
+            first = next(iter(X.values()))
+        elif isinstance(X, (list, tuple)):
+            first = X[0]
+        else:
+            first = X
+        batch = min(first.shape[0], self.numpy_batch_size)
+        return mxio.NDArrayIter(X, y, batch_size=batch, shuffle=False)
 
     def save(self, prefix, epoch=None):
         if epoch is None:

@@ -243,3 +243,31 @@ def test_python_module_root_namespace():
     assert hasattr(mx.image, "imdecode")
     assert hasattr(mx.recordio, "unpack_img")
     assert issubclass(mx.mod.PythonLossModule, mx.mod.PythonModule)
+
+
+def test_feedforward_predict_then_fit_keeps_labels():
+    """predict() at a different batch size must not clobber the module's
+    label shapes — a later fit() would silently train on zero labels."""
+    x, y = _toy_data(200)
+    model = mx.model.FeedForward(_mlp_sym(), ctx=mx.cpu(), num_epoch=6,
+                                 learning_rate=0.5, numpy_batch_size=20)
+    model.fit(x, y)
+    preds = model.predict(x[:10])  # smaller batch -> reshape path
+    assert preds.shape == (10, 4)
+    mod = model._get_module()
+    assert mod.label_shapes and mod.label_shapes[0][1][0] == 10
+    # training again still learns (labels still flow)
+    model.fit(x, y)
+    acc = (np.argmax(np.asarray(model.predict(x)), axis=1) ==
+           y.astype(int)).mean()
+    assert acc > 0.9, acc
+
+
+def test_feedforward_list_input_batch_clamp():
+    """list-of-arrays input clamps batch on the SAMPLE count."""
+    x, y = _toy_data(50)
+    model = mx.model.FeedForward(_mlp_sym(), ctx=mx.cpu(), num_epoch=1,
+                                 learning_rate=0.1, numpy_batch_size=128)
+    model.fit([x], y)
+    it = model._prepare_data([x])
+    assert it.batch_size == 50
