@@ -1,0 +1,14 @@
+#!/bin/sh
+# Local CI entry point (the reference's tests/travis/run_test.sh analog):
+# lint-lite -> native build -> unit suite -> multichip dryrun.
+set -e
+cd "$(dirname "$0")/.."
+python -m compileall -q mxnet_tpu tools example
+if command -v g++ > /dev/null; then
+  g++ -O2 -shared -fPIC -std=c++17 -o libmxnet_tpu_native.so \
+      src/native.cc -lpthread
+fi
+JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+  python -m pytest tests/ -q
+python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+echo "CI OK"

@@ -25,8 +25,9 @@ NUM_FRAMES = 30
 CDF_BINS = 600
 
 
-def get_lenet(img=32):
-    """Frame-difference LeNet (reference Train.py:16-38)."""
+def get_lenet():
+    """Frame-difference LeNet (reference Train.py:16-38); the symbol is
+    shape-agnostic — image size is fixed at bind time."""
     source = mx.sym.Variable("data")
     source = (source - 128) * (1.0 / 128)
     frames = mx.sym.SliceChannel(source, num_outputs=NUM_FRAMES)
@@ -102,7 +103,7 @@ if __name__ == "__main__":
                             batch_size=args.batch_size)
 
     ctx = mx.tpu() if mx.num_tpus() > 0 else mx.cpu()
-    net = get_lenet(args.img)
+    net = get_lenet()
     # the reference trains separate systole/diastole models with the same
     # code path; one model suffices to demonstrate the pipeline
     model = mx.model.FeedForward(

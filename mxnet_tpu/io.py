@@ -413,17 +413,18 @@ class PrefetchingIter(DataIter):
     def iter_next(self):
         for e in self.data_ready:
             e.wait()
-        for i, err in enumerate(self._errors):
-            if err is not None:
-                self._errors[i] = None
-                # invalidate the half-populated round and re-arm the
-                # producers, so a caller that catches the error and calls
-                # next() again gets a clean fetch instead of None.pad
-                for j in range(self.n_iter):
-                    self.next_batch[j] = None
-                    self.data_ready[j].clear()
-                    self.data_taken[j].set()
-                raise err
+        err = next((e for e in self._errors if e is not None), None)
+        if err is not None:
+            # clear EVERY producer's error (a stale sibling error must not
+            # poison the next, clean round), invalidate the half-populated
+            # batches, and re-arm the producers so a caller that catches
+            # the error can keep iterating
+            self._errors = [None for _ in range(self.n_iter)]
+            for j in range(self.n_iter):
+                self.next_batch[j] = None
+                self.data_ready[j].clear()
+                self.data_taken[j].set()
+            raise err
         if self.next_batch[0] is None:
             for i in self.next_batch:
                 assert i is None, "Number of entry mismatches between iters"

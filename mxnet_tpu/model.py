@@ -159,7 +159,17 @@ class FeedForward:
             eval_end_callback=None, eval_batch_end_callback=None):
         data = self._prepare_data(X, y)
         mod = self._get_module()
-        if mod.binded and [tuple(d[1]) for d in mod.data_shapes] != \
+        force_init = False
+        if mod.binded and not mod.for_training:
+            # predict() may have bound the shared module for inference
+            # (no gradient arrays, grad_req null): training needs a real
+            # rebind, not a reshape.  Force re-init so a predict-first
+            # module (whose "params" were never initialized) starts from
+            # the initializer / self.arg_params, not allocator leftovers.
+            mod.bind(data.provide_data, data.provide_label or None,
+                     for_training=True, force_rebind=True)
+            force_init = True
+        elif mod.binded and [tuple(d[1]) for d in mod.data_shapes] != \
                 [tuple(d[1]) for d in data.provide_data]:
             # the shared module may have been reshaped by predict();
             # bring it back to the training shapes before fitting
@@ -171,7 +181,8 @@ class FeedForward:
                 optimizer_params=self.kwargs or {"learning_rate": 0.01},
                 initializer=self.initializer, arg_params=self.arg_params,
                 aux_params=self.aux_params, begin_epoch=self.begin_epoch,
-                num_epoch=self.num_epoch, monitor=monitor)
+                num_epoch=self.num_epoch, monitor=monitor,
+                force_init=force_init)
         self.arg_params, self.aux_params = mod.get_params()
 
     def predict(self, X, num_batch=None, return_data=False, reset=True):

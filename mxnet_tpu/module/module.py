@@ -143,7 +143,13 @@ class Module(BaseModule):
              inputs_need_grad=False, force_rebind=False, shared_module=None,
              grad_req="write"):
         """reference ``module.py:323``"""
+        saved_params = None
         if force_rebind:
+            if self._exec is not None and self.params_initialized:
+                # the reference preserves parameter values across a
+                # rebind; dropping them here would silently restart
+                # training from whatever the fresh executor allocates
+                saved_params = self.get_params()
             self._exec = None
             self.binded = False
         if self.binded:
@@ -191,6 +197,9 @@ class Module(BaseModule):
         self.binded = True
         if shared_module is not None and shared_module.params_initialized:
             self.params_initialized = True
+        if saved_params is not None:
+            self.set_params(saved_params[0], saved_params[1],
+                            force_init=True)
 
     def reshape(self, data_shapes, label_shapes=None):
         """reference module.py reshape"""

@@ -271,3 +271,16 @@ def test_feedforward_list_input_batch_clamp():
     model.fit([x], y)
     it = model._prepare_data([x])
     assert it.batch_size == 50
+
+
+def test_feedforward_predict_first_then_fit_learns():
+    """predict() before any fit() binds for inference; fit() must rebind
+    for training (not reshape) or gradients silently never flow."""
+    x, y = _toy_data(200)
+    model = mx.model.FeedForward(_mlp_sym(), ctx=mx.cpu(), num_epoch=6,
+                                 learning_rate=0.5, numpy_batch_size=20)
+    model.predict(x[:10])  # inference-first bind
+    model.fit(x, y)
+    acc = (np.argmax(np.asarray(model.predict(x)), axis=1) ==
+           y.astype(int)).mean()
+    assert acc > 0.9, acc

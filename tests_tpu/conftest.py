@@ -1,0 +1,20 @@
+"""TPU-hardware tests: require a real TPU; skip the whole tree without one.
+
+No platform pinning here — contrast with tests/conftest.py, which forces
+the virtual CPU mesh. The axon sitecustomize exposes the tunneled chip.
+"""
+
+import pytest
+
+
+def pytest_collection_modifyitems(config, items):
+    import jax
+
+    try:
+        has_tpu = any(d.platform != "cpu" for d in jax.devices())
+    except Exception:
+        has_tpu = False
+    if not has_tpu:
+        skip = pytest.mark.skip(reason="no TPU visible")
+        for item in items:
+            item.add_marker(skip)

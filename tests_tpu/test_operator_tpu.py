@@ -1,0 +1,112 @@
+"""CPU-vs-TPU parity for the core op/layer set.
+
+Reference: ``tests/python/gpu/test_operator_gpu.py`` — reuses the CPU op
+checks through ``check_consistency`` across ``[mx.cpu(), mx.gpu()]``;
+here the context pair is ``[mx.cpu(), mx.tpu()]``. Tolerances allow the
+TPU's default-bf16 matmul/conv passes.
+"""
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import check_consistency
+
+RTOL, ATOL = 2e-2, 2e-2
+
+
+def _ctx_list(**shapes):
+    return [dict(ctx=mx.cpu(), **shapes), dict(ctx=mx.tpu(), **shapes)]
+
+
+def test_fullyconnected_parity():
+    sym = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=8,
+                                name="fc")
+    check_consistency(sym, _ctx_list(data=(4, 10)), rtol=RTOL, atol=ATOL)
+
+
+def test_convolution_parity():
+    sym = mx.sym.Convolution(mx.sym.Variable("data"), num_filter=4,
+                             kernel=(3, 3), pad=(1, 1), name="conv")
+    # scale inputs down: bf16 conv error is relative to magnitude, and
+    # 3x3x3 accumulations at unit scale exceed a fixed atol
+    check_consistency(sym, _ctx_list(data=(2, 3, 8, 8)), scale=0.3,
+                      rtol=RTOL, atol=ATOL)
+
+
+def test_batchnorm_relu_pool_parity():
+    data = mx.sym.Variable("data")
+    net = mx.sym.Convolution(data, num_filter=4, kernel=(3, 3), pad=(1, 1))
+    net = mx.sym.BatchNorm(net, fix_gamma=False)
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.Pooling(net, kernel=(2, 2), stride=(2, 2),
+                         pool_type="max")
+    check_consistency(net, _ctx_list(data=(2, 3, 8, 8)), scale=0.3,
+                      rtol=RTOL, atol=ATOL)
+
+
+def test_softmax_output_parity():
+    data = mx.sym.Variable("data")
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(data, num_hidden=5), name="softmax")
+    check_consistency(net, _ctx_list(data=(6, 12),
+                                     softmax_label=(6,)),
+                      rtol=RTOL, atol=ATOL)
+
+
+def test_elemwise_broadcast_reduce_parity():
+    a = mx.sym.Variable("a")
+    b = mx.sym.Variable("b")
+    net = mx.sym.broadcast_add(a * 2.0, b)
+    net = mx.sym.sum(net, axis=1)
+    check_consistency(net, _ctx_list(a=(3, 4), b=(1, 4)), rtol=RTOL,
+                      atol=ATOL)
+
+
+def test_rnn_cell_parity():
+    data = mx.sym.Variable("data")
+    cell = mx.rnn.LSTMCell(num_hidden=6, prefix="l_")
+    outs, _ = cell.unroll(4, inputs=data, merge_outputs=True,
+                          layout="NTC")
+    check_consistency(outs, _ctx_list(data=(2, 4, 5)), rtol=RTOL,
+                      atol=ATOL)
+
+
+def test_imperative_ops_parity():
+    rs = np.random.RandomState(0)
+    x = rs.rand(4, 5).astype(np.float32)
+    for op in ("exp", "sqrt", "sigmoid", "tanh"):
+        c = getattr(mx.nd, op)(mx.nd.array(x, ctx=mx.cpu())).asnumpy()
+        t = getattr(mx.nd, op)(mx.nd.array(x, ctx=mx.tpu())).asnumpy()
+        np.testing.assert_allclose(c, t, rtol=1e-3, atol=1e-5)
+
+
+def test_module_train_step_parity():
+    """One fwd/bwd/update step yields near-identical params on both
+    backends (nightly multi_lenet-style determinism check)."""
+    rs = np.random.RandomState(3)
+    x = rs.rand(8, 6).astype(np.float32)
+    y = rs.randint(0, 3, 8).astype(np.float32)
+    params = {}
+    for ctx in (mx.cpu(), mx.tpu()):
+        net = mx.sym.SoftmaxOutput(
+            mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=3,
+                                  name="fc"), name="softmax")
+        mod = mx.mod.Module(net, context=ctx)
+        mod.bind(data_shapes=[("data", (8, 6))],
+                 label_shapes=[("softmax_label", (8,))])
+        irs = np.random.RandomState(7)
+        mod.init_params(mx.init.Zero())
+        mod.set_params({n: mx.nd.array(
+            irs.normal(0, 0.1, a.shape).astype(np.float32))
+            for n, a in mod.get_params()[0].items()}, {})
+        mod.init_optimizer(optimizer="sgd",
+                           optimizer_params={"learning_rate": 0.1})
+        batch = mx.io.DataBatch(data=[mx.nd.array(x, ctx=ctx)],
+                                label=[mx.nd.array(y, ctx=ctx)])
+        mod.forward_backward(batch)
+        mod.update()
+        params[str(ctx)] = {k: v.asnumpy()
+                            for k, v in mod.get_params()[0].items()}
+    (ca, ta) = params.values()
+    for k in ca:
+        np.testing.assert_allclose(ca[k], ta[k], rtol=2e-2, atol=2e-3)
