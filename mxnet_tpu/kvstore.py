@@ -183,10 +183,12 @@ class KVStoreDist(KVStore):
         self._versions = {}
         reg = {"cmd": "register", "role": "worker"}
         worker_id = os.environ.get("DMLC_WORKER_ID")
-        if worker_id is None:
-            # under an MPI/slurm launcher every rank shares one env; the
+        if worker_id is None and os.environ.get("DMLC_ROLE") == "worker":
+            # under an MPI/slurm *launcher* every rank shares one env; the
             # process-manager rank is the worker identity (dmlc-tracker's
-            # mpi backend relies on the same variables)
+            # mpi backend relies on the same variables).  Gated on DMLC_ROLE
+            # so a process merely running inside a slurm/MPI allocation does
+            # not silently adopt that rank and collide on rejoin.
             for var in ("OMPI_COMM_WORLD_RANK", "PMI_RANK", "SLURM_PROCID"):
                 if var in os.environ:
                     worker_id = os.environ[var]

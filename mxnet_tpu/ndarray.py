@@ -580,13 +580,13 @@ def _invoke(op, args, kwargs):
         return isinstance(v, NDArray) or \
             (isinstance(v, np.ndarray) and v.ndim > 0)
 
-    def _as_nd(v):
-        return v if isinstance(v, NDArray) else array(np.asarray(v))
-
-    named_inputs = {k: _as_nd(v) for k, v in kwargs.items()
-                    if _is_tensor(v)}
+    # tensors stay raw (numpy uncoerced) until the declared-order input
+    # list is assembled, so the op's context comes from the first NDArray
+    # in *declared argument order* — not call-site arg/kwarg ordering —
+    # and numpy operands are then coerced onto that context
+    named_inputs = {k: v for k, v in kwargs.items() if _is_tensor(v)}
     attr_kwargs = {k: v for k, v in kwargs.items() if not _is_tensor(v)}
-    pos_inputs = [_as_nd(a) for a in args if _is_tensor(a)]
+    pos_inputs = [a for a in args if _is_tensor(a)]
     attr_args = [a for a in args if not _is_tensor(a)]
     if attr_args:
         # positional scalars fill the op's params in declaration order
@@ -639,12 +639,21 @@ def _invoke(op, args, kwargs):
                          "(op takes %d inputs + %d aux)"
                          % (op.name, leftover, len(arg_names),
                             len(aux_names)))
+    op_ctx = next((a._ctx for a in inputs + aux_arrays
+                   if isinstance(a, NDArray)), None)
+
+    def _as_nd(v):
+        return v if isinstance(v, NDArray) else array(np.asarray(v),
+                                                      ctx=op_ctx)
+
+    inputs = [_as_nd(v) for v in inputs]
+    aux_arrays = [_as_nd(v) for v in aux_arrays]
 
     rng = _random.next_key() if op.needs_rng else None
     fn = _reg.jitted_apply(op.name, _reg.attrs_key(attrs), True)
     with _profiler.span(op.name, "imperative") as sp:
         if inputs:
-            octx = inputs[0]._ctx
+            octx = op_ctx or inputs[0]._ctx  # op_ctx None => all-numpy inputs
             outs, aux_up = fn([x._jx for x in inputs],
                               [x._jx for x in aux_arrays], rng)
         else:

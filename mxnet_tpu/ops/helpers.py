@@ -2,7 +2,15 @@
 
 from __future__ import annotations
 
+import jax.numpy as jnp
+
 from .registry import register
+
+
+def acc_dtype(dtype):
+    """MXU accumulation dtype for matmul/conv: f32 for low-precision
+    inputs (the reference's cuDNN path accumulates f32), else unchanged."""
+    return jnp.float32 if dtype in (jnp.bfloat16, jnp.float16) else dtype
 
 
 def simple(name, fn, *, arguments=("data",), params=None, outputs=("output",),

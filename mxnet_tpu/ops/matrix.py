@@ -12,7 +12,7 @@ import jax
 import jax.numpy as jnp
 
 from ..base import MXNetError
-from .helpers import simple
+from .helpers import acc_dtype as _acc, simple
 from .registry import REQUIRED, pbool, pfloat, pint, ptuple, register
 
 
@@ -32,8 +32,12 @@ def _opt_int(v):
 def _dot(lhs, rhs, transpose_a, transpose_b):
     a = lhs.T if transpose_a else lhs
     b = rhs.T if transpose_b else rhs
-    # preferred_element_type keeps f32 accumulation for bf16 inputs (MXU native)
-    return jax.lax.dot(a, b) if a.ndim == 2 and b.ndim == 2 else jnp.dot(a, b)
+    # f32 accumulation for bf16 inputs (MXU native), rounded back after
+    pet = _acc(jnp.result_type(a.dtype, b.dtype))
+    out = (jax.lax.dot(a, b, preferred_element_type=pet)
+           if a.ndim == 2 and b.ndim == 2
+           else jnp.dot(a, b, preferred_element_type=pet))
+    return out.astype(jnp.result_type(a.dtype, b.dtype))
 
 
 simple("dot", _dot, arguments=("lhs", "rhs"),
@@ -43,7 +47,8 @@ simple("dot", _dot, arguments=("lhs", "rhs"),
 def _batch_dot(lhs, rhs, transpose_a, transpose_b):
     a = jnp.swapaxes(lhs, -1, -2) if transpose_a else lhs
     b = jnp.swapaxes(rhs, -1, -2) if transpose_b else rhs
-    return jnp.matmul(a, b)
+    rt = jnp.result_type(a.dtype, b.dtype)
+    return jnp.matmul(a, b, preferred_element_type=_acc(rt)).astype(rt)
 
 
 simple("batch_dot", _batch_dot, arguments=("lhs", "rhs"),

@@ -21,8 +21,44 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..base import MXNetError
-from .helpers import simple
+from .helpers import acc_dtype as _acc_dtype, simple
 from .registry import (REQUIRED, pbool, pfloat, pint, pstr, ptuple, register)
+
+
+@lru_cache(maxsize=None)
+def _conv_f32acc(stride, padding, lhs_dilation, rhs_dilation, dn, groups):
+    """Conv whose primal accumulates f32 for low-precision inputs (the
+    reference's cuDNN conv accumulates f32; bf16 partials would drift
+    top-1), output cast back to the input dtype.
+
+    JAX 0.9's conv transpose rule rejects the mixed-dtype cotangent that
+    ``preferred_element_type`` + ``astype`` produces, so the backward is a
+    custom_vjp that casts the cotangent to the primal dtype and reuses the
+    plain same-dtype conv vjp (whose grad convs still accumulate f32
+    inside the MXU)."""
+    kw = dict(window_strides=stride, padding=padding,
+              lhs_dilation=lhs_dilation, rhs_dilation=rhs_dilation,
+              dimension_numbers=dn, feature_group_count=groups)
+
+    def plain(data, weight):
+        return jax.lax.conv_general_dilated(data, weight, **kw)
+
+    @jax.custom_vjp
+    def conv(data, weight):
+        return jax.lax.conv_general_dilated(
+            data, weight, preferred_element_type=_acc_dtype(data.dtype),
+            **kw).astype(data.dtype)
+
+    def fwd(data, weight):
+        return conv(data, weight), (data, weight)
+
+    def bwd(res, g):
+        data, weight = res
+        _, vjp = jax.vjp(plain, data, weight)
+        return vjp(g.astype(data.dtype))
+
+    conv.defvjp(fwd, bwd)
+    return conv
 
 
 def _norm_stp(kernel, stride, dilate, pad):
@@ -42,7 +78,8 @@ def _fully_connected(attrs, inputs, aux, is_train, rng):
     data = _match_param_dtype(data, weight)
     if attrs["flatten"] and data.ndim > 2:
         data = data.reshape(data.shape[0], -1)
-    out = jnp.dot(data, weight.T)
+    out = jnp.dot(data, weight.T,
+                  preferred_element_type=_acc_dtype(data.dtype)).astype(data.dtype)
     if not attrs["no_bias"]:
         out = out + inputs[2]
     return [out]
@@ -79,15 +116,9 @@ def _convolution(attrs, inputs, aux, is_train, rng):
     nd = len(kernel)
     stride, dilate, pad = _norm_stp(kernel, attrs["stride"], attrs["dilate"],
                                     attrs["pad"])
-    out = jax.lax.conv_general_dilated(
-        data, weight,
-        window_strides=stride,
-        padding=[(p, p) for p in pad],
-        rhs_dilation=dilate,
-        dimension_numbers=_CONV_DIMNUMS[nd],
-        feature_group_count=attrs["num_group"],
-        preferred_element_type=data.dtype,
-    )
+    out = _conv_f32acc(stride, tuple((p, p) for p in pad), (1,) * nd,
+                       dilate, _CONV_DIMNUMS[nd],
+                       attrs["num_group"])(data, weight)
     if not attrs["no_bias"]:
         bias = inputs[2].reshape((1, -1) + (1,) * nd)
         out = out + bias
@@ -123,15 +154,8 @@ def _deconvolution(attrs, inputs, aux, is_train, rng):
                for k, p, a in zip(kernel, pad, adj)]
     dn = {1: ("NCH", "IOH", "NCH"), 2: ("NCHW", "IOHW", "NCHW"),
           3: ("NCDHW", "IODHW", "NCDHW")}[nd]
-    out = jax.lax.conv_general_dilated(
-        data, weight[flip],
-        window_strides=(1,) * nd,
-        padding=padding,
-        lhs_dilation=stride,
-        rhs_dilation=dilate,
-        dimension_numbers=dn,
-        feature_group_count=attrs["num_group"],
-    )
+    out = _conv_f32acc(tuple((1,) * nd), tuple(padding), stride, dilate,
+                       dn, attrs["num_group"])(data, weight[flip])
     if not attrs["no_bias"]:
         out = out + inputs[2].reshape((1, -1) + (1,) * nd)
     return [out]

@@ -248,9 +248,12 @@ def test_python_module_root_namespace():
 def test_feedforward_predict_then_fit_keeps_labels():
     """predict() at a different batch size must not clobber the module's
     label shapes — a later fit() would silently train on zero labels."""
+    mx.random.seed(42)
     x, y = _toy_data(200)
-    model = mx.model.FeedForward(_mlp_sym(), ctx=mx.cpu(), num_epoch=6,
-                                 learning_rate=0.5, numpy_batch_size=20)
+    model = mx.model.FeedForward(_mlp_sym(), ctx=mx.cpu(), num_epoch=8,
+                                 initializer=mx.init.Xavier(),
+                                 learning_rate=0.1, momentum=0.9,
+                                 numpy_batch_size=20)
     model.fit(x, y)
     preds = model.predict(x[:10])  # smaller batch -> reshape path
     assert preds.shape == (10, 4)
@@ -276,9 +279,12 @@ def test_feedforward_list_input_batch_clamp():
 def test_feedforward_predict_first_then_fit_learns():
     """predict() before any fit() binds for inference; fit() must rebind
     for training (not reshape) or gradients silently never flow."""
+    mx.random.seed(42)
     x, y = _toy_data(200)
-    model = mx.model.FeedForward(_mlp_sym(), ctx=mx.cpu(), num_epoch=6,
-                                 learning_rate=0.5, numpy_batch_size=20)
+    model = mx.model.FeedForward(_mlp_sym(), ctx=mx.cpu(), num_epoch=8,
+                                 initializer=mx.init.Xavier(),
+                                 learning_rate=0.1, momentum=0.9,
+                                 numpy_batch_size=20)
     model.predict(x[:10])  # inference-first bind
     model.fit(x, y)
     acc = (np.argmax(np.asarray(model.predict(x)), axis=1) ==

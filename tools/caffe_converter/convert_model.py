@@ -48,21 +48,29 @@ def _blob_array(blob_bytes):
     return np.asarray(data, np.float32).reshape(shape)
 
 
-def parse_caffemodel(buf):
-    """Returns [(layer_name, layer_type, [blob arrays])]."""
+def _parse_layers(buf):
+    """One wire pass over the NetParameter; returns
+    [(layer_name, layer_type, [blob arrays], [bottom blobs], [top blobs])]."""
     net = wire.collect(buf, wanted=(2, 100))
     out = []
-    for raw in net[100]:  # modern LayerParameter
-        f = wire.collect(raw, wanted=(1, 2, 7))
+    for raw in net[100]:  # LayerParameter: name=1 type=2 bottom=3 top=4 blobs=7
+        f = wire.collect(raw, wanted=(1, 2, 3, 4, 7))
         name = f[1][0].decode() if f[1] else ""
         typ = f[2][0].decode() if f[2] else ""
-        out.append((name, typ, [_blob_array(b) for b in f[7]]))
-    for raw in net[2]:  # V1LayerParameter
-        f = wire.collect(raw, wanted=(4, 5, 6))
+        out.append((name, typ, [_blob_array(b) for b in f[7]],
+                    [b.decode() for b in f[3]], [t.decode() for t in f[4]]))
+    for raw in net[2]:  # V1LayerParameter: bottom=2 top=3 name=4 type=5 blobs=6
+        f = wire.collect(raw, wanted=(2, 3, 4, 5, 6))
         name = f[4][0].decode() if f[4] else ""
         typ = int(f[5][0]) if f[5] else 0
-        out.append((name, typ, [_blob_array(b) for b in f[6]]))
+        out.append((name, typ, [_blob_array(b) for b in f[6]],
+                    [b.decode() for b in f[2]], [t.decode() for t in f[3]]))
     return out
+
+
+def parse_caffemodel(buf):
+    """Returns [(layer_name, layer_type, [blob arrays])]."""
+    return [l[:3] for l in _parse_layers(buf)]
 
 
 _V1_CONV, _V1_IP, _V1_DECONV = 4, 14, 39
@@ -100,18 +108,31 @@ def convert_model(layers):
     return args, aux
 
 
-def _propagate_bn_stats(layers, args, aux):
+def parse_topology(buf):
+    """Returns [(layer_name, layer_type, [bottom blobs], [top blobs])]."""
+    return [(n, t, bo, tp) for n, t, _, bo, tp in _parse_layers(buf)]
+
+
+def _propagate_bn_stats(topology, args, aux):
     """The symbol converter re-emits BatchNorm under the paired Scale
     layer's name; copy the stats across and give the Scale layer's
-    BatchNorm its gamma/beta."""
+    BatchNorm its gamma/beta.  Pairing is by the Scale layer's bottom
+    blob (the same pending_bn logic as convert_symbol), so interleaved
+    BN/Scale orders resolve to the right stats."""
+    bn_by_top = {}  # top blob -> BatchNorm layer name
     prev_bn = None
-    for name, typ, blobs in layers:
+    for name, typ, bottoms, tops in topology:
         if typ in ("BatchNorm", _V1_BN):
+            for t in tops:
+                bn_by_top[t] = name
             prev_bn = name
-        elif typ == "Scale" and prev_bn is not None:
-            aux[name + "_moving_mean"] = aux.get(prev_bn + "_moving_mean")
-            aux[name + "_moving_var"] = aux.get(prev_bn + "_moving_var")
-            prev_bn = None
+        elif typ == "Scale":
+            src = bn_by_top.get(bottoms[0]) if bottoms else None
+            if src is None:  # topology w/o bottoms: layer-order fallback
+                src, prev_bn = prev_bn, None
+            if src is not None:
+                aux[name + "_moving_mean"] = aux.get(src + "_moving_mean")
+                aux[name + "_moving_var"] = aux.get(src + "_moving_var")
     return args, aux
 
 
@@ -125,9 +146,10 @@ def convert(prototxt_path, caffemodel_path, output_prefix, epoch=0):
         sym, inputs = convert_symbol(f.read())
     with open(caffemodel_path, "rb") as f:
         buf = f.read()
-    layers = parse_caffemodel(buf)
-    args, aux = convert_model(layers)
-    args, aux = _propagate_bn_stats(layers, args, aux)
+    layers5 = _parse_layers(buf)
+    args, aux = convert_model([l[:3] for l in layers5])
+    args, aux = _propagate_bn_stats(
+        [(n, t, bo, tp) for n, t, _, bo, tp in layers5], args, aux)
 
     wanted_args = set(sym.list_arguments())
     wanted_aux = set(sym.list_auxiliary_states())

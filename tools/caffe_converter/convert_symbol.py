@@ -60,7 +60,10 @@ def _pair(param, field, default=0):
 
 
 def _skip(typ):
-    return typ in ("Data", "ImageData", "HDF5Data", "Accuracy", "Silence")
+    # "Input" included so the output scan never picks an Input declaration
+    # that appears after compute layers as the network output
+    return typ in ("Data", "ImageData", "HDF5Data", "Accuracy", "Silence",
+                   "Input")
 
 
 def convert_symbol(prototxt_text):
@@ -84,7 +87,7 @@ def convert_symbol(prototxt_text):
     pending_bn = {}
 
     for name, typ, lay in _layers(net):
-        if _skip(typ) or typ == "Input":
+        if _skip(typ):
             # data/Input layers declare the input blob (the modern deploy
             # form: layer { type: "Input" input_param { shape {...} } })
             for top in lay.get("top", []):
