@@ -245,6 +245,10 @@ class Executor:
         self._last_state = None
         self._rng_step = 0
         self._fns = {}
+        self._needs_rng = None
+        self._rng_cache = None
+        self._seg_chain = None
+        self._init_placement()
 
     arg_arrays = property(lambda s: [s.arg_dict[n] for n in s.arg_names])
     grad_arrays = property(lambda s: [s.grad_dict.get(n) for n in s.arg_names])
@@ -346,10 +350,285 @@ class Executor:
                 return list(outs), new_aux_list, new_p, new_m, grad_list
 
             fn = jax.jit(f, donate_argnums=(0, 4))
+        elif isinstance(kind, tuple) and kind[0] == "train_sgd_scan":
+            # K full train steps inside ONE dispatch: lax.scan over stacked
+            # input batches with params/momenta/aux as carry.  The
+            # reference bulks engine ops into segments to cut dispatch
+            # overhead (``graph_executor.cc:678`` InitOpSegs /
+            # MXNET_EXEC_BULK_EXEC_TRAIN); on a tunneled TPU the per-step
+            # dispatch round trip is tens of ms, so bulking across steps
+            # is the same trade one level up.
+            _, upd_names_t, scan_names_t, momentum, rescale, clip = kind
+            upd_names = list(upd_names_t)
+            scan_names = list(scan_names_t)
+            static_names = [n for n in arg_names
+                            if n not in upd_names_t and n not in scan_names_t]
+
+            def f(upd_vals, static_vals, aux, rng, moms, lrs, wds, stacks):
+                def body(carry, xs):
+                    cur_p, cur_m, cur_aux, cur_rng = carry
+                    amap = dict(zip(upd_names, cur_p))
+                    amap.update(zip(static_names, static_vals))
+                    amap.update(zip(scan_names, xs))
+                    args = [amap[n] for n in arg_names]
+                    outs, new_aux_list, vjp_fn = _vjp_parts(
+                        args, cur_aux, cur_rng)
+                    (grads,) = vjp_fn(tuple(jnp.ones_like(o) for o in outs))
+                    new_p, new_m = [], []
+                    for i, n in enumerate(upd_names):
+                        p, m = sgd_step_math(
+                            amap[n], grads[n], cur_m[i] if momentum != 0.0
+                            else None, lrs[i], wds[i], momentum, rescale,
+                            clip)
+                        new_p.append(p)
+                        if m is not None:
+                            new_m.append(m)
+                    nxt_rng = jax.random.fold_in(cur_rng, 1)
+                    return (new_p, new_m, new_aux_list, nxt_rng), list(outs)
+
+                (new_p, new_m, new_aux_list, _), outs_stack = jax.lax.scan(
+                    body, (list(upd_vals), list(moms), list(aux), rng),
+                    list(stacks))
+                return outs_stack, new_aux_list, new_p, new_m
+
+            fn = jax.jit(f, donate_argnums=(0, 4))
         else:
             raise ValueError(kind)
         self._fns[kind] = fn
         return fn
+
+    # -- group2ctx placement (model parallelism) --------------------------
+    def _init_placement(self):
+        """The ``PlaceDevice`` pass analog (reference
+        ``graph_executor.cc:231-305`` + ``src/operator/cross_device_copy.cc``).
+
+        Nodes annotated with a ``ctx_group`` attr (``mx.AttrScope``) are
+        assigned the mapped context; variables adopt their first consumer's
+        context (reference ``AssignContext``), and parameter / gradient /
+        aux NDArrays are MOVED onto those devices at bind time.  Execution
+        then runs as per-device jitted *segments* — maximal topo runs on
+        one device — with ``jax.device_put`` at segment boundaries playing
+        the ``_CrossDeviceCopy`` role.  When every group maps to the bind
+        context the plan collapses and the whole-graph single-jit fast
+        path is used."""
+        self._segments = None
+        if not self._group2ctx:
+            return
+        nodes = self._symbol._nodes()
+        base = self._ctx
+        dev_of = {}
+        distinct = False
+        for node in nodes:
+            if node.is_variable:
+                continue
+            g = node.misc_attr.get("ctx_group")
+            ctx = self._group2ctx.get(g, base) if g is not None else base
+            dev_of[id(node)] = ctx
+            if ctx.jax_device() != base.jax_device():
+                distinct = True
+        if not distinct:
+            return
+        # variables adopt the context of their first consumer
+        for node in nodes:
+            if node.is_variable:
+                continue
+            for child, _ci in node.inputs:
+                if child.is_variable and id(child) not in dev_of:
+                    dev_of[id(child)] = dev_of[id(node)]
+        for node in nodes:
+            if node.is_variable and id(node) not in dev_of:
+                dev_of[id(node)] = base
+        name2ctx = {n.name: dev_of[id(n)] for n in nodes if n.is_variable}
+        for group in (self.arg_dict, self.aux_dict, self.grad_dict):
+            for n, arr in group.items():
+                ctx = name2ctx.get(n)
+                if ctx is not None and \
+                        arr._ctx.jax_device() != ctx.jax_device():
+                    arr._jx = jax.device_put(arr._jx, ctx.jax_device())
+                    arr._ctx = ctx
+        # maximal same-device topo runs of compute nodes
+        ni_of = {id(n): i for i, n in enumerate(nodes)}
+        segs = []
+        for node in nodes:
+            if node.is_variable:
+                continue
+            d = dev_of[id(node)]
+            if segs and segs[-1][0].jax_device() == d.jax_device():
+                segs[-1][1].append(node)
+            else:
+                segs.append((d, [node]))
+        # per-segment IO: external entries consumed / entries needed later
+        produced_by = {}
+        for si, (_d, seg_nodes) in enumerate(segs):
+            for n in seg_nodes:
+                produced_by[id(n)] = si
+        needed_later = {}  # entry -> first consumer segment > producer
+        seg_io = []
+        out_entries = {(id(n), i) for n, i in self._symbol._outputs}
+        for si, (_d, seg_nodes) in enumerate(segs):
+            in_keys, seen = [], set()
+            for n in seg_nodes:
+                for c, ci in n.inputs:
+                    k = (id(c), ci)
+                    if produced_by.get(id(c)) == si:
+                        continue
+                    if k not in seen:
+                        seen.add(k)
+                        in_keys.append(k)
+            seg_io.append([in_keys, None])
+        consumers = {}
+        for si, (_d, seg_nodes) in enumerate(segs):
+            for k in seg_io[si][0]:
+                consumers.setdefault(k, []).append(si)
+        for si, (_d, seg_nodes) in enumerate(segs):
+            outs = []
+            for n in seg_nodes:
+                nouts = len(n.op.list_outputs(n.attrs))
+                for i in range(nouts):
+                    k = (id(n), i)
+                    if k in consumers or k in out_entries:
+                        outs.append(k)
+            seg_io[si][1] = outs
+        self._segments = segs
+        self._seg_io = seg_io
+        self._seg_ni = ni_of
+        self._seg_dev_of = dev_of
+
+    def _seg_fn(self, si, is_train):
+        key = ("seg", si, is_train)
+        if key in self._fns:
+            return self._fns[key]
+        _dev, seg_nodes = self._segments[si]
+        in_keys, out_keys = self._seg_io[si]
+        ni_of = self._seg_ni
+        # entry keys are ids — rebuild the local maps inside the closure
+        def f(in_vals, rng):
+            entry = dict(zip(in_keys, in_vals))
+            aux_updates = []
+            for node in seg_nodes:
+                op = node.op
+                na = node.num_args()
+                ins = [entry[(id(c), ci)] for c, ci in node.inputs[:na]]
+                auxs = [entry[(id(c), ci)] for c, ci in node.inputs[na:]]
+                k = jax.random.fold_in(rng, ni_of[id(node)]) \
+                    if op.needs_rng else None
+                outs, aux_up = op.apply(node.attrs, ins, auxs, is_train, k)
+                for i, o in enumerate(outs):
+                    entry[(id(node), i)] = o
+                if aux_up is not None and is_train:
+                    for (child, _ci), new in zip(node.inputs[na:], aux_up):
+                        aux_updates.append((child.name, new))
+            return [entry[k2] for k2 in out_keys], dict(aux_updates)
+
+        fn = jax.jit(f)
+        self._fns[key] = fn
+        return fn
+
+    def _forward_segmented(self, is_train):
+        """Forward across placement segments; training stores a vjp chain
+        for ``backward``."""
+        entry = {}
+        arg_map = {n: a for n, a in self.arg_dict.items()}
+        for node in self._symbol._nodes():
+            if not node.is_variable:
+                continue
+            arr = arg_map.get(node.name)
+            if arr is None:
+                arr = self.aux_dict.get(node.name)
+            if arr is None:
+                raise MXNetError("unbound variable %r" % node.name)
+            entry[(id(node), 0)] = arr._jx
+        rng = self.next_rng()
+        diff = set(self._diff_names())
+        chain = []
+        new_aux_all = {}
+        train_grads = is_train and bool(diff)
+        for si, (dev, _seg_nodes) in enumerate(self._segments):
+            in_keys, out_keys = self._seg_io[si]
+            jdev = dev.jax_device()
+            ins = [jax.device_put(entry[k], jdev) for k in in_keys]
+            srng = jax.device_put(rng, jdev)
+            fn = self._seg_fn(si, is_train)
+            if train_grads:
+                outs, vjp_fn, aux_d = jax.vjp(
+                    lambda vals: fn(vals, srng), ins, has_aux=True)
+            else:
+                outs, aux_d = fn(ins, rng=srng)
+                vjp_fn = None
+            for k, v in zip(out_keys, outs):
+                entry[k] = v
+            new_aux_all.update(aux_d)
+            chain.append((vjp_fn, in_keys, out_keys,
+                          [(o.shape, o.dtype) for o in outs], dev))
+        if is_train:
+            for name, v in new_aux_all.items():
+                arr = self.aux_dict.get(name)
+                if arr is not None:
+                    arr._jx = v
+        outs = [entry[(id(n), i)] for n, i in self._symbol._outputs]
+        self._seg_chain = chain if train_grads else None
+        self._pending_grads = "segmented" if train_grads else None
+        self._last_state = None
+        out_ctx = self._segments[-1][0]
+        self.outputs = [NDArray._from_jax(o, out_ctx) for o in outs]
+        if self._monitor_callback is not None:
+            for name, arr in zip(self.output_names, self.outputs):
+                self._monitor_callback(name, arr)
+        return self.outputs
+
+    def _backward_segmented(self, out_grads):
+        """Chain segment vjps in reverse; cross-segment cotangents hop
+        devices exactly where ``_CrossDeviceCopy`` nodes would sit."""
+        cot = {}
+        out_entries = [(id(n), i) for n, i in self._symbol._outputs]
+        if out_grads is None:
+            for k, o in zip(out_entries, self.outputs):
+                cot[k] = jnp.ones(o.shape, o.dtype)
+        else:
+            if isinstance(out_grads, NDArray):
+                out_grads = [out_grads]
+            for k, g in zip(out_entries, out_grads):
+                cot[k] = g._jx if isinstance(g, NDArray) else jnp.asarray(g)
+        var_name = {id(n): n.name for n in self._symbol._nodes()
+                    if n.is_variable}
+        diff = set(self._diff_names())
+        grads = {}
+        for vjp_fn, in_keys, out_keys, out_avals, dev in \
+                reversed(self._seg_chain):
+            jdev = dev.jax_device()
+            out_cots = tuple(
+                jax.device_put(cot[k], jdev) if k in cot
+                else jnp.zeros(shape, dtype)
+                for k, (shape, dtype) in zip(out_keys, out_avals))
+            (in_cots,) = vjp_fn(list(out_cots))
+            for k, c in zip(in_keys, in_cots):
+                nm = var_name.get(k[0])
+                if nm is not None:
+                    if nm in diff:
+                        grads[nm] = grads[nm] + c if nm in grads else c
+                else:
+                    cot[k] = cot[k] + c if k in cot else c
+        return grads
+
+    def next_rng(self):
+        """Per-dispatch rng key on the executor's device.
+
+        Graphs with no rng-consuming ops (the common CNN case) reuse ONE
+        cached device key — XLA dead-code-eliminates the argument, and the
+        per-step ``jax.random.split`` dispatch + ``device_put`` round trip
+        (tens of ms through a tunneled chip) disappear from the hot loop.
+        Graphs that do consume rng draw a fresh key every dispatch."""
+        if self._needs_rng is None:
+            self._needs_rng = any(
+                (not n.is_variable) and n.op.needs_rng
+                for n in self._symbol._nodes())
+        if self._needs_rng:
+            return jax.device_put(_random.next_key(),
+                                  self._ctx.jax_device())
+        if self._rng_cache is None:
+            self._rng_cache = jax.device_put(_random.next_key(),
+                                             self._ctx.jax_device())
+        return self._rng_cache
 
     # -- API --------------------------------------------------------------
     def forward(self, is_train=False, **kwargs):
@@ -367,11 +646,14 @@ class Executor:
                 dst._jx = jax.device_put(val, self._ctx.jax_device())
             else:
                 dst[:] = v
+        if self._segments is not None:
+            self._rng_step += 1
+            return self._forward_segmented(is_train)
         args = [a._jx for a in self.arg_arrays]
         aux = [a._jx for a in self.aux_arrays]
         # rng must live on the executor's device: jit rejects mixed-device
         # args (e.g. cpu-bound module on a machine whose default is TPU)
-        rng = jax.device_put(_random.next_key(), self._ctx.jax_device())
+        rng = self.next_rng()
         self._rng_step += 1
         fused_bwd = is_train and bool(self._diff_names())
         name = ("%s_forward%s" % (self._symbol_name(),
@@ -408,7 +690,9 @@ class Executor:
             return
         if self._pending_grads is None:
             raise MXNetError("backward called before forward(is_train=True)")
-        if out_grads is None:
+        if self._pending_grads == "segmented":
+            grads = self._backward_segmented(out_grads)
+        elif out_grads is None:
             grads = self._pending_grads
         else:
             if isinstance(out_grads, NDArray):

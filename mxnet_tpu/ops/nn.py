@@ -292,10 +292,13 @@ def _batch_norm(attrs, inputs, aux, is_train, rng):
     bshape = (1, -1) + (1,) * (x.ndim - 2)
     use_batch = is_train and not attrs["use_global_stats"]
     if use_batch:
-        # compute stats in f32 even for bf16 activations (TPU numerics)
+        # compute stats in f32 even for bf16 activations (TPU numerics).
+        # E[x], E[x^2] in ONE fused pass over x (jnp.var would re-read x a
+        # second time — BN reductions are the bandwidth hot spot of a conv
+        # net step on TPU)
         xf = x.astype(jnp.float32)
         mean = jnp.mean(xf, axis=red)
-        var = jnp.var(xf, axis=red)
+        var = jnp.mean(jnp.square(xf), axis=red) - jnp.square(mean)
     else:
         mean, var = moving_mean, moving_var
     g = jnp.ones_like(gamma) if attrs["fix_gamma"] else gamma

@@ -290,3 +290,81 @@ def test_feedforward_predict_first_then_fit_learns():
     acc = (np.argmax(np.asarray(model.predict(x)), axis=1) ==
            y.astype(int)).mean()
     assert acc > 0.9, acc
+
+
+def test_run_bulk_matches_sequential():
+    """run_bulk (K steps in one scanned dispatch) must produce the same
+    params/aux as K sequential fused steps."""
+    import os
+
+    rs = np.random.RandomState(0)
+    batches = [mx.io.DataBatch(
+        data=[mx.nd.array(rs.rand(16, 8).astype(np.float32))],
+        label=[mx.nd.array(rs.randint(0, 3, 16).astype(np.float32))])
+        for _ in range(4)]
+
+    def build():
+        data = mx.sym.Variable("data")
+        h = mx.sym.FullyConnected(data, num_hidden=16, name="fc1")
+        h = mx.sym.Activation(h, act_type="relu")
+        h = mx.sym.BatchNorm(h, name="bn")
+        h = mx.sym.FullyConnected(h, num_hidden=3, name="fc2")
+        net = mx.sym.SoftmaxOutput(h, name="softmax")
+        mod = mx.mod.Module(net, context=mx.cpu())
+        mod.bind(data_shapes=[("data", (16, 8))],
+                 label_shapes=[("softmax_label", (16,))])
+        mod.init_params(mx.init.Zero())
+        irs = np.random.RandomState(5)
+        mod.set_params({n: mx.nd.array(
+            irs.normal(0, 0.1, a.shape).astype(np.float32))
+            for n, a in mod.get_params()[0].items()},
+            {n: a for n, a in mod.get_params()[1].items()})
+        mod.init_optimizer(optimizer="sgd",
+                           optimizer_params={"learning_rate": 0.1,
+                                             "momentum": 0.9, "wd": 1e-3})
+        return mod
+
+    os.environ["MXNET_FUSE_TRAIN_STEP"] = "1"
+    try:
+        seq = build()
+        for b in batches:
+            seq.forward_backward(b)
+            seq.update()
+        out_seq = seq.get_outputs()[0].asnumpy()
+        blk = build()
+        blk.run_bulk(batches)
+        out_blk = blk.get_outputs()[0].asnumpy()
+    finally:
+        os.environ.pop("MXNET_FUSE_TRAIN_STEP", None)
+    assert_almost_equal(out_blk, out_seq, rtol=1e-5, atol=1e-6)
+    ps, pb = seq.get_params(), blk.get_params()
+    for k in ps[0]:
+        assert_almost_equal(pb[0][k].asnumpy(), ps[0][k].asnumpy(),
+                            rtol=1e-5, atol=1e-6)
+    for k in ps[1]:
+        assert_almost_equal(pb[1][k].asnumpy(), ps[1][k].asnumpy(),
+                            rtol=1e-5, atol=1e-6)
+
+
+def test_run_bulk_fallback_without_fuse_flag():
+    """Without MXNET_FUSE_TRAIN_STEP, run_bulk falls back to the exact
+    per-batch path (and still trains)."""
+    rs = np.random.RandomState(1)
+    batches = [mx.io.DataBatch(
+        data=[mx.nd.array(rs.rand(8, 4).astype(np.float32))],
+        label=[mx.nd.array(rs.randint(0, 2, 8).astype(np.float32))])
+        for _ in range(2)]
+    data = mx.sym.Variable("data")
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(data, num_hidden=2, name="fc"), name="softmax")
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.bind(data_shapes=[("data", (8, 4))],
+             label_shapes=[("softmax_label", (8,))])
+    mod.init_params(mx.init.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1})
+    w0 = mod.get_params()[0]["fc_weight"].asnumpy().copy()
+    mod.run_bulk(batches)
+    w1 = mod.get_params()[0]["fc_weight"].asnumpy()
+    assert not np.allclose(w0, w1)
+    assert mod.get_outputs()[0].shape == (8, 2)
