@@ -76,8 +76,11 @@ if __name__ == "__main__":
     # one context per layer group: TPU chips if available, else CPU devices
     import jax
 
-    devs = ([mx.tpu(i) for i in range(mx.num_tpus())]
-            or [mx.cpu(i) for i in range(len(jax.devices()))])
+    tpus = [mx.tpu(i) for i in range(mx.num_tpus())]
+    cpus = [mx.cpu(i) for i in range(len(jax.devices("cpu")))]
+    # model parallelism wants the widest device set: a many-core CPU mesh
+    # beats a single chip for layer placement
+    devs = tpus if len(tpus) >= len(cpus) else cpus
     group2ctx = {"layer%d" % i: devs[i % len(devs)]
                  for i in range(args.num_layers)}
     logging.info("placement: %s", {k: str(v) for k, v in group2ctx.items()})
@@ -85,6 +88,13 @@ if __name__ == "__main__":
     ex = sym.simple_bind(devs[0], group2ctx=group2ctx, grad_req="write",
                          data=(args.batch_size, args.seq_len),
                          softmax_label=(args.batch_size, args.seq_len))
+    if len(devs) > 1:
+        placed = {next(iter(a._jx.devices()))
+                  for n, a in ex.arg_dict.items()
+                  if n not in ("data", "softmax_label")}
+        assert len(placed) >= 2, \
+            "group2ctx placement failed: params all on one device"
+        logging.info("params spread over %d devices", len(placed))
     init = mx.init.Xavier()
     for name, arr in ex.arg_dict.items():
         if name not in ("data", "softmax_label"):

@@ -87,3 +87,104 @@ def test_batch_not_divisible_raises():
     mod = mx.mod.Module(_mlp(), context=[mx.cpu(i) for i in range(8)])
     with pytest.raises(mx.MXNetError):
         mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+
+
+# -- group2ctx placement (model parallelism) --------------------------------
+def _group2ctx_sym():
+    data = sym.Variable("data")
+    with mx.AttrScope(ctx_group="g0"):
+        h = sym.FullyConnected(data, num_hidden=16, name="fc1")
+        h = sym.Activation(h, act_type="relu")
+    with mx.AttrScope(ctx_group="g1"):
+        h = sym.FullyConnected(h, num_hidden=4, name="fc2")
+        out = sym.SoftmaxOutput(h, name="softmax")
+    return out
+
+
+def test_group2ctx_places_params_on_groups():
+    """ctx_group annotations must MOVE parameters onto the mapped devices
+    (reference PlaceDevice, graph_executor.cc:231-305) — not silently run
+    the whole graph on the bind context."""
+    _need_devices(2)
+    net = _group2ctx_sym()
+    ex = net.simple_bind(mx.cpu(0),
+                         group2ctx={"g0": mx.cpu(0), "g1": mx.cpu(1)},
+                         data=(8, 10), softmax_label=(8,))
+    d0 = mx.cpu(0).jax_device()
+    d1 = mx.cpu(1).jax_device()
+    assert list(ex.arg_dict["fc1_weight"]._jx.devices()) == [d0]
+    assert list(ex.arg_dict["fc2_weight"]._jx.devices()) == [d1]
+    assert list(ex.grad_dict["fc2_weight"]._jx.devices()) == [d1]
+    devs = {next(iter(a._jx.devices())) for n, a in ex.arg_dict.items()}
+    assert len(devs) >= 2
+
+
+def test_group2ctx_matches_single_device():
+    """Same net, same init: group2ctx placement across 2 devices must
+    produce the same outputs and gradients as single-device execution."""
+    _need_devices(2)
+    rs = np.random.RandomState(0)
+    x = rs.rand(8, 10).astype(np.float32)
+    y = rs.randint(0, 4, 8).astype(np.float32)
+    params = {"fc1_weight": rs.randn(16, 10).astype(np.float32) * 0.1,
+              "fc1_bias": np.zeros(16, np.float32),
+              "fc2_weight": rs.randn(4, 16).astype(np.float32) * 0.1,
+              "fc2_bias": np.zeros(4, np.float32)}
+
+    def run(group2ctx):
+        net = _group2ctx_sym()
+        ex = net.simple_bind(mx.cpu(0), group2ctx=group2ctx,
+                             data=(8, 10), softmax_label=(8,))
+        for n, v in params.items():
+            ex.arg_dict[n][:] = v
+        ex.arg_dict["data"][:] = x
+        ex.arg_dict["softmax_label"][:] = y
+        ex.forward(is_train=True)
+        ex.backward()
+        return (ex.outputs[0].asnumpy(),
+                {n: g.asnumpy() for n, g in ex.grad_dict.items()
+                 if g is not None and n not in ("data", "softmax_label")})
+
+    out1, g1 = run(None)
+    out2, g2 = run({"g0": mx.cpu(0), "g1": mx.cpu(1)})
+    assert_almost_equal(out2, out1, rtol=1e-5, atol=1e-6)
+    for k in g1:
+        assert_almost_equal(g2[k], g1[k], rtol=1e-5, atol=1e-6)
+
+
+def test_group2ctx_uniform_collapses_to_fast_path():
+    """All groups on the bind device -> no segmentation."""
+    net = _group2ctx_sym()
+    ex = net.simple_bind(mx.cpu(0),
+                         group2ctx={"g0": mx.cpu(0), "g1": mx.cpu(0)},
+                         data=(8, 10), softmax_label=(8,))
+    assert ex._segments is None
+
+
+def test_group2ctx_predict_and_aux():
+    """Segmented path handles aux-state ops (BatchNorm) and predict."""
+    _need_devices(2)
+    data = sym.Variable("data")
+    with mx.AttrScope(ctx_group="g0"):
+        h = sym.FullyConnected(data, num_hidden=8, name="fc1")
+        h = sym.BatchNorm(h, name="bn1")
+    with mx.AttrScope(ctx_group="g1"):
+        h = sym.FullyConnected(h, num_hidden=2, name="fc2")
+        net = sym.SoftmaxOutput(h, name="softmax")
+    ex = net.simple_bind(mx.cpu(0),
+                         group2ctx={"g0": mx.cpu(0), "g1": mx.cpu(1)},
+                         data=(4, 6), softmax_label=(4,))
+    rs = np.random.RandomState(1)
+    for n, a in ex.arg_dict.items():
+        if n not in ("data", "softmax_label"):
+            a[:] = rs.randn(*a.shape).astype(np.float32) * 0.1
+    ex.arg_dict["data"][:] = rs.rand(4, 6).astype(np.float32)
+    ex.arg_dict["softmax_label"][:] = np.array([0, 1, 0, 1], np.float32)
+    mean0 = ex.aux_dict["bn1_moving_mean"].asnumpy().copy()
+    ex.forward(is_train=True)
+    ex.backward()
+    assert not np.allclose(ex.aux_dict["bn1_moving_mean"].asnumpy(), mean0)
+    ex.forward(is_train=False)
+    out = ex.outputs[0].asnumpy()
+    assert out.shape == (4, 2)
+    np.testing.assert_allclose(out.sum(axis=1), np.ones(4), rtol=1e-5)
