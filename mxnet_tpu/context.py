@@ -65,9 +65,15 @@ class Context:
 
     # -- JAX mapping ------------------------------------------------------
     def jax_device(self):
-        """The PJRT device backing this context."""
+        """The PJRT device backing this context.
+
+        Contexts are PROCESS-LOCAL (a worker's ``mx.cpu(0)``/``mx.tpu(0)``
+        is its own chip): under a ``jax.distributed`` process group the
+        lookup uses addressable devices only — ``jax.devices()`` would
+        enumerate every process's chips."""
         if self._norm_type() == "cpu":
-            devs = jax.devices("cpu") if jax.default_backend() != "cpu" else jax.devices()
+            devs = jax.local_devices(backend="cpu") \
+                if jax.default_backend() != "cpu" else jax.local_devices()
             return devs[min(self.device_id, len(devs) - 1)]
         devs = _accel_devices()
         if not devs:
@@ -97,10 +103,11 @@ class Context:
 
 
 def _accel_devices():
-    """Non-CPU PJRT devices (TPU chips; the axon tunnel chip included)."""
+    """Process-local non-CPU PJRT devices (TPU chips; axon tunnel chip
+    included)."""
     if jax.default_backend() == "cpu":
         return []
-    return [d for d in jax.devices() if d.platform != "cpu"]
+    return [d for d in jax.local_devices() if d.platform != "cpu"]
 
 
 def _default_typeid():

@@ -1,0 +1,143 @@
+"""TPU-native multi-process data parallelism — the real ``dist_sync``.
+
+The reference's ``dist_sync`` is engine-ordered ZPush/ZPull with server-side
+merge (``src/kvstore/kvstore_dist.h:93-121``,
+``kvstore_dist_server.h:164-227``): every gradient crosses the network to a
+parameter server each step.  The TPU-native replacement (SURVEY §5.8) keeps
+gradients on-chip: each worker process joins ONE ``jax.distributed`` process
+group, the training step jits over the GLOBAL device mesh, and XLA inserts
+the cross-process psum for the gradient reduction — ICI within a slice, DCN
+across slices/hosts.  The parameter server survives only for
+update-on-server semantics and explicit ``push``/``pull`` (KVStore API).
+
+Wiring is pure env, like the reference (``DMLC_ROLE``, ``DMLC_WORKER_ID``,
+``DMLC_NUM_WORKER``, ``DMLC_PS_ROOT_URI/PORT`` — SURVEY §3.3):
+``tools/launch.py`` spawns workers with these set, and the coordinator
+listens on ``DMLC_PS_ROOT_PORT + 1`` of the root host (override with
+``MXNET_COORDINATOR_ADDRESS``).  ``MXNET_DIST_INGRAPH=0`` opts out, falling
+back to pure parameter-server gradients.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+import numpy as np
+
+_lock = threading.Lock()
+_state = {"initialized": False, "rank": 0, "num_processes": 1}
+
+
+def is_initialized():
+    return _state["initialized"]
+
+
+def rank():
+    return _state["rank"]
+
+
+def num_processes():
+    return _state["num_processes"]
+
+
+def init_from_env(rank_hint=None):
+    """Join the process group described by the launcher env.  Idempotent;
+    returns True when this process is part of an initialized multi-process
+    group.  No-ops (returns False) unless the env identifies this process
+    as exactly one launcher-spawned worker — in-process multi-client
+    setups (tests driving several KVStore clients from threads) must not
+    grab a group identity."""
+    with _lock:
+        if _state["initialized"]:
+            return True
+        if os.environ.get("MXNET_DIST_INGRAPH", "1") == "0":
+            return False
+        # launcher-spawned workers carry an explicit role + worker count
+        # (tools/launch.py); anything else (threaded multi-client tests,
+        # plain scripts) must not grab a process-group identity
+        if os.environ.get("DMLC_ROLE") != "worker" \
+                or "DMLC_NUM_WORKER" not in os.environ:
+            return False
+        nw = int(os.environ["DMLC_NUM_WORKER"])
+        pid = rank_hint if rank_hint is not None else \
+            os.environ.get("DMLC_WORKER_ID")
+        if nw < 2 or pid is None:
+            return False
+        pid = int(pid)
+        coord = os.environ.get("MXNET_COORDINATOR_ADDRESS")
+        if not coord:
+            host = os.environ.get("DMLC_PS_ROOT_URI")
+            port = os.environ.get("DMLC_PS_ROOT_PORT")
+            if not host or not port:
+                return False
+            coord = "%s:%d" % (host, int(port) + 1)
+        import jax
+
+        jax.distributed.initialize(coordinator_address=coord,
+                                   num_processes=nw, process_id=pid)
+        _state.update(initialized=True, rank=pid, num_processes=nw)
+        return True
+
+
+def init(coordinator_address, num_processes_, process_id):
+    """Explicit process-group init (the launcher-env-free path)."""
+    with _lock:
+        if _state["initialized"]:
+            return
+        import jax
+
+        jax.distributed.initialize(coordinator_address=coordinator_address,
+                                   num_processes=num_processes_,
+                                   process_id=process_id)
+        _state.update(initialized=True, rank=process_id,
+                      num_processes=num_processes_)
+
+
+def global_mesh(axis_name="data"):
+    """1-D mesh over EVERY device in the process group."""
+    import jax
+    from jax.sharding import Mesh
+
+    return Mesh(np.array(jax.devices()), (axis_name,))
+
+
+def replicate(mesh, value):
+    """Host value -> globally replicated array on the mesh."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    v = np.asarray(value)
+    return jax.make_array_from_callback(
+        v.shape, NamedSharding(mesh, P()), lambda idx: v[idx])
+
+
+def shard_batch(mesh, local_value, axis_name="data"):
+    """Per-process local batch -> global batch-sharded array."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return jax.make_array_from_process_local_data(
+        NamedSharding(mesh, P(axis_name)), np.asarray(local_value))
+
+
+def broadcast_from_root(value):
+    """Rank-0's host value to every process (the reference's Init
+    broadcast of rank-0 weights, ``kvstore_dist.h:58-76``)."""
+    from jax.experimental import multihost_utils
+
+    return multihost_utils.broadcast_one_to_all(np.asarray(value))
+
+
+def local_rows(global_array):
+    """This process's rows of a batch-sharded global array (sorted by
+    global offset) — per-worker metric/outputs view."""
+    shards = sorted(global_array.addressable_shards,
+                    key=lambda s: s.index[0].start or 0)
+    return np.concatenate([np.asarray(s.data) for s in shards])
+
+
+def barrier(tag="mxnet_tpu_barrier"):
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices(tag)

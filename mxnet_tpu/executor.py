@@ -248,6 +248,7 @@ class Executor:
         self._needs_rng = None
         self._rng_cache = None
         self._seg_chain = None
+        self._global_mesh = None  # set by Module in multi-process mode
         self._init_placement()
 
     arg_arrays = property(lambda s: [s.arg_dict[n] for n in s.arg_names])
@@ -622,6 +623,20 @@ class Executor:
             self._needs_rng = any(
                 (not n.is_variable) and n.op.needs_rng
                 for n in self._symbol._nodes())
+        if self._global_mesh is not None:
+            # multi-process SPMD: the key must be a global replicated
+            # array (and identical on every process — fold a counter on a
+            # fixed base rather than splitting process-local state)
+            from . import dist as _dist
+
+            if self._needs_rng:
+                key = np.asarray(jax.random.fold_in(
+                    jax.random.PRNGKey(_random.get_seed()), self._rng_step))
+                return _dist.replicate(self._global_mesh, key)
+            if self._rng_cache is None:
+                self._rng_cache = _dist.replicate(
+                    self._global_mesh, np.asarray(jax.random.PRNGKey(0)))
+            return self._rng_cache
         if self._needs_rng:
             return jax.device_put(_random.next_key(),
                                   self._ctx.jax_device())

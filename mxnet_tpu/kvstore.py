@@ -205,6 +205,16 @@ class KVStoreDist(KVStore):
         # command the server into the mode this type implies (reference
         # kvstore.cc:32-35: sync unless the type carries _async)
         self._rpc({"cmd": "sync_mode", "value": "_async" not in kv_type})
+        # TPU-native gradient plane: join the jax.distributed process
+        # group so training steps run in-graph collectives across
+        # processes (psum over the global mesh) instead of per-step PS
+        # push/pull.  dist_async keeps the PS plane — asynchronous
+        # updates have no collective analog (SURVEY §5.8).
+        self.in_graph_sync = False
+        if "_async" not in kv_type:
+            from . import dist as _dist
+
+            self.in_graph_sync = _dist.init_from_env(rank_hint=self._rank)
 
     def _rpc(self, msg):
         self._ps.send_msg(self._sock, msg)

@@ -56,6 +56,11 @@ def _create_kvstore(kvstore, num_device, arg_params):
         raise TypeError("kvstore must be KVStore, str or None")
     if kv is None:
         update_on_kvstore = False
+    elif getattr(kv, "in_graph_sync", False):
+        # TPU-native dist_sync: gradients reduce in-graph (psum over the
+        # global mesh); every worker applies the identical update locally,
+        # so the server-side optimizer plane is bypassed
+        update_on_kvstore = False
     return (kv, update_on_kvstore)
 
 

@@ -81,6 +81,8 @@ class Module(BaseModule):
         self._grad_req = "write"
         self._fused_step = None
         self._pending_full = False  # staged single-dispatch train step
+        self._dist_dp = False  # multi-process in-graph data parallelism
+        self._dist_placed_states = set()
 
     # -- properties -------------------------------------------------------
     @property
@@ -130,12 +132,27 @@ class Module(BaseModule):
         return Mesh(np.array(devices), ("data",))
 
     def _shard(self, arr, batch_axis):
-        """Place an NDArray globally over the module mesh."""
+        """Place an NDArray globally over the module mesh.
+
+        Multi-process (dist in-graph) mode: non-batch arrays are
+        broadcast from rank 0 (the reference's Init broadcast,
+        ``kvstore_dist.h:58-76``) and replicated over the GLOBAL mesh;
+        batch arrays are left for per-step ``_load_io`` sharding."""
+        if self._mesh is None:
+            return
+        if self._dist_dp:
+            from .. import dist as _dist
+
+            if batch_axis:
+                return
+            arr._jx = _dist.replicate(
+                self._mesh, _dist.broadcast_from_root(np.asarray(arr._jx)))
+            return
+        if len(self._context) == 1:
+            return
         import jax
         from jax.sharding import NamedSharding, PartitionSpec as P
 
-        if self._mesh is None or len(self._context) == 1:
-            return
         spec = P("data") if batch_axis else P()
         arr._jx = jax.device_put(arr._jx, NamedSharding(self._mesh, spec))
 
@@ -162,7 +179,23 @@ class Module(BaseModule):
         self._data_shapes = _parse_data_desc(data_shapes)
         self._label_shapes = _parse_data_desc(label_shapes) \
             if label_shapes else []
-        if len(self._context) > 1:
+        from .. import dist as _dist
+
+        if _dist.is_initialized() and len(self._context) == 1:
+            # TPU-native dist_sync: one jitted SPMD step over the GLOBAL
+            # mesh; each process feeds its local batch shard and XLA
+            # psums the gradients in-graph (SURVEY §5.8)
+            import jax
+
+            self._dist_dp = True
+            self._mesh = _dist.global_mesh("data")
+            local_devs = jax.local_device_count()
+            for _, s in self._data_shapes + self._label_shapes:
+                if s and s[0] % local_devs != 0:
+                    raise MXNetError(
+                        "local batch %d not divisible by %d local devices"
+                        % (s[0], local_devs))
+        elif len(self._context) > 1:
             self._mesh = self._make_mesh()
             for _, s in self._data_shapes + self._label_shapes:
                 if s and s[0] % len(self._context) != 0:
@@ -170,6 +203,13 @@ class Module(BaseModule):
                         "batch size %d not divisible by %d devices"
                         % (s[0], len(self._context)))
         shapes = dict(self._data_shapes + self._label_shapes)
+        if self._dist_dp:
+            # the executor binds GLOBAL batch shapes (local x processes)
+            nproc = _dist.num_processes()
+            shapes = {n: ((s[0] * nproc,) + tuple(s[1:])
+                          if n in (self._data_names + self._label_names)
+                          and s else s)
+                      for n, s in shapes.items()}
         req = {}
         for n in self._symbol.list_arguments():
             if n in self._param_names and n not in self._fixed_param_names \
@@ -184,6 +224,8 @@ class Module(BaseModule):
         self._exec = Executor._simple_bind(
             self._symbol, self._context[0], grad_req=req,
             shared_exec=shared_exec, **shapes)
+        if self._dist_dp:
+            self._exec._global_mesh = self._mesh
         # global placement over the mesh
         if self._mesh is not None:
             for n in self._symbol.list_arguments():
@@ -252,6 +294,17 @@ class Module(BaseModule):
         arg_params = {n: self._exec.arg_dict[n] for n in self._param_names}
         (kvstore, update_on_kvstore) = _create_kvstore(
             kvstore, len(self._context), arg_params)
+        if kvstore is not None and getattr(kvstore, "in_graph_sync", False) \
+                and not self._dist_dp:
+            # the process group came up with the kvstore (after bind):
+            # re-bind onto the global mesh, preserving parameters (bind
+            # broadcasts rank-0 values during placement)
+            self.bind(self._data_shapes, self._label_shapes or None,
+                      for_training=self.for_training,
+                      inputs_need_grad=self.inputs_need_grad,
+                      force_rebind=True, grad_req=self._grad_req)
+            arg_params = {n: self._exec.arg_dict[n]
+                          for n in self._param_names}
         batch_size = self._data_shapes[0][1][0]
         if kvstore and "dist" in kvstore.type:
             batch_size *= kvstore.num_workers
@@ -310,6 +363,20 @@ class Module(BaseModule):
             if name not in self._exec.arg_dict:
                 continue
             dst = self._exec.arg_dict[name]
+            if self._dist_dp:
+                # local batch shard -> global batch-sharded array
+                from .. import dist as _dist
+
+                loc = np.asarray(src._jx if isinstance(src, NDArray)
+                                 else src, dtype=dst.dtype)
+                nproc = _dist.num_processes()
+                if (loc.shape[0] * nproc,) + loc.shape[1:] != dst.shape:
+                    raise MXNetError(
+                        "input %r local shape %s does not tile to bound "
+                        "global shape %s over %d processes"
+                        % (name, loc.shape, dst.shape, nproc))
+                dst._jx = _dist.shard_batch(self._mesh, loc)
+                continue
             jx = src._jx if isinstance(src, NDArray) else None
             if jx is None:
                 dst[:] = src
@@ -369,7 +436,10 @@ class Module(BaseModule):
         if not (self.binded and self.params_initialized
                 and self.optimizer_initialized):
             return False
-        if type(self._optimizer) is not opt.SGD or self._kvstore is not None:
+        if type(self._optimizer) is not opt.SGD:
+            return False
+        if self._kvstore is not None and \
+                not getattr(self._kvstore, "in_graph_sync", False):
             return False
         if self.inputs_need_grad or self._exec._monitor_callback is not None:
             return False
@@ -420,6 +490,7 @@ class Module(BaseModule):
             if idx not in updater.states:
                 updater.states[idx] = optimizer.create_state(
                     idx, ex.arg_dict[names[idx]])
+            self._place_opt_state(idx, updater.states[idx])
             optimizer._update_count(idx)
         lrs, wds = self._get_hyper_arrays(optimizer, len(names))
         clip = optimizer.clip_gradient \
@@ -469,7 +540,8 @@ class Module(BaseModule):
 
         if not batches:
             return
-        if not self._full_step_eligible() or self._optimizer is None:
+        if not self._full_step_eligible() or self._optimizer is None \
+                or self._dist_dp:
             for b in batches:
                 self.forward_backward(b)
                 self.update()
@@ -562,7 +634,8 @@ class Module(BaseModule):
             self._run_full_step()
             return
         local_kv = self._kvstore is None or (
-            not self._update_on_kvstore and "dist" not in self._kvstore.type)
+            not self._update_on_kvstore and "dist" not in self._kvstore.type) \
+            or getattr(self._kvstore, "in_graph_sync", False)
         if local_kv and self._updater is not None \
                 and self._try_fused_update():
             return
@@ -578,19 +651,43 @@ class Module(BaseModule):
 
     def _get_hyper_arrays(self, optimizer, n):
         """Device copies of per-index lr/wd, re-uploaded only when a
-        scheduler changes the values."""
+        scheduler changes the values.  Multi-process mode passes host
+        numpy (pjit replicates them) — a committed local array would
+        clash with global-mesh arguments."""
         import jax.numpy as jnp
 
         lr_vals = tuple(optimizer._get_lr(i) for i in range(n))
         wd_vals = tuple(optimizer._get_wd(i) for i in range(n))
         cached = getattr(self, "_fused_hyper_cache", None)
         if cached is None or cached[0] != lr_vals or cached[1] != wd_vals:
+            mk = np.asarray if self._dist_dp else \
+                (lambda v, d=None: jnp.asarray(v, jnp.float32))
             self._fused_hyper_cache = (
                 lr_vals, wd_vals,
-                jnp.asarray(lr_vals, jnp.float32),
-                jnp.asarray(wd_vals, jnp.float32))
+                mk(np.asarray(lr_vals, np.float32)),
+                mk(np.asarray(wd_vals, np.float32)))
             cached = self._fused_hyper_cache
         return cached[2], cached[3]
+
+    def _place_opt_state(self, idx, state):
+        """Optimizer state arrays (momentum etc.) join the module mesh —
+        a locally-committed buffer cannot enter a jit whose other
+        arguments are mesh-placed (multihost jit rejects it outright)."""
+        if state is None or self._mesh is None \
+                or idx in self._dist_placed_states:
+            return state
+        if self._dist_dp:
+            from .. import dist as _dist
+
+            state._jx = _dist.replicate(self._mesh, np.asarray(state._jx))
+        else:
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            state._jx = jax.device_put(state._jx,
+                                       NamedSharding(self._mesh, P()))
+        self._dist_placed_states.add(idx)
+        return state
 
     def _try_fused_update(self):
         import jax
@@ -615,6 +712,7 @@ class Module(BaseModule):
                 if idx not in updater.states:
                     updater.states[idx] = optimizer.create_state(
                         idx, self._exec.arg_dict[n])
+                self._place_opt_state(idx, updater.states[idx])
 
             from ..executor import sgd_step_math
 
@@ -648,6 +746,14 @@ class Module(BaseModule):
     def get_outputs(self, merge_multi_context=True):
         assert self.binded
         self._materialize_pending()
+        if self._dist_dp:
+            # per-worker view: this process's rows of the global batch
+            # (the reference's per-worker outputs/metric semantics)
+            from .. import dist as _dist
+            from ..ndarray import array as nd_array
+
+            return [nd_array(_dist.local_rows(o._jx))
+                    for o in self._exec.outputs]
         return self._exec.outputs
 
     def get_input_grads(self, merge_multi_context=True):

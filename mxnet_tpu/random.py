@@ -23,11 +23,22 @@ _lock = threading.Lock()
 _key = None
 
 
+_seed_value = 0
+
+
 def seed(seed_state):
     """reference ``random.py:40`` / MXRandomSeed"""
-    global _key
+    global _key, _seed_value
     with _lock:
+        _seed_value = int(seed_state)
         _key = jax.random.PRNGKey(int(seed_state))
+
+
+def get_seed():
+    """The last value passed to ``seed()`` (0 before any call) — the
+    shared base for multi-process SPMD keys, which must be identical on
+    every process."""
+    return _seed_value
 
 
 def next_key():

@@ -118,6 +118,9 @@ def main():
         "DMLC_PS_ROOT_PORT": str(port),
         "DMLC_NUM_WORKER": str(args.num_workers),
         "DMLC_NUM_SERVER": "1",
+        # jax.distributed coordinator for the in-graph gradient plane
+        # (rank 0 hosts it; see mxnet_tpu/dist.py)
+        "MXNET_COORDINATOR_ADDRESS": "%s:%d" % (root_uri, _free_port()),
         "PYTHONPATH": here + (os.pathsep + pypath if pypath else ""),
     }
     base_env.update(wire)
