@@ -49,8 +49,10 @@ def imdecode(buf, flag=1, to_rgb=True):
     if img is None:
         raise MXNetError("cannot decode image")
     if to_rgb and img.ndim == 3 and img.shape[2] == 3:
-        img = img[:, :, ::-1]
-    return np.ascontiguousarray(img)
+        # SIMD channel swap; a reversed view + ascontiguousarray costs a
+        # strided copy per image
+        img = cv2.cvtColor(img, cv2.COLOR_BGR2RGB)
+    return img
 
 
 def imresize(src, w, h, interp=1):
@@ -148,7 +150,7 @@ class HorizontalFlipAug(Augmenter):
 
     def __call__(self, src):
         if pyrandom.random() < self.p:
-            return np.ascontiguousarray(src[:, ::-1])
+            return src[:, ::-1]  # view; batch staging copies it anyway
         return src
 
 
@@ -281,8 +283,13 @@ class ImageIter(DataIter):
                  path_imgidx=None, shuffle=False, part_index=0, num_parts=1,
                  aug_list=None, imglist=None, data_name="data",
                  label_name="softmax_label", last_batch_handle="pad",
-                 preprocess_threads=1, **kwargs):
+                 preprocess_threads=1, post_batch=None, **kwargs):
         super().__init__(batch_size)
+        # post_batch(hwc_batch, label, valid) -> (data NDArray, label
+        # NDArray): batch-level cast/normalize/transpose (host-vectorized
+        # or on-device) replacing the per-image CastAug chain; augmenters
+        # must then keep images uint8 HWC (geometric ops only)
+        self._post_batch = post_batch
         # parallel decode/augment on the native engine's worker pool
         # (the C++ ImageRecordIter's preprocess_threads,
         # iter_image_recordio.cc) — cv2 releases the GIL during decode
@@ -413,15 +420,26 @@ class ImageIter(DataIter):
 
     def next(self):
         c, h, w = self.data_shape
-        data = np.zeros((self.batch_size, c, h, w), np.float32)
+        post = self._post_batch
+        # fast path stages uint8 HWC (geometric augs preserve dtype) and
+        # converts once per batch; classic path converts per image (the
+        # aug chain may produce float, e.g. CastAug/ColorNormalizeAug)
+        hwc = np.empty((self.batch_size, h, w, c), np.uint8) \
+            if post is not None else None
+        data = None if post is not None \
+            else np.empty((self.batch_size, c, h, w), np.float32)
         if self.label_width == 1:
             label = np.zeros((self.batch_size,), np.float32)
         else:
             label = np.zeros((self.batch_size, self.label_width), np.float32)
+
         def fill(i, img, lbl):
             if img.ndim == 2:
                 img = img[:, :, None]
-            data[i] = np.asarray(img, np.float32).transpose(2, 0, 1)
+            if post is not None:
+                hwc[i] = img
+            else:
+                data[i] = np.asarray(img, np.float32).transpose(2, 0, 1)
             lbl = np.asarray(lbl).reshape(-1)
             if self.label_width == 1:
                 label[i] = lbl[0]
@@ -467,11 +485,22 @@ class ImageIter(DataIter):
         pad = self.batch_size - i
         if pad:  # pad with the last valid sample (reference pad semantics)
             for j in range(i, self.batch_size):
-                data[j] = data[i - 1]
+                if post is not None:
+                    hwc[j] = hwc[i - 1]
+                else:
+                    data[j] = data[i - 1]
                 label[j] = label[i - 1]
+        if post is not None:
+            d_nd, l_nd = post(hwc, label)
+            return DataBatch(data=[d_nd], label=[l_nd], pad=pad,
+                             provide_data=self.provide_data,
+                             provide_label=self.provide_label)
         # batches carry NDArrays like every other DataIter (reference
-        # DataBatch contract: .data/.label are NDArray lists)
-        return DataBatch(data=[ndarray.array(data)],
-                         label=[ndarray.array(label)], pad=pad,
+        # DataBatch contract: .data/.label are NDArray lists); they live
+        # on CPU — iterators fill host memory, the executor moves it
+        from .context import cpu as _cpu
+
+        return DataBatch(data=[ndarray.array(data, ctx=_cpu())],
+                         label=[ndarray.array(label, ctx=_cpu())], pad=pad,
                          provide_data=self.provide_data,
                          provide_label=self.provide_label)

@@ -460,22 +460,94 @@ class PrefetchingIter(DataIter):
         return self.current_batch.pad
 
 
+def _batch_converter(mean, std, scale, ctx):
+    """Batch-level cast+normalize+transpose for the ImageRecordIter fast
+    path: uint8 HWC staging -> f32 NCHW, either host-vectorized or — with
+    ``ctx`` on an accelerator — ON DEVICE, so the host ships a quarter of
+    the bytes and the chip does the layout work (the TPU answer to the
+    reference's GPU-side ``ImageRecordUInt8Iter`` pattern)."""
+    from . import ndarray
+
+    use_mean = mean is not None and mean.any()
+    use_std = std is not None and (std != 1.0).any()
+
+    if ctx is not None:
+        import jax
+        import jax.numpy as jnp
+
+        dev = ctx.jax_device()
+        mean_j = jnp.asarray(mean) if use_mean else None
+        std_j = jnp.asarray(std) if use_std else None
+
+        @jax.jit
+        def convert(x):
+            y = x.astype(jnp.float32)
+            if use_mean:
+                y = y - mean_j
+            if use_std:
+                y = y / std_j
+            if scale != 1.0:
+                y = y * jnp.float32(scale)
+            return y.transpose(0, 3, 1, 2)
+
+        def post(hwc, label):
+            out = convert(jax.device_put(hwc, dev))
+            return (ndarray.NDArray._from_jax(out, ctx),
+                    ndarray.array(label, ctx=ctx))
+
+        return post
+
+    mean_c = mean.reshape(1, -1, 1, 1) if use_mean else None
+    std_c = std.reshape(1, -1, 1, 1) if use_std else None
+    from .context import cpu as _cpu
+
+    def post(hwc, label):
+        # ONE strided-read/contiguous-write pass does transpose+cast; the
+        # resulting contiguous buffer makes the jax conversion a memcpy.
+        # Host batches stay on CPU (reference iterators fill pinned host
+        # memory; the executor's _load_io does the device copy) — an
+        # accelerator default context would drag every batch through the
+        # host->device link twice
+        x = hwc.transpose(0, 3, 1, 2).astype(np.float32)
+        if use_mean:
+            x -= mean_c
+        if use_std:
+            x /= std_c
+        if scale != 1.0:
+            x *= np.float32(scale)
+        return (ndarray.array(x, ctx=_cpu()),
+                ndarray.array(label, ctx=_cpu()))
+
+    return post
+
+
 def ImageRecordIter(path_imgrec, data_shape, batch_size, shuffle=False,
                     part_index=0, num_parts=1, rand_crop=False,
                     rand_mirror=False, mean_r=0.0, mean_g=0.0, mean_b=0.0,
                     std_r=1.0, std_g=1.0, std_b=1.0, scale=1.0, resize=0,
                     path_imgidx=None, prefetch=True, data_name="data",
                     label_name="softmax_label", label_width=1,
-                    preprocess_threads=1, prefetch_buffer=1,
-                    round_batch=None, **kwargs):
+                    preprocess_threads=4, prefetch_buffer=1,
+                    round_batch=None, ctx=None, **kwargs):
     """C-iter-style facade over ``image.ImageIter`` (+ prefetch thread).
 
     Reference: ``ImageRecordIter`` registered at
     ``src/io/iter_image_recordio.cc:458`` with the decode→augment→batch→
     prefetch decorator chain of §3.5; kwargs mirror its dmlc params
     (``mean_r``..., ``rand_crop``, ``part_index``/``num_parts``...).
+
+    TPU-first pipeline shape: N decode threads (``preprocess_threads``,
+    default 4 — cv2 releases the GIL) run geometric augmenters on uint8,
+    the batch is cast/normalized/transposed ONCE (on ``ctx`` when it is
+    an accelerator — quarter the host->device bytes, layout work on the
+    MXU's neighbors), and ``PrefetchingIter`` double-buffers the whole
+    thing against the consumer (``iter_prefetcher.h:49`` analog).
+    Per-image color augmentations (brightness/contrast/saturation/pca)
+    need float images, so requesting them falls back to the reference's
+    per-image CastAug chain.
     """
-    from .image import CreateAugmenter, ImageIter
+    from .image import (CenterCropAug, CreateAugmenter, HorizontalFlipAug,
+                        ImageIter, RandomCropAug, ResizeAug)
 
     known = ("brightness", "contrast", "saturation", "pca_noise",
              "inter_method")
@@ -485,20 +557,37 @@ def ImageRecordIter(path_imgrec, data_shape, batch_size, shuffle=False,
                         % sorted(unknown))
     mean = np.array([mean_r, mean_g, mean_b], np.float32)
     std = np.array([std_r, std_g, std_b], np.float32)
-    aug_list = CreateAugmenter(
-        data_shape, resize=resize, rand_crop=rand_crop,
-        rand_mirror=rand_mirror,
-        mean=mean if mean.any() else None,
-        std=std if (std != 1.0).any() else None,
-        **kwargs)
-    if scale != 1.0:
-        aug_list.append(lambda img: img * scale)
+    color_ops = any(kwargs.get(k) for k in
+                    ("brightness", "contrast", "saturation", "pca_noise"))
+    post_batch = None
+    if not color_ops:
+        # fast path: geometric augs stay uint8; one batch-level convert
+        inter = kwargs.get("inter_method", 1)
+        aug_list = []
+        if resize > 0:
+            aug_list.append(ResizeAug(resize, inter))
+        crop_size = (data_shape[2], data_shape[1])
+        aug_list.append(RandomCropAug(crop_size, inter) if rand_crop
+                        else CenterCropAug(crop_size, inter))
+        if rand_mirror:
+            aug_list.append(HorizontalFlipAug(0.5))
+        post_batch = _batch_converter(mean, std, scale, ctx)
+    else:
+        aug_list = CreateAugmenter(
+            data_shape, resize=resize, rand_crop=rand_crop,
+            rand_mirror=rand_mirror,
+            mean=mean if mean.any() else None,
+            std=std if (std != 1.0).any() else None,
+            **kwargs)
+        if scale != 1.0:
+            aug_list.append(lambda img: img * scale)
     it = ImageIter(batch_size, data_shape, label_width=label_width,
                    path_imgrec=path_imgrec, path_imgidx=path_imgidx,
                    shuffle=shuffle, part_index=part_index,
                    num_parts=num_parts, aug_list=aug_list,
                    data_name=data_name, label_name=label_name,
-                   preprocess_threads=preprocess_threads)
+                   preprocess_threads=preprocess_threads,
+                   post_batch=post_batch)
     # reference knobs: prefetch_buffer=0 disables the background thread
     # (the python prefetcher is double-buffered regardless of depth).
     # Final-batch semantics are the reference's round_batch=0 style:

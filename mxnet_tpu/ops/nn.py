@@ -55,7 +55,12 @@ def _conv_f32acc(stride, padding, lhs_dilation, rhs_dilation, dn, groups):
     def bwd(res, g):
         data, weight = res
         _, vjp = jax.vjp(plain, data, weight)
-        return vjp(g.astype(data.dtype))
+        # the barrier keeps XLA:TPU from fusing a pad/slice-produced
+        # cotangent into the transposed convs — that fusion miscompiles
+        # on the current TPU toolchain (wrong data-gradients for any
+        # Pad/Crop/slice directly after a conv; verified against CPU and
+        # finite differences)
+        return vjp(jax.lax.optimization_barrier(g.astype(data.dtype)))
 
     conv.defvjp(fwd, bwd)
     return conv

@@ -573,7 +573,27 @@ def __getattr__(name):
     return fn
 
 
-# aliases matching reference sym namespace
-pow = sys.modules[__name__].__dict__["_power"]  # noqa: A001
-maximum = sys.modules[__name__].__dict__["_maximum"]
-minimum = sys.modules[__name__].__dict__["_minimum"]
+# aliases matching reference sym namespace; symbol∘scalar mixes dispatch
+# to the *_scalar ops exactly like the reference's mx.sym.maximum et al.
+def _sym_or_scalar(sym_op, scalar_op, rscalar_op=None):
+    mod = sys.modules[__name__]
+
+    def fn(lhs, rhs):
+        if isinstance(lhs, Symbol) and isinstance(rhs, Symbol):
+            return getattr(mod, sym_op)(lhs, rhs)
+        if isinstance(lhs, Symbol):
+            return getattr(mod, scalar_op)(lhs, scalar=float(rhs))
+        if isinstance(rhs, Symbol):
+            return getattr(mod, rscalar_op or scalar_op)(
+                rhs, scalar=float(lhs))
+        raise MXNetError("%s: at least one Symbol operand required"
+                         % sym_op)
+
+    fn.__name__ = sym_op.lstrip("_")
+    return fn
+
+
+pow = _sym_or_scalar("_power", "_power_scalar", "_rpower_scalar")  # noqa: A001
+maximum = _sym_or_scalar("_maximum", "_maximum_scalar")
+minimum = _sym_or_scalar("_minimum", "_minimum_scalar")
+hypot = _sym_or_scalar("_hypot", "_hypot_scalar")

@@ -177,3 +177,79 @@ def test_prefetching_iter_reset_clears_errors():
     it.reset()
     batch = it.next()  # healthy after reset — stale error must not raise
     assert batch.data[0].shape == (2, 2)
+
+
+def _write_rec(tmp_path, n=12, size=16):
+    import numpy as np
+
+    from mxnet_tpu import recordio
+
+    rec_path = str(tmp_path / "fp.rec")
+    rec = recordio.MXRecordIO(rec_path, "w")
+    rs = np.random.RandomState(3)
+    imgs = []
+    for i in range(n):
+        img = (rs.rand(size, size, 3) * 255).astype(np.uint8)
+        imgs.append(img)
+        rec.write(recordio.pack_img(recordio.IRHeader(0, float(i), i, 0),
+                                    img, img_fmt=".png"))
+    rec.close()
+    return rec_path, imgs
+
+
+def test_image_record_iter_fast_path_values(tmp_path):
+    """The uint8-staging fast path (no color augs) must produce the same
+    normalized NCHW values as doing the math by hand."""
+    import numpy as np
+
+    rec_path, imgs = _write_rec(tmp_path, n=6, size=16)
+    mean = (10.0, 20.0, 30.0)
+    std = (2.0, 3.0, 4.0)
+    it = io.ImageRecordIter(path_imgrec=rec_path, data_shape=(3, 16, 16),
+                            batch_size=6, prefetch=False,
+                            preprocess_threads=1,
+                            mean_r=mean[0], mean_g=mean[1], mean_b=mean[2],
+                            std_r=std[0], std_g=std[1], std_b=std[2],
+                            scale=0.5)
+    batch = it.next()
+    got = batch.data[0].asnumpy()
+    assert batch.data[0].context.device_type in ("cpu",)
+    for i, img in enumerate(imgs[:6]):
+        # pack_img takes BGR (cv2 convention); imdecode returns RGB
+        want = img[:, :, ::-1].astype(np.float32)
+        want = (want - np.array(mean, np.float32)) / np.array(std, np.float32)
+        want = (want * 0.5).transpose(2, 0, 1)
+        np.testing.assert_allclose(got[i], want, rtol=1e-5, atol=1e-5)
+
+
+def test_image_record_iter_device_convert_matches_host(tmp_path):
+    """ctx= moves cast/normalize/transpose on device; values must match
+    the host path."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+
+    rec_path, _ = _write_rec(tmp_path, n=8, size=16)
+
+    def run(**kw):
+        it = io.ImageRecordIter(path_imgrec=rec_path,
+                                data_shape=(3, 16, 16), batch_size=8,
+                                prefetch=False, preprocess_threads=1,
+                                mean_r=5.0, std_r=2.0, scale=0.25, **kw)
+        return it.next().data[0]
+
+    host = run()
+    dev = run(ctx=mx.cpu(0))
+    assert dev.shape == (8, 3, 16, 16)
+    np.testing.assert_allclose(dev.asnumpy(), host.asnumpy(),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_image_record_iter_color_augs_still_work(tmp_path):
+    """brightness etc. fall back to the per-image float chain."""
+    rec_path, _ = _write_rec(tmp_path, n=4, size=16)
+    it = io.ImageRecordIter(path_imgrec=rec_path, data_shape=(3, 16, 16),
+                            batch_size=4, prefetch=False,
+                            preprocess_threads=1, brightness=0.1)
+    batch = it.next()
+    assert batch.data[0].shape == (4, 3, 16, 16)

@@ -1,0 +1,649 @@
+"""Exhaustive per-op forward + gradient sweep.
+
+Reference: ``tests/python/unittest/test_operator.py`` (3018 LoC of per-op
+numerical checks).  Parametrized table-driven version: every differentiable
+op in the §2.3 census gets ``check_numeric_gradient`` (finite differences vs
+the symbolic backward) and a numpy-reference forward where one exists.
+``tests_tpu/test_operator_tpu.py`` re-runs this module's cases cross-backend
+via ``check_consistency``."""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import sym
+from mxnet_tpu.test_utils import (assert_almost_equal,
+                                  check_numeric_gradient,
+                                  check_symbolic_forward)
+
+RS = np.random.RandomState(7)
+
+
+def _pos(shape, lo=0.5, hi=2.0):
+    return (RS.rand(*shape) * (hi - lo) + lo).astype(np.float32)
+
+
+def _sym1(opname, **attrs):
+    return getattr(sym, opname)(sym.Variable("x"), **attrs)
+
+
+# ---------------------------------------------------------------------------
+# unary math ops: (op, numpy ref, input transform for domain safety)
+# ---------------------------------------------------------------------------
+UNARY = [
+    ("negative", lambda x: -x, None),
+    ("abs", np.abs, None),
+    ("sign", np.sign, None),
+    ("round", np.round, None),
+    ("ceil", np.ceil, None),
+    ("floor", np.floor, None),
+    ("fix", np.fix, None),
+    ("rint", np.rint, None),
+    ("square", np.square, None),
+    ("sqrt", np.sqrt, "pos"),
+    ("rsqrt", lambda x: 1.0 / np.sqrt(x), "pos"),
+    ("exp", np.exp, None),
+    ("log", np.log, "pos"),
+    ("log2", np.log2, "pos"),
+    ("log10", np.log10, "pos"),
+    ("log1p", np.log1p, "pos"),
+    ("expm1", np.expm1, None),
+    ("sin", np.sin, None),
+    ("cos", np.cos, None),
+    ("tan", np.tan, "small"),
+    ("arcsin", np.arcsin, "unit"),
+    ("arccos", np.arccos, "unit"),
+    ("arctan", np.arctan, None),
+    ("sinh", np.sinh, None),
+    ("cosh", np.cosh, None),
+    ("tanh", np.tanh, None),
+    ("arcsinh", np.arcsinh, None),
+    ("arccosh", lambda x: np.arccosh(x), "gt1"),
+    ("arctanh", np.arctanh, "unit"),
+    ("gamma", lambda x: np.vectorize(__import__("math").gamma)(x), "pos"),
+    ("gammaln", lambda x: np.vectorize(__import__("math").lgamma)(x), "pos"),
+    ("degrees", np.degrees, None),
+    ("radians", np.radians, None),
+]
+
+_NONDIFF = {"sign", "round", "ceil", "floor", "fix", "rint"}
+
+
+def _unary_input(mode):
+    if mode == "pos":
+        return _pos((3, 4))
+    if mode == "unit":
+        return (RS.rand(3, 4).astype(np.float32) * 1.6 - 0.8)
+    if mode == "gt1":
+        return _pos((3, 4), 1.2, 3.0)
+    if mode == "small":
+        return (RS.rand(3, 4).astype(np.float32) * 0.8 - 0.4)
+    return (RS.randn(3, 4)).astype(np.float32) + 0.05
+
+
+@pytest.mark.parametrize("op,ref,mode", UNARY, ids=[u[0] for u in UNARY])
+def test_unary(op, ref, mode):
+    x = _unary_input(mode)
+    s = _sym1(op)
+    check_symbolic_forward(s, {"x": x}, [ref(x)], rtol=1e-4, atol=1e-5)
+    if op not in _NONDIFF:
+        check_numeric_gradient(s, {"x": x}, numeric_eps=1e-3, rtol=0.05,
+                               atol=1e-3)
+
+
+# ---------------------------------------------------------------------------
+# binary elemwise + broadcast
+# ---------------------------------------------------------------------------
+BINARY = [
+    ("elemwise_add", np.add), ("elemwise_sub", np.subtract),
+    ("elemwise_mul", np.multiply), ("elemwise_div", np.divide),
+]
+BROADCAST = [
+    ("broadcast_add", np.add), ("broadcast_sub", np.subtract),
+    ("broadcast_mul", np.multiply), ("broadcast_div", np.divide),
+    ("broadcast_power", np.power), ("broadcast_maximum", np.maximum),
+    ("broadcast_minimum", np.minimum),
+    ("broadcast_hypot", np.hypot),
+]
+BROADCAST_CMP = [
+    ("broadcast_equal", np.equal), ("broadcast_not_equal", np.not_equal),
+    ("broadcast_greater", np.greater),
+    ("broadcast_greater_equal", np.greater_equal),
+    ("broadcast_lesser", np.less),
+    ("broadcast_lesser_equal", np.less_equal),
+]
+
+
+@pytest.mark.parametrize("op,ref", BINARY, ids=[b[0] for b in BINARY])
+def test_binary_elemwise(op, ref):
+    a, b = _pos((3, 4)), _pos((3, 4))
+    s = getattr(sym, op)(sym.Variable("a"), sym.Variable("b"))
+    check_symbolic_forward(s, {"a": a, "b": b}, [ref(a, b)], rtol=1e-5)
+    check_numeric_gradient(s, {"a": a, "b": b}, rtol=0.05, atol=1e-3)
+
+
+@pytest.mark.parametrize("op,ref", BROADCAST, ids=[b[0] for b in BROADCAST])
+def test_binary_broadcast(op, ref):
+    a, b = _pos((2, 3, 4)), _pos((1, 3, 1))
+    s = getattr(sym, op)(sym.Variable("a"), sym.Variable("b"))
+    check_symbolic_forward(s, {"a": a, "b": b},
+                           [ref(a, b).astype(np.float32)], rtol=1e-4,
+                           atol=1e-5)
+    check_numeric_gradient(s, {"a": a, "b": b}, rtol=0.05, atol=1e-3)
+
+
+@pytest.mark.parametrize("op,ref", BROADCAST_CMP,
+                         ids=[b[0] for b in BROADCAST_CMP])
+def test_binary_broadcast_compare(op, ref):
+    a = RS.randint(0, 3, (2, 3, 4)).astype(np.float32)
+    b = RS.randint(0, 3, (1, 3, 1)).astype(np.float32)
+    s = getattr(sym, op)(sym.Variable("a"), sym.Variable("b"))
+    check_symbolic_forward(s, {"a": a, "b": b},
+                           [ref(a, b).astype(np.float32)], rtol=1e-6)
+
+
+def test_scalar_ops_via_operators():
+    x = _pos((3, 4))
+    cases = [
+        (sym.Variable("x") + 2.5, x + 2.5),
+        (sym.Variable("x") - 1.5, x - 1.5),
+        (2.0 - sym.Variable("x"), 2.0 - x),
+        (sym.Variable("x") * 3.0, x * 3.0),
+        (sym.Variable("x") / 2.0, x / 2.0),
+        (6.0 / sym.Variable("x"), 6.0 / x),
+        (sym.Variable("x") ** 2.0, x ** 2.0),
+        (sym.maximum(sym.Variable("x"), 1.0), np.maximum(x, 1.0)),
+        (sym.minimum(sym.Variable("x"), 1.0), np.minimum(x, 1.0)),
+    ]
+    for s, want in cases:
+        check_symbolic_forward(s, {"x": x}, [want], rtol=1e-5)
+        check_numeric_gradient(s, {"x": x}, rtol=0.05, atol=1e-3)
+
+
+def test_misc_elemwise():
+    a, b = _pos((3, 4)), _pos((3, 4))
+    s = sym.hypot(sym.Variable("a"), sym.Variable("b"))
+    check_symbolic_forward(s, {"a": a, "b": b}, [np.hypot(a, b)], rtol=1e-5)
+    check_numeric_gradient(s, {"a": a, "b": b}, rtol=0.05, atol=1e-3)
+    x = RS.randn(3, 4).astype(np.float32)
+    s = sym.smooth_l1(sym.Variable("x"), scalar=1.0)
+    want = np.where(np.abs(x) < 1.0, 0.5 * x * x, np.abs(x) - 0.5)
+    check_symbolic_forward(s, {"x": x}, [want], rtol=1e-5)
+    check_numeric_gradient(s, {"x": x}, rtol=0.05, atol=1e-3)
+
+
+# ---------------------------------------------------------------------------
+# reductions
+# ---------------------------------------------------------------------------
+RED = [
+    ("sum", np.sum, True), ("mean", np.mean, True),
+    ("prod", np.prod, True), ("nansum", np.nansum, True),
+    ("nanprod", np.nanprod, True),
+    ("max", np.max, True), ("min", np.min, True),
+]
+
+
+@pytest.mark.parametrize("op,ref,diff", RED, ids=[r[0] for r in RED])
+@pytest.mark.parametrize("axis", [None, 0, 1, (0, 2)])
+def test_reduction(op, ref, diff, axis):
+    x = _pos((2, 3, 4))
+    kw = {} if axis is None else {"axis": axis}
+    s = _sym1(op, **kw)
+    want = ref(x) if axis is None else ref(x, axis=axis)
+    check_symbolic_forward(s, {"x": x}, [np.asarray(want, np.float32)],
+                           rtol=1e-4, atol=1e-5)
+    if diff:
+        check_numeric_gradient(s, {"x": x}, rtol=0.05, atol=1e-3)
+
+
+def test_argmax_argmin_norm():
+    x = RS.randn(3, 5).astype(np.float32)
+    check_symbolic_forward(_sym1("argmax", axis=1), {"x": x},
+                           [np.argmax(x, 1).astype(np.float32)])
+    check_symbolic_forward(_sym1("argmin", axis=1), {"x": x},
+                           [np.argmin(x, 1).astype(np.float32)])
+    check_symbolic_forward(_sym1("argmax_channel"), {"x": x},
+                           [np.argmax(x, 1).astype(np.float32)])
+    check_symbolic_forward(_sym1("norm"), {"x": x},
+                           [np.asarray(np.sqrt((x * x).sum()), np.float32)],
+                           rtol=1e-4)
+    check_numeric_gradient(_sym1("norm"), {"x": x}, rtol=0.05, atol=1e-3)
+
+
+def test_broadcast_axis_and_to():
+    x = _pos((1, 3, 1))
+    s = _sym1("broadcast_axis", axis=(0, 2), size=(2, 4))
+    check_symbolic_forward(s, {"x": x}, [np.broadcast_to(x, (2, 3, 4))])
+    check_numeric_gradient(s, {"x": x}, rtol=0.05, atol=1e-3)
+    s = _sym1("broadcast_to", shape=(2, 3, 4))
+    check_symbolic_forward(s, {"x": x}, [np.broadcast_to(x, (2, 3, 4))])
+
+
+def test_add_n():
+    arrs = {ch: _pos((2, 3)) for ch in "abc"}
+    s = sym.add_n(*[sym.Variable(c) for c in "abc"])
+    check_symbolic_forward(s, arrs, [arrs["a"] + arrs["b"] + arrs["c"]])
+    check_numeric_gradient(s, arrs, rtol=0.05, atol=1e-3)
+
+
+# ---------------------------------------------------------------------------
+# matrix / shape ops
+# ---------------------------------------------------------------------------
+def test_dot_variants():
+    a, b = _pos((3, 4)), _pos((4, 5))
+    s = sym.dot(sym.Variable("a"), sym.Variable("b"))
+    check_symbolic_forward(s, {"a": a, "b": b}, [a @ b], rtol=1e-4)
+    check_numeric_gradient(s, {"a": a, "b": b}, rtol=0.05, atol=1e-3)
+    s = sym.dot(sym.Variable("a"), sym.Variable("b"), transpose_a=True)
+    check_symbolic_forward(s, {"a": _pos((4, 3)), "b": b},
+                           [_pos((4, 3)).T @ b], rtol=1e-4) \
+        if False else None  # transpose_a checked against fresh draw below
+    a2 = _pos((4, 3))
+    check_symbolic_forward(s, {"a": a2, "b": b}, [a2.T @ b], rtol=1e-4)
+    bt = _pos((2, 3, 4)), _pos((2, 4, 5))
+    s = sym.batch_dot(sym.Variable("a"), sym.Variable("b"))
+    check_symbolic_forward(s, {"a": bt[0], "b": bt[1]},
+                           [np.matmul(bt[0], bt[1])], rtol=1e-4)
+    check_numeric_gradient(s, {"a": bt[0], "b": bt[1]}, rtol=0.05,
+                           atol=1e-3)
+
+
+SHAPE_OPS = [
+    ("transpose", {"axes": (1, 0, 2)},
+     lambda x: x.transpose(1, 0, 2), (2, 3, 4), True),
+    ("expand_dims", {"axis": 1}, lambda x: x[:, None], (3, 4), True),
+    ("Flatten", {}, lambda x: x.reshape(2, -1), (2, 3, 4), True),
+    ("Reshape", {"shape": (4, 6)}, lambda x: x.reshape(4, 6), (2, 3, 4),
+     True),
+    ("slice", {"begin": (0, 1), "end": (2, 3)}, lambda x: x[0:2, 1:3],
+     (3, 4), True),
+    ("slice_axis", {"axis": 1, "begin": 1, "end": 3}, lambda x: x[:, 1:3],
+     (3, 4), True),
+    ("clip", {"a_min": 0.8, "a_max": 1.5}, lambda x: np.clip(x, 0.8, 1.5),
+     (3, 4), True),
+    ("repeat", {"repeats": 2, "axis": 1}, lambda x: np.repeat(x, 2, 1),
+     (2, 3), True),
+    ("tile", {"reps": (2, 2)}, lambda x: np.tile(x, (2, 2)), (2, 3), True),
+    ("reverse", {"axis": 1}, lambda x: x[:, ::-1], (2, 4), True),
+    ("flip", {"axis": 1}, lambda x: x[:, ::-1], (2, 4), True),
+    ("SwapAxis", {"dim1": 0, "dim2": 2}, lambda x: x.swapaxes(0, 2),
+     (2, 3, 4), True),
+    ("Cast", {"dtype": "float64"}, lambda x: x.astype(np.float64), (3, 4),
+     False),
+    ("BlockGrad", {}, lambda x: x, (3, 4), False),
+    ("_copy", {}, lambda x: x, (3, 4), True),
+]
+
+
+@pytest.mark.parametrize("op,attrs,ref,shape,diff", SHAPE_OPS,
+                         ids=[s[0] for s in SHAPE_OPS])
+def test_shape_op(op, attrs, ref, shape, diff):
+    x = _pos(shape)
+    s = _sym1(op, **attrs)
+    check_symbolic_forward(s, {"x": x}, [ref(x)], rtol=1e-5)
+    if diff:
+        check_numeric_gradient(s, {"x": x}, rtol=0.05, atol=1e-3)
+
+
+def test_concat_and_slice_channel():
+    a, b = _pos((2, 3)), _pos((2, 2))
+    s = sym.Concat(sym.Variable("a"), sym.Variable("b"), dim=1)
+    check_symbolic_forward(s, {"a": a, "b": b},
+                           [np.concatenate([a, b], 1)])
+    check_numeric_gradient(s, {"a": a, "b": b}, rtol=0.05, atol=1e-3)
+    x = _pos((2, 6))
+    s = sym.SliceChannel(sym.Variable("x"), num_outputs=3, axis=1)
+    check_symbolic_forward(s, {"x": x},
+                           [x[:, 0:2], x[:, 2:4], x[:, 4:6]])
+
+
+def test_where_and_pick():
+    c = RS.randint(0, 2, (3, 4)).astype(np.float32)
+    a, b = _pos((3, 4)), _pos((3, 4))
+    s = sym.where(sym.Variable("c"), sym.Variable("a"), sym.Variable("b"))
+    check_symbolic_forward(s, {"c": c, "a": a, "b": b},
+                           [np.where(c != 0, a, b)])
+    idx = RS.randint(0, 4, (3,)).astype(np.float32)
+    s = sym.pick(sym.Variable("x"), sym.Variable("i"), axis=1)
+    x = _pos((3, 4))
+    check_symbolic_forward(s, {"x": x, "i": idx},
+                           [x[np.arange(3), idx.astype(int)]])
+
+
+def test_indexing_family():
+    w = _pos((6, 4))
+    idx = np.array([0, 3, 5], np.float32)
+    s = sym.take(sym.Variable("x"), sym.Variable("i"))
+    check_symbolic_forward(s, {"x": w, "i": idx}, [w[idx.astype(int)]])
+    check_numeric_gradient(s, {"x": w, "i": idx}, grad_nodes=["x"],
+                           rtol=0.05, atol=1e-3)
+    # batch_take: per-row index
+    x = _pos((3, 4))
+    bi = np.array([1, 0, 3], np.float32)
+    s = sym.batch_take(sym.Variable("x"), sym.Variable("i"))
+    check_symbolic_forward(s, {"x": x, "i": bi},
+                           [x[np.arange(3), bi.astype(int)]])
+    s = sym.one_hot(sym.Variable("i"), depth=5)
+    check_symbolic_forward(s, {"i": np.array([1, 4, 0], np.float32)},
+                           [np.eye(5, dtype=np.float32)[[1, 4, 0]]])
+    emb = sym.Embedding(sym.Variable("i"), sym.Variable("w"),
+                        input_dim=6, output_dim=4)
+    check_symbolic_forward(emb, {"i": idx, "w": w}, [w[idx.astype(int)]])
+    check_numeric_gradient(emb, {"i": idx, "w": w}, grad_nodes=["w"],
+                           rtol=0.05, atol=1e-3)
+
+
+def test_ordering_family():
+    x = RS.randn(3, 6).astype(np.float32)
+    s = sym.topk(sym.Variable("x"), k=2, axis=1, ret_typ="value")
+    want = -np.sort(-x, axis=1)[:, :2]
+    check_symbolic_forward(s, {"x": x}, [want])
+    s = sym.sort(sym.Variable("x"), axis=1)
+    check_symbolic_forward(s, {"x": x}, [np.sort(x, 1)])
+    s = sym.argsort(sym.Variable("x"), axis=1)
+    check_symbolic_forward(s, {"x": x},
+                           [np.argsort(x, 1).astype(np.float32)])
+
+
+# ---------------------------------------------------------------------------
+# NN layers — gradient checks
+# ---------------------------------------------------------------------------
+def test_fully_connected_grad():
+    loc = {"x": _pos((4, 6)), "w": _pos((3, 6)), "b": _pos((3,))}
+    s = sym.FullyConnected(sym.Variable("x"), sym.Variable("w"),
+                           sym.Variable("b"), num_hidden=3)
+    check_symbolic_forward(s, loc, [loc["x"] @ loc["w"].T + loc["b"]],
+                           rtol=1e-4)
+    check_numeric_gradient(s, loc, rtol=0.05, atol=1e-3)
+
+
+@pytest.mark.parametrize("nd_", [1, 2, 3])
+def test_convolution_grad_nd(nd_):
+    spatial = {1: (7,), 2: (6, 7), 3: (4, 5, 6)}[nd_]
+    kern = {1: (3,), 2: (3, 3), 3: (2, 2, 2)}[nd_]
+    loc = {"x": _pos((2, 3) + spatial) * 0.5,
+           "w": _pos((4, 3) + kern) * 0.5, "b": _pos((4,)) * 0.5}
+    s = sym.Convolution(sym.Variable("x"), sym.Variable("w"),
+                        sym.Variable("b"), kernel=kern, num_filter=4,
+                        pad=tuple(1 for _ in kern))
+    check_numeric_gradient(s, loc, rtol=0.05, atol=5e-3)
+
+
+def test_convolution_stride_dilate_groups():
+    loc = {"x": _pos((2, 4, 8, 8)) * 0.5, "w": _pos((4, 2, 3, 3)) * 0.5,
+           "b": _pos((4,)) * 0.5}
+    s = sym.Convolution(sym.Variable("x"), sym.Variable("w"),
+                        sym.Variable("b"), kernel=(3, 3), num_filter=4,
+                        stride=(2, 2), dilate=(2, 2), pad=(2, 2),
+                        num_group=2)
+    check_numeric_gradient(s, loc, rtol=0.05, atol=5e-3)
+
+
+def test_deconvolution_grad():
+    loc = {"x": _pos((2, 3, 5, 5)) * 0.5, "w": _pos((3, 4, 3, 3)) * 0.5}
+    s = sym.Deconvolution(sym.Variable("x"), sym.Variable("w"),
+                          kernel=(3, 3), num_filter=4, no_bias=True,
+                          stride=(2, 2), pad=(1, 1), adj=(1, 1))
+    check_numeric_gradient(s, loc, rtol=0.05, atol=5e-3)
+
+
+@pytest.mark.parametrize("pool_type", ["max", "avg", "sum"])
+def test_pooling_grad(pool_type):
+    x = _pos((2, 2, 6, 6))
+    s = sym.Pooling(sym.Variable("x"), kernel=(2, 2), stride=(2, 2),
+                    pool_type=pool_type)
+    check_numeric_gradient(s, {"x": x}, rtol=0.05, atol=5e-3)
+
+
+def test_global_pooling():
+    x = _pos((2, 3, 5, 5))
+    s = sym.Pooling(sym.Variable("x"), kernel=(1, 1), global_pool=True,
+                    pool_type="avg")
+    check_symbolic_forward(s, {"x": x},
+                           [x.mean(axis=(2, 3), keepdims=True)], rtol=1e-4)
+    check_numeric_gradient(s, {"x": x}, rtol=0.05, atol=1e-3)
+
+
+def test_batchnorm_grad():
+    """x-grad vs the analytic BN backward (the ones-cotangent numeric
+    check is degenerate: sum(out) is invariant in x, so the true x-grad
+    is exactly 0 and finite differences see only f32 noise); gamma/beta
+    still get the numeric check."""
+    x, g = _pos((4, 3, 5, 5)), _pos((3,))
+    b = _pos((3,))
+    eps = 1e-3
+    aux = {"moving_mean": mx.nd.zeros((3,)),
+           "moving_var": mx.nd.ones((3,))}
+    s = sym.BatchNorm(sym.Variable("x"), sym.Variable("g"),
+                      sym.Variable("b"), fix_gamma=False, eps=eps)
+    # beta's numeric check is well-posed (grad = count); gamma shares x's
+    # degeneracy (sum(xhat) = 0), so it joins the analytic check below
+    check_numeric_gradient(s, {"x": x, "g": g, "b": b}, aux_states=aux,
+                           grad_nodes=["b"], rtol=0.08, atol=5e-3)
+    # analytic backward, random cotangent
+    dy = RS.randn(4, 3, 5, 5).astype(np.float32)
+    ex = s.bind(mx.cpu(), {"x": mx.nd.array(x), "g": mx.nd.array(g),
+                           "b": mx.nd.array(b)},
+                args_grad={"x": mx.nd.zeros(x.shape),
+                           "g": mx.nd.zeros(g.shape),
+                           "b": mx.nd.zeros(b.shape)},
+                grad_req="write",
+                aux_states={k: v.copy() for k, v in aux.items()})
+    ex.forward(is_train=True)
+    ex.backward([mx.nd.array(dy)])
+    m = x.mean(axis=(0, 2, 3), keepdims=True)
+    v = x.var(axis=(0, 2, 3), keepdims=True)
+    s_ = np.sqrt(v + eps)
+    xhat = (x - m) / s_
+    gd = g.reshape(1, 3, 1, 1)
+    want_x = (gd / s_) * (dy - dy.mean(axis=(0, 2, 3), keepdims=True)
+                          - xhat * (dy * xhat).mean(axis=(0, 2, 3),
+                                                    keepdims=True))
+    assert_almost_equal(ex.grad_dict["x"].asnumpy(), want_x,
+                        rtol=1e-3, atol=1e-4)
+    assert_almost_equal(ex.grad_dict["g"].asnumpy(),
+                        (dy * xhat).sum(axis=(0, 2, 3)), rtol=1e-3,
+                        atol=1e-3)
+    assert_almost_equal(ex.grad_dict["b"].asnumpy(),
+                        dy.sum(axis=(0, 2, 3)), rtol=1e-3, atol=1e-3)
+
+
+@pytest.mark.parametrize("act", ["relu", "sigmoid", "tanh", "softrelu"])
+def test_activation_grad(act):
+    x = RS.randn(3, 4).astype(np.float32) + 0.05
+    s = sym.Activation(sym.Variable("x"), act_type=act)
+    check_numeric_gradient(s, {"x": x}, rtol=0.05, atol=1e-3)
+
+
+@pytest.mark.parametrize("act", ["leaky", "elu", "prelu"])
+def test_leaky_relu_grad(act):
+    loc = {"x": RS.randn(3, 4).astype(np.float32) + 0.05}
+    kw = {}
+    if act == "prelu":
+        loc["gamma"] = _pos((4,)) * 0.2
+        s = sym.LeakyReLU(sym.Variable("x"), sym.Variable("gamma"),
+                          act_type=act)
+    else:
+        s = sym.LeakyReLU(sym.Variable("x"), act_type=act, **kw)
+    check_numeric_gradient(s, loc, rtol=0.05, atol=1e-3)
+
+
+def test_softmax_family():
+    x = RS.randn(4, 5).astype(np.float32)
+
+    def np_softmax(v, ax=-1):
+        e = np.exp(v - v.max(axis=ax, keepdims=True))
+        return e / e.sum(axis=ax, keepdims=True)
+
+    s = sym.softmax(sym.Variable("x"))
+    check_symbolic_forward(s, {"x": x}, [np_softmax(x)], rtol=1e-4)
+    check_numeric_gradient(s, {"x": x}, rtol=0.05, atol=1e-3)
+    s = sym.SoftmaxActivation(sym.Variable("x"))
+    check_symbolic_forward(s, {"x": x}, [np_softmax(x)], rtol=1e-4)
+    s = sym.log_softmax(sym.Variable("x")) \
+        if hasattr(sym, "log_softmax") else None
+    lab = RS.randint(0, 5, (4,)).astype(np.float32)
+    s = sym.softmax_cross_entropy(sym.Variable("x"), sym.Variable("y"))
+    want = -np.log(np_softmax(x)[np.arange(4), lab.astype(int)]).sum()
+    check_symbolic_forward(s, {"x": x, "y": lab},
+                           [np.asarray(want, np.float32)], rtol=1e-4)
+
+
+def test_lrn_instancenorm_l2norm_grads():
+    x = _pos((2, 4, 5, 5))
+    s = sym.LRN(sym.Variable("x"), nsize=3)
+    check_numeric_gradient(s, {"x": x}, rtol=0.08, atol=5e-3)
+    # InstanceNorm x-grad has the same sum-invariance degeneracy as BN —
+    # numeric-check the affine params only
+    loc = {"x": _pos((2, 3, 4, 4)), "g": _pos((3,)), "b": _pos((3,))}
+    s = sym.InstanceNorm(sym.Variable("x"), sym.Variable("g"),
+                         sym.Variable("b"))
+    check_numeric_gradient(s, loc, grad_nodes=["g", "b"], rtol=0.08,
+                           atol=5e-3)
+    x2 = _pos((3, 6))
+    s = sym.L2Normalization(sym.Variable("x"))
+    check_symbolic_forward(
+        s, {"x": x2},
+        [x2 / np.sqrt((x2 * x2).sum(1, keepdims=True) + 1e-10)],
+        rtol=1e-4)
+    check_numeric_gradient(s, {"x": x2}, rtol=0.05, atol=1e-3)
+
+
+def test_pad_crop_upsample_grads():
+    x = _pos((2, 2, 4, 4))
+    s = sym.Pad(sym.Variable("x"), mode="constant",
+                pad_width=(0, 0, 0, 0, 1, 1, 2, 2))
+    want = np.pad(x, ((0, 0), (0, 0), (1, 1), (2, 2)))
+    check_symbolic_forward(s, {"x": x}, [want])
+    check_numeric_gradient(s, {"x": x}, rtol=0.05, atol=1e-3)
+    s = sym.Crop(sym.Variable("x"), offset=(1, 1), h_w=(2, 2),
+                 num_args=1)
+    check_symbolic_forward(s, {"x": x}, [x[:, :, 1:3, 1:3]])
+    s = sym.UpSampling(sym.Variable("x"), scale=2, sample_type="nearest",
+                       num_args=1)
+    want = x.repeat(2, axis=2).repeat(2, axis=3)
+    check_symbolic_forward(s, {"x": x}, [want])
+    check_numeric_gradient(s, {"x": x}, rtol=0.05, atol=1e-3)
+
+
+def test_dropout_modes():
+    x = _pos((50, 40))
+    s = sym.Dropout(sym.Variable("x"), p=0.5)
+    # eval mode: identity
+    check_symbolic_forward(s, {"x": x}, [x])
+    # train mode: ~half zeros, scaled
+    ex = s.simple_bind(mx.cpu(), x=(50, 40))
+    ex.arg_dict["x"][:] = x
+    out = ex.forward(is_train=True)[0].asnumpy()
+    frac = (out == 0).mean()
+    assert 0.3 < frac < 0.7, frac
+    nz = out != 0
+    assert_almost_equal(out[nz], (x * 2.0)[nz], rtol=1e-5)
+
+
+def test_sequence_ops():
+    x = _pos((4, 3, 2))  # (seq, batch, feat)
+    ln = np.array([2, 4, 1], np.float32)
+    s = sym.SequenceLast(sym.Variable("x"), sym.Variable("l"),
+                         use_sequence_length=True)
+    want = np.stack([x[1, 0], x[3, 1], x[0, 2]])
+    check_symbolic_forward(s, {"x": x, "l": ln}, [want])
+    s = sym.SequenceMask(sym.Variable("x"), sym.Variable("l"),
+                         use_sequence_length=True, value=0.0)
+    want = x.copy()
+    want[2:, 0] = 0
+    want[1:, 2] = 0
+    check_symbolic_forward(s, {"x": x, "l": ln}, [want])
+    s = sym.SequenceReverse(sym.Variable("x"), sym.Variable("l"),
+                            use_sequence_length=True)
+    want = x.copy()
+    want[:2, 0] = x[:2, 0][::-1]
+    want[:4, 1] = x[:4, 1][::-1]
+    check_symbolic_forward(s, {"x": x, "l": ln}, [want])
+
+
+def test_regression_outputs_and_losses():
+    x = _pos((4, 3))
+    y = _pos((4, 3))
+
+    s = sym.LinearRegressionOutput(sym.Variable("x"), sym.Variable("y"))
+    check_symbolic_forward(s, {"x": x, "y": y}, [x])
+    s = sym.MAERegressionOutput(sym.Variable("x"), sym.Variable("y"))
+    check_symbolic_forward(s, {"x": x, "y": y}, [x])
+    s = sym.LogisticRegressionOutput(sym.Variable("x"), sym.Variable("y"))
+    check_symbolic_forward(s, {"x": x, "y": y},
+                           [1.0 / (1.0 + np.exp(-x))], rtol=1e-5)
+    s = sym.MakeLoss(sym.square(sym.Variable("x")))
+    check_symbolic_forward(s, {"x": x}, [np.square(x)])
+    check_numeric_gradient(s, {"x": x}, rtol=0.05, atol=1e-3)
+
+
+def test_spatial_ops_forward():
+    x = _pos((1, 1, 4, 4))
+    rois = np.array([[0, 0, 0, 3, 3]], np.float32)
+    s = sym.ROIPooling(sym.Variable("x"), sym.Variable("r"),
+                       pooled_size=(2, 2), spatial_scale=1.0)
+    out = check_symbolic_forward.__wrapped__ if False else None
+    ex = s.bind(mx.cpu(), {"x": mx.nd.array(x), "r": mx.nd.array(rois)})
+    o = ex.forward()[0].asnumpy()
+    assert o.shape == (1, 1, 2, 2)
+    assert o.max() <= x.max() + 1e-6
+    # GridGenerator + BilinearSampler: identity affine ~ identity image
+    aff = np.array([[1, 0, 0, 0, 1, 0]], np.float32)
+    g = sym.GridGenerator(sym.Variable("a"), transform_type="affine",
+                          target_shape=(4, 4))
+    bs = sym.BilinearSampler(sym.Variable("x"), g)
+    ex = bs.bind(mx.cpu(), {"x": mx.nd.array(x), "a": mx.nd.array(aff)})
+    o = ex.forward()[0].asnumpy()
+    assert_almost_equal(o, x, rtol=1e-4, atol=1e-4)
+
+
+def test_svm_output_and_identity_attach():
+    x = RS.randn(4, 3).astype(np.float32)
+    y = RS.randint(0, 3, (4,)).astype(np.float32)
+    s = sym.SVMOutput(sym.Variable("x"), sym.Variable("y"))
+    check_symbolic_forward(s, {"x": x, "y": y}, [x])
+    s = sym.IdentityAttachKLSparseReg(sym.Variable("x"))
+    check_symbolic_forward(s, {"x": x}, [x])
+
+
+def test_init_and_sampling_ops():
+    z = mx.nd.zeros((2, 3))
+    assert (z.asnumpy() == 0).all()
+    o = mx.nd.ones((2, 3))
+    assert (o.asnumpy() == 1).all()
+    ar = mx.nd.arange(0, 10, 2)
+    np.testing.assert_allclose(ar.asnumpy(), np.arange(0, 10, 2))
+    ol = mx.nd.ones_like(z)
+    assert (ol.asnumpy() == 1).all()
+    u = mx.nd.uniform(0, 1, shape=(100,))
+    assert 0 <= u.asnumpy().min() and u.asnumpy().max() <= 1
+    n = mx.nd.normal(0, 1, shape=(500,))
+    assert abs(float(n.asnumpy().mean())) < 0.3
+
+
+def test_fused_optimizer_ops():
+    w = _pos((4, 3))
+    g = _pos((4, 3))
+    out = mx.nd.sgd_update(mx.nd.array(w), mx.nd.array(g), lr=0.1, wd=0.01)
+    want = w - 0.1 * (g + 0.01 * w)
+    assert_almost_equal(out, want, rtol=1e-5)
+    m = np.zeros_like(w)
+    wn = mx.nd.array(w)
+    mn = mx.nd.array(m)
+    out = mx.nd.sgd_mom_update(wn, mx.nd.array(g), mn, lr=0.1,
+                               momentum=0.9, wd=0.01)
+    new_w = out[0] if isinstance(out, list) else out
+    want = w - 0.1 * (g + 0.01 * w)  # first step: mom starts at 0
+    assert_almost_equal(new_w, want, rtol=1e-4)
+    # adam_update smoke vs numpy single step
+    m0 = np.zeros_like(w)
+    v0 = np.zeros_like(w)
+    out = mx.nd.adam_update(mx.nd.array(w), mx.nd.array(g),
+                            mx.nd.array(m0), mx.nd.array(v0), lr=0.1,
+                            beta1=0.9, beta2=0.999, epsilon=1e-8, wd=0.0)
+    new_w = out[0] if isinstance(out, list) else out
+    mt = 0.1 * g
+    vt = 0.001 * g * g
+    want = w - 0.1 * mt / (np.sqrt(vt) + 1e-8)
+    assert_almost_equal(new_w, want, rtol=1e-3, atol=1e-4)

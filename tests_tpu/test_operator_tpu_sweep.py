@@ -1,0 +1,106 @@
+"""CPU-vs-TPU parity sweep over the op census.
+
+Reference: ``tests/python/gpu/test_operator_gpu.py`` re-runs the whole CPU
+op suite cross-backend via ``check_consistency`` (``test_utils.py:677``).
+This module re-runs ``tests/test_operator_sweep.py``'s case tables on
+``[mx.cpu(), mx.tpu()]`` — outputs AND gradients must agree within bf16-pass
+tolerances."""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tests"))
+
+import mxnet_tpu as mx
+from mxnet_tpu import sym
+from mxnet_tpu.test_utils import check_consistency
+
+from test_operator_sweep import (BINARY, BROADCAST, RED, SHAPE_OPS, UNARY,
+                                 _NONDIFF, _unary_input)
+
+RTOL, ATOL = 2e-2, 2e-2
+
+
+def _ctx_list(**shapes):
+    return [dict(ctx=mx.cpu(), **shapes), dict(ctx=mx.tpu(), **shapes)]
+
+
+@pytest.mark.parametrize("op,ref,mode", UNARY, ids=[u[0] for u in UNARY])
+def test_unary_parity(op, ref, mode):
+    del ref
+    x = _unary_input(mode)
+    s = getattr(sym, op)(sym.Variable("x"))
+    check_consistency(s, _ctx_list(x=x.shape), rtol=RTOL, atol=ATOL,
+                      arg_params={"x": x})
+
+
+@pytest.mark.parametrize("op,ref", BINARY + BROADCAST,
+                         ids=[b[0] for b in BINARY + BROADCAST])
+def test_binary_parity(op, ref):
+    del ref
+    rs = np.random.RandomState(11)
+    if op.startswith("broadcast_"):
+        sa, sb = (2, 3, 4), (1, 3, 1)
+    else:
+        sa = sb = (3, 4)
+    a = (rs.rand(*sa) * 1.5 + 0.5).astype(np.float32)
+    b = (rs.rand(*sb) * 1.5 + 0.5).astype(np.float32)
+    s = getattr(sym, op)(sym.Variable("a"), sym.Variable("b"))
+    check_consistency(s, _ctx_list(a=sa, b=sb), rtol=RTOL, atol=ATOL,
+                      arg_params={"a": a, "b": b})
+
+
+@pytest.mark.parametrize("op,ref,diff", RED, ids=[r[0] for r in RED])
+def test_reduction_parity(op, ref, diff):
+    del ref, diff
+    rs = np.random.RandomState(5)
+    x = (rs.rand(2, 3, 4) * 1.5 + 0.5).astype(np.float32)
+    s = getattr(sym, op)(sym.Variable("x"), axis=1)
+    check_consistency(s, _ctx_list(x=(2, 3, 4)), rtol=RTOL, atol=ATOL,
+                      arg_params={"x": x})
+
+
+@pytest.mark.parametrize("op,attrs,ref,shape,diff", SHAPE_OPS,
+                         ids=[s[0] for s in SHAPE_OPS])
+def test_shape_op_parity(op, attrs, ref, shape, diff):
+    del ref, diff
+    if op == "Cast":
+        pytest.skip("dtype-changing op; parity covered by forward checks")
+    s = getattr(sym, op)(sym.Variable("x"), **attrs)
+    check_consistency(s, _ctx_list(x=shape), rtol=RTOL, atol=ATOL)
+
+
+def test_conv_block_parity():
+    data = sym.Variable("data")
+    net = sym.Convolution(data, num_filter=8, kernel=(3, 3), pad=(1, 1),
+                          stride=(2, 2), num_group=2)
+    net = sym.BatchNorm(net, fix_gamma=False)
+    net = sym.LeakyReLU(net, act_type="leaky")
+    net = sym.Pooling(net, kernel=(2, 2), stride=(2, 2), pool_type="avg")
+    net = sym.Flatten(net)
+    net = sym.FullyConnected(net, num_hidden=4)
+    check_consistency(net, _ctx_list(data=(2, 4, 8, 8)), scale=0.3,
+                      rtol=RTOL, atol=ATOL)
+
+
+def test_deconv_upsample_pad_parity():
+    data = sym.Variable("data")
+    net = sym.Deconvolution(data, num_filter=4, kernel=(3, 3),
+                            stride=(2, 2), pad=(1, 1), no_bias=True)
+    net = sym.Pad(net, mode="constant", pad_width=(0, 0, 0, 0, 1, 1, 1, 1))
+    net = sym.UpSampling(net, scale=2, sample_type="nearest", num_args=1)
+    check_consistency(net, _ctx_list(data=(1, 3, 5, 5)), scale=0.3,
+                      rtol=RTOL, atol=ATOL)
+
+
+def test_embedding_take_parity():
+    idx = np.array([0, 2, 1], np.float32)
+    w = np.random.RandomState(2).rand(4, 5).astype(np.float32)
+    s = sym.Embedding(sym.Variable("i"), sym.Variable("w"), input_dim=4,
+                      output_dim=5)
+    check_consistency(s, _ctx_list(i=(3,), w=(4, 5)), rtol=RTOL, atol=ATOL,
+                      arg_params={"i": idx, "w": w})
