@@ -50,8 +50,25 @@ class Module(BaseModule):
 
     def __init__(self, symbol, data_names=("data",), label_names=("softmax_label",),
                  logger=logging, context=None, work_load_list=None,
-                 fixed_param_names=None):
+                 fixed_param_names=None, shard_rules=None):
         super().__init__(logger=logger)
+        # context may be a jax.sharding.Mesh: Module.fit then runs the
+        # whole dp(×tp×…) strategy through THIS surface — shard_rules
+        # ([(param-name regex, PartitionSpec), ...]) places chosen
+        # parameters over model axes and XLA inserts the implied
+        # collectives (SURVEY §7.9 north star: `Module.fit` on a mesh)
+        self._user_mesh = None
+        from jax.sharding import Mesh as _JaxMesh
+
+        if isinstance(context, _JaxMesh):
+            self._user_mesh = context
+            dev0 = context.devices.flat[0]
+            context = [Context("cpu" if dev0.platform == "cpu" else "tpu",
+                               0)]
+        import re as _re
+
+        self._shard_rules = [(_re.compile(p), spec)
+                             for p, spec in (shard_rules or [])]
         if context is None:
             from ..context import current_context
 
@@ -131,15 +148,33 @@ class Module(BaseModule):
             raise MXNetError("duplicate devices in context list")
         return Mesh(np.array(devices), ("data",))
 
-    def _shard(self, arr, batch_axis):
+    def _batch_axis_name(self):
+        """Mesh axis that shards the batch: 'data' when present, else the
+        first axis."""
+        names = self._mesh.axis_names
+        return "data" if "data" in names else names[0]
+
+    def _param_spec(self, name):
+        from jax.sharding import PartitionSpec as P
+
+        for prog, spec in self._shard_rules:
+            if name is not None and prog.match(name):
+                return spec
+        return P()
+
+    def _shard(self, arr, batch_axis, name=None):
         """Place an NDArray globally over the module mesh.
 
-        Multi-process (dist in-graph) mode: non-batch arrays are
-        broadcast from rank 0 (the reference's Init broadcast,
-        ``kvstore_dist.h:58-76``) and replicated over the GLOBAL mesh;
-        batch arrays are left for per-step ``_load_io`` sharding."""
+        Batch arrays shard over the batch axis; parameters follow their
+        ``shard_rules`` spec (replicated by default — tensor parallelism
+        is a rule away).  Multi-process (dist in-graph) mode additionally
+        broadcasts non-batch arrays from rank 0 (the reference's Init
+        broadcast, ``kvstore_dist.h:58-76``)."""
         if self._mesh is None:
             return
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
         if self._dist_dp:
             from .. import dist as _dist
 
@@ -148,12 +183,10 @@ class Module(BaseModule):
             arr._jx = _dist.replicate(
                 self._mesh, _dist.broadcast_from_root(np.asarray(arr._jx)))
             return
-        if len(self._context) == 1:
+        if len(self._context) == 1 and self._user_mesh is None:
             return
-        import jax
-        from jax.sharding import NamedSharding, PartitionSpec as P
-
-        spec = P("data") if batch_axis else P()
+        spec = P(self._batch_axis_name()) if batch_axis \
+            else self._param_spec(name)
         arr._jx = jax.device_put(arr._jx, NamedSharding(self._mesh, spec))
 
     def bind(self, data_shapes, label_shapes=None, for_training=True,
@@ -181,7 +214,17 @@ class Module(BaseModule):
             if label_shapes else []
         from .. import dist as _dist
 
-        if _dist.is_initialized() and len(self._context) == 1:
+        if self._user_mesh is not None:
+            # explicit mesh: dp over the batch axis + whatever the
+            # shard_rules place on the other axes
+            self._mesh = self._user_mesh
+            nbatch = self._mesh.shape[self._batch_axis_name()]
+            for _, s in self._data_shapes + self._label_shapes:
+                if s and s[0] % nbatch != 0:
+                    raise MXNetError(
+                        "batch size %d not divisible by mesh %r axis "
+                        "size %d" % (s[0], self._batch_axis_name(), nbatch))
+        elif _dist.is_initialized() and len(self._context) == 1:
             # TPU-native dist_sync: one jitted SPMD step over the GLOBAL
             # mesh; each process feeds its local batch shard and XLA
             # psums the gradients in-graph (SURVEY §5.8)
@@ -231,11 +274,11 @@ class Module(BaseModule):
             for n in self._symbol.list_arguments():
                 batch_axis = n in self._data_names or n in self._label_names
                 if self._exec.arg_dict.get(n) is not None:
-                    self._shard(self._exec.arg_dict[n], batch_axis)
+                    self._shard(self._exec.arg_dict[n], batch_axis, n)
                 if self._exec.grad_dict.get(n) is not None:
-                    self._shard(self._exec.grad_dict[n], batch_axis)
+                    self._shard(self._exec.grad_dict[n], batch_axis, n)
             for n in self._aux_names:
-                self._shard(self._exec.aux_dict[n], False)
+                self._shard(self._exec.aux_dict[n], False, n)
         self.binded = True
         if shared_module is not None and shared_module.params_initialized:
             self.params_initialized = True
@@ -277,9 +320,9 @@ class Module(BaseModule):
         # restore global sharding after host-side init writes
         if self._mesh is not None:
             for name in self._param_names:
-                self._shard(self._exec.arg_dict[name], False)
+                self._shard(self._exec.arg_dict[name], False, name)
             for name in self._aux_names:
-                self._shard(self._exec.aux_dict[name], False)
+                self._shard(self._exec.aux_dict[name], False, name)
         self.params_initialized = True
 
     # -- optimizer --------------------------------------------------------
@@ -490,7 +533,7 @@ class Module(BaseModule):
             if idx not in updater.states:
                 updater.states[idx] = optimizer.create_state(
                     idx, ex.arg_dict[names[idx]])
-            self._place_opt_state(idx, updater.states[idx])
+            self._place_opt_state(idx, updater.states[idx], names[idx])
             optimizer._update_count(idx)
         lrs, wds = self._get_hyper_arrays(optimizer, len(names))
         clip = optimizer.clip_gradient \
@@ -669,10 +712,12 @@ class Module(BaseModule):
             cached = self._fused_hyper_cache
         return cached[2], cached[3]
 
-    def _place_opt_state(self, idx, state):
+    def _place_opt_state(self, idx, state, name=None):
         """Optimizer state arrays (momentum etc.) join the module mesh —
         a locally-committed buffer cannot enter a jit whose other
-        arguments are mesh-placed (multihost jit rejects it outright)."""
+        arguments are mesh-placed (multihost jit rejects it outright).
+        States shard exactly like their parameter (a TP-sharded weight's
+        momentum shards with it)."""
         if state is None or self._mesh is None \
                 or idx in self._dist_placed_states:
             return state
@@ -682,10 +727,11 @@ class Module(BaseModule):
             state._jx = _dist.replicate(self._mesh, np.asarray(state._jx))
         else:
             import jax
-            from jax.sharding import NamedSharding, PartitionSpec as P
+            from jax.sharding import NamedSharding
 
-            state._jx = jax.device_put(state._jx,
-                                       NamedSharding(self._mesh, P()))
+            state._jx = jax.device_put(
+                state._jx, NamedSharding(self._mesh,
+                                         self._param_spec(name)))
         self._dist_placed_states.add(idx)
         return state
 
@@ -712,7 +758,7 @@ class Module(BaseModule):
                 if idx not in updater.states:
                     updater.states[idx] = optimizer.create_state(
                         idx, self._exec.arg_dict[n])
-                self._place_opt_state(idx, updater.states[idx])
+                self._place_opt_state(idx, updater.states[idx], n)
 
             from ..executor import sgd_step_math
 

@@ -1,5 +1,10 @@
 """SPMDTrainer — a fully-fused sharded training step over a device mesh.
 
+NOTE: the user-facing surface for dp×tp training is ``mx.mod.Module`` with
+``context=<jax Mesh>`` + ``shard_rules`` (reference users never see a second
+trainer class); SPMDTrainer remains as the low-level engine and for
+experiments that bypass the Module bookkeeping.
+
 One jitted function per (symbol, mesh, shardings): forward + backward +
 SGD-momentum update, with parameter/optimizer-state buffers donated.  This
 is the ``Module.fit`` hot path distilled to its TPU-native core: the
