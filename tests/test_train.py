@@ -93,3 +93,90 @@ def test_dtype_bf16_convergence(tmp_path):
     assert mod.get_outputs()[0]._jx.dtype == jnp.bfloat16
     acc = _final_acc(mod, val)
     assert acc > 0.85, acc
+
+
+def _train_lenet(tmp_path, dtype, epochs=2):
+    mx.random.seed(5)
+    np.random.seed(5)
+    train, val = _mnist_iters(tmp_path, 100, flat=False)
+    net = mx.models.get_symbol("lenet", num_classes=10)
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.bind(data_shapes=train.provide_data,
+             label_shapes=train.provide_label)
+    mod.init_params(mx.init.Xavier())
+    if dtype != "float32":
+        for n, a in mod._exec.arg_dict.items():
+            if n not in ("data", "softmax_label"):
+                a._jx = a._jx.astype(dtype)
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1,
+                                         "momentum": 0.9})
+    for _ in range(epochs):
+        train.reset()
+        for batch in train:
+            mod.forward_backward(batch)
+            mod.update()
+    return _final_acc(mod, val)
+
+
+def test_dtype_parity_lenet(tmp_path):
+    """reference train/test_dtype.py: low-precision training must reach
+    the SAME accuracy as f32 (guards the 'identical top-1' goal against
+    accumulation/numerics regressions — f32 matmul/conv accumulation)."""
+    acc32 = _train_lenet(tmp_path, "float32")
+    acc16 = _train_lenet(tmp_path, "bfloat16")
+    assert acc32 > 0.9, acc32
+    assert acc16 >= acc32 - 0.03, (acc16, acc32)
+
+
+def _synth_cifar(n=512, seed=0):
+    """Synthetic 3x28x28 'CIFAR': class = dominant color channel +
+    spatial quadrant signal, learnable by a small conv net."""
+    rs = np.random.RandomState(seed)
+    y = rs.randint(0, 4, n)
+    x = rs.rand(n, 3, 28, 28).astype(np.float32) * 0.4
+    for i, lab in enumerate(y):
+        ch = lab % 3
+        x[i, ch] += 0.4
+        if lab == 3:
+            x[i, :, :14, :14] += 0.5
+    return x, y.astype(np.float32)
+
+
+def _train_cifar_resnet(dtype, epochs=3):
+    mx.random.seed(9)
+    np.random.seed(9)
+    x, y = _synth_cifar()
+    train = mx.io.NDArrayIter(x[:448], y[:448], batch_size=64,
+                              shuffle=True)
+    val = mx.io.NDArrayIter(x[448:], y[448:], batch_size=64)
+    net = mx.models.get_symbol("resnet", num_classes=4, num_layers=8,
+                               image_shape=(3, 28, 28))
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.bind(data_shapes=train.provide_data,
+             label_shapes=train.provide_label)
+    mod.init_params(mx.init.Xavier(rnd_type="gaussian", factor_type="in",
+                                   magnitude=2))
+    if dtype != "float32":
+        for n_, a in mod._exec.arg_dict.items():
+            if n_ not in ("data", "softmax_label"):
+                a._jx = a._jx.astype(dtype)
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.2,
+                                         "momentum": 0.9, "wd": 1e-4})
+    for _ in range(epochs):
+        train.reset()
+        for batch in train:
+            mod.forward_backward(batch)
+            mod.update()
+    return _final_acc(mod, val)
+
+
+def test_dtype_parity_cifar_resnet():
+    """bf16 ResNet (BatchNorm stats f32, f32 conv accumulation) matches
+    f32 convergence on synthetic CIFAR — the small-scale stand-in for
+    ResNet-50 'identical top-1 @ 90 epochs'."""
+    acc32 = _train_cifar_resnet("float32")
+    acc16 = _train_cifar_resnet("bfloat16")
+    assert acc32 > 0.8, acc32
+    assert acc16 >= acc32 - 0.05, (acc16, acc32)
