@@ -1,0 +1,207 @@
+#!/usr/bin/env python
+"""Full BASELINE.md table on the bench chip -> BENCH_extra.json.
+
+One row per reference row (SURVEY §6 / docs/how_to/perf.md:67-140):
+- inference imgs/sec batch 32: alexnet / vgg / inception-bn / inception-v3 /
+  resnet-50 / resnet-152
+- training imgs/sec batch 32: alexnet / inception-v3 / resnet-50
+- PTB LSTM (BucketingModule) samples/sec
+- SSD-VGG16 300x300 training sec/step
+
+Run: ``python bench_extra.py`` (defaults tuned for the tunneled chip).
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "example", "image-classification"))
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import models  # noqa: E402
+
+DTYPE = os.environ.get("BENCH_DTYPE", "bfloat16")
+STEPS = int(os.environ.get("BENCH_STEPS", "10"))
+ROWS = []
+
+
+def _ctx():
+    return mx.tpu() if mx.num_tpus() > 0 else mx.cpu()
+
+
+def _sync_param(mod):
+    return np.asarray(next(iter(mod._exec.arg_dict.values()))
+                      ._jx.reshape(-1)[:1])
+
+
+def row(name, value, unit, ref_k80=None):
+    entry = {"metric": name, "value": round(value, 2), "unit": unit}
+    if ref_k80:
+        entry["ref_k80"] = ref_k80
+        entry["vs_k80"] = round(value / ref_k80, 2)
+    ROWS.append(entry)
+    print(json.dumps(entry), flush=True)
+
+
+def infer_score(network, ref, batch=32, **kw):
+    from benchmark_score import score
+
+    ips = score(network, batch, dtype=DTYPE, num_batches=STEPS, **kw)
+    tag = network if "num_layers" not in kw \
+        else "%s-%d" % (network, kw["num_layers"])
+    row("infer_%s_b%d" % (tag, batch), ips, "images/sec", ref)
+
+
+def train_score(network, ref, batch=32, image_shape=(3, 224, 224), **kw):
+    os.environ.setdefault("MXNET_FUSE_TRAIN_STEP", "1")
+    ctx = _ctx()
+    sym = models.get_symbol(network, num_classes=1000,
+                            image_shape=image_shape, **kw)
+    mod = mx.mod.Module(sym, context=ctx)
+    mod.bind(data_shapes=[("data", (batch,) + image_shape)],
+             label_shapes=[("softmax_label", (batch,))])
+    mod.init_params(mx.init.Xavier(rnd_type="gaussian", factor_type="in",
+                                   magnitude=2))
+    if DTYPE != "float32":
+        for n, a in mod._exec.arg_dict.items():
+            if n != "softmax_label":
+                a._jx = a._jx.astype(DTYPE)
+    mod.init_optimizer(kvstore=None, optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.05,
+                                         "momentum": 0.9, "wd": 1e-4})
+    rs = np.random.RandomState(0)
+    batches = [mx.io.DataBatch(
+        data=[mx.nd.array(rs.rand(batch, *image_shape).astype(np.float32),
+                          ctx=ctx, dtype=DTYPE)],
+        label=[mx.nd.array(rs.randint(0, 1000, batch).astype(np.float32),
+                           ctx=ctx)]) for _ in range(5)]
+    mod.run_bulk(batches)
+    _sync_param(mod)
+    t0 = time.time()
+    for _ in range(max(1, STEPS // 5)):
+        mod.run_bulk(batches)
+    _sync_param(mod)
+    n = max(1, STEPS // 5) * 5
+    tag = network if "num_layers" not in kw \
+        else "%s-%d" % (network, kw["num_layers"])
+    row("train_%s_b%d" % (tag, batch), batch * n / (time.time() - t0),
+        "images/sec", ref)
+
+
+def lstm_score(batch=32, seq=35, hidden=200, layers=2, vocab=10000):
+    os.environ.setdefault("MXNET_FUSE_TRAIN_STEP", "1")
+    ctx = _ctx()
+    data = mx.sym.Variable("data")
+    embed = mx.sym.Embedding(data, input_dim=vocab, output_dim=hidden)
+    stack = mx.rnn.SequentialRNNCell()
+    for i in range(layers):
+        stack.add(mx.rnn.LSTMCell(num_hidden=hidden, prefix="lstm_l%d_" % i))
+    outputs, _ = stack.unroll(seq, inputs=embed, merge_outputs=True)
+    pred = mx.sym.Reshape(outputs, shape=(-1, hidden))
+    pred = mx.sym.FullyConnected(pred, num_hidden=vocab, name="pred")
+    label = mx.sym.Reshape(mx.sym.Variable("softmax_label"), shape=(-1,))
+    net = mx.sym.SoftmaxOutput(pred, label, name="softmax")
+    mod = mx.mod.Module(net, context=ctx)
+    mod.bind(data_shapes=[("data", (batch, seq))],
+             label_shapes=[("softmax_label", (batch, seq))])
+    mod.init_params(mx.init.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1})
+    rs = np.random.RandomState(0)
+    b = mx.io.DataBatch(
+        data=[mx.nd.array(rs.randint(0, vocab, (batch, seq))
+                          .astype(np.float32), ctx=ctx)],
+        label=[mx.nd.array(rs.randint(0, vocab, (batch, seq))
+                           .astype(np.float32), ctx=ctx)])
+    mod.run_bulk([b] * 5)
+    _sync_param(mod)
+    t0 = time.time()
+    mod.run_bulk([b] * STEPS)
+    _sync_param(mod)
+    row("train_ptb_lstm_b%d_seq%d" % (batch, seq),
+        batch * STEPS / (time.time() - t0), "samples/sec")
+
+
+def ssd_score(batch=8, size=300):
+    ctx = _ctx()
+    from mxnet_tpu.models import ssd_vgg16
+
+    net = ssd_vgg16.get_symbol_train(num_classes=20)
+    mod = mx.mod.Module(net, context=ctx,
+                        label_names=["label"], data_names=["data"])
+    mod.bind(data_shapes=[("data", (batch, 3, size, size))],
+             label_shapes=[("label", (batch, 3, 5))])
+    mod.init_params(mx.init.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.001,
+                                         "momentum": 0.9})
+    rs = np.random.RandomState(0)
+    lab = -np.ones((batch, 3, 5), np.float32)
+    lab[:, 0] = [0, 0.2, 0.2, 0.6, 0.6]
+    b = mx.io.DataBatch(
+        data=[mx.nd.array(rs.rand(batch, 3, size, size)
+                          .astype(np.float32), ctx=ctx)],
+        label=[mx.nd.array(lab, ctx=ctx)])
+    for _ in range(2):
+        mod.forward_backward(b)
+        mod.update()
+    _sync_param(mod)
+    t0 = time.time()
+    for _ in range(STEPS):
+        mod.forward_backward(b)
+        mod.update()
+    _sync_param(mod)
+    sec = (time.time() - t0) / STEPS
+    row("train_ssd_vgg16_%d_b%d_sec_per_step" % (size, batch), sec,
+        "sec/step")
+
+
+def main():
+    which = set((sys.argv[1].split(",") if len(sys.argv) > 1 else
+                 ["infer", "train", "lstm", "ssd"]))
+    if "infer" in which:
+        # reference K80 inference rows: perf.md:67-75
+        infer_score("alexnet", 1443.9)
+        infer_score("vgg", 229.0)
+        infer_score("inception-bn", 287.9)
+        infer_score("inception-v3", 106.4)
+        infer_score("resnet", 167.1, num_layers=50)
+        infer_score("resnet", 69.7, num_layers=152)
+    if "train" in which:
+        # reference K80 training rows: perf.md:108-117
+        nets = os.environ.get("BENCH_TRAIN_NETS",
+                              "alexnet,inception-v3,resnet").split(",")
+        if "alexnet" in nets:
+            train_score("alexnet", 483.4)
+        if "inception-v3" in nets:
+            train_score("inception-v3", 29.6, image_shape=(3, 299, 299))
+        if "resnet" in nets:
+            train_score("resnet", 45.5, num_layers=50)
+    if "lstm" in which:
+        lstm_score()
+    if "ssd" in which:
+        ssd_score()
+    # merge with rows from earlier (partial) invocations
+    merged = {}
+    if os.path.exists("BENCH_extra.json"):
+        try:
+            with open("BENCH_extra.json") as f:
+                for r in json.load(f).get("rows", []):
+                    merged[r["metric"]] = r
+        except (ValueError, KeyError):
+            pass
+    for r in ROWS:
+        merged[r["metric"]] = r
+    with open("BENCH_extra.json", "w") as f:
+        json.dump({"dtype": DTYPE, "chip": "tunneled TPU v5e",
+                   "rows": list(merged.values())}, f, indent=1)
+    print("wrote BENCH_extra.json (%d rows)" % len(merged))
+
+
+if __name__ == "__main__":
+    main()

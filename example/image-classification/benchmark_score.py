@@ -37,14 +37,24 @@ def score(network, batch_size, image_shape=(3, 224, 224), num_batches=20,
         data=[mx.nd.array(rs.rand(*data_shape).astype(np.float32),
                           dtype=dtype)], label=[])
 
-    for _ in range(3):  # warmup/compile
-        mod.forward(batch, is_train=False)
-    mod.get_outputs()[0].wait_to_read()
+    # K forwards scanned inside one dispatch (Module.predict_bulk): the
+    # honest throughput on an async/tunneled backend — waiting on the last
+    # of K *independent* dispatches lets the runtime overlap or dedupe
+    # them and the clock lies by orders of magnitude
+    bulk = [batch] * min(5, num_batches)
+
+    def sync():
+        np.asarray(mod._exec.outputs[0]._jx.reshape(-1)[:1])
+
+    mod.predict_bulk(bulk)
+    sync()
     tic = time.time()
-    for _ in range(num_batches):
-        mod.forward(batch, is_train=False)
-    mod.get_outputs()[0].wait_to_read()
-    return num_batches * batch_size / (time.time() - tic)
+    done = 0
+    while done < num_batches:
+        mod.predict_bulk(bulk)
+        done += len(bulk)
+    sync()
+    return done * batch_size / (time.time() - tic)
 
 
 if __name__ == "__main__":

@@ -393,6 +393,27 @@ class Executor:
                 return outs_stack, new_aux_list, new_p, new_m
 
             fn = jax.jit(f, donate_argnums=(0, 4))
+        elif isinstance(kind, tuple) and kind[0] == "predict_scan":
+            # K inference forwards in ONE dispatch (lax.scan over stacked
+            # inputs) — the serving-throughput analog of train_sgd_scan
+            _, scan_names_t = kind
+            scan_names = list(scan_names_t)
+            static_names = [n for n in arg_names if n not in scan_names_t]
+
+            def f(static_vals, aux, rng, stacks):
+                axmap = dict(zip(aux_names, aux))
+
+                def body(carry, xs):
+                    amap = dict(zip(static_names, static_vals))
+                    amap.update(zip(scan_names, xs))
+                    outs, _ = _graph_forward(symbol, amap, axmap, False,
+                                             rng)
+                    return carry, list(outs)
+
+                _, outs_stack = jax.lax.scan(body, 0, list(stacks))
+                return outs_stack
+
+            fn = jax.jit(f)
         else:
             raise ValueError(kind)
         self._fns[kind] = fn

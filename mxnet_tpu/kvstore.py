@@ -168,18 +168,28 @@ class KVStoreDist(KVStore):
         host = os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1")
         port = int(os.environ.get("DMLC_PS_ROOT_PORT", "9090"))
         self._ps = ps
-        # the server process imports jax before binding; retry with backoff
+        # multi-server sharding (reference ps-lite: N servers, big arrays
+        # split by EncodeKey, kvstore_dist.h:40): server i at port+i;
+        # server 0 doubles as the scheduler (ranks, barrier)
+        self._num_servers = max(1, int(os.environ.get("DMLC_NUM_SERVER",
+                                                      "1")))
+        self._bigarray_bound = int(os.environ.get(
+            "MXNET_KVSTORE_BIGARRAY_BOUND", "1000000"))
+        self._socks = []
         deadline = _time.time() + float(
             os.environ.get("MXNET_KVSTORE_CONNECT_TIMEOUT", "120"))
-        while True:
-            try:
-                self._sock = _socket.create_connection((host, port),
-                                                       timeout=300)
-                break
-            except OSError:
-                if _time.time() > deadline:
-                    raise
-                _time.sleep(0.2)
+        for sid in range(self._num_servers):
+            # servers import jax before binding; retry with backoff
+            while True:
+                try:
+                    self._socks.append(_socket.create_connection(
+                        (host, port + sid), timeout=300))
+                    break
+                except OSError:
+                    if _time.time() > deadline:
+                        raise
+                    _time.sleep(0.2)
+        self._sock = self._socks[0]  # scheduler
         self._versions = {}
         reg = {"cmd": "register", "role": "worker"}
         worker_id = os.environ.get("DMLC_WORKER_ID")
@@ -202,9 +212,11 @@ class KVStoreDist(KVStore):
         self._num_workers = reply["num_workers"]
         self.is_recovery = bool(reply.get("is_recovery", False))
         self._update_on_kvstore = True
-        # command the server into the mode this type implies (reference
+        # command every server into the mode this type implies (reference
         # kvstore.cc:32-35: sync unless the type carries _async)
-        self._rpc({"cmd": "sync_mode", "value": "_async" not in kv_type})
+        for s in self._socks:
+            self._rpc({"cmd": "sync_mode", "value": "_async" not in kv_type},
+                      sock=s)
         # TPU-native gradient plane: join the jax.distributed process
         # group so training steps run in-graph collectives across
         # processes (psum over the global mesh) instead of per-step PS
@@ -216,14 +228,32 @@ class KVStoreDist(KVStore):
 
             self.in_graph_sync = _dist.init_from_env(rank_hint=self._rank)
 
-    def _rpc(self, msg):
-        self._ps.send_msg(self._sock, msg)
-        reply = self._ps.recv_msg(self._sock)
+    def _rpc(self, msg, sock=None):
+        sock = self._sock if sock is None else sock
+        self._ps.send_msg(sock, msg)
+        reply = self._ps.recv_msg(sock)
         if reply is None:
             raise MXNetError("kvstore server connection lost")
         if "error" in reply:
             raise MXNetError(reply["error"])
         return reply
+
+    def _server_of(self, key):
+        """Small keys live whole on one server (round-robin by key)."""
+        try:
+            return int(key) % self._num_servers
+        except (TypeError, ValueError):
+            return hash(str(key)) % self._num_servers
+
+    def _shards(self, key, size):
+        """[(subkey, server, slice)] — arrays over the bigarray bound
+        split into one contiguous chunk per server (EncodeKey analog)."""
+        n = self._num_servers
+        if n == 1 or size < self._bigarray_bound:
+            return None
+        bounds = [size * i // n for i in range(n + 1)]
+        return [("%s#%d" % (key, i), i, slice(bounds[i], bounds[i + 1]))
+                for i in range(n) if bounds[i + 1] > bounds[i]]
 
     @property
     def rank(self):
@@ -238,36 +268,72 @@ class KVStoreDist(KVStore):
         for k, vlist in zip(keys, vals):
             # first init wins on the server (rank-0 broadcast semantics,
             # kvstore_dist.h:58-76)
-            self._rpc({"cmd": "init", "key": k,
-                       "value": vlist[0].asnumpy()})
+            v = vlist[0].asnumpy()
+            shards = self._shards(k, v.size)
+            if shards is None:
+                self._rpc({"cmd": "init", "key": k, "value": v},
+                          sock=self._socks[self._server_of(k)])
+            else:
+                flat = v.reshape(-1)
+                for sk, sid, sl in shards:
+                    self._rpc({"cmd": "init", "key": sk,
+                               "value": flat[sl]}, sock=self._socks[sid])
         self.barrier()
 
     def push(self, key, value, priority=0):
         keys, vals = _ctype_key_value(key, value)
         for k, vlist in zip(keys, vals):
-            merged = _merge_devices(vlist)
-            reply = self._rpc({"cmd": "push", "key": k,
-                               "value": merged.asnumpy(),
-                               "rank": self._rank})
-            self._versions[k] = max(self._versions.get(k, 0),
-                                    reply["version"])
+            merged = _merge_devices(vlist).asnumpy()
+            shards = self._shards(k, merged.size)
+            if shards is None:
+                reply = self._rpc({"cmd": "push", "key": k,
+                                   "value": merged, "rank": self._rank},
+                                  sock=self._socks[self._server_of(k)])
+                self._versions[k] = max(self._versions.get(k, 0),
+                                        reply["version"])
+                continue
+            flat = merged.reshape(-1)
+            for sk, sid, sl in shards:
+                reply = self._rpc({"cmd": "push", "key": sk,
+                                   "value": flat[sl], "rank": self._rank},
+                                  sock=self._socks[sid])
+                self._versions[sk] = max(self._versions.get(sk, 0),
+                                         reply["version"])
 
     def pull(self, key, out=None, priority=0):
+        import numpy as _np
+
         from .ndarray import array
 
         keys, outs = _ctype_key_value(key, out)
         for k, olist in zip(keys, outs):
-            reply = self._rpc({"cmd": "pull", "key": k,
-                               "version": self._versions.get(k, 0)})
-            val = array(reply["value"])
+            size = int(_np.prod(olist[0].shape)) if olist else 0
+            shards = self._shards(k, size)
+            if shards is None:
+                reply = self._rpc({"cmd": "pull", "key": k,
+                                   "version": self._versions.get(k, 0)},
+                                  sock=self._socks[self._server_of(k)])
+                val = array(reply["value"])
+            else:
+                flat = _np.empty((size,), _np.float32)
+                for sk, sid, sl in shards:
+                    reply = self._rpc(
+                        {"cmd": "pull", "key": sk,
+                         "version": self._versions.get(sk, 0)},
+                        sock=self._socks[sid])
+                    part = _np.asarray(reply["value"], _np.float32)
+                    flat[sl] = part
+                val = array(flat.reshape(olist[0].shape))
             for o in olist:
                 val.copyto(o)
 
     def set_optimizer(self, optimizer):
-        """Serialize the optimizer to the server (reference
-        ``python/mxnet/kvstore.py:232`` pickles it to servers)."""
+        """Serialize the optimizer to every server (reference
+        ``python/mxnet/kvstore.py:232`` pickles it to all servers)."""
         self._optimizer = optimizer
-        self._rpc({"cmd": "set_optimizer", "bytes": pickle.dumps(optimizer)})
+        blob = pickle.dumps(optimizer)
+        for s in self._socks:
+            self._rpc({"cmd": "set_optimizer", "bytes": blob}, sock=s)
 
     def set_updater(self, updater):
         # dist mode: updates happen on the server; a locally-set updater
@@ -283,13 +349,22 @@ class KVStoreDist(KVStore):
         self._rpc({"cmd": "user_command", "head": head, "body": body})
 
     def save_optimizer_states(self, fname):
-        reply = self._rpc({"cmd": "get_updater_states"})
+        blobs = [self._rpc({"cmd": "get_updater_states"},
+                           sock=s)["states"] for s in self._socks]
         with open(fname, "wb") as f:
-            f.write(reply["states"])
+            f.write(blobs[0] if len(blobs) == 1 else
+                    b"MXPSMULTI" + pickle.dumps(blobs))
 
     def load_optimizer_states(self, fname):
         with open(fname, "rb") as f:
-            self._rpc({"cmd": "set_updater_states", "states": f.read()})
+            data = f.read()
+        if data.startswith(b"MXPSMULTI"):
+            blobs = pickle.loads(data[len(b"MXPSMULTI"):])
+            for s, blob in zip(self._socks, blobs):
+                self._rpc({"cmd": "set_updater_states", "states": blob},
+                          sock=s)
+        else:
+            self._rpc({"cmd": "set_updater_states", "states": data})
 
     def close(self):
         """Rank 0 stops the server after a final barrier (the reference's
@@ -299,15 +374,18 @@ class KVStoreDist(KVStore):
         try:
             self.barrier()
             if self._rank == 0:
-                self._rpc({"cmd": "stop"})
+                for s in self._socks:
+                    self._rpc({"cmd": "stop"}, sock=s)
         finally:
-            self._sock.close()
+            for s in self._socks:
+                s.close()
             self._sock = None
+            self._socks = []
 
     def __del__(self):
         try:
-            if self._sock is not None:
-                self._sock.close()
+            for s in getattr(self, "_socks", []):
+                s.close()
         except Exception:
             pass
 

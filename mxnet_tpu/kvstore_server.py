@@ -361,7 +361,12 @@ def run_server():
 
     jax.config.update("jax_platforms", "cpu")
     num_workers = int(os.environ["DMLC_NUM_WORKER"])
-    port = int(os.environ.get("DMLC_PS_ROOT_PORT", "9090"))
+    # multi-server sharding (reference ps-lite N servers + EncodeKey,
+    # kvstore_dist.h:40): server i listens at root port + i; workers
+    # route keys/big-array chunks by server id, server 0 doubles as the
+    # scheduler (rank assignment, barrier)
+    sid = int(os.environ.get("DMLC_SERVER_ID", "0"))
+    port = int(os.environ.get("DMLC_PS_ROOT_PORT", "9090")) + sid
     # bind address is separate from the advertised DMLC_PS_ROOT_URI: on
     # multi-host launches the hostname may resolve to loopback locally
     # (Debian's 127.0.1.1 convention), so bind all interfaces whenever the

@@ -397,7 +397,11 @@ def test_launcher_fit_with_server_optimizer(tmp_path):
     `from . import` (ndarray._invoke's profiler import)."""
     script = tmp_path / "worker.py"
     script.write_text(_FIT_SCRIPT)
-    env = dict(os.environ, OUT_DIR=str(tmp_path), JAX_PLATFORMS="cpu")
+    # pin the PS gradient plane: this test exercises update-on-kvstore
+    # (server-side optimizer); the in-graph collective plane has its own
+    # test (test_dist_ingraph.py)
+    env = dict(os.environ, OUT_DIR=str(tmp_path), JAX_PLATFORMS="cpu",
+               MXNET_DIST_INGRAPH="0")
     env.pop("DMLC_PS_ROOT_PORT", None)
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO, "tools", "launch.py"),
@@ -409,3 +413,131 @@ def test_launcher_fit_with_server_optimizer(tmp_path):
     w1 = np.load(tmp_path / "w1.npy")
     np.testing.assert_allclose(w0, w1, rtol=1e-5)
     assert np.abs(w0).sum() > 0
+
+
+def test_launcher_sge_mode(tmp_path):
+    """--launcher sge submits one qsub job per worker with the wire env
+    in -v; a local stub standing in for qsub parses -v and runs the job
+    (reference dmlc-tracker sge backend shape)."""
+    script = tmp_path / "worker.py"
+    script.write_text(_LAUNCH_SCRIPT)
+    # stub qsub: consume flags, export the -v list, run the command
+    stub = tmp_path / "fake_qsub.sh"
+    stub.write_text(
+        "#!/bin/sh\n"
+        "envs=''\n"
+        "while [ $# -gt 0 ]; do\n"
+        "  case $1 in\n"
+        "    -v) envs=$2; shift 2;;\n"
+        "    -sync|-N) shift 2;;\n"
+        "    -b) shift 2;;\n"
+        "    -cwd) shift;;\n"
+        "    *) break;;\n"
+        "  esac\n"
+        "done\n"
+        "IFS=','\n"
+        "for kv in $envs; do export \"$kv\"; done\n"
+        "unset IFS\n"
+        "exec \"$@\"\n")
+    stub.chmod(0o755)
+    env = dict(os.environ, OUT_DIR=str(tmp_path), JAX_PLATFORMS="cpu",
+               MXNET_LAUNCH_QSUB=str(stub))
+    env.pop("DMLC_PS_ROOT_PORT", None)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+         "-n", "2", "--launcher", "sge",
+         "--env", "OUT_DIR=%s" % tmp_path, "--env", "JAX_PLATFORMS=cpu",
+         sys.executable, str(script)],
+        env=env, timeout=300, capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert (tmp_path / "ok.0").exists() and (tmp_path / "ok.1").exists()
+
+
+def test_launcher_yarn_mode(tmp_path):
+    """--launcher yarn submits all workers through one distributed-shell
+    job; containers carry no per-rank env, so the PS assigns ranks in
+    connect order. A local stub spawns N copies of -shell_command."""
+    script = tmp_path / "worker.py"
+    script.write_text(_LAUNCH_SCRIPT)
+    stub = tmp_path / "fake_yarn.sh"
+    stub.write_text(
+        "#!/bin/sh\n"
+        "# yarn jar <jar> -num_containers N -shell_command CMD\n"
+        "shift 2\n"
+        "N=''; CMD=''\n"
+        "while [ $# -gt 0 ]; do\n"
+        "  case $1 in\n"
+        "    -num_containers) N=$2; shift 2;;\n"
+        "    -shell_command) CMD=$2; shift 2;;\n"
+        "    *) shift;;\n"
+        "  esac\n"
+        "done\n"
+        "i=0; pids=''\n"
+        "while [ $i -lt $N ]; do\n"
+        "  sh -c \"$CMD\" & pids=\"$pids $!\"\n"
+        "  i=$((i+1))\n"
+        "done\n"
+        "rc=0; for p in $pids; do wait $p || rc=1; done\n"
+        "exit $rc\n")
+    stub.chmod(0o755)
+    env = dict(os.environ, OUT_DIR=str(tmp_path), JAX_PLATFORMS="cpu",
+               MXNET_LAUNCH_YARN=str(stub))
+    env.pop("DMLC_PS_ROOT_PORT", None)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+         "-n", "2", "--launcher", "yarn",
+         "--env", "OUT_DIR=%s" % tmp_path, "--env", "JAX_PLATFORMS=cpu",
+         sys.executable, str(script)],
+        env=env, timeout=300, capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    # rank-less registration: both workers completed with distinct ranks
+    assert (tmp_path / "ok.0").exists() and (tmp_path / "ok.1").exists()
+
+
+_MULTISERVER_SCRIPT = r"""
+import os, sys
+import numpy as np
+import jax; jax.config.update("jax_platforms", "cpu")
+import mxnet_tpu as mx
+kv = mx.kv.create("dist_sync")
+rank, n = kv.rank, kv.num_workers
+assert kv._num_servers == 2, kv._num_servers
+# small keys route whole to one server each (0 -> srv0, 1 -> srv1)
+for key in (0, 1):
+    kv.init(key, mx.nd.zeros((4,)))
+    kv.push(key, mx.nd.array(np.full((4,), (key + 1) * (rank + 1),
+                                     np.float32)))
+    out = mx.nd.zeros((4,))
+    kv.pull(key, out=out)
+    expect = (key + 1) * sum(r + 1 for r in range(n))
+    assert (out.asnumpy() == expect).all(), (key, out.asnumpy())
+# big array shards across both servers (bound lowered via env)
+big = np.arange(10, dtype=np.float32)
+kv.init(7, mx.nd.array(np.zeros_like(big)))
+kv.push(7, mx.nd.array(big * (rank + 1)))
+out = mx.nd.zeros((10,))
+kv.pull(7, out=out)
+expect = big * sum(r + 1 for r in range(n))
+np.testing.assert_array_equal(out.asnumpy(), expect)
+open(os.path.join(os.environ["OUT_DIR"], "ok.%d" % rank), "w").write("1")
+kv.close()
+"""
+
+
+def test_multi_server_sharding(tmp_path):
+    """launch.py -s 2: keys round-robin over servers, big arrays split
+    into per-server chunks (reference ps-lite EncodeKey/bigarray_bound_,
+    kvstore_dist.h:40); sums remain exact."""
+    script = tmp_path / "worker.py"
+    script.write_text(_MULTISERVER_SCRIPT)
+    env = dict(os.environ, OUT_DIR=str(tmp_path), JAX_PLATFORMS="cpu",
+               MXNET_KVSTORE_BIGARRAY_BOUND="8", MXNET_DIST_INGRAPH="0")
+    env.pop("DMLC_PS_ROOT_PORT", None)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+         "-n", "2", "-s", "2",
+         "--env", "MXNET_KVSTORE_BIGARRAY_BOUND=8",
+         sys.executable, str(script)],
+        env=env, timeout=300, capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert (tmp_path / "ok.0").exists() and (tmp_path / "ok.1").exists()

@@ -368,3 +368,24 @@ def test_run_bulk_fallback_without_fuse_flag():
     w1 = mod.get_params()[0]["fc_weight"].asnumpy()
     assert not np.allclose(w0, w1)
     assert mod.get_outputs()[0].shape == (8, 2)
+
+
+def test_predict_bulk_matches_forward():
+    """predict_bulk (K scanned forwards) == per-batch forward outputs."""
+    rs = np.random.RandomState(2)
+    data = mx.sym.Variable("data")
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(data, num_hidden=3, name="fc"),
+        name="softmax")
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.bind(data_shapes=[("data", (4, 6))],
+             label_shapes=[("softmax_label", (4,))], for_training=False)
+    mod.init_params(mx.init.Xavier())
+    batches = [mx.io.DataBatch(
+        data=[mx.nd.array(rs.rand(4, 6).astype(np.float32))],
+        label=[mx.nd.zeros((4,))]) for _ in range(3)]
+    bulk = mod.predict_bulk(batches)
+    for b, outs in zip(batches, bulk):
+        mod.forward(b, is_train=False)
+        ref = mod.get_outputs()[0].asnumpy()
+        assert_almost_equal(outs[0].asnumpy(), ref, rtol=1e-5, atol=1e-6)

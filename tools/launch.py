@@ -64,6 +64,36 @@ def _spawn_mpi(cmd, env, fwd_keys, num_workers, hostfile):
     return subprocess.Popen(argv, env=env)
 
 
+def _spawn_sge(cmd, env, fwd_keys, rank):
+    """Submit one worker as an SGE job (reference dmlc-tracker sge
+    backend): ``qsub -sync y`` so the launcher's wait covers the job; env
+    travels via ``-v``.  ``MXNET_LAUNCH_QSUB`` overrides the binary."""
+    qsub = os.environ.get("MXNET_LAUNCH_QSUB", "qsub")
+    envs = ",".join("%s=%s" % (k, env[k]) for k in sorted(fwd_keys)
+                    if k in env)
+    argv = shlex.split(qsub) + ["-sync", "y", "-b", "y", "-cwd",
+                                "-N", "mxnet_worker%d" % rank,
+                                "-v", envs] + list(cmd)
+    return subprocess.Popen(argv, env=env)
+
+
+def _spawn_yarn(cmd, env, fwd_keys, num_workers):
+    """Submit all workers through the YARN distributed-shell runner
+    (reference dmlc-tracker yarn backend shape).  Containers have no
+    per-rank env, so workers register rank-less and the PS assigns ranks
+    in connect order.  ``MXNET_LAUNCH_YARN`` overrides the binary."""
+    yarn = os.environ.get("MXNET_LAUNCH_YARN", "yarn")
+    exports = ["%s=%s" % (k, env[k]) for k in sorted(fwd_keys)
+               if k in env and k != "DMLC_WORKER_ID"]
+    argv = shlex.split(yarn) + [
+        "jar", env.get("MXNET_YARN_JAR", "dmlc-yarn-distshell.jar"),
+        "-num_containers", str(num_workers),
+        "-shell_command",
+        " ".join(["env"] + [shlex.quote(e) for e in exports]
+                 + [shlex.quote(c) for c in cmd])]
+    return subprocess.Popen(argv, env=env)
+
+
 def _spawn_ssh(host, cmd, env, base_keys):
     """Run cmd on host with the DMLC_*/MXNET_* env inlined (dmlc-tracker
     forwards the wire-protocol env the same way)."""
@@ -80,10 +110,11 @@ def main():
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("-n", "--num-workers", type=int, required=True)
     p.add_argument("-s", "--num-servers", type=int, default=1,
-                   help="kept for reference CLI parity; the TPU PS is a "
-                        "single threaded server process")
+                   help="parameter-server processes; keys and big-array "
+                        "chunks shard across them (ps-lite EncodeKey "
+                        "analog), server 0 doubles as the scheduler")
     p.add_argument("--launcher", default="local",
-                   choices=["local", "ssh", "mpi"])
+                   choices=["local", "ssh", "mpi", "sge", "yarn"])
     p.add_argument("-H", "--hostfile", type=str, default=None,
                    help="ssh: file with one host per line; mpi: forwarded "
                         "to mpirun --hostfile")
@@ -93,6 +124,15 @@ def main():
     args = p.parse_args()
     if not args.command:
         p.error("no command given")
+    if args.launcher in ("sge", "yarn"):
+        import shutil
+
+        var, default = {"sge": ("MXNET_LAUNCH_QSUB", "qsub"),
+                        "yarn": ("MXNET_LAUNCH_YARN", "yarn")}[args.launcher]
+        prog = shlex.split(os.environ.get(var, default))[0]
+        if shutil.which(prog) is None and not os.path.exists(prog):
+            p.error("--launcher %s requires %r on PATH (or set %s)"
+                    % (args.launcher, prog, var))
     hosts = None
     if args.launcher == "ssh":
         if not args.hostfile:
@@ -117,7 +157,7 @@ def main():
         "DMLC_PS_ROOT_URI": root_uri,
         "DMLC_PS_ROOT_PORT": str(port),
         "DMLC_NUM_WORKER": str(args.num_workers),
-        "DMLC_NUM_SERVER": "1",
+        "DMLC_NUM_SERVER": str(max(1, args.num_servers)),
         # jax.distributed coordinator for the in-graph gradient plane
         # (rank 0 hosts it; see mxnet_tpu/dist.py)
         "MXNET_COORDINATOR_ADDRESS": "%s:%d" % (root_uri, _free_port()),
@@ -128,12 +168,12 @@ def main():
     fwd_keys = set(wire) | {"DMLC_ROLE", "DMLC_WORKER_ID"} | \
         {kv.split("=", 1)[0] for kv in args.env}
 
-    # server always runs on the launching host (reference scheduler-host
-    # convention for the single-server setup)
-    server = subprocess.Popen(
+    # servers run on the launching host (reference scheduler-host
+    # convention); server i binds root port + i, server 0 = scheduler
+    servers = [subprocess.Popen(
         [sys.executable, "-m", "mxnet_tpu.kvstore_server"],
-        env=dict(base_env, DMLC_ROLE="server"),
-    )
+        env=dict(base_env, DMLC_ROLE="server", DMLC_SERVER_ID=str(i)),
+    ) for i in range(max(1, args.num_servers))]
     time.sleep(0.3)
 
     workers = []
@@ -142,6 +182,11 @@ def main():
         env.pop("DMLC_WORKER_ID", None)  # ranks come from the MPI runtime
         workers.append(_spawn_mpi(args.command, env, fwd_keys,
                                   args.num_workers, args.hostfile))
+    elif args.launcher == "yarn":
+        env = dict(base_env, DMLC_ROLE="worker")
+        env.pop("DMLC_WORKER_ID", None)  # PS assigns ranks on connect
+        workers.append(_spawn_yarn(args.command, env, fwd_keys,
+                                   args.num_workers))
     else:
         for rank in range(args.num_workers):
             env = dict(base_env, DMLC_ROLE="worker",
@@ -150,16 +195,20 @@ def main():
                 host = hosts[rank % len(hosts)]
                 workers.append(_spawn_ssh(host, args.command, env,
                                           fwd_keys))
+            elif args.launcher == "sge":
+                workers.append(_spawn_sge(args.command, env, fwd_keys,
+                                          rank))
             else:
                 workers.append(_spawn_local(args.command, env))
     rc = 0
     for w in workers:
         rc |= w.wait()
-    # rank-0's KVStoreDist.close() stops the server; reap or kill
-    try:
-        server.wait(timeout=10)
-    except subprocess.TimeoutExpired:
-        server.terminate()
+    # rank-0's KVStoreDist.close() stops the servers; reap or kill
+    for server in servers:
+        try:
+            server.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            server.terminate()
     sys.exit(rc)
 
 
