@@ -111,7 +111,13 @@ class Predictor:
         if key not in self._input_shapes:
             raise MXNetError("unknown input %r (have %s)"
                              % (key, sorted(self._input_shapes)))
-        self._exec.arg_dict[key][:] = np.asarray(data, np.float32)
+        dst = self._exec.arg_dict[key]
+        arr = np.asarray(data, np.float32)
+        if arr.shape != tuple(dst.shape):
+            # the C ABI hands inputs over as flat float buffers
+            # (c_predict_api.h MXPredSetInput semantics)
+            arr = arr.reshape(dst.shape)
+        dst[:] = arr
 
     def forward(self):
         """MXPredForward"""

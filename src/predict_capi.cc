@@ -1,0 +1,285 @@
+// C predict ABI implementation (include/mxnet_tpu/c_predict_api.h).
+//
+// Reference: src/c_api/c_predict_api.cc — load symbol JSON + params blob,
+// bind with grad_req=null, SetInput/Forward/GetOutput.  The compute path
+// here is XLA through the Python package, so this library embeds CPython
+// and drives mxnet_tpu.predict.Predictor — the same object the Python
+// predict API uses (one runtime, N frontends; SURVEY §2.7).
+//
+// Build:
+//   g++ -O2 -shared -fPIC -std=c++17 src/predict_capi.cc \
+//       $(python3-config --includes) $(python3-config --ldflags --embed) \
+//       -o libmxnet_tpu_predict.so
+// The interpreter is initialized lazily on first MXPredCreate; set
+// MXNET_TPU_HOME to the repo/site-packages root if mxnet_tpu is not
+// importable from the default sys.path.
+
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <vector>
+
+extern "C" {
+#include "../include/mxnet_tpu/c_predict_api.h"
+}
+
+namespace {
+
+thread_local std::string g_last_error;
+
+void set_error(const std::string& msg) { g_last_error = msg; }
+
+std::string py_error() {
+  PyObject *type, *value, *tb;
+  PyErr_Fetch(&type, &value, &tb);
+  std::string msg = "python error";
+  if (value != nullptr) {
+    PyObject* s = PyObject_Str(value);
+    if (s != nullptr) {
+      msg = PyUnicode_AsUTF8(s);
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+  return msg;
+}
+
+// one-time embedded interpreter init
+std::once_flag g_init_flag;
+bool g_init_ok = false;
+
+void init_python() {
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);
+  }
+  PyObject* sys_path = PySys_GetObject("path");  // borrowed
+  const char* home = std::getenv("MXNET_TPU_HOME");
+  if (home != nullptr && sys_path != nullptr) {
+    PyObject* p = PyUnicode_FromString(home);
+    PyList_Insert(sys_path, 0, p);
+    Py_DECREF(p);
+  }
+  g_init_ok = true;
+}
+
+struct Predictor {
+  PyObject* obj;                       // mxnet_tpu.predict.Predictor
+  std::vector<uint32_t> shape_buf;     // GetOutputShape scratch
+};
+
+// GIL guard: the embedding host may call from any thread
+struct Gil {
+  PyGILState_STATE st;
+  Gil() : st(PyGILState_Ensure()) {}
+  ~Gil() { PyGILState_Release(st); }
+};
+
+PyObject* shapes_dict(uint32_t num_input_nodes, const char** input_keys,
+                      const uint32_t* indptr, const uint32_t* data) {
+  PyObject* shapes = PyDict_New();
+  for (uint32_t i = 0; i < num_input_nodes; ++i) {
+    uint32_t lo = indptr[i], hi = indptr[i + 1];
+    PyObject* tup = PyTuple_New(hi - lo);
+    for (uint32_t d = lo; d < hi; ++d) {
+      PyTuple_SET_ITEM(tup, d - lo, PyLong_FromUnsignedLong(data[d]));
+    }
+    PyDict_SetItemString(shapes, input_keys[i], tup);
+    Py_DECREF(tup);
+  }
+  return shapes;
+}
+
+}  // namespace
+
+extern "C" {
+
+const char* MXGetLastError(void) { return g_last_error.c_str(); }
+
+int MXPredCreate(const char* symbol_json_str, const void* param_bytes,
+                 int param_size, int dev_type, int dev_id,
+                 uint32_t num_input_nodes, const char** input_keys,
+                 const uint32_t* input_shape_indptr,
+                 const uint32_t* input_shape_data, PredictorHandle* out) {
+  std::call_once(g_init_flag, init_python);
+  if (!g_init_ok) {
+    set_error("embedded python failed to initialize");
+    return -1;
+  }
+  Gil gil;
+  PyObject* mod = PyImport_ImportModule("mxnet_tpu.predict");
+  if (mod == nullptr) {
+    set_error("import mxnet_tpu.predict: " + py_error());
+    return -1;
+  }
+  PyObject* ctx_mod = PyImport_ImportModule("mxnet_tpu.context");
+  if (ctx_mod == nullptr) {
+    Py_DECREF(mod);
+    set_error("import mxnet_tpu.context: " + py_error());
+    return -1;
+  }
+  const char* ctx_fn = (dev_type == 1 || dev_type == 3) ? "cpu" : "tpu";
+  PyObject* ctx = PyObject_CallMethod(ctx_mod, ctx_fn, "i", dev_id);
+  Py_DECREF(ctx_mod);
+  if (ctx == nullptr) {
+    Py_DECREF(mod);
+    set_error("context: " + py_error());
+    return -1;
+  }
+  PyObject* shapes = shapes_dict(num_input_nodes, input_keys,
+                                 input_shape_indptr, input_shape_data);
+  PyObject* blob = PyBytes_FromStringAndSize(
+      static_cast<const char*>(param_bytes), param_size);
+  PyObject* pred = PyObject_CallMethod(
+      mod, "create", "sOOO", symbol_json_str, blob, shapes, ctx);
+  Py_DECREF(blob);
+  Py_DECREF(shapes);
+  Py_DECREF(ctx);
+  Py_DECREF(mod);
+  if (pred == nullptr) {
+    set_error("Predictor create: " + py_error());
+    return -1;
+  }
+  auto* h = new Predictor{pred, {}};
+  *out = h;
+  return 0;
+}
+
+int MXPredSetInput(PredictorHandle handle, const char* key,
+                   const float* data, uint32_t size) {
+  auto* h = static_cast<Predictor*>(handle);
+  Gil gil;
+  // hand the floats over as a bytes buffer; Predictor.set_input accepts
+  // (key, flat_float32_bytes) via numpy frombuffer on the Python side
+  PyObject* np = PyImport_ImportModule("numpy");
+  if (np == nullptr) {
+    set_error("import numpy: " + py_error());
+    return -1;
+  }
+  PyObject* bytes = PyBytes_FromStringAndSize(
+      reinterpret_cast<const char*>(data),
+      static_cast<Py_ssize_t>(size) * 4);
+  PyObject* arr = PyObject_CallMethod(np, "frombuffer", "Os", bytes,
+                                      "float32");
+  Py_DECREF(bytes);
+  Py_DECREF(np);
+  if (arr == nullptr) {
+    set_error("frombuffer: " + py_error());
+    return -1;
+  }
+  PyObject* r = PyObject_CallMethod(h->obj, "set_input", "sO", key, arr);
+  Py_DECREF(arr);
+  if (r == nullptr) {
+    set_error("set_input: " + py_error());
+    return -1;
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXPredForward(PredictorHandle handle) {
+  auto* h = static_cast<Predictor*>(handle);
+  Gil gil;
+  PyObject* r = PyObject_CallMethod(h->obj, "forward", nullptr);
+  if (r == nullptr) {
+    set_error("forward: " + py_error());
+    return -1;
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXPredGetOutputShape(PredictorHandle handle, uint32_t index,
+                         uint32_t** shape_data, uint32_t* shape_ndim) {
+  auto* h = static_cast<Predictor*>(handle);
+  Gil gil;
+  PyObject* shp = PyObject_CallMethod(h->obj, "get_output_shape", "I",
+                                      index);
+  if (shp == nullptr) {
+    set_error("get_output_shape: " + py_error());
+    return -1;
+  }
+  Py_ssize_t n = PySequence_Size(shp);
+  h->shape_buf.resize(static_cast<size_t>(n));
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject* item = PySequence_GetItem(shp, i);
+    h->shape_buf[static_cast<size_t>(i)] =
+        static_cast<uint32_t>(PyLong_AsUnsignedLong(item));
+    Py_DECREF(item);
+  }
+  Py_DECREF(shp);
+  *shape_data = h->shape_buf.data();
+  *shape_ndim = static_cast<uint32_t>(n);
+  return 0;
+}
+
+int MXPredGetOutput(PredictorHandle handle, uint32_t index, float* data,
+                    uint32_t size) {
+  auto* h = static_cast<Predictor*>(handle);
+  Gil gil;
+  PyObject* out = PyObject_CallMethod(h->obj, "get_output", "I", index);
+  if (out == nullptr) {
+    set_error("get_output: " + py_error());
+    return -1;
+  }
+  // get_output returns numpy already; astype(float32) normalizes dtype
+  PyObject* f32 = PyObject_CallMethod(out, "astype", "s", "float32");
+  Py_DECREF(out);
+  if (f32 == nullptr) {
+    set_error("astype: " + py_error());
+    return -1;
+  }
+  PyObject* bytes = PyObject_CallMethod(f32, "tobytes", nullptr);
+  Py_DECREF(f32);
+  if (bytes == nullptr) {
+    set_error("tobytes: " + py_error());
+    return -1;
+  }
+  Py_ssize_t nbytes = PyBytes_Size(bytes);
+  if (static_cast<uint64_t>(nbytes) < static_cast<uint64_t>(size) * 4) {
+    Py_DECREF(bytes);
+    set_error("output smaller than requested size");
+    return -1;
+  }
+  std::memcpy(data, PyBytes_AsString(bytes),
+              static_cast<size_t>(size) * 4);
+  Py_DECREF(bytes);
+  return 0;
+}
+
+int MXPredReshape(PredictorHandle handle, uint32_t num_input_nodes,
+                  const char** input_keys,
+                  const uint32_t* input_shape_indptr,
+                  const uint32_t* input_shape_data) {
+  auto* h = static_cast<Predictor*>(handle);
+  Gil gil;
+  PyObject* shapes = shapes_dict(num_input_nodes, input_keys,
+                                 input_shape_indptr, input_shape_data);
+  PyObject* r = PyObject_CallMethod(h->obj, "reshape", "O", shapes);
+  Py_DECREF(shapes);
+  if (r == nullptr) {
+    set_error("reshape: " + py_error());
+    return -1;
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXPredFree(PredictorHandle handle) {
+  auto* h = static_cast<Predictor*>(handle);
+  {
+    Gil gil;
+    PyObject* r = PyObject_CallMethod(h->obj, "free", nullptr);
+    Py_XDECREF(r);
+    PyErr_Clear();
+    Py_DECREF(h->obj);
+  }
+  delete h;
+  return 0;
+}
+
+}  // extern "C"
