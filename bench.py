@@ -25,7 +25,7 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 BATCH = int(os.environ.get("BENCH_BATCH", "128"))
 WARMUP = int(os.environ.get("BENCH_WARMUP", "2"))
 STEPS = int(os.environ.get("BENCH_STEPS", "20"))
-BULK = max(1, int(os.environ.get("BENCH_BULK", "5")))
+BULK = max(1, int(os.environ.get("BENCH_BULK", "10")))
 # the tunneled chip is a shared resource with large run-to-run variance;
 # best-of-N timed repetitions is the standard interference-robust estimate
 REPEATS = max(1, int(os.environ.get("BENCH_REPEATS", "3")))

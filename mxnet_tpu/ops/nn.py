@@ -59,8 +59,14 @@ def _conv_f32acc(stride, padding, lhs_dilation, rhs_dilation, dn, groups):
         # cotangent into the transposed convs — that fusion miscompiles
         # on the current TPU toolchain (wrong data-gradients for any
         # Pad/Crop/slice directly after a conv; verified against CPU and
-        # finite differences)
-        return vjp(jax.lax.optimization_barrier(g.astype(data.dtype)))
+        # finite differences).  MXNET_CONV_GRAD_BARRIER=0 disables it for
+        # toolchains without the bug.
+        g = g.astype(data.dtype)
+        import os
+
+        if os.environ.get("MXNET_CONV_GRAD_BARRIER", "1") != "0":
+            g = jax.lax.optimization_barrier(g)
+        return vjp(g)
 
     conv.defvjp(fwd, bwd)
     return conv
