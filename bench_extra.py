@@ -118,7 +118,7 @@ def lstm_score(batch=32, seq=35, hidden=200, layers=2, vocab=10000):
                           .astype(np.float32), ctx=ctx)],
         label=[mx.nd.array(rs.randint(0, vocab, (batch, seq))
                            .astype(np.float32), ctx=ctx)])
-    mod.run_bulk([b] * 5)
+    mod.run_bulk([b] * STEPS)  # warmup at the SAME bulk size (jit key)
     _sync_param(mod)
     t0 = time.time()
     mod.run_bulk([b] * STEPS)
