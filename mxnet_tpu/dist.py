@@ -71,11 +71,18 @@ def init_from_env(rank_hint=None):
             port = os.environ.get("DMLC_PS_ROOT_PORT")
             if not host or not port:
                 return False
-            coord = "%s:%d" % (host, int(port) + 1)
+            # first slot past the PS servers (server i binds port+i) —
+            # only valid when rank 0 runs on the root host (single-host
+            # env wiring); multi-host launches must set
+            # MXNET_COORDINATOR_ADDRESS to rank-0's node
+            nsrv = max(1, int(os.environ.get("DMLC_NUM_SERVER", "1")))
+            coord = "%s:%d" % (host, int(port) + nsrv + 7)
         import jax
 
+        timeout = int(os.environ.get("MXNET_DIST_INIT_TIMEOUT", "120"))
         jax.distributed.initialize(coordinator_address=coord,
-                                   num_processes=nw, process_id=pid)
+                                   num_processes=nw, process_id=pid,
+                                   initialization_timeout=timeout)
         _state.update(initialized=True, rank=pid, num_processes=nw)
         return True
 

@@ -484,7 +484,6 @@ class Executor:
         for si, (_d, seg_nodes) in enumerate(segs):
             for n in seg_nodes:
                 produced_by[id(n)] = si
-        needed_later = {}  # entry -> first consumer segment > producer
         seg_io = []
         out_entries = {(id(n), i) for n, i in self._symbol._outputs}
         for si, (_d, seg_nodes) in enumerate(segs):
@@ -647,10 +646,13 @@ class Executor:
         if self._global_mesh is not None:
             # multi-process SPMD: the key must be a global replicated
             # array (and identical on every process — fold a counter on a
-            # fixed base rather than splitting process-local state)
+            # fixed base rather than splitting process-local state).  The
+            # counter advances HERE so every caller (forward, fused step,
+            # bulk) gets a fresh key.
             from . import dist as _dist
 
             if self._needs_rng:
+                self._rng_step += 1
                 key = np.asarray(jax.random.fold_in(
                     jax.random.PRNGKey(_random.get_seed()), self._rng_step))
                 return _dist.replicate(self._global_mesh, key)

@@ -631,17 +631,20 @@ class Module(BaseModule):
 
         # benchmark loops re-submit the same device-resident batches every
         # bulk; re-stacking them costs a dispatch round trip per input, so
-        # memoize on the identity of the underlying buffers
-        skey = tuple(id((b.data if k == "data" else b.label)[i]._jx)
-                     if isinstance((b.data if k == "data" else b.label)[i],
-                                   NDArray) else None
-                     for k, i in name_pos.values() for b in batches)
+        # memoize on the identity of the underlying buffers.  The cache
+        # PINS those buffers (keyed list): an id() key alone would go
+        # stale when fresh batches reuse a freed object's address
+        keyed = [(b.data if k == "data" else b.label)[i]._jx
+                 if isinstance((b.data if k == "data" else b.label)[i],
+                               NDArray) else None
+                 for k, i in name_pos.values() for b in batches]
+        skey = tuple(id(v) if v is not None else None for v in keyed)
         cached = getattr(self, "_bulk_stack_cache", None)
         if cached is not None and cached[0] == skey and None not in skey:
             stacks = cached[1]
         else:
             stacks = [stack(n) for n in scan_names]
-            self._bulk_stack_cache = (skey, stacks)
+            self._bulk_stack_cache = (skey, stacks, keyed)
         names_set = set(names)
         static = [n for n in ex.arg_names
                   if n not in names_set and n not in scan_names]
@@ -702,15 +705,17 @@ class Module(BaseModule):
                 vals.append(jx.astype(ex.arg_dict[n]._jx.dtype))
             return jax.device_put(jnp.stack(vals), dev)
 
-        skey = tuple(id(v._jx) if isinstance(v, NDArray) else None
-                     for b in batches
-                     for v in list(b.data) + list(b.label or []))
+        # cache pins the keyed buffers so id()s cannot be reused stale
+        keyed = [v._jx if isinstance(v, NDArray) else None
+                 for b in batches
+                 for v in list(b.data) + list(b.label or [])]
+        skey = tuple(id(v) if v is not None else None for v in keyed)
         cached = getattr(self, "_pred_stack_cache", None)
         if cached is not None and cached[0] == skey and None not in skey:
             stacks = cached[1]
         else:
             stacks = [stack(n) for n in scan_names]
-            self._pred_stack_cache = (skey, stacks)
+            self._pred_stack_cache = (skey, stacks, keyed)
         static = [n for n in ex.arg_names if n not in scan_names]
         static_vals = [ex.arg_dict[n]._jx for n in static]
         aux = [a._jx for a in ex.aux_arrays]

@@ -309,7 +309,11 @@ def _batch_norm(attrs, inputs, aux, is_train, rng):
         # net step on TPU)
         xf = x.astype(jnp.float32)
         mean = jnp.mean(xf, axis=red)
-        var = jnp.mean(jnp.square(xf), axis=red) - jnp.square(mean)
+        # clamp: E[x^2]-E[x]^2 can go slightly negative from f32
+        # cancellation when |mean| >> std (e.g. raw 0-255 inputs); the
+        # clamp keeps rsqrt finite at some precision cost in that regime
+        var = jnp.maximum(
+            jnp.mean(jnp.square(xf), axis=red) - jnp.square(mean), 0.0)
     else:
         mean, var = moving_mean, moving_var
     g = jnp.ones_like(gamma) if attrs["fix_gamma"] else gamma

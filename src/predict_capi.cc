@@ -54,8 +54,10 @@ std::once_flag g_init_flag;
 bool g_init_ok = false;
 
 void init_python() {
+  bool we_initialized = false;
   if (!Py_IsInitialized()) {
     Py_InitializeEx(0);
+    we_initialized = true;
   }
   PyObject* sys_path = PySys_GetObject("path");  // borrowed
   const char* home = std::getenv("MXNET_TPU_HOME");
@@ -63,6 +65,11 @@ void init_python() {
     PyObject* p = PyUnicode_FromString(home);
     PyList_Insert(sys_path, 0, p);
     Py_DECREF(p);
+  }
+  if (we_initialized) {
+    // release the GIL Py_InitializeEx left held by this thread, or every
+    // other thread's PyGILState_Ensure would deadlock forever
+    PyEval_SaveThread();
   }
   g_init_ok = true;
 }

@@ -228,3 +228,35 @@ def test_crop_op():
     out2 = mx.nd.Crop(mx.nd.array(data), like, num_args=2,
                       center_crop=True).asnumpy()
     np.testing.assert_array_equal(out2, data[:, :, 1:5, 1:5])
+
+
+def test_spatial_family_gradients():
+    """Numeric gradients for the sampler family (the reference checks
+    these per-op in test_operator.py)."""
+    from mxnet_tpu.test_utils import check_numeric_gradient
+
+    rs = np.random.RandomState(4)
+    # BilinearSampler wrt data (grid fixed: its grad is smooth but the
+    # sampler is piecewise-bilinear in the grid -> data-only check)
+    data = rs.rand(1, 2, 5, 5).astype(np.float32)
+    grid = np.stack(np.meshgrid(np.linspace(-0.8, 0.8, 4),
+                                np.linspace(-0.8, 0.8, 4)))[None] \
+        .astype(np.float32)
+    s = mx.sym.BilinearSampler(mx.sym.Variable("data"),
+                               mx.sym.Variable("grid"))
+    check_numeric_gradient(s, {"data": data, "grid": grid},
+                           grad_nodes=["data"], rtol=0.05, atol=1e-3)
+    # Correlation wrt both inputs
+    a = rs.rand(1, 2, 5, 5).astype(np.float32)
+    b = rs.rand(1, 2, 5, 5).astype(np.float32)
+    s = mx.sym.Correlation(mx.sym.Variable("a"), mx.sym.Variable("b"),
+                           kernel_size=1, max_displacement=1,
+                           stride1=1, stride2=1, pad_size=1)
+    check_numeric_gradient(s, {"a": a, "b": b}, rtol=0.08, atol=5e-3)
+    # ROIPooling wrt data
+    x = rs.rand(1, 1, 6, 6).astype(np.float32)
+    rois = np.array([[0, 0, 0, 5, 5]], np.float32)
+    s = mx.sym.ROIPooling(mx.sym.Variable("x"), mx.sym.Variable("r"),
+                          pooled_size=(3, 3), spatial_scale=1.0)
+    check_numeric_gradient(s, {"x": x, "r": rois}, grad_nodes=["x"],
+                           rtol=0.08, atol=5e-3)

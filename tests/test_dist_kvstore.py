@@ -315,8 +315,11 @@ def test_launcher_ssh_mode(tmp_path):
     stub = tmp_path / "fake_ssh.sh"
     stub.write_text("#!/bin/sh\nshift\nexec sh -c \"$@\"\n")
     stub.chmod(0o755)
+    # the stub runs "remote" workers locally, so the coordinator address
+    # (hosts[0]) is unresolvable — pin the PS plane; in-graph sync has
+    # its own end-to-end test
     env = dict(os.environ, OUT_DIR=str(tmp_path), JAX_PLATFORMS="cpu",
-               MXNET_LAUNCH_SSH=str(stub))
+               MXNET_LAUNCH_SSH=str(stub), MXNET_DIST_INGRAPH="0")
     env.pop("DMLC_PS_ROOT_PORT", None)
     # exercise the real ssh addressing path (gethostname advertise +
     # bind-all), not the 127.0.0.1 left over from earlier tests

@@ -134,9 +134,9 @@ def main():
             p.error("--launcher %s requires %r on PATH (or set %s)"
                     % (args.launcher, prog, var))
     hosts = None
-    if args.launcher == "ssh":
-        if not args.hostfile:
-            p.error("--launcher ssh requires --hostfile")
+    if args.launcher == "ssh" and not args.hostfile:
+        p.error("--launcher ssh requires --hostfile")
+    if args.hostfile:
         with open(args.hostfile) as f:
             hosts = [h for h in (ln.strip() for ln in f)
                      if h and not h.startswith("#")]
@@ -158,11 +158,26 @@ def main():
         "DMLC_PS_ROOT_PORT": str(port),
         "DMLC_NUM_WORKER": str(args.num_workers),
         "DMLC_NUM_SERVER": str(max(1, args.num_servers)),
-        # jax.distributed coordinator for the in-graph gradient plane
-        # (rank 0 hosts it; see mxnet_tpu/dist.py)
-        "MXNET_COORDINATOR_ADDRESS": "%s:%d" % (root_uri, _free_port()),
         "PYTHONPATH": here + (os.pathsep + pypath if pypath else ""),
     }
+    # jax.distributed coordinator for the in-graph gradient plane: the
+    # service runs INSIDE rank-0's worker process, so the advertised host
+    # must be where rank 0 actually lands — localhost for the local
+    # launcher, hosts[0] for ssh/mpi-with-hostfile.  sge/yarn place
+    # workers on scheduler-chosen hosts the launcher cannot know, so
+    # in-graph sync is disabled there unless the user wires
+    # MXNET_COORDINATOR_ADDRESS to rank-0's node themselves.
+    if "MXNET_COORDINATOR_ADDRESS" not in base_env:
+        if args.launcher == "local" or \
+                (args.launcher == "mpi" and not args.hostfile):
+            wire["MXNET_COORDINATOR_ADDRESS"] = \
+                "127.0.0.1:%d" % _free_port()
+        elif args.launcher in ("ssh", "mpi"):
+            # can't probe a remote port: first free slot past the servers
+            wire["MXNET_COORDINATOR_ADDRESS"] = "%s:%d" % (
+                hosts[0], port + max(1, args.num_servers) + 7)
+        elif "MXNET_DIST_INGRAPH" not in base_env:
+            wire["MXNET_DIST_INGRAPH"] = "0"
     base_env.update(wire)
     # keys forwarded to remote hosts (wire protocol + role, per-worker id)
     fwd_keys = set(wire) | {"DMLC_ROLE", "DMLC_WORKER_ID"} | \
