@@ -54,9 +54,10 @@ if __name__ == "__main__":
 
     rs = np.random.RandomState(0)
     # sentiment = presence of "positive" vs "negative" token sets
-    pos_tokens = rs.choice(args.vocab, 20, replace=False)
+    k = min(20, args.vocab // 3)  # token-set size scales with the vocab
+    pos_tokens = rs.choice(args.vocab, k, replace=False)
     neg_tokens = rs.choice(
-        [t for t in range(args.vocab) if t not in set(pos_tokens)], 20,
+        [t for t in range(args.vocab) if t not in set(pos_tokens)], k,
         replace=False)
     n = 2048
     X = rs.randint(0, args.vocab, (n, args.seq_len))

@@ -53,3 +53,58 @@ def test_rl_actor_critic(tmp_path):
     # the bandit must be essentially solved (random = 0.25)
     final = float(out.strip().rsplit("final avg reward ", 1)[1].split()[0])
     assert final > 0.8
+
+
+def test_lstm_bucketing(tmp_path):
+    out = _run("rnn/lstm_bucketing.py", "--num-epochs", "1",
+               "--num-hidden", "16", "--num-embed", "16",
+               "--num-sentences", "60", "--vocab-size", "20",
+               "--batch-size", "8")
+    assert "Perplexity" in out or "perplexity" in out.lower()
+
+
+def test_gan_dcgan(tmp_path):
+    _run("gan/dcgan.py", "--num-steps", "2", "--batch-size", "4",
+         "--ngf", "8", "--ndf", "8", "--z-dim", "8")
+
+
+def test_rcnn_train(tmp_path):
+    _run("rcnn/train.py", "--num-steps", "2", "--image-size", "64",
+         "--num-classes", "3")
+
+
+def test_bi_lstm_sort(tmp_path):
+    _run("bi-lstm-sort/lstm_sort.py", "--num-epochs", "1",
+         "--seq-len", "4", "--vocab", "8", "--num-hidden", "12",
+         "--batch-size", "8")
+
+
+def test_nce_lm(tmp_path):
+    _run("nce-loss/nce_lm.py", "--num-steps", "4", "--vocab-size", "40",
+         "--num-hidden", "12", "--batch-size", "8")
+
+
+def test_fcn_xs(tmp_path):
+    _run("fcn-xs/fcn_xs.py", "--num-epochs", "1", "--side", "32",
+         "--batch-size", "2")
+
+
+def test_autoencoder(tmp_path):
+    _run("autoencoder/autoencoder.py", "--num-epochs", "1",
+         "--dims", "32,16", "--batch-size", "16")
+
+
+def test_stochastic_depth(tmp_path):
+    _run("stochastic-depth/sd_module.py", "--num-steps", "3",
+         "--num-blocks", "2", "--batch-size", "4")
+
+
+def test_text_cnn(tmp_path):
+    _run("cnn_text_classification/text_cnn.py", "--num-epochs", "1",
+         "--seq-len", "8", "--vocab", "30", "--embed-dim", "8",
+         "--num-filter", "4", "--batch-size", "8")
+
+
+def test_neural_style(tmp_path):
+    _run("neural-style/neural_style.py", "--num-steps", "2",
+         "--size", "48")
