@@ -166,3 +166,80 @@ print(json.dumps(params))
     assert proc.returncode == 0, proc.stderr[-3000:]
     out = json.loads(proc.stdout.strip().splitlines()[-1])
     return {k: np.asarray(v, np.float32) for k, v in out.items()}
+
+
+_WORKER_BN_DROPOUT = r"""
+import os, sys
+import numpy as np
+import jax; jax.config.update("jax_platforms", "cpu")
+import mxnet_tpu as mx
+from mxnet_tpu import io
+
+kv = mx.kv.create("dist_sync")
+rank, n = kv.rank, kv.num_workers
+assert kv.in_graph_sync
+
+rs = np.random.RandomState(13)
+X = rs.rand(64, 10).astype(np.float32)
+Y = rs.randint(0, 4, 64).astype(np.float32)
+local_x = X[rank * 32:(rank + 1) * 32]
+local_y = Y[rank * 32:(rank + 1) * 32]
+
+data = mx.sym.Variable("data")
+h = mx.sym.FullyConnected(data, num_hidden=16, name="fc1")
+h = mx.sym.BatchNorm(h, name="bn1")  # aux stats update in-graph
+h = mx.sym.Activation(h, act_type="relu")
+h = mx.sym.Dropout(h, p=0.25)  # multihost rng must advance per step
+h = mx.sym.FullyConnected(h, num_hidden=4, name="fc2")
+net = mx.sym.SoftmaxOutput(h, name="softmax")
+
+mod = mx.mod.Module(net, context=mx.cpu())
+it = io.NDArrayIter(local_x, local_y, batch_size=8)
+mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+np.random.seed(3 + rank)
+mod.init_params(mx.init.Xavier())
+mod.init_optimizer(kvstore=kv, optimizer="sgd",
+                   optimizer_params={"learning_rate": 0.1,
+                                     "momentum": 0.9})
+rngs = []
+for epoch in range(2):
+    it.reset()
+    for batch in it:
+        mod.forward_backward(batch)
+        mod.update()
+        rngs.append(int(np.asarray(mod._exec._rng_step)))
+assert rngs == sorted(set(rngs)), "rng step did not advance: %s" % rngs
+
+out = {}
+for k, v in mod.get_params()[0].items():
+    out[k] = v.asnumpy()
+for k, v in mod.get_params()[1].items():
+    out["aux_" + k] = v.asnumpy()
+np.savez(os.path.join(os.environ["OUT_DIR"], "bnp.%d.npz" % rank), **out)
+open(os.path.join(os.environ["OUT_DIR"], "ok.%d" % rank), "w").write("1")
+kv.close()
+"""
+
+
+def test_dist_sync_in_graph_bn_dropout(tmp_path):
+    """BatchNorm aux stats and Dropout masks come from the in-graph
+    global-batch computation: every worker must end with IDENTICAL
+    params AND moving stats, and the shared rng key must advance every
+    step (stale-key regression test)."""
+    script = tmp_path / "worker.py"
+    script.write_text(_WORKER_BN_DROPOUT)
+    env = dict(os.environ, OUT_DIR=str(tmp_path), JAX_PLATFORMS="cpu")
+    env.pop("DMLC_PS_ROOT_PORT", None)
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+         "-n", "2", sys.executable, str(script)],
+        env=env, timeout=540, capture_output=True, text=True)
+    assert proc.returncode == 0, (proc.stdout[-1500:], proc.stderr[-3000:])
+    p0 = dict(np.load(tmp_path / "bnp.0.npz"))
+    p1 = dict(np.load(tmp_path / "bnp.1.npz"))
+    assert any(k.startswith("aux_") for k in p0)
+    for k in p0:
+        np.testing.assert_array_equal(p0[k], p1[k], err_msg=k)
+    # training actually moved the BN stats
+    assert np.abs(p0["aux_bn1_moving_mean"]).sum() > 0
