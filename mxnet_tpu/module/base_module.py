@@ -143,23 +143,60 @@ class BaseModule:
             validation_metric = eval_metric
         eval_metric = _as_metric(eval_metric)
 
+        import os
+
+        # MXNET_BULK_TRAIN_STEPS=K dispatches K steps per XLA program
+        # (Module.run_bulk lax.scan) — the training-loop spelling of the
+        # reference's MXNET_EXEC_BULK_EXEC_TRAIN op bulking.  Metric
+        # updates and batch callbacks still fire per batch (from the
+        # scanned outputs); monitors need per-step observation, so a
+        # monitor forces the classic path.
+        bulk_k = max(1, int(os.environ.get("MXNET_BULK_TRAIN_STEPS", "1")))
+        use_bulk = bulk_k > 1 and monitor is None \
+            and hasattr(self, "run_bulk")
+
         for epoch in range(begin_epoch, num_epoch):
             tic = time.time()
             eval_metric.reset()
-            for nbatch, data_batch in enumerate(train_data):
-                if monitor is not None:
-                    monitor.tic()
-                self.forward_backward(data_batch)
-                self.update()
-                self.update_metric(eval_metric, data_batch.label)
-                if monitor is not None:
-                    monitor.toc_print()
-                if batch_end_callback is not None:
-                    batch_end_param = BatchEndParam(
-                        epoch=epoch, nbatch=nbatch, eval_metric=eval_metric,
-                        locals=locals())
-                    for callback in _as_list(batch_end_callback):
-                        callback(batch_end_param)
+            if use_bulk:
+                nbatch = -1
+                chunk = []
+
+                def _flush(chunk, nbatch):
+                    outs = self.run_bulk(chunk, return_outputs=True)
+                    for i, b in enumerate(chunk):
+                        nbatch += 1
+                        eval_metric.update(b.label, [o[i] for o in outs])
+                        if batch_end_callback is not None:
+                            bp = BatchEndParam(epoch=epoch, nbatch=nbatch,
+                                               eval_metric=eval_metric,
+                                               locals=locals())
+                            for callback in _as_list(batch_end_callback):
+                                callback(bp)
+                    return nbatch
+
+                for data_batch in train_data:
+                    chunk.append(data_batch)
+                    if len(chunk) == bulk_k:
+                        nbatch = _flush(chunk, nbatch)
+                        chunk = []
+                if chunk:
+                    nbatch = _flush(chunk, nbatch)
+            else:
+                for nbatch, data_batch in enumerate(train_data):
+                    if monitor is not None:
+                        monitor.tic()
+                    self.forward_backward(data_batch)
+                    self.update()
+                    self.update_metric(eval_metric, data_batch.label)
+                    if monitor is not None:
+                        monitor.toc_print()
+                    if batch_end_callback is not None:
+                        batch_end_param = BatchEndParam(
+                            epoch=epoch, nbatch=nbatch,
+                            eval_metric=eval_metric, locals=locals())
+                        for callback in _as_list(batch_end_callback):
+                            callback(batch_end_param)
             for name, val in eval_metric.get_name_value():
                 self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
             toc = time.time()

@@ -563,7 +563,7 @@ class Module(BaseModule):
             ex.grad_dict[n]._jx = g
         ex._pending_grads = None
 
-    def run_bulk(self, batches):
+    def run_bulk(self, batches, return_outputs=False):
         """Run ``len(batches)`` full fwd+bwd+update steps as ONE XLA
         dispatch: ``lax.scan`` over the stacked batches with params /
         momenta / aux (BN stats) as the scan carry.
@@ -577,18 +577,29 @@ class Module(BaseModule):
         back to per-batch ``forward_backward``+``update`` otherwise.
         After the call ``get_outputs()`` returns the LAST step's outputs;
         per-step gradients are not materialized (``grad_dict`` is stale —
-        the scan keeps them on-chip)."""
+        the scan keeps them on-chip).
+
+        ``return_outputs=True`` additionally returns, per symbol output,
+        a host numpy array stacked over the batches (``(K, ...)``) — one
+        transfer for all K steps' outputs, for metric updates."""
         import jax
         import jax.numpy as jnp
 
         if not batches:
-            return
+            return [] if return_outputs else None
         if not self._full_step_eligible() or self._optimizer is None \
                 or self._dist_dp:
+            per_batch = []
             for b in batches:
                 self.forward_backward(b)
                 self.update()
-            return
+                if return_outputs:
+                    per_batch.append([o.asnumpy()
+                                      for o in self.get_outputs()])
+            if return_outputs:
+                return [np.stack([pb[i] for pb in per_batch])
+                        for i in range(len(per_batch[0]))]
+            return None
         ex = self._exec
         optimizer, updater = self._optimizer, self._updater
         names = [n for n in self._param_names
@@ -664,6 +675,9 @@ class Module(BaseModule):
         for i, m in enumerate(new_m):
             updater.states[i]._jx = m
         ex._pending_grads = None
+        if return_outputs:
+            return [np.asarray(o) for o in outs_stack]
+        return None
 
     def predict_bulk(self, batches):
         """Run ``len(batches)`` inference forwards as ONE XLA dispatch

@@ -389,3 +389,41 @@ def test_predict_bulk_matches_forward():
         mod.forward(b, is_train=False)
         ref = mod.get_outputs()[0].asnumpy()
         assert_almost_equal(outs[0].asnumpy(), ref, rtol=1e-5, atol=1e-6)
+
+
+def test_fit_with_bulk_train_steps_matches_classic():
+    """MXNET_BULK_TRAIN_STEPS=K: fit() trains through run_bulk with
+    per-batch metric updates; final params and the train metric must
+    match the classic per-batch loop."""
+    import os
+
+    x, y = _toy_data(192)
+
+    def run(bulk):
+        os.environ["MXNET_FUSE_TRAIN_STEP"] = "1"
+        if bulk:
+            os.environ["MXNET_BULK_TRAIN_STEPS"] = "4"
+        try:
+            mx.random.seed(0)
+            np.random.seed(0)
+            train = io.NDArrayIter(x, y, batch_size=16)
+            mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+            accs = []
+            mod.fit(train, optimizer="sgd",
+                    optimizer_params={"learning_rate": 0.2,
+                                      "momentum": 0.9},
+                    initializer=mx.init.Xavier(), num_epoch=3,
+                    batch_end_callback=lambda p: accs.append(
+                        p.eval_metric.get()[1]))
+            return ({k: v.asnumpy() for k, v in mod.get_params()[0].items()},
+                    accs)
+        finally:
+            os.environ.pop("MXNET_FUSE_TRAIN_STEP", None)
+            os.environ.pop("MXNET_BULK_TRAIN_STEPS", None)
+
+    p_classic, acc_classic = run(False)
+    p_bulk, acc_bulk = run(True)
+    assert len(acc_bulk) == len(acc_classic) > 0
+    for k in p_classic:
+        assert_almost_equal(p_bulk[k], p_classic[k], rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(acc_bulk, acc_classic, rtol=1e-6)
