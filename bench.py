@@ -28,7 +28,7 @@ STEPS = int(os.environ.get("BENCH_STEPS", "20"))
 BULK = max(1, int(os.environ.get("BENCH_BULK", "10")))
 # the tunneled chip is a shared resource with large run-to-run variance;
 # best-of-N timed repetitions is the standard interference-robust estimate
-REPEATS = max(1, int(os.environ.get("BENCH_REPEATS", "3")))
+REPEATS = max(1, int(os.environ.get("BENCH_REPEATS", "5")))
 BASELINE_IPS = 45.52  # K80 ResNet-50 train, docs/how_to/perf.md:108-117
 DTYPE = os.environ.get("BENCH_DTYPE", "bfloat16")
 # ResNet-50 @224: ~4.1 GFLOP forward/img; fwd+bwd ~= 3x forward

@@ -240,6 +240,13 @@ class Module(BaseModule):
                         % (s[0], local_devs))
         elif len(self._context) > 1:
             self._mesh = self._make_mesh()
+            if self._work_load_list and \
+                    len(set(self._work_load_list)) > 1:
+                # XLA sharding splits the batch uniformly; the
+                # reference's weighted decide_slices has no SPMD analog
+                self.logger.warning(
+                    "work_load_list with non-uniform weights is ignored: "
+                    "the mesh shards the batch evenly across devices")
             for _, s in self._data_shapes + self._label_shapes:
                 if s and s[0] % len(self._context) != 0:
                     raise MXNetError(

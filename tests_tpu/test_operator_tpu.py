@@ -110,3 +110,47 @@ def test_module_train_step_parity():
     (ca, ta) = params.values()
     for k in ca:
         np.testing.assert_allclose(ca[k], ta[k], rtol=2e-2, atol=2e-3)
+
+
+def test_run_bulk_parity_on_tpu():
+    """run_bulk (scanned steps) must match sequential fused steps ON THE
+    CHIP — guards the scan lowering against backend regressions."""
+    import os
+
+    rs = np.random.RandomState(0)
+    batches = [mx.io.DataBatch(
+        data=[mx.nd.array(rs.rand(8, 6).astype(np.float32))],
+        label=[mx.nd.array(rs.randint(0, 3, 8).astype(np.float32))])
+        for _ in range(3)]
+
+    def build():
+        net = mx.sym.SoftmaxOutput(mx.sym.FullyConnected(
+            mx.sym.Variable("data"), num_hidden=3, name="fc"),
+            name="softmax")
+        mod = mx.mod.Module(net, context=mx.tpu())
+        mod.bind(data_shapes=[("data", (8, 6))],
+                 label_shapes=[("softmax_label", (8,))])
+        mod.init_params(mx.init.Zero())
+        irs = np.random.RandomState(5)
+        mod.set_params({n: mx.nd.array(
+            irs.normal(0, 0.1, a.shape).astype(np.float32))
+            for n, a in mod.get_params()[0].items()}, {})
+        mod.init_optimizer(optimizer="sgd",
+                           optimizer_params={"learning_rate": 0.1,
+                                             "momentum": 0.9})
+        return mod
+
+    os.environ["MXNET_FUSE_TRAIN_STEP"] = "1"
+    try:
+        seq = build()
+        for b in batches:
+            seq.forward_backward(b)
+            seq.update()
+        blk = build()
+        blk.run_bulk(batches)
+    finally:
+        os.environ.pop("MXNET_FUSE_TRAIN_STEP", None)
+    ps, pb = seq.get_params()[0], blk.get_params()[0]
+    for k in ps:
+        np.testing.assert_allclose(pb[k].asnumpy(), ps[k].asnumpy(),
+                                   rtol=2e-3, atol=1e-4)
