@@ -1,0 +1,70 @@
+/* Minimal deployment client on the C predict ABI — the surface the
+ * reference's matlab binding and amalgamation mobile builds sit on
+ * (reference src/c_api/c_predict_api.cc; here include/mxnet_tpu/
+ * c_predict_api.h backed by libmxnet_tpu_predict.so).
+ *
+ * Usage: predict <prefix-symbol.json> <prefix-0000.params> <n> <dim>
+ * Feeds an n x dim batch of ramp values and prints the output row sums.
+ */
+#include <stdio.h>
+#include <stdlib.h>
+
+#include "mxnet_tpu/c_predict_api.h"
+
+static void* slurp(const char* path, long* size) {
+    FILE* f = fopen(path, "rb");
+    if (f == NULL) { perror(path); exit(1); }
+    fseek(f, 0, SEEK_END);
+    *size = ftell(f);
+    fseek(f, 0, SEEK_SET);
+    void* buf = malloc(*size + 1);
+    if (fread(buf, 1, *size, f) != (size_t)*size) { exit(1); }
+    ((char*)buf)[*size] = 0;
+    fclose(f);
+    return buf;
+}
+
+int main(int argc, char** argv) {
+    if (argc < 5) {
+        fprintf(stderr, "usage: %s symbol.json params N DIM\n", argv[0]);
+        return 2;
+    }
+    long jn, pn;
+    char* json = slurp(argv[1], &jn);
+    void* params = slurp(argv[2], &pn);
+    uint32_t n = (uint32_t)atoi(argv[3]);
+    uint32_t dim = (uint32_t)atoi(argv[4]);
+
+    const char* keys[] = {"data"};
+    uint32_t indptr[] = {0, 2};
+    uint32_t shape[] = {n, dim};
+    PredictorHandle h;
+    if (MXPredCreate(json, params, (int)pn, 1, 0, 1, keys, indptr, shape,
+                     &h) != 0) {
+        fprintf(stderr, "create: %s\n", MXGetLastError());
+        return 1;
+    }
+    float* in = malloc(sizeof(float) * n * dim);
+    for (uint32_t i = 0; i < n * dim; ++i) in[i] = (float)i / (n * dim);
+    if (MXPredSetInput(h, "data", in, n * dim) != 0 ||
+        MXPredForward(h) != 0) {
+        fprintf(stderr, "run: %s\n", MXGetLastError());
+        return 1;
+    }
+    uint32_t *shp, ndim;
+    MXPredGetOutputShape(h, 0, &shp, &ndim);
+    uint32_t total = 1;
+    printf("output shape:");
+    for (uint32_t i = 0; i < ndim; ++i) { printf(" %u", shp[i]); total *= shp[i]; }
+    printf("\n");
+    float* out = malloc(sizeof(float) * total);
+    MXPredGetOutput(h, 0, out, total);
+    for (uint32_t r = 0; r < shp[0]; ++r) {
+        float s = 0;
+        for (uint32_t c = 0; c < total / shp[0]; ++c)
+            s += out[r * (total / shp[0]) + c];
+        printf("row %u sum %.4f\n", r, s);
+    }
+    MXPredFree(h);
+    return 0;
+}
