@@ -34,6 +34,10 @@ int main(int argc, char** argv) {
     void* params = slurp(argv[2], &pn);
     uint32_t n = (uint32_t)atoi(argv[3]);
     uint32_t dim = (uint32_t)atoi(argv[4]);
+    if (n == 0 || dim == 0) {
+        fprintf(stderr, "N and DIM must be positive integers\n");
+        return 2;
+    }
 
     const char* keys[] = {"data"};
     uint32_t indptr[] = {0, 2};
@@ -52,7 +56,10 @@ int main(int argc, char** argv) {
         return 1;
     }
     uint32_t *shp, ndim;
-    MXPredGetOutputShape(h, 0, &shp, &ndim);
+    if (MXPredGetOutputShape(h, 0, &shp, &ndim) != 0 || ndim == 0) {
+        fprintf(stderr, "output shape: %s\n", MXGetLastError());
+        return 1;
+    }
     uint32_t total = 1;
     printf("output shape:");
     for (uint32_t i = 0; i < ndim; ++i) { printf(" %u", shp[i]); total *= shp[i]; }

@@ -154,6 +154,13 @@ class BaseModule:
         bulk_k = max(1, int(os.environ.get("MXNET_BULK_TRAIN_STEPS", "1")))
         use_bulk = bulk_k > 1 and monitor is None \
             and hasattr(self, "run_bulk")
+        if use_bulk and hasattr(self, "_full_step_eligible") \
+                and not self._full_step_eligible():
+            self.logger.warning(
+                "MXNET_BULK_TRAIN_STEPS=%d has no effect: the fused step "
+                "is not eligible (requires MXNET_FUSE_TRAIN_STEP=1, plain "
+                "SGD, local/in-graph kvstore); training runs per batch",
+                bulk_k)
 
         for epoch in range(begin_epoch, num_epoch):
             tic = time.time()

@@ -612,10 +612,17 @@ class Module(BaseModule):
         names = [n for n in self._param_names
                  if ex.grad_dict.get(n) is not None]
         if not names:
+            per_batch = []
             for b in batches:
                 self.forward_backward(b)
                 self.update()
-            return
+                if return_outputs:
+                    per_batch.append([o.asnumpy()
+                                      for o in self.get_outputs()])
+            if return_outputs:
+                return [np.stack([pb[i] for pb in per_batch])
+                        for i in range(len(per_batch[0]))]
+            return None
         self._pending_full = False
         for idx in range(len(names)):
             if idx not in updater.states:
