@@ -243,3 +243,72 @@ def test_dist_sync_in_graph_bn_dropout(tmp_path):
         np.testing.assert_array_equal(p0[k], p1[k], err_msg=k)
     # training actually moved the BN stats
     assert np.abs(p0["aux_bn1_moving_mean"]).sum() > 0
+
+
+_WORKER_BOTH_PLANES = r"""
+import os, sys
+import numpy as np
+import jax; jax.config.update("jax_platforms", "cpu")
+import mxnet_tpu as mx
+from mxnet_tpu import io
+
+kv = mx.kv.create("dist_sync")
+rank, n = kv.rank, kv.num_workers
+assert n == 4 and kv.in_graph_sync and kv._num_servers == 2
+
+# PS plane alongside the collective plane: sharded big-array exactness
+big = np.arange(12, dtype=np.float32)
+kv.init(3, mx.nd.zeros((12,)))
+kv.push(3, mx.nd.array(big * (rank + 1)))
+out = mx.nd.zeros((12,))
+kv.pull(3, out=out)
+np.testing.assert_array_equal(out.asnumpy(), big * 10)  # 1+2+3+4
+
+# collective plane: 4-way in-graph DP
+rs = np.random.RandomState(21)
+X = rs.rand(64, 6).astype(np.float32)
+Y = rs.randint(0, 3, 64).astype(np.float32)
+lx = X[rank * 16:(rank + 1) * 16]
+ly = Y[rank * 16:(rank + 1) * 16]
+net = mx.sym.SoftmaxOutput(mx.sym.FullyConnected(
+    mx.sym.Variable("data"), num_hidden=3, name="fc"), name="softmax")
+mod = mx.mod.Module(net, context=mx.cpu())
+it = io.NDArrayIter(lx, ly, batch_size=8)
+mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+np.random.seed(rank * 11 + 1)
+mod.init_params(mx.init.Xavier())
+mod.init_optimizer(kvstore=kv, optimizer="sgd",
+                   optimizer_params={"learning_rate": 0.2})
+for _ in range(2):
+    it.reset()
+    for b in it:
+        mod.forward_backward(b)
+        mod.update()
+w = mod.get_params()[0]["fc_weight"].asnumpy()
+np.save(os.path.join(os.environ["OUT_DIR"], "w%d.npy" % rank), w)
+open(os.path.join(os.environ["OUT_DIR"], "ok.%d" % rank), "w").write("1")
+kv.close()
+"""
+
+
+def test_four_workers_two_servers_both_planes(tmp_path):
+    """4 workers x 2 PS shards: the sharded push/pull plane and the
+    in-graph collective plane coexist in one job; weights identical on
+    every worker."""
+    script = tmp_path / "worker.py"
+    script.write_text(_WORKER_BOTH_PLANES)
+    env = dict(os.environ, OUT_DIR=str(tmp_path), JAX_PLATFORMS="cpu",
+               MXNET_KVSTORE_BIGARRAY_BOUND="8")
+    env.pop("DMLC_PS_ROOT_PORT", None)
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+         "-n", "4", "-s", "2",
+         "--env", "MXNET_KVSTORE_BIGARRAY_BOUND=8",
+         sys.executable, str(script)],
+        env=env, timeout=540, capture_output=True, text=True)
+    assert proc.returncode == 0, (proc.stdout[-1500:], proc.stderr[-3000:])
+    ws = [np.load(tmp_path / ("w%d.npy" % r)) for r in range(4)]
+    for r in range(1, 4):
+        np.testing.assert_array_equal(ws[0], ws[r])
+    assert np.abs(ws[0]).sum() > 0
