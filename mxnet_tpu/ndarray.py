@@ -378,17 +378,22 @@ def onehot_encode(indices, out):
 
 def imdecode(str_img, clip_rect=(0, 0, 0, 0), out=None, index=0, channels=3,
              mean=None):
-    """Decode an image buffer (reference ``_imdecode``). Uses PIL if present."""
+    """Decode an image buffer (reference ``_imdecode``), via PIL when
+    present, else OpenCV (always available in this framework)."""
     import io as _io
 
+    buf = str_img if isinstance(str_img, bytes) else str_img.encode()
     try:
         from PIL import Image
-    except ImportError as e:
-        raise MXNetError("imdecode requires PIL") from e
-    img = Image.open(_io.BytesIO(str_img if isinstance(str_img, bytes)
-                                 else str_img.encode()))
-    arr = np.asarray(img.convert("RGB" if channels == 3 else "L"),
-                     dtype=np.float32)
+
+        img = Image.open(_io.BytesIO(buf))
+        arr = np.asarray(img.convert("RGB" if channels == 3 else "L"),
+                         dtype=np.float32)
+    except ImportError:
+        from .image import imdecode as _cv_imdecode
+
+        arr = _cv_imdecode(buf, flag=1 if channels == 3 else 0)
+        arr = np.asarray(arr, np.float32)
     if arr.ndim == 2:
         arr = arr[:, :, None]
     arr = arr.transpose(2, 0, 1)[None]
