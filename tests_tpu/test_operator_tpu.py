@@ -192,3 +192,43 @@ def test_flash_attention_pallas_on_chip():
     np.testing.assert_allclose(out_t, out_c, rtol=2e-2, atol=2e-2)
     for n in g_c:
         np.testing.assert_allclose(g_t[n], g_c[n], rtol=3e-2, atol=3e-2)
+
+
+def test_optimizer_kernels_parity():
+    """Fused optimizer update kernels (the reference's sgd_update/
+    adam_update .cu kernels) produce the same results on TPU as CPU."""
+    rs = np.random.RandomState(7)
+    w = rs.randn(64, 32).astype(np.float32)
+    g = rs.randn(64, 32).astype(np.float32) * 0.1
+    m = rs.randn(64, 32).astype(np.float32) * 0.01
+    v = np.abs(rs.randn(64, 32)).astype(np.float32) * 0.01
+
+    def on(ctx):
+        res = {}
+        out = mx.nd.sgd_update(mx.nd.array(w, ctx=ctx),
+                               mx.nd.array(g, ctx=ctx), lr=0.1, wd=0.01)
+        res["sgd"] = (out[0] if isinstance(out, list) else out).asnumpy()
+        out = mx.nd.sgd_mom_update(mx.nd.array(w, ctx=ctx),
+                                   mx.nd.array(g, ctx=ctx),
+                                   mx.nd.array(m, ctx=ctx),
+                                   lr=0.1, momentum=0.9, wd=0.01)
+        res["sgdm"] = (out[0] if isinstance(out, list) else out).asnumpy()
+        out = mx.nd.adam_update(mx.nd.array(w, ctx=ctx),
+                                mx.nd.array(g, ctx=ctx),
+                                mx.nd.array(m, ctx=ctx),
+                                mx.nd.array(v, ctx=ctx),
+                                lr=0.01, beta1=0.9, beta2=0.999,
+                                epsilon=1e-8, wd=0.0)
+        res["adam"] = (out[0] if isinstance(out, list) else out).asnumpy()
+        out = mx.nd.rmsprop_update(mx.nd.array(w, ctx=ctx),
+                                   mx.nd.array(g, ctx=ctx),
+                                   mx.nd.array(v, ctx=ctx),
+                                   lr=0.01, gamma1=0.95, epsilon=1e-8,
+                                   wd=0.0)
+        res["rmsprop"] = (out[0] if isinstance(out, list) else out).asnumpy()
+        return res
+
+    cpu, tpu = on(mx.cpu()), on(mx.tpu())
+    for k in cpu:
+        np.testing.assert_allclose(tpu[k], cpu[k], rtol=1e-5, atol=1e-6,
+                                   err_msg=k)
