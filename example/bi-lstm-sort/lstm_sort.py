@@ -23,6 +23,7 @@ if __name__ == "__main__":
     parser.add_argument("--vocab", type=int, default=10)
     parser.add_argument("--num-hidden", type=int, default=64)
     parser.add_argument("--batch-size", type=int, default=32)
+    parser.add_argument("--num-examples", type=int, default=2048)
     parser.add_argument("--num-epochs", type=int, default=8)
     args = parser.parse_args()
 
@@ -40,7 +41,7 @@ if __name__ == "__main__":
     net = mx.sym.SoftmaxOutput(pred, label_r, name="softmax")
 
     rs = np.random.RandomState(0)
-    X = rs.randint(0, V, (2048, T))
+    X = rs.randint(0, V, (args.num_examples, T))
     Y = np.sort(X, axis=1)
     it = mx.io.NDArrayIter({"data": X.astype(np.float32)},
                            {"softmax_label": Y.astype(np.float32)},

@@ -50,6 +50,7 @@ if __name__ == "__main__":
     parser.add_argument("--num-filter", type=int, default=32)
     parser.add_argument("--batch-size", type=int, default=32)
     parser.add_argument("--num-epochs", type=int, default=5)
+    parser.add_argument("--num-examples", type=int, default=2048)
     args = parser.parse_args()
 
     rs = np.random.RandomState(0)
@@ -59,7 +60,7 @@ if __name__ == "__main__":
     neg_tokens = rs.choice(
         [t for t in range(args.vocab) if t not in set(pos_tokens)], k,
         replace=False)
-    n = 2048
+    n = args.num_examples
     X = rs.randint(0, args.vocab, (n, args.seq_len))
     y = rs.randint(0, 2, n)
     for i in range(n):

@@ -36,8 +36,10 @@ parser.add_argument("--disp-batches", type=int, default=50)
 parser.add_argument("--kv-store", type=str, default="device")
 parser.add_argument("--num-sentences", type=int, default=2000)
 parser.add_argument("--vocab-size", type=int, default=100)
+parser.add_argument("--buckets", type=str, default="10,20,30,40,50,60",
+                    help="comma-separated bucket lengths")
 
-BUCKETS = [10, 20, 30, 40, 50, 60]
+BUCKETS = [10, 20, 30, 40, 50, 60]  # overridden by --buckets after parse
 START_TOKEN = 2  # 0 = pad/invalid, 1 = unk
 
 
@@ -68,6 +70,7 @@ if __name__ == "__main__":
     logging.basicConfig(level=logging.INFO,
                         format="%(asctime)-15s %(message)s")
     args = parser.parse_args()
+    BUCKETS = [int(b) for b in args.buckets.split(",")]
     train_sent = synth_corpus(args.num_sentences, args.vocab_size)
     val_sent = synth_corpus(args.num_sentences // 10, args.vocab_size,
                             seed=17)

@@ -59,7 +59,7 @@ def test_lstm_bucketing(tmp_path):
     out = _run("rnn/lstm_bucketing.py", "--num-epochs", "1",
                "--num-hidden", "16", "--num-embed", "16",
                "--num-sentences", "60", "--vocab-size", "20",
-               "--batch-size", "8")
+               "--batch-size", "8", "--buckets", "10,20")
     assert "Perplexity" in out or "perplexity" in out.lower()
 
 
@@ -76,7 +76,7 @@ def test_rcnn_train(tmp_path):
 def test_bi_lstm_sort(tmp_path):
     _run("bi-lstm-sort/lstm_sort.py", "--num-epochs", "1",
          "--seq-len", "4", "--vocab", "8", "--num-hidden", "12",
-         "--batch-size", "8")
+         "--batch-size", "8", "--num-examples", "256")
 
 
 def test_nce_lm(tmp_path):
@@ -102,7 +102,8 @@ def test_stochastic_depth(tmp_path):
 def test_text_cnn(tmp_path):
     _run("cnn_text_classification/text_cnn.py", "--num-epochs", "1",
          "--seq-len", "8", "--vocab", "30", "--embed-dim", "8",
-         "--num-filter", "4", "--batch-size", "8")
+         "--num-filter", "4", "--batch-size", "8",
+         "--num-examples", "256")
 
 
 def test_neural_style(tmp_path):
