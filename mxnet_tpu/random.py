@@ -27,11 +27,19 @@ _seed_value = 0
 
 
 def seed(seed_state):
-    """reference ``random.py:40`` / MXRandomSeed"""
+    """reference ``random.py:40`` / MXRandomSeed.
+
+    Also seeds numpy's global RNG: the reference's initializers draw from
+    the engine RNG that MXRandomSeed controls, so ``mx.random.seed(n)``
+    makes ``init_params`` reproducible there — here the initializer zoo
+    samples via ``np.random``, and seeding it keeps that contract."""
+    import numpy as _np
+
     global _key, _seed_value
     with _lock:
         _seed_value = int(seed_state)
         _key = jax.random.PRNGKey(int(seed_state))
+        _np.random.seed(int(seed_state) & 0xFFFFFFFF)
 
 
 def get_seed():

@@ -66,3 +66,23 @@ def test_mx_random_namespace():
     assert x.shape == (4, 4)
     y = mx.random.normal(0, 1, shape=(3,), dtype="float32")
     assert y.dtype == np.float32
+
+
+def test_seed_makes_init_params_reproducible():
+    """Reference contract: mx.random.seed(n) alone reproduces
+    init_params draws (MXRandomSeed controls the RNG initializers use)."""
+    import mxnet_tpu as mx
+
+    def draw():
+        mx.random.seed(1234)
+        net = mx.sym.FullyConnected(mx.sym.Variable("data"),
+                                    num_hidden=8, name="fc")
+        mod = mx.mod.Module(mx.sym.SoftmaxOutput(net, name="softmax"),
+                            context=mx.cpu())
+        mod.bind(data_shapes=[("data", (4, 6))],
+                 label_shapes=[("softmax_label", (4,))])
+        mod.init_params(mx.init.Xavier())
+        return mod.get_params()[0]["fc_weight"].asnumpy()
+
+    w1, w2 = draw(), draw()
+    np.testing.assert_array_equal(w1, w2)
