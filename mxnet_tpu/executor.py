@@ -747,9 +747,17 @@ class Executor:
                     args, aux, rng, out_grads)
                 sp.sync(grads)
         for name in self._diff_names():
-            g = grads[name]
+            g = grads.get(name)
             dst = self.grad_dict.get(name)
             if dst is None:
+                continue
+            if g is None:
+                # segmented (group2ctx) backward only produces cotangents
+                # for variables reached by the chain; a bound-but-unused
+                # differentiable param gets a zero gradient (write) or is
+                # left untouched (add)
+                if self.grad_req[name] != "add":
+                    dst._jx = jnp.zeros_like(dst._jx)
                 continue
             if self.grad_req[name] == "add":
                 dst._jx = dst._jx + g

@@ -315,13 +315,17 @@ class KVStoreDist(KVStore):
                                   sock=self._socks[self._server_of(k)])
                 val = array(reply["value"])
             else:
-                flat = _np.empty((size,), _np.float32)
+                flat = None
                 for sk, sid, sl in shards:
                     reply = self._rpc(
                         {"cmd": "pull", "key": sk,
                          "version": self._versions.get(sk, 0)},
                         sock=self._socks[sid])
-                    part = _np.asarray(reply["value"], _np.float32)
+                    part = _np.asarray(reply["value"])
+                    if flat is None:
+                        # dtype follows the stored shards — a hardcoded
+                        # f32 buffer would silently cast f64/int/bf16 keys
+                        flat = _np.empty((size,), part.dtype)
                     flat[sl] = part
                 val = array(flat.reshape(olist[0].shape))
             for o in olist:

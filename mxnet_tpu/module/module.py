@@ -778,12 +778,30 @@ class Module(BaseModule):
         param_arrays = [[self._exec.arg_dict[n]] for n in self._param_names]
         grad_arrays = [[self._exec.grad_dict.get(n)]
                        for n in self._param_names]
+        if self._mesh is not None and self._updater is not None:
+            # mesh-placed weights/grads cannot enter an update jit with
+            # locally-committed optimizer state: create + place states
+            # (momentum, adam mean/var, ...) on the module mesh up front
+            for index, n in enumerate(self._param_names):
+                if self._exec.grad_dict.get(n) is None:
+                    continue
+                if index not in self._updater.states:
+                    self._updater.states[index] = \
+                        self._optimizer.create_state(
+                            index, self._exec.arg_dict[n])
+                self._place_opt_state(index, self._updater.states[index], n)
         if self._update_on_kvstore:
             _update_params_on_kvstore(param_arrays, grad_arrays,
                                       self._kvstore)
         else:
+            # in_graph_sync: gradients were already globally psum'd inside
+            # the step — pushing them through the PS would sum them across
+            # num_workers a second time.  The PS stays a control plane
+            # (init / explicit push-pull), not a gradient plane.
+            kv = None if getattr(self._kvstore, "in_graph_sync", False) \
+                else self._kvstore
             _update_params(param_arrays, grad_arrays, updater=self._updater,
-                           num_device=1, kvstore=self._kvstore)
+                           num_device=1, kvstore=kv)
 
     def _get_hyper_arrays(self, optimizer, n):
         """Device copies of per-index lr/wd, re-uploaded only when a
@@ -814,17 +832,29 @@ class Module(BaseModule):
         if state is None or self._mesh is None \
                 or idx in self._dist_placed_states:
             return state
-        if self._dist_dp:
-            from .. import dist as _dist
 
-            state._jx = _dist.replicate(self._mesh, np.asarray(state._jx))
+        def place(arr):
+            if arr is None:
+                return
+            if self._dist_dp:
+                from .. import dist as _dist
+
+                arr._jx = _dist.replicate(self._mesh, np.asarray(arr._jx))
+            else:
+                import jax
+                from jax.sharding import NamedSharding
+
+                arr._jx = jax.device_put(
+                    arr._jx, NamedSharding(self._mesh,
+                                           self._param_spec(name)))
+
+        # multi-array states (adam mean/var, rmsprop n/g/delta) place
+        # every element alongside the parameter
+        if isinstance(state, (tuple, list)):
+            for s in state:
+                place(s)
         else:
-            import jax
-            from jax.sharding import NamedSharding
-
-            state._jx = jax.device_put(
-                state._jx, NamedSharding(self._mesh,
-                                         self._param_spec(name)))
+            place(state)
         self._dist_placed_states.add(idx)
         return state
 
@@ -949,3 +979,7 @@ class Module(BaseModule):
         else:
             with open(fname, "rb") as fin:
                 self._updater.set_states(fin.read())
+            # the unpickled states are locally-committed host arrays —
+            # they must be re-placed on the module mesh before the next
+            # update jit sees them
+            self._dist_placed_states.clear()

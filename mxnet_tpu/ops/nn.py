@@ -308,12 +308,17 @@ def _batch_norm(attrs, inputs, aux, is_train, rng):
         # second time — BN reductions are the bandwidth hot spot of a conv
         # net step on TPU)
         xf = x.astype(jnp.float32)
-        mean = jnp.mean(xf, axis=red)
-        # clamp: E[x^2]-E[x]^2 can go slightly negative from f32
-        # cancellation when |mean| >> std (e.g. raw 0-255 inputs); the
-        # clamp keeps rsqrt finite at some precision cost in that regime
+        # shifted single-pass variance: center on a per-channel probe
+        # (first element, gradient-stopped — the shifts cancel exactly in
+        # mean and var) so E[d^2]-E[d]^2 cancels catastrophically only
+        # when |mean-probe| >> std, not |mean| >> std (raw 0-255 inputs)
+        probe = jax.lax.stop_gradient(
+            xf[(0, slice(None)) + (0,) * (x.ndim - 2)])
+        d = xf - probe.reshape(bshape)
+        mean_d = jnp.mean(d, axis=red)
         var = jnp.maximum(
-            jnp.mean(jnp.square(xf), axis=red) - jnp.square(mean), 0.0)
+            jnp.mean(jnp.square(d), axis=red) - jnp.square(mean_d), 0.0)
+        mean = mean_d + probe
     else:
         mean, var = moving_mean, moving_var
     g = jnp.ones_like(gamma) if attrs["fix_gamma"] else gamma

@@ -39,7 +39,14 @@ std::string py_error() {
   if (value != nullptr) {
     PyObject* s = PyObject_Str(value);
     if (s != nullptr) {
-      msg = PyUnicode_AsUTF8(s);
+      const char* utf8 = PyUnicode_AsUTF8(s);
+      if (utf8 != nullptr) {
+        msg = utf8;
+      } else {
+        // non-UTF8-encodable exception text: AsUTF8 raised a fresh
+        // UnicodeEncodeError that must not stay pending after we return
+        PyErr_Clear();
+      }
       Py_DECREF(s);
     }
   }

@@ -53,8 +53,11 @@ mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
 np.random.seed(7 if rank == 0 else 999)  # DIFFERENT init per rank on
 # purpose: only rank 0's draw may survive (the broadcast-from-root check)
 mod.init_params(mx.init.Xavier(rnd_type="gaussian", magnitude=2.0))
-mod.init_optimizer(kvstore=kv, optimizer="sgd",
-                   optimizer_params={"learning_rate": 0.2, "momentum": 0.9})
+opt_name = os.environ.get("TEST_OPT", "sgd")
+opt_params = {"learning_rate": 0.2, "momentum": 0.9} if opt_name == "sgd" \
+    else {"learning_rate": 0.05}
+mod.init_optimizer(kvstore=kv, optimizer=opt_name,
+                   optimizer_params=opt_params)
 init_pushes = pushes["n"]
 
 assert mod._dist_dp, "module did not enter global-mesh mode"
@@ -76,10 +79,15 @@ kv.close()
 """
 
 
-def test_dist_sync_in_graph_two_workers(tmp_path):
+@pytest.mark.parametrize("opt_name", ["sgd", "adam"])
+def test_dist_sync_in_graph_two_workers(tmp_path, opt_name):
+    # adam covers the non-fused update path: gradients are already
+    # globally psum'd in-graph, so update() must NOT route them through
+    # the PS a second time (ADVICE r2 high: double reduction)
     script = tmp_path / "worker.py"
     script.write_text(_WORKER)
-    env = dict(os.environ, OUT_DIR=str(tmp_path), JAX_PLATFORMS="cpu")
+    env = dict(os.environ, OUT_DIR=str(tmp_path), JAX_PLATFORMS="cpu",
+               TEST_OPT=opt_name)
     env.pop("DMLC_PS_ROOT_PORT", None)
     env.pop("XLA_FLAGS", None)  # workers see exactly one local cpu device
     proc = subprocess.run(
@@ -98,13 +106,13 @@ def test_dist_sync_in_graph_two_workers(tmp_path):
 
     # and must match a single-process 2-device mesh run on the same
     # global batch with the same rank-0 init
-    ref = _single_process_reference()
+    ref = _single_process_reference(opt_name)
     for k in ref:
         np.testing.assert_allclose(p0[k], ref[k], rtol=2e-5, atol=1e-6,
                                    err_msg=k)
 
 
-def _single_process_reference():
+def _single_process_reference(opt_name="sgd"):
     """Same training run: one process, 2-virtual-device mesh, global
     batch 16, rank-0's initializer."""
     script = r"""
@@ -141,8 +149,10 @@ mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
 np.random.seed(7)  # rank-0's init draw
 mod.init_params(mx.init.Xavier(rnd_type="gaussian", magnitude=2.0))
 # rescale matches dist (local 8 x 2 workers = 16)
-mod.init_optimizer(optimizer="sgd",
-                   optimizer_params={"learning_rate": 0.2, "momentum": 0.9})
+opt_name = os.environ.get("TEST_OPT", "sgd")
+opt_params = {"learning_rate": 0.2, "momentum": 0.9} if opt_name == "sgd" \
+    else {"learning_rate": 0.05}
+mod.init_optimizer(optimizer=opt_name, optimizer_params=opt_params)
 for epoch in range(3):
     it.reset()
     for batch in it:
@@ -157,7 +167,7 @@ print(json.dumps(params))
     with tempfile.NamedTemporaryFile("w", suffix=".py", delete=False) as f:
         f.write(script % REPO)
         path = f.name
-    env = dict(os.environ)
+    env = dict(os.environ, TEST_OPT=opt_name)
     for k in ("DMLC_ROLE", "DMLC_NUM_WORKER", "DMLC_WORKER_ID"):
         env.pop(k, None)
     proc = subprocess.run([sys.executable, path], env=env, timeout=300,

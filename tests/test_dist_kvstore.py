@@ -522,6 +522,17 @@ out = mx.nd.zeros((10,))
 kv.pull(7, out=out)
 expect = big * sum(r + 1 for r in range(n))
 np.testing.assert_array_equal(out.asnumpy(), expect)
+# dtype round-trip over the sharded path: an int32 big array must come
+# back int32 exactly (the reassembly buffer follows the stored shard
+# dtype; a hardcoded f32 buffer silently casts)
+bigi = np.arange(12, dtype=np.int32) * 1000003
+kv.init(9, mx.nd.array(np.zeros_like(bigi), dtype=np.int32))
+kv.push(9, mx.nd.array(bigi * (rank + 1), dtype=np.int32))
+outi = mx.nd.zeros((12,), dtype=np.int32)
+kv.pull(9, out=outi)
+assert outi.asnumpy().dtype == np.int32, outi.asnumpy().dtype
+np.testing.assert_array_equal(
+    outi.asnumpy(), bigi * sum(r + 1 for r in range(n)))
 open(os.path.join(os.environ["OUT_DIR"], "ok.%d" % rank), "w").write("1")
 kv.close()
 """
