@@ -11,8 +11,17 @@ training steps scanned inside one XLA computation, the TPU analog of the
 reference's MXNET_EXEC_BULK_EXEC_TRAIN op bulking) so tunnel dispatch
 latency does not pollute the compute measurement.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "mfu_pct",
-"tflops"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "tflops",
+"flops_per_img", "flops_source"}; when the chip's bf16 peak is known
+(detected from device_kind, or BENCH_PEAK_TFLOPS) the line also carries
+{"mfu_pct", "peak_tflops", "peak_source"}.
+
+FLOPs are measured from XLA cost analysis of the COMPILED bulk step (the
+scan body counts once = one training step; 2 flops per MAC — the same
+convention as the chip's peak rating).  Compiling the AOT-lowered step a
+second time costs ~30s through the tunnel but keeps the count
+post-optimization (pre-DCE counts would include dead primal convs from
+the conv custom_vjp).
 """
 
 import json
@@ -31,10 +40,45 @@ BULK = max(1, int(os.environ.get("BENCH_BULK", "10")))
 REPEATS = max(1, int(os.environ.get("BENCH_REPEATS", "5")))
 BASELINE_IPS = 45.52  # K80 ResNet-50 train, docs/how_to/perf.md:108-117
 DTYPE = os.environ.get("BENCH_DTYPE", "bfloat16")
-# ResNet-50 @224: ~4.1 GFLOP forward/img; fwd+bwd ~= 3x forward
-FLOPS_PER_IMG = float(os.environ.get("BENCH_FLOPS_PER_IMG", "12.3e9"))
-# bf16 dense peak of the bench chip (v5e = 197 TFLOP/s) for the MFU figure
-PEAK_TFLOPS = float(os.environ.get("BENCH_PEAK_TFLOPS", "197"))
+
+# bf16 dense peak TFLOP/s by PJRT device_kind (published chip specs);
+# BENCH_PEAK_TFLOPS overrides for kinds not listed here
+_PEAK_BY_KIND = {
+    "TPU v2": 46.0,
+    "TPU v3": 123.0,
+    "TPU v4": 275.0,
+    "TPU v4 lite": 138.0,
+    "TPU v5": 459.0,        # v5p
+    "TPU v5 lite": 197.0,   # v5e
+    "TPU v5e": 197.0,
+    "TPU v6 lite": 918.0,   # v6e / Trillium
+    "TPU v6e": 918.0,
+}
+
+
+def _detect_peak_tflops(device):
+    env = os.environ.get("BENCH_PEAK_TFLOPS")
+    if env:
+        return float(env), "env"
+    kind = getattr(device, "device_kind", "") or ""
+    if kind in _PEAK_BY_KIND:
+        return _PEAK_BY_KIND[kind], kind
+    return None, kind
+
+
+def _measure_flops_per_img(mod):
+    """FLOPs of one compiled training step via XLA cost analysis of the
+    actual bulk-scan executable (scan body counted once = one step),
+    divided by batch size.  BENCH_FLOPS_PER_IMG overrides (escape hatch
+    for backends without cost analysis)."""
+    env = os.environ.get("BENCH_FLOPS_PER_IMG")
+    if env:
+        return float(env), "env"
+    cost = mod.bulk_cost_analysis()
+    if cost and cost.get("flops"):
+        return float(cost["flops"]) / BATCH, "xla_cost_analysis"
+    # ResNet-50 @224: ~4.1 GFLOP forward/img; fwd+bwd ~= 3x forward
+    return 12.3e9, "estimate"
 
 
 def main():
@@ -95,6 +139,9 @@ def main():
     run(WARMUP * BULK)
     sync()
 
+    flops_per_img, flops_src = _measure_flops_per_img(mod)
+    peak_tflops, peak_src = _detect_peak_tflops(mod._exec._ctx.jax_device())
+
     best = float("inf")
     for _ in range(REPEATS):
         t0 = time.time()
@@ -103,15 +150,21 @@ def main():
         best = min(best, time.time() - t0)
 
     ips = BATCH * STEPS / best
-    tflops = ips * FLOPS_PER_IMG / 1e12
-    print(json.dumps({
+    tflops = ips * flops_per_img / 1e12
+    row = {
         "metric": "resnet50_train_imgs_per_sec_b%d" % BATCH,
         "value": round(ips, 2),
         "unit": "images/sec",
         "vs_baseline": round(ips / BASELINE_IPS, 3),
-        "mfu_pct": round(100.0 * tflops / PEAK_TFLOPS, 2),
         "tflops": round(tflops, 2),
-    }))
+        "flops_per_img": round(flops_per_img / 1e9, 3),
+        "flops_source": flops_src,
+    }
+    if peak_tflops:
+        row["mfu_pct"] = round(100.0 * tflops / peak_tflops, 2)
+        row["peak_tflops"] = peak_tflops
+        row["peak_source"] = peak_src
+    print(json.dumps(row))
 
 
 if __name__ == "__main__":

@@ -679,8 +679,13 @@ class Module(BaseModule):
         rng = ex.next_rng()
         moms = [updater.states[i]._jx for i in range(len(names))] \
             if optimizer.momentum != 0.0 else []
-        outs_stack, new_aux, new_p, new_m = fn(
-            upd_vals, static_vals, aux, rng, moms, lrs, wds, stacks)
+        call_args = (upd_vals, static_vals, aux, rng, moms, lrs, wds,
+                     stacks)
+        # abstract signature for bulk_cost_analysis (avals survive buffer
+        # donation; holding the concrete arrays would not)
+        self._last_bulk_sig = (fn, jax.tree_util.tree_map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), call_args))
+        outs_stack, new_aux, new_p, new_m = fn(*call_args)
         ex.outputs = [NDArray._from_jax(o[-1], ex._ctx) for o in outs_stack]
         for arr, v in zip(ex.aux_arrays, new_aux):
             arr._jx = v
@@ -692,6 +697,33 @@ class Module(BaseModule):
         if return_outputs:
             return [np.asarray(o) for o in outs_stack]
         return None
+
+    def bulk_cost_analysis(self):
+        """XLA cost analysis of ONE compiled training step.
+
+        Requires a prior :meth:`run_bulk` call (uses its signature).  The
+        bulk step is a ``lax.scan`` over K batches; XLA's HLO cost
+        analysis counts the loop body once, so the returned ``flops`` /
+        ``bytes accessed`` are per-step figures — the measured FLOP count
+        the benchmark divides by batch size for FLOPs/image (no
+        hand-derived constants).  Returns the cost dict, or None when no
+        bulk signature exists or analysis is unsupported on the backend.
+        """
+        sig = getattr(self, "_last_bulk_sig", None)
+        if sig is None:
+            return None
+        fn, args = sig
+        try:
+            lowered = fn.lower(*args)
+        except Exception:
+            return None
+        try:
+            return lowered.compile().cost_analysis()
+        except Exception:
+            try:
+                return lowered.cost_analysis()
+            except Exception:
+                return None
 
     def predict_bulk(self, batches):
         """Run ``len(batches)`` inference forwards as ONE XLA dispatch

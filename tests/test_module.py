@@ -427,3 +427,41 @@ def test_fit_with_bulk_train_steps_matches_classic():
     for k in p_classic:
         assert_almost_equal(p_bulk[k], p_classic[k], rtol=1e-4, atol=1e-5)
     np.testing.assert_allclose(acc_bulk, acc_classic, rtol=1e-6)
+
+
+def test_bulk_cost_analysis_measures_step_flops():
+    """bulk_cost_analysis returns the XLA-measured FLOPs of ONE training
+    step (the scan body is counted once), close to the analytic count —
+    the benchmark's MFU must rest on this, not a hand-derived constant."""
+    import os
+
+    rs = np.random.RandomState(0)
+    B, D, H = 16, 8, 32
+    batches = [mx.io.DataBatch(
+        data=[mx.nd.array(rs.rand(B, D).astype(np.float32))],
+        label=[mx.nd.array(rs.randint(0, 3, B).astype(np.float32))])
+        for _ in range(3)]
+    data = mx.sym.Variable("data")
+    h = mx.sym.FullyConnected(data, num_hidden=H, name="fc1")
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(h, num_hidden=3, name="fc2"), name="softmax")
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.bind(data_shapes=[("data", (B, D))],
+             label_shapes=[("softmax_label", (B,))])
+    mod.init_params(mx.init.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1,
+                                         "momentum": 0.9})
+    assert mod.bulk_cost_analysis() is None  # no bulk signature yet
+    os.environ["MXNET_FUSE_TRAIN_STEP"] = "1"
+    try:
+        mod.run_bulk(batches)
+    finally:
+        os.environ.pop("MXNET_FUSE_TRAIN_STEP", None)
+    cost = mod.bulk_cost_analysis()
+    assert cost is not None and cost.get("flops", 0) > 0
+    # analytic: fc1 fwd+dgrad+wgrad 3*2*B*D*H + fc2 3*2*B*H*3 (2 flops/MAC)
+    analytic = 3 * 2 * B * D * H + 3 * 2 * B * H * 3
+    # one step only (scan body once), within 3x for elementwise overhead
+    assert analytic * 0.5 < cost["flops"] < analytic * 3, \
+        (cost["flops"], analytic)
