@@ -35,6 +35,32 @@ from . import random as _random
 from .base import MXNetError
 from .context import Context
 from .ndarray import NDArray, zeros as nd_zeros
+from .ops import registry as _ops_registry
+
+
+class _DeviceHintFn:
+    """Wraps an executor's jitted step so tracing (first call, or .lower)
+    runs with ``ops.registry.trace_device`` set to the executor's device —
+    device-dependent lowering (Pallas vs XLA) must follow the
+    computation's device, not the process-wide default backend."""
+
+    def __init__(self, fn, dev_type):
+        self._fn = fn
+        self._dev = dev_type
+
+    def __call__(self, *args, **kwargs):
+        tok = _ops_registry.trace_device.set(self._dev)
+        try:
+            return self._fn(*args, **kwargs)
+        finally:
+            _ops_registry.trace_device.reset(tok)
+
+    def lower(self, *args, **kwargs):
+        tok = _ops_registry.trace_device.set(self._dev)
+        try:
+            return self._fn.lower(*args, **kwargs)
+        finally:
+            _ops_registry.trace_device.reset(tok)
 
 __all__ = ["Executor"]
 
@@ -97,9 +123,48 @@ def _graph_forward(symbol, arg_vals, aux_vals, is_train, rng):
                                 is_train, rng)
 
 
+def _bn_relu_peephole(symbol, nodes):
+    """BatchNorm nodes whose SOLE consumer is a relu ``Activation`` fuse
+    into one kernel application (stats+normalize+relu in a single HBM
+    pass via ops/bn_pallas.py) — the executor-level analog of cuDNN's
+    fused BN-activation.  Returns ({id(bn)}, {id(act): bn_node})."""
+    count = {}
+    for node in nodes:
+        if node.is_variable:
+            continue
+        for c, ci in node.inputs:
+            k = (id(c), ci)
+            count[k] = count.get(k, 0) + 1
+    for n, i in symbol._outputs:
+        k = (id(n), i)
+        count[k] = count.get(k, 0) + 1  # graph outputs must materialize
+    bn_defer, act_fuse = set(), {}
+    for node in nodes:
+        if node.is_variable or node.op is None \
+                or node.op.name != "Activation" \
+                or node.attrs.get("act_type") != "relu":
+            continue
+        child, ci = node.inputs[0]
+        if ci != 0 or child.is_variable or child.op.name != "BatchNorm":
+            continue
+        a = child.attrs
+        if a.get("use_global_stats") or a.get("output_mean_var"):
+            continue
+        if count.get((id(child), 0), 0) != 1:
+            continue
+        bn_defer.add(id(child))
+        act_fuse[id(node)] = child
+    return bn_defer, act_fuse
+
+
 def _graph_forward_plain(symbol, nodes, arg_vals, aux_vals, is_train, rng):
+    from .ops.nn import _batch_norm as _bn_apply
+
     entry_val = {}
     new_aux = {}
+    bn_defer, act_fuse = _bn_relu_peephole(symbol, nodes) \
+        if is_train else (set(), {})
+    bn_stash = {}
     for ni, node in enumerate(nodes):
         if node.is_variable:
             if node.name in arg_vals:
@@ -111,6 +176,24 @@ def _graph_forward_plain(symbol, nodes, arg_vals, aux_vals, is_train, rng):
             continue
         op = node.op
         na = node.num_args()
+        if id(node) in bn_defer:
+            # computed inside the consuming relu Activation's slot
+            bn_stash[id(node)] = (
+                [entry_val[(id(c), ci)] for c, ci in node.inputs[:na]],
+                [entry_val[(id(c), ci)] for c, ci in node.inputs[na:]])
+            continue
+        if id(node) in act_fuse:
+            bn_node = act_fuse[id(node)]
+            bn_ins, bn_auxs = bn_stash[id(bn_node)]
+            outs, aux_up = _bn_apply(bn_node.attrs, bn_ins, bn_auxs,
+                                     True, None, act_type="relu")
+            entry_val[(id(node), 0)] = outs[0]
+            if aux_up is not None:
+                na_bn = bn_node.num_args()
+                for (child, _ci), new in zip(bn_node.inputs[na_bn:],
+                                             aux_up):
+                    new_aux[child.name] = new
+            continue
         ins = [entry_val[(id(c), ci)] for c, ci in node.inputs[:na]]
         auxs = [entry_val[(id(c), ci)] for c, ci in node.inputs[na:]]
         key = jax.random.fold_in(rng, ni) if op.needs_rng else None
@@ -264,8 +347,11 @@ class Executor:
         return [n for n in self.arg_names if self.grad_req[n] != "null"]
 
     def _get_fn(self, kind):
-        if kind in self._fns:
-            return self._fns[kind]
+        # keyed on the trace-time env fingerprint: MXNET_BN_*/mirror/
+        # barrier toggles must retrace, not silently reuse a stale jit
+        cache_key = (kind, _ops_registry.trace_env_fingerprint())
+        if cache_key in self._fns:
+            return self._fns[cache_key]
         symbol = self._symbol
         arg_names = list(self.arg_names)
         aux_names = list(self.aux_names)
@@ -416,7 +502,8 @@ class Executor:
             fn = jax.jit(f)
         else:
             raise ValueError(kind)
-        self._fns[kind] = fn
+        fn = _DeviceHintFn(fn, self._ctx.device_type)
+        self._fns[cache_key] = fn
         return fn
 
     # -- group2ctx placement (model parallelism) --------------------------
@@ -516,7 +603,8 @@ class Executor:
         self._seg_dev_of = dev_of
 
     def _seg_fn(self, si, is_train):
-        key = ("seg", si, is_train)
+        key = ("seg", si, is_train,
+               _ops_registry.trace_env_fingerprint())
         if key in self._fns:
             return self._fns[key]
         _dev, seg_nodes = self._segments[si]
@@ -541,7 +629,7 @@ class Executor:
                         aux_updates.append((child.name, new))
             return [entry[k2] for k2 in out_keys], dict(aux_updates)
 
-        fn = jax.jit(f)
+        fn = _DeviceHintFn(jax.jit(f), _dev.device_type)
         self._fns[key] = fn
         return fn
 

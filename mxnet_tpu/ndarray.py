@@ -659,12 +659,20 @@ def _invoke(op, args, kwargs):
     with _profiler.span(op.name, "imperative") as sp:
         if inputs:
             octx = op_ctx or inputs[0]._ctx  # op_ctx None => all-numpy inputs
-            outs, aux_up = fn([x._jx for x in inputs],
-                              [x._jx for x in aux_arrays], rng)
         else:
             octx = ctx or current_context()
-            with jax.default_device(octx.jax_device()):
-                outs, aux_up = fn([], [], rng)
+        # trace-time device hint: lowering decisions (Pallas vs XLA)
+        # follow the op's device, not the process default backend
+        tok = _reg.trace_device.set(octx.device_type)
+        try:
+            if inputs:
+                outs, aux_up = fn([x._jx for x in inputs],
+                                  [x._jx for x in aux_arrays], rng)
+            else:
+                with jax.default_device(octx.jax_device()):
+                    outs, aux_up = fn([], [], rng)
+        finally:
+            _reg.trace_device.reset(tok)
         sp.sync(outs)
     # write aux updates back (reference mutates aux NDArrays in the op)
     for arr, new in zip(aux_arrays, aux_up or []):

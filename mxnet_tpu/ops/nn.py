@@ -21,6 +21,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..base import MXNetError
+from . import bn_pallas
 from .helpers import acc_dtype as _acc_dtype, simple
 from .registry import (REQUIRED, pbool, pfloat, pint, pstr, ptuple, register)
 
@@ -296,12 +297,31 @@ register("SoftmaxActivation", _softmax_activation,
 # BatchNorm — reference ``batch_norm-inl.h`` / cudnn_batch_norm.
 # aux moving_mean/moving_var updated in train mode (functional aux-update).
 # ---------------------------------------------------------------------------
-def _batch_norm(attrs, inputs, aux, is_train, rng):
+def _batch_norm(attrs, inputs, aux, is_train, rng, act_type=None):
+    """``act_type="relu"`` fuses the activation into the Pallas kernel —
+    set only by the executor's BN->ReLU peephole (the registered op always
+    passes None)."""
     x, gamma, beta = inputs
     moving_mean, moving_var = aux
     red = (0,) + tuple(range(2, x.ndim))
     bshape = (1, -1) + (1,) * (x.ndim - 2)
-    use_batch = is_train and not attrs["use_global_stats"]
+    import os as _os
+
+    bn_mode = _os.environ.get("MXNET_BN_ABLATION", "")
+    if bn_mode == "frozen":  # perf-ablation only: skip batch statistics
+        use_batch = False
+    else:
+        use_batch = is_train and not attrs["use_global_stats"]
+    if use_batch and not attrs["output_mean_var"] \
+            and bn_pallas.eligible(x):
+        # fused single-HBM-pass BN (+ReLU): see ops/bn_pallas.py
+        out, mean, var = bn_pallas.bn_train(
+            x, gamma, beta, attrs["eps"], attrs["fix_gamma"],
+            relu=(act_type == "relu"))
+        m = attrs["momentum"]
+        new_mean = moving_mean * m + jax.lax.stop_gradient(mean) * (1 - m)
+        new_var = moving_var * m + jax.lax.stop_gradient(var) * (1 - m)
+        return [out], [new_mean, new_var]
     if use_batch:
         # compute stats in f32 even for bf16 activations (TPU numerics).
         # E[x], E[x^2] in ONE fused pass over x (jnp.var would re-read x a
@@ -327,6 +347,8 @@ def _batch_norm(attrs, inputs, aux, is_train, rng):
     shift = (beta.astype(jnp.float32)
              - mean * scale.astype(jnp.float32)).astype(x.dtype)
     out = x * scale.reshape(bshape) + shift.reshape(bshape)
+    if act_type == "relu":  # peephole fallback when Pallas is ineligible
+        out = jnp.maximum(out, 0)
     outs = [out, mean, var] if attrs["output_mean_var"] else [out]
     if use_batch:
         m = attrs["momentum"]

@@ -238,8 +238,36 @@ def list_ops():
 # each (op, attrs, is_train) gets one jitted callable and XLA/PJRT async
 # dispatch provides the same fire-and-forget semantics.
 
-@lru_cache(maxsize=None)
+# env vars some ops read at TRACE time (conv-grad barrier, BN ablation /
+# Pallas mode): every trace cache keys on this fingerprint, otherwise a
+# mid-process toggle is silently ignored by the cached jit
+_TRACE_ENV_VARS = ("MXNET_BN_PALLAS", "MXNET_BN_ABLATION",
+                   "MXNET_CONV_GRAD_BARRIER", "MXNET_BACKWARD_DO_MIRROR")
+
+
+def trace_env_fingerprint():
+    import os
+
+    return tuple(os.environ.get(v, "") for v in _TRACE_ENV_VARS)
+
+
+# device the current executor trace targets ("tpu"/"cpu"/None) — set by
+# the executor/imperative dispatch around tracing so device-dependent
+# lowering decisions (Pallas vs XLA) follow the computation's actual
+# device, not the process-wide jax.default_backend()
+import contextvars as _contextvars
+
+trace_device = _contextvars.ContextVar("mxnet_tpu_trace_device",
+                                       default=None)
+
+
 def jitted_apply(op_name, attrs_tuple, is_train):
+    return _jitted_apply(op_name, attrs_tuple, is_train,
+                         trace_env_fingerprint())
+
+
+@lru_cache(maxsize=None)
+def _jitted_apply(op_name, attrs_tuple, is_train, _env_key):
     op = get(op_name)
     attrs = dict(attrs_tuple)
 

@@ -1,0 +1,169 @@
+"""Fused Pallas BatchNorm (ops/bn_pallas.py) and the executor's BN->ReLU
+peephole.
+
+The Pallas kernels are OFF by default (measured net-slower than XLA's
+schedule on the bench chip — see docs/how_to/perf.md) but remain an
+opt-in; these tests pin their numerics via interpret mode on CPU, and pin
+the peephole's correctness in both its fused-apply and fallback forms.
+
+Reference analog: ``tests/python/unittest/test_operator.py`` BatchNorm
+checks + ``tests/python/gpu/test_operator_gpu.py`` check_consistency.
+"""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_RUN = r"""
+import jax; jax.config.update("jax_platforms", "cpu")
+import sys, os, json
+sys.path.insert(0, %(repo)r)
+os.environ["MXNET_BN_PALLAS"] = %(mode)r
+import numpy as np
+import mxnet_tpu as mx
+
+rs = np.random.RandomState(0)
+shape = tuple(%(shape)s)
+X = (rs.rand(*shape).astype(np.float32) * 3 + 1)
+
+data = mx.sym.Variable("data")
+h = mx.sym.BatchNorm(data, fix_gamma=%(fix_gamma)s, eps=1e-3,
+                     momentum=0.9, name="bn")
+if %(relu)s:
+    h = mx.sym.Activation(h, act_type="relu")
+h = mx.sym.Flatten(h) if len(shape) > 2 else h
+net = mx.sym.SoftmaxOutput(
+    mx.sym.FullyConnected(h, num_hidden=3, name="fc"), name="softmax")
+ex = net.simple_bind(mx.cpu(), data=shape, softmax_label=(shape[0],))
+rs2 = np.random.RandomState(1)
+for n, a in ex.arg_dict.items():
+    if n not in ("data", "softmax_label"):
+        a[:] = rs2.normal(0, 0.5, a.shape).astype(np.float32)
+ex.arg_dict["data"][:] = X
+ex.arg_dict["softmax_label"][:] = rs.randint(0, 3, shape[0]).astype(
+    np.float32)
+out = ex.forward(is_train=True)[0].asnumpy()
+ex.backward()
+res = {"out": out.tolist()}
+for n, g in ex.grad_dict.items():
+    if g is not None:
+        res["g_" + n] = g.asnumpy().tolist()
+for n, a in ex.aux_dict.items():
+    res["a_" + n] = a.asnumpy().tolist()
+print("JSON" + json.dumps(res))
+"""
+
+
+def _run(mode, shape, fix_gamma, relu):
+    import json
+
+    script = _RUN % {"repo": REPO, "mode": mode, "shape": list(shape),
+                     "fix_gamma": fix_gamma, "relu": relu}
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)
+    p = subprocess.run([sys.executable, "-c", script], env=env,
+                       capture_output=True, text=True, timeout=300)
+    assert p.returncode == 0, p.stderr[-2000:]
+    line = [l for l in p.stdout.splitlines() if l.startswith("JSON")][0]
+    return {k: np.asarray(v) for k, v in json.loads(line[4:]).items()}
+
+
+@pytest.mark.parametrize("shape,fix_gamma,relu", [
+    ((8, 6, 5, 7), False, True),    # fused BN+relu, odd spatial
+    ((8, 16, 4, 4), True, True),    # fix_gamma (zero dgamma)
+    ((8, 12), False, False),        # 2D input, plain BN
+    ((4, 8, 3, 2, 2), False, True),  # 5D (3D-conv style)
+])
+def test_pallas_interpret_matches_xla(shape, fix_gamma, relu):
+    """Kernel math (interpret mode) == the XLA lowering: outputs, every
+    gradient, and the moving-stat updates."""
+    ref = _run("0", shape, fix_gamma, relu)
+    pal = _run("interpret", shape, fix_gamma, relu)
+    assert ref.keys() == pal.keys()
+    for k in ref:
+        np.testing.assert_allclose(pal[k], ref[k], rtol=2e-4, atol=2e-5,
+                                    err_msg=k)
+
+
+def test_peephole_single_consumer_only():
+    """A BN feeding relu AND a second consumer must NOT fuse (the
+    pre-relu value is needed); results must equal the unfused graph."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    sys.path.insert(0, REPO)
+    import mxnet_tpu as mx
+    from mxnet_tpu.executor import _bn_relu_peephole
+
+    data = mx.sym.Variable("data")
+    bn = mx.sym.BatchNorm(data, name="bn")
+    relu = mx.sym.Activation(bn, act_type="relu")
+    both = relu + bn  # second consumer of bn
+    net = mx.sym.MakeLoss(mx.sym.sum(both))
+    nodes = net._nodes()
+    bn_defer, act_fuse = _bn_relu_peephole(net, nodes)
+    assert not bn_defer and not act_fuse
+
+    # single consumer -> fuses
+    data2 = mx.sym.Variable("data")
+    bn2 = mx.sym.BatchNorm(data2, name="bn2")
+    relu2 = mx.sym.Activation(bn2, act_type="relu")
+    net2 = mx.sym.MakeLoss(mx.sym.sum(relu2))
+    d2, a2 = _bn_relu_peephole(net2, net2._nodes())
+    assert len(d2) == 1 and len(a2) == 1
+
+
+def test_peephole_fallback_matches_unfused():
+    """With Pallas off, the peephole's fused apply (XLA math + relu in
+    one op application) must be numerically identical to the plain
+    BN-then-Activation walk, including aux updates."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    sys.path.insert(0, REPO)
+    import mxnet_tpu as mx
+
+    os.environ["MXNET_BN_PALLAS"] = "0"
+    rs = np.random.RandomState(3)
+    X = rs.rand(8, 4, 6, 6).astype(np.float32) * 5
+
+    def build(act_name):
+        data = mx.sym.Variable("data")
+        h = mx.sym.BatchNorm(data, fix_gamma=False, name="bn")
+        h = mx.sym.Activation(h, act_type=act_name, name="act")
+        return mx.sym.MakeLoss(mx.sym.sum(h))
+
+    # relu fuses via peephole; sigmoid never does — both must give the
+    # same BN numerics, so compare relu-peephole against a manual
+    # max(BN,0) graph that cannot fuse
+    data = mx.sym.Variable("data")
+    h = mx.sym.BatchNorm(data, fix_gamma=False, name="bn")
+    manual = mx.sym.MakeLoss(mx.sym.sum(mx.sym.maximum(h, 0.0)))
+
+    def grads_of(net):
+        ex = net.simple_bind(mx.cpu(), data=(8, 4, 6, 6))
+        rs2 = np.random.RandomState(1)
+        for n, a in ex.arg_dict.items():
+            if n != "data":
+                a[:] = rs2.normal(0, 0.5, a.shape).astype(np.float32)
+        ex.arg_dict["data"][:] = X
+        out = ex.forward(is_train=True)[0].asnumpy().copy()
+        ex.backward()
+        gs = {n: g.asnumpy().copy()
+              for n, g in ex.grad_dict.items() if g is not None}
+        auxs = {n: a.asnumpy().copy() for n, a in ex.aux_dict.items()}
+        return out, gs, auxs
+
+    o1, g1, x1 = grads_of(build("relu"))
+    o2, g2, x2 = grads_of(manual)
+    np.testing.assert_allclose(o1, o2, rtol=1e-6)
+    for k in g1:
+        np.testing.assert_allclose(g1[k], g2[k], rtol=1e-5, atol=1e-7,
+                                    err_msg=k)
+    for k in x1:
+        np.testing.assert_allclose(x1[k], x2[k], rtol=1e-6, err_msg=k)

@@ -232,3 +232,49 @@ def test_optimizer_kernels_parity():
     for k in cpu:
         np.testing.assert_allclose(tpu[k], cpu[k], rtol=1e-5, atol=1e-6,
                                    err_msg=k)
+
+
+def test_pallas_bn_on_chip_matches_xla():
+    """Opt-in Pallas fused BN (MXNET_BN_PALLAS=1): hardware run must match
+    the TPU XLA lowering's outputs, all gradients, and aux updates (the
+    kernel is off by default for perf, not correctness — keep it honest
+    against toolchain changes)."""
+    import os
+
+    rs = np.random.RandomState(0)
+    X = rs.rand(16, 32, 7, 7).astype(np.float32) * 3 + 1
+
+    def run(mode):
+        os.environ["MXNET_BN_PALLAS"] = mode
+        try:
+            data = mx.sym.Variable("data")
+            h = mx.sym.BatchNorm(data, fix_gamma=False, eps=1e-3,
+                                 momentum=0.9, name="bn")
+            h = mx.sym.Activation(h, act_type="relu")
+            net = mx.sym.MakeLoss(mx.sym.sum(h))
+            ex = net.simple_bind(mx.tpu(), data=(16, 32, 7, 7))
+            rs2 = np.random.RandomState(1)
+            for n, a in ex.arg_dict.items():
+                if n != "data":
+                    a[:] = rs2.normal(0, 0.5, a.shape).astype(np.float32)
+            ex.arg_dict["data"][:] = X
+            out = ex.forward(is_train=True)[0].asnumpy().copy()
+            ex.backward()
+            gs = {n: g.asnumpy().copy()
+                  for n, g in ex.grad_dict.items() if g is not None}
+            auxs = {n: a.asnumpy().copy() for n, a in ex.aux_dict.items()}
+            return out, gs, auxs
+        finally:
+            os.environ.pop("MXNET_BN_PALLAS", None)
+
+    o_xla, g_xla, a_xla = run("0")
+    o_pal, g_pal, a_pal = run("1")
+    np.testing.assert_allclose(o_pal, o_xla, rtol=1e-4, atol=1e-5)
+    for k in g_xla:
+        # reduction-order noise: dgamma sums ~1e2-magnitude products in a
+        # different association than XLA's multi-output fused reduce
+        np.testing.assert_allclose(g_pal[k], g_xla[k], rtol=1e-3,
+                                   atol=1e-3, err_msg=k)
+    for k in a_xla:
+        np.testing.assert_allclose(a_pal[k], a_xla[k], rtol=1e-5,
+                                   err_msg=k)
