@@ -268,6 +268,34 @@ def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
     return auglist
 
 
+def _native_aug_plan(aug_list, data_shape):
+    """Match the fast-path aug chain [ResizeAug?, RandomCrop|CenterCrop,
+    HorizontalFlipAug?] against the native batch decoder's capabilities
+    (src/imgdecode.cc).  Returns (resize_shorter, random_crop, flip_p) or
+    None when the chain needs the Python per-image path."""
+    if data_shape[0] != 3:
+        return None
+    resize = 0
+    augs = list(aug_list)
+    if augs and isinstance(augs[0], ResizeAug):
+        if augs[0].interp != 1:  # native resize is bilinear only
+            return None
+        resize = augs.pop(0).size
+    if not augs or not isinstance(augs[0], (RandomCropAug, CenterCropAug)):
+        return None
+    crop = augs.pop(0)
+    if tuple(crop.size) != (data_shape[2], data_shape[1]):
+        return None
+    if crop.interp != 1:
+        return None
+    flip_p = 0.0
+    if augs and isinstance(augs[0], HorizontalFlipAug):
+        flip_p = augs.pop(0).p
+    if augs:
+        return None
+    return resize, isinstance(crop, RandomCropAug), flip_p
+
+
 class ImageIter(DataIter):
     """Image iterator over a RecordIO shard or an image list.
 
@@ -283,7 +311,8 @@ class ImageIter(DataIter):
                  path_imgidx=None, shuffle=False, part_index=0, num_parts=1,
                  aug_list=None, imglist=None, data_name="data",
                  label_name="softmax_label", last_batch_handle="pad",
-                 preprocess_threads=1, post_batch=None, **kwargs):
+                 preprocess_threads=1, post_batch=None, native_norm=None,
+                 **kwargs):
         super().__init__(batch_size)
         # post_batch(hwc_batch, label, valid) -> (data NDArray, label
         # NDArray): batch-level cast/normalize/transpose (host-vectorized
@@ -293,15 +322,11 @@ class ImageIter(DataIter):
         # parallel decode/augment on the native engine's worker pool
         # (the C++ ImageRecordIter's preprocess_threads,
         # iter_image_recordio.cc) — cv2 releases the GIL during decode
+        # created lazily on the first batch that actually needs it — when
+        # the native batch decoder engages, the Python-side worker pool
+        # would only sit idle
         self._engine = None
-        if preprocess_threads > 1:
-            try:
-                from .native import Engine
-
-                self._engine = Engine(num_workers=preprocess_threads)
-            except RuntimeError:
-                logging.warning("native engine unavailable; "
-                                "decoding on one thread")
+        self._engine_workers = preprocess_threads
         assert path_imgrec or path_imglist or imglist is not None, \
             "one of path_imgrec / path_imglist / imglist is required"
         self.data_shape = tuple(data_shape)
@@ -362,6 +387,19 @@ class ImageIter(DataIter):
                      "mean", "std", "brightness", "contrast", "saturation",
                      "pca_noise", "inter_method")})
             if aug_list is None else aug_list)
+        # native batch decode (src/imgdecode.cc): eligible when the fast
+        # path is active (uint8 staging) and the aug chain is purely
+        # geometric; the library loads lazily on first next()
+        self._native_plan = _native_aug_plan(self.aug_list, data_shape) \
+            if post_batch is not None else None
+        # (mean, std, scale) for the native fused f32-NCHW output; only
+        # meaningful for host batches (device conversion ships uint8)
+        self._native_norm = native_norm
+        self._preprocess_threads = max(1, int(preprocess_threads))
+        assert last_batch_handle in ("pad", "discard", "roll_over"), \
+            last_batch_handle
+        self.last_batch_handle = last_batch_handle
+        self._overflow = 0
         self.cursor = 0
         self.reset()
 
@@ -377,11 +415,77 @@ class ImageIter(DataIter):
         return [DataDesc(self.label_name, shape, np.float32)]
 
     def reset(self):
+        # roll_over (reference round_batch=1, iter_batchloader.h:36): the
+        # wrapped final batch already consumed the FIRST ov samples of
+        # the next epoch's order (_wrap_start reshuffled), so keep that
+        # permutation and skip them — every sample is seen once per cycle
+        self._exhausted = False
+        ov = getattr(self, "_overflow", 0)
+        self._overflow = 0
+        if ov:
+            if self.seq is not None:
+                self.cursor = ov
+            else:
+                self.imgrec.reset()
+                for _ in range(ov):
+                    self.imgrec.read()
+                self.cursor = ov
+            return
         if self.seq is not None and self.shuffle:
             pyrandom.shuffle(self.seq)
         if self.imgrec is not None and self.seq is None:
             self.imgrec.reset()
         self.cursor = 0
+
+    def _maybe_engine(self):
+        """Python-side decode worker pool, created on first use (the
+        reference's preprocess_threads, iter_image_recordio.cc — cv2
+        releases the GIL so threads overlap)."""
+        if self._engine is None and self._engine_workers > 1:
+            self._engine_workers = 1  # one attempt
+            try:
+                from .native import Engine
+
+                self._engine = Engine(num_workers=self._preprocess_threads)
+            except RuntimeError:
+                logging.warning("native engine unavailable; "
+                                "decoding on one thread")
+        return self._engine
+
+    def _wrap_start(self):
+        """Start the NEXT epoch's read order mid-batch (roll_over fill):
+        the wrapped samples are the first of the new epoch."""
+        if self.seq is not None and self.shuffle:
+            pyrandom.shuffle(self.seq)
+        if self.imgrec is not None and self.seq is None:
+            self.imgrec.reset()
+        self.cursor = 0
+
+    def _gather_batch_raws(self):
+        """Collect up to batch_size (bytes-or-img, label) items applying
+        the last-batch policy: 'pad' returns a short list (caller pads),
+        'discard' drops the partial batch, 'roll_over' wraps to the start
+        and notes the overflow for the next reset()."""
+        if self._exhausted:
+            raise StopIteration
+        raws = []
+        while len(raws) < self.batch_size:
+            item = self._read_raw()
+            if item is None:
+                if not raws:
+                    raise StopIteration
+                if self.last_batch_handle == "discard":
+                    raise StopIteration
+                if self.last_batch_handle == "roll_over":
+                    # wrap to the start to complete the epoch's FINAL
+                    # batch; the epoch ends after it
+                    self._wrap_start()
+                    self._overflow = self.batch_size - len(raws)
+                    self._exhausted = True
+                    continue
+                break  # pad
+            raws.append(item)
+        return raws
 
     def _read_raw(self):
         """Fetch one (encoded bytes, label) — file IO only, main thread."""
@@ -412,12 +516,6 @@ class ImageIter(DataIter):
             img = aug(img)
         return img
 
-    def _read_one(self):
-        item = self._read_raw()
-        if item is None:
-            return None
-        return self._decode_augment(item[0]), item[1]
-
     def next(self):
         c, h, w = self.data_shape
         post = self._post_batch
@@ -447,17 +545,75 @@ class ImageIter(DataIter):
                 label[i] = lbl[:self.label_width]
 
         i = 0
-        if self._engine is not None:
+        native_lib = None
+        if self._native_plan is not None and post is not None:
+            from .native import get_imgdecode_lib
+
+            native_lib = get_imgdecode_lib()
+        if native_lib is not None:
+            # one C call decodes+augments the whole batch (reference: the
+            # C++ parser threads of iter_image_recordio.cc:458); with
+            # native_norm set the call also fuses cast+normalize+
+            # transpose and fills f32 NCHW directly — the host post pass
+            # costs as much as the decode, so fusing it in doubles the
+            # host pipeline rate
+            import ctypes
+
+            raws = self._gather_batch_raws()
+            n = len(raws)
+            resize, rand_c, flip_p = self._native_plan
+            bufs = (ctypes.c_void_p * n)(*[
+                ctypes.cast(ctypes.c_char_p(b), ctypes.c_void_p)
+                for b, _ in raws])
+            lens = (ctypes.c_int64 * n)(*[len(b) for b, _ in raws])
+            fx = (ctypes.c_float * n)(*[
+                (pyrandom.random() if rand_c else -1.0) for _ in range(n)])
+            fy = (ctypes.c_float * n)(*[
+                (pyrandom.random() if rand_c else -1.0) for _ in range(n)])
+            mir = (ctypes.c_ubyte * n)(*[
+                1 if (flip_p and pyrandom.random() < flip_p) else 0
+                for _ in range(n)])
+            f32_mode = self._native_norm is not None
+            if f32_mode:
+                nchw = np.empty((self.batch_size, c, h, w), np.float32)
+                mean3, std3, scale = self._native_norm
+                out_ptr = nchw.ctypes.data_as(ctypes.c_void_p)
+                mean_p = (ctypes.c_float * 3)(*mean3)
+                std_p = (ctypes.c_float * 3)(*std3)
+            else:
+                out_ptr = hwc.ctypes.data_as(ctypes.c_void_p)
+                mean_p = std_p = None
+                scale = 1.0
+            bad = native_lib.MXIMGBatchDecode(
+                bufs, lens, n, resize, fx, fy, mir, h, w,
+                out_ptr, int(f32_mode), mean_p, std_p,
+                ctypes.c_float(scale), self._preprocess_threads)
+            if bad:
+                raise MXNetError(
+                    "%d image(s) failed to decode in this batch" % bad)
+            for j, (_b, lbl) in enumerate(raws):
+                lbl = np.asarray(lbl).reshape(-1)
+                if self.label_width == 1:
+                    label[j] = lbl[0]
+                else:
+                    label[j] = lbl[:self.label_width]
+            if f32_mode:
+                pad = self.batch_size - n
+                for j in range(n, self.batch_size):
+                    nchw[j] = nchw[n - 1]
+                    label[j] = label[n - 1]
+                from .context import cpu as _cpu
+
+                return DataBatch(
+                    data=[ndarray.array(nchw, ctx=_cpu())],
+                    label=[ndarray.array(label, ctx=_cpu())], pad=pad,
+                    provide_data=self.provide_data,
+                    provide_label=self.provide_label)
+            i = n
+        elif self._maybe_engine() is not None:
             # raw reads on this thread, decode+augment fanned out to the
             # native engine workers; slots are disjoint → no mutable deps
-            raws = []
-            while len(raws) < self.batch_size:
-                item = self._read_raw()
-                if item is None:
-                    break
-                raws.append(item)
-            if not raws:
-                raise StopIteration
+            raws = self._gather_batch_raws()
             errors = []
             for j, (img_bytes, lbl) in enumerate(raws):
                 def work(j=j, img_bytes=img_bytes, lbl=lbl):
@@ -471,17 +627,9 @@ class ImageIter(DataIter):
                 raise errors[0]
             i = len(raws)
         else:
-            try:
-                while i < self.batch_size:
-                    item = self._read_one()
-                    if item is None:
-                        raise StopIteration
-                    img, lbl = item
-                    fill(i, img, lbl)
-                    i += 1
-            except StopIteration:
-                if i == 0:
-                    raise
+            for img_bytes, lbl in self._gather_batch_raws():
+                fill(i, self._decode_augment(img_bytes), lbl)
+                i += 1
         pad = self.batch_size - i
         if pad:  # pad with the last valid sample (reference pad semantics)
             for j in range(i, self.batch_size):

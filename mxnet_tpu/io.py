@@ -581,23 +581,25 @@ def ImageRecordIter(path_imgrec, data_shape, batch_size, shuffle=False,
             **kwargs)
         if scale != 1.0:
             aug_list.append(lambda img: img * scale)
+    # host-destination batches fuse cast+normalize+transpose into the
+    # native decode call (f32 NCHW straight out of C); device batches
+    # keep uint8 staging so the link carries a quarter of the bytes
+    native_norm = (tuple(mean), tuple(std), float(scale)) \
+        if (post_batch is not None and ctx is None) else None
+    # reference round_batch=1 (iter_batchloader.h:36): the final partial
+    # batch wraps around to the start of the data and the next epoch
+    # skips the wrapped samples — every sample still appears once per
+    # cycle and every batch is full (pad == 0), the semantics dist
+    # workers rely on for equal step counts.  round_batch=0/None keeps
+    # the pad-and-set-batch.pad behavior.
     it = ImageIter(batch_size, data_shape, label_width=label_width,
                    path_imgrec=path_imgrec, path_imgidx=path_imgidx,
                    shuffle=shuffle, part_index=part_index,
                    num_parts=num_parts, aug_list=aug_list,
                    data_name=data_name, label_name=label_name,
                    preprocess_threads=preprocess_threads,
-                   post_batch=post_batch)
-    # reference knobs: prefetch_buffer=0 disables the background thread
-    # (the python prefetcher is double-buffered regardless of depth).
-    # Final-batch semantics are the reference's round_batch=0 style:
-    # the partial batch is padded and batch.pad is set — wrap-around
-    # filling (round_batch=1) is not implemented, so warn if requested.
-    if round_batch:
-        logging.warning(
-            "ImageRecordIter: round_batch=True (wrap-around final batch) "
-            "is not implemented; the final batch is padded with batch.pad "
-            "set (round_batch=False semantics)")
+                   post_batch=post_batch, native_norm=native_norm,
+                   last_batch_handle="roll_over" if round_batch else "pad")
     if not prefetch or not prefetch_buffer:
         return it
     return PrefetchingIter(it)

@@ -248,3 +248,79 @@ def default_engine():
         if _default_engine is None and get_lib() is not None:
             _default_engine = Engine()
         return _default_engine
+
+
+# ---------------------------------------------------------------------------
+# optional OpenCV-backed batch image decode (src/imgdecode.cc)
+# ---------------------------------------------------------------------------
+_IMG_SRC_CANDIDATES = (
+    os.path.join(os.path.dirname(os.path.dirname(_HERE)), "src",
+                 "imgdecode.cc"),
+    os.path.join(_HERE, "imgdecode.cc"),
+)
+_IMG_SRC = next((p for p in _IMG_SRC_CANDIDATES if os.path.exists(p)),
+                _IMG_SRC_CANDIDATES[0])
+_IMG_LIB_PATH = os.path.join(_HERE, "libmxnet_tpu_imgdecode.so")
+
+_img_lib = None
+_img_lib_tried = False
+_img_lib_lock = threading.Lock()
+
+
+def _build_imgdecode():
+    # flags via pkg-config when available, else the conventional paths
+    try:
+        flags = subprocess.run(
+            ["pkg-config", "--cflags", "opencv4"], check=True,
+            capture_output=True, text=True).stdout.split()
+    except (OSError, subprocess.CalledProcessError):
+        flags = ["-I/usr/include/opencv4"]
+    libs = ["-lopencv_imgcodecs", "-lopencv_imgproc", "-lopencv_core"]
+    tmp = "%s.%d.tmp" % (_IMG_LIB_PATH, os.getpid())
+    cmd = (["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
+            _IMG_SRC, "-o", tmp] + flags + libs)
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, text=True)
+        os.replace(tmp, _IMG_LIB_PATH)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+
+
+def get_imgdecode_lib():
+    """Load (building if needed) the OpenCV batch-decode library; None
+    when OpenCV dev files are absent (callers use the Python path)."""
+    global _img_lib, _img_lib_tried
+    with _img_lib_lock:
+        if _img_lib is not None or _img_lib_tried:
+            return _img_lib
+        _img_lib_tried = True
+        try:
+            have_src = os.path.exists(_IMG_SRC)
+            if not os.path.isfile(_IMG_LIB_PATH):
+                _build_imgdecode()
+            elif (have_src and os.path.getmtime(_IMG_LIB_PATH)
+                  < os.path.getmtime(_IMG_SRC)):
+                _build_imgdecode()
+            lib = ctypes.CDLL(_IMG_LIB_PATH)
+        except (OSError, subprocess.CalledProcessError):
+            return None
+        lib.MXIMGBatchDecode.restype = ctypes.c_int
+        lib.MXIMGBatchDecode.argtypes = [
+            ctypes.POINTER(ctypes.c_void_p),    # bufs
+            ctypes.POINTER(ctypes.c_int64),     # lens
+            ctypes.c_int,                       # n
+            ctypes.c_int,                       # resize_shorter
+            ctypes.POINTER(ctypes.c_float),     # crop_fx
+            ctypes.POINTER(ctypes.c_float),     # crop_fy
+            ctypes.POINTER(ctypes.c_ubyte),     # mirror
+            ctypes.c_int, ctypes.c_int,         # out_h, out_w
+            ctypes.c_void_p,                    # out (u8 HWC | f32 NCHW)
+            ctypes.c_int,                       # out_f32_nchw
+            ctypes.POINTER(ctypes.c_float),     # mean3 (nullable)
+            ctypes.POINTER(ctypes.c_float),     # std3 (nullable)
+            ctypes.c_float,                     # scale
+            ctypes.c_int,                       # nthreads
+        ]
+        _img_lib = lib
+        return _img_lib

@@ -1,0 +1,174 @@
+"""Native batched image decode (src/imgdecode.cc) vs the Python path.
+
+Reference analog: the C++ ImageRecordIter parser threads
+(``src/io/iter_image_recordio.cc:458``) vs ``python/mxnet/image.py`` —
+both must produce the same pixels for deterministic augmentations.
+"""
+
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import io as mxio, recordio
+from mxnet_tpu.native import get_imgdecode_lib
+
+pytestmark = pytest.mark.skipif(get_imgdecode_lib() is None,
+                                reason="OpenCV dev files unavailable")
+
+
+def _make_rec(tmp, n=24, size=256):
+    rs = np.random.RandomState(7)
+    path = os.path.join(tmp, "t.rec")
+    w = recordio.MXRecordIO(path, "w")
+    raw = []
+    for i in range(n):
+        base = rs.rand(8, 8, 3)
+        img = (np.kron(base, np.ones((size // 8, size // 8, 1))) * 160
+               + rs.rand(size, size, 3) * 60).astype(np.uint8)
+        raw.append(img)
+        w.write(recordio.pack_img(recordio.IRHeader(0, float(i), i, 0),
+                                  img, quality=95))
+    w.close()
+    return path, raw
+
+
+def _drain(it):
+    data, labels = [], []
+    for b in it:
+        n = b.data[0].shape[0] - b.pad
+        data.append(b.data[0].asnumpy()[:n])
+        labels.append(b.label[0].asnumpy()[:n])
+    return np.concatenate(data), np.concatenate(labels)
+
+
+def test_native_matches_python_center_crop(tmp_path):
+    """Deterministic chain (center crop, no mirror): native batch decode
+    must produce EXACTLY the Python per-image path's pixels/labels."""
+    path, _ = _make_rec(str(tmp_path))
+
+    def build(force_python):
+        it = mxio.ImageRecordIter(
+            path_imgrec=path, data_shape=(3, 224, 224), batch_size=8,
+            preprocess_threads=1, prefetch=False,
+            mean_r=123.0, mean_g=117.0, mean_b=104.0,
+            std_r=58.4, std_g=57.1, std_b=57.4)
+        if force_python:
+            it._native_plan = None
+        return it
+
+    d_py, l_py = _drain(build(True))
+    d_nat, l_nat = _drain(build(False))
+    np.testing.assert_array_equal(l_nat, l_py)
+    # both paths decode with cv2 and normalize in f32; tiny float
+    # association differences only
+    np.testing.assert_allclose(d_nat, d_py, atol=1e-4)
+
+
+def test_native_resize_then_crop(tmp_path):
+    """resize=N (shorter edge) then center crop — the standard ImageNet
+    val chain — matches the Python path."""
+    path, _ = _make_rec(str(tmp_path), size=320)
+
+    def build(force_python):
+        it = mxio.ImageRecordIter(
+            path_imgrec=path, data_shape=(3, 224, 224), batch_size=8,
+            resize=256, preprocess_threads=1, prefetch=False)
+        if force_python:
+            it._native_plan = None
+        return it
+
+    d_py, _ = _drain(build(True))
+    d_nat, _ = _drain(build(False))
+    np.testing.assert_allclose(d_nat, d_py, atol=1e-3)
+
+
+def test_native_random_crop_mirror_statistics(tmp_path):
+    """Random crop + mirror can't be compared pixelwise (different RNG
+    streams) — check shapes, dtype, value range, and that successive
+    epochs differ (augmentation actually randomizes)."""
+    path, _ = _make_rec(str(tmp_path))
+    it = mxio.ImageRecordIter(
+        path_imgrec=path, data_shape=(3, 224, 224), batch_size=8,
+        rand_crop=True, rand_mirror=True, preprocess_threads=2,
+        prefetch=False)
+    assert it._native_plan is not None
+    d1, _ = _drain(it)
+    it.reset()
+    d2, _ = _drain(it)
+    assert d1.shape == (24, 3, 224, 224) and d1.dtype == np.float32
+    assert 0 <= d1.min() and d1.max() <= 255
+    assert np.abs(d1 - d2).max() > 0  # crops/mirrors differ across epochs
+
+
+def test_native_bad_jpeg_raises(tmp_path):
+    path = os.path.join(str(tmp_path), "bad.rec")
+    w = recordio.MXRecordIO(path, "w")
+    w.write(recordio.pack(recordio.IRHeader(0, 0.0, 0, 0),
+                          b"not a jpeg at all"))
+    w.close()
+    it = mxio.ImageRecordIter(
+        path_imgrec=path, data_shape=(3, 224, 224), batch_size=1,
+        preprocess_threads=1, prefetch=False)
+    if it._native_plan is None:
+        pytest.skip("native path not engaged")
+    with pytest.raises(Exception):
+        next(iter(it))
+
+
+def test_round_batch_wraparound(tmp_path):
+    """round_batch=1 (reference iter_batchloader.h:36): the final batch
+    wraps to the start (pad == 0 always) and the next epoch skips the
+    wrapped samples — each sample appears exactly once per cycle."""
+    path, _ = _make_rec(str(tmp_path), n=10)
+    it = mxio.ImageRecordIter(
+        path_imgrec=path, data_shape=(3, 224, 224), batch_size=4,
+        preprocess_threads=1, prefetch=False, round_batch=1)
+
+    def epoch_labels(it):
+        out = []
+        for b in it:
+            assert b.pad == 0  # roll_over: every batch is full
+            out.append(b.label[0].asnumpy())
+        return np.concatenate(out)
+
+    e1 = epoch_labels(it)
+    it.reset()
+    e2 = epoch_labels(it)
+    # epoch 1: 0..9 then wraps 0,1 -> 12 samples, 3 full batches
+    np.testing.assert_array_equal(
+        e1, np.array([0, 1, 2, 3, 4, 5, 6, 7, 8, 9, 0, 1], np.float32))
+    # epoch 2 resumes at sample 2; the remaining 8 samples are exactly
+    # two full batches, so it ends without wrapping
+    np.testing.assert_array_equal(
+        e2, np.array([2, 3, 4, 5, 6, 7, 8, 9], np.float32))
+
+
+def test_round_batch_exact_multiple_no_wrap(tmp_path):
+    path, _ = _make_rec(str(tmp_path), n=8)
+    it = mxio.ImageRecordIter(
+        path_imgrec=path, data_shape=(3, 224, 224), batch_size=4,
+        preprocess_threads=1, prefetch=False, round_batch=1)
+    e1 = np.concatenate([b.label[0].asnumpy() for b in it])
+    it.reset()
+    e2 = np.concatenate([b.label[0].asnumpy() for b in it])
+    np.testing.assert_array_equal(e1, np.arange(8, dtype=np.float32))
+    np.testing.assert_array_equal(e2, e1)
+
+
+def test_round_batch_shuffle_once_per_cycle(tmp_path):
+    """Shuffled roll_over: the wrap consumes the FIRST samples of the
+    next epoch's permutation, so over two epochs every sample appears
+    exactly twice (the dist-worker equal-step contract)."""
+    path, _ = _make_rec(str(tmp_path), n=10)
+    it = mxio.ImageRecordIter(
+        path_imgrec=path, data_shape=(3, 224, 224), batch_size=4,
+        shuffle=True, preprocess_threads=1, prefetch=False, round_batch=1)
+    e1 = np.concatenate([b.label[0].asnumpy() for b in it])
+    it.reset()
+    e2 = np.concatenate([b.label[0].asnumpy() for b in it])
+    assert len(e1) == 12 and len(e2) == 8
+    counts = np.bincount(np.concatenate([e1, e2]).astype(int), minlength=10)
+    np.testing.assert_array_equal(counts, np.full(10, 2))
