@@ -1,10 +1,18 @@
-// Train a small MLP classifier entirely from C++.
+// Train a small MLP classifier entirely from C++ against the frontend
+// C ABI (no Python.h anywhere in this translation unit).
 //
-// Reference: cpp-package/example/mlp.cpp — same flow: build symbol, bind,
-// init, per-batch forward/backward/update, report accuracy.
+// Reference: cpp-package/example/mlp.cpp — same flow: build symbol,
+// simple_bind, init params, per-batch forward/backward/update via the
+// optimizer registry, report accuracy.
+//
+// Run with MXNET_TPU_HOME pointing at the directory containing the
+// mxnet_tpu package (the runtime lives behind libmxnet_tpu_frontend.so).
 
+#include <algorithm>
+#include <cmath>
 #include <cstdlib>
 #include <iostream>
+#include <map>
 #include <random>
 #include <vector>
 
@@ -13,69 +21,120 @@
 namespace mc = mxnet_tpu_cpp;
 
 int main(int argc, char** argv) {
-  const char* repo = argc > 1 ? argv[1] : ".";
-  const char* extra = argc > 2 ? argv[2] : "";
-  mc::Runtime& rt = mc::Runtime::Init(repo, extra);
+  if (argc > 1) setenv("MXNET_TPU_HOME", argv[1], 1);
 
-  // symbol: 32 -> 64 relu -> 4 softmax
-  mc::Symbol data = mc::Symbol::Variable(rt, "data");
-  mc::Symbol fc1 = mc::Symbol::Op(rt, "FullyConnected", {data},
-                                  mc::Kwargs().set("num_hidden", 64)
-                                      .set("name", "fc1"));
-  mc::Symbol act = mc::Symbol::Op(rt, "Activation", {fc1},
-                                  mc::Kwargs().set("act_type", "relu"));
-  mc::Symbol fc2 = mc::Symbol::Op(rt, "FullyConnected", {act},
-                                  mc::Kwargs().set("num_hidden", 4)
-                                      .set("name", "fc2"));
-  mc::Symbol net = mc::Symbol::Op(rt, "SoftmaxOutput", {fc2},
-                                  mc::Kwargs().set("name", "softmax"));
+  const uint32_t B = 32, D = 32, C = 4;
+  mc::RandomSeed(7);
 
-  const long B = 32, D = 32, C = 4;
-  mc::Module mod(rt, net);
-  mod.Bind({B, D}, {B});
-  mod.InitParams();
-  mod.InitOptimizer("sgd", 0.2, 0.9);
+  // symbol: D -> 64 relu -> C softmax
+  mc::Symbol data = mc::Symbol::Variable("data");
+  mc::Symbol fc1 = mc::Symbol::Op("FullyConnected", "fc1", {data.get()},
+                                  {{"num_hidden", "64"}});
+  mc::Symbol act = mc::Symbol::Op("Activation", "relu1", {fc1.get()},
+                                  {{"act_type", "relu"}});
+  mc::Symbol fc2 = mc::Symbol::Op("FullyConnected", "fc2", {act.get()},
+                                  {{"num_hidden", "4"}});
+  mc::Symbol net = mc::Symbol::Op("SoftmaxOutput", "softmax", {fc2.get()},
+                                  {});
 
-  // synthetic clustered data
+  // synthetic clustered data: class c centered at indicator pattern c
+  const uint32_t N = 512;
   std::mt19937 gen(0);
-  std::normal_distribution<float> noise(0.f, 0.1f);
-  std::uniform_real_distribution<float> unif(0.f, 1.f);
-  std::uniform_int_distribution<int> cls(0, C - 1);
-  std::vector<float> centers(C * D);
-  for (auto& c : centers) c = unif(gen);
-
-  double last_acc = 0.0;
-  for (int step = 0; step < 60; ++step) {
-    std::vector<float> x(B * D);
-    std::vector<float> y(B);
-    int correct_src[B];
-    for (long b = 0; b < B; ++b) {
-      int k = cls(gen);
-      correct_src[b] = k;
-      y[b] = static_cast<float>(k);
-      for (long d = 0; d < D; ++d)
-        x[b * D + d] = centers[k * D + d] + noise(gen);
-    }
-    mc::Value xd = rt.ndarray(x, {B, D});
-    mc::Value yd = rt.ndarray(y, {B});
-    mod.ForwardBackward(xd, yd);
-    mod.Update();
-    if (step % 20 == 0 || step == 59) {
-      std::vector<float> probs = mod.Outputs();
-      int correct = 0;
-      for (long b = 0; b < B; ++b) {
-        int arg = 0;
-        for (int c = 1; c < C; ++c)
-          if (probs[b * C + c] > probs[b * C + arg]) arg = c;
-        if (arg == correct_src[b]) ++correct;
-      }
-      last_acc = static_cast<double>(correct) / B;
-      std::cout << "step " << step << " batch accuracy " << last_acc
-                << std::endl;
+  std::normal_distribution<float> noise(0.f, 0.35f);
+  std::vector<float> xs(N * D);
+  std::vector<float> ys(N);
+  for (uint32_t i = 0; i < N; ++i) {
+    uint32_t c = i % C;
+    ys[i] = static_cast<float>(c);
+    for (uint32_t d = 0; d < D; ++d) {
+      xs[i * D + d] = (d % C == c ? 1.f : 0.f) + noise(gen);
     }
   }
-  if (last_acc < 0.9) {
-    std::cerr << "FAILED: final accuracy " << last_acc << std::endl;
+  mc::NDArray x_all({N, D});
+  x_all.SyncCopyFromCPU(xs.data(), xs.size());
+  mc::NDArray y_all({N});
+  y_all.SyncCopyFromCPU(ys.data(), ys.size());
+  mc::DataIter iter(x_all, y_all, B);
+
+  mc::Executor exec(net, mc::Dev::kCPU, 0,
+                    {{"data", {B, D}}, {"softmax_label", {B}}});
+
+  // Xavier-ish host-side init (the ABI also exposes imperative ops; a
+  // local fill keeps the example self-contained)
+  auto init_param = [&](const std::string& name) {
+    mc::NDArray p = exec.Arg(name);
+    auto shp = p.Shape();
+    uint64_t n = p.Size();
+    float fan = static_cast<float>(shp[0] + (shp.size() > 1 ? shp[1] : 1));
+    std::uniform_real_distribution<float> u(-std::sqrt(6.f / fan),
+                                            std::sqrt(6.f / fan));
+    std::vector<float> buf(n);
+    for (auto& v : buf) v = u(gen);
+    p.SyncCopyFromCPU(buf.data(), n);
+  };
+  std::vector<std::string> params;
+  for (const auto& a : net.ListArguments()) {
+    if (a != "data" && a != "softmax_label") {
+      params.push_back(a);
+      init_param(a);
+    }
+  }
+
+  mc::KwArgs opt_args{{"learning_rate", "0.2"}, {"momentum", "0.9"}};
+  opt_args.Set("rescale_grad", std::to_string(1.0 / B));
+  mc::Optimizer opt("sgd", opt_args);
+
+  // Arg/Grad return stable write-through aliases — hoist them once
+  // instead of paying an ABI round-trip per use
+  mc::NDArray arg_data = exec.Arg("data");
+  mc::NDArray arg_label = exec.Arg("softmax_label");
+  std::vector<mc::NDArray> weights, grads;
+  for (const auto& p : params) {
+    weights.push_back(exec.Arg(p));
+    grads.push_back(exec.Grad(p));
+  }
+
+  for (int epoch = 0; epoch < 12; ++epoch) {
+    iter.BeforeFirst();
+    while (iter.Next()) {
+      std::vector<float> bx = iter.Data().AsVector();
+      std::vector<float> by = iter.Label().AsVector();
+      arg_data.SyncCopyFromCPU(bx.data(), B * D);
+      arg_label.SyncCopyFromCPU(by.data(), B);
+      exec.Forward(true);
+      exec.Backward();
+      for (size_t i = 0; i < params.size(); ++i) {
+        opt.Update(static_cast<int>(i), weights[i], grads[i]);
+      }
+    }
+  }
+
+  // accuracy over the full set
+  int correct = 0, total = 0;
+  iter.BeforeFirst();
+  while (iter.Next()) {
+    std::vector<float> bx = iter.Data().AsVector();
+    std::vector<float> labels = iter.Label().AsVector();
+    arg_data.SyncCopyFromCPU(bx.data(), B * D);
+    exec.Forward(false);
+    std::vector<float> probs = exec.Outputs()[0].AsVector();
+    int pad = iter.Pad();
+    for (uint32_t i = 0; i + static_cast<uint32_t>(pad) < B; ++i) {
+      int arg = 0;
+      for (uint32_t c = 1; c < C; ++c) {
+        if (probs[i * C + c] > probs[i * C + arg]) {
+          arg = static_cast<int>(c);
+        }
+      }
+      correct += (arg == static_cast<int>(labels[i]));
+      ++total;
+    }
+  }
+  float acc = static_cast<float>(correct) / static_cast<float>(total);
+  std::cout << "accuracy: " << acc << " (" << correct << "/" << total
+            << ")" << std::endl;
+  if (acc < 0.9f) {
+    std::cerr << "FAILED: accuracy below threshold" << std::endl;
     return 1;
   }
   std::cout << "C++ frontend training OK" << std::endl;

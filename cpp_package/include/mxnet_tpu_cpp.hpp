@@ -1,319 +1,367 @@
 // C++ frontend for the TPU-native framework.
 //
-// Reference: cpp-package/include/mxnet-cpp/ (SURVEY §2.7) — a full
-// training-capable C++ API (NDArray/Symbol/Optimizer/Module) that sits on
-// the same runtime every other frontend uses.  The reference rides the C
-// ABI of libmxnet; here the runtime's compute path is XLA driven through
-// the Python package, so this frontend embeds the CPython interpreter
-// (the supported "C ABI" of CPython) and drives exactly the same objects
-// a Python user gets — one runtime, N language frontends, as in the
-// reference where Scala/R/Perl all bind the same libmxnet.so.
+// Reference: cpp-package/include/mxnet-cpp/ (SURVEY §2.7) — a
+// training-capable C++ API (NDArray/Symbol/Executor/Optimizer/KVStore/
+// DataIter) riding the C ABI of libmxnet, exactly as the scala/R/perl
+// bindings do.  This header is the same shape: every class wraps an
+// opaque handle of include/mxnet_tpu/c_frontend_api.h and calls ONLY the
+// C surface — no Python.h, no CPython API anywhere in consumer code.
+// Link against libmxnet_tpu_frontend.so (which hosts the runtime) and
+// set MXNET_TPU_HOME to the directory containing the mxnet_tpu package.
 //
-// Header-only. Link with: python3.12-config --includes / --ldflags +
-// -lpython3.12.
+// Header-only; requires C++17.
 
 #pragma once
 
-#include <Python.h>
+#include <mxnet_tpu/c_frontend_api.h>
 
-#include <cstdio>
+#include <cstdint>
+#include <map>
 #include <stdexcept>
 #include <string>
+#include <utility>
 #include <vector>
 
 namespace mxnet_tpu_cpp {
 
-// RAII PyObject* handle with call/attr helpers.
-class Value {
+inline void Check(int rc) {
+  if (rc != 0) {
+    throw std::runtime_error(MXFrontGetLastError());
+  }
+}
+
+// string key/value params marshalled as two const char* arrays
+class KwArgs {
  public:
-  Value() : obj_(nullptr) {}
-  explicit Value(PyObject* obj) : obj_(obj) {}  // steals the reference
-  Value(const Value& o) : obj_(o.obj_) { Py_XINCREF(obj_); }
-  Value(Value&& o) noexcept : obj_(o.obj_) { o.obj_ = nullptr; }
-  Value& operator=(Value o) {
-    std::swap(obj_, o.obj_);
+  KwArgs() = default;
+  KwArgs(std::initializer_list<std::pair<std::string, std::string>> kv) {
+    for (const auto& p : kv) Set(p.first, p.second);
+  }
+  KwArgs& Set(const std::string& k, const std::string& v) {
+    keys_.push_back(k);
+    vals_.push_back(v);
     return *this;
   }
-  ~Value() { Py_XDECREF(obj_); }
-
-  static Value borrowed(PyObject* obj) {
-    Py_XINCREF(obj);
-    return Value(obj);
-  }
-  static Value none() {
-    Py_INCREF(Py_None);
-    return Value(Py_None);
-  }
-  static Value str(const std::string& s) {
-    return Check(PyUnicode_FromString(s.c_str()));
-  }
-  static Value integer(long v) { return Check(PyLong_FromLong(v)); }
-  static Value floating(double v) { return Check(PyFloat_FromDouble(v)); }
-  static Value boolean(bool v) { return borrowed(v ? Py_True : Py_False); }
-
-  PyObject* get() const { return obj_; }
-  bool valid() const { return obj_ != nullptr; }
-
-  Value attr(const std::string& name) const {
-    return Check(PyObject_GetAttrString(obj_, name.c_str()));
-  }
-  Value item(long i) const {
-    return Check(PySequence_GetItem(obj_, i));
-  }
-  long size() const { return static_cast<long>(PySequence_Size(obj_)); }
-
-  // call with positional args only
-  template <typename... A>
-  Value operator()(const A&... args) const {
-    Value tuple = MakeTuple(args...);
-    return Check(PyObject_CallObject(obj_, tuple.get()));
-  }
-  // call with positional tuple + kwargs dict
-  Value call(const Value& args, const Value& kwargs) const {
-    return Check(PyObject_Call(obj_, args.get(), kwargs.get()));
-  }
-
-  double as_double() const { return PyFloat_AsDouble(obj_); }
-  long as_long() const { return PyLong_AsLong(obj_); }
-  std::string as_string() const {
-    Value s = Check(PyObject_Str(obj_));
-    return PyUnicode_AsUTF8(s.get());
-  }
-
-  template <typename... A>
-  static Value MakeTuple(const A&... args) {
-    PyObject* t = PyTuple_New(sizeof...(A));
-    int i = 0;
-    (void)std::initializer_list<int>{
-        (PyTuple_SetItem(t, i++, ToPy(args)), 0)...};
-    return Check(t);
-  }
-
-  static Value Check(PyObject* obj) {
-    if (obj == nullptr) {
-      PyErr_Print();
-      throw std::runtime_error("python call failed");
-    }
-    return Value(obj);
-  }
+  int size() const { return static_cast<int>(keys_.size()); }
+  std::vector<const char*> keys() const { return CStrs(keys_); }
+  std::vector<const char*> vals() const { return CStrs(vals_); }
 
  private:
-  // ToPy returns NEW references (PyTuple_SetItem steals them)
-  static PyObject* ToPy(const Value& v) {
-    Py_XINCREF(v.get());
-    return v.get();
+  static std::vector<const char*> CStrs(const std::vector<std::string>& v) {
+    std::vector<const char*> out;
+    out.reserve(v.size());
+    for (const auto& s : v) out.push_back(s.c_str());
+    return out;
   }
-  static PyObject* ToPy(const std::string& s) {
-    return PyUnicode_FromString(s.c_str());
-  }
-  static PyObject* ToPy(const char* s) { return PyUnicode_FromString(s); }
-  static PyObject* ToPy(long v) { return PyLong_FromLong(v); }
-  static PyObject* ToPy(int v) { return PyLong_FromLong(v); }
-  static PyObject* ToPy(double v) { return PyFloat_FromDouble(v); }
-
-  PyObject* obj_;
+  std::vector<std::string> keys_, vals_;
 };
 
-// kwargs builder
-class Kwargs {
+enum class Dev { kCPU = 1, kTPU = 4 };
+
+class NDArray {
  public:
-  Kwargs() : dict_(Value::Check(PyDict_New())) {}
-  Kwargs& set(const std::string& k, const Value& v) {
-    PyDict_SetItemString(dict_.get(), k.c_str(), v.get());
+  NDArray() : h_(nullptr) {}
+  explicit NDArray(NDArrayHandle h) : h_(h) {}  // takes ownership
+  NDArray(const std::vector<uint32_t>& shape, Dev dev = Dev::kCPU,
+          int dev_id = 0, int dtype = 0) {
+    Check(MXFrontNDArrayCreate(shape.data(),
+                               static_cast<uint32_t>(shape.size()),
+                               static_cast<int>(dev), dev_id, dtype, &h_));
+  }
+  NDArray(const NDArray&) = delete;
+  NDArray& operator=(const NDArray&) = delete;
+  NDArray(NDArray&& o) noexcept : h_(o.h_) { o.h_ = nullptr; }
+  NDArray& operator=(NDArray&& o) noexcept {
+    std::swap(h_, o.h_);
     return *this;
   }
-  Kwargs& set(const std::string& k, const std::string& v) {
-    return set(k, Value::str(v));
-  }
-  // without this, string literals would resolve to the bool overload
-  Kwargs& set(const std::string& k, const char* v) {
-    return set(k, Value::str(v));
-  }
-  Kwargs& set(const std::string& k, long v) {
-    return set(k, Value::integer(v));
-  }
-  Kwargs& set(const std::string& k, int v) {
-    return set(k, Value::integer(v));
-  }
-  Kwargs& set(const std::string& k, double v) {
-    return set(k, Value::floating(v));
-  }
-  Kwargs& set(const std::string& k, bool v) {
-    return set(k, Value::boolean(v));
-  }
-  const Value& dict() const { return dict_; }
-
- private:
-  Value dict_;
-};
-
-// The runtime singleton: embedded interpreter + the mxnet_tpu module.
-class Runtime {
- public:
-  // repo_root: directory containing mxnet_tpu/; extra_path: e.g. a venv's
-  // site-packages when embedding outside that venv's python binary.
-  static Runtime& Init(const std::string& repo_root,
-                       const std::string& extra_path = "") {
-    static Runtime rt(repo_root, extra_path);
-    return rt;
+  ~NDArray() {
+    if (h_ != nullptr) MXFrontNDArrayFree(h_);
   }
 
-  Value mx() const { return mx_; }
-  Value nd() const { return mx_.attr("nd"); }
-  Value sym() const { return mx_.attr("sym"); }
-  Value numpy() const { return np_; }
+  NDArrayHandle get() const { return h_; }
+  bool valid() const { return h_ != nullptr; }
 
-  // numpy float32 array from a flat buffer + shape
-  Value array(const std::vector<float>& data,
-              const std::vector<long>& shape) const {
-    Value np_arr = np_.attr("array")(FloatList(data));
-    np_arr = np_arr.attr("astype")(std::string("float32"));
-    return np_arr.attr("reshape")(LongList(shape));
+  void SyncCopyFromCPU(const float* data, uint64_t size) {
+    Check(MXFrontNDArraySyncCopyFromCPU(h_, data, size));
   }
-
-  // NDArray from buffer+shape
-  Value ndarray(const std::vector<float>& data,
-                const std::vector<long>& shape) const {
-    return nd().attr("array")(array(data, shape));
+  void SyncCopyToCPU(float* data, uint64_t size) const {
+    Check(MXFrontNDArraySyncCopyToCPU(h_, data, size));
   }
-
-  static Value FloatList(const std::vector<float>& v) {
-    PyObject* lst = PyList_New(static_cast<Py_ssize_t>(v.size()));
-    for (size_t i = 0; i < v.size(); ++i)
-      PyList_SetItem(lst, static_cast<Py_ssize_t>(i),
-                     PyFloat_FromDouble(v[i]));
-    return Value::Check(lst);
+  std::vector<uint32_t> Shape() const {
+    uint32_t nd;
+    const uint32_t* dims;
+    Check(MXFrontNDArrayGetShape(h_, &nd, &dims));
+    return std::vector<uint32_t>(dims, dims + nd);
   }
-  static Value LongList(const std::vector<long>& v) {
-    PyObject* lst = PyList_New(static_cast<Py_ssize_t>(v.size()));
-    for (size_t i = 0; i < v.size(); ++i)
-      PyList_SetItem(lst, static_cast<Py_ssize_t>(i),
-                     PyLong_FromLong(v[i]));
-    return Value::Check(lst);
+  uint64_t Size() const {
+    uint64_t n = 1;
+    for (uint32_t d : Shape()) n *= d;
+    return n;
   }
-
-  static std::vector<float> to_vector(const Value& ndarray_or_np) {
-    Value flat = ndarray_or_np;
-    if (PyObject_HasAttrString(flat.get(), "asnumpy"))
-      flat = flat.attr("asnumpy")();
-    flat = flat.attr("reshape")(Value::integer(-1));
-    Value lst = flat.attr("tolist")();
-    long n = lst.size();
-    std::vector<float> out(static_cast<size_t>(n));
-    for (long i = 0; i < n; ++i)
-      out[static_cast<size_t>(i)] = static_cast<float>(
-          lst.item(i).as_double());
+  std::vector<float> AsVector() const {
+    std::vector<float> out(Size());
+    SyncCopyToCPU(out.data(), out.size());
     return out;
   }
 
- private:
-  Runtime(const std::string& repo_root, const std::string& extra_path) {
-    Py_Initialize();
-    Value sys = Value::Check(PyImport_ImportModule("sys"));
-    Value path = sys.attr("path");
-    if (!extra_path.empty())
-      path.attr("insert")(Value::integer(0), Value::str(extra_path));
-    path.attr("insert")(Value::integer(0), Value::str(repo_root));
-    np_ = Value::Check(PyImport_ImportModule("numpy"));
-    mx_ = Value::Check(PyImport_ImportModule("mxnet_tpu"));
+  // generic imperative op (reference MXImperativeInvoke)
+  static std::vector<NDArray> Invoke(const std::string& op,
+                                     const std::vector<NDArrayHandle>& ins,
+                                     const KwArgs& params = {}) {
+    NDArrayHandle outs[8];
+    int n = 8;
+    auto k = params.keys();
+    auto v = params.vals();
+    Check(MXFrontImperativeInvoke(
+        op.c_str(), static_cast<int>(ins.size()),
+        const_cast<NDArrayHandle*>(ins.data()), params.size(),
+        k.data(), v.data(), &n, outs));
+    std::vector<NDArray> res;
+    res.reserve(n);
+    for (int i = 0; i < n; ++i) res.emplace_back(outs[i]);
+    return res;
   }
-  Value mx_, np_;
-};
 
-// --- typed facades (the mxnet-cpp surface) --------------------------------
+  static void WaitAll() { Check(MXFrontNDArrayWaitAll()); }
+
+ private:
+  NDArrayHandle h_;
+};
 
 class Symbol {
  public:
-  Symbol() {}
-  explicit Symbol(Value v) : v_(v) {}
-  static Symbol Variable(Runtime& rt, const std::string& name) {
-    return Symbol(rt.sym().attr("Variable")(name));
+  Symbol() : h_(nullptr) {}
+  explicit Symbol(SymbolHandle h) : h_(h) {}
+  Symbol(const Symbol&) = delete;
+  Symbol& operator=(const Symbol&) = delete;
+  Symbol(Symbol&& o) noexcept : h_(o.h_) { o.h_ = nullptr; }
+  Symbol& operator=(Symbol&& o) noexcept {
+    std::swap(h_, o.h_);
+    return *this;
   }
-  // generic op application: Symbol::Op(rt, "FullyConnected", {data}, kw)
-  static Symbol Op(Runtime& rt, const std::string& op,
-                   const std::vector<Symbol>& args, const Kwargs& kw) {
-    PyObject* t = PyTuple_New(static_cast<Py_ssize_t>(args.size()));
-    for (size_t i = 0; i < args.size(); ++i) {
-      Py_XINCREF(args[i].v_.get());
-      PyTuple_SetItem(t, static_cast<Py_ssize_t>(i), args[i].v_.get());
-    }
-    return Symbol(rt.sym().attr(op).call(Value::Check(t), kw.dict()));
+  ~Symbol() {
+    if (h_ != nullptr) MXFrontSymbolFree(h_);
   }
-  Value value() const { return v_; }
+
+  SymbolHandle get() const { return h_; }
+
+  static Symbol Variable(const std::string& name) {
+    SymbolHandle h;
+    Check(MXFrontSymbolCreateVariable(name.c_str(), &h));
+    return Symbol(h);
+  }
+  static Symbol Op(const std::string& op, const std::string& name,
+                   const std::vector<SymbolHandle>& inputs,
+                   const KwArgs& params = {}) {
+    SymbolHandle h;
+    auto k = params.keys();
+    auto v = params.vals();
+    Check(MXFrontSymbolCreateOp(
+        op.c_str(), name.c_str(), params.size(), k.data(), v.data(),
+        static_cast<int>(inputs.size()), nullptr,
+        const_cast<SymbolHandle*>(inputs.data()), &h));
+    return Symbol(h);
+  }
+
+  std::vector<std::string> ListArguments() const { return List(0); }
+  std::vector<std::string> ListAuxiliaryStates() const { return List(1); }
+  std::vector<std::string> ListOutputs() const { return List(2); }
+
+  std::string ToJSON() const {
+    const char* js;
+    Check(MXFrontSymbolSaveToJSON(h_, &js));
+    return js;
+  }
+  static Symbol FromJSON(const std::string& js) {
+    SymbolHandle h;
+    Check(MXFrontSymbolCreateFromJSON(js.c_str(), &h));
+    return Symbol(h);
+  }
 
  private:
-  Value v_;
+  std::vector<std::string> List(int which) const {
+    int n;
+    const char** names;
+    int rc = which == 0
+        ? MXFrontSymbolListArguments(h_, &n, &names)
+        : which == 1 ? MXFrontSymbolListAuxiliaryStates(h_, &n, &names)
+                     : MXFrontSymbolListOutputs(h_, &n, &names);
+    Check(rc);
+    std::vector<std::string> out;
+    out.reserve(n);
+    for (int i = 0; i < n; ++i) out.emplace_back(names[i]);
+    return out;
+  }
+  SymbolHandle h_;
 };
 
-class Module {
+class Executor {
  public:
-  Module(Runtime& rt, const Symbol& net) : rt_(&rt) {
-    mod_ = rt.mx().attr("mod").attr("Module")(net.value());
-  }
-
-  void Bind(const std::vector<long>& data_shape,
-            const std::vector<long>& label_shape) {
-    Value ds = Value::MakeTuple(Value::MakeTuple(
-        Value::str("data"), TupleOf(data_shape)));
-    Kwargs kw;
-    if (!label_shape.empty()) {
-      kw.set("label_shapes", Value::MakeTuple(Value::MakeTuple(
-          Value::str("softmax_label"), TupleOf(label_shape))));
+  Executor(const Symbol& sym, Dev dev, int dev_id,
+           const std::map<std::string, std::vector<uint32_t>>& shapes,
+           const std::string& grad_req = "write") {
+    std::vector<const char*> keys;
+    std::vector<uint32_t> indptr{0};
+    std::vector<uint32_t> data;
+    for (const auto& kv : shapes) {
+      keys.push_back(kv.first.c_str());
+      for (uint32_t d : kv.second) data.push_back(d);
+      indptr.push_back(static_cast<uint32_t>(data.size()));
     }
-    mod_.attr("bind").call(Value::MakeTuple(ds), kw.dict());
+    Check(MXFrontExecutorSimpleBind(
+        sym.get(), static_cast<int>(dev), dev_id,
+        static_cast<uint32_t>(keys.size()), keys.data(), indptr.data(),
+        data.data(), grad_req.c_str(), &h_));
+  }
+  Executor(const Executor&) = delete;
+  Executor& operator=(const Executor&) = delete;
+  ~Executor() {
+    if (h_ != nullptr) MXFrontExecutorFree(h_);
   }
 
-  void InitParams(double xavier_magnitude = 2.0) {
-    Kwargs kw;
-    kw.set("magnitude", xavier_magnitude);
-    Value init = rt_->mx().attr("init").attr("Xavier")
-        .call(Value::MakeTuple(), kw.dict());
-    mod_.attr("init_params")(init);
+  void Forward(bool is_train) {
+    Check(MXFrontExecutorForward(h_, is_train ? 1 : 0));
   }
+  void Backward() { Check(MXFrontExecutorBackward(h_, 0, nullptr)); }
 
-  void InitOptimizer(const std::string& name, double lr,
-                     double momentum = 0.0) {
-    Kwargs opt_params;
-    opt_params.set("learning_rate", lr);
-    if (momentum != 0.0) opt_params.set("momentum", momentum);
-    Kwargs kw;
-    kw.set("optimizer", name);
-    kw.set("optimizer_params", opt_params.dict());
-    mod_.attr("init_optimizer").call(Value::MakeTuple(), kw.dict());
+  std::vector<NDArray> Outputs() const {
+    int n;
+    NDArrayHandle* hs;
+    Check(MXFrontExecutorOutputs(h_, &n, &hs));
+    std::vector<NDArray> out;
+    out.reserve(n);
+    for (int i = 0; i < n; ++i) out.emplace_back(hs[i]);
+    return out;
   }
+  // named access; the returned NDArray aliases the executor's buffer
+  // object (writes through it update the executor state)
+  NDArray Arg(const std::string& name) const { return Get(0, name); }
+  NDArray Grad(const std::string& name) const { return Get(1, name); }
+  NDArray Aux(const std::string& name) const { return Get(2, name); }
 
-  void ForwardBackward(const Value& data, const Value& label) {
-    Value lst_d = Value::MakeTuple(data);
-    Value lst_l = Value::MakeTuple(label);
-    Kwargs kw;
-    kw.set("data", Value::Check(PySequence_List(lst_d.get())));
-    kw.set("label", Value::Check(PySequence_List(lst_l.get())));
-    Value batch = rt_->mx().attr("io").attr("DataBatch")
-        .call(Value::MakeTuple(), kw.dict());
-    mod_.attr("forward_backward")(batch);
+ private:
+  NDArray Get(int which, const std::string& name) const {
+    NDArrayHandle h;
+    int rc = which == 0 ? MXFrontExecutorGetArg(h_, name.c_str(), &h)
+             : which == 1 ? MXFrontExecutorGetGrad(h_, name.c_str(), &h)
+                          : MXFrontExecutorGetAux(h_, name.c_str(), &h);
+    Check(rc);
+    return NDArray(h);
   }
+  ExecutorHandle h_;
+};
 
-  void Update() { mod_.attr("update")(); }
-
-  std::vector<float> Outputs() {
-    Value outs = mod_.attr("get_outputs")();
-    return Runtime::to_vector(outs.item(0));
+class Optimizer {
+ public:
+  Optimizer(const std::string& name, const KwArgs& params) {
+    auto k = params.keys();
+    auto v = params.vals();
+    Check(MXFrontOptimizerCreate(name.c_str(), params.size(), k.data(),
+                                 v.data(), &h_));
   }
-
-  void SaveCheckpoint(const std::string& prefix, int epoch) {
-    mod_.attr("save_checkpoint")(prefix, epoch);
+  Optimizer(const Optimizer&) = delete;
+  Optimizer& operator=(const Optimizer&) = delete;
+  ~Optimizer() {
+    if (h_ != nullptr) MXFrontOptimizerFree(h_);
+  }
+  void Update(int index, const NDArray& weight, const NDArray& grad) {
+    Check(MXFrontOptimizerUpdate(h_, index, weight.get(), grad.get()));
   }
 
  private:
-  static Value TupleOf(const std::vector<long>& v) {
-    PyObject* t = PyTuple_New(static_cast<Py_ssize_t>(v.size()));
-    for (size_t i = 0; i < v.size(); ++i)
-      PyTuple_SetItem(t, static_cast<Py_ssize_t>(i),
-                      PyLong_FromLong(v[i]));
-    return Value::Check(t);
-  }
-  Runtime* rt_;
-  Value mod_;
+  OptimizerHandle h_;
 };
+
+class KVStore {
+ public:
+  explicit KVStore(const std::string& type) {
+    Check(MXFrontKVStoreCreate(type.c_str(), &h_));
+  }
+  KVStore(const KVStore&) = delete;
+  KVStore& operator=(const KVStore&) = delete;
+  ~KVStore() {
+    if (h_ != nullptr) MXFrontKVStoreFree(h_);
+  }
+  void Init(int key, const NDArray& v) {
+    Check(MXFrontKVStoreInit(h_, key, v.get()));
+  }
+  void Push(int key, const NDArray& v, int priority = 0) {
+    Check(MXFrontKVStorePush(h_, key, v.get(), priority));
+  }
+  void Pull(int key, NDArray* out, int priority = 0) {
+    Check(MXFrontKVStorePull(h_, key, out->get(), priority));
+  }
+  void SetOptimizer(const std::string& name, const KwArgs& params) {
+    auto k = params.keys();
+    auto v = params.vals();
+    Check(MXFrontKVStoreSetOptimizer(h_, name.c_str(), params.size(),
+                                     k.data(), v.data()));
+  }
+  int Rank() const {
+    int r;
+    Check(MXFrontKVStoreGetRank(h_, &r));
+    return r;
+  }
+  int NumWorkers() const {
+    int n;
+    Check(MXFrontKVStoreGetGroupSize(h_, &n));
+    return n;
+  }
+
+ private:
+  KVStoreHandle h_;
+};
+
+class DataIter {
+ public:
+  // registered iterator by name (MNISTIter / ImageRecordIter / ...)
+  DataIter(const std::string& name, const KwArgs& params) {
+    auto k = params.keys();
+    auto v = params.vals();
+    Check(MXFrontDataIterCreate(name.c_str(), params.size(), k.data(),
+                                v.data(), &h_));
+  }
+  // NDArrayIter over in-memory arrays
+  DataIter(const NDArray& data, const NDArray& label, int batch_size,
+           bool shuffle = false,
+           const std::string& last_batch_handle = "pad") {
+    Check(MXFrontDataIterCreateNDArray(data.get(), label.get(), batch_size,
+                                       shuffle ? 1 : 0,
+                                       last_batch_handle.c_str(), &h_));
+  }
+  DataIter(const DataIter&) = delete;
+  DataIter& operator=(const DataIter&) = delete;
+  ~DataIter() {
+    if (h_ != nullptr) MXFrontDataIterFree(h_);
+  }
+
+  bool Next() {
+    int more;
+    Check(MXFrontDataIterNext(h_, &more));
+    return more != 0;
+  }
+  void BeforeFirst() { Check(MXFrontDataIterBeforeFirst(h_)); }
+  NDArray Data() const {
+    NDArrayHandle h;
+    Check(MXFrontDataIterGetData(h_, &h));
+    return NDArray(h);
+  }
+  NDArray Label() const {
+    NDArrayHandle h;
+    Check(MXFrontDataIterGetLabel(h_, &h));
+    return NDArray(h);
+  }
+  int Pad() const {
+    int p;
+    Check(MXFrontDataIterGetPad(h_, &p));
+    return p;
+  }
+
+ private:
+  DataIterHandle h_;
+};
+
+inline void RandomSeed(int seed) { Check(MXFrontRandomSeed(seed)); }
 
 }  // namespace mxnet_tpu_cpp

@@ -1,15 +1,22 @@
 /*!
- * C ABI of the native host runtime — the binding surface for non-Python
- * frontends.
+ * C ABI of the native host runtime (engine / storage / recordio).
  *
- * Reference: include/mxnet/c_api.h (1475 lines, 116 MXNET_DLL functions) is
- * the surface every reference language binding sits on (SURVEY §2.7).  In
- * the TPU framework the device path is PJRT/XLA (bound per-language through
- * each language's JAX/PJRT story), so the native C ABI covers the HOST
- * runtime: the async dependency engine, pooled host storage, and the
- * RecordIO scanner.  The C++ frontend (cpp_package/) and the Python ctypes
- * layer (mxnet_tpu/native/__init__.py) both sit on exactly these symbols,
- * compiled from src/native.cc into libmxnet_tpu_native.so.
+ * Reference: include/mxnet/c_api.h (1475 lines, 116 MXNET_DLL functions)
+ * is the surface every reference language binding sits on (SURVEY §2.7).
+ * The TPU framework splits that surface in three:
+ *
+ *  1. THIS header — the host-runtime ABI (async dependency engine,
+ *     pooled host storage, RecordIO scanner), compiled from
+ *     src/native.cc into libmxnet_tpu_native.so; the Python ctypes layer
+ *     (mxnet_tpu/native/__init__.py) sits on it.
+ *  2. c_frontend_api.h — the handle-based FRONTEND ABI (NDArray /
+ *     Symbol / Executor / KVStore / DataIter / Optimizer), the binding
+ *     surface for non-Python languages; the C++ frontend (cpp_package/)
+ *     compiles against it alone.  Implemented by src/frontend_capi.cc
+ *     (libmxnet_tpu_frontend.so), which hosts the runtime the same way
+ *     the reference's C ABI hosts its C++ runtime.
+ *  3. c_predict_api.h — the minimal standalone inference ABI
+ *     (reference c_predict_api.h analog) for deployment targets.
  *
  * All handles are opaque void*.  Thread-safety: a handle may be used from
  * any thread; Push is serialized internally by the engine's queues.

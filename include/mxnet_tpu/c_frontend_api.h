@@ -1,0 +1,194 @@
+/*!
+ * Frontend C ABI — the handle-based binding surface for non-Python
+ * language frontends (NDArray / Symbol / Executor / KVStore / DataIter /
+ * Optimizer), the TPU framework's analog of the reference
+ * include/mxnet/c_api.h (116 MXNET_DLL functions; every binding — scala,
+ * R, perl, matlab, cpp-package — sits on it, SURVEY §2.7).
+ *
+ * Implementation (src/frontend_capi.cc, built into
+ * libmxnet_tpu_frontend.so): the compute path of this framework is
+ * XLA/PJRT driven through the Python package, so the ABI hosts an
+ * embedded CPython interpreter exactly like the reference's C ABI hosts
+ * its C++ runtime — consumers link ONLY this C surface (no Python.h).
+ * Set MXNET_TPU_HOME to the repo/site-packages dir holding mxnet_tpu
+ * before the first call.
+ *
+ * Conventions (all inherited from the reference ABI):
+ *  - every function returns 0 on success, -1 on failure;
+ *    MXFrontGetLastError() describes the failure (thread-local).
+ *  - handles are opaque; free NDArray/Symbol/Executor/KVStore/DataIter/
+ *    Optimizer handles with the matching *Free call.
+ *  - out-pointer arrays (shapes, name lists) point into THREAD-LOCAL
+ *    scratch valid until the next ABI call on the same thread.
+ *  - dtype codes: 0=float32 1=float64 2=float16 3=uint8 4=int32
+ *    6=bfloat16 (TPU extension).
+ *  - dev_type: 1=cpu (3=cpu_pinned alias), 2=gpu accepted as the
+ *    accelerator alias, 4=tpu.
+ */
+#ifndef MXNET_TPU_C_FRONTEND_API_H_
+#define MXNET_TPU_C_FRONTEND_API_H_
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef void* NDArrayHandle;
+typedef void* SymbolHandle;
+typedef void* ExecutorHandle;
+typedef void* KVStoreHandle;
+typedef void* DataIterHandle;
+typedef void* OptimizerHandle;
+
+/* ---- runtime ---------------------------------------------------------- */
+/*! \brief thread-local message for the last failed call. */
+const char* MXFrontGetLastError(void);
+/*! \brief seed every RNG (reference MXRandomSeed: also seeds numpy). */
+int MXFrontRandomSeed(int seed);
+/*! \brief finalize the embedded runtime (optional; process exit works). */
+int MXFrontNotifyShutdown(void);
+/*! \brief number of registered operators; names via MXFrontListOps. */
+int MXFrontListOps(int* out_size, const char*** out_names);
+
+/* ---- NDArray ---------------------------------------------------------- */
+int MXFrontNDArrayCreate(const uint32_t* shape, uint32_t ndim,
+                         int dev_type, int dev_id, int dtype,
+                         NDArrayHandle* out);
+int MXFrontNDArrayFree(NDArrayHandle h);
+/*! \brief blocking element copy host->array; size in ELEMENTS. */
+int MXFrontNDArraySyncCopyFromCPU(NDArrayHandle h, const void* data,
+                                  uint64_t size);
+/*! \brief blocking element copy array->host (the asnumpy sync point). */
+int MXFrontNDArraySyncCopyToCPU(NDArrayHandle h, void* data,
+                                uint64_t size);
+int MXFrontNDArrayGetShape(NDArrayHandle h, uint32_t* out_ndim,
+                           const uint32_t** out_shape);
+int MXFrontNDArrayGetDType(NDArrayHandle h, int* out_dtype);
+/*! \brief dmlc-magic save/load (reference MXNDArraySave/Load format). */
+int MXFrontNDArraySave(const char* fname, uint32_t num,
+                       NDArrayHandle* handles, const char** keys);
+int MXFrontNDArrayLoad(const char* fname, uint32_t* out_num,
+                       NDArrayHandle** out_handles,
+                       const char*** out_keys);
+/*! \brief generic imperative op dispatch (reference MXImperativeInvoke):
+ *  invokes registered op \p op_name on \p inputs with string params.
+ *  On entry *num_outputs is the capacity of \p outputs; on exit the
+ *  actual count. */
+int MXFrontImperativeInvoke(const char* op_name, int num_inputs,
+                            NDArrayHandle* inputs, int num_params,
+                            const char** param_keys,
+                            const char** param_vals,
+                            int* num_outputs, NDArrayHandle* outputs);
+/*! \brief block until all pending async work completes. */
+int MXFrontNDArrayWaitAll(void);
+
+/* ---- Symbol ----------------------------------------------------------- */
+int MXFrontSymbolCreateVariable(const char* name, SymbolHandle* out);
+/*! \brief build one op node: params as strings, inputs positionally
+ *  (input_keys may be NULL) — the one-step form of the reference's
+ *  CreateAtomicSymbol+Compose pair. */
+int MXFrontSymbolCreateOp(const char* op_name, const char* name,
+                          int num_params, const char** param_keys,
+                          const char** param_vals,
+                          int num_inputs, const char** input_keys,
+                          SymbolHandle* inputs, SymbolHandle* out);
+int MXFrontSymbolGroup(int num, SymbolHandle* syms, SymbolHandle* out);
+int MXFrontSymbolFree(SymbolHandle h);
+int MXFrontSymbolListArguments(SymbolHandle h, int* out_size,
+                               const char*** out_names);
+int MXFrontSymbolListAuxiliaryStates(SymbolHandle h, int* out_size,
+                                     const char*** out_names);
+int MXFrontSymbolListOutputs(SymbolHandle h, int* out_size,
+                             const char*** out_names);
+int MXFrontSymbolSaveToJSON(SymbolHandle h, const char** out_json);
+int MXFrontSymbolCreateFromJSON(const char* json, SymbolHandle* out);
+/*! \brief shape inference: provided arg shapes as a CSR triple keyed by
+ *  name; outputs are three shape lists (args / outputs / aux) in the
+ *  order of the corresponding List* call. */
+int MXFrontSymbolInferShape(SymbolHandle h, uint32_t num_args,
+                            const char** keys, const uint32_t* indptr,
+                            const uint32_t* shape_data,
+                            uint32_t* arg_count, const uint32_t** arg_ndim,
+                            const uint32_t*** arg_shapes,
+                            uint32_t* out_count, const uint32_t** out_ndim,
+                            const uint32_t*** out_shapes,
+                            uint32_t* aux_count, const uint32_t** aux_ndim,
+                            const uint32_t*** aux_shapes);
+
+/* ---- Executor --------------------------------------------------------- */
+/*! \brief infer shapes from the provided input shapes, allocate
+ *  arg/grad/aux arrays, bind (reference MXExecutorSimpleBind).
+ *  grad_req: "write", "add" or "null". */
+int MXFrontExecutorSimpleBind(SymbolHandle sym, int dev_type, int dev_id,
+                              uint32_t num_provided, const char** keys,
+                              const uint32_t* indptr,
+                              const uint32_t* shape_data,
+                              const char* grad_req, ExecutorHandle* out);
+int MXFrontExecutorFree(ExecutorHandle h);
+int MXFrontExecutorForward(ExecutorHandle h, int is_train);
+/*! \brief num_head_grads == 0 uses the default head gradients (loss
+ *  graphs); otherwise one cotangent per output. */
+int MXFrontExecutorBackward(ExecutorHandle h, int num_head_grads,
+                            NDArrayHandle* head_grads);
+int MXFrontExecutorOutputs(ExecutorHandle h, int* out_size,
+                           NDArrayHandle** out_handles);
+/*! \brief named access into arg_dict / grad_dict / aux_dict; grad of an
+ *  unbound name yields *out == NULL with return 0. */
+int MXFrontExecutorGetArg(ExecutorHandle h, const char* name,
+                          NDArrayHandle* out);
+int MXFrontExecutorGetGrad(ExecutorHandle h, const char* name,
+                           NDArrayHandle* out);
+int MXFrontExecutorGetAux(ExecutorHandle h, const char* name,
+                          NDArrayHandle* out);
+
+/* ---- Optimizer (registry-backed; reference cpp-package reimplements
+ * these in C++ — here the one registry serves every frontend) ----------- */
+int MXFrontOptimizerCreate(const char* name, int num_params,
+                           const char** keys, const char** vals,
+                           OptimizerHandle* out);
+int MXFrontOptimizerFree(OptimizerHandle h);
+/*! \brief apply one update step: state is kept per index inside the
+ *  handle (reference get_updater closure semantics). */
+int MXFrontOptimizerUpdate(OptimizerHandle h, int index,
+                           NDArrayHandle weight, NDArrayHandle grad);
+
+/* ---- KVStore ---------------------------------------------------------- */
+int MXFrontKVStoreCreate(const char* type, KVStoreHandle* out);
+int MXFrontKVStoreFree(KVStoreHandle h);
+int MXFrontKVStoreInit(KVStoreHandle h, int key, NDArrayHandle v);
+int MXFrontKVStorePush(KVStoreHandle h, int key, NDArrayHandle v,
+                       int priority);
+int MXFrontKVStorePull(KVStoreHandle h, int key, NDArrayHandle out,
+                       int priority);
+int MXFrontKVStoreSetOptimizer(KVStoreHandle h, const char* opt_name,
+                               int num_params, const char** keys,
+                               const char** vals);
+int MXFrontKVStoreGetRank(KVStoreHandle h, int* out);
+int MXFrontKVStoreGetGroupSize(KVStoreHandle h, int* out);
+int MXFrontKVStoreBarrier(KVStoreHandle h);
+
+/* ---- DataIter --------------------------------------------------------- */
+/*! \brief create a registered iterator by name ("MNISTIter",
+ *  "ImageRecordIter", "CSVIter", ...) with string params (reference
+ *  MXDataIterCreateIter). */
+int MXFrontDataIterCreate(const char* name, int num_params,
+                          const char** keys, const char** vals,
+                          DataIterHandle* out);
+/*! \brief NDArrayIter over in-memory arrays. */
+int MXFrontDataIterCreateNDArray(NDArrayHandle data, NDArrayHandle label,
+                                 int batch_size, int shuffle,
+                                 const char* last_batch_handle,
+                                 DataIterHandle* out);
+int MXFrontDataIterFree(DataIterHandle h);
+int MXFrontDataIterNext(DataIterHandle h, int* out_more);
+int MXFrontDataIterBeforeFirst(DataIterHandle h);
+int MXFrontDataIterGetData(DataIterHandle h, NDArrayHandle* out);
+int MXFrontDataIterGetLabel(DataIterHandle h, NDArrayHandle* out);
+int MXFrontDataIterGetPad(DataIterHandle h, int* out_pad);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif  /* MXNET_TPU_C_FRONTEND_API_H_ */

@@ -1,0 +1,295 @@
+"""Marshalling layer for the frontend C ABI (src/frontend_capi.cc).
+
+The embedded interpreter inside ``libmxnet_tpu_frontend.so`` imports this
+module once and drives the whole framework through these thin functions —
+plain ints/strings/lists cross the C boundary, every object stays a
+``PyObject*`` handle on the C side.  Keeping the marshalling here (rather
+than in CPython C-API calls) keeps the C++ layer small and the behavior
+identical to what a Python user gets.
+
+Reference analog: ``src/c_api/c_api*.cc`` (2452 LoC of C++ glue over the
+C++ runtime); here the runtime is the Python package itself, so the glue
+is Python (SURVEY §2.7 row: C ABI is "the real public surface").
+"""
+
+from __future__ import annotations
+
+import ctypes
+
+import numpy as np
+
+from . import io as mxio
+from . import ndarray as nd
+from . import optimizer as opt
+from . import symbol as sym
+from .context import Context
+from .kvstore import create as kv_create
+from .ndarray import NDArray
+
+_DTYPES = {0: np.float32, 1: np.float64, 2: np.float16, 3: np.uint8,
+           4: np.int32, 6: "bfloat16"}
+_DTYPE_CODES = {"float32": 0, "float64": 1, "float16": 2, "uint8": 3,
+                "int32": 4, "bfloat16": 6}
+
+
+def _ctx(dev_type, dev_id):
+    # 1/3 = cpu (pinned alias), 2 = accelerator alias, 4 = tpu
+    return Context("cpu" if dev_type in (1, 3) else "tpu", dev_id)
+
+
+def _np_dtype(code):
+    if code not in _DTYPES:
+        raise ValueError("unknown dtype code %d" % code)
+    d = _DTYPES[code]
+    if d == "bfloat16":
+        import jax.numpy as jnp
+
+        return jnp.bfloat16
+    return d
+
+
+def _host_view(addr, size, np_dtype):
+    buf = (ctypes.c_char * (size * np.dtype(np_dtype).itemsize)) \
+        .from_address(addr)
+    return np.frombuffer(buf, dtype=np_dtype, count=size)
+
+
+# ---- NDArray --------------------------------------------------------------
+
+def nd_create(shape, dev_type, dev_id, dtype):
+    return nd.zeros(tuple(shape), ctx=_ctx(dev_type, dev_id),
+                    dtype=_np_dtype(dtype))
+
+
+def nd_copy_from(a, addr, size):
+    # host buffer is in the array's dtype unless bf16 (no numpy dtype on
+    # the C side): bf16 arrays take f32 host data
+    host_dt = np.float32 if str(a.dtype) == "bfloat16" else a.dtype
+    v = _host_view(addr, size, host_dt).reshape(a.shape)
+    a[:] = v
+
+
+def nd_copy_to(a, addr, size):
+    host_dt = np.float32 if str(a.dtype) == "bfloat16" else a.dtype
+    out = _host_view(addr, size, host_dt)
+    out[:] = np.asarray(a.asnumpy(), dtype=host_dt).reshape(-1)
+
+
+def nd_shape(a):
+    return tuple(int(d) for d in a.shape)
+
+
+def nd_dtype(a):
+    return _DTYPE_CODES.get(str(np.dtype(a.dtype).name)
+                            if str(a.dtype) != "bfloat16" else "bfloat16",
+                            0)
+
+
+def nd_save(fname, arrays, keys):
+    if keys is None:
+        nd.save(fname, list(arrays))
+    else:
+        nd.save(fname, dict(zip(keys, arrays)))
+
+
+def nd_load(fname):
+    data = nd.load(fname)
+    if isinstance(data, dict):
+        keys = list(data.keys())
+        return keys, [data[k] for k in keys]
+    return None, list(data)
+
+
+def invoke(op_name, inputs, keys, vals):
+    fn = getattr(nd, op_name)
+    out = fn(*inputs, **dict(zip(keys, vals)))
+    if isinstance(out, (list, tuple)):
+        return list(out)
+    return [out]
+
+
+def wait_all():
+    nd.waitall()
+
+
+def list_ops():
+    from .ops.registry import list_ops as _lo
+
+    return list(_lo())
+
+
+def random_seed(seed):
+    from . import random as _random
+
+    _random.seed(seed)
+
+
+# ---- Symbol ---------------------------------------------------------------
+
+def sym_var(name):
+    return sym.Variable(name)
+
+
+def sym_op(op_name, name, pkeys, pvals, ikeys, inputs):
+    kwargs = dict(zip(pkeys, pvals))
+    if name:
+        kwargs["name"] = name
+    fn = getattr(sym, op_name)
+    if ikeys is None:
+        return fn(*inputs, **kwargs)
+    kwargs.update(dict(zip(ikeys, inputs)))
+    return fn(**kwargs)
+
+
+def sym_group(syms):
+    return sym.Group(list(syms))
+
+
+def sym_list(s, which):
+    if which == 0:
+        return s.list_arguments()
+    if which == 1:
+        return s.list_auxiliary_states()
+    return s.list_outputs()
+
+
+def sym_json(s):
+    return s.tojson()
+
+
+def sym_from_json(js):
+    return sym.load_json(js)
+
+
+def sym_infer_shape(s, names, shapes):
+    args, outs, auxs = s.infer_shape(**dict(zip(names, shapes)))
+    fix = lambda ls: [tuple(int(d) for d in t) for t in (ls or [])]
+    return fix(args), fix(outs), fix(auxs)
+
+
+# ---- Executor -------------------------------------------------------------
+
+def exec_simple_bind(s, dev_type, dev_id, names, shapes, grad_req):
+    return s.simple_bind(_ctx(dev_type, dev_id), grad_req=grad_req,
+                         **dict(zip(names, shapes)))
+
+
+def exec_forward(ex, is_train):
+    ex.forward(is_train=bool(is_train))
+
+
+def exec_backward(ex, head_grads):
+    ex.backward(head_grads if head_grads else None)
+
+
+def exec_outputs(ex):
+    return list(ex.outputs)
+
+
+def exec_get(ex, which, name):
+    d = (ex.arg_dict, ex.grad_dict, ex.aux_dict)[which]
+    return d.get(name)
+
+
+# ---- Optimizer ------------------------------------------------------------
+
+def opt_create(name, keys, vals):
+    optimizer = opt.create(name, **dict(zip(keys, vals)))
+    return opt.get_updater(optimizer)
+
+
+def opt_update(updater, index, weight, grad):
+    updater(index, grad, weight)
+
+
+# ---- KVStore --------------------------------------------------------------
+
+def kvstore_create(type_):
+    return kv_create(type_)
+
+
+def kv_init(kv, key, value):
+    kv.init(key, value)
+
+
+def kv_push(kv, key, value, priority):
+    kv.push(key, value, priority=priority)
+
+
+def kv_pull(kv, key, out, priority):
+    kv.pull(key, out=out, priority=priority)
+
+
+def kv_set_optimizer(kv, name, keys, vals):
+    kv.set_optimizer(opt.create(name, **dict(zip(keys, vals))))
+
+
+def kv_rank(kv):
+    return int(kv.rank)
+
+
+def kv_size(kv):
+    return int(kv.num_workers)
+
+
+def kv_barrier(kv):
+    kv._barrier() if hasattr(kv, "_barrier") else None
+
+
+def kv_close(kv):
+    close = getattr(kv, "close", None)
+    if close is not None:
+        close()
+
+
+# ---- DataIter -------------------------------------------------------------
+
+class _IterState:
+    """Iterator + its current batch (MXDataIterNext/GetData contract)."""
+
+    def __init__(self, it):
+        self.it = it
+        self.batch = None
+
+    def next(self):
+        try:
+            self.batch = next(self.it)
+            return True
+        except StopIteration:
+            self.batch = None
+            return False
+
+    def before_first(self):
+        self.it.reset()
+        self.batch = None
+
+
+def iter_create(name, keys, vals):
+    fn = getattr(mxio, name)
+    return _IterState(fn(**dict(zip(keys, vals))))
+
+
+def iter_create_nd(data, label, batch_size, shuffle, last_batch_handle):
+    return _IterState(mxio.NDArrayIter(
+        data, label, batch_size=batch_size, shuffle=bool(shuffle),
+        last_batch_handle=last_batch_handle))
+
+
+def iter_next(st):
+    return st.next()
+
+
+def iter_before_first(st):
+    st.before_first()
+
+
+def iter_data(st):
+    return st.batch.data[0]
+
+
+def iter_label(st):
+    return st.batch.label[0]
+
+
+def iter_pad(st):
+    return int(st.batch.pad or 0)

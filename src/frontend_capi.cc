@@ -1,0 +1,804 @@
+// Frontend C ABI implementation (include/mxnet_tpu/c_frontend_api.h).
+//
+// Embeds CPython and drives mxnet_tpu through the thin marshalling layer
+// mxnet_tpu/_cfrontend.py — every handle crossing the ABI is a PyObject*
+// reference owned by the caller until the matching *Free.  The reference
+// analog is src/c_api/c_api*.cc gluing the C surface to the C++ runtime
+// (SURVEY §2.7); here the runtime is the Python package, and this file is
+// the supported path for every non-Python language frontend (the
+// cpp_package C++ API compiles against this ABI alone).
+//
+// Build: g++ -O2 -shared -fPIC -std=c++17 src/frontend_capi.cc \
+//   $(python3-config --includes) -o libmxnet_tpu_frontend.so
+// Consumers need only -lmxnet_tpu_frontend (plus libpythonX.Y at link of
+// the shared lib itself) and MXNET_TPU_HOME pointing at the package.
+
+#include <Python.h>
+
+#include <cstdint>
+#include <cstdlib>
+#include <mutex>
+#include <string>
+#include <vector>
+
+#include "../include/mxnet_tpu/c_frontend_api.h"
+
+namespace {
+
+thread_local std::string g_last_error;
+
+void set_error(const std::string& msg) { g_last_error = msg; }
+
+std::string py_error() {
+  PyObject *type, *value, *tb;
+  PyErr_Fetch(&type, &value, &tb);
+  std::string msg = "python error";
+  if (value != nullptr) {
+    PyObject* s = PyObject_Str(value);
+    if (s != nullptr) {
+      const char* utf8 = PyUnicode_AsUTF8(s);
+      if (utf8 != nullptr) {
+        msg = utf8;
+      } else {
+        PyErr_Clear();
+      }
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+  return msg;
+}
+
+std::once_flag g_init_flag;
+bool g_init_ok = false;
+PyObject* g_mod = nullptr;  // mxnet_tpu._cfrontend (immortal)
+
+void init_python() {
+  bool we_initialized = false;
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);
+    we_initialized = true;
+  }
+  PyGILState_STATE st = PyGILState_Ensure();
+  PyObject* sys_path = PySys_GetObject("path");  // borrowed
+  // MXNET_TPU_HOME: dir containing the mxnet_tpu package.
+  // MXNET_TPU_EXTRA_PATH: one more entry (e.g. a venv's site-packages
+  // when the linked libpython's default path lacks numpy/jax).
+  for (const char* var : {"MXNET_TPU_EXTRA_PATH", "MXNET_TPU_HOME"}) {
+    const char* dir = std::getenv(var);
+    if (dir != nullptr && sys_path != nullptr) {
+      PyObject* p = PyUnicode_FromString(dir);
+      PyList_Insert(sys_path, 0, p);
+      Py_DECREF(p);
+    }
+  }
+  g_mod = PyImport_ImportModule("mxnet_tpu._cfrontend");
+  if (g_mod == nullptr) {
+    set_error("import mxnet_tpu._cfrontend: " + py_error());
+  } else {
+    g_init_ok = true;
+  }
+  PyGILState_Release(st);
+  if (we_initialized) {
+    // drop the GIL this thread holds after Py_InitializeEx, or every
+    // other thread's PyGILState_Ensure deadlocks
+    PyEval_SaveThread();
+  }
+}
+
+struct Gil {
+  PyGILState_STATE st;
+  Gil() : st(PyGILState_Ensure()) {}
+  ~Gil() { PyGILState_Release(st); }
+};
+
+bool ensure_init() {
+  std::call_once(g_init_flag, init_python);
+  if (!g_init_ok) {
+    if (g_last_error.empty()) set_error("embedded python failed to init");
+    return false;
+  }
+  return true;
+}
+
+// Py helpers (all require the GIL) ------------------------------------------
+
+PyObject* str_list(int n, const char** v) {
+  PyObject* l = PyList_New(n);
+  for (int i = 0; i < n; ++i) {
+    PyList_SET_ITEM(l, i, PyUnicode_FromString(v[i]));
+  }
+  return l;
+}
+
+PyObject* handle_list(int n, void** v) {
+  PyObject* l = PyList_New(n);
+  for (int i = 0; i < n; ++i) {
+    PyObject* o = static_cast<PyObject*>(v[i]);
+    Py_INCREF(o);
+    PyList_SET_ITEM(l, i, o);
+  }
+  return l;
+}
+
+PyObject* shape_tuple(const uint32_t* data, uint32_t lo, uint32_t hi) {
+  PyObject* t = PyTuple_New(hi - lo);
+  for (uint32_t d = lo; d < hi; ++d) {
+    PyTuple_SET_ITEM(t, d - lo, PyLong_FromUnsignedLong(data[d]));
+  }
+  return t;
+}
+
+// variadic call into g_mod; returns a NEW reference or nullptr (error set)
+PyObject* callf(const char* fn, const char* fmt, ...) {
+  PyObject* f = PyObject_GetAttrString(g_mod, fn);
+  if (f == nullptr) {
+    set_error(std::string(fn) + ": " + py_error());
+    return nullptr;
+  }
+  va_list va;
+  va_start(va, fmt);
+  PyObject* args = Py_VaBuildValue(fmt, va);
+  va_end(va);
+  if (args == nullptr) {
+    Py_DECREF(f);
+    set_error(std::string(fn) + " args: " + py_error());
+    return nullptr;
+  }
+  if (!PyTuple_Check(args)) {  // single-arg format -> wrap
+    PyObject* t = PyTuple_Pack(1, args);
+    Py_DECREF(args);
+    args = t;
+  }
+  PyObject* r = PyObject_CallObject(f, args);
+  Py_DECREF(args);
+  Py_DECREF(f);
+  if (r == nullptr) {
+    set_error(std::string(fn) + ": " + py_error());
+  }
+  return r;
+}
+
+// thread-local scratch: string lists + shape buffers handed out via
+// out-pointers stay valid until the next ABI call on the same thread
+// (reference c_api_common.h thread-local return buffers)
+struct Scratch {
+  std::vector<std::string> strings;
+  std::vector<const char*> cstrs;
+  std::vector<uint32_t> dims;                 // flattened shape dims
+  std::vector<uint32_t> ndims;                // per-shape rank
+  std::vector<const uint32_t*> shape_ptrs;    // per-shape data pointer
+  std::vector<void*> handles;
+};
+thread_local Scratch g_scratch[3];  // up to 3 shape lists per call
+
+int fill_string_list(PyObject* list, int* out_size,
+                     const char*** out_names, Scratch* s) {
+  Py_ssize_t n = PySequence_Size(list);
+  s->strings.clear();
+  s->cstrs.clear();
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject* it = PySequence_GetItem(list, i);
+    const char* c = PyUnicode_AsUTF8(it);
+    s->strings.emplace_back(c ? c : "");
+    Py_XDECREF(it);
+  }
+  for (auto& str : s->strings) s->cstrs.push_back(str.c_str());
+  *out_size = static_cast<int>(n);
+  *out_names = s->cstrs.data();
+  return 0;
+}
+
+// shapes: list of tuples -> scratch (count, ndims[], ptrs[])
+void fill_shape_list(PyObject* shapes, uint32_t* count,
+                     const uint32_t** out_ndim,
+                     const uint32_t*** out_shapes, Scratch* s) {
+  Py_ssize_t n = PySequence_Size(shapes);
+  s->dims.clear();
+  s->ndims.clear();
+  std::vector<size_t> offsets;
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject* t = PySequence_GetItem(shapes, i);
+    Py_ssize_t nd = PySequence_Size(t);
+    s->ndims.push_back(static_cast<uint32_t>(nd));
+    offsets.push_back(s->dims.size());
+    for (Py_ssize_t d = 0; d < nd; ++d) {
+      PyObject* v = PySequence_GetItem(t, d);
+      s->dims.push_back(static_cast<uint32_t>(PyLong_AsUnsignedLong(v)));
+      Py_XDECREF(v);
+    }
+    Py_XDECREF(t);
+  }
+  s->shape_ptrs.clear();
+  for (size_t i = 0; i < offsets.size(); ++i) {
+    s->shape_ptrs.push_back(s->dims.data() + offsets[i]);
+  }
+  *count = static_cast<uint32_t>(n);
+  *out_ndim = s->ndims.data();
+  *out_shapes = s->shape_ptrs.data();
+}
+
+#define API_BEGIN()                         \
+  if (!ensure_init()) return -1;            \
+  Gil gil_;                                 \
+  try {
+#define API_END()                           \
+  } catch (const std::exception& e) {       \
+    set_error(e.what());                    \
+    return -1;                              \
+  }                                         \
+  return 0;
+
+}  // namespace
+
+extern "C" {
+
+const char* MXFrontGetLastError(void) { return g_last_error.c_str(); }
+
+int MXFrontRandomSeed(int seed) {
+  API_BEGIN();
+  PyObject* r = callf("random_seed", "(i)", seed);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXFrontNotifyShutdown(void) {
+  // the embedded interpreter stays up for the process lifetime (multiple
+  // frontends may share it); provided for ABI parity
+  return 0;
+}
+
+int MXFrontListOps(int* out_size, const char*** out_names) {
+  API_BEGIN();
+  PyObject* r = callf("list_ops", "()");
+  if (r == nullptr) return -1;
+  fill_string_list(r, out_size, out_names, &g_scratch[0]);
+  Py_DECREF(r);
+  API_END();
+}
+
+/* ---- NDArray ---------------------------------------------------------- */
+
+int MXFrontNDArrayCreate(const uint32_t* shape, uint32_t ndim,
+                         int dev_type, int dev_id, int dtype,
+                         NDArrayHandle* out) {
+  API_BEGIN();
+  PyObject* shp = shape_tuple(shape, 0, ndim);
+  PyObject* r = callf("nd_create", "(Oiii)", shp, dev_type, dev_id, dtype);
+  Py_DECREF(shp);
+  if (r == nullptr) return -1;
+  *out = r;
+  API_END();
+}
+
+int MXFrontNDArrayFree(NDArrayHandle h) {
+  if (h == nullptr || !ensure_init()) return 0;
+  Gil gil;
+  Py_DECREF(static_cast<PyObject*>(h));
+  return 0;
+}
+
+int MXFrontNDArraySyncCopyFromCPU(NDArrayHandle h, const void* data,
+                                  uint64_t size) {
+  API_BEGIN();
+  PyObject* r = callf("nd_copy_from", "(OKK)", h,
+                      (unsigned long long)(uintptr_t)data,
+                      (unsigned long long)size);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXFrontNDArraySyncCopyToCPU(NDArrayHandle h, void* data,
+                                uint64_t size) {
+  API_BEGIN();
+  PyObject* r = callf("nd_copy_to", "(OKK)", h,
+                      (unsigned long long)(uintptr_t)data,
+                      (unsigned long long)size);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXFrontNDArrayGetShape(NDArrayHandle h, uint32_t* out_ndim,
+                           const uint32_t** out_shape) {
+  API_BEGIN();
+  PyObject* r = callf("nd_shape", "(O)", h);
+  if (r == nullptr) return -1;
+  Scratch* s = &g_scratch[0];
+  s->dims.clear();
+  Py_ssize_t n = PySequence_Size(r);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject* v = PySequence_GetItem(r, i);
+    s->dims.push_back(static_cast<uint32_t>(PyLong_AsUnsignedLong(v)));
+    Py_XDECREF(v);
+  }
+  Py_DECREF(r);
+  *out_ndim = static_cast<uint32_t>(n);
+  *out_shape = s->dims.data();
+  API_END();
+}
+
+int MXFrontNDArrayGetDType(NDArrayHandle h, int* out_dtype) {
+  API_BEGIN();
+  PyObject* r = callf("nd_dtype", "(O)", h);
+  if (r == nullptr) return -1;
+  *out_dtype = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXFrontNDArraySave(const char* fname, uint32_t num,
+                       NDArrayHandle* handles, const char** keys) {
+  API_BEGIN();
+  PyObject* arrs = handle_list(num, handles);
+  PyObject* k = keys ? str_list(num, keys) : (Py_INCREF(Py_None), Py_None);
+  PyObject* r = callf("nd_save", "(sOO)", fname, arrs, k);
+  Py_DECREF(arrs);
+  Py_DECREF(k);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXFrontNDArrayLoad(const char* fname, uint32_t* out_num,
+                       NDArrayHandle** out_handles,
+                       const char*** out_keys) {
+  API_BEGIN();
+  PyObject* r = callf("nd_load", "(s)", fname);
+  if (r == nullptr) return -1;
+  PyObject* keys = PyTuple_GetItem(r, 0);     // borrowed
+  PyObject* arrays = PyTuple_GetItem(r, 1);   // borrowed
+  Scratch* s = &g_scratch[0];
+  s->handles.clear();
+  Py_ssize_t n = PySequence_Size(arrays);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    s->handles.push_back(PySequence_GetItem(arrays, i));  // new refs
+  }
+  *out_num = static_cast<uint32_t>(n);
+  *out_handles = s->handles.data();
+  if (keys == Py_None) {
+    *out_keys = nullptr;
+  } else {
+    int sz;
+    fill_string_list(keys, &sz, out_keys, &g_scratch[1]);
+  }
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXFrontImperativeInvoke(const char* op_name, int num_inputs,
+                            NDArrayHandle* inputs, int num_params,
+                            const char** param_keys,
+                            const char** param_vals,
+                            int* num_outputs, NDArrayHandle* outputs) {
+  API_BEGIN();
+  PyObject* ins = handle_list(num_inputs, inputs);
+  PyObject* pk = str_list(num_params, param_keys);
+  PyObject* pv = str_list(num_params, param_vals);
+  PyObject* r = callf("invoke", "(sOOO)", op_name, ins, pk, pv);
+  Py_DECREF(ins);
+  Py_DECREF(pk);
+  Py_DECREF(pv);
+  if (r == nullptr) return -1;
+  Py_ssize_t n = PySequence_Size(r);
+  if (n > *num_outputs) {
+    Py_DECREF(r);
+    set_error("output buffer too small");
+    return -1;
+  }
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    outputs[i] = PySequence_GetItem(r, i);  // new ref -> caller owns
+  }
+  *num_outputs = static_cast<int>(n);
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXFrontNDArrayWaitAll(void) {
+  API_BEGIN();
+  PyObject* r = callf("wait_all", "()");
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  API_END();
+}
+
+/* ---- Symbol ----------------------------------------------------------- */
+
+int MXFrontSymbolCreateVariable(const char* name, SymbolHandle* out) {
+  API_BEGIN();
+  PyObject* r = callf("sym_var", "(s)", name);
+  if (r == nullptr) return -1;
+  *out = r;
+  API_END();
+}
+
+int MXFrontSymbolCreateOp(const char* op_name, const char* name,
+                          int num_params, const char** param_keys,
+                          const char** param_vals,
+                          int num_inputs, const char** input_keys,
+                          SymbolHandle* inputs, SymbolHandle* out) {
+  API_BEGIN();
+  PyObject* pk = str_list(num_params, param_keys);
+  PyObject* pv = str_list(num_params, param_vals);
+  PyObject* ik = input_keys
+      ? str_list(num_inputs, input_keys) : (Py_INCREF(Py_None), Py_None);
+  PyObject* ins = handle_list(num_inputs, inputs);
+  PyObject* r = callf("sym_op", "(ssOOOO)", op_name, name ? name : "",
+                      pk, pv, ik, ins);
+  Py_DECREF(pk);
+  Py_DECREF(pv);
+  Py_DECREF(ik);
+  Py_DECREF(ins);
+  if (r == nullptr) return -1;
+  *out = r;
+  API_END();
+}
+
+int MXFrontSymbolGroup(int num, SymbolHandle* syms, SymbolHandle* out) {
+  API_BEGIN();
+  PyObject* l = handle_list(num, syms);
+  PyObject* r = callf("sym_group", "(O)", l);
+  Py_DECREF(l);
+  if (r == nullptr) return -1;
+  *out = r;
+  API_END();
+}
+
+int MXFrontSymbolFree(SymbolHandle h) { return MXFrontNDArrayFree(h); }
+
+static int sym_list_impl(SymbolHandle h, int which, int* out_size,
+                         const char*** out_names) {
+  API_BEGIN();
+  PyObject* r = callf("sym_list", "(Oi)", h, which);
+  if (r == nullptr) return -1;
+  fill_string_list(r, out_size, out_names, &g_scratch[0]);
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXFrontSymbolListArguments(SymbolHandle h, int* out_size,
+                               const char*** out_names) {
+  return sym_list_impl(h, 0, out_size, out_names);
+}
+
+int MXFrontSymbolListAuxiliaryStates(SymbolHandle h, int* out_size,
+                                     const char*** out_names) {
+  return sym_list_impl(h, 1, out_size, out_names);
+}
+
+int MXFrontSymbolListOutputs(SymbolHandle h, int* out_size,
+                             const char*** out_names) {
+  return sym_list_impl(h, 2, out_size, out_names);
+}
+
+int MXFrontSymbolSaveToJSON(SymbolHandle h, const char** out_json) {
+  API_BEGIN();
+  PyObject* r = callf("sym_json", "(O)", h);
+  if (r == nullptr) return -1;
+  const char* c = PyUnicode_AsUTF8(r);
+  Scratch* s = &g_scratch[0];
+  s->strings.clear();
+  s->strings.emplace_back(c ? c : "");
+  *out_json = s->strings[0].c_str();
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXFrontSymbolCreateFromJSON(const char* json, SymbolHandle* out) {
+  API_BEGIN();
+  PyObject* r = callf("sym_from_json", "(s)", json);
+  if (r == nullptr) return -1;
+  *out = r;
+  API_END();
+}
+
+int MXFrontSymbolInferShape(SymbolHandle h, uint32_t num_args,
+                            const char** keys, const uint32_t* indptr,
+                            const uint32_t* shape_data,
+                            uint32_t* arg_count, const uint32_t** arg_ndim,
+                            const uint32_t*** arg_shapes,
+                            uint32_t* out_count, const uint32_t** out_ndim,
+                            const uint32_t*** out_shapes,
+                            uint32_t* aux_count, const uint32_t** aux_ndim,
+                            const uint32_t*** aux_shapes) {
+  API_BEGIN();
+  PyObject* names = str_list(num_args, keys);
+  PyObject* shapes = PyList_New(num_args);
+  for (uint32_t i = 0; i < num_args; ++i) {
+    PyList_SET_ITEM(shapes, i,
+                    shape_tuple(shape_data, indptr[i], indptr[i + 1]));
+  }
+  PyObject* r = callf("sym_infer_shape", "(OOO)", h, names, shapes);
+  Py_DECREF(names);
+  Py_DECREF(shapes);
+  if (r == nullptr) return -1;
+  fill_shape_list(PyTuple_GetItem(r, 0), arg_count, arg_ndim, arg_shapes,
+                  &g_scratch[0]);
+  fill_shape_list(PyTuple_GetItem(r, 1), out_count, out_ndim, out_shapes,
+                  &g_scratch[1]);
+  fill_shape_list(PyTuple_GetItem(r, 2), aux_count, aux_ndim, aux_shapes,
+                  &g_scratch[2]);
+  Py_DECREF(r);
+  API_END();
+}
+
+/* ---- Executor --------------------------------------------------------- */
+
+int MXFrontExecutorSimpleBind(SymbolHandle sym, int dev_type, int dev_id,
+                              uint32_t num_provided, const char** keys,
+                              const uint32_t* indptr,
+                              const uint32_t* shape_data,
+                              const char* grad_req, ExecutorHandle* out) {
+  API_BEGIN();
+  PyObject* names = str_list(num_provided, keys);
+  PyObject* shapes = PyList_New(num_provided);
+  for (uint32_t i = 0; i < num_provided; ++i) {
+    PyList_SET_ITEM(shapes, i,
+                    shape_tuple(shape_data, indptr[i], indptr[i + 1]));
+  }
+  PyObject* r = callf("exec_simple_bind", "(OiiOOs)", sym, dev_type,
+                      dev_id, names, shapes, grad_req);
+  Py_DECREF(names);
+  Py_DECREF(shapes);
+  if (r == nullptr) return -1;
+  *out = r;
+  API_END();
+}
+
+int MXFrontExecutorFree(ExecutorHandle h) { return MXFrontNDArrayFree(h); }
+
+int MXFrontExecutorForward(ExecutorHandle h, int is_train) {
+  API_BEGIN();
+  PyObject* r = callf("exec_forward", "(Oi)", h, is_train);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXFrontExecutorBackward(ExecutorHandle h, int num_head_grads,
+                            NDArrayHandle* head_grads) {
+  API_BEGIN();
+  PyObject* hg = handle_list(num_head_grads, head_grads);
+  PyObject* r = callf("exec_backward", "(OO)", h, hg);
+  Py_DECREF(hg);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXFrontExecutorOutputs(ExecutorHandle h, int* out_size,
+                           NDArrayHandle** out_handles) {
+  API_BEGIN();
+  PyObject* r = callf("exec_outputs", "(O)", h);
+  if (r == nullptr) return -1;
+  Scratch* s = &g_scratch[0];
+  s->handles.clear();
+  Py_ssize_t n = PySequence_Size(r);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    s->handles.push_back(PySequence_GetItem(r, i));  // new refs
+  }
+  Py_DECREF(r);
+  *out_size = static_cast<int>(n);
+  *out_handles = s->handles.data();
+  API_END();
+}
+
+static int exec_get_impl(ExecutorHandle h, int which, const char* name,
+                         NDArrayHandle* out) {
+  API_BEGIN();
+  PyObject* r = callf("exec_get", "(Ois)", h, which, name);
+  if (r == nullptr) return -1;
+  if (r == Py_None) {
+    Py_DECREF(r);
+    *out = nullptr;
+  } else {
+    *out = r;
+  }
+  API_END();
+}
+
+int MXFrontExecutorGetArg(ExecutorHandle h, const char* name,
+                          NDArrayHandle* out) {
+  return exec_get_impl(h, 0, name, out);
+}
+
+int MXFrontExecutorGetGrad(ExecutorHandle h, const char* name,
+                           NDArrayHandle* out) {
+  return exec_get_impl(h, 1, name, out);
+}
+
+int MXFrontExecutorGetAux(ExecutorHandle h, const char* name,
+                          NDArrayHandle* out) {
+  return exec_get_impl(h, 2, name, out);
+}
+
+/* ---- Optimizer -------------------------------------------------------- */
+
+int MXFrontOptimizerCreate(const char* name, int num_params,
+                           const char** keys, const char** vals,
+                           OptimizerHandle* out) {
+  API_BEGIN();
+  PyObject* k = str_list(num_params, keys);
+  PyObject* v = str_list(num_params, vals);
+  PyObject* r = callf("opt_create", "(sOO)", name, k, v);
+  Py_DECREF(k);
+  Py_DECREF(v);
+  if (r == nullptr) return -1;
+  *out = r;
+  API_END();
+}
+
+int MXFrontOptimizerFree(OptimizerHandle h) { return MXFrontNDArrayFree(h); }
+
+int MXFrontOptimizerUpdate(OptimizerHandle h, int index,
+                           NDArrayHandle weight, NDArrayHandle grad) {
+  API_BEGIN();
+  PyObject* r = callf("opt_update", "(OiOO)", h, index, weight, grad);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  API_END();
+}
+
+/* ---- KVStore ---------------------------------------------------------- */
+
+int MXFrontKVStoreCreate(const char* type, KVStoreHandle* out) {
+  API_BEGIN();
+  PyObject* r = callf("kvstore_create", "(s)", type);
+  if (r == nullptr) return -1;
+  *out = r;
+  API_END();
+}
+
+int MXFrontKVStoreFree(KVStoreHandle h) {
+  if (h == nullptr || !ensure_init()) return 0;
+  Gil gil;
+  PyObject* r = callf("kv_close", "(O)", h);
+  Py_XDECREF(r);
+  Py_DECREF(static_cast<PyObject*>(h));
+  return 0;
+}
+
+int MXFrontKVStoreInit(KVStoreHandle h, int key, NDArrayHandle v) {
+  API_BEGIN();
+  PyObject* r = callf("kv_init", "(OiO)", h, key, v);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXFrontKVStorePush(KVStoreHandle h, int key, NDArrayHandle v,
+                       int priority) {
+  API_BEGIN();
+  PyObject* r = callf("kv_push", "(OiOi)", h, key, v, priority);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXFrontKVStorePull(KVStoreHandle h, int key, NDArrayHandle out,
+                       int priority) {
+  API_BEGIN();
+  PyObject* r = callf("kv_pull", "(OiOi)", h, key, out, priority);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXFrontKVStoreSetOptimizer(KVStoreHandle h, const char* opt_name,
+                               int num_params, const char** keys,
+                               const char** vals) {
+  API_BEGIN();
+  PyObject* k = str_list(num_params, keys);
+  PyObject* v = str_list(num_params, vals);
+  PyObject* r = callf("kv_set_optimizer", "(OsOO)", h, opt_name, k, v);
+  Py_DECREF(k);
+  Py_DECREF(v);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXFrontKVStoreGetRank(KVStoreHandle h, int* out) {
+  API_BEGIN();
+  PyObject* r = callf("kv_rank", "(O)", h);
+  if (r == nullptr) return -1;
+  *out = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXFrontKVStoreGetGroupSize(KVStoreHandle h, int* out) {
+  API_BEGIN();
+  PyObject* r = callf("kv_size", "(O)", h);
+  if (r == nullptr) return -1;
+  *out = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXFrontKVStoreBarrier(KVStoreHandle h) {
+  API_BEGIN();
+  PyObject* r = callf("kv_barrier", "(O)", h);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  API_END();
+}
+
+/* ---- DataIter --------------------------------------------------------- */
+
+int MXFrontDataIterCreate(const char* name, int num_params,
+                          const char** keys, const char** vals,
+                          DataIterHandle* out) {
+  API_BEGIN();
+  PyObject* k = str_list(num_params, keys);
+  PyObject* v = str_list(num_params, vals);
+  PyObject* r = callf("iter_create", "(sOO)", name, k, v);
+  Py_DECREF(k);
+  Py_DECREF(v);
+  if (r == nullptr) return -1;
+  *out = r;
+  API_END();
+}
+
+int MXFrontDataIterCreateNDArray(NDArrayHandle data, NDArrayHandle label,
+                                 int batch_size, int shuffle,
+                                 const char* last_batch_handle,
+                                 DataIterHandle* out) {
+  API_BEGIN();
+  PyObject* r = callf("iter_create_nd", "(OOiis)", data, label,
+                      batch_size, shuffle, last_batch_handle);
+  if (r == nullptr) return -1;
+  *out = r;
+  API_END();
+}
+
+int MXFrontDataIterFree(DataIterHandle h) { return MXFrontNDArrayFree(h); }
+
+int MXFrontDataIterNext(DataIterHandle h, int* out_more) {
+  API_BEGIN();
+  PyObject* r = callf("iter_next", "(O)", h);
+  if (r == nullptr) return -1;
+  *out_more = PyObject_IsTrue(r) ? 1 : 0;
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXFrontDataIterBeforeFirst(DataIterHandle h) {
+  API_BEGIN();
+  PyObject* r = callf("iter_before_first", "(O)", h);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  API_END();
+}
+
+static int iter_get_impl(DataIterHandle h, const char* fn,
+                         NDArrayHandle* out) {
+  API_BEGIN();
+  PyObject* r = callf(fn, "(O)", h);
+  if (r == nullptr) return -1;
+  *out = r;
+  API_END();
+}
+
+int MXFrontDataIterGetData(DataIterHandle h, NDArrayHandle* out) {
+  return iter_get_impl(h, "iter_data", out);
+}
+
+int MXFrontDataIterGetLabel(DataIterHandle h, NDArrayHandle* out) {
+  return iter_get_impl(h, "iter_label", out);
+}
+
+int MXFrontDataIterGetPad(DataIterHandle h, int* out_pad) {
+  API_BEGIN();
+  PyObject* r = callf("iter_pad", "(O)", h);
+  if (r == nullptr) return -1;
+  *out_pad = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  API_END();
+}
+
+}  // extern "C"

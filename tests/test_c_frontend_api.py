@@ -1,0 +1,221 @@
+"""Frontend C ABI (include/mxnet_tpu/c_frontend_api.h) end-to-end.
+
+Builds libmxnet_tpu_frontend.so from src/frontend_capi.cc and drives it
+through ctypes IN A SUBPROCESS exactly like a foreign-language binding
+would: NDArray copies, imperative invoke, symbol building + JSON
+round-trip, simple_bind forward/backward, optimizer update, kvstore
+push/pull, NDArrayIter batches — the reference's
+``tests/python/unittest`` coverage of the c_api surface, collapsed to
+the handle lifecycle essentials.
+"""
+
+import os
+import shutil
+import subprocess
+import sys
+import sysconfig
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_DRIVER = r"""
+import ctypes, os, sys
+import numpy as np
+
+lib = ctypes.CDLL(sys.argv[1])
+lib.MXFrontGetLastError.restype = ctypes.c_char_p
+P = ctypes.c_void_p
+
+
+def ck(rc):
+    if rc != 0:
+        raise RuntimeError(lib.MXFrontGetLastError().decode())
+
+
+# --- NDArray roundtrip + imperative invoke -------------------------------
+h = P()
+ck(lib.MXFrontNDArrayCreate((ctypes.c_uint32 * 2)(2, 3), 2, 1, 0, 0,
+                            ctypes.byref(h)))
+data = np.arange(6, dtype=np.float32)
+ck(lib.MXFrontNDArraySyncCopyFromCPU(h, data.ctypes.data_as(P),
+                                     ctypes.c_uint64(6)))
+nd = ctypes.c_uint32()
+dims = ctypes.POINTER(ctypes.c_uint32)()
+ck(lib.MXFrontNDArrayGetShape(h, ctypes.byref(nd), ctypes.byref(dims)))
+assert nd.value == 2 and dims[0] == 2 and dims[1] == 3
+outs = (P * 4)()
+nout = ctypes.c_int(4)
+ck(lib.MXFrontImperativeInvoke(b"elemwise_add", 2, (P * 2)(h, h), 0,
+                               None, None, ctypes.byref(nout), outs))
+r = np.zeros(6, np.float32)
+ck(lib.MXFrontNDArraySyncCopyToCPU(P(outs[0]), r.ctypes.data_as(P),
+                                   ctypes.c_uint64(6)))
+assert (r == data * 2).all(), r
+ck(lib.MXFrontNDArrayFree(P(outs[0])))
+print("invoke OK")
+
+# --- ops census ----------------------------------------------------------
+n = ctypes.c_int()
+names = ctypes.POINTER(ctypes.c_char_p)()
+ck(lib.MXFrontListOps(ctypes.byref(n), ctypes.byref(names)))
+assert n.value > 200, n.value
+print("ops:", n.value)
+
+# --- symbol + json + infer_shape ----------------------------------------
+v = P()
+ck(lib.MXFrontSymbolCreateVariable(b"data", ctypes.byref(v)))
+fc = P()
+ck(lib.MXFrontSymbolCreateOp(
+    b"FullyConnected", b"fc", 1, (ctypes.c_char_p * 1)(b"num_hidden"),
+    (ctypes.c_char_p * 1)(b"4"), 1, None, (P * 1)(v), ctypes.byref(fc)))
+sm = P()
+ck(lib.MXFrontSymbolCreateOp(b"SoftmaxOutput", b"softmax", 0, None, None,
+                             1, None, (P * 1)(fc), ctypes.byref(sm)))
+ck(lib.MXFrontSymbolListArguments(sm, ctypes.byref(n), ctypes.byref(names)))
+args = [names[i].decode() for i in range(n.value)]
+assert args == ["data", "fc_weight", "fc_bias", "softmax_label"], args
+js = ctypes.c_char_p()
+ck(lib.MXFrontSymbolSaveToJSON(sm, ctypes.byref(js)))
+sm2 = P()
+ck(lib.MXFrontSymbolCreateFromJSON(js.value, ctypes.byref(sm2)))
+
+ac = ctypes.c_uint32()
+andim = ctypes.POINTER(ctypes.c_uint32)()
+ashp = ctypes.POINTER(ctypes.POINTER(ctypes.c_uint32))()
+oc = ctypes.c_uint32()
+ondim = ctypes.POINTER(ctypes.c_uint32)()
+oshp = ctypes.POINTER(ctypes.POINTER(ctypes.c_uint32))()
+xc = ctypes.c_uint32()
+xndim = ctypes.POINTER(ctypes.c_uint32)()
+xshp = ctypes.POINTER(ctypes.POINTER(ctypes.c_uint32))()
+ck(lib.MXFrontSymbolInferShape(
+    sm, 1, (ctypes.c_char_p * 1)(b"data"), (ctypes.c_uint32 * 2)(0, 2),
+    (ctypes.c_uint32 * 2)(8, 6),
+    ctypes.byref(ac), ctypes.byref(andim), ctypes.byref(ashp),
+    ctypes.byref(oc), ctypes.byref(ondim), ctypes.byref(oshp),
+    ctypes.byref(xc), ctypes.byref(xndim), ctypes.byref(xshp)))
+assert ac.value == 4 and oc.value == 1
+assert [ashp[1][d] for d in range(andim[1])] == [4, 6]  # fc_weight
+assert [oshp[0][d] for d in range(ondim[0])] == [8, 4]
+print("symbol OK")
+
+# --- executor train step -------------------------------------------------
+ex = P()
+ck(lib.MXFrontExecutorSimpleBind(
+    sm, 1, 0, 2, (ctypes.c_char_p * 2)(b"data", b"softmax_label"),
+    (ctypes.c_uint32 * 3)(0, 2, 3), (ctypes.c_uint32 * 3)(8, 6, 8),
+    b"write", ctypes.byref(ex)))
+rs = np.random.RandomState(0)
+for name, shape in ((b"fc_weight", (4, 6)), (b"fc_bias", (4,)),
+                    (b"data", (8, 6))):
+    a = P()
+    ck(lib.MXFrontExecutorGetArg(ex, name, ctypes.byref(a)))
+    val = rs.normal(0, 0.3, shape).astype(np.float32)
+    ck(lib.MXFrontNDArraySyncCopyFromCPU(
+        a, val.ctypes.data_as(P), ctypes.c_uint64(val.size)))
+    ck(lib.MXFrontNDArrayFree(a))
+ck(lib.MXFrontExecutorForward(ex, 1))
+ck(lib.MXFrontExecutorBackward(ex, 0, None))
+g = P()
+ck(lib.MXFrontExecutorGetGrad(ex, b"fc_weight", ctypes.byref(g)))
+gd = np.zeros(24, np.float32)
+ck(lib.MXFrontNDArraySyncCopyToCPU(g, gd.ctypes.data_as(P),
+                                   ctypes.c_uint64(24)))
+assert np.abs(gd).sum() > 0
+no = ctypes.c_int()
+ohs = ctypes.POINTER(P)()
+ck(lib.MXFrontExecutorOutputs(ex, ctypes.byref(no), ctypes.byref(ohs)))
+assert no.value == 1
+print("executor OK")
+
+# --- optimizer update changes the weight --------------------------------
+w = P()
+ck(lib.MXFrontExecutorGetArg(ex, b"fc_weight", ctypes.byref(w)))
+before = np.zeros(24, np.float32)
+ck(lib.MXFrontNDArraySyncCopyToCPU(w, before.ctypes.data_as(P),
+                                   ctypes.c_uint64(24)))
+o = P()
+ck(lib.MXFrontOptimizerCreate(
+    b"sgd", 1, (ctypes.c_char_p * 1)(b"learning_rate"),
+    (ctypes.c_char_p * 1)(b"0.5"), ctypes.byref(o)))
+ck(lib.MXFrontOptimizerUpdate(o, 0, w, g))
+after = np.zeros(24, np.float32)
+ck(lib.MXFrontNDArraySyncCopyToCPU(w, after.ctypes.data_as(P),
+                                   ctypes.c_uint64(24)))
+assert np.abs(after - before).max() > 0
+print("optimizer OK")
+
+# --- kvstore -------------------------------------------------------------
+kv = P()
+ck(lib.MXFrontKVStoreCreate(b"local", ctypes.byref(kv)))
+ck(lib.MXFrontKVStoreInit(kv, 0, w))
+ck(lib.MXFrontKVStorePush(kv, 0, g, 0))
+ck(lib.MXFrontKVStorePull(kv, 0, w, 0))
+rank = ctypes.c_int()
+ck(lib.MXFrontKVStoreGetRank(kv, ctypes.byref(rank)))
+assert rank.value == 0
+print("kvstore OK")
+
+# --- save/load roundtrip -------------------------------------------------
+fn = os.path.join(sys.argv[2], "arrs.params").encode()
+ck(lib.MXFrontNDArraySave(fn, 1, (P * 1)(h),
+                          (ctypes.c_char_p * 1)(b"arr0")))
+num = ctypes.c_uint32()
+hs = ctypes.POINTER(P)()
+keys = ctypes.POINTER(ctypes.c_char_p)()
+ck(lib.MXFrontNDArrayLoad(fn, ctypes.byref(num), ctypes.byref(hs),
+                          ctypes.byref(keys)))
+assert num.value == 1 and keys[0] == b"arr0"
+back = np.zeros(6, np.float32)
+ck(lib.MXFrontNDArraySyncCopyToCPU(P(hs[0]), back.ctypes.data_as(P),
+                                   ctypes.c_uint64(6)))
+assert (back == data).all()
+print("save/load OK")
+
+# --- data iterator -------------------------------------------------------
+bigd = P()
+ck(lib.MXFrontNDArrayCreate((ctypes.c_uint32 * 2)(10, 6), 2, 1, 0, 0,
+                            ctypes.byref(bigd)))
+bigl = P()
+ck(lib.MXFrontNDArrayCreate((ctypes.c_uint32 * 1)(10), 1, 1, 0, 0,
+                            ctypes.byref(bigl)))
+it = P()
+ck(lib.MXFrontDataIterCreateNDArray(bigd, bigl, 4, 0, b"pad",
+                                    ctypes.byref(it)))
+more = ctypes.c_int()
+batches = 0
+while True:
+    ck(lib.MXFrontDataIterNext(it, ctypes.byref(more)))
+    if not more.value:
+        break
+    d = P()
+    ck(lib.MXFrontDataIterGetData(it, ctypes.byref(d)))
+    ck(lib.MXFrontNDArrayFree(d))
+    batches += 1
+assert batches == 3, batches
+print("dataiter OK")
+print("C FRONTEND ABI OK")
+"""
+
+
+@pytest.mark.skipif(shutil.which("g++") is None,
+                    reason="needs a C++ toolchain")
+def test_c_frontend_api_end_to_end(tmp_path):
+    inc = sysconfig.get_paths()["include"]
+    lib = tmp_path / "libmxnet_tpu_frontend.so"
+    r = subprocess.run(
+        ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+         os.path.join(REPO, "src", "frontend_capi.cc"),
+         "-I", inc, "-o", str(lib)],
+        capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr[-2000:]
+    driver = tmp_path / "driver.py"
+    driver.write_text(_DRIVER)
+    env = dict(os.environ, MXNET_TPU_HOME=REPO, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)
+    r = subprocess.run(
+        [sys.executable, str(driver), str(lib), str(tmp_path)],
+        env=env, capture_output=True, text=True, timeout=420)
+    assert r.returncode == 0, (r.stdout[-800:], r.stderr[-2500:])
+    assert "C FRONTEND ABI OK" in r.stdout
