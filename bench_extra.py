@@ -39,13 +39,34 @@ def _sync_param(mod):
                       ._jx.reshape(-1)[:1])
 
 
-def row(name, value, unit, ref_k80=None):
+def row(name, value, unit, ref_k80=None, **extra):
     entry = {"metric": name, "value": round(value, 2), "unit": unit}
     if ref_k80:
         entry["ref_k80"] = ref_k80
         entry["vs_k80"] = round(value / ref_k80, 2)
+    entry.update(extra)
     ROWS.append(entry)
     print(json.dumps(entry), flush=True)
+
+
+def _mfu_fields(mod, samples_per_sec, per_sample_div):
+    """Anchor a row with measured per-step FLOPs + MFU when the reference
+    publishes no comparable number (round-2 verdict: no uninterpretable
+    rows).  Uses the compiled bulk step's XLA cost analysis (scan body
+    counted once) and the chip peak detected from device_kind."""
+    from bench import _detect_peak_tflops
+
+    cost = mod.bulk_cost_analysis()
+    if not cost or not cost.get("flops"):
+        return {}
+    flops_per_sample = float(cost["flops"]) / per_sample_div
+    tflops = samples_per_sec * flops_per_sample / 1e12
+    out = {"flops_per_sample_g": round(flops_per_sample / 1e9, 3),
+           "tflops": round(tflops, 2)}
+    peak, _src = _detect_peak_tflops(mod._exec._ctx.jax_device())
+    if peak:
+        out["mfu_pct"] = round(100.0 * tflops / peak, 2)
+    return out
 
 
 def infer_score(network, ref, batch=32, **kw):
@@ -123,8 +144,13 @@ def lstm_score(batch=32, seq=35, hidden=200, layers=2, vocab=10000):
     t0 = time.time()
     mod.run_bulk([b] * STEPS)
     _sync_param(mod)
-    row("train_ptb_lstm_b%d_seq%d" % (batch, seq),
-        batch * STEPS / (time.time() - t0), "samples/sec")
+    sps = batch * STEPS / (time.time() - t0)
+    # no reference-published PTB throughput exists; the row carries
+    # measured FLOPs + MFU as its comparator, and
+    # tests/test_rnn.py::test_ptb_perplexity_converges is the paired
+    # convergence smoke (reference example/rnn/lstm_bucketing.py:96-107)
+    row("train_ptb_lstm_b%d_seq%d" % (batch, seq), sps, "samples/sec",
+        **_mfu_fields(mod, sps, batch))
 
 
 def ssd_score(batch=8, size=300):
@@ -147,18 +173,18 @@ def ssd_score(batch=8, size=300):
         data=[mx.nd.array(rs.rand(batch, 3, size, size)
                           .astype(np.float32), ctx=ctx)],
         label=[mx.nd.array(lab, ctx=ctx)])
-    for _ in range(2):
-        mod.forward_backward(b)
-        mod.update()
+    os.environ.setdefault("MXNET_FUSE_TRAIN_STEP", "1")
+    mod.run_bulk([b] * STEPS)  # warmup (and the cost-analysis signature)
     _sync_param(mod)
     t0 = time.time()
-    for _ in range(STEPS):
-        mod.forward_backward(b)
-        mod.update()
+    mod.run_bulk([b] * STEPS)
     _sync_param(mod)
     sec = (time.time() - t0) / STEPS
+    # no reference-published SSD step time exists; measured FLOPs + MFU
+    # anchor the row, and tests/test_ssd.py::
+    # test_ssd_train_step_runs_and_learns is the paired convergence smoke
     row("train_ssd_vgg16_%d_b%d_sec_per_step" % (size, batch), sec,
-        "sec/step")
+        "sec/step", **_mfu_fields(mod, batch / sec, batch))
 
 
 def io_score(num_images=4096, batch=128):
