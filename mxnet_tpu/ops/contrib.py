@@ -304,6 +304,42 @@ def _gen_base_anchors(base_size, scales, ratios):
 
 def _proposal(attrs, inputs, aux, is_train, rng):
     cls_prob, bbox_pred, im_info = inputs
+    from . import bn_pallas
+
+    if not bn_pallas._on_tpu():
+        return _proposal_compute(attrs, cls_prob, bbox_pred, im_info)
+    # XLA:TPU SIGABRTs compiling the fused decode->top_k->NMS->compact
+    # pipeline on the current toolchain (each stage compiles alone;
+    # stage optimization_barriers do not help) — run the op as a host
+    # callback instead.  Proposal is a small inference-side op (RPN),
+    # so the round trip is cheap relative to the backbone.
+    import functools
+
+    host = functools.partial(_proposal_host, attrs)
+    out_shapes = [jax.ShapeDtypeStruct(
+        (cls_prob.shape[0] * attrs["rpn_post_nms_top_n"], 5),
+        jnp.float32)]
+    if attrs["output_score"]:
+        out_shapes.append(jax.ShapeDtypeStruct(
+            (cls_prob.shape[0] * attrs["rpn_post_nms_top_n"], 1),
+            jnp.float32))
+    outs = jax.pure_callback(host, out_shapes, cls_prob, bbox_pred,
+                             im_info)
+    # the reference Proposal declares no backward (zero grad) — and a
+    # pure_callback has no VJP, so training graphs must not transpose
+    # through it
+    return [jax.lax.stop_gradient(o) for o in outs]
+
+
+def _proposal_host(attrs, cls_prob, bbox_pred, im_info):
+    with jax.default_device(jax.devices("cpu")[0]):
+        outs = _proposal_compute(attrs, jnp.asarray(np.asarray(cls_prob)),
+                                 jnp.asarray(np.asarray(bbox_pred)),
+                                 jnp.asarray(np.asarray(im_info)))
+    return [np.asarray(o, np.float32) for o in outs]
+
+
+def _proposal_compute(attrs, cls_prob, bbox_pred, im_info):
     B, _, H, W = cls_prob.shape
     stride = attrs["feature_stride"]
     scales = attrs["scales"]

@@ -146,7 +146,11 @@ def _grid_generator(attrs, inputs, aux, is_train, rng):
         gx, gy = _identity_grid(h, w, data.dtype)
         ones = jnp.ones_like(gx)
         coords = jnp.stack([gx, gy, ones], axis=0).reshape(3, -1)
-        out = jnp.einsum("bij,jk->bik", theta, coords)
+        # the grid matmul is tiny but its outputs are SAMPLING
+        # COORDINATES: a bf16 MXU pass moves them ~1e-2 relative, which
+        # shifts bilinear cell assignment — force full-precision
+        out = jnp.einsum("bij,jk->bik", theta, coords,
+                         precision=jax.lax.Precision.HIGHEST)
         return [out.reshape(data.shape[0], 2, h, w)]
     if tt == "warp":
         # data = flow (B, 2, H, W) in pixels; grid = identity + normalized flow
@@ -182,7 +186,9 @@ def _spatial_transformer(attrs, inputs, aux, is_train, rng):
     gx, gy = _identity_grid(h, w, data.dtype)
     ones = jnp.ones_like(gx)
     coords = jnp.stack([gx, gy, ones], axis=0).reshape(3, -1)
-    grid = jnp.einsum("bij,jk->bik", theta, coords).reshape(
+    # full-precision grid: see _grid_generator (sampling coordinates)
+    grid = jnp.einsum("bij,jk->bik", theta, coords,
+                      precision=jax.lax.Precision.HIGHEST).reshape(
         loc.shape[0], 2, h, w)
 
     def one(img, g):
