@@ -28,11 +28,13 @@ class _BuildPy(build_py):
 
     def run(self):
         super().run()
-        src = os.path.join(HERE, "src", "native.cc")
         dst_dir = os.path.join(self.build_lib, "mxnet_tpu", "native")
-        if os.path.exists(src) and os.path.isdir(dst_dir):
-            shutil.copy2(src, os.path.join(dst_dir, "native.cc"))
-        else:
+        for name in ("native.cc", "imgdecode.cc"):
+            s = os.path.join(HERE, "src", name)
+            if os.path.exists(s) and os.path.isdir(dst_dir):
+                shutil.copy2(s, os.path.join(dst_dir, name))
+        src = os.path.join(HERE, "src", "native.cc")
+        if not os.path.exists(src):
             # sdists must carry src/native.cc (MANIFEST.in); installs
             # without it lose the native host runtime
             import warnings
@@ -49,7 +51,7 @@ setup(
     long_description=_readme(),
     long_description_content_type="text/markdown",
     packages=find_packages(include=["mxnet_tpu", "mxnet_tpu.*"]),
-    package_data={"mxnet_tpu.native": ["native.cc"]},
+    package_data={"mxnet_tpu.native": ["native.cc", "imgdecode.cc"]},
     cmdclass={"build_py": _BuildPy},
     python_requires=">=3.10",
     install_requires=[

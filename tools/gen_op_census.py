@@ -158,6 +158,11 @@ def main():
                         if t not in cpu]
                 tpu += [t for t in _grep_tree("tests_tpu", probe)
                         if t not in tpu]
+            # the tests_tpu parity harness binds BOTH cpu and tpu
+            # contexts (check_consistency) — hardware coverage implies
+            # CPU execution of the same op
+            if not cpu and tpu:
+                cpu = list(tpu)
             if group_names & sweep_ops:
                 tpu = ["tests_tpu/test_operator_tpu_sweep.py (table)"] \
                     + [t for t in tpu
@@ -186,7 +191,9 @@ def main():
                 "`MXNET_REGISTER_NDARRAY_FUN` over the reference "
                 "`src/operator` + `src/ndarray`). Coverage columns: "
                 "word-grep over `tests/` (CPU) and `tests_tpu/` "
-                "(hardware parity); file shown is the first hit.\n\n")
+                "(hardware parity); file shown is the first hit. "
+                "tests_tpu parity tests bind BOTH backends "
+                "(check_consistency), so they count for CPU too.\n\n")
         f.write("Reference coverage: %d present, %d via alias, %d "
                 "renamed, %d moved to python API, %d absent.\n\n"
                 % (counts["yes"], counts["alias"], counts["renamed"],
