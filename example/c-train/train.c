@@ -1,0 +1,172 @@
+/* Train an MLP classifier from PURE C against the frontend C ABI —
+ * the training-capable non-Python consumer proof for the bindings
+ * story (include/mxnet_tpu/c_frontend_api.h; the reference analog is
+ * any language binding driving libmxnet's c_api.h).
+ *
+ * Build (see README.md):
+ *   gcc -O2 train.c -I../../include -L. -lmxnet_tpu_frontend \
+ *       -Wl,-rpath,'$ORIGIN' -lm -o c_train
+ * Run with MXNET_TPU_HOME pointing at the repo / site-packages dir.
+ */
+#include <math.h>
+#include <stdio.h>
+#include <stdlib.h>
+
+#include <mxnet_tpu/c_frontend_api.h>
+
+#define CK(call)                                                       \
+  do {                                                                 \
+    if ((call) != 0) {                                                 \
+      fprintf(stderr, "ABI error: %s\n", MXFrontGetLastError());       \
+      return 1;                                                        \
+    }                                                                  \
+  } while (0)
+
+#define B 32
+#define D 16
+#define C 4
+#define N 256
+
+static float frandu(unsigned int* seed) {
+  *seed = *seed * 1103515245u + 12345u;
+  return (float)((*seed >> 16) & 0x7fff) / 32768.0f;
+}
+
+int main(void) {
+  CK(MXFrontRandomSeed(11));
+
+  /* ---- symbol: D -> 32 relu -> C softmax ---- */
+  SymbolHandle data, fc1, act, fc2, net;
+  CK(MXFrontSymbolCreateVariable("data", &data));
+  {
+    const char* k[] = {"num_hidden"};
+    const char* v[] = {"32"};
+    SymbolHandle ins[] = {data};
+    CK(MXFrontSymbolCreateOp("FullyConnected", "fc1", 1, k, v, 1, NULL,
+                             ins, &fc1));
+  }
+  {
+    const char* k[] = {"act_type"};
+    const char* v[] = {"relu"};
+    SymbolHandle ins[] = {fc1};
+    CK(MXFrontSymbolCreateOp("Activation", "relu1", 1, k, v, 1, NULL,
+                             ins, &act));
+  }
+  {
+    const char* k[] = {"num_hidden"};
+    const char* v[] = {"4"};
+    SymbolHandle ins[] = {act};
+    CK(MXFrontSymbolCreateOp("FullyConnected", "fc2", 1, k, v, 1, NULL,
+                             ins, &fc2));
+  }
+  {
+    SymbolHandle ins[] = {fc2};
+    CK(MXFrontSymbolCreateOp("SoftmaxOutput", "softmax", 0, NULL, NULL,
+                             1, NULL, ins, &net));
+  }
+
+  /* ---- executor ---- */
+  ExecutorHandle exec;
+  {
+    const char* keys[] = {"data", "softmax_label"};
+    uint32_t indptr[] = {0, 2, 3};
+    uint32_t dims[] = {B, D, B};
+    CK(MXFrontExecutorSimpleBind(net, 1 /* cpu */, 0, 2, keys, indptr,
+                                 dims, "write", &exec));
+  }
+
+  /* ---- init params (uniform fan-scaled) ---- */
+  int n_args;
+  const char** arg_names;
+  CK(MXFrontSymbolListArguments(net, &n_args, &arg_names));
+  char param_names[16][64];
+  NDArrayHandle weights[16], grads[16];
+  int n_params = 0;
+  unsigned int seed = 7;
+  for (int i = 0; i < n_args; ++i) {
+    const char* nm = arg_names[i];
+    if (nm[0] == 'd' || nm[0] == 's') continue;  /* data / softmax_label */
+    snprintf(param_names[n_params], 64, "%s", nm);
+    ++n_params;
+  }
+  for (int i = 0; i < n_params; ++i) {
+    CK(MXFrontExecutorGetArg(exec, param_names[i], &weights[i]));
+    CK(MXFrontExecutorGetGrad(exec, param_names[i], &grads[i]));
+    uint32_t nd;
+    const uint32_t* shp;
+    CK(MXFrontNDArrayGetShape(weights[i], &nd, &shp));
+    uint64_t sz = 1;
+    float fan = 0.f;
+    for (uint32_t d = 0; d < nd; ++d) {
+      sz *= shp[d];
+      fan += (float)shp[d];
+    }
+    float scale = sqrtf(6.0f / fan);
+    float* buf = malloc(sz * sizeof(float));
+    for (uint64_t j = 0; j < sz; ++j)
+      buf[j] = (frandu(&seed) * 2.0f - 1.0f) * scale;
+    CK(MXFrontNDArraySyncCopyFromCPU(weights[i], buf, sz));
+    free(buf);
+  }
+
+  /* ---- synthetic clustered data ---- */
+  static float xs[N * D], ys[N];
+  for (int i = 0; i < N; ++i) {
+    int c = i % C;
+    ys[i] = (float)c;
+    for (int d = 0; d < D; ++d)
+      xs[i * D + d] = (d % C == c ? 1.0f : 0.0f)
+          + (frandu(&seed) - 0.5f) * 0.7f;
+  }
+
+  NDArrayHandle a_data, a_label;
+  CK(MXFrontExecutorGetArg(exec, "data", &a_data));
+  CK(MXFrontExecutorGetArg(exec, "softmax_label", &a_label));
+
+  OptimizerHandle opt;
+  {
+    const char* k[] = {"learning_rate", "momentum", "rescale_grad"};
+    const char* v[] = {"0.2", "0.9", "0.03125"};
+    CK(MXFrontOptimizerCreate("sgd", 3, k, v, &opt));
+  }
+
+  /* ---- training loop ---- */
+  for (int epoch = 0; epoch < 10; ++epoch) {
+    for (int off = 0; off + B <= N; off += B) {
+      CK(MXFrontNDArraySyncCopyFromCPU(a_data, xs + off * D, B * D));
+      CK(MXFrontNDArraySyncCopyFromCPU(a_label, ys + off, B));
+      CK(MXFrontExecutorForward(exec, 1));
+      CK(MXFrontExecutorBackward(exec, 0, NULL));
+      for (int i = 0; i < n_params; ++i)
+        CK(MXFrontOptimizerUpdate(opt, i, weights[i], grads[i]));
+    }
+  }
+
+  /* ---- accuracy ---- */
+  int correct = 0, total = 0;
+  for (int off = 0; off + B <= N; off += B) {
+    CK(MXFrontNDArraySyncCopyFromCPU(a_data, xs + off * D, B * D));
+    CK(MXFrontExecutorForward(exec, 0));
+    int n_out;
+    NDArrayHandle* outs;
+    CK(MXFrontExecutorOutputs(exec, &n_out, &outs));
+    float probs[B * C];
+    CK(MXFrontNDArraySyncCopyToCPU(outs[0], probs, B * C));
+    for (int i = 0; i < n_out; ++i) MXFrontNDArrayFree(outs[i]);
+    for (int b = 0; b < B; ++b) {
+      int arg = 0;
+      for (int c = 1; c < C; ++c)
+        if (probs[b * C + c] > probs[b * C + arg]) arg = c;
+      correct += (arg == (int)ys[off + b]);
+      ++total;
+    }
+  }
+  float acc = (float)correct / (float)total;
+  printf("accuracy: %.3f (%d/%d)\n", acc, correct, total);
+  if (acc < 0.9f) {
+    fprintf(stderr, "FAILED: accuracy below threshold\n");
+    return 1;
+  }
+  printf("C TRAIN OK\n");
+  return 0;
+}
