@@ -647,3 +647,57 @@ def test_fused_optimizer_ops():
     vt = 0.001 * g * g
     want = w - 0.1 * mt / (np.sqrt(vt) + 1e-8)
     assert_almost_equal(new_w, want, rtol=1e-3, atol=1e-4)
+
+
+def test_spatial_ops_numeric_gradients():
+    """Finite-difference gradient checks for the spatial family — the
+    gnarliest gradient structures in the census (reference
+    test_operator.py checks these per-op).  Smooth inputs keep bilinear
+    sampling differentiable at the probe scale."""
+    rs = np.random.RandomState(0)
+    yy, xx = np.meshgrid(np.linspace(0, 1, 5), np.linspace(0, 1, 5),
+                         indexing="ij")
+    img = (np.sin(2.2 * xx + 0.7 * yy) + 1.5).astype(np.float32)
+    x = img[None, None]
+
+    # BilinearSampler: grads wrt data AND grid
+    # offset keeps every sample point off integer pixel coordinates,
+    # where the bilinear gradient is discontinuous and finite
+    # differences disagree with the (one-sided) analytic value
+    grid = np.stack([xx * 1.6 - 0.77, yy * 1.6 - 0.81]) \
+        .astype(np.float32)[None]
+    s = sym.BilinearSampler(sym.Variable("x"), sym.Variable("g"))
+    check_numeric_gradient(s, {"x": x, "g": grid}, numeric_eps=1e-3,
+                           rtol=0.06, atol=2e-3)
+
+    # SpatialTransformer: grads wrt data and loc
+    loc = np.array([[0.85, 0.05, 0.02, -0.04, 0.9, 0.01]], np.float32)
+    st = sym.SpatialTransformer(sym.Variable("x"), sym.Variable("l"),
+                                target_shape=(5, 5),
+                                transform_type="affine",
+                                sampler_type="bilinear")
+    check_numeric_gradient(st, {"x": x, "l": loc}, numeric_eps=1e-3,
+                           rtol=0.06, atol=2e-3)
+
+    # ROIPooling: grad wrt data only (rois are integer-ish coordinates)
+    rois = np.array([[0, 0, 0, 3, 3]], np.float32)
+    rp = sym.ROIPooling(sym.Variable("x"), sym.Variable("r"),
+                        pooled_size=(2, 2), spatial_scale=1.0)
+    check_numeric_gradient(rp, {"x": x, "r": rois}, grad_nodes=["x"],
+                           numeric_eps=1e-3, rtol=0.06, atol=2e-3)
+
+    # Correlation: grads wrt both inputs
+    a = (rs.rand(1, 2, 5, 5) * 0.5 + 0.5).astype(np.float32)
+    b = (rs.rand(1, 2, 5, 5) * 0.5 + 0.5).astype(np.float32)
+    co = sym.Correlation(sym.Variable("a"), sym.Variable("b"),
+                         kernel_size=1, max_displacement=1, stride1=1,
+                         stride2=1, pad_size=1)
+    check_numeric_gradient(co, {"a": a, "b": b}, numeric_eps=1e-3,
+                           rtol=0.06, atol=2e-3)
+
+    # GridGenerator(warp) -> sampler chain: grad wrt the flow field
+    flow = (rs.rand(1, 2, 5, 5).astype(np.float32) - 0.5) * 0.4 + 0.013
+    gw = sym.GridGenerator(sym.Variable("f"), transform_type="warp")
+    ch = sym.BilinearSampler(sym.Variable("x"), gw)
+    check_numeric_gradient(ch, {"x": x, "f": flow}, numeric_eps=1e-3,
+                           rtol=0.08, atol=3e-3)
