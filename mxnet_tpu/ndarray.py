@@ -655,16 +655,17 @@ def _invoke(op, args, kwargs):
     aux_arrays = [_as_nd(v) for v in aux_arrays]
 
     rng = _random.next_key() if op.needs_rng else None
-    fn = _reg.jitted_apply(op.name, _reg.attrs_key(attrs), True)
     with _profiler.span(op.name, "imperative") as sp:
         if inputs:
             octx = op_ctx or inputs[0]._ctx  # op_ctx None => all-numpy inputs
         else:
             octx = ctx or current_context()
         # trace-time device hint: lowering decisions (Pallas vs XLA)
-        # follow the op's device, not the process default backend
+        # follow the op's device, not the process default backend — set
+        # BEFORE the cache lookup (the jit cache keys on the device)
         tok = _reg.trace_device.set(octx.device_type)
         try:
+            fn = _reg.jitted_apply(op.name, _reg.attrs_key(attrs), True)
             if inputs:
                 outs, aux_up = fn([x._jx for x in inputs],
                                   [x._jx for x in aux_arrays], rng)

@@ -262,12 +262,15 @@ trace_device = _contextvars.ContextVar("mxnet_tpu_trace_device",
 
 
 def jitted_apply(op_name, attrs_tuple, is_train):
+    # keyed on the trace device too: the traced jaxpr bakes in
+    # device-dependent lowering decisions (Pallas vs XLA), so a CPU call
+    # must not reuse a TPU-traced function or vice versa
     return _jitted_apply(op_name, attrs_tuple, is_train,
-                         trace_env_fingerprint())
+                         trace_env_fingerprint(), trace_device.get())
 
 
 @lru_cache(maxsize=None)
-def _jitted_apply(op_name, attrs_tuple, is_train, _env_key):
+def _jitted_apply(op_name, attrs_tuple, is_train, _env_key, _dev_key):
     op = get(op_name)
     attrs = dict(attrs_tuple)
 
