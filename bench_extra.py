@@ -30,6 +30,20 @@ STEPS = int(os.environ.get("BENCH_STEPS", "10"))
 ROWS = []
 
 
+def _git_rev():
+    try:
+        import subprocess
+        return subprocess.check_output(
+            ["git", "rev-parse", "--short", "HEAD"],
+            cwd=os.path.dirname(os.path.abspath(__file__)) or ".",
+            stderr=subprocess.DEVNULL).decode().strip()
+    except Exception:
+        return "unknown"
+
+
+_REV = _git_rev()
+
+
 def _ctx():
     return mx.tpu() if mx.num_tpus() > 0 else mx.cpu()
 
@@ -40,7 +54,10 @@ def _sync_param(mod):
 
 
 def row(name, value, unit, ref_k80=None, **extra):
-    entry = {"metric": name, "value": round(value, 2), "unit": unit}
+    # provenance per row: best-of-N merge keeps rows from older runs, so
+    # each row records which code revision measured it (advisor r3)
+    entry = {"metric": name, "value": round(value, 2), "unit": unit,
+             "commit": _REV, "ts": int(time.time())}
     if ref_k80:
         entry["ref_k80"] = ref_k80
         entry["vs_k80"] = round(value / ref_k80, 2)

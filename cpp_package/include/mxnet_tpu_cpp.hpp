@@ -109,14 +109,25 @@ class NDArray {
   static std::vector<NDArray> Invoke(const std::string& op,
                                      const std::vector<NDArrayHandle>& ins,
                                      const KwArgs& params = {}) {
-    NDArrayHandle outs[8];
-    int n = 8;
+    // the ABI writes the true output count back into n on overflow, so
+    // one retry with the reported size handles ops with unbounded output
+    // counts (SliceChannel num_outputs=K, multi-output RNN states)
+    std::vector<NDArrayHandle> outs(64);
+    int n = static_cast<int>(outs.size());
     auto k = params.keys();
     auto v = params.vals();
-    Check(MXFrontImperativeInvoke(
+    int rc = MXFrontImperativeInvoke(
         op.c_str(), static_cast<int>(ins.size()),
         const_cast<NDArrayHandle*>(ins.data()), params.size(),
-        k.data(), v.data(), &n, outs));
+        k.data(), v.data(), &n, outs.data());
+    if (rc != 0 && n > static_cast<int>(outs.size())) {
+      outs.resize(n);
+      rc = MXFrontImperativeInvoke(
+          op.c_str(), static_cast<int>(ins.size()),
+          const_cast<NDArrayHandle*>(ins.data()), params.size(),
+          k.data(), v.data(), &n, outs.data());
+    }
+    Check(rc);
     std::vector<NDArray> res;
     res.reserve(n);
     for (int i = 0; i < n; ++i) res.emplace_back(outs[i]);

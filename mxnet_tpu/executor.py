@@ -145,7 +145,8 @@ def _bn_relu_peephole(symbol, nodes):
                 or node.attrs.get("act_type") != "relu":
             continue
         child, ci = node.inputs[0]
-        if ci != 0 or child.is_variable or child.op.name != "BatchNorm":
+        if ci != 0 or child.is_variable or child.op is None \
+                or child.op.name != "BatchNorm":
             continue
         a = child.attrs
         if a.get("use_global_stats") or a.get("output_mean_var"):

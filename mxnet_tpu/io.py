@@ -528,7 +528,7 @@ def ImageRecordIter(path_imgrec, data_shape, batch_size, shuffle=False,
                     path_imgidx=None, prefetch=True, data_name="data",
                     label_name="softmax_label", label_width=1,
                     preprocess_threads=4, prefetch_buffer=1,
-                    round_batch=None, ctx=None, **kwargs):
+                    round_batch=True, ctx=None, **kwargs):
     """C-iter-style facade over ``image.ImageIter`` (+ prefetch thread).
 
     Reference: ``ImageRecordIter`` registered at
@@ -590,8 +590,9 @@ def ImageRecordIter(path_imgrec, data_shape, batch_size, shuffle=False,
     # batch wraps around to the start of the data and the next epoch
     # skips the wrapped samples — every sample still appears once per
     # cycle and every batch is full (pad == 0), the semantics dist
-    # workers rely on for equal step counts.  round_batch=0/None keeps
-    # the pad-and-set-batch.pad behavior.
+    # workers rely on for equal step counts.  Defaults ON to match the
+    # reference (iter_batchloader.h:30 set_default(true)); round_batch=0
+    # keeps the pad-and-set-batch.pad behavior.
     it = ImageIter(batch_size, data_shape, label_width=label_width,
                    path_imgrec=path_imgrec, path_imgidx=path_imgidx,
                    shuffle=shuffle, part_index=part_index,

@@ -181,7 +181,8 @@ int fill_string_list(PyObject* list, int* out_size,
   s->cstrs.clear();
   for (Py_ssize_t i = 0; i < n; ++i) {
     PyObject* it = PySequence_GetItem(list, i);
-    const char* c = PyUnicode_AsUTF8(it);
+    const char* c = it ? PyUnicode_AsUTF8(it) : nullptr;
+    if (c == nullptr) PyErr_Clear();  // don't poison the next C-API call
     s->strings.emplace_back(c ? c : "");
     Py_XDECREF(it);
   }
@@ -206,7 +207,9 @@ void fill_shape_list(PyObject* shapes, uint32_t* count,
     offsets.push_back(s->dims.size());
     for (Py_ssize_t d = 0; d < nd; ++d) {
       PyObject* v = PySequence_GetItem(t, d);
-      s->dims.push_back(static_cast<uint32_t>(PyLong_AsUnsignedLong(v)));
+      unsigned long dim = v ? PyLong_AsUnsignedLong(v) : 0;
+      if (PyErr_Occurred()) { PyErr_Clear(); dim = 0; }
+      s->dims.push_back(static_cast<uint32_t>(dim));
       Py_XDECREF(v);
     }
     Py_XDECREF(t);
@@ -313,7 +316,9 @@ int MXFrontNDArrayGetShape(NDArrayHandle h, uint32_t* out_ndim,
   Py_ssize_t n = PySequence_Size(r);
   for (Py_ssize_t i = 0; i < n; ++i) {
     PyObject* v = PySequence_GetItem(r, i);
-    s->dims.push_back(static_cast<uint32_t>(PyLong_AsUnsignedLong(v)));
+    unsigned long dim = v ? PyLong_AsUnsignedLong(v) : 0;
+    if (PyErr_Occurred()) { PyErr_Clear(); dim = 0; }
+    s->dims.push_back(static_cast<uint32_t>(dim));
     Py_XDECREF(v);
   }
   Py_DECREF(r);
@@ -387,6 +392,7 @@ int MXFrontImperativeInvoke(const char* op_name, int num_inputs,
   Py_ssize_t n = PySequence_Size(r);
   if (n > *num_outputs) {
     Py_DECREF(r);
+    *num_outputs = static_cast<int>(n);  // tell the caller what to allocate
     set_error("output buffer too small");
     return -1;
   }

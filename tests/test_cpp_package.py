@@ -20,6 +20,15 @@ def test_cpp_frontend_trains(tmp_path):
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env.pop("XLA_FLAGS", None)
+    # cv2's import hook leaves a trailing ':' on LD_LIBRARY_PATH (an
+    # empty entry = cwd), which makes the loader resolve library names
+    # from the subprocess cwd — strip empty entries so train_mlp binds
+    # its own build-dir frontend lib, not a stray cwd one
+    llp = ":".join(p for p in env.get("LD_LIBRARY_PATH", "").split(":") if p)
+    if llp:
+        env["LD_LIBRARY_PATH"] = llp
+    else:
+        env.pop("LD_LIBRARY_PATH", None)
     subprocess.run(["cmake", "-B", build, "-G", "Ninja", CPP],
                    check=True, capture_output=True, text=True)
     subprocess.run(["ninja", "-C", build], check=True,
