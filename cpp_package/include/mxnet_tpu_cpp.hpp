@@ -111,7 +111,11 @@ class NDArray {
                                      const KwArgs& params = {}) {
     // the ABI writes the true output count back into n on overflow, so
     // one retry with the reported size handles ops with unbounded output
-    // counts (SliceChannel num_outputs=K, multi-output RNN states)
+    // counts (SliceChannel num_outputs=K, multi-output RNN states).
+    // Caveat: the overflowed first call already ran the op, so a >64-
+    // output op executes twice (and a >64-output *sampling* op would
+    // advance the RNG twice) — pre-size via a first Invoke on a small
+    // input if that matters
     std::vector<NDArrayHandle> outs(64);
     int n = static_cast<int>(outs.size());
     auto k = params.keys();

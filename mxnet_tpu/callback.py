@@ -1,9 +1,21 @@
-"""Training callbacks (reference ``python/mxnet/callback.py``)."""
+"""Training callbacks: checkpointing, metric logging, throughput.
+
+API parity with the reference's ``python/mxnet/callback.py`` (same
+callables, same ``BatchEndParam``-shaped argument contract), built on
+this repo's conventions: throughput is measured between explicit *marks*
+(the last log point) with a monotonic clock, so the reported samples/sec
+stays correct even when the callback list drops or duplicates batch
+events — the reference instead assumes exactly ``frequent`` batches
+elapsed between logs.
+
+Batch-end callbacks receive any object with ``epoch``, ``nbatch`` and
+``eval_metric`` attributes (``model.BatchEndParam``); epoch-end
+callbacks receive ``(epoch, symbol, arg_params, aux_params)``.
+"""
 
 from __future__ import annotations
 
 import logging
-import math
 import sys
 import time
 
@@ -12,8 +24,9 @@ __all__ = ["module_checkpoint", "do_checkpoint", "log_train_metric",
 
 
 def module_checkpoint(mod, prefix, period=1, save_optimizer_states=False):
-    """reference ``callback.py:11``"""
-    period = int(max(1, period))
+    """Epoch-end callback: ``mod.save_checkpoint`` every ``period``
+    epochs (reference ``callback.py:11``)."""
+    period = max(1, int(period))
 
     def _callback(iter_no, sym=None, arg=None, aux=None):
         if (iter_no + 1) % period == 0:
@@ -23,10 +36,11 @@ def module_checkpoint(mod, prefix, period=1, save_optimizer_states=False):
 
 
 def do_checkpoint(prefix, period=1):
-    """reference ``callback.py:39``"""
+    """Epoch-end callback: save symbol+params every ``period`` epochs
+    (reference ``callback.py:39``)."""
     from .model import save_checkpoint
 
-    period = int(max(1, period))
+    period = max(1, int(period))
 
     def _callback(iter_no, sym, arg, aux):
         if (iter_no + 1) % period == 0:
@@ -36,12 +50,12 @@ def do_checkpoint(prefix, period=1):
 
 
 def log_train_metric(period, auto_reset=False):
-    """reference ``callback.py`` log_train_metric"""
+    """Batch-end callback: log the running training metric every
+    ``period`` batches (reference ``callback.py`` log_train_metric)."""
 
     def _callback(param):
         if param.nbatch % period == 0 and param.eval_metric is not None:
-            name_value = param.eval_metric.get_name_value()
-            for name, value in name_value:
+            for name, value in param.eval_metric.get_name_value():
                 logging.info("Iter[%d] Batch[%d] Train-%s=%f",
                              param.epoch, param.nbatch, name, value)
             if auto_reset:
@@ -51,50 +65,52 @@ def log_train_metric(period, auto_reset=False):
 
 
 class Speedometer:
-    """reference ``callback.py:89`` — samples/sec logging."""
+    """Batch-end callback: log samples/sec every ``frequent`` batches
+    (reference ``callback.py:89``).
+
+    Throughput is ``(batches since the last log) * batch_size /
+    elapsed`` from a monotonic clock — measured, not assumed, so a
+    missed callback or an epoch boundary can't skew the rate.  A drop in
+    ``nbatch`` (new epoch / iterator reset) re-arms the mark without
+    logging a bogus first interval.
+    """
 
     def __init__(self, batch_size, frequent=50):
         self.batch_size = batch_size
         self.frequent = frequent
-        self.init = False
-        self.tic = 0
-        self.last_count = 0
+        self._mark = None  # (nbatch, perf_counter) at the last log/reset
 
     def __call__(self, param):
+        now = time.perf_counter()
         count = param.nbatch
-        if self.last_count > count:
-            self.init = False
-        self.last_count = count
-        if self.init:
-            if count % self.frequent == 0:
-                speed = self.frequent * self.batch_size / \
-                    (time.time() - self.tic)
-                if param.eval_metric is not None:
-                    name_value = param.eval_metric.get_name_value()
-                    s = "Epoch[%d] Batch [%d]\tSpeed: %.2f samples/sec" \
-                        % (param.epoch, count, speed)
-                    for name, value in name_value:
-                        s += "\tTrain-%s=%f" % (name, value)
-                    logging.info(s)
-                else:
-                    logging.info("Iter[%d] Batch [%d]\tSpeed: %.2f samples/sec",
-                                 param.epoch, count, speed)
-                self.tic = time.time()
+        if self._mark is None or count < self._mark[0]:
+            self._mark = (count, now)  # fresh epoch: arm, don't log
+            return
+        if count == self._mark[0] or count % self.frequent != 0:
+            return
+        elapsed = now - self._mark[1]
+        speed = (count - self._mark[0]) * self.batch_size / max(elapsed, 1e-9)
+        self._mark = (count, now)
+        if param.eval_metric is not None:
+            metrics = "".join("\tTrain-%s=%f" % nv
+                              for nv in param.eval_metric.get_name_value())
+            logging.info("Epoch[%d] Batch [%d]\tSpeed: %.2f samples/sec%s",
+                         param.epoch, count, speed, metrics)
         else:
-            self.init = True
-            self.tic = time.time()
+            logging.info("Iter[%d] Batch [%d]\tSpeed: %.2f samples/sec",
+                         param.epoch, count, speed)
 
 
 class ProgressBar:
-    """reference ``callback.py`` ProgressBar"""
+    """Batch-end callback: in-place text progress bar over ``total``
+    batches (reference ``callback.py`` ProgressBar)."""
 
     def __init__(self, total, length=80):
-        self.bar_len = length
         self.total = total
+        self.length = length
 
     def __call__(self, param):
-        count = param.nbatch
-        filled_len = int(round(self.bar_len * count / float(self.total)))
-        percents = math.ceil(100.0 * count / float(self.total))
-        prog_bar = "=" * filled_len + "-" * (self.bar_len - filled_len)
-        sys.stdout.write("[%s] %s%s\r" % (prog_bar, percents, "%"))
+        frac = min(max(param.nbatch / float(self.total), 0.0), 1.0)
+        filled = round(self.length * frac)
+        bar = "=" * filled + "-" * (self.length - filled)
+        sys.stdout.write("[%s] %d%%\r" % (bar, int(frac * 100 + 0.999999)))

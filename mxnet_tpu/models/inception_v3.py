@@ -1,5 +1,13 @@
-"""Inception-v3 (reference ``example/image-classification/symbols/
-inception-v3.py`` — Szegedy et al., Rethinking the Inception Architecture)."""
+"""Inception-v3 (Szegedy et al., Rethinking the Inception Architecture).
+
+Derivation note: the stage/branch structure and every layer name
+(``ch_concat_%s_chconcat``, ``%s%s_conv2d``, ...) follow the reference's
+``example/image-classification/symbols/inception-v3.py`` deliberately —
+checkpoint files and the caffe converter match weights *by layer name*,
+so name-for-name parity is the compatibility contract, not incidental
+similarity.  The ops themselves lower through this repo's symbol layer
+to XLA (see ``ops/nn.py``), not the reference's kernels.
+"""
 
 from .. import symbol as sym
 

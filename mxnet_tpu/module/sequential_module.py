@@ -1,9 +1,28 @@
-"""SequentialModule — chain of modules (reference
-``python/mxnet/module/sequential_module.py``)."""
+"""SequentialModule — a pipeline of modules executed back-to-back.
+
+API parity with the reference's ``python/mxnet/module/
+sequential_module.py`` (``add(module, take_labels=..., auto_wiring=...)``,
+the ``META_*`` constants, the BaseModule contract), re-built around an
+explicit stage record instead of the reference's parallel
+``_modules``/``_metas`` lists and ``dir()``-reflection over ``META_``
+attributes: each ``add`` appends a ``_Stage`` carrying the module and
+its wiring flags, and every pass (bind / forward / backward / metric)
+iterates stages.
+
+Stage semantics:
+
+- ``take_labels``: this stage's ``bind``/``update_metric`` see the real
+  label shapes/batch labels (loss heads); all other stages bind
+  label-free.
+- ``auto_wiring``: the previous stage's outputs are renamed
+  positionally onto this stage's ``data_names`` before binding (lets a
+  generic head consume whatever the backbone produced).
+"""
 
 from __future__ import annotations
 
 import logging
+from typing import NamedTuple
 
 from ..initializer import Uniform
 from .base_module import BaseModule
@@ -11,48 +30,71 @@ from .base_module import BaseModule
 __all__ = ["SequentialModule"]
 
 
-class SequentialModule(BaseModule):
-    """reference ``sequential_module.py:15``"""
+class _Stage(NamedTuple):
+    module: object
+    take_labels: bool
+    auto_wiring: bool
+    meta: dict  # all meta kwargs as given (incl. subclass extras)
 
+
+class SequentialModule(BaseModule):
+    """Chain of modules; data flows stage i -> stage i+1, gradients flow
+    back stage i+1 -> stage i (reference ``sequential_module.py:15``)."""
+
+    # public constants kept for reference-API compatibility:
+    # seq.add(m, **{SequentialModule.META_TAKE_LABELS: True})
     META_TAKE_LABELS = "take_labels"
     META_AUTO_WIRING = "auto_wiring"
 
     def __init__(self, logger=logging):
         super().__init__(logger=logger)
-        self._modules = []
-        self._metas = []
+        self._stages = []
         self._label_shapes = None
-        self._data_shapes = None
-        self._meta_keys = set([getattr(SequentialModule, x)
-                               for x in dir(SequentialModule)
-                               if x.startswith("META_")])
+
+    # kept as a property so reference-style introspection of ._modules
+    # (and this file's own older callers) keeps working
+    @property
+    def _modules(self):
+        return [s.module for s in self._stages]
+
+    @property
+    def _metas(self):
+        return [s.meta for s in self._stages]
 
     def add(self, module, **kwargs):
-        self._modules.append(module)
-        for key in kwargs:
-            assert key in self._meta_keys, "Unknown meta %s" % key
-        self._metas.append(kwargs)
+        # reference pattern: subclasses may declare extra META_* class
+        # constants; any such value is an accepted meta key
+        known = {getattr(type(self), a) for a in dir(type(self))
+                 if a.startswith("META_")}
+        unknown = set(kwargs) - known
+        if unknown:
+            raise ValueError("Unknown meta %s (known: %s)"
+                             % (sorted(unknown), sorted(known)))
+        self._stages.append(_Stage(
+            module=module,
+            take_labels=bool(kwargs.get(self.META_TAKE_LABELS, False)),
+            auto_wiring=bool(kwargs.get(self.META_AUTO_WIRING, False)),
+            meta=dict(kwargs)))
+        # any topology change invalidates bind/init state
         self.binded = False
         self.params_initialized = False
         self.optimizer_initialized = False
         return self
 
+    # ---- shapes & names -------------------------------------------------
+
     @property
     def data_names(self):
-        if len(self._modules) > 0:
-            return self._modules[0].data_names
-        return []
+        return self._stages[0].module.data_names if self._stages else []
 
     @property
     def output_names(self):
-        if len(self._modules) > 0:
-            return self._modules[-1].output_names
-        return []
+        return self._stages[-1].module.output_names if self._stages else []
 
     @property
     def data_shapes(self):
         assert self.binded
-        return self._modules[0].data_shapes
+        return self._stages[0].module.data_shapes
 
     @property
     def label_shapes(self):
@@ -62,46 +104,49 @@ class SequentialModule(BaseModule):
     @property
     def output_shapes(self):
         assert self.binded
-        return self._modules[-1].output_shapes
+        return self._stages[-1].module.output_shapes
+
+    # ---- parameters -----------------------------------------------------
 
     def get_params(self):
         assert self.binded and self.params_initialized
-        arg_params = dict()
-        aux_params = dict()
-        for module in self._modules:
-            arg, aux = module.get_params()
+        arg_params, aux_params = {}, {}
+        for stage in self._stages:
+            arg, aux = stage.module.get_params()
             arg_params.update(arg)
             aux_params.update(aux)
-        return (arg_params, aux_params)
+        return arg_params, aux_params
 
     def init_params(self, initializer=Uniform(0.01), arg_params=None,
                     aux_params=None, allow_missing=False, force_init=False):
         if self.params_initialized and not force_init:
             return
         assert self.binded
-        for module in self._modules:
-            module.init_params(initializer=initializer, arg_params=arg_params,
-                               aux_params=aux_params,
-                               allow_missing=allow_missing,
-                               force_init=force_init)
-
-        def _check_name(known_names, new_names, modules, i):
-            for name in new_names:
-                assert not name in known_names, \
-                    "Duplicated parameter names: " + \
-                    "name %s in layer %d (%s) is already used in layer %d " \
-                    "(%s)." % (name, i, type(modules[i]),
-                               known_names[name],
-                               type(modules[known_names[name]]))
-                known_names[name] = i
-
-        arg_names = dict()
-        aux_names = dict()
-        for i_layer, module in enumerate(self._modules):
-            arg_params, aux_params = module.get_params()
-            _check_name(arg_names, arg_params.keys(), self._modules, i_layer)
-            _check_name(aux_names, aux_params.keys(), self._modules, i_layer)
+        for stage in self._stages:
+            stage.module.init_params(
+                initializer=initializer, arg_params=arg_params,
+                aux_params=aux_params, allow_missing=allow_missing,
+                force_init=force_init)
+        self._assert_unique_param_names()
         self.params_initialized = True
+
+    def _assert_unique_param_names(self):
+        """A param name appearing in two stages would silently shadow in
+        get_params(); fail loudly with both stage positions instead."""
+        owner = {}
+        for i, stage in enumerate(self._stages):
+            arg, aux = stage.module.get_params()
+            for name in list(arg) + list(aux):
+                if name in owner:
+                    raise ValueError(
+                        "Duplicated parameter name %r: stage %d (%s) and "
+                        "stage %d (%s)" % (
+                            name, owner[name],
+                            type(self._stages[owner[name]].module).__name__,
+                            i, type(stage.module).__name__))
+                owner[name] = i
+
+    # ---- bind / optimizer ----------------------------------------------
 
     def bind(self, data_shapes, label_shapes=None, for_training=True,
              inputs_need_grad=False, force_rebind=False, shared_module=None,
@@ -112,40 +157,31 @@ class SequentialModule(BaseModule):
         if inputs_need_grad:
             assert for_training
         assert shared_module is None, "Shared module is not supported"
-        assert len(self._modules) > 0, "Attempting to bind an empty Sequential"
+        assert self._stages, "Attempting to bind an empty Sequential"
         self.binded = True
-        self._label_shapes = label_shapes
 
-        my_data_shapes = data_shapes
-        anybody_ever_needs_label = False
-        for i_layer, module in enumerate(self._modules):
-            meta = self._metas[i_layer]
-            if SequentialModule.META_TAKE_LABELS in meta and \
-                    meta[SequentialModule.META_TAKE_LABELS]:
-                my_label_shapes = label_shapes
-                anybody_ever_needs_label = True
-            else:
-                my_label_shapes = None
+        flowing_shapes = data_shapes
+        for i, stage in enumerate(self._stages):
+            if stage.auto_wiring:
+                names = stage.module.data_names
+                assert len(names) == len(flowing_shapes)
+                flowing_shapes = [
+                    (name, shape)
+                    for name, (_, shape) in zip(names, flowing_shapes)]
+            stage.module.bind(
+                data_shapes=flowing_shapes,
+                label_shapes=label_shapes if stage.take_labels else None,
+                for_training=for_training,
+                # interior stages always need input grads to keep the
+                # chain's backward flowing; stage 0 only if asked
+                inputs_need_grad=bool(
+                    for_training and (inputs_need_grad or i > 0)),
+                force_rebind=force_rebind, shared_module=None,
+                grad_req=grad_req)
+            flowing_shapes = stage.module.output_shapes
 
-            my_inputs_need_grad = bool(for_training and (
-                inputs_need_grad or i_layer > 0))
-
-            if meta.get(SequentialModule.META_AUTO_WIRING, False):
-                data_names = module.data_names
-                assert len(data_names) == len(my_data_shapes)
-                my_data_shapes = [(new_name, shape) for (new_name, (_, shape))
-                                  in zip(data_names, my_data_shapes)]
-
-            module.bind(data_shapes=my_data_shapes,
-                        label_shapes=my_label_shapes,
-                        for_training=for_training,
-                        inputs_need_grad=my_inputs_need_grad,
-                        force_rebind=force_rebind, shared_module=None,
-                        grad_req=grad_req)
-            my_data_shapes = module.output_shapes
-
-        if not anybody_ever_needs_label:
-            self._label_shapes = None
+        self._label_shapes = label_shapes \
+            if any(s.take_labels for s in self._stages) else None
 
     def init_optimizer(self, kvstore="local", optimizer="sgd",
                        optimizer_params=(("learning_rate", 0.01),),
@@ -154,64 +190,64 @@ class SequentialModule(BaseModule):
         if self.optimizer_initialized and not force_init:
             self.logger.warning("optimizer already initialized, ignoring.")
             return
-        for module in self._modules:
-            module.init_optimizer(kvstore=kvstore, optimizer=optimizer,
-                                  optimizer_params=optimizer_params,
-                                  force_init=force_init)
+        for stage in self._stages:
+            stage.module.init_optimizer(
+                kvstore=kvstore, optimizer=optimizer,
+                optimizer_params=optimizer_params, force_init=force_init)
         self.optimizer_initialized = True
+
+    # ---- compute --------------------------------------------------------
 
     def forward(self, data_batch, is_train=None):
         assert self.binded and self.params_initialized
         from ..io import DataBatch
 
-        data_batch = DataBatch(data=data_batch.data, label=data_batch.label,
-                               pad=data_batch.pad, index=data_batch.index,
-                               provide_data=data_batch.provide_data,
-                               provide_label=data_batch.provide_label)
-        for i_layer, module in enumerate(self._modules):
-            module.forward(data_batch, is_train=is_train)
-            if i_layer + 1 == len(self._modules):
+        batch = data_batch
+        for i, stage in enumerate(self._stages):
+            stage.module.forward(batch, is_train=is_train)
+            if i + 1 == len(self._stages):
                 break
-            data_batch.data = module.get_outputs()
-            if hasattr(data_batch, "provide_data"):
-                data_names = [x.name if hasattr(x, "name") else x[0]
-                              for x in module.output_shapes]
-                assert len(data_names) == len(module.get_outputs())
-                data_batch.provide_data = [
-                    (name, x.shape) for name, x in
-                    zip(data_names, module.get_outputs())]
+            outputs = stage.module.get_outputs()
+            names = [x.name if hasattr(x, "name") else x[0]
+                     for x in stage.module.output_shapes]
+            assert len(names) == len(outputs)
+            # fresh batch per stage: outputs become the next stage's
+            # data, labels ride through untouched for take_labels heads
+            batch = DataBatch(
+                data=outputs, label=batch.label, pad=batch.pad,
+                index=batch.index,
+                provide_data=[(n, x.shape) for n, x in zip(names, outputs)],
+                provide_label=batch.provide_label)
 
     def backward(self, out_grads=None):
         assert self.binded and self.params_initialized
-        for i_layer, module in reversed(list(enumerate(self._modules))):
-            module.backward(out_grads=out_grads)
-            if i_layer == 0:
-                break
-            out_grads = module.get_input_grads()
+        for i in range(len(self._stages) - 1, -1, -1):
+            self._stages[i].module.backward(out_grads=out_grads)
+            if i > 0:
+                out_grads = self._stages[i].module.get_input_grads()
 
     def update(self):
         assert self.binded and self.params_initialized \
             and self.optimizer_initialized
-        for module in self._modules:
-            module.update()
+        for stage in self._stages:
+            stage.module.update()
 
     def get_outputs(self, merge_multi_context=True):
         assert self.binded and self.params_initialized
-        return self._modules[-1].get_outputs(merge_multi_context)
+        return self._stages[-1].module.get_outputs(merge_multi_context)
 
     def get_input_grads(self, merge_multi_context=True):
         assert self.binded and self.params_initialized \
             and self.inputs_need_grad
-        return self._modules[0].get_input_grads(merge_multi_context)
+        return self._stages[0].module.get_input_grads(merge_multi_context)
 
     def update_metric(self, eval_metric, labels):
         assert self.binded and self.params_initialized
-        for meta, module in zip(self._metas, self._modules):
-            if SequentialModule.META_TAKE_LABELS in meta and \
-                    meta[SequentialModule.META_TAKE_LABELS]:
-                module.update_metric(eval_metric, labels)
+        for stage in self._stages:
+            if stage.take_labels:
+                stage.module.update_metric(eval_metric, labels)
 
     def install_monitor(self, mon):
         assert self.binded
-        for module in self._modules:
-            module.install_monitor(mon)
+        for stage in self._stages:
+            stage.module.install_monitor(mon)

@@ -193,9 +193,9 @@ int fill_string_list(PyObject* list, int* out_size,
 }
 
 // shapes: list of tuples -> scratch (count, ndims[], ptrs[])
-void fill_shape_list(PyObject* shapes, uint32_t* count,
-                     const uint32_t** out_ndim,
-                     const uint32_t*** out_shapes, Scratch* s) {
+int fill_shape_list(PyObject* shapes, uint32_t* count,
+                    const uint32_t** out_ndim,
+                    const uint32_t*** out_shapes, Scratch* s) {
   Py_ssize_t n = PySequence_Size(shapes);
   s->dims.clear();
   s->ndims.clear();
@@ -208,7 +208,13 @@ void fill_shape_list(PyObject* shapes, uint32_t* count,
     for (Py_ssize_t d = 0; d < nd; ++d) {
       PyObject* v = PySequence_GetItem(t, d);
       unsigned long dim = v ? PyLong_AsUnsignedLong(v) : 0;
-      if (PyErr_Occurred()) { PyErr_Clear(); dim = 0; }
+      if (PyErr_Occurred()) {
+        PyErr_Clear();
+        Py_XDECREF(v);
+        Py_XDECREF(t);
+        set_error("shape list: non-integer dimension");
+        return -1;  // silent 0-dims would mis-size caller buffers
+      }
       s->dims.push_back(static_cast<uint32_t>(dim));
       Py_XDECREF(v);
     }
@@ -221,6 +227,7 @@ void fill_shape_list(PyObject* shapes, uint32_t* count,
   *count = static_cast<uint32_t>(n);
   *out_ndim = s->ndims.data();
   *out_shapes = s->shape_ptrs.data();
+  return 0;
 }
 
 #define API_BEGIN()                         \
@@ -317,7 +324,13 @@ int MXFrontNDArrayGetShape(NDArrayHandle h, uint32_t* out_ndim,
   for (Py_ssize_t i = 0; i < n; ++i) {
     PyObject* v = PySequence_GetItem(r, i);
     unsigned long dim = v ? PyLong_AsUnsignedLong(v) : 0;
-    if (PyErr_Occurred()) { PyErr_Clear(); dim = 0; }
+    if (PyErr_Occurred()) {
+      PyErr_Clear();
+      Py_XDECREF(v);
+      Py_DECREF(r);
+      set_error("nd_shape: non-integer dimension");
+      return -1;  // a silent 0-dim would truncate the caller's copy
+    }
     s->dims.push_back(static_cast<uint32_t>(dim));
     Py_XDECREF(v);
   }
@@ -522,13 +535,18 @@ int MXFrontSymbolInferShape(SymbolHandle h, uint32_t num_args,
   Py_DECREF(names);
   Py_DECREF(shapes);
   if (r == nullptr) return -1;
-  fill_shape_list(PyTuple_GetItem(r, 0), arg_count, arg_ndim, arg_shapes,
-                  &g_scratch[0]);
-  fill_shape_list(PyTuple_GetItem(r, 1), out_count, out_ndim, out_shapes,
-                  &g_scratch[1]);
-  fill_shape_list(PyTuple_GetItem(r, 2), aux_count, aux_ndim, aux_shapes,
-                  &g_scratch[2]);
+  int rc = fill_shape_list(PyTuple_GetItem(r, 0), arg_count, arg_ndim,
+                           arg_shapes, &g_scratch[0]);
+  if (rc == 0) {
+    rc = fill_shape_list(PyTuple_GetItem(r, 1), out_count, out_ndim,
+                         out_shapes, &g_scratch[1]);
+  }
+  if (rc == 0) {
+    rc = fill_shape_list(PyTuple_GetItem(r, 2), aux_count, aux_ndim,
+                         aux_shapes, &g_scratch[2]);
+  }
   Py_DECREF(r);
+  if (rc != 0) return -1;
   API_END();
 }
 
