@@ -12,9 +12,16 @@ reference's MXNET_EXEC_BULK_EXEC_TRAIN op bulking) so tunnel dispatch
 latency does not pollute the compute measurement.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "tflops",
-"flops_per_img", "flops_source"}; when the chip's bf16 peak is known
-(detected from device_kind, or BENCH_PEAK_TFLOPS) the line also carries
-{"mfu_pct", "peak_tflops", "peak_source"}.
+"flops_per_img", "flops_source", "value_median", "repeats"}; when the
+chip's bf16 peak is known (detected from device_kind, or
+BENCH_PEAK_TFLOPS) the line also carries {"mfu_pct", "peak_tflops",
+"peak_source"} plus "regime_probe_tflops" — a sustained-matmul
+microprobe run just before timing.  The probe doubles as a regime gate:
+if the shared chip is visibly contended (probe below
+BENCH_REGIME_MIN_FRAC of peak), the bench waits and re-probes a bounded
+number of times before timing, so the recorded number isn't a co-tenant
+lottery.  "value" stays best-of-N (interference-robust); "value_median"
+reports the middle run for honesty about spread.
 
 FLOPs are measured from XLA cost analysis of the COMPILED bulk step (the
 scan body counts once = one training step; 2 flops per MAC — the same
@@ -37,7 +44,13 @@ STEPS = int(os.environ.get("BENCH_STEPS", "20"))
 BULK = max(1, int(os.environ.get("BENCH_BULK", "10")))
 # the tunneled chip is a shared resource with large run-to-run variance;
 # best-of-N timed repetitions is the standard interference-robust estimate
-REPEATS = max(1, int(os.environ.get("BENCH_REPEATS", "5")))
+REPEATS = max(1, int(os.environ.get("BENCH_REPEATS", "7")))
+# chip-regime guard: a sustained-matmul microprobe must reach this
+# fraction of the detected peak before timing starts, else wait and
+# retry (the shared chip swings 2x with co-tenant load); 0 disables
+REGIME_MIN_FRAC = float(os.environ.get("BENCH_REGIME_MIN_FRAC", "0.35"))
+REGIME_TRIES = int(os.environ.get("BENCH_REGIME_TRIES", "4"))
+REGIME_WAIT_S = float(os.environ.get("BENCH_REGIME_WAIT_S", "20"))
 BASELINE_IPS = 45.52  # K80 ResNet-50 train, docs/how_to/perf.md:108-117
 DTYPE = os.environ.get("BENCH_DTYPE", "bfloat16")
 
@@ -79,6 +92,39 @@ def _measure_flops_per_img(mod):
         return float(cost["flops"]) / BATCH, "xla_cost_analysis"
     # ResNet-50 @224: ~4.1 GFLOP forward/img; fwd+bwd ~= 3x forward
     return 12.3e9, "estimate"
+
+
+def _probe_matmul_tflops(device):
+    """Sustained bf16 matmul TFLOP/s right now — the chip-regime probe.
+
+    Eight chained 8192^3 matmuls inside one jit (~9 TFLOP) so the
+    ~40-50ms tunnel dispatch is amortized; best of 3 timed dispatches.
+    Comparing this against the chip's rated peak tells contended
+    co-tenancy apart from a genuinely slow benchmark run.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    n = int(os.environ.get("BENCH_PROBE_N", "8192"))
+    reps = 8
+    x = jax.device_put(jnp.full((n, n), 0.001, jnp.bfloat16), device)
+
+    @jax.jit
+    def chain(a):
+        def body(_, acc):
+            return (acc @ a) * jnp.bfloat16(1e-3)
+
+        return lax.fori_loop(0, reps, body, a)
+
+    chain(x).block_until_ready()  # compile
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.time()
+        chain(x).block_until_ready()
+        best = min(best, time.time() - t0)
+    del x
+    return reps * 2 * n ** 3 / best / 1e12
 
 
 def main():
@@ -140,14 +186,29 @@ def main():
     sync()
 
     flops_per_img, flops_src = _measure_flops_per_img(mod)
-    peak_tflops, peak_src = _detect_peak_tflops(mod._exec._ctx.jax_device())
+    device = mod._exec._ctx.jax_device()
+    peak_tflops, peak_src = _detect_peak_tflops(device)
 
-    best = float("inf")
+    # regime gate: don't time while a co-tenant is hammering the chip.
+    # Probe sustained matmul; below the threshold, wait and re-probe
+    # (bounded), then record whatever regime the timing actually ran in.
+    probe_tflops = None
+    if mx.num_tpus() > 0 and REGIME_MIN_FRAC > 0 and peak_tflops:
+        for attempt in range(REGIME_TRIES):
+            probe_tflops = _probe_matmul_tflops(device)
+            if probe_tflops >= REGIME_MIN_FRAC * peak_tflops:
+                break
+            if attempt < REGIME_TRIES - 1:
+                time.sleep(REGIME_WAIT_S)
+
+    times = []
     for _ in range(REPEATS):
         t0 = time.time()
         run(STEPS)
         sync()
-        best = min(best, time.time() - t0)
+        times.append(time.time() - t0)
+    best = min(times)
+    median = sorted(times)[len(times) // 2]
 
     ips = BATCH * STEPS / best
     tflops = ips * flops_per_img / 1e12
@@ -159,7 +220,11 @@ def main():
         "tflops": round(tflops, 2),
         "flops_per_img": round(flops_per_img / 1e9, 3),
         "flops_source": flops_src,
+        "value_median": round(BATCH * STEPS / median, 2),
+        "repeats": REPEATS,
     }
+    if probe_tflops is not None:
+        row["regime_probe_tflops"] = round(probe_tflops, 1)
     if peak_tflops:
         row["mfu_pct"] = round(100.0 * tflops / peak_tflops, 2)
         row["peak_tflops"] = peak_tflops

@@ -32,9 +32,17 @@ __all__ = ["SequentialModule"]
 
 class _Stage(NamedTuple):
     module: object
-    take_labels: bool
-    auto_wiring: bool
     meta: dict  # all meta kwargs as given (incl. subclass extras)
+
+    # read through to the dict so legacy mutation via seq._metas[i][...]
+    # (a reference-supported pattern) stays effective at bind time
+    @property
+    def take_labels(self):
+        return bool(self.meta.get(SequentialModule.META_TAKE_LABELS, False))
+
+    @property
+    def auto_wiring(self):
+        return bool(self.meta.get(SequentialModule.META_AUTO_WIRING, False))
 
 
 class SequentialModule(BaseModule):
@@ -70,11 +78,7 @@ class SequentialModule(BaseModule):
         if unknown:
             raise ValueError("Unknown meta %s (known: %s)"
                              % (sorted(unknown), sorted(known)))
-        self._stages.append(_Stage(
-            module=module,
-            take_labels=bool(kwargs.get(self.META_TAKE_LABELS, False)),
-            auto_wiring=bool(kwargs.get(self.META_AUTO_WIRING, False)),
-            meta=dict(kwargs)))
+        self._stages.append(_Stage(module=module, meta=dict(kwargs)))
         # any topology change invalidates bind/init state
         self.binded = False
         self.params_initialized = False

@@ -149,19 +149,53 @@ class BaseRNNCell(object):
 
         Returns (outputs, final_states); outputs merged into one symbol
         when ``merge_outputs=True``, else a list of per-step symbols.
+
+        TPU note: gated cells hoist the input-side projection out of the
+        unrolled recurrence — all ``length`` steps' ``x @ W_i2h`` run as
+        ONE ``(T*N, I)`` matmul (MXU-sized) instead of T thin per-step
+        matmuls; only the ``h @ W_h2h`` recurrence stays per-step.  Same
+        weights, same math, same node-name scheme for the recurrent
+        part — just a graph shape the MXU can actually fill (the
+        unfused analog of what ``FusedRNNCell``/``ops/rnn.py`` do
+        inside ``lax.scan``).
         """
         self.reset()
         inputs_list, _ = _normalize_sequence(length, inputs, layout, False)
         if begin_state is None:
             begin_state = self._derived_begin_state(inputs_list[0])
         states = begin_state
+        i2h_seq = self._hoisted_i2h(inputs_list)
         outputs = []
         for i in range(length):
-            output, states = self(inputs_list[i], states)
+            if i2h_seq is None:
+                output, states = self(inputs_list[i], states)
+            else:
+                self._counter += 1
+                name = "%st%d_" % (self._prefix, self._counter)
+                output, states = self._step(i2h_seq[i], states, name)
             outputs.append(output)
         outputs, _ = _normalize_sequence(length, outputs, layout,
                                          merge_outputs)
         return outputs, states
+
+    def _hoisted_i2h(self, inputs_list):
+        """Per-step input projections from one whole-sequence matmul, or
+        None when the cell doesn't support hoisting (then ``unroll``
+        falls back to stepping ``self(...)``)."""
+        return None
+
+    def _i2h_seq(self, inputs_list, num_hidden_total):
+        """Concat T step inputs on the batch axis, project once, slice
+        back into per-step ``(N, G*H)`` blocks.  Callers guard the
+        single-step case (hoisting one step is a no-op)."""
+        cat = symbol.Concat(*inputs_list, dim=0,
+                            name="%si2h_cat" % self._prefix)
+        proj = symbol.FullyConnected(
+            data=cat, weight=self._iW, bias=self._iB,
+            num_hidden=num_hidden_total, name="%si2h_seq" % self._prefix)
+        return list(symbol.SliceChannel(
+            proj, num_outputs=len(inputs_list), axis=0,
+            name="%si2h_split" % self._prefix))
 
     def _get_activation(self, inputs, activation, **kwargs):
         if isinstance(activation, str):
@@ -197,6 +231,14 @@ class RNNCell(BaseRNNCell):
                                     bias=self._iB,
                                     num_hidden=self._num_hidden,
                                     name="%si2h" % name)
+        return self._step(i2h, states, name)
+
+    def _hoisted_i2h(self, inputs_list):
+        if len(inputs_list) < 2:
+            return None
+        return self._i2h_seq(inputs_list, self._num_hidden)
+
+    def _step(self, i2h, states, name):
         h2h = symbol.FullyConnected(data=states[0], weight=self._hW,
                                     bias=self._hB,
                                     num_hidden=self._num_hidden,
@@ -236,6 +278,14 @@ class LSTMCell(BaseRNNCell):
                                     bias=self._iB,
                                     num_hidden=self._num_hidden * 4,
                                     name="%si2h" % name)
+        return self._step(i2h, states, name)
+
+    def _hoisted_i2h(self, inputs_list):
+        if len(inputs_list) < 2:
+            return None
+        return self._i2h_seq(inputs_list, self._num_hidden * 4)
+
+    def _step(self, i2h, states, name):
         h2h = symbol.FullyConnected(data=states[0], weight=self._hW,
                                     bias=self._hB,
                                     num_hidden=self._num_hidden * 4,
@@ -276,11 +326,19 @@ class GRUCell(BaseRNNCell):
     def __call__(self, inputs, states):
         self._counter += 1
         name = "%st%d_" % (self._prefix, self._counter)
-        prev_h = states[0]
         i2h = symbol.FullyConnected(data=inputs, weight=self._iW,
                                     bias=self._iB,
                                     num_hidden=self._num_hidden * 3,
                                     name="%si2h" % name)
+        return self._step(i2h, states, name)
+
+    def _hoisted_i2h(self, inputs_list):
+        if len(inputs_list) < 2:
+            return None
+        return self._i2h_seq(inputs_list, self._num_hidden * 3)
+
+    def _step(self, i2h, states, name):
+        prev_h = states[0]
         h2h = symbol.FullyConnected(data=prev_h, weight=self._hW,
                                     bias=self._hB,
                                     num_hidden=self._num_hidden * 3,
