@@ -277,7 +277,45 @@ def io_score(num_images=4096, batch=128):
     row("io_jpeg_decode_floor_1core", len(bufs) / (time.time() - tic),
         "images/sec")
 
-    for threads in (1, 4, 8):
+    # full-work floor: the native batch call alone with the SAME augment
+    # plan the pipeline rows run (decode + resize + random crop + random
+    # mirror + fused f32-NCHW normalize, one C call/batch) — the
+    # pipeline rows below should sit within a few % of THIS row; the
+    # decode-only floor above excludes augment work the pipeline must do
+    from mxnet_tpu.native import get_imgdecode_lib, imgdecode_batch
+
+    lib = get_imgdecode_lib()
+    if lib is not None:
+        import random as pyrandom
+
+        h = w_ = 224
+        out = np.empty((batch, 3, h, w_), np.float32)
+
+        def native_floor_pass():
+            for s in range(0, len(bufs), batch):
+                chunk = bufs[s:s + batch]
+                nb = len(chunk)
+                imgdecode_batch(
+                    lib, chunk, out[:nb], 256,
+                    [pyrandom.random() for _ in range(nb)],
+                    [pyrandom.random() for _ in range(nb)],
+                    [1 if pyrandom.random() < 0.5 else 0
+                     for _ in range(nb)],
+                    h, w_, norm=((0, 0, 0), (1, 1, 1), 1.0), nthreads=1)
+
+        best = float("inf")
+        for _ in range(2):  # best-of-2: the shared host jitters ±20%
+            tic = time.time()
+            native_floor_pass()
+            best = min(best, time.time() - tic)
+        row("io_native_aug_floor_1core", len(bufs) / best, "images/sec")
+
+    # thread-count rows are measured INTERLEAVED (t1,t4,t8,t1,t4,t8...)
+    # so shared-host load drift hits every count equally instead of
+    # whichever row ran last
+    counts = (1, 4, 8)
+    iters = {}
+    for threads in counts:
         it = mxio.ImageRecordIter(
             path_imgrec=rec_path, data_shape=(3, 224, 224),
             batch_size=batch, rand_crop=True, rand_mirror=True,
@@ -285,15 +323,23 @@ def io_score(num_images=4096, batch=128):
         # warm one epoch (thread pool spin-up, page cache)
         for b in it:
             b.data[0].wait_to_read()
-        it.reset()
-        tic = time.time()
-        seen = 0
-        for b in it:
-            b.data[0].wait_to_read()
-            seen += batch - b.pad
-        dt = time.time() - tic
-        row("io_imagerecord_jpeg224_t%d" % threads, seen / dt,
-            "images/sec")
+        iters[threads] = it
+    best = {t: float("inf") for t in counts}
+    seen = {t: 0 for t in counts}
+    for _ in range(3):
+        for threads in counts:
+            it = iters[threads]
+            it.reset()
+            tic = time.time()
+            n = 0
+            for b in it:
+                b.data[0].wait_to_read()
+                n += batch - b.pad
+            best[threads] = min(best[threads], time.time() - tic)
+            seen[threads] = n
+    for threads in counts:
+        row("io_imagerecord_jpeg224_t%d" % threads,
+            seen[threads] / best[threads], "images/sec")
 
     import shutil
 

@@ -765,8 +765,9 @@ class Executor:
                 raise MXNetError("forward: unknown input %r" % k)
             dst = self.arg_dict[k]
             if isinstance(v, NDArray):
-                val = v._jx.astype(dst._jx.dtype) \
-                    if v._jx.dtype != dst._jx.dtype else v._jx
+                src = v._transfer_src()
+                val = src.astype(dst._jx.dtype) \
+                    if src.dtype != dst._jx.dtype else src
                 # inputs may live on another device (reference CopyFromTo
                 # semantics): move to the executor's device; same-device
                 # put is free

@@ -417,7 +417,8 @@ class Module(BaseModule):
                 # local batch shard -> global batch-sharded array
                 from .. import dist as _dist
 
-                loc = np.asarray(src._jx if isinstance(src, NDArray)
+                loc = np.asarray(src._transfer_src()
+                                 if isinstance(src, NDArray)
                                  else src, dtype=dst.dtype)
                 nproc = _dist.num_processes()
                 if (loc.shape[0] * nproc,) + loc.shape[1:] != dst.shape:
@@ -427,7 +428,9 @@ class Module(BaseModule):
                         % (name, loc.shape, dst.shape, nproc))
                 dst._jx = _dist.shard_batch(self._mesh, loc)
                 continue
-            jx = src._jx if isinstance(src, NDArray) else None
+            # _transfer_src: host-backed iterator batches hand over their
+            # raw numpy buffer — device_put below is then the ONE copy
+            jx = src._transfer_src() if isinstance(src, NDArray) else None
             if jx is None:
                 dst[:] = src
                 continue
@@ -647,11 +650,16 @@ class Module(BaseModule):
 
         def stack(n):
             kind, i = name_pos[n]
+            dtype = ex.arg_dict[n]._jx.dtype
             vals = []
             for b in batches:
                 v = (b.data if kind == "data" else b.label)[i]
-                jx = v._jx if isinstance(v, NDArray) else jnp.asarray(v)
-                vals.append(jx.astype(ex.arg_dict[n]._jx.dtype))
+                raw = v._transfer_src() if isinstance(v, NDArray) \
+                    else jnp.asarray(v)
+                vals.append(raw.astype(dtype))
+            if all(isinstance(v, np.ndarray) for v in vals):
+                # host-backed batches: stack on host, ship once
+                return jax.device_put(np.stack(vals), dev)
             return jax.device_put(jnp.stack(vals), dev)
 
         # benchmark loops re-submit the same device-resident batches every

@@ -324,3 +324,36 @@ def get_imgdecode_lib():
         ]
         _img_lib = lib
         return _img_lib
+
+
+def imgdecode_batch(lib, raw_bufs, out, resize_shorter, crop_fx, crop_fy,
+                    mirror, out_h, out_w, norm=None, nthreads=1):
+    """The one marshalling site for ``MXIMGBatchDecode``.
+
+    ``raw_bufs``: list of JPEG byte strings; ``out``: preallocated numpy
+    array — uint8 (N,H,W,3) or, with ``norm=(mean3, std3, scale)``,
+    float32 (N,3,H,W) filled normalized; ``crop_fx/crop_fy``: per-image
+    crop anchors in [0,1] or -1 for center; ``mirror``: per-image 0/1.
+    Returns the number of images that failed to decode.
+    """
+    import ctypes as ct
+
+    n = len(raw_bufs)
+    bufs = (ct.c_void_p * n)(*[
+        ct.cast(ct.c_char_p(b), ct.c_void_p) for b in raw_bufs])
+    lens = (ct.c_int64 * n)(*[len(b) for b in raw_bufs])
+    fx = (ct.c_float * n)(*crop_fx)
+    fy = (ct.c_float * n)(*crop_fy)
+    mir = (ct.c_ubyte * n)(*mirror)
+    if norm is not None:
+        mean3, std3, scale = norm
+        mean_p = (ct.c_float * 3)(*mean3)
+        std_p = (ct.c_float * 3)(*std3)
+        f32 = 1
+    else:
+        mean_p = std_p = None
+        scale, f32 = 1.0, 0
+    return lib.MXIMGBatchDecode(
+        bufs, lens, n, resize_shorter, fx, fy, mir, out_h, out_w,
+        out.ctypes.data_as(ct.c_void_p), f32, mean_p, std_p,
+        ct.c_float(scale), nthreads)
