@@ -127,7 +127,15 @@ def _probe_matmul_tflops(device):
     return reps * 2 * n ** 3 / best / 1e12
 
 
-def main():
+def setup():
+    """Build the benchmarked Module + synthetic batches.
+
+    Returns ``(mod, run, sync)`` where ``run(nsteps)`` dispatches that
+    many full training steps in BULK-sized scan bulks and ``sync()`` is
+    a cheap true device barrier.  Shared by ``bench.py`` itself and
+    ``tools/perf/step_profile.py`` so the profiled step is EXACTLY the
+    benchmarked step.
+    """
     # fwd+bwd+update as ONE XLA dispatch with donated param buffers
     os.environ.setdefault("MXNET_FUSE_TRAIN_STEP", "1")
     # honor an explicit CPU request even under the axon sitecustomize,
@@ -181,6 +189,16 @@ def main():
         # transitively depends on every prior step
         return np.asarray(
             mod._exec.arg_dict["conv0_weight"]._jx.reshape(-1)[:1])
+
+    return mod, run, sync
+
+
+def main():
+    import numpy as np  # noqa: F401  (env guards run inside setup)
+
+    import mxnet_tpu as mx
+
+    mod, run, sync = setup()
 
     run(WARMUP * BULK)
     sync()

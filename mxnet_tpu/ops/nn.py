@@ -323,11 +323,20 @@ def _batch_norm(attrs, inputs, aux, is_train, rng, act_type=None):
         new_var = moving_var * m + jax.lax.stop_gradient(var) * (1 - m)
         return [out], [new_mean, new_var]
     if use_batch:
-        # compute stats in f32 even for bf16 activations (TPU numerics).
-        # E[x], E[x^2] in ONE fused pass over x (jnp.var would re-read x a
-        # second time — BN reductions are the bandwidth hot spot of a conv
-        # net step on TPU)
-        xf = x.astype(jnp.float32)
+        # Stats ACCUMULATE in f32 always; what varies is the dtype of the
+        # elementwise read pass.  For bf16 activations the read stays
+        # bf16 (opt out: MXNET_BN_STATS_F32=1): materializing x.astype
+        # (f32) made XLA emit a second full-size f32 copy of every conv
+        # output as a fusion epilogue (+wider reduce reads) — measured
+        # ~4 ms/step of pure bandwidth on ResNet-50 b128 (per-HLO
+        # profile, tools/perf/step_profile.py).  The probe-shift below
+        # bounds the bf16 rounding of d to ~2^-8 relative of the
+        # *deviation*, and round-to-nearest is unbiased, so the
+        # batch-mean/var error vanishes as 1/sqrt(N) — validated by the
+        # bf16 convergence-parity harness.
+        keep_bf16 = (x.dtype == jnp.bfloat16
+                     and _os.environ.get("MXNET_BN_STATS_F32", "0") != "1")
+        xf = x if keep_bf16 else x.astype(jnp.float32)
         # shifted single-pass variance: center on a per-channel probe
         # (first element, gradient-stopped — the shifts cancel exactly in
         # mean and var) so E[d^2]-E[d]^2 cancels catastrophically only
@@ -335,10 +344,13 @@ def _batch_norm(attrs, inputs, aux, is_train, rng, act_type=None):
         probe = jax.lax.stop_gradient(
             xf[(0, slice(None)) + (0,) * (x.ndim - 2)])
         d = xf - probe.reshape(bshape)
-        mean_d = jnp.mean(d, axis=red)
-        var = jnp.maximum(
-            jnp.mean(jnp.square(d), axis=red) - jnp.square(mean_d), 0.0)
-        mean = mean_d + probe
+        cnt = 1
+        for ax in red:
+            cnt *= x.shape[ax]
+        mean_d = jnp.sum(d, axis=red, dtype=jnp.float32) / cnt
+        sq = jnp.sum(jnp.square(d.astype(jnp.float32)), axis=red) / cnt
+        var = jnp.maximum(sq - jnp.square(mean_d), 0.0)
+        mean = mean_d + probe.astype(jnp.float32)
     else:
         mean, var = moving_mean, moving_var
     g = jnp.ones_like(gamma) if attrs["fix_gamma"] else gamma

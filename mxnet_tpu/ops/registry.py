@@ -242,7 +242,8 @@ def list_ops():
 # Pallas mode): every trace cache keys on this fingerprint, otherwise a
 # mid-process toggle is silently ignored by the cached jit
 _TRACE_ENV_VARS = ("MXNET_BN_PALLAS", "MXNET_BN_ABLATION",
-                   "MXNET_CONV_GRAD_BARRIER", "MXNET_BACKWARD_DO_MIRROR")
+                   "MXNET_BN_STATS_F32", "MXNET_CONV_GRAD_BARRIER",
+                   "MXNET_BACKWARD_DO_MIRROR")
 
 
 def trace_env_fingerprint():

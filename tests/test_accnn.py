@@ -16,6 +16,7 @@ from tools.accnn.rank_selection import get_ranksel  # noqa: E402
 
 
 def _toy_model(tmp_path, seed=0):
+    mx.random.seed(seed)
     data = mx.sym.Variable("data")
     c = mx.sym.Convolution(data, num_filter=8, kernel=(3, 3), pad=(1, 1),
                            name="conv1")
