@@ -11,6 +11,7 @@
 #include <math.h>
 #include <stdio.h>
 #include <stdlib.h>
+#include <string.h>
 
 #include <mxnet_tpu/c_frontend_api.h>
 
@@ -167,6 +168,28 @@ int main(void) {
     fprintf(stderr, "FAILED: accuracy below threshold\n");
     return 1;
   }
+
+  /* ---- RecordIO from pure C: log the run as records, read back ---- */
+  {
+    RecordIOHandle w, r;
+    char line[64];
+    const char* buf;
+    uint64_t size;
+    CK(MXFrontRecordIOWriterCreate("/tmp/c_train_log.rec", &w));
+    snprintf(line, sizeof(line), "accuracy=%.3f", acc);
+    CK(MXFrontRecordIOWriterWriteRecord(w, line, strlen(line)));
+    CK(MXFrontRecordIOWriterWriteRecord(w, "done", 4));
+    CK(MXFrontRecordIOWriterFree(w));
+    CK(MXFrontRecordIOReaderCreate("/tmp/c_train_log.rec", &r));
+    CK(MXFrontRecordIOReaderReadRecord(r, &buf, &size));
+    if (size == 0 || strncmp(buf, "accuracy=", 9) != 0) {
+      fprintf(stderr, "FAILED: recordio roundtrip\n");
+      return 1;
+    }
+    printf("recordio: %.*s\n", (int)size, buf);
+    CK(MXFrontRecordIOReaderFree(r));
+  }
+
   printf("C TRAIN OK\n");
   return 0;
 }

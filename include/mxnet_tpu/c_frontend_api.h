@@ -40,6 +40,7 @@ typedef void* ExecutorHandle;
 typedef void* KVStoreHandle;
 typedef void* DataIterHandle;
 typedef void* OptimizerHandle;
+typedef void* RecordIOHandle;
 
 /* ---- runtime ---------------------------------------------------------- */
 /*! \brief thread-local message for the last failed call. */
@@ -50,6 +51,24 @@ int MXFrontRandomSeed(int seed);
 int MXFrontNotifyShutdown(void);
 /*! \brief number of registered operators; names via MXFrontListOps. */
 int MXFrontListOps(int* out_size, const char*** out_names);
+/*! \brief framework version as major*10000+minor*100+patch
+ *  (reference MXGetVersion). */
+int MXFrontGetVersion(int* out);
+/*! \brief device count for dev_type (1=cpu, 2/4=accelerator/tpu) —
+ *  the reference MXGetGPUCount analog. */
+int MXFrontGetDeviceCount(int dev_type, int* out);
+/*! \brief names of the registered data iterators (reference
+ *  MXListDataIters; creation stays name-based via MXFrontDataIterCreate). */
+int MXFrontListDataIters(int* out_size, const char*** out_names);
+
+/* ---- profiler (reference MXSetProfilerConfig/State, MXDumpProfile) ---- */
+/*! \brief mode 0 = symbolic-only, 1 = all ops; filename receives the
+ *  chrome://tracing JSON on dump. */
+int MXFrontSetProfilerConfig(int mode, const char* filename);
+/*! \brief state 1 = run, 0 = stop (stop also flushes to the file). */
+int MXFrontSetProfilerState(int state);
+/*! \brief write collected spans to the configured file now. */
+int MXFrontDumpProfile(void);
 
 /* ---- NDArray ---------------------------------------------------------- */
 int MXFrontNDArrayCreate(const uint32_t* shape, uint32_t ndim,
@@ -82,6 +101,17 @@ int MXFrontImperativeInvoke(const char* op_name, int num_inputs,
                             int* num_outputs, NDArrayHandle* outputs);
 /*! \brief block until all pending async work completes. */
 int MXFrontNDArrayWaitAll(void);
+/*! \brief zero-copy-semantics views (reference MXNDArraySlice/At/
+ *  Reshape): the result is a NEW handle sharing storage semantics with
+ *  the source (functional backend: value snapshot at call time). */
+int MXFrontNDArraySlice(NDArrayHandle h, uint32_t begin, uint32_t end,
+                        NDArrayHandle* out);
+int MXFrontNDArrayAt(NDArrayHandle h, uint32_t idx, NDArrayHandle* out);
+int MXFrontNDArrayReshape(NDArrayHandle h, int ndim, const int* dims,
+                          NDArrayHandle* out);
+/*! \brief device of the array (dev_type codes as in Create). */
+int MXFrontNDArrayGetContext(NDArrayHandle h, int* out_dev_type,
+                             int* out_dev_id);
 
 /* ---- Symbol ----------------------------------------------------------- */
 int MXFrontSymbolCreateVariable(const char* name, SymbolHandle* out);
@@ -103,6 +133,49 @@ int MXFrontSymbolListOutputs(SymbolHandle h, int* out_size,
                              const char*** out_names);
 int MXFrontSymbolSaveToJSON(SymbolHandle h, const char** out_json);
 int MXFrontSymbolCreateFromJSON(const char* json, SymbolHandle* out);
+/*! \brief deep copy (reference MXSymbolCopy). */
+int MXFrontSymbolCopy(SymbolHandle h, SymbolHandle* out);
+/*! \brief human-readable graph description (reference MXSymbolPrint). */
+int MXFrontSymbolPrint(SymbolHandle h, const char** out_str);
+/*! \brief node attribute access (reference MXSymbolGetAttr/SetAttr/
+ *  ListAttr).  GetAttr: *out_success = 0 and *out = "" when unset. */
+int MXFrontSymbolGetAttr(SymbolHandle h, const char* key,
+                         const char** out, int* out_success);
+int MXFrontSymbolSetAttr(SymbolHandle h, const char* key,
+                         const char* value);
+/*! \brief flat "key" or recursive "node$key" pairs; out_pairs holds
+ *  2*out_size strings (key, value, key, value, ...). */
+int MXFrontSymbolListAttr(SymbolHandle h, int recursive, int* out_size,
+                          const char*** out_pairs);
+/*! \brief symbol whose outputs are EVERY internal node output
+ *  (reference MXSymbolGetInternals — the monitor/feature-extraction
+ *  primitive). */
+int MXFrontSymbolGetInternals(SymbolHandle h, SymbolHandle* out);
+/*! \brief select one output of a multi-output symbol. */
+int MXFrontSymbolGetOutput(SymbolHandle h, uint32_t index,
+                           SymbolHandle* out);
+/*! \brief compose IN PLACE: bind variable inputs of \p h to other
+ *  symbols — by name when \p keys is non-NULL, else positionally over
+ *  the symbol's arguments (reference MXSymbolCompose;
+ *  MXFrontSymbolCreateOp already covers the common create+compose
+ *  path — this is for rewiring a loaded graph). */
+int MXFrontSymbolCompose(SymbolHandle h, const char* name,
+                         uint32_t num_args, const char** keys,
+                         SymbolHandle* args);
+/*! \brief InferShape that tolerates unknowable shapes (reference
+ *  MXSymbolInferShapePartial): unknown entries come back with ndim 0.
+ *  Same CSR convention and scratch lifetime as MXFrontSymbolInferShape
+ *  (dtype inference is joint with shapes on this backend — reference
+ *  MXSymbolInferType has no standalone analog; bind infers both). */
+int MXFrontSymbolInferShapePartial(
+    SymbolHandle h, uint32_t num_args, const char** keys,
+    const uint32_t* indptr, const uint32_t* shape_data,
+    uint32_t* arg_count, const uint32_t** arg_ndim,
+    const uint32_t*** arg_shapes,
+    uint32_t* out_count, const uint32_t** out_ndim,
+    const uint32_t*** out_shapes,
+    uint32_t* aux_count, const uint32_t** aux_ndim,
+    const uint32_t*** aux_shapes);
 /*! \brief shape inference: provided arg shapes as a CSR triple keyed by
  *  name; outputs are three shape lists (args / outputs / aux) in the
  *  order of the corresponding List* call. */
@@ -141,6 +214,65 @@ int MXFrontExecutorGetGrad(ExecutorHandle h, const char* name,
                            NDArrayHandle* out);
 int MXFrontExecutorGetAux(ExecutorHandle h, const char* name,
                           NDArrayHandle* out);
+/*! \brief human-readable execution plan (reference MXExecutorPrint). */
+int MXFrontExecutorPrint(ExecutorHandle h, const char** out_str);
+/*! \brief install a per-output monitor fired during Forward (reference
+ *  MXExecutorSetMonitorCallback): cb(name, array, cb_data) for every
+ *  executor output; the NDArrayHandle passed to the callback is owned
+ *  by the runtime and valid only inside the callback (copy out via
+ *  SyncCopyToCPU).  cb == NULL uninstalls. */
+typedef void (*MXFrontMonitorCallback)(const char* name,
+                                       NDArrayHandle array, void* cb_data);
+int MXFrontExecutorSetMonitorCallback(ExecutorHandle h,
+                                      MXFrontMonitorCallback cb,
+                                      void* cb_data);
+
+/* ---- custom operators from C (reference MXCustomOpRegister) ----------- */
+/*! \brief shape inference for a C custom op: fill out_shape (capacity
+ *  *out_ndim elements) and set *out_ndim to the output rank.  Return 0
+ *  on success. */
+typedef int (*MXFrontCustomOpInferShapeFn)(
+    uint32_t num_inputs, const uint32_t* in_ndims,
+    const uint32_t** in_shapes, uint32_t* out_ndim, uint32_t* out_shape,
+    void* user_data);
+/*! \brief forward: float32 host buffers, sizes in elements. */
+typedef int (*MXFrontCustomOpForwardFn)(
+    uint32_t num_inputs, const float** in_data, const uint64_t* in_sizes,
+    float* out_data, uint64_t out_size, void* user_data);
+/*! \brief backward: fill in_grads[i] (same sizes as the inputs) from
+ *  the inputs and the output cotangent.  NULL for inference-only ops
+ *  (gradient through the op is then an error at trace time). */
+typedef int (*MXFrontCustomOpBackwardFn)(
+    uint32_t num_inputs, const float** in_data, const float* out_grad,
+    float** in_grads, const uint64_t* in_sizes, uint64_t out_size,
+    void* user_data);
+/*! \brief register \p op_type as a single-output operator runnable from
+ *  every frontend (imperative invoke, symbols, executors).  The
+ *  callbacks run on the HOST inside the traced graph (the TPU analog of
+ *  the reference's CPU custom-op path: the compiled step calls back to
+ *  host for this op, like NumpyOp/CustomOp do from Python). */
+int MXFrontCustomOpRegister(const char* op_type, uint32_t num_inputs,
+                            MXFrontCustomOpInferShapeFn infer_shape,
+                            MXFrontCustomOpForwardFn forward,
+                            MXFrontCustomOpBackwardFn backward,
+                            void* user_data);
+
+/* ---- RecordIO (reference MXRecordIOWriter / MXRecordIOReader ABI) ----- */
+int MXFrontRecordIOWriterCreate(const char* uri, RecordIOHandle* out);
+int MXFrontRecordIOWriterFree(RecordIOHandle h);
+int MXFrontRecordIOWriterWriteRecord(RecordIOHandle h, const char* buf,
+                                     uint64_t size);
+/*! \brief byte position of the write head (feeds .idx files). */
+int MXFrontRecordIOWriterTell(RecordIOHandle h, uint64_t* out_pos);
+int MXFrontRecordIOReaderCreate(const char* uri, RecordIOHandle* out);
+int MXFrontRecordIOReaderFree(RecordIOHandle h);
+/*! \brief next record into thread-local scratch; *out_size = 0 and
+ *  *out_buf = NULL at end of file. */
+int MXFrontRecordIOReaderReadRecord(RecordIOHandle h,
+                                    const char** out_buf,
+                                    uint64_t* out_size);
+/*! \brief seek the read head to a byte position from WriterTell. */
+int MXFrontRecordIOReaderSeek(RecordIOHandle h, uint64_t pos);
 
 /* ---- Optimizer (registry-backed; reference cpp-package reimplements
  * these in C++ — here the one registry serves every frontend) ----------- */

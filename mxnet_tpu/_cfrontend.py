@@ -118,16 +118,155 @@ def list_ops():
     return list(_lo())
 
 
+def get_version():
+    from . import __version__ as v
+
+    parts = (v.split(".") + ["0", "0"])[:3]
+    nums = [int("".join(ch for ch in p if ch.isdigit()) or 0)
+            for p in parts]
+    return nums[0] * 10000 + nums[1] * 100 + nums[2]
+
+
+def get_device_count(dev_type):
+    if dev_type in (1, 3):
+        import os
+
+        return os.cpu_count() or 1
+    from .context import num_tpus
+
+    return num_tpus()
+
+
+def list_data_iters():
+    return [n for n in ("NDArrayIter", "CSVIter", "ImageRecordIter",
+                        "ImageIter", "MNISTIter", "LibSVMIter",
+                        "PrefetchingIter", "ResizeIter")
+            if hasattr(mxio, n)]
+
+
+# ---- profiler -------------------------------------------------------------
+
+def profiler_set_config(mode, filename):
+    from . import profiler
+
+    profiler.profiler_set_config(mode="all" if mode else "symbolic",
+                                 filename=filename)
+
+
+def profiler_set_state(state):
+    from . import profiler
+
+    profiler.profiler_set_state("run" if state else "stop")
+
+
+def profiler_dump():
+    from . import profiler
+
+    profiler.dump_profile()
+
+
 def random_seed(seed):
     from . import random as _random
 
     _random.seed(seed)
 
 
+def nd_slice(a, begin, end):
+    return a[begin:end]
+
+
+def nd_at(a, idx):
+    return a[idx]
+
+
+def nd_reshape(a, dims):
+    return a.reshape(tuple(dims))
+
+
+def nd_context(a):
+    ctx = a.context
+    return (1 if ctx.device_type == "cpu" else 4), int(ctx.device_id)
+
+
 # ---- Symbol ---------------------------------------------------------------
 
 def sym_var(name):
     return sym.Variable(name)
+
+
+def sym_copy(s):
+    """Deep graph clone (reference MXSymbolCopy): fresh nodes, shared
+    OpDefs — so composing/attr-editing the copy cannot mutate graphs the
+    original (or an executor bound to it) still references."""
+    from .symbol import Symbol, _Node
+
+    memo = {}
+    for node in s._nodes():  # post-order: inputs are cloned before users
+        memo[id(node)] = _Node(
+            node.op, node.name, dict(node.attrs),
+            [(memo[id(c)], ci) for c, ci in node.inputs],
+            dict(node.misc_attr))
+    return Symbol([(memo[id(n)], i) for n, i in s._outputs])
+
+
+def sym_print(s):
+    return s.debug_str() if hasattr(s, "debug_str") else repr(s)
+
+
+def sym_get_attr(s, key):
+    v = s.attr(key)
+    return ("", 0) if v is None else (str(v), 1)
+
+
+def sym_set_attr(s, key, value):
+    s._set_attr(**{key: value})
+
+
+def sym_list_attr(s, recursive):
+    d = s.attr_dict() if recursive else (s.list_attr() or {})
+    pairs = []
+    if recursive:
+        for node, attrs in sorted(d.items()):
+            for k, v in sorted(attrs.items()):
+                pairs += ["%s$%s" % (node, k), str(v)]
+    else:
+        for k, v in sorted(d.items()):
+            pairs += [str(k), str(v)]
+    return pairs
+
+
+def sym_get_internals(s):
+    return s.get_internals()
+
+
+def sym_get_output(s, index):
+    return s[int(index)]
+
+
+def sym_compose(s, name, keys, args):
+    """In-place compose (reference MXSymbolCompose): rewire variable
+    inputs of every node in ``s`` to the given symbols' heads."""
+    if keys is None:
+        keys = s.list_arguments()[:len(args)]
+    mapping = {}
+    for k, a in zip(keys, args):
+        mapping[k] = a._entry()
+    for node in s._nodes():
+        node.inputs = [
+            mapping[child.name] if child.is_variable
+            and child.name in mapping else (child, ci)
+            for child, ci in node.inputs]
+    if name:
+        head, _ = s._entry()
+        head.name = name
+    return None
+
+
+def sym_infer_shape_partial(s, names, shapes):
+    args, outs, auxs = s.infer_shape_partial(**dict(zip(names, shapes)))
+    fix = lambda ls: [tuple(int(d) for d in t) if t is not None else ()
+                      for t in (ls or [])]
+    return fix(args), fix(outs), fix(auxs)
 
 
 def sym_op(op_name, name, pkeys, pvals, ikeys, inputs):
@@ -189,6 +328,189 @@ def exec_outputs(ex):
 def exec_get(ex, which, name):
     d = (ex.arg_dict, ex.grad_dict, ex.aux_dict)[which]
     return d.get(name)
+
+
+def exec_print(ex):
+    lines = ["Executor (ctx=%s)" % (ex._ctx,)]
+    for title, d in (("args", ex.arg_dict), ("aux", ex.aux_dict)):
+        for n, a in d.items():
+            lines.append("  %s %s: %s %s" % (title, n,
+                                             tuple(a.shape), a.dtype))
+    for i, o in enumerate(ex.outputs or []):
+        lines.append("  output[%d]: %s %s" % (i, tuple(o.shape), o.dtype))
+    return "\n".join(lines)
+
+
+def exec_set_monitor(ex, cb_addr, data_addr):
+    """Install a C monitor callback (MXFrontExecutorSetMonitorCallback):
+    trampoline the (name, NDArrayHandle, user_data) C signature through
+    ctypes.  ``id(arr)`` IS the PyObject* the C side treats as a handle;
+    the array is kept referenced for the duration of the call."""
+    if not cb_addr:
+        ex.set_monitor_callback(None)
+        return
+    cfn = ctypes.CFUNCTYPE(None, ctypes.c_char_p, ctypes.c_void_p,
+                           ctypes.c_void_p)(cb_addr)
+    user = ctypes.c_void_p(data_addr)
+
+    def monitor(name, arr):
+        cfn(str(name).encode(), ctypes.c_void_p(id(arr)), user)
+
+    ex.set_monitor_callback(monitor)
+
+
+# ---- custom ops from C ----------------------------------------------------
+
+_custom_keepalive = []  # registered trampolines live for the process
+
+
+def custom_op_register(op_type, num_inputs, infer_addr, fwd_addr,
+                       bwd_addr, user_addr):
+    """Register a C-authored operator (MXFrontCustomOpRegister).
+
+    The reference's ``MXCustomOpRegister`` hands C function pointers to
+    its engine (``src/operator/custom/custom.cc:183``); here the
+    pointers are wrapped with ctypes and staged into the traced graph
+    with ``jax.pure_callback`` exactly like Python ``CustomOp``s
+    (``ops/custom.py``) — so a C custom op works from imperative
+    invoke, symbols, executors, and under jit.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from .ops.registry import register as _register
+
+    u32p = ctypes.POINTER(ctypes.c_uint32)
+    f32p = ctypes.POINTER(ctypes.c_float)
+    INFER = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_uint32, u32p,
+                             ctypes.POINTER(u32p), u32p, u32p,
+                             ctypes.c_void_p)
+    FWD = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_uint32,
+                           ctypes.POINTER(f32p), ctypes.POINTER(ctypes.c_uint64),
+                           f32p, ctypes.c_uint64, ctypes.c_void_p)
+    BWD = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_uint32,
+                           ctypes.POINTER(f32p), f32p,
+                           ctypes.POINTER(f32p),
+                           ctypes.POINTER(ctypes.c_uint64),
+                           ctypes.c_uint64, ctypes.c_void_p)
+    infer = INFER(infer_addr)
+    fwd = FWD(fwd_addr)
+    bwd = BWD(bwd_addr) if bwd_addr else None
+    user = ctypes.c_void_p(user_addr)
+    _custom_keepalive.append((infer, fwd, bwd))
+    n = int(num_inputs)
+
+    def _out_shape(in_shapes):
+        nds = (ctypes.c_uint32 * n)(*[len(s) for s in in_shapes])
+        bufs = [(ctypes.c_uint32 * max(len(s), 1))(*s) for s in in_shapes]
+        ptrs = (u32p * n)(*[ctypes.cast(b, u32p) for b in bufs])
+        cap = 16
+        out = (ctypes.c_uint32 * cap)()
+        ndim = ctypes.c_uint32(cap)
+        if infer(n, nds, ptrs, ctypes.byref(ndim), out, user) != 0:
+            raise RuntimeError("%s: infer_shape callback failed" % op_type)
+        return tuple(int(out[i]) for i in range(ndim.value))
+
+    def _in_ptrs(arrs):
+        ptrs = (f32p * n)(*[a.ctypes.data_as(f32p) for a in arrs])
+        sizes = (ctypes.c_uint64 * n)(*[a.size for a in arrs])
+        return ptrs, sizes
+
+    def _fwd_host(oshape, *arrs):
+        # oshape was fixed at trace time (_call_fwd); re-running the C
+        # infer_shape callback here would add a per-step ctypes round
+        # trip and could disagree with the traced result type
+        arrs = [np.ascontiguousarray(np.asarray(a, np.float32))
+                for a in arrs]
+        outb = np.zeros(oshape, np.float32)
+        ptrs, sizes = _in_ptrs(arrs)
+        if fwd(n, ptrs, sizes, outb.ctypes.data_as(f32p), outb.size,
+               user) != 0:
+            raise RuntimeError("%s: forward callback failed" % op_type)
+        return outb
+
+    def _bwd_host(og, *arrs):
+        arrs = [np.ascontiguousarray(np.asarray(a, np.float32))
+                for a in arrs]
+        og = np.ascontiguousarray(np.asarray(og, np.float32))
+        grads = [np.zeros(a.shape, np.float32) for a in arrs]
+        ptrs, sizes = _in_ptrs(arrs)
+        gptrs = (f32p * n)(*[g.ctypes.data_as(f32p) for g in grads])
+        if bwd(n, ptrs, og.ctypes.data_as(f32p), gptrs, sizes, og.size,
+               user) != 0:
+            raise RuntimeError("%s: backward callback failed" % op_type)
+        return tuple(grads)
+
+    def _call_fwd(xs):
+        import functools
+
+        oshape = _out_shape([tuple(map(int, x.shape)) for x in xs])
+        res = jax.ShapeDtypeStruct(oshape, np.float32)
+        return jax.pure_callback(functools.partial(_fwd_host, oshape),
+                                 res, *[x.astype(jnp.float32) for x in xs])
+
+    @jax.custom_vjp
+    def op_fn(*xs):
+        return _call_fwd(xs)
+
+    def op_fwd(*xs):
+        return _call_fwd(xs), xs
+
+    if bwd is not None:
+        def op_bwd(xs, og):
+            res = tuple(jax.ShapeDtypeStruct(tuple(map(int, x.shape)),
+                                             np.float32) for x in xs)
+            gs = jax.pure_callback(
+                _bwd_host, res, og.astype(jnp.float32),
+                *[x.astype(jnp.float32) for x in xs])
+            return tuple(g.astype(x.dtype) for g, x in zip(gs, xs))
+    else:
+        def op_bwd(xs, og):
+            # header contract (c_frontend_api.h): gradient through a
+            # backward-less C op is a TRACE-TIME error, not silent zeros
+            raise RuntimeError(
+                "%s: registered without a backward callback; gradient "
+                "through it is undefined (MXFrontCustomOpRegister)"
+                % op_type)
+
+    op_fn.defvjp(op_fwd, op_bwd)
+
+    def apply_fn(attrs, inputs, aux, is_train, rng):
+        return [op_fn(*inputs)], None
+
+    _register(op_type, apply_fn,
+              arguments=tuple("data%d" % i for i in range(n)),
+              hint=op_type.lower())
+
+
+# ---- RecordIO -------------------------------------------------------------
+
+def recio_open(uri, flag):
+    from .recordio import MXRecordIO
+
+    return MXRecordIO(uri, flag)
+
+
+def recio_close(r):
+    r.close()
+
+
+def recio_write(r, addr, size):
+    buf = ctypes.string_at(ctypes.c_void_p(addr), size)
+    r.write(buf)
+
+
+def recio_tell(r):
+    return int(r.tell())
+
+
+def recio_read(r):
+    data = r.read()
+    return data  # bytes or None at EOF
+
+
+def recio_seek(r, pos):
+    r.record.seek(int(pos))
 
 
 # ---- Optimizer ------------------------------------------------------------

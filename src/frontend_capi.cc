@@ -174,6 +174,15 @@ struct Scratch {
 };
 thread_local Scratch g_scratch[3];  // up to 3 shape lists per call
 
+// single string -> thread-local scratch; "" on non-UTF8 (error cleared)
+void fill_string(PyObject* str, const char** out, Scratch* s) {
+  const char* c = PyUnicode_AsUTF8(str);
+  if (c == nullptr) PyErr_Clear();
+  s->strings.clear();
+  s->strings.emplace_back(c ? c : "");
+  *out = s->strings[0].c_str();
+}
+
 int fill_string_list(PyObject* list, int* out_size,
                      const char*** out_names, Scratch* s) {
   Py_ssize_t n = PySequence_Size(list);
@@ -266,6 +275,59 @@ int MXFrontListOps(int* out_size, const char*** out_names) {
   PyObject* r = callf("list_ops", "()");
   if (r == nullptr) return -1;
   fill_string_list(r, out_size, out_names, &g_scratch[0]);
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXFrontGetVersion(int* out) {
+  API_BEGIN();
+  PyObject* r = callf("get_version", "()");
+  if (r == nullptr) return -1;
+  *out = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXFrontGetDeviceCount(int dev_type, int* out) {
+  API_BEGIN();
+  PyObject* r = callf("get_device_count", "(i)", dev_type);
+  if (r == nullptr) return -1;
+  *out = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXFrontListDataIters(int* out_size, const char*** out_names) {
+  API_BEGIN();
+  PyObject* r = callf("list_data_iters", "()");
+  if (r == nullptr) return -1;
+  fill_string_list(r, out_size, out_names, &g_scratch[0]);
+  Py_DECREF(r);
+  API_END();
+}
+
+/* ---- profiler --------------------------------------------------------- */
+
+int MXFrontSetProfilerConfig(int mode, const char* filename) {
+  API_BEGIN();
+  PyObject* r = callf("profiler_set_config", "(is)", mode, filename);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXFrontSetProfilerState(int state) {
+  API_BEGIN();
+  PyObject* r = callf("profiler_set_state", "(i)", state);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXFrontDumpProfile(void) {
+  API_BEGIN();
+  PyObject* r = callf("profiler_dump", "()");
+  if (r == nullptr) return -1;
   Py_DECREF(r);
   API_END();
 }
@@ -425,6 +487,48 @@ int MXFrontNDArrayWaitAll(void) {
   API_END();
 }
 
+int MXFrontNDArraySlice(NDArrayHandle h, uint32_t begin, uint32_t end,
+                        NDArrayHandle* out) {
+  API_BEGIN();
+  PyObject* r = callf("nd_slice", "(OII)", h, begin, end);
+  if (r == nullptr) return -1;
+  *out = r;
+  API_END();
+}
+
+int MXFrontNDArrayAt(NDArrayHandle h, uint32_t idx, NDArrayHandle* out) {
+  API_BEGIN();
+  PyObject* r = callf("nd_at", "(OI)", h, idx);
+  if (r == nullptr) return -1;
+  *out = r;
+  API_END();
+}
+
+int MXFrontNDArrayReshape(NDArrayHandle h, int ndim, const int* dims,
+                          NDArrayHandle* out) {
+  API_BEGIN();
+  PyObject* t = PyTuple_New(ndim);
+  for (int i = 0; i < ndim; ++i) {
+    PyTuple_SET_ITEM(t, i, PyLong_FromLong(dims[i]));
+  }
+  PyObject* r = callf("nd_reshape", "(OO)", h, t);
+  Py_DECREF(t);
+  if (r == nullptr) return -1;
+  *out = r;
+  API_END();
+}
+
+int MXFrontNDArrayGetContext(NDArrayHandle h, int* out_dev_type,
+                             int* out_dev_id) {
+  API_BEGIN();
+  PyObject* r = callf("nd_context", "(O)", h);
+  if (r == nullptr) return -1;
+  *out_dev_type = static_cast<int>(PyLong_AsLong(PyTuple_GetItem(r, 0)));
+  *out_dev_id = static_cast<int>(PyLong_AsLong(PyTuple_GetItem(r, 1)));
+  Py_DECREF(r);
+  API_END();
+}
+
 /* ---- Symbol ----------------------------------------------------------- */
 
 int MXFrontSymbolCreateVariable(const char* name, SymbolHandle* out) {
@@ -498,11 +602,7 @@ int MXFrontSymbolSaveToJSON(SymbolHandle h, const char** out_json) {
   API_BEGIN();
   PyObject* r = callf("sym_json", "(O)", h);
   if (r == nullptr) return -1;
-  const char* c = PyUnicode_AsUTF8(r);
-  Scratch* s = &g_scratch[0];
-  s->strings.clear();
-  s->strings.emplace_back(c ? c : "");
-  *out_json = s->strings[0].c_str();
+  fill_string(r, out_json, &g_scratch[0]);
   Py_DECREF(r);
   API_END();
 }
@@ -515,7 +615,90 @@ int MXFrontSymbolCreateFromJSON(const char* json, SymbolHandle* out) {
   API_END();
 }
 
-int MXFrontSymbolInferShape(SymbolHandle h, uint32_t num_args,
+int MXFrontSymbolCopy(SymbolHandle h, SymbolHandle* out) {
+  API_BEGIN();
+  PyObject* r = callf("sym_copy", "(O)", h);
+  if (r == nullptr) return -1;
+  *out = r;
+  API_END();
+}
+
+int MXFrontSymbolPrint(SymbolHandle h, const char** out_str) {
+  API_BEGIN();
+  PyObject* r = callf("sym_print", "(O)", h);
+  if (r == nullptr) return -1;
+  fill_string(r, out_str, &g_scratch[0]);
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXFrontSymbolGetAttr(SymbolHandle h, const char* key,
+                         const char** out, int* out_success) {
+  API_BEGIN();
+  PyObject* r = callf("sym_get_attr", "(Os)", h, key);
+  if (r == nullptr) return -1;
+  fill_string(PyTuple_GetItem(r, 0), out, &g_scratch[0]);
+  *out_success =
+      static_cast<int>(PyLong_AsLong(PyTuple_GetItem(r, 1)));
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXFrontSymbolSetAttr(SymbolHandle h, const char* key,
+                         const char* value) {
+  API_BEGIN();
+  PyObject* r = callf("sym_set_attr", "(Oss)", h, key, value);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXFrontSymbolListAttr(SymbolHandle h, int recursive, int* out_size,
+                          const char*** out_pairs) {
+  API_BEGIN();
+  PyObject* r = callf("sym_list_attr", "(Oi)", h, recursive);
+  if (r == nullptr) return -1;
+  int n2 = 0;
+  fill_string_list(r, &n2, out_pairs, &g_scratch[0]);
+  *out_size = n2 / 2;
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXFrontSymbolGetInternals(SymbolHandle h, SymbolHandle* out) {
+  API_BEGIN();
+  PyObject* r = callf("sym_get_internals", "(O)", h);
+  if (r == nullptr) return -1;
+  *out = r;
+  API_END();
+}
+
+int MXFrontSymbolGetOutput(SymbolHandle h, uint32_t index,
+                           SymbolHandle* out) {
+  API_BEGIN();
+  PyObject* r = callf("sym_get_output", "(OI)", h, index);
+  if (r == nullptr) return -1;
+  *out = r;
+  API_END();
+}
+
+int MXFrontSymbolCompose(SymbolHandle h, const char* name,
+                         uint32_t num_args, const char** keys,
+                         SymbolHandle* args) {
+  API_BEGIN();
+  PyObject* k = keys ? str_list(num_args, keys)
+                     : (Py_INCREF(Py_None), Py_None);
+  PyObject* a = handle_list(num_args, args);
+  PyObject* r = callf("sym_compose", "(OsOO)", h, name ? name : "", k, a);
+  Py_DECREF(k);
+  Py_DECREF(a);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  API_END();
+}
+
+static int infer_shape_impl(const char* pyfn, SymbolHandle h,
+                            uint32_t num_args,
                             const char** keys, const uint32_t* indptr,
                             const uint32_t* shape_data,
                             uint32_t* arg_count, const uint32_t** arg_ndim,
@@ -531,7 +714,7 @@ int MXFrontSymbolInferShape(SymbolHandle h, uint32_t num_args,
     PyList_SET_ITEM(shapes, i,
                     shape_tuple(shape_data, indptr[i], indptr[i + 1]));
   }
-  PyObject* r = callf("sym_infer_shape", "(OOO)", h, names, shapes);
+  PyObject* r = callf(pyfn, "(OOO)", h, names, shapes);
   Py_DECREF(names);
   Py_DECREF(shapes);
   if (r == nullptr) return -1;
@@ -548,6 +731,36 @@ int MXFrontSymbolInferShape(SymbolHandle h, uint32_t num_args,
   Py_DECREF(r);
   if (rc != 0) return -1;
   API_END();
+}
+
+int MXFrontSymbolInferShape(SymbolHandle h, uint32_t num_args,
+                            const char** keys, const uint32_t* indptr,
+                            const uint32_t* shape_data,
+                            uint32_t* arg_count, const uint32_t** arg_ndim,
+                            const uint32_t*** arg_shapes,
+                            uint32_t* out_count, const uint32_t** out_ndim,
+                            const uint32_t*** out_shapes,
+                            uint32_t* aux_count, const uint32_t** aux_ndim,
+                            const uint32_t*** aux_shapes) {
+  return infer_shape_impl("sym_infer_shape", h, num_args, keys, indptr,
+                          shape_data, arg_count, arg_ndim, arg_shapes,
+                          out_count, out_ndim, out_shapes,
+                          aux_count, aux_ndim, aux_shapes);
+}
+
+int MXFrontSymbolInferShapePartial(
+    SymbolHandle h, uint32_t num_args, const char** keys,
+    const uint32_t* indptr, const uint32_t* shape_data,
+    uint32_t* arg_count, const uint32_t** arg_ndim,
+    const uint32_t*** arg_shapes,
+    uint32_t* out_count, const uint32_t** out_ndim,
+    const uint32_t*** out_shapes,
+    uint32_t* aux_count, const uint32_t** aux_ndim,
+    const uint32_t*** aux_shapes) {
+  return infer_shape_impl("sym_infer_shape_partial", h, num_args, keys,
+                          indptr, shape_data, arg_count, arg_ndim,
+                          arg_shapes, out_count, out_ndim, out_shapes,
+                          aux_count, aux_ndim, aux_shapes);
 }
 
 /* ---- Executor --------------------------------------------------------- */
@@ -638,6 +851,143 @@ int MXFrontExecutorGetGrad(ExecutorHandle h, const char* name,
 int MXFrontExecutorGetAux(ExecutorHandle h, const char* name,
                           NDArrayHandle* out) {
   return exec_get_impl(h, 2, name, out);
+}
+
+int MXFrontExecutorPrint(ExecutorHandle h, const char** out_str) {
+  API_BEGIN();
+  PyObject* r = callf("exec_print", "(O)", h);
+  if (r == nullptr) return -1;
+  fill_string(r, out_str, &g_scratch[0]);
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXFrontExecutorSetMonitorCallback(ExecutorHandle h,
+                                      MXFrontMonitorCallback cb,
+                                      void* cb_data) {
+  API_BEGIN();
+  PyObject* r = callf("exec_set_monitor", "(OKK)", h,
+                      (unsigned long long)(uintptr_t)cb,
+                      (unsigned long long)(uintptr_t)cb_data);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  API_END();
+}
+
+/* ---- custom operators from C ------------------------------------------ */
+
+int MXFrontCustomOpRegister(const char* op_type, uint32_t num_inputs,
+                            MXFrontCustomOpInferShapeFn infer_shape,
+                            MXFrontCustomOpForwardFn forward,
+                            MXFrontCustomOpBackwardFn backward,
+                            void* user_data) {
+  API_BEGIN();
+  if (infer_shape == nullptr || forward == nullptr) {
+    set_error("MXFrontCustomOpRegister: infer_shape and forward "
+              "callbacks are required");
+    return -1;
+  }
+  PyObject* r = callf("custom_op_register", "(sIKKKK)", op_type,
+                      num_inputs,
+                      (unsigned long long)(uintptr_t)infer_shape,
+                      (unsigned long long)(uintptr_t)forward,
+                      (unsigned long long)(uintptr_t)backward,
+                      (unsigned long long)(uintptr_t)user_data);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  API_END();
+}
+
+/* ---- RecordIO --------------------------------------------------------- */
+
+static int recio_open_impl(const char* uri, const char* flag,
+                           RecordIOHandle* out) {
+  API_BEGIN();
+  PyObject* r = callf("recio_open", "(ss)", uri, flag);
+  if (r == nullptr) return -1;
+  *out = r;
+  API_END();
+}
+
+int MXFrontRecordIOWriterCreate(const char* uri, RecordIOHandle* out) {
+  return recio_open_impl(uri, "w", out);
+}
+
+int MXFrontRecordIOReaderCreate(const char* uri, RecordIOHandle* out) {
+  return recio_open_impl(uri, "r", out);
+}
+
+static int recio_free_impl(RecordIOHandle h) {
+  if (h == nullptr || !ensure_init()) return 0;
+  Gil gil;
+  PyObject* r = callf("recio_close", "(O)", h);
+  Py_XDECREF(r);
+  Py_DECREF(static_cast<PyObject*>(h));
+  return 0;
+}
+
+int MXFrontRecordIOWriterFree(RecordIOHandle h) {
+  return recio_free_impl(h);
+}
+
+int MXFrontRecordIOReaderFree(RecordIOHandle h) {
+  return recio_free_impl(h);
+}
+
+int MXFrontRecordIOWriterWriteRecord(RecordIOHandle h, const char* buf,
+                                     uint64_t size) {
+  API_BEGIN();
+  PyObject* r = callf("recio_write", "(OKK)", h,
+                      (unsigned long long)(uintptr_t)buf,
+                      (unsigned long long)size);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXFrontRecordIOWriterTell(RecordIOHandle h, uint64_t* out_pos) {
+  API_BEGIN();
+  PyObject* r = callf("recio_tell", "(O)", h);
+  if (r == nullptr) return -1;
+  *out_pos = static_cast<uint64_t>(PyLong_AsUnsignedLongLong(r));
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXFrontRecordIOReaderReadRecord(RecordIOHandle h,
+                                    const char** out_buf,
+                                    uint64_t* out_size) {
+  API_BEGIN();
+  PyObject* r = callf("recio_read", "(O)", h);
+  if (r == nullptr) return -1;
+  if (r == Py_None) {  // EOF
+    Py_DECREF(r);
+    *out_buf = nullptr;
+    *out_size = 0;
+    return 0;
+  }
+  char* data = nullptr;
+  Py_ssize_t len = 0;
+  if (PyBytes_AsStringAndSize(r, &data, &len) != 0) {
+    Py_DECREF(r);
+    set_error("recio_read: " + py_error());
+    return -1;
+  }
+  Scratch* s = &g_scratch[0];
+  s->strings.clear();
+  s->strings.emplace_back(data, static_cast<size_t>(len));
+  *out_buf = s->strings[0].data();
+  *out_size = static_cast<uint64_t>(len);
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXFrontRecordIOReaderSeek(RecordIOHandle h, uint64_t pos) {
+  API_BEGIN();
+  PyObject* r = callf("recio_seek", "(OK)", h, (unsigned long long)pos);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  API_END();
 }
 
 /* ---- Optimizer -------------------------------------------------------- */

@@ -195,6 +195,191 @@ while True:
     batches += 1
 assert batches == 3, batches
 print("dataiter OK")
+
+# --- runtime info --------------------------------------------------------
+vi = ctypes.c_int()
+ck(lib.MXFrontGetVersion(ctypes.byref(vi)))
+assert vi.value >= 100, vi.value
+ck(lib.MXFrontGetDeviceCount(1, ctypes.byref(vi)))
+assert vi.value >= 1
+ck(lib.MXFrontListDataIters(ctypes.byref(n), ctypes.byref(names)))
+iters = [names[i].decode() for i in range(n.value)]
+assert "NDArrayIter" in iters and "ImageRecordIter" in iters, iters
+
+# --- ndarray views -------------------------------------------------------
+sl = P()
+ck(lib.MXFrontNDArraySlice(h, 0, 1, ctypes.byref(sl)))
+ck(lib.MXFrontNDArrayGetShape(sl, ctypes.byref(nd), ctypes.byref(dims)))
+assert (nd.value, dims[0], dims[1]) == (2, 1, 3)
+at = P()
+ck(lib.MXFrontNDArrayAt(h, 1, ctypes.byref(at)))
+ck(lib.MXFrontNDArrayGetShape(at, ctypes.byref(nd), ctypes.byref(dims)))
+assert nd.value == 1 and dims[0] == 3
+rs2 = P()
+ck(lib.MXFrontNDArrayReshape(h, 2, (ctypes.c_int * 2)(3, -1),
+                             ctypes.byref(rs2)))
+ck(lib.MXFrontNDArrayGetShape(rs2, ctypes.byref(nd), ctypes.byref(dims)))
+assert (dims[0], dims[1]) == (3, 2)
+dt = ctypes.c_int()
+di = ctypes.c_int()
+ck(lib.MXFrontNDArrayGetContext(h, ctypes.byref(dt), ctypes.byref(di)))
+assert dt.value == 1
+for v_ in (sl, at, rs2):
+    ck(lib.MXFrontNDArrayFree(v_))
+print("views OK")
+
+# --- symbol attrs / copy / print / internals / compose / partial --------
+ck(lib.MXFrontSymbolSetAttr(fc, b"lr_mult", b"2.0"))
+sval = ctypes.c_char_p()
+succ = ctypes.c_int()
+ck(lib.MXFrontSymbolGetAttr(fc, b"lr_mult", ctypes.byref(sval),
+                            ctypes.byref(succ)))
+assert succ.value == 1 and sval.value == b"2.0"
+ck(lib.MXFrontSymbolGetAttr(fc, b"absent", ctypes.byref(sval),
+                            ctypes.byref(succ)))
+assert succ.value == 0
+ck(lib.MXFrontSymbolListAttr(fc, 0, ctypes.byref(n), ctypes.byref(names)))
+assert n.value == 1 and names[0] == b"lr_mult"
+cp = P()
+ck(lib.MXFrontSymbolCopy(sm, ctypes.byref(cp)))
+ck(lib.MXFrontSymbolPrint(sm, ctypes.byref(sval)))
+assert b"softmax" in sval.value
+ints = P()
+ck(lib.MXFrontSymbolGetInternals(sm, ctypes.byref(ints)))
+ck(lib.MXFrontSymbolListOutputs(ints, ctypes.byref(n),
+                                ctypes.byref(names)))
+internals = [names[i].decode() for i in range(n.value)]
+assert "fc_output" in internals, internals
+o0 = P()
+ck(lib.MXFrontSymbolGetOutput(ints, internals.index("fc_output"),
+                              ctypes.byref(o0)))
+# partial inference with NO provided shapes must not fail
+ck(lib.MXFrontSymbolInferShapePartial(
+    sm, 0, None, None, None,
+    ctypes.byref(ac), ctypes.byref(andim), ctypes.byref(ashp),
+    ctypes.byref(oc), ctypes.byref(ondim), ctypes.byref(oshp),
+    ctypes.byref(xc), ctypes.byref(xndim), ctypes.byref(xshp)))
+assert ac.value == 4
+# compose: rewire the copy's data input to a fresh variable
+d2 = P()
+ck(lib.MXFrontSymbolCreateVariable(b"data2", ctypes.byref(d2)))
+ck(lib.MXFrontSymbolCompose(cp, None, 1, (ctypes.c_char_p * 1)(b"data"),
+                            (P * 1)(d2)))
+ck(lib.MXFrontSymbolListArguments(cp, ctypes.byref(n),
+                                  ctypes.byref(names)))
+cargs = [names[i].decode() for i in range(n.value)]
+assert "data2" in cargs and "data" not in cargs, cargs
+print("symbol extras OK")
+
+# --- profiler ------------------------------------------------------------
+prof = os.path.join(sys.argv[2], "abi_profile.json").encode()
+ck(lib.MXFrontSetProfilerConfig(1, prof))
+ck(lib.MXFrontSetProfilerState(1))
+ck(lib.MXFrontNDArrayWaitAll())
+ck(lib.MXFrontSetProfilerState(0))
+ck(lib.MXFrontDumpProfile())
+assert os.path.exists(prof)
+print("profiler OK")
+
+# --- RecordIO ------------------------------------------------------------
+rec = os.path.join(sys.argv[2], "abi.rec").encode()
+wr = P()
+ck(lib.MXFrontRecordIOWriterCreate(rec, ctypes.byref(wr)))
+ck(lib.MXFrontRecordIOWriterWriteRecord(wr, b"hello", 5))
+pos = ctypes.c_uint64()
+ck(lib.MXFrontRecordIOWriterTell(wr, ctypes.byref(pos)))
+ck(lib.MXFrontRecordIOWriterWriteRecord(wr, b"world!!", 7))
+ck(lib.MXFrontRecordIOWriterFree(wr))
+rd = P()
+ck(lib.MXFrontRecordIOReaderCreate(rec, ctypes.byref(rd)))
+buf = ctypes.c_char_p()
+sz = ctypes.c_uint64()
+ck(lib.MXFrontRecordIOReaderReadRecord(rd, ctypes.byref(buf),
+                                       ctypes.byref(sz)))
+assert ctypes.string_at(buf, sz.value) == b"hello"
+ck(lib.MXFrontRecordIOReaderSeek(rd, pos.value))
+ck(lib.MXFrontRecordIOReaderReadRecord(rd, ctypes.byref(buf),
+                                       ctypes.byref(sz)))
+assert ctypes.string_at(buf, sz.value) == b"world!!"
+ck(lib.MXFrontRecordIOReaderReadRecord(rd, ctypes.byref(buf),
+                                       ctypes.byref(sz)))
+assert sz.value == 0 and not buf.value  # EOF
+ck(lib.MXFrontRecordIOReaderFree(rd))
+print("recordio OK")
+
+# --- custom op from C function pointers ---------------------------------
+u32p = ctypes.POINTER(ctypes.c_uint32)
+f32p = ctypes.POINTER(ctypes.c_float)
+INFER = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_uint32, u32p,
+                         ctypes.POINTER(u32p), u32p, u32p, P)
+FWD = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_uint32,
+                       ctypes.POINTER(f32p),
+                       ctypes.POINTER(ctypes.c_uint64), f32p,
+                       ctypes.c_uint64, P)
+BWD = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_uint32,
+                       ctypes.POINTER(f32p), f32p, ctypes.POINTER(f32p),
+                       ctypes.POINTER(ctypes.c_uint64), ctypes.c_uint64, P)
+
+
+def c_infer(ni, ndims, shapes, out_ndim, out_shape, _u):
+    out_ndim[0] = ndims[0]
+    for i in range(ndims[0]):
+        out_shape[i] = shapes[0][i]
+    return 0
+
+
+def c_fwd(ni, ins, sizes, out, osize, _u):
+    for i in range(osize):
+        out[i] = ins[0][i] * 3.0
+    return 0
+
+
+def c_bwd(ni, ins, og, grads, sizes, osize, _u):
+    for i in range(osize):
+        grads[0][i] = og[i] * 3.0
+    return 0
+
+
+infer_c, fwd_c, bwd_c = INFER(c_infer), FWD(c_fwd), BWD(c_bwd)
+ck(lib.MXFrontCustomOpRegister(b"triple", 1,
+                               ctypes.cast(infer_c, P),
+                               ctypes.cast(fwd_c, P),
+                               ctypes.cast(bwd_c, P), None))
+outs3 = (P * 2)()
+nout3 = ctypes.c_int(2)
+ck(lib.MXFrontImperativeInvoke(b"triple", 1, (P * 1)(h), 0, None, None,
+                               ctypes.byref(nout3), outs3))
+r3 = np.zeros(6, np.float32)
+ck(lib.MXFrontNDArraySyncCopyToCPU(P(outs3[0]), r3.ctypes.data_as(P),
+                                   ctypes.c_uint64(6)))
+assert np.allclose(r3, data * 3), r3
+ck(lib.MXFrontNDArrayFree(P(outs3[0])))
+print("custom op OK")
+
+# --- executor monitor + print -------------------------------------------
+seen = []
+MON = ctypes.CFUNCTYPE(None, ctypes.c_char_p, P, P)
+
+
+def c_mon(mname, arr, _u):
+    shp = ctypes.c_uint32()
+    dd = ctypes.POINTER(ctypes.c_uint32)()
+    # NOTE: wrap the raw pointer — bare ints truncate to 32-bit c_int
+    lib.MXFrontNDArrayGetShape(P(arr), ctypes.byref(shp),
+                               ctypes.byref(dd))
+    seen.append((mname.decode(), tuple(dd[i] for i in range(shp.value))))
+
+
+mon_c = MON(c_mon)
+ck(lib.MXFrontExecutorSetMonitorCallback(ex, mon_c, None))
+ck(lib.MXFrontExecutorForward(ex, 0))
+assert seen and seen[0][1] == (8, 4), seen
+ck(lib.MXFrontExecutorSetMonitorCallback(
+    ex, ctypes.cast(None, MON), None))
+ck(lib.MXFrontExecutorForward(ex, 0))
+ck(lib.MXFrontExecutorPrint(ex, ctypes.byref(sval)))
+assert b"Executor" in sval.value
+print("monitor OK")
 print("C FRONTEND ABI OK")
 """
 
