@@ -43,6 +43,12 @@ __all__ = [
 _REGISTRY: dict[str, "OpDef"] = {}
 _ALIASES: dict[str, str] = {}
 
+# op-name -> count of OpDef.apply calls this process (trace-time compute
+# invocations — NOT word-grep mentions).  tests/conftest.py dumps this
+# at session end when MXNET_OP_COVERAGE_OUT is set; tools/gen_op_census
+# reads the dump so the census "coverage" column counts real executions.
+INVOCATIONS: dict[str, int] = {}
+
 REQUIRED = object()
 
 
@@ -173,6 +179,7 @@ class OpDef:
     # -- compute ----------------------------------------------------------
     def apply(self, attrs, inputs, aux, is_train, rng):
         """Returns (outputs_list, aux_updates_list_or_None)."""
+        INVOCATIONS[self.name] = INVOCATIONS.get(self.name, 0) + 1
         res = self._apply(attrs, list(inputs), list(aux), is_train, rng)
         if isinstance(res, tuple) and len(res) == 2 and isinstance(res[0], list):
             outs, aux_up = res
@@ -242,8 +249,8 @@ def list_ops():
 # Pallas mode): every trace cache keys on this fingerprint, otherwise a
 # mid-process toggle is silently ignored by the cached jit
 _TRACE_ENV_VARS = ("MXNET_BN_PALLAS", "MXNET_BN_ABLATION",
-                   "MXNET_BN_STATS_F32", "MXNET_CONV_GRAD_BARRIER",
-                   "MXNET_BACKWARD_DO_MIRROR")
+                   "MXNET_BN_STATS_F32", "MXNET_CONV_STEM_S2D",
+                   "MXNET_CONV_GRAD_BARRIER", "MXNET_BACKWARD_DO_MIRROR")
 
 
 def trace_env_fingerprint():

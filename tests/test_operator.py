@@ -196,6 +196,40 @@ def test_conv_pool_gradient():
         rtol=7e-2)
 
 
+def test_conv_stem_s2d_exact():
+    """The space-to-depth stem rewrite (7x7/s2/p3, few channels ->
+    s2d(2x2) + 4x4/s1) must reproduce the direct convolution exactly
+    (ops/nn.py _stem_s2d_conv; MLPerf TPU stem transform), fwd and
+    grads, since it is ON by default."""
+    import os
+
+    rs = np.random.RandomState(0)
+    x = rs.rand(2, 3, 32, 32).astype(np.float32)
+    w = rs.rand(8, 3, 7, 7).astype(np.float32)
+
+    def run():
+        data = sym.Variable("data")
+        net = sym.Convolution(data, num_filter=8, kernel=(7, 7),
+                              stride=(2, 2), pad=(3, 3), no_bias=True,
+                              name="c0")
+        ex = net.simple_bind(mx.cpu(), data=x.shape, grad_req="write")
+        ex.arg_dict["data"][:] = x
+        ex.arg_dict["c0_weight"][:] = w
+        out = ex.forward(is_train=True)[0].asnumpy()
+        ex.backward(nd.ones(out.shape))
+        return out, ex.grad_dict["c0_weight"].asnumpy()
+
+    os.environ["MXNET_CONV_STEM_S2D"] = "0"
+    try:
+        out_direct, g_direct = run()
+    finally:
+        os.environ.pop("MXNET_CONV_STEM_S2D", None)
+    out_s2d, g_s2d = run()  # default path
+    assert out_s2d.shape == out_direct.shape == (2, 8, 16, 16)
+    assert_almost_equal(out_s2d, out_direct, rtol=1e-4, atol=1e-4)
+    assert_almost_equal(g_s2d, g_direct, rtol=1e-3, atol=1e-3)
+
+
 def test_activation_grads():
     for act in ["relu", "sigmoid", "tanh", "softrelu"]:
         data = sym.Variable("data")
