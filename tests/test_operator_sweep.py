@@ -701,3 +701,174 @@ def test_spatial_ops_numeric_gradients():
     ch = sym.BilinearSampler(sym.Variable("x"), gw)
     check_numeric_gradient(ch, {"x": x, "f": flow}, numeric_eps=1e-3,
                            rtol=0.08, atol=3e-3)
+
+
+# ---------------------------------------------------------------------------
+# depth sweeps: degenerate shapes x low precision x grad_req
+# (reference test_operator.py exercises the same three axes per op —
+# edge shapes, fp16 forward parity, req='add'/'null' accumulation)
+# ---------------------------------------------------------------------------
+
+DEGENERATE_SHAPES = [(1,), (1, 1), (2, 1, 3, 1)]
+_DEG_IDS = ["x".join(map(str, s)) for s in DEGENERATE_SHAPES]
+
+
+@pytest.mark.parametrize("shape", DEGENERATE_SHAPES, ids=_DEG_IDS)
+@pytest.mark.parametrize("op,ref,mode", UNARY, ids=[u[0] for u in UNARY])
+def test_unary_degenerate_shapes(op, ref, mode, shape):
+    """Rank-1 / all-singleton / interior-singleton shapes must flow
+    through forward unchanged (the reference sweeps edge shapes per op;
+    singleton axes are where layout/squeeze bugs live)."""
+    x = _unary_input(mode)
+    x = np.resize(x, shape).astype(np.float32)
+    check_symbolic_forward(_sym1(op), {"x": x}, [ref(x)],
+                           rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("shape", DEGENERATE_SHAPES, ids=_DEG_IDS)
+@pytest.mark.parametrize("op,ref", BINARY, ids=[b[0] for b in BINARY])
+def test_binary_degenerate_shapes(op, ref, shape):
+    a = np.resize(_pos((3, 4)), shape).astype(np.float32)
+    b = np.resize(_pos((3, 4)), shape).astype(np.float32)
+    s = getattr(sym, op)(sym.Variable("a"), sym.Variable("b"))
+    check_symbolic_forward(s, {"a": a, "b": b}, [ref(a, b)], rtol=1e-5)
+
+
+@pytest.mark.parametrize("shape", DEGENERATE_SHAPES, ids=_DEG_IDS)
+@pytest.mark.parametrize("op,ref", BROADCAST[:4],
+                         ids=[b[0] for b in BROADCAST[:4]])
+def test_broadcast_against_singleton(op, ref, shape):
+    """Every broadcast op against a full-singleton rhs of matching
+    rank (the degenerate broadcast everyone writes: x op scalar-like)."""
+    a = np.resize(_pos((3, 4)), shape).astype(np.float32)
+    b = _pos((1,) * len(shape)).astype(np.float32)
+    s = getattr(sym, op)(sym.Variable("a"), sym.Variable("b"))
+    check_symbolic_forward(s, {"a": a, "b": b},
+                           [ref(a, b).astype(np.float32)], rtol=1e-4,
+                           atol=1e-5)
+
+
+@pytest.mark.parametrize("axis", [0, -1, (0,), None])
+@pytest.mark.parametrize("op,ref,diff", RED, ids=[r[0] for r in RED])
+def test_reduction_degenerate(op, ref, diff, axis):
+    """Reductions over singleton and negative axes on a shape with
+    interior 1-dims; keepdims round-trip."""
+    x = _pos((2, 1, 3))
+    kw = {} if axis is None else {"axis": axis}
+    want = ref(x) if axis is None else ref(x, axis=axis)
+    check_symbolic_forward(_sym1(op, **kw), {"x": x},
+                           [np.asarray(want, np.float32)],
+                           rtol=1e-4, atol=1e-5)
+    kw["keepdims"] = True
+    want_k = ref(x, axis=axis, keepdims=True) if axis is not None \
+        else np.asarray(ref(x)).reshape((1, 1, 1))
+    check_symbolic_forward(_sym1(op, **kw), {"x": x},
+                           [np.asarray(want_k, np.float32)],
+                           rtol=1e-4, atol=1e-5)
+
+
+# low-precision forward parity: same op, fp16/bf16 inputs, loose tol.
+# Ops whose reference values explode in half precision are given wider
+# tolerance rather than skipped (the point is "it runs and is sane").
+_LP_SKIP = {"gamma", "gammaln"}  # lgamma lowering is f32+ only
+
+
+@pytest.mark.parametrize("dtype", ["float16", "bfloat16"])
+@pytest.mark.parametrize("op,ref,mode", UNARY, ids=[u[0] for u in UNARY])
+def test_unary_low_precision(op, ref, mode, dtype):
+    if op in _LP_SKIP:
+        pytest.skip("%s: f32-only lowering" % op)
+    from mxnet_tpu import nd as _nd
+
+    x = _unary_input(mode)
+    a = _nd.array(x, dtype=dtype)
+    out = getattr(_nd, op)(a)
+    got_dt = "bfloat16" if "bfloat16" in str(out.dtype) \
+        else np.dtype(out.dtype).name
+    assert got_dt == dtype, (op, out.dtype)
+    got = out.asnumpy().astype(np.float32)
+    want = ref(x.astype(np.float32))
+    rtol = 0.05 if dtype == "bfloat16" else 0.02
+    assert_almost_equal(got, want, rtol=rtol, atol=rtol)
+
+
+# grad_req sweep: 'add' accumulates across backward calls, 'null'
+# suppresses the gradient entirely (executor.py grad_req contract,
+# reference include/mxnet/op_attr_types.h kAddTo/kNullOp)
+def _gradreq_cases():
+    v = sym.Variable
+    return [
+        ("FullyConnected",
+         sym.FullyConnected(v("x"), num_hidden=4, name="fc"),
+         {"x": (2, 3)}, "fc_weight"),
+        ("Convolution",
+         sym.Convolution(v("x"), num_filter=4, kernel=(3, 3), pad=(1, 1),
+                         name="cv"),
+         {"x": (1, 2, 5, 5)}, "cv_weight"),
+        ("BatchNorm",
+         sym.BatchNorm(v("x"), fix_gamma=False, name="bn"),
+         {"x": (2, 3, 4, 4)}, "bn_gamma"),
+        ("Activation", sym.Activation(v("x"), act_type="tanh"),
+         {"x": (3, 4)}, "x"),
+        ("elemwise_mul", sym.elemwise_mul(v("x"), v("y")),
+         {"x": (3, 4), "y": (3, 4)}, "y"),
+        ("broadcast_add",
+         sym.broadcast_add(v("x"), v("y")),
+         {"x": (2, 3, 4), "y": (1, 3, 1)}, "y"),
+        ("sum", sym.sum(v("x"), axis=1), {"x": (3, 4)}, "x"),
+        ("dot", sym.dot(v("x"), v("y")), {"x": (3, 4), "y": (4, 2)}, "y"),
+        ("Embedding",
+         sym.Embedding(v("i"), input_dim=5, output_dim=3, name="em"),
+         {"i": (4,)}, "em_weight"),
+        ("SliceChannel",
+         sym.SliceChannel(v("x"), num_outputs=2)[0],
+         {"x": (2, 4)}, "x"),
+        ("transpose", sym.transpose(v("x")), {"x": (3, 4)}, "x"),
+        ("LeakyReLU", sym.LeakyReLU(v("x"), act_type="leaky"),
+         {"x": (3, 4)}, "x"),
+    ]
+
+
+_GR_IDS = [c[0] for c in _gradreq_cases()]
+
+
+@pytest.mark.parametrize("case", _gradreq_cases(), ids=_GR_IDS)
+def test_grad_req_add_accumulates(case):
+    _name, s, shapes, wrt = case
+    if "i" in shapes:  # integer input for Embedding
+        vals = {"i": RS.randint(0, 5, shapes["i"]).astype(np.float32)}
+    else:
+        vals = {k: RS.randn(*shp).astype(np.float32)
+                for k, shp in shapes.items()}
+    ex = s.simple_bind(mx.cpu(), grad_req="add", **shapes)
+    for k, a in vals.items():
+        ex.arg_dict[k][:] = a
+    ex.forward(is_train=True)
+    head = np.ones([int(d) for d in ex.outputs[0].shape], np.float32)
+    ex.backward(mx.nd.array(head))
+    g1 = ex.grad_dict[wrt].asnumpy().copy()
+    ex.forward(is_train=True)
+    ex.backward(mx.nd.array(head))
+    g2 = ex.grad_dict[wrt].asnumpy()
+    assert np.abs(g1).sum() > 0, "zero gradient for %s" % wrt
+    assert_almost_equal(g2, 2 * g1, rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("case", _gradreq_cases(), ids=_GR_IDS)
+def test_grad_req_null_suppresses(case):
+    _name, s, shapes, wrt = case
+    req = {n: ("null" if n == wrt else "write")
+           for n in s.list_arguments()}
+    ex = s.simple_bind(mx.cpu(), grad_req=req, **shapes)
+    for k, shp in shapes.items():
+        if k == "i":
+            ex.arg_dict[k][:] = RS.randint(0, 5, shp).astype(np.float32)
+        else:
+            ex.arg_dict[k][:] = RS.randn(*shp).astype(np.float32)
+    ex.forward(is_train=True)
+    head = np.ones([int(d) for d in ex.outputs[0].shape], np.float32)
+    ex.backward(mx.nd.array(head))
+    assert ex.grad_dict.get(wrt) is None
+    others = [n for n, r in req.items() if r == "write"]
+    if others:
+        assert any(ex.grad_dict.get(n) is not None for n in others)

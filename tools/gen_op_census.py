@@ -116,6 +116,24 @@ def _sweep_table_ops():
     return ops
 
 
+def _load_invocations():
+    """Real execution counts from a full-suite run
+    (MXNET_OP_COVERAGE_OUT=docs/op_coverage.json pytest tests/ -q):
+    {op_name: OpDef.apply call count}.  Empty dict when the dump is
+    absent — the census then marks the column unavailable rather than
+    falling back to grep counts."""
+    import json
+
+    path = os.path.join(ROOT, "docs", "op_coverage.json")
+    if not os.path.exists(path):
+        return {}
+    try:
+        with open(path) as f:
+            return json.load(f).get("counts", {})
+    except (OSError, ValueError):
+        return {}
+
+
 def main():
     from mxnet_tpu.ops import registry
 
@@ -123,6 +141,7 @@ def main():
     aliases = dict(registry._ALIASES)
     all_names = set(registry.list_ops())
     sweep_ops = _sweep_table_ops()
+    invocations = _load_invocations()
 
     def resolve(ref_name):
         """-> (status, repo_name): present / alias / renamed / absent."""
@@ -167,7 +186,8 @@ def main():
                 tpu = ["tests_tpu/test_operator_tpu_sweep.py (table)"] \
                     + [t for t in tpu
                        if "test_operator_tpu_sweep" not in t]
-            rows.append((group, ref, status, repo,
+            inv = sum(invocations.get(n, 0) for n in group_names)
+            rows.append((group, ref, status, repo, inv,
                          len(cpu), cpu[0] if cpu else "",
                          len(tpu), tpu[0] if tpu else ""))
 
@@ -189,19 +209,37 @@ def main():
         f.write("Reference census source: SURVEY §2.3 (grep of "
                 "`MXNET_REGISTER_OP_PROPERTY` / `NNVM_REGISTER_OP` / "
                 "`MXNET_REGISTER_NDARRAY_FUN` over the reference "
-                "`src/operator` + `src/ndarray`). Coverage columns: "
-                "word-grep over `tests/` (CPU) and `tests_tpu/` "
-                "(hardware parity); file shown is the first hit. "
-                "tests_tpu parity tests bind BOTH backends "
+                "`src/operator` + `src/ndarray`). Columns: "
+                "**invocations** counts real `OpDef.apply` executions "
+                "recorded by a full CPU suite run "
+                "(`MXNET_OP_COVERAGE_OUT=docs/op_coverage.json pytest "
+                "tests/ -q`, summed over the op's alias group; "
+                "subprocess-driven tests — C ABI clients, dist workers "
+                "— execute ops their parent process cannot count). "
+                "The *mentions* columns word-grep `tests/` (CPU) and "
+                "`tests_tpu/` (hardware parity); file shown is the "
+                "first hit. tests_tpu parity tests bind BOTH backends "
                 "(check_consistency), so they count for CPU too.\n\n")
         f.write("Reference coverage: %d present, %d via alias, %d "
                 "renamed, %d moved to python API, %d absent.\n\n"
                 % (counts["yes"], counts["alias"], counts["renamed"],
                    counts["moved"], counts["no"]))
-        f.write("| group | reference op | status | repo op | CPU tests "
-                "| first CPU test | TPU tests | first TPU test |\n")
-        f.write("|---|---|---|---|---|---|---|---|\n")
-        for (group, ref, status, repo, nc, c0, nt, t0) in rows:
+        if invocations:
+            runnable = sum(1 for r in rows if r[2] not in ("moved", "no"))
+            f.write("Invocation coverage: **%d / %d runnable reference "
+                    "ops executed at least once** by the recorded suite "
+                    "run.\n\n"
+                    % (sum(1 for r in rows
+                           if r[2] not in ("moved", "no") and r[4] > 0),
+                       runnable))
+        else:
+            f.write("Invocation column unavailable: docs/op_coverage.json"
+                    " not found (regenerate via the command above).\n\n")
+        f.write("| group | reference op | status | repo op | invocations "
+                "| CPU mentions | first CPU test | TPU mentions "
+                "| first TPU test |\n")
+        f.write("|---|---|---|---|---|---|---|---|---|\n")
+        for (group, ref, status, repo, inv, nc, c0, nt, t0) in rows:
             cell = "=" if repo == ref.rstrip("†") else (
                 ("`%s`" % repo) if repo else "")
             tcell = t0
@@ -209,8 +247,9 @@ def main():
                 tcell = "host-side op (by design)"
             elif not nt and status == "moved":
                 tcell = "python API (host-side)"
-            f.write("| %s | `%s` | %s | %s | %d | %s | %d | %s |\n"
-                    % (group, ref, status, cell, nc, c0, nt, tcell))
+            f.write("| %s | `%s` | %s | %s | %s | %d | %s | %d | %s |\n"
+                    % (group, ref, status, cell,
+                       inv if invocations else "-", nc, c0, nt, tcell))
         f.write("\n## Ops beyond the reference census (%d)\n\n"
                 % len(extra))
         f.write("New-capability ops (attention/ring/MoE, bf16 casts, "
