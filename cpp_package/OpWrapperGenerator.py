@@ -1,0 +1,265 @@
+#!/usr/bin/env python
+"""Generate typed C++ op wrappers from the operator registry.
+
+Reference analog: ``cpp-package/OpWrapperGenerator.py`` builds
+``include/mxnet-cpp/op.h`` by parsing the C op registry's docstrings.
+Here the single Python registry (``mxnet_tpu/ops/registry.py``) carries
+typed param specs directly (parser + default per param), so generation
+is a straight walk — no docstring parsing — and emits
+``cpp_package/include/mxnet_tpu_cpp_ops.hpp``: one typed builder per
+public operator in ``namespace mxnet_tpu_cpp::op``.
+
+Each wrapper takes ``(symbol_name, inputs..., typed params...)``,
+formats params to the string attrs the ABI speaks, and calls
+``Symbol::Op``.  Two forms per op:
+
+* a generic form over ``std::vector<SymbolHandle>`` (any input count —
+  trailing weight/aux variables are auto-created at compose time, the
+  same contract as the Python frontend), and
+* when the op's leading argument is a single tensor, a convenience
+  overload over ``const Symbol&``.
+
+Regenerate with ``python cpp_package/OpWrapperGenerator.py``; CI
+regenerates and diffs so the committed header cannot go stale
+(the census-freshness pattern, ``ci/``).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from mxnet_tpu.ops import registry  # noqa: E402
+import mxnet_tpu  # noqa: E402,F401  (populates the registry)
+
+CPP_KEYWORDS = {
+    "operator", "new", "delete", "template", "default", "register",
+    "return", "switch", "case", "this", "class", "struct", "union",
+    "float", "double", "int", "bool", "char", "void", "axis", "begin",
+    "end",
+}
+# "axis"/"begin"/"end" are fine as identifiers but shadow std:: names
+# under `using namespace std` in consumer code; suffix them too.
+
+HEADER = '''\
+// GENERATED FILE — do not edit.
+// python cpp_package/OpWrapperGenerator.py  regenerates from the op
+// registry (mxnet_tpu/ops/registry.py).  Reference analog:
+// cpp-package/include/mxnet-cpp/op.h from OpWrapperGenerator.py.
+//
+// One typed builder per public operator: params are C++-typed and
+// formatted into the string attrs the frontend ABI speaks
+// (include/mxnet_tpu/c_frontend_api.h).  Inputs compose positionally;
+// omitted trailing inputs (weights, aux states) are auto-created as
+// variables at compose time, exactly like the Python frontend.
+
+#pragma once
+
+#include "mxnet_tpu_cpp.hpp"
+
+#include <cstdint>
+#include <sstream>
+#include <string>
+#include <vector>
+
+namespace mxnet_tpu_cpp {
+
+// attr-string shape literal: Shape{3, 3} -> "(3, 3)"
+struct Shape {
+  std::vector<int> dims;
+  Shape() = default;
+  Shape(std::initializer_list<int> d) : dims(d) {}
+  explicit Shape(const std::vector<int>& d) : dims(d) {}
+  std::string str() const {
+    std::ostringstream os;
+    os << "(";
+    for (size_t i = 0; i < dims.size(); ++i) {
+      if (i) os << ", ";
+      os << dims[i];
+    }
+    os << ")";
+    return os.str();
+  }
+};
+
+namespace op {
+
+inline std::string AttrStr(const std::string& v) { return v; }
+inline std::string AttrStr(const char* v) { return v; }
+inline std::string AttrStr(bool v) { return v ? "true" : "false"; }
+inline std::string AttrStr(int v) { return std::to_string(v); }
+inline std::string AttrStr(int64_t v) { return std::to_string(v); }
+inline std::string AttrStr(uint32_t v) { return std::to_string(v); }
+inline std::string AttrStr(double v) {
+  std::ostringstream os;
+  os << v;
+  return os.str();
+}
+inline std::string AttrStr(const Shape& v) { return v.str(); }
+
+'''
+
+FOOTER = '''\
+}  // namespace op
+}  // namespace mxnet_tpu_cpp
+'''
+
+
+def cpp_ident(name):
+    ident = name
+    if ident in CPP_KEYWORDS:
+        ident += "_arg"
+    return ident
+
+
+def param_type(parser):
+    if parser is registry.pbool:
+        return "bool"
+    if parser is registry.pint:
+        return "int"
+    if parser is registry.pfloat:
+        return "double"
+    if parser in (registry.ptuple, registry.ptuple_or_int):
+        return "Shape"
+    return "const std::string&"  # pstr, pdtype, bespoke parsers
+
+
+def default_literal(parser, default):
+    """(literal, guard) for a param default.
+
+    ``literal`` is the C++ default argument, or None when the param is
+    required in C++ too.  ``guard`` is a condition string: when the
+    param's registry default is None ("unset"), the C++ default is an
+    empty sentinel and Set() is skipped unless the guard holds — so the
+    attr is only sent when the caller provided a value, matching the
+    Python frontend's None-means-omit contract.
+    """
+    t = param_type(parser)
+    if default is None:
+        if t == "Shape":
+            return "Shape{}", "!%s.dims.empty()"
+        if t == "const std::string&":
+            return '""', "!%s.empty()"
+        return None, None  # numeric/bool: no clean sentinel -> required
+    if default is registry.REQUIRED:
+        return None, None
+    if t == "bool":
+        return ("true" if default else "false"), None
+    if t == "int":
+        return str(int(default)), None
+    if t == "double":
+        return repr(float(default)), None
+    if t == "Shape":
+        try:
+            return ("Shape{%s}"
+                    % ", ".join(str(int(d)) for d in default)), None
+        except TypeError:
+            return "Shape{}", "!%s.dims.empty()"
+    return '"%s"' % str(default).replace('"', '\\"'), None
+
+
+def fn_name(op_name):
+    # public ops only reach here; keep the registry spelling
+    return cpp_ident(op_name)
+
+
+def gen_op(op):
+    attrs_for_names = {}
+    for k, (parser, default) in op.params.items():
+        attrs_for_names[k] = None if default is registry.REQUIRED else default
+    try:
+        arg_names = op.list_arguments(attrs_for_names)
+    except Exception:
+        arg_names = ["data"]
+
+    # params: required first (C++ default args must trail), registry order
+    required, optional = [], []
+    for k, (parser, default) in op.params.items():
+        if op.key_var_num_args == k:
+            continue  # derived from the input count below
+        lit, guard = default_literal(parser, default)
+        (optional if lit is not None else required).append(
+            (k, parser, lit, guard))
+    plist = required + optional
+
+    def sig_params(with_defaults):
+        out = []
+        for k, parser, lit, _guard in plist:
+            piece = "%s %s" % (param_type(parser), cpp_ident(k))
+            if with_defaults and lit is not None:
+                piece += " = %s" % lit
+            out.append(piece)
+        return out
+
+    body = ["  KwArgs params_;"]
+    for k, parser, _lit, guard in plist:
+        set_stmt = 'params_.Set("%s", AttrStr(%s));' % (k, cpp_ident(k))
+        if guard is not None:
+            body.append("  if (%s) %s" % (guard % cpp_ident(k), set_stmt))
+        else:
+            body.append("  " + set_stmt)
+    if op.key_var_num_args:
+        body.append('  params_.Set("%s", AttrStr('
+                    "static_cast<int>(inputs.size())));"
+                    % op.key_var_num_args)
+    body.append('  return Symbol::Op("%s", symbol_name, inputs, params_);'
+                % op.name)
+
+    lines = []
+    doc_args = ", ".join(arg_names) if arg_names else "-"
+    lines.append("// %s(%s)" % (op.name, doc_args))
+    sig = ["const std::string& symbol_name",
+           "const std::vector<SymbolHandle>& inputs"] + sig_params(True)
+    lines.append("inline Symbol %s(%s) {" % (fn_name(op.name),
+                                             ",\n    ".join(sig)))
+    lines.extend(body)
+    lines.append("}")
+
+    # single-tensor convenience overload (the overwhelmingly common form)
+    if arg_names and not op.key_var_num_args:
+        sig1 = ["const std::string& symbol_name", "const Symbol& data"] \
+            + sig_params(True)
+        call_args = ["symbol_name",
+                     "std::vector<SymbolHandle>{data.get()}"] + \
+            [cpp_ident(k) for k, _p, _l, _g in plist]
+        lines.append("inline Symbol %s(%s) {" % (fn_name(op.name),
+                                                 ",\n    ".join(sig1)))
+        lines.append("  return %s(%s);" % (fn_name(op.name),
+                                           ", ".join(call_args)))
+        lines.append("}")
+    return "\n".join(lines) + "\n"
+
+
+def main(out=None):
+    names = sorted(n for n in registry._REGISTRY
+                   if not n.startswith("_"))
+    chunks = [HEADER]
+    count = 0
+    for n in names:
+        op = registry.get(n)
+        try:
+            chunks.append(gen_op(op))
+            count += 1
+        except Exception as e:  # pragma: no cover - generator robustness
+            chunks.append("// %s: skipped (%s)\n" % (n, e))
+    chunks.append(FOOTER)
+    if out is None:
+        out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "include", "mxnet_tpu_cpp_ops.hpp")
+    with open(out, "w") as f:
+        f.write("\n".join(chunks))
+    print("wrote %s: %d ops" % (out, count))
+
+
+def _cli():
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=None,
+                    help="output path (default: the committed header; "
+                         "freshness checks pass a temp path and diff)")
+    main(ap.parse_args().out)
+
+
+if __name__ == "__main__":
+    _cli()

@@ -1,0 +1,2269 @@
+// GENERATED FILE — do not edit.
+// python cpp_package/OpWrapperGenerator.py  regenerates from the op
+// registry (mxnet_tpu/ops/registry.py).  Reference analog:
+// cpp-package/include/mxnet-cpp/op.h from OpWrapperGenerator.py.
+//
+// One typed builder per public operator: params are C++-typed and
+// formatted into the string attrs the frontend ABI speaks
+// (include/mxnet_tpu/c_frontend_api.h).  Inputs compose positionally;
+// omitted trailing inputs (weights, aux states) are auto-created as
+// variables at compose time, exactly like the Python frontend.
+
+#pragma once
+
+#include "mxnet_tpu_cpp.hpp"
+
+#include <cstdint>
+#include <sstream>
+#include <string>
+#include <vector>
+
+namespace mxnet_tpu_cpp {
+
+// attr-string shape literal: Shape{3, 3} -> "(3, 3)"
+struct Shape {
+  std::vector<int> dims;
+  Shape() = default;
+  Shape(std::initializer_list<int> d) : dims(d) {}
+  explicit Shape(const std::vector<int>& d) : dims(d) {}
+  std::string str() const {
+    std::ostringstream os;
+    os << "(";
+    for (size_t i = 0; i < dims.size(); ++i) {
+      if (i) os << ", ";
+      os << dims[i];
+    }
+    os << ")";
+    return os.str();
+  }
+};
+
+namespace op {
+
+inline std::string AttrStr(const std::string& v) { return v; }
+inline std::string AttrStr(const char* v) { return v; }
+inline std::string AttrStr(bool v) { return v ? "true" : "false"; }
+inline std::string AttrStr(int v) { return std::to_string(v); }
+inline std::string AttrStr(int64_t v) { return std::to_string(v); }
+inline std::string AttrStr(uint32_t v) { return std::to_string(v); }
+inline std::string AttrStr(double v) {
+  std::ostringstream os;
+  os << v;
+  return os.str();
+}
+inline std::string AttrStr(const Shape& v) { return v.str(); }
+
+
+// Activation(data)
+inline Symbol Activation(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs,
+    const std::string& act_type) {
+  KwArgs params_;
+  params_.Set("act_type", AttrStr(act_type));
+  return Symbol::Op("Activation", symbol_name, inputs, params_);
+}
+inline Symbol Activation(const std::string& symbol_name,
+    const Symbol& data,
+    const std::string& act_type) {
+  return Activation(symbol_name, std::vector<SymbolHandle>{data.get()}, act_type);
+}
+
+// BatchNorm(data, gamma, beta)
+inline Symbol BatchNorm(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs,
+    double eps = 0.001,
+    double momentum = 0.9,
+    bool fix_gamma = true,
+    bool use_global_stats = false,
+    bool output_mean_var = false) {
+  KwArgs params_;
+  params_.Set("eps", AttrStr(eps));
+  params_.Set("momentum", AttrStr(momentum));
+  params_.Set("fix_gamma", AttrStr(fix_gamma));
+  params_.Set("use_global_stats", AttrStr(use_global_stats));
+  params_.Set("output_mean_var", AttrStr(output_mean_var));
+  return Symbol::Op("BatchNorm", symbol_name, inputs, params_);
+}
+inline Symbol BatchNorm(const std::string& symbol_name,
+    const Symbol& data,
+    double eps = 0.001,
+    double momentum = 0.9,
+    bool fix_gamma = true,
+    bool use_global_stats = false,
+    bool output_mean_var = false) {
+  return BatchNorm(symbol_name, std::vector<SymbolHandle>{data.get()}, eps, momentum, fix_gamma, use_global_stats, output_mean_var);
+}
+
+// BilinearSampler(data, grid)
+inline Symbol BilinearSampler(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs) {
+  KwArgs params_;
+  return Symbol::Op("BilinearSampler", symbol_name, inputs, params_);
+}
+inline Symbol BilinearSampler(const std::string& symbol_name,
+    const Symbol& data) {
+  return BilinearSampler(symbol_name, std::vector<SymbolHandle>{data.get()});
+}
+
+// BlockGrad(data)
+inline Symbol BlockGrad(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs) {
+  KwArgs params_;
+  return Symbol::Op("BlockGrad", symbol_name, inputs, params_);
+}
+inline Symbol BlockGrad(const std::string& symbol_name,
+    const Symbol& data) {
+  return BlockGrad(symbol_name, std::vector<SymbolHandle>{data.get()});
+}
+
+// CTCLoss(data, label)
+inline Symbol CTCLoss(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs,
+    bool use_data_lengths = false,
+    bool use_label_lengths = false,
+    const std::string& blank_label = "first") {
+  KwArgs params_;
+  params_.Set("use_data_lengths", AttrStr(use_data_lengths));
+  params_.Set("use_label_lengths", AttrStr(use_label_lengths));
+  params_.Set("blank_label", AttrStr(blank_label));
+  return Symbol::Op("CTCLoss", symbol_name, inputs, params_);
+}
+inline Symbol CTCLoss(const std::string& symbol_name,
+    const Symbol& data,
+    bool use_data_lengths = false,
+    bool use_label_lengths = false,
+    const std::string& blank_label = "first") {
+  return CTCLoss(symbol_name, std::vector<SymbolHandle>{data.get()}, use_data_lengths, use_label_lengths, blank_label);
+}
+
+// Cast(data)
+inline Symbol Cast(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs,
+    const std::string& dtype) {
+  KwArgs params_;
+  params_.Set("dtype", AttrStr(dtype));
+  return Symbol::Op("Cast", symbol_name, inputs, params_);
+}
+inline Symbol Cast(const std::string& symbol_name,
+    const Symbol& data,
+    const std::string& dtype) {
+  return Cast(symbol_name, std::vector<SymbolHandle>{data.get()}, dtype);
+}
+
+// Concat(data)
+inline Symbol Concat(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs,
+    int dim = 1) {
+  KwArgs params_;
+  params_.Set("dim", AttrStr(dim));
+  params_.Set("num_args", AttrStr(static_cast<int>(inputs.size())));
+  return Symbol::Op("Concat", symbol_name, inputs, params_);
+}
+
+// Convolution(data, weight, bias)
+inline Symbol Convolution(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs,
+    Shape kernel,
+    int num_filter,
+    Shape stride = Shape{},
+    Shape dilate = Shape{},
+    Shape pad = Shape{},
+    int num_group = 1,
+    int workspace = 1024,
+    bool no_bias = false,
+    const std::string& cudnn_tune = "",
+    bool cudnn_off = false,
+    const std::string& layout = "") {
+  KwArgs params_;
+  params_.Set("kernel", AttrStr(kernel));
+  params_.Set("num_filter", AttrStr(num_filter));
+  params_.Set("stride", AttrStr(stride));
+  params_.Set("dilate", AttrStr(dilate));
+  params_.Set("pad", AttrStr(pad));
+  params_.Set("num_group", AttrStr(num_group));
+  params_.Set("workspace", AttrStr(workspace));
+  params_.Set("no_bias", AttrStr(no_bias));
+  if (!cudnn_tune.empty()) params_.Set("cudnn_tune", AttrStr(cudnn_tune));
+  params_.Set("cudnn_off", AttrStr(cudnn_off));
+  if (!layout.empty()) params_.Set("layout", AttrStr(layout));
+  return Symbol::Op("Convolution", symbol_name, inputs, params_);
+}
+inline Symbol Convolution(const std::string& symbol_name,
+    const Symbol& data,
+    Shape kernel,
+    int num_filter,
+    Shape stride = Shape{},
+    Shape dilate = Shape{},
+    Shape pad = Shape{},
+    int num_group = 1,
+    int workspace = 1024,
+    bool no_bias = false,
+    const std::string& cudnn_tune = "",
+    bool cudnn_off = false,
+    const std::string& layout = "") {
+  return Convolution(symbol_name, std::vector<SymbolHandle>{data.get()}, kernel, num_filter, stride, dilate, pad, num_group, workspace, no_bias, cudnn_tune, cudnn_off, layout);
+}
+
+// Correlation(data1, data2)
+inline Symbol Correlation(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs,
+    int kernel_size = 1,
+    int max_displacement = 1,
+    int stride1 = 1,
+    int stride2 = 1,
+    int pad_size = 0,
+    bool is_multiply = true) {
+  KwArgs params_;
+  params_.Set("kernel_size", AttrStr(kernel_size));
+  params_.Set("max_displacement", AttrStr(max_displacement));
+  params_.Set("stride1", AttrStr(stride1));
+  params_.Set("stride2", AttrStr(stride2));
+  params_.Set("pad_size", AttrStr(pad_size));
+  params_.Set("is_multiply", AttrStr(is_multiply));
+  return Symbol::Op("Correlation", symbol_name, inputs, params_);
+}
+inline Symbol Correlation(const std::string& symbol_name,
+    const Symbol& data,
+    int kernel_size = 1,
+    int max_displacement = 1,
+    int stride1 = 1,
+    int stride2 = 1,
+    int pad_size = 0,
+    bool is_multiply = true) {
+  return Correlation(symbol_name, std::vector<SymbolHandle>{data.get()}, kernel_size, max_displacement, stride1, stride2, pad_size, is_multiply);
+}
+
+// Crop(data)
+inline Symbol Crop(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs,
+    Shape offset = Shape{0, 0},
+    Shape h_w = Shape{0, 0},
+    bool center_crop = false) {
+  KwArgs params_;
+  params_.Set("offset", AttrStr(offset));
+  params_.Set("h_w", AttrStr(h_w));
+  params_.Set("center_crop", AttrStr(center_crop));
+  params_.Set("num_args", AttrStr(static_cast<int>(inputs.size())));
+  return Symbol::Op("Crop", symbol_name, inputs, params_);
+}
+
+// Custom(data)
+inline Symbol Custom(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs,
+    const std::string& op_type) {
+  KwArgs params_;
+  params_.Set("op_type", AttrStr(op_type));
+  return Symbol::Op("Custom", symbol_name, inputs, params_);
+}
+inline Symbol Custom(const std::string& symbol_name,
+    const Symbol& data,
+    const std::string& op_type) {
+  return Custom(symbol_name, std::vector<SymbolHandle>{data.get()}, op_type);
+}
+
+// Deconvolution(data, weight, bias)
+inline Symbol Deconvolution(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs,
+    Shape kernel,
+    int num_filter,
+    Shape stride = Shape{},
+    Shape dilate = Shape{},
+    Shape pad = Shape{},
+    int num_group = 1,
+    int workspace = 1024,
+    bool no_bias = false,
+    const std::string& cudnn_tune = "",
+    bool cudnn_off = false,
+    const std::string& layout = "",
+    Shape adj = Shape{},
+    Shape target_shape = Shape{}) {
+  KwArgs params_;
+  params_.Set("kernel", AttrStr(kernel));
+  params_.Set("num_filter", AttrStr(num_filter));
+  params_.Set("stride", AttrStr(stride));
+  params_.Set("dilate", AttrStr(dilate));
+  params_.Set("pad", AttrStr(pad));
+  params_.Set("num_group", AttrStr(num_group));
+  params_.Set("workspace", AttrStr(workspace));
+  params_.Set("no_bias", AttrStr(no_bias));
+  if (!cudnn_tune.empty()) params_.Set("cudnn_tune", AttrStr(cudnn_tune));
+  params_.Set("cudnn_off", AttrStr(cudnn_off));
+  if (!layout.empty()) params_.Set("layout", AttrStr(layout));
+  params_.Set("adj", AttrStr(adj));
+  params_.Set("target_shape", AttrStr(target_shape));
+  return Symbol::Op("Deconvolution", symbol_name, inputs, params_);
+}
+inline Symbol Deconvolution(const std::string& symbol_name,
+    const Symbol& data,
+    Shape kernel,
+    int num_filter,
+    Shape stride = Shape{},
+    Shape dilate = Shape{},
+    Shape pad = Shape{},
+    int num_group = 1,
+    int workspace = 1024,
+    bool no_bias = false,
+    const std::string& cudnn_tune = "",
+    bool cudnn_off = false,
+    const std::string& layout = "",
+    Shape adj = Shape{},
+    Shape target_shape = Shape{}) {
+  return Deconvolution(symbol_name, std::vector<SymbolHandle>{data.get()}, kernel, num_filter, stride, dilate, pad, num_group, workspace, no_bias, cudnn_tune, cudnn_off, layout, adj, target_shape);
+}
+
+// Dropout(data)
+inline Symbol Dropout(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs,
+    double p = 0.5) {
+  KwArgs params_;
+  params_.Set("p", AttrStr(p));
+  return Symbol::Op("Dropout", symbol_name, inputs, params_);
+}
+inline Symbol Dropout(const std::string& symbol_name,
+    const Symbol& data,
+    double p = 0.5) {
+  return Dropout(symbol_name, std::vector<SymbolHandle>{data.get()}, p);
+}
+
+// Embedding(data, weight)
+inline Symbol Embedding(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs,
+    int input_dim,
+    int output_dim,
+    const std::string& dtype = "float32") {
+  KwArgs params_;
+  params_.Set("input_dim", AttrStr(input_dim));
+  params_.Set("output_dim", AttrStr(output_dim));
+  params_.Set("dtype", AttrStr(dtype));
+  return Symbol::Op("Embedding", symbol_name, inputs, params_);
+}
+inline Symbol Embedding(const std::string& symbol_name,
+    const Symbol& data,
+    int input_dim,
+    int output_dim,
+    const std::string& dtype = "float32") {
+  return Embedding(symbol_name, std::vector<SymbolHandle>{data.get()}, input_dim, output_dim, dtype);
+}
+
+// Flatten(data)
+inline Symbol Flatten(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs) {
+  KwArgs params_;
+  return Symbol::Op("Flatten", symbol_name, inputs, params_);
+}
+inline Symbol Flatten(const std::string& symbol_name,
+    const Symbol& data) {
+  return Flatten(symbol_name, std::vector<SymbolHandle>{data.get()});
+}
+
+// FullyConnected(data, weight, bias)
+inline Symbol FullyConnected(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs,
+    int num_hidden,
+    bool no_bias = false,
+    bool flatten = true) {
+  KwArgs params_;
+  params_.Set("num_hidden", AttrStr(num_hidden));
+  params_.Set("no_bias", AttrStr(no_bias));
+  params_.Set("flatten", AttrStr(flatten));
+  return Symbol::Op("FullyConnected", symbol_name, inputs, params_);
+}
+inline Symbol FullyConnected(const std::string& symbol_name,
+    const Symbol& data,
+    int num_hidden,
+    bool no_bias = false,
+    bool flatten = true) {
+  return FullyConnected(symbol_name, std::vector<SymbolHandle>{data.get()}, num_hidden, no_bias, flatten);
+}
+
+// GridGenerator(data)
+inline Symbol GridGenerator(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs,
+    const std::string& transform_type,
+    Shape target_shape = Shape{0, 0}) {
+  KwArgs params_;
+  params_.Set("transform_type", AttrStr(transform_type));
+  params_.Set("target_shape", AttrStr(target_shape));
+  return Symbol::Op("GridGenerator", symbol_name, inputs, params_);
+}
+inline Symbol GridGenerator(const std::string& symbol_name,
+    const Symbol& data,
+    const std::string& transform_type,
+    Shape target_shape = Shape{0, 0}) {
+  return GridGenerator(symbol_name, std::vector<SymbolHandle>{data.get()}, transform_type, target_shape);
+}
+
+// IdentityAttachKLSparseReg(data)
+inline Symbol IdentityAttachKLSparseReg(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs,
+    double sparseness_target = 0.1,
+    double penalty = 0.001,
+    double momentum = 0.9) {
+  KwArgs params_;
+  params_.Set("sparseness_target", AttrStr(sparseness_target));
+  params_.Set("penalty", AttrStr(penalty));
+  params_.Set("momentum", AttrStr(momentum));
+  return Symbol::Op("IdentityAttachKLSparseReg", symbol_name, inputs, params_);
+}
+inline Symbol IdentityAttachKLSparseReg(const std::string& symbol_name,
+    const Symbol& data,
+    double sparseness_target = 0.1,
+    double penalty = 0.001,
+    double momentum = 0.9) {
+  return IdentityAttachKLSparseReg(symbol_name, std::vector<SymbolHandle>{data.get()}, sparseness_target, penalty, momentum);
+}
+
+// InstanceNorm(data, gamma, beta)
+inline Symbol InstanceNorm(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs,
+    double eps = 0.001) {
+  KwArgs params_;
+  params_.Set("eps", AttrStr(eps));
+  return Symbol::Op("InstanceNorm", symbol_name, inputs, params_);
+}
+inline Symbol InstanceNorm(const std::string& symbol_name,
+    const Symbol& data,
+    double eps = 0.001) {
+  return InstanceNorm(symbol_name, std::vector<SymbolHandle>{data.get()}, eps);
+}
+
+// L2Normalization(data)
+inline Symbol L2Normalization(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs,
+    double eps = 1e-10,
+    const std::string& mode = "instance") {
+  KwArgs params_;
+  params_.Set("eps", AttrStr(eps));
+  params_.Set("mode", AttrStr(mode));
+  return Symbol::Op("L2Normalization", symbol_name, inputs, params_);
+}
+inline Symbol L2Normalization(const std::string& symbol_name,
+    const Symbol& data,
+    double eps = 1e-10,
+    const std::string& mode = "instance") {
+  return L2Normalization(symbol_name, std::vector<SymbolHandle>{data.get()}, eps, mode);
+}
+
+// LRN(data)
+inline Symbol LRN(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs,
+    int nsize,
+    double alpha = 0.0001,
+    double beta = 0.75,
+    double knorm = 2.0) {
+  KwArgs params_;
+  params_.Set("nsize", AttrStr(nsize));
+  params_.Set("alpha", AttrStr(alpha));
+  params_.Set("beta", AttrStr(beta));
+  params_.Set("knorm", AttrStr(knorm));
+  return Symbol::Op("LRN", symbol_name, inputs, params_);
+}
+inline Symbol LRN(const std::string& symbol_name,
+    const Symbol& data,
+    int nsize,
+    double alpha = 0.0001,
+    double beta = 0.75,
+    double knorm = 2.0) {
+  return LRN(symbol_name, std::vector<SymbolHandle>{data.get()}, nsize, alpha, beta, knorm);
+}
+
+// LeakyReLU(data)
+inline Symbol LeakyReLU(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs,
+    const std::string& act_type = "leaky",
+    double slope = 0.25,
+    double lower_bound = 0.125,
+    double upper_bound = 0.334) {
+  KwArgs params_;
+  params_.Set("act_type", AttrStr(act_type));
+  params_.Set("slope", AttrStr(slope));
+  params_.Set("lower_bound", AttrStr(lower_bound));
+  params_.Set("upper_bound", AttrStr(upper_bound));
+  return Symbol::Op("LeakyReLU", symbol_name, inputs, params_);
+}
+inline Symbol LeakyReLU(const std::string& symbol_name,
+    const Symbol& data,
+    const std::string& act_type = "leaky",
+    double slope = 0.25,
+    double lower_bound = 0.125,
+    double upper_bound = 0.334) {
+  return LeakyReLU(symbol_name, std::vector<SymbolHandle>{data.get()}, act_type, slope, lower_bound, upper_bound);
+}
+
+// LinearRegressionOutput(data, label)
+inline Symbol LinearRegressionOutput(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs,
+    double grad_scale = 1.0) {
+  KwArgs params_;
+  params_.Set("grad_scale", AttrStr(grad_scale));
+  return Symbol::Op("LinearRegressionOutput", symbol_name, inputs, params_);
+}
+inline Symbol LinearRegressionOutput(const std::string& symbol_name,
+    const Symbol& data,
+    double grad_scale = 1.0) {
+  return LinearRegressionOutput(symbol_name, std::vector<SymbolHandle>{data.get()}, grad_scale);
+}
+
+// LogisticRegressionOutput(data, label)
+inline Symbol LogisticRegressionOutput(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs,
+    double grad_scale = 1.0) {
+  KwArgs params_;
+  params_.Set("grad_scale", AttrStr(grad_scale));
+  return Symbol::Op("LogisticRegressionOutput", symbol_name, inputs, params_);
+}
+inline Symbol LogisticRegressionOutput(const std::string& symbol_name,
+    const Symbol& data,
+    double grad_scale = 1.0) {
+  return LogisticRegressionOutput(symbol_name, std::vector<SymbolHandle>{data.get()}, grad_scale);
+}
+
+// MAERegressionOutput(data, label)
+inline Symbol MAERegressionOutput(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs,
+    double grad_scale = 1.0) {
+  KwArgs params_;
+  params_.Set("grad_scale", AttrStr(grad_scale));
+  return Symbol::Op("MAERegressionOutput", symbol_name, inputs, params_);
+}
+inline Symbol MAERegressionOutput(const std::string& symbol_name,
+    const Symbol& data,
+    double grad_scale = 1.0) {
+  return MAERegressionOutput(symbol_name, std::vector<SymbolHandle>{data.get()}, grad_scale);
+}
+
+// MakeLoss(data)
+inline Symbol MakeLoss(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs,
+    double grad_scale = 1.0,
+    double valid_thresh = 0.0,
+    const std::string& normalization = "null") {
+  KwArgs params_;
+  params_.Set("grad_scale", AttrStr(grad_scale));
+  params_.Set("valid_thresh", AttrStr(valid_thresh));
+  params_.Set("normalization", AttrStr(normalization));
+  return Symbol::Op("MakeLoss", symbol_name, inputs, params_);
+}
+inline Symbol MakeLoss(const std::string& symbol_name,
+    const Symbol& data,
+    double grad_scale = 1.0,
+    double valid_thresh = 0.0,
+    const std::string& normalization = "null") {
+  return MakeLoss(symbol_name, std::vector<SymbolHandle>{data.get()}, grad_scale, valid_thresh, normalization);
+}
+
+// Pad(data)
+inline Symbol Pad(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs,
+    Shape pad_width,
+    const std::string& mode = "constant",
+    double constant_value = 0.0) {
+  KwArgs params_;
+  params_.Set("pad_width", AttrStr(pad_width));
+  params_.Set("mode", AttrStr(mode));
+  params_.Set("constant_value", AttrStr(constant_value));
+  return Symbol::Op("Pad", symbol_name, inputs, params_);
+}
+inline Symbol Pad(const std::string& symbol_name,
+    const Symbol& data,
+    Shape pad_width,
+    const std::string& mode = "constant",
+    double constant_value = 0.0) {
+  return Pad(symbol_name, std::vector<SymbolHandle>{data.get()}, pad_width, mode, constant_value);
+}
+
+// Pooling(data)
+inline Symbol Pooling(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs,
+    Shape kernel = Shape{},
+    const std::string& pool_type = "max",
+    bool global_pool = false,
+    Shape stride = Shape{},
+    Shape pad = Shape{},
+    const std::string& pooling_convention = "valid") {
+  KwArgs params_;
+  params_.Set("kernel", AttrStr(kernel));
+  params_.Set("pool_type", AttrStr(pool_type));
+  params_.Set("global_pool", AttrStr(global_pool));
+  params_.Set("stride", AttrStr(stride));
+  params_.Set("pad", AttrStr(pad));
+  params_.Set("pooling_convention", AttrStr(pooling_convention));
+  return Symbol::Op("Pooling", symbol_name, inputs, params_);
+}
+inline Symbol Pooling(const std::string& symbol_name,
+    const Symbol& data,
+    Shape kernel = Shape{},
+    const std::string& pool_type = "max",
+    bool global_pool = false,
+    Shape stride = Shape{},
+    Shape pad = Shape{},
+    const std::string& pooling_convention = "valid") {
+  return Pooling(symbol_name, std::vector<SymbolHandle>{data.get()}, kernel, pool_type, global_pool, stride, pad, pooling_convention);
+}
+
+// RNN(data, parameters, state)
+inline Symbol RNN(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs,
+    int state_size,
+    int num_layers,
+    const std::string& mode,
+    bool bidirectional = false,
+    double p = 0.0,
+    bool state_outputs = false,
+    double pkeep_ = 1.0,
+    bool lstm_q_ = false) {
+  KwArgs params_;
+  params_.Set("state_size", AttrStr(state_size));
+  params_.Set("num_layers", AttrStr(num_layers));
+  params_.Set("mode", AttrStr(mode));
+  params_.Set("bidirectional", AttrStr(bidirectional));
+  params_.Set("p", AttrStr(p));
+  params_.Set("state_outputs", AttrStr(state_outputs));
+  params_.Set("pkeep_", AttrStr(pkeep_));
+  params_.Set("lstm_q_", AttrStr(lstm_q_));
+  return Symbol::Op("RNN", symbol_name, inputs, params_);
+}
+inline Symbol RNN(const std::string& symbol_name,
+    const Symbol& data,
+    int state_size,
+    int num_layers,
+    const std::string& mode,
+    bool bidirectional = false,
+    double p = 0.0,
+    bool state_outputs = false,
+    double pkeep_ = 1.0,
+    bool lstm_q_ = false) {
+  return RNN(symbol_name, std::vector<SymbolHandle>{data.get()}, state_size, num_layers, mode, bidirectional, p, state_outputs, pkeep_, lstm_q_);
+}
+
+// ROIPooling(data, rois)
+inline Symbol ROIPooling(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs,
+    Shape pooled_size,
+    double spatial_scale) {
+  KwArgs params_;
+  params_.Set("pooled_size", AttrStr(pooled_size));
+  params_.Set("spatial_scale", AttrStr(spatial_scale));
+  return Symbol::Op("ROIPooling", symbol_name, inputs, params_);
+}
+inline Symbol ROIPooling(const std::string& symbol_name,
+    const Symbol& data,
+    Shape pooled_size,
+    double spatial_scale) {
+  return ROIPooling(symbol_name, std::vector<SymbolHandle>{data.get()}, pooled_size, spatial_scale);
+}
+
+// Reshape(data)
+inline Symbol Reshape(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs,
+    Shape shape = Shape{},
+    Shape target_shape = Shape{},
+    bool keep_highest = false,
+    bool reverse = false) {
+  KwArgs params_;
+  params_.Set("shape", AttrStr(shape));
+  params_.Set("target_shape", AttrStr(target_shape));
+  params_.Set("keep_highest", AttrStr(keep_highest));
+  params_.Set("reverse", AttrStr(reverse));
+  return Symbol::Op("Reshape", symbol_name, inputs, params_);
+}
+inline Symbol Reshape(const std::string& symbol_name,
+    const Symbol& data,
+    Shape shape = Shape{},
+    Shape target_shape = Shape{},
+    bool keep_highest = false,
+    bool reverse = false) {
+  return Reshape(symbol_name, std::vector<SymbolHandle>{data.get()}, shape, target_shape, keep_highest, reverse);
+}
+
+// SVMOutput(data, label)
+inline Symbol SVMOutput(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs,
+    double margin = 1.0,
+    double regularization_coefficient = 1.0,
+    bool use_linear = false) {
+  KwArgs params_;
+  params_.Set("margin", AttrStr(margin));
+  params_.Set("regularization_coefficient", AttrStr(regularization_coefficient));
+  params_.Set("use_linear", AttrStr(use_linear));
+  return Symbol::Op("SVMOutput", symbol_name, inputs, params_);
+}
+inline Symbol SVMOutput(const std::string& symbol_name,
+    const Symbol& data,
+    double margin = 1.0,
+    double regularization_coefficient = 1.0,
+    bool use_linear = false) {
+  return SVMOutput(symbol_name, std::vector<SymbolHandle>{data.get()}, margin, regularization_coefficient, use_linear);
+}
+
+// SequenceLast(data)
+inline Symbol SequenceLast(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs,
+    bool use_sequence_length = false) {
+  KwArgs params_;
+  params_.Set("use_sequence_length", AttrStr(use_sequence_length));
+  return Symbol::Op("SequenceLast", symbol_name, inputs, params_);
+}
+inline Symbol SequenceLast(const std::string& symbol_name,
+    const Symbol& data,
+    bool use_sequence_length = false) {
+  return SequenceLast(symbol_name, std::vector<SymbolHandle>{data.get()}, use_sequence_length);
+}
+
+// SequenceMask(data)
+inline Symbol SequenceMask(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs,
+    bool use_sequence_length = false,
+    double value = 0.0) {
+  KwArgs params_;
+  params_.Set("use_sequence_length", AttrStr(use_sequence_length));
+  params_.Set("value", AttrStr(value));
+  return Symbol::Op("SequenceMask", symbol_name, inputs, params_);
+}
+inline Symbol SequenceMask(const std::string& symbol_name,
+    const Symbol& data,
+    bool use_sequence_length = false,
+    double value = 0.0) {
+  return SequenceMask(symbol_name, std::vector<SymbolHandle>{data.get()}, use_sequence_length, value);
+}
+
+// SequenceReverse(data)
+inline Symbol SequenceReverse(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs,
+    bool use_sequence_length = false) {
+  KwArgs params_;
+  params_.Set("use_sequence_length", AttrStr(use_sequence_length));
+  return Symbol::Op("SequenceReverse", symbol_name, inputs, params_);
+}
+inline Symbol SequenceReverse(const std::string& symbol_name,
+    const Symbol& data,
+    bool use_sequence_length = false) {
+  return SequenceReverse(symbol_name, std::vector<SymbolHandle>{data.get()}, use_sequence_length);
+}
+
+// SliceChannel(data)
+inline Symbol SliceChannel(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs,
+    int num_outputs,
+    int axis_arg = 1,
+    bool squeeze_axis = false) {
+  KwArgs params_;
+  params_.Set("num_outputs", AttrStr(num_outputs));
+  params_.Set("axis", AttrStr(axis_arg));
+  params_.Set("squeeze_axis", AttrStr(squeeze_axis));
+  return Symbol::Op("SliceChannel", symbol_name, inputs, params_);
+}
+inline Symbol SliceChannel(const std::string& symbol_name,
+    const Symbol& data,
+    int num_outputs,
+    int axis_arg = 1,
+    bool squeeze_axis = false) {
+  return SliceChannel(symbol_name, std::vector<SymbolHandle>{data.get()}, num_outputs, axis_arg, squeeze_axis);
+}
+
+// SoftmaxActivation(data)
+inline Symbol SoftmaxActivation(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs,
+    const std::string& mode = "instance") {
+  KwArgs params_;
+  params_.Set("mode", AttrStr(mode));
+  return Symbol::Op("SoftmaxActivation", symbol_name, inputs, params_);
+}
+inline Symbol SoftmaxActivation(const std::string& symbol_name,
+    const Symbol& data,
+    const std::string& mode = "instance") {
+  return SoftmaxActivation(symbol_name, std::vector<SymbolHandle>{data.get()}, mode);
+}
+
+// SoftmaxOutput(data, label)
+inline Symbol SoftmaxOutput(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs,
+    double grad_scale = 1.0,
+    double ignore_label = -1.0,
+    bool multi_output = false,
+    bool use_ignore = false,
+    bool preserve_shape = false,
+    const std::string& normalization = "null",
+    bool out_grad = false) {
+  KwArgs params_;
+  params_.Set("grad_scale", AttrStr(grad_scale));
+  params_.Set("ignore_label", AttrStr(ignore_label));
+  params_.Set("multi_output", AttrStr(multi_output));
+  params_.Set("use_ignore", AttrStr(use_ignore));
+  params_.Set("preserve_shape", AttrStr(preserve_shape));
+  params_.Set("normalization", AttrStr(normalization));
+  params_.Set("out_grad", AttrStr(out_grad));
+  return Symbol::Op("SoftmaxOutput", symbol_name, inputs, params_);
+}
+inline Symbol SoftmaxOutput(const std::string& symbol_name,
+    const Symbol& data,
+    double grad_scale = 1.0,
+    double ignore_label = -1.0,
+    bool multi_output = false,
+    bool use_ignore = false,
+    bool preserve_shape = false,
+    const std::string& normalization = "null",
+    bool out_grad = false) {
+  return SoftmaxOutput(symbol_name, std::vector<SymbolHandle>{data.get()}, grad_scale, ignore_label, multi_output, use_ignore, preserve_shape, normalization, out_grad);
+}
+
+// SpatialTransformer(data, loc)
+inline Symbol SpatialTransformer(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs,
+    Shape target_shape = Shape{0, 0},
+    const std::string& transform_type = "affine",
+    const std::string& sampler_type = "bilinear") {
+  KwArgs params_;
+  params_.Set("target_shape", AttrStr(target_shape));
+  params_.Set("transform_type", AttrStr(transform_type));
+  params_.Set("sampler_type", AttrStr(sampler_type));
+  return Symbol::Op("SpatialTransformer", symbol_name, inputs, params_);
+}
+inline Symbol SpatialTransformer(const std::string& symbol_name,
+    const Symbol& data,
+    Shape target_shape = Shape{0, 0},
+    const std::string& transform_type = "affine",
+    const std::string& sampler_type = "bilinear") {
+  return SpatialTransformer(symbol_name, std::vector<SymbolHandle>{data.get()}, target_shape, transform_type, sampler_type);
+}
+
+// SwapAxis(data)
+inline Symbol SwapAxis(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs,
+    int dim1 = 0,
+    int dim2 = 0) {
+  KwArgs params_;
+  params_.Set("dim1", AttrStr(dim1));
+  params_.Set("dim2", AttrStr(dim2));
+  return Symbol::Op("SwapAxis", symbol_name, inputs, params_);
+}
+inline Symbol SwapAxis(const std::string& symbol_name,
+    const Symbol& data,
+    int dim1 = 0,
+    int dim2 = 0) {
+  return SwapAxis(symbol_name, std::vector<SymbolHandle>{data.get()}, dim1, dim2);
+}
+
+// TorchCriterion(data, label)
+inline Symbol TorchCriterion(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs,
+    const std::string& lua_string) {
+  KwArgs params_;
+  params_.Set("lua_string", AttrStr(lua_string));
+  return Symbol::Op("TorchCriterion", symbol_name, inputs, params_);
+}
+inline Symbol TorchCriterion(const std::string& symbol_name,
+    const Symbol& data,
+    const std::string& lua_string) {
+  return TorchCriterion(symbol_name, std::vector<SymbolHandle>{data.get()}, lua_string);
+}
+
+// TorchModule(data)
+inline Symbol TorchModule(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs,
+    const std::string& lua_string,
+    int num_data = 1,
+    int num_params = -1,
+    int num_outputs = 1) {
+  KwArgs params_;
+  params_.Set("lua_string", AttrStr(lua_string));
+  params_.Set("num_data", AttrStr(num_data));
+  params_.Set("num_params", AttrStr(num_params));
+  params_.Set("num_outputs", AttrStr(num_outputs));
+  return Symbol::Op("TorchModule", symbol_name, inputs, params_);
+}
+inline Symbol TorchModule(const std::string& symbol_name,
+    const Symbol& data,
+    const std::string& lua_string,
+    int num_data = 1,
+    int num_params = -1,
+    int num_outputs = 1) {
+  return TorchModule(symbol_name, std::vector<SymbolHandle>{data.get()}, lua_string, num_data, num_params, num_outputs);
+}
+
+// UpSampling(data, weight)
+inline Symbol UpSampling(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs,
+    int scale,
+    const std::string& sample_type,
+    int num_filter = 0,
+    const std::string& multi_input_mode = "concat",
+    int workspace = 512) {
+  KwArgs params_;
+  params_.Set("scale", AttrStr(scale));
+  params_.Set("sample_type", AttrStr(sample_type));
+  params_.Set("num_filter", AttrStr(num_filter));
+  params_.Set("multi_input_mode", AttrStr(multi_input_mode));
+  params_.Set("workspace", AttrStr(workspace));
+  params_.Set("num_args", AttrStr(static_cast<int>(inputs.size())));
+  return Symbol::Op("UpSampling", symbol_name, inputs, params_);
+}
+
+// abs(data)
+inline Symbol abs(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs) {
+  KwArgs params_;
+  return Symbol::Op("abs", symbol_name, inputs, params_);
+}
+inline Symbol abs(const std::string& symbol_name,
+    const Symbol& data) {
+  return abs(symbol_name, std::vector<SymbolHandle>{data.get()});
+}
+
+// adam_update(weight, grad, mean, var)
+inline Symbol adam_update(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs,
+    double lr,
+    double wd = 0.0,
+    double rescale_grad = 1.0,
+    double clip_gradient = -1.0,
+    double beta1 = 0.9,
+    double beta2 = 0.999,
+    double epsilon = 1e-08) {
+  KwArgs params_;
+  params_.Set("lr", AttrStr(lr));
+  params_.Set("wd", AttrStr(wd));
+  params_.Set("rescale_grad", AttrStr(rescale_grad));
+  params_.Set("clip_gradient", AttrStr(clip_gradient));
+  params_.Set("beta1", AttrStr(beta1));
+  params_.Set("beta2", AttrStr(beta2));
+  params_.Set("epsilon", AttrStr(epsilon));
+  return Symbol::Op("adam_update", symbol_name, inputs, params_);
+}
+inline Symbol adam_update(const std::string& symbol_name,
+    const Symbol& data,
+    double lr,
+    double wd = 0.0,
+    double rescale_grad = 1.0,
+    double clip_gradient = -1.0,
+    double beta1 = 0.9,
+    double beta2 = 0.999,
+    double epsilon = 1e-08) {
+  return adam_update(symbol_name, std::vector<SymbolHandle>{data.get()}, lr, wd, rescale_grad, clip_gradient, beta1, beta2, epsilon);
+}
+
+// add_n(data)
+inline Symbol add_n(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs) {
+  KwArgs params_;
+  params_.Set("num_args", AttrStr(static_cast<int>(inputs.size())));
+  return Symbol::Op("add_n", symbol_name, inputs, params_);
+}
+
+// arccos(data)
+inline Symbol arccos(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs) {
+  KwArgs params_;
+  return Symbol::Op("arccos", symbol_name, inputs, params_);
+}
+inline Symbol arccos(const std::string& symbol_name,
+    const Symbol& data) {
+  return arccos(symbol_name, std::vector<SymbolHandle>{data.get()});
+}
+
+// arccosh(data)
+inline Symbol arccosh(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs) {
+  KwArgs params_;
+  return Symbol::Op("arccosh", symbol_name, inputs, params_);
+}
+inline Symbol arccosh(const std::string& symbol_name,
+    const Symbol& data) {
+  return arccosh(symbol_name, std::vector<SymbolHandle>{data.get()});
+}
+
+// arcsin(data)
+inline Symbol arcsin(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs) {
+  KwArgs params_;
+  return Symbol::Op("arcsin", symbol_name, inputs, params_);
+}
+inline Symbol arcsin(const std::string& symbol_name,
+    const Symbol& data) {
+  return arcsin(symbol_name, std::vector<SymbolHandle>{data.get()});
+}
+
+// arcsinh(data)
+inline Symbol arcsinh(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs) {
+  KwArgs params_;
+  return Symbol::Op("arcsinh", symbol_name, inputs, params_);
+}
+inline Symbol arcsinh(const std::string& symbol_name,
+    const Symbol& data) {
+  return arcsinh(symbol_name, std::vector<SymbolHandle>{data.get()});
+}
+
+// arctan(data)
+inline Symbol arctan(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs) {
+  KwArgs params_;
+  return Symbol::Op("arctan", symbol_name, inputs, params_);
+}
+inline Symbol arctan(const std::string& symbol_name,
+    const Symbol& data) {
+  return arctan(symbol_name, std::vector<SymbolHandle>{data.get()});
+}
+
+// arctanh(data)
+inline Symbol arctanh(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs) {
+  KwArgs params_;
+  return Symbol::Op("arctanh", symbol_name, inputs, params_);
+}
+inline Symbol arctanh(const std::string& symbol_name,
+    const Symbol& data) {
+  return arctanh(symbol_name, std::vector<SymbolHandle>{data.get()});
+}
+
+// argmax(data)
+inline Symbol argmax(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs,
+    const std::string& axis_arg = "",
+    bool keepdims = false) {
+  KwArgs params_;
+  if (!axis_arg.empty()) params_.Set("axis", AttrStr(axis_arg));
+  params_.Set("keepdims", AttrStr(keepdims));
+  return Symbol::Op("argmax", symbol_name, inputs, params_);
+}
+inline Symbol argmax(const std::string& symbol_name,
+    const Symbol& data,
+    const std::string& axis_arg = "",
+    bool keepdims = false) {
+  return argmax(symbol_name, std::vector<SymbolHandle>{data.get()}, axis_arg, keepdims);
+}
+
+// argmax_channel(data)
+inline Symbol argmax_channel(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs) {
+  KwArgs params_;
+  return Symbol::Op("argmax_channel", symbol_name, inputs, params_);
+}
+inline Symbol argmax_channel(const std::string& symbol_name,
+    const Symbol& data) {
+  return argmax_channel(symbol_name, std::vector<SymbolHandle>{data.get()});
+}
+
+// argmin(data)
+inline Symbol argmin(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs,
+    const std::string& axis_arg = "",
+    bool keepdims = false) {
+  KwArgs params_;
+  if (!axis_arg.empty()) params_.Set("axis", AttrStr(axis_arg));
+  params_.Set("keepdims", AttrStr(keepdims));
+  return Symbol::Op("argmin", symbol_name, inputs, params_);
+}
+inline Symbol argmin(const std::string& symbol_name,
+    const Symbol& data,
+    const std::string& axis_arg = "",
+    bool keepdims = false) {
+  return argmin(symbol_name, std::vector<SymbolHandle>{data.get()}, axis_arg, keepdims);
+}
+
+// argsort(data)
+inline Symbol argsort(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs,
+    const std::string& axis_arg = "-1",
+    bool is_ascend = true) {
+  KwArgs params_;
+  params_.Set("axis", AttrStr(axis_arg));
+  params_.Set("is_ascend", AttrStr(is_ascend));
+  return Symbol::Op("argsort", symbol_name, inputs, params_);
+}
+inline Symbol argsort(const std::string& symbol_name,
+    const Symbol& data,
+    const std::string& axis_arg = "-1",
+    bool is_ascend = true) {
+  return argsort(symbol_name, std::vector<SymbolHandle>{data.get()}, axis_arg, is_ascend);
+}
+
+// batch_dot(lhs, rhs)
+inline Symbol batch_dot(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs,
+    bool transpose_a = false,
+    bool transpose_b = false) {
+  KwArgs params_;
+  params_.Set("transpose_a", AttrStr(transpose_a));
+  params_.Set("transpose_b", AttrStr(transpose_b));
+  return Symbol::Op("batch_dot", symbol_name, inputs, params_);
+}
+inline Symbol batch_dot(const std::string& symbol_name,
+    const Symbol& data,
+    bool transpose_a = false,
+    bool transpose_b = false) {
+  return batch_dot(symbol_name, std::vector<SymbolHandle>{data.get()}, transpose_a, transpose_b);
+}
+
+// batch_take(a, indices)
+inline Symbol batch_take(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs) {
+  KwArgs params_;
+  return Symbol::Op("batch_take", symbol_name, inputs, params_);
+}
+inline Symbol batch_take(const std::string& symbol_name,
+    const Symbol& data) {
+  return batch_take(symbol_name, std::vector<SymbolHandle>{data.get()});
+}
+
+// broadcast_add(lhs, rhs)
+inline Symbol broadcast_add(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs) {
+  KwArgs params_;
+  return Symbol::Op("broadcast_add", symbol_name, inputs, params_);
+}
+inline Symbol broadcast_add(const std::string& symbol_name,
+    const Symbol& data) {
+  return broadcast_add(symbol_name, std::vector<SymbolHandle>{data.get()});
+}
+
+// broadcast_axis(data)
+inline Symbol broadcast_axis(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs,
+    Shape axis_arg,
+    Shape size) {
+  KwArgs params_;
+  params_.Set("axis", AttrStr(axis_arg));
+  params_.Set("size", AttrStr(size));
+  return Symbol::Op("broadcast_axis", symbol_name, inputs, params_);
+}
+inline Symbol broadcast_axis(const std::string& symbol_name,
+    const Symbol& data,
+    Shape axis_arg,
+    Shape size) {
+  return broadcast_axis(symbol_name, std::vector<SymbolHandle>{data.get()}, axis_arg, size);
+}
+
+// broadcast_div(lhs, rhs)
+inline Symbol broadcast_div(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs) {
+  KwArgs params_;
+  return Symbol::Op("broadcast_div", symbol_name, inputs, params_);
+}
+inline Symbol broadcast_div(const std::string& symbol_name,
+    const Symbol& data) {
+  return broadcast_div(symbol_name, std::vector<SymbolHandle>{data.get()});
+}
+
+// broadcast_equal(lhs, rhs)
+inline Symbol broadcast_equal(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs) {
+  KwArgs params_;
+  return Symbol::Op("broadcast_equal", symbol_name, inputs, params_);
+}
+inline Symbol broadcast_equal(const std::string& symbol_name,
+    const Symbol& data) {
+  return broadcast_equal(symbol_name, std::vector<SymbolHandle>{data.get()});
+}
+
+// broadcast_greater(lhs, rhs)
+inline Symbol broadcast_greater(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs) {
+  KwArgs params_;
+  return Symbol::Op("broadcast_greater", symbol_name, inputs, params_);
+}
+inline Symbol broadcast_greater(const std::string& symbol_name,
+    const Symbol& data) {
+  return broadcast_greater(symbol_name, std::vector<SymbolHandle>{data.get()});
+}
+
+// broadcast_greater_equal(lhs, rhs)
+inline Symbol broadcast_greater_equal(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs) {
+  KwArgs params_;
+  return Symbol::Op("broadcast_greater_equal", symbol_name, inputs, params_);
+}
+inline Symbol broadcast_greater_equal(const std::string& symbol_name,
+    const Symbol& data) {
+  return broadcast_greater_equal(symbol_name, std::vector<SymbolHandle>{data.get()});
+}
+
+// broadcast_hypot(lhs, rhs)
+inline Symbol broadcast_hypot(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs) {
+  KwArgs params_;
+  return Symbol::Op("broadcast_hypot", symbol_name, inputs, params_);
+}
+inline Symbol broadcast_hypot(const std::string& symbol_name,
+    const Symbol& data) {
+  return broadcast_hypot(symbol_name, std::vector<SymbolHandle>{data.get()});
+}
+
+// broadcast_lesser(lhs, rhs)
+inline Symbol broadcast_lesser(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs) {
+  KwArgs params_;
+  return Symbol::Op("broadcast_lesser", symbol_name, inputs, params_);
+}
+inline Symbol broadcast_lesser(const std::string& symbol_name,
+    const Symbol& data) {
+  return broadcast_lesser(symbol_name, std::vector<SymbolHandle>{data.get()});
+}
+
+// broadcast_lesser_equal(lhs, rhs)
+inline Symbol broadcast_lesser_equal(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs) {
+  KwArgs params_;
+  return Symbol::Op("broadcast_lesser_equal", symbol_name, inputs, params_);
+}
+inline Symbol broadcast_lesser_equal(const std::string& symbol_name,
+    const Symbol& data) {
+  return broadcast_lesser_equal(symbol_name, std::vector<SymbolHandle>{data.get()});
+}
+
+// broadcast_maximum(lhs, rhs)
+inline Symbol broadcast_maximum(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs) {
+  KwArgs params_;
+  return Symbol::Op("broadcast_maximum", symbol_name, inputs, params_);
+}
+inline Symbol broadcast_maximum(const std::string& symbol_name,
+    const Symbol& data) {
+  return broadcast_maximum(symbol_name, std::vector<SymbolHandle>{data.get()});
+}
+
+// broadcast_minimum(lhs, rhs)
+inline Symbol broadcast_minimum(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs) {
+  KwArgs params_;
+  return Symbol::Op("broadcast_minimum", symbol_name, inputs, params_);
+}
+inline Symbol broadcast_minimum(const std::string& symbol_name,
+    const Symbol& data) {
+  return broadcast_minimum(symbol_name, std::vector<SymbolHandle>{data.get()});
+}
+
+// broadcast_mul(lhs, rhs)
+inline Symbol broadcast_mul(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs) {
+  KwArgs params_;
+  return Symbol::Op("broadcast_mul", symbol_name, inputs, params_);
+}
+inline Symbol broadcast_mul(const std::string& symbol_name,
+    const Symbol& data) {
+  return broadcast_mul(symbol_name, std::vector<SymbolHandle>{data.get()});
+}
+
+// broadcast_not_equal(lhs, rhs)
+inline Symbol broadcast_not_equal(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs) {
+  KwArgs params_;
+  return Symbol::Op("broadcast_not_equal", symbol_name, inputs, params_);
+}
+inline Symbol broadcast_not_equal(const std::string& symbol_name,
+    const Symbol& data) {
+  return broadcast_not_equal(symbol_name, std::vector<SymbolHandle>{data.get()});
+}
+
+// broadcast_power(lhs, rhs)
+inline Symbol broadcast_power(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs) {
+  KwArgs params_;
+  return Symbol::Op("broadcast_power", symbol_name, inputs, params_);
+}
+inline Symbol broadcast_power(const std::string& symbol_name,
+    const Symbol& data) {
+  return broadcast_power(symbol_name, std::vector<SymbolHandle>{data.get()});
+}
+
+// broadcast_sub(lhs, rhs)
+inline Symbol broadcast_sub(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs) {
+  KwArgs params_;
+  return Symbol::Op("broadcast_sub", symbol_name, inputs, params_);
+}
+inline Symbol broadcast_sub(const std::string& symbol_name,
+    const Symbol& data) {
+  return broadcast_sub(symbol_name, std::vector<SymbolHandle>{data.get()});
+}
+
+// broadcast_to(data)
+inline Symbol broadcast_to(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs,
+    Shape shape) {
+  KwArgs params_;
+  params_.Set("shape", AttrStr(shape));
+  return Symbol::Op("broadcast_to", symbol_name, inputs, params_);
+}
+inline Symbol broadcast_to(const std::string& symbol_name,
+    const Symbol& data,
+    Shape shape) {
+  return broadcast_to(symbol_name, std::vector<SymbolHandle>{data.get()}, shape);
+}
+
+// ceil(data)
+inline Symbol ceil(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs) {
+  KwArgs params_;
+  return Symbol::Op("ceil", symbol_name, inputs, params_);
+}
+inline Symbol ceil(const std::string& symbol_name,
+    const Symbol& data) {
+  return ceil(symbol_name, std::vector<SymbolHandle>{data.get()});
+}
+
+// clip(data)
+inline Symbol clip(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs,
+    double a_min,
+    double a_max) {
+  KwArgs params_;
+  params_.Set("a_min", AttrStr(a_min));
+  params_.Set("a_max", AttrStr(a_max));
+  return Symbol::Op("clip", symbol_name, inputs, params_);
+}
+inline Symbol clip(const std::string& symbol_name,
+    const Symbol& data,
+    double a_min,
+    double a_max) {
+  return clip(symbol_name, std::vector<SymbolHandle>{data.get()}, a_min, a_max);
+}
+
+// cos(data)
+inline Symbol cos(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs) {
+  KwArgs params_;
+  return Symbol::Op("cos", symbol_name, inputs, params_);
+}
+inline Symbol cos(const std::string& symbol_name,
+    const Symbol& data) {
+  return cos(symbol_name, std::vector<SymbolHandle>{data.get()});
+}
+
+// cosh(data)
+inline Symbol cosh(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs) {
+  KwArgs params_;
+  return Symbol::Op("cosh", symbol_name, inputs, params_);
+}
+inline Symbol cosh(const std::string& symbol_name,
+    const Symbol& data) {
+  return cosh(symbol_name, std::vector<SymbolHandle>{data.get()});
+}
+
+// degrees(data)
+inline Symbol degrees(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs) {
+  KwArgs params_;
+  return Symbol::Op("degrees", symbol_name, inputs, params_);
+}
+inline Symbol degrees(const std::string& symbol_name,
+    const Symbol& data) {
+  return degrees(symbol_name, std::vector<SymbolHandle>{data.get()});
+}
+
+// dot(lhs, rhs)
+inline Symbol dot(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs,
+    bool transpose_a = false,
+    bool transpose_b = false) {
+  KwArgs params_;
+  params_.Set("transpose_a", AttrStr(transpose_a));
+  params_.Set("transpose_b", AttrStr(transpose_b));
+  return Symbol::Op("dot", symbol_name, inputs, params_);
+}
+inline Symbol dot(const std::string& symbol_name,
+    const Symbol& data,
+    bool transpose_a = false,
+    bool transpose_b = false) {
+  return dot(symbol_name, std::vector<SymbolHandle>{data.get()}, transpose_a, transpose_b);
+}
+
+// elemwise_add(lhs, rhs)
+inline Symbol elemwise_add(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs) {
+  KwArgs params_;
+  return Symbol::Op("elemwise_add", symbol_name, inputs, params_);
+}
+inline Symbol elemwise_add(const std::string& symbol_name,
+    const Symbol& data) {
+  return elemwise_add(symbol_name, std::vector<SymbolHandle>{data.get()});
+}
+
+// elemwise_div(lhs, rhs)
+inline Symbol elemwise_div(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs) {
+  KwArgs params_;
+  return Symbol::Op("elemwise_div", symbol_name, inputs, params_);
+}
+inline Symbol elemwise_div(const std::string& symbol_name,
+    const Symbol& data) {
+  return elemwise_div(symbol_name, std::vector<SymbolHandle>{data.get()});
+}
+
+// elemwise_mul(lhs, rhs)
+inline Symbol elemwise_mul(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs) {
+  KwArgs params_;
+  return Symbol::Op("elemwise_mul", symbol_name, inputs, params_);
+}
+inline Symbol elemwise_mul(const std::string& symbol_name,
+    const Symbol& data) {
+  return elemwise_mul(symbol_name, std::vector<SymbolHandle>{data.get()});
+}
+
+// elemwise_sub(lhs, rhs)
+inline Symbol elemwise_sub(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs) {
+  KwArgs params_;
+  return Symbol::Op("elemwise_sub", symbol_name, inputs, params_);
+}
+inline Symbol elemwise_sub(const std::string& symbol_name,
+    const Symbol& data) {
+  return elemwise_sub(symbol_name, std::vector<SymbolHandle>{data.get()});
+}
+
+// exp(data)
+inline Symbol exp(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs) {
+  KwArgs params_;
+  return Symbol::Op("exp", symbol_name, inputs, params_);
+}
+inline Symbol exp(const std::string& symbol_name,
+    const Symbol& data) {
+  return exp(symbol_name, std::vector<SymbolHandle>{data.get()});
+}
+
+// expand_dims(data)
+inline Symbol expand_dims(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs,
+    int axis_arg) {
+  KwArgs params_;
+  params_.Set("axis", AttrStr(axis_arg));
+  return Symbol::Op("expand_dims", symbol_name, inputs, params_);
+}
+inline Symbol expand_dims(const std::string& symbol_name,
+    const Symbol& data,
+    int axis_arg) {
+  return expand_dims(symbol_name, std::vector<SymbolHandle>{data.get()}, axis_arg);
+}
+
+// expm1(data)
+inline Symbol expm1(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs) {
+  KwArgs params_;
+  return Symbol::Op("expm1", symbol_name, inputs, params_);
+}
+inline Symbol expm1(const std::string& symbol_name,
+    const Symbol& data) {
+  return expm1(symbol_name, std::vector<SymbolHandle>{data.get()});
+}
+
+// fill_element_0index(lhs, mhs, rhs)
+inline Symbol fill_element_0index(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs) {
+  KwArgs params_;
+  return Symbol::Op("fill_element_0index", symbol_name, inputs, params_);
+}
+inline Symbol fill_element_0index(const std::string& symbol_name,
+    const Symbol& data) {
+  return fill_element_0index(symbol_name, std::vector<SymbolHandle>{data.get()});
+}
+
+// fix(data)
+inline Symbol fix(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs) {
+  KwArgs params_;
+  return Symbol::Op("fix", symbol_name, inputs, params_);
+}
+inline Symbol fix(const std::string& symbol_name,
+    const Symbol& data) {
+  return fix(symbol_name, std::vector<SymbolHandle>{data.get()});
+}
+
+// floor(data)
+inline Symbol floor(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs) {
+  KwArgs params_;
+  return Symbol::Op("floor", symbol_name, inputs, params_);
+}
+inline Symbol floor(const std::string& symbol_name,
+    const Symbol& data) {
+  return floor(symbol_name, std::vector<SymbolHandle>{data.get()});
+}
+
+// gamma(data)
+inline Symbol gamma(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs) {
+  KwArgs params_;
+  return Symbol::Op("gamma", symbol_name, inputs, params_);
+}
+inline Symbol gamma(const std::string& symbol_name,
+    const Symbol& data) {
+  return gamma(symbol_name, std::vector<SymbolHandle>{data.get()});
+}
+
+// gammaln(data)
+inline Symbol gammaln(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs) {
+  KwArgs params_;
+  return Symbol::Op("gammaln", symbol_name, inputs, params_);
+}
+inline Symbol gammaln(const std::string& symbol_name,
+    const Symbol& data) {
+  return gammaln(symbol_name, std::vector<SymbolHandle>{data.get()});
+}
+
+// log(data)
+inline Symbol log(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs) {
+  KwArgs params_;
+  return Symbol::Op("log", symbol_name, inputs, params_);
+}
+inline Symbol log(const std::string& symbol_name,
+    const Symbol& data) {
+  return log(symbol_name, std::vector<SymbolHandle>{data.get()});
+}
+
+// log10(data)
+inline Symbol log10(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs) {
+  KwArgs params_;
+  return Symbol::Op("log10", symbol_name, inputs, params_);
+}
+inline Symbol log10(const std::string& symbol_name,
+    const Symbol& data) {
+  return log10(symbol_name, std::vector<SymbolHandle>{data.get()});
+}
+
+// log1p(data)
+inline Symbol log1p(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs) {
+  KwArgs params_;
+  return Symbol::Op("log1p", symbol_name, inputs, params_);
+}
+inline Symbol log1p(const std::string& symbol_name,
+    const Symbol& data) {
+  return log1p(symbol_name, std::vector<SymbolHandle>{data.get()});
+}
+
+// log2(data)
+inline Symbol log2(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs) {
+  KwArgs params_;
+  return Symbol::Op("log2", symbol_name, inputs, params_);
+}
+inline Symbol log2(const std::string& symbol_name,
+    const Symbol& data) {
+  return log2(symbol_name, std::vector<SymbolHandle>{data.get()});
+}
+
+// log_softmax(data)
+inline Symbol log_softmax(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs,
+    int axis_arg = -1,
+    double temperature = 1.0) {
+  KwArgs params_;
+  params_.Set("axis", AttrStr(axis_arg));
+  params_.Set("temperature", AttrStr(temperature));
+  return Symbol::Op("log_softmax", symbol_name, inputs, params_);
+}
+inline Symbol log_softmax(const std::string& symbol_name,
+    const Symbol& data,
+    int axis_arg = -1,
+    double temperature = 1.0) {
+  return log_softmax(symbol_name, std::vector<SymbolHandle>{data.get()}, axis_arg, temperature);
+}
+
+// make_loss(data)
+inline Symbol make_loss(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs) {
+  KwArgs params_;
+  return Symbol::Op("make_loss", symbol_name, inputs, params_);
+}
+inline Symbol make_loss(const std::string& symbol_name,
+    const Symbol& data) {
+  return make_loss(symbol_name, std::vector<SymbolHandle>{data.get()});
+}
+
+// max(data)
+inline Symbol max(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs,
+    const std::string& axis_arg = "",
+    bool keepdims = false,
+    bool exclude = false) {
+  KwArgs params_;
+  if (!axis_arg.empty()) params_.Set("axis", AttrStr(axis_arg));
+  params_.Set("keepdims", AttrStr(keepdims));
+  params_.Set("exclude", AttrStr(exclude));
+  return Symbol::Op("max", symbol_name, inputs, params_);
+}
+inline Symbol max(const std::string& symbol_name,
+    const Symbol& data,
+    const std::string& axis_arg = "",
+    bool keepdims = false,
+    bool exclude = false) {
+  return max(symbol_name, std::vector<SymbolHandle>{data.get()}, axis_arg, keepdims, exclude);
+}
+
+// mean(data)
+inline Symbol mean(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs,
+    const std::string& axis_arg = "",
+    bool keepdims = false,
+    bool exclude = false) {
+  KwArgs params_;
+  if (!axis_arg.empty()) params_.Set("axis", AttrStr(axis_arg));
+  params_.Set("keepdims", AttrStr(keepdims));
+  params_.Set("exclude", AttrStr(exclude));
+  return Symbol::Op("mean", symbol_name, inputs, params_);
+}
+inline Symbol mean(const std::string& symbol_name,
+    const Symbol& data,
+    const std::string& axis_arg = "",
+    bool keepdims = false,
+    bool exclude = false) {
+  return mean(symbol_name, std::vector<SymbolHandle>{data.get()}, axis_arg, keepdims, exclude);
+}
+
+// min(data)
+inline Symbol min(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs,
+    const std::string& axis_arg = "",
+    bool keepdims = false,
+    bool exclude = false) {
+  KwArgs params_;
+  if (!axis_arg.empty()) params_.Set("axis", AttrStr(axis_arg));
+  params_.Set("keepdims", AttrStr(keepdims));
+  params_.Set("exclude", AttrStr(exclude));
+  return Symbol::Op("min", symbol_name, inputs, params_);
+}
+inline Symbol min(const std::string& symbol_name,
+    const Symbol& data,
+    const std::string& axis_arg = "",
+    bool keepdims = false,
+    bool exclude = false) {
+  return min(symbol_name, std::vector<SymbolHandle>{data.get()}, axis_arg, keepdims, exclude);
+}
+
+// nanprod(data)
+inline Symbol nanprod(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs,
+    const std::string& axis_arg = "",
+    bool keepdims = false,
+    bool exclude = false) {
+  KwArgs params_;
+  if (!axis_arg.empty()) params_.Set("axis", AttrStr(axis_arg));
+  params_.Set("keepdims", AttrStr(keepdims));
+  params_.Set("exclude", AttrStr(exclude));
+  return Symbol::Op("nanprod", symbol_name, inputs, params_);
+}
+inline Symbol nanprod(const std::string& symbol_name,
+    const Symbol& data,
+    const std::string& axis_arg = "",
+    bool keepdims = false,
+    bool exclude = false) {
+  return nanprod(symbol_name, std::vector<SymbolHandle>{data.get()}, axis_arg, keepdims, exclude);
+}
+
+// nansum(data)
+inline Symbol nansum(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs,
+    const std::string& axis_arg = "",
+    bool keepdims = false,
+    bool exclude = false) {
+  KwArgs params_;
+  if (!axis_arg.empty()) params_.Set("axis", AttrStr(axis_arg));
+  params_.Set("keepdims", AttrStr(keepdims));
+  params_.Set("exclude", AttrStr(exclude));
+  return Symbol::Op("nansum", symbol_name, inputs, params_);
+}
+inline Symbol nansum(const std::string& symbol_name,
+    const Symbol& data,
+    const std::string& axis_arg = "",
+    bool keepdims = false,
+    bool exclude = false) {
+  return nansum(symbol_name, std::vector<SymbolHandle>{data.get()}, axis_arg, keepdims, exclude);
+}
+
+// negative(data)
+inline Symbol negative(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs) {
+  KwArgs params_;
+  return Symbol::Op("negative", symbol_name, inputs, params_);
+}
+inline Symbol negative(const std::string& symbol_name,
+    const Symbol& data) {
+  return negative(symbol_name, std::vector<SymbolHandle>{data.get()});
+}
+
+// norm(data)
+inline Symbol norm(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs) {
+  KwArgs params_;
+  return Symbol::Op("norm", symbol_name, inputs, params_);
+}
+inline Symbol norm(const std::string& symbol_name,
+    const Symbol& data) {
+  return norm(symbol_name, std::vector<SymbolHandle>{data.get()});
+}
+
+// one_hot(indices)
+inline Symbol one_hot(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs,
+    int depth,
+    double on_value = 1.0,
+    double off_value = 0.0,
+    const std::string& dtype = "float32") {
+  KwArgs params_;
+  params_.Set("depth", AttrStr(depth));
+  params_.Set("on_value", AttrStr(on_value));
+  params_.Set("off_value", AttrStr(off_value));
+  params_.Set("dtype", AttrStr(dtype));
+  return Symbol::Op("one_hot", symbol_name, inputs, params_);
+}
+inline Symbol one_hot(const std::string& symbol_name,
+    const Symbol& data,
+    int depth,
+    double on_value = 1.0,
+    double off_value = 0.0,
+    const std::string& dtype = "float32") {
+  return one_hot(symbol_name, std::vector<SymbolHandle>{data.get()}, depth, on_value, off_value, dtype);
+}
+
+// ones_like(data)
+inline Symbol ones_like(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs) {
+  KwArgs params_;
+  return Symbol::Op("ones_like", symbol_name, inputs, params_);
+}
+inline Symbol ones_like(const std::string& symbol_name,
+    const Symbol& data) {
+  return ones_like(symbol_name, std::vector<SymbolHandle>{data.get()});
+}
+
+// pick(data, index)
+inline Symbol pick(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs,
+    const std::string& axis_arg = "-1",
+    bool keepdims = false) {
+  KwArgs params_;
+  params_.Set("axis", AttrStr(axis_arg));
+  params_.Set("keepdims", AttrStr(keepdims));
+  return Symbol::Op("pick", symbol_name, inputs, params_);
+}
+inline Symbol pick(const std::string& symbol_name,
+    const Symbol& data,
+    const std::string& axis_arg = "-1",
+    bool keepdims = false) {
+  return pick(symbol_name, std::vector<SymbolHandle>{data.get()}, axis_arg, keepdims);
+}
+
+// prod(data)
+inline Symbol prod(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs,
+    const std::string& axis_arg = "",
+    bool keepdims = false,
+    bool exclude = false) {
+  KwArgs params_;
+  if (!axis_arg.empty()) params_.Set("axis", AttrStr(axis_arg));
+  params_.Set("keepdims", AttrStr(keepdims));
+  params_.Set("exclude", AttrStr(exclude));
+  return Symbol::Op("prod", symbol_name, inputs, params_);
+}
+inline Symbol prod(const std::string& symbol_name,
+    const Symbol& data,
+    const std::string& axis_arg = "",
+    bool keepdims = false,
+    bool exclude = false) {
+  return prod(symbol_name, std::vector<SymbolHandle>{data.get()}, axis_arg, keepdims, exclude);
+}
+
+// radians(data)
+inline Symbol radians(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs) {
+  KwArgs params_;
+  return Symbol::Op("radians", symbol_name, inputs, params_);
+}
+inline Symbol radians(const std::string& symbol_name,
+    const Symbol& data) {
+  return radians(symbol_name, std::vector<SymbolHandle>{data.get()});
+}
+
+// relu(data)
+inline Symbol relu(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs) {
+  KwArgs params_;
+  return Symbol::Op("relu", symbol_name, inputs, params_);
+}
+inline Symbol relu(const std::string& symbol_name,
+    const Symbol& data) {
+  return relu(symbol_name, std::vector<SymbolHandle>{data.get()});
+}
+
+// repeat(data)
+inline Symbol repeat(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs,
+    int repeats,
+    const std::string& axis_arg = "") {
+  KwArgs params_;
+  params_.Set("repeats", AttrStr(repeats));
+  if (!axis_arg.empty()) params_.Set("axis", AttrStr(axis_arg));
+  return Symbol::Op("repeat", symbol_name, inputs, params_);
+}
+inline Symbol repeat(const std::string& symbol_name,
+    const Symbol& data,
+    int repeats,
+    const std::string& axis_arg = "") {
+  return repeat(symbol_name, std::vector<SymbolHandle>{data.get()}, repeats, axis_arg);
+}
+
+// reverse(data)
+inline Symbol reverse(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs,
+    Shape axis_arg) {
+  KwArgs params_;
+  params_.Set("axis", AttrStr(axis_arg));
+  return Symbol::Op("reverse", symbol_name, inputs, params_);
+}
+inline Symbol reverse(const std::string& symbol_name,
+    const Symbol& data,
+    Shape axis_arg) {
+  return reverse(symbol_name, std::vector<SymbolHandle>{data.get()}, axis_arg);
+}
+
+// rint(data)
+inline Symbol rint(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs) {
+  KwArgs params_;
+  return Symbol::Op("rint", symbol_name, inputs, params_);
+}
+inline Symbol rint(const std::string& symbol_name,
+    const Symbol& data) {
+  return rint(symbol_name, std::vector<SymbolHandle>{data.get()});
+}
+
+// rmsprop_update(weight, grad, n)
+inline Symbol rmsprop_update(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs,
+    double lr,
+    double wd = 0.0,
+    double rescale_grad = 1.0,
+    double clip_gradient = -1.0,
+    double gamma1 = 0.95,
+    double epsilon = 1e-08,
+    double clip_weights = -1.0) {
+  KwArgs params_;
+  params_.Set("lr", AttrStr(lr));
+  params_.Set("wd", AttrStr(wd));
+  params_.Set("rescale_grad", AttrStr(rescale_grad));
+  params_.Set("clip_gradient", AttrStr(clip_gradient));
+  params_.Set("gamma1", AttrStr(gamma1));
+  params_.Set("epsilon", AttrStr(epsilon));
+  params_.Set("clip_weights", AttrStr(clip_weights));
+  return Symbol::Op("rmsprop_update", symbol_name, inputs, params_);
+}
+inline Symbol rmsprop_update(const std::string& symbol_name,
+    const Symbol& data,
+    double lr,
+    double wd = 0.0,
+    double rescale_grad = 1.0,
+    double clip_gradient = -1.0,
+    double gamma1 = 0.95,
+    double epsilon = 1e-08,
+    double clip_weights = -1.0) {
+  return rmsprop_update(symbol_name, std::vector<SymbolHandle>{data.get()}, lr, wd, rescale_grad, clip_gradient, gamma1, epsilon, clip_weights);
+}
+
+// rmspropalex_update(weight, grad, n, g, delta)
+inline Symbol rmspropalex_update(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs,
+    double lr,
+    double wd = 0.0,
+    double rescale_grad = 1.0,
+    double clip_gradient = -1.0,
+    double gamma1 = 0.95,
+    double gamma2 = 0.9,
+    double epsilon = 1e-08,
+    double clip_weights = -1.0) {
+  KwArgs params_;
+  params_.Set("lr", AttrStr(lr));
+  params_.Set("wd", AttrStr(wd));
+  params_.Set("rescale_grad", AttrStr(rescale_grad));
+  params_.Set("clip_gradient", AttrStr(clip_gradient));
+  params_.Set("gamma1", AttrStr(gamma1));
+  params_.Set("gamma2", AttrStr(gamma2));
+  params_.Set("epsilon", AttrStr(epsilon));
+  params_.Set("clip_weights", AttrStr(clip_weights));
+  return Symbol::Op("rmspropalex_update", symbol_name, inputs, params_);
+}
+inline Symbol rmspropalex_update(const std::string& symbol_name,
+    const Symbol& data,
+    double lr,
+    double wd = 0.0,
+    double rescale_grad = 1.0,
+    double clip_gradient = -1.0,
+    double gamma1 = 0.95,
+    double gamma2 = 0.9,
+    double epsilon = 1e-08,
+    double clip_weights = -1.0) {
+  return rmspropalex_update(symbol_name, std::vector<SymbolHandle>{data.get()}, lr, wd, rescale_grad, clip_gradient, gamma1, gamma2, epsilon, clip_weights);
+}
+
+// round(data)
+inline Symbol round(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs) {
+  KwArgs params_;
+  return Symbol::Op("round", symbol_name, inputs, params_);
+}
+inline Symbol round(const std::string& symbol_name,
+    const Symbol& data) {
+  return round(symbol_name, std::vector<SymbolHandle>{data.get()});
+}
+
+// rsqrt(data)
+inline Symbol rsqrt(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs) {
+  KwArgs params_;
+  return Symbol::Op("rsqrt", symbol_name, inputs, params_);
+}
+inline Symbol rsqrt(const std::string& symbol_name,
+    const Symbol& data) {
+  return rsqrt(symbol_name, std::vector<SymbolHandle>{data.get()});
+}
+
+// sgd_mom_update(weight, grad, mom)
+inline Symbol sgd_mom_update(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs,
+    double lr,
+    double wd = 0.0,
+    double rescale_grad = 1.0,
+    double clip_gradient = -1.0,
+    double momentum = 0.0) {
+  KwArgs params_;
+  params_.Set("lr", AttrStr(lr));
+  params_.Set("wd", AttrStr(wd));
+  params_.Set("rescale_grad", AttrStr(rescale_grad));
+  params_.Set("clip_gradient", AttrStr(clip_gradient));
+  params_.Set("momentum", AttrStr(momentum));
+  return Symbol::Op("sgd_mom_update", symbol_name, inputs, params_);
+}
+inline Symbol sgd_mom_update(const std::string& symbol_name,
+    const Symbol& data,
+    double lr,
+    double wd = 0.0,
+    double rescale_grad = 1.0,
+    double clip_gradient = -1.0,
+    double momentum = 0.0) {
+  return sgd_mom_update(symbol_name, std::vector<SymbolHandle>{data.get()}, lr, wd, rescale_grad, clip_gradient, momentum);
+}
+
+// sgd_update(weight, grad)
+inline Symbol sgd_update(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs,
+    double lr,
+    double wd = 0.0,
+    double rescale_grad = 1.0,
+    double clip_gradient = -1.0) {
+  KwArgs params_;
+  params_.Set("lr", AttrStr(lr));
+  params_.Set("wd", AttrStr(wd));
+  params_.Set("rescale_grad", AttrStr(rescale_grad));
+  params_.Set("clip_gradient", AttrStr(clip_gradient));
+  return Symbol::Op("sgd_update", symbol_name, inputs, params_);
+}
+inline Symbol sgd_update(const std::string& symbol_name,
+    const Symbol& data,
+    double lr,
+    double wd = 0.0,
+    double rescale_grad = 1.0,
+    double clip_gradient = -1.0) {
+  return sgd_update(symbol_name, std::vector<SymbolHandle>{data.get()}, lr, wd, rescale_grad, clip_gradient);
+}
+
+// sigmoid(data)
+inline Symbol sigmoid(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs) {
+  KwArgs params_;
+  return Symbol::Op("sigmoid", symbol_name, inputs, params_);
+}
+inline Symbol sigmoid(const std::string& symbol_name,
+    const Symbol& data) {
+  return sigmoid(symbol_name, std::vector<SymbolHandle>{data.get()});
+}
+
+// sign(data)
+inline Symbol sign(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs) {
+  KwArgs params_;
+  return Symbol::Op("sign", symbol_name, inputs, params_);
+}
+inline Symbol sign(const std::string& symbol_name,
+    const Symbol& data) {
+  return sign(symbol_name, std::vector<SymbolHandle>{data.get()});
+}
+
+// sin(data)
+inline Symbol sin(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs) {
+  KwArgs params_;
+  return Symbol::Op("sin", symbol_name, inputs, params_);
+}
+inline Symbol sin(const std::string& symbol_name,
+    const Symbol& data) {
+  return sin(symbol_name, std::vector<SymbolHandle>{data.get()});
+}
+
+// sinh(data)
+inline Symbol sinh(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs) {
+  KwArgs params_;
+  return Symbol::Op("sinh", symbol_name, inputs, params_);
+}
+inline Symbol sinh(const std::string& symbol_name,
+    const Symbol& data) {
+  return sinh(symbol_name, std::vector<SymbolHandle>{data.get()});
+}
+
+// slice(data)
+inline Symbol slice(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs,
+    Shape begin_arg,
+    Shape end_arg) {
+  KwArgs params_;
+  params_.Set("begin", AttrStr(begin_arg));
+  params_.Set("end", AttrStr(end_arg));
+  return Symbol::Op("slice", symbol_name, inputs, params_);
+}
+inline Symbol slice(const std::string& symbol_name,
+    const Symbol& data,
+    Shape begin_arg,
+    Shape end_arg) {
+  return slice(symbol_name, std::vector<SymbolHandle>{data.get()}, begin_arg, end_arg);
+}
+
+// slice_axis(data)
+inline Symbol slice_axis(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs,
+    int axis_arg,
+    int begin_arg,
+    const std::string& end_arg = "") {
+  KwArgs params_;
+  params_.Set("axis", AttrStr(axis_arg));
+  params_.Set("begin", AttrStr(begin_arg));
+  if (!end_arg.empty()) params_.Set("end", AttrStr(end_arg));
+  return Symbol::Op("slice_axis", symbol_name, inputs, params_);
+}
+inline Symbol slice_axis(const std::string& symbol_name,
+    const Symbol& data,
+    int axis_arg,
+    int begin_arg,
+    const std::string& end_arg = "") {
+  return slice_axis(symbol_name, std::vector<SymbolHandle>{data.get()}, axis_arg, begin_arg, end_arg);
+}
+
+// smooth_l1(data)
+inline Symbol smooth_l1(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs,
+    double scalar = 1.0) {
+  KwArgs params_;
+  params_.Set("scalar", AttrStr(scalar));
+  return Symbol::Op("smooth_l1", symbol_name, inputs, params_);
+}
+inline Symbol smooth_l1(const std::string& symbol_name,
+    const Symbol& data,
+    double scalar = 1.0) {
+  return smooth_l1(symbol_name, std::vector<SymbolHandle>{data.get()}, scalar);
+}
+
+// softmax(data)
+inline Symbol softmax(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs,
+    int axis_arg = -1,
+    double temperature = 1.0) {
+  KwArgs params_;
+  params_.Set("axis", AttrStr(axis_arg));
+  params_.Set("temperature", AttrStr(temperature));
+  return Symbol::Op("softmax", symbol_name, inputs, params_);
+}
+inline Symbol softmax(const std::string& symbol_name,
+    const Symbol& data,
+    int axis_arg = -1,
+    double temperature = 1.0) {
+  return softmax(symbol_name, std::vector<SymbolHandle>{data.get()}, axis_arg, temperature);
+}
+
+// softmax_cross_entropy(data, label)
+inline Symbol softmax_cross_entropy(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs) {
+  KwArgs params_;
+  return Symbol::Op("softmax_cross_entropy", symbol_name, inputs, params_);
+}
+inline Symbol softmax_cross_entropy(const std::string& symbol_name,
+    const Symbol& data) {
+  return softmax_cross_entropy(symbol_name, std::vector<SymbolHandle>{data.get()});
+}
+
+// sort(data)
+inline Symbol sort(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs,
+    const std::string& axis_arg = "-1",
+    bool is_ascend = true) {
+  KwArgs params_;
+  params_.Set("axis", AttrStr(axis_arg));
+  params_.Set("is_ascend", AttrStr(is_ascend));
+  return Symbol::Op("sort", symbol_name, inputs, params_);
+}
+inline Symbol sort(const std::string& symbol_name,
+    const Symbol& data,
+    const std::string& axis_arg = "-1",
+    bool is_ascend = true) {
+  return sort(symbol_name, std::vector<SymbolHandle>{data.get()}, axis_arg, is_ascend);
+}
+
+// sqrt(data)
+inline Symbol sqrt(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs) {
+  KwArgs params_;
+  return Symbol::Op("sqrt", symbol_name, inputs, params_);
+}
+inline Symbol sqrt(const std::string& symbol_name,
+    const Symbol& data) {
+  return sqrt(symbol_name, std::vector<SymbolHandle>{data.get()});
+}
+
+// square(data)
+inline Symbol square(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs) {
+  KwArgs params_;
+  return Symbol::Op("square", symbol_name, inputs, params_);
+}
+inline Symbol square(const std::string& symbol_name,
+    const Symbol& data) {
+  return square(symbol_name, std::vector<SymbolHandle>{data.get()});
+}
+
+// sum(data)
+inline Symbol sum(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs,
+    const std::string& axis_arg = "",
+    bool keepdims = false,
+    bool exclude = false) {
+  KwArgs params_;
+  if (!axis_arg.empty()) params_.Set("axis", AttrStr(axis_arg));
+  params_.Set("keepdims", AttrStr(keepdims));
+  params_.Set("exclude", AttrStr(exclude));
+  return Symbol::Op("sum", symbol_name, inputs, params_);
+}
+inline Symbol sum(const std::string& symbol_name,
+    const Symbol& data,
+    const std::string& axis_arg = "",
+    bool keepdims = false,
+    bool exclude = false) {
+  return sum(symbol_name, std::vector<SymbolHandle>{data.get()}, axis_arg, keepdims, exclude);
+}
+
+// take(a, indices)
+inline Symbol take(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs,
+    int axis_arg = 0,
+    const std::string& mode = "clip") {
+  KwArgs params_;
+  params_.Set("axis", AttrStr(axis_arg));
+  params_.Set("mode", AttrStr(mode));
+  return Symbol::Op("take", symbol_name, inputs, params_);
+}
+inline Symbol take(const std::string& symbol_name,
+    const Symbol& data,
+    int axis_arg = 0,
+    const std::string& mode = "clip") {
+  return take(symbol_name, std::vector<SymbolHandle>{data.get()}, axis_arg, mode);
+}
+
+// tan(data)
+inline Symbol tan(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs) {
+  KwArgs params_;
+  return Symbol::Op("tan", symbol_name, inputs, params_);
+}
+inline Symbol tan(const std::string& symbol_name,
+    const Symbol& data) {
+  return tan(symbol_name, std::vector<SymbolHandle>{data.get()});
+}
+
+// tanh(data)
+inline Symbol tanh(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs) {
+  KwArgs params_;
+  return Symbol::Op("tanh", symbol_name, inputs, params_);
+}
+inline Symbol tanh(const std::string& symbol_name,
+    const Symbol& data) {
+  return tanh(symbol_name, std::vector<SymbolHandle>{data.get()});
+}
+
+// tile(data)
+inline Symbol tile(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs,
+    Shape reps) {
+  KwArgs params_;
+  params_.Set("reps", AttrStr(reps));
+  return Symbol::Op("tile", symbol_name, inputs, params_);
+}
+inline Symbol tile(const std::string& symbol_name,
+    const Symbol& data,
+    Shape reps) {
+  return tile(symbol_name, std::vector<SymbolHandle>{data.get()}, reps);
+}
+
+// topk(data)
+inline Symbol topk(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs,
+    const std::string& axis_arg = "-1",
+    int k = 1,
+    const std::string& ret_typ = "indices",
+    bool is_ascend = false) {
+  KwArgs params_;
+  params_.Set("axis", AttrStr(axis_arg));
+  params_.Set("k", AttrStr(k));
+  params_.Set("ret_typ", AttrStr(ret_typ));
+  params_.Set("is_ascend", AttrStr(is_ascend));
+  return Symbol::Op("topk", symbol_name, inputs, params_);
+}
+inline Symbol topk(const std::string& symbol_name,
+    const Symbol& data,
+    const std::string& axis_arg = "-1",
+    int k = 1,
+    const std::string& ret_typ = "indices",
+    bool is_ascend = false) {
+  return topk(symbol_name, std::vector<SymbolHandle>{data.get()}, axis_arg, k, ret_typ, is_ascend);
+}
+
+// transpose(data)
+inline Symbol transpose(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs,
+    const std::string& axes = "") {
+  KwArgs params_;
+  if (!axes.empty()) params_.Set("axes", AttrStr(axes));
+  return Symbol::Op("transpose", symbol_name, inputs, params_);
+}
+inline Symbol transpose(const std::string& symbol_name,
+    const Symbol& data,
+    const std::string& axes = "") {
+  return transpose(symbol_name, std::vector<SymbolHandle>{data.get()}, axes);
+}
+
+// where(condition, x, y)
+inline Symbol where(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs) {
+  KwArgs params_;
+  return Symbol::Op("where", symbol_name, inputs, params_);
+}
+inline Symbol where(const std::string& symbol_name,
+    const Symbol& data) {
+  return where(symbol_name, std::vector<SymbolHandle>{data.get()});
+}
+
+// zeros_like(data)
+inline Symbol zeros_like(const std::string& symbol_name,
+    const std::vector<SymbolHandle>& inputs) {
+  KwArgs params_;
+  return Symbol::Op("zeros_like", symbol_name, inputs, params_);
+}
+inline Symbol zeros_like(const std::string& symbol_name,
+    const Symbol& data) {
+  return zeros_like(symbol_name, std::vector<SymbolHandle>{data.get()});
+}
+
+}  // namespace op
+}  // namespace mxnet_tpu_cpp
