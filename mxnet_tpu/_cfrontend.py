@@ -251,13 +251,15 @@ def sym_compose(s, name, keys, args):
     mapping = {}
     for k, a in zip(keys, args):
         mapping[k] = a._entry()
+    # validate BEFORE mutating: a failing call must leave the graph
+    # untouched (renaming needs a single-output head)
+    head = s._entry()[0] if name else None
     for node in s._nodes():
         node.inputs = [
             mapping[child.name] if child.is_variable
             and child.name in mapping else (child, ci)
             for child, ci in node.inputs]
-    if name:
-        head, _ = s._entry()
+    if head is not None:
         head.name = name
     return None
 

@@ -1,0 +1,135 @@
+/* XS glue: perl <-> the C predict ABI (include/mxnet_tpu/c_predict_api.h).
+ *
+ * Reference analog: perl-package/AI-MXNetCAPI (SWIG over c_api.h) — the
+ * reference ships a full perl training binding; this is the predict-only
+ * proof that the TPU framework's C ABI carries a non-C language
+ * mechanically: 7 entry points, no Python.h, no framework internals.
+ * Build: perl Makefile.PL && make (links libmxnet_tpu_predict.so).
+ */
+
+#include "EXTERN.h"
+#include "perl.h"
+#include "XSUB.h"
+
+#include <mxnet_tpu/c_predict_api.h>
+
+static void croak_last(const char* what) {
+  croak("%s: %s", what, MXGetLastError());
+}
+
+MODULE = AI::MXNetTPU::Predict  PACKAGE = AI::MXNetTPU::Predict
+
+PROTOTYPES: DISABLE
+
+IV
+_create(symbol_json, params_blob, dev_type, dev_id, input_key, shape_ref)
+    const char* symbol_json
+    SV* params_blob
+    int dev_type
+    int dev_id
+    const char* input_key
+    SV* shape_ref
+  CODE:
+  {
+    STRLEN blob_len;
+    const char* blob = SvPVbyte(params_blob, blob_len);
+    AV* av = (AV*)SvRV(shape_ref);
+    uint32_t ndim = (uint32_t)(av_len(av) + 1);
+    uint32_t* dims = (uint32_t*)alloca(sizeof(uint32_t) * (ndim ? ndim : 1));
+    uint32_t i;
+    uint32_t indptr[2];
+    const char* keys[1];
+    PredictorHandle h;
+    for (i = 0; i < ndim; ++i) {
+      SV** el = av_fetch(av, i, 0);
+      dims[i] = el ? (uint32_t)SvUV(*el) : 0;
+    }
+    indptr[0] = 0;
+    indptr[1] = ndim;
+    keys[0] = input_key;
+    if (MXPredCreate(symbol_json, blob, (int)blob_len, dev_type, dev_id,
+                     1, keys, indptr, dims, &h) != 0) {
+      croak_last("MXPredCreate");
+    }
+    RETVAL = PTR2IV(h);
+  }
+  OUTPUT:
+    RETVAL
+
+void
+_set_input(handle, key, data_ref)
+    IV handle
+    const char* key
+    SV* data_ref
+  CODE:
+  {
+    AV* av = (AV*)SvRV(data_ref);
+    uint32_t n = (uint32_t)(av_len(av) + 1);
+    float* buf = (float*)malloc(sizeof(float) * (n ? n : 1));
+    uint32_t i;
+    int rc;
+    for (i = 0; i < n; ++i) {
+      SV** el = av_fetch(av, i, 0);
+      buf[i] = el ? (float)SvNV(*el) : 0.0f;
+    }
+    rc = MXPredSetInput(INT2PTR(PredictorHandle, handle), key, buf, n);
+    free(buf);
+    if (rc != 0) croak_last("MXPredSetInput");
+  }
+
+void
+_forward(handle)
+    IV handle
+  CODE:
+    if (MXPredForward(INT2PTR(PredictorHandle, handle)) != 0) {
+      croak_last("MXPredForward");
+    }
+
+SV*
+_output_shape(handle, index)
+    IV handle
+    UV index
+  CODE:
+  {
+    uint32_t* shape;
+    uint32_t ndim, i;
+    AV* av;
+    if (MXPredGetOutputShape(INT2PTR(PredictorHandle, handle),
+                             (uint32_t)index, &shape, &ndim) != 0) {
+      croak_last("MXPredGetOutputShape");
+    }
+    av = newAV();
+    for (i = 0; i < ndim; ++i) av_push(av, newSVuv(shape[i]));
+    RETVAL = newRV_noinc((SV*)av);
+  }
+  OUTPUT:
+    RETVAL
+
+SV*
+_get_output(handle, index, size)
+    IV handle
+    UV index
+    UV size
+  CODE:
+  {
+    float* buf = (float*)malloc(sizeof(float) * (size ? size : 1));
+    AV* av;
+    UV i;
+    if (MXPredGetOutput(INT2PTR(PredictorHandle, handle), (uint32_t)index,
+                        buf, (uint32_t)size) != 0) {
+      free(buf);
+      croak_last("MXPredGetOutput");
+    }
+    av = newAV();
+    for (i = 0; i < size; ++i) av_push(av, newSVnv(buf[i]));
+    free(buf);
+    RETVAL = newRV_noinc((SV*)av);
+  }
+  OUTPUT:
+    RETVAL
+
+void
+_free(handle)
+    IV handle
+  CODE:
+    MXPredFree(INT2PTR(PredictorHandle, handle));

@@ -1,0 +1,83 @@
+package AI::MXNetTPU::Predict;
+
+# Perl predict binding for the TPU-native framework, riding the C
+# predict ABI alone (include/mxnet_tpu/c_predict_api.h).  Reference
+# analog: perl-package/AI-MXNet* (full SWIG binding over c_api.h); this
+# module is the mechanical predict-only core proving the ABI carries a
+# non-C/C++ language: load checkpoint, set input, forward, read output.
+#
+#   my $p = AI::MXNetTPU::Predict->new(
+#       symbol_json => $json, params => $blob,
+#       input_name => "data", input_shape => [1, 3, 224, 224]);
+#   $p->set_input([@pixels]);
+#   $p->forward;
+#   my $probs = $p->output(0);   # arrayref of floats
+
+use strict;
+use warnings;
+
+our $VERSION = '0.01';
+
+require XSLoader;
+XSLoader::load('AI::MXNetTPU::Predict', $VERSION);
+
+sub new {
+    my ($class, %args) = @_;
+    my $dev_type = $args{dev_type} // 1;    # 1=cpu, 4=tpu
+    my $dev_id   = $args{dev_id}   // 0;
+    my $name     = $args{input_name} // "data";
+    my $handle = _create($args{symbol_json}, $args{params},
+                         $dev_type, $dev_id, $name, $args{input_shape});
+    return bless {
+        handle     => $handle,
+        input_name => $name,
+    }, $class;
+}
+
+sub from_checkpoint {
+    my ($class, %args) = @_;
+    my $json = do {
+        open my $fh, '<', $args{symbol_file}
+            or die "open $args{symbol_file}: $!";
+        local $/; <$fh>;
+    };
+    my $blob = do {
+        open my $fh, '<:raw', $args{params_file}
+            or die "open $args{params_file}: $!";
+        local $/; <$fh>;
+    };
+    return $class->new(%args, symbol_json => $json, params => $blob);
+}
+
+sub set_input {
+    my ($self, $data, $name) = @_;
+    _set_input($self->{handle}, $name // $self->{input_name}, $data);
+    return $self;
+}
+
+sub forward {
+    my ($self) = @_;
+    _forward($self->{handle});
+    return $self;
+}
+
+sub output_shape {
+    my ($self, $index) = @_;
+    return _output_shape($self->{handle}, $index // 0);
+}
+
+sub output {
+    my ($self, $index) = @_;
+    $index //= 0;
+    my $shape = $self->output_shape($index);
+    my $size = 1;
+    $size *= $_ for @$shape;
+    return _get_output($self->{handle}, $index, $size);
+}
+
+sub DESTROY {
+    my ($self) = @_;
+    _free($self->{handle}) if $self->{handle};
+}
+
+1;

@@ -1,0 +1,36 @@
+#!/usr/bin/perl
+# Load a saved checkpoint and classify one input — entirely from perl.
+#
+#   perl predict.pl <prefix> <epoch> <csv-of-floats> <ndim,dims...>
+#
+# e.g. perl predict.pl model/mlp 1 "0.1,0.2,..." 2,1,32
+# Prints the argmax class and its probability.
+
+use strict;
+use warnings;
+use FindBin;
+use lib "$FindBin::Bin/../AI-MXNetTPU-Predict/blib/lib";
+use lib "$FindBin::Bin/../AI-MXNetTPU-Predict/blib/arch";
+use AI::MXNetTPU::Predict;
+
+my ($prefix, $epoch, $csv, $shape_csv) = @ARGV;
+die "usage: $0 prefix epoch data-csv shape-csv\n" unless defined $shape_csv;
+
+my @data  = split /,/, $csv;
+my @shape = split /,/, $shape_csv;
+
+my $p = AI::MXNetTPU::Predict->from_checkpoint(
+    symbol_file => sprintf("%s-symbol.json", $prefix),
+    params_file => sprintf("%s-%04d.params", $prefix, $epoch),
+    input_shape => \@shape,
+);
+$p->set_input(\@data);
+$p->forward;
+my $out = $p->output(0);
+
+my ($best, $best_p) = (0, $out->[0]);
+for my $i (1 .. $#$out) {
+    ($best, $best_p) = ($i, $out->[$i]) if $out->[$i] > $best_p;
+}
+printf "class=%d prob=%.4f outputs=%d\n", $best, $best_p,
+       scalar(@$out);
