@@ -224,13 +224,23 @@ def _decode_boxes(anchors, loc_pred, var, clip):
 def _greedy_nms(boxes, cls_id, order, nms_thresh, force):
     """Greedy NMS over boxes visited in `order`; returns keep mask."""
     A = boxes.shape[0]
-    iou = _iou_matrix(boxes, boxes)
-    pos = jnp.zeros((A,), jnp.int32).at[order].set(jnp.arange(A))
+    # inverse permutation WITHOUT a scatter: .at[order].set(iota) trips
+    # XLA:TPU's variadic-scatter emitter when fused with the surrounding
+    # pipeline (scatter_emitter.cc CHECK, operand_indices 2 vs 1) —
+    # argsort of a permutation is its inverse and lowers to sort
+    pos = jnp.argsort(order).astype(jnp.int32)
+    # O(A^2) IoU memory is fine to ~2k boxes; past that (RPN pre-NMS
+    # defaults to 6000) the materialized matrix OOMs fused-on-TPU, so
+    # compute each visited box's IoU row on the fly (O(A) memory, same
+    # total FLOPs)
+    iou = _iou_matrix(boxes, boxes) if A <= 2048 else None
 
     def body(i, keep):
         j = order[i]
+        row = iou[j] if iou is not None \
+            else _iou_matrix(boxes[j][None, :], boxes)[0]
         alive = keep[j] & (cls_id[j] >= 0)
-        sup = (iou[j] >= nms_thresh) & (pos > i) & \
+        sup = (row >= nms_thresh) & (pos > i) & \
             (force | (cls_id == cls_id[j])) & (cls_id >= 0)
         return jnp.where(alive & sup, False, keep)
 
@@ -258,8 +268,8 @@ def _multibox_detection(attrs, inputs, aux, is_train, rng):
             kmask = _greedy_nms(boxes, cid, order, nms_thresh, force)
             cid = jnp.where(kmask, cid, -1.0)
         if topk > 0:
-            rank = jnp.zeros_like(order).at[order].set(
-                jnp.arange(order.shape[0]))
+            # scatter-free inverse permutation (see _greedy_nms)
+            rank = jnp.argsort(order)
             cid = jnp.where(rank < topk, cid, -1.0)
         rows = jnp.concatenate(
             [cid[:, None], score[:, None], boxes], axis=1)
@@ -304,39 +314,17 @@ def _gen_base_anchors(base_size, scales, ratios):
 
 def _proposal(attrs, inputs, aux, is_train, rng):
     cls_prob, bbox_pred, im_info = inputs
-    from . import bn_pallas
-
-    if not bn_pallas._on_tpu():
-        return _proposal_compute(attrs, cls_prob, bbox_pred, im_info)
-    # XLA:TPU SIGABRTs compiling the fused decode->top_k->NMS->compact
-    # pipeline on the current toolchain (each stage compiles alone;
-    # stage optimization_barriers do not help) — run the op as a host
-    # callback instead.  Proposal is a small inference-side op (RPN),
-    # so the round trip is cheap relative to the backbone.
-    import functools
-
-    host = functools.partial(_proposal_host, attrs)
-    out_shapes = [jax.ShapeDtypeStruct(
-        (cls_prob.shape[0] * attrs["rpn_post_nms_top_n"], 5),
-        jnp.float32)]
-    if attrs["output_score"]:
-        out_shapes.append(jax.ShapeDtypeStruct(
-            (cls_prob.shape[0] * attrs["rpn_post_nms_top_n"], 1),
-            jnp.float32))
-    outs = jax.pure_callback(host, out_shapes, cls_prob, bbox_pred,
-                             im_info)
-    # the reference Proposal declares no backward (zero grad) — and a
-    # pure_callback has no VJP, so training graphs must not transpose
-    # through it
-    return [jax.lax.stop_gradient(o) for o in outs]
-
-
-def _proposal_host(attrs, cls_prob, bbox_pred, im_info):
-    with jax.default_device(jax.devices("cpu")[0]):
-        outs = _proposal_compute(attrs, jnp.asarray(np.asarray(cls_prob)),
-                                 jnp.asarray(np.asarray(bbox_pred)),
-                                 jnp.asarray(np.asarray(im_info)))
-    return [np.asarray(o, np.float32) for o in outs]
+    # Round-4 note: this op used to run as a host pure_callback on TPU
+    # because the fused decode->top_k->NMS pipeline SIGABRTed XLA:TPU's
+    # scatter emitter.  The crash was the inverse-permutation scatter
+    # (.at[order].set(iota)) inside NMS; _greedy_nms now inverts via
+    # argsort (scatter-free) and streams IoU rows past 2k boxes, so the
+    # whole pipeline compiles and runs ON-DEVICE at reference sizes
+    # (pre-NMS 6000) — no callback, works through callback-less hosts.
+    # The reference Proposal declares no backward (zero grad).
+    return [jax.lax.stop_gradient(o)
+            for o in _proposal_compute(attrs, cls_prob, bbox_pred,
+                                       im_info)]
 
 
 def _proposal_compute(attrs, cls_prob, bbox_pred, im_info):

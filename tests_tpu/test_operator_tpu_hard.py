@@ -120,29 +120,10 @@ def test_multibox_chain_parity():
         assert_almost_equal(a, b, rtol=1e-3, atol=1e-4)
 
 
-def _backend_supports_callbacks():
-    """The Proposal op's TPU path is a host callback (the fused
-    decode->top_k->NMS pipeline SIGABRTs the current XLA:TPU fusion
-    pass); tunneled backends (axon_pjrt) cannot execute host callbacks
-    at all, so probe once."""
-    import jax
-    import jax.numpy as jnp
-
-    try:
-        dev = jax.devices()[0]
-        fn = jax.jit(lambda x: jax.pure_callback(
-            lambda v: np.asarray(v) + 1.0,
-            jax.ShapeDtypeStruct((2,), jnp.float32), x), device=dev)
-        np.asarray(fn(jnp.zeros((2,), jnp.float32)))
-        return True
-    except Exception:
-        return False
-
-
 def test_proposal_parity():
-    if not _backend_supports_callbacks():
-        pytest.skip("backend cannot run host callbacks (axon tunnel); "
-                    "Proposal's TPU path requires them")
+    # round 4: Proposal runs fully ON-DEVICE (the NMS scatter that
+    # SIGABRTed XLA:TPU was replaced with an argsort inverse
+    # permutation), so no callback probe / skip is needed anymore
     cls_prob = sym.Variable("cls_prob")
     bbox_pred = sym.Variable("bbox_pred")
     im_info = sym.Variable("im_info")
@@ -159,6 +140,34 @@ def test_proposal_parity():
     for ctx in (mx.cpu(), mx.tpu()):
         ex = net.simple_bind(ctx, grad_req="null", cls_prob=(1, 2, 6, 6),
                              bbox_pred=(1, 4, 6, 6), im_info=(1, 3))
+        for k, v in args.items():
+            ex.arg_dict[k][:] = v
+        outs.append([o.asnumpy() for o in ex.forward(is_train=False)])
+    for a, b in zip(*outs):
+        assert_almost_equal(a, b, rtol=1e-3, atol=1e-4)
+
+
+def test_proposal_parity_streaming_nms():
+    """>2048 anchors takes the O(A)-memory row-streaming NMS branch
+    (_greedy_nms); parity against the CPU matrix-path result."""
+    cls_prob = sym.Variable("cls_prob")
+    bbox_pred = sym.Variable("bbox_pred")
+    im_info = sym.Variable("im_info")
+    net = sym.Proposal(cls_prob, bbox_pred, im_info,
+                       feature_stride=8, scales=(4, 8, 16),
+                       ratios=(0.5, 1.0, 2.0), rpn_pre_nms_top_n=2304,
+                       rpn_post_nms_top_n=16)
+    rs = np.random.RandomState(11)
+    # 16x16 grid x 9 anchors = 2304 > 2048 -> streaming branch
+    args = {"cls_prob": rs.rand(1, 18, 16, 16).astype(np.float32),
+            "bbox_pred": (rs.rand(1, 36, 16, 16).astype(np.float32)
+                          - 0.5) * 0.1,
+            "im_info": np.array([[128, 128, 1.0]], np.float32)}
+    outs = []
+    for ctx in (mx.cpu(), mx.tpu()):
+        ex = net.simple_bind(ctx, grad_req="null",
+                             cls_prob=(1, 18, 16, 16),
+                             bbox_pred=(1, 36, 16, 16), im_info=(1, 3))
         for k, v in args.items():
             ex.arg_dict[k][:] = v
         outs.append([o.asnumpy() for o in ex.forward(is_train=False)])
