@@ -778,9 +778,29 @@ _LP_SKIP = {"gamma", "gammaln"}  # lgamma lowering is f32+ only
 def test_unary_low_precision(op, ref, mode, dtype):
     if op in _LP_SKIP:
         pytest.skip("%s: f32-only lowering" % op)
+    import zlib
+
     from mxnet_tpu import nd as _nd
 
-    x = _unary_input(mode)
+    # per-case deterministic inputs: the shared module RandomState draws
+    # in execution order, so -k subsets would see different values than
+    # the full suite (an order-dependence flake)
+    lrs = np.random.RandomState(zlib.crc32(("%s-%s" % (op, dtype))
+                                           .encode()) % (2 ** 31))
+    if op in _NONDIFF and op != "sign":
+        # discontinuous-at-integers ops are ill-posed where bf16
+        # rounding can cross a boundary; keep inputs mid-interval
+        x = (lrs.randint(-3, 4, (3, 4)) + 0.3).astype(np.float32)
+    elif mode == "pos":
+        x = (lrs.rand(3, 4) * 1.5 + 0.5).astype(np.float32)
+    elif mode == "unit":
+        x = (lrs.rand(3, 4) * 1.6 - 0.8).astype(np.float32)
+    elif mode == "gt1":
+        x = (lrs.rand(3, 4) * 1.8 + 1.2).astype(np.float32)
+    elif mode == "small":
+        x = (lrs.rand(3, 4) * 0.8 - 0.4).astype(np.float32)
+    else:
+        x = (lrs.randn(3, 4) + 0.05).astype(np.float32)
     a = _nd.array(x, dtype=dtype)
     out = getattr(_nd, op)(a)
     got_dt = "bfloat16" if "bfloat16" in str(out.dtype) \
@@ -872,3 +892,53 @@ def test_grad_req_null_suppresses(case):
     others = [n for n, r in req.items() if r == "write"]
     if others:
         assert any(ex.grad_dict.get(n) is not None for n in others)
+
+
+def test_census_tail_ops_execute():
+    """The 15 ops the invocation census caught with word-mentions but
+    ZERO real executions — each invoked imperatively with a value
+    assertion, so the census coverage claim is execution-backed."""
+    from mxnet_tpu import nd as _nd
+
+    a = np.array([[1.0, 2.0], [3.0, 2.0]], np.float32)
+    b = np.array([[1.0, 1.0], [3.0, 4.0]], np.float32)
+    na, nb = _nd.array(a), _nd.array(b)
+
+    for op, ref in (("_equal", a == b), ("_not_equal", a != b),
+                    ("_greater", a > b), ("_greater_equal", a >= b),
+                    ("_lesser", a < b), ("_lesser_equal", a <= b)):
+        got = getattr(_nd, op)(na, nb).asnumpy()
+        assert (got == ref.astype(np.float32)).all(), op
+
+    assert_almost_equal(_nd._grad_add(na, nb).asnumpy(), a + b)
+    assert_almost_equal(_nd._hypot_scalar(na, scalar=4.0).asnumpy(),
+                        np.hypot(a, 4.0), rtol=1e-6)
+    assert_almost_equal(_nd._rpower_scalar(na, scalar=2.0).asnumpy(),
+                        2.0 ** a, rtol=1e-6)
+
+    ar = _nd._arange(start=1.0, stop=7.0, step=2.0).asnumpy()
+    assert (ar == np.arange(1.0, 7.0, 2.0, np.float32)).all()
+    assert (_nd._ones(shape=(2, 3)).asnumpy() == 1).all()
+    assert (_nd._zeros(shape=(2, 3)).asnumpy() == 0).all()
+
+    ident = _nd._identity_with_attr_like_rhs(na, nb).asnumpy()
+    assert (ident == a).all()
+
+    # fill_element_0index: lhs[i, rhs[i]] = mhs[i]
+    lhs = _nd.array(np.zeros((2, 3), np.float32))
+    out = _nd.fill_element_0index(
+        lhs, _nd.array(np.array([5.0, 7.0], np.float32)),
+        _nd.array(np.array([1.0, 2.0], np.float32))).asnumpy()
+    want = np.zeros((2, 3), np.float32)
+    want[0, 1], want[1, 2] = 5.0, 7.0
+    assert (out == want).all(), out
+
+    # rmspropalex_update: one step moves the weight opposite the grad
+    w = _nd.array(np.ones((4,), np.float32))
+    g = _nd.array(np.full((4,), 0.5, np.float32))
+    n_ = _nd.array(np.zeros((4,), np.float32))
+    g2 = _nd.array(np.zeros((4,), np.float32))
+    d_ = _nd.array(np.zeros((4,), np.float32))
+    out = _nd.rmspropalex_update(w, g, n_, g2, d_, lr=0.1)
+    neww = (out[0] if isinstance(out, (list, tuple)) else out).asnumpy()
+    assert (neww < 1.0).all(), neww
