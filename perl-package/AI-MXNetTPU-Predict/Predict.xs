@@ -17,6 +17,15 @@ static void croak_last(const char* what) {
   croak("%s: %s", what, MXGetLastError());
 }
 
+/* SvRV on a non-reference is undefined behavior (a segfault, not a
+ * perl exception) — validate every incoming arrayref. */
+static AV* want_av(SV* sv, const char* what) {
+  if (!SvROK(sv) || SvTYPE(SvRV(sv)) != SVt_PVAV) {
+    croak("%s: expected an ARRAY reference", what);
+  }
+  return (AV*)SvRV(sv);
+}
+
 MODULE = AI::MXNetTPU::Predict  PACKAGE = AI::MXNetTPU::Predict
 
 PROTOTYPES: DISABLE
@@ -33,7 +42,7 @@ _create(symbol_json, params_blob, dev_type, dev_id, input_key, shape_ref)
   {
     STRLEN blob_len;
     const char* blob = SvPVbyte(params_blob, blob_len);
-    AV* av = (AV*)SvRV(shape_ref);
+    AV* av = want_av(shape_ref, "input_shape");
     uint32_t ndim = (uint32_t)(av_len(av) + 1);
     uint32_t* dims = (uint32_t*)alloca(sizeof(uint32_t) * (ndim ? ndim : 1));
     uint32_t i;
@@ -63,7 +72,7 @@ _set_input(handle, key, data_ref)
     SV* data_ref
   CODE:
   {
-    AV* av = (AV*)SvRV(data_ref);
+    AV* av = want_av(data_ref, "set_input data");
     uint32_t n = (uint32_t)(av_len(av) + 1);
     float* buf = (float*)malloc(sizeof(float) * (n ? n : 1));
     uint32_t i;

@@ -1,9 +1,9 @@
 #!/usr/bin/perl
 # Load a saved checkpoint and classify one input — entirely from perl.
 #
-#   perl predict.pl <prefix> <epoch> <csv-of-floats> <ndim,dims...>
+#   perl predict.pl <prefix> <epoch> <csv-of-floats> <csv-of-dims>
 #
-# e.g. perl predict.pl model/mlp 1 "0.1,0.2,..." 2,1,32
+# e.g. perl predict.pl model/mlp 1 "0.1,0.2,..." 1,32   # shape (1, 32)
 # Prints the argmax class and its probability.
 
 use strict;

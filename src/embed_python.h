@@ -1,0 +1,31 @@
+// Shared helper for the embedded-CPython ABI libraries
+// (frontend_capi.cc, predict_capi.cc).  Header-only so each library
+// still builds standalone with a single g++ command.
+#ifndef MXNET_TPU_SRC_EMBED_PYTHON_H_
+#define MXNET_TPU_SRC_EMBED_PYTHON_H_
+
+#include <Python.h>
+
+#include <dlfcn.h>
+
+namespace mxnet_tpu_embed {
+
+inline void promote_libpython() {
+  // FFI hosts (perl DynaLoader, LuaJIT ffi, node) dlopen these
+  // libraries RTLD_LOCAL, so the libpython they depend on never
+  // reaches the GLOBAL symbol namespace — and the interpreter's OWN
+  // extension modules (math, numpy's C core) then fail with
+  // "undefined symbol: PyFloat_Type".  Re-dlopen the already-loaded
+  // libpython by its resolved path with RTLD_GLOBAL|RTLD_NOLOAD to
+  // promote it.  (A statically linked interpreter resolves dli_fname
+  // to the executable; the NOLOAD dlopen is then a harmless no-op.)
+  Dl_info info;
+  if (dladdr(reinterpret_cast<void*>(&Py_Initialize), &info) != 0 &&
+      info.dli_fname != nullptr) {
+    dlopen(info.dli_fname, RTLD_NOW | RTLD_GLOBAL | RTLD_NOLOAD);
+  }
+}
+
+}  // namespace mxnet_tpu_embed
+
+#endif  // MXNET_TPU_SRC_EMBED_PYTHON_H_

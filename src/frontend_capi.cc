@@ -15,13 +15,13 @@
 
 #include <Python.h>
 
-#include <dlfcn.h>
-
 #include <cstdint>
 #include <cstdlib>
 #include <mutex>
 #include <string>
 #include <vector>
+
+#include "embed_python.h"
 
 #include "../include/mxnet_tpu/c_frontend_api.h"
 
@@ -57,24 +57,10 @@ std::once_flag g_init_flag;
 bool g_init_ok = false;
 PyObject* g_mod = nullptr;  // mxnet_tpu._cfrontend (immortal)
 
-void promote_libpython() {
-  // FFI hosts (perl DynaLoader, LuaJIT ffi, node) dlopen this library
-  // RTLD_LOCAL, so our libpython dependency never reaches the GLOBAL
-  // namespace and the interpreter's own extension modules (math,
-  // numpy's C core) fail with "undefined symbol: PyFloat_Type".
-  // Re-dlopen the already-loaded libpython by its resolved path with
-  // RTLD_GLOBAL|RTLD_NOLOAD to promote it (same fix as predict_capi).
-  Dl_info info;
-  if (dladdr(reinterpret_cast<void*>(&Py_Initialize), &info) != 0 &&
-      info.dli_fname != nullptr) {
-    dlopen(info.dli_fname, RTLD_NOW | RTLD_GLOBAL | RTLD_NOLOAD);
-  }
-}
-
 void init_python() {
   bool we_initialized = false;
   if (!Py_IsInitialized()) {
-    promote_libpython();
+    mxnet_tpu_embed::promote_libpython();
     Py_InitializeEx(0);
     we_initialized = true;
   }

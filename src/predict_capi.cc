@@ -16,13 +16,13 @@
 
 #include <Python.h>
 
-#include <dlfcn.h>
-
 #include <cstdint>
 #include <cstring>
 #include <mutex>
 #include <string>
 #include <vector>
+
+#include "embed_python.h"
 
 extern "C" {
 #include "../include/mxnet_tpu/c_predict_api.h"
@@ -62,24 +62,10 @@ std::string py_error() {
 std::once_flag g_init_flag;
 bool g_init_ok = false;
 
-void promote_libpython() {
-  // FFI hosts (perl DynaLoader, LuaJIT ffi, node) dlopen this library
-  // RTLD_LOCAL, so the libpython we depend on never reaches the GLOBAL
-  // symbol namespace — and the interpreter's OWN extension modules
-  // (math, numpy's C core) then fail with "undefined symbol:
-  // PyFloat_Type".  Re-dlopen the already-loaded libpython by its real
-  // path with RTLD_GLOBAL|RTLD_NOLOAD to promote it.
-  Dl_info info;
-  if (dladdr(reinterpret_cast<void*>(&Py_Initialize), &info) != 0 &&
-      info.dli_fname != nullptr) {
-    dlopen(info.dli_fname, RTLD_NOW | RTLD_GLOBAL | RTLD_NOLOAD);
-  }
-}
-
 void init_python() {
   bool we_initialized = false;
   if (!Py_IsInitialized()) {
-    promote_libpython();
+    mxnet_tpu_embed::promote_libpython();
     Py_InitializeEx(0);
     we_initialized = true;
   }
