@@ -55,8 +55,11 @@ def _sync_param(mod):
 
 def row(name, value, unit, ref_k80=None, **extra):
     # provenance per row: best-of-N merge keeps rows from older runs, so
-    # each row records which code revision measured it (advisor r3)
-    entry = {"metric": name, "value": round(value, 2), "unit": unit,
+    # each row records which code revision measured it (advisor r3).
+    # sec/step values are ~0.03 — two decimals would alias distinct
+    # runs (and disagree with the row's own tflops field)
+    digits = 4 if unit.startswith("sec") else 2
+    entry = {"metric": name, "value": round(value, digits), "unit": unit,
              "commit": _REV, "ts": int(time.time())}
     if ref_k80:
         entry["ref_k80"] = ref_k80

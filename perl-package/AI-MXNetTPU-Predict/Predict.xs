@@ -44,7 +44,10 @@ _create(symbol_json, params_blob, dev_type, dev_id, input_key, shape_ref)
     const char* blob = SvPVbyte(params_blob, blob_len);
     AV* av = want_av(shape_ref, "input_shape");
     uint32_t ndim = (uint32_t)(av_len(av) + 1);
-    uint32_t* dims = (uint32_t*)alloca(sizeof(uint32_t) * (ndim ? ndim : 1));
+    uint32_t dims[64];  /* tensor ranks are tiny; bound the stack use */
+    if (ndim > 64) {
+      croak("input_shape: %u dims (max 64)", (unsigned)ndim);
+    }
     uint32_t i;
     uint32_t indptr[2];
     const char* keys[1];
@@ -77,6 +80,9 @@ _set_input(handle, key, data_ref)
     float* buf = (float*)malloc(sizeof(float) * (n ? n : 1));
     uint32_t i;
     int rc;
+    if (buf == NULL) {
+      croak("set_input: out of memory for %u floats", (unsigned)n);
+    }
     for (i = 0; i < n; ++i) {
       SV** el = av_fetch(av, i, 0);
       buf[i] = el ? (float)SvNV(*el) : 0.0f;
@@ -124,6 +130,9 @@ _get_output(handle, index, size)
     float* buf = (float*)malloc(sizeof(float) * (size ? size : 1));
     AV* av;
     UV i;
+    if (buf == NULL) {
+      croak("get_output: out of memory for %" UVuf " floats", size);
+    }
     if (MXPredGetOutput(INT2PTR(PredictorHandle, handle), (uint32_t)index,
                         buf, (uint32_t)size) != 0) {
       free(buf);

@@ -1,0 +1,255 @@
+"""Reference-semantics edge cases for the subtle op families.
+
+Reference: ``tests/python/unittest/test_operator.py`` spends most of its
+3018 LoC on exactly these behaviors — pooling conventions, pad modes,
+cast matrices, index-mode edge values, sequence-length boundaries.
+Every case here pins a semantic the word 'works' doesn't cover.
+"""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sym
+from mxnet_tpu.test_utils import assert_almost_equal
+
+RS = np.random.RandomState(42)
+
+
+# ---- Pooling conventions (reference pooling-inl.h kValid/kFull) -----------
+
+def _pool(x, **kw):
+    return nd.Pooling(nd.array(x), **kw).asnumpy()
+
+
+def test_pooling_convention_shapes():
+    x = RS.rand(1, 1, 7, 7).astype(np.float32)
+    # valid: floor((7-3)/2)+1 = 3 ; full: ceil((7-3)/2)+1 = 3
+    assert _pool(x, kernel=(3, 3), stride=(2, 2)).shape == (1, 1, 3, 3)
+    # 8x8: valid floor(5/3)+1=2, full ceil(5/3)+1=3
+    x = RS.rand(1, 1, 8, 8).astype(np.float32)
+    assert _pool(x, kernel=(3, 3), stride=(3, 3),
+                 pooling_convention="valid").shape == (1, 1, 2, 2)
+    assert _pool(x, kernel=(3, 3), stride=(3, 3),
+                 pooling_convention="full").shape == (1, 1, 3, 3)
+
+
+def test_pooling_full_convention_values():
+    """'full' windows hanging off the edge must pool only the valid
+    region (max) / divide by the FULL kernel count only for the
+    in-bounds elements (avg follows the reference's exclude-pad count
+    when the window is clipped)."""
+    x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    out = _pool(x, kernel=(3, 3), stride=(3, 3),
+                pooling_convention="full", pool_type="max")
+    assert out.shape == (1, 1, 2, 2)
+    # windows: [0:3,0:3], [0:3,3:4], [3:4,0:3], [3:4,3:4]
+    want = np.array([[10, 11], [14, 15]], np.float32)
+    assert (out[0, 0] == want).all(), out
+
+
+def test_pooling_pad_and_avg():
+    x = np.ones((1, 1, 4, 4), np.float32)
+    out = _pool(x, kernel=(2, 2), stride=(2, 2), pad=(1, 1),
+                pool_type="avg")
+    # padded avg pooling counts pad zeros (reference kAvgPooling w/ pad)
+    assert out.shape == (1, 1, 3, 3)
+    assert abs(out[0, 0, 0, 0] - 0.25) < 1e-6, out[0, 0]
+    assert abs(out[0, 0, 1, 1] - 1.0) < 1e-6
+
+
+def test_pooling_sum_type():
+    x = np.ones((1, 1, 4, 4), np.float32)
+    out = _pool(x, kernel=(2, 2), stride=(2, 2), pool_type="sum")
+    assert (out == 4.0).all()
+
+
+# ---- Pad modes vs np.pad --------------------------------------------------
+
+@pytest.mark.parametrize("mode,npmode", [("constant", "constant"),
+                                         ("edge", "edge"),
+                                         ("reflect", "reflect")])
+def test_pad_modes_match_numpy(mode, npmode):
+    x = RS.rand(1, 2, 4, 5).astype(np.float32)
+    pw = (0, 0, 0, 0, 1, 2, 2, 1)
+    want = np.pad(x, ((0, 0), (0, 0), (1, 2), (2, 1)), npmode,
+                  **({"constant_values": 3.5}
+                     if npmode == "constant" else {}))
+    got = nd.Pad(nd.array(x), mode=mode, pad_width=pw,
+                 constant_value=3.5).asnumpy()
+    assert_almost_equal(got, want.astype(np.float32))
+
+
+# ---- Cast matrix ----------------------------------------------------------
+
+@pytest.mark.parametrize("src", ["float32", "float16", "uint8", "int32"])
+@pytest.mark.parametrize("dst", ["float32", "float16", "uint8", "int32"])
+def test_cast_matrix(src, dst):
+    x = np.array([[0, 1, 2], [3, 100, 255]], np.float64)
+    a = nd.array(x.astype(src))
+    out = nd.Cast(a, dtype=dst).asnumpy()
+    assert out.dtype == np.dtype(dst), (src, dst, out.dtype)
+    assert_almost_equal(out.astype(np.float64),
+                        x.astype(src).astype(dst).astype(np.float64))
+
+
+def test_cast_bfloat16_roundtrip():
+    x = RS.rand(3, 4).astype(np.float32)
+    b = nd.Cast(nd.array(x), dtype="bfloat16")
+    back = nd.Cast(b, dtype="float32").asnumpy()
+    assert np.max(np.abs(back - x)) < 0.01  # bf16 has 8 mantissa bits
+
+
+# ---- take / batch_take index-mode edges -----------------------------------
+
+def test_take_clip_and_wrap_modes():
+    x = np.arange(12, dtype=np.float32).reshape(4, 3)
+    idx = np.array([-1, 0, 3, 5], np.float32)
+    clip = nd.take(nd.array(x), nd.array(idx), mode="clip").asnumpy()
+    want_clip = x[np.clip(idx.astype(int), 0, 3)]
+    assert (clip == want_clip).all()
+    wrap = nd.take(nd.array(x), nd.array(idx), mode="wrap").asnumpy()
+    want_wrap = x[idx.astype(int) % 4]
+    assert (wrap == want_wrap).all()
+
+
+def test_take_axis1():
+    x = np.arange(12, dtype=np.float32).reshape(3, 4)
+    idx = np.array([3, 0], np.float32)
+    out = nd.take(nd.array(x), nd.array(idx), axis=1).asnumpy()
+    assert (out == x[:, [3, 0]]).all()
+
+
+def test_batch_take():
+    x = np.arange(12, dtype=np.float32).reshape(4, 3)
+    idx = np.array([0, 2, 1, 0], np.float32)
+    out = nd.batch_take(nd.array(x), nd.array(idx)).asnumpy()
+    want = x[np.arange(4), idx.astype(int)]
+    assert (out == want).all()
+
+
+def test_embedding_forward_and_grad_rows():
+    """Only looked-up rows may receive gradient."""
+    table = RS.rand(5, 3).astype(np.float32)
+    e = sym.Embedding(sym.Variable("i"), input_dim=5, output_dim=3,
+                      name="em")
+    ex = e.simple_bind(mx.cpu(), i=(3,), grad_req="write")
+    ex.arg_dict["i"][:] = np.array([1.0, 3.0, 1.0])
+    ex.arg_dict["em_weight"][:] = table
+    out = ex.forward(is_train=True)[0].asnumpy()
+    assert (out == table[[1, 3, 1]]).all()
+    ex.backward(nd.ones((3, 3)))
+    g = ex.grad_dict["em_weight"].asnumpy()
+    assert (g[1] == 2).all() and (g[3] == 1).all()
+    assert (g[[0, 2, 4]] == 0).all()
+
+
+# ---- slice family edges ---------------------------------------------------
+
+def test_slice_negative_and_axis_bounds():
+    x = np.arange(24, dtype=np.float32).reshape(4, 6)
+    # the 0.9.x-era slice takes CONCRETE begin/end tuples (no None
+    # entries — MXNDArraySlice is mx_uint begin/end); negative bounds
+    # go through slice_axis
+    got = nd.slice(nd.array(x), begin=(1, 0), end=(3, 5)).asnumpy()
+    assert (got == x[1:3, :5]).all()
+    got = nd.slice_axis(nd.array(x), axis=0, begin=1, end=3).asnumpy()
+    assert (got == x[1:3]).all()
+
+
+def test_slice_assign_family():
+    x = np.zeros((3, 4), np.float32)
+    out = nd._slice_assign(nd.array(x), nd.ones((1, 2)),
+                           begin=(1, 1), end=(2, 3)).asnumpy()
+    want = x.copy()
+    want[1:2, 1:3] = 1
+    assert (out == want).all()
+    out = nd._crop_assign_scalar(nd.array(x), begin=(0, 0), end=(2, 2),
+                                 scalar=7.0).asnumpy()
+    want = x.copy()
+    want[:2, :2] = 7
+    assert (out == want).all()
+
+
+# ---- sequence ops at boundary lengths -------------------------------------
+
+def test_sequence_ops_boundary_lengths():
+    # (seq, batch, feat)
+    x = np.arange(24, dtype=np.float32).reshape(4, 2, 3)
+    slen = np.array([1.0, 4.0], np.float32)
+    masked = nd.SequenceMask(nd.array(x), nd.array(slen),
+                             use_sequence_length=True,
+                             value=-9.0).asnumpy()
+    assert (masked[0] == x[0]).all()
+    assert (masked[1:, 0] == -9.0).all()      # batch 0: only step 0 kept
+    assert (masked[:, 1] == x[:, 1]).all()    # batch 1: full length
+    last = nd.SequenceLast(nd.array(x), nd.array(slen),
+                           use_sequence_length=True).asnumpy()
+    assert (last[0] == x[0, 0]).all() and (last[1] == x[3, 1]).all()
+    rev = nd.SequenceReverse(nd.array(x), nd.array(slen),
+                             use_sequence_length=True).asnumpy()
+    assert (rev[:, 1] == x[::-1, 1]).all()    # full reverse
+    assert (rev[0, 0] == x[0, 0]).all()       # length-1: unchanged
+    assert (rev[1:, 0] == x[1:, 0]).all()
+
+
+# ---- Deconvolution adj / target_shape -------------------------------------
+
+def test_deconvolution_adj_and_target_shape():
+    x = RS.rand(1, 2, 4, 4).astype(np.float32)
+    base = nd.Deconvolution(nd.array(x), nd.ones((2, 3, 3, 3)),
+                            nd.zeros((3,)), kernel=(3, 3), stride=(2, 2),
+                            num_filter=3)
+    assert base.shape == (1, 3, 9, 9)
+    adj = nd.Deconvolution(nd.array(x), nd.ones((2, 3, 3, 3)),
+                           nd.zeros((3,)), kernel=(3, 3), stride=(2, 2),
+                           num_filter=3, adj=(1, 1))
+    assert adj.shape == (1, 3, 10, 10)
+    # adj only pads the bottom/right edge: the overlap region matches
+    assert_almost_equal(adj.asnumpy()[:, :, :9, :9], base.asnumpy())
+
+
+# ---- UpSampling -----------------------------------------------------------
+
+def test_upsampling_nearest_scales():
+    x = np.arange(4, dtype=np.float32).reshape(1, 1, 2, 2)
+    for s in (2, 3):
+        out = nd.UpSampling(nd.array(x), scale=s,
+                            sample_type="nearest").asnumpy()
+        assert out.shape == (1, 1, 2 * s, 2 * s)
+        want = x.repeat(s, axis=2).repeat(s, axis=3)
+        assert (out == want).all()
+
+
+# ---- BatchNorm attr interplay ---------------------------------------------
+
+def test_batchnorm_global_stats_and_mean_var_outputs():
+    x = (RS.rand(4, 3, 2, 2) * 2 + 1).astype(np.float32)
+    net = sym.BatchNorm(sym.Variable("x"), fix_gamma=False,
+                        use_global_stats=True, eps=1e-4, name="bn")
+    ex = net.simple_bind(mx.cpu(), x=x.shape, grad_req="null")
+    ex.arg_dict["x"][:] = x
+    ex.arg_dict["bn_gamma"][:] = np.full((3,), 2.0, np.float32)
+    ex.arg_dict["bn_beta"][:] = np.full((3,), 0.5, np.float32)
+    mm = np.array([0.5, 1.0, 1.5], np.float32)
+    mv = np.array([1.0, 4.0, 0.25], np.float32)
+    ex.aux_dict["bn_moving_mean"][:] = mm
+    ex.aux_dict["bn_moving_var"][:] = mv
+    out = ex.forward(is_train=True)[0].asnumpy()  # global stats EVEN in train
+    want = 2.0 * (x - mm[None, :, None, None]) \
+        / np.sqrt(mv[None, :, None, None] + 1e-4) + 0.5
+    assert_almost_equal(out, want, rtol=1e-4, atol=1e-4)
+    # aux must NOT move under use_global_stats
+    assert (ex.aux_dict["bn_moving_mean"].asnumpy() == mm).all()
+
+    net2 = sym.BatchNorm(sym.Variable("x"), output_mean_var=True,
+                         fix_gamma=True, name="bn2")
+    ex2 = net2.simple_bind(mx.cpu(), x=x.shape, grad_req="null")
+    ex2.arg_dict["x"][:] = x
+    outs = ex2.forward(is_train=True)
+    assert len(outs) == 3
+    mean = outs[1].asnumpy()
+    var = outs[2].asnumpy()
+    assert_almost_equal(mean, x.mean(axis=(0, 2, 3)), rtol=1e-3,
+                        atol=1e-4)
+    assert_almost_equal(var, x.var(axis=(0, 2, 3)), rtol=1e-2, atol=1e-3)
