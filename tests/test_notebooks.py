@@ -1,0 +1,49 @@
+"""Execute every code cell of the tutorial notebooks (so they cannot
+rot) and the example/utils data helpers' offline path."""
+
+import json
+import os
+import struct
+
+import numpy as np
+import pytest
+
+ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+NB_DIR = os.path.join(ROOT, "example", "notebooks")
+
+
+@pytest.mark.parametrize("name", ["basics.ipynb", "train_module.ipynb"])
+def test_notebook_cells_execute(name):
+    with open(os.path.join(NB_DIR, name)) as f:
+        nb = json.load(f)
+    ns = {}
+    ran = 0
+    for cell in nb["cells"]:
+        if cell["cell_type"] != "code":
+            continue
+        src = "".join(cell["source"])
+        exec(compile(src, "%s[cell %d]" % (name, ran), "exec"), ns)
+        ran += 1
+    assert ran >= 5, "notebook %s has only %d code cells" % (name, ran)
+
+
+def test_get_data_synthesized_mnist(tmp_path, monkeypatch):
+    monkeypatch.syspath_prepend(os.path.join(ROOT, "example"))
+    from utils.get_data import get_mnist, mnist_iterators
+
+    d = get_mnist(str(tmp_path / "mnist"), synthesize=True)
+    # a synthetic set must refuse to masquerade as the real one
+    with pytest.raises(RuntimeError, match="SYNTHETIC"):
+        get_mnist(d, synthesize=False)
+    # files are REAL idx format
+    with open(os.path.join(d, "train-images-idx3-ubyte"), "rb") as f:
+        magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+    assert (magic, rows, cols) == (0x803, 28, 28) and n > 0
+    train_iter, val_iter = mnist_iterators(d, batch_size=32,
+                                           synthesize=True)
+    batch = next(iter(train_iter))
+    assert tuple(batch.data[0].shape) == (32, 1, 28, 28)
+    x = batch.data[0].asnumpy()
+    assert 0.0 <= x.min() and x.max() <= 1.0
+    labels = batch.label[0].asnumpy()
+    assert set(np.unique(labels)).issubset(set(range(10)))
