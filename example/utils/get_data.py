@@ -20,12 +20,12 @@ MNIST_MIRRORS = [
     "https://storage.googleapis.com/cvdf-datasets/mnist/",
     "https://ossci-datasets.s3.amazonaws.com/mnist/",
 ]
-_MNIST_FILES = {
-    "train-images-idx3-ubyte.gz": None,
-    "train-labels-idx1-ubyte.gz": None,
-    "t10k-images-idx3-ubyte.gz": None,
-    "t10k-labels-idx1-ubyte.gz": None,
-}
+_MNIST_FILES = (
+    "train-images-idx3-ubyte.gz",
+    "train-labels-idx1-ubyte.gz",
+    "t10k-images-idx3-ubyte.gz",
+    "t10k-labels-idx1-ubyte.gz",
+)
 
 
 def _write_idx_images(path, images):
@@ -86,12 +86,15 @@ def get_mnist(data_dir="data/mnist", synthesize=False):
     silently train on them)."""
     os.makedirs(data_dir, exist_ok=True)
     marker = os.path.join(data_dir, _MARKER)
+    # the marker guards the WHOLE directory, complete or not: a real
+    # download into a dir holding synthetic leftovers would otherwise
+    # silently mix the two sets
+    if os.path.exists(marker) and not synthesize:
+        raise RuntimeError(
+            "%s holds a SYNTHETIC stand-in set; delete the directory "
+            "to download real MNIST" % data_dir)
     names = [n[:-3] for n in _MNIST_FILES]
     if all(os.path.exists(os.path.join(data_dir, n)) for n in names):
-        if os.path.exists(marker) and not synthesize:
-            raise RuntimeError(
-                "%s holds a SYNTHETIC stand-in set; delete the directory "
-                "to download real MNIST" % data_dir)
         return data_dir
     if synthesize:
         _synthesize_mnist(data_dir)
