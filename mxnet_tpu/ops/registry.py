@@ -250,7 +250,8 @@ def list_ops():
 # mid-process toggle is silently ignored by the cached jit
 _TRACE_ENV_VARS = ("MXNET_BN_PALLAS", "MXNET_BN_ABLATION",
                    "MXNET_BN_STATS_F32", "MXNET_CONV_STEM_S2D",
-                   "MXNET_CONV_GRAD_BARRIER", "MXNET_BACKWARD_DO_MIRROR")
+                   "MXNET_RNN_PALLAS", "MXNET_CONV_GRAD_BARRIER",
+                   "MXNET_BACKWARD_DO_MIRROR")
 
 
 def trace_env_fingerprint():

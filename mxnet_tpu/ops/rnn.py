@@ -80,6 +80,30 @@ def _scan_layer(x, wx, wh, bx, bh, h0, c0, mode):
     xproj = jnp.einsum("tni,gi->tng", x, wx) + bx  # one big MXU matmul
 
     if mode == "lstm":
+        import os as _os
+
+        if _os.environ.get("MXNET_RNN_PALLAS", "0") == "1":
+            # Fused whole-sequence Pallas cell (cudnn fused-RNN analog).
+            # OFF by default: measured at parity with the scan path on
+            # v5e, not faster (docs/how_to/perf.md, round-4 negative) —
+            # XLA's scan already runs the cell at the hardware's
+            # per-step cost.  Kept as the capability artifact with
+            # fwd+bwd parity pinned on CPU (interpret) and hardware.
+            from . import bn_pallas, rnn_pallas
+
+            T, N = xproj.shape[0], xproj.shape[1]
+            H = h0.shape[-1]
+            if rnn_pallas.fits(T, N, H, xproj.dtype):
+                xp4 = xproj.reshape(T, N, 4, H).transpose(0, 2, 1, 3)
+                w4 = wh.T.reshape(H, 4, H).transpose(1, 0, 2)
+                bh4 = bh.reshape(4, H)
+                # _on_tpu handles the unset-trace_device fallback
+                # (default_backend) — None must not mean interpret
+                interp = not bn_pallas._on_tpu()
+                ys, h, c = rnn_pallas.lstm_seq(xp4, w4, bh4, h0, c0,
+                                               interp)
+                return ys, h, c
+
         def step(carry, xp):
             h, c = carry
             gates = xp + jnp.dot(h, wh.T) + bh

@@ -1,0 +1,222 @@
+"""Fused LSTM sequence kernels (Pallas) — the cuDNN fused-RNN analog.
+
+Reference: ``src/operator/cudnn_rnn-inl.h:127`` (cudnnRNNForwardTraining)
+exists because per-timestep kernel launches starved the GPU; the XLA
+analog of that overhead is the pile of small per-step HLOs inside the
+``lax.scan`` cell (gate splits/sigmoids/muls — each a distinct op with
+fixed cost at [N,H]-sized operands).  These kernels run the WHOLE
+recurrence in one Pallas call, everything VMEM-resident: per step, four
+MXU dots plus fused VPU gate math, no inter-HLO overhead.
+
+Layout rules (Mosaic): the LANE (last) axis is never sliced at non-128
+multiples, and kernels do no in-kernel reshape/transpose.  Gates
+therefore ride a dedicated leading axis — projections are
+``(T, 4, N, H)``, recurrent weights ``(4, H, H)`` with ``w4[k]`` the
+(in, out) matrix of gate k, biases ``(4, H)`` — and every gate access
+is a static index.
+
+Backward is a second kernel over the saved activations (post-activation
+gates + cell states), wired through ``jax.custom_vjp`` so ``jax.grad``
+of a graph containing the fused op works like any other.  CPU runs use
+``interpret=True`` (same code, executed by the Pallas interpreter);
+hardware parity is pinned in ``tests_tpu/``.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+# VMEM budget guard: xp + saved gates dominate (two (T,4,N,H) f32 bufs)
+_VMEM_LIMIT_BYTES = 10 * 1024 * 1024
+
+
+def fits(seq_len, batch, hidden, dtype) -> bool:
+    if dtype != jnp.float32:
+        return False
+    per = seq_len * 4 * batch * hidden * 4
+    return 2 * per + 3 * seq_len * batch * hidden * 4 < _VMEM_LIMIT_BYTES
+
+
+def _nt(a, b):
+    """a (N, K) x b (M, K) -> (N, M): contract last with last."""
+    return jax.lax.dot_general(a, b, (((1,), (1,)), ((), ())),
+                               preferred_element_type=jnp.float32)
+
+
+def _tn(a, b):
+    """a (K, N) x b (K, M) -> (N, M): contract first with first."""
+    return jax.lax.dot_general(a, b, (((0,), (0,)), ((), ())),
+                               preferred_element_type=jnp.float32)
+
+
+def _fwd_kernel(T, xp_ref, w4_ref, bh_ref, h0_ref, c0_ref,
+                ys_ref, gates_ref, cs_ref, hT_ref, cT_ref):
+    w4 = w4_ref[...]            # (4, H, H): per-gate (in, out)
+    bh = bh_ref[...]            # (4, H)
+
+    def body(t, carry):
+        h, c = carry
+        xp = xp_ref[pl.ds(t, 1)][0]   # (4, N, H)
+        z = [jnp.dot(h, w4[k], preferred_element_type=jnp.float32)
+             for k in range(4)]
+        i = jax.nn.sigmoid(xp[0] + z[0] + bh[0])
+        f = jax.nn.sigmoid(xp[1] + z[1] + bh[1])
+        g = jnp.tanh(xp[2] + z[2] + bh[2])
+        o = jax.nn.sigmoid(xp[3] + z[3] + bh[3])
+        c = f * c + i * g
+        h = o * jnp.tanh(c)
+        ys_ref[pl.ds(t, 1)] = h[None]
+        cs_ref[pl.ds(t, 1)] = c[None]
+        gates_ref[pl.ds(t, 1)] = jnp.stack([i, f, g, o])[None]
+        return h, c
+
+    h, c = jax.lax.fori_loop(0, T, body, (h0_ref[...], c0_ref[...]))
+    hT_ref[...] = h
+    cT_ref[...] = c
+
+
+def _bwd_kernel(T, gates_ref, cs_ref, ys_ref, w4_ref, h0_ref, c0_ref,
+                dys_ref, dhT_ref, dcT_ref,
+                dxp_ref, dw4_ref, dbh_ref, dh0_ref, dc0_ref):
+    w4 = w4_ref[...]
+    dw4_ref[...] = jnp.zeros(dw4_ref.shape, dw4_ref.dtype)
+    dbh_ref[...] = jnp.zeros(dbh_ref.shape, dbh_ref.dtype)
+
+    def body(kk, carry):
+        dh, dc = carry
+        t = T - 1 - kk
+        tp = jnp.maximum(t - 1, 0)
+        gs = gates_ref[pl.ds(t, 1)][0]   # (4, N, H)
+        i, f, g, o = gs[0], gs[1], gs[2], gs[3]
+        c = cs_ref[pl.ds(t, 1)][0]
+        c_prev = jnp.where(t > 0,
+                           cs_ref[pl.ds(tp, 1)][0],
+                           c0_ref[...])
+        h_prev = jnp.where(t > 0,
+                           ys_ref[pl.ds(tp, 1)][0],
+                           h0_ref[...])
+        dh = dh + dys_ref[pl.ds(t, 1)][0]
+        tc = jnp.tanh(c)
+        do = dh * tc
+        dc = dc + dh * o * (1.0 - tc * tc)
+        dz = [
+            dc * g * i * (1.0 - i),           # d pre-act input gate
+            dc * c_prev * f * (1.0 - f),      # d pre-act forget gate
+            dc * i * (1.0 - g * g),           # d pre-act candidate
+            do * o * (1.0 - o),               # d pre-act output gate
+        ]
+        dxp_ref[pl.ds(t, 1)] = jnp.stack(dz)[None]
+        dh_new = jnp.zeros_like(dh)
+        for k in range(4):
+            dbh_ref[k, :] += jnp.sum(dz[k], axis=0)
+            dw4_ref[k] += _tn(h_prev, dz[k])   # (H_in, H_out)
+            dh_new = dh_new + _nt(dz[k], w4[k])
+        dc = dc * f
+        return dh_new, dc
+
+    dh, dc = jax.lax.fori_loop(0, T, body, (dhT_ref[...], dcT_ref[...]))
+    dh0_ref[...] = dh
+    dc0_ref[...] = dc
+
+
+def _infer_kernel(T, xp_ref, w4_ref, bh_ref, h0_ref, c0_ref,
+                  ys_ref, hT_ref, cT_ref):
+    """Forward without residuals: inference writes only ys/hT/cT —
+    the (T,4,N,H) gates + (T,N,H) cs buffers are training-only."""
+    w4 = w4_ref[...]
+    bh = bh_ref[...]
+
+    def body(t, carry):
+        h, c = carry
+        xp = xp_ref[pl.ds(t, 1)][0]
+        z = [jnp.dot(h, w4[k], preferred_element_type=jnp.float32)
+             for k in range(4)]
+        i = jax.nn.sigmoid(xp[0] + z[0] + bh[0])
+        f = jax.nn.sigmoid(xp[1] + z[1] + bh[1])
+        g = jnp.tanh(xp[2] + z[2] + bh[2])
+        o = jax.nn.sigmoid(xp[3] + z[3] + bh[3])
+        c = f * c + i * g
+        h = o * jnp.tanh(c)
+        ys_ref[pl.ds(t, 1)] = h[None]
+        return h, c
+
+    h, c = jax.lax.fori_loop(0, T, body, (h0_ref[...], c0_ref[...]))
+    hT_ref[...] = h
+    cT_ref[...] = c
+
+
+def _run_infer(xp, w4, bh, h0, c0, interpret):
+    T, _, N, H = xp.shape
+    out_shapes = [
+        jax.ShapeDtypeStruct((T, N, H), jnp.float32),
+        jax.ShapeDtypeStruct((N, H), jnp.float32),
+        jax.ShapeDtypeStruct((N, H), jnp.float32),
+    ]
+    return pl.pallas_call(
+        functools.partial(_infer_kernel, T), out_shape=out_shapes,
+        interpret=interpret)(xp, w4, bh, h0, c0)
+
+
+def _run_fwd(xp, w4, bh, h0, c0, interpret):
+    T, _, N, H = xp.shape
+    out_shapes = [
+        jax.ShapeDtypeStruct((T, N, H), jnp.float32),      # ys
+        jax.ShapeDtypeStruct((T, 4, N, H), jnp.float32),   # gates
+        jax.ShapeDtypeStruct((T, N, H), jnp.float32),      # cs
+        jax.ShapeDtypeStruct((N, H), jnp.float32),         # hT
+        jax.ShapeDtypeStruct((N, H), jnp.float32),         # cT
+    ]
+    return pl.pallas_call(
+        functools.partial(_fwd_kernel, T), out_shape=out_shapes,
+        interpret=interpret)(xp, w4, bh, h0, c0)
+
+
+def _run_bwd(gates, cs, ys, w4, h0, c0, dys, dhT, dcT, interpret):
+    T, _, N, H = gates.shape
+    out_shapes = [
+        jax.ShapeDtypeStruct((T, 4, N, H), jnp.float32),   # dxp
+        jax.ShapeDtypeStruct((4, H, H), jnp.float32),      # dw4
+        jax.ShapeDtypeStruct((4, H), jnp.float32),         # dbh
+        jax.ShapeDtypeStruct((N, H), jnp.float32),         # dh0
+        jax.ShapeDtypeStruct((N, H), jnp.float32),         # dc0
+    ]
+    return pl.pallas_call(
+        functools.partial(_bwd_kernel, T), out_shape=out_shapes,
+        interpret=interpret)(gates, cs, ys, w4, h0, c0, dys, dhT, dcT)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5,))
+def lstm_seq(xp, w4, bh, h0, c0, interpret=False):
+    """Fused LSTM over a whole sequence.
+
+    xp: (T, 4, N, H) input-side projections (x@Wx + bx, gate-major);
+    w4: (4, H, H) recurrent weights, w4[k] = (in, out) of gate k;
+    bh: (4, H); h0/c0: (N, H).  Gate order i, f, g, o (the RNN op's
+    split order).  Returns (ys (T,N,H), hT, cT).
+
+    The primal (no gradient requested) runs the residual-free
+    inference kernel; under ``jax.grad`` the vjp fwd saves
+    gates/cell-states for the backward kernel.
+    """
+    ys, hT, cT = _run_infer(xp, w4, bh, h0, c0, interpret)
+    return ys, hT, cT
+
+
+def _vjp_fwd(xp, w4, bh, h0, c0, interpret):
+    ys, gates, cs, hT, cT = _run_fwd(xp, w4, bh, h0, c0, interpret)
+    return (ys, hT, cT), (gates, cs, ys, w4, h0, c0)
+
+
+def _vjp_bwd(interpret, saved, grads):
+    gates, cs, ys, w4, h0, c0 = saved
+    dys, dhT, dcT = grads
+    dxp, dw4, dbh, dh0, dc0 = _run_bwd(
+        gates, cs, ys, w4, h0, c0, dys, dhT, dcT, interpret)
+    return dxp, dw4, dbh, dh0, dc0
+
+
+lstm_seq.defvjp(_vjp_fwd, _vjp_bwd)

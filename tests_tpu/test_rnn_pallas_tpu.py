@@ -1,0 +1,43 @@
+"""Fused Pallas LSTM on real hardware: numerics vs the scan path."""
+
+import os
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import sym
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def _run(flag, ctx, seq=35, batch=32, nin=200, nh=200):
+    os.environ["MXNET_RNN_PALLAS"] = flag
+    try:
+        rs = np.random.RandomState(0)
+        from mxnet_tpu.ops.rnn import rnn_param_size
+
+        psize = rnn_param_size(nin, nh, 2, "lstm", False)
+        net = sym.RNN(sym.Variable("x"), sym.Variable("p"),
+                      sym.Variable("hs"), sym.Variable("cs"),
+                      state_size=nh, num_layers=2, mode="lstm",
+                      name="rnn")
+        ex = net.simple_bind(ctx, x=(seq, batch, nin), p=(psize,),
+                             hs=(2, batch, nh), cs=(2, batch, nh),
+                             grad_req="write")
+        ex.arg_dict["x"][:] = rs.randn(seq, batch, nin) * 0.2
+        ex.arg_dict["p"][:] = rs.randn(psize) * 0.1
+        ex.arg_dict["hs"][:] = 0
+        ex.arg_dict["cs"][:] = 0
+        out = ex.forward(is_train=True)[0].asnumpy()
+        ex.backward(mx.nd.ones(out.shape, ctx=ctx))
+        return out, ex.grad_dict["p"].asnumpy()
+    finally:
+        os.environ.pop("MXNET_RNN_PALLAS", None)
+
+
+def test_fused_lstm_hardware_parity():
+    ctx = mx.tpu()
+    out_s, gp_s = _run("0", ctx)
+    out_k, gp_k = _run("1", ctx)
+    assert_almost_equal(out_k, out_s, rtol=2e-3, atol=2e-3)
+    scale = max(1e-6, float(np.abs(gp_s).max()))
+    assert float(np.abs(gp_k - gp_s).max()) / scale < 2e-2
