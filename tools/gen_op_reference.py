@@ -1,0 +1,100 @@
+#!/usr/bin/env python
+"""Generate docs/api/op_reference.md — the per-operator API reference.
+
+Reference analog: the reference builds per-op docs from its C registry's
+docstrings at import (``python/mxnet/_ctypes``).  Here the registry
+carries typed param specs directly, so the reference is generated: one
+row per public op — arguments, aux states, outputs, and every param
+with its type and default — plus the alias table.
+
+Regenerate with ``python tools/gen_op_reference.py`` (CI freshness via
+``tests/test_docs_generated.py``).
+"""
+
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+from mxnet_tpu.ops import registry  # noqa: E402
+import mxnet_tpu  # noqa: E402,F401  (populates the registry)
+
+
+def _type_name(parser):
+    return {
+        registry.pbool: "bool", registry.pint: "int",
+        registry.pfloat: "float", registry.pstr: "str",
+        registry.ptuple: "shape", registry.ptuple_or_int: "shape",
+        registry.pdtype: "dtype",
+    }.get(parser, getattr(parser, "__name__", "str").lstrip("_p"))
+
+
+def _default_str(d):
+    if d is registry.REQUIRED:
+        return "required"
+    if d is None:
+        return "None"
+    if isinstance(d, str):
+        return "'%s'" % d
+    return str(d)
+
+
+def _names(fn_or_seq, op):
+    attrs = {k: (None if v[1] is registry.REQUIRED else v[1])
+             for k, v in op.params.items()}
+    try:
+        return ", ".join(fn_or_seq(attrs))
+    except Exception:
+        return "(attr-dependent)"
+
+
+def main(out=None):
+    names = sorted(registry._REGISTRY)
+    aliases = sorted(registry._ALIASES.items())
+    lines = [
+        "# Operator reference (generated — do not edit)",
+        "",
+        "Regenerate with `python tools/gen_op_reference.py`.  Every op",
+        "is callable as `mx.nd.<Op>(...)` (imperative) and",
+        "`mx.sym.<Op>(...)` (symbolic); params accept python values or",
+        "the string forms used in symbol JSON.  Names beginning with an",
+        "underscore are internal/scalar variants kept for reference",
+        "parity.",
+        "",
+        "%d distinct operators, %d aliases." % (len(names), len(aliases)),
+        "",
+        "| op | arguments | aux states | outputs | params (type=default) |",
+        "|---|---|---|---|---|",
+    ]
+    for n in names:
+        op = registry.get(n)
+        params = "; ".join(
+            "%s: %s=%s" % (k, _type_name(p), _default_str(d))
+            for k, (p, d) in op.params.items()) or "—"
+        lines.append("| `%s` | %s | %s | %s | %s |" % (
+            n,
+            _names(op.list_arguments, op) or "—",
+            _names(op.list_aux_states, op) or "—",
+            _names(op.list_outputs, op) or "—",
+            params))
+    lines += ["", "## Aliases", "",
+              "| alias | canonical op |", "|---|---|"]
+    for a, t in aliases:
+        lines.append("| `%s` | `%s` |" % (a, t))
+    lines.append("")
+    if out is None:
+        out = os.path.join(ROOT, "docs", "api", "op_reference.md")
+    out = os.path.abspath(out)  # bare filename -> dirname would be ''
+    os.makedirs(os.path.dirname(out), exist_ok=True)
+    with open(out, "w") as f:
+        f.write("\n".join(lines))
+    print("wrote %s: %d ops, %d aliases" % (out, len(names), len(aliases)))
+
+
+if __name__ == "__main__":
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=None)
+    main(ap.parse_args().out)
