@@ -182,7 +182,9 @@ int main(void) {
     CK(MXFrontRecordIOWriterFree(w));
     CK(MXFrontRecordIOReaderCreate("/tmp/c_train_log.rec", &r));
     CK(MXFrontRecordIOReaderReadRecord(r, &buf, &size));
-    if (size == 0 || strncmp(buf, "accuracy=", 9) != 0) {
+    /* EOF is signalled by buf == NULL; a non-NULL buf with size == 0 is a
+     * legitimately empty record. */
+    if (buf == NULL || size < 9 || strncmp(buf, "accuracy=", 9) != 0) {
       fprintf(stderr, "FAILED: recordio roundtrip\n");
       return 1;
     }

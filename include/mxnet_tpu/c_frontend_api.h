@@ -218,9 +218,10 @@ int MXFrontExecutorGetAux(ExecutorHandle h, const char* name,
 int MXFrontExecutorPrint(ExecutorHandle h, const char** out_str);
 /*! \brief install a per-output monitor fired during Forward (reference
  *  MXExecutorSetMonitorCallback): cb(name, array, cb_data) for every
- *  executor output; the NDArrayHandle passed to the callback is owned
- *  by the runtime and valid only inside the callback (copy out via
- *  SyncCopyToCPU).  cb == NULL uninstalls. */
+ *  executor output; the NDArrayHandle passed to the callback is OWNED
+ *  by the callback — release it with MXFrontNDArrayFree like any other
+ *  handle (it stays valid after the callback returns until freed).
+ *  cb == NULL uninstalls. */
 typedef void (*MXFrontMonitorCallback)(const char* name,
                                        NDArrayHandle array, void* cb_data);
 int MXFrontExecutorSetMonitorCallback(ExecutorHandle h,

@@ -347,7 +347,10 @@ def exec_set_monitor(ex, cb_addr, data_addr):
     """Install a C monitor callback (MXFrontExecutorSetMonitorCallback):
     trampoline the (name, NDArrayHandle, user_data) C signature through
     ctypes.  ``id(arr)`` IS the PyObject* the C side treats as a handle;
-    the array is kept referenced for the duration of the call."""
+    an owned reference is taken before the call, so the handle follows
+    the same contract as every other NDArrayHandle in the ABI — the
+    callback releases it with MXFrontNDArrayFree (and may keep it alive
+    past the callback's return until then)."""
     if not cb_addr:
         ex.set_monitor_callback(None)
         return
@@ -356,6 +359,7 @@ def exec_set_monitor(ex, cb_addr, data_addr):
     user = ctypes.c_void_p(data_addr)
 
     def monitor(name, arr):
+        ctypes.pythonapi.Py_IncRef(ctypes.py_object(arr))
         cfn(str(name).encode(), ctypes.c_void_p(id(arr)), user)
 
     ex.set_monitor_callback(monitor)

@@ -221,6 +221,11 @@ def _decode_boxes(anchors, loc_pred, var, clip):
     return out
 
 
+# Above this box count the O(A^2) IoU matrix is not materialized (see
+# _greedy_nms); module-level so tests can pin matrix==streaming parity.
+NMS_MATRIX_MAX_BOXES = 2048
+
+
 def _greedy_nms(boxes, cls_id, order, nms_thresh, force):
     """Greedy NMS over boxes visited in `order`; returns keep mask."""
     A = boxes.shape[0]
@@ -233,7 +238,7 @@ def _greedy_nms(boxes, cls_id, order, nms_thresh, force):
     # defaults to 6000) the materialized matrix OOMs fused-on-TPU, so
     # compute each visited box's IoU row on the fly (O(A) memory, same
     # total FLOPs)
-    iou = _iou_matrix(boxes, boxes) if A <= 2048 else None
+    iou = _iou_matrix(boxes, boxes) if A <= NMS_MATRIX_MAX_BOXES else None
 
     def body(i, keep):
         j = order[i]

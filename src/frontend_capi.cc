@@ -242,6 +242,20 @@ int fill_shape_list(PyObject* shapes, uint32_t* count,
   return 0;
 }
 
+// Verify a value returned by the python layer is a tuple of >= n items;
+// a malformed return must surface as -1 + MXFrontGetLastError, never as a
+// NULL deref inside the host process.
+int tuple_check(PyObject* r, Py_ssize_t n, const char* fn) {
+  if (r == nullptr || !PyTuple_Check(r) ||
+      PyTuple_GET_SIZE(r) < n) {
+    set_error(std::string(fn) + ": python layer returned a malformed " +
+              "value (expected a tuple of >= " + std::to_string(n) +
+              " items)");
+    return -1;
+  }
+  return 0;
+}
+
 #define API_BEGIN()                         \
   if (!ensure_init()) return -1;            \
   Gil gil_;                                 \
@@ -433,11 +447,18 @@ int MXFrontNDArrayLoad(const char* fname, uint32_t* out_num,
   API_BEGIN();
   PyObject* r = callf("nd_load", "(s)", fname);
   if (r == nullptr) return -1;
+  if (tuple_check(r, 2, "nd_load") != 0) { Py_DECREF(r); return -1; }
   PyObject* keys = PyTuple_GetItem(r, 0);     // borrowed
   PyObject* arrays = PyTuple_GetItem(r, 1);   // borrowed
   Scratch* s = &g_scratch[0];
   s->handles.clear();
   Py_ssize_t n = PySequence_Size(arrays);
+  if (n < 0) {
+    PyErr_Clear();
+    Py_DECREF(r);
+    set_error("nd_load: python layer returned a non-sequence array list");
+    return -1;
+  }
   for (Py_ssize_t i = 0; i < n; ++i) {
     s->handles.push_back(PySequence_GetItem(arrays, i));  // new refs
   }
@@ -526,8 +547,15 @@ int MXFrontNDArrayGetContext(NDArrayHandle h, int* out_dev_type,
   API_BEGIN();
   PyObject* r = callf("nd_context", "(O)", h);
   if (r == nullptr) return -1;
+  if (tuple_check(r, 2, "nd_context") != 0) { Py_DECREF(r); return -1; }
   *out_dev_type = static_cast<int>(PyLong_AsLong(PyTuple_GetItem(r, 0)));
   *out_dev_id = static_cast<int>(PyLong_AsLong(PyTuple_GetItem(r, 1)));
+  if (PyErr_Occurred()) {
+    PyErr_Clear();
+    Py_DECREF(r);
+    set_error("nd_context: python layer returned non-integer items");
+    return -1;
+  }
   Py_DECREF(r);
   API_END();
 }
@@ -640,9 +668,16 @@ int MXFrontSymbolGetAttr(SymbolHandle h, const char* key,
   API_BEGIN();
   PyObject* r = callf("sym_get_attr", "(Os)", h, key);
   if (r == nullptr) return -1;
+  if (tuple_check(r, 2, "sym_get_attr") != 0) { Py_DECREF(r); return -1; }
   fill_string(PyTuple_GetItem(r, 0), out, &g_scratch[0]);
   *out_success =
       static_cast<int>(PyLong_AsLong(PyTuple_GetItem(r, 1)));
+  if (PyErr_Occurred()) {
+    PyErr_Clear();
+    Py_DECREF(r);
+    set_error("sym_get_attr: python layer returned a non-integer flag");
+    return -1;
+  }
   Py_DECREF(r);
   API_END();
 }
@@ -721,6 +756,7 @@ static int infer_shape_impl(const char* pyfn, SymbolHandle h,
   Py_DECREF(names);
   Py_DECREF(shapes);
   if (r == nullptr) return -1;
+  if (tuple_check(r, 3, pyfn) != 0) { Py_DECREF(r); return -1; }
   int rc = fill_shape_list(PyTuple_GetItem(r, 0), arg_count, arg_ndim,
                            arg_shapes, &g_scratch[0]);
   if (rc == 0) {

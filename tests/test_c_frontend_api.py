@@ -368,6 +368,7 @@ def c_mon(mname, arr, _u):
     lib.MXFrontNDArrayGetShape(P(arr), ctypes.byref(shp),
                                ctypes.byref(dd))
     seen.append((mname.decode(), tuple(dd[i] for i in range(shp.value))))
+    lib.MXFrontNDArrayFree(P(arr))  # monitor handles are owned
 
 
 mon_c = MON(c_mon)

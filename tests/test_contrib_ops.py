@@ -176,6 +176,33 @@ def test_greedy_nms_streaming_matches_matrix():
         assert (got == want).all(), (a, int((got != want).sum()))
 
 
+def test_greedy_nms_branch_equivalence_identical_inputs(monkeypatch):
+    """Pin streaming == matrix directly: the SAME boxes through both
+    branches (the size-based switch is forced via NMS_MATRIX_MAX_BOXES),
+    with mixed class ids and force_suppress off so the class-gating path
+    is exercised too."""
+    import jax.numpy as jnp
+
+    from mxnet_tpu.ops import contrib
+
+    rs = np.random.RandomState(7)
+    a = 600
+    xy = rs.rand(a, 2).astype(np.float32) * 60
+    wh = rs.rand(a, 2).astype(np.float32) * 30 + 2
+    boxes = jnp.asarray(np.concatenate([xy, xy + wh], axis=1))
+    cls_id = jnp.asarray(rs.randint(-1, 3, size=a).astype(np.float32))
+    order = jnp.asarray(rs.permutation(a))
+    kwargs = dict(nms_thresh=0.5, force=False)
+
+    got_matrix = np.asarray(
+        contrib._greedy_nms(boxes, cls_id, order, **kwargs))
+    monkeypatch.setattr(contrib, "NMS_MATRIX_MAX_BOXES", 0)
+    got_stream = np.asarray(
+        contrib._greedy_nms(boxes, cls_id, order, **kwargs))
+    assert (got_matrix == got_stream).all(), \
+        int((got_matrix != got_stream).sum())
+
+
 def test_roi_pooling_vs_numpy():
     rs = np.random.RandomState(1)
     data = rs.randn(1, 2, 6, 6).astype(np.float32)
