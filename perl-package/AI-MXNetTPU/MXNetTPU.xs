@@ -1,0 +1,574 @@
+/* XS glue: perl <-> the frontend C ABI (include/mxnet_tpu/c_frontend_api.h).
+ *
+ * Reference analog: perl-package/AI-MXNetCAPI (SWIG over c_api.h) feeding
+ * perl-package/AI-MXNet (the reference's full perl TRAINING frontend).
+ * Each XSUB below is a mechanical marshal of one ABI call — no Python.h,
+ * no framework internals — proving the 82-function frontend ABI carries a
+ * complete training loop (symbol build, simple_bind, forward/backward,
+ * optimizer update, NDArray save/load, NDArrayIter) from a second
+ * language.  Build: MXNET_TPU_LIBDIR=<dir> perl Makefile.PL && make.
+ */
+
+#include "EXTERN.h"
+#include "perl.h"
+#include "XSUB.h"
+
+#include <mxnet_tpu/c_frontend_api.h>
+
+static void croak_last(const char* what) {
+  croak("%s: %s", what, MXFrontGetLastError());
+}
+
+/* SvRV on a non-reference is undefined behavior (a segfault, not a
+ * perl exception) — validate every incoming arrayref. */
+static AV* want_av(SV* sv, const char* what) {
+  if (!SvROK(sv) || SvTYPE(SvRV(sv)) != SVt_PVAV) {
+    croak("%s: expected an ARRAY reference", what);
+  }
+  return (AV*)SvRV(sv);
+}
+
+/* arrayref of strings -> malloc'd char*[] (pointers borrow the SVs'
+ * buffers, valid for the duration of the XSUB). */
+static const char** av_strings(AV* av, uint32_t* out_n) {
+  uint32_t n = (uint32_t)(av_len(av) + 1);
+  const char** out = (const char**)malloc(sizeof(char*) * (n ? n : 1));
+  uint32_t i;
+  if (out == NULL) croak("out of memory for %u strings", (unsigned)n);
+  for (i = 0; i < n; ++i) {
+    SV** el = av_fetch(av, i, 0);
+    out[i] = el ? SvPV_nolen(*el) : "";
+  }
+  *out_n = n;
+  return out;
+}
+
+MODULE = AI::MXNetTPU  PACKAGE = AI::MXNetTPU::FFI
+
+PROTOTYPES: DISABLE
+
+void
+seed(s)
+    int s
+  CODE:
+    if (MXFrontRandomSeed(s) != 0) croak_last("MXFrontRandomSeed");
+
+void
+waitall()
+  CODE:
+    if (MXFrontNDArrayWaitAll() != 0) croak_last("MXFrontNDArrayWaitAll");
+
+IV
+nd_create(shape_ref, dev_type, dev_id, dtype)
+    SV* shape_ref
+    int dev_type
+    int dev_id
+    int dtype
+  CODE:
+  {
+    AV* av = want_av(shape_ref, "nd_create shape");
+    uint32_t ndim = (uint32_t)(av_len(av) + 1);
+    uint32_t dims[64];
+    uint32_t i;
+    NDArrayHandle h;
+    if (ndim > 64) croak("nd_create: %u dims (max 64)", (unsigned)ndim);
+    for (i = 0; i < ndim; ++i) {
+      SV** el = av_fetch(av, i, 0);
+      dims[i] = el ? (uint32_t)SvUV(*el) : 0;
+    }
+    if (MXFrontNDArrayCreate(dims, ndim, dev_type, dev_id, dtype, &h) != 0) {
+      croak_last("MXFrontNDArrayCreate");
+    }
+    RETVAL = PTR2IV(h);
+  }
+  OUTPUT:
+    RETVAL
+
+void
+nd_free(h)
+    IV h
+  CODE:
+    MXFrontNDArrayFree(INT2PTR(NDArrayHandle, h));
+
+void
+nd_set(h, data_ref)
+    IV h
+    SV* data_ref
+  CODE:
+  {
+    AV* av = want_av(data_ref, "nd_set data");
+    uint64_t n = (uint64_t)(av_len(av) + 1);
+    float* buf = (float*)malloc(sizeof(float) * (n ? n : 1));
+    uint64_t i;
+    int rc;
+    if (buf == NULL) croak("nd_set: out of memory");
+    for (i = 0; i < n; ++i) {
+      SV** el = av_fetch(av, (I32)i, 0);
+      buf[i] = el ? (float)SvNV(*el) : 0.0f;
+    }
+    rc = MXFrontNDArraySyncCopyFromCPU(INT2PTR(NDArrayHandle, h), buf, n);
+    free(buf);
+    if (rc != 0) croak_last("MXFrontNDArraySyncCopyFromCPU");
+  }
+
+SV*
+nd_shape(h)
+    IV h
+  CODE:
+  {
+    uint32_t ndim, i;
+    const uint32_t* shape;
+    AV* av;
+    if (MXFrontNDArrayGetShape(INT2PTR(NDArrayHandle, h), &ndim,
+                               &shape) != 0) {
+      croak_last("MXFrontNDArrayGetShape");
+    }
+    av = newAV();
+    for (i = 0; i < ndim; ++i) av_push(av, newSVuv(shape[i]));
+    RETVAL = newRV_noinc((SV*)av);
+  }
+  OUTPUT:
+    RETVAL
+
+SV*
+nd_values(h)
+    IV h
+  CODE:
+  {
+    uint32_t ndim, i;
+    const uint32_t* shape;
+    uint64_t size = 1;
+    float* buf;
+    AV* av;
+    uint64_t j;
+    if (MXFrontNDArrayGetShape(INT2PTR(NDArrayHandle, h), &ndim,
+                               &shape) != 0) {
+      croak_last("MXFrontNDArrayGetShape");
+    }
+    for (i = 0; i < ndim; ++i) size *= shape[i];
+    buf = (float*)malloc(sizeof(float) * (size ? size : 1));
+    if (buf == NULL) croak("nd_values: out of memory");
+    if (MXFrontNDArraySyncCopyToCPU(INT2PTR(NDArrayHandle, h), buf,
+                                    size) != 0) {
+      free(buf);
+      croak_last("MXFrontNDArraySyncCopyToCPU");
+    }
+    av = newAV();
+    for (j = 0; j < size; ++j) av_push(av, newSVnv(buf[j]));
+    free(buf);
+    RETVAL = newRV_noinc((SV*)av);
+  }
+  OUTPUT:
+    RETVAL
+
+void
+nd_save(fname, handles_ref, names_ref)
+    const char* fname
+    SV* handles_ref
+    SV* names_ref
+  CODE:
+  {
+    AV* hav = want_av(handles_ref, "nd_save handles");
+    AV* nav = want_av(names_ref, "nd_save names");
+    uint32_t n = (uint32_t)(av_len(hav) + 1);
+    uint32_t nn;
+    NDArrayHandle* hs;
+    const char** names = av_strings(nav, &nn);
+    uint32_t i;
+    int rc;
+    if (nn != n) {
+      free((void*)names);
+      croak("nd_save: %u handles but %u names", (unsigned)n, (unsigned)nn);
+    }
+    hs = (NDArrayHandle*)malloc(sizeof(NDArrayHandle) * (n ? n : 1));
+    if (hs == NULL) { free((void*)names); croak("nd_save: out of memory"); }
+    for (i = 0; i < n; ++i) {
+      SV** el = av_fetch(hav, i, 0);
+      hs[i] = el ? INT2PTR(NDArrayHandle, SvIV(*el)) : NULL;
+    }
+    rc = MXFrontNDArraySave(fname, n, hs, names);
+    free(hs);
+    free((void*)names);
+    if (rc != 0) croak_last("MXFrontNDArraySave");
+  }
+
+SV*
+nd_load(fname)
+    const char* fname
+  CODE:
+  {
+    uint32_t n, i;
+    NDArrayHandle* hs;
+    const char** keys;
+    AV* names = newAV();
+    AV* handles = newAV();
+    AV* pair = newAV();
+    if (MXFrontNDArrayLoad(fname, &n, &hs, &keys) != 0) {
+      croak_last("MXFrontNDArrayLoad");
+    }
+    for (i = 0; i < n; ++i) {
+      av_push(names, keys ? newSVpv(keys[i], 0) : newSVpv("", 0));
+      av_push(handles, newSViv(PTR2IV(hs[i])));
+    }
+    av_push(pair, newRV_noinc((SV*)names));
+    av_push(pair, newRV_noinc((SV*)handles));
+    RETVAL = newRV_noinc((SV*)pair);
+  }
+  OUTPUT:
+    RETVAL
+
+IV
+sym_var(name)
+    const char* name
+  CODE:
+  {
+    SymbolHandle h;
+    if (MXFrontSymbolCreateVariable(name, &h) != 0) {
+      croak_last("MXFrontSymbolCreateVariable");
+    }
+    RETVAL = PTR2IV(h);
+  }
+  OUTPUT:
+    RETVAL
+
+IV
+sym_op(op_name, name, pk_ref, pv_ref, inputs_ref)
+    const char* op_name
+    const char* name
+    SV* pk_ref
+    SV* pv_ref
+    SV* inputs_ref
+  CODE:
+  {
+    AV* pkav = want_av(pk_ref, "sym_op param keys");
+    AV* pvav = want_av(pv_ref, "sym_op param vals");
+    AV* inav = want_av(inputs_ref, "sym_op inputs");
+    uint32_t npk, npv;
+    const char** pk = av_strings(pkav, &npk);
+    const char** pv = av_strings(pvav, &npv);
+    uint32_t nin = (uint32_t)(av_len(inav) + 1);
+    SymbolHandle ins[64];
+    SymbolHandle out;
+    uint32_t i;
+    int rc;
+    if (npk != npv) {
+      free((void*)pk); free((void*)pv);
+      croak("sym_op: %u keys but %u vals", (unsigned)npk, (unsigned)npv);
+    }
+    if (nin > 64) {
+      free((void*)pk); free((void*)pv);
+      croak("sym_op: %u inputs (max 64)", (unsigned)nin);
+    }
+    for (i = 0; i < nin; ++i) {
+      SV** el = av_fetch(inav, i, 0);
+      ins[i] = el ? INT2PTR(SymbolHandle, SvIV(*el)) : NULL;
+    }
+    rc = MXFrontSymbolCreateOp(op_name, name, (int)npk, pk, pv,
+                               (int)nin, NULL, ins, &out);
+    free((void*)pk);
+    free((void*)pv);
+    if (rc != 0) croak_last("MXFrontSymbolCreateOp");
+    RETVAL = PTR2IV(out);
+  }
+  OUTPUT:
+    RETVAL
+
+void
+sym_free(h)
+    IV h
+  CODE:
+    MXFrontSymbolFree(INT2PTR(SymbolHandle, h));
+
+SV*
+sym_list_arguments(h)
+    IV h
+  CODE:
+  {
+    int n, i;
+    const char** names;
+    AV* av = newAV();
+    if (MXFrontSymbolListArguments(INT2PTR(SymbolHandle, h), &n,
+                                   &names) != 0) {
+      croak_last("MXFrontSymbolListArguments");
+    }
+    for (i = 0; i < n; ++i) av_push(av, newSVpv(names[i], 0));
+    RETVAL = newRV_noinc((SV*)av);
+  }
+  OUTPUT:
+    RETVAL
+
+SV*
+sym_tojson(h)
+    IV h
+  CODE:
+  {
+    const char* json;
+    if (MXFrontSymbolSaveToJSON(INT2PTR(SymbolHandle, h), &json) != 0) {
+      croak_last("MXFrontSymbolSaveToJSON");
+    }
+    RETVAL = newSVpv(json, 0);
+  }
+  OUTPUT:
+    RETVAL
+
+IV
+sym_from_json(json)
+    const char* json
+  CODE:
+  {
+    SymbolHandle h;
+    if (MXFrontSymbolCreateFromJSON(json, &h) != 0) {
+      croak_last("MXFrontSymbolCreateFromJSON");
+    }
+    RETVAL = PTR2IV(h);
+  }
+  OUTPUT:
+    RETVAL
+
+IV
+exec_simple_bind(sym, dev_type, dev_id, keys_ref, shapes_ref, grad_req)
+    IV sym
+    int dev_type
+    int dev_id
+    SV* keys_ref
+    SV* shapes_ref
+    const char* grad_req
+  CODE:
+  {
+    AV* kav = want_av(keys_ref, "simple_bind keys");
+    AV* sav = want_av(shapes_ref, "simple_bind shapes");
+    uint32_t nk;
+    const char** keys = av_strings(kav, &nk);
+    uint32_t ns = (uint32_t)(av_len(sav) + 1);
+    uint32_t indptr[65];
+    uint32_t dims[256];
+    uint32_t pos = 0;
+    uint32_t i;
+    ExecutorHandle out;
+    int rc;
+    if (ns != nk || ns > 64) {
+      free((void*)keys);
+      croak("simple_bind: %u keys vs %u shapes (max 64)",
+            (unsigned)nk, (unsigned)ns);
+    }
+    indptr[0] = 0;
+    for (i = 0; i < ns; ++i) {
+      SV** el = av_fetch(sav, i, 0);
+      AV* shp = want_av(el ? *el : &PL_sv_undef, "simple_bind shape");
+      uint32_t nd = (uint32_t)(av_len(shp) + 1);
+      uint32_t d;
+      if (pos + nd > 256) {
+        free((void*)keys);
+        croak("simple_bind: too many total dims");
+      }
+      for (d = 0; d < nd; ++d) {
+        SV** dv = av_fetch(shp, d, 0);
+        dims[pos++] = dv ? (uint32_t)SvUV(*dv) : 0;
+      }
+      indptr[i + 1] = pos;
+    }
+    rc = MXFrontExecutorSimpleBind(INT2PTR(SymbolHandle, sym), dev_type,
+                                   dev_id, nk, keys, indptr, dims,
+                                   grad_req, &out);
+    free((void*)keys);
+    if (rc != 0) croak_last("MXFrontExecutorSimpleBind");
+    RETVAL = PTR2IV(out);
+  }
+  OUTPUT:
+    RETVAL
+
+void
+exec_forward(h, is_train)
+    IV h
+    int is_train
+  CODE:
+    if (MXFrontExecutorForward(INT2PTR(ExecutorHandle, h), is_train) != 0) {
+      croak_last("MXFrontExecutorForward");
+    }
+
+void
+exec_backward(h)
+    IV h
+  CODE:
+    if (MXFrontExecutorBackward(INT2PTR(ExecutorHandle, h), 0, NULL) != 0) {
+      croak_last("MXFrontExecutorBackward");
+    }
+
+SV*
+exec_outputs(h)
+    IV h
+  CODE:
+  {
+    int n, i;
+    NDArrayHandle* outs;
+    AV* av = newAV();
+    if (MXFrontExecutorOutputs(INT2PTR(ExecutorHandle, h), &n,
+                               &outs) != 0) {
+      croak_last("MXFrontExecutorOutputs");
+    }
+    for (i = 0; i < n; ++i) av_push(av, newSViv(PTR2IV(outs[i])));
+    RETVAL = newRV_noinc((SV*)av);
+  }
+  OUTPUT:
+    RETVAL
+
+IV
+exec_get_arg(h, name)
+    IV h
+    const char* name
+  CODE:
+  {
+    NDArrayHandle out;
+    if (MXFrontExecutorGetArg(INT2PTR(ExecutorHandle, h), name,
+                              &out) != 0) {
+      croak_last("MXFrontExecutorGetArg");
+    }
+    RETVAL = PTR2IV(out);
+  }
+  OUTPUT:
+    RETVAL
+
+IV
+exec_get_grad(h, name)
+    IV h
+    const char* name
+  CODE:
+  {
+    NDArrayHandle out;
+    if (MXFrontExecutorGetGrad(INT2PTR(ExecutorHandle, h), name,
+                               &out) != 0) {
+      croak_last("MXFrontExecutorGetGrad");
+    }
+    RETVAL = PTR2IV(out);  /* 0 (NULL) for unbound grads, by contract */
+  }
+  OUTPUT:
+    RETVAL
+
+void
+exec_free(h)
+    IV h
+  CODE:
+    MXFrontExecutorFree(INT2PTR(ExecutorHandle, h));
+
+IV
+opt_create(name, k_ref, v_ref)
+    const char* name
+    SV* k_ref
+    SV* v_ref
+  CODE:
+  {
+    AV* kav = want_av(k_ref, "opt_create keys");
+    AV* vav = want_av(v_ref, "opt_create vals");
+    uint32_t nk, nv;
+    const char** k = av_strings(kav, &nk);
+    const char** v = av_strings(vav, &nv);
+    OptimizerHandle out;
+    int rc;
+    if (nk != nv) {
+      free((void*)k); free((void*)v);
+      croak("opt_create: %u keys but %u vals", (unsigned)nk, (unsigned)nv);
+    }
+    rc = MXFrontOptimizerCreate(name, (int)nk, k, v, &out);
+    free((void*)k);
+    free((void*)v);
+    if (rc != 0) croak_last("MXFrontOptimizerCreate");
+    RETVAL = PTR2IV(out);
+  }
+  OUTPUT:
+    RETVAL
+
+void
+opt_update(opt, index, weight, grad)
+    IV opt
+    int index
+    IV weight
+    IV grad
+  CODE:
+    if (MXFrontOptimizerUpdate(INT2PTR(OptimizerHandle, opt), index,
+                               INT2PTR(NDArrayHandle, weight),
+                               INT2PTR(NDArrayHandle, grad)) != 0) {
+      croak_last("MXFrontOptimizerUpdate");
+    }
+
+void
+opt_free(h)
+    IV h
+  CODE:
+    MXFrontOptimizerFree(INT2PTR(OptimizerHandle, h));
+
+IV
+iter_ndarray(data, label, batch_size, shuffle, last_batch)
+    IV data
+    IV label
+    int batch_size
+    int shuffle
+    const char* last_batch
+  CODE:
+  {
+    DataIterHandle out;
+    if (MXFrontDataIterCreateNDArray(INT2PTR(NDArrayHandle, data),
+                                     INT2PTR(NDArrayHandle, label),
+                                     batch_size, shuffle, last_batch,
+                                     &out) != 0) {
+      croak_last("MXFrontDataIterCreateNDArray");
+    }
+    RETVAL = PTR2IV(out);
+  }
+  OUTPUT:
+    RETVAL
+
+int
+iter_next(h)
+    IV h
+  CODE:
+  {
+    int more;
+    if (MXFrontDataIterNext(INT2PTR(DataIterHandle, h), &more) != 0) {
+      croak_last("MXFrontDataIterNext");
+    }
+    RETVAL = more;
+  }
+  OUTPUT:
+    RETVAL
+
+void
+iter_before_first(h)
+    IV h
+  CODE:
+    if (MXFrontDataIterBeforeFirst(INT2PTR(DataIterHandle, h)) != 0) {
+      croak_last("MXFrontDataIterBeforeFirst");
+    }
+
+IV
+iter_data(h)
+    IV h
+  CODE:
+  {
+    NDArrayHandle out;
+    if (MXFrontDataIterGetData(INT2PTR(DataIterHandle, h), &out) != 0) {
+      croak_last("MXFrontDataIterGetData");
+    }
+    RETVAL = PTR2IV(out);
+  }
+  OUTPUT:
+    RETVAL
+
+IV
+iter_label(h)
+    IV h
+  CODE:
+  {
+    NDArrayHandle out;
+    if (MXFrontDataIterGetLabel(INT2PTR(DataIterHandle, h), &out) != 0) {
+      croak_last("MXFrontDataIterGetLabel");
+    }
+    RETVAL = PTR2IV(out);
+  }
+  OUTPUT:
+    RETVAL
+
+void
+iter_free(h)
+    IV h
+  CODE:
+    MXFrontDataIterFree(INT2PTR(DataIterHandle, h));

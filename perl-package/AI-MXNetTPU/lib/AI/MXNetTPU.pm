@@ -1,0 +1,287 @@
+package AI::MXNetTPU;
+
+# Perl TRAINING frontend for the TPU-native framework, riding the
+# frontend C ABI (include/mxnet_tpu/c_frontend_api.h) alone — no
+# Python.h, no framework internals.  Reference analog:
+# perl-package/AI-MXNet (the reference's full perl training API over
+# AI-MXNetCAPI/SWIG); here the same capability classes — NDArray,
+# Symbol (any registered op via AUTOLOAD), Executor
+# (simple_bind/forward/backward), Optimizer, NDArrayIter — are thin
+# perl objects over the mechanical XS layer in MXNetTPU.xs.
+#
+#   use AI::MXNetTPU;
+#   my $data = AI::MXNetTPU::Symbol->Variable("data");
+#   my $net  = AI::MXNetTPU::Symbol->FullyConnected(
+#                  data => $data, num_hidden => 32, name => "fc1");
+#   $net = AI::MXNetTPU::Symbol->SoftmaxOutput(data => $net,
+#                                              name => "softmax");
+#   my $ex  = $net->simple_bind(shapes => { data => [32, 16],
+#                                           softmax_label => [32] });
+#   my $opt = AI::MXNetTPU::Optimizer->new("sgd", learning_rate => 0.1);
+#   ... per batch: $ex->arg("data")->set(\@x); $ex->forward(1);
+#       $ex->backward; $opt->update($i, $ex->arg($_), $ex->grad($_));
+
+use strict;
+use warnings;
+
+our $VERSION = '0.02';
+
+require XSLoader;
+XSLoader::load('AI::MXNetTPU', $VERSION);
+
+sub seed { AI::MXNetTPU::FFI::seed($_[1] // $_[0]) }
+
+# --------------------------------------------------------------------------
+package AI::MXNetTPU::NDArray;
+
+use strict;
+use warnings;
+
+# dev_type codes as in the ABI: 1=cpu, 4=tpu.  dtype 0 = float32.
+sub new {
+    my ($class, $shape, %args) = @_;
+    my $h = AI::MXNetTPU::FFI::nd_create(
+        $shape, $args{dev_type} // 1, $args{dev_id} // 0,
+        $args{dtype} // 0);
+    return bless { handle => $h, owned => 1 }, $class;
+}
+
+# wrap a raw handle (executor-owned args/grads are NOT freed by us;
+# pass owned => 1 for handles the wrapper must release)
+sub _wrap {
+    my ($class, $h, $owned) = @_;
+    return undef unless $h;
+    return bless { handle => $h, owned => $owned ? 1 : 0 }, $class;
+}
+
+sub handle { $_[0]{handle} }
+
+sub set {
+    my ($self, $data) = @_;
+    AI::MXNetTPU::FFI::nd_set($self->{handle}, $data);
+    return $self;
+}
+
+sub values { AI::MXNetTPU::FFI::nd_values($_[0]{handle}) }
+sub shape  { AI::MXNetTPU::FFI::nd_shape($_[0]{handle}) }
+
+sub size {
+    my $s = $_[0]->shape;
+    my $n = 1;
+    $n *= $_ for @$s;
+    return $n;
+}
+
+# save/load in the dmlc-magic checkpoint format (interoperates with the
+# python frontend's mx.nd.save/load and Module checkpoints)
+sub save {
+    my ($class, $fname, $named) = @_;
+    my (@names, @handles);
+    for my $k (sort keys %$named) {
+        push @names, $k;
+        push @handles, $named->{$k}{handle};
+    }
+    AI::MXNetTPU::FFI::nd_save($fname, \@handles, \@names);
+}
+
+sub load {
+    my ($class, $fname) = @_;
+    my $pair = AI::MXNetTPU::FFI::nd_load($fname);
+    my ($names, $handles) = @$pair;
+    my %out;
+    for my $i (0 .. $#$names) {
+        $out{$names->[$i]} =
+            AI::MXNetTPU::NDArray->_wrap($handles->[$i], 1);
+    }
+    return \%out;
+}
+
+sub DESTROY {
+    my ($self) = @_;
+    AI::MXNetTPU::FFI::nd_free($self->{handle})
+        if $self->{handle} && $self->{owned};
+}
+
+# --------------------------------------------------------------------------
+package AI::MXNetTPU::Symbol;
+
+use strict;
+use warnings;
+use Carp qw(croak);
+
+our $AUTOLOAD;
+
+sub Variable {
+    my ($class, $name) = @_;
+    return bless { handle => AI::MXNetTPU::FFI::sym_var($name) },
+        'AI::MXNetTPU::Symbol';
+}
+
+# Any registered operator as a class method — the reference AI::MXNet
+# generates op methods from MXSymbolListAtomicSymbolCreators; here
+# AUTOLOAD defers entirely to the registry behind the ABI (unknown ops
+# croak with the registry's own error).  Symbol-valued kwargs become op
+# inputs, everything else is stringified into op params.
+sub AUTOLOAD {
+    my ($class, %kw) = @_;
+    my $op = $AUTOLOAD;
+    $op =~ s/.*:://;
+    return if $op eq 'DESTROY';
+    my $name = delete $kw{name} // '';
+    my (@ins, @pk, @pv);
+    for my $k (sort keys %kw) {
+        my $v = $kw{$k};
+        if (ref($v) && $v->isa('AI::MXNetTPU::Symbol')) {
+            push @ins, $v->{handle};
+        } elsif (ref($v) eq 'ARRAY') {
+            push @pk, $k;
+            push @pv, '(' . join(',', @$v) . ')';
+        } else {
+            push @pk, $k;
+            push @pv, "$v";
+        }
+    }
+    croak "$op: no symbol inputs given" unless @ins;
+    my $h = AI::MXNetTPU::FFI::sym_op($op, $name, \@pk, \@pv, \@ins);
+    return bless { handle => $h }, 'AI::MXNetTPU::Symbol';
+}
+
+sub handle { $_[0]{handle} }
+
+sub list_arguments {
+    AI::MXNetTPU::FFI::sym_list_arguments($_[0]{handle});
+}
+
+sub tojson { AI::MXNetTPU::FFI::sym_tojson($_[0]{handle}) }
+
+sub from_json {
+    my ($class, $json) = @_;
+    return bless { handle => AI::MXNetTPU::FFI::sym_from_json($json) },
+        'AI::MXNetTPU::Symbol';
+}
+
+sub simple_bind {
+    my ($self, %args) = @_;
+    my $shapes = $args{shapes} or croak "simple_bind: shapes required";
+    my (@keys, @shp);
+    for my $k (sort keys %$shapes) {
+        push @keys, $k;
+        push @shp, $shapes->{$k};
+    }
+    my $h = AI::MXNetTPU::FFI::exec_simple_bind(
+        $self->{handle}, $args{dev_type} // 1, $args{dev_id} // 0,
+        \@keys, \@shp, $args{grad_req} // 'write');
+    return AI::MXNetTPU::Executor->_wrap($h);
+}
+
+sub DESTROY {
+    my ($self) = @_;
+    AI::MXNetTPU::FFI::sym_free($self->{handle}) if $self->{handle};
+}
+
+# --------------------------------------------------------------------------
+package AI::MXNetTPU::Executor;
+
+use strict;
+use warnings;
+
+sub _wrap { bless { handle => $_[1] }, $_[0] }
+
+sub forward {
+    my ($self, $is_train) = @_;
+    AI::MXNetTPU::FFI::exec_forward($self->{handle}, $is_train ? 1 : 0);
+    return $self;
+}
+
+sub backward {
+    AI::MXNetTPU::FFI::exec_backward($_[0]{handle});
+    return $_[0];
+}
+
+sub outputs {
+    my $hs = AI::MXNetTPU::FFI::exec_outputs($_[0]{handle});
+    return [map { AI::MXNetTPU::NDArray->_wrap($_, 1) } @$hs];
+}
+
+# executor-owned views: not freed by the wrapper (owned => 0)
+sub arg {
+    my ($self, $name) = @_;
+    return AI::MXNetTPU::NDArray->_wrap(
+        AI::MXNetTPU::FFI::exec_get_arg($self->{handle}, $name), 0);
+}
+
+sub grad {
+    my ($self, $name) = @_;
+    return AI::MXNetTPU::NDArray->_wrap(
+        AI::MXNetTPU::FFI::exec_get_grad($self->{handle}, $name), 0);
+}
+
+sub DESTROY {
+    my ($self) = @_;
+    AI::MXNetTPU::FFI::exec_free($self->{handle}) if $self->{handle};
+}
+
+# --------------------------------------------------------------------------
+package AI::MXNetTPU::Optimizer;
+
+use strict;
+use warnings;
+
+sub new {
+    my ($class, $name, %params) = @_;
+    my (@k, @v);
+    for my $key (sort keys %params) {
+        push @k, $key;
+        push @v, "$params{$key}";
+    }
+    return bless {
+        handle => AI::MXNetTPU::FFI::opt_create($name, \@k, \@v),
+    }, $class;
+}
+
+sub update {
+    my ($self, $index, $weight, $grad) = @_;
+    AI::MXNetTPU::FFI::opt_update($self->{handle}, $index,
+                                  $weight->handle, $grad->handle);
+    return $self;
+}
+
+sub DESTROY {
+    my ($self) = @_;
+    AI::MXNetTPU::FFI::opt_free($self->{handle}) if $self->{handle};
+}
+
+# --------------------------------------------------------------------------
+package AI::MXNetTPU::NDArrayIter;
+
+use strict;
+use warnings;
+
+sub new {
+    my ($class, %args) = @_;
+    my $h = AI::MXNetTPU::FFI::iter_ndarray(
+        $args{data}{handle}, $args{label}{handle},
+        $args{batch_size} // 1, $args{shuffle} ? 1 : 0,
+        $args{last_batch_handle} // 'pad');
+    return bless { handle => $h }, $class;
+}
+
+sub next  { AI::MXNetTPU::FFI::iter_next($_[0]{handle}) }
+sub reset { AI::MXNetTPU::FFI::iter_before_first($_[0]{handle}) }
+
+sub data {
+    AI::MXNetTPU::NDArray->_wrap(
+        AI::MXNetTPU::FFI::iter_data($_[0]{handle}), 1);
+}
+
+sub label {
+    AI::MXNetTPU::NDArray->_wrap(
+        AI::MXNetTPU::FFI::iter_label($_[0]{handle}), 1);
+}
+
+sub DESTROY {
+    my ($self) = @_;
+    AI::MXNetTPU::FFI::iter_free($self->{handle}) if $self->{handle};
+}
+
+1;

@@ -1,0 +1,92 @@
+#!/usr/bin/perl
+# Train an MNIST-shaped MLP entirely from perl over the frontend C ABI —
+# the second-language TRAINING proof (reference analog: any AI::MXNet
+# training script, e.g. perl-package/AI-MXNet/examples/mnist.pl).
+#
+#   perl train_mlp.pl <init.nd> <data.nd> <out.nd> <epochs> <lr> <batch>
+#
+# <init.nd>: dmlc-format params (fc1_weight, fc1_bias, fc2_weight,
+# fc2_bias) written by any frontend (here: the python test driver, so
+# both frontends start from identical weights).  <data.nd>: arrays
+# "data" (N, 784) and "label" (N,).  Per epoch prints
+# "epoch <i> loss <mean-cross-entropy>"; final params go to <out.nd>.
+
+use strict;
+use warnings;
+use FindBin;
+use lib "$FindBin::Bin/../AI-MXNetTPU/blib/lib";
+use lib "$FindBin::Bin/../AI-MXNetTPU/blib/arch";
+use AI::MXNetTPU;
+
+my ($init_file, $data_file, $out_file, $epochs, $lr, $batch) = @ARGV;
+die "usage: $0 init.nd data.nd out.nd epochs lr batch\n"
+    unless defined $batch;
+
+# ---- symbol: 784 -> 128 relu -> 10 softmax -------------------------------
+my $data = AI::MXNetTPU::Symbol->Variable("data");
+my $fc1  = AI::MXNetTPU::Symbol->FullyConnected(
+    data => $data, num_hidden => 128, name => "fc1");
+my $act  = AI::MXNetTPU::Symbol->Activation(
+    data => $fc1, act_type => "relu", name => "relu1");
+my $fc2  = AI::MXNetTPU::Symbol->FullyConnected(
+    data => $act, num_hidden => 10, name => "fc2");
+my $net  = AI::MXNetTPU::Symbol->SoftmaxOutput(
+    data => $fc2, name => "softmax");
+
+# ---- bind ----------------------------------------------------------------
+my $arrays = AI::MXNetTPU::NDArray->load($data_file);
+my $xs = $arrays->{data}  or die "no 'data' array in $data_file";
+my $ys = $arrays->{label} or die "no 'label' array in $data_file";
+my ($n, $d) = @{$xs->shape};
+
+my $ex = $net->simple_bind(
+    shapes => { data => [$batch, $d], softmax_label => [$batch] });
+
+# ---- init from the shared checkpoint (identical to the python side) ------
+my $init = AI::MXNetTPU::NDArray->load($init_file);
+my @param_names = grep { $_ ne 'data' && $_ ne 'softmax_label' }
+    @{$net->list_arguments};
+for my $p (@param_names) {
+    die "missing init param $p" unless $init->{$p};
+    $ex->arg($p)->set($init->{$p}->values);
+}
+
+my $opt = AI::MXNetTPU::Optimizer->new(
+    "sgd", learning_rate => $lr, rescale_grad => 1.0 / $batch);
+
+# ---- training loop -------------------------------------------------------
+my $xvals = $xs->values;    # flat (N*D) floats
+my $yvals = $ys->values;
+my $a_data  = $ex->arg("data");
+my $a_label = $ex->arg("softmax_label");
+
+for my $epoch (0 .. $epochs - 1) {
+    my ($loss_sum, $loss_n) = (0.0, 0);
+    for (my $off = 0; $off + $batch <= $n; $off += $batch) {
+        my @bx = @$xvals[$off * $d .. ($off + $batch) * $d - 1];
+        my @by = @$yvals[$off .. $off + $batch - 1];
+        $a_data->set(\@bx);
+        $a_label->set(\@by);
+        $ex->forward(1);
+        # cross-entropy from the softmax output, before the update
+        my $probs = $ex->outputs->[0]->values;
+        my $k = scalar(@$probs) / $batch;
+        for my $b (0 .. $batch - 1) {
+            my $p = $probs->[$b * $k + $by[$b]];
+            $p = 1e-12 if $p < 1e-12;
+            $loss_sum -= log($p);
+            ++$loss_n;
+        }
+        $ex->backward;
+        my $i = 0;
+        for my $p (@param_names) {
+            $opt->update($i++, $ex->arg($p), $ex->grad($p));
+        }
+    }
+    printf "epoch %d loss %.6f\n", $epoch, $loss_sum / $loss_n;
+}
+
+# ---- save final params (readable by the python frontend) -----------------
+my %final = map { $_ => $ex->arg($_) } @param_names;
+AI::MXNetTPU::NDArray->save($out_file, \%final);
+print "TRAIN DONE\n";

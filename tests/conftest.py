@@ -31,19 +31,8 @@ def pytest_sessionfinish(session, exitstatus):
     MXNET_OP_COVERAGE_OUT=path pytest tests/ ... writes {op: count}.
     tools/gen_op_census.py consumes the dump so the census coverage
     column counts executions, not word-grep mentions."""
-    out = os.environ.get("MXNET_OP_COVERAGE_OUT")
-    if not out:
-        return
-    import json
-
     try:
-        from mxnet_tpu.ops import registry
+        from mxnet_tpu.test_utils import dump_op_coverage
     except Exception:
         return
-    payload = {
-        "note": "OpDef.apply call counts from one pytest session",
-        "argv": sys.argv[1:],
-        "counts": dict(sorted(registry.INVOCATIONS.items())),
-    }
-    with open(out, "w") as f:
-        json.dump(payload, f, indent=1)
+    dump_op_coverage("OpDef.apply call counts from one pytest session")

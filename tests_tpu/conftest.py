@@ -7,6 +7,18 @@ the virtual CPU mesh. The axon sitecustomize exposes the tunneled chip.
 import pytest
 
 
+def pytest_sessionfinish(session, exitstatus):
+    """Same execution-count dump as tests/conftest.py, so the census TPU
+    column can be execution-backed: MXNET_OP_COVERAGE_OUT=path pytest
+    tests_tpu/ writes {op: OpDef.apply call count} for the hardware run.
+    An all-skip session (no TPU) writes nothing."""
+    try:
+        from mxnet_tpu.test_utils import dump_op_coverage
+    except Exception:
+        return
+    dump_op_coverage("OpDef.apply call counts from one tests_tpu session")
+
+
 def pytest_collection_modifyitems(config, items):
     import jax
 
