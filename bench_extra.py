@@ -203,7 +203,10 @@ def lstm_score(batch=32, seq=35, hidden=200, layers=2, vocab=10000):
     score(build(True), "train_ptb_fusedlstm_b%d_seq%d" % (batch, seq))
 
 
-def ssd_score(batch=8, size=300):
+def ssd_setup(batch=8, size=300):
+    """SSD-VGG16 train-step module in bench.setup()'s (mod, run, sync)
+    shape, so tools/perf/step_profile.py --model ssd profiles EXACTLY
+    the step ssd_score records."""
     ctx = _ctx()
     from mxnet_tpu.models import ssd_vgg16
 
@@ -213,6 +216,13 @@ def ssd_score(batch=8, size=300):
     mod.bind(data_shapes=[("data", (batch, 3, size, size))],
              label_shapes=[("label", (batch, 3, 5))])
     mod.init_params(mx.init.Xavier())
+    # bf16 params/activations like the ResNet headline bench (labels and
+    # BN stats stay f32 inside the ops); the target/matching math in
+    # MultiBoxTarget runs on the f32 label input either way
+    if DTYPE != "float32":
+        for n, a in mod._exec.arg_dict.items():
+            if n != "label":
+                a._jx = a._jx.astype(DTYPE)
     mod.init_optimizer(optimizer="sgd",
                        optimizer_params={"learning_rate": 0.001,
                                          "momentum": 0.9})
@@ -221,14 +231,26 @@ def ssd_score(batch=8, size=300):
     lab[:, 0] = [0, 0.2, 0.2, 0.6, 0.6]
     b = mx.io.DataBatch(
         data=[mx.nd.array(rs.rand(batch, 3, size, size)
-                          .astype(np.float32), ctx=ctx)],
+                          .astype(np.float32), ctx=ctx, dtype=DTYPE)],
         label=[mx.nd.array(lab, ctx=ctx)])
     os.environ.setdefault("MXNET_FUSE_TRAIN_STEP", "1")
-    mod.run_bulk([b] * STEPS)  # warmup (and the cost-analysis signature)
-    _sync_param(mod)
+
+    def run(nsteps):
+        mod.run_bulk([b] * nsteps)
+
+    def sync():
+        return _sync_param(mod)
+
+    return mod, run, sync
+
+
+def ssd_score(batch=8, size=300):
+    mod, run, sync = ssd_setup(batch, size)
+    run(STEPS)  # warmup (and the cost-analysis signature)
+    sync()
     t0 = time.time()
-    mod.run_bulk([b] * STEPS)
-    _sync_param(mod)
+    run(STEPS)
+    sync()
     sec = (time.time() - t0) / STEPS
     # no reference-published SSD step time exists; measured FLOPs + MFU
     # anchor the row, and tests/test_ssd.py::
@@ -349,6 +371,34 @@ def io_score(num_images=4096, batch=128):
     for threads in counts:
         row("io_imagerecord_jpeg224_t%d" % threads,
             seen[threads] / best[threads], "images/sec")
+
+    # multi-PROCESS decode rows (MultiProcessIter): the scaling path for
+    # hosts where the in-process pool clamps to the affinity mask.  On
+    # this 1-core bench host p2 is a graceful-contention check; on an
+    # M-core host the same rows are the scaling check.  p-counts
+    # interleaved like the t-rows (p1,p2,p1,p2) so load drift hits both
+    # equally, best-of-2.
+    p_iters = {1: iters[1],
+               2: mxio.ImageRecordIter(
+                   path_imgrec=rec_path, data_shape=(3, 224, 224),
+                   batch_size=batch, rand_crop=True, rand_mirror=True,
+                   decode_procs=2)}
+    best_p = {p: float("inf") for p in p_iters}
+    seen_p = {p: 0 for p in p_iters}
+    for _ in range(2):
+        for procs, it in p_iters.items():
+            it.reset()
+            tic = time.time()
+            n = 0
+            for b in it:
+                b.data[0].wait_to_read()
+                n += batch - b.pad
+            best_p[procs] = min(best_p[procs], time.time() - tic)
+            seen_p[procs] = n
+    for procs in p_iters:
+        row("io_imagerecord_jpeg224_p%d" % procs,
+            seen_p[procs] / best_p[procs], "images/sec")
+    p_iters[2].close()
 
     import shutil
 

@@ -388,13 +388,22 @@ class ImageIter(DataIter):
                      "pca_noise", "inter_method")})
             if aug_list is None else aug_list)
         # native batch decode (src/imgdecode.cc): eligible when the fast
-        # path is active (uint8 staging) and the aug chain is purely
-        # geometric; the library loads lazily on first next()
+        # path is active (uint8 staging via post_batch, or fused f32
+        # output via native_norm — the multi-process workers use the
+        # latter alone, their post step IS the norm) and the aug chain
+        # is purely geometric; the library loads lazily on first next()
         self._native_plan = _native_aug_plan(self.aug_list, data_shape) \
-            if post_batch is not None else None
+            if (post_batch is not None or native_norm is not None) \
+            else None
         # (mean, std, scale) for the native fused f32-NCHW output; only
         # meaningful for host batches (device conversion ships uint8)
         self._native_norm = native_norm
+        # optional caller-provided output buffers for the NEXT batch:
+        # (f32 NCHW data_buf, f32 label_buf).  The native f32 path
+        # decodes straight into them (the multi-process decode workers
+        # point this at a shared-memory slot, making the IPC handoff
+        # zero-copy); consumed once, then reset to None.
+        self.batch_out = None
         self._preprocess_threads = max(1, int(preprocess_threads))
         assert last_batch_handle in ("pad", "discard", "roll_over"), \
             last_batch_handle
@@ -546,7 +555,8 @@ class ImageIter(DataIter):
 
         i = 0
         native_lib = None
-        if self._native_plan is not None and post is not None:
+        if self._native_plan is not None and \
+                (post is not None or self._native_norm is not None):
             from .native import get_imgdecode_lib
 
             native_lib = get_imgdecode_lib()
@@ -570,7 +580,13 @@ class ImageIter(DataIter):
                    for _ in range(n)]
             f32_mode = self._native_norm is not None
             if f32_mode:
-                nchw = np.empty((self.batch_size, c, h, w), np.float32)
+                if self.batch_out is not None:
+                    nchw, label_buf = self.batch_out
+                    self.batch_out = None
+                    label = label_buf.reshape(label.shape)
+                else:
+                    nchw = np.empty((self.batch_size, c, h, w),
+                                    np.float32)
                 out_arr, norm = nchw, self._native_norm
             else:
                 out_arr, norm = hwc, None

@@ -232,31 +232,40 @@ sym_var(name)
     RETVAL
 
 IV
-sym_op(op_name, name, pk_ref, pv_ref, inputs_ref)
+sym_op(op_name, name, pk_ref, pv_ref, ik_ref, inputs_ref)
     const char* op_name
     const char* name
     SV* pk_ref
     SV* pv_ref
+    SV* ik_ref
     SV* inputs_ref
   CODE:
   {
     AV* pkav = want_av(pk_ref, "sym_op param keys");
     AV* pvav = want_av(pv_ref, "sym_op param vals");
     AV* inav = want_av(inputs_ref, "sym_op inputs");
-    uint32_t npk, npv;
+    uint32_t npk, npv, nik = 0;
     const char** pk = av_strings(pkav, &npk);
     const char** pv = av_strings(pvav, &npv);
+    const char** ik = NULL;
     uint32_t nin = (uint32_t)(av_len(inav) + 1);
     SymbolHandle ins[64];
     SymbolHandle out;
     uint32_t i;
     int rc;
-    if (npk != npv) {
-      free((void*)pk); free((void*)pv);
-      croak("sym_op: %u keys but %u vals", (unsigned)npk, (unsigned)npv);
+    /* empty ik arrayref -> positional inputs (NULL input_keys);
+     * otherwise inputs are bound BY NAME, one key per input */
+    if (SvOK(ik_ref)) {
+      AV* ikav = want_av(ik_ref, "sym_op input keys");
+      if (av_len(ikav) + 1 > 0) ik = av_strings(ikav, &nik);
+    }
+    if (npk != npv || (ik != NULL && nik != nin)) {
+      free((void*)pk); free((void*)pv); free((void*)ik);
+      croak("sym_op: %u/%u param keys/vals, %u input keys for %u inputs",
+            (unsigned)npk, (unsigned)npv, (unsigned)nik, (unsigned)nin);
     }
     if (nin > 64) {
-      free((void*)pk); free((void*)pv);
+      free((void*)pk); free((void*)pv); free((void*)ik);
       croak("sym_op: %u inputs (max 64)", (unsigned)nin);
     }
     for (i = 0; i < nin; ++i) {
@@ -264,9 +273,10 @@ sym_op(op_name, name, pk_ref, pv_ref, inputs_ref)
       ins[i] = el ? INT2PTR(SymbolHandle, SvIV(*el)) : NULL;
     }
     rc = MXFrontSymbolCreateOp(op_name, name, (int)npk, pk, pv,
-                               (int)nin, NULL, ins, &out);
+                               (int)nin, ik, ins, &out);
     free((void*)pk);
     free((void*)pv);
+    free((void*)ik);
     if (rc != 0) croak_last("MXFrontSymbolCreateOp");
     RETVAL = PTR2IV(out);
   }

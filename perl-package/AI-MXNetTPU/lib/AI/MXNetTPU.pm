@@ -121,17 +121,20 @@ sub Variable {
 # generates op methods from MXSymbolListAtomicSymbolCreators; here
 # AUTOLOAD defers entirely to the registry behind the ABI (unknown ops
 # croak with the registry's own error).  Symbol-valued kwargs become op
-# inputs, everything else is stringified into op params.
+# inputs bound BY NAME (kwarg order is a hash, so positional binding
+# would silently miswire multi-input ops); everything else is
+# stringified into op params.
 sub AUTOLOAD {
     my ($class, %kw) = @_;
     my $op = $AUTOLOAD;
     $op =~ s/.*:://;
     return if $op eq 'DESTROY';
     my $name = delete $kw{name} // '';
-    my (@ins, @pk, @pv);
+    my (@ik, @ins, @pk, @pv);
     for my $k (sort keys %kw) {
         my $v = $kw{$k};
         if (ref($v) && $v->isa('AI::MXNetTPU::Symbol')) {
+            push @ik, $k;
             push @ins, $v->{handle};
         } elsif (ref($v) eq 'ARRAY') {
             push @pk, $k;
@@ -142,7 +145,8 @@ sub AUTOLOAD {
         }
     }
     croak "$op: no symbol inputs given" unless @ins;
-    my $h = AI::MXNetTPU::FFI::sym_op($op, $name, \@pk, \@pv, \@ins);
+    my $h = AI::MXNetTPU::FFI::sym_op($op, $name, \@pk, \@pv,
+                                      \@ik, \@ins);
     return bless { handle => $h }, 'AI::MXNetTPU::Symbol';
 }
 
@@ -203,17 +207,20 @@ sub outputs {
     return [map { AI::MXNetTPU::NDArray->_wrap($_, 1) } @$hs];
 }
 
-# executor-owned views: not freed by the wrapper (owned => 0)
+# each GetArg/GetGrad call returns a NEW handle the caller must free
+# (ABI convention: every NDArrayHandle is released with the matching
+# *Free) — the wrapper owns it; the executor keeps the array alive
+# independently
 sub arg {
     my ($self, $name) = @_;
     return AI::MXNetTPU::NDArray->_wrap(
-        AI::MXNetTPU::FFI::exec_get_arg($self->{handle}, $name), 0);
+        AI::MXNetTPU::FFI::exec_get_arg($self->{handle}, $name), 1);
 }
 
 sub grad {
     my ($self, $name) = @_;
     return AI::MXNetTPU::NDArray->_wrap(
-        AI::MXNetTPU::FFI::exec_get_grad($self->{handle}, $name), 0);
+        AI::MXNetTPU::FFI::exec_get_grad($self->{handle}, $name), 1);
 }
 
 sub DESTROY {

@@ -253,3 +253,65 @@ def test_image_record_iter_color_augs_still_work(tmp_path):
                             preprocess_threads=1, brightness=0.1)
     batch = it.next()
     assert batch.data[0].shape == (4, 3, 16, 16)
+
+
+def test_multiprocess_decode_shard_coverage(tmp_path):
+    """decode_procs=N (MultiProcessIter): N worker PROCESSES each own a
+    part_index/num_parts shard; per-epoch sample coverage must equal the
+    single-process iterator exactly (order may differ), two epochs in a
+    row (exercises the end-drain + re-command protocol), and a second
+    epoch must not duplicate or drop samples."""
+    import numpy as np
+
+    from mxnet_tpu import recordio
+
+    rec_path = str(tmp_path / "mp.rec")
+    rec = recordio.MXRecordIO(rec_path, "w")
+    rs = np.random.RandomState(3)
+    n = 24
+    for i in range(n):
+        img = (rs.rand(16, 16, 3) * 255).astype(np.uint8)
+        rec.write(recordio.pack_img(recordio.IRHeader(0, float(i), i, 0),
+                                    img))
+    rec.close()
+
+    def labels_of(it):
+        out = []
+        for b in it:
+            lab = b.label[0].asnumpy()
+            out.extend(lab[:len(lab) - b.pad].astype(int).tolist())
+        return out
+
+    single = io.ImageRecordIter(path_imgrec=rec_path,
+                                data_shape=(3, 16, 16), batch_size=4,
+                                round_batch=True)
+    want = sorted(labels_of(single))
+    assert want == list(range(n))
+
+    it = io.ImageRecordIter(path_imgrec=rec_path, data_shape=(3, 16, 16),
+                            batch_size=4, round_batch=True,
+                            decode_procs=2)
+    try:
+        assert isinstance(it, io.MultiProcessIter)
+        got1 = labels_of(it)
+        assert sorted(got1) == want, sorted(got1)
+        it.reset()
+        got2 = labels_of(it)
+        assert sorted(got2) == want, sorted(got2)
+        batch = next(iter(io.ImageRecordIter(
+            path_imgrec=rec_path, data_shape=(3, 16, 16), batch_size=4,
+            round_batch=True, decode_procs=2)))
+        assert batch.data[0].shape == (4, 3, 16, 16)
+    finally:
+        it.close()
+
+
+def test_multiprocess_decode_rejects_bad_combos(tmp_path):
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError):
+        io.ImageRecordIter(path_imgrec="x.rec", data_shape=(3, 8, 8),
+                           batch_size=2, decode_procs=2, num_parts=2)
+    with _pytest.raises(ValueError):
+        io.ImageRecordIter(path_imgrec="x.rec", data_shape=(3, 8, 8),
+                           batch_size=2, decode_procs=2, brightness=0.2)

@@ -536,3 +536,35 @@ def test_imperative_jit_cache_keys_on_device():
         assert_almost_equal(out_cpu, out_tpu, rtol=2e-3, atol=2e-3)
     finally:
         os.environ.pop("MXNET_BN_PALLAS", None)
+
+
+def test_census_tail_ops_execute_tpu():
+    """The 6 hardware-runnable ops the TPU invocation census caught
+    with zero executions (Cast, softmax, where, _arange, _zeros,
+    _ones) — each runs imperatively ON THE CHIP with a value check, so
+    the census TPU column is execution-backed for every row."""
+    rs = np.random.RandomState(9)
+    a = rs.rand(4, 6).astype(np.float32)
+    ta = mx.nd.array(a, ctx=mx.tpu())
+
+    c = mx.nd.Cast(ta, dtype="float16").asnumpy()
+    assert c.dtype == np.float16 and np.allclose(c, a, atol=1e-2)
+
+    s = mx.nd.softmax(ta, axis=-1).asnumpy()
+    want = np.exp(a) / np.exp(a).sum(-1, keepdims=True)
+    assert np.allclose(s, want, rtol=1e-4, atol=1e-5)
+
+    cond = mx.nd.array((a > 0.5).astype(np.float32), ctx=mx.tpu())
+    tb = mx.nd.array(-a, ctx=mx.tpu())
+    w = mx.nd.where(cond, ta, tb).asnumpy()
+    assert np.allclose(w, np.where(a > 0.5, a, -a))
+
+    z = mx.nd._zeros(shape=(3, 2), ctx=mx.tpu())
+    o = mx.nd._ones(shape=(3, 2), ctx=mx.tpu())
+    r = mx.nd._arange(start=2.0, stop=11.0, step=3.0, ctx=mx.tpu())
+    assert (z.asnumpy() == 0).all() and (o.asnumpy() == 1).all()
+    assert (r.asnumpy() == np.arange(2.0, 11.0, 3.0,
+                                     dtype=np.float32)).all()
+    for nd_arr in (z, o, r):
+        assert "tpu" in str(nd_arr.context).lower() \
+            or nd_arr.context.device_typeid != 1, nd_arr.context

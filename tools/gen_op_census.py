@@ -116,15 +116,16 @@ def _sweep_table_ops():
     return ops
 
 
-def _load_invocations():
+def _load_invocations(fname="op_coverage.json"):
     """Real execution counts from a full-suite run
-    (MXNET_OP_COVERAGE_OUT=docs/op_coverage.json pytest tests/ -q):
-    {op_name: OpDef.apply call count}.  Empty dict when the dump is
-    absent — the census then marks the column unavailable rather than
-    falling back to grep counts."""
+    (MXNET_OP_COVERAGE_OUT=docs/op_coverage.json pytest tests/ -q for
+    the CPU column; docs/op_coverage_tpu.json + pytest tests_tpu/ on
+    hardware for the TPU column): {op_name: OpDef.apply call count}.
+    Empty dict when the dump is absent — the census then marks the
+    column unavailable rather than falling back to grep counts."""
     import json
 
-    path = os.path.join(ROOT, "docs", "op_coverage.json")
+    path = os.path.join(ROOT, "docs", fname)
     if not os.path.exists(path):
         return {}
     try:
@@ -142,6 +143,7 @@ def main():
     all_names = set(registry.list_ops())
     sweep_ops = _sweep_table_ops()
     invocations = _load_invocations()
+    tpu_invocations = _load_invocations("op_coverage_tpu.json")
 
     def resolve(ref_name):
         """-> (status, repo_name): present / alias / renamed / absent."""
@@ -187,7 +189,8 @@ def main():
                     + [t for t in tpu
                        if "test_operator_tpu_sweep" not in t]
             inv = sum(invocations.get(n, 0) for n in group_names)
-            rows.append((group, ref, status, repo, inv,
+            tinv = sum(tpu_invocations.get(n, 0) for n in group_names)
+            rows.append((group, ref, status, repo, inv, tinv,
                          len(cpu), cpu[0] if cpu else "",
                          len(tpu), tpu[0] if tpu else ""))
 
@@ -216,6 +219,10 @@ def main():
                 "tests/ -q`, summed over the op's alias group; "
                 "subprocess-driven tests — C ABI clients, dist workers "
                 "— execute ops their parent process cannot count). "
+                "**tpu invocations** is the SAME execution counter "
+                "recorded by the hardware parity suite "
+                "(`MXNET_OP_COVERAGE_OUT=docs/op_coverage_tpu.json "
+                "pytest tests_tpu/` on a real chip). "
                 "The *mentions* columns word-grep `tests/` (CPU) and "
                 "`tests_tpu/` (hardware parity); file shown is the "
                 "first hit. tests_tpu parity tests bind BOTH backends "
@@ -224,8 +231,8 @@ def main():
                 "renamed, %d moved to python API, %d absent.\n\n"
                 % (counts["yes"], counts["alias"], counts["renamed"],
                    counts["moved"], counts["no"]))
+        runnable = sum(1 for r in rows if r[2] not in ("moved", "no"))
         if invocations:
-            runnable = sum(1 for r in rows if r[2] not in ("moved", "no"))
             f.write("Invocation coverage: **%d / %d runnable reference "
                     "ops executed at least once** by the recorded suite "
                     "run.\n\n"
@@ -235,11 +242,23 @@ def main():
         else:
             f.write("Invocation column unavailable: docs/op_coverage.json"
                     " not found (regenerate via the command above).\n\n")
+        if tpu_invocations:
+            tpu_runnable = sum(
+                1 for r in rows if r[2] not in ("moved", "no")
+                and r[1] not in CPU_ONLY)
+            f.write("TPU invocation coverage: **%d / %d "
+                    "hardware-runnable reference ops executed** by the "
+                    "recorded tests_tpu hardware run (%d host-side-by-"
+                    "design ops excluded).\n\n"
+                    % (sum(1 for r in rows
+                           if r[2] not in ("moved", "no")
+                           and r[1] not in CPU_ONLY and r[5] > 0),
+                       tpu_runnable, len(CPU_ONLY)))
         f.write("| group | reference op | status | repo op | invocations "
-                "| CPU mentions | first CPU test | TPU mentions "
-                "| first TPU test |\n")
-        f.write("|---|---|---|---|---|---|---|---|---|\n")
-        for (group, ref, status, repo, inv, nc, c0, nt, t0) in rows:
+                "| tpu invocations | CPU mentions | first CPU test "
+                "| TPU mentions | first TPU test |\n")
+        f.write("|---|---|---|---|---|---|---|---|---|---|\n")
+        for (group, ref, status, repo, inv, tinv, nc, c0, nt, t0) in rows:
             cell = "=" if repo == ref.rstrip("†") else (
                 ("`%s`" % repo) if repo else "")
             tcell = t0
@@ -247,9 +266,13 @@ def main():
                 tcell = "host-side op (by design)"
             elif not nt and status == "moved":
                 tcell = "python API (host-side)"
-            f.write("| %s | `%s` | %s | %s | %s | %d | %s | %d | %s |\n"
+            ticell = "host-side" if ref in CPU_ONLY else (
+                str(tinv) if tpu_invocations else "-")
+            f.write("| %s | `%s` | %s | %s | %s | %s | %d | %s | %d "
+                    "| %s |\n"
                     % (group, ref, status, cell,
-                       inv if invocations else "-", nc, c0, nt, tcell))
+                       inv if invocations else "-", ticell, nc, c0, nt,
+                       tcell))
         f.write("\n## Ops beyond the reference census (%d)\n\n"
                 % len(extra))
         f.write("New-capability ops (attention/ring/MoE, bf16 casts, "

@@ -42,12 +42,21 @@ _REPO = os.path.dirname(os.path.dirname(os.path.dirname(
 sys.path.insert(0, _REPO)
 
 
-def capture(steps, tracedir):
+def capture(steps, tracedir, model="resnet"):
     import bench
 
-    mod, run, sync = bench.setup()
+    if model == "resnet":
+        mod, run, sync = bench.setup()
+        warm = 2 * bench.BULK
+    elif model == "ssd":
+        import bench_extra
+
+        mod, run, sync = bench_extra.ssd_setup()
+        warm = steps
+    else:
+        raise SystemExit("unknown --model %r" % model)
     # compile + warm every jit path before the trace window opens
-    run(2 * bench.BULK)
+    run(warm)
     sync()
 
     import jax.profiler
@@ -169,12 +178,15 @@ def main():
     ap.add_argument("--steps", type=int,
                     default=int(os.environ.get("BENCH_BULK", "10")))
     ap.add_argument("--top", type=int, default=40)
+    ap.add_argument("--model", default="resnet",
+                    choices=("resnet", "ssd"),
+                    help="which benched step to profile")
     ap.add_argument("--json", help="also dump aggregated rows as JSON")
     ap.add_argument("--keep-trace", action="store_true")
     args = ap.parse_args()
 
     tracedir = tempfile.mkdtemp(prefix="step_profile_")
-    capture(args.steps, tracedir)
+    capture(args.steps, tracedir, args.model)
     events, _ = load_device_events(tracedir)
     rows = aggregate(events, args.steps)
     table, total_us = render(rows, args.steps, args.top)
