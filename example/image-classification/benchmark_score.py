@@ -19,7 +19,7 @@ from mxnet_tpu import models  # noqa: E402
 
 
 def score(network, batch_size, image_shape=(3, 224, 224), num_batches=20,
-          dtype="float32", **net_kwargs):
+          dtype="float32", return_mod=False, **net_kwargs):
     sym = models.get_symbol(network, num_classes=1000,
                             image_shape=image_shape, **net_kwargs)
     ctx = mx.tpu() if mx.num_tpus() > 0 else mx.cpu()
@@ -54,7 +54,8 @@ def score(network, batch_size, image_shape=(3, 224, 224), num_batches=20,
         mod.predict_bulk(bulk)
         done += len(bulk)
     sync()
-    return done * batch_size / (time.time() - tic)
+    ips = done * batch_size / (time.time() - tic)
+    return (ips, mod) if return_mod else ips
 
 
 if __name__ == "__main__":

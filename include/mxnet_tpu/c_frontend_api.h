@@ -41,6 +41,7 @@ typedef void* KVStoreHandle;
 typedef void* DataIterHandle;
 typedef void* OptimizerHandle;
 typedef void* RecordIOHandle;
+typedef void* RtcHandle;
 
 /* ---- runtime ---------------------------------------------------------- */
 /*! \brief thread-local message for the last failed call. */
@@ -90,6 +91,14 @@ int MXFrontNDArraySave(const char* fname, uint32_t num,
 int MXFrontNDArrayLoad(const char* fname, uint32_t* out_num,
                        NDArrayHandle** out_handles,
                        const char*** out_keys);
+/*! \brief serialize ONE array to bytes (reference MXNDArraySaveRawBytes:
+ *  the single dmlc array segment, no multi-array header); *out_buf is
+ *  thread-local scratch valid until the next call on this thread. */
+int MXFrontNDArraySaveRawBytes(NDArrayHandle h, uint64_t* out_size,
+                               const char** out_buf);
+/*! \brief inverse (reference MXNDArrayLoadFromRawBytes). */
+int MXFrontNDArrayLoadFromRawBytes(const void* buf, uint64_t size,
+                                   NDArrayHandle* out);
 /*! \brief generic imperative op dispatch (reference MXImperativeInvoke):
  *  invokes registered op \p op_name on \p inputs with string params.
  *  On entry *num_outputs is the capacity of \p outputs; on exit the
@@ -319,6 +328,28 @@ int MXFrontDataIterBeforeFirst(DataIterHandle h);
 int MXFrontDataIterGetData(DataIterHandle h, NDArrayHandle* out);
 int MXFrontDataIterGetLabel(DataIterHandle h, NDArrayHandle* out);
 int MXFrontDataIterGetPad(DataIterHandle h, int* out_pad);
+
+/* ---- Rtc (reference MXRtcCreate/Push/Free: runtime-compiled kernels;
+ * here the kernel source is a python/JAX/Pallas function compiled by
+ * mxnet_tpu.rtc — the TPU analog of the reference's CUDA RTC) -------- */
+/*! \brief compile a kernel; \p kernel must define a function named
+ *  \p name taking num_input arrays and returning num_output arrays.
+ *  \p inputs / \p outputs may be NULL (accepted for reference API
+ *  parity; shapes bind at Push time on this backend). */
+int MXFrontRtcCreate(const char* name, uint32_t num_input,
+                     uint32_t num_output, const char** input_names,
+                     const char** output_names, NDArrayHandle* inputs,
+                     NDArrayHandle* outputs, const char* kernel,
+                     RtcHandle* out);
+/*! \brief run the kernel, writing into \p outputs.  The six launch
+ *  dims are accepted for reference parity; XLA/Mosaic chooses the
+ *  launch geometry here. */
+int MXFrontRtcPush(RtcHandle h, uint32_t num_input, uint32_t num_output,
+                   NDArrayHandle* inputs, NDArrayHandle* outputs,
+                   uint32_t gridDimX, uint32_t gridDimY,
+                   uint32_t gridDimZ, uint32_t blockDimX,
+                   uint32_t blockDimY, uint32_t blockDimZ);
+int MXFrontRtcFree(RtcHandle h);
 
 #ifdef __cplusplus
 }

@@ -100,6 +100,25 @@ def nd_load(fname):
     return None, list(data)
 
 
+def nd_save_raw(arr):
+    return nd.save_raw_bytes(arr)
+
+
+def nd_load_raw(addr, size):
+    return nd.load_from_raw_bytes(
+        ctypes.string_at(ctypes.c_void_p(addr), size))
+
+
+def rtc_create(name, input_names, output_names, kernel):
+    from . import rtc
+
+    return rtc.Rtc(name, list(input_names), list(output_names), kernel)
+
+
+def rtc_push(r, ins, outs):
+    r.push(list(ins), list(outs))
+
+
 def invoke(op_name, inputs, keys, vals):
     fn = getattr(nd, op_name)
     out = fn(*inputs, **dict(zip(keys, vals)))

@@ -446,7 +446,8 @@ class Executor:
             # MXNET_EXEC_BULK_EXEC_TRAIN); on a tunneled TPU the per-step
             # dispatch round trip is tens of ms, so bulking across steps
             # is the same trade one level up.
-            _, upd_names_t, scan_names_t, momentum, rescale, clip = kind
+            (_, upd_names_t, scan_names_t, momentum, rescale, clip,
+             collect) = kind
             upd_names = list(upd_names_t)
             scan_names = list(scan_names_t)
             static_names = [n for n in arg_names
@@ -472,7 +473,11 @@ class Executor:
                         if m is not None:
                             new_m.append(m)
                     nxt_rng = jax.random.fold_in(cur_rng, 1)
-                    return (new_p, new_m, new_aux_list, nxt_rng), list(outs)
+                    # collect=False skips the K-step output stack — at
+                    # PTB shapes the stacked softmax (K, N*T, vocab) is
+                    # GBs of HBM nobody reads (b256/bulk-80 OOM'd 27 GB)
+                    return ((new_p, new_m, new_aux_list, nxt_rng),
+                            list(outs) if collect else None)
 
                 (new_p, new_m, new_aux_list, _), outs_stack = jax.lax.scan(
                     body, (list(upd_vals), list(moms), list(aux), rng),

@@ -585,9 +585,14 @@ class Module(BaseModule):
         Requires the same eligibility as the fused step
         (``MXNET_FUSE_TRAIN_STEP=1``, plain SGD, local kvstore); falls
         back to per-batch ``forward_backward``+``update`` otherwise.
-        After the call ``get_outputs()`` returns the LAST step's outputs;
-        per-step gradients are not materialized (``grad_dict`` is stale —
-        the scan keeps them on-chip).
+        With ``return_outputs=True`` every step's outputs are stacked
+        and returned, and ``get_outputs()`` reflects the last step.
+        With the default ``return_outputs=False`` the scan does NOT
+        materialize the per-step output stack at all (at PTB shapes the
+        stacked softmax is GBs of HBM nobody reads) — ``get_outputs()``
+        is left stale, and per-step gradients are likewise not
+        materialized (``grad_dict`` stale — the scan keeps them
+        on-chip).
 
         ``return_outputs=True`` additionally returns, per symbol output,
         a host numpy array stacked over the batches (``(K, ...)``) — one
@@ -640,7 +645,8 @@ class Module(BaseModule):
         scan_names = [n for n in (self._data_names + self._label_names)
                       if n in ex.arg_dict]
         fn = ex._get_fn(("train_sgd_scan", tuple(names), tuple(scan_names),
-                         optimizer.momentum, optimizer.rescale_grad, clip))
+                         optimizer.momentum, optimizer.rescale_grad, clip,
+                         bool(return_outputs)))
         dev = ex._ctx.jax_device()
         name_pos = {}
         for i, n in enumerate(self._data_names):
@@ -694,7 +700,9 @@ class Module(BaseModule):
         self._last_bulk_sig = (fn, jax.tree_util.tree_map(
             lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), call_args))
         outs_stack, new_aux, new_p, new_m = fn(*call_args)
-        ex.outputs = [NDArray._from_jax(o[-1], ex._ctx) for o in outs_stack]
+        if outs_stack is not None:
+            ex.outputs = [NDArray._from_jax(o[-1], ex._ctx)
+                          for o in outs_stack]
         for arr, v in zip(ex.aux_arrays, new_aux):
             arr._jx = v
         for n, p in zip(names, new_p):
@@ -787,7 +795,12 @@ class Module(BaseModule):
         static = [n for n in ex.arg_names if n not in scan_names]
         static_vals = [ex.arg_dict[n]._jx for n in static]
         aux = [a._jx for a in ex.aux_arrays]
-        outs_stack = fn(static_vals, aux, ex.next_rng(), stacks)
+        call_args = (static_vals, aux, ex.next_rng(), stacks)
+        # same abstract signature record as run_bulk, so inference-only
+        # benches get bulk_cost_analysis (measured FLOPs -> MFU) too
+        self._last_bulk_sig = (fn, jax.tree_util.tree_map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), call_args))
+        outs_stack = fn(*call_args)
         result = []
         for k in range(len(batches)):
             result.append([NDArray._from_jax(o[k], ex._ctx)

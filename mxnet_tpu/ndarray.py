@@ -545,26 +545,55 @@ _FLAG_TO_DTYPE = {0: "float32", 1: "float64", 2: "float16", 3: "uint8",
 _DTYPE_TO_FLAG = {v: k for k, v in _FLAG_TO_DTYPE.items()}
 
 
+def _write_array_segment(f, a):
+    """One array's dmlc segment (ndim, shape, context, dtype flag,
+    data) — the unit _save_dmlc repeats and the unit the reference's
+    MXNDArraySaveRawBytes serializes alone."""
+    arr = a.asnumpy() if isinstance(a, NDArray) else np.asarray(a)
+    dname = str(a._jx.dtype) if isinstance(a, NDArray) else str(arr.dtype)
+    if dname not in _DTYPE_TO_FLAG:
+        raise MXNetError("save: dtype %r has no dmlc type flag" % dname)
+    if dname == "bfloat16":
+        arr = np.asarray(a._jx).view(np.uint16) \
+            if isinstance(a, NDArray) else arr.view(np.uint16)
+    f.write(_struct.pack("<I", arr.ndim))
+    f.write(_struct.pack("<%dI" % arr.ndim, *arr.shape))
+    f.write(_struct.pack("<ii", 1, 0))           # Context: cpu(0)
+    f.write(_struct.pack("<i", _DTYPE_TO_FLAG[dname]))
+    f.write(np.ascontiguousarray(arr).tobytes())
+
+
 def _save_dmlc(f, names, arrays):
     f.write(_struct.pack("<QQ", _DMLC_MAGIC, 0))
     f.write(_struct.pack("<Q", len(arrays)))
     for a in arrays:
-        arr = a.asnumpy() if isinstance(a, NDArray) else np.asarray(a)
-        dname = str(a._jx.dtype) if isinstance(a, NDArray) else str(arr.dtype)
-        if dname not in _DTYPE_TO_FLAG:
-            raise MXNetError("save: dtype %r has no dmlc type flag" % dname)
-        if dname == "bfloat16":
-            arr = np.asarray(a._jx).view(np.uint16) \
-                if isinstance(a, NDArray) else arr.view(np.uint16)
-        f.write(_struct.pack("<I", arr.ndim))
-        f.write(_struct.pack("<%dI" % arr.ndim, *arr.shape))
-        f.write(_struct.pack("<ii", 1, 0))           # Context: cpu(0)
-        f.write(_struct.pack("<i", _DTYPE_TO_FLAG[dname]))
-        f.write(np.ascontiguousarray(arr).tobytes())
+        _write_array_segment(f, a)
     f.write(_struct.pack("<Q", len(names)))
     for n in names:
         b = n.encode()
         f.write(_struct.pack("<Q", len(b)) + b)
+
+
+def _read_array_segment(rd, rdbytes):
+    """Inverse of _write_array_segment (shared by _load_dmlc and
+    load_from_raw_bytes)."""
+    (ndim,) = rd("<I")
+    shape = rd("<%dI" % ndim) if ndim else ()
+    _dev_type, _dev_id = rd("<ii")
+    (flag,) = rd("<i")
+    dname = _FLAG_TO_DTYPE.get(flag)
+    if dname is None:
+        raise MXNetError("unknown dtype flag %d" % flag)
+    if dname == "bfloat16":
+        import jax.numpy as jnp_
+
+        n = int(np.prod(shape)) if shape else 1
+        raw = np.frombuffer(rdbytes(2 * n), np.uint16).reshape(shape)
+        return array(raw.view(jnp_.bfloat16))
+    dt = np.dtype(dname)
+    n = int(np.prod(shape)) if shape else 1
+    raw = np.frombuffer(rdbytes(dt.itemsize * n), dt).reshape(shape)
+    return array(raw)
 
 
 def _load_dmlc(f):
@@ -583,24 +612,7 @@ def _load_dmlc(f):
     (count,) = rd("<Q")
     arrays = []
     for _ in range(count):
-        (ndim,) = rd("<I")
-        shape = rd("<%dI" % ndim) if ndim else ()
-        _dev_type, _dev_id = rd("<ii")
-        (flag,) = rd("<i")
-        dname = _FLAG_TO_DTYPE.get(flag)
-        if dname is None:
-            raise MXNetError("unknown dtype flag %d" % flag)
-        if dname == "bfloat16":
-            import jax.numpy as jnp_
-
-            n = int(np.prod(shape)) if shape else 1
-            raw = np.frombuffer(rdbytes(2 * n), np.uint16).reshape(shape)
-            arrays.append(array(raw.view(jnp_.bfloat16)))
-        else:
-            dt = np.dtype(dname)
-            n = int(np.prod(shape)) if shape else 1
-            raw = np.frombuffer(rdbytes(dt.itemsize * n), dt).reshape(shape)
-            arrays.append(array(raw))
+        arrays.append(_read_array_segment(rd, rdbytes))
     (n_names,) = rd("<Q")
     if n_names and n_names != len(arrays):
         raise MXNetError("malformed params file: %d names for %d arrays"
@@ -655,6 +667,36 @@ def load(fname):
         if keys[0].startswith("l:"):
             return [array(f[k]) for k in keys]
         return {k[2:]: array(f[k]) for k in keys}
+
+
+def save_raw_bytes(arr):
+    """Serialize ONE NDArray to bytes (reference MXNDArraySaveRawBytes /
+    ``NDArray::Save`` to a string stream): the single dmlc array segment
+    without the multi-array file header."""
+    import io as _io
+
+    f = _io.BytesIO()
+    _write_array_segment(f, arr)
+    return f.getvalue()
+
+
+def load_from_raw_bytes(buf):
+    """Inverse of :func:`save_raw_bytes` (reference
+    MXNDArrayLoadFromRawBytes)."""
+    import io as _io
+
+    f = _io.BytesIO(bytes(buf))
+
+    def rdbytes(size):
+        b = f.read(size)
+        if len(b) != size:
+            raise MXNetError("truncated raw NDArray bytes")
+        return b
+
+    def rd(fmt):
+        return _struct.unpack(fmt, rdbytes(_struct.calcsize(fmt)))
+
+    return _read_array_segment(rd, rdbytes)
 
 
 # ---------------------------------------------------------------------------

@@ -1214,4 +1214,80 @@ int MXFrontDataIterGetPad(DataIterHandle h, int* out_pad) {
   API_END();
 }
 
+/* ---- raw-bytes NDArray serialization ---------------------------------- */
+
+int MXFrontNDArraySaveRawBytes(NDArrayHandle h, uint64_t* out_size,
+                               const char** out_buf) {
+  API_BEGIN();
+  PyObject* r = callf("nd_save_raw", "(O)", h);
+  if (r == nullptr) return -1;
+  char* data = nullptr;
+  Py_ssize_t len = 0;
+  if (PyBytes_AsStringAndSize(r, &data, &len) != 0) {
+    Py_DECREF(r);
+    set_error("nd_save_raw: " + py_error());
+    return -1;
+  }
+  Scratch* s = &g_scratch[0];
+  s->strings.clear();
+  s->strings.emplace_back(data, static_cast<size_t>(len));
+  *out_buf = s->strings[0].data();
+  *out_size = static_cast<uint64_t>(len);
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXFrontNDArrayLoadFromRawBytes(const void* buf, uint64_t size,
+                                   NDArrayHandle* out) {
+  API_BEGIN();
+  PyObject* r = callf("nd_load_raw", "(KK)",
+                      (unsigned long long)(uintptr_t)buf,
+                      (unsigned long long)size);
+  if (r == nullptr) return -1;
+  *out = r;
+  API_END();
+}
+
+/* ---- Rtc --------------------------------------------------------------- */
+
+int MXFrontRtcCreate(const char* name, uint32_t num_input,
+                     uint32_t num_output, const char** input_names,
+                     const char** output_names, NDArrayHandle* inputs,
+                     NDArrayHandle* outputs, const char* kernel,
+                     RtcHandle* out) {
+  (void)inputs;   /* reference-parity args: shapes bind at Push here */
+  (void)outputs;
+  API_BEGIN();
+  PyObject* in_names = str_list(static_cast<int>(num_input), input_names);
+  PyObject* out_names =
+      str_list(static_cast<int>(num_output), output_names);
+  PyObject* r = callf("rtc_create", "(sOOs)", name, in_names, out_names,
+                      kernel);
+  Py_DECREF(in_names);
+  Py_DECREF(out_names);
+  if (r == nullptr) return -1;
+  *out = r;
+  API_END();
+}
+
+int MXFrontRtcPush(RtcHandle h, uint32_t num_input, uint32_t num_output,
+                   NDArrayHandle* inputs, NDArrayHandle* outputs,
+                   uint32_t gridDimX, uint32_t gridDimY,
+                   uint32_t gridDimZ, uint32_t blockDimX,
+                   uint32_t blockDimY, uint32_t blockDimZ) {
+  (void)gridDimX; (void)gridDimY; (void)gridDimZ;
+  (void)blockDimX; (void)blockDimY; (void)blockDimZ;
+  API_BEGIN();
+  PyObject* ins = handle_list(static_cast<int>(num_input), inputs);
+  PyObject* outs = handle_list(static_cast<int>(num_output), outputs);
+  PyObject* r = callf("rtc_push", "(OOO)", h, ins, outs);
+  Py_DECREF(ins);
+  Py_DECREF(outs);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXFrontRtcFree(RtcHandle h) { return MXFrontNDArrayFree(h); }
+
 }  // extern "C"

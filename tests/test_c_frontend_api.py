@@ -381,6 +381,58 @@ ck(lib.MXFrontExecutorForward(ex, 0))
 ck(lib.MXFrontExecutorPrint(ex, ctypes.byref(sval)))
 assert b"Executor" in sval.value
 print("monitor OK")
+
+# --- raw-bytes single-NDArray serialization ------------------------------
+raw_src = P()
+ck(lib.MXFrontNDArrayCreate((ctypes.c_uint32 * 2)(2, 2), 2, 1, 0, 0,
+                            ctypes.byref(raw_src)))
+rawdata = np.array([1.5, -2.0, 3.25, 0.0], np.float32)
+ck(lib.MXFrontNDArraySyncCopyFromCPU(raw_src,
+                                     rawdata.ctypes.data_as(P),
+                                     ctypes.c_uint64(4)))
+rb_size = ctypes.c_uint64()
+rb_buf = ctypes.c_char_p()
+ck(lib.MXFrontNDArraySaveRawBytes(raw_src, ctypes.byref(rb_size),
+                                  ctypes.byref(rb_buf)))
+blob = ctypes.string_at(rb_buf, rb_size.value)
+assert len(blob) == rb_size.value and rb_size.value > 16, rb_size.value
+back = P()
+ck(lib.MXFrontNDArrayLoadFromRawBytes(blob, ctypes.c_uint64(len(blob)),
+                                      ctypes.byref(back)))
+rt = np.zeros(4, np.float32)
+ck(lib.MXFrontNDArraySyncCopyToCPU(back, rt.ctypes.data_as(P),
+                                   ctypes.c_uint64(4)))
+assert (rt == rawdata).all(), rt
+ck(lib.MXFrontNDArrayFree(back))
+ck(lib.MXFrontNDArrayFree(raw_src))
+print("raw bytes OK")
+
+# --- Rtc: runtime-compiled kernel from C ---------------------------------
+rtc_in = P()
+rtc_out = P()
+ck(lib.MXFrontNDArrayCreate((ctypes.c_uint32 * 1)(4,), 1, 1, 0, 0,
+                            ctypes.byref(rtc_in)))
+ck(lib.MXFrontNDArrayCreate((ctypes.c_uint32 * 1)(4,), 1, 1, 0, 0,
+                            ctypes.byref(rtc_out)))
+xv = np.array([1.0, 2.0, 3.0, 4.0], np.float32)
+ck(lib.MXFrontNDArraySyncCopyFromCPU(rtc_in, xv.ctypes.data_as(P),
+                                     ctypes.c_uint64(4)))
+kernel = b"def scale2(x):\n    return 2.0 * x + 1.0\n"
+rtc_h = P()
+in_names = (ctypes.c_char_p * 1)(b"x")
+out_names = (ctypes.c_char_p * 1)(b"y")
+ck(lib.MXFrontRtcCreate(b"scale2", 1, 1, in_names, out_names,
+                        None, None, kernel, ctypes.byref(rtc_h)))
+ck(lib.MXFrontRtcPush(rtc_h, 1, 1, (P * 1)(rtc_in), (P * 1)(rtc_out),
+                      1, 1, 1, 1, 1, 1))
+yv = np.zeros(4, np.float32)
+ck(lib.MXFrontNDArraySyncCopyToCPU(rtc_out, yv.ctypes.data_as(P),
+                                   ctypes.c_uint64(4)))
+assert np.allclose(yv, 2.0 * xv + 1.0), yv
+ck(lib.MXFrontRtcFree(rtc_h))
+ck(lib.MXFrontNDArrayFree(rtc_in))
+ck(lib.MXFrontNDArrayFree(rtc_out))
+print("rtc OK")
 print("C FRONTEND ABI OK")
 """
 

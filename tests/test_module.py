@@ -332,7 +332,9 @@ def test_run_bulk_matches_sequential():
             seq.update()
         out_seq = seq.get_outputs()[0].asnumpy()
         blk = build()
-        blk.run_bulk(batches)
+        # return_outputs=True: the default no-collect path leaves
+        # get_outputs() stale by contract (no K-step output stack)
+        blk.run_bulk(batches, return_outputs=True)
         out_blk = blk.get_outputs()[0].asnumpy()
     finally:
         os.environ.pop("MXNET_FUSE_TRAIN_STEP", None)

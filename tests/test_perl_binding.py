@@ -156,7 +156,7 @@ def _python_reference_run(init_params, xs, ys, epochs, lr, batch):
     reason="needs perl + toolchain")
 def test_perl_training_matches_python(tmp_path):
     """The second-language TRAINING proof the round-4 verdict asked for:
-    AI::MXNetTPU (XS over the 82-fn frontend ABI) builds the MNIST MLP
+    AI::MXNetTPU (XS over the 87-fn frontend ABI) builds the MNIST MLP
     symbol, binds, and runs the full forward/backward/sgd loop from a
     .pl script — loss decreases, and the loss curve AND final weights
     match a python run of the identical loop (same init, same batches,

@@ -1,0 +1,228 @@
+#!/usr/bin/env python
+"""First-principles HBM byte accounting per HLO, cross-checking the
+profiler's counters.
+
+``step_profile.py`` attributes GB/s from the profiler's
+``raw_bytes_accessed`` — a counter the perf doc calls generous (loop
+fusions reported at 917 GB/s against an ~819 GB/s HBM spec).  This tool
+computes the MINIMUM bytes each profiled op must move — every distinct
+operand buffer read once + every output buffer written once, straight
+from the compiled HLO's buffer shapes — and prints both accountings per
+category.  Where the profiler exceeds first-principles, the delta is
+re-reads (conv window overlap, remat inside a fusion); where
+first-principles exceeds the achievable-bandwidth-times-measured-time
+product, the op is NOT memory-bound no matter what the counter says.
+
+Usage:
+    python tools/perf/step_profile.py --model resnet --json prof.json
+    python tools/perf/hlo_bytes.py --model resnet --profile prof.json
+
+The HLO text comes from the SAME compiled executable the bench runs
+(the module's recorded bulk signature re-lowered through the jit cache
+— no extra device work beyond one warm bulk).
+"""
+
+import argparse
+import collections
+import json
+import os
+import re
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+sys.path.insert(0, _REPO)
+
+_DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2, "f16": 2,
+    "bf16": 2, "s32": 4, "u32": 4, "f32": 4, "s64": 8, "u64": 8,
+    "f64": 8, "c64": 8, "c128": 16, "token": 0, "s4": 1, "u4": 1,
+}
+
+_SHAPE_RX = re.compile(r"(\w+)\[([\d,]*)\](?:\{([^{}]*)\})?")
+
+
+def shape_bytes(type_str, hbm_only=False):
+    """Total bytes of an HLO type string; tuples sum their elements.
+    With hbm_only, buffers whose layout carries a non-default memory
+    space (``S(1)`` = VMEM on TPU — XLA's memory-space-assignment pins
+    them on-chip) count ZERO: their reads/writes never touch HBM, which
+    is exactly how shape-derived byte counters came to imply >spec
+    bandwidths."""
+    total = 0
+    for dt, dims, layout in _SHAPE_RX.findall(type_str):
+        if dt not in _DTYPE_BYTES:
+            continue
+        if hbm_only and layout and re.search(r"S\([1-9]", layout):
+            continue
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        total += n * _DTYPE_BYTES[dt]
+    return total
+
+
+_INSTR_RX = re.compile(
+    r"^\s*(?:ROOT\s+)?%?([\w.\-]+)\s+=\s+(\([^)]*\)|\S+)\s+([\w\-]+)\(")
+_OPERAND_RX = re.compile(r"%([\w.\-]+)")
+
+
+def parse_hlo(text):
+    """-> {name: (hbm_output_bytes, op_kind, [operand names])} over
+    every computation in the module (profiled rows live inside the bulk
+    while-body, not just ENTRY).  Byte counts exclude VMEM-space
+    (``S(1)``) buffers — see shape_bytes."""
+    out = {}
+    for line in text.splitlines():
+        m = _INSTR_RX.match(line)
+        if m is None:
+            continue
+        name, type_str, kind = m.groups()
+        # operands: %refs inside the first (...) after the op kind
+        rest = line[m.end():]
+        depth, i = 1, 0
+        while i < len(rest) and depth > 0:
+            if rest[i] == "(":
+                depth += 1
+            elif rest[i] == ")":
+                depth -= 1
+            i += 1
+        operands = _OPERAND_RX.findall(rest[:i])
+        idx = None
+        if kind == "get-tuple-element":
+            mi = re.search(r"index=(\d+)", line)
+            idx = int(mi.group(1)) if mi else None
+        out[name] = (shape_bytes(type_str, hbm_only=True), kind,
+                     operands, idx)
+    return out
+
+
+def min_bytes(name, instrs):
+    """Minimum HBM traffic of one instruction: distinct operand buffers
+    read once + outputs written once.  get-tuple-element and bitcast
+    operands resolve through to their source (they alias, no traffic);
+    two gtes of the SAME tuple at DIFFERENT indices are distinct
+    buffers and both count (scan carries are multi-element tuples)."""
+    out_bytes = instrs[name][0]
+    operands = instrs[name][2]
+
+    def resolve(op):
+        """-> hashable identity of the underlying buffer."""
+        idx_path = ()
+        seen = set()
+        while op in instrs and instrs[op][1] in (
+                "get-tuple-element", "bitcast", "copy-done"):
+            if op in seen:
+                break
+            seen.add(op)
+            if instrs[op][1] == "get-tuple-element":
+                idx_path = idx_path + (instrs[op][3],)
+            src = instrs[op][2]
+            if not src:
+                break
+            op = src[0]
+        return (op, idx_path)
+
+    total = out_bytes
+    counted = set()
+    for op in operands:
+        key = resolve(op)
+        if key in counted:
+            continue
+        counted.add(key)
+        # read size = the operand's own (element) shape, not the
+        # resolved tuple's — a gte reads one slice
+        total += instrs[op][0] if op in instrs else 0
+    return total
+
+
+def compiled_text(model):
+    import bench
+
+    if model == "resnet":
+        mod, run, sync = bench.setup()
+        warm = bench.BULK
+    else:
+        import bench_extra
+
+        mod, run, sync = bench_extra.ssd_setup()
+        warm = 10
+    run(warm)
+    sync()
+    fn, avals = mod._last_bulk_sig
+    return fn.lower(*avals).compile().as_text()
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="resnet",
+                    choices=("resnet", "ssd"))
+    ap.add_argument("--profile", required=True,
+                    help="step_profile.py --json output")
+    ap.add_argument("--hlo", help="use a saved HLO text instead of "
+                    "rebuilding the bench step")
+    args = ap.parse_args()
+
+    with open(args.profile) as f:
+        prof = json.load(f)
+    if args.hlo:
+        text = open(args.hlo).read()
+    else:
+        text = compiled_text(args.model)
+    instrs = parse_hlo(text)
+
+    steps = prof["steps"]
+    # per category: [dur_ps, prof_bytes, fp_bytes, matched_ps,
+    #               slice_read_ps]
+    cats = collections.defaultdict(lambda: [0.0, 0, 0, 0, 0])
+    unmatched = 0
+    for r in prof["rows"]:
+        name = r["name"]
+        cat = r["category"]
+        c = cats[cat]
+        c[0] += r["dur_ps"]
+        if name not in instrs:
+            unmatched += 1
+            continue
+        fp = min_bytes(name, instrs) * r["count"]
+        # fp is a true LOWER bound only when the op reads its operands
+        # in full; a scan-body fusion whose operand is the whole K-step
+        # input stack reads one slice per iteration, making fp exceed
+        # the profiler count — such rows can't cross-check bandwidth
+        # and are bucketed separately
+        if fp > r["bytes"] * 1.02 and r["bytes"]:
+            c[4] += r["dur_ps"]
+            continue
+        c[1] += r["bytes"]
+        c[2] += fp
+        c[3] += r["dur_ps"]
+
+    print("| category | us/step | counter GB/s | true-HBM GB/s "
+          "| counter inflation | cross-checked time |")
+    print("|---|---|---|---|---|---|")
+    for cat, (ps, pbytes, fbytes, mps, slice_ps) in sorted(
+            cats.items(), key=lambda kv: -kv[1][0]):
+        if cat == "while":
+            continue  # container; children accounted individually
+        us = ps / 1e6 / steps
+        pgb = pbytes / (mps / 1e12) / 1e9 if mps else 0.0
+        fgb = fbytes / (mps / 1e12) / 1e9 if mps else 0.0
+        # counter bytes over true-HBM bytes = the share of counted
+        # traffic that was VMEM-served (S(1) buffers) or re-read
+        rr = ("%.2fx" % (pbytes / fbytes)) if fbytes else "-"
+        print("| %s | %.1f | %.0f | %.0f | %s | %.0f%% |" % (
+            cat, us, pgb, fgb, rr, 100.0 * mps / ps if ps else 0))
+    excl = sum(c[4] for c in cats.values())
+    if excl:
+        print("\nexcluded %.1f us/step of slice-read rows (fp bound "
+              "not applicable)" % (excl / 1e6 / steps))
+    if unmatched:
+        print("%d profiled rows had no HLO match — use the .hlo.txt "
+              "dumped by step_profile --json (same process, same "
+              "executable) to avoid fusion renumbering"
+              % unmatched, file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -186,7 +186,7 @@ def main():
     args = ap.parse_args()
 
     tracedir = tempfile.mkdtemp(prefix="step_profile_")
-    capture(args.steps, tracedir, args.model)
+    mod = capture(args.steps, tracedir, args.model)
     events, _ = load_device_events(tracedir)
     rows = aggregate(events, args.steps)
     table, total_us = render(rows, args.steps, args.top)
@@ -195,6 +195,16 @@ def main():
         with open(args.json, "w") as f:
             json.dump({"steps": args.steps, "total_us_per_step": total_us,
                        "rows": rows}, f, indent=1)
+        # the SAME executable's HLO (jit-cache hit on the recorded bulk
+        # signature) so tools/perf/hlo_bytes.py matches fusion names
+        # exactly — a fresh-process recompile renumbers fusions
+        try:
+            fn, avals = mod._last_bulk_sig
+            with open(args.json + ".hlo.txt", "w") as f:
+                f.write(fn.lower(*avals).compile().as_text())
+            print("hlo text:", args.json + ".hlo.txt", file=sys.stderr)
+        except Exception as e:  # profiling still useful without it
+            print("hlo dump failed: %s" % e, file=sys.stderr)
     if not args.keep_trace:
         import shutil
 
