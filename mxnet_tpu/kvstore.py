@@ -178,18 +178,22 @@ class KVStoreDist(KVStore):
         self._socks = []
         deadline = _time.time() + float(
             os.environ.get("MXNET_KVSTORE_CONNECT_TIMEOUT", "120"))
-        for sid in range(self._num_servers):
-            # servers import jax before binding; retry with backoff
-            while True:
-                try:
-                    self._socks.append(_socket.create_connection(
-                        (host, port + sid), timeout=300))
-                    break
-                except OSError:
-                    if _time.time() > deadline:
-                        raise
-                    _time.sleep(0.2)
-        self._sock = self._socks[0]  # scheduler
+        def connect_all():
+            self._socks = []
+            for sid in range(self._num_servers):
+                # servers import jax before binding; retry with backoff
+                while True:
+                    try:
+                        self._socks.append(_socket.create_connection(
+                            (host, port + sid), timeout=300))
+                        break
+                    except OSError:
+                        if _time.time() > deadline:
+                            raise
+                        _time.sleep(0.2)
+            self._sock = self._socks[0]  # scheduler
+
+        connect_all()
         self._versions = {}
         reg = {"cmd": "register", "role": "worker"}
         worker_id = os.environ.get("DMLC_WORKER_ID")
@@ -207,7 +211,31 @@ class KVStoreDist(KVStore):
             # announce identity so a restarted worker rejoins with its old
             # rank (the reference's ps-lite is_recovery path)
             reg["preferred_rank"] = int(worker_id)
-        reply = self._rpc(reg)
+        # a loaded host can drop the just-accepted connection before the
+        # register reply (seen as a suite-level flake) — as a clean FIN
+        # (recv returns b'' -> MXNetError 'connection lost') or as an
+        # RST (ConnectionResetError/BrokenPipeError).  Retrying is only
+        # safe when the registration is idempotent server-side, i.e.
+        # when preferred_rank identifies this worker (the rejoin path);
+        # without an identity a processed-but-unacknowledged register
+        # would leak a ghost rank on retry, so that case still raises.
+        while True:
+            try:
+                reply = self._rpc(reg)
+                break
+            except (MXNetError, OSError) as e:
+                dropped = isinstance(e, OSError) \
+                    or "connection lost" in str(e)
+                if not dropped or "preferred_rank" not in reg \
+                        or _time.time() > deadline:
+                    raise
+                for s in self._socks:
+                    try:
+                        s.close()
+                    except OSError:
+                        pass
+                _time.sleep(0.3)
+                connect_all()
         self._rank = reply["rank"]
         self._num_workers = reply["num_workers"]
         self.is_recovery = bool(reply.get("is_recovery", False))

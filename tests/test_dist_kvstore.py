@@ -555,3 +555,65 @@ def test_multi_server_sharding(tmp_path):
         env=env, timeout=300, capture_output=True, text=True)
     assert proc.returncode == 0, proc.stderr[-2000:]
     assert (tmp_path / "ok.0").exists() and (tmp_path / "ok.1").exists()
+
+
+def test_register_retries_through_dropped_connection():
+    """A loaded host can accept and then drop a worker's connection
+    before the register reply (seen as a rare suite-level flake);
+    registration must reconnect and retry within the connect deadline.
+    A drop-first-connection proxy in front of the server makes the
+    failure deterministic."""
+    import socket as _socket
+
+    srv = _with_server(1)
+    drop_done = threading.Event()
+    proxy = _socket.socket()
+    proxy.bind(("127.0.0.1", 0))
+    proxy.listen(4)
+    pport = proxy.getsockname()[1]
+
+    def run_proxy():
+        # first connection: accept, drop immediately (the flake)
+        c, _ = proxy.accept()
+        c.close()
+        drop_done.set()
+        # second connection: transparent byte pump to the real server
+        c, _ = proxy.accept()
+        up = _socket.create_connection(("127.0.0.1", srv.port))
+
+        def pump(a, b):
+            try:
+                while True:
+                    d = a.recv(65536)
+                    if not d:
+                        break
+                    b.sendall(d)
+            except OSError:
+                pass
+
+        t1 = threading.Thread(target=pump, args=(c, up), daemon=True)
+        t2 = threading.Thread(target=pump, args=(up, c), daemon=True)
+        t1.start()
+        t2.start()
+        t1.join(timeout=30)
+
+    pt = threading.Thread(target=run_proxy, daemon=True)
+    pt.start()
+    os.environ["DMLC_PS_ROOT_PORT"] = str(pport)
+    os.environ["DMLC_WORKER_ID"] = "0"
+    os.environ["DMLC_NUM_SERVER"] = "1"  # earlier tests may leak 2
+    try:
+        kv = kvstore.KVStoreDist("dist_sync")
+        assert drop_done.is_set(), "proxy never dropped a connection"
+        assert kv.rank == 0
+        kv.init(5, mx.nd.ones((3,)))
+        out = mx.nd.zeros((3,))
+        kv.pull(5, out)
+        assert (out.asnumpy() == 1).all()
+        kv.close()
+    finally:
+        os.environ["DMLC_PS_ROOT_PORT"] = str(srv.port)
+        os.environ.pop("DMLC_WORKER_ID", None)
+        os.environ.pop("DMLC_NUM_SERVER", None)
+        proxy.close()
+        srv.close()

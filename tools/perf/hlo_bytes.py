@@ -189,9 +189,10 @@ def main():
         # fp is a true LOWER bound only when the op reads its operands
         # in full; a scan-body fusion whose operand is the whole K-step
         # input stack reads one slice per iteration, making fp exceed
-        # the profiler count — such rows can't cross-check bandwidth
-        # and are bucketed separately
-        if fp > r["bytes"] * 1.02 and r["bytes"]:
+        # the profiler count — such rows (and rows the profiler
+        # reports NO bytes for) can't cross-check bandwidth and are
+        # bucketed separately
+        if r["bytes"] == 0 or fp > r["bytes"] * 1.02:
             c[4] += r["dur_ps"]
             continue
         c[1] += r["bytes"]
