@@ -162,7 +162,7 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
     def _finish():
         l = jnp.maximum(l_scr[:, 0], 1e-30)
         o_ref[0] = (acc_scr[:] / l[:, None]).astype(o_ref.dtype)
-        lse_ref[0] = (m_scr[:, 0] + jnp.log(l))
+        lse_ref[0, :, 0] = (m_scr[:, 0] + jnp.log(l))
 
 
 def _flash_pallas(q, k, v, causal, scale, block_q=256, block_k=512,
@@ -195,11 +195,18 @@ def _flash_pallas(q, k, v, causal, scale, block_q=256, block_k=512,
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b_, q_, k_: (b_, q_, 0)),
-            pl.BlockSpec((1, block_q), lambda b_, q_, k_: (b_, q_)),
+            # lse rides as (bh, lq, 1) so the block's minor-two dims are
+            # (block_q, 1) — sublane divisible by 8, lane equal to the
+            # array dim.  A (1, block_q) block puts 1 in the sublane
+            # slot and fails Mosaic's tile rule — which silently meant
+            # this kernel NEVER lowered on real TPU until round 5 (the
+            # d%128 gate routed the only hardware test through the scan
+            # path)
+            pl.BlockSpec((1, block_q, 1), lambda b_, q_, k_: (b_, q_, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, lq, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, lq), jnp.float32),
+            jax.ShapeDtypeStruct((bh, lq, 1), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, 128), jnp.float32),   # m
@@ -210,6 +217,7 @@ def _flash_pallas(q, k, v, causal, scale, block_q=256, block_k=512,
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(qr, kr, vr)
+    # the reshape drops the trailing singleton the lse BlockSpec needed
     return out.reshape(b, h, lq, d), lse.reshape(b, h, lq)
 
 
