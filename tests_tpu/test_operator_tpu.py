@@ -7,6 +7,7 @@ TPU's default-bf16 matmul/conv passes.
 """
 
 import numpy as np
+import pytest
 
 import mxnet_tpu as mx
 from mxnet_tpu.test_utils import check_consistency
@@ -282,14 +283,15 @@ def test_pallas_bn_on_chip_matches_xla():
                                    err_msg=k)
 
 
-def test_flash_attention_pallas_kernel_routes_on_chip():
+@pytest.mark.parametrize("dtype", ["float32", "bfloat16"])
+def test_flash_attention_pallas_kernel_routes_on_chip(dtype):
     """At kernel-eligible shapes (d % 128 == 0, aligned seq) the REAL
     Pallas kernel must (a) be selected, (b) lower and run on hardware,
-    and (c) match the dense reference.  The older on-chip test uses
-    d=32, which the _use_pallas gate routes to the scan path — that
-    masked a Mosaic tile-rule violation in the lse out-spec that made
-    the kernel fail to lower on TPU at every eligible shape until
-    round 5."""
+    and (c) match the dense reference — in f32 AND bf16 (training
+    dtype).  The older on-chip test uses d=32, which the _use_pallas
+    gate routes to the scan path — that masked a Mosaic tile-rule
+    violation in the lse out-spec that made the kernel fail to lower on
+    TPU at every eligible shape until round 5."""
     import jax.numpy as jnp
 
     from mxnet_tpu.ops import attention as att
@@ -298,15 +300,23 @@ def test_flash_attention_pallas_kernel_routes_on_chip():
     assert att._use_pallas(np.zeros((b, h, l, d)), np.zeros((b, h, l, d)),
                            256, 512)
     rs = np.random.RandomState(3)
-    q = jnp.asarray(rs.normal(0, 1, (b, h, l, d)).astype(np.float32))
-    k = jnp.asarray(rs.normal(0, 1, (b, h, l, d)).astype(np.float32))
-    v = jnp.asarray(rs.normal(0, 1, (b, h, l, d)).astype(np.float32))
+    jdt = jnp.bfloat16 if dtype == "bfloat16" else jnp.float32
+    q = jnp.asarray(rs.normal(0, 1, (b, h, l, d)).astype(np.float32),
+                    dtype=jdt)
+    k = jnp.asarray(rs.normal(0, 1, (b, h, l, d)).astype(np.float32),
+                    dtype=jdt)
+    v = jnp.asarray(rs.normal(0, 1, (b, h, l, d)).astype(np.float32),
+                    dtype=jdt)
     scale = float(1.0 / np.sqrt(d))
+    tol = 2e-2 if dtype == "float32" else 5e-2
+    lse_tol = 1e-4 if dtype == "float32" else 1e-3
     for causal in (False, True):
         out, lse = att._flash_pallas(q, k, v, causal, scale)
+        assert out.dtype == jdt
         ref = att._attn_reference(q, k, v, causal=causal, scale=scale)
-        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
-                                   rtol=2e-2, atol=2e-2)
+        np.testing.assert_allclose(
+            np.asarray(out, dtype=np.float32),
+            np.asarray(ref, dtype=np.float32), rtol=tol, atol=tol)
         _, lse_scan = att._flash_scan(q, k, v, causal, scale)
         np.testing.assert_allclose(np.asarray(lse), np.asarray(lse_scan),
-                                   rtol=1e-4, atol=1e-4)
+                                   rtol=lse_tol, atol=lse_tol)
