@@ -13,6 +13,13 @@ which is the whole point of the ring schedule: compute hides communication.
 
 Differentiable end-to-end (scan + ppermute have transposable VJPs), so the
 same code path serves training — no separate backward kernel needed.
+
+Per-visiting-shard blocks are dense einsums: XLA schedules them on the MXU,
+at O(Lc^2) score memory per step (Lc = L/ring).  Swapping in the Pallas
+flash kernel (working on hardware since round 5, 2.6x over the scan core)
+would drop that to O(Lc) — but the ring merge needs a DIFFERENTIABLE
+(out, lse) pair per block, and the kernel's custom_vjp exposes only `out`;
+threading lse cotangents through the FA2 backward is the prerequisite.
 """
 
 from __future__ import annotations
