@@ -249,8 +249,14 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_k):
     return out, (q, k, v, out, lse)
 
 
-def _flash_bwd(causal, scale, block_q, block_k, res, do):
-    """FA2 backward: blockwise over K, plain-JAX matmuls (MXU via XLA)."""
+def _flash_bwd_core(causal, scale, block_q, block_k, res, do, dlse=None):
+    """FA2 backward: blockwise over K, plain-JAX matmuls (MXU via XLA).
+
+    ``dlse`` (optional, (B,H,Lq) f32) is the cotangent of the logsumexp
+    output: d lse_i / d s_ij = p_ij, so it enters as ``ds += p * dlse``
+    — the one extra term that makes the (out, lse) PAIR differentiable
+    (ring attention merges blocks through lse, so lse carries real
+    gradients there)."""
     q, k, v, out, lse = res
     qf = q.astype(jnp.float32)
     kf = k.astype(jnp.float32)
@@ -284,7 +290,10 @@ def _flash_bwd(causal, scale, block_q, block_k, res, do):
         p = jnp.where(valid, jnp.exp(s - lse[..., None]), 0.0)
         dv_blk = jnp.einsum("bhqk,bhqd->bhkd", p, dof)
         dp = jnp.einsum("bhqd,bhkd->bhqk", dof, v_blk)
-        ds = p * (dp - delta[..., None]) * scale
+        dsum = dp - delta[..., None]
+        if dlse is not None:
+            dsum = dsum + dlse.astype(jnp.float32)[..., None]
+        ds = p * dsum * scale
         dq = dq + jnp.einsum("bhqk,bhkd->bhqd", ds, k_blk)
         dk_blk = jnp.einsum("bhqk,bhqd->bhkd", ds, qf)
         return dq, (dk_blk, dv_blk)
@@ -296,7 +305,47 @@ def _flash_bwd(causal, scale, block_q, block_k, res, do):
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
+def _flash_bwd(causal, scale, block_q, block_k, res, do):
+    return _flash_bwd_core(causal, scale, block_q, block_k, res, do)
+
+
 _flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+# ---------------------------------------------------------------------------
+# (out, lse) pair — the differentiable unit ring attention merges
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_pair(q, k, v, causal, scale, block_q, block_k):
+    out, res = _flash_fwd(q, k, v, causal, scale, block_q, block_k)
+    return out, res[4]
+
+
+def _flash_pair_fwd(q, k, v, causal, scale, block_q, block_k):
+    out, res = _flash_fwd(q, k, v, causal, scale, block_q, block_k)
+    return (out, res[4]), res
+
+
+def _flash_pair_bwd(causal, scale, block_q, block_k, res, cts):
+    do, dlse = cts
+    return _flash_bwd_core(causal, scale, block_q, block_k, res, do,
+                           dlse=dlse)
+
+
+_flash_pair.defvjp(_flash_pair_fwd, _flash_pair_bwd)
+
+
+def flash_attention_with_lse(q, k, v, causal=False, softmax_scale=None,
+                             block_q=256, block_k=512):
+    """Like :func:`flash_attention` but also returns the per-query
+    logsumexp (B, H, Lq) — differentiable in BOTH outputs, which is what
+    lets ``parallel.ring`` merge per-shard kernel calls with gradients
+    flowing through the merge weights."""
+    if softmax_scale is None:
+        softmax_scale = float(1.0 / np.sqrt(q.shape[-1]))
+    return _flash_pair(q, k, v, bool(causal), float(softmax_scale),
+                       int(block_q), int(block_k))
 
 
 def flash_attention(q, k, v, causal=False, softmax_scale=None,

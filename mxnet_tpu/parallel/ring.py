@@ -14,12 +14,12 @@ which is the whole point of the ring schedule: compute hides communication.
 Differentiable end-to-end (scan + ppermute have transposable VJPs), so the
 same code path serves training — no separate backward kernel needed.
 
-Per-visiting-shard blocks are dense einsums: XLA schedules them on the MXU,
-at O(Lc^2) score memory per step (Lc = L/ring).  Swapping in the Pallas
-flash kernel (working on hardware since round 5, 2.6x over the scan core)
-would drop that to O(Lc) — but the ring merge needs a DIFFERENTIABLE
-(out, lse) pair per block, and the kernel's custom_vjp exposes only `out`;
-threading lse cotangents through the FA2 backward is the prerequisite.
+Per-visiting-shard blocks run through ``ops.attention``'s differentiable
+(out, lse) flash pair (round 5): on TPU at kernel-eligible shapes that is
+the Pallas kernel (2.6x over the scan core, O(Lc) score memory instead of
+the previous dense einsum's O(Lc^2)); elsewhere the blockwise-scan core.
+Shards merge by logsumexp reweighting, with gradients flowing through the
+merge weights via the pair's lse cotangent.
 """
 
 from __future__ import annotations
@@ -37,43 +37,65 @@ NEG_INF = -1e30
 def ring_attention(q, k, v, axis_name, causal=False, softmax_scale=None):
     """Blockwise ring attention over ``axis_name``.  Must run inside
     ``shard_map``; q/k/v are the local sequence shards (B, H, Lc, D)."""
+    from ..ops.attention import flash_attention_with_lse
+
     n = jax.lax.psum(1, axis_name)
     idx = jax.lax.axis_index(axis_name)
     b, h, lc, d = q.shape
     if softmax_scale is None:
         softmax_scale = float(1.0 / np.sqrt(d))
 
-    qf = q.astype(jnp.float32)
     perm = [(j, (j + 1) % n) for j in range(n)]
-    q_pos = idx * lc + jnp.arange(lc)[:, None]            # global q positions
+
+    def block(kc, vc, owner):
+        """(out, lse) of the local q against one visiting K/V shard.
+        With causal masking a visiting shard is either fully visible
+        (owner < idx), the diagonal (owner == idx -> causal kernel
+        call), or fully hidden (owner > idx -> no kernel at all) — so
+        a three-way switch covers every case with no offset mask, and
+        hidden steps skip the flash forward AND its backward/residuals
+        entirely."""
+        def full_b():
+            return flash_attention_with_lse(
+                q, kc, vc, causal=False, softmax_scale=softmax_scale)
+
+        if not causal:     # python constant: no dead branches traced
+            return full_b()
+
+        def diag_b():
+            return flash_attention_with_lse(
+                q, kc, vc, causal=True, softmax_scale=softmax_scale)
+
+        def hidden_b():
+            return (jnp.zeros((b, h, lc, d), q.dtype),
+                    jnp.full((b, h, lc), NEG_INF, jnp.float32))
+
+        which = jnp.where(owner == idx, 1, jnp.where(owner > idx, 2, 0))
+        return jax.lax.switch(which, (full_b, diag_b, hidden_b))
 
     def step(carry, s):
-        o, m, l, kc, vc = carry
+        o, lse, kc, vc = carry
         owner = (idx - s) % n                              # shard origin
-        kpos = owner * lc + jnp.arange(lc)[None, :]
-        sc = jnp.einsum("bhqd,bhkd->bhqk", qf, kc.astype(jnp.float32))
-        sc = sc * softmax_scale
-        if causal:
-            sc = jnp.where(q_pos >= kpos, sc, NEG_INF)
-        m_new = jnp.maximum(m, sc.max(axis=-1))
-        alpha = jnp.exp(m - m_new)
-        p = jnp.exp(sc - m_new[..., None])
-        l_new = l * alpha + p.sum(axis=-1)
-        o_new = o * alpha[..., None] + jnp.einsum(
-            "bhqk,bhkd->bhqd", p, vc.astype(jnp.float32))
+        o_s, lse_s = block(kc, vc, owner)
+        # logsumexp merge of normalized (o, lse) pairs
+        m = jnp.maximum(lse, lse_s)
+        w1 = jnp.exp(lse - m)
+        w2 = jnp.exp(lse_s - m)
+        tot = jnp.maximum(w1 + w2, 1e-30)
+        o = (o * w1[..., None]
+             + o_s.astype(jnp.float32) * w2[..., None]) / tot[..., None]
+        lse = m + jnp.log(tot)
         kc = jax.lax.ppermute(kc, axis_name, perm)
         vc = jax.lax.ppermute(vc, axis_name, perm)
-        return (o_new, m_new, l_new, kc, vc), None
+        return (o, lse, kc, vc), None
 
     o0 = jnp.zeros((b, h, lc, d), jnp.float32)
-    m0 = jnp.full((b, h, lc), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((b, h, lc), jnp.float32)
-    (o, m, l, _, _), _ = jax.lax.scan(step, (o0, m0, l0, k, v),
+    lse0 = jnp.full((b, h, lc), NEG_INF, jnp.float32)
+    (o, _lse, _, _), _ = jax.lax.scan(step, (o0, lse0, k, v),
                                       jnp.arange(n))
-    # with causal masking the first tokens of rank 0 always see >=1 key,
-    # so l>0 everywhere; the maximum is a guard for empty-ring edge cases
-    l = jnp.maximum(l, 1e-30)
-    return (o / l[..., None]).astype(q.dtype)
+    # with causal masking the first tokens of rank 0 always see >=1 key;
+    # the tot guard above covers empty-ring edge cases
+    return o.astype(q.dtype)
 
 
 def ring_self_attention(q, k, v, mesh, seq_axis="data", causal=False,
