@@ -81,6 +81,7 @@ def main():
     args = ap.parse_args()
 
     vocab, embed, heads, batch = 32, 32, 2, 16
+    mx.random.seed(0)   # deterministic init -> reproducible curve
     rs = np.random.RandomState(0)
     X, Y = copy_task(256, args.seq, vocab, rs)
 
