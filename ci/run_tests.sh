@@ -4,6 +4,8 @@
 set -e
 cd "$(dirname "$0")/.."
 python -m compileall -q mxnet_tpu tools example
+# resilience lint: no silently-swallowed exceptions in the framework
+python ci/check_bare_except.py
 if command -v g++ > /dev/null; then
   g++ -O2 -shared -fPIC -std=c++17 -o libmxnet_tpu_native.so \
       src/native.cc -lpthread

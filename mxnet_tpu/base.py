@@ -10,15 +10,80 @@ for auto-generated symbol names (reference ``python/mxnet/name.py``).
 
 from __future__ import annotations
 
+import os
 import threading
 
-__all__ = ["MXNetError", "Registry", "NameManager", "Prefix", "string_types"]
+__all__ = ["MXNetError", "Registry", "NameManager", "Prefix", "string_types",
+           "atomic_write", "atomic_write_bytes"]
 
 string_types = (str,)
 
 
 class MXNetError(RuntimeError):
     """Error raised by the framework (reference: ``base.py:42`` MXNetError)."""
+
+
+def _fsync_dir(path):
+    """fsync the directory entry so a completed rename survives a crash."""
+    d = os.path.dirname(os.path.abspath(path))
+    try:
+        fd = os.open(d, os.O_RDONLY)
+    except OSError:
+        return  # exotic filesystems may refuse O_RDONLY on a dir
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def atomic_write(path, write_fn, fault_point=None):
+    """Crash-safe file write: ``write_fn(tmp_path)`` → fsync → atomic
+    rename onto ``path`` (checkpoints, manifests, optimizer states).
+
+    A reader never observes a half-written ``path``: either the old
+    content (or nothing) or the complete new content.  ``fault_point``
+    names a :mod:`mxnet_tpu.faults` injection point; when armed and
+    firing, the temp file is truncated and :class:`faults.FaultInjected`
+    raised — the on-disk state of a host dying mid-write (the rename
+    never happens, the previous ``path`` stays intact)."""
+    from . import faults as _faults  # lazy: faults imports base
+
+    tmp = "%s.tmp-%d" % (path, os.getpid())
+    try:
+        write_fn(tmp)
+        if fault_point is not None and _faults.should_fire(fault_point):
+            size = os.path.getsize(tmp)
+            with open(tmp, "r+b") as f:
+                f.truncate(max(0, size // 2))
+            raise _faults.FaultInjected(
+                "fault %r: write of %s killed mid-file"
+                % (fault_point, path))
+        fd = os.open(tmp, os.O_RDONLY)
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+        os.replace(tmp, path)
+        _fsync_dir(path)
+    except _faults.FaultInjected:
+        raise  # simulated crash: leave the truncated temp file behind
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def atomic_write_bytes(path, data, mode="wb", fault_point=None):
+    """:func:`atomic_write` of a ready blob.  Closes (flushes) the temp
+    file before the fsync+rename — ``lambda tmp: open(tmp).write(data)``
+    call sites would lean on refcount finalization for the flush, which
+    only CPython guarantees."""
+    def _write(tmp):
+        with open(tmp, mode) as f:
+            f.write(data)
+    atomic_write(path, _write, fault_point=fault_point)
 
 
 class Registry:

@@ -1,0 +1,199 @@
+"""Deterministic fault injection for resilience testing.
+
+The TensorFlow paper (Abadi et al., 2016, §4.3) treats checkpoint +
+transport-retry as the fault-tolerance story of a dataflow system; this
+module makes that layer *testable* by letting tests (and operators, via an
+env var) arm named injection points that the runtime consults on its hot
+paths.  A disarmed point is a dict lookup against an empty registry —
+effectively free — so the hooks stay compiled into production code paths.
+
+Injection points wired into the framework:
+
+=====================  =====================================================
+point                  effect when it fires
+=====================  =====================================================
+``kvstore.push.socket``  worker-side transport sockets are closed before the
+                         Nth ``KVStoreDist.push`` sends, so the push fails
+                         with a clean ``MXNetError`` (a mid-push peer death)
+``checkpoint.write``     the Nth atomic checkpoint write dies after the temp
+                         file is half-written (truncated, never renamed) —
+                         a host crash mid-``save_checkpoint``
+``fit.batch``            the Nth training batch's gradients are poisoned
+                         with NaN before ``update()`` (a corrupt reduction /
+                         overflow), exercising the NaN-policy guards
+``recordio.read``        the Nth ``MXRecordIO.read`` behaves as if the
+                         record's magic were corrupt
+=====================  =====================================================
+
+Arming — programmatic::
+
+    from mxnet_tpu import faults
+    faults.arm("kvstore.push.socket", at=3)        # fire on the 3rd push
+    faults.arm("fit.batch", at=2, count=2)         # batches 2 and 3
+    ...
+    faults.disarm()                                # clear everything
+
+or via environment (picked up by any process, including launched workers)::
+
+    MXNET_FAULT_SPEC="kvstore.push.socket:at=3;fit.batch:at=2,count=2"
+
+Spec grammar: ``point[:key=value[,key=value...]]`` joined by ``;``.  Keys:
+``at`` (1-based hit index of the first firing, default 1) and ``count``
+(number of consecutive firings, default 1; ``count=-1`` means every hit
+from ``at`` on).  Hit counting is per-process and deterministic — there is
+no randomness, so a failing fault test replays exactly.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+from .base import MXNetError
+
+__all__ = ["POINTS", "FaultInjected", "arm", "disarm", "armed",
+           "should_fire", "hits", "reset_counters", "parse_spec"]
+
+#: the injection points the framework consults (``arm`` validates against
+#: this so a typo'd point fails loudly instead of never firing)
+POINTS = ("kvstore.push.socket", "checkpoint.write", "fit.batch",
+          "recordio.read")
+
+
+class FaultInjected(MXNetError):
+    """Raised by call sites that surface an armed fault as an error."""
+
+
+class _Point:
+    __slots__ = ("at", "count", "hits")
+
+    def __init__(self, at=1, count=1):
+        if at < 1:
+            raise ValueError("fault 'at' is a 1-based hit index (got %d)"
+                             % at)
+        self.at = at
+        self.count = count
+        self.hits = 0
+
+
+_lock = threading.Lock()
+_armed = {}          # point -> _Point
+_env_seen = None     # last MXNET_FAULT_SPEC value parsed (None = never)
+
+
+def parse_spec(spec):
+    """Parse ``point:at=N,count=M;point2...`` into ``{point: (at, count)}``."""
+    out = {}
+    for part in (spec or "").split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        point, _, args = part.partition(":")
+        point = point.strip()
+        if point not in POINTS:
+            raise MXNetError("unknown fault point %r (valid: %s)"
+                             % (point, ", ".join(POINTS)))
+        kw = {"at": 1, "count": 1}
+        for item in args.split(","):
+            item = item.strip()
+            if not item:
+                continue
+            k, _, v = item.partition("=")
+            k = k.strip()
+            if k not in ("at", "count"):
+                raise MXNetError("unknown fault spec key %r in %r"
+                                 % (k, part))
+            try:
+                kw[k] = int(v)
+            except ValueError:
+                raise MXNetError("fault spec %r: %s must be an integer"
+                                 % (part, k))
+        out[point] = (kw["at"], kw["count"])
+    return out
+
+
+def _sync_env():
+    """Re-arm from MXNET_FAULT_SPEC whenever its value changes (lock held).
+
+    Env-armed points replace the whole registry so clearing the variable
+    disarms them; programmatic ``arm`` calls after the last env change are
+    preserved only until the env changes again (tests use one or the
+    other)."""
+    global _env_seen
+    spec = os.environ.get("MXNET_FAULT_SPEC", "")
+    if spec == _env_seen:
+        return
+    _env_seen = spec
+    _armed.clear()
+    for point, (at, count) in parse_spec(spec).items():
+        _armed[point] = _Point(at, count)
+
+
+def arm(point, at=1, count=1):
+    """Arm ``point`` to fire on hits ``at .. at+count-1`` (1-based).
+
+    ``count=-1`` fires on every hit from ``at`` on."""
+    if point not in POINTS:
+        raise MXNetError("unknown fault point %r (valid: %s)"
+                         % (point, ", ".join(POINTS)))
+    with _lock:
+        _sync_env()
+        _armed[point] = _Point(at, count)
+
+
+def disarm(point=None):
+    """Disarm one point, or everything (including env-armed) when None."""
+    global _env_seen
+    with _lock:
+        if point is None:
+            _armed.clear()
+            # mark the current env value consumed so it does not re-arm
+            _env_seen = os.environ.get("MXNET_FAULT_SPEC", "")
+        else:
+            _armed.pop(point, None)
+
+
+def _nothing_armed():
+    """Lock-free fast path: with no point armed and no env spec set, the
+    hot-path ``should_fire`` calls must not serialize every reader/push
+    thread on ``_lock`` — a disarmed point stays effectively free."""
+    return not _armed and not os.environ.get("MXNET_FAULT_SPEC")
+
+
+def armed(point):
+    """True when ``point`` is armed (it may or may not fire on this hit)."""
+    if _nothing_armed():
+        return False
+    with _lock:
+        _sync_env()
+        return point in _armed
+
+
+def should_fire(point):
+    """Record one hit of ``point``; True when this hit is inside the armed
+    firing window.  The single call every instrumented site makes."""
+    if _nothing_armed():
+        return False
+    with _lock:
+        _sync_env()
+        st = _armed.get(point)
+        if st is None:
+            return False
+        st.hits += 1
+        if st.hits < st.at:
+            return False
+        return st.count < 0 or st.hits < st.at + st.count
+
+
+def hits(point):
+    """How many times ``point`` has been consulted while armed."""
+    with _lock:
+        st = _armed.get(point)
+        return 0 if st is None else st.hits
+
+
+def reset_counters():
+    """Zero the hit counters of all armed points (keep them armed)."""
+    with _lock:
+        for st in _armed.values():
+            st.hits = 0

@@ -24,7 +24,8 @@ from .base import MXNetError
 from .ndarray import NDArray, array
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "MNISTIter",
-           "CSVIter", "ResizeIter", "PrefetchingIter", "ImageRecordIter"]
+           "CSVIter", "ResizeIter", "PrefetchingIter", "ImageRecordIter",
+           "corrupt_skip_count", "reset_corrupt_skip_count"]
 
 
 class DataDesc:
@@ -688,8 +689,23 @@ class MultiProcessIter(DataIter):
     def __del__(self):
         try:
             self.close()
-        except Exception:
+        except Exception:  # noqa: broad-except — interpreter-shutdown GC
             pass
+
+
+def corrupt_skip_count():
+    """Process-wide count of corrupt records skipped by the data pipeline
+    under ``MXNET_IO_SKIP_CORRUPT=1`` (see docs/resilience.md).  Per-reader
+    counts live on ``MXRecordIO.num_skipped``."""
+    from . import recordio
+
+    return recordio.skipped_record_count()
+
+
+def reset_corrupt_skip_count():
+    from . import recordio
+
+    recordio.reset_skipped_record_count()
 
 
 def _batch_converter(mean, std, scale, ctx):

@@ -29,10 +29,19 @@ import os
 import pickle
 import time as _time
 
-from .base import MXNetError
+from . import faults as _faults
+from .base import MXNetError, atomic_write_bytes as _atomic_write_bytes
 from .ndarray import NDArray, zeros
+from .retry import RetryPolicy, retry_call
 
-__all__ = ["KVStore", "KVStoreDist", "create"]
+__all__ = ["KVStore", "KVStoreDist", "ConnectionLost", "create"]
+
+
+class ConnectionLost(MXNetError):
+    """The PS transport died under an RPC (peer FIN/RST, NIC loss, armed
+    ``kvstore.push.socket`` fault).  The server's per-key state survives a
+    worker-side transport loss, so ``KVStoreDist.reconnect()`` can rejoin
+    with the same rank and resume."""
 
 
 def _ctype_key_value(keys, vals):
@@ -138,8 +147,8 @@ class KVStore:
     def save_optimizer_states(self, fname):
         if self._updater is None:
             raise MXNetError("optimizer not initialized on kvstore")
-        with open(fname, "wb") as f:
-            f.write(self._updater.get_states())
+        states = self._updater.get_states()
+        _atomic_write_bytes(fname, states)
 
     def load_optimizer_states(self, fname):
         if self._updater is None:
@@ -161,13 +170,11 @@ class KVStoreDist(KVStore):
 
     def __init__(self, kv_type="dist_sync"):
         super().__init__(kv_type)
-        import socket as _socket
-
         from . import kvstore_server as ps
 
-        host = os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1")
-        port = int(os.environ.get("DMLC_PS_ROOT_PORT", "9090"))
         self._ps = ps
+        self._host = os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1")
+        self._port = int(os.environ.get("DMLC_PS_ROOT_PORT", "9090"))
         # multi-server sharding (reference ps-lite: N servers, big arrays
         # split by EncodeKey, kvstore_dist.h:40): server i at port+i;
         # server 0 doubles as the scheduler (ranks, barrier)
@@ -176,26 +183,26 @@ class KVStoreDist(KVStore):
         self._bigarray_bound = int(os.environ.get(
             "MXNET_KVSTORE_BIGARRAY_BOUND", "1000000"))
         self._socks = []
-        deadline = _time.time() + float(
-            os.environ.get("MXNET_KVSTORE_CONNECT_TIMEOUT", "120"))
-        def connect_all():
-            self._socks = []
-            for sid in range(self._num_servers):
-                # servers import jax before binding; retry with backoff
-                while True:
-                    try:
-                        self._socks.append(_socket.create_connection(
-                            (host, port + sid), timeout=300))
-                        break
-                    except OSError:
-                        if _time.time() > deadline:
-                            raise
-                        _time.sleep(0.2)
-            self._sock = self._socks[0]  # scheduler
-
-        connect_all()
+        self._sock = None
+        self._rank = None
         self._versions = {}
-        reg = {"cmd": "register", "role": "worker"}
+        # per-(sub)key count of this rank's acked pushes — the "round"
+        # field of a push.  Distinct from _versions (server's global
+        # version, which gates pulls): in sync mode the two coincide, but
+        # in async the version advances once per push from ANY rank, so
+        # only this counter lines up with the server's per-rank replay
+        # window (st.pushed[rank] / round_base)
+        self._push_seq = {}
+        # (sub)keys whose push RPC was acked before a later key in the
+        # same push() call lost the transport: their server-side round
+        # already counted, and their ack advanced self._push_seq past the
+        # server's replay window — so the documented recovery (reconnect()
+        # + re-push the same batch) must skip them client-side or their
+        # gradient lands twice.  Consumed only by the first push after
+        # reconnect() (_repush_window), so an application that abandons
+        # the failed batch instead cannot silently lose fresh gradients.
+        self._acked_in_failed_push = set()
+        self._repush_window = False
         worker_id = os.environ.get("DMLC_WORKER_ID")
         if worker_id is None and os.environ.get("DMLC_ROLE") == "worker":
             # under an MPI/slurm *launcher* every rank shares one env; the
@@ -207,44 +214,9 @@ class KVStoreDist(KVStore):
                 if var in os.environ:
                     worker_id = os.environ[var]
                     break
-        if worker_id is not None:
-            # announce identity so a restarted worker rejoins with its old
-            # rank (the reference's ps-lite is_recovery path)
-            reg["preferred_rank"] = int(worker_id)
-        # a loaded host can drop the just-accepted connection before the
-        # register reply (seen as a suite-level flake) — as a clean FIN
-        # (recv returns b'' -> MXNetError 'connection lost') or as an
-        # RST (ConnectionResetError/BrokenPipeError).  Retrying is only
-        # safe when the registration is idempotent server-side, i.e.
-        # when preferred_rank identifies this worker (the rejoin path);
-        # without an identity a processed-but-unacknowledged register
-        # would leak a ghost rank on retry, so that case still raises.
-        while True:
-            try:
-                reply = self._rpc(reg)
-                break
-            except (MXNetError, OSError) as e:
-                dropped = isinstance(e, OSError) \
-                    or "connection lost" in str(e)
-                if not dropped or "preferred_rank" not in reg \
-                        or _time.time() > deadline:
-                    raise
-                for s in self._socks:
-                    try:
-                        s.close()
-                    except OSError:
-                        pass
-                _time.sleep(0.3)
-                connect_all()
-        self._rank = reply["rank"]
-        self._num_workers = reply["num_workers"]
-        self.is_recovery = bool(reply.get("is_recovery", False))
-        self._update_on_kvstore = True
-        # command every server into the mode this type implies (reference
-        # kvstore.cc:32-35: sync unless the type carries _async)
-        for s in self._socks:
-            self._rpc({"cmd": "sync_mode", "value": "_async" not in kv_type},
-                      sock=s)
+        self._preferred_rank = int(worker_id) if worker_id is not None \
+            else None
+        self._connect_and_register()
         # TPU-native gradient plane: join the jax.distributed process
         # group so training steps run in-graph collectives across
         # processes (psum over the global mesh) instead of per-step PS
@@ -256,12 +228,153 @@ class KVStoreDist(KVStore):
 
             self.in_graph_sync = _dist.init_from_env(rank_hint=self._rank)
 
+    # -- transport --------------------------------------------------------
+    @staticmethod
+    def _connect_policy():
+        """Backoff/deadline for connect+register, shared by initial
+        connection and ``reconnect()``.  ``MXNET_KVSTORE_CONNECT_DEADLINE``
+        (seconds) bounds the whole sequence; the legacy
+        ``MXNET_KVSTORE_CONNECT_TIMEOUT`` spelling is honored as a
+        fallback."""
+        deadline = float(os.environ.get(
+            "MXNET_KVSTORE_CONNECT_DEADLINE",
+            os.environ.get("MXNET_KVSTORE_CONNECT_TIMEOUT", "120")))
+        return RetryPolicy(deadline=deadline, base_delay=0.2,
+                           max_delay=2.0, jitter=0.5)
+
+    def _close_socks(self):
+        for s in self._socks:
+            try:
+                s.close()
+            except OSError:
+                pass
+        self._socks = []
+        self._sock = None
+
+    def _connect_all(self, policy, start):
+        """(Re)open one socket per server; servers import jax before
+        binding, so each connect retries with backoff until the shared
+        deadline."""
+        import socket as _socket
+
+        self._close_socks()
+        socks = []
+        for sid in range(self._num_servers):
+            socks.append(retry_call(
+                lambda sid=sid: _socket.create_connection(
+                    (self._host, self._port + sid), timeout=300),
+                retry_on=(OSError,), policy=policy, start=start))
+        self._socks = socks
+        self._sock = socks[0]  # scheduler
+
+    def _reopen_sock(self, sid):
+        """Best-effort reopen of one server connection (retry hook).  A
+        failed connect leaves the old dead socket in place, so the next
+        RPC attempt fails fast and the caller's retry loop comes back
+        here until its deadline expires."""
+        import socket as _socket
+
+        try:
+            self._socks[sid].close()
+        except OSError:
+            pass
+        try:
+            self._socks[sid] = _socket.create_connection(
+                (self._host, self._port + sid), timeout=300)
+        except OSError:
+            return
+        if sid == 0:
+            self._sock = self._socks[0]
+
+    def _connect_and_register(self, rejoin=False):
+        policy = self._connect_policy()
+        start = _time.monotonic()
+        self._connect_all(policy, start)
+        # rejoin=True marks a same-process reconnect(): per-key round
+        # numbering (self._push_seq) is continuous, so the server may
+        # treat a low-numbered re-push as a replay and dedup it; a fresh
+        # process restarts numbering at 0 and must not be deduped
+        reg = {"cmd": "register", "role": "worker", "rejoin": rejoin}
+        if self._preferred_rank is not None:
+            # announce identity so a restarted worker rejoins with its old
+            # rank (the reference's ps-lite is_recovery path)
+            reg["preferred_rank"] = self._preferred_rank
+
+        # a loaded host can drop the just-accepted connection before the
+        # register reply (seen as a suite-level flake) — as a clean FIN
+        # (ConnectionLost) or an RST (OSError).  Retrying is only safe
+        # when the registration is idempotent server-side, i.e. when
+        # preferred_rank identifies this worker (the rejoin path); without
+        # an identity a processed-but-unacknowledged register would leak a
+        # ghost rank on retry, so that case still raises.
+        def _register_retryable(e):
+            dropped = isinstance(e, (ConnectionLost, OSError))
+            return dropped and "preferred_rank" in reg
+
+        reply = retry_call(
+            lambda: self._rpc(reg),
+            retry_on=(MXNetError, OSError),
+            retry_if=_register_retryable,
+            on_retry=lambda e, n: self._connect_all(policy, start),
+            policy=policy, start=start)
+        self._rank = reply["rank"]
+        self._num_workers = reply["num_workers"]
+        self.is_recovery = bool(reply.get("is_recovery", False))
+        self._update_on_kvstore = True
+        # announce the scheduler-assigned rank to every shard server: each
+        # server keeps its own live/round_base bookkeeping, so without
+        # this a restarted worker's fresh round numbering would be misread
+        # as replays on servers 1..N-1 (its pushes silently dropped), and
+        # their dead-peer detection would never know the rank existed.
+        # preferred_rank makes the announce idempotent, so a dropped
+        # connection mid-announce is safely retried on a fresh socket.
+        ann = {"cmd": "register", "role": "worker", "rejoin": rejoin,
+               "preferred_rank": self._rank}
+        for sid in range(1, len(self._socks)):
+            retry_call(
+                lambda sid=sid: self._rpc(ann, sock=self._socks[sid]),
+                retry_on=(MXNetError, OSError),
+                # a dropped connection is retryable; a server error reply
+                # (e.g. a rank collision) is permanent — fail fast rather
+                # than burning the whole connect deadline on it
+                retry_if=lambda e: isinstance(e, (ConnectionLost, OSError)),
+                on_retry=lambda e, n, sid=sid: self._reopen_sock(sid),
+                policy=policy, start=start)
+        # command every server into the mode this type implies (reference
+        # kvstore.cc:32-35: sync unless the type carries _async)
+        for s in self._socks:
+            self._rpc({"cmd": "sync_mode",
+                       "value": "_async" not in self._type}, sock=s)
+
+    def reconnect(self):
+        """Rebuild the transport after a :class:`ConnectionLost`.
+
+        Re-registers with the current rank (the server's is_recovery
+        path), so per-key versions and server-side optimizer state are
+        resumed, not reset.  Bounded by the same connect deadline as the
+        initial connection."""
+        if self._rank is not None:
+            self._preferred_rank = self._rank
+        self._connect_and_register(rejoin=True)
+        # the next push() is the documented re-push of the batch that lost
+        # its transport: let it skip the keys that were already acked
+        self._repush_window = True
+
     def _rpc(self, msg, sock=None):
         sock = self._sock if sock is None else sock
-        self._ps.send_msg(sock, msg)
-        reply = self._ps.recv_msg(sock)
+        try:
+            self._ps.send_msg(sock, msg)
+            reply = self._ps.recv_msg(sock)
+        except OSError as e:
+            raise ConnectionLost(
+                "kvstore transport failure during %r: %s "
+                "(reconnect() rejoins with the same rank)"
+                % (msg.get("cmd"), e))
         if reply is None:
-            raise MXNetError("kvstore server connection lost")
+            raise ConnectionLost(
+                "kvstore server connection lost during %r "
+                "(reconnect() rejoins with the same rank)"
+                % (msg.get("cmd"),))
         if "error" in reply:
             raise MXNetError(reply["error"])
         return reply
@@ -309,24 +422,55 @@ class KVStoreDist(KVStore):
         self.barrier()
 
     def push(self, key, value, priority=0):
+        """Push gradients; on :class:`ConnectionLost` the documented
+        recovery is ``reconnect()`` then re-calling ``push`` with the
+        SAME keys/values — keys the failed call already got acked are
+        skipped client-side (their round counted server-side), and unacked
+        keys re-send their original round so the server's replay guard
+        dedups a push whose reply (not the push itself) was lost."""
+        if _faults.should_fire("kvstore.push.socket"):
+            # sever the transport before the send — the observable state
+            # of a peer/NIC dying mid-push.  The next RPC fails with a
+            # clean ConnectionLost; the server never saw the push, so a
+            # reconnect()+re-push lands in the correct sync round.
+            for s in self._socks:
+                try:
+                    s.close()
+                except OSError:
+                    pass
         keys, vals = _ctype_key_value(key, value)
+        already_acked = self._acked_in_failed_push \
+            if self._repush_window else set()
+        self._repush_window = False
+        self._acked_in_failed_push = set()
+        acked = set()
+
+        def _push_one(k, value, sock):
+            if k in already_acked:
+                acked.add(k)  # counted in the call that lost its transport
+                return
+            try:
+                reply = self._rpc({"cmd": "push", "key": k, "value": value,
+                                   "rank": self._rank,
+                                   "round": self._push_seq.get(k, 0)},
+                                  sock=sock)
+            except (ConnectionLost, OSError):
+                self._acked_in_failed_push = acked
+                raise
+            self._push_seq[k] = self._push_seq.get(k, 0) + 1
+            self._versions[k] = max(self._versions.get(k, 0),
+                                    reply["version"])
+            acked.add(k)
+
         for k, vlist in zip(keys, vals):
             merged = _merge_devices(vlist).asnumpy()
             shards = self._shards(k, merged.size)
             if shards is None:
-                reply = self._rpc({"cmd": "push", "key": k,
-                                   "value": merged, "rank": self._rank},
-                                  sock=self._socks[self._server_of(k)])
-                self._versions[k] = max(self._versions.get(k, 0),
-                                        reply["version"])
+                _push_one(k, merged, self._socks[self._server_of(k)])
                 continue
             flat = merged.reshape(-1)
             for sk, sid, sl in shards:
-                reply = self._rpc({"cmd": "push", "key": sk,
-                                   "value": flat[sl], "rank": self._rank},
-                                  sock=self._socks[sid])
-                self._versions[sk] = max(self._versions.get(sk, 0),
-                                         reply["version"])
+                _push_one(sk, flat[sl], self._socks[sid])
 
     def pull(self, key, out=None, priority=0):
         import numpy as _np
@@ -377,15 +521,21 @@ class KVStoreDist(KVStore):
     def barrier(self):
         self._rpc({"cmd": "barrier", "rank": self._rank})
 
+    def heartbeat(self):
+        """Liveness ping to the scheduler; returns its cluster view
+        (``{"live": [ranks...], "num_workers": n}``) and refreshes this
+        rank's last-seen time for dead-peer diagnosis."""
+        return self._rpc({"cmd": "heartbeat", "rank": self._rank})
+
     def send_command_to_servers(self, head, body):
         self._rpc({"cmd": "user_command", "head": head, "body": body})
 
     def save_optimizer_states(self, fname):
         blobs = [self._rpc({"cmd": "get_updater_states"},
                            sock=s)["states"] for s in self._socks]
-        with open(fname, "wb") as f:
-            f.write(blobs[0] if len(blobs) == 1 else
-                    b"MXPSMULTI" + pickle.dumps(blobs))
+        payload = blobs[0] if len(blobs) == 1 else \
+            b"MXPSMULTI" + pickle.dumps(blobs)
+        _atomic_write_bytes(fname, payload)
 
     def load_optimizer_states(self, fname):
         with open(fname, "rb") as f:
@@ -418,8 +568,8 @@ class KVStoreDist(KVStore):
         try:
             for s in getattr(self, "_socks", []):
                 s.close()
-        except Exception:
-            pass
+        except (OSError, AttributeError, TypeError):
+            pass  # interpreter-shutdown cleanup: sockets may be half-gone
 
 
 def create(name="local"):

@@ -38,6 +38,7 @@ import socketserver
 import struct
 import sys
 import threading
+import time
 from collections import defaultdict
 
 import numpy as np
@@ -84,6 +85,16 @@ class _Disconnected(Exception):
     """Raised inside a handler whose peer socket died mid-wait."""
 
 
+class _DeadPeer(Exception):
+    """A *different* worker's rank has been dead past the heartbeat
+    deadline while this handler was blocked waiting on it; carries the
+    human-readable diagnosis naming the lost rank."""
+
+    def __init__(self, message):
+        super().__init__(message)
+        self.message = message
+
+
 def _sock_dead(sock):
     """Non-blocking closed-peer probe (MSG_PEEK)."""
     try:
@@ -117,20 +128,24 @@ def recv_msg(sock):
 
 
 class _KeyState:
-    __slots__ = ("value", "version", "rounds", "pushed")
+    __slots__ = ("value", "version", "rounds", "pushed", "round_base")
 
     def __init__(self, value):
         self.value = value
         self.version = 0
         self.rounds = defaultdict(lambda: [None, 0])  # round -> [sum, count]
         self.pushed = defaultdict(int)                # rank -> push count
+        # rank -> pushed count when the rank's current incarnation
+        # registered; client rounds below it predate this incarnation and
+        # must not be mistaken for replays (see _push dedup)
+        self.round_base = defaultdict(int)
 
 
 class KVStoreServer:
     """Threaded PS: one handler thread per connection."""
 
     def __init__(self, num_workers, sync_mode=True, host="127.0.0.1",
-                 port=0):
+                 port=0, heartbeat_deadline=None):
         self.num_workers = num_workers
         self.sync_mode = sync_mode
         self.keys = {}
@@ -139,6 +154,17 @@ class KVStoreServer:
         self.next_rank = 0
         self.registered = set()   # ranks ever assigned (rejoin detection)
         self.live = {}            # rank -> connection currently holding it
+        self.dead_since = {}      # rank -> monotonic time its conn died
+        self.last_seen = {}       # rank -> monotonic time of last message
+        # dead-peer detection: a blocked sync wait (barrier, versioned
+        # pull) whose missing peer has been disconnected longer than this
+        # raises a clean error naming the lost rank instead of hanging
+        # forever (TF-paper-style fail-fast so the job can restart from a
+        # checkpoint)
+        if heartbeat_deadline is None:
+            heartbeat_deadline = float(os.environ.get(
+                "MXNET_KVSTORE_HEARTBEAT_DEADLINE", "120"))
+        self.heartbeat_deadline = heartbeat_deadline
         self.barrier_waiters = set()  # ranks arrived at the current barrier
         self.barrier_gen = 0
         self.stopped = threading.Event()
@@ -177,6 +203,7 @@ class KVStoreServer:
             rank = getattr(conn, "rank", None)
             if rank is not None and self.live.get(rank) is conn:
                 del self.live[rank]
+                self.dead_since[rank] = time.monotonic()
                 self.barrier_waiters.discard(rank)
                 self.lock.notify_all()
 
@@ -212,8 +239,26 @@ class KVStoreServer:
                 if conn is not None:
                     conn.rank = rank
                     self.live[rank] = conn
+                self.dead_since.pop(rank, None)
+                self.last_seen[rank] = time.monotonic()
+                if not msg.get("rejoin"):
+                    # a fresh worker process (not a same-process
+                    # reconnect()) restarts its per-key round numbering
+                    # at 0: remember the current pushed counts so its low
+                    # rounds are not misread as replays
+                    for st in self.keys.values():
+                        st.round_base[rank] = st.pushed[rank]
             return {"rank": rank, "num_workers": self.num_workers,
                     "is_recovery": recovery}
+        if cmd == "heartbeat":
+            # liveness ping: refreshes last_seen and reports the cluster
+            # view so a worker can see who the server thinks is alive
+            with self.lock:
+                rank = msg.get("rank", getattr(conn, "rank", None))
+                if rank is not None:
+                    self.last_seen[rank] = time.monotonic()
+                return {"live": sorted(self.live),
+                        "num_workers": self.num_workers}
         if cmd == "init":
             with self.lock:
                 if msg["key"] not in self.keys:
@@ -221,7 +266,8 @@ class KVStoreServer:
                         np.array(msg["value"], copy=True))
                 return {"version": self.keys[msg["key"]].version}
         if cmd == "push":
-            return self._push(msg["key"], msg["value"], msg["rank"])
+            return self._push(msg["key"], msg["value"], msg["rank"],
+                              msg.get("round"))
         if cmd == "pull":
             return self._pull(msg["key"], msg.get("version", 0), conn)
         if cmd == "set_optimizer":
@@ -272,18 +318,36 @@ class KVStoreServer:
         else:
             st.value = np.array(merged, copy=True)
 
-    def _push(self, key, value, rank):
+    def _push(self, key, value, rank, client_round=None):
         value = np.asarray(value)
         with self.lock:
             st = self.keys.get(key)
             if st is None:
                 return {"error": "key %r not initialized" % key}
             if not self.sync_mode:
+                rnd = st.pushed[rank]
+                if client_round is not None \
+                        and st.round_base[rank] <= client_round < rnd:
+                    # replay (reply lost, worker re-pushed after
+                    # reconnect()): already applied — ack, don't take a
+                    # second optimizer step for the same gradient
+                    return {"version": st.version}
+                st.pushed[rank] += 1
                 self._apply(st, key, value)
                 st.version += 1
                 self.lock.notify_all()
                 return {"version": st.version}
             rnd = st.pushed[rank]
+            if client_round is not None \
+                    and st.round_base[rank] <= client_round < rnd:
+                # replay of an already-counted push: the reply was lost
+                # mid-transport and the worker re-pushed after
+                # reconnect().  Counting it again would shift this rank's
+                # contributions one round forward forever, so ack with
+                # the original round's reply instead.  (Rounds below the
+                # incarnation base are a restarted process's fresh
+                # numbering, not replays — those take the normal path.)
+                return {"version": client_round + 1}
             st.pushed[rank] += 1
             slot = st.rounds[rnd]
             slot[0] = value if slot[0] is None else slot[0] + value
@@ -296,29 +360,70 @@ class KVStoreServer:
                 self.lock.notify_all()
             return {"version": rnd + 1}
 
-    def _wait_interruptible(self, conn, cond):
+    def _check_dead_peers(self, wait_started):
+        """Raise _DeadPeer (lock held) when a sync wait is blocked on a
+        rank whose connection has been gone past the heartbeat deadline —
+        or when, after the deadline, some ranks never registered at all."""
+        now = time.monotonic()
+        for rank in sorted(self.dead_since):
+            dead_for = now - self.dead_since[rank]
+            if dead_for > self.heartbeat_deadline:
+                seen = self.last_seen.get(rank)
+                seen_txt = "" if seen is None \
+                    else ", last message %.1fs ago" % (now - seen)
+                raise _DeadPeer(
+                    "worker rank %d lost: disconnected %.1fs ago%s "
+                    "(> heartbeat deadline %.0fs)"
+                    % (rank, dead_for, seen_txt, self.heartbeat_deadline))
+        # `registered` is empty only before ANY worker announced itself
+        # (workers register on the scheduler and announce their rank to
+        # every shard server), and an empty set says nothing about worker
+        # liveness — so the never-registered check must not fire then
+        if self.registered \
+                and len(self.registered) < self.num_workers \
+                and now - wait_started > self.heartbeat_deadline:
+            raise _DeadPeer(
+                "only %d of %d workers ever registered within the "
+                "heartbeat deadline (%.0fs); registered ranks: %s"
+                % (len(self.registered), self.num_workers,
+                   self.heartbeat_deadline, sorted(self.registered)))
+
+    def _wait_interruptible(self, conn, cond, watch_peers=False):
         """Condition-wait (lock held) that notices a dead peer: a blocked
         handler thread must release its rank, or the worker's restarted
-        incarnation is refused as a rank collision."""
+        incarnation is refused as a rank collision.  With ``watch_peers``
+        the wait also fails fast — _DeadPeer naming the lost rank — when
+        a rank it depends on has been dead past the heartbeat deadline."""
+        started = time.monotonic()
         while not cond():
             self.lock.wait(timeout=1.0)
             if cond():
                 return
             if conn is not None and _sock_dead(conn.request):
                 raise _Disconnected()
+            if watch_peers:
+                self._check_dead_peers(started)
 
     def _pull(self, key, version, conn=None):
         with self.lock:
             st = self.keys.get(key)
             if st is None:
                 return {"error": "key %r not initialized" % key}
-            self._wait_interruptible(conn, lambda: st.version >= version)
+            try:
+                self._wait_interruptible(
+                    conn, lambda: st.version >= version, watch_peers=True)
+            except _DeadPeer as e:
+                # a sync round can never complete without the lost rank's
+                # push — fail the pull with the diagnosis, don't hang
+                return {"error": "pull(%r) abandoned: %s"
+                                 % (key, e.message)}
             return {"value": st.value, "version": st.version}
 
     def _barrier(self, rank, conn_rank, conn=None):
         """Rank-tracked barrier: a dead worker's contribution is withdrawn
         by on_disconnect, so a restart cannot release a generation early
-        or leave it off by one."""
+        or leave it off by one.  A barrier blocked on a rank that stays
+        dead past the heartbeat deadline fails with an error naming it."""
         with self.lock:
             gen = self.barrier_gen
             r = rank if rank is not None else conn_rank
@@ -330,10 +435,14 @@ class KVStoreServer:
             else:
                 try:
                     self._wait_interruptible(
-                        conn, lambda: self.barrier_gen != gen)
+                        conn, lambda: self.barrier_gen != gen,
+                        watch_peers=True)
                 except _Disconnected:
                     self.barrier_waiters.discard(r)
                     raise
+                except _DeadPeer as e:
+                    self.barrier_waiters.discard(r)
+                    return {"error": "barrier abandoned: %s" % e.message}
             return {}
 
     # -- lifecycle ---------------------------------------------------------

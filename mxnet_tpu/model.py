@@ -9,31 +9,42 @@ helper trio used by Module (``_create_kvstore`` :40,
 
 from __future__ import annotations
 
+import glob as _glob
+import json
 import logging
+import os
+import re as _re
 
 import numpy as np
 
 from . import io as mxio
 from . import ndarray as nd
 from . import symbol as sym
-from .base import MXNetError
+from .base import (MXNetError, atomic_write as _atomic_write,
+                   atomic_write_bytes as _atomic_write_bytes)
 from .context import cpu
 from .initializer import Uniform
 from .kvstore import KVStore, create as _create_kv
 from .ndarray import NDArray
 
 __all__ = ["BatchEndParam", "save_checkpoint", "load_checkpoint",
-           "FeedForward"]
+           "checkpoint_manifest", "list_checkpoints",
+           "load_latest_checkpoint", "FeedForward"]
 
 
 class BatchEndParam:
-    """reference model.py BatchEndParams namedtuple"""
+    """reference model.py BatchEndParams namedtuple, extended with the
+    NaN-guard observation fields (``nan_detected``/``nan_action``) so
+    callbacks and metrics can see when a batch tripped the policy."""
 
-    def __init__(self, epoch, nbatch, eval_metric, locals=None):
+    def __init__(self, epoch, nbatch, eval_metric, locals=None,
+                 nan_detected=False, nan_action=None):
         self.epoch = epoch
         self.nbatch = nbatch
         self.eval_metric = eval_metric
         self.locals = locals
+        self.nan_detected = nan_detected
+        self.nan_action = nan_action
 
 
 def _create_kvstore(kvstore, num_device, arg_params):
@@ -98,14 +109,81 @@ def _update_params(param_arrays, grad_arrays, updater, num_device,
             updater(index * num_device + k, g, w)
 
 
+def _manifest_path(prefix):
+    return "%s-manifest.json" % prefix
+
+
+def checkpoint_manifest(prefix):
+    """Read ``prefix-manifest.json`` -> dict, or None when absent/corrupt.
+
+    Format (version 1)::
+
+        {"format": 1, "prefix": "<basename>", "epochs": [1, 2, 3],
+         "latest": 3}
+
+    ``epochs`` lists every epoch whose params file completed its atomic
+    rename; ``latest`` is ``max(epochs)``.  The manifest itself is written
+    atomically, so it never names an epoch whose file was still in
+    flight."""
+    try:
+        with open(_manifest_path(prefix)) as f:
+            m = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if not isinstance(m, dict) or not isinstance(m.get("epochs"), list) \
+            or not all(isinstance(e, int) for e in m["epochs"]):
+        return None
+    return m
+
+
+def _manifest_add_epoch(prefix, epoch):
+    m = checkpoint_manifest(prefix) or {
+        "format": 1, "prefix": os.path.basename(prefix), "epochs": []}
+    epochs = sorted(set(int(e) for e in m["epochs"]) | {int(epoch)})
+    m["epochs"] = epochs
+    m["latest"] = epochs[-1]
+    blob = json.dumps(m, indent=2, sort_keys=True)
+    _atomic_write_bytes(_manifest_path(prefix), blob, mode="w")
+
+
+def list_checkpoints(prefix):
+    """Epochs with an on-disk params file, newest first.
+
+    The manifest is the primary source; files present on disk but missing
+    from it (older framework versions, hand-copied checkpoints) are merged
+    in, so resume never ignores a checkpoint that actually exists."""
+    found = set()
+    m = checkpoint_manifest(prefix)
+    if m is not None:
+        found.update(int(e) for e in m["epochs"])
+    # epochs >= 10000 render as 5+ digits under %04d, and the prefix may
+    # contain glob metacharacters — escape it and let the regex decide
+    pat = _re.compile(_re.escape(os.path.basename(prefix)) +
+                      r"-(\d{4,})\.params$")
+    for path in _glob.glob("%s-*.params" % _glob.escape(prefix)):
+        mt = pat.search(os.path.basename(path))
+        if mt:
+            found.add(int(mt.group(1)))
+    return sorted((e for e in found
+                   if os.path.exists("%s-%04d.params" % (prefix, e))),
+                  reverse=True)
+
+
 def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params):
-    """reference ``model.py:319`` — prefix-symbol.json + prefix-%04d.params."""
+    """reference ``model.py:319`` — prefix-symbol.json + prefix-%04d.params.
+
+    Both files are written crash-safely (temp file + fsync + atomic
+    rename), and ``prefix-manifest.json`` records the epoch only after the
+    params rename completed — a host dying mid-save leaves the previous
+    checkpoint fully intact and the manifest pointing at it."""
     if symbol is not None:
-        symbol.save("%s-symbol.json" % prefix)
+        _atomic_write("%s-symbol.json" % prefix, symbol.save)
     save_dict = {("arg:%s" % k): v for k, v in arg_params.items()}
     save_dict.update({("aux:%s" % k): v for k, v in aux_params.items()})
     param_name = "%s-%04d.params" % (prefix, epoch)
-    nd.save(param_name, save_dict)
+    _atomic_write(param_name, lambda tmp: nd.save(tmp, save_dict),
+                  fault_point="checkpoint.write")
+    _manifest_add_epoch(prefix, epoch)
     logging.info("Saved checkpoint to \"%s\"", param_name)
 
 
@@ -122,6 +200,26 @@ def load_checkpoint(prefix, epoch):
         if tp == "aux":
             aux_params[name] = v
     return (symbol, arg_params, aux_params)
+
+
+def load_latest_checkpoint(prefix, logger=logging):
+    """Newest checkpoint that passes a full load-verify pass.
+
+    Walks ``list_checkpoints`` newest-first; a truncated or otherwise
+    corrupt params file is skipped with a warning (never a crash) and the
+    next-older epoch is tried.  Returns ``(epoch, symbol, arg_params,
+    aux_params)`` or None when no loadable checkpoint exists — the
+    ``Module.fit(resume="auto")`` discovery pass."""
+    for epoch in list_checkpoints(prefix):
+        try:
+            symbol, arg_params, aux_params = load_checkpoint(prefix, epoch)
+        except (MXNetError, OSError, ValueError) as e:
+            logger.warning(
+                "checkpoint %s-%04d.params failed verification (%s); "
+                "falling back to the previous epoch", prefix, epoch, e)
+            continue
+        return (epoch, symbol, arg_params, aux_params)
+    return None
 
 
 class FeedForward:

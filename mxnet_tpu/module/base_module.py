@@ -10,16 +10,20 @@ SURVEY §3.1.
 from __future__ import annotations
 
 import logging
+import os
 import time
 
 import numpy as np
 
+from .. import faults as _faults
 from .. import metric as _metric
 from ..base import MXNetError
 from ..model import BatchEndParam
 from ..initializer import Uniform
 
 __all__ = ["BaseModule"]
+
+_NAN_POLICIES = ("raise", "skip_batch", "rollback")
 
 
 def _as_metric(m):
@@ -125,9 +129,70 @@ class BaseModule:
             initializer=Uniform(0.01), arg_params=None, aux_params=None,
             allow_missing=False, force_rebind=False, force_init=False,
             begin_epoch=0, num_epoch=None, validation_metric=None,
-            monitor=None):
-        """reference ``base_module.py:369`` — THE training loop."""
+            monitor=None, checkpoint_prefix=None, checkpoint_period=1,
+            resume=None, nan_policy=None):
+        """reference ``base_module.py:369`` — THE training loop.
+
+        Resilience extensions (docs/resilience.md):
+
+        ``checkpoint_prefix``
+            When set, an atomic checkpoint (params [+ optimizer states] +
+            manifest) is written every ``checkpoint_period`` epochs and at
+            the final epoch.
+        ``resume="auto"``
+            Restart from the newest checkpoint under ``checkpoint_prefix``
+            that passes a load-verify pass; truncated/corrupt files are
+            skipped with a warning.  ``begin_epoch``/``arg_params`` are
+            taken from the recovered checkpoint.
+        ``nan_policy``
+            Per-batch NaN/Inf guard on loss and gradients (default: the
+            ``MXNET_NAN_POLICY`` env var; None disables).  ``"raise"``
+            aborts with MXNetError, ``"skip_batch"`` drops the batch's
+            update, ``"rollback"`` restores the last valid checkpoint and
+            drops the batch.  Tripped batches are visible to callbacks via
+            ``BatchEndParam.nan_detected``/``nan_action``.
+        """
         assert num_epoch is not None, "please specify number of epochs"
+
+        if nan_policy is None:
+            nan_policy = os.environ.get("MXNET_NAN_POLICY") or None
+        if nan_policy is not None and nan_policy not in _NAN_POLICIES:
+            raise MXNetError("nan_policy must be one of %s, got %r"
+                             % (_NAN_POLICIES, nan_policy))
+        if nan_policy == "rollback" and checkpoint_prefix is None:
+            raise MXNetError(
+                "nan_policy='rollback' needs checkpoint_prefix to know "
+                "what to roll back to")
+        if resume not in (None, "auto"):
+            raise MXNetError("resume must be None or 'auto', got %r"
+                             % (resume,))
+        if checkpoint_prefix is not None and checkpoint_period < 1:
+            raise MXNetError("checkpoint_period must be >= 1, got %r"
+                             % (checkpoint_period,))
+        resume_states = None
+        if resume == "auto":
+            if checkpoint_prefix is None:
+                raise MXNetError("resume='auto' needs checkpoint_prefix")
+            from ..model import load_latest_checkpoint
+
+            found = load_latest_checkpoint(checkpoint_prefix,
+                                           logger=self.logger)
+            if found is not None:
+                ck_epoch, _ck_sym, ck_arg, ck_aux = found
+                begin_epoch = ck_epoch
+                arg_params, aux_params = ck_arg, ck_aux
+                force_init = True
+                states = "%s-%04d.states" % (checkpoint_prefix, ck_epoch)
+                if os.path.exists(states) \
+                        and hasattr(self, "load_optimizer_states"):
+                    resume_states = states
+                self.logger.info(
+                    "resume='auto': restarting from checkpoint epoch %d "
+                    "(%s)", ck_epoch, checkpoint_prefix)
+            else:
+                self.logger.info(
+                    "resume='auto': no loadable checkpoint under %r; "
+                    "starting from scratch", checkpoint_prefix)
 
         self.bind(data_shapes=train_data.provide_data,
                   label_shapes=train_data.provide_label, for_training=True,
@@ -139,20 +204,35 @@ class BaseModule:
                          force_init=force_init)
         self.init_optimizer(kvstore=kvstore, optimizer=optimizer,
                             optimizer_params=optimizer_params)
+        if resume_states is not None:
+            self.load_optimizer_states(resume_states)
+        if nan_policy in ("skip_batch", "rollback"):
+            kv = getattr(self, "_kvstore", None)
+            if kv is not None and getattr(kv, "num_workers", 1) > 1 \
+                    and not getattr(kv, "in_graph_sync", False):
+                # the NaN check sees only this rank's loss/grads, and
+                # skipping update() skips this rank's PS push — the other
+                # ranks still push, so sync rounds shift one step out of
+                # phase (and 'rollback' restores params on one rank only)
+                self.logger.warning(
+                    "nan_policy=%r is rank-local: skipping a batch in "
+                    "multi-worker sync training desynchronizes parameter-"
+                    "server rounds across ranks; prefer nan_policy='raise' "
+                    "with resume='auto' for distributed runs", nan_policy)
         if validation_metric is None:
             validation_metric = eval_metric
         eval_metric = _as_metric(eval_metric)
-
-        import os
 
         # MXNET_BULK_TRAIN_STEPS=K dispatches K steps per XLA program
         # (Module.run_bulk lax.scan) — the training-loop spelling of the
         # reference's MXNET_EXEC_BULK_EXEC_TRAIN op bulking.  Metric
         # updates and batch callbacks still fire per batch (from the
         # scanned outputs); monitors need per-step observation, so a
-        # monitor forces the classic path.
+        # monitor forces the classic path — as do the per-batch NaN guard
+        # and the fit.batch fault point, which must see every step.
         bulk_k = max(1, int(os.environ.get("MXNET_BULK_TRAIN_STEPS", "1")))
         use_bulk = bulk_k > 1 and monitor is None \
+            and nan_policy is None and not _faults.armed("fit.batch") \
             and hasattr(self, "run_bulk")
         if use_bulk and hasattr(self, "_full_step_eligible") \
                 and not self._full_step_eligible():
@@ -194,14 +274,43 @@ class BaseModule:
                     if monitor is not None:
                         monitor.tic()
                     self.forward_backward(data_batch)
-                    self.update()
-                    self.update_metric(eval_metric, data_batch.label)
+                    if _faults.should_fire("fit.batch"):
+                        self.logger.warning(
+                            "fault 'fit.batch': poisoning gradients with "
+                            "NaN at epoch %d batch %d", epoch, nbatch)
+                        self._poison_gradients_nan()
+                    nan_detected = False
+                    nan_action = None
+                    if nan_policy is not None \
+                            and self._batch_has_nonfinite():
+                        nan_detected = True
+                        nan_action = nan_policy
+                        if nan_policy == "raise":
+                            raise MXNetError(
+                                "NaN/Inf detected in loss/gradients at "
+                                "epoch %d batch %d (nan_policy='raise')"
+                                % (epoch, nbatch))
+                        if nan_policy == "rollback":
+                            self.logger.warning(
+                                "NaN/Inf at epoch %d batch %d: rolling "
+                                "back to the last valid checkpoint",
+                                epoch, nbatch)
+                            self._rollback_to_checkpoint(checkpoint_prefix)
+                        else:
+                            self.logger.warning(
+                                "NaN/Inf at epoch %d batch %d: skipping "
+                                "batch", epoch, nbatch)
+                    else:
+                        self.update()
+                        self.update_metric(eval_metric, data_batch.label)
                     if monitor is not None:
                         monitor.toc_print()
                     if batch_end_callback is not None:
                         batch_end_param = BatchEndParam(
                             epoch=epoch, nbatch=nbatch,
-                            eval_metric=eval_metric, locals=locals())
+                            eval_metric=eval_metric, locals=locals(),
+                            nan_detected=nan_detected,
+                            nan_action=nan_action)
                         for callback in _as_list(batch_end_callback):
                             callback(batch_end_param)
             for name, val in eval_metric.get_name_value():
@@ -211,6 +320,11 @@ class BaseModule:
 
             arg_params_, aux_params_ = self.get_params()
             self.set_params(arg_params_, aux_params_)
+            if checkpoint_prefix is not None and \
+                    ((epoch + 1) % checkpoint_period == 0
+                     or epoch + 1 == num_epoch):
+                self._save_fit_checkpoint(checkpoint_prefix, epoch + 1,
+                                          arg_params_, aux_params_)
             if epoch_end_callback is not None:
                 for callback in _as_list(epoch_end_callback):
                     callback(epoch, self.symbol, arg_params_, aux_params_)
@@ -223,6 +337,85 @@ class BaseModule:
                     self.logger.info("Epoch[%d] Validation-%s=%f",
                                      epoch, name, val)
             train_data.reset()
+
+    # -- resilience helpers (docs/resilience.md) --------------------------
+    def _guard_exec(self):
+        """The executor whose gradients the NaN guard inspects: this
+        module's, or the active bucket's for BucketingModule."""
+        ex = getattr(self, "_exec", None)
+        if ex is None:
+            ex = getattr(getattr(self, "_curr_module", None), "_exec", None)
+        return ex
+
+    def _batch_has_nonfinite(self):
+        """True when any output (loss) or parameter gradient of the batch
+        just computed contains NaN/Inf.  Pulls to host — pair with a
+        policy; the check is the price of the guard."""
+        arrays = list(self.get_outputs())
+        ex = self._guard_exec()
+        if ex is not None:
+            arrays += [g for g in ex.grad_dict.values() if g is not None]
+        for a in arrays:
+            v = a.asnumpy()
+            if v.dtype.kind == "f" and not np.isfinite(v).all():
+                return True
+        return False
+
+    def _poison_gradients_nan(self):
+        """fault 'fit.batch': overwrite the first parameter gradient with
+        NaN — the observable state of a corrupt reduction/overflow."""
+        mat = getattr(self, "_materialize_pending", None)
+        if mat is not None:
+            mat()  # a staged fused step would recompute (unpoison) grads
+        ex = self._guard_exec()
+        if ex is None:
+            raise MXNetError("fault 'fit.batch' armed but this module "
+                             "exposes no gradient arrays")
+        for g in ex.grad_dict.values():
+            if g is not None:
+                g[:] = np.nan
+                return
+        raise MXNetError("fault 'fit.batch' armed but no gradients bound")
+
+    def _rollback_to_checkpoint(self, prefix):
+        """nan_policy='rollback': restore params from the newest valid
+        checkpoint under ``prefix``."""
+        from ..model import load_latest_checkpoint
+
+        found = load_latest_checkpoint(prefix, logger=self.logger)
+        if found is None:
+            raise MXNetError(
+                "nan_policy='rollback': no valid checkpoint under prefix "
+                "%r to roll back to" % prefix)
+        epoch, _sym, arg_params, aux_params = found
+        self.set_params(arg_params, aux_params, force_init=True)
+        # restore optimizer state too: post-divergence moments (inflated
+        # by the huge pre-NaN gradients) applied to rolled-back weights
+        # would immediately re-diverge
+        states = "%s-%04d.states" % (prefix, epoch)
+        if os.path.exists(states) and hasattr(self,
+                                              "load_optimizer_states"):
+            self.load_optimizer_states(states)
+        else:
+            self.logger.warning(
+                "rollback: no optimizer state snapshot (%s); keeping "
+                "current optimizer moments with epoch-%d parameters",
+                states, epoch)
+        self.logger.info("rolled back parameters to checkpoint epoch %d",
+                         epoch)
+        return epoch
+
+    def _save_fit_checkpoint(self, prefix, epoch, arg_params, aux_params):
+        """Per-epoch atomic checkpoint from inside fit (params + optimizer
+        states when the module supports them + manifest)."""
+        if hasattr(self, "save_checkpoint"):
+            self.save_checkpoint(
+                prefix, epoch,
+                save_optimizer_states=self.optimizer_initialized)
+        else:
+            from ..model import save_checkpoint as _save_ckpt
+
+            _save_ckpt(prefix, epoch, self.symbol, arg_params, aux_params)
 
     # -- properties / abstract --------------------------------------------
     @property

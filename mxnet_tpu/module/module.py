@@ -734,12 +734,16 @@ class Module(BaseModule):
         except Exception:
             return None
         try:
-            return lowered.compile().cost_analysis()
+            cost = lowered.compile().cost_analysis()
         except Exception:
             try:
-                return lowered.cost_analysis()
+                cost = lowered.cost_analysis()
             except Exception:
                 return None
+        # older jax returns a one-dict-per-device list
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else None
+        return cost
 
     def predict_bulk(self, batches):
         """Run ``len(batches)`` inference forwards as ONE XLA dispatch
@@ -1022,8 +1026,9 @@ class Module(BaseModule):
         if self._update_on_kvstore:
             self._kvstore.save_optimizer_states(fname)
         else:
-            with open(fname, "wb") as fout:
-                fout.write(self._updater.get_states())
+            from ..base import atomic_write_bytes
+
+            atomic_write_bytes(fname, self._updater.get_states())
 
     def load_optimizer_states(self, fname):
         assert self.optimizer_initialized

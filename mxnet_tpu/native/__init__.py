@@ -167,7 +167,7 @@ class Engine:
     def __del__(self):
         try:
             self.close()
-        except Exception:
+        except Exception:  # noqa: broad-except — interpreter-shutdown GC
             pass
 
 
@@ -213,7 +213,7 @@ class PooledStorage:
     def __del__(self):
         try:
             self.close()
-        except Exception:
+        except Exception:  # noqa: broad-except — interpreter-shutdown GC
             pass
 
 

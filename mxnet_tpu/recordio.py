@@ -24,19 +24,55 @@ reference's ``PrefetcherIter`` pinned-memory double buffering
 
 from __future__ import annotations
 
+import logging
 import os
 import struct
+import threading
 from collections import namedtuple
 
 import numpy as np
 
+from . import faults as _faults
 from .base import MXNetError
 
 __all__ = ["MXRecordIO", "MXIndexedRecordIO", "IRHeader",
-           "pack", "unpack", "pack_img", "unpack_img"]
+           "pack", "unpack", "pack_img", "unpack_img",
+           "skipped_record_count", "reset_skipped_record_count"]
 
 _KMAGIC = 0xced7230a
 _STRUCT_U32 = struct.Struct("<I")
+
+
+class _Truncated(MXNetError):
+    """A record short-read (file ended inside a record): a torn tail when
+    no later record boundary exists, mid-file corruption when one does."""
+
+# process-wide tally of corrupt records skipped under
+# MXNET_IO_SKIP_CORRUPT=1, across every reader (per-reader counts live on
+# MXRecordIO.num_skipped); readers may sit on prefetch threads, hence the
+# lock
+_skip_lock = threading.Lock()
+_total_skipped = 0
+
+
+def _note_skip(uri, pos, err):
+    global _total_skipped
+    with _skip_lock:
+        _total_skipped += 1
+    logging.warning("recordio: skipping corrupt record in %s near byte %d "
+                    "(%s)", uri, pos, err)
+
+
+def skipped_record_count():
+    """Corrupt records skipped process-wide (MXNET_IO_SKIP_CORRUPT=1)."""
+    with _skip_lock:
+        return _total_skipped
+
+
+def reset_skipped_record_count():
+    global _total_skipped
+    with _skip_lock:
+        _total_skipped = 0
 
 
 def _encode_lrec(cflag, length):
@@ -48,12 +84,23 @@ def _decode_lrec(lrec):
 
 
 class MXRecordIO:
-    """Sequential RecordIO reader/writer (``flag`` = 'r' or 'w')."""
+    """Sequential RecordIO reader/writer (``flag`` = 'r' or 'w').
 
-    def __init__(self, uri, flag):
+    ``skip_corrupt`` (default: the ``MXNET_IO_SKIP_CORRUPT`` env var):
+    when truthy, a corrupt record (bad magic, short read, broken
+    multi-part chain) is *skipped* — the reader rescans for the next
+    record boundary, bumps ``num_skipped`` and the process-wide counter
+    (:func:`skipped_record_count`) — instead of raising mid-epoch."""
+
+    def __init__(self, uri, flag, skip_corrupt=None):
         self.uri = uri
         self.flag = flag
         self.record = None
+        if skip_corrupt is None:
+            skip_corrupt = os.environ.get(
+                "MXNET_IO_SKIP_CORRUPT", "0") not in ("0", "", "false")
+        self.skip_corrupt = skip_corrupt
+        self.num_skipped = 0
         self.open()
 
     def open(self):
@@ -75,7 +122,7 @@ class MXRecordIO:
     def __del__(self):
         try:
             self.close()
-        except Exception:
+        except Exception:  # noqa: broad-except — interpreter-shutdown GC
             pass
 
     def __getstate__(self):
@@ -133,23 +180,33 @@ class MXRecordIO:
 
     def _read_part(self):
         head = self.record.read(4)
+        if len(head) == 0:
+            return None, None  # clean EOF on a record boundary
         if len(head) < 4:
-            return None, None
+            raise _Truncated("short read: truncated record magic at %d"
+                             % (self.record.tell() - len(head)))
         magic, = _STRUCT_U32.unpack(head)
         if magic != _KMAGIC:
             raise MXNetError("invalid record magic %x at %d"
                              % (magic, self.record.tell() - 4))
-        lrec, = _STRUCT_U32.unpack(self.record.read(4))
+        lbuf = self.record.read(4)
+        if len(lbuf) < 4:
+            raise _Truncated("short read: truncated record length at %d"
+                             % (self.record.tell() - len(lbuf)))
+        lrec, = _STRUCT_U32.unpack(lbuf)
         cflag, length = _decode_lrec(lrec)
         data = self.record.read(length)
+        if len(data) < length:
+            raise _Truncated(
+                "short read: record payload truncated (%d of %d bytes) "
+                "at %d" % (len(data), length, self.record.tell()))
         pad = (4 - length % 4) % 4
         if pad:
             self.record.read(pad)
         return cflag, data
 
-    def read(self):
-        """Read one record; None at EOF."""
-        assert not self.writable
+    def _read_one(self):
+        """Read one record; None at EOF; MXNetError on corruption."""
         cflag, data = self._read_part()
         if cflag is None:
             return None
@@ -170,6 +227,69 @@ class MXRecordIO:
             if cflag != 2:
                 raise MXNetError("corrupt record chain (cflag=%d)" % cflag)
         return b"".join(out)
+
+    def _resync(self):
+        """After a corrupt record: scan forward for the next 4-byte-
+        aligned magic (payload magics are escaped on write, so any
+        aligned magic is a real boundary).  False at EOF."""
+        magic_bytes = _STRUCT_U32.pack(_KMAGIC)
+        pos = self.record.tell()
+        pos += (-pos) % 4  # records are 4-byte aligned
+        while True:
+            self.record.seek(pos)
+            chunk = self.record.read(1 << 16)
+            if len(chunk) < 4:
+                return False
+            i = chunk.find(magic_bytes)
+            while i >= 0 and (pos + i) % 4 != 0:
+                i = chunk.find(magic_bytes, i + 1)
+            if i >= 0:
+                self.record.seek(pos + i)
+                return True
+            pos += len(chunk) - 3  # overlap: magic may straddle chunks
+
+    def read(self):
+        """Read one record; None at EOF.
+
+        With ``skip_corrupt`` armed a corrupt record is counted and
+        skipped (reader resyncs to the next boundary); otherwise the
+        corruption raises MXNetError."""
+        assert not self.writable
+        while True:
+            pos = self.record.tell()
+            try:
+                if _faults.should_fire("recordio.read"):
+                    self._read_one()  # consume the record the fault eats
+                    raise MXNetError(
+                        "fault 'recordio.read': record at %d treated as "
+                        "corrupt" % pos)
+                return self._read_one()
+            except MXNetError as e:
+                if not self.skip_corrupt:
+                    if not isinstance(e, _Truncated):
+                        raise
+                    # a short read with no later record boundary is a torn
+                    # tail (writer killed mid-append) — the pre-resilience
+                    # reader treated that as EOF, so ending the epoch
+                    # cleanly (with a warning) is not a behavior change;
+                    # a boundary AFTER the short read means real mid-file
+                    # corruption, which stays fail-loud by default
+                    self.record.seek(pos + 4)
+                    if self._resync():
+                        self.record.seek(pos)
+                        raise
+                    logging.warning(
+                        "recordio: ignoring truncated trailing record in "
+                        "%s near byte %d (%s)", self.uri, pos, e)
+                    return None
+                self.num_skipped += 1
+                _note_skip(self.uri, pos, e)
+                # rescan from just past the failed record's header — a
+                # corrupt *length* field may have dragged the cursor far
+                # past the next good record (even to EOF)
+                self.record.seek(pos + 4)
+                if not self._resync():
+                    return None
 
 
 class MXIndexedRecordIO(MXRecordIO):
@@ -221,8 +341,15 @@ class MXIndexedRecordIO(MXRecordIO):
         self.record.seek(self.idx[idx])
 
     def read_idx(self, idx):
+        """Random access returns key ``idx``'s record or raises — the
+        sequential ``skip_corrupt`` resync must not kick in here, or a
+        corrupt record would be silently *substituted* by whatever record
+        follows it on disk."""
         self.seek(idx)
-        return self.read()
+        if _faults.should_fire("recordio.read"):
+            raise MXNetError("fault 'recordio.read': record %r treated "
+                             "as corrupt" % (idx,))
+        return self._read_one()
 
     def write_idx(self, idx, buf):
         key = self.key_type(idx)

@@ -1,0 +1,103 @@
+"""Bounded retry with exponential backoff — the shared transport policy.
+
+Extracted from the ad-hoc sleep/retry loops in ``kvstore.py`` so every
+reconnect path (worker connect, register, explicit ``reconnect()``) shares
+one tested policy: exponential backoff with deterministic-free jitter,
+capped per-attempt delay, and a hard wall-clock deadline after which the
+last error propagates unchanged.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+
+__all__ = ["RetryPolicy", "retry_call"]
+
+
+class RetryPolicy:
+    """Backoff schedule + deadline.
+
+    Parameters
+    ----------
+    deadline : float or None
+        Wall-clock budget in seconds from the first attempt.  When the
+        budget is exhausted the last exception propagates.  None retries
+        forever (callers should almost always set one).
+    base_delay / max_delay : float
+        First sleep and per-sleep cap (seconds); delays double each retry.
+    jitter : float
+        Fraction of the delay randomized away (0..1): a delay ``d`` sleeps
+        ``d * (1 - jitter * random())``, de-synchronizing worker herds that
+        all lost the same server.
+    max_attempts : int or None
+        Optional attempt cap on top of the deadline.
+    """
+
+    def __init__(self, deadline=None, base_delay=0.1, max_delay=2.0,
+                 jitter=0.5, max_attempts=None):
+        self.deadline = deadline
+        self.base_delay = base_delay
+        self.max_delay = max_delay
+        self.jitter = jitter
+        self.max_attempts = max_attempts
+
+    def delays(self):
+        """Yield sleep durations; the *caller* enforces the deadline (it
+        knows when the first attempt started)."""
+        d = self.base_delay
+        while True:
+            yield d * (1.0 - self.jitter * random.random())
+            d = min(d * 2.0, self.max_delay)
+
+
+def retry_call(fn, retry_on=(OSError,), policy=None, retry_if=None,
+               on_retry=None, start=None, **policy_kwargs):
+    """Call ``fn()`` until it returns, retrying listed exceptions.
+
+    Parameters
+    ----------
+    fn : callable
+        Zero-argument callable to attempt.
+    retry_on : tuple of exception types
+        Exceptions that trigger a retry; anything else propagates at once.
+    policy : RetryPolicy, optional
+        Schedule + deadline.  ``policy_kwargs`` (``deadline=...`` etc.)
+        construct one when not given.
+    retry_if : callable(exc) -> bool, optional
+        Extra predicate — a matching exception type is only retried when
+        this also returns True (e.g. "only idempotent registrations").
+    on_retry : callable(exc, attempt), optional
+        Observer invoked before each sleep (cleanup/logging hook).
+    start : float (time.monotonic()), optional
+        Deadline anchor.  Several ``retry_call``s sharing one ``start``
+        share one absolute deadline (e.g. connect-to-N-servers then
+        register, all within a single budget).
+
+    The deadline is measured from ``start`` (default: the first attempt);
+    when it expires, the exception that caused the final retry propagates
+    unchanged.
+    """
+    if policy is None:
+        policy = RetryPolicy(**policy_kwargs)
+    if start is None:
+        start = time.monotonic()
+    attempt = 0
+    for delay in policy.delays():
+        attempt += 1
+        try:
+            return fn()
+        except retry_on as e:  # noqa: PERF203 — retry loop by design
+            if retry_if is not None and not retry_if(e):
+                raise
+            if policy.max_attempts is not None \
+                    and attempt >= policy.max_attempts:
+                raise
+            now = time.monotonic()
+            if policy.deadline is not None \
+                    and now + delay > start + policy.deadline:
+                raise
+            if on_retry is not None:
+                on_retry(e, attempt)
+            time.sleep(delay)
+    raise AssertionError("unreachable")  # pragma: no cover

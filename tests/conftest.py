@@ -26,6 +26,13 @@ jax.config.update("jax_platforms", "cpu")
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: excluded from the tier-1 run (-m 'not slow'); fault-"
+        "injection tests must stay fast enough to NOT need this")
+
+
 def pytest_sessionfinish(session, exitstatus):
     """Dump real op-invocation counts (OpDef.apply calls) when asked:
     MXNET_OP_COVERAGE_OUT=path pytest tests/ ... writes {op: count}.

@@ -1,0 +1,743 @@
+"""Resilience suite: fault injection, atomic checkpoint/auto-resume,
+NaN-policy guards, corrupt-record skipping, and hardened KVStore
+transport (docs/resilience.md).
+
+The TensorFlow paper (Abadi et al., 2016) treats user-level checkpointing
+plus transport retry as the fault-tolerance mechanism of a dataflow
+system; these tests arm deterministic faults (mxnet_tpu.faults) against
+each layer and assert the recovery story: a killed fit resumes to the
+same result, a dead worker fails a sync barrier with a clear error
+naming the lost rank, and corrupt inputs are skipped and counted rather
+than crashing mid-epoch.
+"""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import faults, kvstore, kvstore_server, recordio
+from mxnet_tpu.base import MXNetError, atomic_write
+from mxnet_tpu.model import (checkpoint_manifest, list_checkpoints,
+                             load_latest_checkpoint, save_checkpoint)
+from mxnet_tpu.retry import RetryPolicy, retry_call
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.disarm()
+    yield
+    faults.disarm()
+    os.environ.pop("MXNET_FAULT_SPEC", None)
+    os.environ.pop("MXNET_IO_SKIP_CORRUPT", None)
+
+
+# -- fault harness ---------------------------------------------------------
+
+def test_fault_spec_parse_and_window():
+    spec = faults.parse_spec("fit.batch:at=2,count=2;recordio.read")
+    assert spec == {"fit.batch": (2, 2), "recordio.read": (1, 1)}
+    with pytest.raises(MXNetError):
+        faults.parse_spec("no.such.point")
+    with pytest.raises(MXNetError):
+        faults.parse_spec("fit.batch:at=maybe")
+    faults.arm("fit.batch", at=2, count=2)
+    assert [faults.should_fire("fit.batch") for _ in range(5)] == \
+        [False, True, True, False, False]
+    assert not faults.should_fire("recordio.read")  # not armed
+
+
+def test_fault_env_spec_arms_and_disarms():
+    os.environ["MXNET_FAULT_SPEC"] = "checkpoint.write:at=1"
+    assert faults.armed("checkpoint.write")
+    assert faults.should_fire("checkpoint.write")
+    os.environ["MXNET_FAULT_SPEC"] = ""
+    assert not faults.armed("checkpoint.write")
+
+
+def test_fault_count_minus_one_fires_forever():
+    faults.arm("fit.batch", at=3, count=-1)
+    fired = [faults.should_fire("fit.batch") for _ in range(6)]
+    assert fired == [False, False, True, True, True, True]
+
+
+# -- retry policy ----------------------------------------------------------
+
+def test_retry_call_retries_then_succeeds():
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise OSError("transient")
+        return "ok"
+
+    assert retry_call(flaky, policy=RetryPolicy(
+        deadline=30, base_delay=0.01, max_delay=0.02)) == "ok"
+    assert len(calls) == 3
+
+
+def test_retry_call_deadline_propagates_last_error():
+    start = time.monotonic()
+    with pytest.raises(OSError, match="always"):
+        retry_call(lambda: (_ for _ in ()).throw(OSError("always")),
+                   policy=RetryPolicy(deadline=0.3, base_delay=0.05,
+                                      max_delay=0.1))
+    assert time.monotonic() - start < 5.0
+
+
+def test_retry_call_max_attempts_and_predicate():
+    calls = []
+
+    def boom():
+        calls.append(1)
+        raise OSError("x")
+
+    with pytest.raises(OSError):
+        retry_call(boom, policy=RetryPolicy(
+            deadline=30, base_delay=0.001, max_attempts=4))
+    assert len(calls) == 4
+    # retry_if=False: no retry at all
+    calls.clear()
+    with pytest.raises(OSError):
+        retry_call(boom, retry_if=lambda e: False,
+                   policy=RetryPolicy(deadline=30, base_delay=0.001))
+    assert len(calls) == 1
+    # a non-listed exception propagates immediately
+    with pytest.raises(ValueError):
+        retry_call(lambda: (_ for _ in ()).throw(ValueError("no")),
+                   retry_on=(OSError,),
+                   policy=RetryPolicy(deadline=30, base_delay=0.001))
+
+
+# -- atomic writes + manifest ----------------------------------------------
+
+def test_atomic_write_crash_leaves_old_content(tmp_path):
+    path = str(tmp_path / "blob.bin")
+    atomic_write(path, lambda tmp: open(tmp, "wb").write(b"GOLD" * 64))
+    assert open(path, "rb").read() == b"GOLD" * 64
+    faults.arm("checkpoint.write", at=1)
+    with pytest.raises(faults.FaultInjected):
+        atomic_write(path, lambda tmp: open(tmp, "wb").write(b"NEW" * 999),
+                     fault_point="checkpoint.write")
+    # the simulated mid-write crash never renamed: old content intact
+    assert open(path, "rb").read() == b"GOLD" * 64
+
+
+def _toy_params(val):
+    return ({"w": mx.nd.array(np.full((4, 3), val, np.float32))},
+            {"m": mx.nd.array(np.ones((3,), np.float32))})
+
+
+def test_manifest_garbage_content_treated_as_corrupt(tmp_path):
+    """Valid JSON with non-integer epochs must read as 'corrupt manifest'
+    (None) — resume falls back to the on-disk scan instead of crashing."""
+    prefix = str(tmp_path / "ck")
+    with open(prefix + "-manifest.json", "w") as f:
+        f.write('{"format": 1, "epochs": ["3x", null]}')
+    assert checkpoint_manifest(prefix) is None
+    assert list_checkpoints(prefix) == []
+    assert load_latest_checkpoint(prefix) is None
+
+
+def test_manifest_tracks_epochs_and_truncation_falls_back(tmp_path):
+    prefix = str(tmp_path / "ck")
+    net = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=3)
+    for epoch in (1, 2, 3):
+        arg, aux = _toy_params(float(epoch))
+        save_checkpoint(prefix, epoch, net, arg, aux)
+    m = checkpoint_manifest(prefix)
+    assert m["epochs"] == [1, 2, 3] and m["latest"] == 3
+    assert list_checkpoints(prefix) == [3, 2, 1]
+    # truncate the newest params file (host died mid-write on a pre-atomic
+    # framework, bitrot, partial copy...): resume must fall back to 2
+    p3 = "%s-%04d.params" % (prefix, 3)
+    blob = open(p3, "rb").read()
+    open(p3, "wb").write(blob[:len(blob) // 2])
+    found = load_latest_checkpoint(prefix)
+    assert found is not None
+    epoch, _sym, arg, _aux = found
+    assert epoch == 2
+    np.testing.assert_allclose(arg["w"].asnumpy(), 2.0)
+
+
+def test_checkpoint_write_fault_preserves_previous_epoch(tmp_path):
+    prefix = str(tmp_path / "ck")
+    net = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=3)
+    arg, aux = _toy_params(1.0)
+    save_checkpoint(prefix, 1, net, arg, aux)
+    faults.arm("checkpoint.write", at=1)
+    arg2, aux2 = _toy_params(2.0)
+    with pytest.raises(faults.FaultInjected):
+        save_checkpoint(prefix, 2, net, arg2, aux2)
+    # epoch 2 never completed its rename: not on disk, not in the manifest
+    assert not os.path.exists("%s-%04d.params" % (prefix, 2))
+    assert checkpoint_manifest(prefix)["latest"] == 1
+    epoch, _sym, arg_l, _aux = load_latest_checkpoint(prefix)
+    assert epoch == 1
+    np.testing.assert_allclose(arg_l["w"].asnumpy(), 1.0)
+
+
+# -- Module.fit: auto-resume + NaN policies --------------------------------
+
+def _toy_dataset(n=64, d=8, classes=3, seed=7):
+    rs = np.random.RandomState(seed)
+    x = rs.rand(n, d).astype(np.float32)
+    y = rs.randint(0, classes, n).astype(np.float32)
+    return x, y
+
+
+def _toy_iter(batch_size=16):
+    x, y = _toy_dataset()
+    return mx.io.NDArrayIter(x, y, batch_size=batch_size, shuffle=False)
+
+
+def _toy_module():
+    data = mx.sym.Variable("data")
+    h = mx.sym.FullyConnected(data, num_hidden=16, name="fc1")
+    h = mx.sym.Activation(h, act_type="relu")
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(h, num_hidden=3, name="fc2"), name="softmax")
+    return mx.mod.Module(net, context=mx.cpu())
+
+
+def _init_args():
+    """One fixed parameter set so every fit in a test starts identically."""
+    mod = _toy_module()
+    it = _toy_iter()
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    np.random.seed(11)
+    mod.init_params(mx.init.Xavier())
+    arg, aux = mod.get_params()
+    return arg, aux
+
+
+def _fit(prefix, num_epoch, resume=None, arg_params=None, aux_params=None,
+         **kwargs):
+    # deep-copy params: the fused train step donates buffers, so arrays
+    # handed to one fit must not be reused by the next
+    def _cp(d):
+        return None if d is None else \
+            {k: mx.nd.array(v.asnumpy()) for k, v in d.items()}
+
+    mod = _toy_module()
+    mod.fit(_toy_iter(), num_epoch=num_epoch, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+            arg_params=_cp(arg_params), aux_params=_cp(aux_params),
+            force_init=arg_params is not None,
+            checkpoint_prefix=prefix, resume=resume, **kwargs)
+    return mod
+
+
+def test_fit_killed_mid_checkpoint_resumes_to_same_result(tmp_path):
+    """THE acceptance path: fit killed at epoch k by the fault harness,
+    restarted with resume='auto', reaches the same final state as an
+    uninterrupted run."""
+    arg0, aux0 = _init_args()
+    # uninterrupted reference run
+    mod_a = _fit(str(tmp_path / "a"), 4, arg_params=arg0, aux_params=aux0)
+    ref_args, _ = mod_a.get_params()
+    # victim run: host "dies" mid-write of the epoch-2 checkpoint
+    prefix_b = str(tmp_path / "b")
+    faults.arm("checkpoint.write", at=2)
+    with pytest.raises(faults.FaultInjected):
+        _fit(prefix_b, 4, arg_params=arg0, aux_params=aux0)
+    faults.disarm()
+    assert checkpoint_manifest(prefix_b)["latest"] == 1
+    # auto-resume: picks up epoch 1 (params + optimizer states), replays
+    mod_b = _fit(prefix_b, 4, resume="auto")
+    got_args, _ = mod_b.get_params()
+    assert checkpoint_manifest(prefix_b)["latest"] == 4
+    for k in ref_args:
+        np.testing.assert_allclose(got_args[k].asnumpy(),
+                                   ref_args[k].asnumpy(),
+                                   rtol=1e-4, atol=1e-5, err_msg=k)
+    # same final metric (here: exact same params => same accuracy)
+    metric = mx.metric.Accuracy()
+    it = _toy_iter()
+    mod_a.score(it, metric)
+    acc_a = metric.get()[1]
+    metric.reset()
+    it.reset()
+    mod_b.score(it, metric)
+    assert abs(metric.get()[1] - acc_a) < 1e-6
+
+
+def test_resume_auto_skips_truncated_checkpoint(tmp_path):
+    prefix = str(tmp_path / "ck")
+    arg0, aux0 = _init_args()
+    _fit(prefix, 3, arg_params=arg0, aux_params=aux0)
+    assert list_checkpoints(prefix) == [3, 2, 1]
+    p3 = "%s-%04d.params" % (prefix, 3)
+    blob = open(p3, "rb").read()
+    open(p3, "wb").write(blob[: len(blob) // 3])
+    # resume sees the corrupt epoch 3, warns, falls back to epoch 2 and
+    # trains the remaining epoch — landing at 3 again, now valid
+    mod = _fit(prefix, 3, resume="auto")
+    assert mod is not None
+    found = load_latest_checkpoint(prefix)
+    assert found is not None and found[0] == 3
+
+
+def test_resume_auto_without_any_checkpoint_starts_fresh(tmp_path):
+    mod = _fit(str(tmp_path / "none"), 1, resume="auto")
+    arg, _ = mod.get_params()
+    assert all(np.isfinite(v.asnumpy()).all() for v in arg.values())
+
+
+def test_nan_policy_raise(tmp_path):
+    faults.arm("fit.batch", at=2)
+    with pytest.raises(MXNetError, match="NaN/Inf"):
+        _fit(None, 1, nan_policy="raise")
+
+
+def test_nan_policy_skip_batch_observable_in_callback(tmp_path):
+    faults.arm("fit.batch", at=2)
+    seen = []
+    mod = _fit(None, 1, nan_policy="skip_batch",
+               batch_end_callback=lambda p: seen.append(
+                   (p.nbatch, p.nan_detected, p.nan_action)))
+    tripped = [s for s in seen if s[1]]
+    assert tripped == [(1, True, "skip_batch")]
+    arg, _ = mod.get_params()
+    for k, v in arg.items():
+        assert np.isfinite(v.asnumpy()).all(), k
+
+
+def test_nan_policy_rollback_restores_checkpoint(tmp_path):
+    prefix = str(tmp_path / "rb")
+    # 4 batches/epoch; fire on the first batch of epoch 2 so the epoch-1
+    # checkpoint exists to roll back to
+    faults.arm("fit.batch", at=5)
+    seen = []
+    mod = _fit(prefix, 2, nan_policy="rollback",
+               batch_end_callback=lambda p: seen.append(
+                   (p.epoch, p.nbatch, p.nan_detected, p.nan_action)))
+    assert (1, 0, True, "rollback") in seen
+    arg, _ = mod.get_params()
+    for k, v in arg.items():
+        assert np.isfinite(v.asnumpy()).all(), k
+
+
+def test_nan_policy_rollback_requires_prefix():
+    with pytest.raises(MXNetError, match="checkpoint_prefix"):
+        _fit(None, 1, nan_policy="rollback")
+    with pytest.raises(MXNetError, match="nan_policy"):
+        _fit(None, 1, nan_policy="explode")
+
+
+def test_fit_rejects_nonpositive_checkpoint_period(tmp_path):
+    with pytest.raises(MXNetError, match="checkpoint_period"):
+        _fit(str(tmp_path / "ck"), 1, checkpoint_period=0)
+
+
+# -- recordio: skip-and-count corrupt records ------------------------------
+
+def _write_records(path, payloads):
+    w = recordio.MXRecordIO(path, "w")
+    for p in payloads:
+        w.write(p)
+    w.close()
+
+
+def test_recordio_corrupt_record_raises_by_default(tmp_path):
+    path = str(tmp_path / "x.rec")
+    _write_records(path, [b"a" * 40, b"b" * 40, b"c" * 40])
+    blob = bytearray(open(path, "rb").read())
+    blob[8 + 40] ^= 0xFF  # smash record 1's magic (records are 48B each)
+    open(path, "wb").write(bytes(blob))
+    r = recordio.MXRecordIO(path, "r", skip_corrupt=False)
+    assert r.read() == b"a" * 40
+    with pytest.raises(MXNetError):
+        while r.read() is not None:
+            pass
+    r.close()
+
+
+def test_recordio_skip_corrupt_counts_and_resyncs(tmp_path):
+    recordio.reset_skipped_record_count()
+    path = str(tmp_path / "x.rec")
+    _write_records(path, [b"a" * 40, b"b" * 40, b"c" * 40, b"d" * 40])
+    blob = bytearray(open(path, "rb").read())
+    blob[8 + 40] ^= 0xFF  # corrupt record 1's magic
+    open(path, "wb").write(bytes(blob))
+    os.environ["MXNET_IO_SKIP_CORRUPT"] = "1"
+    r = recordio.MXRecordIO(path, "r")  # picks the env default up
+    got = []
+    while True:
+        rec = r.read()
+        if rec is None:
+            break
+        got.append(rec)
+    assert got == [b"a" * 40, b"c" * 40, b"d" * 40]
+    assert r.num_skipped == 1
+    assert mx.io.corrupt_skip_count() == 1
+    mx.io.reset_corrupt_skip_count()
+    r.close()
+
+
+def test_recordio_corrupt_length_skips_one_record_not_rest(tmp_path):
+    """A corrupt *length* field drags the failed read far past the next
+    boundary (possibly to EOF); the resync must restart from the failed
+    record's header, not from wherever the bad read left the cursor."""
+    path = str(tmp_path / "x.rec")
+    _write_records(path, [b"a" * 40, b"b" * 40, b"c" * 40, b"d" * 40])
+    blob = bytearray(open(path, "rb").read())
+    # record 1's length word: a huge 29-bit length reads to EOF
+    blob[48 + 4:48 + 8] = (0x1FFFFFFF).to_bytes(4, "little")
+    open(path, "wb").write(bytes(blob))
+    r = recordio.MXRecordIO(path, "r", skip_corrupt=True)
+    got = []
+    while True:
+        rec = r.read()
+        if rec is None:
+            break
+        got.append(rec)
+    assert got == [b"a" * 40, b"c" * 40, b"d" * 40]
+    assert r.num_skipped == 1
+    r.close()
+
+
+def test_recordio_truncated_tail_skipped_not_crash(tmp_path):
+    path = str(tmp_path / "x.rec")
+    _write_records(path, [b"a" * 40, b"b" * 400])
+    blob = open(path, "rb").read()
+    open(path, "wb").write(blob[: len(blob) - 100])  # torn final record
+    r = recordio.MXRecordIO(path, "r", skip_corrupt=True)
+    assert r.read() == b"a" * 40
+    assert r.read() is None  # truncated tail: skipped, clean EOF
+    assert r.num_skipped == 1
+    r.close()
+
+
+def test_recordio_truncated_tail_clean_eof_by_default(tmp_path):
+    """A torn final record (writer killed mid-append) ends the epoch as a
+    clean EOF even WITHOUT skip_corrupt — the pre-resilience reader
+    treated any short read as EOF, so raising here would crash existing
+    pipelines on upgrade.  Mid-file corruption still raises by default."""
+    path = str(tmp_path / "x.rec")
+    _write_records(path, [b"a" * 40, b"b" * 400])
+    blob = open(path, "rb").read()
+    open(path, "wb").write(blob[: len(blob) - 100])  # torn final record
+    r = recordio.MXRecordIO(path, "r", skip_corrupt=False)
+    assert r.read() == b"a" * 40
+    assert r.read() is None  # torn tail: EOF, not MXNetError
+    r.close()
+    # a 1-3 byte trailing fragment of a magic behaves the same
+    open(path, "ab").write(b"\x0a#")
+    r = recordio.MXRecordIO(path, "r", skip_corrupt=False)
+    assert r.read() == b"a" * 40
+    assert r.read() is None
+    r.close()
+
+
+def test_list_checkpoints_glob_metachar_prefix(tmp_path):
+    """A prefix containing glob metacharacters (sweep dirs like
+    'sweep[lr=0.1]') must not break the on-disk checkpoint scan."""
+    d = tmp_path / "sweep[lr=0.1]"
+    d.mkdir()
+    prefix = str(d / "ck")
+    arg, aux = _toy_params(1.0)
+    save_checkpoint(prefix, 1, None, arg, aux)
+    os.remove(prefix + "-manifest.json")  # force the disk-scan path
+    assert list_checkpoints(prefix) == [1]
+
+
+def test_indexed_read_idx_corrupt_raises_even_with_skip(tmp_path):
+    """Random access must return the requested record or fail — the
+    sequential skip-corrupt resync substituting the *next* record on disk
+    would silently train on the wrong sample."""
+    path = str(tmp_path / "x.rec")
+    idxp = str(tmp_path / "x.idx")
+    w = recordio.MXIndexedRecordIO(idxp, path, "w")
+    for i in range(3):
+        w.write_idx(i, bytes("rec-%d" % i, "ascii") * 8)  # 40B payload
+    w.close()
+    blob = bytearray(open(path, "rb").read())
+    blob[48] ^= 0xFF  # records are 48B each; smash record 1's magic
+    open(path, "wb").write(bytes(blob))
+    os.environ["MXNET_IO_SKIP_CORRUPT"] = "1"
+    r = recordio.MXIndexedRecordIO(idxp, path, "r")
+    assert r.read_idx(0) == b"rec-0" * 8
+    with pytest.raises(MXNetError):
+        r.read_idx(1)  # corrupt: raise, don't substitute record 2
+    assert r.read_idx(2) == b"rec-2" * 8
+    r.close()
+
+
+def test_recordio_read_fault_point(tmp_path):
+    path = str(tmp_path / "x.rec")
+    _write_records(path, [b"a" * 40, b"b" * 40, b"c" * 40])
+    faults.arm("recordio.read", at=2)
+    r = recordio.MXRecordIO(path, "r", skip_corrupt=True)
+    got = []
+    while True:
+        rec = r.read()
+        if rec is None:
+            break
+        got.append(rec)
+    # record 1 eaten by the injected fault, counted as a skip
+    assert got == [b"a" * 40, b"c" * 40]
+    assert r.num_skipped == 1
+    r.close()
+
+
+# -- kvstore transport hardening -------------------------------------------
+
+def _server(num_workers, **kw):
+    srv = kvstore_server.KVStoreServer(num_workers, **kw)
+    srv.start_background()
+    os.environ["DMLC_PS_ROOT_URI"] = "127.0.0.1"
+    os.environ["DMLC_PS_ROOT_PORT"] = str(srv.port)
+    return srv
+
+
+def test_kvstore_server_dedups_replayed_push_after_rejoin():
+    """A push whose *reply* was lost is re-sent after reconnect(); the
+    server must ack it without counting it into the next sync round."""
+    srv = kvstore_server.KVStoreServer(num_workers=2, sync_mode=True)
+    try:
+        for r in (0, 1):
+            srv.dispatch({"cmd": "register", "role": "worker",
+                          "preferred_rank": r})
+        srv.dispatch({"cmd": "init", "key": 7, "value": np.zeros(2)})
+        one = np.ones(2, np.float32)
+        r1 = srv.dispatch({"cmd": "push", "key": 7, "value": one,
+                           "rank": 0, "round": 0})
+        # reply lost -> same-process reconnect (rejoin) -> replay
+        srv.dispatch({"cmd": "register", "role": "worker",
+                      "preferred_rank": 0, "rejoin": True})
+        r2 = srv.dispatch({"cmd": "push", "key": 7, "value": one,
+                           "rank": 0, "round": 0})
+        assert r1 == r2 == {"version": 1}
+        assert srv.keys[7].pushed[0] == 1  # not double counted
+        srv.dispatch({"cmd": "push", "key": 7, "value": one,
+                      "rank": 1, "round": 0})
+        out = srv.dispatch({"cmd": "pull", "key": 7, "version": 1})
+        assert out["version"] == 1
+        np.testing.assert_allclose(out["value"], 2 * one)
+    finally:
+        srv.server.server_close()  # never started serving; shutdown() would block
+
+
+def test_kvstore_server_fresh_restart_push_not_deduped():
+    """A restarted worker *process* renumbers its rounds from 0; its first
+    push must take the normal path, not be dropped as a replay."""
+    srv = kvstore_server.KVStoreServer(num_workers=1, sync_mode=True)
+    try:
+        srv.dispatch({"cmd": "register", "role": "worker",
+                      "preferred_rank": 0})
+        srv.dispatch({"cmd": "init", "key": 7, "value": np.zeros(2)})
+        one = np.ones(2, np.float32)
+        srv.dispatch({"cmd": "push", "key": 7, "value": one,
+                      "rank": 0, "round": 0})
+        # worker dies and restarts: fresh register (no rejoin flag)
+        srv.dispatch({"cmd": "register", "role": "worker",
+                      "preferred_rank": 0})
+        out = srv.dispatch({"cmd": "push", "key": 7, "value": one,
+                            "rank": 0, "round": 0})
+        assert out == {"version": 2}  # counted as round 1, not dropped
+        assert srv.keys[7].pushed[0] == 2
+    finally:
+        srv.server.server_close()  # never started serving; shutdown() would block
+
+
+def test_kvstore_server_async_push_replay_not_applied_twice():
+    """dist_async applies pushes immediately — a re-push whose reply was
+    lost must still be deduped, or the parameter takes two optimizer
+    steps for one batch."""
+    srv = kvstore_server.KVStoreServer(num_workers=1, sync_mode=False)
+    try:
+        srv.dispatch({"cmd": "register", "role": "worker",
+                      "preferred_rank": 0})
+        srv.dispatch({"cmd": "init", "key": 7, "value": np.zeros(2)})
+        one = np.ones(2, np.float32)
+        srv.dispatch({"cmd": "push", "key": 7, "value": one,
+                      "rank": 0, "round": 0})
+        # reply lost -> reconnect -> replay of the same round
+        srv.dispatch({"cmd": "register", "role": "worker",
+                      "preferred_rank": 0, "rejoin": True})
+        srv.dispatch({"cmd": "push", "key": 7, "value": one,
+                      "rank": 0, "round": 0})
+        assert srv.keys[7].pushed[0] == 1  # applied once, not twice
+        np.testing.assert_allclose(srv.keys[7].value, one)
+        # a genuinely new round is applied
+        srv.dispatch({"cmd": "push", "key": 7, "value": 2 * one,
+                      "rank": 0, "round": 1})
+        assert srv.keys[7].pushed[0] == 2
+        np.testing.assert_allclose(srv.keys[7].value, 2 * one)
+    finally:
+        srv.server.server_close()  # never started serving
+
+
+def test_kvstore_killed_mid_push_clean_error_then_reconnect():
+    srv = _server(1)
+    try:
+        kv = kvstore.KVStoreDist("dist_sync")
+        kv.init(3, mx.nd.zeros((4,)))
+        faults.arm("kvstore.push.socket", at=1)
+        with pytest.raises(kvstore.ConnectionLost, match="reconnect"):
+            kv.push(3, mx.nd.array(np.ones(4, np.float32)))
+        faults.disarm()
+        # rejoin with the same rank; server-side state survived
+        kv.reconnect()
+        assert kv.rank == 0 and kv.is_recovery
+        kv.push(3, mx.nd.array(np.full(4, 2.0, np.float32)))
+        out = mx.nd.zeros((4,))
+        kv.pull(3, out=out)
+        np.testing.assert_allclose(out.asnumpy(), 2.0)
+        live = kv.heartbeat()
+        assert live["live"] == [0]
+    finally:
+        srv.close()
+
+
+def test_kvstore_dead_worker_fails_barrier_naming_rank():
+    """Acceptance: a sync barrier with one dead worker errors within the
+    heartbeat deadline, naming the lost rank — it does not hang."""
+    deadline = 2.0
+    srv = _server(2, heartbeat_deadline=deadline)
+    try:
+        kv0 = kvstore.KVStoreDist("dist_sync")
+        kv1 = kvstore.KVStoreDist("dist_sync")
+        assert {kv0.rank, kv1.rank} == {0, 1}
+        dead = kv1 if kv1.rank == 1 else kv0
+        alive = kv0 if dead is kv1 else kv1
+        dead._close_socks()  # worker 1 dies without deregistering
+        t0 = time.monotonic()
+        with pytest.raises(MXNetError, match=r"rank 1 lost"):
+            alive.barrier()
+        elapsed = time.monotonic() - t0
+        assert elapsed < deadline + 8.0, \
+            "barrier should fail fast, took %.1fs" % elapsed
+    finally:
+        srv.close()
+
+
+def test_kvstore_multikey_repush_after_partial_ack_not_double_counted():
+    """push([a, b]) where a's RPC is acked and then b loses the transport:
+    re-pushing the same batch after reconnect() must not count a twice
+    (its ack advanced the worker's round past the server replay window)."""
+    srv = _server(1)
+    try:
+        kv = kvstore.KVStoreDist("dist_sync")
+        kv.init([1, 2], [mx.nd.zeros((2,)), mx.nd.zeros((2,))])
+        orig_rpc = kv._rpc
+        pushes = []
+
+        def flaky(msg, sock=None):
+            if msg.get("cmd") == "push":
+                pushes.append(msg["key"])
+                if len(pushes) == 2:
+                    raise kvstore.ConnectionLost("transport died after "
+                                                 "key 1 was acked")
+            return orig_rpc(msg, sock=sock)
+
+        kv._rpc = flaky
+        one = mx.nd.array(np.ones(2, np.float32))
+        with pytest.raises(kvstore.ConnectionLost):
+            kv.push([1, 2], [one, one])
+        kv._rpc = orig_rpc
+        kv.reconnect()
+        kv.push([1, 2], [one, one])  # documented recovery: same batch
+        assert srv.keys[1].pushed[0] == 1, "acked key pushed twice"
+        assert srv.keys[2].pushed[0] == 1
+        for k in (1, 2):
+            out = mx.nd.zeros((2,))
+            kv.pull(k, out=out)
+            np.testing.assert_allclose(out.asnumpy(), 1.0)
+    finally:
+        srv.close()
+
+
+def test_kvstore_register_announced_to_every_server():
+    """Each shard server keeps its own rank/incarnation bookkeeping, so a
+    worker must register on all of them: a restarted worker's fresh round
+    numbering is otherwise misread as replays on servers 1..N-1 and its
+    gradients silently dropped."""
+    srv0 = srv1 = None
+    try:
+        for _ in range(20):  # port+1 must be free; retry on collision
+            srv0 = kvstore_server.KVStoreServer(num_workers=1)
+            try:
+                srv1 = kvstore_server.KVStoreServer(num_workers=1,
+                                                    port=srv0.port + 1)
+                break
+            except OSError:
+                srv0.server.server_close()
+                srv0 = None
+        assert srv1 is not None, "could not bind consecutive ports"
+        srv0.start_background()
+        srv1.start_background()
+        os.environ["DMLC_PS_ROOT_URI"] = "127.0.0.1"
+        os.environ["DMLC_PS_ROOT_PORT"] = str(srv0.port)
+        os.environ["DMLC_NUM_SERVER"] = "2"
+        os.environ["DMLC_WORKER_ID"] = "0"
+        kv = kvstore.KVStoreDist("dist_sync")
+        assert srv1.registered == {0}, "rank not announced to shard server"
+        kv.init(1, mx.nd.zeros((2,)))  # key 1 shards to server 1
+        one = mx.nd.array(np.ones(2, np.float32))
+        kv.push(1, one)
+        assert srv1.keys[1].pushed[0] == 1
+        # worker process dies and restarts: fresh numbering from round 0
+        kv._close_socks()
+        for _ in range(100):  # wait for the servers to reap the old conn
+            if 0 not in srv0.live and 0 not in srv1.live:
+                break
+            time.sleep(0.05)
+        kv2 = kvstore.KVStoreDist("dist_sync")
+        kv2.push(1, one)  # round 0 again — must be counted, not dropped
+        assert srv1.keys[1].pushed[0] == 2, \
+            "restarted worker's push dropped as a replay on the shard server"
+        out = mx.nd.zeros((2,))
+        kv2.pull(1, out=out)  # no updater: pull returns the round's sum
+        np.testing.assert_allclose(out.asnumpy(), 1.0)
+    finally:
+        os.environ.pop("DMLC_NUM_SERVER", None)
+        os.environ.pop("DMLC_WORKER_ID", None)
+        for s in (srv0, srv1):
+            if s is not None:
+                s.close()
+
+
+def test_kvstore_dead_worker_fails_versioned_pull():
+    import threading
+
+    deadline = 2.0
+    srv = _server(2, heartbeat_deadline=deadline)
+    try:
+        kv0 = kvstore.KVStoreDist("dist_sync")
+        kv1 = kvstore.KVStoreDist("dist_sync")
+        dead, alive = (kv1, kv0) if kv1.rank == 1 else (kv0, kv1)
+        # init barriers across both workers, so run it on both in threads
+        ts = [threading.Thread(
+            target=lambda kv=kv: kv.init(7, mx.nd.zeros((3,))),
+            daemon=True) for kv in (kv0, kv1)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=60)
+        assert not any(t.is_alive() for t in ts), "init hung"
+        dead._close_socks()  # rank 1 dies mid-job
+        # sync round: version only advances once BOTH ranks push; the
+        # surviving worker's versioned pull must fail fast, naming rank 1
+        alive.push(7, mx.nd.array(np.ones(3, np.float32)))
+        out = mx.nd.zeros((3,))
+        with pytest.raises(MXNetError, match="rank 1"):
+            alive.pull(7, out=out)
+    finally:
+        srv.close()
+
+
+def test_kvstore_connect_deadline_env(monkeypatch):
+    """No server listening: connect fails after the configured deadline
+    instead of the 120s default."""
+    monkeypatch.setenv("DMLC_PS_ROOT_URI", "127.0.0.1")
+    monkeypatch.setenv("DMLC_PS_ROOT_PORT", "1")  # nothing listens on 1
+    monkeypatch.setenv("MXNET_KVSTORE_CONNECT_DEADLINE", "0.5")
+    t0 = time.monotonic()
+    with pytest.raises(OSError):
+        kvstore.KVStoreDist("dist_sync")
+    assert time.monotonic() - t0 < 10.0
