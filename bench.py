@@ -12,7 +12,8 @@ reference's MXNET_EXEC_BULK_EXEC_TRAIN op bulking) so tunnel dispatch
 latency does not pollute the compute measurement.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "tflops",
-"flops_per_img", "flops_source", "value_median", "repeats"}; when the
+"flops_per_img", "flops_source", "value_median", "repeats",
+"phase_breakdown"}; when the
 chip's bf16 peak is known (detected from device_kind, or
 BENCH_PEAK_TFLOPS) the line also carries {"mfu_pct", "peak_tflops",
 "peak_source"} plus "regime_probe_tflops" — a sustained-matmul
@@ -29,6 +30,12 @@ convention as the chip's peak rating).  Compiling the AOT-lowered step a
 second time costs ~30s through the tunnel but keeps the count
 post-optimization (pre-DCE counts would include dead primal convs from
 the conv custom_vjp).
+
+"phase_breakdown" attributes the measured step time to phases via the
+telemetry registry (docs/observability.md): input stacking vs XLA
+dispatch vs the device-sync wait, per timed step, plus the process's
+cumulative XLA compile count/seconds — so a BENCH regression is
+attributed to a phase instead of guessed at.
 """
 
 import json
@@ -151,7 +158,12 @@ def setup():
 
     import mxnet_tpu as mx
     from mxnet_tpu import io as mxio
+    from mxnet_tpu import telemetry
     from mxnet_tpu.models import resnet
+
+    # per-phase attribution of the measured step time (stack/dispatch
+    # from Module.run_bulk, sync below, compile from the executor)
+    telemetry.enable()
 
     ctx = mx.tpu() if mx.num_tpus() > 0 else mx.cpu()
 
@@ -190,8 +202,9 @@ def setup():
         # device barrier through the tunnel (reading the whole buffer
         # would drag MBs across the link); the final step's param update
         # transitively depends on every prior step
-        return np.asarray(
-            mod._exec.arg_dict["conv0_weight"]._jx.reshape(-1)[:1])
+        with telemetry.phase("sync", family="bench"):
+            return np.asarray(
+                mod._exec.arg_dict["conv0_weight"]._jx.reshape(-1)[:1])
 
     return mod, run, sync
 
@@ -222,6 +235,16 @@ def main():
             if attempt < REGIME_TRIES - 1:
                 time.sleep(REGIME_WAIT_S)
 
+    from mxnet_tpu import telemetry
+
+    def _phase_sums():
+        sums = {}
+        for fam in ("bulk", "bench"):
+            for ph, (s, _n) in telemetry.phase_totals(fam).items():
+                sums[ph] = s
+        return sums
+
+    phase_base = _phase_sums()
     times = []
     for _ in range(REPEATS):
         t0 = time.time()
@@ -230,6 +253,17 @@ def main():
         times.append(time.time() - t0)
     best = min(times)
     median = sorted(times)[len(times) // 2]
+    phase_end = _phase_sums()
+    timed_steps = REPEATS * STEPS
+    breakdown = {
+        "%s_ms_per_step" % ph: round(
+            1e3 * (phase_end.get(ph, 0.0) - phase_base.get(ph, 0.0))
+            / timed_steps, 3)
+        for ph in ("stack", "dispatch", "sync")}
+    breakdown["compile_count"] = int(
+        telemetry.counter_total("xla.compile.count"))
+    breakdown["compile_s"] = round(
+        telemetry.counter_total("xla.compile.seconds"), 2)
 
     ips = BATCH * STEPS / best
     tflops = ips * flops_per_img / 1e12
@@ -243,6 +277,7 @@ def main():
         "flops_source": flops_src,
         "value_median": round(BATCH * STEPS / median, 2),
         "repeats": REPEATS,
+        "phase_breakdown": breakdown,
     }
     if probe_tflops is not None:
         row["regime_probe_tflops"] = round(probe_tflops, 1)

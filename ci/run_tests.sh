@@ -6,6 +6,9 @@ cd "$(dirname "$0")/.."
 python -m compileall -q mxnet_tpu tools example
 # resilience lint: no silently-swallowed exceptions in the framework
 python ci/check_bare_except.py
+# observability lint: framework output goes through logging/telemetry,
+# never bare print (bench.py's stdout is a one-JSON-line contract)
+python ci/check_print.py
 if command -v g++ > /dev/null; then
   g++ -O2 -shared -fPIC -std=c++17 -o libmxnet_tpu_native.so \
       src/native.cc -lpthread

@@ -11,6 +11,7 @@ __version__ = "0.1.0"
 from .base import MXNetError
 from .context import Context, cpu, gpu, tpu, current_context, num_tpus
 
+from . import telemetry
 from . import faults
 from . import retry
 

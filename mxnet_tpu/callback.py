@@ -19,8 +19,10 @@ import logging
 import sys
 import time
 
+from . import telemetry as _telemetry
+
 __all__ = ["module_checkpoint", "do_checkpoint", "log_train_metric",
-           "Speedometer", "ProgressBar"]
+           "Speedometer", "ProgressBar", "TelemetryReport"]
 
 
 def module_checkpoint(mod, prefix, period=1, save_optimizer_states=False):
@@ -73,12 +75,21 @@ class Speedometer:
     missed callback or an epoch boundary can't skew the rate.  A drop in
     ``nbatch`` (new epoch / iterator reset) re-arms the mark without
     logging a bogus first interval.
+
+    Besides the instantaneous rate, an exponentially-smoothed rate
+    (``smoothing`` is the weight kept on history per log interval) is
+    reported — the number to read on jittery input pipelines — and both
+    survive in ``telemetry.snapshot()`` as the
+    ``fit.samples_per_sec{kind=instant|smoothed}`` gauges instead of
+    scrolling away on stdout.
     """
 
-    def __init__(self, batch_size, frequent=50):
+    def __init__(self, batch_size, frequent=50, smoothing=0.7):
         self.batch_size = batch_size
         self.frequent = frequent
+        self.smoothing = min(max(float(smoothing), 0.0), 1.0)
         self._mark = None  # (nbatch, perf_counter) at the last log/reset
+        self._ema = None
 
     def __call__(self, param):
         now = time.perf_counter()
@@ -91,26 +102,136 @@ class Speedometer:
         elapsed = now - self._mark[1]
         speed = (count - self._mark[0]) * self.batch_size / max(elapsed, 1e-9)
         self._mark = (count, now)
+        self._ema = speed if self._ema is None else \
+            self.smoothing * self._ema + (1.0 - self.smoothing) * speed
+        if _telemetry.enabled():
+            _telemetry.set_gauge("fit.samples_per_sec", speed,
+                                 kind="instant")
+            _telemetry.set_gauge("fit.samples_per_sec", self._ema,
+                                 kind="smoothed")
         if param.eval_metric is not None:
             metrics = "".join("\tTrain-%s=%f" % nv
                               for nv in param.eval_metric.get_name_value())
-            logging.info("Epoch[%d] Batch [%d]\tSpeed: %.2f samples/sec%s",
-                         param.epoch, count, speed, metrics)
+            logging.info("Epoch[%d] Batch [%d]\tSpeed: %.2f samples/sec "
+                         "(smoothed %.2f)%s",
+                         param.epoch, count, speed, self._ema, metrics)
         else:
-            logging.info("Iter[%d] Batch [%d]\tSpeed: %.2f samples/sec",
-                         param.epoch, count, speed)
+            logging.info("Iter[%d] Batch [%d]\tSpeed: %.2f samples/sec "
+                         "(smoothed %.2f)",
+                         param.epoch, count, speed, self._ema)
 
 
 class ProgressBar:
     """Batch-end callback: in-place text progress bar over ``total``
-    batches (reference ``callback.py`` ProgressBar)."""
+    batches (reference ``callback.py`` ProgressBar).
+
+    When ``nbatch`` reaches ``total`` a terminating newline is emitted
+    (once per fill) so the cursor does not stay parked on the bar line;
+    an ``nbatch`` drop (next epoch) re-arms the bar.  ``length`` and
+    ``total`` are clamped to >= 1 (an unknown batch count must not
+    divide by zero inside the fit loop's callback).
+    """
 
     def __init__(self, total, length=80):
-        self.total = total
-        self.length = length
+        self.total = max(1, int(total))
+        self.length = max(1, int(length))
+        self._done = False
+        self._last = None
 
     def __call__(self, param):
+        if self._last is not None and param.nbatch < self._last:
+            self._done = False  # new epoch: the bar restarts
+        self._last = param.nbatch
         frac = min(max(param.nbatch / float(self.total), 0.0), 1.0)
         filled = round(self.length * frac)
         bar = "=" * filled + "-" * (self.length - filled)
         sys.stdout.write("[%s] %d%%\r" % (bar, int(frac * 100 + 0.999999)))
+        if param.nbatch >= self.total and not self._done:
+            sys.stdout.write("\n")
+            self._done = True
+
+
+class TelemetryReport:
+    """Structured training report from the telemetry registry — the
+    replacement for eyeballing Speedometer lines (docs/observability.md).
+
+    Use one instance as BOTH callbacks::
+
+        report = mx.callback.TelemetryReport(frequent=50)
+        mod.fit(train, batch_end_callback=report,
+                epoch_end_callback=report.epoch, ...)
+
+    Every ``frequent`` batches it logs the per-phase step-time breakdown
+    (ms/batch of data wait, forward+backward, optimizer/kvstore sync,
+    metric — deltas since its last report, not lifetime averages) plus
+    transport and compile counter deltas.  At epoch end it samples
+    device/host memory, logs the epoch summary and, with ``dump_path``
+    set, rewrites the snapshot JSON there.  A no-op (with one hint log)
+    while telemetry is disabled.
+    """
+
+    _PHASES = ("data", "forward_backward", "update", "metric",
+               "bulk_step", "checkpoint")
+    _COUNTERS = ("kvstore.push.count", "kvstore.pull.count",
+                 "kvstore.reconnects", "xla.compile.count",
+                 "resilience.nan_batches", "resilience.recordio_skipped")
+
+    def __init__(self, frequent=50, logger=None, dump_path=None):
+        self.frequent = max(1, int(frequent))
+        self.logger = logger or logging.getLogger(__name__)
+        self.dump_path = dump_path
+        self._base = None  # (phase_totals, counter totals) at last report
+        self._hinted = False
+
+    def _delta(self):
+        phases = _telemetry.phase_totals("fit")
+        counters = {c: _telemetry.counter_total(c) for c in self._COUNTERS}
+        base = self._base or ({}, {c: 0 for c in self._COUNTERS})
+        self._base = (phases, counters)
+        dp = {}
+        for ph, (s, n) in phases.items():
+            s0, n0 = base[0].get(ph, (0.0, 0))
+            if n > n0:
+                dp[ph] = (s - s0, n - n0)
+        dc = {c: counters[c] - base[1].get(c, 0) for c in self._COUNTERS}
+        return dp, dc
+
+    def __call__(self, param):
+        if not _telemetry.enabled():
+            if not self._hinted:
+                self._hinted = True
+                self.logger.info(
+                    "TelemetryReport: telemetry is disabled — set "
+                    "MXNET_TELEMETRY=1 (or mx.telemetry.enable()) for "
+                    "per-phase reports")
+            return
+        if param.nbatch == 0 or param.nbatch % self.frequent != 0:
+            return
+        dp, dc = self._delta()
+        phase_txt = "  ".join(
+            "%s %.1fms" % (ph, 1e3 * s / n)
+            for ph, (s, n) in sorted(dp.items(),
+                                     key=lambda kv: -kv[1][0]))
+        counter_txt = "  ".join("%s +%d" % (c.split(".", 1)[1], d)
+                                for c, d in sorted(dc.items()) if d)
+        self.logger.info("Epoch[%d] Batch[%d] phases/batch: %s%s",
+                         param.epoch, param.nbatch,
+                         phase_txt or "(no phase data)",
+                         ("  |  " + counter_txt) if counter_txt else "")
+
+    def epoch(self, epoch, sym=None, arg=None, aux=None):
+        """Epoch-end half of the callback pair."""
+        if not _telemetry.enabled():
+            return
+        _telemetry.sample_memory()
+        totals = _telemetry.phase_totals("fit")
+        txt = "  ".join("%s %.2fs/%d" % (ph, s, n)
+                        for ph, (s, n) in sorted(totals.items(),
+                                                 key=lambda kv: -kv[1][0]))
+        rss = _telemetry.gauge_value("memory.host.max_rss_bytes")
+        self.logger.info(
+            "Epoch[%d] telemetry: %s%s", epoch, txt or "(no phase data)",
+            ("  |  host max RSS %.0f MB" % (rss / 1e6))
+            if rss and rss > 0 else "")
+        if self.dump_path:
+            _telemetry.dump(self.dump_path)

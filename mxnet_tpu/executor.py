@@ -26,12 +26,17 @@ kWriteTo/kAddTo/kNullOp; 'add' accumulates into the bound grad arrays.
 
 from __future__ import annotations
 
+import logging
+import os
+import time
+
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from . import profiler as _profiler
 from . import random as _random
+from . import telemetry as _telemetry
 from .base import MXNetError
 from .context import Context
 from .ndarray import NDArray, zeros as nd_zeros
@@ -42,18 +47,39 @@ class _DeviceHintFn:
     """Wraps an executor's jitted step so tracing (first call, or .lower)
     runs with ``ops.registry.trace_device`` set to the executor's device —
     device-dependent lowering (Pallas vs XLA) must follow the
-    computation's device, not the process-wide default backend."""
+    computation's device, not the process-wide default backend.
 
-    def __init__(self, fn, dev_type):
+    ``compile_note`` (a kind string, set only when telemetry is enabled at
+    build time) times the FIRST call — which pays jax tracing + XLA
+    compilation synchronously — into the ``xla.compile.*`` metrics; after
+    that the wrapper is a single attribute check per dispatch."""
+
+    def __init__(self, fn, dev_type, compile_note=None):
         self._fn = fn
         self._dev = dev_type
+        self._note = compile_note
 
     def __call__(self, *args, **kwargs):
+        if self._note is not None:
+            return self._first_call(args, kwargs)
         tok = _ops_registry.trace_device.set(self._dev)
         try:
             return self._fn(*args, **kwargs)
         finally:
             _ops_registry.trace_device.reset(tok)
+
+    def _first_call(self, args, kwargs):
+        note, self._note = self._note, None
+        tok = _ops_registry.trace_device.set(self._dev)
+        t0 = time.perf_counter()
+        try:
+            return self._fn(*args, **kwargs)
+        finally:
+            _ops_registry.trace_device.reset(tok)
+            dt = time.perf_counter() - t0
+            _telemetry.inc("xla.compile.seconds", dt, kind=note)
+            _telemetry.observe("xla.compile.first_call_seconds", dt,
+                               kind=note)
 
     def lower(self, *args, **kwargs):
         tok = _ops_registry.trace_device.set(self._dev)
@@ -329,6 +355,7 @@ class Executor:
         self._last_state = None
         self._rng_step = 0
         self._fns = {}
+        self._build_counts = {}  # program identity -> build count
         self._needs_rng = None
         self._rng_cache = None
         self._seg_chain = None
@@ -347,11 +374,52 @@ class Executor:
     def _diff_names(self):
         return [n for n in self.arg_names if self.grad_req[n] != "null"]
 
+    def _note_build(self, kind):
+        """Record one jitted-program build (``xla.compile.count``) and run
+        the recompilation detector.
+
+        Builds are counted per program *identity* — the executor kind
+        (``predict``/``train``/``train_sgd``/...; placement segments key
+        on ``(seg, index, is_train)``) with tuple-kind parameters and the
+        env fingerprint stripped — so each program's legitimate first
+        build counts once and only REbuilds of the same identity
+        accumulate: hyperparameters baked into a fused-step cache key,
+        env-fingerprint flips.  An identity built more than
+        ``MXNET_RECOMPILE_WARN_THRESHOLD`` times (default 8, 0 disables)
+        warns with the executor's name and bumps
+        ``xla.recompile_warnings``; a many-segment executor compiling
+        everything exactly once never trips it.  Returns the telemetry
+        compile-note for :class:`_DeviceHintFn` first-call timing (None
+        when disabled)."""
+        if isinstance(kind, str):
+            ident = kind_name = kind
+        elif kind[0] == "seg":  # ("seg", si, is_train, fingerprint)
+            ident = kind[:3]
+            kind_name = "seg"
+        else:
+            ident = kind_name = str(kind[0])
+        builds = self._build_counts[ident] = \
+            self._build_counts.get(ident, 0) + 1
+        limit = int(os.environ.get("MXNET_RECOMPILE_WARN_THRESHOLD", "8"))
+        if 0 < limit < builds:
+            logging.warning(
+                "executor %r compiled its %r program %d times (threshold "
+                "%d): recompilation churn — per-step hyperparameter "
+                "changes or env-fingerprint flips retrace/recompile every "
+                "time (MXNET_RECOMPILE_WARN_THRESHOLD tunes this)",
+                self._symbol_name(), kind_name, builds, limit)
+            _telemetry.inc("xla.recompile_warnings")
+        if not _telemetry.enabled():
+            return None
+        _telemetry.inc("xla.compile.count", kind=kind_name)
+        return kind_name
+
     def _get_fn(self, kind):
         # keyed on the trace-time env fingerprint: MXNET_BN_*/mirror/
         # barrier toggles must retrace, not silently reuse a stale jit
         cache_key = (kind, _ops_registry.trace_env_fingerprint())
         if cache_key in self._fns:
+            _telemetry.inc("xla.compile.cache_hits")
             return self._fns[cache_key]
         symbol = self._symbol
         arg_names = list(self.arg_names)
@@ -508,7 +576,7 @@ class Executor:
             fn = jax.jit(f)
         else:
             raise ValueError(kind)
-        fn = _DeviceHintFn(fn, self._ctx.device_type)
+        fn = _DeviceHintFn(fn, self._ctx.device_type, self._note_build(kind))
         self._fns[cache_key] = fn
         return fn
 
@@ -612,6 +680,7 @@ class Executor:
         key = ("seg", si, is_train,
                _ops_registry.trace_env_fingerprint())
         if key in self._fns:
+            _telemetry.inc("xla.compile.cache_hits")
             return self._fns[key]
         _dev, seg_nodes = self._segments[si]
         in_keys, out_keys = self._seg_io[si]
@@ -635,7 +704,8 @@ class Executor:
                         aux_updates.append((child.name, new))
             return [entry[k2] for k2 in out_keys], dict(aux_updates)
 
-        fn = _DeviceHintFn(jax.jit(f), _dev.device_type)
+        fn = _DeviceHintFn(jax.jit(f), _dev.device_type,
+                           self._note_build(key))
         self._fns[key] = fn
         return fn
 

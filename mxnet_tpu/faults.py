@@ -49,6 +49,7 @@ from __future__ import annotations
 import os
 import threading
 
+from . import telemetry as _telemetry
 from .base import MXNetError
 
 __all__ = ["POINTS", "FaultInjected", "arm", "disarm", "armed",
@@ -182,7 +183,11 @@ def should_fire(point):
         st.hits += 1
         if st.hits < st.at:
             return False
-        return st.count < 0 or st.hits < st.at + st.count
+        fire = st.count < 0 or st.hits < st.at + st.count
+    if fire:
+        _telemetry.inc("resilience.fault_injected", point=point)
+        _telemetry.event("fault_injected", point=point)
+    return fire
 
 
 def hits(point):

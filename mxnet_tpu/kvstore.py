@@ -30,9 +30,20 @@ import pickle
 import time as _time
 
 from . import faults as _faults
+from . import telemetry as _telemetry
 from .base import MXNetError, atomic_write_bytes as _atomic_write_bytes
 from .ndarray import NDArray, zeros
 from .retry import RetryPolicy, retry_call
+
+
+def _nd_nbytes(arr):
+    """Byte size of an NDArray/ndarray for the transport byte counters."""
+    import numpy as _np
+
+    try:
+        return int(arr.size) * _np.dtype(arr.dtype).itemsize
+    except TypeError:
+        return 0
 
 __all__ = ["KVStore", "KVStoreDist", "ConnectionLost", "create"]
 
@@ -107,6 +118,10 @@ class KVStore:
             if k not in self._store:
                 raise MXNetError("key %r not initialized" % k)
             merged = _merge_devices(vlist)
+            if _telemetry.enabled():
+                _telemetry.inc("kvstore.push.count", store=self._type)
+                _telemetry.inc("kvstore.push.bytes", _nd_nbytes(merged),
+                               store=self._type)
             if self._updater is not None:
                 self._updater(k, merged, self._store[k])
             else:
@@ -120,6 +135,11 @@ class KVStore:
         for k, olist in zip(keys, outs):
             if k not in self._store:
                 raise MXNetError("key %r not initialized" % k)
+            if _telemetry.enabled():
+                _telemetry.inc("kvstore.pull.count", store=self._type)
+                _telemetry.inc("kvstore.pull.bytes",
+                               _nd_nbytes(self._store[k]) * len(olist),
+                               store=self._type)
             for o in olist:
                 self._store[k].copyto(o)
 
@@ -263,7 +283,8 @@ class KVStoreDist(KVStore):
             socks.append(retry_call(
                 lambda sid=sid: _socket.create_connection(
                     (self._host, self._port + sid), timeout=300),
-                retry_on=(OSError,), policy=policy, start=start))
+                retry_on=(OSError,), policy=policy, start=start,
+                metric="kvstore.connect"))
         self._socks = socks
         self._sock = socks[0]  # scheduler
 
@@ -316,7 +337,7 @@ class KVStoreDist(KVStore):
             retry_on=(MXNetError, OSError),
             retry_if=_register_retryable,
             on_retry=lambda e, n: self._connect_all(policy, start),
-            policy=policy, start=start)
+            policy=policy, start=start, metric="kvstore.register")
         self._rank = reply["rank"]
         self._num_workers = reply["num_workers"]
         self.is_recovery = bool(reply.get("is_recovery", False))
@@ -339,7 +360,7 @@ class KVStoreDist(KVStore):
                 # than burning the whole connect deadline on it
                 retry_if=lambda e: isinstance(e, (ConnectionLost, OSError)),
                 on_retry=lambda e, n, sid=sid: self._reopen_sock(sid),
-                policy=policy, start=start)
+                policy=policy, start=start, metric="kvstore.announce")
         # command every server into the mode this type implies (reference
         # kvstore.cc:32-35: sync unless the type carries _async)
         for s in self._socks:
@@ -356,6 +377,8 @@ class KVStoreDist(KVStore):
         if self._rank is not None:
             self._preferred_rank = self._rank
         self._connect_and_register(rejoin=True)
+        _telemetry.inc("kvstore.reconnects")
+        _telemetry.event("kvstore.reconnect", rank=self._rank)
         # the next push() is the documented re-push of the batch that lost
         # its transport: let it skip the keys that were already acked
         self._repush_window = True
@@ -366,11 +389,13 @@ class KVStoreDist(KVStore):
             self._ps.send_msg(sock, msg)
             reply = self._ps.recv_msg(sock)
         except OSError as e:
+            _telemetry.inc("kvstore.connection_lost", cmd=msg.get("cmd"))
             raise ConnectionLost(
                 "kvstore transport failure during %r: %s "
                 "(reconnect() rejoins with the same rank)"
                 % (msg.get("cmd"), e))
         if reply is None:
+            _telemetry.inc("kvstore.connection_lost", cmd=msg.get("cmd"))
             raise ConnectionLost(
                 "kvstore server connection lost during %r "
                 "(reconnect() rejoins with the same rank)"
@@ -449,6 +474,8 @@ class KVStoreDist(KVStore):
             if k in already_acked:
                 acked.add(k)  # counted in the call that lost its transport
                 return
+            tele = _telemetry.enabled()
+            t0 = _time.perf_counter() if tele else 0.0
             try:
                 reply = self._rpc({"cmd": "push", "key": k, "value": value,
                                    "rank": self._rank,
@@ -457,6 +484,13 @@ class KVStoreDist(KVStore):
             except (ConnectionLost, OSError):
                 self._acked_in_failed_push = acked
                 raise
+            if tele:
+                _telemetry.observe("kvstore.push.seconds",
+                                   _time.perf_counter() - t0,
+                                   store=self._type)
+                _telemetry.inc("kvstore.push.count", store=self._type)
+                _telemetry.inc("kvstore.push.bytes", int(value.nbytes),
+                               store=self._type)
             self._push_seq[k] = self._push_seq.get(k, 0) + 1
             self._versions[k] = max(self._versions.get(k, 0),
                                     reply["version"])
@@ -480,6 +514,8 @@ class KVStoreDist(KVStore):
         keys, outs = _ctype_key_value(key, out)
         for k, olist in zip(keys, outs):
             size = int(_np.prod(olist[0].shape)) if olist else 0
+            tele = _telemetry.enabled()
+            t0 = _time.perf_counter() if tele else 0.0
             shards = self._shards(k, size)
             if shards is None:
                 reply = self._rpc({"cmd": "pull", "key": k,
@@ -500,6 +536,13 @@ class KVStoreDist(KVStore):
                         flat = _np.empty((size,), part.dtype)
                     flat[sl] = part
                 val = array(flat.reshape(olist[0].shape))
+            if tele:
+                _telemetry.observe("kvstore.pull.seconds",
+                                   _time.perf_counter() - t0,
+                                   store=self._type)
+                _telemetry.inc("kvstore.pull.count", store=self._type)
+                _telemetry.inc("kvstore.pull.bytes", _nd_nbytes(val),
+                               store=self._type)
             for o in olist:
                 val.copyto(o)
 
@@ -519,12 +562,14 @@ class KVStoreDist(KVStore):
     _set_updater = set_updater
 
     def barrier(self):
-        self._rpc({"cmd": "barrier", "rank": self._rank})
+        with _telemetry.phase("barrier", family="kvstore"):
+            self._rpc({"cmd": "barrier", "rank": self._rank})
 
     def heartbeat(self):
         """Liveness ping to the scheduler; returns its cluster view
         (``{"live": [ranks...], "num_workers": n}``) and refreshes this
         rank's last-seen time for dead-peer diagnosis."""
+        _telemetry.inc("kvstore.heartbeats")
         return self._rpc({"cmd": "heartbeat", "rank": self._rank})
 
     def send_command_to_servers(self, head, body):

@@ -66,6 +66,15 @@ def _pkg_mod(name):
     return mod
 
 
+def _tele():
+    """The telemetry module via sys.modules (import-lock-safe inside
+    handler threads, like ``_pkg_mod``); None when the package is not
+    fully imported (standalone ``python kvstore_server.py``)."""
+    if not __package__:
+        return None
+    return sys.modules.get("%s.telemetry" % __package__)
+
+
 class _SysUnpickler(pickle.Unpickler):
     """Unpickler that prefers sys.modules over __import__ (deadlock-safe
     inside handler threads; see _pkg_mod)."""
@@ -253,6 +262,9 @@ class KVStoreServer:
         if cmd == "heartbeat":
             # liveness ping: refreshes last_seen and reports the cluster
             # view so a worker can see who the server thinks is alive
+            t = _tele()
+            if t is not None:
+                t.inc("kvstore.server.heartbeats")
             with self.lock:
                 rank = msg.get("rank", getattr(conn, "rank", None))
                 if rank is not None:
@@ -320,6 +332,10 @@ class KVStoreServer:
 
     def _push(self, key, value, rank, client_round=None):
         value = np.asarray(value)
+        t = _tele()
+        if t is not None and t.enabled():
+            t.inc("kvstore.server.pushes", rank=rank)
+            t.inc("kvstore.server.push_bytes", int(value.nbytes))
         with self.lock:
             st = self.keys.get(key)
             if st is None:
@@ -371,6 +387,11 @@ class KVStoreServer:
                 seen = self.last_seen.get(rank)
                 seen_txt = "" if seen is None \
                     else ", last message %.1fs ago" % (now - seen)
+                t = _tele()
+                if t is not None:
+                    t.inc("kvstore.server.heartbeat_deaths", rank=rank)
+                    t.event("kvstore.heartbeat_death", rank=rank,
+                            dead_for_s=round(dead_for, 1))
                 raise _DeadPeer(
                     "worker rank %d lost: disconnected %.1fs ago%s "
                     "(> heartbeat deadline %.0fs)"

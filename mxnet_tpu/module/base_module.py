@@ -17,6 +17,7 @@ import numpy as np
 
 from .. import faults as _faults
 from .. import metric as _metric
+from .. import telemetry as _telemetry
 from ..base import MXNetError
 from ..model import BatchEndParam
 from ..initializer import Uniform
@@ -24,6 +25,17 @@ from ..initializer import Uniform
 __all__ = ["BaseModule"]
 
 _NAN_POLICIES = ("raise", "skip_batch", "rollback")
+
+#: end-of-iterator sentinel for the phase-timed batch loop (a data batch
+#: may legitimately be falsy, so ``None`` would be ambiguous)
+_FIT_END = object()
+
+#: resilience counters declared at zero when fit starts under telemetry,
+#: so the family is visible in ``snapshot()`` even for a clean run
+_RESILIENCE_COUNTERS = (
+    "resilience.nan_batches", "resilience.recordio_skipped",
+    "resilience.fault_injected", "resilience.checkpoint.saves",
+    "resilience.checkpoint.resumes", "resilience.rollbacks")
 
 
 def _as_metric(m):
@@ -179,6 +191,9 @@ class BaseModule:
                                            logger=self.logger)
             if found is not None:
                 ck_epoch, _ck_sym, ck_arg, ck_aux = found
+                _telemetry.inc("resilience.checkpoint.resumes")
+                _telemetry.event("checkpoint.resume", epoch=ck_epoch,
+                                 prefix=checkpoint_prefix)
                 begin_epoch = ck_epoch
                 arg_params, aux_params = ck_arg, ck_aux
                 force_init = True
@@ -242,6 +257,12 @@ class BaseModule:
                 "SGD, local/in-graph kvstore); training runs per batch",
                 bulk_k)
 
+        if _telemetry.enabled():
+            # declare the resilience family at zero so a clean run's
+            # snapshot still shows it (docs/observability.md)
+            for _c in _RESILIENCE_COUNTERS:
+                _telemetry.inc(_c, 0)
+
         for epoch in range(begin_epoch, num_epoch):
             tic = time.time()
             eval_metric.reset()
@@ -250,9 +271,11 @@ class BaseModule:
                 chunk = []
 
                 def _flush(chunk, nbatch):
-                    outs = self.run_bulk(chunk, return_outputs=True)
+                    with _telemetry.phase("bulk_step"):
+                        outs = self.run_bulk(chunk, return_outputs=True)
                     for i, b in enumerate(chunk):
                         nbatch += 1
+                        _telemetry.inc("fit.batches")
                         eval_metric.update(b.label, [o[i] for o in outs])
                         if batch_end_callback is not None:
                             bp = BatchEndParam(epoch=epoch, nbatch=nbatch,
@@ -262,7 +285,12 @@ class BaseModule:
                                 callback(bp)
                     return nbatch
 
-                for data_batch in train_data:
+                train_iter = iter(train_data)
+                while True:
+                    with _telemetry.phase("data"):
+                        data_batch = next(train_iter, _FIT_END)
+                    if data_batch is _FIT_END:
+                        break
                     chunk.append(data_batch)
                     if len(chunk) == bulk_k:
                         nbatch = _flush(chunk, nbatch)
@@ -270,10 +298,23 @@ class BaseModule:
                 if chunk:
                     nbatch = _flush(chunk, nbatch)
             else:
-                for nbatch, data_batch in enumerate(train_data):
+                train_iter = iter(train_data)
+                nbatch = -1
+                while True:
+                    # the four step phases (data wait / forward+backward /
+                    # optimizer+kvstore sync / metric) land in telemetry's
+                    # fit.phase_seconds and, when the profiler runs, as
+                    # chrome-trace spans.  JAX dispatch is async: device
+                    # compute time surfaces in the first blocking phase.
+                    with _telemetry.phase("data"):
+                        data_batch = next(train_iter, _FIT_END)
+                    if data_batch is _FIT_END:
+                        break
+                    nbatch += 1
                     if monitor is not None:
                         monitor.tic()
-                    self.forward_backward(data_batch)
+                    with _telemetry.phase("forward_backward"):
+                        self.forward_backward(data_batch)
                     if _faults.should_fire("fit.batch"):
                         self.logger.warning(
                             "fault 'fit.batch': poisoning gradients with "
@@ -285,6 +326,10 @@ class BaseModule:
                             and self._batch_has_nonfinite():
                         nan_detected = True
                         nan_action = nan_policy
+                        _telemetry.inc("resilience.nan_batches",
+                                       action=nan_policy)
+                        _telemetry.event("nan_batch", epoch=epoch,
+                                         batch=nbatch, action=nan_policy)
                         if nan_policy == "raise":
                             raise MXNetError(
                                 "NaN/Inf detected in loss/gradients at "
@@ -301,8 +346,12 @@ class BaseModule:
                                 "NaN/Inf at epoch %d batch %d: skipping "
                                 "batch", epoch, nbatch)
                     else:
-                        self.update()
-                        self.update_metric(eval_metric, data_batch.label)
+                        with _telemetry.phase("update"):
+                            self.update()
+                        with _telemetry.phase("metric"):
+                            self.update_metric(eval_metric,
+                                               data_batch.label)
+                    _telemetry.inc("fit.batches")
                     if monitor is not None:
                         monitor.toc_print()
                     if batch_end_callback is not None:
@@ -317,14 +366,18 @@ class BaseModule:
                 self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
             toc = time.time()
             self.logger.info("Epoch[%d] Time cost=%.3f", epoch, (toc - tic))
+            _telemetry.inc("fit.epochs")
+            _telemetry.set_gauge("fit.epoch_seconds", toc - tic)
+            _telemetry.sample_memory()
 
             arg_params_, aux_params_ = self.get_params()
             self.set_params(arg_params_, aux_params_)
             if checkpoint_prefix is not None and \
                     ((epoch + 1) % checkpoint_period == 0
                      or epoch + 1 == num_epoch):
-                self._save_fit_checkpoint(checkpoint_prefix, epoch + 1,
-                                          arg_params_, aux_params_)
+                with _telemetry.phase("checkpoint"):
+                    self._save_fit_checkpoint(checkpoint_prefix, epoch + 1,
+                                              arg_params_, aux_params_)
             if epoch_end_callback is not None:
                 for callback in _as_list(epoch_end_callback):
                     callback(epoch, self.symbol, arg_params_, aux_params_)
@@ -403,11 +456,14 @@ class BaseModule:
                 states, epoch)
         self.logger.info("rolled back parameters to checkpoint epoch %d",
                          epoch)
+        _telemetry.inc("resilience.rollbacks")
+        _telemetry.event("rollback", to_epoch=epoch, prefix=prefix)
         return epoch
 
     def _save_fit_checkpoint(self, prefix, epoch, arg_params, aux_params):
         """Per-epoch atomic checkpoint from inside fit (params + optimizer
         states when the module supports them + manifest)."""
+        _telemetry.inc("resilience.checkpoint.saves")
         if hasattr(self, "save_checkpoint"):
             self.save_checkpoint(
                 prefix, epoch,

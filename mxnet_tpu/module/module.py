@@ -21,6 +21,7 @@ import numpy as np
 
 from .. import optimizer as opt
 from .. import random as _random
+from .. import telemetry as _telemetry
 from ..base import MXNetError
 from ..context import Context, cpu
 from ..executor import Executor
@@ -682,7 +683,8 @@ class Module(BaseModule):
         if cached is not None and cached[0] == skey and None not in skey:
             stacks = cached[1]
         else:
-            stacks = [stack(n) for n in scan_names]
+            with _telemetry.phase("stack", family="bulk"):
+                stacks = [stack(n) for n in scan_names]
             self._bulk_stack_cache = (skey, stacks, keyed)
         names_set = set(names)
         static = [n for n in ex.arg_names
@@ -699,7 +701,10 @@ class Module(BaseModule):
         # donation; holding the concrete arrays would not)
         self._last_bulk_sig = (fn, jax.tree_util.tree_map(
             lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), call_args))
-        outs_stack, new_aux, new_p, new_m = fn(*call_args)
+        # host-side dispatch wall time (XLA executes async; device time
+        # shows up wherever the caller first blocks on results)
+        with _telemetry.phase("dispatch", family="bulk"):
+            outs_stack, new_aux, new_p, new_m = fn(*call_args)
         if outs_stack is not None:
             ex.outputs = [NDArray._from_jax(o[-1], ex._ctx)
                           for o in outs_stack]

@@ -67,19 +67,29 @@ def profiler_set_config(mode="symbolic", filename="profile.json",
 
 
 def profiler_set_state(state="stop"):
-    """reference ``python/mxnet/profiler.py:25`` (``MXSetProfilerState``)."""
+    """reference ``python/mxnet/profiler.py:25`` (``MXSetProfilerState``).
+
+    With a ``trace_dir`` configured, the jax profiler trace is started/
+    stopped BEFORE ``_state`` commits: if ``start_trace``/``stop_trace``
+    raises, the recorded state keeps describing reality (a failed start
+    leaves the profiler stopped; a failed stop leaves it running so stop
+    can be retried).  A second ``stop`` (or ``run``) is a no-op rather
+    than an unmatched ``stop_trace`` call.
+    """
     global _state
     if state not in (State.RUN, State.STOP):
         raise MXNetError("profiler state must be 'run' or 'stop'")
     prev = _state
-    _state = state
+    if state == prev:
+        return  # idempotent: nothing to transition, no trace calls
     if _trace_dir:
         import jax
 
-        if state == State.RUN and prev == State.STOP:
+        if state == State.RUN:
             jax.profiler.start_trace(_trace_dir)
-        elif state == State.STOP and prev == State.RUN:
+        else:
             jax.profiler.stop_trace()
+    _state = state
 
 
 def running():

@@ -33,6 +33,7 @@ from collections import namedtuple
 import numpy as np
 
 from . import faults as _faults
+from . import telemetry as _telemetry
 from .base import MXNetError
 
 __all__ = ["MXRecordIO", "MXIndexedRecordIO", "IRHeader",
@@ -59,6 +60,8 @@ def _note_skip(uri, pos, err):
     global _total_skipped
     with _skip_lock:
         _total_skipped += 1
+    _telemetry.inc("resilience.recordio_skipped")
+    _telemetry.event("recordio_skip", uri=uri, pos=pos, error=str(err))
     logging.warning("recordio: skipping corrupt record in %s near byte %d "
                     "(%s)", uri, pos, err)
 

@@ -12,6 +12,8 @@ from __future__ import annotations
 import random
 import time
 
+from . import telemetry as _telemetry
+
 __all__ = ["RetryPolicy", "retry_call"]
 
 
@@ -52,7 +54,7 @@ class RetryPolicy:
 
 
 def retry_call(fn, retry_on=(OSError,), policy=None, retry_if=None,
-               on_retry=None, start=None, **policy_kwargs):
+               on_retry=None, start=None, metric=None, **policy_kwargs):
     """Call ``fn()`` until it returns, retrying listed exceptions.
 
     Parameters
@@ -73,6 +75,10 @@ def retry_call(fn, retry_on=(OSError,), policy=None, retry_if=None,
         Deadline anchor.  Several ``retry_call``s sharing one ``start``
         share one absolute deadline (e.g. connect-to-N-servers then
         register, all within a single budget).
+    metric : str, optional
+        Telemetry site label: each retry bumps the ``retry.count`` and
+        ``retry.backoff_seconds`` counters labeled ``site=<metric>``
+        (no-op while telemetry is disabled).
 
     The deadline is measured from ``start`` (default: the first attempt);
     when it expires, the exception that caused the final retry propagates
@@ -97,6 +103,9 @@ def retry_call(fn, retry_on=(OSError,), policy=None, retry_if=None,
             if policy.deadline is not None \
                     and now + delay > start + policy.deadline:
                 raise
+            if metric is not None and _telemetry.enabled():
+                _telemetry.inc("retry.count", site=metric)
+                _telemetry.inc("retry.backoff_seconds", delay, site=metric)
             if on_retry is not None:
                 on_retry(e, attempt)
             time.sleep(delay)

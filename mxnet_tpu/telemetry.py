@@ -1,0 +1,407 @@
+"""Telemetry — the process-wide metrics registry + structured event log.
+
+The reference stack's observability is three disconnected point tools
+(the chrome-trace profiler, the per-tensor ``Monitor``, the
+``Speedometer`` log line); the TensorFlow system paper instead treats
+run-level metrics and tracing as a first-class subsystem.  This module
+is that subsystem for the TPU framework: every layer (``Module.fit``
+phase timing, KVStore transport, XLA compile tracking, resilience
+events, device memory) reports into ONE thread-safe registry, exposed as
+
+* ``snapshot()``   — nested dict (counters / gauges / histograms / events)
+* ``dump(path)``   — the snapshot as JSON
+* ``dump_events(path)`` — the structured event log as JSONL
+* ``prometheus_text()`` / ``write_prometheus(path)`` — Prometheus
+  text-exposition format (``mxnet_``-prefixed metric names)
+
+Cost model (the ``profiler.span.__init__`` trick): telemetry is OFF by
+default and every recording call checks one module-level boolean first,
+so a disabled counter bump is a single early-returning function call and
+a disabled :class:`phase` timer does no clock reads — instrumentation
+stays compiled into production hot paths at effectively zero cost
+(tests/test_telemetry.py pins the per-batch overhead).
+
+Enable with ``MXNET_TELEMETRY=1`` (or :func:`enable`).  Setting
+``MXNET_TELEMETRY_DUMP=path`` implies enablement and atexit-writes the
+snapshot JSON to ``path`` plus the event log to
+``<path-sans-ext>.events.jsonl``.
+
+Metric names are dotted families (``fit.*``, ``kvstore.*``, ``xla.*``,
+``resilience.*``, ``memory.*``); labels are free-form keyword arguments
+(``inc("kvstore.push.count", server=0)``).
+"""
+
+from __future__ import annotations
+
+import bisect
+import json
+import os
+import re
+import threading
+import time
+from collections import deque
+
+from . import profiler as _profiler
+
+__all__ = ["enabled", "enable", "disable", "inc", "set_gauge", "observe",
+           "event", "phase", "snapshot", "dump", "dump_events",
+           "prometheus_text", "write_prometheus", "reset", "sample_memory",
+           "phase_totals", "counter_total", "gauge_value"]
+
+#: default histogram bucket upper bounds (seconds-flavored; callers may
+#: pass their own on first ``observe`` of a metric)
+DEFAULT_BUCKETS = (1e-5, 1e-4, 1e-3, 1e-2, 0.1, 1.0, 10.0, 60.0)
+
+_lock = threading.Lock()
+_counters = {}   # (name, labels) -> float
+_gauges = {}     # (name, labels) -> float
+_hists = {}      # (name, labels) -> _Histogram
+_events = deque(maxlen=int(os.environ.get("MXNET_TELEMETRY_EVENTS_MAX",
+                                          "10000")))
+
+_enabled = (os.environ.get("MXNET_TELEMETRY", "0")
+            not in ("0", "", "false")
+            or bool(os.environ.get("MXNET_TELEMETRY_DUMP")))
+
+
+def enabled():
+    """True when the registry records (``MXNET_TELEMETRY=1`` or
+    :func:`enable`); the one check every hot path makes."""
+    return _enabled
+
+
+def enable():
+    global _enabled
+    _enabled = True
+
+
+def disable():
+    global _enabled
+    _enabled = False
+
+
+def _key(name, labels):
+    if not labels:
+        return (name, ())
+    return (name, tuple(sorted((k, str(v)) for k, v in labels.items())))
+
+
+# -- recording --------------------------------------------------------------
+def inc(name, value=1, **labels):
+    """Add ``value`` to counter ``name`` (``inc(name, 0)`` declares it at
+    zero so a family is visible in ``snapshot()`` before its first
+    increment)."""
+    if not _enabled:
+        return
+    k = _key(name, labels)
+    with _lock:
+        _counters[k] = _counters.get(k, 0) + value
+
+
+def set_gauge(name, value, **labels):
+    """Set gauge ``name`` to ``value`` (last write wins)."""
+    if not _enabled:
+        return
+    with _lock:
+        _gauges[_key(name, labels)] = value
+
+
+class _Histogram:
+    __slots__ = ("buckets", "counts", "count", "sum", "min", "max")
+
+    def __init__(self, buckets=DEFAULT_BUCKETS):
+        self.buckets = tuple(buckets)
+        self.counts = [0] * (len(self.buckets) + 1)  # +1: overflow (+Inf)
+        self.count = 0
+        self.sum = 0.0
+        self.min = None
+        self.max = None
+
+    def observe(self, v):
+        v = float(v)
+        self.counts[bisect.bisect_left(self.buckets, v)] += 1
+        self.count += 1
+        self.sum += v
+        if self.min is None or v < self.min:
+            self.min = v
+        if self.max is None or v > self.max:
+            self.max = v
+
+
+def observe(name, value, buckets=None, **labels):
+    """Record ``value`` into histogram ``name`` (bucket bounds fixed by
+    the first observation)."""
+    if not _enabled:
+        return
+    k = _key(name, labels)
+    with _lock:
+        h = _hists.get(k)
+        if h is None:
+            h = _hists[k] = _Histogram(buckets or DEFAULT_BUCKETS)
+        h.observe(value)
+
+
+def event(name, **fields):
+    """Append one structured event (``{"ts", "event", **fields}``) to the
+    in-memory JSONL log (bounded ring; ``dump_events`` exports)."""
+    if not _enabled:
+        return
+    rec = {"ts": round(time.time(), 6), "event": name}
+    rec.update(fields)
+    with _lock:
+        _events.append(rec)
+
+
+class phase:
+    """Time one training-loop phase: a histogram observation in
+    ``<family>.phase_seconds{phase=<name>}`` and — when the profiler is
+    running — a chrome-trace span via ``profiler.record``.
+
+    Disabled-cheap like ``profiler.span``: the enabled check happens once
+    in ``__init__`` and a disabled phase does no clock reads.  Note JAX
+    dispatch is asynchronous, so device compute time is attributed to the
+    first phase that blocks on results (see docs/observability.md).
+    """
+
+    __slots__ = ("_name", "_family", "_t0", "_on", "_prof")
+
+    def __init__(self, name, family="fit"):
+        self._prof = _profiler.running()
+        self._on = _enabled or self._prof
+        if self._on:
+            self._name = name
+            self._family = family
+
+    def __enter__(self):
+        if self._on:
+            self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        if self._on:
+            dt = time.perf_counter() - self._t0
+            if _enabled:
+                observe(self._family + ".phase_seconds", dt,
+                        phase=self._name)
+            if self._prof:
+                end = _profiler._now_us()
+                _profiler.record("%s:%s" % (self._family, self._name),
+                                 "phase", end - dt * 1e6, end)
+        return False
+
+
+# -- derived reads ----------------------------------------------------------
+def phase_totals(family="fit"):
+    """``{phase: (sum_seconds, count)}`` for one family's phase
+    histograms — the per-phase step-time breakdown consumers
+    (``TelemetryReport``, ``bench.py``) read."""
+    name = family + ".phase_seconds"
+    out = {}
+    with _lock:
+        for (n, labels), h in _hists.items():
+            if n == name:
+                out[dict(labels).get("phase", "")] = (h.sum, h.count)
+    return out
+
+
+def counter_total(name):
+    """Sum of counter ``name`` across all label sets (0 when absent)."""
+    with _lock:
+        return sum(v for (n, _), v in _counters.items() if n == name)
+
+
+def gauge_value(name, **labels):
+    """Current value of gauge ``name`` (None when unset)."""
+    with _lock:
+        return _gauges.get(_key(name, labels))
+
+
+# -- memory sampling --------------------------------------------------------
+def sample_memory():
+    """Sample device (HBM) memory stats from JAX into ``memory.device.*``
+    gauges, plus the host max-RSS so the memory family exists even on
+    backends (CPU) whose devices expose no ``memory_stats``."""
+    if not _enabled:
+        return
+    try:
+        import jax
+
+        devices = jax.local_devices()
+    except (ImportError, RuntimeError):
+        devices = []
+    for d in devices:
+        stats_fn = getattr(d, "memory_stats", None)
+        stats = None
+        if stats_fn is not None:
+            try:
+                stats = stats_fn()
+            except (RuntimeError, NotImplementedError):
+                stats = None  # backend without allocator stats
+        if not stats:
+            continue
+        for k in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit"):
+            if k in stats:
+                set_gauge("memory.device.%s" % k, stats[k],
+                          device=getattr(d, "id", 0))
+    try:
+        import resource
+        import sys
+
+        rss = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+        # ru_maxrss unit is kilobytes on Linux but bytes on macOS
+        if sys.platform != "darwin":
+            rss *= 1024
+        set_gauge("memory.host.max_rss_bytes", rss)
+    except (ImportError, ValueError, OSError):  # non-POSIX host
+        pass
+
+
+# -- exporters --------------------------------------------------------------
+def _label_str(labels):
+    return ",".join("%s=%s" % kv for kv in labels)
+
+
+def _hist_dict(h):
+    cum, acc = {}, 0
+    for b, c in zip(h.buckets, h.counts):
+        acc += c
+        cum["%g" % b] = acc
+    cum["+Inf"] = acc + h.counts[-1]
+    return {"count": h.count, "sum": h.sum, "min": h.min, "max": h.max,
+            "mean": (h.sum / h.count) if h.count else 0.0, "buckets": cum}
+
+
+def snapshot():
+    """The whole registry as a nested dict:
+    ``{enabled, counters: {name: {labels: v}}, gauges: {...},
+    histograms: {name: {labels: {count,sum,min,max,mean,buckets}}},
+    events: {count, recent}}``."""
+    with _lock:
+        counters, gauges, hists = {}, {}, {}
+        for (n, labels), v in sorted(_counters.items()):
+            counters.setdefault(n, {})[_label_str(labels)] = v
+        for (n, labels), v in sorted(_gauges.items()):
+            gauges.setdefault(n, {})[_label_str(labels)] = v
+        for (n, labels), h in sorted(_hists.items()):
+            hists.setdefault(n, {})[_label_str(labels)] = _hist_dict(h)
+        return {"enabled": _enabled, "counters": counters, "gauges": gauges,
+                "histograms": hists,
+                "events": {"count": len(_events),
+                           "recent": list(_events)[-100:]}}
+
+
+def dump(path):
+    """Write ``snapshot()`` as JSON; returns ``path``."""
+    with open(path, "w") as f:
+        json.dump(snapshot(), f, indent=1, default=str)
+    return path
+
+
+def dump_events(path):
+    """Write the structured event log as JSONL (one event per line);
+    returns ``path``."""
+    with _lock:
+        events = list(_events)
+    with open(path, "w") as f:
+        for rec in events:
+            f.write(json.dumps(rec, default=str))
+            f.write("\n")
+    return path
+
+
+def _prom_name(name):
+    s = re.sub(r"[^a-zA-Z0-9_]", "_", name)
+    return s if s.startswith("mxnet_") else "mxnet_" + s
+
+
+def _prom_esc(v):
+    return str(v).replace("\\", "\\\\").replace('"', '\\"') \
+        .replace("\n", "\\n")
+
+
+def _prom_labels(labels, extra=()):
+    items = list(labels) + list(extra)
+    if not items:
+        return ""
+    return "{%s}" % ",".join('%s="%s"' % (k, _prom_esc(v))
+                             for k, v in items)
+
+
+def _prom_num(v):
+    v = float(v)
+    return "%d" % int(v) if v.is_integer() else repr(v)
+
+
+def prometheus_text():
+    """The registry in Prometheus text-exposition format (counter /
+    gauge / histogram types, cumulative ``le`` buckets)."""
+    with _lock:
+        counters = sorted(_counters.items())
+        gauges = sorted(_gauges.items())
+        hists = sorted(_hists.items())
+    lines = []
+    for kind, store in (("counter", counters), ("gauge", gauges)):
+        seen = set()
+        for (name, labels), v in store:
+            pname = _prom_name(name)
+            if pname not in seen:
+                seen.add(pname)
+                lines.append("# TYPE %s %s" % (pname, kind))
+            lines.append("%s%s %s" % (pname, _prom_labels(labels),
+                                      _prom_num(v)))
+    seen = set()
+    for (name, labels), h in hists:
+        pname = _prom_name(name)
+        if pname not in seen:
+            seen.add(pname)
+            lines.append("# TYPE %s histogram" % pname)
+        acc = 0
+        for b, c in zip(h.buckets, h.counts):
+            acc += c
+            lines.append("%s_bucket%s %d" % (
+                pname, _prom_labels(labels, [("le", "%g" % b)]), acc))
+        lines.append("%s_bucket%s %d" % (
+            pname, _prom_labels(labels, [("le", "+Inf")]),
+            acc + h.counts[-1]))
+        lines.append("%s_sum%s %s" % (pname, _prom_labels(labels),
+                                      _prom_num(h.sum)))
+        lines.append("%s_count%s %d" % (pname, _prom_labels(labels),
+                                        h.count))
+    return "\n".join(lines) + "\n"
+
+
+def write_prometheus(path):
+    """Write :func:`prometheus_text` to ``path`` (e.g. for a node-exporter
+    textfile collector); returns ``path``."""
+    with open(path, "w") as f:
+        f.write(prometheus_text())
+    return path
+
+
+def reset():
+    """Clear all metrics and events (tests; enablement is unchanged)."""
+    with _lock:
+        _counters.clear()
+        _gauges.clear()
+        _hists.clear()
+        _events.clear()
+
+
+def _atexit_dump():  # pragma: no cover - exercised via subprocess test
+    path = os.environ.get("MXNET_TELEMETRY_DUMP")
+    if not path:
+        return
+    try:
+        dump(path)
+        dump_events(os.path.splitext(path)[0] + ".events.jsonl")
+    except OSError as e:
+        import logging
+
+        logging.warning("telemetry: could not write %r at exit: %s",
+                        path, e)
+
+
+if os.environ.get("MXNET_TELEMETRY_DUMP"):
+    import atexit
+
+    atexit.register(_atexit_dump)
