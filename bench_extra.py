@@ -469,9 +469,82 @@ def io_score(num_images=4096, batch=128):
     shutil.rmtree(tmpdir, ignore_errors=True)
 
 
+def serving_score(loads=(4, 16, 64), buckets=(1, 8, 32), in_dim=64,
+                  hidden=256, classes=100, reqs_per_client=24):
+    """Serving-subsystem offered-load sweep (docs/serving.md): N client
+    threads issue back-to-back single-sample requests through the
+    dynamic batcher (batch buckets 1/8/32); each load level records
+    sustained req/s plus p50/p99 request latency and how many device
+    dispatches the coalescing spent.  The trajectory row future PRs
+    watch: batching efficiency = requests/dispatch at load 64."""
+    import threading
+
+    from mxnet_tpu import serving
+
+    rs = np.random.RandomState(0)
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=hidden, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu", name="relu1")
+    net = mx.sym.FullyConnected(net, num_hidden=classes, name="fc2")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    params = {"fc1_weight": (rs.randn(hidden, in_dim) * 0.1)
+              .astype(np.float32),
+              "fc1_bias": np.zeros(hidden, np.float32),
+              "fc2_weight": (rs.randn(classes, hidden) * 0.1)
+              .astype(np.float32),
+              "fc2_bias": np.zeros(classes, np.float32)}
+    import io as _io
+
+    buf = _io.BytesIO()
+    np.savez(buf, **params)
+    reg = serving.ModelRegistry(batch_timeout_us=2000,
+                                max_queue_depth=4096)
+    model = reg.load("bench", net, buf.getvalue(), (in_dim,),
+                     buckets=buckets)
+    X = rs.rand(256, in_dim).astype(np.float32)
+    btag = "_".join(str(b) for b in buckets)
+    for load in loads:
+        lat = []
+        lat_lock = threading.Lock()
+        errors = []
+
+        def client(cid):
+            mine = []
+            for r in range(reqs_per_client):
+                t0 = time.perf_counter()
+                try:
+                    model.predict(X[(cid + r) % len(X)], timeout=120)
+                except Exception as e:
+                    errors.append(e)
+                    return
+                mine.append(time.perf_counter() - t0)
+            with lat_lock:
+                lat.extend(mine)
+
+        d0 = model.batcher.dispatches
+        threads = [threading.Thread(target=client, args=(c,))
+                   for c in range(load)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        if errors:
+            raise errors[0]
+        n = load * reqs_per_client
+        dispatches = model.batcher.dispatches - d0
+        row("serving_b%s_load%d" % (btag, load), n / wall, "req/sec",
+            p50_ms=round(float(np.percentile(lat, 50)) * 1e3, 3),
+            p99_ms=round(float(np.percentile(lat, 99)) * 1e3, 3),
+            dispatches=dispatches,
+            reqs_per_dispatch=round(n / max(1, dispatches), 2))
+    reg.close()
+
+
 def main():
     which = set((sys.argv[1].split(",") if len(sys.argv) > 1 else
-                 ["infer", "train", "lstm", "ssd", "io"]))
+                 ["infer", "train", "lstm", "ssd", "io", "serving"]))
     if "io" in which:
         io_score()
     if "infer" in which:
@@ -497,6 +570,8 @@ def main():
         lstm_batch_scaling()
     if "ssd" in which:
         ssd_score()
+    if "serving" in which:
+        serving_score()
     print("done: %d rows this run (persisted incrementally)" % len(ROWS))
 
 

@@ -9,6 +9,9 @@ python ci/check_bare_except.py
 # observability lint: framework output goes through logging/telemetry,
 # never bare print (bench.py's stdout is a one-JSON-line contract)
 python ci/check_print.py
+# docs lint: every MXNET_* env var read in the framework is documented
+# in docs/how_to/env_var.md
+python ci/check_env_docs.py
 if command -v g++ > /dev/null; then
   g++ -O2 -shared -fPIC -std=c++17 -o libmxnet_tpu_native.so \
       src/native.cc -lpthread

@@ -60,6 +60,7 @@ from . import models
 from . import log
 from . import operator
 from . import predict
+from . import serving
 from . import profiler
 from . import rtc
 from . import torch_bridge

@@ -23,6 +23,12 @@ point                  effect when it fires
                          overflow), exercising the NaN-policy guards
 ``recordio.read``        the Nth ``MXRecordIO.read`` behaves as if the
                          record's magic were corrupt
+``serving.dispatch``     the Nth batched serving dispatch dies before the
+                         device call — every request in that batch gets the
+                         error; the batcher worker survives
+``serving.model.write``  the Nth ``serving.save_model`` publish dies with
+                         the manifest half-written (truncated, never
+                         renamed) — a publisher crash mid-publish
 =====================  =====================================================
 
 Arming — programmatic::
@@ -58,7 +64,7 @@ __all__ = ["POINTS", "FaultInjected", "arm", "disarm", "armed",
 #: the injection points the framework consults (``arm`` validates against
 #: this so a typo'd point fails loudly instead of never firing)
 POINTS = ("kvstore.push.socket", "checkpoint.write", "fit.batch",
-          "recordio.read")
+          "recordio.read", "serving.dispatch", "serving.model.write")
 
 
 class FaultInjected(MXNetError):

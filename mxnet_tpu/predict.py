@@ -15,12 +15,15 @@ cache exactly like the reference's shared-memory rebind.
 
 from __future__ import annotations
 
+import collections as _collections
 import io as _io
+import os as _os
 
 import numpy as np
 
 from . import ndarray as nd
 from . import symbol as _symbol
+from . import telemetry as _telemetry
 from .base import MXNetError
 from .context import Context, cpu
 
@@ -80,12 +83,56 @@ class Predictor:
                     k = k[4:]
                 params[k] = v
         self._params = params
+        try:
+            cap = int(_os.environ.get("MXNET_PRED_CACHE_SIZE", "16"))
+        except ValueError:
+            cap = 16
+        #: bound on retained shape-specialized executors (each holds one
+        #: compiled XLA program + its device buffers).  0 disables
+        #: caching: every reshape rebinds and recompiles, the pre-LRU
+        #: behavior.
+        self._cache_cap = max(0, cap)
+        self._exec_cache = _collections.OrderedDict()
         self._bind(dict(input_shapes))
 
-    def _bind(self, input_shapes):
+    @staticmethod
+    def _shape_key(shapes):
+        return tuple(sorted((k, tuple(v)) for k, v in shapes.items()))
+
+    @staticmethod
+    def _is_weight(name, input_shapes):
+        return name not in input_shapes \
+            and not (name == "label" or name.endswith("_label"))
+
+    def _bind(self, input_shapes, _from_exec=None):
+        """Bind for ``input_shapes`` through the bounded shape-keyed
+        executor cache (LRU, ``MXNET_PRED_CACHE_SIZE``, default 16).
+
+        Under real traffic with varied shapes the unbounded reference
+        behavior — every distinct shape compiles an executor retained
+        forever — is an OOM; the LRU keeps the jit cache (and its device
+        buffers) bounded while round-robin over a declared bucket set
+        (serving) stays all-hits after warm-up."""
         self._input_shapes = dict(input_shapes)
-        self._exec = self._sym.simple_bind(self._ctx, grad_req="null",
-                                           **self._input_shapes)
+        key = self._shape_key(self._input_shapes)
+        cached = self._exec_cache.pop(key, None)
+        if cached is not None:
+            self._exec_cache[key] = cached  # re-insert as most recent
+            self._exec = cached
+            _telemetry.inc("predict.cache.hits")
+        else:
+            self._exec = self._sym.simple_bind(self._ctx, grad_req="null",
+                                               **self._input_shapes)
+            _telemetry.inc("predict.cache.misses")
+            if self._cache_cap > 0:
+                self._exec_cache[key] = self._exec
+                while len(self._exec_cache) > self._cache_cap:
+                    self._exec_cache.popitem(last=False)
+                    _telemetry.inc("predict.cache.evictions")
+        if _from_exec is not None:
+            if _from_exec is not self._exec:
+                self._transfer_state(_from_exec, self._exec)
+            return
         arg_names = set(self._exec.arg_dict)
         aux_names = set(self._exec.aux_dict)
         for k, v in self._params.items():
@@ -104,6 +151,18 @@ class Predictor:
         if missing and self._params:
             raise MXNetError("predictor: params blob is missing %s"
                              % sorted(missing))
+
+    def _transfer_state(self, src, dst):
+        """Carry weights/aux from executor ``src`` into ``dst`` by device
+        buffer handoff — weight shapes are batch-independent, so this is
+        reference-sharing, not a host round trip (the reference's
+        MXPredReshape keeps the arg arrays for the same reason)."""
+        for k, v in src.arg_dict.items():
+            if self._is_weight(k, self._input_shapes) and k in dst.arg_dict:
+                dst.arg_dict[k]._jx = v._jx
+        for k, v in src.aux_dict.items():
+            if k in dst.aux_dict:
+                dst.aux_dict[k]._jx = v._jx
 
     # -- the C ABI surface -------------------------------------------------
     def set_input(self, key, data):
@@ -128,27 +187,30 @@ class Predictor:
         return tuple(self._exec.outputs[index].shape)
 
     def get_output(self, index=0):
-        """MXPredGetOutput — returns numpy (the C API copies out)."""
-        return self._exec.outputs[index].asnumpy()
+        """MXPredGetOutput — returns numpy (the C API copies out).
+
+        Always an owning copy: ``asnumpy`` over a CPU jax buffer can be a
+        zero-copy view, and the underlying executor buffer may be donated
+        or reused by the next ``forward`` — a held output must not change
+        retroactively when the predictor serves the next request."""
+        out = self._exec.outputs[index].asnumpy()
+        if not out.flags["OWNDATA"] or not out.flags["WRITEABLE"]:
+            out = out.copy()
+        return out
 
     def reshape(self, new_input_shapes):
         """MXPredReshape — rebind under the shape-keyed jit cache; params
-        are retained (c_predict_api.cc keeps the arg arrays)."""
+        are retained (c_predict_api.cc keeps the arg arrays).  A shape
+        seen within the last ``MXNET_PRED_CACHE_SIZE`` distinct shapes
+        reuses its compiled executor (no retrace)."""
         shapes = dict(self._input_shapes)
         shapes.update(new_input_shapes)
-        # current weights (possibly mutated via set_input on weights);
-        # labels are batch-shaped dead inputs, not weights
-        for k, v in self._exec.arg_dict.items():
-            if k not in self._input_shapes \
-                    and not (k == "label" or k.endswith("_label")):
-                self._params[k] = v.asnumpy()
-        for k, v in self._exec.aux_dict.items():
-            self._params[k] = v.asnumpy()
-        self._bind(shapes)
+        self._bind(shapes, _from_exec=self._exec)
 
     def free(self):
         """MXPredFree"""
         self._exec = None
+        self._exec_cache.clear()
 
 
 def create(symbol_json, param_blob, input_shapes, ctx=None):

@@ -1,0 +1,25 @@
+"""Serving subsystem — a throughput-oriented model server over the
+single-request :class:`~mxnet_tpu.predict.Predictor`.
+
+Three layers (see ``docs/serving.md``):
+
+* :mod:`~mxnet_tpu.serving.batcher` — dynamic micro-batching with
+  shape-bucket padding, per-request deadlines, and typed
+  :class:`Overloaded` admission control;
+* :mod:`~mxnet_tpu.serving.registry` — versioned multi-model registry
+  with atomic publish (checksummed manifest-last), atomic reload, and
+  per-bucket warm-up compilation at load time;
+* :mod:`~mxnet_tpu.serving.frontend` — in-process handle + stdlib HTTP
+  JSON endpoint (``/predict``, ``/healthz``, ``/metrics``).
+"""
+
+from .batcher import (BATCH_SIZE_BUCKETS, LATENCY_BUCKETS, DeadlineExceeded,
+                      DynamicBatcher, Future, InvalidRequest, Overloaded)
+from .frontend import ServingHandle, ServingHTTPServer
+from .registry import (MANIFEST, ModelRegistry, ServedModel, UnknownModel,
+                       save_model)
+
+__all__ = ["DynamicBatcher", "Future", "Overloaded", "DeadlineExceeded",
+           "InvalidRequest", "LATENCY_BUCKETS", "BATCH_SIZE_BUCKETS",
+           "ModelRegistry", "ServedModel", "UnknownModel", "save_model",
+           "MANIFEST", "ServingHandle", "ServingHTTPServer"]

@@ -1,0 +1,227 @@
+"""Serving frontends: in-process handle + stdlib HTTP/JSON endpoint.
+
+:class:`ServingHandle` is the zero-copy in-process surface (what an
+embedding application calls).  :class:`ServingHTTPServer` exposes the
+same registry over ``http.server`` — no web framework, matching the
+repo's no-new-deps rule — with three routes:
+
+* ``POST /predict`` — ``{"model": name, "data": nested-list,
+  "deadline_ms": optional}`` → ``{"model", "version", "shape",
+  "output"}``; typed failures map to HTTP: :class:`Overloaded` → 429,
+  :class:`DeadlineExceeded` → 504, :class:`UnknownModel` → 404.
+* ``GET /healthz`` — liveness + the loaded model/version table.
+* ``GET /metrics`` — the process-wide telemetry registry in Prometheus
+  text exposition (PR 2's ``telemetry.prometheus_text``), scrapable.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+from .. import telemetry as _telemetry
+from .batcher import (DeadlineExceeded, DynamicBatcher, InvalidRequest,
+                      Overloaded)
+from .registry import UnknownModel
+
+__all__ = ["ServingHandle", "ServingHTTPServer"]
+
+_log = logging.getLogger("mxnet_tpu.serving")
+
+
+class ServingHandle:
+    """In-process serving facade over a
+    :class:`~mxnet_tpu.serving.registry.ModelRegistry`."""
+
+    def __init__(self, registry):
+        self.registry = registry
+
+    def predict(self, model, data, deadline_ms=None,
+                timeout=DynamicBatcher.DEFAULT_TIMEOUT):
+        return self.registry.get(model).predict(
+            data, deadline_ms=deadline_ms, timeout=timeout)
+
+    def healthz(self):
+        return {"status": "ok",
+                "models": {m.name: m.version
+                           for m in self.registry.models()}}
+
+    def metrics_text(self):
+        return _telemetry.prometheus_text()
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "mxnet-tpu-serving/1.0"
+    protocol_version = "HTTP/1.1"
+    #: request-body cap: one request must not be able to OOM the server
+    max_body_bytes = 32 << 20
+
+    def log_message(self, fmt, *args):
+        # route through logging (operators filter), never bare stdout
+        _log.debug("%s %s", self.address_string(), fmt % args)
+
+    def _send(self, code, payload, content_type="application/json"):
+        body = payload if isinstance(payload, bytes) \
+            else json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _count(self):
+        # label cardinality stays bounded: scanner/bot paths must not
+        # mint one permanent counter entry per distinct URL
+        route = self.path if self.path in ("/predict", "/healthz",
+                                           "/metrics") else "other"
+        _telemetry.inc("serving.http.requests", route=route)
+
+    def do_GET(self):
+        handle = self.server.serving_handle
+        self._count()
+        if self.path == "/healthz":
+            self._send(200, handle.healthz())
+        elif self.path == "/metrics":
+            self._send(200, handle.metrics_text().encode(),
+                       content_type="text/plain; version=0.0.4")
+        else:
+            self._send(404, {"error": "unknown route %r" % self.path})
+
+    def _drain_body(self):
+        """Consume an unread request body so the keep-alive connection
+        stays in sync for the next request (oversized bodies close the
+        connection instead of stalling on a slow sender)."""
+        try:
+            length = int(self.headers.get("Content-Length") or 0)
+        except ValueError:
+            length = 0
+        if length > (1 << 20):
+            self.close_connection = True
+        elif length > 0:
+            self.rfile.read(length)
+
+    def do_POST(self):
+        self._count()
+        chunked = "chunked" in (self.headers.get("Transfer-Encoding")
+                                or "").lower()
+        if self.path != "/predict":
+            # an undrained body would desync this keep-alive connection
+            if chunked:
+                self.close_connection = True
+            else:
+                self._drain_body()
+            return self._send(404, {"error": "unknown route %r"
+                                    % self.path})
+        if chunked:
+            # we only read Content-Length bodies
+            self.close_connection = True
+            return self._send(411, {"error": "chunked bodies are not "
+                                    "supported; send Content-Length"})
+        try:
+            length = int(self.headers.get("Content-Length") or 0)
+        except ValueError:
+            length = -1
+        if not 0 <= length <= self.max_body_bytes:
+            # oversized/negative declarations must neither buffer the
+            # body in RAM nor pin the handler thread on a read
+            self.close_connection = True
+            return self._send(413, {"error": "Content-Length must be in "
+                                    "0..%d" % self.max_body_bytes})
+        try:
+            req = json.loads(self.rfile.read(length) or b"{}")
+            model = req["model"]
+            data = np.asarray(req["data"], np.float32)
+            deadline_ms = req.get("deadline_ms")
+            if deadline_ms is not None:
+                deadline_ms = float(deadline_ms)
+            timeout = float(req.get("timeout_s", 60.0))
+        except (ValueError, KeyError, TypeError) as e:
+            # the body may be partially read at this point; don't let the
+            # next pipelined request parse the remainder as a request line
+            self.close_connection = True
+            return self._send(400, {"error": "bad /predict request: %s"
+                                    % e})
+        handle = self.server.serving_handle
+        try:
+            # resolve ONCE: the version reported is the version that
+            # served, and a concurrent unload/reload can't turn a
+            # completed prediction into a 404
+            served = handle.registry.get(model)
+            out = served.predict(data, deadline_ms=deadline_ms,
+                                 timeout=timeout)
+            version = served.version
+        except InvalidRequest as e:
+            return self._send(400, {"error": str(e)})
+        except Overloaded as e:
+            return self._send(429, {"error": str(e)})
+        except DeadlineExceeded as e:
+            return self._send(504, {"error": str(e)})
+        except UnknownModel as e:
+            return self._send(404, {"error": str(e)})
+        except Exception as e:
+            # a dispatch error re-raised from the batch (numpy shape
+            # mismatch, injected fault, ...) must still produce an HTTP
+            # response on this keep-alive connection, never a handler
+            # crash with the client left hanging
+            return self._send(500, {"error": str(e)})
+        out = np.asarray(out)
+        self._send(200, {"model": model, "version": version,
+                         "shape": list(out.shape),
+                         "output": out.tolist()})
+
+
+class ServingHTTPServer:
+    """Threaded HTTP server over a registry; ``port=0`` binds an
+    ephemeral port (read ``.port`` after construction).
+
+    ::
+
+        server = ServingHTTPServer(registry, port=8080).start()
+        ...
+        server.stop()
+    """
+
+    def __init__(self, registry, host="127.0.0.1", port=8080):
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd.daemon_threads = True
+        self._httpd.serving_handle = ServingHandle(registry)
+        self._thread = None
+
+    @property
+    def host(self):
+        return self._httpd.server_address[0]
+
+    @property
+    def port(self):
+        return self._httpd.server_address[1]
+
+    @property
+    def url(self):
+        return "http://%s:%d" % (self.host, self.port)
+
+    def start(self):
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._httpd.serve_forever, name="serving-http",
+                daemon=True)
+            self._thread.start()
+            _log.info("serving: HTTP endpoint up at %s", self.url)
+        return self
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=10)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False

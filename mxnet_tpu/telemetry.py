@@ -46,7 +46,7 @@ from . import profiler as _profiler
 __all__ = ["enabled", "enable", "disable", "inc", "set_gauge", "observe",
            "event", "phase", "snapshot", "dump", "dump_events",
            "prometheus_text", "write_prometheus", "reset", "sample_memory",
-           "phase_totals", "counter_total", "gauge_value"]
+           "phase_totals", "counter_total", "gauge_value", "hist_quantile"]
 
 #: default histogram bucket upper bounds (seconds-flavored; callers may
 #: pass their own on first ``observe`` of a metric)
@@ -214,6 +214,31 @@ def gauge_value(name, **labels):
     """Current value of gauge ``name`` (None when unset)."""
     with _lock:
         return _gauges.get(_key(name, labels))
+
+
+def hist_quantile(name, q, **labels):
+    """Estimate the ``q``-quantile (0..1) of histogram ``name`` from its
+    bucket counts — linear interpolation inside the target bucket, the
+    observed min/max capping the first/overflow buckets.  What the
+    serving layer's p50/p99 reads (and Prometheus' ``histogram_quantile``
+    would compute from the same exposition); None when unobserved."""
+    with _lock:
+        h = _hists.get(_key(name, labels))
+        if h is None or h.count == 0:
+            return None
+        target = q * h.count
+        acc = 0
+        lo = h.min
+        for b, c in zip(h.buckets, h.counts):
+            if acc + c >= target:
+                if c == 0:
+                    return min(lo, h.max)
+                frac = (target - acc) / c
+                return min(lo + (min(b, h.max) - lo) * max(0.0, frac),
+                           h.max)
+            acc += c
+            lo = max(lo, b)
+        return h.max  # overflow bucket: cap at the observed max
 
 
 # -- memory sampling --------------------------------------------------------

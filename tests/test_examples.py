@@ -39,6 +39,12 @@ def test_train_mnist(tmp_path):
     assert "Validation-accuracy" in out
 
 
+def test_serving_example(tmp_path):
+    out = _run("serving/serve_mlp.py")
+    assert "serving-demo-ok" in out
+    assert "0 recompiles" in out
+
+
 def test_custom_op_example(tmp_path):
     out = _run("numpy-ops/custom_softmax.py", "--num-epochs", "2")
     assert "Train-accuracy" in out

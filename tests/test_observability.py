@@ -257,3 +257,38 @@ def test_check_print_clean_on_framework_tree():
         [sys.executable, os.path.join(ROOT, "ci", "check_print.py")],
         capture_output=True, text=True)
     assert proc.returncode == 0, proc.stdout
+
+
+# -- ci/check_env_docs --------------------------------------------------------
+
+def _run_check_env_docs(*paths):
+    return subprocess.run(
+        [sys.executable, os.path.join(ROOT, "ci", "check_env_docs.py")]
+        + [str(p) for p in paths], capture_output=True, text=True)
+
+
+def test_check_env_docs_flags_undocumented_var(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text('import os\n'
+                   'x = os.environ.get("MXNET_SURELY_UNDOCUMENTED_KNOB")\n')
+    proc = _run_check_env_docs(bad)
+    assert proc.returncode == 1
+    assert "MXNET_SURELY_UNDOCUMENTED_KNOB" in proc.stdout
+    assert "bad.py:2" in proc.stdout
+
+
+def test_check_env_docs_ignores_prose_and_noqa(tmp_path):
+    ok = tmp_path / "ok.py"
+    ok.write_text(
+        '"""Docstring mentioning MXNET_FAKE_DOCSTRING_ONLY is fine."""\n'
+        '# comment: MXNET_FAKE_COMMENT_ONLY never trips AST constants\n'
+        'y = os_environ_like("MXNET_FAKE_EXEMPTED")  # noqa: test-only\n')
+    assert _run_check_env_docs(ok).returncode == 0, \
+        _run_check_env_docs(ok).stdout
+
+
+def test_check_env_docs_clean_on_framework_tree():
+    """The canonical env-var doc covers every MXNET_* read in mxnet_tpu/
+    (the drift this checker exists to stop)."""
+    proc = _run_check_env_docs()
+    assert proc.returncode == 0, proc.stdout

@@ -83,6 +83,91 @@ def test_predictor_partial_out(tmp_path):
     assert pred.get_output_shape(0) == (4, 16)
 
 
+def test_get_output_is_copy_safe_across_forwards(tmp_path):
+    """MXPredGetOutput copies out: an output held across the next
+    forward must not change retroactively when the executor buffer is
+    donated/reused (ISSUE 3 regression)."""
+    net, prefix, X, y = _train_tiny(tmp_path)
+    symbol_json = open(prefix + "-symbol.json").read()
+    pred = predict.Predictor(symbol_json, prefix + "-0003.params",
+                             {"data": (4, 8)})
+    pred.set_input("data", X[:4])
+    pred.forward()
+    out1 = pred.get_output(0)
+    held = out1.copy()
+    pred.set_input("data", X[4:8])  # different rows -> different outputs
+    pred.forward()
+    out2 = pred.get_output(0)
+    assert not np.allclose(out1, out2)
+    assert_almost_equal(out1, held, rtol=0, atol=0)
+    # an owning, writable array — the C-API copy-out contract
+    assert out1.flags["OWNDATA"] and out1.flags["WRITEABLE"]
+    out1[:] = 0.0  # must not alias any live buffer
+    pred.forward()
+    assert_almost_equal(pred.get_output(0), out2, rtol=1e-6)
+
+
+def test_reshape_cache_hits_and_lru_eviction(tmp_path, monkeypatch):
+    """The shape-keyed executor cache is LRU-bounded by
+    MXNET_PRED_CACHE_SIZE: revisited shapes rebind without recompiling,
+    shapes pushed out of the window recompile (but stay correct)."""
+    from mxnet_tpu import telemetry
+
+    net, prefix, X, y = _train_tiny(tmp_path)
+    symbol_json = open(prefix + "-symbol.json").read()
+    monkeypatch.setenv("MXNET_PRED_CACHE_SIZE", "2")
+    telemetry.reset()
+    telemetry.enable()
+    try:
+        pred = predict.Predictor(symbol_json, prefix + "-0003.params",
+                                 {"data": (2, 8)})
+        pred.set_input("data", X[:2])
+        pred.forward()
+        ref2 = pred.get_output(0)
+
+        pred.reshape({"data": (4, 8)})      # miss: 2 shapes cached
+        pred.set_input("data", X[:4])
+        pred.forward()
+        ref4 = pred.get_output(0)
+
+        pred.reshape({"data": (2, 8)})      # hit: within the window
+        assert telemetry.counter_total("predict.cache.hits") == 1
+        pred.set_input("data", X[:2])
+        pred.forward()
+        assert_almost_equal(pred.get_output(0), ref2, rtol=1e-5)
+
+        pred.reshape({"data": (6, 8)})      # miss: evicts LRU (4, 8)
+        assert telemetry.counter_total("predict.cache.evictions") == 1
+        pred.reshape({"data": (4, 8)})      # miss again: was evicted
+        assert telemetry.counter_total("predict.cache.misses") == 4
+        pred.set_input("data", X[:4])
+        pred.forward()
+        # weights survived the whole eviction/rebind churn
+        assert_almost_equal(pred.get_output(0), ref4, rtol=1e-5)
+        assert len(pred._exec_cache) == 2
+    finally:
+        telemetry.disable()
+        telemetry.reset()
+
+
+def test_pred_cache_size_zero_disables_caching(tmp_path, monkeypatch):
+    net, prefix, X, y = _train_tiny(tmp_path)
+    symbol_json = open(prefix + "-symbol.json").read()
+    monkeypatch.setenv("MXNET_PRED_CACHE_SIZE", "0")
+    pred = predict.Predictor(symbol_json, prefix + "-0003.params",
+                             {"data": (2, 8)})
+    assert len(pred._exec_cache) == 0
+    pred.set_input("data", X[:2])
+    pred.forward()
+    ref = pred.get_output(0)
+    pred.reshape({"data": (4, 8)})
+    pred.reshape({"data": (2, 8)})  # rebind, no retention
+    assert len(pred._exec_cache) == 0
+    pred.set_input("data", X[:2])
+    pred.forward()
+    assert_almost_equal(pred.get_output(0), ref, rtol=1e-5)
+
+
 def test_predictor_missing_params_raises(tmp_path):
     net, prefix, X, y = _train_tiny(tmp_path)
     symbol_json = open(prefix + "-symbol.json").read()

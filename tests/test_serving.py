@@ -1,0 +1,448 @@
+"""Serving subsystem (docs/serving.md): dynamic batcher coalescing and
+timeout flush, shape-bucket padding correctness, deadline/overload
+shedding, registry atomic publish/reload under fault injection, the HTTP
+frontend, and the headline acceptance demo (64 concurrent requests ->
+ceil(64/32) dispatches, zero recompiles after warm-up)."""
+
+import io
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import faults, predict, serving, telemetry
+from mxnet_tpu.serving import (DeadlineExceeded, DynamicBatcher,
+                               ModelRegistry, Overloaded, ServingHTTPServer,
+                               UnknownModel, save_model)
+
+IN_DIM = 8
+CLASSES = 4
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    """Enabled, empty telemetry + disarmed faults per test (serving
+    acceptance reads counters; faults must never leak across tests)."""
+    faults.disarm()
+    telemetry.reset()
+    telemetry.enable()
+    yield
+    faults.disarm()
+    telemetry.disable()
+    telemetry.reset()
+
+
+def _mlp(seed=0, hidden=16):
+    """Tiny MLP symbol + params blob (npz container, the predictor's
+    fallback format) — no training needed for serving-layer tests."""
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=hidden, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu", name="relu1")
+    net = mx.sym.FullyConnected(net, num_hidden=CLASSES, name="fc2")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    rs = np.random.RandomState(seed)
+    params = {
+        "fc1_weight": (rs.randn(hidden, IN_DIM) * 0.3).astype(np.float32),
+        "fc1_bias": rs.randn(hidden).astype(np.float32) * 0.1,
+        "fc2_weight": (rs.randn(CLASSES, hidden) * 0.3).astype(np.float32),
+        "fc2_bias": rs.randn(CLASSES).astype(np.float32) * 0.1,
+    }
+    buf = io.BytesIO()
+    np.savez(buf, **params)
+    return net, buf.getvalue()
+
+
+def _reference_outputs(sym, blob, X):
+    """Ground truth at the request's exact shape, outside the serving
+    stack."""
+    p = predict.Predictor(sym, blob, {"data": X.shape})
+    p.set_input("data", X)
+    p.forward()
+    out = p.get_output(0)
+    p.free()
+    return out
+
+
+# -- batcher ----------------------------------------------------------------
+
+def test_batcher_coalesces_prequeued_requests():
+    """64 queued single-row requests drain in exactly ceil(64/32)=2
+    full-bucket dispatches."""
+    shapes = []
+
+    def dispatch(rows):
+        shapes.append(rows.shape)
+        return rows * 2.0
+
+    b = DynamicBatcher(dispatch, buckets=(1, 8, 32), max_queue_depth=64)
+    X = np.arange(64, dtype=np.float32).reshape(64, 1)
+    futs = [b.submit(X[i:i + 1]) for i in range(64)]
+    b.start()
+    outs = [f.result(timeout=30) for f in futs]
+    b.stop()
+    assert b.dispatches == 2
+    assert shapes == [(32, 1), (32, 1)]
+    got = np.concatenate(outs)
+    np.testing.assert_allclose(got, X * 2.0)
+    assert telemetry.counter_total("serving.request.count") == 64
+    assert telemetry.counter_total("serving.dispatch.count") == 2
+
+
+def test_batcher_timeout_flushes_partial_batch():
+    """A non-full batch dispatches after batch_timeout_us, padded to its
+    bucket."""
+    shapes = []
+
+    def dispatch(rows):
+        shapes.append(rows.shape)
+        return rows + 1.0
+
+    b = DynamicBatcher(dispatch, buckets=(1, 8, 32),
+                       batch_timeout_us=100_000).start()
+    futs = [b.submit(np.full((1, 2), float(i), np.float32))
+            for i in range(3)]
+    outs = [f.result(timeout=30) for f in futs]
+    b.stop()
+    assert b.dispatches == 1
+    assert shapes == [(8, 2)]  # 3 real rows padded to the 8 bucket
+    for i, o in enumerate(outs):
+        np.testing.assert_allclose(o, np.full((1, 2), i + 1.0))
+
+
+def test_batcher_multi_row_requests_and_head_of_line():
+    """Row batches coalesce by rows; an oversized next request waits for
+    the following dispatch instead of overflowing the bucket."""
+    sizes = []
+    b = DynamicBatcher(lambda rows: (sizes.append(rows.shape[0]),
+                                     rows)[1],
+                       buckets=(4,), max_queue_depth=64)
+    f1 = b.submit(np.zeros((3, 2), np.float32))
+    f2 = b.submit(np.zeros((2, 2), np.float32))  # 3+2 > 4: next batch
+    b.start()
+    assert f1.result(timeout=30).shape == (3, 2)
+    assert f2.result(timeout=30).shape == (2, 2)
+    b.stop()
+    assert sizes == [4, 4]  # 3-pad-1, then 2-pad-2
+    with pytest.raises(mx.MXNetError):
+        b.submit(np.zeros((5, 2), np.float32))  # > max_batch_size
+
+
+def test_deadline_expired_requests_are_shed():
+    b = DynamicBatcher(lambda rows: rows, buckets=(8,))
+    fut = b.submit(np.zeros((1, 2), np.float32), deadline_ms=1)
+    live = b.submit(np.zeros((1, 2), np.float32))
+    import time
+
+    time.sleep(0.05)  # let the 1ms deadline lapse while queued
+    b.start()
+    with pytest.raises(DeadlineExceeded):
+        fut.result(timeout=30)
+    assert live.result(timeout=30).shape == (1, 2)
+    b.stop()
+    snap = telemetry.snapshot()
+    assert snap["counters"]["serving.shed.count"][
+        "model=model,reason=deadline"] == 1
+
+
+def test_overload_fast_fails_with_typed_error():
+    b = DynamicBatcher(lambda rows: rows, buckets=(8,), max_queue_depth=4)
+    for _ in range(4):
+        b.submit(np.zeros((1, 2), np.float32))
+    with pytest.raises(Overloaded):
+        b.submit(np.zeros((1, 2), np.float32))
+    snap = telemetry.snapshot()
+    assert snap["counters"]["serving.shed.count"][
+        "model=model,reason=overload"] == 1
+    b.start()
+    b.stop()  # drains the 4 accepted requests
+
+
+def test_dispatch_fault_fails_batch_not_worker():
+    """An injected dispatch fault errors that batch's requests; the next
+    batch serves normally."""
+    b = DynamicBatcher(lambda rows: rows, buckets=(8,),
+                       batch_timeout_us=1000).start()
+    faults.arm("serving.dispatch", at=1)
+    bad = b.submit(np.zeros((1, 2), np.float32))
+    with pytest.raises(faults.FaultInjected):
+        bad.result(timeout=30)
+    good = b.submit(np.ones((1, 2), np.float32))
+    np.testing.assert_allclose(good.result(timeout=30), np.ones((1, 2)))
+    b.stop()
+    assert telemetry.counter_total("serving.error.count") == 1
+
+
+def test_mis_shaped_request_rejected_at_submit_worker_survives():
+    """A request with wrong feature dims gets a typed error at submit
+    (when the shape is declared) and can never kill the worker."""
+    b = DynamicBatcher(lambda rows: rows, buckets=(8,),
+                       feature_shape=(4,), batch_timeout_us=1000).start()
+    with pytest.raises(mx.MXNetError):
+        b.submit(np.zeros((1, 3), np.float32))  # 3 != declared 4
+    ok = b.submit(np.zeros((2, 4), np.float32))
+    assert ok.result(timeout=30).shape == (2, 4)
+    b.stop()
+
+
+def test_dispatch_assembly_failure_fails_batch_not_worker():
+    """Even without a declared feature shape, a poison batch (ragged
+    concat) errors its own futures; the next batch still serves."""
+    b = DynamicBatcher(lambda rows: rows, buckets=(8,),
+                       batch_timeout_us=50_000)
+    f1 = b.submit(np.zeros((1, 3), np.float32))
+    f2 = b.submit(np.zeros((1, 5), np.float32))  # ragged with f1
+    b.start()
+    with pytest.raises(ValueError):
+        f1.result(timeout=30)
+    with pytest.raises(ValueError):
+        f2.result(timeout=30)
+    good = b.submit(np.ones((1, 2), np.float32))
+    np.testing.assert_allclose(good.result(timeout=30), np.ones((1, 2)))
+    b.stop()
+
+
+def test_closed_batcher_fails_submits_fast():
+    b = DynamicBatcher(lambda rows: rows, buckets=(8,)).start()
+    b.close()
+    with pytest.raises(mx.MXNetError):
+        b.submit(np.zeros((1, 2), np.float32))
+
+
+# -- bucket padding correctness through a real model ------------------------
+
+def test_bucket_padding_does_not_change_real_outputs():
+    sym, blob = _mlp()
+    reg = ModelRegistry(batch_timeout_us=1000)
+    reg.load("mlp", sym, blob, (IN_DIM,), buckets=(1, 8, 32))
+    X = np.random.RandomState(3).rand(5, IN_DIM).astype(np.float32)
+    out = reg.get("mlp").predict(X, timeout=30)  # 5 rows -> 8 bucket
+    ref = _reference_outputs(sym, blob, X)
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+    # single-sample convenience: ndim == feature ndim wraps + unwraps
+    out1 = reg.get("mlp").predict(X[0], timeout=30)
+    np.testing.assert_allclose(out1, ref[0], rtol=1e-5, atol=1e-6)
+    reg.close()
+
+
+# -- registry ---------------------------------------------------------------
+
+def test_registry_versioned_reload_and_unload():
+    sym, blob = _mlp(seed=0)
+    sym2, blob2 = _mlp(seed=7)
+    reg = ModelRegistry(batch_timeout_us=1000)
+    m1 = reg.load("m", sym, blob, (IN_DIM,), buckets=(8,))
+    assert m1.version == 1
+    X = np.random.RandomState(0).rand(2, IN_DIM).astype(np.float32)
+    out1 = reg.get("m").predict(X, timeout=30)
+    m2 = reg.reload("m", sym2, blob2, (IN_DIM,), buckets=(8,))
+    assert m2.version == 2 and reg.get("m") is m2
+    out2 = reg.get("m").predict(X, timeout=30)
+    assert not np.allclose(out1, out2)  # genuinely the new weights
+    # a straggler holding the replaced version fails fast, never hangs
+    with pytest.raises(mx.MXNetError):
+        m1.predict(X, timeout=30)
+    reg.unload("m")
+    with pytest.raises(UnknownModel):
+        reg.get("m")
+    with pytest.raises(UnknownModel):
+        reg.unload("m")
+    reg.close()
+
+
+def test_registry_atomic_reload_under_mid_write_fault(tmp_path):
+    """A publisher crash mid-manifest-write must leave the previous
+    version serving AND fully loadable from disk: payloads are
+    version-qualified and the checksummed manifest is written last, so
+    the torn v2 publish is invisible to readers."""
+    d = str(tmp_path / "model")
+    sym, blob = _mlp(seed=0)
+    sym2, blob2 = _mlp(seed=7)
+    save_model(d, sym, blob, (IN_DIM,), buckets=(1, 8), version=1,
+               name="m")
+    reg = ModelRegistry(batch_timeout_us=1000)
+    reg.load_dir(d)
+    X = np.random.RandomState(1).rand(3, IN_DIM).astype(np.float32)
+    out1 = reg.get("m").predict(X, timeout=30)
+
+    faults.arm("serving.model.write", at=1)
+    with pytest.raises(faults.FaultInjected):
+        save_model(d, sym2, blob2, (IN_DIM,), buckets=(1, 8), version=2,
+                   name="m")
+    # v2 payloads landed under new names, the manifest (written LAST)
+    # still describes v1's intact files: the in-memory registry keeps
+    # serving v1 AND a cold restart reloads v1 from disk
+    reg.load_dir(d)
+    assert reg.get("m").version == 1
+    np.testing.assert_allclose(reg.get("m").predict(X, timeout=30), out1,
+                               rtol=1e-5, atol=1e-6)
+    cold = ModelRegistry(batch_timeout_us=1000)
+    cold.load_dir(d)
+    assert cold.get("m").version == 1
+    cold.close()
+
+    faults.disarm()
+    save_model(d, sym2, blob2, (IN_DIM,), buckets=(1, 8), version=2,
+               name="m")
+    reg.load_dir(d)
+    assert reg.get("m").version == 2
+    assert not np.allclose(reg.get("m").predict(X, timeout=30), out1)
+    # a deleted payload behind an intact manifest is a typed torn-publish
+    # error, not a raw FileNotFoundError
+    import os
+
+    os.unlink(os.path.join(d, "model-v2.params"))
+    with pytest.raises(mx.MXNetError):
+        reg.load_dir(d)
+    reg.close()
+
+
+def test_registry_load_dir_requires_manifest(tmp_path):
+    with pytest.raises(mx.MXNetError):
+        ModelRegistry().load_dir(str(tmp_path))
+
+
+def test_registry_rejects_exec_cache_smaller_than_buckets(monkeypatch):
+    """A cache that cannot hold every declared bucket (including 0 =
+    disabled) would retrace on every bucket change — refuse at load."""
+    sym, blob = _mlp()
+    for cap in ("0", "1"):
+        monkeypatch.setenv("MXNET_PRED_CACHE_SIZE", cap)
+        with pytest.raises(mx.MXNetError):
+            ModelRegistry().load("m", sym, blob, (IN_DIM,),
+                                 buckets=(1, 8))
+
+
+# -- HTTP frontend ----------------------------------------------------------
+
+def _post(url, payload):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    return json.load(urllib.request.urlopen(req, timeout=30))
+
+
+def test_http_predict_healthz_metrics_roundtrip():
+    sym, blob = _mlp()
+    reg = ModelRegistry(batch_timeout_us=1000)
+    reg.load("mlp", sym, blob, (IN_DIM,), buckets=(1, 8))
+    X = np.random.RandomState(5).rand(3, IN_DIM).astype(np.float32)
+    ref = _reference_outputs(sym, blob, X)
+    with ServingHTTPServer(reg, port=0) as srv:
+        resp = _post(srv.url + "/predict",
+                     {"model": "mlp", "data": X.tolist()})
+        assert resp["model"] == "mlp" and resp["version"] == 1
+        assert resp["shape"] == [3, CLASSES]
+        np.testing.assert_allclose(np.asarray(resp["output"]), ref,
+                                   rtol=1e-4, atol=1e-5)
+
+        health = json.load(urllib.request.urlopen(srv.url + "/healthz",
+                                                  timeout=30))
+        assert health == {"status": "ok", "models": {"mlp": 1}}
+
+        text = urllib.request.urlopen(srv.url + "/metrics",
+                                      timeout=30).read().decode()
+        for family in ("mxnet_serving_request_count",
+                       "mxnet_serving_shed_count",
+                       "mxnet_serving_queue_depth",
+                       "mxnet_serving_batch_size"):
+            assert family in text, family
+
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(srv.url + "/predict", {"model": "nope", "data": [[0.0]]})
+        assert e.value.code == 404
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(srv.url + "/predict", {"data": [[0.0]]})  # no model key
+        assert e.value.code == 400
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(srv.url + "/predict",
+                  {"model": "mlp", "data": X.tolist(),
+                   "timeout_s": "soon"})  # non-numeric knob -> 400
+        assert e.value.code == 400
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(srv.url + "/predict",
+                  {"model": "mlp", "data": [[0.0, 1.0]]})  # wrong dims
+        assert e.value.code == 400  # a client error, not a 5xx page
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(srv.url + "/nothere", timeout=30)
+        assert e.value.code == 404
+    reg.close()
+
+
+# -- the acceptance demo ----------------------------------------------------
+
+def test_acceptance_64_concurrent_requests_two_dispatches_no_recompile():
+    """ISSUE 3 acceptance: >= 64 concurrent requests serve through
+    <= ceil(64/max_batch_size) device dispatches; the four serving
+    metric families are in snapshot() and /metrics; exactly one XLA
+    compile per declared bucket at warm-up and ZERO during traffic."""
+    sym, blob = _mlp()
+    reg = ModelRegistry(batch_timeout_us=5000, max_queue_depth=128)
+    model = reg.load("mlp", sym, blob, (IN_DIM,), buckets=(1, 8, 32))
+    # warm-up compiled each declared bucket exactly once
+    compiles = telemetry.snapshot()["counters"]["xla.compile.count"]
+    assert compiles.get("kind=predict") == 3
+
+    X = np.random.RandomState(9).rand(64, IN_DIM).astype(np.float32)
+    ref = _reference_outputs(sym, blob, X)
+    model.batcher.stop()  # pre-queue so coalescing is deterministic
+    c0 = telemetry.counter_total("xla.compile.count")
+    d0 = model.batcher.dispatches
+    futs = [model.batcher.submit(X[i:i + 1]) for i in range(64)]
+    model.batcher.start()
+    outs = [f.result(timeout=60) for f in futs]
+
+    assert model.batcher.dispatches - d0 <= int(np.ceil(64 / 32))
+    assert telemetry.counter_total("xla.compile.count") == c0, \
+        "traffic phase must not recompile"
+    np.testing.assert_allclose(np.concatenate(outs), ref,
+                               rtol=1e-5, atol=1e-6)
+
+    snap = telemetry.snapshot()
+    assert "serving.request.count" in snap["counters"]
+    assert "serving.shed.count" in snap["counters"]
+    assert "serving.queue.depth" in snap["gauges"]
+    assert "serving.batch.size" in snap["histograms"]
+    with ServingHTTPServer(reg, port=0) as srv:
+        text = urllib.request.urlopen(srv.url + "/metrics",
+                                      timeout=30).read().decode()
+    for family in ("mxnet_serving_request_count", "mxnet_serving_batch_size",
+                   "mxnet_serving_queue_depth", "mxnet_serving_shed_count"):
+        assert family in text, family
+    # p50/p99 are derivable from the exposed histogram
+    assert telemetry.hist_quantile("serving.request.latency_seconds", 0.5,
+                                   model="mlp") is not None
+    reg.close()
+
+
+def test_threaded_clients_all_served():
+    """Realistic concurrency (no pre-queueing): 48 client threads, all
+    requests answered correctly, strictly fewer dispatches than
+    requests."""
+    sym, blob = _mlp()
+    reg = ModelRegistry(batch_timeout_us=20_000, max_queue_depth=256)
+    model = reg.load("mlp", sym, blob, (IN_DIM,), buckets=(1, 8, 32))
+    X = np.random.RandomState(2).rand(48, IN_DIM).astype(np.float32)
+    ref = _reference_outputs(sym, blob, X)
+    outs = [None] * 48
+    errs = []
+
+    def client(i):
+        try:
+            outs[i] = model.predict(X[i], timeout=60)
+        except Exception as e:  # surfaced via errs below
+            errs.append(e)
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(48)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert not errs
+    np.testing.assert_allclose(np.stack(outs), ref, rtol=1e-5, atol=1e-6)
+    assert model.batcher.dispatches < 48
+    reg.close()

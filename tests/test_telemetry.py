@@ -60,6 +60,17 @@ def test_gauge_last_write_wins():
     assert telemetry.gauge_value("t.g") == 42.5
 
 
+def test_hist_quantile_estimates_from_buckets():
+    for v in (0.001,) * 50 + (0.08,) * 49 + (2.0,):
+        telemetry.observe("t.lat", v, buckets=(0.005, 0.01, 0.05, 0.1, 1.0))
+    # p50 falls in the first bucket, p99 in the (0.05, 0.1] bucket, and
+    # p100 caps at the observed max rather than the +Inf bound
+    assert telemetry.hist_quantile("t.lat", 0.5) <= 0.005
+    assert 0.05 <= telemetry.hist_quantile("t.lat", 0.99) <= 0.1
+    assert telemetry.hist_quantile("t.lat", 1.0) == 2.0
+    assert telemetry.hist_quantile("t.absent", 0.5) is None
+
+
 def test_histogram_stats_and_buckets():
     for v in (0.002, 0.003, 2.0):
         telemetry.observe("t.h", v)
