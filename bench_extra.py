@@ -323,6 +323,72 @@ def ssd_score(batch=8, size=300):
         "sec/step", **_mfu_fields(mod, batch / sec, batch))
 
 
+def fit_score(network="resnet", num_layers=50, batch=32,
+              image_shape=(3, 224, 224)):
+    """``Module.fit`` end-to-end vs the raw ``run_bulk`` ceiling — the
+    trajectory row for the sync-free fit work (device metrics + in-graph
+    NaN guard + device prefetch, docs/how_to/perf.md).  Synthetic host
+    data through ``NDArrayIter`` (so the H2D path is real), Accuracy +
+    CrossEntropy metrics, a Speedometer attached — i.e. fit as users
+    call it — then the same module's ``run_bulk`` on device-resident
+    batches as the ceiling.  Persists imgs/sec for both plus the
+    fit/bulk ratio; the gap closing over PRs is the point."""
+    os.environ.setdefault("MXNET_FUSE_TRAIN_STEP", "1")
+    os.environ.setdefault("MXNET_BULK_TRAIN_STEPS", "5")
+    from mxnet_tpu import telemetry
+
+    telemetry.enable()
+    ctx = _ctx()
+    sym = models.get_symbol(network, num_classes=1000,
+                            image_shape=image_shape, num_layers=num_layers)
+    mod = mx.mod.Module(sym, context=ctx)
+    rs = np.random.RandomState(0)
+    nbatches = max(2 * STEPS, 20)
+    x = rs.rand(nbatches * batch, *image_shape).astype(np.float32)
+    y = rs.randint(0, 1000, nbatches * batch).astype(np.float32)
+    train = mx.io.NDArrayIter(x, y, batch_size=batch,
+                              last_batch_handle="discard")
+    fit_kw = dict(
+        eval_metric=["accuracy", mx.metric.CrossEntropy()],
+        batch_end_callback=mx.callback.Speedometer(
+            batch, frequent=max(10, nbatches // 2)),
+        optimizer="sgd",
+        optimizer_params={"learning_rate": 0.05, "momentum": 0.9},
+        initializer=mx.init.Xavier(rnd_type="gaussian", factor_type="in",
+                                   magnitude=2),
+        kvstore=None, num_epoch=1, prefetch_to_device=True)
+    mod.fit(train, **fit_kw)  # epoch 0: traces + compiles + warms caches
+    train.reset()
+    telemetry.reset()
+    t0 = time.time()
+    mod.fit(train, **fit_kw)
+    fit_sec = time.time() - t0
+    fit_ips = nbatches * batch / fit_sec
+    phases = {ph: round(1e3 * s / max(1, n), 3)
+              for ph, (s, n) in telemetry.phase_totals("fit").items()}
+
+    # the ceiling: the same module's hand-driven bulk loop on
+    # device-resident batches (what bench.py's train rows measure)
+    bulk_batches = [mx.io.DataBatch(
+        data=[mx.nd.array(rs.rand(batch, *image_shape).astype(np.float32),
+                          ctx=ctx)],
+        label=[mx.nd.array(rs.randint(0, 1000, batch).astype(np.float32),
+                           ctx=ctx)]) for _ in range(5)]
+    mod.run_bulk(bulk_batches)
+    _sync_param(mod)
+    t0 = time.time()
+    for _ in range(max(1, STEPS // 5)):
+        mod.run_bulk(bulk_batches)
+    _sync_param(mod)
+    bulk_ips = batch * max(1, STEPS // 5) * 5 / (time.time() - t0)
+    ratio = fit_ips / bulk_ips
+    tag = network if num_layers is None \
+        else "%s-%d" % (network, num_layers)
+    row("fit_%s_b%d" % (tag, batch), fit_ips, "images/sec",
+        bulk_ips=round(bulk_ips, 2), phase_ms_per_batch=phases)
+    row("fit_vs_bulk_%s_b%d" % (tag, batch), ratio, "ratio")
+
+
 def io_score(num_images=4096, batch=128):
     """Data-pipeline throughput: synthetic JPEG RecordIO at ImageNet
     shapes, drained ``--test-io`` style (decode + augment + batch, no
@@ -544,7 +610,8 @@ def serving_score(loads=(4, 16, 64), buckets=(1, 8, 32), in_dim=64,
 
 def main():
     which = set((sys.argv[1].split(",") if len(sys.argv) > 1 else
-                 ["infer", "train", "lstm", "ssd", "io", "serving"]))
+                 ["infer", "train", "fit", "lstm", "ssd", "io",
+                  "serving"]))
     if "io" in which:
         io_score()
     if "infer" in which:
@@ -565,6 +632,8 @@ def main():
             train_score("inception-v3", 29.6, image_shape=(3, 299, 299))
         if "resnet" in nets:
             train_score("resnet", 45.5, num_layers=50)
+    if "fit" in which:
+        fit_score()
     if "lstm" in which:
         lstm_score()
         lstm_batch_scaling()

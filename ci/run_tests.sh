@@ -12,6 +12,9 @@ python ci/check_print.py
 # docs lint: every MXNET_* env var read in the framework is documented
 # in docs/how_to/env_var.md
 python ci/check_env_docs.py
+# perf lint: no host-synchronizing calls (.asnumpy / np.asarray) in the
+# fit/step hot-path modules unless tagged '# host-sync: ok <reason>'
+python ci/check_host_sync.py
 if command -v g++ > /dev/null; then
   g++ -O2 -shared -fPIC -std=c++17 -o libmxnet_tpu_native.so \
       src/native.cc -lpthread

@@ -82,12 +82,22 @@ class Speedometer:
     survive in ``telemetry.snapshot()`` as the
     ``fit.samples_per_sec{kind=instant|smoothed}`` gauges instead of
     scrolling away on stdout.
+
+    Device-metric discipline: the metric is read (``get_name_value``)
+    ONLY inside the ``frequent``-cadence log branch — with a
+    :class:`~mxnet_tpu.metric.DeviceMetric` that read is the sync point,
+    so rate reporting never forces a per-batch device sync.
+    ``auto_reset=True`` (reference parity) additionally resets the metric
+    after each log, making the printed values per-interval rather than
+    running; the default ``False`` keeps the running-epoch semantics.
     """
 
-    def __init__(self, batch_size, frequent=50, smoothing=0.7):
+    def __init__(self, batch_size, frequent=50, smoothing=0.7,
+                 auto_reset=False):
         self.batch_size = batch_size
         self.frequent = frequent
         self.smoothing = min(max(float(smoothing), 0.0), 1.0)
+        self.auto_reset = auto_reset
         self._mark = None  # (nbatch, perf_counter) at the last log/reset
         self._ema = None
 
@@ -115,6 +125,8 @@ class Speedometer:
             logging.info("Epoch[%d] Batch [%d]\tSpeed: %.2f samples/sec "
                          "(smoothed %.2f)%s",
                          param.epoch, count, speed, self._ema, metrics)
+            if self.auto_reset:
+                param.eval_metric.reset()
         else:
             logging.info("Iter[%d] Batch [%d]\tSpeed: %.2f samples/sec "
                          "(smoothed %.2f)",
@@ -170,7 +182,7 @@ class TelemetryReport:
     while telemetry is disabled.
     """
 
-    _PHASES = ("data", "forward_backward", "update", "metric",
+    _PHASES = ("data", "forward_backward", "update", "metric", "sync",
                "bulk_step", "checkpoint")
     _COUNTERS = ("kvstore.push.count", "kvstore.pull.count",
                  "kvstore.reconnects", "xla.compile.count",

@@ -313,6 +313,40 @@ def _graph_forward_mirror(symbol, nodes, arg_vals, aux_vals, rng,
     return outputs, new_aux
 
 
+def _nonfinite_expr(values):
+    """Trace-time helper: ONE fused logical-or over every floating leaf —
+    ``True`` iff any value contains NaN/Inf.  This is the in-graph NaN
+    guard reduction the train kinds fold into the step (docs/resilience.md):
+    the host reads a single scalar instead of pulling every output and
+    gradient."""
+    flags = [jnp.logical_not(jnp.all(jnp.isfinite(v))) for v in values
+             if jnp.issubdtype(v.dtype, jnp.floating)]
+    if not flags:
+        return jnp.zeros((), jnp.bool_)
+    out = flags[0]
+    for f in flags[1:]:
+        out = jnp.logical_or(out, f)
+    return out
+
+
+_ANY_NONFINITE_JIT = None
+
+
+def any_nonfinite(values):
+    """One jitted logical-or reduction over ``values`` (device arrays) →
+    python bool.  The sync is a single scalar transfer; the per-array
+    reductions run on device.  Used by the NaN-guard fallback for
+    executors without an accumulated in-graph flag (e.g. after a fault
+    injection poisoned gradients out-of-graph)."""
+    vals = [v for v in values if jnp.issubdtype(v.dtype, jnp.floating)]
+    if not vals:
+        return False
+    global _ANY_NONFINITE_JIT
+    if _ANY_NONFINITE_JIT is None:
+        _ANY_NONFINITE_JIT = jax.jit(_nonfinite_expr)
+    return bool(_ANY_NONFINITE_JIT(vals))
+
+
 def sgd_step_math(p, g, mom, lr, wd, momentum, rescale, clip):
     """One SGD(-momentum) parameter step, math in f32, result cast back to
     the stored dtype (bf16 params stay bf16).  Shared by the two-dispatch
@@ -360,6 +394,15 @@ class Executor:
         self._rng_cache = None
         self._seg_chain = None
         self._global_mesh = None  # set by Module in multi-process mode
+        # in-graph NaN guard (Module._install_nan_guard): train kinds fold
+        # a logical-or reduction over outputs+grads into the step and
+        # accumulate it here as a device scalar — read via
+        # consume_nan_flag() at the caller's cadence, no per-batch pulls
+        self._nan_guard = False
+        self._nan_acc = None    # accumulated device flag (or None)
+        self._nan_batch = None  # THIS batch's flag (gates metric stats)
+        self._nan_stale = False  # out-of-graph grad mutation invalidated it
+        self._nan_false = None  # cached device False scalar
         self._init_placement()
 
     arg_arrays = property(lambda s: [s.arg_dict[n] for n in s.arg_names])
@@ -458,6 +501,19 @@ class Executor:
                 return list(outs), new_aux_list, grads
 
             fn = jax.jit(f)
+        elif kind == "train_guard":
+            # fused fwd+bwd + in-graph NaN guard: one extra scalar output
+            # or-accumulating non-finiteness of outputs+grads into the
+            # carried flag (replaces the per-gradient asnumpy() loop)
+            def f(args, aux, rng, nan_acc):
+                outs, new_aux_list, vjp_fn = _vjp_parts(args, aux, rng)
+                (grads,) = vjp_fn(tuple(jnp.ones_like(o) for o in outs))
+                flag = _nonfinite_expr(
+                    list(outs) + [grads[n] for n in diff_names])
+                return (list(outs), new_aux_list, grads,
+                        jnp.logical_or(nan_acc, flag), flag)
+
+            fn = jax.jit(f)
         elif kind == "train_fwd":
             # forward-only in train mode (aux updates, no grads) — used when
             # the caller never calls backward (e.g. Monitor probing)
@@ -483,12 +539,17 @@ class Executor:
             # param/momentum buffers — the whole training step is a single
             # XLA computation (the reference's bulk-segment idea taken to
             # its TPU conclusion).  Hyperparameters are baked into the
-            # compiled step; Module caches per hyper-tuple.
-            _, upd_names_t, momentum, rescale, clip = kind
+            # compiled step; Module caches per hyper-tuple.  With
+            # ``guard`` the step also folds the NaN-guard reduction in: a
+            # non-finite batch's param/momentum update is withheld
+            # in-graph (jnp.where on the batch flag — the fused step
+            # never applies a poisoned update) and the flag or-accumulates
+            # into the carried scalar for the host's lazy read.
+            _, upd_names_t, momentum, rescale, clip, guard = kind
             upd_names = list(upd_names_t)
             other_names = [n for n in arg_names if n not in upd_names_t]
 
-            def f(upd_vals, other_vals, aux, rng, moms, lrs, wds):
+            def _step_core(upd_vals, other_vals, aux, rng, moms, lrs, wds):
                 amap = dict(zip(upd_names, upd_vals))
                 amap.update(zip(other_names, other_vals))
                 args = [amap[n] for n in arg_names]
@@ -504,6 +565,22 @@ class Executor:
                         new_m.append(m)
                 grad_list = [grads[n] for n in upd_names]
                 return list(outs), new_aux_list, new_p, new_m, grad_list
+
+            if guard:
+                def f(upd_vals, other_vals, aux, rng, moms, lrs, wds,
+                      nan_acc):
+                    outs, new_aux_list, new_p, new_m, grad_list = \
+                        _step_core(upd_vals, other_vals, aux, rng, moms,
+                                   lrs, wds)
+                    flag = _nonfinite_expr(outs + grad_list)
+                    new_p = [jnp.where(flag, p0, p1)
+                             for p0, p1 in zip(upd_vals, new_p)]
+                    new_m = [jnp.where(flag, m0, m1)
+                             for m0, m1 in zip(moms, new_m)]
+                    return (outs, new_aux_list, new_p, new_m, grad_list,
+                            jnp.logical_or(nan_acc, flag), flag)
+            else:
+                f = _step_core
 
             fn = jax.jit(f, donate_argnums=(0, 4))
         elif isinstance(kind, tuple) and kind[0] == "train_sgd_scan":
@@ -795,6 +872,28 @@ class Executor:
                     cot[k] = cot[k] + c if k in cot else c
         return grads
 
+    # -- in-graph NaN guard ----------------------------------------------
+    def _nan_acc_in(self):
+        """The accumulator value to feed the next guarded dispatch."""
+        if self._nan_acc is not None:
+            return self._nan_acc
+        if self._nan_false is None:
+            self._nan_false = jax.device_put(np.zeros((), np.bool_),
+                                             self._ctx.jax_device())
+        return self._nan_false
+
+    def consume_nan_flag(self):
+        """Read-and-reset the accumulated in-graph guard flag: ONE scalar
+        device→host transfer (blocks until the steps that produced it
+        complete — the caller picks the cadence via
+        ``MXNET_NAN_CHECK_PERIOD``)."""
+        if self._nan_acc is None:
+            return False
+        flag = bool(np.asarray(self._nan_acc))  # host-sync: ok — one scalar at the guard cadence
+        self._nan_acc = None
+        self._nan_stale = False
+        return flag
+
     def next_rng(self):
         """Per-dispatch rng key on the executor's device.
 
@@ -817,12 +916,13 @@ class Executor:
 
             if self._needs_rng:
                 self._rng_step += 1
-                key = np.asarray(jax.random.fold_in(
+                key = np.asarray(jax.random.fold_in(  # host-sync: ok — tiny key, dist replication needs host numpy
                     jax.random.PRNGKey(_random.get_seed()), self._rng_step))
                 return _dist.replicate(self._global_mesh, key)
             if self._rng_cache is None:
                 self._rng_cache = _dist.replicate(
-                    self._global_mesh, np.asarray(jax.random.PRNGKey(0)))
+                    self._global_mesh,
+                    np.asarray(jax.random.PRNGKey(0)))  # host-sync: ok — one-time key replication
             return self._rng_cache
         if self._needs_rng:
             return jax.device_put(_random.next_key(),
@@ -849,6 +949,10 @@ class Executor:
                 dst._jx = jax.device_put(val, self._ctx.jax_device())
             else:
                 dst[:] = v
+        # per-dispatch batch flag: only a guarded TRAIN dispatch sets it —
+        # an eval forward (score during a guarded fit) must never inherit
+        # the last training batch's flag as a metric gate
+        self._nan_batch = None
         if self._segments is not None:
             self._rng_step += 1
             return self._forward_segmented(is_train)
@@ -865,7 +969,16 @@ class Executor:
         with _profiler.span(name, "symbolic") as sp:
             if is_train:
                 if self._diff_names():
-                    outs, new_aux, grads = self._get_fn("train")(args, aux, rng)
+                    if self._nan_guard:
+                        outs, new_aux, grads, acc, batch_flag = \
+                            self._get_fn("train_guard")(
+                                args, aux, rng, self._nan_acc_in())
+                        self._nan_acc = acc
+                        self._nan_batch = batch_flag
+                        self._nan_stale = False
+                    else:
+                        outs, new_aux, grads = self._get_fn("train")(
+                            args, aux, rng)
                     self._pending_grads = grads
                     self._last_state = (args, aux, rng)
                     sp.sync(grads)

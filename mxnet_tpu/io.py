@@ -24,8 +24,9 @@ from .base import MXNetError
 from .ndarray import NDArray, array
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "MNISTIter",
-           "CSVIter", "ResizeIter", "PrefetchingIter", "ImageRecordIter",
-           "corrupt_skip_count", "reset_corrupt_skip_count"]
+           "CSVIter", "ResizeIter", "PrefetchingIter", "DevicePrefetchIter",
+           "ImageRecordIter", "corrupt_skip_count",
+           "reset_corrupt_skip_count"]
 
 
 class DataDesc:
@@ -334,7 +335,12 @@ class ResizeIter(DataIter):
 
 class PrefetchingIter(DataIter):
     """reference ``io.py:281`` — background thread double-buffering (the
-    python analog of ``src/io/iter_prefetcher.h:49``)."""
+    python analog of ``src/io/iter_prefetcher.h:49``).
+
+    Owns one daemon thread per sub-iterator; call :meth:`close` (or use
+    the iterator as a context manager) to stop and join them — relying
+    on ``__del__`` alone leaks N live threads for as long as the GC
+    defers the collection."""
 
     def __init__(self, iters, rename_data=None, rename_label=None):
         super().__init__()
@@ -361,7 +367,7 @@ class PrefetchingIter(DataIter):
                 if not self.started:
                     break
                 try:
-                    self.next_batch[i] = self.iters[i].next()
+                    self.next_batch[i] = self._produce(i)
                 except StopIteration:
                     self.next_batch[i] = None
                 except BaseException as e:  # noqa: BLE001
@@ -378,10 +384,34 @@ class PrefetchingIter(DataIter):
         for thread in self.prefetch_threads:
             thread.start()
 
-    def __del__(self):
+    def _produce(self, i):
+        """Produce sub-iterator ``i``'s next batch — runs ON the prefetch
+        thread.  The hook :class:`DevicePrefetchIter` overrides to add
+        the host→device copy to the background work."""
+        return self.iters[i].next()
+
+    def close(self):
+        """Stop the prefetch threads and JOIN them (idempotent).  After
+        ``close()`` the iterator must not be used again."""
         self.started = False
         for e in self.data_taken:
             e.set()
+        for t in self.prefetch_threads:
+            if t is not threading.current_thread():
+                t.join(timeout=5)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:  # noqa: broad-except — interpreter-shutdown GC
+            pass
 
     @property
     def provide_data(self):
@@ -459,6 +489,64 @@ class PrefetchingIter(DataIter):
 
     def getpad(self):
         return self.current_batch.pad
+
+
+class DevicePrefetchIter(PrefetchingIter):
+    """Device-side double-buffered prefetch: the background thread also
+    runs each batch array's ``jax.device_put``, so the host→device copy
+    of batch N overlaps the device compute of batch N-1 — completing on
+    the device link what :class:`PrefetchingIter` does for host decode
+    (``iter_prefetcher.h`` took decode off the critical path; the H2D
+    copy stayed on it until now).
+
+    ``placer(name, array) -> NDArray`` does the placement; ``Module``
+    passes its ``_device_put_batch`` (bound-buffer sharding, so meshes
+    place the batch axis exactly as ``Module._shard`` did at bind).
+    Alternatively pass ``device`` (a jax device) for a plain single-device
+    put.  ``fit(prefetch_to_device=True)`` (or ``MXNET_DEVICE_PREFETCH=1``)
+    wires this in around ``train_data`` and closes it deterministically.
+    """
+
+    def __init__(self, iters, placer=None, device=None, rename_data=None,
+                 rename_label=None):
+        if placer is None:
+            if device is None:
+                raise MXNetError(
+                    "DevicePrefetchIter needs a placer or a device")
+
+            def placer(_name, arr):
+                import jax
+
+                from .ndarray import NDArray
+
+                raw = arr._transfer_src() if isinstance(arr, NDArray) \
+                    else np.asarray(arr)
+                return NDArray._from_jax(jax.device_put(raw, device))
+
+        # set before super().__init__: the prefetch threads start inside
+        # it and call _produce immediately
+        self._placer = placer
+        self._names_cache = {}
+        super().__init__(iters, rename_data=rename_data,
+                         rename_label=rename_label)
+
+    def _names(self, i):
+        cached = self._names_cache.get(i)
+        if cached is None:
+            cached = ([d.name for d in self.iters[i].provide_data],
+                      [d.name for d in self.iters[i].provide_label])
+            self._names_cache[i] = cached
+        return cached
+
+    def _produce(self, i):
+        batch = self.iters[i].next()
+        data_names, label_names = self._names(i)
+        batch.data = [self._placer(n, a)
+                      for n, a in zip(data_names, batch.data)]
+        if batch.label:
+            batch.label = [self._placer(n, a)
+                           for n, a in zip(label_names, batch.label)]
+        return batch
 
 
 def _mp_decode_worker(ctor_kwargs, shm_names, data_shape, label_shape,

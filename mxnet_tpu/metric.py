@@ -1,6 +1,24 @@
-"""Evaluation metrics (``mx.metric``). Reference: ``python/mxnet/metric.py``."""
+"""Evaluation metrics (``mx.metric``). Reference: ``python/mxnet/metric.py``.
+
+Two update paths:
+
+* **host** (the reference semantics): ``update(labels, preds)`` pulls the
+  arrays to host and accumulates python floats — works for any metric,
+  costs one device→host sync per batch.
+* **device** (the sync-free ``Module.fit`` path, docs/how_to/perf.md):
+  metrics that define ``_device_batch_stats`` reduce each batch to two
+  scalars *(sum_metric delta, num_inst delta)* **on device**; a
+  :class:`DeviceMetric` wrapper dispatches one tiny jitted accumulation
+  per batch into a device-resident buffer, and only ``get()`` /
+  ``get_name_value()`` syncs (folding the buffer back into the wrapped
+  host metric, so mixed host/device updates still add up).  ``fit`` and
+  ``score`` auto-wrap eligible metrics; custom/host-only metrics fall
+  back to the host path (``MXNET_DEVICE_METRIC=0`` disables globally).
+"""
 
 from __future__ import annotations
+
+import os as _os
 
 import numpy as _np
 
@@ -9,7 +27,9 @@ from .ndarray import NDArray
 
 __all__ = ["EvalMetric", "CompositeEvalMetric", "Accuracy", "TopKAccuracy",
            "F1", "Perplexity", "MAE", "MSE", "RMSE", "CrossEntropy", "Loss",
-           "CustomMetric", "np", "create", "check_label_shapes"]
+           "CustomMetric", "DeviceMetric", "np", "create",
+           "check_label_shapes", "device_capable", "device_enabled",
+           "as_device"]
 
 registry = Registry("metric")
 
@@ -27,7 +47,7 @@ def check_label_shapes(labels, preds, shape=0):
 
 
 def _as_np(x):
-    return x.asnumpy() if isinstance(x, NDArray) else _np.asarray(x)
+    return x.asnumpy() if isinstance(x, NDArray) else _np.asarray(x)  # host-sync: ok — host metric path
 
 
 class EvalMetric:
@@ -69,6 +89,15 @@ class EvalMetric:
 
     def __str__(self):
         return "EvalMetric: {}".format(dict(self.get_name_value()))
+
+    # -- device path (sync-free fit) --------------------------------------
+    def _device_batch_stats(self, labels, preds):
+        """Per-batch sufficient statistics as traced jax scalars:
+        ``(sum_metric delta, num_inst delta)``.  Subclasses override with
+        pure ``jnp`` math (runs inside :class:`DeviceMetric`'s jit); the
+        base sentinel means "no device path" and the metric stays on the
+        host ``update()`` fallback."""
+        raise NotImplementedError("%s has no device path" % self.name)
 
 
 @registry.register
@@ -126,6 +155,22 @@ class Accuracy(EvalMetric):
             self.sum_metric += float((p == lab).sum())
             self.num_inst += len(p)
 
+    def _device_batch_stats(self, labels, preds):
+        import jax.numpy as jnp
+
+        check_label_shapes(labels, preds)
+        s, n = jnp.float32(0.0), 0
+        for label, p in zip(labels, preds):
+            if p.ndim > 1 and p.shape[-1] > 1:
+                p = jnp.argmax(p, axis=self.axis if p.ndim > self.axis
+                               else -1)
+            lab = label.astype(jnp.int32).reshape(-1)
+            p = p.astype(jnp.int32).reshape(-1)
+            check_label_shapes(lab, p, shape=1)
+            s = s + (p == lab).sum().astype(jnp.float32)
+            n += p.size
+        return s, jnp.float32(n)
+
 
 @registry.register
 class TopKAccuracy(EvalMetric):
@@ -150,6 +195,23 @@ class TopKAccuracy(EvalMetric):
                     (p[:, num_classes - 1 - j].flatten() ==
                      lab.flatten()).sum())
             self.num_inst += num_samples
+
+    def _device_batch_stats(self, labels, preds):
+        # device argsort is stable where numpy's default is not; on exact
+        # logit ties the top-k *membership* can differ from the host path
+        import jax.numpy as jnp
+
+        check_label_shapes(labels, preds)
+        s, n = jnp.float32(0.0), 0
+        for label, pred in zip(labels, preds):
+            p = jnp.argsort(pred.astype(jnp.float32), axis=1)
+            lab = label.astype(jnp.int32).reshape(-1)
+            num_classes = p.shape[1]
+            for j in range(min(num_classes, self.top_k)):
+                s = s + (p[:, num_classes - 1 - j].reshape(-1) == lab) \
+                    .sum().astype(jnp.float32)
+            n += p.shape[0]
+        return s, jnp.float32(n)
 
 
 @registry.register
@@ -238,6 +300,18 @@ class MAE(EvalMetric):
             self.sum_metric += float(_np.abs(label - pred).mean())
             self.num_inst += 1
 
+    def _device_batch_stats(self, labels, preds):
+        import jax.numpy as jnp
+
+        check_label_shapes(labels, preds)
+        s, n = jnp.float32(0.0), 0
+        for label, pred in zip(labels, preds):
+            if label.ndim == 1:
+                label = label.reshape(label.shape[0], 1)
+            s = s + jnp.abs(label - pred).mean().astype(jnp.float32)
+            n += 1
+        return s, jnp.float32(n)
+
 
 @registry.register
 class MSE(EvalMetric):
@@ -255,6 +329,18 @@ class MSE(EvalMetric):
                 label = label.reshape(label.shape[0], 1)
             self.sum_metric += float(((label - pred) ** 2.0).mean())
             self.num_inst += 1
+
+    def _device_batch_stats(self, labels, preds):
+        import jax.numpy as jnp
+
+        check_label_shapes(labels, preds)
+        s, n = jnp.float32(0.0), 0
+        for label, pred in zip(labels, preds):
+            if label.ndim == 1:
+                label = label.reshape(label.shape[0], 1)
+            s = s + ((label - pred) ** 2.0).mean().astype(jnp.float32)
+            n += 1
+        return s, jnp.float32(n)
 
 
 @registry.register
@@ -275,6 +361,19 @@ class RMSE(EvalMetric):
                 _np.sqrt(((label - pred) ** 2.0).mean()))
             self.num_inst += 1
 
+    def _device_batch_stats(self, labels, preds):
+        import jax.numpy as jnp
+
+        check_label_shapes(labels, preds)
+        s, n = jnp.float32(0.0), 0
+        for label, pred in zip(labels, preds):
+            if label.ndim == 1:
+                label = label.reshape(label.shape[0], 1)
+            s = s + jnp.sqrt(((label - pred) ** 2.0).mean()) \
+                .astype(jnp.float32)
+            n += 1
+        return s, jnp.float32(n)
+
 
 @registry.register
 class CrossEntropy(EvalMetric):
@@ -294,6 +393,23 @@ class CrossEntropy(EvalMetric):
             self.sum_metric += float((-_np.log(prob + self.eps)).sum())
             self.num_inst += label.shape[0]
 
+    def _device_batch_stats(self, labels, preds):
+        import jax.numpy as jnp
+
+        check_label_shapes(labels, preds)
+        s, n = jnp.float32(0.0), 0
+        for label, pred in zip(labels, preds):
+            lab = label.astype(jnp.int32).reshape(-1)
+            assert lab.shape[0] == pred.shape[0]
+            prob = pred[jnp.arange(lab.shape[0]), lab]
+            # jax gather CLAMPS out-of-range indices where numpy raises —
+            # surface corrupt labels as NaN instead of a plausible value
+            prob = jnp.where((lab >= 0) & (lab < pred.shape[-1]),
+                             prob, jnp.nan)
+            s = s + (-jnp.log(prob + self.eps)).sum().astype(jnp.float32)
+            n += lab.shape[0]
+        return s, jnp.float32(n)
+
 
 @registry.register
 class Loss(EvalMetric):
@@ -307,6 +423,15 @@ class Loss(EvalMetric):
             pred = _as_np(pred)
             self.sum_metric += float(pred.sum())
             self.num_inst += pred.size
+
+    def _device_batch_stats(self, labels, preds):
+        import jax.numpy as jnp
+
+        s, n = jnp.float32(0.0), 0
+        for pred in preds:
+            s = s + pred.sum().astype(jnp.float32)
+            n += pred.size
+        return s, jnp.float32(n)
 
 
 @registry.register
@@ -344,6 +469,224 @@ class CustomMetric(EvalMetric):
             else:
                 self.sum_metric += reval
                 self.num_inst += 1
+
+
+# ---------------------------------------------------------------------------
+# device-resident accumulation (the sync-free Module.fit path)
+# ---------------------------------------------------------------------------
+def _leaves_of(metric):
+    """Flatten a (possibly composite) metric into its leaf metrics, in
+    ``get()`` order."""
+    if isinstance(metric, CompositeEvalMetric):
+        return [leaf for child in metric.metrics
+                for leaf in _leaves_of(child)]
+    return [metric]
+
+
+def _defining_class(cls, name):
+    for c in cls.__mro__:
+        if name in vars(c):
+            return c
+    return None
+
+
+def device_capable(metric):
+    """True when every leaf of ``metric`` has a device stats path (and a
+    scalar accumulator) — i.e. :class:`DeviceMetric` can wrap it.
+
+    A subclass that overrides ``update()`` with custom semantics but
+    inherits a builtin's ``_device_batch_stats`` is NOT capable: the
+    device path would silently compute the parent's statistics and
+    bypass the override, so the stats definition must live at (or below)
+    the class that defines ``update()``."""
+    leaves = _leaves_of(metric)
+    if not leaves:
+        return False
+    for leaf in leaves:
+        if leaf.num is not None:
+            return False
+        c_stats = _defining_class(type(leaf), "_device_batch_stats")
+        if c_stats is None or c_stats is EvalMetric:
+            return False
+        c_update = _defining_class(type(leaf), "update")
+        if c_update is not None and not issubclass(c_stats, c_update):
+            return False
+    return True
+
+
+def device_enabled():
+    """Global switch for the device metric path (``MXNET_DEVICE_METRIC``,
+    default on; ``0`` forces every metric through host ``update()``)."""
+    return _os.environ.get("MXNET_DEVICE_METRIC", "1") \
+        not in ("0", "false")
+
+
+def as_device(metric):
+    """Wrap ``metric`` in a :class:`DeviceMetric` when eligible and
+    enabled; return it unchanged otherwise (the host fallback).  The
+    wrapper is cached on the metric, so repeated wrapping (``score``
+    every validation epoch) reuses the accumulated jit cache instead of
+    retracing."""
+    if isinstance(metric, DeviceMetric):
+        return metric
+    if device_enabled() and device_capable(metric):
+        wrapper = getattr(metric, "_device_wrapper", None)
+        if wrapper is None:
+            wrapper = DeviceMetric(metric)
+            metric._device_wrapper = wrapper
+        return wrapper
+    return metric
+
+
+def _device_raw(x):
+    """Underlying buffer for the device path: jax array for device-backed
+    NDArrays, raw numpy for host-backed ones (placed by the caller)."""
+    return x._transfer_src() if isinstance(x, NDArray) else x
+
+
+class DeviceMetric(EvalMetric):
+    """Device-resident accumulator around a host :class:`EvalMetric`.
+
+    ``update()`` dispatches ONE tiny jitted reduction per batch — each
+    leaf metric's ``_device_batch_stats`` sufficient statistics, summed
+    into a ``(n_leaves, 2)`` device buffer with a donated accumulator —
+    and returns without blocking; the XLA computation overlaps the next
+    step's host work exactly like the training dispatch itself.
+    ``get()``/``get_name_value()`` are the only sync points: the buffer
+    is pulled once (telemetry ``sync`` phase), folded *into* the wrapped
+    leaves' host ``sum_metric``/``num_inst``, and cleared — so mixed
+    host/device updates, callback-cadence reads (``Speedometer``) and
+    user-held references to the wrapped metric all stay consistent.
+
+    Accumulation runs in float32 on device; versus the host path's
+    float64 python accumulation the values agree to accumulation-order
+    rounding (integral counts — Accuracy hits, instance counts — are
+    exact below 2**24; see docs/how_to/perf.md).
+    """
+
+    def __init__(self, base):
+        base = base if isinstance(base, EvalMetric) else create(base)
+        if not device_capable(base):
+            raise MXNetError("metric %r has no device path" % base.name)
+        self._base = base
+        self._leaves = _leaves_of(base)
+        self._fns = {}
+        self._acc = None
+        self._acc_dev = None
+        self.sync_count = 0  # observability: how often a read forced a sync
+        super().__init__(base.name)
+
+    @property
+    def base(self):
+        return self._base
+
+    # the documented EvalMetric attribute surface keeps working on the
+    # wrapper (fit hands it to BatchEndParam callbacks): reads sync the
+    # device accumulator into the base first, exactly like get()
+    @property
+    def num_inst(self):
+        self._sync()
+        return self._base.num_inst
+
+    @property
+    def sum_metric(self):
+        self._sync()
+        return self._base.sum_metric
+
+    def reset(self):
+        base = getattr(self, "_base", None)
+        if base is None:  # EvalMetric.__init__ calls reset() pre-attrs
+            return
+        base.reset()
+        self._acc = None
+
+    def update(self, labels, preds, skip=None):
+        """Accumulate one batch.  ``skip`` (an optional device bool
+        scalar, e.g. the executor's in-graph NaN-guard batch flag) zeroes
+        the batch's statistics inside the jit — exact skip-batch metric
+        semantics with no host read."""
+        import jax
+        import jax.numpy as jnp
+
+        labels_j = [_device_raw(x) for x in (labels or [])]
+        preds_j = [_device_raw(x) for x in (preds or [])]
+        # host-resident pieces (iterator labels, bulk-path numpy) join the
+        # device-resident ones (module outputs / bound labels) on the
+        # latter's device
+        dev = None
+        for v in preds_j + labels_j:
+            devs = getattr(v, "devices", None)
+            if callable(devs):
+                ds = devs()
+                if len(ds) == 1:
+                    dev = next(iter(ds))
+                    break
+
+        def _place(v):
+            if isinstance(v, _np.ndarray):
+                return jax.device_put(v, dev) if dev is not None \
+                    else jnp.asarray(v)
+            return v
+
+        labels_j = [_place(v) for v in labels_j]
+        preds_j = [_place(v) for v in preds_j]
+        key = (tuple((tuple(v.shape), str(v.dtype)) for v in labels_j),
+               tuple((tuple(v.shape), str(v.dtype)) for v in preds_j),
+               skip is not None)
+        fn = self._fns.get(key)
+        if fn is None:
+            leaves = self._leaves
+            gated = skip is not None
+
+            def step(acc, labels, preds, *skip_arg):
+                rows = []
+                for leaf in leaves:
+                    s, n = leaf._device_batch_stats(labels, preds)
+                    rows.append(jnp.stack([jnp.asarray(s, jnp.float32),
+                                           jnp.asarray(n, jnp.float32)]))
+                stats = jnp.stack(rows)
+                if gated:
+                    stats = jnp.where(skip_arg[0],
+                                      jnp.zeros_like(stats), stats)
+                return acc + stats
+
+            fn = jax.jit(step, donate_argnums=(0,))
+            self._fns[key] = fn
+        if self._acc is None:
+            zeros = _np.zeros((len(self._leaves), 2), _np.float32)
+            self._acc = jax.device_put(zeros, dev) if dev is not None \
+                else jnp.asarray(zeros)
+            self._acc_dev = dev
+        elif dev is not None and self._acc_dev is not None \
+                and dev != self._acc_dev:
+            # rebind moved the executor: device-to-device hop, no host trip
+            self._acc = jax.device_put(self._acc, dev)
+            self._acc_dev = dev
+        self._acc = fn(self._acc, labels_j, preds_j) if skip is None \
+            else fn(self._acc, labels_j, preds_j, skip)
+
+    def _sync(self):
+        """THE sync point: fold the device accumulator into the wrapped
+        host leaves (one blocking transfer, telemetry ``sync`` phase)."""
+        if self._acc is None:
+            return
+        from . import telemetry as _telemetry
+
+        with _telemetry.phase("sync"):
+            vals = _np.asarray(self._acc)  # host-sync: ok — the metric read IS the sync point
+        self._acc = None
+        self.sync_count += 1
+        for leaf, (s, n) in zip(self._leaves, vals):
+            leaf.sum_metric += float(s)
+            leaf.num_inst += int(n)
+
+    def get(self):
+        self._sync()
+        return self._base.get()
+
+    def get_name_value(self):
+        self._sync()
+        return self._base.get_name_value()
 
 
 def np(numpy_feval, name=None, allow_extra_outputs=False):

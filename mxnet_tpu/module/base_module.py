@@ -64,11 +64,16 @@ class BaseModule:
     def score(self, eval_data, eval_metric, num_batch=None,
               batch_end_callback=None, score_end_callback=None, reset=True,
               epoch=0):
-        """reference :197"""
+        """reference :197 — like ``fit``, auto-selects the device metric
+        path for eligible metrics (the wrapped metric object the caller
+        passed is folded back into at the final sync, so its ``get()``
+        stays correct)."""
         assert self.binded and self.params_initialized
         if reset:
             eval_data.reset()
-        eval_metric = _as_metric(eval_metric)
+        # wrap BEFORE reset: a cached device wrapper may hold unsynced
+        # stats from a previous pass, and reset() clears both layers
+        eval_metric = _metric.as_device(_as_metric(eval_metric))
         eval_metric.reset()
         actual_num_batch = 0
         for nbatch, eval_batch in enumerate(eval_data):
@@ -142,8 +147,19 @@ class BaseModule:
             allow_missing=False, force_rebind=False, force_init=False,
             begin_epoch=0, num_epoch=None, validation_metric=None,
             monitor=None, checkpoint_prefix=None, checkpoint_period=1,
-            resume=None, nan_policy=None):
+            resume=None, nan_policy=None, nan_check_period=None,
+            prefetch_to_device=None):
         """reference ``base_module.py:369`` — THE training loop.
+
+        Sync-free hot loop (docs/how_to/perf.md): eligible metrics are
+        auto-wrapped in :class:`~mxnet_tpu.metric.DeviceMetric` (per-batch
+        stats accumulate on device; reads at callback cadence are the only
+        syncs — ``MXNET_DEVICE_METRIC=0`` restores the host path), the
+        NaN guard is folded into the step as one in-graph reduction read
+        every ``nan_check_period`` batches (``MXNET_NAN_CHECK_PERIOD``,
+        default 1), and ``prefetch_to_device=True``
+        (``MXNET_DEVICE_PREFETCH=1``) stages each batch's H2D copy on a
+        background thread via :class:`~mxnet_tpu.io.DevicePrefetchIter`.
 
         Resilience extensions (docs/resilience.md):
 
@@ -162,7 +178,10 @@ class BaseModule:
             aborts with MXNetError, ``"skip_batch"`` drops the batch's
             update, ``"rollback"`` restores the last valid checkpoint and
             drops the batch.  Tripped batches are visible to callbacks via
-            ``BatchEndParam.nan_detected``/``nan_action``.
+            ``BatchEndParam.nan_detected``/``nan_action``.  The check is a
+            device-side reduction folded into the step; with
+            ``nan_check_period=N`` the one-scalar flag read happens every
+            N batches (amortized semantics: see docs/resilience.md).
         """
         assert num_epoch is not None, "please specify number of epochs"
 
@@ -171,6 +190,15 @@ class BaseModule:
         if nan_policy is not None and nan_policy not in _NAN_POLICIES:
             raise MXNetError("nan_policy must be one of %s, got %r"
                              % (_NAN_POLICIES, nan_policy))
+        if nan_check_period is None:
+            nan_check_period = int(
+                os.environ.get("MXNET_NAN_CHECK_PERIOD", "1") or 1)
+        if nan_check_period < 1:
+            raise MXNetError("nan_check_period must be >= 1, got %r"
+                             % (nan_check_period,))
+        if prefetch_to_device is None:
+            prefetch_to_device = os.environ.get(
+                "MXNET_DEVICE_PREFETCH", "0") not in ("0", "", "false")
         if nan_policy == "rollback" and checkpoint_prefix is None:
             raise MXNetError(
                 "nan_policy='rollback' needs checkpoint_prefix to know "
@@ -221,6 +249,11 @@ class BaseModule:
                             optimizer_params=optimizer_params)
         if resume_states is not None:
             self.load_optimizer_states(resume_states)
+        if hasattr(self, "_install_nan_guard"):
+            # unconditional: a previous fit's guard must DISARM when this
+            # fit runs without a policy (stale accumulated flags would
+            # otherwise leak into a later guarded run)
+            self._install_nan_guard(nan_policy)
         if nan_policy in ("skip_batch", "rollback"):
             kv = getattr(self, "_kvstore", None)
             if kv is not None and getattr(kv, "num_workers", 1) > 1 \
@@ -236,7 +269,11 @@ class BaseModule:
                     "with resume='auto' for distributed runs", nan_policy)
         if validation_metric is None:
             validation_metric = eval_metric
-        eval_metric = _as_metric(eval_metric)
+        # materialize the validation metric ONCE so every epoch's score()
+        # reuses one (device-wrapped) instance and its jit cache, instead
+        # of re-creating + retracing per epoch
+        validation_metric = _metric.as_device(_as_metric(validation_metric))
+        eval_metric = _metric.as_device(_as_metric(eval_metric))
 
         # MXNET_BULK_TRAIN_STEPS=K dispatches K steps per XLA program
         # (Module.run_bulk lax.scan) — the training-loop spelling of the
@@ -263,16 +300,86 @@ class BaseModule:
             for _c in _RESILIENCE_COUNTERS:
                 _telemetry.inc(_c, 0)
 
+        def _trip_nan_policy(epoch, nbatch, gated):
+            """Apply ``nan_policy`` to a flagged batch.  ``gated``: the
+            fused step already withheld the non-finite update in-graph."""
+            _telemetry.inc("resilience.nan_batches", action=nan_policy)
+            _telemetry.event("nan_batch", epoch=epoch, batch=nbatch,
+                             action=nan_policy)
+            if nan_policy == "raise":
+                raise MXNetError(
+                    "NaN/Inf detected in loss/gradients at epoch %d "
+                    "batch %d (nan_policy='raise')" % (epoch, nbatch))
+            if nan_policy == "rollback":
+                self.logger.warning(
+                    "NaN/Inf at epoch %d batch %d: rolling back to the "
+                    "last valid checkpoint", epoch, nbatch)
+                self._rollback_to_checkpoint(checkpoint_prefix)
+            elif gated:
+                self.logger.warning(
+                    "NaN/Inf at epoch %d batch %d: batch update withheld "
+                    "in-graph (skip_batch)", epoch, nbatch)
+            else:
+                self.logger.warning(
+                    "NaN/Inf at epoch %d batch %d: skipping batch",
+                    epoch, nbatch)
+
+        # device-side double-buffered prefetch: a background thread runs
+        # each batch's host→device copy (honoring the module's sharding
+        # via _device_put_batch) so H2D overlaps the previous step's
+        # compute — the device-level completion of PrefetchingIter's
+        # host-decode overlap (iter_prefetcher.h analog)
+        fit_data = train_data
+        if prefetch_to_device and hasattr(self, "_device_put_batch") \
+                and not getattr(self, "_dist_dp", False):
+            from ..io import DevicePrefetchIter
+
+            fit_data = DevicePrefetchIter(train_data,
+                                          placer=self._device_put_batch)
+        owns_iter = fit_data is not train_data
+        try:
+            self._fit_epochs(
+                fit_data, eval_data, eval_metric, validation_metric,
+                epoch_end_callback, batch_end_callback, eval_end_callback,
+                eval_batch_end_callback, monitor, begin_epoch, num_epoch,
+                checkpoint_prefix, checkpoint_period, nan_policy,
+                nan_check_period, use_bulk, bulk_k, _trip_nan_policy,
+                owns_iter)
+            if owns_iter:
+                # restore fit's postcondition (train_data left reset)
+                # only after the producer threads are joined — the
+                # wrapper's own reset would re-arm them, racing for the
+                # user's first post-fit batch
+                fit_data.close()
+                train_data.reset()
+        finally:
+            if owns_iter:
+                fit_data.close()
+
+    def _fit_epochs(self, fit_data, eval_data, eval_metric,
+                    validation_metric, epoch_end_callback,
+                    batch_end_callback, eval_end_callback,
+                    eval_batch_end_callback, monitor, begin_epoch,
+                    num_epoch, checkpoint_prefix, checkpoint_period,
+                    nan_policy, nan_check_period, use_bulk, bulk_k,
+                    _trip_nan_policy, owns_iter=False):
+        """The epoch/batch loop body of :meth:`fit` (split out so the
+        device-prefetch wrapper can be closed deterministically)."""
         for epoch in range(begin_epoch, num_epoch):
             tic = time.time()
             eval_metric.reset()
             if use_bulk:
                 nbatch = -1
                 chunk = []
+                device_out = isinstance(eval_metric, _metric.DeviceMetric)
 
                 def _flush(chunk, nbatch):
                     with _telemetry.phase("bulk_step"):
-                        outs = self.run_bulk(chunk, return_outputs=True)
+                        # device metrics consume the stacked outputs
+                        # without the (K, ...) host transfer
+                        outs = self.run_bulk(
+                            chunk, return_outputs="device" if device_out
+                            else True)
                     for i, b in enumerate(chunk):
                         nbatch += 1
                         _telemetry.inc("fit.batches")
@@ -285,7 +392,7 @@ class BaseModule:
                                 callback(bp)
                     return nbatch
 
-                train_iter = iter(train_data)
+                train_iter = iter(fit_data)
                 while True:
                     with _telemetry.phase("data"):
                         data_batch = next(train_iter, _FIT_END)
@@ -298,14 +405,23 @@ class BaseModule:
                 if chunk:
                     nbatch = _flush(chunk, nbatch)
             else:
-                train_iter = iter(train_data)
+                train_iter = iter(fit_data)
                 nbatch = -1
+                # True while EVERY unread batch since the last flag read
+                # was a staged fused step (whose in-graph gate withheld
+                # non-finite updates) — a two-phase batch in the window
+                # means a poisoned update may have landed, and the trip
+                # log must not claim otherwise
+                window_all_staged = True
                 while True:
-                    # the four step phases (data wait / forward+backward /
-                    # optimizer+kvstore sync / metric) land in telemetry's
-                    # fit.phase_seconds and, when the profiler runs, as
-                    # chrome-trace spans.  JAX dispatch is async: device
-                    # compute time surfaces in the first blocking phase.
+                    # the step phases (data wait / forward+backward /
+                    # optimizer+kvstore sync / metric dispatch) land in
+                    # telemetry's fit.phase_seconds and, when the profiler
+                    # runs, as chrome-trace spans.  JAX dispatch is async:
+                    # device compute time surfaces in the first BLOCKING
+                    # phase — with device metrics and the in-graph NaN
+                    # guard that is the explicit `sync` phase (metric
+                    # reads, guard-flag reads), no longer `metric`.
                     with _telemetry.phase("data"):
                         data_batch = next(train_iter, _FIT_END)
                     if data_batch is _FIT_END:
@@ -322,35 +438,35 @@ class BaseModule:
                         self._poison_gradients_nan()
                     nan_detected = False
                     nan_action = None
-                    if nan_policy is not None \
-                            and self._batch_has_nonfinite():
-                        nan_detected = True
-                        nan_action = nan_policy
-                        _telemetry.inc("resilience.nan_batches",
-                                       action=nan_policy)
-                        _telemetry.event("nan_batch", epoch=epoch,
-                                         batch=nbatch, action=nan_policy)
-                        if nan_policy == "raise":
-                            raise MXNetError(
-                                "NaN/Inf detected in loss/gradients at "
-                                "epoch %d batch %d (nan_policy='raise')"
-                                % (epoch, nbatch))
-                        if nan_policy == "rollback":
-                            self.logger.warning(
-                                "NaN/Inf at epoch %d batch %d: rolling "
-                                "back to the last valid checkpoint",
-                                epoch, nbatch)
-                            self._rollback_to_checkpoint(checkpoint_prefix)
-                        else:
-                            self.logger.warning(
-                                "NaN/Inf at epoch %d batch %d: skipping "
-                                "batch", epoch, nbatch)
-                    else:
+                    staged = bool(getattr(self, "_pending_full", False))
+                    window_all_staged = window_all_staged and staged
+                    check_nan = nan_policy is not None and \
+                        (nbatch + 1) % nan_check_period == 0
+                    # guard cadence: the two-phase path checks BEFORE the
+                    # update (exact skip); a staged fused step runs first
+                    # — its in-graph gate already withheld any non-finite
+                    # update — and the accumulated flag is read after.
+                    # Either read is one scalar (or a device-side
+                    # reduction after an out-of-graph gradient mutation),
+                    # never per-array host pulls.
+                    tripped = check_nan and not staged \
+                        and self._batch_has_nonfinite()
+                    if not tripped:
                         with _telemetry.phase("update"):
                             self.update()
+                        if check_nan and staged:
+                            tripped = self._batch_has_nonfinite()
+                    if tripped:
+                        nan_detected = True
+                        nan_action = nan_policy
+                        _trip_nan_policy(epoch, nbatch,
+                                         gated=window_all_staged)
+                    else:
                         with _telemetry.phase("metric"):
                             self.update_metric(eval_metric,
                                                data_batch.label)
+                    if check_nan:
+                        window_all_staged = True  # flag consumed: new window
                     _telemetry.inc("fit.batches")
                     if monitor is not None:
                         monitor.toc_print()
@@ -362,6 +478,14 @@ class BaseModule:
                             nan_action=nan_action)
                         for callback in _as_list(batch_end_callback):
                             callback(batch_end_param)
+                # epoch-boundary drain: with nan_check_period > 1 the
+                # last window may not have been read yet — a NaN epoch
+                # must not survive into checkpoint/eval unflagged
+                if nan_policy is not None and nbatch >= 0 and \
+                        (nbatch + 1) % nan_check_period != 0 and \
+                        self._batch_has_nonfinite():
+                    _trip_nan_policy(epoch, nbatch,
+                                     gated=window_all_staged)
             for name, val in eval_metric.get_name_value():
                 self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
             toc = time.time()
@@ -389,7 +513,11 @@ class BaseModule:
                 for name, val in res:
                     self.logger.info("Epoch[%d] Validation-%s=%f",
                                      epoch, name, val)
-            train_data.reset()
+            if epoch + 1 < num_epoch or not owns_iter:
+                # an owned prefetch wrapper skips the FINAL reset: it
+                # would re-arm the producer thread, which could consume
+                # the user's first post-fit batch before close() lands
+                fit_data.reset()
 
     # -- resilience helpers (docs/resilience.md) --------------------------
     def _guard_exec(self):
@@ -402,17 +530,40 @@ class BaseModule:
 
     def _batch_has_nonfinite(self):
         """True when any output (loss) or parameter gradient of the batch
-        just computed contains NaN/Inf.  Pulls to host — pair with a
-        policy; the check is the price of the guard."""
-        arrays = list(self.get_outputs())
+        just computed contains NaN/Inf.  Device-side either way: the
+        executor's accumulated in-graph guard flag when available (ONE
+        scalar transfer — the reduction already ran inside the step), else
+        one jitted logical-or reduction over the live outputs+grads (the
+        path after an out-of-graph gradient mutation, and for modules
+        without the fused guard).  Either read lands in the telemetry
+        ``sync`` phase."""
         ex = self._guard_exec()
-        if ex is not None:
-            arrays += [g for g in ex.grad_dict.values() if g is not None]
-        for a in arrays:
-            v = a.asnumpy()
-            if v.dtype.kind == "f" and not np.isfinite(v).all():
-                return True
-        return False
+        with _telemetry.phase("sync"):
+            if ex is not None and getattr(ex, "_nan_acc", None) is not None \
+                    and not getattr(ex, "_nan_stale", False):
+                return ex.consume_nan_flag()
+            if ex is not None:
+                # a stale accumulator predates the mutation that made it
+                # stale — discard it and reduce over the arrays as-is
+                ex._nan_acc = None
+                ex._nan_stale = False
+            arrays = [o._jx for o in self.get_outputs()
+                      if hasattr(o, "_jx")]
+            if ex is not None:
+                arrays += [g._jx for g in ex.grad_dict.values()
+                           if g is not None]
+            from ..executor import any_nonfinite
+
+            try:
+                return any_nonfinite(arrays)
+            except ValueError:
+                # mixed-device arrays (group2ctx placement) cannot share
+                # one jit — fall back to per-array host checks
+                for a in arrays:
+                    v = np.asarray(a)  # host-sync: ok — group2ctx fallback
+                    if v.dtype.kind == "f" and not np.isfinite(v).all():
+                        return True
+                return False
 
     def _poison_gradients_nan(self):
         """fault 'fit.batch': overwrite the first parameter gradient with
@@ -427,6 +578,9 @@ class BaseModule:
         for g in ex.grad_dict.values():
             if g is not None:
                 g[:] = np.nan
+                # the in-graph guard flag predates this mutation: force
+                # the next check onto the live-array reduction
+                ex._nan_stale = True
                 return
         raise MXNetError("fault 'fit.batch' armed but no gradients bound")
 

@@ -19,6 +19,7 @@ import logging
 
 import numpy as np
 
+from .. import metric as _metric
 from .. import optimizer as opt
 from .. import random as _random
 from .. import telemetry as _telemetry
@@ -182,7 +183,8 @@ class Module(BaseModule):
             if batch_axis:
                 return
             arr._jx = _dist.replicate(
-                self._mesh, _dist.broadcast_from_root(np.asarray(arr._jx)))
+                self._mesh,
+                _dist.broadcast_from_root(np.asarray(arr._jx)))  # host-sync: ok — dist init-time broadcast
             return
         if len(self._context) == 1 and self._user_mesh is None:
             return
@@ -418,7 +420,7 @@ class Module(BaseModule):
                 # local batch shard -> global batch-sharded array
                 from .. import dist as _dist
 
-                loc = np.asarray(src._transfer_src()
+                loc = np.asarray(src._transfer_src()  # host-sync: ok — dist shards stage through host numpy
                                  if isinstance(src, NDArray)
                                  else src, dtype=dst.dtype)
                 nproc = _dist.num_processes()
@@ -527,6 +529,21 @@ class Module(BaseModule):
             self._exec.forward(is_train=True)
             self._exec.backward()
 
+    def _install_nan_guard(self, policy):
+        """Arm (``policy`` set) or disarm (``None``) the in-graph NaN/Inf
+        guard: the train kinds fold a logical-or reduction over
+        outputs+grads into the step, the fused step additionally
+        withholds a non-finite update in-graph, and the host reads one
+        accumulated scalar at the ``MXNET_NAN_CHECK_PERIOD`` cadence
+        (docs/resilience.md).  Disarming also drops any accumulated
+        flag so it cannot leak into a later guarded fit."""
+        if self._exec is not None:
+            self._exec._nan_guard = policy is not None
+            if policy is None:
+                self._exec._nan_acc = None
+                self._exec._nan_batch = None
+                self._exec._nan_stale = False
+
     def _run_full_step(self):
         import jax
         import jax.numpy as jnp
@@ -549,8 +566,9 @@ class Module(BaseModule):
         lrs, wds = self._get_hyper_arrays(optimizer, len(names))
         clip = optimizer.clip_gradient \
             if optimizer.clip_gradient is not None else -1.0
+        guard = bool(getattr(ex, "_nan_guard", False))
         fn = ex._get_fn(("train_sgd", tuple(names), optimizer.momentum,
-                         optimizer.rescale_grad, clip))
+                         optimizer.rescale_grad, clip, guard))
         names_set = set(names)
         other = [n for n in ex.arg_names if n not in names_set]
         upd_vals = [ex.arg_dict[n]._jx for n in names]
@@ -559,8 +577,16 @@ class Module(BaseModule):
         rng = ex.next_rng()
         moms = [updater.states[i]._jx for i in range(len(names))] \
             if optimizer.momentum != 0.0 else []
-        outs, new_aux, new_p, new_m, grad_list = fn(
-            upd_vals, other_vals, aux, rng, moms, lrs, wds)
+        if guard:
+            outs, new_aux, new_p, new_m, grad_list, acc, batch_flag = fn(
+                upd_vals, other_vals, aux, rng, moms, lrs, wds,
+                ex._nan_acc_in())
+            ex._nan_acc = acc
+            ex._nan_batch = batch_flag
+            ex._nan_stale = False
+        else:
+            outs, new_aux, new_p, new_m, grad_list = fn(
+                upd_vals, other_vals, aux, rng, moms, lrs, wds)
         ex.outputs = [NDArray._from_jax(o, ex._ctx) for o in outs]
         for arr, v in zip(ex.aux_arrays, new_aux):
             arr._jx = v
@@ -597,41 +623,41 @@ class Module(BaseModule):
 
         ``return_outputs=True`` additionally returns, per symbol output,
         a host numpy array stacked over the batches (``(K, ...)``) — one
-        transfer for all K steps' outputs, for metric updates."""
+        transfer for all K steps' outputs, for metric updates.
+        ``return_outputs="device"`` returns the same stacks WITHOUT the
+        host transfer (jax arrays on the step device) — the sync-free
+        fit path feeds them straight to device-resident metrics."""
         import jax
         import jax.numpy as jnp
 
         if not batches:
             return [] if return_outputs else None
-        if not self._full_step_eligible() or self._optimizer is None \
-                or self._dist_dp:
+
+        def _per_batch_fallback():
             per_batch = []
             for b in batches:
                 self.forward_backward(b)
                 self.update()
                 if return_outputs:
-                    per_batch.append([o.asnumpy()
-                                      for o in self.get_outputs()])
-            if return_outputs:
-                return [np.stack([pb[i] for pb in per_batch])
-                        for i in range(len(per_batch[0]))]
-            return None
+                    outs = self.get_outputs()
+                    per_batch.append(
+                        [o._jx for o in outs] if return_outputs == "device"
+                        else [o.asnumpy() for o in outs])  # host-sync: ok — explicit host-output mode
+            if not return_outputs:
+                return None
+            stack = jnp.stack if return_outputs == "device" else np.stack
+            return [stack([pb[i] for pb in per_batch])
+                    for i in range(len(per_batch[0]))]
+
+        if not self._full_step_eligible() or self._optimizer is None \
+                or self._dist_dp:
+            return _per_batch_fallback()
         ex = self._exec
         optimizer, updater = self._optimizer, self._updater
         names = [n for n in self._param_names
                  if ex.grad_dict.get(n) is not None]
         if not names:
-            per_batch = []
-            for b in batches:
-                self.forward_backward(b)
-                self.update()
-                if return_outputs:
-                    per_batch.append([o.asnumpy()
-                                      for o in self.get_outputs()])
-            if return_outputs:
-                return [np.stack([pb[i] for pb in per_batch])
-                        for i in range(len(per_batch[0]))]
-            return None
+            return _per_batch_fallback()
         self._pending_full = False
         for idx in range(len(names)):
             if idx not in updater.states:
@@ -715,8 +741,10 @@ class Module(BaseModule):
         for i, m in enumerate(new_m):
             updater.states[i]._jx = m
         ex._pending_grads = None
+        if return_outputs == "device":
+            return list(outs_stack)
         if return_outputs:
-            return [np.asarray(o) for o in outs_stack]
+            return [np.asarray(o) for o in outs_stack]  # host-sync: ok — explicit host-output mode
         return None
 
     def bulk_cost_analysis(self):
@@ -880,8 +908,8 @@ class Module(BaseModule):
                 (lambda v, d=None: jnp.asarray(v, jnp.float32))
             self._fused_hyper_cache = (
                 lr_vals, wd_vals,
-                mk(np.asarray(lr_vals, np.float32)),
-                mk(np.asarray(wd_vals, np.float32)))
+                mk(np.asarray(lr_vals, np.float32)),   # host-sync: ok — python floats, no device buffer
+                mk(np.asarray(wd_vals, np.float32)))  # host-sync: ok — python floats, no device buffer
             cached = self._fused_hyper_cache
         return cached[2], cached[3]
 
@@ -901,7 +929,8 @@ class Module(BaseModule):
             if self._dist_dp:
                 from .. import dist as _dist
 
-                arr._jx = _dist.replicate(self._mesh, np.asarray(arr._jx))
+                arr._jx = _dist.replicate(
+                    self._mesh, np.asarray(arr._jx))  # host-sync: ok — dist init-time state placement
             else:
                 import jax
                 from jax.sharding import NamedSharding
@@ -993,7 +1022,50 @@ class Module(BaseModule):
         return [self._exec.grad_dict.get(n) for n in self._data_names]
 
     def update_metric(self, eval_metric, labels):
+        if isinstance(eval_metric, _metric.DeviceMetric) \
+                and not self._dist_dp:
+            if labels and self._label_shapes:
+                # the labels were already loaded onto the executor's
+                # device by forward()'s _load_io — hand the bound arrays
+                # to the device metric instead of re-shipping (or worse,
+                # re-materializing) the iterator's host buffers.  Only
+                # when THIS batch carried labels: an unlabeled batch must
+                # keep its (empty) list so the metric errors exactly like
+                # the host path, not silently read stale bound buffers
+                bound = [self._exec.arg_dict[n]
+                         for n, _ in self._label_shapes
+                         if n in self._exec.arg_dict]
+                if len(bound) == len(labels):
+                    labels = bound
+            # under the in-graph NaN guard, a flagged batch's statistics
+            # are zeroed inside the metric's accumulation jit — exact
+            # skip-batch metric semantics at ANY check cadence, no sync
+            skip = self._exec._nan_batch \
+                if getattr(self._exec, "_nan_guard", False) else None
+            eval_metric.update(labels, self.get_outputs(), skip=skip)
+            return
         eval_metric.update(labels, self.get_outputs())
+
+    def _device_put_batch(self, name, arr):
+        """Prefetch-thread H2D placer (``fit(prefetch_to_device=True)``):
+        move ONE input batch array onto the bound array's device — using
+        the bound buffer's sharding, so mesh contexts get the same
+        batch-axis placement ``Module._shard`` committed at bind — while
+        the previous step's compute is still in flight.  Runs on the
+        ``DevicePrefetchIter`` background thread; ``_load_io``'s
+        device_put then finds the data already resident (a no-op put)."""
+        import jax
+
+        dst = self._exec.arg_dict.get(name) if self._exec is not None \
+            else None
+        if dst is None:
+            return arr
+        raw = arr._transfer_src() if isinstance(arr, NDArray) \
+            else np.asarray(arr)  # host-sync: ok — host iterator batch, not a device buffer
+        if isinstance(raw, np.ndarray) and raw.dtype != dst._jx.dtype:
+            raw = raw.astype(dst._jx.dtype)
+        return NDArray._from_jax(jax.device_put(raw, dst._jx.sharding),
+                                 dst._ctx)
 
     def install_monitor(self, mon):
         assert self.binded

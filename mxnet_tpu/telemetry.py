@@ -160,7 +160,10 @@ class phase:
     Disabled-cheap like ``profiler.span``: the enabled check happens once
     in ``__init__`` and a disabled phase does no clock reads.  Note JAX
     dispatch is asynchronous, so device compute time is attributed to the
-    first phase that blocks on results (see docs/observability.md).
+    first phase that blocks on results (see docs/observability.md) — in
+    the sync-free fit loop that is the explicit ``sync`` phase (device
+    metric reads, NaN-guard flag reads), which exists precisely so
+    ``metric`` and friends time only their dispatch work.
     """
 
     __slots__ = ("_name", "_family", "_t0", "_on", "_prof")
