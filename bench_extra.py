@@ -389,6 +389,68 @@ def fit_score(network="resnet", num_layers=50, batch=32,
     row("fit_vs_bulk_%s_b%d" % (tag, batch), ratio, "ratio")
 
 
+def ckpt_score(batch=4096, nbatches=40, in_dim=256, hidden=512,
+               every_n=10, reps=3):
+    """Checkpointing-overhead row: steps/sec with batch-granular
+    checkpointing OFF vs SYNC (inline serialization) vs ASYNC (the
+    device-copy + background-writer path) at
+    ``checkpoint_every_n_batches=10``.  The persisted
+    ``ckpt_async_overhead`` ratio (async/off) tracks the async path's
+    <2% claim (docs/resilience.md "Preemption & exact resume"); the
+    sync row is the baseline that shows what the writer thread buys."""
+    import shutil
+    import tempfile
+
+    ctx = _ctx()
+    data = mx.sym.Variable("data")
+    h = mx.sym.FullyConnected(data, num_hidden=hidden, name="fc1")
+    h = mx.sym.Activation(h, act_type="relu")
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(h, num_hidden=10, name="fc2"),
+        name="softmax")
+    rs = np.random.RandomState(0)
+    x = rs.rand(nbatches * batch, in_dim).astype(np.float32)
+    y = rs.randint(0, 10, nbatches * batch).astype(np.float32)
+
+    def one(mode, prefix):
+        os.environ["MXNET_CKPT_ASYNC"] = "0" if mode == "sync" else "1"
+        mod = mx.mod.Module(net, context=ctx)
+        train = mx.io.NDArrayIter(x, y, batch_size=batch,
+                                  last_batch_handle="discard")
+        kw = dict(optimizer="sgd",
+                  optimizer_params={"learning_rate": 0.05,
+                                    "momentum": 0.9},
+                  num_epoch=1)
+        if mode != "off":
+            kw.update(checkpoint_prefix=prefix,
+                      checkpoint_every_n_batches=every_n)
+        mod.fit(train, **kw)  # warm-up: traces + compiles
+        best = float("inf")
+        for _ in range(reps):  # best-of: the bench host is noisy
+            train.reset()
+            t0 = time.time()
+            mod.fit(train, **kw)
+            best = min(best, time.time() - t0)
+        os.environ.pop("MXNET_CKPT_ASYNC", None)
+        return nbatches / best
+
+    tmpdir = tempfile.mkdtemp(prefix="bench_ckpt_")
+    try:
+        off = one("off", None)
+        sync = one("sync", os.path.join(tmpdir, "sync"))
+        async_ = one("async", os.path.join(tmpdir, "async"))
+    finally:
+        shutil.rmtree(tmpdir, ignore_errors=True)
+    row("ckpt_off_b%d" % batch, off, "steps/sec")
+    row("ckpt_sync_b%d" % batch, sync, "steps/sec",
+        vs_off=round(sync / off, 4))
+    row("ckpt_async_b%d" % batch, async_, "steps/sec",
+        vs_off=round(async_ / off, 4))
+    # the tracked claim: async batch-granular checkpointing costs <2%
+    row("ckpt_async_overhead_b%d" % batch, async_ / off, "ratio",
+        every_n_batches=every_n)
+
+
 def io_score(num_images=4096, batch=128):
     """Data-pipeline throughput: synthetic JPEG RecordIO at ImageNet
     shapes, drained ``--test-io`` style (decode + augment + batch, no
@@ -611,7 +673,7 @@ def serving_score(loads=(4, 16, 64), buckets=(1, 8, 32), in_dim=64,
 def main():
     which = set((sys.argv[1].split(",") if len(sys.argv) > 1 else
                  ["infer", "train", "fit", "lstm", "ssd", "io",
-                  "serving"]))
+                  "serving", "ckpt"]))
     if "io" in which:
         io_score()
     if "infer" in which:
@@ -641,6 +703,8 @@ def main():
         ssd_score()
     if "serving" in which:
         serving_score()
+    if "ckpt" in which:
+        ckpt_score()
     print("done: %d rows this run (persisted incrementally)" % len(ROWS))
 
 

@@ -1,0 +1,23 @@
+#!/bin/sh
+# Kill/resume chaos matrix (docs/resilience.md "Preemption & exact
+# resume"): run the preemption determinism suite CHAOS_RUNS times (default
+# 5) with rotating seeds.  Each run kills training at several batch
+# indices via the deterministic `fit.preempt` fault (a REAL SIGTERM to
+# the test process), resumes with resume="auto", and pins the final
+# params/metrics bit-identical to a never-killed run — the seed rotates
+# the dataset and kill points so the matrix covers different
+# batch/epoch/cadence alignments.
+#
+# Wired into ci/run_tests.sh behind CHAOS=1 (it multiplies suite time).
+set -e
+cd "$(dirname "$0")/.."
+runs="${CHAOS_RUNS:-5}"
+i=0
+while [ "$i" -lt "$runs" ]; do
+  echo "== chaos run $((i + 1))/$runs (MXNET_CHAOS_SEED=$i) =="
+  JAX_PLATFORMS=cpu MXNET_CHAOS_SEED="$i" \
+    python -m pytest tests/test_preemption.py -q -p no:cacheprovider \
+    -k "kill or chaos or preempt"
+  i=$((i + 1))
+done
+echo "CHAOS OK ($runs runs)"

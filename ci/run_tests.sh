@@ -15,6 +15,9 @@ python ci/check_env_docs.py
 # perf lint: no host-synchronizing calls (.asnumpy / np.asarray) in the
 # fit/step hot-path modules unless tagged '# host-sync: ok <reason>'
 python ci/check_host_sync.py
+# signal hygiene: every signal.signal install in framework code pairs
+# with a restore in a finally block of the same function
+python ci/check_signal_restore.py
 if command -v g++ > /dev/null; then
   g++ -O2 -shared -fPIC -std=c++17 -o libmxnet_tpu_native.so \
       src/native.cc -lpthread
@@ -56,4 +59,9 @@ if [ "${f64_skips:-0}" -ne 4 ]; then
   exit 1
 fi
 python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+# kill/resume chaos matrix (5x rotating seeds) — opt-in, it multiplies
+# suite time: CHAOS=1 sh ci/run_tests.sh
+if [ "${CHAOS:-0}" = "1" ]; then
+  sh ci/run_chaos.sh
+fi
 echo "CI OK"

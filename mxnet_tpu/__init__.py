@@ -55,6 +55,8 @@ from .module import Module
 
 from . import model
 from .model import FeedForward
+from . import checkpoint
+from .checkpoint import TrainingPreempted
 from . import models
 
 from . import log

@@ -36,7 +36,7 @@ def _fsync_dir(path):
         os.close(fd)
 
 
-def atomic_write(path, write_fn, fault_point=None):
+def atomic_write(path, write_fn, fault_point=None, durable=True):
     """Crash-safe file write: ``write_fn(tmp_path)`` → fsync → atomic
     rename onto ``path`` (checkpoints, manifests, optimizer states).
 
@@ -45,7 +45,15 @@ def atomic_write(path, write_fn, fault_point=None):
     names a :mod:`mxnet_tpu.faults` injection point; when armed and
     firing, the temp file is truncated and :class:`faults.FaultInjected`
     raised — the on-disk state of a host dying mid-write (the rename
-    never happens, the previous ``path`` stays intact)."""
+    never happens, the previous ``path`` stays intact).
+
+    ``durable=False`` skips the fsyncs (file + directory): the rename
+    is still atomic against PROCESS death — the preemption threat model,
+    where the kernel and page cache survive — but the bytes may be lost
+    to a power/kernel crash.  The batch-granular snapshot path uses it
+    (a snapshot's value is measured in batches; the fully-durable epoch
+    checkpoint is never more than an epoch behind), keeping the writer
+    off the fsync stalls."""
     from . import faults as _faults  # lazy: faults imports base
 
     tmp = "%s.tmp-%d" % (path, os.getpid())
@@ -58,13 +66,15 @@ def atomic_write(path, write_fn, fault_point=None):
             raise _faults.FaultInjected(
                 "fault %r: write of %s killed mid-file"
                 % (fault_point, path))
-        fd = os.open(tmp, os.O_RDONLY)
-        try:
-            os.fsync(fd)
-        finally:
-            os.close(fd)
+        if durable:
+            fd = os.open(tmp, os.O_RDONLY)
+            try:
+                os.fsync(fd)
+            finally:
+                os.close(fd)
         os.replace(tmp, path)
-        _fsync_dir(path)
+        if durable:
+            _fsync_dir(path)
     except _faults.FaultInjected:
         raise  # simulated crash: leave the truncated temp file behind
     except BaseException:
@@ -75,7 +85,8 @@ def atomic_write(path, write_fn, fault_point=None):
         raise
 
 
-def atomic_write_bytes(path, data, mode="wb", fault_point=None):
+def atomic_write_bytes(path, data, mode="wb", fault_point=None,
+                       durable=True):
     """:func:`atomic_write` of a ready blob.  Closes (flushes) the temp
     file before the fsync+rename — ``lambda tmp: open(tmp).write(data)``
     call sites would lean on refcount finalization for the flush, which
@@ -83,7 +94,7 @@ def atomic_write_bytes(path, data, mode="wb", fault_point=None):
     def _write(tmp):
         with open(tmp, mode) as f:
             f.write(data)
-    atomic_write(path, _write, fault_point=fault_point)
+    atomic_write(path, _write, fault_point=fault_point, durable=durable)
 
 
 class Registry:

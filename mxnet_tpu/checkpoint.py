@@ -1,0 +1,547 @@
+"""Preemption-tolerant checkpointing: async batch-granular snapshots.
+
+TPU pods preempt.  The TensorFlow paper (Abadi et al., 2016, §4.3)
+treats checkpoint/restore as *the* fault-tolerance primitive of a
+dataflow system, and the property that makes preemption a non-event is
+that snapshots are (a) fine-grained — losing at most a few batches —
+and (b) cheap enough to take constantly.  This module supplies both for
+``Module.fit`` (docs/resilience.md "Preemption & exact resume"):
+
+* **Capture is device-side and async.**  A snapshot starts as
+  ``NDArray.copy()`` of every parameter / aux / optimizer-state array —
+  one dispatched device-to-device copy each, no host sync on the
+  training loop.  The host-owned smalls (iterator cursor, RNG state,
+  metric sums, optimizer update counts) are captured synchronously;
+  they are dict-sized.
+* **Serialization is one background writer thread.**  The writer pulls
+  the captured snapshot, performs the device→host transfer *there*, and
+  writes through the ``base.atomic_write`` temp+fsync+rename protocol
+  with the manifest updated LAST — a crash at any byte leaves the
+  previous generation fully loadable.  Back-pressure is strict: at most
+  ONE snapshot may be in flight (queued or writing); a cadence tick
+  that lands while the writer is busy is *dropped* and counted
+  (``resilience.checkpoint.async_dropped``) rather than queued — two
+  in-flight snapshots would double the pinned device copies.
+* **Payloads are sha256-verified.**  Every generation records the
+  digest of its params/states files in the manifest; resume re-hashes
+  before loading and falls back to the previous generation on mismatch
+  (``resilience.checkpoint.corrupt_skipped``).
+* **Retention is generational.**  ``MXNET_CKPT_KEEP_LAST`` (default 3)
+  bounds the on-disk snapshot generations; GC removes a generation's
+  manifest entry FIRST, then its payload files, so a crash mid-GC can
+  orphan a payload (harmless, swept next GC) but never leave a
+  manifest entry pointing at removed bytes.
+
+Telemetry family: ``resilience.checkpoint.async_write_seconds``
+(histogram), ``resilience.checkpoint.async_inflight`` (gauge),
+``resilience.checkpoint.async_dropped`` / ``.corrupt_skipped`` /
+``.pruned`` (counters) — see docs/observability.md.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import pickle
+import threading
+import time
+
+from . import telemetry as _telemetry
+from .base import MXNetError, atomic_write, atomic_write_bytes
+
+__all__ = ["TrainingPreempted", "Snapshot", "TrainingState",
+           "AsyncSnapshotWriter", "snapshot_path", "write_snapshot",
+           "gc_snapshots", "discard_snapshots_from", "load_latest_state",
+           "keep_last_default"]
+
+#: iterator states larger than this (JSON bytes) move to a per-
+#: generation sidecar file instead of the manifest — a shuffled
+#: ImageIter's full permutation is O(dataset) and must not be rewritten
+#: into the manifest (under its lock) on every cadence tick
+ITER_STATE_INLINE_BYTES = 16384
+
+
+class TrainingPreempted(MXNetError):
+    """``Module.fit`` was preempted (SIGTERM/SIGINT) and drained
+    gracefully: the in-flight batch finished, accumulators were flushed,
+    and a final checkpoint was written.  ``checkpoint_path`` names it
+    (None when fit ran without ``checkpoint_prefix``); ``epoch`` /
+    ``nbatch`` locate the last completed batch."""
+
+    def __init__(self, msg, checkpoint_path=None, epoch=None, nbatch=None,
+                 signum=None):
+        super().__init__(msg)
+        self.checkpoint_path = checkpoint_path
+        self.epoch = epoch
+        self.nbatch = nbatch
+        self.signum = signum
+
+
+class Snapshot:
+    """One captured mid-epoch training state, pre-serialization.
+
+    ``arg_params``/``aux_params`` map name → NDArray *device copies*;
+    ``opt_states`` is the updater's ``{index: state}`` tree of device
+    copies (or None when the module has no local updater).  The rest are
+    small JSON-able host dicts captured synchronously."""
+
+    __slots__ = ("epoch", "nbatch", "arg_params", "aux_params",
+                 "opt_states", "opt_counts", "rng_state", "metric_state",
+                 "iter_state")
+
+    def __init__(self, epoch, nbatch, arg_params, aux_params,
+                 opt_states=None, opt_counts=None, rng_state=None,
+                 metric_state=None, iter_state=None):
+        self.epoch = int(epoch)
+        self.nbatch = int(nbatch)
+        self.arg_params = arg_params
+        self.aux_params = aux_params
+        self.opt_states = opt_states
+        self.opt_counts = opt_counts
+        self.rng_state = rng_state
+        self.metric_state = metric_state
+        self.iter_state = iter_state
+
+
+class TrainingState:
+    """What resume recovers: the richest verified state under a prefix.
+
+    ``nbatch`` is None for an epoch-boundary checkpoint (resume restarts
+    epoch ``epoch`` from batch 0, the pre-existing behavior) and the
+    0-based index of the last completed batch for a mid-epoch snapshot
+    (resume continues at ``nbatch + 1`` of epoch ``epoch``)."""
+
+    __slots__ = ("epoch", "nbatch", "arg_params", "aux_params",
+                 "states_path", "states_bytes", "rng_state",
+                 "metric_state", "iter_state", "opt_counts", "path")
+
+    def __init__(self, epoch, nbatch, arg_params, aux_params,
+                 states_path=None, states_bytes=None, rng_state=None,
+                 metric_state=None, iter_state=None, opt_counts=None,
+                 path=None):
+        self.epoch = epoch
+        self.nbatch = nbatch
+        self.arg_params = arg_params
+        self.aux_params = aux_params
+        self.states_path = states_path
+        self.states_bytes = states_bytes
+        self.rng_state = rng_state
+        self.metric_state = metric_state
+        self.iter_state = iter_state
+        self.opt_counts = opt_counts
+        self.path = path
+
+
+def keep_last_default():
+    """Snapshot generations kept on disk (``MXNET_CKPT_KEEP_LAST``)."""
+    return int(os.environ.get("MXNET_CKPT_KEEP_LAST", "3") or 3)
+
+
+def snapshot_path(prefix, epoch, nbatch, kind="params"):
+    """``<prefix>-snap-EEEE-BBBBBB.params`` — distinct from the epoch
+    checkpoint namespace (``<prefix>-EEEE.params``), so the epoch scan
+    in ``model.list_checkpoints`` never confuses a mid-epoch snapshot
+    for a completed epoch."""
+    return "%s-snap-%04d-%06d.%s" % (prefix, epoch, nbatch, kind)
+
+
+def write_snapshot(prefix, snap, logger=logging, keep_last=None):
+    """Serialize ``snap`` crash-safely under ``prefix`` (blocking; the
+    device→host transfer happens inside).  Payloads first, each atomic;
+    the manifest entry (with payload sha256s) is committed LAST; then
+    retention GC runs.  Returns the params path."""
+    from . import ndarray as nd
+    from . import model as _model
+
+    t0 = time.perf_counter()
+    params_path = snapshot_path(prefix, snap.epoch, snap.nbatch, "params")
+    save_dict = {("arg:%s" % k): v for k, v in snap.arg_params.items()}
+    save_dict.update({("aux:%s" % k): v
+                      for k, v in snap.aux_params.items()})
+    # durable=False: snapshot writes stay atomic against PROCESS death
+    # (the preemption threat model) but skip the fsync stalls; the
+    # fully-durable epoch checkpoint bounds power-loss exposure
+    atomic_write(params_path, lambda tmp: nd.save(tmp, save_dict),
+                 fault_point="checkpoint.write", durable=False)
+    entry = {
+        "epoch": snap.epoch, "nbatch": snap.nbatch,
+        "params": os.path.basename(params_path),
+        "sha256": _model._sha256_file(params_path),
+        "states": None, "states_sha256": None,
+        "opt_counts": snap.opt_counts, "rng_state": snap.rng_state,
+        "metric_state": snap.metric_state, "iter_state": snap.iter_state,
+    }
+    if snap.opt_states is not None:
+        states_path = snapshot_path(prefix, snap.epoch, snap.nbatch,
+                                    "states")
+        states_blob = pickle.dumps(snap.opt_states)
+        atomic_write_bytes(states_path, states_blob, durable=False)
+        entry["states"] = os.path.basename(states_path)
+        # hash the in-memory blob — no second read of the file
+        entry["states_sha256"] = hashlib.sha256(states_blob).hexdigest()
+    if snap.iter_state is not None:
+        iter_blob = json.dumps(snap.iter_state).encode()
+        if len(iter_blob) > ITER_STATE_INLINE_BYTES:
+            # big iterator state (shuffled ImageIter carries the whole
+            # epoch permutation) becomes a per-generation sidecar; the
+            # manifest keeps only the pointer + digest
+            iter_path = snapshot_path(prefix, snap.epoch, snap.nbatch,
+                                      "iter.json")
+            atomic_write_bytes(iter_path, iter_blob, durable=False)
+            entry["iter_state"] = None
+            entry["iter_state_file"] = os.path.basename(iter_path)
+            entry["iter_state_sha256"] = \
+                hashlib.sha256(iter_blob).hexdigest()
+    # the commit point: a crash before this line leaves orphan payloads
+    # (swept by a later GC), never a manifest entry without its bytes
+    _model._manifest_add_snapshot(prefix, entry)
+    gc_snapshots(prefix, keep_last=keep_last, logger=logger)
+    _telemetry.inc("resilience.checkpoint.saves")
+    _telemetry.observe("resilience.checkpoint.async_write_seconds",
+                       time.perf_counter() - t0)
+    _telemetry.event("checkpoint.snapshot", epoch=snap.epoch,
+                     nbatch=snap.nbatch, path=params_path)
+    return params_path
+
+
+def gc_snapshots(prefix, keep_last=None, logger=logging):
+    """Prune snapshot generations beyond ``keep_last`` (newest kept).
+
+    Order is manifest-first: the pruned generations' entries are removed
+    (atomic manifest rewrite) BEFORE any payload unlink, so a crash
+    mid-GC never leaves the manifest pointing at removed payloads — at
+    worst an orphan payload file survives until the next GC pass, which
+    also sweeps on-disk ``-snap-`` files no longer in the manifest."""
+    from . import model as _model
+
+    if keep_last is None:
+        keep_last = keep_last_default()
+    if keep_last < 1:
+        keep_last = 1
+    pruned = _model._manifest_prune_snapshots(prefix, keep_last)
+    if not pruned:
+        # steady state (≤ keep_last generations): nothing to do — no
+        # manifest rewrite, no directory scan.  Orphans from a crash
+        # mid-GC wait for the next real prune pass
+        return 0
+    base_dir = os.path.dirname(os.path.abspath(prefix)) or "."
+    victims = []
+    for entry in pruned:
+        for key in _PAYLOAD_KEYS:
+            name = entry.get(key)
+            if name:
+                victims.append(os.path.join(base_dir, name))
+    # orphan sweep: -snap- payloads on disk but absent from the manifest
+    # (a previous crash between manifest write and unlink)
+    live = set()
+    m = _model.checkpoint_manifest(prefix)
+    for entry in (m or {}).get("snapshots", []):
+        for key in _PAYLOAD_KEYS:
+            if entry.get(key):
+                live.add(entry[key])
+    snap_marker = "%s-snap-" % os.path.basename(prefix)
+    try:
+        for name in os.listdir(base_dir):
+            if name.startswith(snap_marker) and name not in live \
+                    and (name.endswith(".params")
+                         or name.endswith(".states")
+                         or name.endswith(".iter.json")):
+                victims.append(os.path.join(base_dir, name))
+    except OSError:
+        pass
+    return _unlink_victims(victims, prefix, logger)
+
+
+#: manifest keys naming on-disk payload files of one snapshot generation
+_PAYLOAD_KEYS = ("params", "states", "iter_state_file")
+
+
+def _unlink_victims(victims, prefix, logger):
+    removed = 0
+    for path in victims:
+        try:
+            os.unlink(path)
+            removed += 1
+        except OSError:
+            pass
+    if removed:
+        _telemetry.inc("resilience.checkpoint.pruned", removed)
+        logger.debug("checkpoint GC: removed %d pruned snapshot files "
+                     "under %r", removed, prefix)
+    return removed
+
+
+def discard_snapshots_from(prefix, epoch, logger=logging):
+    """Drop every snapshot generation at or after 0-based loop epoch
+    ``epoch`` — i.e. everything newer than the epoch-``epoch`` boundary
+    checkpoint.  ``nan_policy='rollback'`` calls this after restoring:
+    snapshots from the abandoned trajectory must not win a later
+    ``resume='auto'`` recency race and resurrect the very state the
+    rollback discarded.  Manifest-first like :func:`gc_snapshots`."""
+    from . import model as _model
+
+    m = _model.checkpoint_manifest(prefix)
+    snaps = (m or {}).get("snapshots", [])
+    doomed = [s for s in snaps if int(s.get("epoch", -1)) >= epoch]
+    if not doomed:
+        return 0
+    keys = {(_model._snap_key(s)) for s in doomed}
+
+    def _drop(man):
+        man["snapshots"] = [s for s in man.get("snapshots", [])
+                            if _model._snap_key(s) not in keys]
+
+    _model._manifest_mutate(prefix, _drop, durable=False)
+    base_dir = os.path.dirname(os.path.abspath(prefix)) or "."
+    victims = [os.path.join(base_dir, s[key])
+               for s in doomed for key in _PAYLOAD_KEYS if s.get(key)]
+    logger.info("rollback: discarded %d post-rollback snapshot "
+                "generation(s) under %r", len(doomed), prefix)
+    return _unlink_victims(victims, prefix, logger)
+
+
+def _verified(path, want_sha, logger, what):
+    """True when ``path`` exists and hashes to ``want_sha`` (a recorded
+    digest is mandatory for snapshots — they are never trusted blind)."""
+    from . import model as _model
+
+    if not os.path.exists(path):
+        logger.warning("resume: %s %s is missing; falling back", what,
+                       path)
+        return False
+    got = _model._sha256_file(path)
+    if want_sha and got != want_sha:
+        logger.warning(
+            "resume: %s %s failed sha256 verification (manifest %s..., "
+            "file %s...); falling back to the previous generation",
+            what, path, (want_sha or "")[:12], got[:12])
+        return False
+    return True
+
+
+def load_latest_state(prefix, logger=logging):
+    """The richest verified training state under ``prefix``: mid-epoch
+    snapshots and epoch-boundary checkpoints in ONE recency order
+    (epoch checkpoint E ≡ position ``(E, batch -1)``; snapshot ``(e,
+    k)`` sorts after it when ``e > E`` or mid-epoch of ``e == E``).
+    Every candidate re-verifies its payload sha256 (and, for epoch
+    checkpoints, takes a full load-verify pass) before being trusted;
+    corrupt generations are skipped with
+    ``resilience.checkpoint.corrupt_skipped`` and the next-older one is
+    tried.  Returns :class:`TrainingState` or None."""
+    from . import model as _model
+    from . import ndarray as nd
+
+    m = _model.checkpoint_manifest(prefix) or {}
+    base_dir = os.path.dirname(os.path.abspath(prefix)) or "."
+    candidates = []
+    for entry in m.get("snapshots", []):
+        try:
+            key = (int(entry["epoch"]), int(entry["nbatch"]))
+        except (KeyError, TypeError, ValueError):
+            continue
+        candidates.append((key, "snapshot", entry))
+    for epoch in _model.list_checkpoints(prefix):
+        candidates.append(((epoch, -1), "epoch", epoch))
+    candidates.sort(key=lambda c: c[0], reverse=True)
+    for _key, kind, payload in candidates:
+        if kind == "epoch":
+            epoch = payload
+            params = "%s-%04d.params" % (prefix, epoch)
+            want = (m.get("payload_sha256") or {}).get(str(epoch))
+            if want and not _verified(params, want, logger,
+                                      "epoch checkpoint"):
+                _telemetry.inc("resilience.checkpoint.corrupt_skipped")
+                continue
+            try:
+                _sym, arg, aux = _model.load_checkpoint(prefix, epoch)
+            except (MXNetError, OSError, ValueError) as e:
+                logger.warning(
+                    "checkpoint %s failed load verification (%s); "
+                    "falling back to the previous generation", params, e)
+                _telemetry.inc("resilience.checkpoint.corrupt_skipped")
+                continue
+            states = "%s-%04d.states" % (prefix, epoch)
+            return TrainingState(
+                epoch=epoch, nbatch=None, arg_params=arg, aux_params=aux,
+                states_path=states if os.path.exists(states) else None,
+                path=params)
+        entry = payload
+        params = os.path.join(base_dir, entry["params"])
+        if not _verified(params, entry.get("sha256"), logger,
+                         "snapshot payload"):
+            _telemetry.inc("resilience.checkpoint.corrupt_skipped")
+            continue
+        states_bytes = None
+        if entry.get("states"):
+            states = os.path.join(base_dir, entry["states"])
+            if not _verified(states, entry.get("states_sha256"), logger,
+                             "snapshot optimizer states"):
+                _telemetry.inc("resilience.checkpoint.corrupt_skipped")
+                continue
+            with open(states, "rb") as f:
+                states_bytes = f.read()
+        iter_state = entry.get("iter_state")
+        if entry.get("iter_state_file"):
+            # big iterator state lives in a sidecar (see write_snapshot)
+            iter_path = os.path.join(base_dir, entry["iter_state_file"])
+            if not _verified(iter_path, entry.get("iter_state_sha256"),
+                             logger, "snapshot iterator state"):
+                _telemetry.inc("resilience.checkpoint.corrupt_skipped")
+                continue
+            try:
+                with open(iter_path, "rb") as f:
+                    iter_state = json.loads(f.read())
+            except (OSError, ValueError) as e:
+                logger.warning("snapshot iterator state %s failed to "
+                               "parse (%s); falling back", iter_path, e)
+                _telemetry.inc("resilience.checkpoint.corrupt_skipped")
+                continue
+        try:
+            save_dict = nd.load(params)
+        except (MXNetError, OSError, ValueError) as e:
+            logger.warning("snapshot %s failed load verification (%s); "
+                           "falling back", params, e)
+            _telemetry.inc("resilience.checkpoint.corrupt_skipped")
+            continue
+        arg, aux = {}, {}
+        for k, v in save_dict.items():
+            tp, name = k.split(":", 1)
+            if tp == "arg":
+                arg[name] = v
+            elif tp == "aux":
+                aux[name] = v
+        return TrainingState(
+            epoch=int(entry["epoch"]), nbatch=int(entry["nbatch"]),
+            arg_params=arg, aux_params=aux, states_bytes=states_bytes,
+            rng_state=entry.get("rng_state"),
+            metric_state=entry.get("metric_state"),
+            iter_state=iter_state,
+            opt_counts=entry.get("opt_counts"), path=params)
+    return None
+
+
+class AsyncSnapshotWriter:
+    """ONE background thread serializing snapshots for one fit call.
+
+    ``submit`` hands over a captured :class:`Snapshot` without blocking;
+    when the writer is busy (writing, or one already queued) the new
+    snapshot is DROPPED and counted — strict ≤1-in-flight back-pressure,
+    because each pending snapshot pins a full set of device-side copies.
+    ``close`` drains the queue (unless ``drain=False``) and JOINS the
+    thread — fit's ``finally`` guarantees no leaked writer threads
+    (pinned in tests/test_preemption.py)."""
+
+    def __init__(self, prefix, keep_last=None, logger=logging,
+                 sync=False):
+        self.prefix = prefix
+        self.keep_last = keep_last
+        self.logger = logger
+        #: sync=True serializes inline in submit() — the benchmark
+        #: baseline (bench_extra.py ckpt_score) and a debugging aid
+        self.sync = sync
+        self._cv = threading.Condition()
+        self._slot = None
+        self._busy = False
+        self._closed = False
+        self._error = None
+        self._thread = None
+        self._warned_drop = False
+        if not sync:
+            self._thread = threading.Thread(
+                target=self._run, name="ckpt-writer", daemon=True)
+            self._thread.start()
+
+    def submit(self, snap):
+        """Queue ``snap``; False (and a counted drop) when busy."""
+        if self.sync:
+            self._write(snap)
+            return True
+        with self._cv:
+            if self._closed:
+                return False
+            if self._busy or self._slot is not None:
+                _telemetry.inc("resilience.checkpoint.async_dropped")
+                # first drop warns (cadence outruns the writer — worth
+                # knowing); the rest go to debug so a tight cadence does
+                # not flood the log
+                log = self.logger.debug if self._warned_drop \
+                    else self.logger.warning
+                self._warned_drop = True
+                log("async checkpoint: writer busy at epoch %d batch %d; "
+                    "snapshot dropped (back-pressure keeps <=1 in "
+                    "flight)", snap.epoch, snap.nbatch)
+                return False
+            self._slot = snap
+            self._cv.notify_all()
+        return True
+
+    def _write(self, snap):
+        _telemetry.set_gauge("resilience.checkpoint.async_inflight", 1)
+        try:
+            write_snapshot(self.prefix, snap, logger=self.logger,
+                           keep_last=self.keep_last)
+        finally:
+            _telemetry.set_gauge("resilience.checkpoint.async_inflight", 0)
+
+    def _run(self):
+        while True:
+            with self._cv:
+                while self._slot is None and not self._closed:
+                    self._cv.wait()
+                snap, self._slot = self._slot, None
+                if snap is None:  # closed with nothing queued
+                    return
+                self._busy = True
+            try:
+                self._write(snap)
+            except BaseException as e:  # noqa: BLE001 — surfaced on drain
+                self._error = e
+                self.logger.warning("async checkpoint write failed: %s", e)
+            finally:
+                with self._cv:
+                    self._busy = False
+                    self._cv.notify_all()
+
+    def drain(self, timeout=None):
+        """Block until no snapshot is queued or being written.  Re-raises
+        (once) a writer-thread failure so fit surfaces it instead of
+        silently training without checkpoints."""
+        if not self.sync:
+            with self._cv:
+                self._cv.wait_for(
+                    lambda: self._slot is None and not self._busy,
+                    timeout=timeout)
+        err, self._error = self._error, None
+        if err is not None:
+            raise err
+
+    def close(self, drain=True):
+        """Stop and JOIN the writer (idempotent).  ``drain=True`` writes
+        whatever is still queued first."""
+        if self.sync:
+            return
+        if drain:
+            try:
+                self.drain()
+            except Exception:
+                if self._thread is not None:
+                    with self._cv:
+                        self._closed = True
+                        self._cv.notify_all()
+                    self._thread.join(timeout=30)
+                raise
+        with self._cv:
+            self._closed = True
+            if not drain:
+                self._slot = None
+            self._cv.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=30)
+            self._thread = None
+
+    @property
+    def alive(self):
+        return self._thread is not None and self._thread.is_alive()

@@ -29,6 +29,11 @@ point                  effect when it fires
 ``serving.model.write``  the Nth ``serving.save_model`` publish dies with
                          the manifest half-written (truncated, never
                          renamed) — a publisher crash mid-publish
+``fit.preempt``          SIGTERM is delivered to this process at the Nth
+                         training batch — a deterministic pod preemption;
+                         ``fit`` finishes the batch, drains, checkpoints
+                         and raises ``TrainingPreempted`` (the kill half
+                         of the kill/resume chaos harness)
 =====================  =====================================================
 
 Arming — programmatic::
@@ -64,7 +69,8 @@ __all__ = ["POINTS", "FaultInjected", "arm", "disarm", "armed",
 #: the injection points the framework consults (``arm`` validates against
 #: this so a typo'd point fails loudly instead of never firing)
 POINTS = ("kvstore.push.socket", "checkpoint.write", "fit.batch",
-          "recordio.read", "serving.dispatch", "serving.model.write")
+          "recordio.read", "serving.dispatch", "serving.model.write",
+          "fit.preempt")
 
 
 class FaultInjected(MXNetError):

@@ -496,6 +496,36 @@ class ImageIter(DataIter):
             raws.append(item)
         return raws
 
+    # -- iterator-state protocol (docs/resilience.md "exact resume") ------
+    def state_dict(self):
+        """Mid-epoch position: the cursor plus — because ``shuffle``
+        permutes ``seq`` in place per epoch — the current read ORDER,
+        and the raw record position for index-less sequential shards.
+        Restoring on an equivalently-constructed iterator replays the
+        exact remaining sample sequence of this epoch."""
+        state = {"type": "ImageIter", "cursor": int(self.cursor),
+                 "overflow": int(getattr(self, "_overflow", 0)),
+                 "exhausted": bool(getattr(self, "_exhausted", False)),
+                 "seq": list(self.seq) if self.seq is not None else None}
+        if self.seq is None and self.imgrec is not None:
+            state["record"] = self.imgrec.state_dict()
+        return state
+
+    def load_state_dict(self, state):
+        if state.get("type", "ImageIter") != "ImageIter":
+            raise MXNetError("iterator state of type %r cannot restore "
+                             "onto ImageIter" % (state.get("type"),))
+        self.cursor = int(state["cursor"])
+        self._overflow = int(state.get("overflow", 0))
+        self._exhausted = bool(state.get("exhausted", False))
+        if state.get("seq") is not None:
+            if self.seq is None:
+                raise MXNetError("ImageIter state carries a sample order "
+                                 "but this iterator has no index")
+            self.seq = list(state["seq"])
+        elif self.imgrec is not None and state.get("record") is not None:
+            self.imgrec.load_state_dict(state["record"])
+
     def _read_raw(self):
         """Fetch one (encoded bytes, label) — file IO only, main thread."""
         if self.imgrec is not None:

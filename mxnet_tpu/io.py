@@ -103,6 +103,25 @@ class DataIter:
     def getpad(self):
         raise NotImplementedError()
 
+    # -- iterator-state protocol (preemption-tolerant fit) ----------------
+    def state_dict(self):
+        """JSON-able mid-epoch position of this iterator.  Restoring it
+        with :meth:`load_state_dict` on a freshly-constructed equivalent
+        iterator makes ``next()`` yield exactly the batch that would
+        have come next — the contract ``Module.fit``'s exact mid-epoch
+        resume builds on (docs/resilience.md).  Iterators without the
+        protocol raise; fit then degrades to epoch-boundary resume."""
+        raise NotImplementedError(
+            "%s does not implement the iterator-state protocol "
+            "(state_dict/load_state_dict); mid-epoch checkpoint resume "
+            "degrades to the epoch boundary" % type(self).__name__)
+
+    def load_state_dict(self, state):
+        """Restore a :meth:`state_dict` capture."""
+        raise NotImplementedError(
+            "%s does not implement the iterator-state protocol"
+            % type(self).__name__)
+
 
 def _init_data(data, allow_empty, default_name):
     """reference io.py _init_data — normalize to list of (name, numpy)."""
@@ -206,6 +225,26 @@ class NDArrayIter(DataIter):
             return self.cursor + self.batch_size - self.num_data
         return 0
 
+    def state_dict(self):
+        # the cursor IS the iterator state: shuffle/discard permute the
+        # backing arrays at construction, so an equivalently-constructed
+        # iterator (same data/seed) + cursor lands on the same batch
+        return {"type": "NDArrayIter", "cursor": self.cursor,
+                "num_data": self.num_data, "batch_size": self.batch_size}
+
+    def load_state_dict(self, state):
+        if state.get("type", "NDArrayIter") != "NDArrayIter":
+            raise MXNetError("iterator state of type %r cannot restore "
+                             "onto NDArrayIter" % (state.get("type"),))
+        if state.get("num_data", self.num_data) != self.num_data or \
+                state.get("batch_size", self.batch_size) != self.batch_size:
+            raise MXNetError(
+                "NDArrayIter state (num_data=%s, batch_size=%s) does not "
+                "match this iterator (num_data=%d, batch_size=%d)"
+                % (state.get("num_data"), state.get("batch_size"),
+                   self.num_data, self.batch_size))
+        self.cursor = int(state["cursor"])
+
 
 def _read_idx(path):
     """Read an MNIST idx file (gz or raw) — ``src/io/iter_mnist.cc`` format."""
@@ -255,6 +294,12 @@ class MNISTIter(DataIter):
     def iter_next(self):
         return self._inner.iter_next()
 
+    def state_dict(self):
+        return {"type": "MNISTIter", "inner": self._inner.state_dict()}
+
+    def load_state_dict(self, state):
+        self._inner.load_state_dict(state["inner"])
+
 
 class CSVIter(DataIter):
     """reference ``src/io/iter_csv.cc:132``"""
@@ -283,6 +328,12 @@ class CSVIter(DataIter):
 
     def next(self):
         return self._inner.next()
+
+    def state_dict(self):
+        return {"type": "CSVIter", "inner": self._inner.state_dict()}
+
+    def load_state_dict(self, state):
+        self._inner.load_state_dict(state["inner"])
 
 
 class ResizeIter(DataIter):
@@ -332,6 +383,14 @@ class ResizeIter(DataIter):
     def getpad(self):
         return self.current_batch.pad
 
+    def state_dict(self):
+        return {"type": "ResizeIter", "cur": self.cur,
+                "inner": self.data_iter.state_dict()}
+
+    def load_state_dict(self, state):
+        self.cur = int(state["cur"])
+        self.data_iter.load_state_dict(state["inner"])
+
 
 class PrefetchingIter(DataIter):
     """reference ``io.py:281`` — background thread double-buffering (the
@@ -360,6 +419,11 @@ class PrefetchingIter(DataIter):
         self.current_batch = [None for _ in range(self.n_iter)]
         self.next_batch = [None for _ in range(self.n_iter)]
         self._errors = [None for _ in range(self.n_iter)]
+        # iterator-state protocol: each produce first captures the
+        # sub-iterator's PRE-batch state, so state_dict() can report the
+        # position of the buffered batch the consumer has not seen yet
+        self._capture_state = True
+        self._pending_state = [None for _ in range(self.n_iter)]
 
         def prefetch_func(self, i):
             while True:
@@ -386,9 +450,59 @@ class PrefetchingIter(DataIter):
 
     def _produce(self, i):
         """Produce sub-iterator ``i``'s next batch — runs ON the prefetch
-        thread.  The hook :class:`DevicePrefetchIter` overrides to add
-        the host→device copy to the background work."""
+        thread.  Captures the inner iterator's pre-batch state first
+        (see :meth:`state_dict`); the hook :meth:`_produce_batch` is what
+        :class:`DevicePrefetchIter` overrides to add the host→device
+        copy to the background work."""
+        if self._capture_state:
+            try:
+                self._pending_state[i] = self.iters[i].state_dict()
+            except NotImplementedError:
+                # the inner iterator has no state protocol: stop asking
+                # (once per wrapper, not once per batch)
+                self._capture_state = False
+                self._pending_state = None
+        return self._produce_batch(i)
+
+    def _produce_batch(self, i):
         return self.iters[i].next()
+
+    def state_dict(self):
+        """State of the *consumer* position: the producers are drained
+        (parked on ``data_taken``) and the captured pre-batch state of
+        the buffered batch is returned — restoring it re-produces that
+        buffered (never-consumed) batch first, so a wrapper snapshot
+        taken after fit consumed ``k`` batches resumes at batch
+        ``k + 1`` exactly, prefetch depth and all."""
+        for e in self.data_ready:
+            e.wait()
+        if not self._capture_state or self._pending_state is None \
+                or any(s is None for s in self._pending_state):
+            raise NotImplementedError(
+                "%s cannot snapshot: wrapped iterator(s) lack the "
+                "state protocol" % type(self).__name__)
+        return {"type": type(self).__name__,
+                "inner": [dict(s) for s in self._pending_state]}
+
+    def load_state_dict(self, state):
+        """Restore: park the producers, rewind the inner iterators to
+        the captured positions, drop the stale buffered batches, and
+        re-arm — the next produced batch comes from the restored
+        state."""
+        inner = state["inner"]
+        if len(inner) != self.n_iter:
+            raise MXNetError(
+                "prefetch state has %d sub-iterators, wrapper has %d"
+                % (len(inner), self.n_iter))
+        for e in self.data_ready:
+            e.wait()
+        for i in range(self.n_iter):
+            self.iters[i].load_state_dict(inner[i])
+        self._errors = [None for _ in range(self.n_iter)]
+        for e in self.data_ready:
+            e.clear()
+        for e in self.data_taken:
+            e.set()
 
     def close(self):
         """Stop the prefetch threads and JOIN them (idempotent).  After
@@ -538,7 +652,7 @@ class DevicePrefetchIter(PrefetchingIter):
             self._names_cache[i] = cached
         return cached
 
-    def _produce(self, i):
+    def _produce_batch(self, i):
         batch = self.iters[i].next()
         data_names, label_names = self._names(i)
         batch.data = [self._placer(n, a)

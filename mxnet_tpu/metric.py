@@ -90,6 +90,28 @@ class EvalMetric:
     def __str__(self):
         return "EvalMetric: {}".format(dict(self.get_name_value()))
 
+    # -- state capture (preemption-tolerant fit) --------------------------
+    def get_state(self):
+        """JSON-able accumulator state for mid-epoch checkpoints
+        (docs/resilience.md "Preemption & exact resume"): restoring it
+        via :meth:`set_state` and continuing from batch k+1 reproduces
+        an uninterrupted epoch's final value exactly.  Accumulators are
+        coerced to plain Python numbers — custom ``update()``
+        implementations routinely leave numpy scalars in ``sum_metric``,
+        which would poison the snapshot manifest's ``json.dumps``."""
+        def _py(v):
+            if isinstance(v, list):
+                return [_py(x) for x in v]
+            return v.item() if hasattr(v, "item") else v
+
+        return {"sum_metric": _py(self.sum_metric),
+                "num_inst": _py(self.num_inst)}
+
+    def set_state(self, state):
+        """Inverse of :meth:`get_state` (after a :meth:`reset`)."""
+        self.sum_metric = state["sum_metric"]
+        self.num_inst = state["num_inst"]
+
     # -- device path (sync-free fit) --------------------------------------
     def _device_batch_stats(self, labels, preds):
         """Per-batch sufficient statistics as traced jax scalars:
@@ -133,6 +155,18 @@ class CompositeEvalMetric(EvalMetric):
             results.append(result) if not isinstance(result, list) \
                 else results.extend(result)
         return (names, results)
+
+    def get_state(self):
+        return {"children": [m.get_state() for m in self.metrics]}
+
+    def set_state(self, state):
+        children = state["children"]
+        if len(children) != len(self.metrics):
+            raise MXNetError(
+                "composite metric state has %d children, metric has %d"
+                % (len(children), len(self.metrics)))
+        for metric, child in zip(self.metrics, children):
+            metric.set_state(child)
 
 
 @registry.register
@@ -687,6 +721,17 @@ class DeviceMetric(EvalMetric):
     def get_name_value(self):
         self._sync()
         return self._base.get_name_value()
+
+    def get_state(self):
+        # the sync folds any device-accumulated stats into the host
+        # leaves first, so the captured state is complete — this is the
+        # "drain the device-metric accumulator" step of a preemption
+        self._sync()
+        return self._base.get_state()
+
+    def set_state(self, state):
+        self._acc = None
+        self._base.set_state(state)
 
 
 def np(numpy_feval, name=None, allow_extra_outputs=False):

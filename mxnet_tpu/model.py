@@ -10,16 +10,19 @@ helper trio used by Module (``_create_kvstore`` :40,
 from __future__ import annotations
 
 import glob as _glob
+import hashlib as _hashlib
 import json
 import logging
 import os
 import re as _re
+import threading as _threading
 
 import numpy as np
 
 from . import io as mxio
 from . import ndarray as nd
 from . import symbol as sym
+from . import telemetry as _telemetry
 from .base import (MXNetError, atomic_write as _atomic_write,
                    atomic_write_bytes as _atomic_write_bytes)
 from .context import cpu
@@ -113,18 +116,39 @@ def _manifest_path(prefix):
     return "%s-manifest.json" % prefix
 
 
+def _sha256_file(path):
+    """Hex sha256 of a file, streamed (checkpoint payloads can be GBs)."""
+    h = _hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+#: one writer at a time for a manifest read-modify-write: the async
+#: snapshot writer thread and fit's epoch-boundary save share a prefix
+_MANIFEST_LOCK = _threading.Lock()
+
+
 def checkpoint_manifest(prefix):
     """Read ``prefix-manifest.json`` -> dict, or None when absent/corrupt.
 
-    Format (version 1)::
+    Format (version 2; version-1 files load unchanged)::
 
-        {"format": 1, "prefix": "<basename>", "epochs": [1, 2, 3],
-         "latest": 3}
+        {"format": 2, "prefix": "<basename>", "epochs": [1, 2, 3],
+         "latest": 3,
+         "payload_sha256": {"3": "<hex>"},
+         "snapshots": [{"epoch": 2, "nbatch": 17,
+                        "params": "<basename>-snap-0002-000017.params",
+                        "sha256": "<hex>", "states": ..., "rng_state": ...,
+                        "metric_state": ..., "iter_state": ...}]}
 
     ``epochs`` lists every epoch whose params file completed its atomic
-    rename; ``latest`` is ``max(epochs)``.  The manifest itself is written
-    atomically, so it never names an epoch whose file was still in
-    flight."""
+    rename; ``latest`` is ``max(epochs)``.  ``snapshots`` lists the
+    retained mid-epoch generations (``mxnet_tpu.checkpoint``), each with
+    the sha256 of its payload files and the host-side state (RNG /
+    metric / iterator) an exact resume needs.  The manifest itself is
+    written atomically, so it never names a file still in flight."""
     try:
         with open(_manifest_path(prefix)) as f:
             m = json.load(f)
@@ -133,17 +157,73 @@ def checkpoint_manifest(prefix):
     if not isinstance(m, dict) or not isinstance(m.get("epochs"), list) \
             or not all(isinstance(e, int) for e in m["epochs"]):
         return None
+    if not isinstance(m.get("snapshots", []), list):
+        return None
     return m
 
 
-def _manifest_add_epoch(prefix, epoch):
-    m = checkpoint_manifest(prefix) or {
-        "format": 1, "prefix": os.path.basename(prefix), "epochs": []}
-    epochs = sorted(set(int(e) for e in m["epochs"]) | {int(epoch)})
-    m["epochs"] = epochs
-    m["latest"] = epochs[-1]
-    blob = json.dumps(m, indent=2, sort_keys=True)
-    _atomic_write_bytes(_manifest_path(prefix), blob, mode="w")
+def _manifest_mutate(prefix, fn, durable=True):
+    """Atomic read-modify-write of the manifest under the process lock.
+    ``fn(m)`` edits the dict in place; the result is committed via
+    ``atomic_write`` so readers see old-or-new, never a torn file.
+    ``durable=False`` (the snapshot hot path) skips the fsyncs — see
+    ``base.atomic_write``."""
+    with _MANIFEST_LOCK:
+        m = checkpoint_manifest(prefix) or {
+            "format": 2, "prefix": os.path.basename(prefix), "epochs": []}
+        m["format"] = 2
+        fn(m)
+        blob = json.dumps(m, indent=2, sort_keys=True)
+        _atomic_write_bytes(_manifest_path(prefix), blob, mode="w",
+                            durable=durable)
+        return m
+
+
+def _manifest_add_epoch(prefix, epoch, sha256=None):
+    def _add(m):
+        epochs = sorted(set(int(e) for e in m["epochs"]) | {int(epoch)})
+        m["epochs"] = epochs
+        m["latest"] = epochs[-1]
+        if sha256 is not None:
+            m.setdefault("payload_sha256", {})[str(int(epoch))] = sha256
+
+    _manifest_mutate(prefix, _add)
+
+
+def _snap_key(entry):
+    return (int(entry.get("epoch", -1)), int(entry.get("nbatch", -1)))
+
+
+def _manifest_add_snapshot(prefix, entry):
+    def _add(m):
+        snaps = [s for s in m.get("snapshots", [])
+                 if _snap_key(s) != _snap_key(entry)]
+        snaps.append(entry)
+        m["snapshots"] = sorted(snaps, key=_snap_key)
+
+    _manifest_mutate(prefix, _add, durable=False)
+
+
+def _manifest_prune_snapshots(prefix, keep_last):
+    """Drop all but the newest ``keep_last`` snapshot entries from the
+    manifest; returns the PRUNED entries (payload files still on disk —
+    the caller unlinks them after this commit, the crash-safe order).
+    Skips the manifest rewrite entirely when nothing needs pruning."""
+    with _MANIFEST_LOCK:
+        m = checkpoint_manifest(prefix)
+    if m is None or len(m.get("snapshots", [])) <= keep_last:
+        return []
+    pruned = []
+
+    def _prune(m):
+        snaps = sorted(m.get("snapshots", []), key=_snap_key)
+        if len(snaps) > keep_last:
+            pruned.extend(snaps[:-keep_last])
+            snaps = snaps[-keep_last:]
+        m["snapshots"] = snaps
+
+    _manifest_mutate(prefix, _prune, durable=False)
+    return pruned
 
 
 def list_checkpoints(prefix):
@@ -183,7 +263,10 @@ def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params):
     param_name = "%s-%04d.params" % (prefix, epoch)
     _atomic_write(param_name, lambda tmp: nd.save(tmp, save_dict),
                   fault_point="checkpoint.write")
-    _manifest_add_epoch(prefix, epoch)
+    # digest of the renamed payload, recorded in the manifest so resume
+    # can verify the bytes before trusting them (a crash between the
+    # rename and this manifest write leaves the previous entry intact)
+    _manifest_add_epoch(prefix, epoch, sha256=_sha256_file(param_name))
     logging.info("Saved checkpoint to \"%s\"", param_name)
 
 
@@ -205,18 +288,33 @@ def load_checkpoint(prefix, epoch):
 def load_latest_checkpoint(prefix, logger=logging):
     """Newest checkpoint that passes a full load-verify pass.
 
-    Walks ``list_checkpoints`` newest-first; a truncated or otherwise
-    corrupt params file is skipped with a warning (never a crash) and the
-    next-older epoch is tried.  Returns ``(epoch, symbol, arg_params,
-    aux_params)`` or None when no loadable checkpoint exists — the
+    Walks ``list_checkpoints`` newest-first; every candidate whose
+    sha256 is recorded in the manifest re-verifies the payload digest
+    BEFORE load, and every candidate additionally takes a full
+    load-verify pass — a truncated, bit-flipped or otherwise corrupt
+    params file is skipped with a warning (never a crash), counted as
+    ``resilience.checkpoint.corrupt_skipped``, and the next-older epoch
+    is tried.  Returns ``(epoch, symbol, arg_params, aux_params)`` or
+    None when no loadable checkpoint exists — the
     ``Module.fit(resume="auto")`` discovery pass."""
+    m = checkpoint_manifest(prefix) or {}
+    shas = m.get("payload_sha256") or {}
     for epoch in list_checkpoints(prefix):
+        params = "%s-%04d.params" % (prefix, epoch)
+        want = shas.get(str(epoch))
+        if want is not None and _sha256_file(params) != want:
+            logger.warning(
+                "checkpoint %s failed sha256 verification against the "
+                "manifest; falling back to the previous epoch", params)
+            _telemetry.inc("resilience.checkpoint.corrupt_skipped")
+            continue
         try:
             symbol, arg_params, aux_params = load_checkpoint(prefix, epoch)
         except (MXNetError, OSError, ValueError) as e:
             logger.warning(
                 "checkpoint %s-%04d.params failed verification (%s); "
                 "falling back to the previous epoch", prefix, epoch, e)
+            _telemetry.inc("resilience.checkpoint.corrupt_skipped")
             continue
         return (epoch, symbol, arg_params, aux_params)
     return None

@@ -9,14 +9,18 @@ SURVEY §3.1.
 
 from __future__ import annotations
 
+import contextlib
 import logging
 import os
+import signal as _signal
+import threading
 import time
 
 import numpy as np
 
 from .. import faults as _faults
 from .. import metric as _metric
+from .. import random as _random
 from .. import telemetry as _telemetry
 from ..base import MXNetError
 from ..model import BatchEndParam
@@ -35,11 +39,239 @@ _FIT_END = object()
 _RESILIENCE_COUNTERS = (
     "resilience.nan_batches", "resilience.recordio_skipped",
     "resilience.fault_injected", "resilience.checkpoint.saves",
-    "resilience.checkpoint.resumes", "resilience.rollbacks")
+    "resilience.checkpoint.resumes", "resilience.rollbacks",
+    "resilience.checkpoint.corrupt_skipped",
+    "resilience.checkpoint.async_dropped", "resilience.preemptions")
 
 
 def _as_metric(m):
     return m if isinstance(m, _metric.EvalMetric) else _metric.create(m)
+
+
+# -- graceful preemption (docs/resilience.md "Preemption & exact resume") --
+
+#: process-wide owner of the SIGTERM/SIGINT handlers: exactly ONE fit
+#: call may hold them — a nested fit (e.g. from a callback) refusing to
+#: double-install is the hygiene contract ci/check_signal_restore.py
+#: lints the restore half of
+_fit_signal_lock = threading.Lock()
+_fit_signal_owner = [None]
+
+
+class _PreemptGuard:
+    """Signal-to-flag bridge for one ``fit`` call: the handler only
+    records the signal; the batch loop notices at the next boundary,
+    finishes the in-flight batch, drains accumulators, checkpoints and
+    raises :class:`~mxnet_tpu.checkpoint.TrainingPreempted`.  A SECOND
+    signal while draining raises ``KeyboardInterrupt`` immediately — the
+    operator insists."""
+
+    __slots__ = ("requested",)
+
+    def __init__(self):
+        self.requested = None
+
+    def __call__(self, signum, frame):
+        if self.requested is not None:
+            raise KeyboardInterrupt(
+                "second signal %s during preemption drain" % signum)
+        self.requested = signum
+
+
+@contextlib.contextmanager
+def _preempt_signals(guard, logger, enable=True):
+    """Install ``guard`` as the SIGTERM/SIGINT handler for the scope,
+    restoring the previous handlers on ANY exit path (the try/finally
+    is what ``ci/check_signal_restore.py`` enforces).  ``enable=False``
+    (fit without ``checkpoint_prefix``) leaves the process handlers
+    untouched — a plain fit keeps its KeyboardInterrupt semantics.
+    Outside the main thread Python forbids handler installation; fit
+    then runs without graceful preemption (logged once)."""
+    if not enable:
+        yield guard
+        return
+    if threading.current_thread() is not threading.main_thread():
+        logger.debug("fit: not on the main thread; SIGTERM/SIGINT "
+                     "graceful drain is unavailable here")
+        yield guard
+        return
+    with _fit_signal_lock:
+        if _fit_signal_owner[0] is not None:
+            raise MXNetError(
+                "a fit call already owns the process SIGTERM/SIGINT "
+                "handlers (nested fit from a callback?): refusing to "
+                "double-install — run the inner fit after the outer one "
+                "finishes, or in a separate process")
+        _fit_signal_owner[0] = guard
+    prev_term = _signal.signal(_signal.SIGTERM, guard)
+    try:
+        prev_int = _signal.signal(_signal.SIGINT, guard)
+        try:
+            yield guard
+        finally:
+            _signal.signal(_signal.SIGINT, prev_int)
+    finally:
+        _signal.signal(_signal.SIGTERM, prev_term)
+        with _fit_signal_lock:
+            _fit_signal_owner[0] = None
+
+
+def _adapt_iter_state(state, target):
+    """Bridge an iterator-state capture across a prefetch-wrapping
+    difference between the killed and the resumed run: a wrapper state
+    unwraps onto a plain iterator (single sub-iterator only) and a plain
+    state wraps for a wrapper target."""
+    from ..io import PrefetchingIter
+
+    wrapper_state = isinstance(state, dict) and \
+        state.get("type") in ("PrefetchingIter", "DevicePrefetchIter")
+    if isinstance(target, PrefetchingIter):
+        if not wrapper_state:
+            return {"type": type(target).__name__, "inner": [state]}
+        return state
+    if wrapper_state and len(state.get("inner", [])) == 1:
+        return state["inner"][0]
+    return state
+
+
+class _FitRun:
+    """Per-``fit`` resilience plumbing: the batch-granular snapshot
+    cadence, the async writer, and the preemption drain sequence."""
+
+    def __init__(self, prefix, every_n, writer, guard, logger,
+                 keep_last=None):
+        self.prefix = prefix
+        self.every_n = every_n
+        self.writer = writer
+        self.guard = guard
+        self.logger = logger
+        self.keep_last = keep_last
+        self._warned_iter = False
+
+    def capture(self, module, epoch, nbatch, fit_data, eval_metric):
+        """One :class:`~mxnet_tpu.checkpoint.Snapshot`: device copies of
+        the big arrays (no host sync), host dicts for the smalls.  The
+        metric capture syncs the device-metric accumulator — that IS the
+        drain step — and the iterator capture drains the prefetch
+        queue."""
+        from .. import checkpoint as _ckpt
+
+        if hasattr(module, "_capture_state_arrays"):
+            arg, aux, opt_states, opt_counts = \
+                module._capture_state_arrays()
+        else:
+            arg_l, aux_l = module.get_params()
+            arg = {k: v.copy() for k, v in arg_l.items()}
+            aux = {k: v.copy() for k, v in aux_l.items()}
+            opt_states = opt_counts = None
+        rng = {"global": _random.get_state()}
+        ex = getattr(module, "_exec", None)
+        if ex is not None:
+            rng["exec_step"] = int(getattr(ex, "_rng_step", 0))
+        try:
+            iter_state = fit_data.state_dict()
+        except NotImplementedError:
+            if not self._warned_iter:
+                self.logger.warning(
+                    "checkpoint snapshot: %s has no iterator-state "
+                    "protocol; mid-epoch resume will degrade to the "
+                    "epoch boundary", type(fit_data).__name__)
+                self._warned_iter = True
+            iter_state = None
+        try:
+            metric_state = eval_metric.get_state()
+        except NotImplementedError:
+            metric_state = None
+        return _ckpt.Snapshot(epoch, nbatch, arg, aux,
+                              opt_states=opt_states,
+                              opt_counts=opt_counts, rng_state=rng,
+                              metric_state=metric_state,
+                              iter_state=iter_state)
+
+    def after_batch(self, module, epoch, nbatch, fit_data, eval_metric,
+                    drain_guard=None):
+        """Bottom-of-batch hook: take the cadence snapshot, then honor a
+        pending preemption (the in-flight batch is complete by now)."""
+        if self.every_n is not None and (nbatch + 1) % self.every_n == 0:
+            self.writer.submit(
+                self.capture(module, epoch, nbatch, fit_data, eval_metric))
+        self.check_preempt(module, epoch, nbatch, fit_data, eval_metric,
+                           drain_guard)
+
+    def epoch_end_preempt(self, module, epoch, already_saved):
+        """Preemption noticed at the epoch boundary: epoch ``epoch`` is
+        fully complete (metrics logged, eval done, iterator reset), so
+        the resume point is the epoch-``epoch + 1`` checkpoint — written
+        here if the cadence had not already produced it."""
+        from .. import checkpoint as _ckpt
+
+        signum = self.guard.requested
+        path = None
+        if self.prefix is not None:
+            if not already_saved:
+                arg_params_, aux_params_ = module.get_params()
+                module._save_fit_checkpoint(self.prefix, epoch + 1,
+                                            arg_params_, aux_params_)
+            path = "%s-%04d.params" % (self.prefix, epoch + 1)
+        _telemetry.inc("resilience.preemptions")
+        _telemetry.event("preemption", epoch=epoch, nbatch=None,
+                         signal=signum, checkpoint=path)
+        self.logger.warning(
+            "preempted (signal %s) during epoch %d wrap-up: epoch "
+            "complete, checkpoint %s", signum, epoch,
+            path if path else "skipped (no checkpoint_prefix)")
+        raise _ckpt.TrainingPreempted(
+            "training preempted by signal %s at the end of epoch %d "
+            "(epoch complete; resume with resume='auto')"
+            % (signum, epoch), checkpoint_path=path, epoch=epoch,
+            nbatch=None, signum=signum)
+
+    def check_preempt(self, module, epoch, nbatch, fit_data, eval_metric,
+                      drain_guard=None):
+        from .. import checkpoint as _ckpt
+
+        if self.guard is None or self.guard.requested is None:
+            return
+        signum = self.guard.requested
+        # drain order: NaN-guard flag first (a poisoned final batch must
+        # not be checkpointed unflagged), then the capture itself syncs
+        # the device-metric accumulator and the prefetch queue
+        if drain_guard is not None:
+            drain_guard()
+        path = None
+        if self.prefix is not None:
+            snap = self.capture(module, epoch, nbatch, fit_data,
+                                eval_metric)
+            if self.writer is not None:
+                # wait out an in-flight async write (≤1 by construction),
+                # then write the final snapshot synchronously.  A STALE
+                # background-write failure must not abort the drain —
+                # the final snapshot below is exactly what a preempted
+                # worker needs most
+                try:
+                    self.writer.drain()
+                except Exception as e:  # noqa: broad-except — logged;
+                    # the synchronous final write raises its own errors
+                    self.logger.warning(
+                        "preemption drain: earlier async snapshot write "
+                        "had failed (%s); writing the final snapshot "
+                        "anyway", e)
+            path = _ckpt.write_snapshot(self.prefix, snap,
+                                        logger=self.logger,
+                                        keep_last=self.keep_last)
+        _telemetry.inc("resilience.preemptions")
+        _telemetry.event("preemption", epoch=epoch, nbatch=nbatch,
+                         signal=signum, checkpoint=path)
+        self.logger.warning(
+            "preempted (signal %s) at epoch %d batch %d: in-flight batch "
+            "finished, accumulators drained, checkpoint %s",
+            signum, epoch, nbatch,
+            path if path else "skipped (no checkpoint_prefix)")
+        raise _ckpt.TrainingPreempted(
+            "training preempted by signal %s at epoch %d batch %d "
+            "(graceful drain complete; resume with resume='auto')"
+            % (signum, epoch, nbatch), checkpoint_path=path, epoch=epoch,
+            nbatch=nbatch, signum=signum)
 
 
 class BaseModule:
@@ -148,7 +380,7 @@ class BaseModule:
             begin_epoch=0, num_epoch=None, validation_metric=None,
             monitor=None, checkpoint_prefix=None, checkpoint_period=1,
             resume=None, nan_policy=None, nan_check_period=None,
-            prefetch_to_device=None):
+            prefetch_to_device=None, checkpoint_every_n_batches=None):
         """reference ``base_module.py:369`` — THE training loop.
 
         Sync-free hot loop (docs/how_to/perf.md): eligible metrics are
@@ -166,12 +398,31 @@ class BaseModule:
         ``checkpoint_prefix``
             When set, an atomic checkpoint (params [+ optimizer states] +
             manifest) is written every ``checkpoint_period`` epochs and at
-            the final epoch.
+            the final epoch.  Additionally installs SIGTERM/SIGINT
+            graceful-preemption handlers for the duration of the call
+            (restored on exit): on signal the in-flight batch finishes,
+            accumulators drain, a final mid-epoch snapshot is written and
+            :class:`~mxnet_tpu.checkpoint.TrainingPreempted` is raised
+            carrying the checkpoint path.
+        ``checkpoint_every_n_batches``
+            Batch-granular snapshot cadence (default: the
+            ``MXNET_CKPT_EVERY_N_BATCHES`` env var; unset disables).
+            Every N batches the params / optimizer states are captured as
+            device-side copies (no host sync on the hot loop) and a
+            background writer thread serializes them — manifest-last,
+            sha256-recorded, ``MXNET_CKPT_KEEP_LAST`` generations
+            retained (``MXNET_CKPT_ASYNC=0`` forces inline writes).  At
+            most one snapshot is ever in flight; cadence ticks landing on
+            a busy writer are dropped and counted.
         ``resume="auto"``
-            Restart from the newest checkpoint under ``checkpoint_prefix``
-            that passes a load-verify pass; truncated/corrupt files are
-            skipped with a warning.  ``begin_epoch``/``arg_params`` are
-            taken from the recovered checkpoint.
+            Restart from the newest checkpoint OR mid-epoch snapshot
+            under ``checkpoint_prefix`` that passes sha256 + load
+            verification; corrupt generations are skipped with a warning
+            and counted.  A mid-epoch snapshot resumes EXACTLY: params,
+            optimizer states and update counts, RNG streams, metric
+            accumulators and the data-iterator position are restored, so
+            the resumed trajectory is bit-identical to an uninterrupted
+            run (tests/test_preemption.py pins this).
         ``nan_policy``
             Per-batch NaN/Inf guard on loss and gradients (default: the
             ``MXNET_NAN_POLICY`` env var; None disables).  ``"raise"``
@@ -209,29 +460,57 @@ class BaseModule:
         if checkpoint_prefix is not None and checkpoint_period < 1:
             raise MXNetError("checkpoint_period must be >= 1, got %r"
                              % (checkpoint_period,))
+        if checkpoint_every_n_batches is None:
+            env_cadence = int(os.environ.get(
+                "MXNET_CKPT_EVERY_N_BATCHES", "0") or 0) or None
+            if env_cadence is not None and checkpoint_prefix is None:
+                # a job-wide env cadence must not break fits that never
+                # asked for checkpointing; only the EXPLICIT argument
+                # hard-fails below
+                self.logger.debug(
+                    "MXNET_CKPT_EVERY_N_BATCHES=%d ignored: this fit "
+                    "has no checkpoint_prefix", env_cadence)
+            else:
+                checkpoint_every_n_batches = env_cadence
+        if checkpoint_every_n_batches is not None:
+            if checkpoint_prefix is None:
+                raise MXNetError(
+                    "checkpoint_every_n_batches needs checkpoint_prefix")
+            if checkpoint_every_n_batches < 1:
+                raise MXNetError(
+                    "checkpoint_every_n_batches must be >= 1, got %r"
+                    % (checkpoint_every_n_batches,))
         resume_states = None
+        resume_state = None  # mid-epoch TrainingState (exact resume)
         if resume == "auto":
             if checkpoint_prefix is None:
                 raise MXNetError("resume='auto' needs checkpoint_prefix")
-            from ..model import load_latest_checkpoint
+            from ..checkpoint import load_latest_state
 
-            found = load_latest_checkpoint(checkpoint_prefix,
-                                           logger=self.logger)
+            found = load_latest_state(checkpoint_prefix,
+                                      logger=self.logger)
             if found is not None:
-                ck_epoch, _ck_sym, ck_arg, ck_aux = found
                 _telemetry.inc("resilience.checkpoint.resumes")
-                _telemetry.event("checkpoint.resume", epoch=ck_epoch,
+                _telemetry.event("checkpoint.resume", epoch=found.epoch,
+                                 nbatch=found.nbatch,
                                  prefix=checkpoint_prefix)
-                begin_epoch = ck_epoch
-                arg_params, aux_params = ck_arg, ck_aux
+                begin_epoch = found.epoch
+                arg_params, aux_params = \
+                    found.arg_params, found.aux_params
                 force_init = True
-                states = "%s-%04d.states" % (checkpoint_prefix, ck_epoch)
-                if os.path.exists(states) \
-                        and hasattr(self, "load_optimizer_states"):
-                    resume_states = states
-                self.logger.info(
-                    "resume='auto': restarting from checkpoint epoch %d "
-                    "(%s)", ck_epoch, checkpoint_prefix)
+                if found.nbatch is None:
+                    if found.states_path is not None \
+                            and hasattr(self, "load_optimizer_states"):
+                        resume_states = found.states_path
+                    self.logger.info(
+                        "resume='auto': restarting from checkpoint epoch "
+                        "%d (%s)", found.epoch, checkpoint_prefix)
+                else:
+                    resume_state = found
+                    self.logger.info(
+                        "resume='auto': exact mid-epoch resume from "
+                        "snapshot epoch %d batch %d (%s)", found.epoch,
+                        found.nbatch, checkpoint_prefix)
             else:
                 self.logger.info(
                     "resume='auto': no loadable checkpoint under %r; "
@@ -249,6 +528,19 @@ class BaseModule:
                             optimizer_params=optimizer_params)
         if resume_states is not None:
             self.load_optimizer_states(resume_states)
+        if resume_state is not None:
+            # exact resume: optimizer states + update counts + RNG
+            # streams (the iterator position is restored further down,
+            # once the actual fit iterator — wrapper included — exists)
+            if hasattr(self, "_restore_opt_snapshot"):
+                self._restore_opt_snapshot(resume_state.states_bytes,
+                                           resume_state.opt_counts)
+            rng = resume_state.rng_state or {}
+            if rng.get("global"):
+                _random.set_state(rng["global"])
+            ex = getattr(self, "_exec", None)
+            if ex is not None and rng.get("exec_step") is not None:
+                ex._rng_step = int(rng["exec_step"])
         if hasattr(self, "_install_nan_guard"):
             # unconditional: a previous fit's guard must DISARM when this
             # fit runs without a policy (stale accumulated flags would
@@ -283,8 +575,12 @@ class BaseModule:
         # monitor forces the classic path — as do the per-batch NaN guard
         # and the fit.batch fault point, which must see every step.
         bulk_k = max(1, int(os.environ.get("MXNET_BULK_TRAIN_STEPS", "1")))
+        # the fit.preempt fault ("deliver SIGTERM at batch k") needs the
+        # per-batch loop for deterministic batch-k delivery, like
+        # fit.batch does
         use_bulk = bulk_k > 1 and monitor is None \
             and nan_policy is None and not _faults.armed("fit.batch") \
+            and not _faults.armed("fit.preempt") \
             and hasattr(self, "run_bulk")
         if use_bulk and hasattr(self, "_full_step_eligible") \
                 and not self._full_step_eligible():
@@ -337,14 +633,68 @@ class BaseModule:
             fit_data = DevicePrefetchIter(train_data,
                                           placer=self._device_put_batch)
         owns_iter = fit_data is not train_data
+        # exact mid-epoch resume: the iterator position restores onto the
+        # iterator fit actually drives (the prefetch wrapper when owned —
+        # its restore drains the queue and rewinds the inner iterator)
+        resume_nbatch = None
+        resume_metric_state = None
+        if resume_state is not None and resume_state.nbatch is not None:
+            if resume_state.iter_state is not None:
+                try:
+                    fit_data.load_state_dict(_adapt_iter_state(
+                        resume_state.iter_state, fit_data))
+                    resume_nbatch = resume_state.nbatch
+                    resume_metric_state = resume_state.metric_state
+                except Exception as e:  # noqa: broad-except — ANY
+                    # restore failure (unsupported iterator, a snapshot
+                    # from a different iterator type raising KeyError,
+                    # shape mismatch) must degrade to epoch-boundary
+                    # resume, never abort a fit whose params snapshot
+                    # loaded fine
+                    self.logger.warning(
+                        "resume: could not restore the iterator position "
+                        "(%s: %s); restarting epoch %d from batch 0 — "
+                        "data from the partial epoch will replay",
+                        type(e).__name__, e, resume_state.epoch)
+            else:
+                self.logger.warning(
+                    "resume: snapshot carries no iterator state; "
+                    "restarting epoch %d from batch 0 — data from the "
+                    "partial epoch will replay", resume_state.epoch)
+        writer = None
+        if checkpoint_every_n_batches is not None:
+            from ..checkpoint import AsyncSnapshotWriter
+
+            ckpt_async = os.environ.get("MXNET_CKPT_ASYNC", "1") \
+                not in ("0", "", "false")
+            writer = AsyncSnapshotWriter(checkpoint_prefix,
+                                         logger=self.logger,
+                                         sync=not ckpt_async)
+        guard = _PreemptGuard()
+        run = _FitRun(checkpoint_prefix, checkpoint_every_n_batches,
+                      writer, guard, self.logger)
+        # visible to _rollback_to_checkpoint: a rollback must quiesce
+        # the writer before discarding post-rollback snapshots
+        self._active_ckpt_writer = writer
         try:
-            self._fit_epochs(
-                fit_data, eval_data, eval_metric, validation_metric,
-                epoch_end_callback, batch_end_callback, eval_end_callback,
-                eval_batch_end_callback, monitor, begin_epoch, num_epoch,
-                checkpoint_prefix, checkpoint_period, nan_policy,
-                nan_check_period, use_bulk, bulk_k, _trip_nan_policy,
-                owns_iter)
+            # graceful preemption is tied to checkpointing: a fit that
+            # never asked for a checkpoint_prefix keeps the process's
+            # own SIGTERM/SIGINT semantics (Ctrl-C still interrupts)
+            with _preempt_signals(guard, self.logger,
+                                  enable=checkpoint_prefix is not None):
+                self._fit_epochs(
+                    fit_data, eval_data, eval_metric, validation_metric,
+                    epoch_end_callback, batch_end_callback,
+                    eval_end_callback, eval_batch_end_callback, monitor,
+                    begin_epoch, num_epoch, checkpoint_prefix,
+                    checkpoint_period, nan_policy, nan_check_period,
+                    use_bulk, bulk_k, _trip_nan_policy, owns_iter,
+                    run=run, resume_nbatch=resume_nbatch,
+                    resume_metric_state=resume_metric_state)
+            if writer is not None:
+                # clean-path close surfaces a failed background write as
+                # an error instead of silently training un-checkpointed
+                writer.close()
             if owns_iter:
                 # restore fit's postcondition (train_data left reset)
                 # only after the producer threads are joined — the
@@ -353,6 +703,16 @@ class BaseModule:
                 fit_data.close()
                 train_data.reset()
         finally:
+            self._active_ckpt_writer = None
+            if writer is not None:
+                try:
+                    writer.close()
+                except Exception as e:  # noqa: broad-except — the clean
+                    # path above already surfaced writer errors; here we
+                    # must not mask the in-flight exception (preemption,
+                    # NaN raise) with a checkpoint-write failure
+                    self.logger.warning(
+                        "async checkpoint writer close: %s", e)
             if owns_iter:
                 fit_data.close()
 
@@ -362,14 +722,29 @@ class BaseModule:
                     eval_batch_end_callback, monitor, begin_epoch,
                     num_epoch, checkpoint_prefix, checkpoint_period,
                     nan_policy, nan_check_period, use_bulk, bulk_k,
-                    _trip_nan_policy, owns_iter=False):
+                    _trip_nan_policy, owns_iter=False, run=None,
+                    resume_nbatch=None, resume_metric_state=None):
         """The epoch/batch loop body of :meth:`fit` (split out so the
-        device-prefetch wrapper can be closed deterministically)."""
+        device-prefetch wrapper can be closed deterministically).
+
+        ``run`` is the per-fit :class:`_FitRun` (snapshot cadence +
+        preemption drain); ``resume_nbatch``/``resume_metric_state``
+        position the FIRST epoch mid-stream for an exact mid-epoch
+        resume — the iterator was already rewound by :meth:`fit`."""
         for epoch in range(begin_epoch, num_epoch):
             tic = time.time()
             eval_metric.reset()
+            start_nbatch = -1
+            if resume_nbatch is not None and epoch == begin_epoch:
+                # continue the interrupted epoch: batch numbering picks
+                # up after the last completed batch (cadences — NaN
+                # window, snapshots, callbacks — stay aligned with an
+                # uninterrupted run) and the metric resumes its sums
+                start_nbatch = resume_nbatch
+                if resume_metric_state is not None:
+                    eval_metric.set_state(resume_metric_state)
             if use_bulk:
-                nbatch = -1
+                nbatch = start_nbatch
                 chunk = []
                 device_out = isinstance(eval_metric, _metric.DeviceMetric)
 
@@ -402,11 +777,22 @@ class BaseModule:
                     if len(chunk) == bulk_k:
                         nbatch = _flush(chunk, nbatch)
                         chunk = []
+                        if run is not None:
+                            # bulk mode snapshots/preempts at chunk
+                            # boundaries only — params mid-chunk reflect
+                            # later batches' updates (the scan carries
+                            # them), so a mid-chunk capture could never
+                            # resume exactly
+                            run.after_batch(self, epoch, nbatch,
+                                            fit_data, eval_metric)
                 if chunk:
                     nbatch = _flush(chunk, nbatch)
+                    if run is not None:
+                        run.after_batch(self, epoch, nbatch, fit_data,
+                                        eval_metric)
             else:
                 train_iter = iter(fit_data)
-                nbatch = -1
+                nbatch = start_nbatch
                 # True while EVERY unread batch since the last flag read
                 # was a staged fused step (whose in-graph gate withheld
                 # non-finite updates) — a two-phase batch in the window
@@ -427,6 +813,15 @@ class BaseModule:
                     if data_batch is _FIT_END:
                         break
                     nbatch += 1
+                    if _faults.should_fire("fit.preempt"):
+                        # deterministic preemption: a REAL SIGTERM to
+                        # this process — the handler sets the drain flag
+                        # and the bottom-of-batch check does the rest,
+                        # exactly like a pod eviction would
+                        self.logger.warning(
+                            "fault 'fit.preempt': delivering SIGTERM at "
+                            "epoch %d batch %d", epoch, nbatch)
+                        os.kill(os.getpid(), _signal.SIGTERM)
                     if monitor is not None:
                         monitor.tic()
                     with _telemetry.phase("forward_backward"):
@@ -478,6 +873,16 @@ class BaseModule:
                             nan_action=nan_action)
                         for callback in _as_list(batch_end_callback):
                             callback(batch_end_param)
+                    if run is not None:
+                        # cadence snapshot + pending-preemption drain;
+                        # the guard drain mirrors the epoch-boundary one
+                        # so a poisoned window never checkpoints silently
+                        run.after_batch(
+                            self, epoch, nbatch, fit_data, eval_metric,
+                            drain_guard=lambda e=epoch, b=nbatch,
+                            g=window_all_staged: self._drain_nan_window(
+                                nan_policy, nan_check_period, e, b, g,
+                                _trip_nan_policy))
                 # epoch-boundary drain: with nan_check_period > 1 the
                 # last window may not have been read yet — a NaN epoch
                 # must not survive into checkpoint/eval unflagged
@@ -518,8 +923,29 @@ class BaseModule:
                 # would re-arm the producer thread, which could consume
                 # the user's first post-fit batch before close() lands
                 fit_data.reset()
+            if run is not None and run.guard is not None and \
+                    run.guard.requested is not None:
+                # a signal that landed during epoch-end processing
+                # (checkpoint save, callbacks, the eval pass) must not
+                # be swallowed: the epoch is complete, so the drain
+                # point is the epoch BOUNDARY — an epoch checkpoint,
+                # not a mid-epoch snapshot of the already-reset iterator
+                already_saved = checkpoint_prefix is not None and \
+                    ((epoch + 1) % checkpoint_period == 0
+                     or epoch + 1 == num_epoch)
+                run.epoch_end_preempt(self, epoch, already_saved)
 
     # -- resilience helpers (docs/resilience.md) --------------------------
+    def _drain_nan_window(self, nan_policy, nan_check_period, epoch,
+                          nbatch, gated, trip):
+        """Preemption-time NaN-guard drain: identical semantics to the
+        epoch-boundary drain — a partial read window is flushed so a
+        poisoned batch never slips into the final checkpoint unflagged."""
+        if nan_policy is not None and nbatch >= 0 and \
+                (nbatch + 1) % nan_check_period != 0 and \
+                self._batch_has_nonfinite():
+            trip(epoch, nbatch, gated=gated)
+
     def _guard_exec(self):
         """The executor whose gradients the NaN guard inspects: this
         module's, or the active bucket's for BucketingModule."""
@@ -608,6 +1034,24 @@ class BaseModule:
                 "rollback: no optimizer state snapshot (%s); keeping "
                 "current optimizer moments with epoch-%d parameters",
                 states, epoch)
+        # mid-epoch snapshots NEWER than the rollback point describe the
+        # abandoned (diverging) trajectory — left in place, a later
+        # resume='auto' would prefer them and resurrect exactly the
+        # state this rollback just discarded.  Quiesce the async writer
+        # FIRST: an in-flight pre-NaN snapshot committing after the
+        # discard would re-poison the manifest
+        from ..checkpoint import discard_snapshots_from
+
+        writer = getattr(self, "_active_ckpt_writer", None)
+        if writer is not None:
+            try:
+                writer.drain()
+            except Exception as e:  # noqa: broad-except — a failed
+                # background write must not abort the rollback itself
+                self.logger.warning(
+                    "rollback: async snapshot writer error ignored "
+                    "while quiescing (%s)", e)
+        discard_snapshots_from(prefix, epoch, logger=self.logger)
         self.logger.info("rolled back parameters to checkpoint epoch %d",
                          epoch)
         _telemetry.inc("resilience.rollbacks")

@@ -1072,6 +1072,98 @@ class Module(BaseModule):
         mon.install(self._exec)
 
     # -- checkpointing ----------------------------------------------------
+    def _capture_state_arrays(self):
+        """Device-side capture for async snapshots (docs/resilience.md):
+        one dispatched device-to-device ``NDArray.copy()`` per parameter
+        / aux / optimizer-state array — NO host sync on the training
+        loop; the background writer does the device→host transfer when
+        it serializes.  Returns ``(arg, aux, opt_states, opt_counts)``
+        where ``opt_states`` mirrors ``Updater.states`` (None when the
+        optimizer plane lives on the kvstore) and ``opt_counts`` carries
+        the scheduler-relevant update counters."""
+        import jax
+
+        assert self.binded and self.params_initialized
+        # a staged fused step must land before its params are captured
+        self._materialize_pending()
+        ex = self._exec
+        # ONE jitted multi-array copy instead of a dispatch per array:
+        # at snapshot cadence the per-dispatch round trip would be the
+        # whole capture cost
+        flat = []
+
+        def _grab(arr):
+            flat.append(arr._jx)
+            return len(flat) - 1
+
+        param_idx = {n: _grab(ex.arg_dict[n]) for n in self._param_names}
+        aux_idx = {n: _grab(a) for n, a in ex.aux_dict.items()}
+        state_spec = None
+        has_states = self.optimizer_initialized \
+            and self._updater is not None and not self._update_on_kvstore
+        if has_states:
+            def _spec(s):
+                if s is None:
+                    return None
+                if isinstance(s, (tuple, list)):
+                    return ("seq", type(s), [_spec(x) for x in s])
+                if isinstance(s, NDArray):
+                    return ("nd", _grab(s), s._ctx)
+                return ("raw", s)
+
+            state_spec = {i: _spec(s)
+                          for i, s in self._updater.states.items()}
+        fn = getattr(self, "_capture_copy_fn", None)
+        if fn is None:
+            fn = jax.jit(lambda xs: [x + 0 for x in xs])
+            self._capture_copy_fn = fn
+        copies = fn(flat) if flat else []
+
+        def _wrap(i, ctx):
+            return NDArray._from_jax(copies[i], ctx)
+
+        arg = {n: _wrap(i, ex.arg_dict[n]._ctx)
+               for n, i in param_idx.items()}
+        aux = {n: _wrap(i, ex.aux_dict[n]._ctx)
+               for n, i in aux_idx.items()}
+        opt_states = None
+        opt_counts = None
+        if has_states:
+            def _build(spec):
+                if spec is None:
+                    return None
+                kind = spec[0]
+                if kind == "seq":
+                    return spec[1](_build(x) for x in spec[2])
+                if kind == "nd":
+                    return _wrap(spec[1], spec[2])
+                return spec[1]
+
+            opt_states = {i: _build(s) for i, s in state_spec.items()}
+        if self._optimizer is not None:
+            opt_counts = {
+                "num_update": int(self._optimizer.num_update),
+                "index_update_count": {
+                    str(k): int(v) for k, v in
+                    self._optimizer._index_update_count.items()}}
+        return arg, aux, opt_states, opt_counts
+
+    def _restore_opt_snapshot(self, states_bytes, opt_counts):
+        """Resume half of :meth:`_capture_state_arrays`: re-install the
+        pickled updater states and the optimizer's update counters so a
+        resumed run's lr schedule continues exactly."""
+        if states_bytes is not None and self._updater is not None:
+            self._updater.set_states(states_bytes)
+            # unpickled states are locally-committed host arrays — the
+            # next update jit re-places them on the module mesh
+            self._dist_placed_states.clear()
+        if opt_counts and self._optimizer is not None:
+            self._optimizer.num_update = int(
+                opt_counts.get("num_update", self._optimizer.num_update))
+            idx = opt_counts.get("index_update_count") or {}
+            self._optimizer._index_update_count = {
+                int(k): int(v) for k, v in idx.items()}
+
     def save_checkpoint(self, prefix, epoch, save_optimizer_states=False):
         """reference module.py save_checkpoint"""
         arg_params, aux_params = self.get_params()

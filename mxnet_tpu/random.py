@@ -14,7 +14,8 @@ import threading
 
 import jax
 
-__all__ = ["seed", "next_key", "uniform", "normal", "randint"]
+__all__ = ["seed", "next_key", "get_state", "set_state", "uniform",
+           "normal", "randint"]
 
 _lock = threading.Lock()
 # lazy: building a PRNGKey runs a jit computation, which would initialize
@@ -57,6 +58,41 @@ def next_key():
             _key = jax.random.PRNGKey(0)
         _key, sub = jax.random.split(_key)
     return sub
+
+
+def get_state():
+    """JSON-able capture of the global RNG state — the jax key, the seed
+    base, and numpy's generator — for exact mid-epoch training resume
+    (docs/resilience.md): a resumed run draws the same sample stream an
+    uninterrupted run would have."""
+    import numpy as _np
+
+    with _lock:
+        key = None if _key is None \
+            else _np.asarray(_key).astype(_np.uint32).tolist()
+        seed_value = _seed_value
+    kind, keys, pos, has_gauss, cached = _np.random.get_state()
+    return {"seed": seed_value, "key": key,
+            "np_state": {"kind": kind, "keys": keys.tolist(), "pos": pos,
+                         "has_gauss": has_gauss, "cached": cached}}
+
+
+def set_state(state):
+    """Inverse of :func:`get_state`."""
+    import numpy as _np
+
+    global _key, _seed_value
+    with _lock:
+        _seed_value = int(state.get("seed", 0))
+        key = state.get("key")
+        _key = None if key is None \
+            else jax.numpy.asarray(_np.asarray(key, _np.uint32))
+    nps = state.get("np_state")
+    if nps:
+        _np.random.set_state((nps["kind"],
+                              _np.asarray(nps["keys"], _np.uint32),
+                              int(nps["pos"]), int(nps["has_gauss"]),
+                              float(nps["cached"])))
 
 
 def _nd():

@@ -148,6 +148,26 @@ class MXRecordIO:
     def tell(self):
         return self.record.tell()
 
+    # -- iterator-state protocol (docs/resilience.md "exact resume") ------
+    def state_dict(self):
+        """Byte position of the read stream — with ``load_state_dict``
+        this lets RecordIO-backed data iterators resume a mid-epoch
+        checkpoint at the exact next record."""
+        if self.writable:
+            raise MXNetError("state_dict is a reader-side protocol "
+                             "(writer position is not resumable)")
+        return {"type": type(self).__name__,
+                "pos": self.record.tell() if self.is_open else 0,
+                "num_skipped": self.num_skipped}
+
+    def load_state_dict(self, state):
+        if self.writable:
+            raise MXNetError("load_state_dict on a writer")
+        if not self.is_open:
+            self.open()
+        self.record.seek(int(state["pos"]))
+        self.num_skipped = int(state.get("num_skipped", 0))
+
     def write(self, buf):
         """Write one record (bytes), splitting at embedded magics."""
         assert self.writable

@@ -157,6 +157,7 @@ class DynamicBatcher:
         self.name = name
         self._queue = deque()
         self._depth = 0  # queued rows (admission unit)
+        self._inflight_rows = 0  # rows inside the current dispatch
         self._cond = threading.Condition(threading.Lock())
         self._thread = None
         self._running = False
@@ -253,13 +254,18 @@ class DynamicBatcher:
             batch = self._next_batch(block=False)
             if not batch:
                 break
-            if drain:
-                self._dispatch(batch)
-            else:
-                err = MXNetError("serving %r stopped before dispatch"
-                                 % self.name)
-                for r in batch:
-                    r.future.set_error(err)
+            try:
+                if drain:
+                    self._dispatch(batch)
+                else:
+                    err = MXNetError("serving %r stopped before dispatch"
+                                     % self.name)
+                    for r in batch:
+                        r.future.set_error(err)
+            finally:
+                with self._cond:
+                    self._inflight_rows = 0
+                    self._cond.notify_all()
 
     def close(self, drain=True):
         """Permanent :meth:`stop`: further ``submit`` calls fail fast
@@ -282,7 +288,20 @@ class DynamicBatcher:
         while self._running:
             batch = self._next_batch(block=True)
             if batch:
-                self._dispatch(batch)
+                try:
+                    self._dispatch(batch)
+                finally:
+                    with self._cond:
+                        self._inflight_rows = 0
+                        self._cond.notify_all()
+
+    def pending_rows(self):
+        """Rows queued plus rows inside the current device dispatch —
+        0 means the batcher is quiescent.  The graceful-drain probe
+        (``ServingHTTPServer.drain`` polls it to know when in-flight
+        work has finished, docs/serving.md)."""
+        with self._cond:
+            return self._depth + self._inflight_rows
 
     def _next_batch(self, block):
         """Pop a coalesced run of requests: flush immediately when
@@ -309,6 +328,7 @@ class DynamicBatcher:
                     break
                 self._cond.wait(min(remaining, 0.05))
             self._depth -= rows
+            self._inflight_rows = rows
             _telemetry.set_gauge("serving.queue.depth", self._depth,
                                  model=self.name)
             return batch

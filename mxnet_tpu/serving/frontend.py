@@ -18,12 +18,16 @@ from __future__ import annotations
 
 import json
 import logging
+import os
+import signal as _signal
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import numpy as np
 
 from .. import telemetry as _telemetry
+from ..base import MXNetError
 from .batcher import (DeadlineExceeded, DynamicBatcher, InvalidRequest,
                       Overloaded)
 from .registry import UnknownModel
@@ -49,6 +53,16 @@ class ServingHandle:
         return {"status": "ok",
                 "models": {m.name: m.version
                            for m in self.registry.models()}}
+
+    def pending_rows(self):
+        """Rows queued or in a device dispatch across every loaded
+        model — the quiescence probe graceful drain polls."""
+        total = 0
+        for m in self.registry.models():
+            batcher = getattr(m, "batcher", None)
+            if batcher is not None:
+                total += batcher.pending_rows()
+        return total
 
     def metrics_text(self):
         return _telemetry.prometheus_text()
@@ -84,7 +98,14 @@ class _Handler(BaseHTTPRequestHandler):
         handle = self.server.serving_handle
         self._count()
         if self.path == "/healthz":
-            self._send(200, handle.healthz())
+            payload = handle.healthz()
+            if getattr(self.server, "draining", False):
+                # a draining replica must fail readiness so the load
+                # balancer stops routing to it while in-flight work
+                # finishes
+                payload["status"] = "draining"
+                return self._send(503, payload)
+            self._send(200, payload)
         elif self.path == "/metrics":
             self._send(200, handle.metrics_text().encode(),
                        content_type="text/plain; version=0.0.4")
@@ -145,33 +166,52 @@ class _Handler(BaseHTTPRequestHandler):
             self.close_connection = True
             return self._send(400, {"error": "bad /predict request: %s"
                                     % e})
-        handle = self.server.serving_handle
+        # admission is lock-coupled with the draining flag: drain()
+        # flips the flag under the same lock, so a request can never
+        # slip between the check and the in-flight count — quiescence
+        # (pending_rows()==0 AND admitted==0) is race-free
+        srv = self.server
+        with srv.admission_lock:
+            draining = getattr(srv, "draining", False)
+            if not draining:
+                srv.admitted_requests += 1
+        if draining:
+            # stop admitting: the drain window is for finishing what is
+            # already queued, not for new work
+            _telemetry.inc("serving.shed.count", reason="draining")
+            return self._send(503, {"error": "server is draining "
+                                    "(preemption); retry elsewhere"})
         try:
-            # resolve ONCE: the version reported is the version that
-            # served, and a concurrent unload/reload can't turn a
-            # completed prediction into a 404
-            served = handle.registry.get(model)
-            out = served.predict(data, deadline_ms=deadline_ms,
-                                 timeout=timeout)
-            version = served.version
-        except InvalidRequest as e:
-            return self._send(400, {"error": str(e)})
-        except Overloaded as e:
-            return self._send(429, {"error": str(e)})
-        except DeadlineExceeded as e:
-            return self._send(504, {"error": str(e)})
-        except UnknownModel as e:
-            return self._send(404, {"error": str(e)})
-        except Exception as e:
-            # a dispatch error re-raised from the batch (numpy shape
-            # mismatch, injected fault, ...) must still produce an HTTP
-            # response on this keep-alive connection, never a handler
-            # crash with the client left hanging
-            return self._send(500, {"error": str(e)})
-        out = np.asarray(out)
-        self._send(200, {"model": model, "version": version,
-                         "shape": list(out.shape),
-                         "output": out.tolist()})
+            handle = srv.serving_handle
+            try:
+                # resolve ONCE: the version reported is the version that
+                # served, and a concurrent unload/reload can't turn a
+                # completed prediction into a 404
+                served = handle.registry.get(model)
+                out = served.predict(data, deadline_ms=deadline_ms,
+                                     timeout=timeout)
+                version = served.version
+            except InvalidRequest as e:
+                return self._send(400, {"error": str(e)})
+            except Overloaded as e:
+                return self._send(429, {"error": str(e)})
+            except DeadlineExceeded as e:
+                return self._send(504, {"error": str(e)})
+            except UnknownModel as e:
+                return self._send(404, {"error": str(e)})
+            except Exception as e:
+                # a dispatch error re-raised from the batch (numpy shape
+                # mismatch, injected fault, ...) must still produce an
+                # HTTP response on this keep-alive connection, never a
+                # handler crash with the client left hanging
+                return self._send(500, {"error": str(e)})
+            out = np.asarray(out)
+            self._send(200, {"model": model, "version": version,
+                             "shape": list(out.shape),
+                             "output": out.tolist()})
+        finally:
+            with srv.admission_lock:
+                srv.admitted_requests -= 1
 
 
 class ServingHTTPServer:
@@ -189,6 +229,12 @@ class ServingHTTPServer:
         self._httpd = ThreadingHTTPServer((host, port), _Handler)
         self._httpd.daemon_threads = True
         self._httpd.serving_handle = ServingHandle(registry)
+        self._httpd.draining = False
+        # admission accounting for graceful drain: flag + count mutate
+        # under ONE lock, so drain() cannot observe quiescence while an
+        # admitted request is still on its way to the batcher
+        self._httpd.admission_lock = threading.Lock()
+        self._httpd.admitted_requests = 0
         self._thread = None
 
     @property
@@ -218,6 +264,74 @@ class ServingHTTPServer:
         t, self._thread = self._thread, None
         if t is not None:
             t.join(timeout=10)
+
+    @property
+    def draining(self):
+        return self._httpd.draining
+
+    def drain(self, deadline=None):
+        """Graceful preemption shutdown (docs/resilience.md): stop
+        admitting (``/predict`` → 503, ``/healthz`` → 503 "draining"),
+        wait for every model batcher to go quiescent — queued plus
+        in-flight dispatches — under ``deadline`` seconds
+        (``MXNET_PREEMPT_DRAIN_DEADLINE``, default 30), then stop the
+        listener.  Returns True when the drain completed before the
+        deadline, False when work was still in flight at cutoff."""
+        if deadline is None:
+            deadline = float(os.environ.get(
+                "MXNET_PREEMPT_DRAIN_DEADLINE", "30") or 30)
+        with self._httpd.admission_lock:
+            self._httpd.draining = True
+        _telemetry.event("preemption", component="serving")
+        _log.warning("serving: draining (deadline %.1fs)", deadline)
+        handle = self._httpd.serving_handle
+        cutoff = time.monotonic() + deadline
+
+        def _busy():
+            with self._httpd.admission_lock:
+                admitted = self._httpd.admitted_requests
+            return admitted + handle.pending_rows()
+
+        clean = True
+        while _busy() > 0:
+            if time.monotonic() >= cutoff:
+                clean = False
+                _log.warning(
+                    "serving: drain deadline hit with %d requests/rows "
+                    "still in flight; stopping anyway", _busy())
+                break
+            time.sleep(0.01)
+        self.stop()
+        _log.info("serving: drained %s", "cleanly" if clean
+                  else "with deadline overrun")
+        return clean
+
+    def run_forever(self, drain_deadline=None):
+        """Serve until SIGTERM/SIGINT, then drain gracefully — the
+        blocking entry point a container deployment calls.  Handlers are
+        installed for the scope and restored on every exit path
+        (``ci/check_signal_restore.py`` lints this shape)."""
+        if threading.current_thread() is not threading.main_thread():
+            raise MXNetError("run_forever installs signal handlers and "
+                             "must run on the main thread")
+        self.start()
+        stop_ev = threading.Event()
+
+        def _on_signal(signum, frame):
+            _telemetry.event("preemption", component="serving",
+                             signal=signum)
+            stop_ev.set()
+
+        prev_term = _signal.signal(_signal.SIGTERM, _on_signal)
+        try:
+            prev_int = _signal.signal(_signal.SIGINT, _on_signal)
+            try:
+                stop_ev.wait()
+                return self.drain(deadline=drain_deadline)
+            finally:
+                _signal.signal(_signal.SIGINT, prev_int)
+        finally:
+            _signal.signal(_signal.SIGTERM, prev_term)
 
     def __enter__(self):
         return self.start()
