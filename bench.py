@@ -64,32 +64,33 @@ REGIME_WAIT_S = float(os.environ.get("BENCH_REGIME_WAIT_S", "20"))
 BASELINE_IPS = 45.52  # K80 ResNet-50 train, docs/how_to/perf.md:108-117
 DTYPE = os.environ.get("BENCH_DTYPE", "bfloat16")
 
-# bf16 dense peak TFLOP/s by PJRT device_kind (published chip specs);
-# BENCH_PEAK_TFLOPS overrides for kinds not listed here
-_PEAK_BY_KIND = {
-    "TPU v2": 46.0,
-    "TPU v3": 123.0,
-    "TPU v4": 275.0,
-    "TPU v4 lite": 138.0,
-    "TPU v5": 459.0,        # v5p
-    "TPU v5 lite": 197.0,   # v5e
-    "TPU v5e": 197.0,
-    "TPU v6 lite": 918.0,   # v6e / Trillium
-    "TPU v6e": 918.0,
-}
-
-
 def _detect_peak_tflops(device):
-    env = os.environ.get("BENCH_PEAK_TFLOPS")
-    if env:
-        return float(env), "env"
+    # canonical detection (env overrides + table) lives with the MFU
+    # machinery in perfdebug, so bench rows and the live perf.mfu_pct
+    # gauge can never disagree about the chip's peak
+    from mxnet_tpu.perfdebug import device_peak_tflops
+
+    if os.environ.get("BENCH_PEAK_TFLOPS") \
+            or os.environ.get("MXNET_PEAK_TFLOPS"):
+        return device_peak_tflops(device), "env"
     kind = getattr(device, "device_kind", "") or ""
-    if kind in _PEAK_BY_KIND:
-        return _PEAK_BY_KIND[kind], kind
-    return None, kind
+    return device_peak_tflops(device), kind
 
 
-def _measure_flops_per_img(mod):
+def _bulk_attrib(mod):
+    """Attribution of the compiled bulk step (one lower+compile covers
+    fingerprint AND cost/memory): the hlo_fingerprint / cost_gflops /
+    hbm_peak_bytes columns a regression bisect starts from."""
+    from mxnet_tpu import perfdebug
+
+    try:
+        return perfdebug.analyze_signature(
+            getattr(mod, "_last_bulk_sig", None))
+    except Exception:
+        return None
+
+
+def _measure_flops_per_img(mod, attrib=None):
     """FLOPs of one compiled training step via XLA cost analysis of the
     actual bulk-scan executable (scan body counted once = one step),
     divided by batch size.  BENCH_FLOPS_PER_IMG overrides (escape hatch
@@ -97,6 +98,13 @@ def _measure_flops_per_img(mod):
     env = os.environ.get("BENCH_FLOPS_PER_IMG")
     if env:
         return float(env), "env"
+    if attrib:
+        if attrib.get("flops"):
+            return float(attrib["flops"]) / BATCH, "xla_cost_analysis"
+        # attribution already lowered+compiled and found no flop count:
+        # re-running bulk_cost_analysis would just recompile the same
+        # program for the same answer
+        return 12.3e9, "estimate"
     cost = mod.bulk_cost_analysis()
     if cost and cost.get("flops"):
         return float(cost["flops"]) / BATCH, "xla_cost_analysis"
@@ -219,7 +227,8 @@ def main():
     run(WARMUP * BULK)
     sync()
 
-    flops_per_img, flops_src = _measure_flops_per_img(mod)
+    attrib = _bulk_attrib(mod)
+    flops_per_img, flops_src = _measure_flops_per_img(mod, attrib)
     device = mod._exec._ctx.jax_device()
     peak_tflops, peak_src = _detect_peak_tflops(device)
 
@@ -279,6 +288,15 @@ def main():
         "repeats": REPEATS,
         "phase_breakdown": breakdown,
     }
+    if attrib:
+        # perf-attribution columns (docs/observability.md): a future
+        # regression bisect starts from "did the executable change and
+        # did it get bigger", not guesswork
+        row["hlo_fingerprint"] = attrib["fingerprint"]
+        if attrib.get("flops"):
+            row["cost_gflops"] = round(attrib["flops"] / 1e9, 3)
+        if attrib.get("hbm_peak_bytes"):
+            row["hbm_peak_bytes"] = int(attrib["hbm_peak_bytes"])
     if probe_tflops is not None:
         row["regime_probe_tflops"] = round(probe_tflops, 1)
     if peak_tflops:

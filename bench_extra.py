@@ -28,6 +28,9 @@ from mxnet_tpu import models  # noqa: E402
 DTYPE = os.environ.get("BENCH_DTYPE", "bfloat16")
 STEPS = int(os.environ.get("BENCH_STEPS", "10"))
 ROWS = []
+#: a metric counts as RECOVERED (waiver shed) only inside this band —
+#: keep in sync with ci/check_bench_gate.py DEFAULT_THRESHOLD_PCT
+_GATE_THRESHOLD_PCT = 5.0
 
 
 def _git_rev():
@@ -92,6 +95,16 @@ def _persist(entry):
             keep = dict(old, latest_value=entry["value"],
                         latest_commit=entry.get("commit"),
                         latest_ts=entry.get("ts"))
+            if "hlo_fingerprint" in entry:
+                # the triage question is "did the executable CHANGE
+                # between best and latest" — record what the regressed
+                # run compiled next to what the best run compiled
+                keep["latest_hlo_fingerprint"] = entry["hlo_fingerprint"]
+            else:
+                # no fingerprint THIS run: a stale one from an earlier
+                # run sitting next to fresh latest_value would misdirect
+                # the same-or-changed triage verdict
+                keep.pop("latest_hlo_fingerprint", None)
             # the flag describes the LATEST measurement — a recovered
             # row must not carry a stale regression marker forward
             keep.pop("regression_vs_best_pct", None)
@@ -102,6 +115,14 @@ def _persist(entry):
                     100.0 * (1.0 - ratio), 1)
                 print("REGRESSION %s: latest %.4g vs best %.4g"
                       % (entry["metric"], entry["value"], old["value"]))
+            if ratio >= 1.0 - _GATE_THRESHOLD_PCT / 100.0:
+                # genuinely recovered (inside the GATE's tolerance, not
+                # just under the 10% stamp threshold): shed the waiver
+                # so the gate re-fires if the regression ever comes
+                # back.  Popping at the stamp threshold instead would
+                # flap waivers forever for a 5..10% regression — the
+                # gate fails it, the next run deletes its waiver
+                keep.pop("waiver", None)
             # backfill MFU onto a kept row measured before the MFU
             # columns existed: FLOPs/sample is a constant of the
             # model+shape, so the old row's tflops/mfu follow exactly
@@ -123,17 +144,29 @@ def _persist(entry):
 def _mfu_fields(mod, samples_per_sec, per_sample_div):
     """Anchor a row with measured per-step FLOPs + MFU when the reference
     publishes no comparable number (round-2 verdict: no uninterpretable
-    rows).  Uses the compiled bulk step's XLA cost analysis (scan body
-    counted once) and the chip peak detected from device_kind."""
-    from bench import _detect_peak_tflops
+    rows), plus the perf-attribution columns (hlo_fingerprint /
+    cost_gflops / hbm_peak_bytes, docs/observability.md) a regression
+    bisect starts from.  ONE lower+compile of the bulk-scan executable
+    (scan body counted once) covers cost, memory and fingerprint; the
+    chip peak is detected from device_kind."""
+    from bench import _bulk_attrib, _detect_peak_tflops
 
-    cost = mod.bulk_cost_analysis()
-    if not cost or not cost.get("flops"):
-        return {}
-    flops_per_sample = float(cost["flops"]) / per_sample_div
+    attrib = _bulk_attrib(mod)
+    flops = attrib.get("flops") if attrib else None
+    if not flops:
+        cost = mod.bulk_cost_analysis()
+        if not cost or not cost.get("flops"):
+            return {}
+        flops = float(cost["flops"])
+    flops_per_sample = flops / per_sample_div
     tflops = samples_per_sec * flops_per_sample / 1e12
     out = {"flops_per_sample_g": round(flops_per_sample / 1e9, 3),
            "tflops": round(tflops, 2)}
+    if attrib:
+        out["hlo_fingerprint"] = attrib["fingerprint"]
+        out["cost_gflops"] = round(flops / 1e9, 3)
+        if attrib.get("hbm_peak_bytes"):
+            out["hbm_peak_bytes"] = int(attrib["hbm_peak_bytes"])
     peak, _src = _detect_peak_tflops(mod._exec._ctx.jax_device())
     if peak:
         out["mfu_pct"] = round(100.0 * tflops / peak, 2)

@@ -1,0 +1,127 @@
+#!/usr/bin/env python
+"""Bench regression gate: fail on unwaived throughput regressions.
+
+``bench_extra.py`` keeps best-of-N per metric in ``BENCH_extra.json``
+and stamps ``regression_vs_best_pct`` onto a row whose LATEST
+measurement fell more than 10% behind its best — but until this gate,
+nothing enforced it (ROADMAP open item 2: the resnet-50/152 and
+inception-v3 inference regressions sat recorded and unexplained).  This
+script exits non-zero when any row regresses more than ``--threshold``
+percent (default 5) without a recorded waiver.
+
+Waiver workflow (documented in docs/observability.md "Bench regression
+gate"): a known/accepted regression is waived by adding a ``waiver``
+field to the row in ``BENCH_extra.json``::
+
+    {"metric": "infer_resnet-50_b32", ..., "regression_vs_best_pct": 38.1,
+     "waiver": "2026-08: tracking in ROADMAP item 2; bisect pending"}
+
+The waiver string should say WHO accepted it and WHY (date + issue /
+ROADMAP pointer).  ``bench_extra.py`` drops a stale waiver
+automatically when the metric recovers, so waivers cannot silently
+outlive the regression they excused.  Rows carrying ``hlo_fingerprint``
+(the perfdebug attribution columns) let the bisect start from "which
+executable changed" instead of guesswork.
+
+Usage: python ci/check_bench_gate.py [BENCH_extra.json] [--threshold 5]
+Wired into ci/run_tests.sh behind ``BENCH_GATE=1`` (the file is only
+refreshed on bench hosts; a CPU CI container must not fail on a stale
+checked-in snapshot by default).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+DEFAULT_THRESHOLD_PCT = 5.0
+
+
+def _regression_pct(row):
+    """Regression of the row's LATEST measurement vs its best, in
+    percent.  Computed from ``value``/``latest_value`` when both exist
+    — the stamped ``regression_vs_best_pct`` only appears past 10%, so
+    trusting it alone would leave a 5..10% dead zone the gate's own
+    threshold promises to cover — falling back to the stamp."""
+    best = row.get("value")
+    latest = row.get("latest_value")
+    if best and latest:
+        lower_better = str(row.get("unit", "")).startswith("sec")
+        ratio = (float(best) / float(latest)) if lower_better \
+            else (float(latest) / float(best))
+        return 100.0 * (1.0 - ratio)
+    pct = row.get("regression_vs_best_pct")
+    return float(pct) if pct is not None else None
+
+
+def check(path, threshold=DEFAULT_THRESHOLD_PCT):
+    """Returns ``(failures, waived)``: rows regressed past ``threshold``
+    without / with a waiver.  Each element is the full row dict, with
+    the effective pct under ``_gate_pct``."""
+    with open(path) as f:
+        data = json.load(f)
+    failures, waived = [], []
+    for row in data.get("rows", []):
+        pct = _regression_pct(row)
+        if pct is None or pct <= threshold:
+            continue
+        row = dict(row, _gate_pct=round(pct, 1))
+        (waived if row.get("waiver") else failures).append(row)
+    return failures, waived
+
+
+def _describe(row):
+    best = row.get("value")
+    latest = row.get("latest_value")
+    parts = ["%s: -%.1f%% vs best" % (row.get("metric"),
+                                      float(row["_gate_pct"]))]
+    if best is not None and latest is not None:
+        parts.append("(best %.4g -> latest %.4g %s)"
+                     % (best, latest, row.get("unit", "")))
+    if row.get("latest_commit"):
+        parts.append("at %s" % row["latest_commit"])
+    if row.get("hlo_fingerprint"):
+        parts.append("hlo=%s" % row["hlo_fingerprint"])
+    if row.get("waiver"):
+        parts.append("WAIVED: %s" % row["waiver"])
+    return " ".join(parts)
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description="fail on unwaived bench regressions vs best")
+    parser.add_argument("path", nargs="?", default="BENCH_extra.json",
+                        help="bench rows file (default: BENCH_extra.json)")
+    parser.add_argument("--threshold", type=float,
+                        default=DEFAULT_THRESHOLD_PCT,
+                        help="max tolerated regression_vs_best_pct "
+                             "without a waiver (default %(default)s)")
+    args = parser.parse_args(argv)
+    if not os.path.exists(args.path):
+        print("check_bench_gate: %s not found; nothing to gate"
+              % args.path)
+        return 0
+    try:
+        failures, waived = check(args.path, args.threshold)
+    except (ValueError, KeyError) as e:
+        print("check_bench_gate: %s is unreadable (%s)" % (args.path, e))
+        return 1
+    for row in waived:
+        print("check_bench_gate: waived   %s" % _describe(row))
+    for row in failures:
+        print("check_bench_gate: REGRESSED %s" % _describe(row))
+    if failures:
+        print("check_bench_gate: %d unwaived regression(s) past %.1f%% "
+              "in %s — fix them, or record a 'waiver' field on the row "
+              "(see docs/observability.md 'Bench regression gate')"
+              % (len(failures), args.threshold, args.path))
+        return 1
+    print("check_bench_gate: OK (%d waived) in %s"
+          % (len(waived), args.path))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

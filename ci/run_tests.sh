@@ -59,6 +59,13 @@ if [ "${f64_skips:-0}" -ne 4 ]; then
   exit 1
 fi
 python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+# bench regression gate: fail on BENCH_extra.json rows regressed >5%
+# vs best without a recorded waiver — opt-in (BENCH_GATE=1) because the
+# snapshot is only refreshed on bench hosts; see docs/observability.md
+# "Bench regression gate" for the waiver workflow
+if [ "${BENCH_GATE:-0}" = "1" ]; then
+  python ci/check_bench_gate.py
+fi
 # kill/resume chaos matrix (5x rotating seeds) — opt-in, it multiplies
 # suite time: CHAOS=1 sh ci/run_tests.sh
 if [ "${CHAOS:-0}" = "1" ]; then

@@ -12,6 +12,7 @@ from .base import MXNetError
 from .context import Context, cpu, gpu, tpu, current_context, num_tpus
 
 from . import telemetry
+from . import perfdebug
 from . import faults
 from . import retry
 

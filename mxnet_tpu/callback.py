@@ -19,6 +19,7 @@ import logging
 import sys
 import time
 
+from . import perfdebug as _perfdebug
 from . import telemetry as _telemetry
 
 __all__ = ["module_checkpoint", "do_checkpoint", "log_train_metric",
@@ -119,18 +120,24 @@ class Speedometer:
                                  kind="instant")
             _telemetry.set_gauge("fit.samples_per_sec", self._ema,
                                  kind="smoothed")
+        # live MFU: the rate is already measured, so folding it against
+        # the captured step flops (perfdebug attribution) and the chip's
+        # rated peak costs no extra sync; None when either is unknown
+        mfu = _perfdebug.note_throughput(self._ema, self.batch_size)
+        mfu_txt = "" if mfu is None else " MFU %.1f%%" % mfu
         if param.eval_metric is not None:
             metrics = "".join("\tTrain-%s=%f" % nv
                               for nv in param.eval_metric.get_name_value())
             logging.info("Epoch[%d] Batch [%d]\tSpeed: %.2f samples/sec "
-                         "(smoothed %.2f)%s",
-                         param.epoch, count, speed, self._ema, metrics)
+                         "(smoothed %.2f)%s%s",
+                         param.epoch, count, speed, self._ema, mfu_txt,
+                         metrics)
             if self.auto_reset:
                 param.eval_metric.reset()
         else:
             logging.info("Iter[%d] Batch [%d]\tSpeed: %.2f samples/sec "
-                         "(smoothed %.2f)",
-                         param.epoch, count, speed, self._ema)
+                         "(smoothed %.2f)%s",
+                         param.epoch, count, speed, self._ema, mfu_txt)
 
 
 class ProgressBar:
@@ -241,9 +248,17 @@ class TelemetryReport:
                         for ph, (s, n) in sorted(totals.items(),
                                                  key=lambda kv: -kv[1][0]))
         rss = _telemetry.gauge_value("memory.host.max_rss_bytes")
+        extras = []
+        if rss and rss > 0:
+            extras.append("host max RSS %.0f MB" % (rss / 1e6))
+        mfu = _telemetry.gauge_value("perf.mfu_pct")
+        if mfu is not None:
+            extras.append("MFU %.1f%%" % mfu)
+        hbm = _telemetry.gauge_value("perf.hbm_peak_bytes")
+        if hbm:
+            extras.append("HBM peak %.0f MB" % (hbm / 1e6))
         self.logger.info(
             "Epoch[%d] telemetry: %s%s", epoch, txt or "(no phase data)",
-            ("  |  host max RSS %.0f MB" % (rss / 1e6))
-            if rss and rss > 0 else "")
+            ("  |  " + "  ".join(extras)) if extras else "")
         if self.dump_path:
             _telemetry.dump(self.dump_path)

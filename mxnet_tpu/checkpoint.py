@@ -32,8 +32,10 @@ and (b) cheap enough to take constantly.  This module supplies both for
   orphan a payload (harmless, swept next GC) but never leave a
   manifest entry pointing at removed bytes.
 
-Telemetry family: ``resilience.checkpoint.async_write_seconds``
-(histogram), ``resilience.checkpoint.async_inflight`` (gauge),
+Telemetry family: ``resilience.checkpoint.async_write_seconds`` /
+``resilience.checkpoint.queue_wait_seconds`` (histograms — write
+duration, and how long a submitted snapshot waited for the writer
+thread), ``resilience.checkpoint.async_inflight`` (gauge),
 ``resilience.checkpoint.async_dropped`` / ``.corrupt_skipped`` /
 ``.pruned`` (counters) — see docs/observability.md.
 """
@@ -474,7 +476,12 @@ class AsyncSnapshotWriter:
                     "snapshot dropped (back-pressure keeps <=1 in "
                     "flight)", snap.epoch, snap.nbatch)
                 return False
-            self._slot = snap
+            # submit timestamp rides along so the writer can histogram
+            # how long the snapshot waited before serialization started
+            # (resilience.checkpoint.queue_wait_seconds): the diagnostic
+            # for "is the <2% async-overhead target writer-bound or
+            # cadence-bound" without a bench rerun
+            self._slot = (snap, time.perf_counter())
             self._cv.notify_all()
         return True
 
@@ -491,10 +498,13 @@ class AsyncSnapshotWriter:
             with self._cv:
                 while self._slot is None and not self._closed:
                     self._cv.wait()
-                snap, self._slot = self._slot, None
-                if snap is None:  # closed with nothing queued
+                item, self._slot = self._slot, None
+                if item is None:  # closed with nothing queued
                     return
                 self._busy = True
+            snap, t_submit = item
+            _telemetry.observe("resilience.checkpoint.queue_wait_seconds",
+                               time.perf_counter() - t_submit)
             try:
                 self._write(snap)
             except BaseException as e:  # noqa: BLE001 — surfaced on drain

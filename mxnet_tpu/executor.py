@@ -34,6 +34,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from . import perfdebug as _perfdebug
 from . import profiler as _profiler
 from . import random as _random
 from . import telemetry as _telemetry
@@ -51,16 +52,21 @@ class _DeviceHintFn:
 
     ``compile_note`` (a kind string, set only when telemetry is enabled at
     build time) times the FIRST call — which pays jax tracing + XLA
-    compilation synchronously — into the ``xla.compile.*`` metrics; after
-    that the wrapper is a single attribute check per dispatch."""
+    compilation synchronously — into the ``xla.compile.*`` metrics;
+    ``attrib`` (``(exec_name, kind_name)``, set only when
+    :mod:`mxnet_tpu.perfdebug` attribution is enabled at build time)
+    additionally captures the first call's compiled-executable cost /
+    memory / HLO fingerprint.  After the first call the wrapper is a
+    single attribute check per dispatch."""
 
-    def __init__(self, fn, dev_type, compile_note=None):
+    def __init__(self, fn, dev_type, compile_note=None, attrib=None):
         self._fn = fn
         self._dev = dev_type
         self._note = compile_note
+        self._attrib = attrib
 
     def __call__(self, *args, **kwargs):
-        if self._note is not None:
+        if self._note is not None or self._attrib is not None:
             return self._first_call(args, kwargs)
         tok = _ops_registry.trace_device.set(self._dev)
         try:
@@ -70,16 +76,23 @@ class _DeviceHintFn:
 
     def _first_call(self, args, kwargs):
         note, self._note = self._note, None
+        attrib, self._attrib = self._attrib, None
         tok = _ops_registry.trace_device.set(self._dev)
         t0 = time.perf_counter()
         try:
             return self._fn(*args, **kwargs)
         finally:
             _ops_registry.trace_device.reset(tok)
-            dt = time.perf_counter() - t0
-            _telemetry.inc("xla.compile.seconds", dt, kind=note)
-            _telemetry.observe("xla.compile.first_call_seconds", dt,
-                               kind=note)
+            if note is not None:
+                dt = time.perf_counter() - t0
+                _telemetry.inc("xla.compile.seconds", dt, kind=note)
+                _telemetry.observe("xla.compile.first_call_seconds", dt,
+                                   kind=note)
+            if attrib is not None:
+                # shapes/dtypes only (aval metadata survives donation);
+                # capture() never raises into the step
+                _perfdebug.capture(attrib[0], attrib[1], self.lower,
+                                   args, kwargs)
 
     def lower(self, *args, **kwargs):
         tok = _ops_registry.trace_device.set(self._dev)
@@ -347,6 +360,17 @@ def any_nonfinite(values):
     return bool(_ANY_NONFINITE_JIT(vals))
 
 
+def _kind_name(kind):
+    """Human name of an executor program kind: the kind string itself,
+    or a tuple kind's head (``("train_sgd", ...)`` -> ``"train_sgd"``,
+    placement segments -> ``"seg"``)."""
+    if isinstance(kind, str):
+        return kind
+    if kind[0] == "seg":
+        return "seg"
+    return str(kind[0])
+
+
 def sgd_step_math(p, g, mom, lr, wd, momentum, rescale, clip):
     """One SGD(-momentum) parameter step, math in f32, result cast back to
     the stored dtype (bf16 params stay bf16).  Shared by the two-dispatch
@@ -434,13 +458,13 @@ class Executor:
         everything exactly once never trips it.  Returns the telemetry
         compile-note for :class:`_DeviceHintFn` first-call timing (None
         when disabled)."""
+        kind_name = _kind_name(kind)
         if isinstance(kind, str):
-            ident = kind_name = kind
+            ident = kind
         elif kind[0] == "seg":  # ("seg", si, is_train, fingerprint)
             ident = kind[:3]
-            kind_name = "seg"
         else:
-            ident = kind_name = str(kind[0])
+            ident = kind_name
         builds = self._build_counts[ident] = \
             self._build_counts.get(ident, 0) + 1
         limit = int(os.environ.get("MXNET_RECOMPILE_WARN_THRESHOLD", "8"))
@@ -653,7 +677,10 @@ class Executor:
             fn = jax.jit(f)
         else:
             raise ValueError(kind)
-        fn = _DeviceHintFn(fn, self._ctx.device_type, self._note_build(kind))
+        attrib = (self._symbol_name(), _kind_name(kind)) \
+            if _perfdebug.enabled() else None
+        fn = _DeviceHintFn(fn, self._ctx.device_type,
+                           self._note_build(kind), attrib)
         self._fns[cache_key] = fn
         return fn
 
@@ -781,8 +808,10 @@ class Executor:
                         aux_updates.append((child.name, new))
             return [entry[k2] for k2 in out_keys], dict(aux_updates)
 
+        attrib = (self._symbol_name(), "seg%d" % si) \
+            if _perfdebug.enabled() else None
         fn = _DeviceHintFn(jax.jit(f), _dev.device_type,
-                           self._note_build(key))
+                           self._note_build(key), attrib)
         self._fns[key] = fn
         return fn
 

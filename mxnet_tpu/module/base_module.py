@@ -20,6 +20,7 @@ import numpy as np
 
 from .. import faults as _faults
 from .. import metric as _metric
+from .. import perfdebug as _perfdebug
 from .. import random as _random
 from .. import telemetry as _telemetry
 from ..base import MXNetError
@@ -216,6 +217,8 @@ class _FitRun:
         _telemetry.inc("resilience.preemptions")
         _telemetry.event("preemption", epoch=epoch, nbatch=None,
                          signal=signum, checkpoint=path)
+        _perfdebug.flight_dump("preemption", epoch=epoch, nbatch=None,
+                               signal=signum, checkpoint=path)
         self.logger.warning(
             "preempted (signal %s) during epoch %d wrap-up: epoch "
             "complete, checkpoint %s", signum, epoch,
@@ -262,6 +265,11 @@ class _FitRun:
         _telemetry.inc("resilience.preemptions")
         _telemetry.event("preemption", epoch=epoch, nbatch=nbatch,
                          signal=signum, checkpoint=path)
+        # the post-mortem record: last batches' phase timings, compiled-
+        # executable attribution and the preemption event itself survive
+        # the process (docs/observability.md "Flight recorder")
+        _perfdebug.flight_dump("preemption", epoch=epoch, nbatch=nbatch,
+                               signal=signum, checkpoint=path)
         self.logger.warning(
             "preempted (signal %s) at epoch %d batch %d: in-flight batch "
             "finished, accumulators drained, checkpoint %s",
@@ -602,6 +610,8 @@ class BaseModule:
             _telemetry.inc("resilience.nan_batches", action=nan_policy)
             _telemetry.event("nan_batch", epoch=epoch, batch=nbatch,
                              action=nan_policy)
+            _perfdebug.flight_dump("nan_trip", epoch=epoch, nbatch=nbatch,
+                                   action=nan_policy)
             if nan_policy == "raise":
                 raise MXNetError(
                     "NaN/Inf detected in loss/gradients at epoch %d "
@@ -682,15 +692,29 @@ class BaseModule:
             # own SIGTERM/SIGINT semantics (Ctrl-C still interrupts)
             with _preempt_signals(guard, self.logger,
                                   enable=checkpoint_prefix is not None):
-                self._fit_epochs(
-                    fit_data, eval_data, eval_metric, validation_metric,
-                    epoch_end_callback, batch_end_callback,
-                    eval_end_callback, eval_batch_end_callback, monitor,
-                    begin_epoch, num_epoch, checkpoint_prefix,
-                    checkpoint_period, nan_policy, nan_check_period,
-                    use_bulk, bulk_k, _trip_nan_policy, owns_iter,
-                    run=run, resume_nbatch=resume_nbatch,
-                    resume_metric_state=resume_metric_state)
+                try:
+                    self._fit_epochs(
+                        fit_data, eval_data, eval_metric,
+                        validation_metric, epoch_end_callback,
+                        batch_end_callback, eval_end_callback,
+                        eval_batch_end_callback, monitor, begin_epoch,
+                        num_epoch, checkpoint_prefix, checkpoint_period,
+                        nan_policy, nan_check_period, use_bulk, bulk_k,
+                        _trip_nan_policy, owns_iter, run=run,
+                        resume_nbatch=resume_nbatch,
+                        resume_metric_state=resume_metric_state)
+                except Exception as e:
+                    # crash flight record: preemption and NaN trips
+                    # dumped at their own sites already (with richer
+                    # context); anything else dying out of fit gets the
+                    # generic crash dump before the exception escapes
+                    from ..checkpoint import TrainingPreempted
+
+                    if not isinstance(e, TrainingPreempted):
+                        _perfdebug.flight_dump(
+                            "crash",
+                            error="%s: %s" % (type(e).__name__, e))
+                    raise
             if writer is not None:
                 # clean-path close surfaces a failed background write as
                 # an error instead of silently training un-checkpointed

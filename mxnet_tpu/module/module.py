@@ -21,6 +21,7 @@ import numpy as np
 
 from .. import metric as _metric
 from .. import optimizer as opt
+from .. import perfdebug as _perfdebug
 from .. import random as _random
 from .. import telemetry as _telemetry
 from ..base import MXNetError
@@ -987,7 +988,9 @@ class Module(BaseModule):
                         new_m.append(nm)
                 return new_p, new_m
 
-            self._fused_step = jax.jit(step, donate_argnums=(0, 2))
+            self._fused_step = _perfdebug.instrument(
+                jax.jit(step, donate_argnums=(0, 2)),
+                self._exec._symbol_name(), "fused_update")
         # per-index bookkeeping keeps num_update/scheduler semantics
         for idx in range(len(names)):
             optimizer._update_count(idx)

@@ -28,7 +28,7 @@ import time
 from .base import MXNetError
 
 __all__ = ["profiler_set_config", "profiler_set_state", "dump_profile",
-           "State", "Mode"]
+           "State", "Mode", "now_us"]
 
 
 class Mode:
@@ -53,6 +53,13 @@ _t0 = time.perf_counter()
 
 def _now_us():
     return (time.perf_counter() - _t0) * 1e6
+
+
+def now_us():
+    """Microseconds on the profiler clock — pair with :func:`record` to
+    emit a span from code that brackets its own timing (the serving
+    dispatch/request spans do)."""
+    return _now_us()
 
 
 def profiler_set_config(mode="symbolic", filename="profile.json",

@@ -40,6 +40,7 @@ from collections import deque
 import numpy as np
 
 from .. import faults as _faults
+from .. import profiler as _profiler
 from .. import telemetry as _telemetry
 from ..base import MXNetError
 
@@ -348,6 +349,11 @@ class DynamicBatcher:
         if not live:
             return
         t0 = time.monotonic()
+        # chrome-trace span for the coalesced dispatch (success or
+        # error): a serving latency spike lines up on the SAME timeline
+        # as compile/fit spans when the profiler runs
+        prof = _profiler.running()
+        span_us = _profiler.now_us() if prof else 0.0
         try:
             # batch assembly is inside the guard: a poison request (e.g.
             # mismatched feature dims past a shape-less dispatch_fn) must
@@ -383,7 +389,13 @@ class DynamicBatcher:
             _telemetry.inc("serving.error.count", model=self.name)
             for r in live:
                 r.future.set_error(e)
+            if prof:
+                _profiler.record("serving:%s:dispatch_error" % self.name,
+                                 "serving", span_us, _profiler.now_us())
             return
+        if prof:
+            _profiler.record("serving:%s:dispatch" % self.name,
+                             "serving", span_us, _profiler.now_us())
         self.dispatches += 1
         _telemetry.inc("serving.dispatch.count", model=self.name)
         _telemetry.observe("serving.batch.latency_seconds",

@@ -26,6 +26,8 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import numpy as np
 
+from .. import perfdebug as _perfdebug
+from .. import profiler as _profiler
 from .. import telemetry as _telemetry
 from ..base import MXNetError
 from .batcher import (DeadlineExceeded, DynamicBatcher, InvalidRequest,
@@ -181,6 +183,11 @@ class _Handler(BaseHTTPRequestHandler):
             _telemetry.inc("serving.shed.count", reason="draining")
             return self._send(503, {"error": "server is draining "
                                     "(preemption); retry elsewhere"})
+        # chrome-trace span for the whole request handling: the HTTP
+        # half of a latency spike sits on the same timeline as the
+        # batcher's dispatch span (and compile/fit spans)
+        prof = _profiler.running()
+        span_us = _profiler.now_us() if prof else 0.0
         try:
             handle = srv.serving_handle
             try:
@@ -212,6 +219,9 @@ class _Handler(BaseHTTPRequestHandler):
         finally:
             with srv.admission_lock:
                 srv.admitted_requests -= 1
+            if prof:
+                _profiler.record("serving:http:%s" % model, "serving",
+                                 span_us, _profiler.now_us())
 
 
 class ServingHTTPServer:
@@ -283,6 +293,7 @@ class ServingHTTPServer:
         with self._httpd.admission_lock:
             self._httpd.draining = True
         _telemetry.event("preemption", component="serving")
+        _perfdebug.flight_dump("serving_drain", deadline=deadline)
         _log.warning("serving: draining (deadline %.1fs)", deadline)
         handle = self._httpd.serving_handle
         cutoff = time.monotonic() + deadline

@@ -46,7 +46,8 @@ from . import profiler as _profiler
 __all__ = ["enabled", "enable", "disable", "inc", "set_gauge", "observe",
            "event", "phase", "snapshot", "dump", "dump_events",
            "prometheus_text", "write_prometheus", "reset", "sample_memory",
-           "phase_totals", "counter_total", "gauge_value", "hist_quantile"]
+           "phase_totals", "counter_total", "gauge_value", "hist_quantile",
+           "events_recent", "set_phase_hook"]
 
 #: default histogram bucket upper bounds (seconds-flavored; callers may
 #: pass their own on first ``observe`` of a metric)
@@ -61,7 +62,14 @@ _events = deque(maxlen=int(os.environ.get("MXNET_TELEMETRY_EVENTS_MAX",
 
 _enabled = (os.environ.get("MXNET_TELEMETRY", "0")
             not in ("0", "", "false")
-            or bool(os.environ.get("MXNET_TELEMETRY_DUMP")))
+            or bool(os.environ.get("MXNET_TELEMETRY_DUMP"))
+            # an armed flight recorder (perfdebug) implies telemetry:
+            # its dumps are built from the event ring and phase timings,
+            # so a recorder without telemetry would dump hollow files
+            # exactly when the post-mortem needs them
+            or os.environ.get("MXNET_FLIGHT_RECORDER", "")
+            not in ("0", "", "false")
+            or bool(os.environ.get("MXNET_FLIGHT_RECORDER_DIR")))
 
 
 def enabled():
@@ -152,6 +160,26 @@ def event(name, **fields):
         _events.append(rec)
 
 
+def events_recent(n=100):
+    """The newest ``n`` structured events (copies) — what the flight
+    recorder folds into a crash dump."""
+    with _lock:
+        return [dict(r) for r in list(_events)[-int(n):]]
+
+
+#: optional per-phase observer installed by :mod:`mxnet_tpu.perfdebug`:
+#: called as ``hook(family, phase_name, seconds)`` from an ENABLED
+#: phase's exit — the flight recorder's per-batch timing feed.  One
+#: attribute check when unset; disabled telemetry never reaches it.
+_phase_hook = None
+
+
+def set_phase_hook(hook):
+    """Install (or clear, with None) the phase observer."""
+    global _phase_hook
+    _phase_hook = hook
+
+
 class phase:
     """Time one training-loop phase: a histogram observation in
     ``<family>.phase_seconds{phase=<name>}`` and — when the profiler is
@@ -190,6 +218,8 @@ class phase:
                 end = _profiler._now_us()
                 _profiler.record("%s:%s" % (self._family, self._name),
                                  "phase", end - dt * 1e6, end)
+            if _phase_hook is not None:
+                _phase_hook(self._family, self._name, dt)
         return False
 
 
