@@ -273,6 +273,16 @@ def main():
         telemetry.counter_total("xla.compile.count"))
     breakdown["compile_s"] = round(
         telemetry.counter_total("xla.compile.seconds"), 2)
+    from mxnet_tpu import compile_cache
+
+    if compile_cache.enabled():
+        # compile-once context: with MXNET_COMPILE_CACHE_DIR set, how
+        # much of this process's compile_s was persistent-cache loads
+        cc = compile_cache.stats()
+        breakdown["persistent_cache_hits"] = cc["hits"]
+        breakdown["persistent_cache_misses"] = cc["misses"]
+        breakdown["persistent_cache_saved_s"] = \
+            cc["compile_time_saved_seconds"]
 
     ips = BATCH * STEPS / best
     tflops = ips * flops_per_img / 1e12

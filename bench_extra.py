@@ -176,7 +176,15 @@ def _mfu_fields(mod, samples_per_sec, per_sample_div):
 def infer_score(network, ref, batch=32, **kw):
     from benchmark_score import score
 
-    ips, mod = score(network, batch, dtype=DTYPE, num_batches=STEPS,
+    # widened window + best-of-3: at the old 10-batch default a window
+    # was TWO bulk dispatches (~100 ms) against a ~50 ms tunnel round
+    # trip — one unlucky window under-measured a deep model by a third.
+    # The round-5 resnet-50/152 + inception-v3 "regressions" were this
+    # (HLO fingerprints across the blamed commits are identical); the
+    # train rows already widened their window (bench.py STEPS 20→60)
+    # and never flapped
+    ips, mod = score(network, batch, dtype=DTYPE,
+                     num_batches=max(STEPS, 30), repeats=3,
                      return_mod=True, **kw)
     tag = network if "num_layers" not in kw \
         else "%s-%d" % (network, kw["num_layers"])
@@ -228,8 +236,11 @@ def lstm_score(batch=32, seq=35, hidden=200, layers=2, vocab=10000):
     # tunnel round trip dominates and the row under-measures ~4x (the
     # round-4 refresh recorded 2.4k samples/s vs the real 23k until the
     # best-of merge saved it) — this row needs a long bulk regardless
-    # of BENCH_STEPS
-    steps = max(STEPS, 80)
+    # of BENCH_STEPS.  240 steps: the old 80-step window was ~110 ms at
+    # b32 — barely 2x the tunnel round trip — and flapped −25% in
+    # round 5 with no HLO change to blame (the PR 7 bisect); ~330 ms
+    # windows put the dispatch tail under 15%
+    steps = max(STEPS, 240)
 
     def build(fused):
         data = mx.sym.Variable("data")
@@ -345,10 +356,15 @@ def ssd_score(batch=8, size=300):
     mod, run, sync = ssd_setup(batch, size)
     run(STEPS)  # warmup (and the cost-analysis signature)
     sync()
-    t0 = time.time()
-    run(STEPS)
-    sync()
-    sec = (time.time() - t0) / STEPS
+    # best-of-3 like the train/lstm rows: a single ~10-step window on
+    # the shared chip measures co-tenant load as much as the model
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.time()
+        run(STEPS)
+        sync()
+        best = min(best, time.time() - t0)
+    sec = best / STEPS
     # no reference-published SSD step time exists; measured FLOPs + MFU
     # anchor the row, and tests/test_ssd.py::
     # test_ssd_train_step_runs_and_learns is the paired convergence smoke
@@ -482,6 +498,161 @@ def ckpt_score(batch=4096, nbatches=40, in_dim=256, hidden=512,
     # the tracked claim: async batch-granular checkpointing costs <2%
     row("ckpt_async_overhead_b%d" % batch, async_ / off, "ratio",
         every_n_batches=every_n)
+
+
+def _compile_probe(model):
+    """Subprocess body of :func:`compile_score`: build ONE model and time
+    from symbol construction to the first dispatched result — the full
+    trace+compile cost a fresh process pays (or, with a populated
+    ``MXNET_COMPILE_CACHE_DIR``, trace + persistent-cache loads) — then
+    time the SAME dispatch again and subtract, so the reported
+    ``build_seconds`` isolates one-time build cost from steady-state
+    execution (which would otherwise swamp the number on hosts where
+    the model runs slowly, e.g. bf16-emulating CPUs).  Reports one
+    ``COMPILE_PROBE`` JSON line on stdout."""
+    from mxnet_tpu import compile_cache as cc
+    from mxnet_tpu import telemetry
+
+    ctx = _ctx()
+    t0 = time.time()
+    if model == "lstm":
+        batch, seq, hidden, layers, vocab = 32, 35, 200, 2, 10000
+        data = mx.sym.Variable("data")
+        embed = mx.sym.Embedding(data, input_dim=vocab, output_dim=hidden)
+        stack = mx.rnn.SequentialRNNCell()
+        for i in range(layers):
+            stack.add(mx.rnn.LSTMCell(num_hidden=hidden,
+                                      prefix="lstm_l%d_" % i))
+        outputs, _ = stack.unroll(seq, inputs=embed, merge_outputs=True)
+        pred = mx.sym.Reshape(outputs, shape=(-1, hidden))
+        pred = mx.sym.FullyConnected(pred, num_hidden=vocab, name="pred")
+        label = mx.sym.Reshape(mx.sym.Variable("softmax_label"),
+                               shape=(-1,))
+        net = mx.sym.SoftmaxOutput(pred, label, name="softmax")
+        mod = mx.mod.Module(net, context=ctx)
+        mod.bind(data_shapes=[("data", (batch, seq))],
+                 label_shapes=[("softmax_label", (batch, seq))])
+        mod.init_params(mx.init.Xavier())
+        mod.init_optimizer(optimizer="sgd",
+                           optimizer_params={"learning_rate": 0.1})
+        b = mx.io.DataBatch(
+            data=[mx.nd.array(np.zeros((batch, seq), np.float32),
+                              ctx=ctx)],
+            label=[mx.nd.array(np.zeros((batch, seq), np.float32),
+                               ctx=ctx)])
+        mod.forward_backward(b)
+        mod.update()
+        _sync_param(mod)
+
+        def _again():
+            mod.forward_backward(b)
+            mod.update()
+            _sync_param(mod)
+    else:
+        network, kw = (("resnet", {"num_layers": 50})
+                       if model == "resnet-50" else (model, {}))
+        batch = 32
+        sym = models.get_symbol(network, num_classes=1000,
+                                image_shape=(3, 224, 224), **kw)
+        mod = mx.mod.Module(sym, context=ctx,
+                            label_names=["softmax_label"])
+        mod.bind(for_training=False, inputs_need_grad=False,
+                 data_shapes=[("data", (batch, 3, 224, 224))])
+        mod.init_params(mx.init.Xavier(magnitude=2.0))
+        if DTYPE != "float32":
+            for n, a in mod._exec.arg_dict.items():
+                a._jx = a._jx.astype(DTYPE)
+        b = mx.io.DataBatch(
+            data=[mx.nd.array(np.zeros((batch, 3, 224, 224), np.float32),
+                              dtype=DTYPE)], label=[])
+        mod.predict_bulk([b] * 2)
+        np.asarray(mod._exec.outputs[0]._jx.reshape(-1)[:1])
+
+        def _again():
+            mod.predict_bulk([b] * 2)
+            np.asarray(mod._exec.outputs[0]._jx.reshape(-1)[:1])
+    first_seconds = time.time() - t0
+
+    def report(build_seconds, steady_seconds=None):
+        st = cc.stats()
+        print("COMPILE_PROBE " + json.dumps({
+            "model": model, "build_seconds": round(build_seconds, 3),
+            "first_result_seconds": round(first_seconds, 3),
+            "steady_seconds": round(steady_seconds, 3)
+            if steady_seconds is not None else None,
+            "cache_enabled": st["enabled"],
+            "persistent_hits": st["hits"],
+            "persistent_misses": st["misses"],
+            "traces": int(telemetry.counter_total("xla.compile.count")),
+        }), flush=True)
+
+    # conservative line FIRST: the steady-state re-dispatch below can
+    # abort the process on backends where executing a cache-DESERIALIZED
+    # executable is unstable (jaxlib 0.4.37 XLA:CPU heap corruption on
+    # the warm unrolled-LSTM step — docs/how_to/perf.md); the parent
+    # takes the LAST line, so a crash still yields a (coarser) row
+    report(first_seconds)
+    t1 = time.time()
+    _again()  # warm in-process: pure execution + dispatch
+    steady_seconds = time.time() - t1
+    report(max(0.0, first_seconds - steady_seconds), steady_seconds)
+
+
+def compile_score(which=("resnet-50", "inception-v3", "lstm")):
+    """Compile-once trajectory rows (docs/how_to/perf.md "Compile
+    once"): per model, a COLD fresh-process build against an empty
+    ``MXNET_COMPILE_CACHE_DIR`` vs a WARM fresh process against the
+    cache the cold run populated — seconds-to-first-result plus trace /
+    persistent hit/miss counts, persisted via ``_persist`` so the bench
+    gate tracks the cache win (and any warm-path regression) like any
+    other row.  The warm row's remaining cost is pure tracing: the gap
+    to cold is exactly what every serving reload, CI run and preemption
+    restart stops paying."""
+    import shutil
+    import subprocess
+    import tempfile
+
+    tmpdir = tempfile.mkdtemp(prefix="bench_cc_")
+    try:
+        for model in which:
+            cache = os.path.join(tmpdir, model)
+            os.makedirs(cache, exist_ok=True)
+            probes = {}
+            for phase in ("cold", "warm"):
+                env = dict(os.environ, MXNET_COMPILE_CACHE_DIR=cache,
+                           MXNET_TELEMETRY="1")
+                proc = subprocess.run(
+                    [sys.executable, os.path.abspath(__file__),
+                     "_compile_probe", model],
+                    env=env, capture_output=True, text=True, timeout=1800)
+                lines = [ln for ln in proc.stdout.splitlines()
+                         if ln.startswith("COMPILE_PROBE ")]
+                if not lines:
+                    raise RuntimeError(
+                        "compile probe %s/%s failed (rc %d): %s"
+                        % (model, phase, proc.returncode,
+                           proc.stderr.strip()[-2000:]))
+                if proc.returncode != 0:
+                    # the steady-state refinement dispatch died (see
+                    # _compile_probe) — keep the conservative line
+                    print("compile probe %s/%s: steady-state re-dispatch "
+                          "aborted (rc %d); using the first-result timing"
+                          % (model, phase, proc.returncode))
+                probes[phase] = json.loads(
+                    lines[-1][len("COMPILE_PROBE "):])
+            cold, warm = probes["cold"], probes["warm"]
+            row("compile_cold_%s" % model, cold["build_seconds"], "sec",
+                traces=cold["traces"],
+                persistent_misses=cold["persistent_misses"])
+            row("compile_warm_%s" % model, warm["build_seconds"], "sec",
+                traces=warm["traces"],
+                persistent_hits=warm["persistent_hits"],
+                cold_compiles=warm["persistent_misses"],
+                speedup_vs_cold=round(
+                    cold["build_seconds"]
+                    / max(1e-9, warm["build_seconds"]), 2))
+    finally:
+        shutil.rmtree(tmpdir, ignore_errors=True)
 
 
 def io_score(num_images=4096, batch=128):
@@ -704,9 +875,12 @@ def serving_score(loads=(4, 16, 64), buckets=(1, 8, 32), in_dim=64,
 
 
 def main():
+    if len(sys.argv) > 1 and sys.argv[1] == "_compile_probe":
+        _compile_probe(sys.argv[2])
+        return
     which = set((sys.argv[1].split(",") if len(sys.argv) > 1 else
                  ["infer", "train", "fit", "lstm", "ssd", "io",
-                  "serving", "ckpt"]))
+                  "serving", "ckpt", "compile"]))
     if "io" in which:
         io_score()
     if "infer" in which:
@@ -738,6 +912,8 @@ def main():
         serving_score()
     if "ckpt" in which:
         ckpt_score()
+    if "compile" in which:
+        compile_score()
     print("done: %d rows this run (persisted incrementally)" % len(ROWS))
 
 

@@ -59,6 +59,11 @@ if [ "${f64_skips:-0}" -ne 4 ]; then
   exit 1
 fi
 python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+# compile-once effectiveness: a small fit+predict runs twice against a
+# temp persistent compile cache; the second run must perform ZERO XLA
+# compilations (every executable loads from the cache) — unstable cache
+# identities re-introduce cold warm-up costs in serving/CI/resume
+python ci/check_compile_cache.py
 # bench regression gate: fail on BENCH_extra.json rows regressed >5%
 # vs best without a recorded waiver — opt-in (BENCH_GATE=1) because the
 # snapshot is only refreshed on bench hosts; see docs/observability.md

@@ -19,7 +19,7 @@ from mxnet_tpu import models  # noqa: E402
 
 
 def score(network, batch_size, image_shape=(3, 224, 224), num_batches=20,
-          dtype="float32", return_mod=False, **net_kwargs):
+          dtype="float32", return_mod=False, repeats=1, **net_kwargs):
     sym = models.get_symbol(network, num_classes=1000,
                             image_shape=image_shape, **net_kwargs)
     ctx = mx.tpu() if mx.num_tpus() > 0 else mx.cpu()
@@ -48,13 +48,24 @@ def score(network, batch_size, image_shape=(3, 224, 224), num_batches=20,
 
     mod.predict_bulk(bulk)
     sync()
-    tic = time.time()
-    done = 0
-    while done < num_batches:
-        mod.predict_bulk(bulk)
-        done += len(bulk)
-    sync()
-    ips = done * batch_size / (time.time() - tic)
+    # best-of-N timed windows (repeats>1): a single short window on the
+    # shared tunneled chip measures the co-tenant/dispatch-latency
+    # lottery as much as the model — the same interference-robust
+    # estimate the train rows already use.  The BENCH_extra round-5
+    # "inference regressions" (resnet-50 −38%, resnet-152 −34%,
+    # inception-v3 −19%) traced to exactly this: identical HLO
+    # fingerprints across the blamed commits, one unlucky 2-dispatch
+    # window (docs/how_to/perf.md "Compile once")
+    best = float("inf")
+    for _ in range(max(1, repeats)):
+        tic = time.time()
+        done = 0
+        while done < num_batches:
+            mod.predict_bulk(bulk)
+            done += len(bulk)
+        sync()
+        best = min(best, time.time() - tic)
+    ips = done * batch_size / best
     return (ips, mod) if return_mod else ips
 
 

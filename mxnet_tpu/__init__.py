@@ -14,6 +14,10 @@ from .context import Context, cpu, gpu, tpu, current_context, num_tpus
 from . import telemetry
 from . import perfdebug
 from . import faults
+from . import compile_cache
+# MXNET_COMPILE_CACHE_DIR arms the persistent XLA compile cache before
+# any executor build can compile (no-op when unset; never raises)
+compile_cache._init_from_env()
 from . import retry
 
 from . import ops

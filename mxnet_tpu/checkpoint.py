@@ -200,6 +200,14 @@ def write_snapshot(prefix, snap, logger=logging, keep_last=None):
     # (swept by a later GC), never a manifest entry without its bytes
     _model._manifest_add_snapshot(prefix, entry)
     gc_snapshots(prefix, keep_last=keep_last, logger=logger)
+    from . import compile_cache as _compile_cache
+
+    if _compile_cache.recording():
+        # warm-up manifest sidecar: a mid-epoch kill before the first
+        # EPOCH checkpoint must still leave the resume path something to
+        # pre-compile from (no-op once written and unchanged)
+        _compile_cache.save_manifest_if_changed(
+            _compile_cache.manifest_path(prefix))
     _telemetry.inc("resilience.checkpoint.saves")
     _telemetry.observe("resilience.checkpoint.async_write_seconds",
                        time.perf_counter() - t0)

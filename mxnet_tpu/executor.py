@@ -34,6 +34,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from . import compile_cache as _compile_cache
 from . import perfdebug as _perfdebug
 from . import profiler as _profiler
 from . import random as _random
@@ -53,17 +54,22 @@ class _DeviceHintFn:
     ``compile_note`` (a kind string, set only when telemetry is enabled at
     build time) times the FIRST call — which pays jax tracing + XLA
     compilation synchronously — into the ``xla.compile.*`` metrics;
-    ``attrib`` (``(exec_name, kind_name)``, set only when
-    :mod:`mxnet_tpu.perfdebug` attribution is enabled at build time)
-    additionally captures the first call's compiled-executable cost /
-    memory / HLO fingerprint.  After the first call the wrapper is a
-    single attribute check per dispatch."""
+    ``attrib`` (``(exec_name, kind_name)``, set when
+    :mod:`mxnet_tpu.perfdebug` attribution OR
+    :mod:`mxnet_tpu.compile_cache` manifest recording is enabled at
+    build time) additionally captures the first call's
+    compiled-executable cost / memory / HLO fingerprint and/or records
+    the build's replayable identity (kind + abstract signature) into the
+    compile-once warm-up registry.  After the first call the wrapper is
+    a single attribute check per dispatch."""
 
-    def __init__(self, fn, dev_type, compile_note=None, attrib=None):
+    def __init__(self, fn, dev_type, compile_note=None, attrib=None,
+                 kind=None):
         self._fn = fn
         self._dev = dev_type
         self._note = compile_note
         self._attrib = attrib
+        self._kind = kind
 
     def __call__(self, *args, **kwargs):
         if self._note is not None or self._attrib is not None:
@@ -83,16 +89,21 @@ class _DeviceHintFn:
             return self._fn(*args, **kwargs)
         finally:
             _ops_registry.trace_device.reset(tok)
+            dt = time.perf_counter() - t0
             if note is not None:
-                dt = time.perf_counter() - t0
                 _telemetry.inc("xla.compile.seconds", dt, kind=note)
                 _telemetry.observe("xla.compile.first_call_seconds", dt,
                                    kind=note)
             if attrib is not None:
                 # shapes/dtypes only (aval metadata survives donation);
-                # capture() never raises into the step
-                _perfdebug.capture(attrib[0], attrib[1], self.lower,
-                                   args, kwargs)
+                # neither hook ever raises into the step
+                if _perfdebug.enabled():
+                    _perfdebug.capture(attrib[0], attrib[1], self.lower,
+                                       args, kwargs)
+                if _compile_cache.recording():
+                    _compile_cache.note_build(
+                        attrib[0], self._kind if self._kind is not None
+                        else attrib[1], self.lower, args, kwargs, dt)
 
     def lower(self, *args, **kwargs):
         tok = _ops_registry.trace_device.set(self._dev)
@@ -473,8 +484,14 @@ class Executor:
                 "executor %r compiled its %r program %d times (threshold "
                 "%d): recompilation churn — per-step hyperparameter "
                 "changes or env-fingerprint flips retrace/recompile every "
-                "time (MXNET_RECOMPILE_WARN_THRESHOLD tunes this)",
-                self._symbol_name(), kind_name, builds, limit)
+                "time (MXNET_RECOMPILE_WARN_THRESHOLD tunes this).%s",
+                self._symbol_name(), kind_name, builds, limit,
+                " Rebuilds are served from the persistent compile cache "
+                "(cheap loads, but the retrace cost remains)."
+                if _compile_cache.enabled() else
+                " MXNET_COMPILE_CACHE_DIR would at least make the "
+                "rebuilds persistent-cache loads instead of full "
+                "compiles.")
             _telemetry.inc("xla.recompile_warnings")
         if not _telemetry.enabled():
             return None
@@ -486,7 +503,9 @@ class Executor:
         # barrier toggles must retrace, not silently reuse a stale jit
         cache_key = (kind, _ops_registry.trace_env_fingerprint())
         if cache_key in self._fns:
-            _telemetry.inc("xla.compile.cache_hits")
+            # IN-PROCESS jit function reuse — split from the on-disk
+            # xla.compile.persistent_cache_hits (compile_cache.py)
+            _telemetry.inc("xla.compile.fn_cache_hits")
             return self._fns[cache_key]
         symbol = self._symbol
         arg_names = list(self.arg_names)
@@ -678,9 +697,9 @@ class Executor:
         else:
             raise ValueError(kind)
         attrib = (self._symbol_name(), _kind_name(kind)) \
-            if _perfdebug.enabled() else None
+            if _perfdebug.enabled() or _compile_cache.recording() else None
         fn = _DeviceHintFn(fn, self._ctx.device_type,
-                           self._note_build(kind), attrib)
+                           self._note_build(kind), attrib, kind=kind)
         self._fns[cache_key] = fn
         return fn
 
@@ -784,7 +803,7 @@ class Executor:
         key = ("seg", si, is_train,
                _ops_registry.trace_env_fingerprint())
         if key in self._fns:
-            _telemetry.inc("xla.compile.cache_hits")
+            _telemetry.inc("xla.compile.fn_cache_hits")
             return self._fns[key]
         _dev, seg_nodes = self._segments[si]
         in_keys, out_keys = self._seg_io[si]
@@ -809,9 +828,10 @@ class Executor:
             return [entry[k2] for k2 in out_keys], dict(aux_updates)
 
         attrib = (self._symbol_name(), "seg%d" % si) \
-            if _perfdebug.enabled() else None
+            if _perfdebug.enabled() or _compile_cache.recording() else None
         fn = _DeviceHintFn(jax.jit(f), _dev.device_type,
-                           self._note_build(key), attrib)
+                           self._note_build(key), attrib,
+                           kind=("seg", si, is_train))
         self._fns[key] = fn
         return fn
 
@@ -960,6 +980,74 @@ class Executor:
             self._rng_cache = jax.device_put(_random.next_key(),
                                              self._ctx.jax_device())
         return self._rng_cache
+
+    # -- compile-once warm-up (docs/how_to/perf.md "Compile once") --------
+    def precompile(self, entries, logger=logging):
+        """AOT-build the programs a warm-up manifest recorded: for each
+        entry, rebuild the jitted function for its kind, ``lower`` it
+        against the recorded abstract signature and ``compile`` — with
+        the persistent compile cache populated this is a disk load, not
+        an XLA compile, so a restart performs zero cold compiles before
+        its first real dispatch.  Nothing is EXECUTED: no parameter,
+        optimizer or rng state is touched, which is what makes this safe
+        immediately before an exact ``resume="auto"`` restart.
+
+        A program whose lowered HLO no longer matches the manifest's
+        fingerprint is the invalidation signal (counted + logged — the
+        fresh build simply wins); entries that cannot be reconstructed
+        (placement segments, foreign kinds, shape mismatches) are
+        skipped or counted as errors, never raised.  Returns a summary
+        dict."""
+        out = {"replayed": 0, "skipped": 0, "errors": 0,
+               "fingerprint_changes": 0}
+        for e in entries:
+            try:
+                kind = _compile_cache.kind_from_json(e.get("kind"))
+            except MXNetError:
+                out["skipped"] += 1
+                continue
+            head = kind if isinstance(kind, str) \
+                else (kind[0] if kind else None)
+            sig = e.get("sig")
+            if head not in _compile_cache.REPLAYABLE_KINDS or sig is None:
+                out["skipped"] += 1
+                continue
+            try:
+                args, kwargs = _compile_cache.signature_from_json(
+                    sig, device=self._ctx.jax_device())
+                fn = self._get_fn(kind)
+                lowered = fn.lower(*args, **kwargs)
+                if e.get("fingerprint"):
+                    fp = _perfdebug.fingerprint_text(lowered.as_text())
+                    if fp != e["fingerprint"]:
+                        out["fingerprint_changes"] += 1
+                        _telemetry.inc(
+                            "compile_cache.manifest.fingerprint_changes")
+                        _telemetry.event(
+                            "compile_cache.fingerprint_change",
+                            exec=e.get("exec"), kind=e.get("kind_name"),
+                            shapes=e.get("shapes"),
+                            old=e["fingerprint"], new=fp)
+                        logger.warning(
+                            "compile_cache: %s/%s@%s lowers to different "
+                            "HLO than the warm-up manifest recorded "
+                            "(%s -> %s): code or trace-env changed since "
+                            "the manifest was written; compiling fresh",
+                            e.get("exec"), e.get("kind_name"),
+                            e.get("shapes"), e["fingerprint"], fp)
+                lowered.compile()
+                out["replayed"] += 1
+            except Exception as exn:  # noqa: broad-except — replay is
+                # an optimization; a stale manifest entry must degrade
+                # to lazy compilation, never break bind/fit/serving
+                out["errors"] += 1
+                _telemetry.inc("compile_cache.manifest.replay_errors")
+                logger.warning(
+                    "compile_cache: manifest replay of %s/%s@%s failed "
+                    "(%s: %s); it will compile lazily instead",
+                    e.get("exec"), e.get("kind_name"), e.get("shapes"),
+                    type(exn).__name__, exn)
+        return out
 
     # -- API --------------------------------------------------------------
     def forward(self, is_train=False, **kwargs):

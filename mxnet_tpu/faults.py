@@ -34,6 +34,11 @@ point                  effect when it fires
                          ``fit`` finishes the batch, drains, checkpoints
                          and raises ``TrainingPreempted`` (the kill half
                          of the kill/resume chaos harness)
+``compile_cache.read``   the Nth persistent-compile-cache read finds its
+                         on-disk entry truncated in half (a host crash
+                         mid-cache-write) — the runtime must warn, fall
+                         back to a clean recompile and self-heal the
+                         entry
 =====================  =====================================================
 
 Arming — programmatic::
@@ -70,7 +75,7 @@ __all__ = ["POINTS", "FaultInjected", "arm", "disarm", "armed",
 #: this so a typo'd point fails loudly instead of never firing)
 POINTS = ("kvstore.push.socket", "checkpoint.write", "fit.batch",
           "recordio.read", "serving.dispatch", "serving.model.write",
-          "fit.preempt")
+          "fit.preempt", "compile_cache.read")
 
 
 class FaultInjected(MXNetError):

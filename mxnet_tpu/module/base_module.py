@@ -18,6 +18,7 @@ import time
 
 import numpy as np
 
+from .. import compile_cache as _compile_cache
 from .. import faults as _faults
 from .. import metric as _metric
 from .. import perfdebug as _perfdebug
@@ -549,6 +550,24 @@ class BaseModule:
             ex = getattr(self, "_exec", None)
             if ex is not None and rng.get("exec_step") is not None:
                 ex._rng_step = int(rng["exec_step"])
+        if resume == "auto" and _compile_cache.enabled() \
+                and hasattr(self, "warm_from_manifest"):
+            # compile-once warm-up (docs/how_to/perf.md "Compile once"):
+            # replay the manifest the previous run saved next to its
+            # checkpoints, so every executable is pre-built — pure
+            # persistent-cache loads — before the loop restarts.  AOT
+            # only: nothing executes, exact-resume state is untouched.
+            man = _compile_cache.load_manifest(
+                _compile_cache.manifest_path(checkpoint_prefix))
+            if man is not None:
+                try:
+                    self.warm_from_manifest(man)
+                except Exception as e:  # noqa: broad-except — warm-up
+                    # is an optimization; resume must proceed without it
+                    self.logger.warning(
+                        "compile_cache: warm-up manifest replay failed "
+                        "(%s: %s); executables will compile lazily",
+                        type(e).__name__, e)
         if hasattr(self, "_install_nan_guard"):
             # unconditional: a previous fit's guard must DISARM when this
             # fit runs without a policy (stale accumulated flags would
@@ -601,8 +620,7 @@ class BaseModule:
         if _telemetry.enabled():
             # declare the resilience family at zero so a clean run's
             # snapshot still shows it (docs/observability.md)
-            for _c in _RESILIENCE_COUNTERS:
-                _telemetry.inc(_c, 0)
+            _telemetry.declare(*_RESILIENCE_COUNTERS)
 
         def _trip_nan_policy(epoch, nbatch, gated):
             """Apply ``nan_policy`` to a flagged batch.  ``gated``: the
@@ -1094,6 +1112,12 @@ class BaseModule:
             from ..model import save_checkpoint as _save_ckpt
 
             _save_ckpt(prefix, epoch, self.symbol, arg_params, aux_params)
+        if _compile_cache.recording():
+            # the warm-up manifest rides the checkpoint cadence: a
+            # restart replays it to pre-build every executable this fit
+            # compiled (no-op when the entry set is unchanged)
+            _compile_cache.save_manifest_if_changed(
+                _compile_cache.manifest_path(prefix))
 
     # -- properties / abstract --------------------------------------------
     @property

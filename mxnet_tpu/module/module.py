@@ -16,9 +16,11 @@ no separate comm phase.  A single context degenerates to a 1-device mesh.
 from __future__ import annotations
 
 import logging
+import time as _time_mod
 
 import numpy as np
 
+from .. import compile_cache as _compile_cache
 from .. import metric as _metric
 from .. import optimizer as opt
 from .. import perfdebug as _perfdebug
@@ -988,8 +990,10 @@ class Module(BaseModule):
                         new_m.append(nm)
                 return new_p, new_m
 
-            self._fused_step = _perfdebug.instrument(
-                jax.jit(step, donate_argnums=(0, 2)),
+            self._fused_step = _compile_cache.instrument(
+                _perfdebug.instrument(
+                    jax.jit(step, donate_argnums=(0, 2)),
+                    self._exec._symbol_name(), "fused_update"),
                 self._exec._symbol_name(), "fused_update")
         # per-index bookkeeping keeps num_update/scheduler semantics
         for idx in range(len(names)):
@@ -1073,6 +1077,43 @@ class Module(BaseModule):
     def install_monitor(self, mon):
         assert self.binded
         mon.install(self._exec)
+
+    # -- compile-once warm-up (docs/how_to/perf.md "Compile once") --------
+    def warm_from_manifest(self, manifest):
+        """Replay a compile-once warm-up manifest: AOT-build + compile
+        every executable a previous run of this model recorded, BEFORE
+        the first real batch dispatches.  With the persistent compile
+        cache populated (``MXNET_COMPILE_CACHE_DIR``) the whole replay
+        is disk loads — a ``resume="auto"`` restart performs zero cold
+        XLA compiles on the training hot path.  State-safe: nothing
+        executes, so parameters / optimizer state / rng are untouched
+        (exact-resume bit-identity is preserved).  Returns the replay
+        summary dict."""
+        assert self.binded, "call bind before warm_from_manifest"
+        entries = manifest.get("entries", []) \
+            if isinstance(manifest, dict) else list(manifest)
+        # the registry records per process, so a multi-model run's
+        # manifest can carry foreign executables: prefer the entries
+        # recorded for THIS executor when any match (a replay of a
+        # foreign program would just burn a trace and log an error)
+        mine = [e for e in entries
+                if e.get("exec") == self._exec._symbol_name()]
+        if mine:
+            entries = mine
+        t0 = _time_mod.perf_counter()
+        summary = self._exec.precompile(entries, logger=self.logger)
+        dt = _time_mod.perf_counter() - t0
+        _telemetry.inc("compile_cache.manifest.replays")
+        _telemetry.event("compile_cache.manifest_replay",
+                         exec=self._exec._symbol_name(),
+                         seconds=round(dt, 3), **summary)
+        self.logger.info(
+            "compile_cache: warm-up manifest replayed in %.2fs — %d "
+            "program(s) pre-built, %d skipped, %d error(s), %d "
+            "fingerprint change(s)", dt, summary["replayed"],
+            summary["skipped"], summary["errors"],
+            summary["fingerprint_changes"])
+        return summary
 
     # -- checkpointing ----------------------------------------------------
     def _capture_state_arrays(self):

@@ -355,28 +355,38 @@ def analyze_signature(sig):
             "hbm_peak_bytes": _device_peak_bytes() or _hbm_total(mem)}
 
 
-class _InstrumentedJit:
-    """Minimal first-call capture wrapper for jitted functions built
-    outside the executor's ``_get_fn`` path (e.g. ``Module``'s fused
-    multi-tensor update)."""
+class _FirstCallHook:
+    """Minimal first-call wrapper for jitted functions built outside
+    the executor's ``_get_fn`` path (e.g. ``Module``'s fused
+    multi-tensor update): ``hook(fn, args, kwargs, seconds)`` runs once
+    after the first call, then the wrapper is one boolean check per
+    dispatch.  Shared by perfdebug attribution and compile_cache
+    manifest recording (:func:`first_call_hook`)."""
 
-    __slots__ = ("_fn", "_name", "_kind", "_pending")
+    __slots__ = ("_fn", "_hook", "_pending")
 
-    def __init__(self, fn, name, kind):
+    def __init__(self, fn, hook):
         self._fn = fn
-        self._name = name
-        self._kind = kind
+        self._hook = hook
         self._pending = True
 
     def __call__(self, *args, **kwargs):
+        if not self._pending:
+            return self._fn(*args, **kwargs)
+        self._pending = False
+        t0 = time.perf_counter()
         out = self._fn(*args, **kwargs)
-        if self._pending:
-            self._pending = False
-            capture(self._name, self._kind, self._fn.lower, args, kwargs)
+        self._hook(self._fn, args, kwargs, time.perf_counter() - t0)
         return out
 
     def lower(self, *args, **kwargs):
         return self._fn.lower(*args, **kwargs)
+
+
+def first_call_hook(fn, hook):
+    """Wrap jitted ``fn`` so ``hook(fn, args, kwargs, seconds)`` fires
+    once after its first call."""
+    return _FirstCallHook(fn, hook)
 
 
 def instrument(fn, name, kind):
@@ -384,7 +394,9 @@ def instrument(fn, name, kind):
     ``fn`` unchanged when attribution is disabled."""
     if not enabled():
         return fn
-    return _InstrumentedJit(fn, name, kind)
+    return _FirstCallHook(
+        fn, lambda f, args, kwargs, _dt: capture(name, kind, f.lower,
+                                                 args, kwargs))
 
 
 # -- reads ------------------------------------------------------------------

@@ -52,9 +52,19 @@ class ServingHandle:
             data, deadline_ms=deadline_ms, timeout=timeout)
 
     def healthz(self):
-        return {"status": "ok",
-                "models": {m.name: m.version
-                           for m in self.registry.models()}}
+        payload = {"status": "ok",
+                   "models": {m.name: m.version
+                              for m in self.registry.models()}}
+        from .. import compile_cache as _compile_cache
+
+        if _compile_cache.enabled():
+            # operators watching a rolling version swap read cold==0
+            # here as "the reload never recompiled" (docs/serving.md)
+            cc = _compile_cache.stats()
+            payload["compile_cache"] = {
+                k: cc[k] for k in ("entries", "bytes", "hits", "misses",
+                                   "evictions")}
+        return payload
 
     def pending_rows(self):
         """Rows queued or in a device dispatch across every loaded

@@ -26,16 +26,31 @@ import threading
 
 import numpy as np
 
+from .. import compile_cache as _compile_cache
 from .. import predict as _predict
 from .. import telemetry as _telemetry
 from ..base import MXNetError, atomic_write, atomic_write_bytes
 from .batcher import DynamicBatcher
 
 __all__ = ["UnknownModel", "ServedModel", "ModelRegistry", "save_model",
-           "MANIFEST"]
+           "MANIFEST", "WARMUP_MANIFEST"]
 
 #: the publish marker: readers only trust a directory carrying one
 MANIFEST = "manifest.json"
+
+#: serializes warm-up build recording across concurrently loading
+#: models: compile_cache's recording scope and hit/miss counters are
+#: process-global, so two interleaved warm-ups would cross-contaminate
+#: each other's manifest entries and cold/warm stats.  Warm-up is a
+#: rare load-time event; serializing it is the cheap correct trade.
+_warmup_record_lock = threading.Lock()
+
+#: compile-once warm-up manifest (docs/how_to/perf.md "Compile once"):
+#: records every executable a load compiled (kind / shape signature /
+#: HLO fingerprint) so the NEXT load of the same directory pre-builds
+#: them all as persistent-cache loads — version-independent, since the
+#: compiled program depends on symbol+shapes, not the weights
+WARMUP_MANIFEST = "warmup.json"
 
 
 class UnknownModel(MXNetError):
@@ -120,12 +135,17 @@ class ServedModel:
     def __init__(self, name, symbol_json, param_blob, input_shape,
                  data_name="data", buckets=(1, 8, 32), version=1,
                  ctx=None, batch_timeout_us=2000, max_queue_depth=128,
-                 autostart=True):
+                 autostart=True, warmup_manifest=None):
         self.name = name
         self.version = int(version)
         self.data_name = data_name
         self.input_shape = tuple(int(d) for d in input_shape)
         self.buckets = tuple(sorted({int(b) for b in buckets}))
+        #: compile-once warm-up manifest of a PREVIOUS load (fingerprint
+        #: verification) and the entries THIS load's warm-up recorded
+        #: (what the registry persists for the next one)
+        self._warmup_manifest = warmup_manifest
+        self.warmup_entries = []
         self._pred = _predict.Predictor(
             symbol_json, param_blob,
             {data_name: (self.buckets[-1],) + self.input_shape}, ctx=ctx)
@@ -151,17 +171,68 @@ class ServedModel:
 
     def warmup(self):
         """Compile every declared bucket now, at load time, so no live
-        request ever eats a first-call XLA trace."""
+        request ever eats a first-call XLA trace.
+
+        With the compile-once subsystem active
+        (``MXNET_COMPILE_CACHE_DIR``), the warm-up's compiles are
+        persistent-cache loads on any repeat load of the same
+        symbol+shapes — ``serving.warmup.cold_compiles`` reports how
+        many executables actually paid a backend compile (0 on a warm
+        reload); each bucket's build is recorded into
+        :attr:`warmup_entries` and the lowered HLO is fingerprinted
+        against the previous load's manifest, a mismatch being the
+        cache-invalidation signal (the model's program changed)."""
         import time as _time
 
-        for b in self.buckets:
-            t0 = _time.perf_counter()
-            self._dispatch(np.zeros((b,) + self.input_shape, np.float32))
-            _telemetry.observe("serving.warmup.seconds",
-                               _time.perf_counter() - t0,
-                               model=self.name, bucket=b)
+        with _warmup_record_lock:
+            stats0 = _compile_cache.stats() if _compile_cache.enabled() \
+                else None
+            with _compile_cache.recording_scope() as rec:
+                for b in self.buckets:
+                    t0 = _time.perf_counter()
+                    self._dispatch(np.zeros((b,) + self.input_shape,
+                                            np.float32))
+                    _telemetry.observe("serving.warmup.seconds",
+                                       _time.perf_counter() - t0,
+                                       model=self.name, bucket=b)
+            self.warmup_entries = rec.entries
+            stats1 = _compile_cache.stats() if stats0 is not None else None
+        cold = warm = None
+        if stats0 is not None:
+            cold = stats1["misses"] - stats0["misses"]
+            warm = stats1["hits"] - stats0["hits"]
+            _telemetry.set_gauge("serving.warmup.cold_compiles", cold,
+                                 model=self.name)
+            _telemetry.set_gauge("serving.warmup.cache_loads", warm,
+                                 model=self.name)
+        self._verify_warmup_fingerprints()
         _telemetry.event("serving.model.warm", model=self.name,
-                         version=self.version, buckets=len(self.buckets))
+                         version=self.version, buckets=len(self.buckets),
+                         cold_compiles=cold, cache_loads=warm)
+
+    def _verify_warmup_fingerprints(self):
+        """Compare this load's recorded builds against the previous
+        load's warm-up manifest: same (kind, shape signature) lowering
+        to different HLO means the model's compiled program changed —
+        the invalidation signal operators watch on version swaps."""
+        man = self._warmup_manifest
+        if not man or not self.warmup_entries:
+            return
+        prev = {(e.get("kind_name"), e.get("shapes")): e.get("fingerprint")
+                for e in man.get("entries", [])}
+        for e in self.warmup_entries:
+            old = prev.get((e.get("kind_name"), e.get("shapes")))
+            new = e.get("fingerprint")
+            if old and new and old != new:
+                _telemetry.inc("compile_cache.manifest.fingerprint_changes")
+                _telemetry.event("compile_cache.fingerprint_change",
+                                 model=self.name, kind=e.get("kind_name"),
+                                 shapes=e.get("shapes"), old=old, new=new)
+                logging.warning(
+                    "serving: model %r %s@%s compiles to different HLO "
+                    "than the previous load (%s -> %s): the program "
+                    "changed, warm-up paid a fresh compile", self.name,
+                    e.get("kind_name"), e.get("shapes"), old, new)
 
     def _dispatch(self, rows):
         """One device dispatch: reshape to the row-count's bucket (an
@@ -206,16 +277,27 @@ class ModelRegistry:
         self._lock = threading.Lock()
 
     def load(self, name, symbol_json, param_blob, input_shape,
-             data_name="data", buckets=(1, 8, 32), version=None):
+             data_name="data", buckets=(1, 8, 32), version=None,
+             warmup_manifest=None):
         """Load (or reload) ``name``: build + warm the new
         :class:`ServedModel` off-registry, then swap atomically.  On any
-        build failure the previously loaded version keeps serving."""
+        build failure the previously loaded version keeps serving.
+
+        ``warmup_manifest`` (a :func:`mxnet_tpu.compile_cache.
+        load_manifest` dict — :meth:`load_dir` wires it automatically)
+        lets the warm-up verify each compiled bucket's HLO fingerprint
+        against the previous load; a RELOAD with no manifest given
+        verifies against the version it replaces."""
         prev = self.get(name, default=None)
         if version is None:
             version = 1 if prev is None else prev.version + 1
+        if warmup_manifest is None and prev is not None \
+                and prev.warmup_entries:
+            warmup_manifest = {"entries": prev.warmup_entries}
         model = ServedModel(name, symbol_json, param_blob, input_shape,
                             data_name=data_name, buckets=buckets,
                             version=version, ctx=self._ctx,
+                            warmup_manifest=warmup_manifest,
                             **self._serve_opts)
         with self._lock:
             prev = self._models.get(name)
@@ -283,12 +365,27 @@ class ModelRegistry:
                 man = new_man
         symbol_json = blobs[man["symbol"]].decode()
         param_blob = blobs[man["params"]]
-        return self.load(name or man["name"], symbol_json, param_blob,
-                         man["input_shape"],
-                         data_name=man.get("data_name", "data"),
-                         buckets=man.get("buckets", (1, 8, 32)),
-                         version=man["version"] if version is None
-                         else version)
+        wu_path = os.path.join(model_dir, WARMUP_MANIFEST)
+        warmup_manifest = _compile_cache.load_manifest(wu_path)
+        model = self.load(name or man["name"], symbol_json, param_blob,
+                          man["input_shape"],
+                          data_name=man.get("data_name", "data"),
+                          buckets=man.get("buckets", (1, 8, 32)),
+                          version=man["version"] if version is None
+                          else version,
+                          warmup_manifest=warmup_manifest)
+        if _compile_cache.recording() and model.warmup_entries:
+            # persist what THIS load compiled so the next load (version
+            # swap, restart) replays it — atomic, never load-fatal
+            try:
+                _compile_cache.save_manifest(
+                    wu_path, entries=model.warmup_entries,
+                    model=model.name)
+            except OSError as e:
+                logging.warning(
+                    "serving: could not write warm-up manifest %s: %s",
+                    wu_path, e)
+        return model
 
     def unload(self, name, drain=True):
         """Remove ``name`` and stop its batcher (draining by default)."""

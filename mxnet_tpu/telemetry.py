@@ -43,8 +43,8 @@ from collections import deque
 
 from . import profiler as _profiler
 
-__all__ = ["enabled", "enable", "disable", "inc", "set_gauge", "observe",
-           "event", "phase", "snapshot", "dump", "dump_events",
+__all__ = ["enabled", "enable", "disable", "inc", "declare", "set_gauge",
+           "observe", "event", "phase", "snapshot", "dump", "dump_events",
            "prometheus_text", "write_prometheus", "reset", "sample_memory",
            "phase_totals", "counter_total", "gauge_value", "hist_quantile",
            "events_recent", "set_phase_hook"]
@@ -104,6 +104,15 @@ def inc(name, value=1, **labels):
     k = _key(name, labels)
     with _lock:
         _counters[k] = _counters.get(k, 0) + value
+
+
+def declare(*names):
+    """Declare counter families at zero so they are visible in
+    ``snapshot()``/Prometheus before their first increment (``fit``
+    does this for the resilience family; ``compile_cache`` for the
+    persistent-cache family)."""
+    for name in names:
+        inc(name, 0)
 
 
 def set_gauge(name, value, **labels):
