@@ -1,17 +1,13 @@
 #!/usr/bin/env python
 """Fail on swallowed exceptions in mxnet_tpu/.
 
-Two patterns break the resilience story (docs/resilience.md) by hiding
-the very errors the retry/checkpoint machinery must see:
-
-  1. a bare ``except:`` anywhere, and
-  2. ``except Exception:`` / ``except BaseException:`` whose entire body
-     is ``pass`` (the silent-swallow antipattern).
-
-A site that legitimately must swallow (interpreter-shutdown ``__del__``
-cleanup) documents itself with a ``# noqa`` comment on the ``except``
-line, which this checker honors.  AST-based, so strings and comments
-never false-positive.
+DEPRECATED shim: the checker logic migrated to the unified graftlint
+framework (``ci/graftlint/passes/bare_except.py``; run it via ``python
+-m ci.graftlint`` or ``--pass bare-except``).  This entry point is kept
+because scripts and docs reference it by path; it preserves the exact
+CLI, output format, and exit semantics (``# noqa`` on the except line
+still honored, plus the unified ``# lint: ok[bare-except] <reason>``
+grammar).
 
 Usage: python ci/check_bare_except.py [root ...]   (default: mxnet_tpu)
 Exit status 1 when violations exist, listing file:line for each.
@@ -19,65 +15,16 @@ Exit status 1 when violations exist, listing file:line for each.
 
 from __future__ import annotations
 
-import ast
 import pathlib
 import sys
 
-BROAD = ("Exception", "BaseException")
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
-
-def _noqa_lines(source):
-    return {i for i, line in enumerate(source.splitlines(), 1)
-            if "# noqa" in line}
-
-
-def _is_swallow(handler):
-    """Body is nothing but pass/``...`` (docstring-less no-op)."""
-    return all(isinstance(st, ast.Pass)
-               or (isinstance(st, ast.Expr)
-                   and isinstance(st.value, ast.Constant)
-                   and st.value.value is Ellipsis)
-               for st in handler.body)
-
-
-def check_file(path):
-    source = path.read_text()
-    try:
-        tree = ast.parse(source, filename=str(path))
-    except SyntaxError as e:
-        return ["%s:%s: syntax error: %s" % (path, e.lineno, e.msg)]
-    noqa = _noqa_lines(source)
-    problems = []
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.ExceptHandler):
-            continue
-        if node.lineno in noqa:
-            continue
-        if node.type is None:
-            problems.append("%s:%d: bare 'except:'" % (path, node.lineno))
-        elif isinstance(node.type, ast.Name) and node.type.id in BROAD \
-                and _is_swallow(node):
-            problems.append(
-                "%s:%d: 'except %s: pass' swallows errors silently "
-                "(handle it, narrow it, or add '# noqa' with a reason)"
-                % (path, node.lineno, node.type.id))
-    return problems
+from ci.graftlint import shim_main  # noqa: E402
 
 
 def main(argv):
-    roots = [pathlib.Path(a) for a in argv[1:]] \
-        or [pathlib.Path(__file__).resolve().parent.parent / "mxnet_tpu"]
-    problems = []
-    for root in roots:
-        files = sorted(root.rglob("*.py")) if root.is_dir() else [root]
-        for f in files:
-            problems.extend(check_file(f))
-    for p in problems:
-        print(p)
-    if problems:
-        print("check_bare_except: %d violation(s)" % len(problems))
-        return 1
-    return 0
+    return shim_main("bare-except", argv[1:])
 
 
 if __name__ == "__main__":

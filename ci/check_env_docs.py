@@ -1,19 +1,13 @@
 #!/usr/bin/env python
 """Fail when an ``MXNET_*`` env var read in mxnet_tpu/ is undocumented.
 
-``docs/how_to/env_var.md`` is the canonical knob list; every PR adds a
-few knobs and the doc silently drifts — until an operator greps the
-source to find out what a setting is called.  This checker closes the
-loop: any string constant in framework code that IS an env-var name
-(``os.environ.get("MXNET_...")`` call sites and the trace-fingerprint
-name tuples alike) must appear, verbatim, in the doc.
-
-AST-based like its siblings (``check_bare_except.py``,
-``check_print.py``): only whole string constants matching
-``^MXNET_[A-Z][A-Z0-9_]*$`` count, so prose mentions in docstrings and
-comments never false-positive.  Reference C-macro names that are not env
-vars (``MXNET_REGISTER_*``) live in ``NOT_ENV``; a line carrying
-``# noqa`` is exempt (document why).
+DEPRECATED shim: the checker logic migrated to the unified graftlint
+framework (``ci/graftlint/passes/env_docs.py``; run it via ``python -m
+ci.graftlint`` or ``--pass env-docs``).  This entry point is kept
+because scripts and docs reference it by path — docs/how_to/env_var.md
+names it as the enforcement hook — and it preserves the exact CLI,
+output format, and exit semantics (``# noqa`` still honored, plus the
+unified ``# lint: ok[env-docs] <reason>`` grammar).
 
 Usage: python ci/check_env_docs.py [root ...]   (default: mxnet_tpu)
 Exit status 1 when violations exist, listing file:line and the var name.
@@ -21,67 +15,16 @@ Exit status 1 when violations exist, listing file:line and the var name.
 
 from __future__ import annotations
 
-import ast
 import pathlib
-import re
 import sys
 
-ENV_RE = re.compile(r"^MXNET_[A-Z][A-Z0-9_]*$")
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
-#: whole-string-constant matches that are NOT env vars: the reference's
-#: C registration macros, quoted as identifiers in framework code
-NOT_ENV = frozenset({
-    "MXNET_REGISTER_NDARRAY_FUN",
-    "MXNET_REGISTER_IMAGE_AUGMENTER",
-})
-
-DOC = pathlib.Path(__file__).resolve().parent.parent \
-    / "docs" / "how_to" / "env_var.md"
-
-
-def _noqa_lines(source):
-    return {i for i, line in enumerate(source.splitlines(), 1)
-            if "# noqa" in line}
-
-
-def env_names_in_file(path):
-    """Yield ``(lineno, name)`` for every env-var-shaped string constant."""
-    source = path.read_text()
-    try:
-        tree = ast.parse(source, filename=str(path))
-    except SyntaxError as e:
-        return [(e.lineno or 0, "SYNTAX ERROR: %s" % e.msg)]
-    noqa = _noqa_lines(source)
-    out = []
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Constant) and isinstance(node.value, str) \
-                and ENV_RE.match(node.value) \
-                and node.value not in NOT_ENV \
-                and node.lineno not in noqa:
-            out.append((node.lineno, node.value))
-    return out
+from ci.graftlint import shim_main  # noqa: E402
 
 
 def main(argv):
-    roots = [pathlib.Path(a) for a in argv[1:]] \
-        or [pathlib.Path(__file__).resolve().parent.parent / "mxnet_tpu"]
-    documented = DOC.read_text() if DOC.exists() else ""
-    problems = []
-    for root in roots:
-        files = sorted(root.rglob("*.py")) if root.is_dir() else [root]
-        for f in files:
-            for lineno, name in env_names_in_file(f):
-                if not re.search(r"\b%s\b" % re.escape(name), documented):
-                    problems.append(
-                        "%s:%d: env var %s is read here but missing from "
-                        "%s" % (f, lineno, name, DOC))
-    for p in problems:
-        print(p)
-    if problems:
-        print("check_env_docs: %d undocumented env var read(s)"
-              % len(problems))
-        return 1
-    return 0
+    return shim_main("env-docs", argv[1:])
 
 
 if __name__ == "__main__":

@@ -1,27 +1,15 @@
 #!/usr/bin/env python
 """Fail on host-synchronizing calls in the fit/step hot-path modules.
 
-The whole point of the sync-free fit loop (docs/how_to/perf.md) is that
-``Module.fit``'s steady state never blocks the host on device results:
-metrics accumulate on device, the NaN guard is one in-graph scalar, and
-H2D runs on the prefetch thread.  One stray ``.asnumpy()`` (a blocking
-device→host copy) or ``np.asarray(device_array)`` in the hot path
-silently reintroduces a per-batch round trip that no test catches but
-every profile shows — so the build fails on them instead.
-
-Checked roots (the fit/step hot path): ``mxnet_tpu/module/``,
-``mxnet_tpu/executor.py``, ``mxnet_tpu/metric.py``.
-
-Flagged call shapes (AST-based, so prose/comments never false-positive):
-
-  * ``<expr>.asnumpy()`` / ``<expr>.asscalar()``
-  * ``np.asarray(...)`` / ``_np.asarray(...)`` / ``numpy.asarray(...)``
-
-A line carrying ``# host-sync: ok`` is exempt — tag the legitimate
-sites (explicit sync points like ``DeviceMetric._sync``, host-values
-conversions that never touch a device buffer, dist-mode host staging)
-with a trailing reason.  ``python_module.py`` is exempt wholesale: the
-PythonModule runs user numpy code by design.
+DEPRECATED shim: the checker logic migrated to the unified graftlint
+framework (``ci/graftlint/passes/host_sync.py``; run it via ``python -m
+ci.graftlint`` or ``--pass host-sync``) and grew ``.item()`` /
+``.tolist()`` coverage on the way (same blocking transfer, different
+spelling).  This entry point is kept because scripts and docs reference
+it by path; it preserves the exact CLI, output format, and exit
+semantics (``# host-sync: ok <reason>`` tags still honored, plus the
+unified ``# lint: ok[host-sync] <reason>`` grammar;
+``python_module.py`` stays exempt wholesale).
 
 Usage: python ci/check_host_sync.py [root ...]
 Exit status 1 when violations exist, listing file:line for each.
@@ -29,78 +17,16 @@ Exit status 1 when violations exist, listing file:line for each.
 
 from __future__ import annotations
 
-import ast
 import pathlib
 import sys
 
-REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
-#: the fit/step hot-path modules (relative to the repo root)
-DEFAULT_ROOTS = ("mxnet_tpu/module", "mxnet_tpu/executor.py",
-                 "mxnet_tpu/metric.py")
-
-#: hot-path-adjacent files that are host-side by design
-ALLOWED_FILES = frozenset({"python_module.py"})
-
-TAG = "# host-sync: ok"
-
-_NUMPY_NAMES = frozenset({"np", "_np", "numpy"})
-
-
-def _tagged_lines(source):
-    return {i for i, line in enumerate(source.splitlines(), 1)
-            if TAG in line}
-
-
-def _is_sync_call(node):
-    func = node.func
-    if not isinstance(func, ast.Attribute):
-        return None
-    if func.attr in ("asnumpy", "asscalar"):
-        return ".%s()" % func.attr
-    if func.attr == "asarray" and isinstance(func.value, ast.Name) \
-            and func.value.id in _NUMPY_NAMES:
-        return "%s.asarray(...)" % func.value.id
-    return None
-
-
-def check_file(path):
-    source = path.read_text()
-    try:
-        tree = ast.parse(source, filename=str(path))
-    except SyntaxError as e:
-        return ["%s:%s: syntax error: %s" % (path, e.lineno, e.msg)]
-    tagged = _tagged_lines(source)
-    problems = []
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Call):
-            continue
-        what = _is_sync_call(node)
-        if what is None or node.lineno in tagged:
-            continue
-        problems.append(
-            "%s:%d: %s in a fit/step hot-path module blocks the host on "
-            "device results (tag the line '%s <reason>' if the sync is "
-            "the point)" % (path, node.lineno, what, TAG))
-    return problems
+from ci.graftlint import shim_main  # noqa: E402
 
 
 def main(argv):
-    roots = [pathlib.Path(a) for a in argv[1:]] \
-        or [REPO / r for r in DEFAULT_ROOTS]
-    problems = []
-    for root in roots:
-        files = sorted(root.rglob("*.py")) if root.is_dir() else [root]
-        for f in files:
-            if f.name in ALLOWED_FILES:
-                continue
-            problems.extend(check_file(f))
-    for p in problems:
-        print(p)
-    if problems:
-        print("check_host_sync: %d violation(s)" % len(problems))
-        return 1
-    return 0
+    return shim_main("host-sync", argv[1:])
 
 
 if __name__ == "__main__":

@@ -1,20 +1,13 @@
 #!/usr/bin/env python
 """Fail on bare ``print(`` calls in mxnet_tpu/ framework code.
 
-Framework output must flow through ``logging`` (so operators can route/
-filter it) or the telemetry registry (so it survives in ``snapshot()``) —
-a stray ``print`` bypasses both and pollutes stdout, which several tools
-(``bench.py``'s one-JSON-line contract, launcher log scraping) treat as
-machine-readable.  Sibling of ``ci/check_bare_except.py``.
-
-Allowed:
-
-  * files in ``ALLOWED_FILES`` — interactive display tools whose very
-    purpose is terminal output (``visualization.py`` print_summary;
-    ``callback.py``'s ProgressBar already writes via ``sys.stdout``)
-  * lines carrying a ``# noqa`` comment (document why)
-
-AST-based, so strings/comments never false-positive.
+DEPRECATED shim: the checker logic migrated to the unified graftlint
+framework (``ci/graftlint/passes/print_call.py``; run it via ``python
+-m ci.graftlint`` or ``--pass print``).  This entry point is kept
+because scripts and docs reference it by path; it preserves the exact
+CLI, output format, and exit semantics (``# noqa`` lines and the
+``visualization.py`` exemption still honored, plus the unified
+``# lint: ok[print] <reason>`` grammar).
 
 Usage: python ci/check_print.py [root ...]   (default: mxnet_tpu)
 Exit status 1 when violations exist, listing file:line for each.
@@ -22,58 +15,16 @@ Exit status 1 when violations exist, listing file:line for each.
 
 from __future__ import annotations
 
-import ast
 import pathlib
 import sys
 
-#: repo-relative file names whose prints are their feature, not a leak
-ALLOWED_FILES = frozenset({"visualization.py"})
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
-
-def _noqa_lines(source):
-    return {i for i, line in enumerate(source.splitlines(), 1)
-            if "# noqa" in line}
-
-
-def check_file(path):
-    source = path.read_text()
-    try:
-        tree = ast.parse(source, filename=str(path))
-    except SyntaxError as e:
-        return ["%s:%s: syntax error: %s" % (path, e.lineno, e.msg)]
-    noqa = _noqa_lines(source)
-    problems = []
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Call):
-            continue
-        if not (isinstance(node.func, ast.Name)
-                and node.func.id == "print"):
-            continue
-        if node.lineno in noqa:
-            continue
-        problems.append(
-            "%s:%d: bare 'print(' in framework code (use logging or "
-            "telemetry; '# noqa' with a reason for CLI display paths)"
-            % (path, node.lineno))
-    return problems
+from ci.graftlint import shim_main  # noqa: E402
 
 
 def main(argv):
-    roots = [pathlib.Path(a) for a in argv[1:]] \
-        or [pathlib.Path(__file__).resolve().parent.parent / "mxnet_tpu"]
-    problems = []
-    for root in roots:
-        files = sorted(root.rglob("*.py")) if root.is_dir() else [root]
-        for f in files:
-            if f.name in ALLOWED_FILES:
-                continue
-            problems.extend(check_file(f))
-    for p in problems:
-        print(p)
-    if problems:
-        print("check_print: %d violation(s)" % len(problems))
-        return 1
-    return 0
+    return shim_main("print", argv[1:])
 
 
 if __name__ == "__main__":

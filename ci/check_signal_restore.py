@@ -1,24 +1,14 @@
 #!/usr/bin/env python
 """Fail when framework code installs a signal handler it cannot restore.
 
-``Module.fit`` and ``ServingHTTPServer.run_forever`` install
-SIGTERM/SIGINT handlers for the duration of a call; leaking them past
-the call (because an exception skipped the restore) silently changes
-process-wide Ctrl-C semantics for everything that runs afterwards — the
-classic signal-hygiene bug.  This checker enforces the structural fix:
-**every ``signal.signal(...)`` install must be paired with a restore in
-a ``finally`` block of the same function.**
-
-Rule (AST-based like its siblings ``check_bare_except.py`` /
-``check_env_docs.py``):
-
-* a ``*.signal(...)`` call whose receiver name mentions ``signal``
-  (``signal.signal``, ``_signal.signal``) counts as a handler
-  *install* when it sits outside every ``finally`` block, and as a
-  *restore* when inside one;
-* per function, the number of installs must not exceed the number of
-  restores — each install has a guaranteed-to-run restore;
-* a line carrying ``# noqa`` is exempt (document why at the site).
+DEPRECATED shim: the checker logic migrated to the unified graftlint
+framework (``ci/graftlint/passes/signal_restore.py``; run it via
+``python -m ci.graftlint`` or ``--pass signal-restore``).  This entry
+point is kept because scripts and docs reference it by path
+(docs/resilience.md names it for the restore-in-finally shape); it
+preserves the exact CLI, output format, and exit semantics (``# noqa``
+still honored, plus the unified ``# lint: ok[signal-restore] <reason>``
+grammar).
 
 Usage: python ci/check_signal_restore.py [root ...]  (default: mxnet_tpu)
 Exit status 1 when violations exist, listing file:line.
@@ -26,109 +16,16 @@ Exit status 1 when violations exist, listing file:line.
 
 from __future__ import annotations
 
-import ast
 import pathlib
 import sys
 
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
-def _noqa_lines(source):
-    return {i for i, line in enumerate(source.splitlines(), 1)
-            if "# noqa" in line}
-
-
-def _is_signal_signal(node):
-    """True for ``<something-named-*signal*>.signal(...)`` calls."""
-    if not isinstance(node, ast.Call):
-        return False
-    fn = node.func
-    return isinstance(fn, ast.Attribute) and fn.attr == "signal" \
-        and isinstance(fn.value, ast.Name) and "signal" in fn.value.id
-
-
-def _finally_call_lines(func):
-    """Line numbers of signal.signal calls inside ``finally`` blocks of
-    ``func`` (not descending into nested function definitions)."""
-    lines = set()
-
-    def walk(node, in_finally):
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
-                             ast.Lambda)) and node is not func:
-            return
-        if in_finally and _is_signal_signal(node):
-            lines.add(node.lineno)
-        if isinstance(node, ast.Try):
-            for child in node.body + node.handlers + node.orelse:
-                walk(child, in_finally)
-            for child in node.finalbody:
-                walk(child, True)
-            return
-        for child in ast.iter_child_nodes(node):
-            walk(child, in_finally)
-
-    walk(func, False)
-    return lines
-
-
-def check_file(path):
-    source = path.read_text()
-    try:
-        tree = ast.parse(source, filename=str(path))
-    except SyntaxError as e:
-        return ["%s:%d: SYNTAX ERROR: %s" % (path, e.lineno or 0, e.msg)]
-    noqa = _noqa_lines(source)
-    problems = []
-    # module-level installs have no function scope to restore in — any
-    # signal.signal outside a function is a violation outright
-    funcs = [n for n in ast.walk(tree)
-             if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
-    owned = set()
-    for func in funcs:
-        restores = _finally_call_lines(func)
-        installs = []
-        for node in ast.walk(func):
-            if _is_signal_signal(node):
-                owned.add(node.lineno)
-                if node.lineno in noqa or node.lineno in restores:
-                    continue
-                installs.append(node.lineno)
-        # nested functions are walked again as their own `func`; only
-        # charge each install to its innermost enclosing function
-        inner = {n.lineno
-                 for child in ast.walk(func)
-                 if isinstance(child, (ast.FunctionDef,
-                                       ast.AsyncFunctionDef))
-                 and child is not func
-                 for n in ast.walk(child) if _is_signal_signal(n)}
-        installs = [ln for ln in installs if ln not in inner]
-        if len(installs) > len(restores):
-            for ln in installs:
-                problems.append(
-                    "%s:%d: signal.signal install without a matching "
-                    "restore in a finally block of the same function"
-                    % (path, ln))
-    for node in ast.walk(tree):
-        if _is_signal_signal(node) and node.lineno not in owned \
-                and node.lineno not in noqa:
-            problems.append(
-                "%s:%d: module-level signal.signal install (no scope "
-                "whose finally could restore it)" % (path, node.lineno))
-    return problems
+from ci.graftlint import shim_main  # noqa: E402
 
 
 def main(argv):
-    roots = [pathlib.Path(a) for a in argv[1:]] \
-        or [pathlib.Path(__file__).resolve().parent.parent / "mxnet_tpu"]
-    problems = []
-    for root in roots:
-        files = sorted(root.rglob("*.py")) if root.is_dir() else [root]
-        for f in files:
-            problems.extend(check_file(f))
-    for p in problems:
-        print(p)
-    if problems:
-        print("check_signal_restore: %d violation(s)" % len(problems))
-        return 1
-    return 0
+    return shim_main("signal-restore", argv[1:])
 
 
 if __name__ == "__main__":

@@ -1,0 +1,110 @@
+"""graftlint — the unified static-analysis framework for this repo.
+
+One shared AST walker, one suppression grammar (``# lint: ok[pass-id]
+<reason>``), one baseline ledger, one output format (human + JSON), and
+a pluggable pass registry; ``python -m ci.graftlint`` runs everything
+over ``mxnet_tpu/`` in seconds.  See docs/linting.md for the pass
+catalog, the suppression grammar, and the baseline workflow.
+
+The five historical ``ci/check_*.py`` lint scripts remain as thin shims
+over their migrated passes (:func:`shim_main` preserves their exact
+CLI, output, and exit semantics); ``check_bench_gate`` /
+``check_compile_cache`` stay full scripts but are also exposed as
+orchestrated passes.
+"""
+
+from __future__ import annotations
+
+import sys
+
+from .core import Finding, Pass, RunContext, Source  # noqa: F401 re-export
+from .passes import ALL_PASSES, DEFAULT_PASSES, by_id  # noqa: F401
+from .runner import run, run_pass  # noqa: F401
+
+
+def shim_main(pass_id, argv=(), out=None):
+    """Legacy ``ci/check_<x>.py`` entry semantics over a migrated pass:
+    positional args are scan roots (default: the pass's own), findings
+    print as ``path:line: message``, the summary keeps the historical
+    ``check_<x>: N <noun>`` line, exit status 1 iff violations.
+
+    Baselines do NOT apply here — the old scripts failed on any
+    violation, and the shims must be bit-compatible gates — but both
+    the legacy tags and the unified suppression grammar are honored."""
+    echo = (lambda s: print(s, file=out)) if out is not None \
+        else (lambda s: print(s))  # noqa: print is this tool's output
+    cls = by_id(pass_id)
+    roots = list(argv) or None
+    ctx = RunContext(roots=roots, literal_paths=True)
+    result = run_pass(cls(), ctx, baseline=None)
+    problems = result.active
+    for f in sorted(problems, key=lambda f: (f.path, f.line)):
+        echo("%s:%d: %s" % (f.path, f.line, f.message))
+    if problems:
+        echo("%s: %s" % (cls.legacy_script,
+                         cls.legacy_summary % len(problems)))
+        return 1
+    return 0
+
+
+def main(argv=None):
+    """``python -m ci.graftlint`` — see ``--help``."""
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        prog="python -m ci.graftlint",
+        description="unified static-analysis runner (docs/linting.md)")
+    parser.add_argument("roots", nargs="*",
+                        help="files/dirs to scan (default: each pass's "
+                             "own roots under the repo)")
+    parser.add_argument("--pass", dest="passes", action="append",
+                        metavar="ID",
+                        help="run only this pass (repeatable); "
+                             "orchestrated passes (bench-gate, "
+                             "compile-cache) only run when named here")
+    parser.add_argument("--list", action="store_true",
+                        help="list passes and exit")
+    parser.add_argument("--json", metavar="PATH",
+                        help="write the machine-readable findings "
+                             "report here (the CI artifact)")
+    parser.add_argument("--update-baseline", action="store_true",
+                        help="rewrite the baseline ledger from the "
+                             "current findings and exit 0")
+    parser.add_argument("--prune-baseline", action="store_true",
+                        help="drop stale baseline entries (whose "
+                             "findings no longer fire)")
+    parser.add_argument("--baseline", metavar="PATH",
+                        help="baseline ledger path (default: "
+                             "ci/graftlint/baseline.json)")
+    parser.add_argument("--emit-telemetry", action="store_true",
+                        help="export per-pass finding counts through "
+                             "mxnet_tpu.telemetry (lint.findings gauges)")
+    args = parser.parse_args(argv)
+
+    if args.list:
+        for cls in ALL_PASSES:
+            kind = "orchestrated" if cls.orchestrated else "analysis"
+            print("%-18s %-12s %s" % (cls.id, kind, cls.title))  # noqa: CLI output
+        return 0
+
+    if args.passes:
+        passes = [by_id(p)() for p in args.passes]
+    else:
+        passes = [cls() for cls in DEFAULT_PASSES]
+
+    from . import baseline as _baseline
+
+    kwargs = {}
+    if args.baseline:
+        kwargs["baseline_path"] = args.baseline
+    else:
+        kwargs["baseline_path"] = _baseline.DEFAULT_PATH
+    ctx = RunContext(roots=args.roots or None)
+    return run(passes, ctx=ctx, json_path=args.json,
+               update_baseline=args.update_baseline,
+               prune_baseline=args.prune_baseline,
+               emit_telemetry=args.emit_telemetry, **kwargs)
+
+
+if __name__ == "__main__":  # pragma: no cover - exercised via __main__
+    sys.exit(main())

@@ -1,0 +1,9 @@
+"""``python -m ci.graftlint`` entry point."""
+
+from __future__ import annotations
+
+import sys
+
+from . import main
+
+sys.exit(main())

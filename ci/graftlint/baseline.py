@@ -1,0 +1,73 @@
+"""Per-pass finding baselines.
+
+A new dataflow pass lands with pre-existing findings the team has not
+triaged yet; failing the build on all of them at once would force either
+mass suppressions (noise in the source) or disabling the pass (losing
+it).  The baseline is the middle path: a checked-in JSON ledger of
+*known* findings that do not fail the build but are tracked as lint debt
+(exported per pass through telemetry, see docs/linting.md "Baselines").
+
+Entries are keyed ``(pass, path, code, detail)`` with a count — no line
+numbers, so unrelated edits never invalidate them — and they EXPIRE:
+an entry whose finding no longer fires is reported as stale (fix ratchet)
+and removed by ``--prune-baseline``; ``--update-baseline`` rewrites the
+ledger from the current run.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+
+DEFAULT_PATH = pathlib.Path(__file__).resolve().parent / "baseline.json"
+
+
+def load(path=DEFAULT_PATH):
+    path = pathlib.Path(path)
+    if not path.exists():
+        return {}
+    data = json.loads(path.read_text())
+    out = {}
+    for pass_id, entries in data.get("passes", {}).items():
+        for e in entries:
+            key = (pass_id, e["path"], e["code"], e.get("detail", ""))
+            out[key] = out.get(key, 0) + int(e.get("count", 1))
+    return out
+
+
+def apply(findings, baseline):
+    """Mark up to ``count`` findings per baseline key as baselined.
+    Returns the stale entries: ``{key: unmatched count}`` for ledger
+    entries that no finding consumed (the pass no longer fires there —
+    candidates for pruning)."""
+    remaining = dict(baseline)
+    for f in findings:
+        if f.suppressed is not None:
+            continue
+        k = f.key()
+        if remaining.get(k, 0) > 0:
+            remaining[k] -= 1
+            f.baselined = True
+    return {k: n for k, n in remaining.items() if n > 0}
+
+
+def build(findings):
+    """Baseline dict covering every unsuppressed finding (what
+    ``--update-baseline`` writes)."""
+    out = {}
+    for f in findings:
+        if f.suppressed is None:
+            out[f.key()] = out.get(f.key(), 0) + 1
+    return out
+
+
+def save(baseline, path=DEFAULT_PATH):
+    passes = {}
+    for (pass_id, rel, code, detail), count in sorted(baseline.items()):
+        entry = {"path": rel, "code": code, "count": count}
+        if detail:
+            entry["detail"] = detail
+        passes.setdefault(pass_id, []).append(entry)
+    payload = {"version": 1, "passes": passes}
+    pathlib.Path(path).write_text(json.dumps(payload, indent=2,
+                                             sort_keys=True) + "\n")

@@ -1,0 +1,479 @@
+"""Shared dataflow machinery for the traced-code passes.
+
+Three building blocks the syntactic checkers could never express:
+
+* **traced-function discovery** — the transitive set of functions whose
+  bodies execute under a jax trace: seeds are functions handed to
+  ``jax.jit`` / ``pmap`` / ``vjp`` / ``grad`` / ``lax.scan`` & friends
+  (by name, lambda, or decorator, including ``partial(jax.jit, ...)``),
+  closed over same-module bare-name calls (a helper called from a
+  traced function is traced too — ``sgd_step_math`` from the fused
+  step, ``_nonfinite_expr`` from the guard kinds);
+* **array-taint analysis** (:class:`PurityScan`) — per traced function,
+  which local names are *traced array values*: results of ``jnp.*`` /
+  ``jax.*`` calls, calls into other traced functions, and parameters
+  whose usage proves array-ness (``.astype`` / ``.at`` / arithmetic
+  receivers).  Crucially, values derived through ``.shape`` / ``.ndim``
+  / ``.dtype`` / ``len()`` are *static* — branching on ``x.shape[0]``
+  is trace-time constant folding, not a host sync — so the purity and
+  recompile passes can tell the two apart;
+* small AST utilities (parent links, dotted-chain rendering, enclosing
+  scope walks) shared by the donation and lock passes.
+
+The analysis is deliberately intraprocedural per module and errs toward
+*silence* on ambiguity: a static-analysis gate over a moving framework
+earns trust by being right when it speaks (suppressions and baselines
+absorb the intentional sites; the fixture tests in
+``tests/test_graftlint.py`` pin the precision contract).
+"""
+
+from __future__ import annotations
+
+import ast
+
+#: roots that mark an expression as jax-side (producing traced values /
+#: allowed inside traced code)
+JAX_ROOTS = frozenset({"jax", "jnp", "lax", "jsp"})
+
+#: attribute names whose *access on a parameter* proves the parameter is
+#: an array (the receiver idioms of jax arrays in this codebase)
+ARRAY_PROOF_ATTRS = frozenset({
+    "astype", "at", "T", "reshape", "sum", "mean", "max", "min", "dot",
+    "transpose", "flatten", "ravel", "squeeze", "take", "clip"})
+
+#: attribute reads that yield *static* (trace-time-constant) values even
+#: on a traced array
+STATIC_ATTRS = frozenset({"shape", "ndim", "size", "dtype"})
+
+#: callables that run their function argument under a trace.  Maps the
+#: terminal attribute (or bare name) to the positional indices holding
+#: function arguments (None = just the first).
+TRACE_ENTRY_FUNCS = {
+    "jit": (0,), "pjit": (0,), "pmap": (0,), "vmap": (0,),
+    "grad": (0,), "value_and_grad": (0,), "vjp": (0,), "jvp": (0,),
+    "linearize": (0,), "checkpoint": (0,), "remat": (0,),
+    "scan": (0,), "while_loop": (0, 1), "fori_loop": (2,),
+    "cond": (1, 2), "switch": (1,), "custom_vjp": (0,), "custom_jvp": (0,),
+}
+
+
+class TracedMeta:
+    """Why a function is traced + what its trace entry says about its
+    parameters."""
+
+    __slots__ = ("why", "seed", "statics")
+
+    def __init__(self, why, seed, statics=frozenset()):
+        self.why = why
+        self.seed = seed
+        self.statics = frozenset(statics)
+
+    def __str__(self):
+        return self.why
+
+
+def _static_params(jit_call, func):
+    """Parameter NAMES declared static by ``static_argnums``/
+    ``static_argnames`` on a trace-entry call wrapping ``func``."""
+    names = set()
+    params = func_params(func)
+    for kw in getattr(jit_call, "keywords", []):
+        if kw.arg == "static_argnums":
+            vals = kw.value.elts if isinstance(kw.value, ast.Tuple) \
+                else [kw.value]
+            for v in vals:
+                if isinstance(v, ast.Constant) \
+                        and isinstance(v.value, int) \
+                        and v.value < len(params):
+                    names.add(params[v.value])
+        elif kw.arg == "static_argnames":
+            vals = kw.value.elts if isinstance(
+                kw.value, (ast.Tuple, ast.List)) else [kw.value]
+            for v in vals:
+                if isinstance(v, ast.Constant) \
+                        and isinstance(v.value, str):
+                    names.add(v.value)
+    return frozenset(names)
+
+
+def parent_map(tree):
+    parents = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+    return parents
+
+
+def dotted(node):
+    """Render ``a.b.c`` / plain ``a`` chains; None for anything else
+    (calls, subscripts — chains we cannot track soundly)."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def root_name(node):
+    """Leftmost name of an attribute/subscript chain (``a`` for
+    ``a.b[0].c``), or None."""
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+
+def enclosing_functions(node, parents):
+    """Innermost-first chain of function nodes containing ``node``."""
+    chain = []
+    cur = parents.get(node)
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.Lambda)):
+            chain.append(cur)
+        cur = parents.get(cur)
+    return chain
+
+
+def func_params(func):
+    a = func.args
+    names = [p.arg for p in
+             getattr(a, "posonlyargs", []) + a.args + a.kwonlyargs]
+    if a.vararg:
+        names.append(a.vararg.arg)
+    if a.kwarg:
+        names.append(a.kwarg.arg)
+    return names
+
+
+def _is_partial_call(call):
+    """``partial(jax.jit, ...)`` / ``functools.partial(jax.jit, ...)``."""
+    f = call.func
+    name = f.id if isinstance(f, ast.Name) else \
+        (f.attr if isinstance(f, ast.Attribute) else None)
+    return name == "partial"
+
+
+def _trace_entry_positions(func_expr):
+    """For a call's func expression, the positional indices that take
+    traced functions — or None when this is not a trace entry.
+
+    Matches ``jax.jit`` / ``jax.lax.scan`` / bare ``jit`` (from-import)
+    by terminal name, requiring a jax-ish root for dotted forms so
+    ``self.jit(...)`` or ``threading.local().scan`` never match, but
+    accepting bare names (``from jax import jit``)."""
+    if isinstance(func_expr, ast.Attribute):
+        if func_expr.attr not in TRACE_ENTRY_FUNCS:
+            return None
+        root = root_name(func_expr)
+        if root in JAX_ROOTS or (root or "").startswith("_jax"):
+            return TRACE_ENTRY_FUNCS[func_expr.attr]
+        return None
+    if isinstance(func_expr, ast.Name):
+        if func_expr.id in ("jit", "pjit", "pmap"):
+            return TRACE_ENTRY_FUNCS[func_expr.id]
+    return None
+
+
+def index_for(source):
+    """The (cached) :class:`ModuleIndex` for a ``core.Source`` — the
+    parent map, scope index, and traced-function closure are built once
+    per file per run and shared by every dataflow pass."""
+    idx = getattr(source, "_graftlint_index", None)
+    if idx is None or idx.tree is not source.tree:
+        idx = ModuleIndex(source.tree)
+        source._graftlint_index = idx
+    return idx
+
+
+class ModuleIndex:
+    """Per-module function/scope index + traced-function closure."""
+
+    def __init__(self, tree):
+        self.tree = tree
+        self._scans = {}
+        self.parents = parent_map(tree)
+        # scope node (module/function) -> {name: function node}
+        self.scope_funcs = {tree: {}}
+        self.all_funcs = []
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.all_funcs.append(node)
+                self.scope_funcs.setdefault(node, {})
+                owner = self._owner_scope(node)
+                self.scope_funcs.setdefault(owner, {})[node.name] = node
+            elif isinstance(node, ast.Assign) \
+                    and isinstance(node.value, ast.Lambda):
+                owner = self._owner_scope(node)
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        self.scope_funcs.setdefault(
+                            owner, {})[t.id] = node.value
+        self.traced = self._traced_closure()
+
+    def _owner_scope(self, node):
+        chain = enclosing_functions(node, self.parents)
+        return chain[0] if chain else self.tree
+
+    def resolve_func(self, name, at_node):
+        """A function object ``name`` could mean at ``at_node``'s scope:
+        innermost enclosing function scopes first, then module scope."""
+        for scope in enclosing_functions(at_node, self.parents):
+            got = self.scope_funcs.get(scope, {}).get(name)
+            if got is not None:
+                return got
+        return self.scope_funcs.get(self.tree, {}).get(name)
+
+    def _decorator_traced(self, func):
+        for dec in getattr(func, "decorator_list", []):
+            target = dec.func if isinstance(dec, ast.Call) else dec
+            if isinstance(dec, ast.Call) and _is_partial_call(dec):
+                for arg in dec.args[:1]:
+                    if _trace_entry_positions(arg) is not None:
+                        return True
+            if _trace_entry_positions(target) is not None:
+                return True
+        return False
+
+    def _traced_closure(self):
+        """Seed + transitively close the traced-function set.
+
+        Each entry maps the function node to a :class:`TracedMeta`:
+        *seeds* (handed straight to a trace entry) know their parameters
+        are traced arrays — minus ``static_argnums``/``static_argnames``
+        positions; closure-reached helpers make no such claim (their
+        parameters may be plain Python hyperparameters)."""
+        traced = {}
+
+        def seed(fn_node, why, statics=frozenset()):
+            if isinstance(fn_node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                    ast.Lambda)) and fn_node not in traced:
+                traced[fn_node] = TracedMeta(why, seed=True,
+                                             statics=statics)
+
+        for func in self.all_funcs:
+            if self._decorator_traced(func):
+                statics = frozenset()
+                for dec in func.decorator_list:
+                    if isinstance(dec, ast.Call):
+                        statics |= _static_params(dec, func)
+                seed(func, "decorated with a jax trace entry", statics)
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            positions = _trace_entry_positions(node.func)
+            fn_args = []
+            if positions is not None:
+                fn_args = [node.args[i] for i in positions
+                           if i < len(node.args)]
+            elif _is_partial_call(node) and node.args:
+                if _trace_entry_positions(node.args[0]) is not None:
+                    fn_args = node.args[1:2]
+            for fa in fn_args:
+                if isinstance(fa, ast.Lambda):
+                    seed(fa, "lambda passed to a jax trace entry",
+                         _static_params(node, fa))
+                elif isinstance(fa, ast.Name):
+                    got = self.resolve_func(fa.id, node)
+                    if got is not None:
+                        seed(got, "passed to a jax trace entry",
+                             _static_params(node, got))
+        # transitive closure over same-module bare-name calls
+        work = list(traced)
+        while work:
+            fn = work.pop()
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Call) \
+                        and isinstance(node.func, ast.Name):
+                    callee = self.resolve_func(node.func.id, node)
+                    if callee is not None and callee not in traced:
+                        traced[callee] = TracedMeta(
+                            "called from traced function", seed=False)
+                        work.append(callee)
+        return traced
+
+    def traced_functions(self):
+        """{function node: TracedMeta} for every function whose body
+        runs under a jax trace (directly or transitively)."""
+        return self.traced
+
+    def purity(self, func):
+        """The (cached) :class:`PurityScan` of ``func`` — shared by the
+        tracer-purity and recompile-hazard passes."""
+        scan = self._scans.get(func)
+        if scan is None:
+            scan = self._scans[func] = PurityScan(func, self)
+        return scan
+
+
+class PurityScan:
+    """Array-taint analysis of ONE traced function.
+
+    After construction, ``arrays`` holds local names proven to carry
+    traced array values and ``statics`` holds names proven to carry
+    trace-time-constant values (``.shape``-derived etc.); everything
+    else is unknown and the passes stay silent about it."""
+
+    def __init__(self, func, index, meta=None):
+        self.func = func
+        self.index = index
+        self.params = set(func_params(func))
+        self.arrays = set()
+        self.statics = set()
+        if meta is None:
+            meta = index.traced.get(func)
+        if meta is not None and meta.seed:
+            # a function handed straight to jax.jit/scan/... receives
+            # tracers for every parameter EXCEPT declared statics
+            self.statics.update(p for p in self.params if p in meta.statics)
+            self.arrays.update(p for p in self.params
+                               if p not in meta.statics)
+        self._prove_array_params()
+        # two rounds reach a fixpoint for straight-line + simple loops
+        for _ in range(2):
+            self._propagate()
+
+    # -- classification ---------------------------------------------------
+    def _prove_array_params(self):
+        for node in ast.walk(self.func):
+            if isinstance(node, ast.Attribute) \
+                    and isinstance(node.value, ast.Name) \
+                    and node.value.id in self.params \
+                    and node.attr in ARRAY_PROOF_ATTRS:
+                self.arrays.add(node.value.id)
+
+    def expr_taint(self, expr):
+        """'array' | 'static' | None (unknown) for an expression."""
+        if isinstance(expr, ast.Name):
+            if expr.id in self.arrays:
+                return "array"
+            if expr.id in self.statics:
+                return "static"
+            return None
+        if isinstance(expr, ast.Constant):
+            return "static"
+        if isinstance(expr, ast.Attribute):
+            if expr.attr in STATIC_ATTRS:
+                return "static"
+            inner = self.expr_taint(expr.value)
+            return inner
+        if isinstance(expr, ast.Subscript):
+            return self.expr_taint(expr.value)
+        if isinstance(expr, ast.Call):
+            return self._call_taint(expr)
+        if isinstance(expr, (ast.BinOp, ast.UnaryOp, ast.BoolOp,
+                             ast.Compare, ast.IfExp)):
+            kids = [self.expr_taint(c) for c in ast.iter_child_nodes(expr)
+                    if isinstance(c, ast.expr)]
+            if "array" in kids:
+                return "array"
+            if kids and all(k == "static" for k in kids):
+                return "static"
+            return None
+        if isinstance(expr, (ast.Tuple, ast.List)):
+            kids = [self.expr_taint(e) for e in expr.elts]
+            if "array" in kids:
+                return "array"
+            if kids and all(k == "static" for k in kids):
+                return "static"
+            return None
+        return None
+
+    def _call_taint(self, call):
+        f = call.func
+        if isinstance(f, ast.Name):
+            if f.id in ("len", "int", "float", "bool", "str", "range",
+                        "enumerate", "zip", "min", "max", "abs", "tuple",
+                        "list"):
+                # builtins of static values stay static; of arrays they
+                # are the coercions the purity pass flags separately
+                kids = [self.expr_taint(a) for a in call.args]
+                return "static" if kids and \
+                    all(k == "static" for k in kids) else None
+            target = self.index.resolve_func(f.id, call)
+            if target is not None and target in self.index.traced:
+                # a traced helper returns traced values only when traced
+                # values flow IN — helpers doing trace-time shape/config
+                # math on plain Python scalars stay static-side
+                if any(self.expr_taint(a) == "array" for a in call.args):
+                    return "array"
+                return None
+            return None
+        if isinstance(f, ast.Attribute):
+            root = root_name(f)
+            if root in JAX_ROOTS:
+                return "array"
+            if f.attr in ("item", "tolist", "asnumpy", "asscalar"):
+                return "static"
+            # method call on an array receiver yields an array
+            # (x.astype(...), x.reshape(...), x.sum(...))
+            if self.expr_taint(f.value) == "array":
+                return "array"
+        return None
+
+    # -- propagation ------------------------------------------------------
+    def _assign_targets(self, target, taint):
+        if isinstance(target, ast.Name):
+            if taint == "array":
+                self.arrays.add(target.id)
+                self.statics.discard(target.id)
+            elif taint == "static" and target.id not in self.arrays:
+                self.statics.add(target.id)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for el in target.elts:
+                self._assign_targets(el, taint)
+        elif isinstance(target, ast.Starred):
+            self._assign_targets(target.value, taint)
+
+    def _propagate(self):
+        for node in ast.walk(self.func):
+            if isinstance(node, ast.Assign):
+                taint = self.expr_taint(node.value)
+                for t in node.targets:
+                    self._assign_targets(t, taint)
+            elif isinstance(node, ast.AugAssign):
+                taint = self.expr_taint(node.value)
+                if taint == "array":
+                    self._assign_targets(node.target, taint)
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                self._assign_targets(node.target,
+                                     self.expr_taint(node.value))
+            elif isinstance(node, ast.For):
+                self._assign_targets(node.target,
+                                     self.expr_taint(node.iter))
+            elif isinstance(node, ast.comprehension):
+                self._assign_targets(node.target,
+                                     self.expr_taint(node.iter))
+
+    def names_in(self, expr):
+        return {n.id for n in ast.walk(expr) if isinstance(n, ast.Name)}
+
+    def array_names_in(self, expr):
+        """Array-tainted bare names appearing in ``expr``, EXCLUDING
+        those reached only through a static derivation: ``x.shape`` in a
+        condition is trace-time constant folding, and identity/membership
+        tests (``x is None``, ``id(n) in plan``) never concretize a
+        tracer — only value comparisons and truthiness do."""
+        hits = set()
+
+        def visit(node):
+            if isinstance(node, ast.Attribute) and node.attr in STATIC_ATTRS:
+                return
+            if isinstance(node, ast.Compare) \
+                    and all(isinstance(op, (ast.Is, ast.IsNot, ast.In,
+                                            ast.NotIn))
+                            for op in node.ops):
+                return
+            if isinstance(node, ast.Call):
+                f = node.func
+                if isinstance(f, ast.Name) and f.id == "len":
+                    return
+            if isinstance(node, ast.Name) and node.id in self.arrays:
+                hits.add(node.id)
+            for child in ast.iter_child_nodes(node):
+                visit(child)
+
+        visit(expr)
+        return hits

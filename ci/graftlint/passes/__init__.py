@@ -1,0 +1,44 @@
+"""Pass registry — every pass, in the order the runner executes them.
+
+The five migrated syntactic passes first (cheapest), then the four
+dataflow passes, then the opt-in orchestrated runners (excluded from
+the default set; see their module docstring)."""
+
+from __future__ import annotations
+
+from .bare_except import BareExceptPass
+from .donation import DonationPass
+from .env_docs import EnvDocsPass
+from .host_sync import HostSyncPass
+from .lock_discipline import LockDisciplinePass
+from .orchestrated import BenchGatePass, CompileCachePass
+from .print_call import PrintPass
+from .recompile_hazard import RecompileHazardPass
+from .signal_restore import SignalRestorePass
+from .tracer_purity import TracerPurityPass
+
+ALL_PASSES = (
+    BareExceptPass,
+    PrintPass,
+    EnvDocsPass,
+    HostSyncPass,
+    SignalRestorePass,
+    TracerPurityPass,
+    RecompileHazardPass,
+    DonationPass,
+    LockDisciplinePass,
+    BenchGatePass,
+    CompileCachePass,
+)
+
+#: the default ``python -m ci.graftlint`` set: every source-analysis
+#: pass; orchestrated runners are opt-in by name
+DEFAULT_PASSES = tuple(p for p in ALL_PASSES if not p.orchestrated)
+
+
+def by_id(pass_id):
+    for cls in ALL_PASSES:
+        if cls.id == pass_id:
+            return cls
+    raise KeyError("unknown graftlint pass %r (known: %s)"
+                   % (pass_id, ", ".join(c.id for c in ALL_PASSES)))

@@ -1,0 +1,48 @@
+"""bare-except pass — swallowed exceptions in framework code.
+
+Migrated from ``ci/check_bare_except.py`` (which remains as a thin
+shim): a bare ``except:`` anywhere, or ``except Exception/BaseException:``
+whose whole body is ``pass``/``...``, hides the very errors the
+retry/checkpoint machinery must see (docs/resilience.md).  Legacy
+``# noqa`` on the except line is still honored."""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Pass
+
+BROAD = ("Exception", "BaseException")
+
+
+def _is_swallow(handler):
+    return all(isinstance(st, ast.Pass)
+               or (isinstance(st, ast.Expr)
+                   and isinstance(st.value, ast.Constant)
+                   and st.value.value is Ellipsis)
+               for st in handler.body)
+
+
+class BareExceptPass(Pass):
+    id = "bare-except"
+    title = "no silently-swallowed exceptions"
+    legacy_tags = ("# noqa",)
+    legacy_script = "check_bare_except"
+    legacy_summary = "%d violation(s)"
+
+    def check_source(self, src, ctx):
+        findings = []
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if node.type is None:
+                findings.append(self.find(
+                    src, node, "bare-except", "bare 'except:'"))
+            elif isinstance(node.type, ast.Name) and node.type.id in BROAD \
+                    and _is_swallow(node):
+                findings.append(self.find(
+                    src, node, "swallow",
+                    "'except %s: pass' swallows errors silently (handle "
+                    "it, narrow it, or add '# noqa' with a reason)"
+                    % node.type.id, detail=node.type.id))
+        return findings

@@ -1,0 +1,193 @@
+"""donation pass — no use-after-donate of jit-donated buffers.
+
+``donate_argnums`` hands a buffer's storage to XLA: after the call the
+Python reference still exists but the array is DELETED — touching it
+raises (CPU) or returns garbage semantics.  The DeviceMetric accumulator
+and the fused-update param/momentum paths (PR 4) rely on the rebind
+idiom ``x = f(x)``; until now nothing but hand-audit kept a refactor
+from re-reading a donated buffer.
+
+Analysis (intra-module, intra-function):
+
+1. **bind** — ``g = jax.jit(f, donate_argnums=(0, 2))`` binds the
+   donated positions to the assignment target (plain name or dotted
+   ``self._fused_step`` chain; wrapper calls around the jit —
+   ``instrument(jax.jit(...), ...)`` — are looked through since they
+   preserve the callable's signature);
+2. **call sites** — every later ``g(...)`` in the module: each donated
+   positional argument that is a trackable name/attr-chain is recorded;
+3. **use-after-donate** — a *load* of that exact chain after the call
+   (same function, statement order), before any rebinding store, is
+   flagged.  ``x = g(x)`` is safe (the store rebinds at the call
+   statement); ``y = g(x); z = x + 1`` is the bug.
+
+Loop-carried reuse (donating in iteration ``i`` a buffer read at the top
+of iteration ``i+1``) is out of scope for the line-ordered analysis and
+stays the fused-step's documented manual audit."""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Pass
+from ..dataflow import dotted, enclosing_functions, parent_map
+
+
+def _jit_donations(expr):
+    """The ``donate_argnums`` positions of a ``jax.jit`` call anywhere
+    inside ``expr`` (wrappers looked through), or None."""
+    for node in ast.walk(expr):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        is_jit = (isinstance(f, ast.Attribute) and f.attr in
+                  ("jit", "pjit")) or \
+                 (isinstance(f, ast.Name) and f.id in ("jit", "pjit"))
+        if not is_jit:
+            continue
+        for kw in node.keywords:
+            if kw.arg != "donate_argnums":
+                continue
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                return (v.value,)
+            if isinstance(v, (ast.Tuple, ast.List)):
+                out = tuple(e.value for e in v.elts
+                            if isinstance(e, ast.Constant)
+                            and isinstance(e.value, int))
+                if out:
+                    return out
+    return None
+
+
+class _ChainEvents(ast.NodeVisitor):
+    """All loads/stores of dotted chains within one function, in source
+    order; subscript stores (``x[0] = ...``) count as loads of the base
+    chain (they touch the donated storage)."""
+
+    def __init__(self, func):
+        self.events = []  # (lineno, col, 'load'|'store', chain)
+        self._nested_depth = 0
+        self._func = func
+        self.visit(func)
+
+    def visit_FunctionDef(self, node):
+        if node is self._func:
+            self.generic_visit(node)
+        # nested defs: their bodies run at unknowable times — skip
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def _record(self, node, kind):
+        chain = dotted(node)
+        if chain:
+            self.events.append((node.lineno, node.col_offset, kind, chain))
+
+    def visit_Name(self, node):
+        kind = "store" if isinstance(node.ctx, (ast.Store, ast.Del)) \
+            else "load"
+        self.events.append((node.lineno, node.col_offset, kind, node.id))
+
+    def visit_Attribute(self, node):
+        kind = "store" if isinstance(node.ctx, (ast.Store, ast.Del)) \
+            else "load"
+        self._record(node, kind)
+        # do not descend: `self._acc` should not also record `self`
+
+    def visit_Subscript(self, node):
+        # x[0] = v writes THROUGH x: the donated storage is touched
+        self._record(node.value, "load")
+        self.visit(node.slice)
+
+
+class DonationPass(Pass):
+    id = "donation"
+    title = "no use-after-donate of donated buffers"
+
+    def check_source(self, src, ctx):
+        findings = []
+        parents = parent_map(src.tree)
+
+        donors = {}  # chain -> donated positions
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.Assign):
+                positions = _jit_donations(node.value)
+                if positions:
+                    for t in node.targets:
+                        chain = dotted(t)
+                        if chain:
+                            donors[chain] = positions
+        if not donors:
+            return findings
+
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = dotted(node.func)
+            if chain not in donors:
+                continue
+            encl = enclosing_functions(node, parents)
+            if not encl:
+                continue
+            func = encl[0]
+            events = _ChainEvents(func).events
+            stmt = self._stmt_of(node, parents)
+            stmt_end = max((n.end_lineno or n.lineno)
+                           for n in ast.walk(stmt)
+                           if hasattr(n, "lineno"))
+            for pos in donors[chain]:
+                if pos >= len(node.args):
+                    continue
+                donated = dotted(node.args[pos])
+                if donated is None:
+                    continue
+                if donated in self._assign_target_chains(stmt):
+                    continue  # x = f(x): rebound at the call statement
+                after = sorted(e for e in events
+                               if e[3] == donated and e[0] > stmt_end)
+                for lineno, _col, kind, _chain in after:
+                    if kind == "store":
+                        break  # rebound: later loads see the new buffer
+                    findings.append(self.find(
+                        src, lineno, "use-after-donate",
+                        "%r is read here after being DONATED to %r at "
+                        "line %d (donate_argnums position %d) — the "
+                        "buffer no longer exists; rebind the result "
+                        "(x = f(x)) or drop the donation"
+                        % (donated, chain, node.lineno, pos),
+                        detail=donated))
+                    break  # one report per donated arg per call
+
+        return findings
+
+    def _assign_target_chains(self, stmt):
+        """Chains rebound by the statement itself (``x = f(x)`` and the
+        tuple/attr variants) — those loads-after see the NEW buffer."""
+        targets = []
+        if isinstance(stmt, ast.Assign):
+            targets = stmt.targets
+        elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+            targets = [stmt.target]
+        chains = set()
+
+        def add(t):
+            if isinstance(t, (ast.Tuple, ast.List)):
+                for el in t.elts:
+                    add(el)
+            elif isinstance(t, ast.Starred):
+                add(t.value)
+            else:
+                c = dotted(t)
+                if c:
+                    chains.add(c)
+
+        for t in targets:
+            add(t)
+        return chains
+
+    def _stmt_of(self, node, parents):
+        cur = node
+        while parents.get(cur) is not None \
+                and not isinstance(cur, ast.stmt):
+            cur = parents[cur]
+        return cur

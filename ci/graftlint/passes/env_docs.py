@@ -1,0 +1,53 @@
+"""env-docs pass — every ``MXNET_*`` env var read must be documented.
+
+Migrated from ``ci/check_env_docs.py`` (thin shim remains).  Any whole
+string constant shaped like an env var name must appear verbatim in
+``docs/how_to/env_var.md``; prose in docstrings/comments never counts
+(AST constants only).  Legacy ``# noqa`` honored."""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from ..core import Pass
+
+ENV_RE = re.compile(r"^MXNET_[A-Z][A-Z0-9_]*$")
+
+#: string constants that are NOT env vars: the reference's C macros
+NOT_ENV = frozenset({
+    "MXNET_REGISTER_NDARRAY_FUN",
+    "MXNET_REGISTER_IMAGE_AUGMENTER",
+})
+
+
+class EnvDocsPass(Pass):
+    id = "env-docs"
+    title = "MXNET_* env var reads are documented"
+    legacy_tags = ("# noqa",)
+    legacy_script = "check_env_docs"
+    legacy_summary = "%d undocumented env var read(s)"
+
+    def run(self, sources, ctx):
+        doc = ctx.env_doc_path
+        documented = doc.read_text() if doc.exists() else ""
+        findings = []
+        for src in sources:
+            if src.syntax_error is not None:
+                e = src.syntax_error
+                findings.append(self.find(
+                    src, e.lineno or 0, "syntax-error",
+                    "SYNTAX ERROR: %s" % e.msg))
+                continue
+            for node in ast.walk(src.tree):
+                if isinstance(node, ast.Constant) \
+                        and isinstance(node.value, str) \
+                        and ENV_RE.match(node.value) \
+                        and node.value not in NOT_ENV:
+                    if not re.search(r"\b%s\b" % re.escape(node.value),
+                                     documented):
+                        findings.append(self.find(
+                            src, node, "undocumented",
+                            "env var %s is read here but missing from %s"
+                            % (node.value, doc), detail=node.value))
+        return findings

@@ -1,0 +1,62 @@
+"""host-sync pass — no host-synchronizing calls in the fit hot path.
+
+Migrated from ``ci/check_host_sync.py`` (thin shim remains).  The
+sync-free fit loop (docs/how_to/perf.md) must never block the host on
+device results in steady state; one stray blocking device→host copy
+reintroduces a per-batch round trip no test catches.  Flagged shapes:
+
+* ``<expr>.asnumpy()`` / ``.asscalar()`` / ``.item()`` / ``.tolist()``
+  (the last two joined the list with the graftlint migration — same
+  blocking transfer, different spelling)
+* ``np.asarray(...)`` / ``_np.asarray(...)`` / ``numpy.asarray(...)``
+
+Legacy ``# host-sync: ok <reason>`` tags are still honored, alongside
+the unified ``# lint: ok[host-sync] <reason>`` grammar."""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Pass
+
+_NUMPY_NAMES = frozenset({"np", "_np", "numpy"})
+_SYNC_METHODS = ("asnumpy", "asscalar", "item", "tolist")
+
+
+def sync_call_shape(node):
+    """The flagged shape for a call node, or None."""
+    func = node.func
+    if not isinstance(func, ast.Attribute):
+        return None
+    if func.attr in _SYNC_METHODS:
+        return ".%s()" % func.attr
+    if func.attr == "asarray" and isinstance(func.value, ast.Name) \
+            and func.value.id in _NUMPY_NAMES:
+        return "%s.asarray(...)" % func.value.id
+    return None
+
+
+class HostSyncPass(Pass):
+    id = "host-sync"
+    title = "fit/step hot path stays sync-free"
+    default_roots = ("mxnet_tpu/module", "mxnet_tpu/executor.py",
+                     "mxnet_tpu/metric.py")
+    excluded_files = frozenset({"python_module.py"})
+    legacy_tags = ("# host-sync: ok",)
+    legacy_script = "check_host_sync"
+    legacy_summary = "%d violation(s)"
+
+    def check_source(self, src, ctx):
+        findings = []
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            what = sync_call_shape(node)
+            if what is None:
+                continue
+            findings.append(self.find(
+                src, node, "host-sync",
+                "%s in a fit/step hot-path module blocks the host on "
+                "device results (tag the line '# host-sync: ok <reason>' "
+                "if the sync is the point)" % what, detail=what))
+        return findings

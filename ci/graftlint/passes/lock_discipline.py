@@ -1,0 +1,413 @@
+"""lock-discipline pass — inferred guard sets, enforced at every access.
+
+The ~20 threaded modules (checkpoint writer, serving batcher, kvstore
+server, compile cache, telemetry registry, ...) share one convention:
+a ``threading.Lock``/``Condition`` attribute guards a set of mutable
+attributes, and every cross-thread access holds it.  Nothing checked
+that convention — a refactor that touches ``self._queue`` outside
+``with self._cond:`` races silently until a production box loses a
+request.  This pass *infers* the guard sets instead of asking for
+annotations:
+
+1. a lock attribute is any ``self.X = threading.Lock()/RLock()/
+   Condition()/Semaphore()`` assignment (module-level ``_lock =
+   threading.Lock()`` analogs too);
+2. an attribute is **guarded by X** when it is accessed inside a
+   ``with self.X:`` block anywhere in the class AND written outside
+   ``__init__`` (mutable shared state — read-only config like
+   ``self.name`` never enters the guard set);
+3. violations:
+
+   * **unlocked-write** — a guarded attribute is written without the
+     lock in any method other than ``__init__``/``__del__``;
+   * **thread-unlocked-read** — a guarded attribute is read without
+     the lock inside a thread body (a method reached from
+     ``Thread(target=self.m)``, transitively through self-calls);
+   * **thread-shared-unguarded** — an attribute written (unlocked,
+     un-guarded) inside a thread body and also touched by non-thread
+     methods: shared state with NO inferred guard at all, the
+     "forgot the lock entirely" case;
+   * **module-unlocked-write** — the module-level analog of
+     unlocked-write for globals mutated under ``with _lock:``
+     elsewhere (rebinds via ``global`` and stores *through* the object
+     — ``_counters[k] = v`` — both count).
+
+Lexical scoping approximation: code inside a nested function defined
+under ``with`` is treated as lock-held (the ``wait_for(lambda: ...)``
+idiom); a nested closure stored and called later outside the lock would
+be missed — none exist in tree today."""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Pass
+from ..dataflow import root_name
+
+LOCK_FACTORIES = frozenset({"Lock", "RLock", "Condition", "Semaphore",
+                            "BoundedSemaphore"})
+
+#: methods whose accesses run before/after any thread can exist
+EXEMPT_METHODS = frozenset({"__init__", "__del__"})
+
+
+def _is_lock_factory(expr):
+    return isinstance(expr, ast.Call) \
+        and isinstance(expr.func, ast.Attribute) \
+        and expr.func.attr in LOCK_FACTORIES \
+        and "threading" in (root_name(expr.func) or "")
+
+
+class _Access:
+    __slots__ = ("attr", "line", "store", "held", "method", "is_call")
+
+    def __init__(self, attr, line, store, held, method, is_call):
+        self.attr = attr
+        self.line = line
+        self.store = store
+        self.held = held        # frozenset of lock names held (lexical)
+        self.method = method
+        self.is_call = is_call  # self.m(...) method invocation
+
+
+class _ClassScan:
+    def __init__(self, cls):
+        self.cls = cls
+        self.methods = {n.name: n for n in cls.body
+                        if isinstance(n, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef))}
+        self.lock_attrs = set()
+        for m in self.methods.values():
+            for node in ast.walk(m):
+                if isinstance(node, ast.Assign) \
+                        and _is_lock_factory(node.value):
+                    for t in node.targets:
+                        if isinstance(t, ast.Attribute) \
+                                and isinstance(t.value, ast.Name) \
+                                and t.value.id == "self":
+                            self.lock_attrs.add(t.attr)
+        self.accesses = []
+        self.calls = {}  # method -> set of self-methods it calls
+        if self.lock_attrs:
+            for name, m in self.methods.items():
+                self._walk(m, name)
+        self.thread_bodies = self._thread_bodies()
+        self.method_held = self._infer_held_helpers()
+
+    def _infer_held_helpers(self):
+        """Lock-held helper inference: a method whose EVERY call site
+        holds lock L runs with L held — ``_sync_env``-style helpers
+        documented "call with the lock held" need no suppression.
+        Thread entry points have no visible call sites and never
+        qualify."""
+        held = {}
+        for _ in range(3):  # helpers calling helpers: small fixpoint
+            changed = False
+            for name in self.methods:
+                if name in self.thread_bodies or name in held:
+                    continue
+                sites = [a for a in self.accesses
+                         if a.is_call and a.attr == name]
+                if not sites:
+                    continue
+                common = None
+                for a in sites:
+                    site_held = a.held | held.get(a.method, frozenset())
+                    common = site_held if common is None \
+                        else (common & site_held)
+                if common:
+                    held[name] = frozenset(common)
+                    changed = True
+            if not changed:
+                break
+        return held
+
+    def effective_held(self, access):
+        return access.held | self.method_held.get(access.method,
+                                                  frozenset())
+
+    # -- access collection ------------------------------------------------
+    def _with_locks(self, withnode):
+        out = set()
+        for item in withnode.items:
+            ce = item.context_expr
+            if isinstance(ce, ast.Attribute) \
+                    and isinstance(ce.value, ast.Name) \
+                    and ce.value.id == "self" \
+                    and ce.attr in self.lock_attrs:
+                out.add(ce.attr)
+        return out
+
+    def _walk(self, method, mname):
+        calls = self.calls.setdefault(mname, set())
+
+        def visit(node, held):
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                inner = held | self._with_locks(node)
+                for item in node.items:
+                    visit(item.context_expr, held)
+                for child in node.body:
+                    visit(child, inner)
+                return
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and isinstance(node.func.value, ast.Name) \
+                    and node.func.value.id == "self":
+                # self.m(...): record as a call (not a state touch) and
+                # descend into the arguments only
+                calls.add(node.func.attr)
+                self.accesses.append(_Access(
+                    node.func.attr, node.lineno, False, frozenset(held),
+                    mname, True))
+                for child in list(node.args) + \
+                        [kw.value for kw in node.keywords]:
+                    visit(child, held)
+                return
+            if isinstance(node, ast.Attribute) \
+                    and isinstance(node.value, ast.Name) \
+                    and node.value.id == "self" \
+                    and node.attr not in self.lock_attrs:
+                store = isinstance(node.ctx, (ast.Store, ast.Del))
+                self.accesses.append(_Access(
+                    node.attr, node.lineno, store, frozenset(held),
+                    mname, False))
+            if isinstance(node, ast.Subscript):
+                # self.x[k] = v stores THROUGH self.x: record the write
+                base = node.value
+                if isinstance(node.ctx, (ast.Store, ast.Del)) \
+                        and isinstance(base, ast.Attribute) \
+                        and isinstance(base.value, ast.Name) \
+                        and base.value.id == "self":
+                    self.accesses.append(_Access(
+                        base.attr, node.lineno, True, frozenset(held),
+                        mname, False))
+            for child in ast.iter_child_nodes(node):
+                visit(child, held)
+
+        visit(method, set())
+
+    # -- thread-body discovery --------------------------------------------
+    def _thread_bodies(self):
+        seeds = set()
+        for m in self.methods.values():
+            for node in ast.walk(m):
+                if not (isinstance(node, ast.Call)
+                        and (
+                            (isinstance(node.func, ast.Attribute)
+                             and node.func.attr == "Thread")
+                            or (isinstance(node.func, ast.Name)
+                                and node.func.id == "Thread"))):
+                    continue
+                for kw in node.keywords:
+                    if kw.arg != "target":
+                        continue
+                    t = kw.value
+                    if isinstance(t, ast.Attribute) \
+                            and isinstance(t.value, ast.Name) \
+                            and t.value.id == "self" \
+                            and t.attr in self.methods:
+                        seeds.add(t.attr)
+        # transitive: self-methods called from a thread body run on it
+        work = list(seeds)
+        while work:
+            m = work.pop()
+            for callee in self.calls.get(m, ()):
+                if callee in self.methods and callee not in seeds:
+                    seeds.add(callee)
+                    work.append(callee)
+        return seeds
+
+
+class LockDisciplinePass(Pass):
+    id = "lock-discipline"
+    title = "inferred lock/attribute guard sets are respected"
+
+    def check_source(self, src, ctx):
+        findings = []
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.ClassDef):
+                findings.extend(self._check_class(src, node))
+        findings.extend(self._check_module(src))
+        return findings
+
+    # -- class level ------------------------------------------------------
+    def _check_class(self, src, cls):
+        scan = _ClassScan(cls)
+        if not scan.lock_attrs:
+            return []
+        state_accesses = [a for a in scan.accesses
+                         if not a.is_call and a.attr not in scan.methods]
+        written = {a.attr for a in state_accesses
+                   if a.store and a.method not in EXEMPT_METHODS}
+        guarded = {}  # attr -> set of locks seen guarding it
+        for a in state_accesses:
+            held = scan.effective_held(a)
+            if held and a.attr in written:
+                guarded.setdefault(a.attr, set()).update(held)
+
+        findings = []
+        reported = set()
+
+        def emit(a, code, msg):
+            key = (a.line, code, a.attr)
+            if key in reported:
+                return
+            reported.add(key)
+            findings.append(self.find(
+                src, a.line, code, msg,
+                detail="%s.%s" % (cls.name, a.attr)))
+
+        for a in state_accesses:
+            if a.method in EXEMPT_METHODS or scan.effective_held(a):
+                continue
+            locks = guarded.get(a.attr)
+            if locks:
+                lockname = "/".join("self.%s" % n for n in sorted(locks))
+                if a.store:
+                    emit(a, "unlocked-write",
+                         "self.%s is written in %s.%s() without holding "
+                         "%s, which guards it elsewhere in the class"
+                         % (a.attr, cls.name, a.method, lockname))
+                elif a.method in scan.thread_bodies:
+                    emit(a, "thread-unlocked-read",
+                         "self.%s is read on the %s.%s() thread without "
+                         "holding %s, which guards it elsewhere — the "
+                         "read can see a torn/stale value"
+                         % (a.attr, cls.name, a.method, lockname))
+        # attributes shared with a thread but never guarded at all
+        unguarded_thread_writes = [
+            a for a in state_accesses
+            if a.store and not scan.effective_held(a)
+            and a.attr not in guarded
+            and a.method in scan.thread_bodies]
+        for a in unguarded_thread_writes:
+            elsewhere = [b for b in state_accesses
+                         if b.attr == a.attr
+                         and b.method not in scan.thread_bodies
+                         and b.method not in EXEMPT_METHODS]
+            if elsewhere:
+                emit(a, "thread-shared-unguarded",
+                     "self.%s is written on the %s.%s() thread and "
+                     "accessed from %s with no lock association at all "
+                     "— give it a guard (any consistent lock) or make "
+                     "the hand-off explicit"
+                     % (a.attr, cls.name, a.method,
+                        ", ".join(sorted({"%s()" % b.method
+                                          for b in elsewhere}))))
+        return findings
+
+    # -- module level -----------------------------------------------------
+    def _check_module(self, src):
+        tree = src.tree
+        module_locks = set()
+        module_globals = set()
+        for stmt in tree.body:
+            if isinstance(stmt, ast.Assign):
+                for t in stmt.targets:
+                    if isinstance(t, ast.Name):
+                        module_globals.add(t.id)
+                        if _is_lock_factory(stmt.value):
+                            module_locks.add(t.id)
+        if not module_locks:
+            return []
+
+        funcs = [n for n in ast.walk(tree)
+                 if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+        func_names = {f.name for f in funcs}
+        events = []  # (lineno, func, global, 'write'|'read', held)
+        call_sites = []  # (caller, callee, held)
+
+        for func in funcs:
+            declared_global = {n for node in ast.walk(func)
+                               if isinstance(node, ast.Global)
+                               for n in node.names}
+            local_stores = {n.id for n in ast.walk(func)
+                            if isinstance(n, ast.Name)
+                            and isinstance(n.ctx, ast.Store)
+                            and n.id not in declared_global}
+
+            def visit(node, held, func=func,
+                      declared_global=declared_global,
+                      local_stores=local_stores):
+                if isinstance(node, (ast.With, ast.AsyncWith)):
+                    inner = set(held)
+                    for item in node.items:
+                        ce = item.context_expr
+                        if isinstance(ce, ast.Name) \
+                                and ce.id in module_locks:
+                            inner.add(ce.id)
+                    for child in node.body:
+                        visit(child, inner)
+                    return
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)) \
+                        and node is not func:
+                    return  # nested defs handled as their own func
+                if isinstance(node, ast.Call) \
+                        and isinstance(node.func, ast.Name) \
+                        and node.func.id in func_names:
+                    call_sites.append((func.name, node.func.id,
+                                       frozenset(held)))
+                if isinstance(node, ast.Subscript) \
+                        and isinstance(node.ctx, (ast.Store, ast.Del)):
+                    root = root_name(node.value)
+                    if root in module_globals \
+                            and root not in local_stores:
+                        events.append((node.lineno, func.name, root,
+                                       "write", frozenset(held)))
+                if isinstance(node, ast.Name):
+                    if node.id in declared_global \
+                            and isinstance(node.ctx, ast.Store):
+                        events.append((node.lineno, func.name, node.id,
+                                       "write", frozenset(held)))
+                for child in ast.iter_child_nodes(node):
+                    visit(child, held)
+
+            visit(func, set())
+
+        # lock-held helper inference (module analog of the class rule):
+        # a function whose every call site holds _lock runs with it held
+        fn_held = {}
+        for _ in range(3):
+            changed = False
+            for name in func_names:
+                if name in fn_held:
+                    continue
+                sites = [(caller, held) for caller, callee, held
+                         in call_sites if callee == name]
+                if not sites:
+                    continue
+                common = None
+                for caller, held in sites:
+                    site_held = held | fn_held.get(caller, frozenset())
+                    common = site_held if common is None \
+                        else (common & site_held)
+                if common:
+                    fn_held[name] = frozenset(common)
+                    changed = True
+            if not changed:
+                break
+
+        guarded = {}
+        for _ln, fn, name, kind, held in events:
+            if kind == "write" and (held | fn_held.get(fn, frozenset())):
+                guarded.setdefault(name, set()).update(
+                    held | fn_held.get(fn, frozenset()))
+
+        findings = []
+        reported = set()
+        for ln, fn, name, kind, held in events:
+            if kind != "write" or name not in guarded \
+                    or (held | fn_held.get(fn, frozenset())):
+                continue
+            key = (ln, name)
+            if key in reported:
+                continue
+            reported.add(key)
+            locks = "/".join(sorted(guarded[name]))
+            findings.append(self.find(
+                src, ln, "module-unlocked-write",
+                "module global %r is mutated in %s() without holding "
+                "%s, which guards it elsewhere in the module"
+                % (name, fn, locks), detail=name))
+        return findings

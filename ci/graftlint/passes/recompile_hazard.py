@@ -1,0 +1,297 @@
+"""recompile-hazard pass — build-time detection of retrace/recompile churn.
+
+PR 6's recompilation detector and PR 7's HLO fingerprinting catch churn
+*at runtime*, after the cost is paid; this pass is their build-time
+complement.  Flagged hazards:
+
+* **jit-in-loop** — ``jax.jit(...)`` constructed inside a ``for``/
+  ``while`` body builds a NEW jitted callable (and cache entry) every
+  iteration; hoist the jit and loop over calls;
+* **mutable closure** — a traced function reading a mutable module
+  global (one rebound elsewhere or declared ``global`` in a function)
+  or an instance attribute (``self.x``): the value is baked at trace
+  time, so mutate-and-call either silently uses the stale value or —
+  when the caller rebuilds per value — recompiles every time;
+* **unstable statics** — ``static_argnums``/``static_argnames`` that
+  are computed (not literal), or call sites passing unhashable
+  list/dict/set literals at static positions: each distinct (or
+  unhashable) static raises or retraces;
+* **param-shape** — a plain Python parameter of a traced function
+  flowing into a shape argument (``jnp.zeros((n, 4))``, ``reshape(n)``)
+  specializes the program per VALUE: every new ``n`` is a full
+  retrace+compile.  Values derived from ``x.shape`` are static per
+  *shape* (the normal, intended specialization) and never flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Pass
+from ..dataflow import (dotted, func_params, index_for, root_name,
+                        _trace_entry_positions)
+
+#: jnp constructors whose FIRST positional (or ``shape=``) argument is a
+#: shape
+SHAPE_FIRST_ARG = frozenset({
+    "zeros", "ones", "full", "empty", "eye", "tri", "arange", "linspace",
+    "broadcast_to", "tile"})
+
+
+def _is_jit_call(node):
+    if not isinstance(node, ast.Call):
+        return False
+    pos = _trace_entry_positions(node.func)
+    if pos is None:
+        return False
+    term = node.func.attr if isinstance(node.func, ast.Attribute) \
+        else node.func.id
+    return term in ("jit", "pjit", "pmap")
+
+
+def _names_excluding_static(expr):
+    """Bare names in ``expr``, skipping subtrees under static
+    derivations (``x.shape``/``x.ndim``/``len(...)``) — a shape built
+    from another array's shape is the intended specialization."""
+    from ..dataflow import STATIC_ATTRS
+
+    hits = set()
+
+    def visit(node):
+        if isinstance(node, ast.Attribute) and node.attr in STATIC_ATTRS:
+            return
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Name) \
+                and node.func.id == "len":
+            return
+        if isinstance(node, ast.Name):
+            hits.add(node.id)
+        for child in ast.iter_child_nodes(node):
+            visit(child)
+
+    visit(expr)
+    return hits
+
+
+def _literal_static(node):
+    """True when a static_argnums/argnames value is a hashable literal."""
+    if isinstance(node, ast.Constant):
+        return True
+    if isinstance(node, ast.Tuple):
+        return all(_literal_static(e) for e in node.elts)
+    return False
+
+
+class RecompileHazardPass(Pass):
+    id = "recompile-hazard"
+    title = "no build-time recompile hazards in traced code"
+
+    def check_source(self, src, ctx):
+        findings = []
+        index = index_for(src)
+        parents = index.parents
+        mutable_globals = self._mutable_globals(src.tree)
+
+        for node in ast.walk(src.tree):
+            if not _is_jit_call(node):
+                continue
+            # R1: jit constructed inside a loop
+            cur = parents.get(node)
+            while cur is not None and not isinstance(
+                    cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Module)):
+                if isinstance(cur, (ast.For, ast.While)):
+                    findings.append(self.find(
+                        src, node, "jit-in-loop",
+                        "jax.jit constructed inside a loop builds a new "
+                        "jitted callable (and compile-cache entry) every "
+                        "iteration — hoist the jit out of the loop"))
+                    break
+                cur = parents.get(cur)
+            # R3: computed statics
+            for kw in node.keywords:
+                if kw.arg in ("static_argnums", "static_argnames") \
+                        and not _literal_static(kw.value):
+                    findings.append(self.find(
+                        src, kw.value, "computed-statics",
+                        "%s computed at runtime — static positions that "
+                        "drift between builds silently key new compile-"
+                        "cache entries; use a literal tuple" % kw.arg,
+                        detail=kw.arg))
+
+        findings.extend(self._static_call_sites(src, index))
+
+        for func, why in index.traced_functions().items():
+            findings.extend(self._check_traced(
+                src, func, why, index, mutable_globals))
+        return findings
+
+    # -- R2 helpers -------------------------------------------------------
+    def _mutable_globals(self, tree):
+        """Module-level names that are rebound after their first binding
+        (multiple module-level stores, AugAssign, or a ``global``
+        declaration inside any function)."""
+        stores = {}
+        mutable = set()
+        for stmt in tree.body:
+            for node in ast.walk(stmt):
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    for inner in ast.walk(node):
+                        if isinstance(inner, ast.Global):
+                            mutable.update(inner.names)
+                    break
+        for stmt in tree.body:
+            targets = []
+            if isinstance(stmt, ast.Assign):
+                targets = stmt.targets
+            elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+                targets = [stmt.target]
+                if isinstance(stmt, ast.AugAssign):
+                    for t in targets:
+                        if isinstance(t, ast.Name):
+                            mutable.add(t.id)
+            for t in targets:
+                if isinstance(t, ast.Name):
+                    stores[t.id] = stores.get(t.id, 0) + 1
+        mutable.update(n for n, c in stores.items() if c > 1)
+        return mutable
+
+    def _check_traced(self, src, func, why, index, mutable_globals):
+        findings = []
+        fname = getattr(func, "name", "<lambda>")
+        scan = index.purity(func)
+        params = set(func_params(func))
+        local_names = set(params)
+        for node in ast.walk(func):
+            if isinstance(node, ast.Name) \
+                    and isinstance(node.ctx, ast.Store):
+                local_names.add(node.id)
+        seen = set()
+        nested = {n for inner in ast.walk(func)
+                  if isinstance(inner, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef))
+                  and inner is not func
+                  for n in ast.walk(inner)}
+        for node in ast.walk(func):
+            if node in nested:
+                continue
+            # R2a: mutable module global read inside traced code
+            if isinstance(node, ast.Name) \
+                    and isinstance(node.ctx, ast.Load) \
+                    and node.id in mutable_globals \
+                    and node.id not in local_names \
+                    and ("global", node.id) not in seen:
+                seen.add(("global", node.id))
+                findings.append(self.find(
+                    src, node, "mutable-closure",
+                    "traced function %r reads mutable module global %r "
+                    "— its value is baked at trace time; rebinding it "
+                    "either goes unseen or forces a retrace per value"
+                    % (fname, node.id), detail=node.id))
+            # R2b: instance attribute read inside traced code
+            if isinstance(node, ast.Attribute) \
+                    and isinstance(node.ctx, ast.Load) \
+                    and isinstance(node.value, ast.Name) \
+                    and node.value.id == "self" \
+                    and ("self", node.attr) not in seen:
+                seen.add(("self", node.attr))
+                findings.append(self.find(
+                    src, node, "mutable-closure",
+                    "traced function %r closes over instance attribute "
+                    "%r — the attribute's value at trace time is baked "
+                    "into the program (pass it as an argument instead)"
+                    % (fname, "self." + node.attr),
+                    detail="self." + node.attr))
+            # R4: plain parameter in a shape position
+            if isinstance(node, ast.Call):
+                for shape_expr in self._shape_args(node):
+                    # declared statics (static_argnums) are the *intended*
+                    # per-value specialization and stay silent; everything
+                    # else — plain Python params of helpers, tracer params
+                    # of seeds — retraces per value (or concretizes)
+                    hot = {n for n in _names_excluding_static(shape_expr)
+                           if n in params and n not in scan.statics}
+                    if hot and ("shape", node.lineno) not in seen:
+                        seen.add(("shape", node.lineno))
+                        findings.append(self.find(
+                            src, node, "param-shape",
+                            "Python parameter(s) %s of traced function "
+                            "%r flow into a shape argument — every "
+                            "distinct value retraces and recompiles "
+                            "(derive shapes from x.shape, or mark the "
+                            "parameter static and accept the "
+                            "specialization)"
+                            % (", ".join(sorted(hot)), fname),
+                            detail=",".join(sorted(hot))))
+        return findings
+
+    def _shape_args(self, call):
+        """Expressions sitting in shape positions of ``call``."""
+        f = call.func
+        out = []
+        if isinstance(f, ast.Attribute):
+            root = root_name(f)
+            if f.attr in SHAPE_FIRST_ARG and root in ("jnp", "np", "_np",
+                                                      "numpy", "jax"):
+                if call.args:
+                    out.append(call.args[0])
+                if f.attr in ("arange", "linspace"):
+                    out.extend(call.args[1:])
+            elif f.attr == "reshape":
+                # jnp.reshape(x, shape) or x.reshape(...)
+                out.extend(call.args[1:] if root in ("jnp", "np", "_np",
+                                                     "numpy")
+                           else call.args)
+            for kw in call.keywords:
+                if kw.arg in ("shape", "new_shape"):
+                    out.append(kw.value)
+        return out
+
+    # -- R3 call-site arm -------------------------------------------------
+    def _static_call_sites(self, src, index):
+        """Bind ``g = jax.jit(f, static_argnums=(k,))`` and flag calls
+        ``g(...)`` passing unhashable literals at static positions."""
+        findings = []
+        bound = {}  # dotted chain -> set of static positions
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Assign):
+                continue
+            jit = next((c for c in ast.walk(node.value)
+                        if _is_jit_call(c)), None)
+            if jit is None:
+                continue
+            positions = set()
+            for kw in jit.keywords:
+                if kw.arg == "static_argnums" \
+                        and _literal_static(kw.value):
+                    vals = kw.value.elts \
+                        if isinstance(kw.value, ast.Tuple) else [kw.value]
+                    positions.update(v.value for v in vals
+                                     if isinstance(v, ast.Constant)
+                                     and isinstance(v.value, int))
+            if not positions:
+                continue
+            for t in node.targets:
+                chain = dotted(t)
+                if chain:
+                    bound[chain] = positions
+        if not bound:
+            return findings
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = dotted(node.func)
+            if chain not in bound:
+                continue
+            for i in bound[chain]:
+                if i < len(node.args) and isinstance(
+                        node.args[i], (ast.List, ast.Dict, ast.Set)):
+                    findings.append(self.find(
+                        src, node.args[i], "unhashable-static",
+                        "unhashable %s literal passed at static position "
+                        "%d of %r — jit statics must be hashable (use a "
+                        "tuple)" % (type(node.args[i]).__name__.lower(),
+                                    i, chain),
+                        detail="%s[%d]" % (chain, i)))
+        return findings

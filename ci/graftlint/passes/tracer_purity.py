@@ -1,0 +1,186 @@
+"""tracer-purity pass — no host coercions or side effects in traced code.
+
+PyGraph's core argument applied to jax tracing: what enters the
+compiled/captured region must be side-effect free and concretization
+free.  For every function reaching ``jax.jit`` / the executor-kind
+builds (discovered transitively by :mod:`ci.graftlint.dataflow`), flag:
+
+* **host-forcing coercions** of traced array values — ``float(x)`` /
+  ``int(x)`` / ``bool(x)``, ``x.item()`` / ``x.tolist()`` /
+  ``x.asnumpy()`` / ``x.asscalar()``, ``np.asarray(x)`` — each forces a
+  blocking device→host transfer *at trace time* and bakes the value
+  into the program (or raises ``ConcretizationTypeError``);
+* **Python control flow on traced values** — ``if``/``while``/``assert``
+  on an array concretizes it; branching on ``x.shape``-derived statics
+  is fine and deliberately not flagged;
+* **host side effects** — logging/telemetry/print/warnings calls,
+  ``time``/``os.environ``/stdlib-``random`` reads, attribute mutation of
+  ``self`` or parameters, ``global`` rebinds: all of these run ONCE at
+  trace time and silently vanish from every later execution (or worse,
+  leak trace-time values).  ``jax.debug.*`` is the sanctioned escape and
+  never flagged.
+
+Precision contract: only *proven* array values are flagged (parameters
+whose usage shows array-ness, jnp/jax call results, values returned by
+other traced functions).  Branching on a plain Python hyperparameter
+(``if momentum != 0.0:`` in ``sgd_step_math``) stays silent — that is
+the trace-time specialization idiom, not a bug."""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Pass
+from ..dataflow import JAX_ROOTS, dotted, index_for, root_name
+
+#: call roots whose invocation inside traced code is a host side effect
+SIDE_EFFECT_ROOTS = frozenset({
+    "logging", "logger", "log", "_log", "warnings", "telemetry",
+    "_telemetry", "profiler", "_profiler"})
+
+#: call roots whose READS are impure (baked once at trace time)
+IMPURE_READ_ROOTS = frozenset({"time", "os", "random", "_random"})
+
+
+class TracerPurityPass(Pass):
+    id = "tracer-purity"
+    title = "traced code is pure and sync-free"
+
+    def check_source(self, src, ctx):
+        findings = []
+        index = index_for(src)
+        for func, why in index.traced_functions().items():
+            findings.extend(self._check_traced(src, func, why, index))
+        return findings
+
+    def _check_traced(self, src, func, why, index):
+        scan = index.purity(func)
+        findings = []
+        fname = getattr(func, "name", "<lambda>")
+        seen_lines = set()
+
+        def emit(node, code, msg, detail=""):
+            key = (node.lineno, code)
+            if key in seen_lines:   # one report per line+code
+                return
+            seen_lines.add(key)
+            findings.append(self.find(
+                src, node, code,
+                "%s (in traced function %r — %s)" % (msg, fname, why),
+                detail=detail or fname))
+
+        # nodes under nested def/async-def belong to those functions —
+        # they are analyzed under their own traced_functions entry when
+        # reached from traced code (lambdas inline into this trace and
+        # stay part of this walk)
+        nested = {n for inner in ast.walk(func)
+                  if isinstance(inner, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef))
+                  and inner is not func
+                  for n in ast.walk(inner)}
+
+        for node in ast.walk(func):
+            if node in nested:
+                continue
+            if isinstance(node, ast.Call):
+                self._check_call(node, scan, emit)
+            elif isinstance(node, (ast.If, ast.While)):
+                names = scan.array_names_in(node.test)
+                if names:
+                    emit(node, "traced-branch",
+                         "Python control flow on traced value(s) %s "
+                         "concretizes them at trace time (use jnp.where/"
+                         "lax.cond for data-dependent behavior)"
+                         % ", ".join(sorted(names)),
+                         detail=",".join(sorted(names)))
+            elif isinstance(node, ast.Assert):
+                names = scan.array_names_in(node.test)
+                if names:
+                    emit(node, "traced-branch",
+                         "assert on traced value(s) %s concretizes them "
+                         "at trace time" % ", ".join(sorted(names)),
+                         detail=",".join(sorted(names)))
+            elif isinstance(node, ast.Assign):
+                for t in node.targets:
+                    self._check_attr_store(t, scan, emit)
+            elif isinstance(node, ast.AugAssign):
+                self._check_attr_store(node.target, scan, emit)
+            elif isinstance(node, ast.Global):
+                emit(node, "traced-side-effect",
+                     "global rebind inside traced code runs once at "
+                     "trace time, not per step",
+                     detail=",".join(node.names))
+        return findings
+
+    def _check_call(self, node, scan, emit):
+        f = node.func
+        # host-forcing builtins on traced arrays
+        if isinstance(f, ast.Name):
+            if f.id in ("float", "int", "bool", "complex") and node.args:
+                names = scan.array_names_in(node.args[0])
+                if names:
+                    emit(node, "host-coercion",
+                         "%s() on traced value(s) %s forces a blocking "
+                         "device sync at trace time and bakes the result "
+                         "into the program"
+                         % (f.id, ", ".join(sorted(names))),
+                         detail=",".join(sorted(names)))
+            elif f.id == "print":
+                emit(node, "traced-side-effect",
+                     "print() inside traced code runs once at trace "
+                     "time only (use jax.debug.print for per-step "
+                     "output)")
+            return
+        if not isinstance(f, ast.Attribute):
+            return
+        root = root_name(f)
+        if root in JAX_ROOTS:
+            return  # jax.debug.print / jnp ops are the sanctioned path
+        # .item()/.tolist()/.asnumpy()/.asscalar() on traced receivers
+        if f.attr in ("item", "tolist", "asnumpy", "asscalar"):
+            if scan.expr_taint(f.value) == "array" \
+                    or (isinstance(f.value, ast.Name)
+                        and f.value.id in scan.arrays):
+                emit(node, "host-coercion",
+                     ".%s() on a traced value forces a blocking device "
+                     "sync at trace time" % f.attr,
+                     detail=dotted(f.value) or f.attr)
+            return
+        if f.attr in ("asarray", "array") \
+                and root in ("np", "_np", "numpy") and node.args:
+            names = scan.array_names_in(node.args[0])
+            if names:
+                emit(node, "host-coercion",
+                     "%s.%s() on traced value(s) %s pulls them to host "
+                     "at trace time (use jnp.%s)"
+                     % (root, f.attr, ", ".join(sorted(names)), f.attr),
+                     detail=",".join(sorted(names)))
+            return
+        if root in SIDE_EFFECT_ROOTS:
+            emit(node, "traced-side-effect",
+                 "%s call inside traced code executes at trace time "
+                 "only — it will not run per step (hoist it to the "
+                 "caller, or use jax.debug.callback)"
+                 % (dotted(f) or root), detail=dotted(f) or root)
+            return
+        if root in IMPURE_READ_ROOTS:
+            emit(node, "traced-impure-read",
+                 "%s call inside traced code is evaluated once at trace "
+                 "time and baked into the compiled program"
+                 % (dotted(f) or root), detail=dotted(f) or root)
+
+    def _check_attr_store(self, target, scan, emit):
+        """Attribute mutation of self/params inside traced code."""
+        if isinstance(target, (ast.Tuple, ast.List)):
+            for el in target.elts:
+                self._check_attr_store(el, scan, emit)
+            return
+        if not isinstance(target, ast.Attribute):
+            return
+        root = root_name(target)
+        if root == "self" or root in scan.params:
+            emit(target, "traced-side-effect",
+                 "attribute mutation %r inside traced code happens at "
+                 "trace time only — per-step state must flow through "
+                 "function returns" % (dotted(target) or root),
+                 detail=dotted(target) or root)

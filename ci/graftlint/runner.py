@@ -1,0 +1,185 @@
+"""graftlint runner — executes passes, applies suppressions + baselines,
+renders human/JSON output, exports lint-debt telemetry.
+
+Exit semantics (shared by ``python -m ci.graftlint`` and the legacy
+shims): **0** when every finding is suppressed or baselined, **1**
+otherwise — identical to the seven scripts this framework replaced.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+from . import baseline as _baseline
+from .core import RunContext, apply_suppressions
+
+
+class PassResult:
+    def __init__(self, lint_pass, findings, stale):
+        self.lint_pass = lint_pass
+        self.findings = findings
+        self.stale = stale
+
+    @property
+    def active(self):
+        return [f for f in self.findings
+                if f.suppressed is None and not f.baselined]
+
+    @property
+    def suppressed(self):
+        return [f for f in self.findings if f.suppressed is not None]
+
+    @property
+    def baselined(self):
+        return [f for f in self.findings if f.baselined]
+
+
+def run_pass(lint_pass, ctx, baseline=None):
+    """Run one pass: collect sources (orchestrated passes take none),
+    apply the suppression grammar + legacy tags, then the baseline."""
+    if lint_pass.orchestrated:
+        findings = lint_pass.run((), ctx)
+        for f in findings:  # suppression comments have no file to live in
+            f.suppressed = None
+        stale = {}
+    else:
+        sources = ctx.collect(lint_pass)
+        findings = lint_pass.run(sources, ctx)
+        by_rel = {s.rel: s for s in sources}
+        apply_suppressions(findings, by_rel, lint_pass.legacy_tags)
+        stale = {}
+    if baseline:
+        mine = {k: v for k, v in baseline.items() if k[0] == lint_pass.id}
+        stale = _baseline.apply(findings, mine)
+    return PassResult(lint_pass, findings, stale)
+
+
+def run(passes, ctx=None, baseline_path=_baseline.DEFAULT_PATH,
+        json_path=None, update_baseline=False, prune_baseline=False,
+        emit_telemetry=False, out=None):
+    """Run ``passes`` and return the process exit code."""
+    import sys
+
+    echo = (lambda s: print(s, file=out)) if out is not None \
+        else (lambda s: print(s))  # noqa: print is this tool's output
+    ctx = ctx or RunContext()
+    t0 = time.monotonic()
+    known = _baseline.load(baseline_path)
+    results = [run_pass(p, ctx, baseline=known) for p in passes]
+    elapsed = time.monotonic() - t0
+
+    all_findings = [f for r in results for f in r.findings]
+    if update_baseline:
+        _baseline.save(_baseline.build(all_findings), baseline_path)
+        echo("graftlint: baseline rewritten with %d entr(ies) at %s"
+             % (len(_baseline.build(all_findings)), baseline_path))
+        return 0
+
+    failures = 0
+    for r in results:
+        for f in sorted(r.findings, key=lambda f: (f.path, f.line)):
+            if f.suppressed is not None or f.baselined:
+                continue
+            echo("%s: [%s/%s] %s" % (f.location(), f.pass_id, f.code,
+                                     f.message))
+        n = len(r.active)
+        failures += n
+        tail = []
+        if r.suppressed:
+            tail.append("%d suppressed" % len(r.suppressed))
+        if r.baselined:
+            tail.append("%d baselined" % len(r.baselined))
+        if r.stale:
+            tail.append("%d STALE baseline entr(ies)"
+                        % sum(r.stale.values()))
+        echo("graftlint: pass %-16s %s%s"
+             % (r.lint_pass.id,
+                ("%d finding(s)" % n) if n else "clean",
+                (" (%s)" % ", ".join(tail)) if tail else ""))
+        for (pid, path, code, detail), cnt in sorted(r.stale.items()):
+            echo("graftlint:   stale baseline: %s %s [%s] %s x%d — the "
+                 "finding no longer fires; run --prune-baseline"
+                 % (pid, path, code, detail or "-", cnt))
+
+    if prune_baseline:
+        kept = _baseline.build([f for f in all_findings if f.baselined])
+        _baseline.save(kept, baseline_path)
+        echo("graftlint: baseline pruned to %d entr(ies)" % len(kept))
+
+    if json_path:
+        payload = {
+            "version": 1,
+            "run_seconds": round(elapsed, 3),
+            "passes": {
+                r.lint_pass.id: {
+                    "title": r.lint_pass.title,
+                    "findings": [f.to_dict() for f in r.findings],
+                    "active": len(r.active),
+                    "suppressed": len(r.suppressed),
+                    "baselined": len(r.baselined),
+                    "stale_baseline": sum(r.stale.values()),
+                } for r in results},
+            "total_active": failures,
+        }
+        with open(json_path, "w") as fh:
+            json.dump(payload, fh, indent=2, sort_keys=True)
+            fh.write("\n")
+
+    if emit_telemetry:
+        _export_telemetry(results, elapsed, echo)
+
+    if failures:
+        echo("graftlint: FAIL — %d unsuppressed, unbaselined finding(s) "
+             "across %d pass(es) in %.1fs (suppress with '# lint: "
+             "ok[pass-id] reason', or baseline with --update-baseline; "
+             "see docs/linting.md)" % (failures, len(passes), elapsed))
+        return 1
+    echo("graftlint: OK — %d pass(es), 0 active findings (%d suppressed, "
+         "%d baselined) in %.1fs"
+         % (len(passes),
+            sum(len(r.suppressed) for r in results),
+            sum(len(r.baselined) for r in results), elapsed))
+    return 0
+
+
+def _export_telemetry(results, elapsed, echo):
+    """Lint debt as telemetry gauges (``lint.findings{pass=,state=}`` +
+    ``lint.run_seconds``) so PROGRESS/bench tooling can track it.  The
+    registry lives in mxnet_tpu (jax import); failures to import must
+    not break a lint run on a stripped environment.
+
+    The registry is in-process and the lint process exits right after,
+    so the snapshot is dumped EXPLICITLY: to ``MXNET_TELEMETRY_DUMP``
+    when set, else to ``/tmp/graftlint-telemetry.json`` — otherwise the
+    gauges would vanish with the process and the documented lint-debt
+    trendline (docs/observability.md) would never land anywhere."""
+    import os
+
+    try:
+        import pathlib
+        import sys
+        sys.path.insert(0, str(pathlib.Path(__file__).resolve()
+                               .parent.parent.parent))
+        from mxnet_tpu import telemetry
+    except Exception as e:  # pragma: no cover - stripped env only
+        echo("graftlint: telemetry export skipped (%s)" % e)
+        return
+    telemetry.enable()
+    for r in results:
+        telemetry.set_gauge("lint.findings", len(r.active),
+                            **{"pass": r.lint_pass.id, "state": "active"})
+        telemetry.set_gauge("lint.findings", len(r.suppressed),
+                            **{"pass": r.lint_pass.id,
+                               "state": "suppressed"})
+        telemetry.set_gauge("lint.findings", len(r.baselined),
+                            **{"pass": r.lint_pass.id, "state": "baselined"})
+    telemetry.set_gauge("lint.run_seconds", round(elapsed, 3))
+    dump_path = os.environ.get("MXNET_TELEMETRY_DUMP") \
+        or "/tmp/graftlint-telemetry.json"
+    try:
+        telemetry.dump(dump_path)
+        echo("graftlint: lint-debt telemetry dumped to %s" % dump_path)
+    except OSError as e:  # pragma: no cover - unwritable tmp only
+        echo("graftlint: telemetry dump to %s failed (%s)"
+             % (dump_path, e))

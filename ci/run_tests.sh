@@ -4,20 +4,16 @@
 set -e
 cd "$(dirname "$0")/.."
 python -m compileall -q mxnet_tpu tools example
-# resilience lint: no silently-swallowed exceptions in the framework
-python ci/check_bare_except.py
-# observability lint: framework output goes through logging/telemetry,
-# never bare print (bench.py's stdout is a one-JSON-line contract)
-python ci/check_print.py
-# docs lint: every MXNET_* env var read in the framework is documented
-# in docs/how_to/env_var.md
-python ci/check_env_docs.py
-# perf lint: no host-synchronizing calls (.asnumpy / np.asarray) in the
-# fit/step hot-path modules unless tagged '# host-sync: ok <reason>'
-python ci/check_host_sync.py
-# signal hygiene: every signal.signal install in framework code pairs
-# with a restore in a finally block of the same function
-python ci/check_signal_restore.py
+# unified static analysis (docs/linting.md): ONE invocation runs every
+# graftlint pass — the five migrated lints (bare-except, print,
+# env-docs, host-sync, signal-restore) plus the dataflow passes
+# (tracer-purity, recompile-hazard, donation, lock-discipline) — over
+# mxnet_tpu/, honoring the shared '# lint: ok[pass-id] <reason>'
+# suppression grammar and the per-pass baselines.  The JSON findings
+# report lands at /tmp/graftlint.json as a CI artifact, and per-pass
+# finding counts export through telemetry (lint.findings gauges) so
+# PROGRESS/bench tooling can track lint debt.
+python -m ci.graftlint --json /tmp/graftlint.json --emit-telemetry
 if command -v g++ > /dev/null; then
   g++ -O2 -shared -fPIC -std=c++17 -o libmxnet_tpu_native.so \
       src/native.cc -lpthread
@@ -62,12 +58,14 @@ python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 # compile-once effectiveness: a small fit+predict runs twice against a
 # temp persistent compile cache; the second run must perform ZERO XLA
 # compilations (every executable loads from the cache) — unstable cache
-# identities re-introduce cold warm-up costs in serving/CI/resume
+# identities re-introduce cold warm-up costs in serving/CI/resume.
+# (also runnable as the orchestrated graftlint pass 'compile-cache')
 python ci/check_compile_cache.py
 # bench regression gate: fail on BENCH_extra.json rows regressed >5%
 # vs best without a recorded waiver — opt-in (BENCH_GATE=1) because the
 # snapshot is only refreshed on bench hosts; see docs/observability.md
-# "Bench regression gate" for the waiver workflow
+# "Bench regression gate" for the waiver workflow.
+# (also runnable as the orchestrated graftlint pass 'bench-gate')
 if [ "${BENCH_GATE:-0}" = "1" ]; then
   python ci/check_bench_gate.py
 fi

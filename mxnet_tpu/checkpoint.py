@@ -516,7 +516,12 @@ class AsyncSnapshotWriter:
             try:
                 self._write(snap)
             except BaseException as e:  # noqa: BLE001 — surfaced on drain
-                self._error = e
+                # published under the condition lock: drain() reads and
+                # clears it from the fit thread, and an unguarded
+                # cross-thread hand-off can deliver a torn/stale error
+                # (flagged by graftlint's lock-discipline pass)
+                with self._cv:
+                    self._error = e
                 self.logger.warning("async checkpoint write failed: %s", e)
             finally:
                 with self._cv:
@@ -532,7 +537,8 @@ class AsyncSnapshotWriter:
                 self._cv.wait_for(
                     lambda: self._slot is None and not self._busy,
                     timeout=timeout)
-        err, self._error = self._error, None
+        with self._cv:
+            err, self._error = self._error, None
         if err is not None:
             raise err
 

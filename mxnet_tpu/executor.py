@@ -132,6 +132,7 @@ def _mirror_mode():
     FLOPs — the deep end of the reference's mirror trade)."""
     import os
 
+    # lint: ok[tracer-purity] read at trace time BY DESIGN — the executor keys its fn cache on trace_env_fingerprint(), so a changed value retraces
     v = os.environ.get("MXNET_BACKWARD_DO_MIRROR", "")
     if v in ("", "0"):
         return 0

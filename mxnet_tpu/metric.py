@@ -102,6 +102,7 @@ class EvalMetric:
         def _py(v):
             if isinstance(v, list):
                 return [_py(x) for x in v]
+            # lint: ok[host-sync] host numpy scalars at snapshot capture — no device buffer involved
             return v.item() if hasattr(v, "item") else v
 
         return {"sum_metric": _py(self.sum_metric),

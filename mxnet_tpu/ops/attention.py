@@ -70,6 +70,7 @@ def _flash_scan(q, k, v, causal, scale, block_k=512):
     if pad:
         kf = jnp.pad(kf, ((0, 0), (0, 0), (0, pad), (0, 0)))
         vf = jnp.pad(vf, ((0, 0), (0, 0), (0, pad), (0, 0)))
+    # lint: ok[recompile-hazard] block_k is a blocking-tuning knob with one default — per-value specialization is the intent
     kb = kf.reshape(kf.shape[0], kf.shape[1], nb, block_k, kf.shape[3])
     vb = vf.reshape(*kb.shape)
     kb = jnp.moveaxis(kb, 2, 0)  # (nb, B, H, block_k, D)

@@ -286,7 +286,13 @@ class DynamicBatcher:
                          % (n, self.max_batch_size))
 
     def _serve_loop(self):
-        while self._running:
+        while True:
+            # the stop flag is written under the condition lock; reading
+            # it bare can see a stale value on the worker thread
+            # (graftlint lock-discipline), so take the lock for the check
+            with self._cond:
+                if not self._running:
+                    return
             batch = self._next_batch(block=True)
             if batch:
                 try:
