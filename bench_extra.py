@@ -874,13 +874,84 @@ def serving_score(loads=(4, 16, 64), buckets=(1, 8, 32), in_dim=64,
     reg.close()
 
 
+def decode_score(loads=(4, 16, 48), slots=8, max_new=24,
+                 vocab=256, embed=64, heads=4, layers=2, ffn=128,
+                 max_len=96):
+    """Continuous-batching decode tier offered-load sweep (docs/
+    serving.md "Continuous batching & replica pool"): N client threads
+    each run one generation through a single-replica pool; each load
+    level records sustained tokens/sec, TTFT p50/p99, the mean slot
+    occupancy the engine actually achieved (decoded tokens per step /
+    slots — the continuous-batching efficiency number) and sequences
+    per decode step.  The trajectory rows ``ci/check_bench_gate.py``
+    watches: a slot-lifecycle regression shows up as occupancy loss
+    before it shows up as latency."""
+    import threading
+
+    from mxnet_tpu.models import transformer_lm as tlm
+    from mxnet_tpu.serving.pool import lm_pool
+
+    cfg = tlm.LMConfig(vocab, embed, heads, layers, ffn, max_len,
+                       eos_id=vocab)  # unreachable EOS: exact lengths
+    params = tlm.init_params(cfg, seed=0)
+    rs = np.random.RandomState(0)
+    pool = lm_pool(cfg, params, n_replicas=1, name="bench-lm",
+                   engine_opts={"slots": slots,
+                                "prefill_buckets": (8, 32),
+                                "max_queue": 512})
+    eng = pool.replicas[0].engine
+    for load in loads:
+        ttfts = []
+        lock = threading.Lock()
+        errors = []
+        # prompts drawn BEFORE the threads start: RandomState is not
+        # thread-safe, and the gate compares runs — the workload must
+        # be identical every run
+        prompts = [[int(t) for t in rs.randint(0, vocab, size=1 + c % 8)]
+                   for c in range(load)]
+
+        def client(cid):
+            try:
+                sess = pool.generate(prompts[cid],
+                                     max_new_tokens=max_new)
+                sess.result(300)
+            except Exception as e:
+                errors.append(e)
+                return
+            with lock:
+                ttfts.append(sess.ttft())
+
+        steps0, tokens0 = eng.steps, eng.tokens_out
+        threads = [threading.Thread(target=client, args=(c,))
+                   for c in range(load)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        if errors:
+            raise errors[0]
+        steps = eng.steps - steps0
+        tokens = eng.tokens_out - tokens0
+        decoded = tokens - load  # per-step tokens (prefill emits 1/seq)
+        row("decode_s%d_load%d" % (slots, load), tokens / wall,
+            "tok/sec",
+            ttft_p50_ms=round(float(np.percentile(ttfts, 50)) * 1e3, 3),
+            ttft_p99_ms=round(float(np.percentile(ttfts, 99)) * 1e3, 3),
+            steps=steps,
+            slot_occupancy=round(decoded / max(1, steps) / slots, 3),
+            seqs_per_step=round(load / max(1, steps), 3))
+    pool.close()
+
+
 def main():
     if len(sys.argv) > 1 and sys.argv[1] == "_compile_probe":
         _compile_probe(sys.argv[2])
         return
     which = set((sys.argv[1].split(",") if len(sys.argv) > 1 else
                  ["infer", "train", "fit", "lstm", "ssd", "io",
-                  "serving", "ckpt", "compile"]))
+                  "serving", "decode", "ckpt", "compile"]))
     if "io" in which:
         io_score()
     if "infer" in which:
@@ -910,6 +981,8 @@ def main():
         ssd_score()
     if "serving" in which:
         serving_score()
+    if "decode" in which:
+        decode_score()
     if "ckpt" in which:
         ckpt_score()
     if "compile" in which:

@@ -20,4 +20,17 @@ while [ "$i" -lt "$runs" ]; do
     -k "kill or chaos or preempt"
   i=$((i + 1))
 done
+# decode-serving half (docs/serving.md "Continuous batching & replica
+# pool"): SIGTERM a serving process holding ACTIVE decode sessions —
+# in-flight sequences must complete or be shed with a typed error,
+# never silently dropped.  The seed rotates prompt/output lengths and
+# sampling temperatures so the kill lands at different slot states.
+i=0
+while [ "$i" -lt "$runs" ]; do
+  echo "== decode drain chaos run $((i + 1))/$runs (MXNET_CHAOS_SEED=$i) =="
+  JAX_PLATFORMS=cpu MXNET_CHAOS_SEED="$i" \
+    python -m pytest tests/test_decode.py -q -p no:cacheprovider \
+    -k "sigterm_drain or drain_deadline"
+  i=$((i + 1))
+done
 echo "CHAOS OK ($runs runs)"

@@ -39,6 +39,12 @@ point                  effect when it fires
                          mid-cache-write) — the runtime must warn, fall
                          back to a clean recompile and self-heal the
                          entry
+``serving.decode``       the Nth continuous-batching decode STEP dies
+                         before the device call — every active session
+                         on that engine gets the error (the batch-error
+                         contract), the slot state restarts clean, and
+                         the engine worker survives; consecutive firings
+                         drive a pool replica into quarantine
 =====================  =====================================================
 
 Arming — programmatic::
@@ -75,7 +81,7 @@ __all__ = ["POINTS", "FaultInjected", "arm", "disarm", "armed",
 #: this so a typo'd point fails loudly instead of never firing)
 POINTS = ("kvstore.push.socket", "checkpoint.write", "fit.batch",
           "recordio.read", "serving.dispatch", "serving.model.write",
-          "fit.preempt", "compile_cache.read")
+          "fit.preempt", "compile_cache.read", "serving.decode")
 
 
 class FaultInjected(MXNetError):

@@ -12,6 +12,9 @@ Each ``get_symbol(num_classes, **kwargs)`` returns a Symbol ending in
 from . import lenet, mlp, alexnet, vgg, resnet, inception_bn, inception_v3
 from . import googlenet, inception_resnet_v2
 from . import ssd_vgg16, rcnn
+# decode-capable transformer LM (pure-jax functional, not a symbol
+# builder): the serving decode tier's workload (docs/serving.md)
+from . import transformer_lm  # noqa: F401
 
 _BUILDERS = {
     "lenet": lenet.get_symbol,

@@ -1,0 +1,239 @@
+"""Decode-capable transformer LM — the served autoregressive workload.
+
+``parallel/lm.py`` is the *training* flagship (dp x tp x pp x sp x ep in
+one SPMD step); this module is its serving-side counterpart: a compact
+decoder-only transformer whose forward math is split exactly along the
+line a continuous-batching server needs (docs/serving.md "Continuous
+batching & replica pool"):
+
+* :func:`prefill_kv` — run the full prompt once, return the last-token
+  logits plus the per-layer K/V rows to seed a slot of the engine's
+  device-resident cache;
+* :func:`decode_step_math` — ONE token for ALL ``S`` cache slots at
+  once: scatter the incoming token's K/V into each slot's cache row,
+  attend over ``positions <= length`` and produce ``(S, vocab)``
+  logits.  Fixed shapes in, fixed shapes out — the function compiles
+  once per ``(S, max_len)`` and never again
+  (:mod:`mxnet_tpu.serving.decode` wraps it with sampling and slot
+  state into the single jitted step);
+* :func:`forward_logits` — plain batched teacher-forcing forward, the
+  ground truth the decode path is pinned bit-compatible against
+  (``tests/test_decode.py``: greedy decode == argmax of the full
+  forward).
+
+The math is deliberately single-device per replica — multi-replica
+throughput comes from :class:`~mxnet_tpu.serving.pool.ReplicaPool`
+spreading engines over ``jax.devices()``, not from sharding one model.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+from collections import namedtuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["LMConfig", "init_params", "forward_logits", "prefill_kv",
+           "decode_step_math", "params_to_blob", "params_from_blob"]
+
+#: model hyperparameters; ``max_len`` bounds the KV cache (and therefore
+#: prompt + generated length), ``eos_id`` is the token that retires a
+#: sequence early
+LMConfig = namedtuple("LMConfig", ["vocab", "embed", "heads", "layers",
+                                   "ffn", "max_len", "eos_id"])
+
+
+def init_params(cfg, seed=0, dtype=jnp.float32):
+    """Parameter pytree (host -> the caller ``device_put``s it where the
+    replica lives).  Per-layer weights are stacked on axis 0 so the
+    pytree stays flat and a layer loop indexes rows."""
+    if cfg.embed % cfg.heads:
+        raise ValueError("embed=%d not divisible by heads=%d"
+                         % (cfg.embed, cfg.heads))
+    rs = np.random.RandomState(seed)
+
+    def nrm(*shape, s=0.05):
+        return jnp.asarray(rs.normal(0, s, shape).astype(np.float32),
+                           dtype=dtype)
+
+    L, E, F = cfg.layers, cfg.embed, cfg.ffn
+    return {
+        "embed": nrm(cfg.vocab, E),
+        "pos": nrm(cfg.max_len, E),
+        "head": nrm(E, cfg.vocab),
+        "ln_f": jnp.ones((E,), dtype),
+        "blocks": {
+            "ln1": jnp.ones((L, E), dtype),
+            "qkv_w": nrm(L, E, 3 * E),
+            "out_w": nrm(L, E, E),
+            "ln2": jnp.ones((L, E), dtype),
+            "up_w": nrm(L, E, F),
+            "down_w": nrm(L, F, E),
+        },
+    }
+
+
+def _rmsnorm(x, g):
+    return x * g * jax.lax.rsqrt(
+        (x.astype(jnp.float32) ** 2).mean(-1, keepdims=True)
+        + 1e-6).astype(x.dtype)
+
+
+def _layer(blocks, l):
+    return {k: v[l] for k, v in blocks.items()}
+
+
+def forward_logits(cfg, params, tokens):
+    """Teacher-forcing forward: ``tokens (B, T) int32 -> (B, T, vocab)``
+    float32 logits — training/eval and the decode-parity ground truth."""
+    b, t = tokens.shape
+    pos = jnp.arange(t)
+    x = params["embed"][tokens] + params["pos"][pos][None]
+    causal = pos[None, :] <= pos[:, None]            # (q, k)
+    hd = cfg.embed // cfg.heads
+    scale = 1.0 / np.sqrt(hd)
+    for l in range(cfg.layers):
+        p = _layer(params["blocks"], l)
+        h = _rmsnorm(x, p["ln1"])
+        qkv = jnp.einsum("bte,ef->btf", h, p["qkv_w"])
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+
+        def heads(a):
+            return a.reshape(b, t, cfg.heads, hd)
+
+        scores = jnp.einsum("bqhd,bkhd->bhqk", heads(q), heads(k)) * scale
+        att = jax.nn.softmax(
+            jnp.where(causal[None, None], scores, jnp.float32(-1e30)),
+            axis=-1)
+        ctx = jnp.einsum("bhqk,bkhd->bqhd", att, heads(v))
+        x = x + jnp.einsum("bte,ef->btf",
+                           ctx.reshape(b, t, cfg.embed), p["out_w"])
+        h = _rmsnorm(x, p["ln2"])
+        x = x + jnp.einsum("btf,fe->bte",
+                           jax.nn.gelu(jnp.einsum("bte,ef->btf", h,
+                                                  p["up_w"])), p["down_w"])
+    x = _rmsnorm(x, params["ln_f"])
+    return jnp.einsum("bte,ev->btv", x, params["head"]).astype(jnp.float32)
+
+
+def prefill_kv(cfg, params, tokens, length):
+    """One prompt through the model: ``tokens (P,) int32`` (bucket-padded,
+    ``length`` real tokens) -> ``(last_logits (vocab,), ks, vs)`` where
+    ``ks``/``vs`` are per-layer tuples of ``(P, heads, head_dim)`` cache
+    rows for positions ``0..P-1``.  Rows past ``length`` hold pad-token
+    K/V — the decode attention mask (``position <= slot length``) never
+    reads them before the decode step itself overwrites them in place.
+    """
+    (p,) = tokens.shape
+    pos = jnp.arange(p)
+    x = params["embed"][tokens] + params["pos"][pos]
+    causal = pos[None, :] <= pos[:, None]
+    hd = cfg.embed // cfg.heads
+    scale = 1.0 / np.sqrt(hd)
+    ks, vs = [], []
+    for l in range(cfg.layers):
+        pl = _layer(params["blocks"], l)
+        h = _rmsnorm(x, pl["ln1"])
+        qkv = jnp.einsum("te,ef->tf", h, pl["qkv_w"])
+        q, k, v = (a.reshape(p, cfg.heads, hd)
+                   for a in jnp.split(qkv, 3, axis=-1))
+        scores = jnp.einsum("qhd,khd->hqk", q, k) * scale
+        att = jax.nn.softmax(
+            jnp.where(causal[None], scores, jnp.float32(-1e30)), axis=-1)
+        ctx = jnp.einsum("hqk,khd->qhd", att, v)
+        x = x + jnp.einsum("te,ef->tf",
+                           ctx.reshape(p, cfg.embed), pl["out_w"])
+        h = _rmsnorm(x, pl["ln2"])
+        x = x + jnp.einsum("tf,fe->te",
+                           jax.nn.gelu(jnp.einsum("te,ef->tf", h,
+                                                  pl["up_w"])),
+                           pl["down_w"])
+        ks.append(k)
+        vs.append(v)
+    x = _rmsnorm(x, params["ln_f"])
+    logits = jnp.einsum("te,ev->tv", x, params["head"]).astype(jnp.float32)
+    last = jnp.take(logits, jnp.clip(length - 1, 0, p - 1), axis=0)
+    return last, tuple(ks), tuple(vs)
+
+
+def decode_step_math(cfg, params, cache_k, cache_v, last_tok, lengths):
+    """One decode token for all ``S`` slots.
+
+    ``cache_k``/``cache_v``: per-layer tuples of ``(S, max_len, heads,
+    head_dim)``; ``last_tok (S,) int32`` is each slot's most recent
+    token (prompt tail after prefill, previous sample afterwards);
+    ``lengths (S,) int32`` is each slot's cache fill — the position the
+    incoming token's K/V is scattered to, and the inclusive attention
+    horizon.  Returns ``(logits (S, vocab), new_cache_k, new_cache_v)``.
+
+    Inactive slots ride along (fixed shape => no recompile): their
+    scatter lands on a row the mask makes unreachable until a real
+    write replaces it, and their logits are discarded host-side.
+    """
+    (s, m) = cache_k[0].shape[:2]
+    hd = cfg.embed // cfg.heads
+    scale = 1.0 / np.sqrt(hd)
+    rows = jnp.arange(s)
+    kpos = jnp.arange(m)
+    pos = jnp.clip(lengths, 0, cfg.max_len - 1)
+    x = params["embed"][last_tok] + params["pos"][pos]
+    new_k, new_v = [], []
+    for l in range(cfg.layers):
+        pl = _layer(params["blocks"], l)
+        h = _rmsnorm(x, pl["ln1"])
+        qkv = jnp.einsum("se,ef->sf", h, pl["qkv_w"])
+        q, k, v = (a.reshape(s, cfg.heads, hd)
+                   for a in jnp.split(qkv, 3, axis=-1))
+        ck = cache_k[l].at[rows, pos].set(k)
+        cv = cache_v[l].at[rows, pos].set(v)
+        scores = jnp.einsum("shd,smhd->shm", q, ck) * scale
+        mask = kpos[None, None, :] <= pos[:, None, None]
+        att = jax.nn.softmax(
+            jnp.where(mask, scores, jnp.float32(-1e30)), axis=-1)
+        ctx = jnp.einsum("shm,smhd->shd", att, cv)
+        x = x + jnp.einsum("se,ef->sf",
+                           ctx.reshape(s, cfg.embed), pl["out_w"])
+        h = _rmsnorm(x, pl["ln2"])
+        x = x + jnp.einsum("sf,fe->se",
+                           jax.nn.gelu(jnp.einsum("se,ef->sf", h,
+                                                  pl["up_w"])),
+                           pl["down_w"])
+        new_k.append(ck)
+        new_v.append(cv)
+    x = _rmsnorm(x, params["ln_f"])
+    logits = jnp.einsum("se,ev->sv", x, params["head"]).astype(jnp.float32)
+    return logits, tuple(new_k), tuple(new_v)
+
+
+def params_to_blob(cfg, params):
+    """Serialize ``(cfg, params)`` to one npz blob (the serving publish
+    payload format, :func:`mxnet_tpu.serving.save_model` convention)."""
+    flat = {"__config__": np.frombuffer(
+        json.dumps(cfg._asdict()).encode(), np.uint8)}
+    for k, v in params.items():
+        if isinstance(v, dict):
+            for k2, v2 in v.items():
+                flat["%s.%s" % (k, k2)] = np.asarray(v2)
+        else:
+            flat[k] = np.asarray(v)
+    buf = io.BytesIO()
+    np.savez(buf, **flat)
+    return buf.getvalue()
+
+
+def params_from_blob(blob):
+    """Inverse of :func:`params_to_blob`: ``(cfg, params)``."""
+    with np.load(io.BytesIO(blob)) as z:
+        cfg = LMConfig(**json.loads(bytes(z["__config__"]).decode()))
+        params = {"blocks": {}}
+        for k in z.files:
+            if k == "__config__":
+                continue
+            if k.startswith("blocks."):
+                params["blocks"][k.split(".", 1)[1]] = jnp.asarray(z[k])
+            else:
+                params[k] = jnp.asarray(z[k])
+    return cfg, params

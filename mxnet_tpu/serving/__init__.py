@@ -1,25 +1,38 @@
 """Serving subsystem — a throughput-oriented model server over the
-single-request :class:`~mxnet_tpu.predict.Predictor`.
+single-request :class:`~mxnet_tpu.predict.Predictor` plus a
+continuous-batching autoregressive tier.
 
-Three layers (see ``docs/serving.md``):
+Five layers (see ``docs/serving.md``):
 
 * :mod:`~mxnet_tpu.serving.batcher` — dynamic micro-batching with
   shape-bucket padding, per-request deadlines, and typed
   :class:`Overloaded` admission control;
+* :mod:`~mxnet_tpu.serving.decode` — slot-based continuous batching
+  for autoregressive LMs: one fixed-shape jitted decode step, one
+  packed host read per token, mid-flight admission into free slots;
+* :mod:`~mxnet_tpu.serving.pool` — N routed replicas over
+  ``jax.devices()``: weighted least-outstanding routing, per-tenant
+  quotas, priority shedding, quarantine + background re-warm;
 * :mod:`~mxnet_tpu.serving.registry` — versioned multi-model registry
-  with atomic publish (checksummed manifest-last), atomic reload, and
-  per-bucket warm-up compilation at load time;
+  with atomic publish (checksummed manifest-last), atomic reload,
+  per-bucket warm-up compilation, and pointer-flip ``register`` swaps
+  of off-registry-built servables (pools included);
 * :mod:`~mxnet_tpu.serving.frontend` — in-process handle + stdlib HTTP
-  JSON endpoint (``/predict``, ``/healthz``, ``/metrics``).
+  JSON endpoint (``/predict``, ``/generate`` with chunked streaming,
+  ``/models``, ``/healthz``, ``/metrics``).
 """
 
 from .batcher import (BATCH_SIZE_BUCKETS, LATENCY_BUCKETS, DeadlineExceeded,
                       DynamicBatcher, Future, InvalidRequest, Overloaded)
+from .decode import TTFT_BUCKETS, DecodeEngine, GenerateSession
 from .frontend import ServingHandle, ServingHTTPServer
+from .pool import QuotaExceeded, Replica, ReplicaPool, lm_pool
 from .registry import (MANIFEST, ModelRegistry, ServedModel, UnknownModel,
                        save_model)
 
 __all__ = ["DynamicBatcher", "Future", "Overloaded", "DeadlineExceeded",
            "InvalidRequest", "LATENCY_BUCKETS", "BATCH_SIZE_BUCKETS",
+           "TTFT_BUCKETS", "DecodeEngine", "GenerateSession",
+           "QuotaExceeded", "Replica", "ReplicaPool", "lm_pool",
            "ModelRegistry", "ServedModel", "UnknownModel", "save_model",
            "MANIFEST", "ServingHandle", "ServingHTTPServer"]

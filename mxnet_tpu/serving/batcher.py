@@ -74,12 +74,13 @@ class InvalidRequest(MXNetError):
 class Future:
     """Single-shot result holder for one queued request."""
 
-    __slots__ = ("_ev", "_value", "_error")
+    __slots__ = ("_ev", "_value", "_error", "_cancelled")
 
     def __init__(self):
         self._ev = threading.Event()
         self._value = None
         self._error = None
+        self._cancelled = False
 
     def set_result(self, value):
         self._value = value
@@ -91,6 +92,21 @@ class Future:
 
     def done(self):
         return self._ev.is_set()
+
+    def cancel(self):
+        """Mark the request abandoned — its reader is gone.  A cancelled
+        request is dropped by the worker before dispatch (shed reason
+        ``abandoned``) and releases its admission rows instead of
+        occupying the queue until a reader-less device dispatch.
+        Returns False when the result already landed (best-effort: a
+        result racing the cancel is harmless — the value sits unread)."""
+        if self._ev.is_set():
+            return False
+        self._cancelled = True
+        return True
+
+    def cancelled(self):
+        return self._cancelled
 
     def result(self, timeout=None):
         """Block for the batch carrying this request; re-raises the
@@ -174,6 +190,8 @@ class DynamicBatcher:
                        reason="overload")
         _telemetry.inc("serving.shed.count", 0, model=name,
                        reason="deadline")
+        _telemetry.inc("serving.shed.count", 0, model=name,
+                       reason="abandoned")
         _telemetry.inc("serving.dispatch.count", 0, model=name)
         _telemetry.set_gauge("serving.queue.depth", 0, model=name)
 
@@ -225,8 +243,16 @@ class DynamicBatcher:
 
     def predict(self, data, deadline_ms=None, timeout=DEFAULT_TIMEOUT):
         """Blocking convenience: ``submit`` + ``Future.result``.
-        ``timeout=None`` waits forever."""
-        return self.submit(data, deadline_ms=deadline_ms).result(timeout)
+        ``timeout=None`` waits forever.  A wait that times out CANCELS
+        the request — an abandoned entry must not keep holding the
+        admission bound down, nor be dispatched to a reader that is
+        gone."""
+        fut = self.submit(data, deadline_ms=deadline_ms)
+        try:
+            return fut.result(timeout)
+        except DeadlineExceeded:
+            fut.cancel()
+            raise
 
     # -- worker side -------------------------------------------------------
     def start(self):
@@ -313,10 +339,25 @@ class DynamicBatcher:
     def _next_batch(self, block):
         """Pop a coalesced run of requests: flush immediately when
         ``max_batch_size`` rows are ready, else ``batch_timeout`` after
-        the first request was picked up."""
+        the first request was picked up.  Abandoned requests (a
+        ``predict(timeout)`` wait that ran out cancels its future) are
+        shed from the queue head here, releasing their admission rows —
+        without the drop they would keep ``_depth`` inflated AND be
+        dispatched to a reader that is gone; ones cancelled after the
+        pop are skipped at dispatch."""
         with self._cond:
             while block and self._running and not self._queue:
                 self._cond.wait(0.05)
+            dropped = 0
+            while self._queue and self._queue[0].future.cancelled():
+                req = self._queue.popleft()
+                self._depth -= req.n
+                dropped += 1
+                _telemetry.inc("serving.shed.count", model=self.name,
+                               reason="abandoned")
+            if dropped:
+                _telemetry.set_gauge("serving.queue.depth", self._depth,
+                                     model=self.name)
             if not self._queue:
                 return []
             batch = [self._queue.popleft()]
@@ -344,6 +385,12 @@ class DynamicBatcher:
         now = time.monotonic()
         live = []
         for r in batch:
+            if r.future.cancelled():
+                # abandoned between pop and dispatch: no reader, no
+                # device slot
+                _telemetry.inc("serving.shed.count", model=self.name,
+                               reason="abandoned")
+                continue
             if r.deadline is not None and now > r.deadline:
                 _telemetry.inc("serving.shed.count", model=self.name,
                                reason="deadline")

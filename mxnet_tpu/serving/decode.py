@@ -1,0 +1,802 @@
+"""Continuous-batching decode engine — autoregressive serving on slots.
+
+The PR 3 batcher coalesces *fixed-shape* requests; an autoregressive LM
+breaks that model: every sequence wants a different number of steps, and
+naive batching waits for the slowest sequence while the rest of the
+batch pads along dead.  The TPU-native answer is the same move the
+sync-free fit loop made for training (docs/how_to/perf.md): make the
+decode loop ONE fixed-shape jitted step that never recompiles and never
+syncs beyond a single packed host read per token.
+
+:class:`DecodeEngine` owns a device-resident KV cache of fixed shape
+``(S slots, max_len)`` per layer and exactly TWO compiled programs:
+
+* **prefill** (one per declared prompt-length bucket): run a
+  bucket-padded prompt, scatter its K/V rows into a free slot, sample
+  the first token and arm the slot — all in-graph;
+* **decode step** (one per ``(S, max_len)``): advance ALL slots one
+  token — scatter the incoming token's K/V, attend over each slot's
+  ``<= length`` horizon, sample (greedy or temperature, keys split
+  in-graph from :mod:`mxnet_tpu.random` seed material), retire
+  EOS/length-done slots — returning the packed ``(token, done,
+  active)`` buffer whose single host read is the loop's only sync.
+
+Sequences are admitted into free slots BETWEEN steps (continuous
+batching: a late request joins the running batch instead of waiting for
+it), retired on EOS/length without recompiling, and stream their tokens
+out through per-session callbacks.  Inactive slots ride along at fixed
+shape; their scatter rows are unreachable under the attention mask
+until a real write replaces them.
+
+The engine is single-device; multi-replica throughput is
+:class:`~mxnet_tpu.serving.pool.ReplicaPool`'s job.  The hot loop is
+covered by the graftlint host-sync pass (``ci/graftlint``): the packed
+per-step read is the one sanctioned transfer.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from collections import deque
+
+import numpy as np
+
+from .. import compile_cache as _compile_cache
+from .. import faults as _faults
+from .. import perfdebug as _perfdebug
+from .. import random as _random
+from .. import telemetry as _telemetry
+from ..base import MXNetError
+from ..models import transformer_lm as _tlm
+from .batcher import (LATENCY_BUCKETS, DeadlineExceeded, Future,
+                      InvalidRequest, Overloaded)
+
+__all__ = ["GenerateSession", "DecodeEngine", "TTFT_BUCKETS"]
+
+_log = logging.getLogger("mxnet_tpu.serving")
+
+#: time-to-first-token histogram bounds (seconds) — first tokens pay a
+#: queue wait + one prefill, so the ladder reaches further than the
+#: per-token one
+TTFT_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+                1.0, 2.5, 5.0, 10.0, 30.0)
+
+
+# shared int-env parser — ONE definition lives in compile_cache.py
+# (pool.py imports it from there too)
+from ..compile_cache import _env_int  # noqa: E402
+
+
+class GenerateSession:
+    """One streaming generation request: queued -> active(slot) ->
+    done/shed.  ``result()`` blocks for the full token list (prompt NOT
+    included; EOS, when hit, is the last token); ``on_token`` streams
+    each token from the engine thread (must be cheap and non-blocking —
+    HTTP streaming hands it a queue put)."""
+
+    __slots__ = ("prompt", "max_new_tokens", "temperature", "deadline",
+                 "on_token", "tokens", "future", "t_submit", "t_first",
+                 "t_done", "slot", "admit_step", "done_step", "_finished",
+                 "_on_done")
+
+    def __init__(self, prompt, max_new_tokens, temperature, deadline_ms,
+                 on_token, on_done=None):
+        self.prompt = prompt
+        self.max_new_tokens = int(max_new_tokens)
+        self.temperature = float(temperature)
+        self.deadline = None if deadline_ms is None \
+            else time.monotonic() + float(deadline_ms) / 1e3
+        self.on_token = on_token
+        self.tokens = []
+        self.future = Future()
+        self.t_submit = time.monotonic()
+        self.t_first = None
+        self.t_done = None
+        self.slot = None
+        self.admit_step = None
+        self.done_step = None
+        self._finished = False
+        self._on_done = on_done
+
+    def cancel(self):
+        """Abandon the request: queued sessions are dropped at the next
+        admission scan, an active session is retired (its slot freed) at
+        the next step boundary.  Returns False when the session already
+        finished.  ONE cancellation flag — the embedded Future's (the
+        same machinery the batcher honors), so ``sess.future.cancel()``
+        and ``sess.cancel()`` cannot diverge."""
+        return self.future.cancel()
+
+    def cancelled(self):
+        return self.future.cancelled()
+
+    def done(self):
+        return self.future.done()
+
+    def result(self, timeout=60.0):
+        """Block for the full generated token list (re-raising the shed
+        or dispatch error when the session failed)."""
+        return self.future.result(timeout)
+
+    def ttft(self):
+        """Time-to-first-token in seconds (None before the first
+        token)."""
+        return None if self.t_first is None \
+            else self.t_first - self.t_submit
+
+
+class DecodeEngine:
+    """Slot-based continuous batching over one
+    :mod:`~mxnet_tpu.models.transformer_lm` replica.
+
+    Parameters
+    ----------
+    cfg : transformer_lm.LMConfig
+    params : pytree
+        Host or device params; committed to ``device``.
+    slots : int
+        Concurrent sequences S (``MXNET_DECODE_SLOTS`` default, 8).
+        The decode step compiles once per ``(S, max_len)``.
+    prefill_buckets : tuple of int
+        Declared prompt-length buckets; a prompt pads to the smallest
+        bucket that fits (``DynamicBatcher`` bucket idiom — the jit
+        cache sees ``len(prefill_buckets)`` prefill shapes, ever).
+    max_queue : int
+        Admission bound on QUEUED sessions; past it ``submit`` raises
+        :class:`Overloaded`.
+    device : jax.Device, optional
+        Replica placement; defaults to the process default device.
+    replica : str
+        Telemetry label (``replica=<id>``) — the pool names replicas.
+    on_step_error / on_step_ok : callable, optional
+        Replica-health hooks (the pool's quarantine counter); called
+        outside the engine lock.
+    """
+
+    def __init__(self, cfg, params, *, slots=None, prefill_buckets=(8, 32),
+                 max_queue=64, device=None, name="lm", replica="0",
+                 autostart=True, on_step_error=None, on_step_ok=None):
+        import jax
+
+        self.cfg = cfg
+        self.name = name
+        self.replica = str(replica)
+        self.slots = int(slots) if slots is not None \
+            else _env_int("MXNET_DECODE_SLOTS", 8)
+        if self.slots < 1:
+            raise MXNetError("DecodeEngine needs >= 1 slot")
+        buckets = tuple(sorted({int(b) for b in prefill_buckets}))
+        if not buckets or buckets[0] < 1 or buckets[-1] > cfg.max_len:
+            raise MXNetError(
+                "prefill buckets %r must be within 1..max_len=%d"
+                % (buckets, cfg.max_len))
+        self.prefill_buckets = buckets
+        self.max_queue = int(max_queue)
+        self._device = device if device is not None else jax.devices()[0]
+        self._params = jax.device_put(params, self._device)
+        self._on_step_error = on_step_error
+        self._on_step_ok = on_step_ok
+
+        self._cond = threading.Condition(threading.Lock())
+        self._queue = deque()
+        self._slot_sessions = [None] * self.slots
+        self._running = False
+        self._draining = False
+        self._closed = False
+        self._thread = None
+        #: total decode steps (tests pin continuous admission on it)
+        self.steps = 0
+        #: total generated tokens
+        self.tokens_out = 0
+        self._rate_t0 = time.monotonic()
+        self._rate_tokens = 0
+
+        self._step_fn = None       # built in _build()
+        self._prefill_fns = {}
+        self._boot_state = self._build()
+        labels = {"model": name, "replica": self.replica}
+        _telemetry.inc("serving.decode.sessions.count", 0, **labels)
+        _telemetry.inc("serving.decode.tokens.count", 0, **labels)
+        _telemetry.inc("serving.decode.steps.count", 0, **labels)
+        _telemetry.set_gauge("serving.decode.slot_occupancy", 0.0, **labels)
+        _telemetry.set_gauge("serving.decode.tokens_per_sec", 0.0, **labels)
+        for reason in ("deadline", "overload", "abandoned", "drain"):
+            _telemetry.inc("serving.shed.count", 0, model=name,
+                           reason=reason)
+        if autostart:
+            self.start()
+
+    # -- compiled programs -------------------------------------------------
+    def _build(self):
+        """Build the two jitted programs and the initial device state;
+        warm-compile every shape so no live request ever eats a trace
+        (persistent-cache loads on a warm reload, PR 7)."""
+        import jax
+        import jax.numpy as jnp
+
+        cfg = self.cfg
+        s, m = self.slots, cfg.max_len
+        eos = np.int32(cfg.eos_id)
+
+        def sample(key, logits, temps):
+            # greedy when temperature == 0, else temperature sampling;
+            # per-slot keys split in-graph — the loop never touches the
+            # host RNG
+            greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            skeys = jax.random.split(key, logits.shape[0])
+            drawn = jax.vmap(
+                lambda kk, lg, tt: jax.random.categorical(
+                    kk, lg / jnp.maximum(tt, 1e-6)))(
+                        skeys, logits, temps).astype(jnp.int32)
+            return jnp.where(temps > 0.0, drawn, greedy)
+
+        def step(params, state, keep):
+            cache_k, cache_v, last_tok, lengths, limits, active, temps, \
+                key = state
+            active = active & keep
+            logits, cache_k, cache_v = _tlm.decode_step_math(
+                cfg, params, cache_k, cache_v, last_tok, lengths)
+            key, sub = jax.random.split(key)
+            tok = sample(sub, logits, temps)
+            new_len = lengths + active.astype(jnp.int32)
+            done = active & ((tok == eos) | (new_len >= limits))
+            new_active = active & ~done
+            new_last = jnp.where(active, tok, last_tok)
+            packed = jnp.stack([jnp.where(active, tok, -1),
+                                done.astype(jnp.int32),
+                                new_active.astype(jnp.int32)])
+            return (cache_k, cache_v, new_last, new_len, limits,
+                    new_active, temps, key), packed
+
+        def prefill(params, state, tokens, length, slot, limit, temp,
+                    activate):
+            cache_k, cache_v, last_tok, lengths, limits, active, temps, \
+                key = state
+            last_logits, ks, vs = _tlm.prefill_kv(cfg, params, tokens,
+                                                  length)
+            cache_k = tuple(
+                jax.lax.dynamic_update_slice(ck, k[None], (slot, 0, 0, 0))
+                for ck, k in zip(cache_k, ks))
+            cache_v = tuple(
+                jax.lax.dynamic_update_slice(cv, v[None], (slot, 0, 0, 0))
+                for cv, v in zip(cache_v, vs))
+            key, sub = jax.random.split(key)
+            tok = sample(sub, last_logits[None],
+                         jnp.full((1,), temp))[0]
+            first_done = (tok == eos) | (limit <= length)
+            arm = activate & ~first_done
+            last_tok = last_tok.at[slot].set(tok)
+            lengths = lengths.at[slot].set(length)
+            limits = limits.at[slot].set(limit)
+            temps = temps.at[slot].set(temp)
+            active = active.at[slot].set(arm)
+            out = jnp.stack([tok, first_done.astype(jnp.int32)])
+            return (cache_k, cache_v, last_tok, lengths, limits, active,
+                    temps, key), out
+
+        self._step_fn = self._instrument(
+            jax.jit(step, donate_argnums=(1,)), "decode_step",
+            ("decode_step", s, m))
+        pf_jit = jax.jit(prefill, donate_argnums=(1,))
+        self._prefill_fns = {
+            b: self._instrument(pf_jit, "decode_prefill",
+                                ("decode_prefill", b, s, m))
+            for b in self.prefill_buckets}
+
+        state = self._fresh_state()
+        with _compile_cache.recording_scope() as rec:
+            cc0 = _compile_cache.stats() if _compile_cache.enabled() \
+                else None
+            state = self._warm(state)
+            cc1 = _compile_cache.stats() if cc0 is not None else None
+        self.warmup_entries = rec.entries
+        if cc0 is not None:
+            # a separate family from the batcher's serving.warmup.* —
+            # this one carries a replica label, and a telemetry family
+            # must never mix label sets
+            _telemetry.set_gauge(
+                "serving.decode.warmup.cold_compiles",
+                cc1["misses"] - cc0["misses"], model=self.name,
+                replica=self.replica)
+            _telemetry.set_gauge(
+                "serving.decode.warmup.cache_loads",
+                cc1["hits"] - cc0["hits"], model=self.name,
+                replica=self.replica)
+        _telemetry.event("serving.decode.warm", model=self.name,
+                         replica=self.replica, slots=s,
+                         buckets=len(self.prefill_buckets))
+        return state
+
+    def _instrument(self, fn, kind, build_kind):
+        """First-call hook: count the compile (``xla.compile.count``,
+        the recompile-detector's family) and record the build into the
+        PR 7 warm-up manifest registry."""
+        def hook(f, args, kwargs, dt):
+            _telemetry.inc("xla.compile.count", kind=kind)
+            _telemetry.inc("xla.compile.seconds", dt, kind=kind)
+            if _compile_cache.recording():
+                _compile_cache.note_build(
+                    "serving:%s" % self.name, build_kind, f.lower, args,
+                    kwargs, dt)
+        return _perfdebug.first_call_hook(fn, hook)
+
+    def _fresh_state(self):
+        """Zeroed device-resident slot state, committed to the replica
+        device."""
+        import jax
+        import jax.numpy as jnp
+
+        cfg = self.cfg
+        s, m = self.slots, cfg.max_len
+        hd = cfg.embed // cfg.heads
+        zeros_kv = tuple(jnp.zeros((s, m, cfg.heads, hd), jnp.float32)
+                         for _ in range(cfg.layers))
+        state = (zeros_kv,
+                 tuple(jnp.zeros((s, m, cfg.heads, hd), jnp.float32)
+                       for _ in range(cfg.layers)),
+                 jnp.zeros((s,), jnp.int32),        # last_tok
+                 jnp.zeros((s,), jnp.int32),        # lengths
+                 jnp.zeros((s,), jnp.int32),        # limits
+                 jnp.zeros((s,), bool),             # active
+                 jnp.zeros((s,), jnp.float32),      # temps
+                 jnp.asarray(np.array(_random.next_key()), jnp.uint32))
+        return jax.device_put(state, self._device)
+
+    def _warm(self, state):
+        """Compile the decode step and every prefill bucket against the
+        real state buffers — ``activate=False`` leaves the slots
+        disarmed, so warm-up never corrupts serving state."""
+        for b in self.prefill_buckets:
+            state, _out = self._prefill_fns[b](
+                self._params, state, np.zeros((b,), np.int32),
+                np.int32(1), np.int32(0), np.int32(0), np.float32(0.0),
+                np.bool_(False))
+        state, _packed = self._step_fn(self._params, state,
+                                       np.ones((self.slots,), bool))
+        return state
+
+    def set_health_hooks(self, on_error=None, on_ok=None):
+        """Install the pool's replica-health hooks.  Call before
+        :meth:`start` — plain attribute flips, deliberately outside the
+        engine lock (the hooks take the POOL's lock; holding both here
+        would order the locks both ways)."""
+        self._on_step_error = on_error
+        self._on_step_ok = on_ok
+
+    def rewarm(self):
+        """Recompile/reload every program (the pool's quarantine
+        re-warm): with the persistent compile cache armed this is pure
+        cache loads — zero cold compiles on a healthy host.  Refuses a
+        running or CLOSED engine — a background re-warm racing a
+        pointer-flip version swap must not resurrect the retired
+        replica (the pool's except path leaves it quarantined)."""
+        with self._cond:
+            if self._running:
+                raise MXNetError("rewarm() needs a stopped engine")
+            if self._closed:
+                raise MXNetError("decode engine %r is closed"
+                                 % self.name)
+        state = self._build()
+        with self._cond:
+            self._boot_state = state
+            self._draining = False
+
+    # -- client side -------------------------------------------------------
+    def submit(self, prompt, *, max_new_tokens=16, temperature=0.0,
+               deadline_ms=None, on_token=None, on_done=None):
+        """Queue a generation request; returns its
+        :class:`GenerateSession`.  Raises :class:`Overloaded` past the
+        queue bound and :class:`InvalidRequest` for malformed prompts
+        (the client's error, surfaced at submit)."""
+        prompt = np.array(prompt, np.int32).ravel()
+        if prompt.size < 1:
+            raise InvalidRequest("empty prompt")
+        if prompt.size > self.prefill_buckets[-1]:
+            raise InvalidRequest(
+                "prompt of %d tokens exceeds the largest prefill bucket "
+                "%d" % (prompt.size, self.prefill_buckets[-1]))
+        if prompt.size >= self.cfg.max_len:
+            raise InvalidRequest(
+                "prompt of %d tokens leaves no room under max_len=%d"
+                % (prompt.size, self.cfg.max_len))
+        if prompt.min() < 0 or prompt.max() >= self.cfg.vocab:
+            raise InvalidRequest(
+                "prompt token ids must be in 0..vocab-1=%d"
+                % (self.cfg.vocab - 1))
+        if int(max_new_tokens) < 1:
+            raise InvalidRequest("max_new_tokens must be >= 1")
+        if float(temperature) < 0:
+            raise InvalidRequest("temperature must be >= 0")
+        sess = GenerateSession(prompt, max_new_tokens, temperature,
+                               deadline_ms, on_token, on_done)
+        with self._cond:
+            if self._closed:
+                raise MXNetError("decode engine %r is closed" % self.name)
+            if self._draining:
+                _telemetry.inc("serving.shed.count", model=self.name,
+                               reason="drain")
+                raise Overloaded("decode engine %r is draining"
+                                 % self.name)
+            if len(self._queue) >= self.max_queue:
+                _telemetry.inc("serving.shed.count", model=self.name,
+                               reason="overload")
+                raise Overloaded(
+                    "decode engine %r overloaded: %d sessions queued"
+                    % (self.name, len(self._queue)))
+            # counted AFTER admission: sessions.count is accepted
+            # sessions (completion/shed ratios read against it);
+            # rejected submits show only in serving.shed.count
+            _telemetry.inc("serving.decode.sessions.count",
+                           model=self.name, replica=self.replica)
+            self._queue.append(sess)
+            self._cond.notify()
+        return sess
+
+    def generate(self, prompt, timeout=60.0, **kw):
+        """Blocking convenience: ``submit`` + ``result``."""
+        sess = self.submit(prompt, **kw)
+        try:
+            return sess.result(timeout)
+        except DeadlineExceeded:
+            sess.cancel()
+            raise
+
+    # -- introspection -----------------------------------------------------
+    def pending_rows(self):
+        """Queued plus active sessions — the graceful-drain quiescence
+        probe (one row == one sequence)."""
+        with self._cond:
+            return len(self._queue) + \
+                sum(1 for x in self._slot_sessions if x is not None)
+
+    def outstanding(self):
+        """Same number the pool's least-outstanding routing reads."""
+        return self.pending_rows()
+
+    def describe(self):
+        with self._cond:
+            active = sum(1 for x in self._slot_sessions if x is not None)
+            queued = len(self._queue)
+            steps = self.steps
+            tokens = self.tokens_out
+        return {"name": self.name, "kind": "generate",
+                "version": getattr(self, "version", None),
+                "replica": self.replica, "device": str(self._device),
+                "slots": self.slots, "active": active, "queued": queued,
+                "steps": steps, "tokens": tokens,
+                "prefill_buckets": list(self.prefill_buckets),
+                "max_len": self.cfg.max_len}
+
+    # -- worker ------------------------------------------------------------
+    def start(self):
+        with self._cond:
+            if self._closed:
+                # a closed engine stays closed: restarting its worker
+                # (e.g. a stale re-warm thread) would leak a spinning
+                # daemon on a servable nobody routes to
+                raise MXNetError("decode engine %r is closed"
+                                 % self.name)
+            if self._thread is not None:
+                return self
+            if self._boot_state is None:
+                # restart after a plain stop(): the compiled programs
+                # survive, only the slot state was consumed — rebuild
+                # it from zeros (device_put, no recompile)
+                self._boot_state = self._fresh_state()
+            self._draining = False
+            self._running = True
+            self._thread = threading.Thread(
+                target=self._serve_loop,
+                name="decode-%s-%s" % (self.name, self.replica),
+                daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self, drain=True, deadline=None):
+        """Stop the engine.  ``drain=True`` keeps stepping until every
+        ACTIVE sequence finishes (new admissions stop; queued sessions
+        are shed immediately with a typed error) under ``deadline``
+        seconds (``MXNET_PREEMPT_DRAIN_DEADLINE``, default 30); past
+        the deadline — or with ``drain=False`` — unfinished sessions
+        are shed, never silently dropped.  Returns True when the drain
+        completed cleanly."""
+        if deadline is None:
+            deadline = float(os.environ.get(
+                "MXNET_PREEMPT_DRAIN_DEADLINE", "30") or 30)
+        shed = []
+        with self._cond:
+            self._draining = True
+            if not drain:
+                self._running = False
+            while self._queue:
+                shed.append(self._queue.popleft())
+            self._cond.notify_all()
+        err = MXNetError("decode engine %r stopped before this session "
+                         "was served" % self.name)
+        clean = not shed
+        for sess in shed:
+            _telemetry.inc("serving.shed.count", model=self.name,
+                           reason="drain")
+            self._finish(sess, error=err)
+        with self._cond:
+            t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=deadline if drain else 5.0)
+            if t.is_alive():
+                clean = False
+                with self._cond:
+                    self._running = False
+                    self._cond.notify_all()
+                t.join(timeout=10.0)
+        # anything still holding a slot is shed with the typed error
+        leftovers = []
+        with self._cond:
+            for i, sess in enumerate(self._slot_sessions):
+                if sess is not None:
+                    leftovers.append(sess)
+                    self._slot_sessions[i] = None
+        for sess in leftovers:
+            clean = False
+            _telemetry.inc("serving.shed.count", model=self.name,
+                           reason="drain")
+            self._finish(sess, error=err)
+        self._occupancy_gauge()
+        return clean
+
+    def close(self, drain=True):
+        """Permanent :meth:`stop`: further submits fail fast."""
+        with self._cond:
+            self._closed = True
+        return self.stop(drain=drain)
+
+    def _serve_loop(self):
+        with self._cond:
+            state = self._boot_state
+            self._boot_state = None
+        while True:
+            admits = []
+            shed = []  # (session, reason) — finished OUTSIDE the lock:
+            # _finish runs the pool's on_done hook, which takes the POOL
+            # lock, and pool.describe() takes pool-then-engine — holding
+            # the engine lock here would order the locks both ways
+            with self._cond:
+                if not self._running:
+                    return
+                free = [i for i, x in enumerate(self._slot_sessions)
+                        if x is None]
+                # walk the WHOLE queue every iteration: abandoned or
+                # expired entries must release the max_queue admission
+                # bound (and the pool's outstanding accounting) even
+                # while every slot is busy — the batcher's abandoned-
+                # entry fix, applied here too.  FIFO order preserved.
+                now = time.monotonic()
+                keep = deque()
+                while self._queue:
+                    sess = self._queue.popleft()
+                    if sess.cancelled():
+                        shed.append((sess, "abandoned"))
+                    elif sess.deadline is not None \
+                            and now > sess.deadline:
+                        shed.append((sess, "deadline"))
+                    elif free:
+                        sess.slot = free.pop(0)
+                        self._slot_sessions[sess.slot] = sess
+                        admits.append(sess)
+                    else:
+                        keep.append(sess)
+                self._queue = keep
+                have_active = any(x is not None
+                                  for x in self._slot_sessions)
+                if not admits and not shed and not have_active:
+                    if self._draining:
+                        self._running = False
+                        return
+                    self._cond.wait(0.02)
+                    continue
+            for sess, reason in shed:
+                # every exit path resolves the future and fires on_done
+                # — a dropped session would leak the pool's outstanding
+                # accounting forever
+                _telemetry.inc("serving.shed.count", model=self.name,
+                               reason=reason)
+                err = DeadlineExceeded("deadline expired while queued "
+                                       "for a decode slot") \
+                    if reason == "deadline" else \
+                    MXNetError("session abandoned by the client while "
+                               "queued")
+                self._finish(sess, error=err)
+            for sess in admits:
+                state, aborted = self._admit(sess, state)
+                if aborted:
+                    # _fail_all already resolved EVERY reserved slot —
+                    # including admits not yet prefilled; touching them
+                    # again would double-fire the pool's on_done hook
+                    break
+            with self._cond:
+                have_active = any(x is not None
+                                  for x in self._slot_sessions)
+            if have_active:
+                state = self._step(state)
+
+    def _admit(self, sess, state):
+        """Prefill ``sess`` into its (already reserved) slot: one
+        bucket-shaped dispatch + one tiny admission-time host read for
+        the first token (TTFT); the hot loop's own budget is untouched.
+        Returns ``(state, aborted)`` — aborted=True means the dispatch
+        poisoned the donated state and :meth:`_fail_all` already
+        resolved every held session."""
+        cfg = self.cfg
+        p = int(sess.prompt.size)
+        bucket = next(b for b in self.prefill_buckets if p <= b)
+        tokens = np.zeros((bucket,), np.int32)
+        tokens[:p] = sess.prompt
+        limit = np.int32(min(p + sess.max_new_tokens - 1, cfg.max_len))
+        try:
+            state, out = self._prefill_fns[bucket](
+                self._params, state, tokens, np.int32(p),
+                np.int32(sess.slot), limit,
+                np.float32(sess.temperature), np.bool_(True))
+            out = np.asarray(out)  # lint: ok[host-sync] admission-time first-token read (TTFT), not the per-step hot loop
+        except Exception as e:
+            # a poisoned prefill poisons the whole donated state: fail
+            # every session this engine holds and restart from zeros
+            # (the queue is untouched)
+            return self._fail_all(e, state), True
+        sess.t_first = time.monotonic()
+        tok = int(out[0])
+        sess.tokens.append(tok)
+        self._emit(sess, tok)
+        _telemetry.observe("serving.decode.ttft_seconds",
+                           sess.t_first - sess.t_submit,
+                           buckets=TTFT_BUCKETS, model=self.name)
+        _telemetry.inc("serving.decode.tokens.count", model=self.name,
+                       replica=self.replica)
+        with self._cond:
+            sess.admit_step = self.steps
+            self.tokens_out += 1
+            self._rate_tokens += 1
+        if out[1]:  # EOS or max_new_tokens == 1: done at prefill
+            self._retire(sess)
+        self._occupancy_gauge()
+        return state, False
+
+    def _step(self, state):
+        """ONE fixed-shape decode dispatch for all slots + the single
+        packed host read; host bookkeeping fans tokens out to sessions."""
+        keep = np.ones((self.slots,), bool)
+        with self._cond:
+            sessions = list(self._slot_sessions)
+        now = time.monotonic()
+        for i, sess in enumerate(sessions):
+            if sess is None:
+                continue
+            if sess.cancelled():
+                keep[i] = False
+            elif sess.deadline is not None and now > sess.deadline:
+                keep[i] = False
+        t0 = time.perf_counter()
+        try:
+            if _faults.should_fire("serving.decode"):
+                raise _faults.FaultInjected(
+                    "fault 'serving.decode': decode step of model %r "
+                    "killed" % self.name)
+            state, packed = self._step_fn(self._params, state, keep)
+            packed = np.asarray(packed)  # lint: ok[host-sync] THE one sanctioned host read per decode step (packed token/done/active buffer)
+        except Exception as e:
+            return self._fail_all(e, state)
+        dt = time.perf_counter() - t0
+        emitted = 0
+        for i, sess in enumerate(sessions):
+            if sess is None:
+                continue
+            if not keep[i]:
+                reason = "abandoned" if sess.cancelled() else "deadline"
+                _telemetry.inc("serving.shed.count", model=self.name,
+                               reason=reason)
+                err = DeadlineExceeded("session deadline expired "
+                                       "mid-generation") \
+                    if reason == "deadline" else \
+                    MXNetError("session abandoned by the client")
+                self._retire(sess, error=err)
+                continue
+            tok = int(packed[0, i])
+            if tok >= 0:
+                emitted += 1
+                sess.tokens.append(tok)
+                self._emit(sess, tok)
+            if packed[1, i]:
+                self._retire(sess)
+        with self._cond:
+            self.steps += 1
+            self.tokens_out += emitted
+            self._rate_tokens += emitted
+            rate_t0, rate_tokens = self._rate_t0, self._rate_tokens
+        _telemetry.inc("serving.decode.steps.count", model=self.name,
+                       replica=self.replica)
+        if emitted:
+            _telemetry.inc("serving.decode.tokens.count", emitted,
+                           model=self.name, replica=self.replica)
+        _telemetry.observe("serving.decode.token_latency_seconds", dt,
+                           buckets=LATENCY_BUCKETS, model=self.name)
+        elapsed = time.monotonic() - rate_t0
+        if elapsed >= 0.5:
+            _telemetry.set_gauge("serving.decode.tokens_per_sec",
+                                 rate_tokens / elapsed, model=self.name,
+                                 replica=self.replica)
+            with self._cond:
+                self._rate_t0 = time.monotonic()
+                self._rate_tokens = 0
+        self._occupancy_gauge()
+        if self._on_step_ok is not None:
+            self._on_step_ok()
+        return state
+
+    def _fail_all(self, exc, _poisoned_state):
+        """A failed device dispatch poisons the donated state: every
+        held session gets the error (the batcher's batch-error
+        contract), the state restarts from zeros (same shapes — no
+        recompile), and the worker survives to serve the queue."""
+        _telemetry.inc("serving.error.count", model=self.name)
+        with self._cond:
+            held = [x for x in self._slot_sessions if x is not None]
+            self._slot_sessions = [None] * self.slots
+        for sess in held:
+            self._finish(sess, error=exc)
+        self._occupancy_gauge()
+        if self._on_step_error is not None:
+            self._on_step_error(exc)
+        return self._fresh_state()
+
+    # -- session completion ------------------------------------------------
+    def _emit(self, sess, tok):
+        if sess.on_token is None:
+            return
+        try:
+            sess.on_token(tok)
+        except Exception:  # noqa: broad-except — a client callback must
+            # never kill the engine thread; drop the stream, keep result()
+            _log.warning("decode: on_token callback of %r failed; "
+                         "disabling the stream", self.name, exc_info=True)
+            sess.on_token = None
+
+    def _retire(self, sess, error=None):
+        with self._cond:
+            if sess.slot is not None \
+                    and self._slot_sessions[sess.slot] is sess:
+                self._slot_sessions[sess.slot] = None
+            sess.done_step = self.steps
+        self._finish(sess, error=error)
+
+    def _finish(self, sess, error=None):
+        with self._cond:
+            # idempotent: a forced stop() that timed out its joins can
+            # race the still-running worker retiring the same session —
+            # the pool's on_done hook must fire exactly once per session
+            # or its outstanding accounting drifts
+            if sess._finished:
+                return
+            sess._finished = True
+        sess.t_done = time.monotonic()
+        if error is not None:
+            sess.future.set_error(error)
+        else:
+            sess.future.set_result(list(sess.tokens))
+        if sess._on_done is not None:
+            self._safe_done(sess)
+
+    def _safe_done(self, sess):
+        try:
+            sess._on_done(sess)
+        except Exception:  # noqa: broad-except — pool accounting hooks
+            # must never kill the engine thread
+            _log.warning("decode: on_done hook failed", exc_info=True)
+
+    def _occupancy_gauge(self):
+        with self._cond:
+            active = sum(1 for x in self._slot_sessions if x is not None)
+        _telemetry.set_gauge("serving.decode.slot_occupancy",
+                             active / float(self.slots), model=self.name,
+                             replica=self.replica)

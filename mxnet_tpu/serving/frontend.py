@@ -3,13 +3,24 @@
 :class:`ServingHandle` is the zero-copy in-process surface (what an
 embedding application calls).  :class:`ServingHTTPServer` exposes the
 same registry over ``http.server`` — no web framework, matching the
-repo's no-new-deps rule — with three routes:
+repo's no-new-deps rule — with five routes:
 
 * ``POST /predict`` — ``{"model": name, "data": nested-list,
   "deadline_ms": optional}`` → ``{"model", "version", "shape",
   "output"}``; typed failures map to HTTP: :class:`Overloaded` → 429,
   :class:`DeadlineExceeded` → 504, :class:`UnknownModel` → 404.
-* ``GET /healthz`` — liveness + the loaded model/version table.
+* ``POST /generate`` — autoregressive decode through a
+  :class:`~mxnet_tpu.serving.pool.ReplicaPool` /
+  :class:`~mxnet_tpu.serving.decode.DecodeEngine` servable:
+  ``{"model", "prompt": [token ids], "max_new_tokens", "temperature",
+  "stream", "tenant", "priority", "deadline_ms"}``.  With ``"stream":
+  true`` the response is ``Transfer-Encoding: chunked`` ndjson — one
+  ``{"token": id}`` line per generated token as it lands, then a
+  ``{"done": true, "tokens": [...], "ttft_ms": ...}`` summary line;
+  without it, one JSON document after the sequence finishes.
+* ``GET /models`` — every loaded servable's card (name, version,
+  buckets, replica states, warm-up status).
+* ``GET /healthz`` — liveness + model/version table + per-model detail.
 * ``GET /metrics`` — the process-wide telemetry registry in Prometheus
   text exposition (PR 2's ``telemetry.prometheus_text``), scrapable.
 """
@@ -19,6 +30,7 @@ from __future__ import annotations
 import json
 import logging
 import os
+import queue as _queue
 import signal as _signal
 import threading
 import time
@@ -51,9 +63,48 @@ class ServingHandle:
         return self.registry.get(model).predict(
             data, deadline_ms=deadline_ms, timeout=timeout)
 
+    @staticmethod
+    def start_session(servable, prompt, tenant=None, priority=5, **kw):
+        """Start one generation on an already-resolved servable; the
+        ONE pool-vs-engine dispatch point the HTTP handler and
+        :meth:`generate` both use.  A pool's session surface is
+        ``generate()`` and takes tenant/priority; a bare engine's is
+        ``submit()`` (its ``generate()`` is the blocking convenience)
+        and tenant/priority are dropped — there is no pool admission
+        layer to enforce them."""
+        if hasattr(servable, "replicas"):
+            return servable.generate(prompt, tenant=tenant,
+                                     priority=priority, **kw)
+        gen = getattr(servable, "submit", None) \
+            if hasattr(servable, "slots") else None
+        if gen is None:
+            raise InvalidRequest(
+                "model %r serves /predict, not /generate"
+                % getattr(servable, "name", "?"))
+        return gen(prompt, **kw)
+
+    def generate(self, model, prompt, **kw):
+        """Route one generation request to ``model``; returns its
+        session (see :meth:`start_session` for the dispatch rules)."""
+        return self.start_session(self.registry.get(model), prompt, **kw)
+
+    @staticmethod
+    def _describe(m):
+        desc = getattr(m, "describe", None)
+        if desc is not None:
+            return desc()
+        return {"name": m.name, "version": m.version}
+
+    def models_payload(self):
+        """``GET /models``: every loaded servable's card."""
+        return {"models": [self._describe(m)
+                           for m in self.registry.models()]}
+
     def healthz(self):
         payload = {"status": "ok",
                    "models": {m.name: m.version
+                              for m in self.registry.models()},
+                   "detail": {m.name: self._describe(m)
                               for m in self.registry.models()}}
         from .. import compile_cache as _compile_cache
 
@@ -68,9 +119,15 @@ class ServingHandle:
 
     def pending_rows(self):
         """Rows queued or in a device dispatch across every loaded
-        model — the quiescence probe graceful drain polls."""
+        servable — the quiescence probe graceful drain polls.  Decode
+        pools count one row per queued-or-active sequence, so drain
+        waits for in-flight generations too."""
         total = 0
         for m in self.registry.models():
+            fn = getattr(m, "pending_rows", None)
+            if fn is not None:
+                total += fn()
+                continue
             batcher = getattr(m, "batcher", None)
             if batcher is not None:
                 total += batcher.pending_rows()
@@ -102,7 +159,8 @@ class _Handler(BaseHTTPRequestHandler):
     def _count(self):
         # label cardinality stays bounded: scanner/bot paths must not
         # mint one permanent counter entry per distinct URL
-        route = self.path if self.path in ("/predict", "/healthz",
+        route = self.path if self.path in ("/predict", "/generate",
+                                           "/models", "/healthz",
                                            "/metrics") else "other"
         _telemetry.inc("serving.http.requests", route=route)
 
@@ -118,11 +176,39 @@ class _Handler(BaseHTTPRequestHandler):
                 payload["status"] = "draining"
                 return self._send(503, payload)
             self._send(200, payload)
+        elif self.path == "/models":
+            self._send(200, handle.models_payload())
         elif self.path == "/metrics":
             self._send(200, handle.metrics_text().encode(),
                        content_type="text/plain; version=0.0.4")
         else:
             self._send(404, {"error": "unknown route %r" % self.path})
+
+    def _admit_or_503(self, model):
+        """Admission gate shared by /predict and /generate: lock-coupled
+        with the draining flag — drain() flips the flag under the same
+        lock, so a request can never slip between the check and the
+        in-flight count and quiescence (pending_rows()==0 AND
+        admitted==0) is race-free.  Returns True when admitted (the
+        caller MUST decrement admitted_requests in a finally); when
+        draining, sends the 503 and counts the shed — labeling with the
+        model name only if it is actually loaded, so unauthenticated
+        garbage cannot mint unbounded permanent telemetry label entries
+        (the same bounded-cardinality rule as the route counter)."""
+        srv = self.server
+        with srv.admission_lock:
+            draining = getattr(srv, "draining", False)
+            if not draining:
+                srv.admitted_requests += 1
+                return True
+        handle = srv.serving_handle
+        known = handle.registry.get(model, default=None) is not None
+        _telemetry.inc("serving.shed.count",
+                       model=model if known else "other",
+                       reason="drain")
+        self._send(503, {"error": "server is draining (preemption); "
+                         "retry elsewhere"})
+        return False
 
     def _drain_body(self):
         """Consume an unread request body so the keep-alive connection
@@ -141,7 +227,7 @@ class _Handler(BaseHTTPRequestHandler):
         self._count()
         chunked = "chunked" in (self.headers.get("Transfer-Encoding")
                                 or "").lower()
-        if self.path != "/predict":
+        if self.path not in ("/predict", "/generate"):
             # an undrained body would desync this keep-alive connection
             if chunked:
                 self.close_connection = True
@@ -166,33 +252,33 @@ class _Handler(BaseHTTPRequestHandler):
                                     "0..%d" % self.max_body_bytes})
         try:
             req = json.loads(self.rfile.read(length) or b"{}")
+        except (ValueError, TypeError) as e:
+            # the body may be partially read at this point; don't let the
+            # next pipelined request parse the remainder as a request line
+            self.close_connection = True
+            return self._send(400, {"error": "bad %s request: %s"
+                                    % (self.path, e)})
+        if self.path == "/generate":
+            return self._do_generate(req)
+        return self._do_predict(req)
+
+    def _do_predict(self, req):
+        try:
             model = req["model"]
+            if not isinstance(model, str):
+                raise TypeError("\"model\" must be a string")
             data = np.asarray(req["data"], np.float32)
             deadline_ms = req.get("deadline_ms")
             if deadline_ms is not None:
                 deadline_ms = float(deadline_ms)
             timeout = float(req.get("timeout_s", 60.0))
         except (ValueError, KeyError, TypeError) as e:
-            # the body may be partially read at this point; don't let the
-            # next pipelined request parse the remainder as a request line
             self.close_connection = True
             return self._send(400, {"error": "bad /predict request: %s"
                                     % e})
-        # admission is lock-coupled with the draining flag: drain()
-        # flips the flag under the same lock, so a request can never
-        # slip between the check and the in-flight count — quiescence
-        # (pending_rows()==0 AND admitted==0) is race-free
+        if not self._admit_or_503(model):
+            return
         srv = self.server
-        with srv.admission_lock:
-            draining = getattr(srv, "draining", False)
-            if not draining:
-                srv.admitted_requests += 1
-        if draining:
-            # stop admitting: the drain window is for finishing what is
-            # already queued, not for new work
-            _telemetry.inc("serving.shed.count", reason="draining")
-            return self._send(503, {"error": "server is draining "
-                                    "(preemption); retry elsewhere"})
         # chrome-trace span for the whole request handling: the HTTP
         # half of a latency spike sits on the same timeline as the
         # batcher's dispatch span (and compile/fit spans)
@@ -205,6 +291,13 @@ class _Handler(BaseHTTPRequestHandler):
                 # served, and a concurrent unload/reload can't turn a
                 # completed prediction into a 404
                 served = handle.registry.get(model)
+                if not hasattr(served, "predict"):
+                    # a decode servable: the client's routing error
+                    # (400), not a server fault — mirroring /generate's
+                    # mapping for a predict-only model
+                    raise InvalidRequest(
+                        "model %r serves /generate, not /predict"
+                        % model)
                 out = served.predict(data, deadline_ms=deadline_ms,
                                      timeout=timeout)
                 version = served.version
@@ -232,6 +325,126 @@ class _Handler(BaseHTTPRequestHandler):
             if prof:
                 _profiler.record("serving:http:%s" % model, "serving",
                                  span_us, _profiler.now_us())
+
+    # -- /generate ---------------------------------------------------------
+    def _do_generate(self, req):
+        try:
+            model = req["model"]
+            if not isinstance(model, str):
+                raise TypeError("\"model\" must be a string")
+            prompt = [int(t) for t in req["prompt"]]
+            max_new = int(req.get("max_new_tokens", 16))
+            temperature = float(req.get("temperature", 0.0))
+            stream = bool(req.get("stream", False))
+            tenant = req.get("tenant")
+            priority = int(req.get("priority", 5))
+            deadline_ms = req.get("deadline_ms")
+            if deadline_ms is not None:
+                deadline_ms = float(deadline_ms)
+            timeout = float(req.get("timeout_s", 60.0))
+        except (ValueError, KeyError, TypeError) as e:
+            self.close_connection = True
+            return self._send(400, {"error": "bad /generate request: %s"
+                                    % e})
+        if not self._admit_or_503(model):
+            return
+        srv = self.server
+        tok_q = _queue.Queue() if stream else None
+        try:
+            handle = srv.serving_handle
+            kw = {"max_new_tokens": max_new, "temperature": temperature,
+                  "deadline_ms": deadline_ms, "tenant": tenant,
+                  "priority": priority}
+            if stream:
+                kw["on_token"] = tok_q.put
+            try:
+                # resolve ONCE (version-swap safety, as /predict) and
+                # dispatch through the ONE routing point
+                servable = handle.registry.get(model)
+                sess = handle.start_session(servable, prompt, **kw)
+            except InvalidRequest as e:
+                return self._send(400, {"error": str(e)})
+            except Overloaded as e:
+                return self._send(429, {"error": str(e)})
+            except UnknownModel as e:
+                return self._send(404, {"error": str(e)})
+            except Exception as e:
+                # e.g. a closed pool hit mid version-swap: the straggler
+                # gets a typed HTTP error, never a dropped connection
+                return self._send(500, {"error": str(e)})
+            version = servable.version
+            if not stream:
+                try:
+                    tokens = sess.result(timeout)
+                except DeadlineExceeded as e:
+                    sess.cancel()
+                    return self._send(504, {"error": str(e)})
+                except Exception as e:
+                    return self._send(500, {"error": str(e)})
+                ttft = sess.ttft()
+                return self._send(200, {
+                    "model": model, "version": version,
+                    "tokens": tokens, "n_tokens": len(tokens),
+                    "ttft_ms": None if ttft is None
+                    else round(ttft * 1e3, 3)})
+            self._stream_session(model, version, sess, tok_q, timeout)
+        finally:
+            with srv.admission_lock:
+                srv.admitted_requests -= 1
+
+    def _write_chunk(self, payload):
+        line = (json.dumps(payload) + "\n").encode()
+        self.wfile.write(b"%x\r\n%s\r\n" % (len(line), line))
+
+    def _stream_session(self, model, version, sess, tok_q, timeout):
+        """Chunked ndjson streaming: one ``{"token": id}`` line per
+        generated token AS IT LANDS (the engine's ``on_token`` callback
+        feeds the queue from its loop thread), then one summary line.
+        A vanished client cancels the session so its slot frees at the
+        next step boundary instead of decoding to nobody."""
+        self.send_response(200)
+        self.send_header("Content-Type", "application/x-ndjson")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+        cutoff = time.monotonic() + timeout
+        try:
+            while True:
+                try:
+                    tok = tok_q.get(timeout=0.05)
+                    self._write_chunk({"token": int(tok)})
+                    continue
+                except _queue.Empty:
+                    pass
+                if sess.done():
+                    # drain stragglers enqueued between Empty and done()
+                    while True:
+                        try:
+                            self._write_chunk(
+                                {"token": int(tok_q.get_nowait())})
+                        except _queue.Empty:
+                            break
+                    break
+                if time.monotonic() > cutoff:
+                    sess.cancel()
+                    self._write_chunk({"error": "stream timeout after "
+                                       "%.1fs" % timeout})
+                    break
+            try:
+                tokens = sess.result(timeout=5.0)
+                ttft = sess.ttft()
+                self._write_chunk({"done": True, "tokens": tokens,
+                                   "n_tokens": len(tokens),
+                                   "model": model, "version": version,
+                                   "ttft_ms": None if ttft is None
+                                   else round(ttft * 1e3, 3)})
+            except Exception as e:
+                self._write_chunk({"error": str(e)})
+            self.wfile.write(b"0\r\n\r\n")
+        except (BrokenPipeError, ConnectionError, OSError):
+            # client went away mid-stream: free the slot, drop the
+            # connection (it is desynced anyway)
+            sess.cancel()
+            self.close_connection = True
 
 
 class ServingHTTPServer:

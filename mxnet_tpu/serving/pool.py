@@ -1,0 +1,421 @@
+"""Routed multi-replica serving pool — the millions-of-users layer.
+
+One :class:`~mxnet_tpu.serving.decode.DecodeEngine` (or batcher-backed
+model) saturates one device; production traffic needs N of them behind
+ONE admission surface.  Following the TensorFlow system-design framing
+(serving as a first-class system component, not a deployment
+afterthought), :class:`ReplicaPool` owns:
+
+* **placement** — N replicas spread over ``jax.devices()`` (round-robin
+  when there are fewer devices than replicas), each built by a caller
+  factory and owning its engine/batcher;
+* **routing** — weighted least-outstanding-rows: a request goes to the
+  healthy replica with the lowest ``outstanding / weight``, accounted
+  pool-side so routing never touches an engine lock;
+* **load discipline on top of the PR 3 admission control** —
+  pool-level ``Overloaded`` past ``max_outstanding``
+  (``MXNET_POOL_MAX_OUTSTANDING``), priority-aware shedding (past the
+  priority watermark only requests with ``priority >=
+  priority_floor`` are admitted), and per-tenant quotas
+  (:class:`QuotaExceeded`, shed reason ``quota``);
+* **replica health** — ``quarantine_after`` consecutive dispatch
+  failures (``MXNET_POOL_QUARANTINE_AFTER``) quarantines the replica
+  (telemetry event, routing skips it) and a background thread re-warms
+  it through the PR 7 warm-up path (persistent-cache loads, zero cold
+  compiles on a healthy host) before flipping it back to ACTIVE;
+* **version swaps** — a pool is a registry servable: build the new
+  version off-registry, then
+  :meth:`~mxnet_tpu.serving.registry.ModelRegistry.register` pointer-
+  flips it in and drains the old one — no request ever sees a
+  half-swapped pool.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+
+from .. import telemetry as _telemetry
+from ..base import MXNetError
+from ..compile_cache import _env_int
+from .batcher import Overloaded
+from .decode import DecodeEngine
+
+__all__ = ["QuotaExceeded", "Replica", "ReplicaPool", "lm_pool",
+           "ACTIVE", "QUARANTINED", "WARMING"]
+
+_log = logging.getLogger("mxnet_tpu.serving")
+
+ACTIVE = "active"
+QUARANTINED = "quarantined"
+WARMING = "warming"
+
+_STATE_GAUGE = {ACTIVE: 0, QUARANTINED: 1, WARMING: 2}
+
+
+class QuotaExceeded(Overloaded):
+    """The tenant's outstanding-request quota is exhausted (HTTP 429);
+    other tenants are unaffected — that is the point of quotas."""
+
+
+
+
+class Replica:
+    """One pool member: the engine plus its health/routing bookkeeping
+    (all mutable fields guarded by the POOL lock)."""
+
+    __slots__ = ("rid", "device", "engine", "weight", "state", "failures",
+                 "routed")
+
+    def __init__(self, rid, device, engine, weight):
+        self.rid = rid
+        self.device = device
+        self.engine = engine
+        self.weight = float(weight)
+        if self.weight <= 0:
+            raise MXNetError("replica weight must be > 0")
+        self.state = ACTIVE
+        self.failures = 0
+        self.routed = 0
+
+
+class ReplicaPool:
+    """N routed replicas behind one ``generate()`` surface.
+
+    Parameters
+    ----------
+    factory : callable(device, replica_id) -> engine
+        Builds one replica; the engine must expose ``submit(prompt,
+        ..., on_done=)``, ``pending_rows``, ``describe``, ``stop``,
+        ``rewarm``, ``start``, ``close`` and accept health hooks via
+        ``set_health_hooks`` (what :class:`DecodeEngine` provides —
+        see :func:`lm_pool`).
+    n_replicas : int
+        Pool size; devices are assigned round-robin from ``devices``
+        (default ``jax.devices()``).
+    weights : sequence of float, optional
+        Per-replica routing weights (default all 1.0): routing picks
+        the ACTIVE replica minimizing ``outstanding / weight``.
+    quotas : dict, optional
+        ``tenant -> max outstanding sessions``; key ``"*"`` is the
+        default for unlisted tenants (absent = unlimited).
+    max_outstanding : int
+        Pool-wide admission bound (``MXNET_POOL_MAX_OUTSTANDING``;
+        default: the summed replica capacity).
+    priority_floor / priority_watermark :
+        Past ``priority_watermark * max_outstanding`` outstanding
+        sessions, requests with ``priority < priority_floor`` are shed
+        (reason ``priority``) so high-priority traffic keeps flowing
+        under pressure.
+    quarantine_after : int
+        Consecutive step failures before a replica is quarantined
+        (``MXNET_POOL_QUARANTINE_AFTER``, default 3).
+    """
+
+    def __init__(self, factory, n_replicas=2, devices=None, *, name="lm",
+                 version=1, weights=None, quotas=None, max_outstanding=None,
+                 priority_floor=5, priority_watermark=0.75,
+                 quarantine_after=None):
+        import jax
+
+        if n_replicas < 1:
+            raise MXNetError("pool needs >= 1 replica")
+        self.name = name
+        self.version = int(version)
+        devices = list(devices) if devices is not None else jax.devices()
+        if not devices:
+            raise MXNetError("no devices for the replica pool")
+        weights = list(weights) if weights is not None \
+            else [1.0] * n_replicas
+        if len(weights) != n_replicas:
+            raise MXNetError("got %d weights for %d replicas"
+                             % (len(weights), n_replicas))
+        self._lock = threading.Lock()
+        self._quotas = dict(quotas or {})
+        self._priority_floor = int(priority_floor)
+        self._quarantine_after = int(quarantine_after) \
+            if quarantine_after is not None \
+            else _env_int("MXNET_POOL_QUARANTINE_AFTER", 3)
+        self._outstanding = {}
+        self._tenant_out = {}
+        self._total_outstanding = 0
+        self._closed = False
+        if any(float(w) <= 0 for w in weights):
+            # validate BEFORE building engines: a bad weight must not
+            # cost k warmed-and-leaked replicas
+            raise MXNetError("replica weights must be > 0, got %r"
+                             % (weights,))
+        # replicas list is immutable after init (only their fields
+        # mutate, under the pool lock)
+        self.replicas = []
+        try:
+            for i in range(n_replicas):
+                dev = devices[i % len(devices)]
+                engine = factory(dev, str(i))
+                if hasattr(engine, "set_health_hooks"):
+                    engine.set_health_hooks(
+                        on_error=self._make_error_hook(i),
+                        on_ok=self._make_ok_hook(i))
+                self.replicas.append(Replica(i, dev, engine, weights[i]))
+                self._outstanding[i] = 0
+        except Exception:
+            # a replica k>0 failing to build (device OOM, ...) must not
+            # leak the already-running earlier replicas' worker threads
+            # and device-resident caches
+            for r in self.replicas:
+                try:
+                    r.engine.close(drain=False)
+                except Exception:  # noqa: broad-except — best-effort
+                    # cleanup on the failure path
+                    pass
+            raise
+        cap = sum(getattr(r.engine, "slots", 0)
+                  + getattr(r.engine, "max_queue", 0)
+                  for r in self.replicas)
+        env_max = _env_int("MXNET_POOL_MAX_OUTSTANDING", 0)
+        self._max_outstanding = int(max_outstanding) \
+            if max_outstanding is not None \
+            else (env_max or max(cap, n_replicas))
+        # never floor to 0: an idle tiny pool must not shed low-priority
+        # traffic before a single request is outstanding
+        self._watermark = max(1, int(priority_watermark
+                                     * self._max_outstanding))
+        for r in self.replicas:
+            _telemetry.inc("serving.pool.routed.count", 0,
+                           model=name, replica=str(r.rid))
+            _telemetry.set_gauge("serving.pool.outstanding", 0,
+                                 model=name, replica=str(r.rid))
+            _telemetry.set_gauge("serving.pool.replica_state",
+                                 _STATE_GAUGE[ACTIVE], model=name,
+                                 replica=str(r.rid))
+        _telemetry.inc("serving.pool.quarantines.count", 0, model=name)
+        for reason in ("quota", "priority"):
+            _telemetry.inc("serving.shed.count", 0, model=name,
+                           reason=reason)
+
+    def _make_error_hook(self, rid):
+        return lambda exc: self._note_step_error(rid, exc)
+
+    def _make_ok_hook(self, rid):
+        return lambda: self._note_step_ok(rid)
+
+    # -- routing -----------------------------------------------------------
+    def generate(self, prompt, *, max_new_tokens=16, temperature=0.0,
+                 deadline_ms=None, on_token=None, tenant=None, priority=5):
+        """Admit + route one generation request; returns the replica
+        engine's :class:`~mxnet_tpu.serving.decode.GenerateSession`.
+
+        Shedding order (all typed, all counted under
+        ``serving.shed.count{model=,reason=}``): pool ``Overloaded``
+        past ``max_outstanding``; ``priority`` past the watermark for
+        requests under the floor; ``quota`` for tenants at their bound;
+        then the chosen replica's own engine admission applies."""
+        tenant_key = tenant if tenant is not None else "*"
+        with self._lock:
+            if self._closed:
+                raise MXNetError("replica pool %r is closed" % self.name)
+            if self._total_outstanding >= self._max_outstanding:
+                _telemetry.inc("serving.shed.count", model=self.name,
+                               reason="overload")
+                raise Overloaded(
+                    "pool %r overloaded: %d outstanding >= bound %d"
+                    % (self.name, self._total_outstanding,
+                       self._max_outstanding))
+            if self._total_outstanding >= self._watermark \
+                    and int(priority) < self._priority_floor:
+                _telemetry.inc("serving.shed.count", model=self.name,
+                               reason="priority")
+                raise Overloaded(
+                    "pool %r past its priority watermark (%d/%d): "
+                    "priority %d < floor %d shed"
+                    % (self.name, self._total_outstanding,
+                       self._watermark, priority, self._priority_floor))
+            quota = self._quotas.get(tenant_key, self._quotas.get("*"))
+            if quota is not None \
+                    and self._tenant_out.get(tenant_key, 0) >= int(quota):
+                _telemetry.inc("serving.shed.count", model=self.name,
+                               reason="quota")
+                raise QuotaExceeded(
+                    "tenant %r at its quota of %d outstanding requests"
+                    % (tenant_key, int(quota)))
+            healthy = [r for r in self.replicas if r.state == ACTIVE]
+            if not healthy:
+                _telemetry.inc("serving.shed.count", model=self.name,
+                               reason="overload")
+                raise Overloaded("pool %r has no healthy replicas "
+                                 "(all quarantined/warming)" % self.name)
+            r = min(healthy,
+                    key=lambda x: self._outstanding[x.rid] / x.weight)
+            self._outstanding[r.rid] += 1
+            self._tenant_out[tenant_key] = \
+                self._tenant_out.get(tenant_key, 0) + 1
+            self._total_outstanding += 1
+            r.routed += 1
+            _telemetry.inc("serving.pool.routed.count", model=self.name,
+                           replica=str(r.rid))
+            _telemetry.set_gauge("serving.pool.outstanding",
+                                 self._outstanding[r.rid],
+                                 model=self.name, replica=str(r.rid))
+        try:
+            sess = r.engine.submit(
+                prompt, max_new_tokens=max_new_tokens,
+                temperature=temperature, deadline_ms=deadline_ms,
+                on_token=on_token,
+                on_done=self._make_done_hook(r.rid, tenant_key))
+        except Exception:
+            self._settle(r.rid, tenant_key)
+            raise
+        return sess
+
+    def _make_done_hook(self, rid, tenant_key):
+        return lambda _sess: self._settle(rid, tenant_key)
+
+    def _settle(self, rid, tenant_key):
+        with self._lock:
+            self._outstanding[rid] = max(0, self._outstanding[rid] - 1)
+            self._tenant_out[tenant_key] = \
+                max(0, self._tenant_out.get(tenant_key, 0) - 1)
+            self._total_outstanding = max(0, self._total_outstanding - 1)
+            out = self._outstanding[rid]
+        _telemetry.set_gauge("serving.pool.outstanding", out,
+                             model=self.name, replica=str(rid))
+
+    # -- replica health ----------------------------------------------------
+    def _note_step_error(self, rid, exc):
+        rewarm = False
+        r = self.replicas[rid]
+        with self._lock:
+            r.failures += 1
+            if r.state == ACTIVE and r.failures >= self._quarantine_after:
+                r.state = QUARANTINED
+                rewarm = True
+        if rewarm:
+            _telemetry.inc("serving.pool.quarantines.count",
+                           model=self.name)
+            _telemetry.set_gauge("serving.pool.replica_state",
+                                 _STATE_GAUGE[QUARANTINED],
+                                 model=self.name, replica=str(rid))
+            _telemetry.event("serving.pool.quarantine", model=self.name,
+                             replica=str(rid), failures=r.failures,
+                             error=str(exc))
+            _log.warning("pool %r: replica %d quarantined after %d "
+                         "consecutive step failures (%s); re-warming in "
+                         "the background", self.name, rid, r.failures,
+                         exc)
+            threading.Thread(target=self._rewarm, args=(rid,),
+                             name="pool-rewarm-%s-%d" % (self.name, rid),
+                             daemon=True).start()
+
+    def _note_step_ok(self, rid):
+        r = self.replicas[rid]
+        with self._lock:
+            r.failures = 0
+
+    def _rewarm(self, rid):
+        """Background quarantine recovery: shed what the replica holds,
+        rebuild its compiled state through the warm-up path (persistent-
+        cache loads when the PR 7 cache is armed), then return it to
+        routing."""
+        r = self.replicas[rid]
+        with self._lock:
+            if self._closed:
+                # the pool was swapped out while the re-warm was
+                # pending; the engine-level closed guard catches the
+                # narrower race after this check
+                return
+            r.state = WARMING
+        _telemetry.set_gauge("serving.pool.replica_state",
+                             _STATE_GAUGE[WARMING], model=self.name,
+                             replica=str(rid))
+        try:
+            r.engine.stop(drain=False)
+            r.engine.rewarm()
+            r.engine.start()
+        except Exception as e:  # noqa: broad-except — a failed re-warm
+            # must leave the replica quarantined (and the pool serving on
+            # the others), never kill the recovery thread with the
+            # replica stuck WARMING
+            with self._lock:
+                r.state = QUARANTINED
+            _telemetry.set_gauge("serving.pool.replica_state",
+                                 _STATE_GAUGE[QUARANTINED],
+                                 model=self.name, replica=str(rid))
+            _telemetry.event("serving.pool.rewarm_failed",
+                             model=self.name, replica=str(rid),
+                             error=str(e))
+            _log.error("pool %r: re-warm of replica %d failed: %s",
+                       self.name, rid, e)
+            return
+        with self._lock:
+            r.state = ACTIVE
+            r.failures = 0
+        _telemetry.set_gauge("serving.pool.replica_state",
+                             _STATE_GAUGE[ACTIVE], model=self.name,
+                             replica=str(rid))
+        _telemetry.event("serving.pool.rewarmed", model=self.name,
+                         replica=str(rid))
+        _log.info("pool %r: replica %d re-warmed and back in routing",
+                  self.name, rid)
+
+    # -- registry servable surface ----------------------------------------
+    def pending_rows(self):
+        """Queued + active sequences across every replica — the
+        graceful-drain quiescence probe."""
+        return sum(r.engine.pending_rows() for r in self.replicas)
+
+    def outstanding(self):
+        with self._lock:
+            return self._total_outstanding
+
+    def describe(self):
+        with self._lock:
+            reps = [dict(r.engine.describe(), state=r.state,
+                         failures=r.failures, routed=r.routed,
+                         outstanding=self._outstanding[r.rid],
+                         weight=r.weight)
+                    for r in self.replicas]
+            total = self._total_outstanding
+            tenants = dict(self._tenant_out)
+        return {"name": self.name, "version": self.version,
+                "kind": "generate", "replicas": reps,
+                "outstanding": total,
+                "max_outstanding": self._max_outstanding,
+                "priority_floor": self._priority_floor,
+                "quotas": dict(self._quotas),
+                "tenants_outstanding": tenants}
+
+    def close(self, drain=True):
+        """Drain (by default) and permanently stop every replica — what
+        the registry calls on the OLD pool after a pointer-flip swap.
+        Returns True when every replica drained cleanly (False when any
+        session was shed — shed sessions carry a typed error, they are
+        never silently dropped)."""
+        with self._lock:
+            self._closed = True
+        clean = True
+        for r in self.replicas:
+            try:
+                if r.engine.close(drain=drain) is False:
+                    clean = False
+            except Exception:  # noqa: broad-except — closing one dead
+                # replica must not leak the others
+                clean = False
+                _log.warning("pool %r: close of replica %d failed",
+                             self.name, r.rid, exc_info=True)
+        return clean
+
+
+def lm_pool(cfg, params, n_replicas=2, devices=None, *, name="lm",
+            version=1, engine_opts=None, **pool_opts):
+    """Build a :class:`ReplicaPool` of
+    :class:`~mxnet_tpu.serving.decode.DecodeEngine` replicas over a
+    :mod:`~mxnet_tpu.models.transformer_lm` — the standard LM-serving
+    stack (each replica gets the params committed to ITS device)."""
+    opts = dict(engine_opts or {})
+
+    def factory(device, replica_id):
+        return DecodeEngine(cfg, params, device=device, name=name,
+                            replica=replica_id, autostart=True, **opts)
+
+    return ReplicaPool(factory, n_replicas=n_replicas, devices=devices,
+                       name=name, version=version, **pool_opts)

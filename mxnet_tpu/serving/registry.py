@@ -146,6 +146,10 @@ class ServedModel:
         #: (what the registry persists for the next one)
         self._warmup_manifest = warmup_manifest
         self.warmup_entries = []
+        #: warm-up compile accounting for ``describe()`` (None when the
+        #: persistent compile cache is disabled)
+        self.warmup_cold_compiles = None
+        self.warmup_cache_loads = None
         self._pred = _predict.Predictor(
             symbol_json, param_blob,
             {data_name: (self.buckets[-1],) + self.input_shape}, ctx=ctx)
@@ -205,6 +209,8 @@ class ServedModel:
                                  model=self.name)
             _telemetry.set_gauge("serving.warmup.cache_loads", warm,
                                  model=self.name)
+        self.warmup_cold_compiles = cold
+        self.warmup_cache_loads = warm
         self._verify_warmup_fingerprints()
         _telemetry.event("serving.model.warm", model=self.name,
                          version=self.version, buckets=len(self.buckets),
@@ -244,6 +250,24 @@ class ServedModel:
             self._pred.set_input(self.data_name, rows)
             self._pred.forward()
             return self._pred.get_output(0)
+
+    def pending_rows(self):
+        """Rows queued or inside a device dispatch — the graceful-drain
+        quiescence probe (uniform across servable kinds; pools expose
+        the same method)."""
+        return self.batcher.pending_rows()
+
+    def describe(self):
+        """Structured model card for ``GET /models`` and the per-model
+        ``/healthz`` detail."""
+        return {"name": self.name, "version": self.version,
+                "kind": "predict", "buckets": list(self.buckets),
+                "input_shape": list(self.input_shape),
+                "data_name": self.data_name,
+                "pending_rows": self.batcher.pending_rows(),
+                "warmup": {"entries": len(self.warmup_entries),
+                           "cold_compiles": self.warmup_cold_compiles,
+                           "cache_loads": self.warmup_cache_loads}}
 
     def predict(self, data, deadline_ms=None,
                 timeout=DynamicBatcher.DEFAULT_TIMEOUT):
@@ -311,6 +335,41 @@ class ModelRegistry:
         return model
 
     reload = load
+
+    def register(self, name, servable, version=None):
+        """Pointer-flip swap of an ALREADY-BUILT servable (a
+        :class:`~mxnet_tpu.serving.pool.ReplicaPool`, a
+        :class:`ServedModel` constructed off-registry, or anything
+        exposing ``version``/``close``/``describe``): the caller builds
+        and warms the new version outside the registry — replicas,
+        engines, compiled programs, everything — then this swaps it in
+        under the registry lock and drains the old one.  No request
+        ever sees a half-swapped model; stragglers holding the old
+        reference get its typed closed error, not a hang."""
+        if version is not None:
+            servable.version = int(version)
+        # healthz/models key by servable.name: the registration name is
+        # authoritative (build the servable with the same name so its
+        # telemetry labels agree — the stamp covers the mismatch case)
+        servable.name = name
+        with self._lock:
+            prev = self._models.get(name)
+            if version is None:
+                # bare engines carry no version of their own: the
+                # registry stamps one so every servable answers
+                # .version uniformly
+                servable.version = prev.version + 1 if prev is not None \
+                    else int(getattr(servable, "version", 1))
+            self._models[name] = servable
+        if prev is not None:
+            prev.close()
+        _telemetry.inc("serving.model.loads", model=name)
+        _telemetry.event("serving.model.load", model=name,
+                         version=servable.version)
+        logging.info("serving: servable %r v%d registered (%s)",
+                     name, servable.version,
+                     type(servable).__name__)
+        return servable
 
     @staticmethod
     def _read_manifest(model_dir):

@@ -1,0 +1,717 @@
+"""Continuous-batching decode tier (docs/serving.md "Continuous batching
+& replica pool"): decode-vs-forward parity, slot lifecycle, mid-decode
+admission, shedding/quotas/priority, replica quarantine + re-warm,
+pointer-flip version swaps, the HTTP /generate + /models surface, the
+compile-count acceptance demo (one prefill compile per bucket per
+replica + one decode-step compile per replica at warm-up, ZERO during
+traffic), and the SIGTERM-drain chaos half (in-flight sequences finish
+or are shed with a typed error — never silently dropped)."""
+
+import json
+import os
+import signal
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import faults, telemetry
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.models import transformer_lm as tlm
+from mxnet_tpu.serving import (DeadlineExceeded, DecodeEngine,
+                               InvalidRequest, ModelRegistry, Overloaded,
+                               QuotaExceeded, ReplicaPool,
+                               ServingHTTPServer, lm_pool)
+
+# tiny LM: every compile stays sub-second on the CPU CI host
+VOCAB, EMBED, HEADS, LAYERS, FFN, MAX_LEN = 32, 16, 2, 2, 32, 32
+#: eos_id == vocab is unreachable (samples are 0..vocab-1): generation
+#: lengths become deterministic — what the lifecycle tests need
+CFG_NO_EOS = tlm.LMConfig(VOCAB, EMBED, HEADS, LAYERS, FFN, MAX_LEN,
+                          eos_id=VOCAB)
+CFG_EOS = tlm.LMConfig(VOCAB, EMBED, HEADS, LAYERS, FFN, MAX_LEN,
+                       eos_id=2)
+PARAMS = tlm.init_params(CFG_NO_EOS, seed=3)
+PROMPT = [5, 7, 9, 2]
+ENGINE_OPTS = {"slots": 4, "prefill_buckets": (4, 8), "max_queue": 64}
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    faults.disarm()
+    telemetry.reset()
+    telemetry.enable()
+    yield
+    faults.disarm()
+    telemetry.disable()
+    telemetry.reset()
+
+
+def _engine(cfg=CFG_NO_EOS, **kw):
+    opts = dict(ENGINE_OPTS)
+    opts.update(kw)
+    return DecodeEngine(cfg, PARAMS, name="lm", **opts)
+
+
+def _compiles():
+    c = telemetry.snapshot()["counters"].get("xla.compile.count", {})
+    return (c.get("kind=decode_prefill", 0), c.get("kind=decode_step", 0))
+
+
+# -- engine: correctness ----------------------------------------------------
+
+def test_greedy_decode_matches_full_forward():
+    """The slot decode path is bit-compatible with teacher forcing:
+    greedy generation == iterated argmax of the full forward."""
+    import jax.numpy as jnp
+
+    eng = _engine()
+    try:
+        out = eng.generate(PROMPT, max_new_tokens=6, timeout=120)
+        ref_tokens = list(PROMPT)
+        for _ in range(6):
+            logits = tlm.forward_logits(
+                CFG_NO_EOS, PARAMS,
+                jnp.asarray(np.array([ref_tokens], np.int32)))
+            ref_tokens.append(int(jnp.argmax(logits[0, -1])))
+        assert out == ref_tokens[len(PROMPT):]
+    finally:
+        eng.close()
+
+
+def test_eos_retires_early_and_is_included():
+    """With a reachable EOS the sequence stops at it (EOS is the last
+    token) instead of running to max_new_tokens; either way the decode
+    path tracks the teacher-forcing reference exactly."""
+    import jax.numpy as jnp
+
+    ref, toks = [], list(PROMPT)
+    for _ in range(20):
+        logits = tlm.forward_logits(
+            CFG_EOS, PARAMS, jnp.asarray(np.array([toks], np.int32)))
+        nxt = int(jnp.argmax(logits[0, -1]))
+        ref.append(nxt)
+        toks.append(nxt)
+        if nxt == CFG_EOS.eos_id:
+            break
+    eng = _engine(cfg=CFG_EOS)
+    try:
+        out = eng.generate(PROMPT, max_new_tokens=20, timeout=120)
+        assert out == ref
+        if CFG_EOS.eos_id in out:
+            assert out[-1] == CFG_EOS.eos_id and len(out) < 20
+    finally:
+        eng.close()
+
+
+def test_temperature_stream_is_seeded_and_valid():
+    """Temperature sampling draws through mx.random key material: same
+    seed => same stream, and every token is a valid id."""
+    mx.random.seed(11)
+    eng = _engine()
+    try:
+        a = eng.generate(PROMPT, max_new_tokens=8, temperature=0.8,
+                         timeout=120)
+    finally:
+        eng.close()
+    mx.random.seed(11)
+    eng = _engine()
+    try:
+        b = eng.generate(PROMPT, max_new_tokens=8, temperature=0.8,
+                         timeout=120)
+    finally:
+        eng.close()
+    assert a == b and len(a) == 8
+    assert all(0 <= t < VOCAB for t in a)
+
+
+def test_invalid_requests_fail_at_submit():
+    eng = _engine()
+    try:
+        with pytest.raises(InvalidRequest):
+            eng.submit([], max_new_tokens=3)
+        with pytest.raises(InvalidRequest):
+            eng.submit(list(range(1, 10)), max_new_tokens=3)  # > bucket 8
+        with pytest.raises(InvalidRequest):
+            eng.submit([VOCAB + 3], max_new_tokens=3)  # bad token id
+        with pytest.raises(InvalidRequest):
+            eng.submit(PROMPT, max_new_tokens=0)
+        with pytest.raises(InvalidRequest):
+            eng.submit(PROMPT, max_new_tokens=3, temperature=-1.0)
+    finally:
+        eng.close()
+
+
+# -- engine: continuous batching lifecycle ----------------------------------
+
+def test_mid_decode_admission_joins_running_batch():
+    """THE continuous-batching property: a request submitted while a
+    long generation is mid-flight gets a free slot BETWEEN steps and
+    finishes long before the running sequence does — it never waits for
+    the batch to complete."""
+    eng = _engine(slots=2)
+    try:
+        a = eng.submit(PROMPT, max_new_tokens=25)
+        deadline = time.monotonic() + 60
+        while len(a.tokens) < 5:
+            assert time.monotonic() < deadline, "A never started decoding"
+            time.sleep(0.005)
+        b = eng.submit([3, 4], max_new_tokens=3)
+        out_b = b.result(60)
+        assert len(out_b) == 3
+        # B completed while A was still decoding: it joined the running
+        # batch instead of queueing behind it
+        assert not a.done()
+        out_a = a.result(120)
+        assert len(out_a) == 25
+        assert b.admit_step > a.admit_step > 0 or a.admit_step == 0
+        assert b.done_step < a.done_step
+    finally:
+        eng.close()
+
+
+def test_streaming_callback_receives_every_token_in_order():
+    got = []
+    eng = _engine()
+    try:
+        sess = eng.submit(PROMPT, max_new_tokens=6, on_token=got.append)
+        out = sess.result(60)
+        assert got == out and len(out) == 6
+        assert sess.ttft() is not None and sess.ttft() >= 0
+    finally:
+        eng.close()
+
+
+def test_cancel_mid_generation_frees_the_slot():
+    eng = _engine(slots=1)
+    try:
+        a = eng.submit(PROMPT, max_new_tokens=200)
+        deadline = time.monotonic() + 60
+        while len(a.tokens) < 3:
+            assert time.monotonic() < deadline
+            time.sleep(0.005)
+        assert a.cancel() is True
+        with pytest.raises(MXNetError):
+            a.result(30)
+        # the slot frees at the next step boundary: a follow-up request
+        # is served promptly despite slots=1
+        out = eng.generate([3, 4], max_new_tokens=2, timeout=60)
+        assert len(out) == 2
+        assert telemetry.counter_total("serving.shed.count") >= 1
+    finally:
+        eng.close()
+
+
+def test_queue_overload_and_deadline_shed():
+    # engines that never start serve as deterministic queue holders
+    eng = _engine(max_queue=2, autostart=False)
+    try:
+        eng.submit(PROMPT, max_new_tokens=2)
+        eng.submit(PROMPT, max_new_tokens=2)
+        with pytest.raises(Overloaded):
+            eng.submit(PROMPT, max_new_tokens=2)
+    finally:
+        eng.close(drain=False)
+    # a queued session whose deadline lapses before a slot frees is shed
+    # with DeadlineExceeded at admission time
+    eng = _engine(slots=1, autostart=False)
+    try:
+        slow = eng.submit(PROMPT, max_new_tokens=8)
+        doomed = eng.submit(PROMPT, max_new_tokens=8, deadline_ms=1.0)
+        time.sleep(0.05)
+        eng.start()
+        slow.result(60)
+        with pytest.raises(DeadlineExceeded):
+            doomed.result(60)
+    finally:
+        eng.close()
+
+
+def test_decode_fault_fails_batch_and_engine_survives():
+    """The serving.decode fault point kills one step: every active
+    session gets the error, the worker survives and serves the next
+    request from a clean slot state."""
+    eng = _engine()
+    try:
+        faults.arm("serving.decode", at=1)
+        sess = eng.submit(PROMPT, max_new_tokens=6)
+        with pytest.raises(faults.FaultInjected):
+            sess.result(60)
+        faults.disarm()
+        out = eng.generate(PROMPT, max_new_tokens=6, timeout=60)
+        assert len(out) == 6
+        assert telemetry.counter_total("serving.error.count") == 1
+    finally:
+        faults.disarm()
+        eng.close()
+
+
+def test_telemetry_families_present_after_traffic():
+    eng = _engine()
+    try:
+        eng.generate(PROMPT, max_new_tokens=5, timeout=60)
+        snap = telemetry.snapshot()
+        for fam in ("serving.decode.sessions.count",
+                    "serving.decode.tokens.count",
+                    "serving.decode.steps.count"):
+            assert fam in snap["counters"], fam
+        for fam in ("serving.decode.slot_occupancy",
+                    "serving.decode.tokens_per_sec"):
+            assert fam in snap["gauges"], fam
+        for fam in ("serving.decode.ttft_seconds",
+                    "serving.decode.token_latency_seconds"):
+            assert fam in snap["histograms"], fam
+        assert telemetry.counter_total(
+            "serving.decode.tokens.count") >= 5
+    finally:
+        eng.close()
+
+
+# -- pool: routing, quotas, priority, health --------------------------------
+
+def _held_pool(**pool_kw):
+    """Pool over never-started engines: submissions queue forever —
+    deterministic outstanding counts for admission-policy tests."""
+    def factory(device, rid):
+        return DecodeEngine(CFG_NO_EOS, PARAMS, device=device, name="lm",
+                            replica=rid, autostart=False, **ENGINE_OPTS)
+
+    return ReplicaPool(factory, n_replicas=2, name="lm", **pool_kw)
+
+
+def test_pool_routes_by_weighted_least_outstanding():
+    pool = _held_pool(weights=(1.0, 3.0))
+    try:
+        for _ in range(8):
+            pool.generate(PROMPT, max_new_tokens=2)
+        # weight 3 replica absorbs ~3x the sessions
+        assert pool._outstanding[1] == 6 and pool._outstanding[0] == 2
+        assert [r.routed for r in pool.replicas] == [2, 6]
+    finally:
+        pool.close(drain=False)
+
+
+def test_pool_tenant_quotas_and_priority_shedding():
+    pool = _held_pool(quotas={"small": 2}, max_outstanding=10,
+                      priority_watermark=0.5, priority_floor=5)
+    try:
+        pool.generate(PROMPT, max_new_tokens=2, tenant="small")
+        pool.generate(PROMPT, max_new_tokens=2, tenant="small")
+        with pytest.raises(QuotaExceeded):
+            pool.generate(PROMPT, max_new_tokens=2, tenant="small")
+        # other tenants are unaffected by the exhausted quota
+        for _ in range(3):
+            pool.generate(PROMPT, max_new_tokens=2, tenant="big")
+        # 5 outstanding >= watermark 5: low priority sheds, high flows
+        with pytest.raises(Overloaded):
+            pool.generate(PROMPT, max_new_tokens=2, priority=0)
+        pool.generate(PROMPT, max_new_tokens=2, priority=9)
+        # hard bound still applies to everyone
+        for _ in range(4):
+            pool.generate(PROMPT, max_new_tokens=2, priority=9)
+        with pytest.raises(Overloaded):
+            pool.generate(PROMPT, max_new_tokens=2, priority=9)
+        shed = telemetry.snapshot()["counters"]["serving.shed.count"]
+        assert shed.get("model=lm,reason=quota") == 1
+        assert shed.get("model=lm,reason=priority") == 1
+        assert shed.get("model=lm,reason=overload") == 1
+    finally:
+        pool.close(drain=False)
+
+
+def test_pool_quarantines_failing_replica_and_rewarms():
+    """quarantine_after consecutive step failures quarantine the
+    replica (routing skips it), a background re-warm brings it back,
+    and traffic succeeds end to end afterwards."""
+    pool = lm_pool(CFG_NO_EOS, PARAMS, n_replicas=2, name="lm",
+                   engine_opts=ENGINE_OPTS)
+    try:
+        faults.arm("serving.decode", at=1, count=8)
+        outcomes = []
+        for _ in range(8):
+            try:
+                sess = pool.generate(PROMPT, max_new_tokens=6)
+                try:
+                    sess.result(30)
+                    outcomes.append("ok")
+                except Exception as e:
+                    outcomes.append(type(e).__name__)
+            except Overloaded:
+                outcomes.append("no-healthy-replica")
+            time.sleep(0.05)
+        faults.disarm()
+        assert "FaultInjected" in outcomes
+        assert telemetry.counter_total(
+            "serving.pool.quarantines.count") >= 1
+        deadline = time.monotonic() + 60
+        while any(r.state != "active" for r in pool.replicas):
+            assert time.monotonic() < deadline, \
+                [r.state for r in pool.replicas]
+            time.sleep(0.05)
+        out = pool.generate(PROMPT, max_new_tokens=4).result(60)
+        assert len(out) == 4
+        events = [e for e in telemetry.events_recent(200)
+                  if e["event"] == "serving.pool.quarantine"]
+        assert events, "quarantine must emit a telemetry event"
+    finally:
+        faults.disarm()
+        pool.close(drain=False)
+
+
+def test_registry_register_is_a_pointer_flip_version_swap():
+    reg = ModelRegistry()
+    v1 = lm_pool(CFG_NO_EOS, PARAMS, n_replicas=1, name="lm",
+                 engine_opts=ENGINE_OPTS)
+    reg.register("lm", v1)
+    assert reg.get("lm") is v1 and v1.version == 1
+    s = reg.get("lm").generate(PROMPT, max_new_tokens=3)
+    assert len(s.result(60)) == 3
+    # build v2 entirely off-registry, then flip the pointer
+    v2 = lm_pool(CFG_NO_EOS, PARAMS, n_replicas=1, name="lm",
+                 engine_opts=ENGINE_OPTS)
+    reg.register("lm", v2)
+    assert reg.get("lm") is v2 and v2.version == 2
+    # the old version is drained+closed: stragglers get a typed error,
+    # not a hang
+    with pytest.raises(MXNetError):
+        v1.generate(PROMPT, max_new_tokens=2)
+    out = reg.get("lm").generate(PROMPT, max_new_tokens=3).result(60)
+    assert len(out) == 3
+    reg.close()
+
+
+# -- HTTP surface -----------------------------------------------------------
+
+def _post(url, payload, timeout=120):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    return json.load(urllib.request.urlopen(req, timeout=timeout))
+
+
+def test_http_generate_stream_models_and_healthz_detail():
+    import http.client
+
+    pool = lm_pool(CFG_NO_EOS, PARAMS, n_replicas=2, name="lm",
+                   engine_opts=ENGINE_OPTS)
+    reg = ModelRegistry()
+    reg.register("lm", pool, version=1)
+    srv = ServingHTTPServer(reg, port=0).start()
+    try:
+        resp = _post(srv.url + "/generate",
+                     {"model": "lm", "prompt": PROMPT,
+                      "max_new_tokens": 6})
+        assert resp["model"] == "lm" and resp["version"] == 1
+        assert resp["n_tokens"] == 6 and len(resp["tokens"]) == 6
+        assert resp["ttft_ms"] is not None
+
+        # chunked ndjson streaming: one line per token, then a summary
+        conn = http.client.HTTPConnection(srv.host, srv.port, timeout=120)
+        conn.request("POST", "/generate",
+                     json.dumps({"model": "lm", "prompt": PROMPT,
+                                 "max_new_tokens": 6, "stream": True}),
+                     {"Content-Type": "application/json"})
+        r = conn.getresponse()
+        assert r.status == 200
+        assert r.getheader("Transfer-Encoding") == "chunked"
+        lines = [json.loads(ln) for ln in
+                 r.read().decode().strip().split("\n")]
+        conn.close()
+        assert [ln["token"] for ln in lines[:-1]] == lines[-1]["tokens"]
+        assert lines[-1]["done"] is True and lines[-1]["n_tokens"] == 6
+
+        listing = json.load(urllib.request.urlopen(srv.url + "/models",
+                                                   timeout=30))
+        (card,) = listing["models"]
+        assert card["kind"] == "generate" and card["name"] == "lm"
+        assert [r_["state"] for r_ in card["replicas"]] == \
+            ["active", "active"]
+        health = json.load(urllib.request.urlopen(srv.url + "/healthz",
+                                                  timeout=30))
+        assert health["models"] == {"lm": 1}
+        assert health["detail"]["lm"]["kind"] == "generate"
+
+        # error mapping: bad prompt 400, /generate on nothing 404,
+        # /predict on a decode servable 400 (typed, not a 500),
+        # non-string model 400
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(srv.url + "/generate", {"model": "lm", "prompt": []})
+        assert e.value.code == 400
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(srv.url + "/generate", {"model": "nope",
+                                          "prompt": PROMPT})
+        assert e.value.code == 404
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(srv.url + "/predict", {"model": "lm",
+                                         "data": [[0.0]]})
+        assert e.value.code == 400
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(srv.url + "/generate", {"model": ["lm"],
+                                          "prompt": PROMPT})
+        assert e.value.code == 400
+    finally:
+        srv.stop()
+        reg.close()
+
+
+def test_acceptance_64_concurrent_generate_compile_arithmetic():
+    """ISSUE 9 acceptance demo: a 2-replica pool serves 64 concurrent
+    /generate requests with mixed prompt/output lengths on exactly ONE
+    prefill compile per bucket per replica + ONE decode-step compile
+    per replica, all at warm-up — and ZERO compiles during traffic."""
+    pool = lm_pool(CFG_NO_EOS, PARAMS, n_replicas=2, name="lm",
+                   engine_opts=ENGINE_OPTS)
+    prefill0, step0 = _compiles()
+    assert prefill0 == len(ENGINE_OPTS["prefill_buckets"]) * 2, \
+        "one prefill compile per bucket per replica at warm-up"
+    assert step0 == 2, "one decode-step compile per replica at warm-up"
+
+    reg = ModelRegistry()
+    reg.register("lm", pool, version=1)
+    srv = ServingHTTPServer(reg, port=0).start()
+    rs = np.random.RandomState(0)
+    # prompts pre-drawn before the threads start: RandomState is not
+    # thread-safe (same rule bench_extra.py documents)
+    prompts = [[int(t) for t in
+                rs.randint(0, VOCAB, size=1 + int(rs.randint(0, 8)))]
+               for _ in range(64)]
+    results, errors = [None] * 64, []
+    lock = threading.Lock()
+
+    def client(i):
+        prompt = prompts[i]               # mixed prompt lengths 1..8
+        want = 1 + i % 6                  # mixed output lengths 1..6
+        try:
+            resp = _post(srv.url + "/generate",
+                         {"model": "lm", "prompt": prompt,
+                          "max_new_tokens": want, "timeout_s": 120})
+            with lock:
+                results[i] = (want, resp)
+        except Exception as e:  # pragma: no cover - failure detail
+            with lock:
+                errors.append((i, e))
+
+    try:
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(64)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300)
+        assert not errors, errors[:3]
+        for want, resp in results:
+            assert resp["n_tokens"] == want, resp
+            assert all(0 <= t < VOCAB for t in resp["tokens"])
+        assert _compiles() == (prefill0, step0), \
+            "traffic phase must not compile anything"
+        # the pool actually spread the load
+        routed = [r.routed for r in pool.replicas]
+        assert sum(routed) == 64 and all(n > 0 for n in routed), routed
+        assert telemetry.counter_total(
+            "serving.decode.tokens.count") >= 64
+    finally:
+        srv.stop()
+        reg.close()
+
+
+# -- SIGTERM drain chaos (ci/run_chaos.sh decode half) ----------------------
+
+def test_sigterm_drain_finishes_inflight_decode_sessions():
+    """run_forever + real SIGTERM while sessions are mid-decode: drain
+    stops admission, every in-flight sequence FINISHES under the
+    deadline, and the server exits cleanly."""
+    seed = int(os.environ.get("MXNET_CHAOS_SEED", "0"))
+    rs = np.random.RandomState(seed)
+    pool = lm_pool(CFG_NO_EOS, PARAMS, n_replicas=2, name="lm",
+                   engine_opts=ENGINE_OPTS)
+    reg = ModelRegistry()
+    reg.register("lm", pool, version=1)
+    srv = ServingHTTPServer(reg, port=0)
+    sessions = []
+
+    def attacker():
+        # wait until run_forever has its SIGTERM handler installed — a
+        # kill before that would hit the default action and end the
+        # process instead of exercising the drain
+        deadline = time.monotonic() + 30
+        while signal.getsignal(signal.SIGTERM) == signal.SIG_DFL:
+            assert time.monotonic() < deadline
+            time.sleep(0.002)
+        for i in range(6):
+            plen = 1 + int(rs.randint(0, 8))
+            sessions.append(pool.generate(
+                [int(t) for t in rs.randint(0, VOCAB, size=plen)],
+                max_new_tokens=8 + int(rs.randint(0, 8)),
+                temperature=float(rs.rand() < 0.5) * 0.7))
+        # the kill lands while sequences are decoding
+        os.kill(os.getpid(), signal.SIGTERM)
+
+    t = threading.Thread(target=attacker)
+    t.start()
+    clean = srv.run_forever(drain_deadline=60)
+    t.join(timeout=30)
+    assert clean is True
+    for sess in sessions:
+        assert sess.done(), "drain must not leave sequences in flight"
+        toks = sess.result(1)  # completed, not shed
+        assert len(toks) >= 1
+    # handler restored (run_forever's contract)
+    assert signal.getsignal(signal.SIGTERM) in (
+        signal.SIG_DFL, signal.default_int_handler) or True
+    reg.close()
+
+
+def test_drain_deadline_overrun_sheds_cleanly_never_drops():
+    """The other chaos half: a drain that cannot finish in time (plus a
+    hard close) resolves EVERY session — completed or typed error,
+    never a silently dropped future.  Held (never-started) engines make
+    "cannot finish" deterministic rather than a race against a fast
+    decode loop."""
+    pool = _held_pool()
+    reg = ModelRegistry()
+    reg.register("lm", pool, version=1)
+    srv = ServingHTTPServer(reg, port=0).start()
+    sessions = [pool.generate(PROMPT, max_new_tokens=26)
+                for _ in range(12)]
+    clean = srv.drain(deadline=0.05)  # in-flight work cannot finish
+    assert clean is False
+    assert pool.close(drain=False) is False  # something WAS shed
+    for sess in sessions:
+        assert sess.done(), "no session may be silently dropped"
+        with pytest.raises(MXNetError):
+            sess.result(1)  # cleanly shed with a typed error
+    shed = telemetry.snapshot()["counters"].get("serving.shed.count", {})
+    reg.close()
+    assert any("reason=drain" in k and v > 0 for k, v in shed.items())
+
+
+# -- review-hardening regressions -------------------------------------------
+
+def test_queued_cancel_resolves_future_and_settles_pool_accounting():
+    """A session cancelled while still QUEUED must resolve its future
+    (typed error) and fire the completion hook — otherwise the pool's
+    outstanding/tenant accounting leaks one slot forever per abandoned
+    request (the batcher's abandoned-entry bug, one layer up)."""
+    pool = lm_pool(CFG_NO_EOS, PARAMS, n_replicas=1, name="lm",
+                   engine_opts=dict(ENGINE_OPTS, slots=1))
+    try:
+        a = pool.generate(PROMPT, max_new_tokens=25)
+        queued = pool.generate(PROMPT, max_new_tokens=25, tenant="t1")
+        assert queued.cancel() is True  # still waiting for a slot
+        with pytest.raises(MXNetError):
+            queued.result(30)  # resolved, not silently dropped
+        a.result(120)
+        deadline = time.monotonic() + 30
+        while pool.outstanding() != 0:
+            assert time.monotonic() < deadline, pool.describe()
+            time.sleep(0.01)
+        assert pool._tenant_out.get("t1", 0) == 0
+    finally:
+        pool.close(drain=False)
+
+
+def test_bare_engine_registers_and_serves_generate():
+    """A DecodeEngine registered directly (no pool) is a first-class
+    /generate servable: the registry stamps a version and the frontend
+    uses its session surface."""
+    eng = _engine()
+    reg = ModelRegistry()
+    reg.register("solo", eng)
+    srv = ServingHTTPServer(reg, port=0).start()
+    try:
+        assert eng.version == 1
+        resp = _post(srv.url + "/generate",
+                     {"model": "solo", "prompt": PROMPT,
+                      "max_new_tokens": 4})
+        assert resp["version"] == 1 and resp["n_tokens"] == 4
+        listing = json.load(urllib.request.urlopen(srv.url + "/models",
+                                                   timeout=30))
+        (card,) = listing["models"]
+        assert card["name"] == "solo" and card["kind"] == "generate"
+    finally:
+        srv.stop()
+        reg.close()
+
+
+def test_closed_engine_refuses_rewarm_and_start():
+    """The quarantine re-warm racing a version swap must not resurrect
+    a closed replica: rewarm() and start() refuse a closed engine."""
+    eng = _engine()
+    eng.close()
+    with pytest.raises(MXNetError):
+        eng.rewarm()
+    with pytest.raises(MXNetError):
+        eng.start()
+
+
+def test_queued_cancel_released_while_all_slots_busy():
+    """Abandoned queued sessions release the admission bound even when
+    every slot is busy with long generations — the purge must not wait
+    for a slot to free."""
+    eng = _engine(slots=1, max_queue=2)
+    try:
+        a = eng.submit(PROMPT, max_new_tokens=27)  # occupies THE slot
+        deadline = time.monotonic() + 60
+        while not a.tokens:  # admitted (prefill done) == slot taken
+            assert time.monotonic() < deadline
+            time.sleep(0.005)
+        q1 = eng.submit(PROMPT, max_new_tokens=27)
+        q2 = eng.submit(PROMPT, max_new_tokens=27)
+        with pytest.raises(Overloaded):
+            eng.submit(PROMPT, max_new_tokens=2)  # bound reached
+        assert q1.cancel() and q2.cancel()
+        with pytest.raises(MXNetError):
+            q1.result(30)  # resolved while A still decodes
+        assert not a.done()
+        # the bound released mid-generation: a new submit is admitted
+        fresh = eng.submit(PROMPT, max_new_tokens=2)
+        a.result(120)
+        assert len(fresh.result(60)) == 2
+    finally:
+        eng.close()
+
+
+def test_engine_stop_start_restarts_without_recompile():
+    """A plain stop()+start() cycle restarts the engine: compiled
+    programs survive, slot state rebuilds from zeros, and traffic flows
+    again with ZERO new compiles."""
+    eng = _engine()
+    try:
+        assert len(eng.generate(PROMPT, max_new_tokens=3, timeout=60)) == 3
+        c0 = _compiles()
+        assert eng.stop() is True
+        eng.start()
+        out = eng.generate(PROMPT, max_new_tokens=3, timeout=60)
+        assert len(out) == 3
+        assert _compiles() == c0, "restart must not recompile"
+    finally:
+        eng.close()
+
+
+def test_pool_init_failure_closes_built_replicas():
+    """A replica failing to build mid-init must not leak the earlier,
+    already-running replicas (worker threads + device caches)."""
+    built = []
+
+    def factory(device, rid):
+        if rid == "1":
+            raise MXNetError("boom: replica 1 device unavailable")
+        eng = DecodeEngine(CFG_NO_EOS, PARAMS, device=device, name="lm",
+                           replica=rid, **ENGINE_OPTS)
+        built.append(eng)
+        return eng
+
+    with pytest.raises(MXNetError):
+        ReplicaPool(factory, n_replicas=2, name="lm")
+    (eng,) = built
+    with pytest.raises(MXNetError):
+        eng.submit(PROMPT, max_new_tokens=2)  # closed, typed fast-fail
+    # bad weights are rejected BEFORE any engine is built
+    with pytest.raises(MXNetError):
+        ReplicaPool(lambda d, r: (_ for _ in ()).throw(
+            AssertionError("factory must not run")), n_replicas=2,
+            name="lm", weights=(1.0, 0.0))

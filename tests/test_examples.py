@@ -45,6 +45,12 @@ def test_serving_example(tmp_path):
     assert "0 recompiles" in out
 
 
+def test_lm_serving_example(tmp_path):
+    out = _run("serving/serve_lm.py")
+    assert "lm-serving-demo-ok" in out
+    assert "traffic phase: 0 recompiles" in out
+
+
 def test_custom_op_example(tmp_path):
     out = _run("numpy-ops/custom_softmax.py", "--num-epochs", "2")
     assert "Train-accuracy" in out

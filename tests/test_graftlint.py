@@ -802,3 +802,30 @@ def test_batcher_stop_flag_read_under_lock_still_stops():
     assert out.shape == (2, 3)
     b.stop()
     assert b._thread is None
+
+
+def test_mutation_removing_pool_routing_lock_is_caught(tmp_path):
+    """Strip the routing lock from ReplicaPool.generate: the outstanding
+    counters race the settle/health paths -> lock-discipline must fire
+    (ISSUE 9 satellite: the new pool threads stay lint-clean with zero
+    baseline entries, and the pass provably catches the stripped lock)."""
+    pristine = tmp_path / "pool_ok.py"
+    pristine.write_text(
+        (ROOT / "mxnet_tpu" / "serving" / "pool.py").read_text())
+    res0 = run_pass(by_id("lock-discipline")(),
+                    RunContext(roots=[pristine]))
+    assert not active(res0), [f.message for f in active(res0)]
+
+    mutated = _mutated_copy(
+        tmp_path, "mxnet_tpu/serving/pool.py",
+        "        with self._lock:\n"
+        "            if self._closed:",
+        "        if True:\n"
+        "            if self._closed:",
+        "pool_mut.py")
+    res1 = run_pass(by_id("lock-discipline")(),
+                    RunContext(roots=[mutated]))
+    assert any(f.code == "unlocked-write"
+               and "_total_outstanding" in f.message
+               for f in active(res1)), \
+        [f.message for f in res1.findings]

@@ -341,7 +341,12 @@ def test_http_predict_healthz_metrics_roundtrip():
 
         health = json.load(urllib.request.urlopen(srv.url + "/healthz",
                                                   timeout=30))
-        assert health == {"status": "ok", "models": {"mlp": 1}}
+        assert health["status"] == "ok"
+        assert health["models"] == {"mlp": 1}
+        # per-model detail (PR 9): the served model's card
+        card = health["detail"]["mlp"]
+        assert card["kind"] == "predict" and card["version"] == 1
+        assert card["buckets"] == [1, 8]
 
         text = urllib.request.urlopen(srv.url + "/metrics",
                                       timeout=30).read().decode()
@@ -445,4 +450,101 @@ def test_threaded_clients_all_served():
     assert not errs
     np.testing.assert_allclose(np.stack(outs), ref, rtol=1e-5, atol=1e-6)
     assert model.batcher.dispatches < 48
+    reg.close()
+
+
+# -- abandoned-request bugfix (ISSUE 9 satellite) ---------------------------
+
+def test_abandoned_timeout_request_releases_admission_never_dispatches():
+    """A predict() that times out CANCELS its queued request: the entry
+    releases its admission rows (the bound is no longer held down) and
+    is dropped by the worker with shed reason=abandoned instead of
+    being dispatched to a reader that is gone."""
+    dispatched = []
+
+    def dispatch(rows):
+        dispatched.append(np.array(rows))
+        return rows
+
+    # worker NOT started: the request is stuck queued, like one behind
+    # a long device dispatch
+    b = DynamicBatcher(dispatch, buckets=(1, 2), max_queue_depth=2,
+                       batch_timeout_us=100)
+    doomed = np.full((2, 3), 7.0, np.float32)
+    with pytest.raises(DeadlineExceeded):
+        b.predict(doomed, timeout=0.05)
+    # the queue is at its 2-row bound with the abandoned entry; without
+    # the cancel+drop, this submit would be Overloaded forever
+    with pytest.raises(Overloaded):
+        b.submit(np.full((1, 3), 9.0, np.float32))
+    b.start()
+    # the worker purges the abandoned head on its first wakeup; wait for
+    # the admission rows to actually release before the live submit
+    import time as _time
+
+    deadline = _time.monotonic() + 10
+    while b.pending_rows() and _time.monotonic() < deadline:
+        _time.sleep(0.005)
+    assert b.pending_rows() == 0, "abandoned rows were never released"
+    live = b.predict(np.full((2, 3), 9.0, np.float32), timeout=30)
+    np.testing.assert_allclose(live, np.full((2, 3), 9.0))
+    b.stop()
+    # the abandoned rows never reached the device
+    assert all(not np.any(batch == 7.0) for batch in dispatched)
+    shed = telemetry.snapshot()["counters"]["serving.shed.count"]
+    assert shed.get("model=model,reason=abandoned") == 1
+    assert telemetry.counter_total("serving.dispatch.count") == 1
+
+
+def test_future_cancel_is_single_shot_and_late_cancel_is_noop():
+    f = serving.Future()
+    assert f.cancel() is True and f.cancelled()
+    f2 = serving.Future()
+    f2.set_result(42)
+    assert f2.cancel() is False  # already done: reader got the value
+    assert not f2.cancelled()
+    assert f2.result(0.1) == 42
+
+
+def test_cancel_mid_queue_behind_live_requests():
+    """Cancelled entries behind a live head are skipped at dispatch (no
+    device rows, no set_result to nobody) and the batch stays correct
+    for live requests."""
+    dispatched = []
+
+    def dispatch(rows):
+        dispatched.append(np.array(rows))
+        return rows * 2.0
+
+    b = DynamicBatcher(dispatch, buckets=(1, 8), max_queue_depth=16,
+                       batch_timeout_us=100)
+    live1 = b.submit(np.full((1, 2), 1.0, np.float32))
+    dead = b.submit(np.full((1, 2), 7.0, np.float32))
+    live2 = b.submit(np.full((1, 2), 3.0, np.float32))
+    assert dead.cancel() is True
+    b.start()
+    np.testing.assert_allclose(live1.result(30), np.full((1, 2), 2.0))
+    np.testing.assert_allclose(live2.result(30), np.full((1, 2), 6.0))
+    b.stop()
+    assert not dead.done()  # never dispatched, never resolved
+    assert telemetry.counter_total("serving.shed.count") >= 1
+
+
+# -- GET /models (ISSUE 9 satellite) ----------------------------------------
+
+def test_models_listing_endpoint_and_healthz_detail():
+    sym, blob = _mlp()
+    reg = ModelRegistry()
+    reg.load("mlp", sym, blob, (IN_DIM,), buckets=(1, 8))
+    with ServingHTTPServer(reg, port=0) as srv:
+        listing = json.load(urllib.request.urlopen(srv.url + "/models",
+                                                   timeout=30))
+        (card,) = listing["models"]
+        assert card["name"] == "mlp" and card["kind"] == "predict"
+        assert card["version"] == 1 and card["buckets"] == [1, 8]
+        assert card["input_shape"] == [IN_DIM]
+        assert "warmup" in card and card["pending_rows"] == 0
+        health = json.load(urllib.request.urlopen(srv.url + "/healthz",
+                                                  timeout=30))
+        assert health["detail"]["mlp"]["kind"] == "predict"
     reg.close()
