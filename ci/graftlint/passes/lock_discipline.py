@@ -101,7 +101,12 @@ class _ClassScan:
         Thread entry points have no visible call sites and never
         qualify."""
         held = {}
-        for _ in range(3):  # helpers calling helpers: small fixpoint
+        # helpers calling helpers: small fixpoint.  Depth 5 covers the
+        # deepest real chain in-tree (KVStoreServer: locked dispatch ->
+        # _wait_interruptible -> _check_dead_peers -> _evict ->
+        # _bump_epoch); each iteration can only ADD held facts, so extra
+        # depth never widens a finding
+        for _ in range(5):
             changed = False
             for name in self.methods:
                 if name in self.thread_bodies or name in held:

@@ -33,4 +33,20 @@ while [ "$i" -lt "$runs" ]; do
     -k "sigterm_drain or drain_deadline"
   i=$((i + 1))
 done
+# elasticity half (docs/resilience.md "Elastic membership &
+# resharding"): kill one worker mid-epoch, admit replacements, and kill
+# a worker DURING the reshard itself via the kvstore.membership /
+# elastic.reshard fault points.  Every outcome must be resume-or-typed-
+# error — never a hang (the suite's thread-join asserts enforce it) —
+# and two replays of the same schedule under the same seed must end
+# bit-identical.  The seed rotates the kill batch and the dataset.
+i=0
+while [ "$i" -lt "$runs" ]; do
+  echo "== elastic chaos run $((i + 1))/$runs (MXNET_CHAOS_SEED=$i) =="
+  JAX_PLATFORMS=cpu MXNET_CHAOS_SEED="$i" \
+    python -m pytest tests/test_elastic.py -q -p no:cacheprovider \
+    -k "acceptance or kill_during_reshard or replays_bit_identical \
+        or fault_point or graceful_leave"
+  i=$((i + 1))
+done
 echo "CHAOS OK ($runs runs)"

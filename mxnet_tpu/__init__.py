@@ -19,6 +19,7 @@ from . import compile_cache
 # any executor build can compile (no-op when unset; never raises)
 compile_cache._init_from_env()
 from . import retry
+from . import elastic
 
 from . import ops
 from . import ndarray

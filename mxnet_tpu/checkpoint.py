@@ -331,7 +331,7 @@ def _verified(path, want_sha, logger, what):
     return True
 
 
-def load_latest_state(prefix, logger=logging):
+def load_latest_state(prefix, logger=logging, want=None):
     """The richest verified training state under ``prefix``: mid-epoch
     snapshots and epoch-boundary checkpoints in ONE recency order
     (epoch checkpoint E ≡ position ``(E, batch -1)``; snapshot ``(e,
@@ -340,7 +340,12 @@ def load_latest_state(prefix, logger=logging):
     checkpoints, takes a full load-verify pass) before being trusted;
     corrupt generations are skipped with
     ``resilience.checkpoint.corrupt_skipped`` and the next-older one is
-    tried.  Returns :class:`TrainingState` or None."""
+    tried.  With ``want=(epoch, nbatch)`` (nbatch None ≡ an epoch
+    checkpoint) only that EXACT generation is considered — the elastic
+    reshard's followers load precisely the generation the leader
+    announced, never whatever their own manifest view surfaces — and a
+    verification failure returns None instead of falling back.
+    Returns :class:`TrainingState` or None."""
     from . import model as _model
     from . import ndarray as nd
 
@@ -355,14 +360,17 @@ def load_latest_state(prefix, logger=logging):
         candidates.append((key, "snapshot", entry))
     for epoch in _model.list_checkpoints(prefix):
         candidates.append(((epoch, -1), "epoch", epoch))
+    if want is not None:
+        wkey = (int(want[0]), -1 if want[1] is None else int(want[1]))
+        candidates = [c for c in candidates if c[0] == wkey]
     candidates.sort(key=lambda c: c[0], reverse=True)
     for _key, kind, payload in candidates:
         if kind == "epoch":
             epoch = payload
             params = "%s-%04d.params" % (prefix, epoch)
-            want = (m.get("payload_sha256") or {}).get(str(epoch))
-            if want and not _verified(params, want, logger,
-                                      "epoch checkpoint"):
+            sha = (m.get("payload_sha256") or {}).get(str(epoch))
+            if sha and not _verified(params, sha, logger,
+                                     "epoch checkpoint"):
                 _telemetry.inc("resilience.checkpoint.corrupt_skipped")
                 continue
             try:

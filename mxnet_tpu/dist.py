@@ -53,6 +53,15 @@ def init_from_env(rank_hint=None):
             return True
         if os.environ.get("MXNET_DIST_INGRAPH", "1") == "0":
             return False
+        from .elastic import enabled as _elastic_enabled
+
+        if _elastic_enabled():
+            # a jax.distributed process group freezes the world at
+            # initialize(): membership cannot change without tearing the
+            # whole group down.  Elastic jobs therefore keep gradients on
+            # the PS plane, whose coordinator owns the membership epoch
+            # (kvstore_server.py; docs/resilience.md "Elastic membership")
+            return False
         # launcher-spawned workers carry an explicit role + worker count
         # (tools/launch.py); anything else (threaded multi-client tests,
         # plain scripts) must not grab a process-group identity

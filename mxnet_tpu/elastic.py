@@ -1,0 +1,535 @@
+"""Elastic distributed training — membership epochs + deterministic reshard.
+
+PR 1 made the KVStore transport survive reconnects and *name* dead peers;
+PR 5 made training resume bit-identically from batch-granular snapshots.
+This module composes the two into elasticity (ROADMAP item 5, the
+TensorFlow-paper checkpoint/restore-as-core-primitive design): world size
+may change mid-job, and a membership change is a *replayable event*, not a
+fatal one.
+
+The three layers (docs/resilience.md "Elastic membership & resharding"):
+
+* **Membership epochs** — the KVStore coordinator owns a monotonically
+  increasing *membership epoch*.  Workers join via ``register``, leave via
+  graceful ``deregister`` or heartbeat-death eviction; every change bumps
+  the epoch.  All elastic push/pull/barrier traffic carries the sender's
+  epoch, and straggler messages from the old world are rejected with a
+  typed :class:`StaleEpoch` — never silently merged into the new world's
+  sync rounds.
+* **Deterministic resharding** — on an epoch bump every worker quiesces at
+  its next batch boundary and runs the reshard cycle
+  (:meth:`ElasticFitRun.sync`): all members of the new epoch rendezvous at
+  the coordinator's quiesce barrier, rehydrate from the newest PR 5
+  snapshot generation (params + server optimizer states + update counts +
+  RNG + metric + data-ledger), push their :func:`assign_keys` share of the
+  snapshot back to the server, and resume in-loop — the process never
+  restarts, and two replays of the same elasticity schedule under the same
+  ``MXNET_CHAOS_SEED`` produce bit-identical parameters because every
+  input to the cycle (rollback generation, shard assignment, key
+  ownership) is a pure function of on-disk state and ``(sorted ranks,
+  epoch)``.
+* **A checkpointable sharded data service** — :class:`mxnet_tpu.io.
+  ElasticShardIter` assigns record shards per ``(rank, ranks, epoch)`` and
+  carries a global sample-accounting ledger in the snapshot manifest, so
+  a membership change neither skips nor repeats records (see io.py).
+
+Env knobs (docs/how_to/env_var.md): ``MXNET_ELASTIC`` arms the layer,
+``MXNET_ELASTIC_QUIESCE_DEADLINE`` bounds the reshard rendezvous,
+``MXNET_ELASTIC_MIN_WORKERS`` / ``MXNET_ELASTIC_MAX_WORKERS`` bound the
+world size.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+
+from . import faults as _faults
+from . import telemetry as _telemetry
+from .base import MXNetError
+
+__all__ = ["StaleEpoch", "MembershipChanged", "enabled", "quiesce_deadline",
+           "min_workers", "max_workers", "assign_keys", "shard_records",
+           "ElasticFitRun"]
+
+
+class StaleEpoch(MXNetError):
+    """A push/pull/barrier/reshard message carried a membership epoch the
+    coordinator has moved past: the sender belongs to the *old world* and
+    must run the reshard cycle before touching the store again.  Typed —
+    never silently merged — so sync rounds of the new world cannot be
+    polluted by straggler traffic.  ``epoch`` is the coordinator's current
+    membership epoch."""
+
+    def __init__(self, msg, epoch=None):
+        super().__init__(msg)
+        self.epoch = epoch
+
+
+class MembershipChanged(Exception):
+    """Control-flow signal raised at a batch boundary by the elastic poll
+    when the coordinator's membership epoch moved: ``fit(elastic=True)``
+    catches it (and :class:`StaleEpoch`) and runs the reshard cycle.  Not
+    an :class:`~mxnet_tpu.base.MXNetError` — it never escapes fit."""
+
+    def __init__(self, old_epoch, new_epoch):
+        super().__init__("membership epoch moved %s -> %s"
+                         % (old_epoch, new_epoch))
+        self.old_epoch = old_epoch
+        self.new_epoch = new_epoch
+
+
+# -- env knobs ---------------------------------------------------------------
+
+def enabled():
+    """True when ``MXNET_ELASTIC`` arms elastic membership."""
+    return os.environ.get("MXNET_ELASTIC", "0") not in ("0", "", "false")
+
+
+def quiesce_deadline():
+    """Seconds the reshard rendezvous waits for all members before
+    evicting non-arrivers (``MXNET_ELASTIC_QUIESCE_DEADLINE``)."""
+    return float(os.environ.get("MXNET_ELASTIC_QUIESCE_DEADLINE", "30")
+                 or 30)
+
+
+def min_workers():
+    """Floor on the elastic world size (``MXNET_ELASTIC_MIN_WORKERS``):
+    membership below it fails reshard with a typed error, never a silent
+    single-worker continuation."""
+    return int(os.environ.get("MXNET_ELASTIC_MIN_WORKERS", "1") or 1)
+
+
+def max_workers():
+    """Ceiling on the elastic world size (``MXNET_ELASTIC_MAX_WORKERS``);
+    0 = unlimited.  Registrations beyond it are rejected with a typed
+    error."""
+    return int(os.environ.get("MXNET_ELASTIC_MAX_WORKERS", "0") or 0)
+
+
+# evicted-as-wedged re-registrations tolerated within ONE reshard cycle
+# before the rank exits typed instead of thrashing the job through
+# evict -> re-register -> epoch-bump forever
+_MAX_REJOINS_PER_SYNC = 3
+
+
+# -- pure reshard math -------------------------------------------------------
+
+def assign_keys(keys, ranks, epoch):
+    """Deterministic key -> owner-rank map: a pure function of
+    ``(sorted keys, sorted ranks, epoch)``.  The owner of a key is the
+    rank that pushes that key's snapshot value back to the coordinator
+    during rehydration; rotating by ``epoch`` spreads the reload work
+    across reshard events.  Every member computes the identical map."""
+    ranks = sorted(ranks)
+    if not ranks:
+        raise MXNetError("assign_keys: empty rank set")
+    return {k: ranks[(i + epoch) % len(ranks)]
+            for i, k in enumerate(sorted(keys, key=str))}
+
+
+def shard_records(record_ids, ranks, epoch):
+    """Deterministic record partition: ``{rank: [ids...]}`` — a pure
+    function of ``(sorted ids, sorted ranks, epoch)``.  Contiguous
+    near-equal slices of the sorted id list, with the rank order rotated
+    by ``epoch`` so repeated reshards move the boundary records around.
+    Every member computes the identical partition; sizes differ by at
+    most one record."""
+    ranks = sorted(ranks)
+    if not ranks:
+        raise MXNetError("shard_records: empty rank set")
+    ids = sorted(record_ids)
+    w = len(ranks)
+    rot = epoch % w
+    order = ranks[rot:] + ranks[:rot]
+    n = len(ids)
+    bounds = [n * i // w for i in range(w + 1)]
+    return {order[i]: ids[bounds[i]:bounds[i + 1]] for i in range(w)}
+
+
+def _find_elastic_iter(it):
+    """The :class:`~mxnet_tpu.io.ElasticShardIter` inside ``it`` (the
+    iterator itself, or the SINGLE sub-iterator of a prefetch wrapper),
+    or None.  A wrapper combining several sub-iterators never matches:
+    the reshard protocol rewinds a wrapper onto exactly one inner state,
+    so a composite wrapper trains with its data partition un-resharded
+    (``ElasticFitRun.__init__`` warns about the degraded mode)."""
+    from .io import ElasticShardIter, PrefetchingIter
+
+    if isinstance(it, ElasticShardIter):
+        return it
+    if isinstance(it, PrefetchingIter) and len(it.iters) == 1 \
+            and isinstance(it.iters[0], ElasticShardIter):
+        return it.iters[0]
+    return None
+
+
+#: marker key under which an elastic leader snapshot carries the
+#: coordinator-side optimizer updater states (pickled blob per server)
+SERVER_STATES_KEY = "__elastic_server_states__"
+
+
+class ElasticFitRun:
+    """Per-``fit(elastic=True)`` reshard driver.
+
+    Owns the batch-boundary membership poll, the data-ledger commit, the
+    leader-only snapshot gating, and :meth:`sync` — the quiesce /
+    rehydrate / reshard / resume cycle that keeps training in-loop across
+    membership changes."""
+
+    def __init__(self, module, kv, prefix, fit_data, logger):
+        self.module = module
+        self.kv = kv
+        self.prefix = prefix
+        self.logger = logger
+        self.fit_data = fit_data
+        self.data_iter = _find_elastic_iter(fit_data)
+        self.ranks = None  # adopted at the first sync()
+        if self.data_iter is None:
+            logger.warning(
+                "fit(elastic=True): train_data carries no singly-wrapped "
+                "ElasticShardIter — membership changes will reshard "
+                "parameters/optimizer state but NOT the data partition "
+                "(records may be skipped or repeated across an "
+                "elasticity event)")
+
+    # -- batch-boundary hooks ---------------------------------------------
+    def is_leader(self):
+        """True when this rank is the membership leader (lowest live
+        rank): the one rank that writes cadence snapshots and epoch
+        checkpoints, so generations under the shared prefix never
+        interleave between writers."""
+        return self.ranks is None or self.kv.rank == min(self.ranks)
+
+    def commit(self, data_batch):
+        """Record the just-trained batch in the data ledger (non-pad
+        records only).  Called after ``update()`` landed — a batch whose
+        update was rejected with :class:`StaleEpoch` is never committed,
+        so its records return to the remaining pool for the new world."""
+        if self.data_iter is None or data_batch is None:
+            return
+        index = getattr(data_batch, "index", None)
+        if index is not None:
+            self.data_iter.commit(index, getattr(data_batch, "pad", 0) or 0)
+
+    def poll(self, epoch, nbatch):
+        """Membership poll at the batch boundary; raises
+        :class:`MembershipChanged` when the coordinator's epoch moved.
+        Passive: the coordinator stamps every elastic push/pull reply
+        with its current epoch, so this batch's own traffic already
+        carried the freshest observation and the poll costs no RPC
+        (a bump landing after this batch's last reply is caught by the
+        next batch's push raising :class:`StaleEpoch` — the update is
+        aborted uncommitted, so exactly-once accounting holds either
+        way).  The ``membership()`` RPC remains only as a fallback for
+        the no-traffic-yet case.  The ``kvstore.membership`` fault point
+        fires here: it severs this worker's transport — the observable
+        state of a worker dying at a membership event."""
+        if _faults.should_fire("kvstore.membership"):
+            self.logger.warning(
+                "fault 'kvstore.membership': severing transport at epoch "
+                "%d batch %d (worker death at a membership boundary)",
+                epoch, nbatch)
+            self.kv._sever("fault 'kvstore.membership' killed this worker")
+        server_epoch = getattr(self.kv, "observed_epoch", None)
+        if server_epoch is None:
+            server_epoch = self.kv.membership().get("epoch")
+        if server_epoch is not None and server_epoch != self.kv.epoch:
+            raise MembershipChanged(self.kv.epoch, server_epoch)
+
+    def leave(self):
+        """Graceful shrink on preemption: announce this worker's exit so
+        the membership epoch bumps NOW and survivors quiesce at their
+        next batch boundary — instead of blocking a full heartbeat
+        deadline in a sync round the departed rank can never complete.
+        Best-effort: a worker whose transport is already severed just
+        falls back to heartbeat-death eviction."""
+        try:
+            self.kv.deregister()
+        except Exception as e:  # noqa: broad-except — the worker is
+            # exiting either way; eviction is the coordinator's fallback
+            self.logger.warning(
+                "elastic: graceful deregister failed (%s); survivors "
+                "fall back to heartbeat-death eviction", e)
+
+    def augment_snapshot(self, snap):
+        """Fold the coordinator-side optimizer updater states into a
+        leader snapshot, so rehydration restores the server's momentum
+        exactly.  In update-on-kvstore mode the updater lives on the
+        server and ``_capture_state_arrays`` sees none locally
+        (``snap.opt_states`` is None here), so the marker dict replaces
+        nothing; a NON-elastic resume of an elastic prefix recognizes
+        the marker and skips the local install (module.py
+        ``_restore_opt_snapshot``)."""
+        try:
+            blobs = self.kv.get_updater_states()
+        except MXNetError:
+            return  # no server-side optimizer (e.g. fit without one yet)
+        snap.opt_states = {SERVER_STATES_KEY: blobs}
+
+    # -- the reshard cycle -------------------------------------------------
+    def sync(self, fallback):
+        """Run the quiesce/rehydrate/reshard cycle until it lands on a
+        stable membership epoch; returns ``(begin_epoch, resume_nbatch,
+        resume_metric_state)`` for re-entering the batch loop.
+        ``fallback`` is returned when no snapshot generation exists yet
+        (a fresh job's initial sync).  A :class:`StaleEpoch` mid-cycle
+        (membership moved again — e.g. a kill *during* the reshard)
+        restarts the cycle; the ``elastic.reshard`` fault point fires at
+        cycle entry to inject exactly that worker death."""
+        rejoins = 0
+        while True:
+            if _faults.should_fire("elastic.reshard"):
+                self.logger.warning(
+                    "fault 'elastic.reshard': severing transport inside "
+                    "the reshard cycle (worker death mid-reshard)")
+                self.kv._sever("fault 'elastic.reshard' killed this worker "
+                               "mid-reshard")
+            try:
+                return self._cycle(fallback)
+            except StaleEpoch as e:
+                # if WE are the one who was evicted (slow past the
+                # quiesce deadline while the socket stayed up), the
+                # coordinator never re-admits a rank on its own — the
+                # not-a-member reply would repeat forever.  Re-register
+                # (the PR 1 same-rank rejoin; an elastic re-admission
+                # bumps the epoch) before restarting the cycle.
+                try:
+                    member = self.kv.rank in (
+                        self.kv.membership().get("ranks") or [])
+                except MXNetError:
+                    member = False
+                if not member:
+                    # bounded: a rank evicted as wedged EVERY cycle
+                    # would otherwise thrash the whole job through
+                    # evict -> re-register -> bump forever; after the
+                    # cap it exits typed (survivors reshard without it)
+                    # — resume-or-typed-error, never a livelock
+                    rejoins += 1
+                    if rejoins > _MAX_REJOINS_PER_SYNC:
+                        raise MXNetError(
+                            "elastic: this rank (%s) was evicted from "
+                            "the membership %d times within one reshard "
+                            "cycle (consistently slower than the "
+                            "quiesce deadline); giving up instead of "
+                            "thrashing the job — raise "
+                            "MXNET_ELASTIC_QUIESCE_DEADLINE or fix the "
+                            "slow rank" % (self.kv.rank, rejoins)) from e
+                    self.logger.warning(
+                        "elastic: this rank (%s) was evicted from the "
+                        "membership; re-registering before the reshard "
+                        "cycle restarts (attempt %d/%d)", self.kv.rank,
+                        rejoins, _MAX_REJOINS_PER_SYNC)
+                    self.kv.reconnect()
+                self.logger.info(
+                    "elastic: membership moved during the reshard cycle "
+                    "(%s); restarting the cycle", e)
+
+    def _cycle(self, fallback):
+        kv, mod = self.kv, self.module
+        rep = kv.reshard_sync()
+        ranks, epoch = rep["ranks"], rep["epoch"]
+        state = None
+        if self.prefix is not None:
+            state = self._adopt_generation(ranks)
+        out = fallback
+        if state is not None:
+            # module rehydration: params + optimizer update counts + RNG
+            # streams from the adopted generation (the PR 5 resume path,
+            # driven mid-fit instead of at process start)
+            mod.set_params(state.arg_params, state.aux_params,
+                           force_init=True)
+            if hasattr(mod, "_restore_opt_snapshot"):
+                mod._restore_opt_snapshot(None, state.opt_counts)
+            rng = state.rng_state or {}
+            if rng.get("global"):
+                from . import random as _random
+
+                _random.set_state(rng["global"])
+            ex = getattr(mod, "_exec", None)
+            if ex is not None and rng.get("exec_step") is not None:
+                ex._rng_step = int(rng["exec_step"])
+            out = (state.epoch,
+                   state.nbatch if state.nbatch is not None else None,
+                   state.metric_state)
+            # coordinator rehydration: each key's assign_keys owner
+            # pushes its snapshot value back (version/round bookkeeping
+            # reset server-side), so survivors and newcomers alike pull
+            # one authoritative post-reshard state
+            entries = mod._elastic_param_entries() \
+                if hasattr(mod, "_elastic_param_entries") else []
+            if entries:
+                owners = assign_keys([i for i, _n in entries], ranks, epoch)
+                for i, name in entries:
+                    if owners[i] == kv.rank and name in state.arg_params:
+                        kv.reload(i, state.arg_params[name].asnumpy())
+        if kv.rank == min(ranks):
+            self._reinstall_optimizer(state, len(ranks))
+        # rendezvous: every member's reloads (and the leader's optimizer
+        # reinstall) are visible before ANY member trains or pulls
+        kv.reshard_commit()
+        self._reshard_data(state, ranks, epoch)
+        if state is not None and hasattr(mod, "_elastic_pull_params"):
+            mod._elastic_pull_params()
+        initial = self.ranks is None
+        self.ranks = list(ranks)
+        if not initial:
+            # the initial rendezvous is job assembly, not an elasticity
+            # event: dashboards keyed on resharded.count must read zero
+            # for a run with no membership change after assembly
+            _telemetry.inc("elastic.resharded.count")
+            _telemetry.event("elastic.reshard", epoch=epoch,
+                             ranks=list(ranks), rank=kv.rank,
+                             rollback=None if state is None else
+                             [state.epoch, state.nbatch])
+        self.logger.info(
+            "elastic: resharded onto membership epoch %d (ranks %s)%s",
+            epoch, list(ranks),
+            "" if state is None else " from snapshot epoch %s batch %s"
+            % (state.epoch, state.nbatch))
+        return out
+
+    def _adopt_generation(self, ranks):
+        """ONE rollback generation for the whole world: the leader reads
+        the manifest, picks the newest verified generation (or None) and
+        announces it through the coordinator (``reshard_choice``); every
+        follower blocks for the announcement and loads EXACTLY that
+        generation.  Independent manifest reads could disagree — a
+        straggler ex-leader's inline write landing between two members'
+        reads, shared-FS visibility lag, a per-member sha fallback — and
+        members adopting different generations would reload mixed server
+        parameters and diverge their data ledgers.  A follower that
+        cannot load the announced generation retries briefly (FS lag),
+        then dies on a typed error rather than training diverged."""
+        import time as _time
+
+        from .checkpoint import load_latest_state
+
+        kv = self.kv
+        if kv.rank == min(ranks):
+            state = load_latest_state(self.prefix, logger=self.logger)
+            kv.set_reshard_choice(
+                None if state is None else
+                {"epoch": state.epoch, "nbatch": state.nbatch})
+            return state
+        want = kv.get_reshard_choice()["choice"]
+        if want is None:
+            return None
+        key = (want["epoch"], want["nbatch"])
+        for attempt in range(3):
+            if attempt:
+                _time.sleep(0.2)  # shared-FS visibility lag
+            state = load_latest_state(self.prefix, logger=self.logger,
+                                      want=key)
+            if state is not None:
+                return state
+        raise MXNetError(
+            "elastic reshard: the leader adopted snapshot generation "
+            "(epoch %s, nbatch %s) but this member cannot load/verify "
+            "it under prefix %r — refusing to train diverged"
+            % (want["epoch"], want["nbatch"], self.prefix))
+
+    def _reinstall_optimizer(self, state, world):
+        """Leader half of rehydration: re-command the server optimizer
+        with the gradient scale of the NEW world size, then restore its
+        updater states from the adopted snapshot (``set_optimizer``
+        creates a fresh updater, so states are re-installed after)."""
+        mod = self.module
+        opt = getattr(mod, "_optimizer", None)
+        if opt is None:
+            return
+        shapes = getattr(mod, "_data_shapes", None)
+        rescaled = False
+        if shapes and getattr(mod, "_auto_rescale_grad", False):
+            # framework-derived rescale follows the world size; a
+            # user-supplied rescale_grad is honored across reshards the
+            # same way init_optimizer honors it at launch
+            want = 1.0 / (shapes[0][1][0] * world)
+            rescaled = opt.rescale_grad != want
+            opt.rescale_grad = want
+        if state is None:
+            # no snapshot (initial rendezvous, or a bump before the
+            # leader's first write): the scale still needs re-commanding
+            # when the adopted world differs from the one init_optimizer
+            # derived for — e.g. an over-subscribed initial cohort that
+            # admitted more arrivers than the launch num_workers.  The
+            # server's updater states are carried across untouched
+            # (set_optimizer builds a fresh updater).
+            if rescaled:
+                try:
+                    blobs = self.kv.get_updater_states()
+                except MXNetError:
+                    blobs = None
+                self.kv.set_optimizer(opt)
+                if blobs:
+                    self.kv.set_updater_states(blobs)
+            return
+        self.kv.set_optimizer(opt)
+        blobs = None
+        if state.states_bytes:
+            try:
+                payload = pickle.loads(state.states_bytes)
+            except Exception:  # noqa: broad-except — a non-elastic
+                # .states payload (raw updater tree) is not restorable
+                # onto the server; momentum restarts from zero
+                payload = None
+            if isinstance(payload, dict):
+                blobs = payload.get(SERVER_STATES_KEY)
+        elif getattr(state, "states_path", None) \
+                and getattr(mod, "_update_on_kvstore", False):
+            # an adopted epoch-boundary checkpoint: its .states file IS
+            # the coordinator capture (kvstore.save_optimizer_states
+            # wire format), not a snapshot's marker pickle — recover the
+            # blobs from disk instead of zeroing the server's momentum
+            from .kvstore import states_file_blobs
+
+            try:
+                with open(state.states_path, "rb") as f:
+                    blobs = states_file_blobs(f.read())
+            except (OSError, pickle.UnpicklingError) as e:
+                self.logger.warning(
+                    "elastic: adopted checkpoint optimizer states %s "
+                    "unreadable (%s)", state.states_path, e)
+        if blobs:
+            self.kv.set_updater_states(blobs)
+        else:
+            self.logger.warning(
+                "elastic: adopted snapshot carries no coordinator "
+                "optimizer states; server momentum restarts from zero")
+
+    def _reshard_data(self, state, ranks, epoch):
+        """Data-service half: adopt the snapshot's global ledger, then
+        recompute this rank's shard of the REMAINING records for the new
+        membership.  A prefetch wrapper is drained and re-armed through
+        the PR 5 pre-produce state protocol so its buffered batch never
+        leaks across the reshard."""
+        it = self.data_iter
+        if it is None:
+            return
+        from .io import PrefetchingIter
+
+        wrapper = self.fit_data \
+            if self.fit_data is not it \
+            and isinstance(self.fit_data, PrefetchingIter) else None
+        if wrapper is not None:
+            # park the producer threads BEFORE touching the inner
+            # iterator: a produce racing the reshard could advance the
+            # post-reshard cursor before state_dict() below captures it,
+            # silently skipping the new assignment's first batch
+            wrapper.drain()
+        ledger_state = None
+        if state is not None and state.iter_state is not None:
+            st = state.iter_state
+            if isinstance(st, dict) and st.get("type") in (
+                    "PrefetchingIter", "DevicePrefetchIter"):
+                inner = st.get("inner") or []
+                st = inner[0] if len(inner) == 1 else None
+            if isinstance(st, dict) and st.get("type") == "ElasticShardIter":
+                ledger_state = st
+        it.reshard(self.kv.rank, ranks, epoch, state=ledger_state)
+        if wrapper is not None:
+            # drain-then-reshard: rewind the wrapper onto the inner
+            # iterator's post-reshard state and re-arm the producers
+            wrapper.load_state_dict(
+                {"type": type(wrapper).__name__,
+                 "inner": [it.state_dict()]})

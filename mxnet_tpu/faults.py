@@ -45,6 +45,16 @@ point                  effect when it fires
                          contract), the slot state restarts clean, and
                          the engine worker survives; consecutive firings
                          drive a pool replica into quarantine
+``kvstore.membership``   the Nth elastic membership poll severs THIS
+                         worker's transport — a worker dying at a batch
+                         boundary; the coordinator evicts it after the
+                         heartbeat deadline and the survivors reshard
+                         around the loss (hit counting is per process)
+``elastic.reshard``      the Nth entry into the elastic reshard cycle
+                         severs THIS worker's transport — a worker dying
+                         DURING the reshard itself; the quiesce deadline
+                         evicts it and the surviving members restart the
+                         cycle on the new membership epoch
 =====================  =====================================================
 
 Arming — programmatic::
@@ -81,7 +91,8 @@ __all__ = ["POINTS", "FaultInjected", "arm", "disarm", "armed",
 #: this so a typo'd point fails loudly instead of never firing)
 POINTS = ("kvstore.push.socket", "checkpoint.write", "fit.batch",
           "recordio.read", "serving.dispatch", "serving.model.write",
-          "fit.preempt", "compile_cache.read", "serving.decode")
+          "fit.preempt", "compile_cache.read", "serving.decode",
+          "kvstore.membership", "elastic.reshard")
 
 
 class FaultInjected(MXNetError):

@@ -25,7 +25,7 @@ from .ndarray import NDArray, array
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "MNISTIter",
            "CSVIter", "ResizeIter", "PrefetchingIter", "DevicePrefetchIter",
-           "ImageRecordIter", "corrupt_skip_count",
+           "ElasticShardIter", "ImageRecordIter", "corrupt_skip_count",
            "reset_corrupt_skip_count"]
 
 
@@ -474,8 +474,7 @@ class PrefetchingIter(DataIter):
         buffered (never-consumed) batch first, so a wrapper snapshot
         taken after fit consumed ``k`` batches resumes at batch
         ``k + 1`` exactly, prefetch depth and all."""
-        for e in self.data_ready:
-            e.wait()
+        self.drain()
         if not self._capture_state or self._pending_state is None \
                 or any(s is None for s in self._pending_state):
             raise NotImplementedError(
@@ -483,6 +482,15 @@ class PrefetchingIter(DataIter):
                 "state protocol" % type(self).__name__)
         return {"type": type(self).__name__,
                 "inner": [dict(s) for s in self._pending_state]}
+
+    def drain(self):
+        """Block until every in-flight produce completes and the
+        producer threads are parked (on ``data_taken``).  The inner
+        iterators are then safe to mutate externally — e.g. an elastic
+        reshard — until ``next()``/``reset()``/``load_state_dict``
+        re-arms production."""
+        for e in self.data_ready:
+            e.wait()
 
     def load_state_dict(self, state):
         """Restore: park the producers, rewind the inner iterators to
@@ -494,8 +502,7 @@ class PrefetchingIter(DataIter):
             raise MXNetError(
                 "prefetch state has %d sub-iterators, wrapper has %d"
                 % (len(inner), self.n_iter))
-        for e in self.data_ready:
-            e.wait()
+        self.drain()
         for i in range(self.n_iter):
             self.iters[i].load_state_dict(inner[i])
         self._errors = [None for _ in range(self.n_iter)]
@@ -544,8 +551,7 @@ class PrefetchingIter(DataIter):
                     for r, i in zip(self.rename_label, self.iters)], [])
 
     def reset(self):
-        for e in self.data_ready:
-            e.wait()
+        self.drain()
         for i in self.iters:
             i.reset()
         # stale producer errors must not outlive the reset
@@ -661,6 +667,337 @@ class DevicePrefetchIter(PrefetchingIter):
             batch.label = [self._placer(n, a)
                            for n, a in zip(label_names, batch.label)]
         return batch
+
+
+class ElasticShardIter(DataIter):
+    """Elastic sharded data service (docs/resilience.md "Elastic
+    membership & resharding"): serves this worker's deterministic shard
+    of a record-addressable dataset, recomputes shard ownership on
+    membership change, and carries a **global sample-accounting ledger**
+    so an elasticity event neither skips nor repeats records.
+
+    Sharding is a pure function: the records *remaining* in the current
+    data epoch (all minus the ledger) are partitioned by
+    :func:`mxnet_tpu.elastic.shard_records` over ``(sorted ranks,
+    membership epoch)`` — every member computes the identical partition,
+    and all members serve the same number of batches per assignment
+    (short shards wrap-pad their tail batch; pad slots are presentation
+    copies, excluded from the ledger).
+
+    The ledger is *derivable*: because synchronous training keeps ranks
+    in batch lockstep, the globally-consumed set at cursor ``pos`` is
+    ``base ∪ (every rank's first pos batches of its shard)`` — a pure
+    function of the state dict, with no runtime cross-worker union.  Any
+    one rank's snapshot therefore carries the correct GLOBAL ledger for
+    its boundary, which is exactly what the reshard cycle adopts when it
+    rolls every member back to the newest snapshot generation.
+
+    Sources: in-memory arrays (``data``/``label``, NDArrayIter-style) or
+    ``record_reader`` — a callable ``(ids) -> (data_arrays,
+    label_arrays)`` over e.g. an ``MXIndexedRecordIO`` file — with
+    ``num_records``.
+    """
+
+    def __init__(self, data=None, label=None, batch_size=1, rank=0,
+                 ranks=(0,), membership_epoch=0, record_reader=None,
+                 num_records=None, data_name="data",
+                 label_name="softmax_label", audit=False):
+        super().__init__(batch_size)
+        self._lock = threading.Lock()
+        self.audit = bool(audit)
+        if record_reader is not None:
+            if num_records is None:
+                raise MXNetError(
+                    "ElasticShardIter(record_reader=...) needs "
+                    "num_records")
+            self._reader = record_reader
+            self._n = int(num_records)
+            probe_d, probe_l = record_reader([0])
+
+            def _descs(arrays, default):
+                names = [default] if len(arrays) == 1 else \
+                    ["_%d_%s" % (i, default) for i in range(len(arrays))]
+                return [DataDesc(nm,
+                                 (batch_size,) + np.asarray(a).shape[1:],
+                                 np.asarray(a).dtype)
+                        for nm, a in zip(names, arrays)]
+
+            self._data_descs = _descs(probe_d, data_name)
+            self._label_descs = _descs(probe_l, label_name)
+            self._arrays = None
+        else:
+            self._reader = None
+            self._arrays = (_init_data(data, allow_empty=False,
+                                       default_name=data_name),
+                            _init_data(label, allow_empty=True,
+                                       default_name=label_name))
+            self._n = self._arrays[0][0][1].shape[0]
+            self._data_descs = [
+                DataDesc(k, (batch_size,) + v.shape[1:], v.dtype)
+                for k, v in self._arrays[0]]
+            self._label_descs = [
+                DataDesc(k, (batch_size,) + v.shape[1:], v.dtype)
+                for k, v in self._arrays[1]]
+        if self._n < 1:
+            raise MXNetError("ElasticShardIter: empty dataset")
+        self.rank = rank
+        self.ranks = sorted(ranks)
+        self.membership_epoch = int(membership_epoch)
+        self.data_epoch = 0
+        self.base = set()        # global ledger at this assignment's start
+        self._pos = 0            # batches served under this assignment
+        self._committed = {}     # data_epoch -> ids THIS rank committed
+        # sample-accounting ledger: the reshard machinery only ever
+        # reads the current and previous data epoch, so reset() prunes
+        # older epochs by default — ``audit=True`` keeps the whole-job
+        # trail (the chaos/acceptance tests assert exactly-once over
+        # EVERY epoch of a run)
+        self.applied = {}        # data_epoch -> {id: surviving-train count}
+        self.history = []        # closed assignment segments (diagnostics)
+        with self._lock:
+            self._recompute()
+
+    # -- pure shard/ledger math (lock held) -------------------------------
+    def _recompute(self):
+        from .elastic import shard_records
+
+        remaining = [i for i in range(self._n) if i not in self.base]
+        if remaining:
+            self._parts = shard_records(remaining, self.ranks,
+                                        self.membership_epoch)
+        else:
+            self._parts = {r: [] for r in self.ranks}
+        self._owned = list(self._parts.get(self.rank, []))
+        longest = max((len(p) for p in self._parts.values()), default=0)
+        self._nbatches = -(-longest // self.batch_size) if longest else 0
+
+    def _served_global(self, pos):
+        """The ledger at cursor ``pos`` of THIS assignment: ``base`` plus
+        every rank's first ``pos`` batches of its shard (lockstep makes
+        all ranks' cursors equal at any sync boundary)."""
+        out = set(self.base)
+        take = pos * self.batch_size
+        for part in self._parts.values():
+            out.update(part[:take])
+        return out
+
+    def ledger(self):
+        """The global sample-accounting ledger at this worker's cursor:
+        the set of records of the current data epoch whose updates are
+        part of the surviving trajectory."""
+        with self._lock:
+            return self._served_global(self._pos)
+
+    @property
+    def num_records(self):
+        return self._n
+
+    # -- DataIter protocol -------------------------------------------------
+    @property
+    def provide_data(self):
+        return self._data_descs
+
+    @property
+    def provide_label(self):
+        return self._label_descs
+
+    def _read(self, ids):
+        from .ndarray import array as _array
+
+        if self._reader is not None:
+            data, label = self._reader(ids)
+            return ([_array(np.asarray(a)) for a in data],
+                    [_array(np.asarray(a)) for a in label])
+        data_src, label_src = self._arrays
+        idx = np.asarray(ids, np.int64)
+        return ([_array(v[idx]) for _k, v in data_src],
+                [_array(v[idx]) for _k, v in label_src])
+
+    def next(self):
+        with self._lock:
+            if self._pos >= self._nbatches:
+                raise StopIteration
+            own = self._owned
+            start = self._pos * self.batch_size
+            ids = list(own[start:start + self.batch_size])
+            pad = self.batch_size - len(ids)
+            if pad:
+                src = own
+                if not src:
+                    # an empty shard (fewer remaining records than
+                    # ranks after a late-epoch reshard): serve full-pad
+                    # batches from the lowest remaining record so this
+                    # rank stays in the sync-round lockstep its peers
+                    # depend on; pads never commit to the ledger.
+                    # _nbatches > 0 guarantees some part is non-empty.
+                    src = [min(min(p)
+                              for p in self._parts.values() if p)]
+                k = 0
+                while len(ids) < self.batch_size:
+                    ids.append(src[k % len(src)])
+                    k += 1
+            self._pos += 1
+        data, label = self._read(ids)
+        return DataBatch(data=data, label=label, pad=pad,
+                         index=np.asarray(ids, np.int64))
+
+    def reset(self):
+        """Data-epoch boundary: close the current assignment segment and
+        start a fresh pass over the FULL record set under the current
+        membership."""
+        with self._lock:
+            self._close_segment("epoch-end")
+            self.data_epoch += 1
+            self.base = set()
+            self._pos = 0
+            # sync lockstep keeps rank cursors within one batch, so the
+            # rollback target (the newest snapshot generation) is always
+            # in the current or previous data epoch: older commit sets
+            # can never be retracted and would otherwise grow without
+            # bound over a long job
+            for e in [e for e in self._committed
+                      if e < self.data_epoch - 1]:
+                del self._committed[e]
+            if not self.audit:
+                # same rule as _committed: epochs older than the
+                # rollback horizon can never be retracted — dropping
+                # them bounds the ledger at O(records) instead of
+                # O(records x epochs) over a long job
+                for e in [e for e in self.applied
+                          if e < self.data_epoch - 1]:
+                    del self.applied[e]
+            self._recompute()
+
+    def _close_segment(self, why):
+        self.history.append({
+            "why": why, "data_epoch": self.data_epoch,
+            "membership_epoch": self.membership_epoch,
+            "ranks": list(self.ranks), "pos": self._pos,
+            "covered": len(self._served_global(self._pos))})
+
+    # -- ledger commits ----------------------------------------------------
+    def commit(self, index, pad=0):
+        """Record a trained batch's non-pad ids as applied in the
+        surviving trajectory.  ``fit(elastic=True)`` calls this after
+        ``update()`` landed; a batch whose update was rejected with
+        ``StaleEpoch`` is never committed, and commits rolled back by a
+        reshard are retracted in :meth:`reshard`."""
+        ids = np.asarray(index).ravel()
+        if pad:
+            ids = ids[:len(ids) - pad]
+        with self._lock:
+            c = self._committed.setdefault(self.data_epoch, set())
+            a = self.applied.setdefault(self.data_epoch, {})
+            for i in ids:
+                i = int(i)
+                if i in c:
+                    continue  # pad wrap / replay: counted once
+                c.add(i)
+                a[i] = a.get(i, 0) + 1
+
+    def _retract(self, epoch, rolled):
+        """Undo rolled-back commits in the epoch's ledger (lock held):
+        decrement each record's applied count (dropping zeroed entries)
+        and remove it from the committed set, so the records re-enter
+        the remaining pool at the next :meth:`_recompute`."""
+        a = self.applied.setdefault(epoch, {})
+        for i in rolled:
+            n = a.get(i, 0) - 1
+            if n > 0:
+                a[i] = n
+            else:
+                a.pop(i, None)
+        self._committed.get(epoch, set()).difference_update(rolled)
+
+    # -- elastic reshard ---------------------------------------------------
+    def reshard(self, rank, ranks, membership_epoch, state=None):
+        """Recompute shard ownership for a new membership.  With
+        ``state`` (the adopted snapshot's iterator state) the GLOBAL
+        ledger rolls back/forward to that snapshot's boundary first:
+        records the snapshot had not yet accounted return to the
+        remaining pool (their updates were rolled back with the
+        parameters), and this rank's local commits beyond the boundary
+        are retracted — no record is skipped, none is trained twice in
+        the surviving trajectory."""
+        from .elastic import shard_records
+
+        with self._lock:
+            self._close_segment("reshard")
+            if state is not None:
+                s_ranks = sorted(state["ranks"])
+                s_base = set(int(i) for i in state["base"])
+                s_pos = int(state["pos"])
+                s_bs = int(state.get("batch_size", self.batch_size))
+                s_depoch = int(state["data_epoch"])
+                remaining = [i for i in range(self._n) if i not in s_base]
+                parts = shard_records(remaining, s_ranks,
+                                      int(state["membership_epoch"])) \
+                    if remaining else {}
+                new_base = set(s_base)
+                for part in parts.values():
+                    new_base.update(part[:s_pos * s_bs])
+                # retract local commits the rollback undid
+                for epoch in sorted(self._committed):
+                    if epoch < s_depoch:
+                        continue
+                    c = self._committed[epoch]
+                    self._retract(
+                        epoch, c - new_base if epoch == s_depoch else set(c))
+                self.data_epoch = s_depoch
+                self.base = new_base
+            else:
+                # no snapshot generation exists (a fresh job's initial
+                # sync, or a membership change before the leader's first
+                # write landed): there is no common rollback target, so
+                # the SEGMENT START is the rollback target — the base is
+                # kept and the current assignment's local commits are
+                # retracted, giving every member (newcomers included)
+                # the identical remaining pool.  Per-rank committed
+                # views must NOT leak into the base: a pull racing the
+                # epoch bump leaves ranks with different committed
+                # boundaries, and divergent bases mean divergent shard
+                # ownership.  An update that landed without a generation
+                # (at most the segment's first round under the pinned
+                # every-batch elastic cadence) is retrained rather than
+                # divergently skipped.
+                c = self._committed.get(self.data_epoch, set())
+                self._retract(self.data_epoch, c - self.base)
+            self.rank = rank
+            self.ranks = sorted(ranks)
+            self.membership_epoch = int(membership_epoch)
+            self._pos = 0
+            self._recompute()
+
+    # -- iterator-state protocol (PR 5) ------------------------------------
+    def state_dict(self):
+        with self._lock:
+            return {"type": "ElasticShardIter", "num_records": self._n,
+                    "batch_size": self.batch_size,
+                    "data_epoch": self.data_epoch,
+                    "membership_epoch": self.membership_epoch,
+                    "ranks": list(self.ranks), "rank": self.rank,
+                    "pos": self._pos, "base": sorted(self.base)}
+
+    def load_state_dict(self, state):
+        if state.get("type") != "ElasticShardIter":
+            raise MXNetError("iterator state of type %r cannot restore "
+                             "onto ElasticShardIter" % (state.get("type"),))
+        if int(state.get("num_records", self._n)) != self._n or \
+                int(state.get("batch_size", self.batch_size)) \
+                != self.batch_size:
+            raise MXNetError(
+                "ElasticShardIter state (num_records=%s, batch_size=%s) "
+                "does not match this iterator (num_records=%d, "
+                "batch_size=%d)" % (state.get("num_records"),
+                                    state.get("batch_size"), self._n,
+                                    self.batch_size))
+        with self._lock:
+            self.data_epoch = int(state["data_epoch"])
+            self.membership_epoch = int(state["membership_epoch"])
+            self.ranks = sorted(state["ranks"])
+            self.base = set(int(i) for i in state["base"])
+            self._pos = int(state["pos"])
+            self._recompute()
 
 
 def _mp_decode_worker(ctor_kwargs, shm_names, data_shape, label_shape,

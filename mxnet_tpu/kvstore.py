@@ -29,11 +29,27 @@ import os
 import pickle
 import time as _time
 
+from . import elastic as _elastic
 from . import faults as _faults
 from . import telemetry as _telemetry
 from .base import MXNetError, atomic_write_bytes as _atomic_write_bytes
+from .elastic import StaleEpoch
 from .ndarray import NDArray, zeros
 from .retry import RetryPolicy, retry_call
+
+
+#: magic prefix of a multi-server optimizer-states file
+#: (``save_optimizer_states`` / ``load_optimizer_states`` wire format)
+MULTI_STATES_MAGIC = b"MXPSMULTI"
+
+
+def states_file_blobs(data):
+    """Decode a ``save_optimizer_states`` file payload into the per-shard
+    coordinator blob list (single raw blob, or the multi-server
+    ``MULTI_STATES_MAGIC`` + pickled list)."""
+    if data.startswith(MULTI_STATES_MAGIC):
+        return pickle.loads(data[len(MULTI_STATES_MAGIC):])
+    return data
 
 
 def _nd_nbytes(arr):
@@ -45,7 +61,8 @@ def _nd_nbytes(arr):
     except TypeError:
         return 0
 
-__all__ = ["KVStore", "KVStoreDist", "ConnectionLost", "create"]
+__all__ = ["KVStore", "KVStoreDist", "ConnectionLost", "StaleEpoch",
+           "create"]
 
 
 class ConnectionLost(MXNetError):
@@ -236,6 +253,26 @@ class KVStoreDist(KVStore):
                     break
         self._preferred_rank = int(worker_id) if worker_id is not None \
             else None
+        # elastic membership (docs/resilience.md "Elastic membership"):
+        # after the first reshard_sync adoption every push/pull/barrier
+        # carries this worker's membership epoch, so straggler traffic
+        # from an old world is rejected with a typed StaleEpoch.  None
+        # until adopted — the init/first-pull phase predates membership
+        # stabilization and is epoch-free by design.
+        self._elastic = _elastic.enabled()
+        self._epoch = None
+        # the most recent membership epoch observed on any server reply
+        # (elastic servers stamp push/pull success replies), giving the
+        # batch-boundary poll a passive signal instead of a dedicated RPC
+        self._observed_epoch = None
+        if self._elastic and self._num_servers > 1:
+            raise MXNetError(
+                "MXNET_ELASTIC=1 requires a single kvstore server "
+                "(DMLC_NUM_SERVER=1): membership epochs live on the "
+                "coordinator, and shard servers evict dead peers "
+                "independently, so their epochs would diverge and "
+                "permanently reject each other's traffic as stale "
+                "(docs/resilience.md 'Elastic membership & resharding')")
         self._connect_and_register()
         # TPU-native gradient plane: join the jax.distributed process
         # group so training steps run in-graph collectives across
@@ -401,8 +438,29 @@ class KVStoreDist(KVStore):
                 "(reconnect() rejoins with the same rank)"
                 % (msg.get("cmd"),))
         if "error" in reply:
+            if reply.get("stale_epoch"):
+                # typed: the coordinator moved to a new membership epoch
+                # — the caller must run the reshard cycle, not retry
+                raise StaleEpoch(reply["error"], epoch=reply.get("epoch"))
             raise MXNetError(reply["error"])
+        if self._elastic and "epoch" in reply:
+            self._observed_epoch = reply["epoch"]
         return reply
+
+    def _with_epoch(self, msg):
+        """Stamp elastic traffic with this worker's adopted membership
+        epoch (no-op before adoption / outside elastic mode)."""
+        if self._elastic and self._epoch is not None:
+            msg["epoch"] = self._epoch
+        return msg
+
+    def _sever(self, why):
+        """Close every server socket and raise :class:`ConnectionLost` —
+        the observable state of this worker dying abruptly.  Used by the
+        ``kvstore.membership`` / ``elastic.reshard`` fault points (and
+        chaos tests) to kill a worker at a deterministic point."""
+        self._close_socks()
+        raise ConnectionLost(why)
 
     def _server_of(self, key):
         """Small keys live whole on one server (round-robin by key)."""
@@ -444,7 +502,11 @@ class KVStoreDist(KVStore):
                 for sk, sid, sl in shards:
                     self._rpc({"cmd": "init", "key": sk,
                                "value": flat[sl]}, sock=self._socks[sid])
-        self.barrier()
+        if not self._elastic:
+            # elastic jobs synchronize at the reshard rendezvous instead:
+            # a barrier here would wedge a mid-job joiner against
+            # survivors that are deep in the batch loop
+            self.barrier()
 
     def push(self, key, value, priority=0):
         """Push gradients; on :class:`ConnectionLost` the documented
@@ -477,10 +539,10 @@ class KVStoreDist(KVStore):
             tele = _telemetry.enabled()
             t0 = _time.perf_counter() if tele else 0.0
             try:
-                reply = self._rpc({"cmd": "push", "key": k, "value": value,
-                                   "rank": self._rank,
-                                   "round": self._push_seq.get(k, 0)},
-                                  sock=sock)
+                reply = self._rpc(self._with_epoch(
+                    {"cmd": "push", "key": k, "value": value,
+                     "rank": self._rank,
+                     "round": self._push_seq.get(k, 0)}), sock=sock)
             except (ConnectionLost, OSError):
                 self._acked_in_failed_push = acked
                 raise
@@ -518,16 +580,17 @@ class KVStoreDist(KVStore):
             t0 = _time.perf_counter() if tele else 0.0
             shards = self._shards(k, size)
             if shards is None:
-                reply = self._rpc({"cmd": "pull", "key": k,
-                                   "version": self._versions.get(k, 0)},
-                                  sock=self._socks[self._server_of(k)])
+                reply = self._rpc(self._with_epoch(
+                    {"cmd": "pull", "key": k,
+                     "version": self._versions.get(k, 0)}),
+                    sock=self._socks[self._server_of(k)])
                 val = array(reply["value"])
             else:
                 flat = None
                 for sk, sid, sl in shards:
-                    reply = self._rpc(
+                    reply = self._rpc(self._with_epoch(
                         {"cmd": "pull", "key": sk,
-                         "version": self._versions.get(sk, 0)},
+                         "version": self._versions.get(sk, 0)}),
                         sock=self._socks[sid])
                     part = _np.asarray(reply["value"])
                     if flat is None:
@@ -563,7 +626,8 @@ class KVStoreDist(KVStore):
 
     def barrier(self):
         with _telemetry.phase("barrier", family="kvstore"):
-            self._rpc({"cmd": "barrier", "rank": self._rank})
+            self._rpc(self._with_epoch({"cmd": "barrier",
+                                        "rank": self._rank}))
 
     def heartbeat(self):
         """Liveness ping to the scheduler; returns its cluster view
@@ -572,26 +636,117 @@ class KVStoreDist(KVStore):
         _telemetry.inc("kvstore.heartbeats")
         return self._rpc({"cmd": "heartbeat", "rank": self._rank})
 
+    # -- elastic membership (docs/resilience.md) --------------------------
+    @property
+    def epoch(self):
+        """The membership epoch this worker adopted at its last
+        ``reshard_sync`` (None before the first adoption)."""
+        return self._epoch
+
+    @property
+    def observed_epoch(self):
+        """The most recent membership epoch observed on any server reply
+        (elastic servers stamp push/pull success replies with theirs):
+        the batch-boundary poll compares it against the adopted epoch
+        without spending an RPC round-trip per batch.  None before any
+        epoch-carrying reply arrives."""
+        return self._observed_epoch
+
+    def membership(self):
+        """The coordinator's membership view: ``{"epoch": E, "ranks":
+        [...], "num_workers": W}``.  The poll's fallback when no reply
+        has carried an epoch yet."""
+        return self._rpc({"cmd": "membership"})
+
+    def deregister(self):
+        """Graceful leave: announce this worker is going away so the
+        membership shrinks NOW (one epoch bump) instead of after a
+        heartbeat deadline of blocked sync rounds."""
+        rep = self._rpc({"cmd": "deregister", "rank": self._rank})
+        _telemetry.event("elastic.deregister", rank=self._rank,
+                         epoch=rep.get("epoch"))
+        return rep
+
+    def reshard_sync(self):
+        """Quiesce rendezvous: block until every member of the current
+        membership epoch arrives, then ADOPT the released view — the
+        epoch, the rank set, the new world size — and reset the per-key
+        push/pull bookkeeping, which the coordinator restarted at zero
+        when the epoch bumped."""
+        rep = self._rpc({"cmd": "reshard_sync", "rank": self._rank})
+        self._epoch = rep["epoch"]
+        self._num_workers = rep["num_workers"]
+        self._versions = {}
+        self._push_seq = {}
+        self._acked_in_failed_push = set()
+        self._repush_window = False
+        return rep
+
+    def set_reshard_choice(self, choice):
+        """Leader half of the adopted-generation rendezvous: announce
+        the snapshot generation (``{"epoch": e, "nbatch": k}``, or None
+        for no-generation) the whole membership rolls back to, so
+        followers load exactly that generation instead of each trusting
+        its own possibly-lagging manifest read."""
+        return self._rpc(self._with_epoch(
+            {"cmd": "reshard_choice", "rank": self._rank, "set": choice}))
+
+    def get_reshard_choice(self):
+        """Follower half: block until the leader's announcement lands
+        (typed :class:`StaleEpoch` when membership moves mid-wait — the
+        reshard cycle restarts)."""
+        return self._rpc(self._with_epoch(
+            {"cmd": "reshard_choice", "rank": self._rank}))
+
+    def reshard_commit(self):
+        """Post-rehydration barrier (epoch-checked): every member's
+        snapshot reloads are visible before any member trains."""
+        return self._rpc(self._with_epoch({"cmd": "reshard_commit",
+                                           "rank": self._rank}))
+
+    def reload(self, key, value):
+        """Rehydration push: set ``key``'s coordinator value from the
+        adopted snapshot and reset its version/round bookkeeping — on
+        the server AND in this client's counters (other members reset
+        theirs when they adopt the epoch at ``reshard_sync``)."""
+        import numpy as _np
+
+        rep = self._rpc(self._with_epoch(
+            {"cmd": "reload", "key": key, "value": _np.asarray(value)}),
+            sock=self._socks[self._server_of(key)])
+        self._versions.pop(key, None)
+        self._push_seq.pop(key, None)
+        return rep
+
+    def get_updater_states(self):
+        """Pickled coordinator-side optimizer updater states, one blob
+        per shard server (the elastic snapshot's server-optimizer
+        capture)."""
+        return [self._rpc({"cmd": "get_updater_states"}, sock=s)["states"]
+                for s in self._socks]
+
+    def set_updater_states(self, blobs):
+        """Re-install coordinator-side optimizer updater states captured
+        by :meth:`get_updater_states` (rehydration half)."""
+        if isinstance(blobs, (bytes, bytearray)):
+            blobs = [blobs]
+        for s, blob in zip(self._socks, blobs):
+            self._rpc({"cmd": "set_updater_states", "states": blob},
+                      sock=s)
+
     def send_command_to_servers(self, head, body):
         self._rpc({"cmd": "user_command", "head": head, "body": body})
 
     def save_optimizer_states(self, fname):
-        blobs = [self._rpc({"cmd": "get_updater_states"},
-                           sock=s)["states"] for s in self._socks]
+        blobs = self.get_updater_states()
         payload = blobs[0] if len(blobs) == 1 else \
-            b"MXPSMULTI" + pickle.dumps(blobs)
+            MULTI_STATES_MAGIC + pickle.dumps(blobs)
         _atomic_write_bytes(fname, payload)
 
     def load_optimizer_states(self, fname):
         with open(fname, "rb") as f:
             data = f.read()
-        if data.startswith(b"MXPSMULTI"):
-            blobs = pickle.loads(data[len(b"MXPSMULTI"):])
-            for s, blob in zip(self._socks, blobs):
-                self._rpc({"cmd": "set_updater_states", "states": blob},
-                          sock=s)
-        else:
-            self._rpc({"cmd": "set_updater_states", "states": data})
+        self.set_updater_states(states_file_blobs(data))
 
     def close(self):
         """Rank 0 stops the server after a final barrier (the reference's
@@ -599,10 +754,24 @@ class KVStoreDist(KVStore):
         if self._sock is None:
             return
         try:
-            self.barrier()
-            if self._rank == 0:
-                for s in self._socks:
-                    self._rpc({"cmd": "stop"}, sock=s)
+            if not self._elastic:
+                # elastic worker lifetimes are decoupled from the
+                # server's (workers come and go mid-job): leaving just
+                # closes the transport; the operator owns server shutdown
+                self.barrier()
+                if self._rank == 0:
+                    for s in self._socks:
+                        self._rpc({"cmd": "stop"}, sock=s)
+            else:
+                # a deliberately-departing elastic worker announces the
+                # leave so the membership shrinks NOW; best-effort — an
+                # already-severed transport (or an already-deregistered
+                # rank: fit's exception path calls leave() first) falls
+                # back to heartbeat-death eviction
+                try:
+                    self.deregister()
+                except Exception:  # noqa: broad-except — closing anyway
+                    pass
         finally:
             for s in self._socks:
                 s.close()

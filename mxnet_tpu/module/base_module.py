@@ -25,10 +25,17 @@ from .. import perfdebug as _perfdebug
 from .. import random as _random
 from .. import telemetry as _telemetry
 from ..base import MXNetError
+from ..elastic import MembershipChanged, StaleEpoch, \
+    enabled as _elastic_enabled
 from ..model import BatchEndParam
 from ..initializer import Uniform
 
 __all__ = ["BaseModule"]
+
+#: control-flow exceptions that hand fit(elastic=True) back to the
+#: reshard cycle: a typed stale-epoch rejection from the coordinator, or
+#: the batch-boundary membership poll noticing an epoch bump
+_ELASTIC_RESYNC = (StaleEpoch, MembershipChanged)
 
 _NAN_POLICIES = ("raise", "skip_batch", "rollback")
 
@@ -138,16 +145,19 @@ def _adapt_iter_state(state, target):
 
 class _FitRun:
     """Per-``fit`` resilience plumbing: the batch-granular snapshot
-    cadence, the async writer, and the preemption drain sequence."""
+    cadence, the async writer, the preemption drain sequence, and —
+    for ``fit(elastic=True)`` — the elastic ledger-commit/membership-poll
+    hooks."""
 
     def __init__(self, prefix, every_n, writer, guard, logger,
-                 keep_last=None):
+                 keep_last=None, elastic=None):
         self.prefix = prefix
         self.every_n = every_n
         self.writer = writer
         self.guard = guard
         self.logger = logger
         self.keep_last = keep_last
+        self.elastic = elastic
         self._warned_iter = False
 
     def capture(self, module, epoch, nbatch, fit_data, eval_metric):
@@ -184,21 +194,35 @@ class _FitRun:
             metric_state = eval_metric.get_state()
         except NotImplementedError:
             metric_state = None
-        return _ckpt.Snapshot(epoch, nbatch, arg, aux,
+        snap = _ckpt.Snapshot(epoch, nbatch, arg, aux,
                               opt_states=opt_states,
                               opt_counts=opt_counts, rng_state=rng,
                               metric_state=metric_state,
                               iter_state=iter_state)
+        if self.elastic is not None:
+            # fold the coordinator-side optimizer states in: elastic
+            # rehydration restores the server's momentum from the snapshot
+            self.elastic.augment_snapshot(snap)
+        return snap
 
     def after_batch(self, module, epoch, nbatch, fit_data, eval_metric,
-                    drain_guard=None):
-        """Bottom-of-batch hook: take the cadence snapshot, then honor a
-        pending preemption (the in-flight batch is complete by now)."""
-        if self.every_n is not None and (nbatch + 1) % self.every_n == 0:
+                    drain_guard=None, data_batch=None):
+        """Bottom-of-batch hook: commit the batch to the elastic data
+        ledger, take the cadence snapshot, honor a pending preemption
+        (the in-flight batch is complete by now), then poll elastic
+        membership — a change raises out to the reshard cycle."""
+        if self.elastic is not None:
+            self.elastic.commit(data_batch)
+        if self.every_n is not None and (nbatch + 1) % self.every_n == 0 \
+                and (self.elastic is None or self.elastic.is_leader()):
+            # elastic fits share one prefix across ranks: only the
+            # membership leader writes, so generations never interleave
             self.writer.submit(
                 self.capture(module, epoch, nbatch, fit_data, eval_metric))
         self.check_preempt(module, epoch, nbatch, fit_data, eval_metric,
                            drain_guard)
+        if self.elastic is not None:
+            self.elastic.poll(epoch, nbatch)
 
     def epoch_end_preempt(self, module, epoch, already_saved):
         """Preemption noticed at the epoch boundary: epoch ``epoch`` is
@@ -209,7 +233,12 @@ class _FitRun:
 
         signum = self.guard.requested
         path = None
-        if self.prefix is not None:
+        if self.prefix is not None and \
+                (self.elastic is None or self.elastic.is_leader()):
+            # elastic ranks share one prefix: only the membership leader
+            # writes the drain checkpoint (same single-writer rule as the
+            # cadence snapshots); a preempted non-leader just leaves — the
+            # survivors reshard from the leader's generations
             if not already_saved:
                 arg_params_, aux_params_ = module.get_params()
                 module._save_fit_checkpoint(self.prefix, epoch + 1,
@@ -243,7 +272,12 @@ class _FitRun:
         if drain_guard is not None:
             drain_guard()
         path = None
-        if self.prefix is not None:
+        if self.prefix is not None and \
+                (self.elastic is None or self.elastic.is_leader()):
+            # single-writer rule under a shared elastic prefix (see
+            # epoch_end_preempt): a preempted non-leader writes nothing —
+            # concurrent same-generation writes from racing ranks could
+            # interleave params/states files across writers
             snap = self.capture(module, epoch, nbatch, fit_data,
                                 eval_metric)
             if self.writer is not None:
@@ -389,7 +423,8 @@ class BaseModule:
             begin_epoch=0, num_epoch=None, validation_metric=None,
             monitor=None, checkpoint_prefix=None, checkpoint_period=1,
             resume=None, nan_policy=None, nan_check_period=None,
-            prefetch_to_device=None, checkpoint_every_n_batches=None):
+            prefetch_to_device=None, checkpoint_every_n_batches=None,
+            elastic=None):
         """reference ``base_module.py:369`` — THE training loop.
 
         Sync-free hot loop (docs/how_to/perf.md): eligible metrics are
@@ -442,8 +477,59 @@ class BaseModule:
             device-side reduction folded into the step; with
             ``nan_check_period=N`` the one-scalar flag read happens every
             N batches (amortized semantics: see docs/resilience.md).
+        ``elastic``
+            (default: the ``MXNET_ELASTIC`` env var) Elastic membership
+            (docs/resilience.md "Elastic membership & resharding"): the
+            world size may change mid-job.  Requires a ``dist_*``
+            kvstore and ``checkpoint_prefix`` (the snapshot protocol is
+            the reshard transport; the cadence is PINNED to every batch
+            — a sparser ``checkpoint_every_n_batches`` is a typed error
+            and ``MXNET_CKPT_EVERY_N_BATCHES`` is ignored with a
+            warning, because the manifest is the reshard rollback
+            target and a sparser cadence would discard committed work).
+            On a membership-epoch bump this fit quiesces at the next
+            batch boundary, rendezvouses with the surviving/new members,
+            rehydrates from the newest snapshot generation and continues
+            in-loop — the process never restarts, and two replays of the
+            same elasticity schedule are bit-identical.  Pair
+            ``train_data`` with an :class:`~mxnet_tpu.io.ElasticShardIter`
+            so the data partition reshards with the world.  NOTE: the
+            initial rendezvous also adopts the newest snapshot
+            generation already under ``checkpoint_prefix`` — a mid-job
+            joiner is indistinguishable from a fresh start, so
+            ``elastic=True`` implies ``resume="auto"`` semantics; give
+            a fresh job a fresh prefix.
         """
         assert num_epoch is not None, "please specify number of epochs"
+
+        if elastic is None:
+            elastic = _elastic_enabled()
+        if elastic:
+            if checkpoint_prefix is None:
+                raise MXNetError(
+                    "fit(elastic=True) needs checkpoint_prefix: the "
+                    "snapshot manifest is the reshard transport")
+            # elastic rollback granularity IS the snapshot cadence, and
+            # it is pinned to every batch: a sparser cadence would
+            # discard up to N-1 committed batches per membership change
+            # and widen the no-generation reshard window the ledger
+            # fallback is built around (io.py ElasticShardIter.reshard)
+            if checkpoint_every_n_batches is not None \
+                    and checkpoint_every_n_batches > 1:
+                raise MXNetError(
+                    "fit(elastic=True) snapshots every batch (the "
+                    "manifest is the reshard rollback target); got "
+                    "checkpoint_every_n_batches=%d"
+                    % checkpoint_every_n_batches)
+            env_n = int(os.environ.get(
+                "MXNET_CKPT_EVERY_N_BATCHES", "0") or 0)
+            if env_n > 1:
+                self.logger.warning(
+                    "MXNET_CKPT_EVERY_N_BATCHES=%d ignored under "
+                    "fit(elastic=True): elastic snapshots every batch "
+                    "(the manifest is the reshard rollback target)",
+                    env_n)
+            checkpoint_every_n_batches = 1
 
         if nan_policy is None:
             nan_policy = os.environ.get("MXNET_NAN_POLICY") or None
@@ -608,7 +694,7 @@ class BaseModule:
         use_bulk = bulk_k > 1 and monitor is None \
             and nan_policy is None and not _faults.armed("fit.batch") \
             and not _faults.armed("fit.preempt") \
-            and hasattr(self, "run_bulk")
+            and not elastic and hasattr(self, "run_bulk")
         if use_bulk and hasattr(self, "_full_step_eligible") \
                 and not self._full_step_eligible():
             self.logger.warning(
@@ -689,18 +775,46 @@ class BaseModule:
                     "resume: snapshot carries no iterator state; "
                     "restarting epoch %d from batch 0 — data from the "
                     "partial epoch will replay", resume_state.epoch)
+        elastic_run = None
+        if elastic:
+            from ..elastic import ElasticFitRun
+
+            kv = getattr(self, "_kvstore", None)
+            if kv is None or not hasattr(kv, "reshard_sync"):
+                raise MXNetError(
+                    "fit(elastic=True) needs a dist_* kvstore (got %r): "
+                    "elastic membership lives on the KVStore coordinator"
+                    % (kvstore if kv is None else kv.type))
+            elastic_run = ElasticFitRun(self, kv, checkpoint_prefix,
+                                        fit_data, self.logger)
+            _telemetry.declare("elastic.resharded.count",
+                               "elastic.stale_epoch.count")
         writer = None
         if checkpoint_every_n_batches is not None:
             from ..checkpoint import AsyncSnapshotWriter
 
-            ckpt_async = os.environ.get("MXNET_CKPT_ASYNC", "1") \
+            # elastic snapshots are the reshard rollback target: they
+            # must exist deterministically at every committed boundary,
+            # so the writer is PINNED inline (the async writer drops
+            # cadence snapshots when busy, which would make the rollback
+            # generation timing-dependent and break replay bit-identity)
+            # — an explicit MXNET_CKPT_ASYNC=1 is ignored with a warning,
+            # the same treatment MXNET_CKPT_EVERY_N_BATCHES gets
+            ckpt_async = os.environ.get(
+                "MXNET_CKPT_ASYNC", "0" if elastic else "1") \
                 not in ("0", "", "false")
+            if elastic and ckpt_async:
+                self.logger.warning(
+                    "MXNET_CKPT_ASYNC=1 ignored under fit(elastic=True): "
+                    "elastic snapshots are the reshard rollback target "
+                    "and must land inline at every committed boundary")
+                ckpt_async = False
             writer = AsyncSnapshotWriter(checkpoint_prefix,
                                          logger=self.logger,
                                          sync=not ckpt_async)
         guard = _PreemptGuard()
         run = _FitRun(checkpoint_prefix, checkpoint_every_n_batches,
-                      writer, guard, self.logger)
+                      writer, guard, self.logger, elastic=elastic_run)
         # visible to _rollback_to_checkpoint: a rollback must quiesce
         # the writer before discarding post-rollback snapshots
         self._active_ckpt_writer = writer
@@ -711,16 +825,42 @@ class BaseModule:
             with _preempt_signals(guard, self.logger,
                                   enable=checkpoint_prefix is not None):
                 try:
-                    self._fit_epochs(
-                        fit_data, eval_data, eval_metric,
-                        validation_metric, epoch_end_callback,
-                        batch_end_callback, eval_end_callback,
-                        eval_batch_end_callback, monitor, begin_epoch,
-                        num_epoch, checkpoint_prefix, checkpoint_period,
-                        nan_policy, nan_check_period, use_bulk, bulk_k,
-                        _trip_nan_policy, owns_iter, run=run,
-                        resume_nbatch=resume_nbatch,
-                        resume_metric_state=resume_metric_state)
+                    if elastic_run is not None:
+                        # initial rendezvous: adopt the membership epoch
+                        # and world, shard the data service — and, for a
+                        # mid-job JOINER, rehydrate from the running
+                        # job's newest snapshot generation
+                        begin_epoch, resume_nbatch, resume_metric_state \
+                            = elastic_run.sync(
+                                (begin_epoch, resume_nbatch,
+                                 resume_metric_state))
+                    while True:
+                        try:
+                            self._fit_epochs(
+                                fit_data, eval_data, eval_metric,
+                                validation_metric, epoch_end_callback,
+                                batch_end_callback, eval_end_callback,
+                                eval_batch_end_callback, monitor,
+                                begin_epoch, num_epoch, checkpoint_prefix,
+                                checkpoint_period, nan_policy,
+                                nan_check_period, use_bulk, bulk_k,
+                                _trip_nan_policy, owns_iter, run=run,
+                                resume_nbatch=resume_nbatch,
+                                resume_metric_state=resume_metric_state)
+                            break
+                        except _ELASTIC_RESYNC as e:
+                            if elastic_run is None:
+                                raise
+                            # membership moved: quiesce is NOW (we are at
+                            # a batch boundary, or the update that raised
+                            # StaleEpoch never landed) — run the reshard
+                            # cycle and re-enter the loop in-process
+                            self.logger.info(
+                                "elastic: quiescing for reshard (%s)", e)
+                            begin_epoch, resume_nbatch, \
+                                resume_metric_state = elastic_run.sync(
+                                    (begin_epoch, resume_nbatch,
+                                     resume_metric_state))
                 except Exception as e:
                     # crash flight record: preemption and NaN trips
                     # dumped at their own sites already (with richer
@@ -732,6 +872,15 @@ class BaseModule:
                         _perfdebug.flight_dump(
                             "crash",
                             error="%s: %s" % (type(e).__name__, e))
+                    if elastic_run is not None:
+                        # ANY exit — preemption, NaN raise, a crashed
+                        # callback — leaves the job: announce it so the
+                        # survivors reshard at their next batch boundary
+                        # instead of stalling a full heartbeat deadline
+                        # in a sync round this rank will never join
+                        # (best-effort; a severed transport falls back
+                        # to heartbeat-death eviction)
+                        elastic_run.leave()
                     raise
             if writer is not None:
                 # clean-path close surfaces a failed background write as
@@ -924,7 +1073,12 @@ class BaseModule:
                             drain_guard=lambda e=epoch, b=nbatch,
                             g=window_all_staged: self._drain_nan_window(
                                 nan_policy, nan_check_period, e, b, g,
-                                _trip_nan_policy))
+                                _trip_nan_policy),
+                            # a NaN-tripped batch's update never landed
+                            # (skipped or rolled back): it must not enter
+                            # the elastic data ledger as trained
+                            data_batch=None if nan_detected
+                            else data_batch)
                 # epoch-boundary drain: with nan_check_period > 1 the
                 # last window may not have been read yet — a NaN epoch
                 # must not survive into checkpoint/eval unflagged
@@ -945,7 +1099,11 @@ class BaseModule:
             self.set_params(arg_params_, aux_params_)
             if checkpoint_prefix is not None and \
                     ((epoch + 1) % checkpoint_period == 0
-                     or epoch + 1 == num_epoch):
+                     or epoch + 1 == num_epoch) and \
+                    (run is None or run.elastic is None
+                     or run.elastic.is_leader()):
+                # elastic fits share one prefix: the membership leader
+                # owns the epoch checkpoints (like the snapshot cadence)
                 with _telemetry.phase("checkpoint"):
                     self._save_fit_checkpoint(checkpoint_prefix, epoch + 1,
                                               arg_params_, aux_params_)

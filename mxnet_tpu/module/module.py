@@ -16,6 +16,7 @@ no separate comm phase.  A single context degenerates to a 1-device mesh.
 from __future__ import annotations
 
 import logging
+import pickle
 import time as _time_mod
 
 import numpy as np
@@ -369,13 +370,18 @@ class Module(BaseModule):
         if isinstance(optimizer, str):
             idx2name = {i: n for i, n in enumerate(self._param_names)}
             optimizer_params = dict(optimizer_params)
-            if "rescale_grad" not in optimizer_params:
+            # whether rescale_grad is framework-derived (1/global-batch)
+            # or user-supplied: an elastic reshard recomputes the former
+            # for the new world size but must never clobber the latter
+            self._auto_rescale_grad = "rescale_grad" not in optimizer_params
+            if self._auto_rescale_grad:
                 optimizer_params["rescale_grad"] = rescale_grad
             optimizer = opt.create(optimizer, sym=self.symbol,
                                    param_idx2name=idx2name,
                                    **optimizer_params)
         else:
             assert isinstance(optimizer, opt.Optimizer)
+            self._auto_rescale_grad = False
             if optimizer.rescale_grad != rescale_grad:
                 self.logger.warning(
                     "Optimizer created manually outside Module but "
@@ -409,6 +415,13 @@ class Module(BaseModule):
         self._kvstore = shared_module._kvstore
         self._update_on_kvstore = shared_module._update_on_kvstore
         self._updater = shared_module._updater
+        # whether rescale_grad is framework-derived travels with the
+        # optimizer: an elastic reshard recomputes it for the new world
+        # only when the lender's init derived it (fit's init_optimizer
+        # early-returns on the borrowed flag, so this is the only site
+        # that can carry it over)
+        self._auto_rescale_grad = getattr(
+            shared_module, "_auto_rescale_grad", False)
         self.optimizer_initialized = True
 
     # -- compute ----------------------------------------------------------
@@ -1192,15 +1205,57 @@ class Module(BaseModule):
                     self._optimizer._index_update_count.items()}}
         return arg, aux, opt_states, opt_counts
 
+    def _elastic_param_entries(self):
+        """The kvstore key space of this module's parameters:
+        ``[(key, name)]`` in the exact ``init_optimizer`` enumeration
+        order — the domain of the elastic reshard's
+        :func:`~mxnet_tpu.elastic.assign_keys` key-ownership map."""
+        return list(enumerate(self._param_names))
+
+    def _elastic_pull_params(self):
+        """Pull every parameter from the (just-rehydrated) coordinator
+        into the bound executor — the final step of the elastic reshard
+        cycle, after which every member holds the identical
+        post-reshard state."""
+        assert self._kvstore is not None
+        for i, n in enumerate(self._param_names):
+            self._kvstore.pull(i, [self._exec.arg_dict[n]], priority=-i)
+
     def _restore_opt_snapshot(self, states_bytes, opt_counts):
         """Resume half of :meth:`_capture_state_arrays`: re-install the
         pickled updater states and the optimizer's update counters so a
         resumed run's lr schedule continues exactly."""
         if states_bytes is not None and self._updater is not None:
-            self._updater.set_states(states_bytes)
-            # unpickled states are locally-committed host arrays — the
-            # next update jit re-places them on the module mesh
-            self._dist_placed_states.clear()
+            from ..elastic import SERVER_STATES_KEY
+
+            payload = None
+            if SERVER_STATES_KEY.encode() in states_bytes:
+                # the marker string can only appear in the pickle of an
+                # elastic leader snapshot's marker dict — the bytes scan
+                # gates the unpickle so a plain (non-elastic) updater
+                # tree is never deserialized twice; the dict check below
+                # stays authoritative
+                try:
+                    payload = pickle.loads(states_bytes)
+                except Exception:  # noqa: broad-except — not a plain
+                    # pickle; let set_states apply its own format handling
+                    payload = None
+            if isinstance(payload, dict) and SERVER_STATES_KEY in payload:
+                # an elastic leader snapshot: its .states carry the
+                # SERVER-side updater blobs (re-installed on the
+                # coordinator by the reshard cycle), not a local updater
+                # tree — installing them locally would corrupt the state
+                # structure.  A non-elastic resume of an elastic prefix
+                # restarts local momentum instead.
+                self.logger.warning(
+                    "resume: snapshot optimizer states are elastic "
+                    "coordinator-side blobs; local updater momentum "
+                    "restarts from zero")
+            else:
+                self._updater.set_states(states_bytes)
+                # unpickled states are locally-committed host arrays —
+                # the next update jit re-places them on the module mesh
+                self._dist_placed_states.clear()
         if opt_counts and self._optimizer is not None:
             self._optimizer.num_update = int(
                 opt_counts.get("num_update", self._optimizer.num_update))

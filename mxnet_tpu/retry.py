@@ -9,12 +9,29 @@ last error propagates unchanged.
 
 from __future__ import annotations
 
+import os
 import random
 import time
 
 from . import telemetry as _telemetry
 
 __all__ = ["RetryPolicy", "retry_call"]
+
+
+def _jitter_rng():
+    """The jitter source: the global ``random`` module normally (herd
+    de-sync wants genuine process entropy), but a PRIVATE ``Random``
+    seeded from ``MXNET_CHAOS_SEED`` when the chaos harness sets it —
+    chaos replays of reconnect/rejoin storms must draw identical backoff
+    schedules, and seeding the global module would perturb every other
+    consumer of ``random`` in the process."""
+    seed = os.environ.get("MXNET_CHAOS_SEED")
+    if not seed:
+        return random
+    try:
+        return random.Random(int(seed))
+    except ValueError:
+        return random.Random(seed)
 
 
 class RetryPolicy:
@@ -46,10 +63,14 @@ class RetryPolicy:
 
     def delays(self):
         """Yield sleep durations; the *caller* enforces the deadline (it
-        knows when the first attempt started)."""
+        knows when the first attempt started).  Each generator draws its
+        jitter from :func:`_jitter_rng` — the global ``random`` module
+        (herd de-sync) unless ``MXNET_CHAOS_SEED`` pins a private,
+        replayable stream."""
+        rng = _jitter_rng()
         d = self.base_delay
         while True:
-            yield d * (1.0 - self.jitter * random.random())
+            yield d * (1.0 - self.jitter * rng.random())
             d = min(d * 2.0, self.max_delay)
 
 

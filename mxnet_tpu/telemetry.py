@@ -27,8 +27,8 @@ snapshot JSON to ``path`` plus the event log to
 ``<path-sans-ext>.events.jsonl``.
 
 Metric names are dotted families (``fit.*``, ``kvstore.*``, ``xla.*``,
-``resilience.*``, ``memory.*``); labels are free-form keyword arguments
-(``inc("kvstore.push.count", server=0)``).
+``resilience.*``, ``elastic.*``, ``memory.*``); labels are free-form
+keyword arguments (``inc("kvstore.push.count", server=0)``).
 """
 
 from __future__ import annotations

@@ -1,0 +1,1253 @@
+"""Elastic training suite (docs/resilience.md "Elastic membership &
+resharding"): membership epochs + typed StaleEpoch on the coordinator,
+deterministic resharding in ``fit(elastic=True)``, the checkpointable
+sharded data service (``io.ElasticShardIter``), the reshard fault points,
+and the satellites — seeded retry jitter, server close() waking parked
+waiters, iterator state across shard reassignment.
+
+The acceptance scenario (kill one of four workers mid-epoch, admit two
+replacements, replay twice bit-identically with an exactly-once sample
+ledger) runs in-process: one elastic ``KVStoreServer`` + one thread per
+worker, each driving its own ``Module.fit(elastic=True)``.  Kill points
+are driven by the test (socket sever at a chosen batch) and by the
+``kvstore.membership`` / ``elastic.reshard`` fault points, rotated by
+``MXNET_CHAOS_SEED`` in the chaos matrix (ci/run_chaos.sh).
+"""
+
+import os
+import pathlib
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import elastic, faults, kvstore, kvstore_server
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.io import ElasticShardIter, PrefetchingIter
+from mxnet_tpu.kvstore import ConnectionLost, StaleEpoch
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+
+CHAOS_SEED = int(os.environ.get("MXNET_CHAOS_SEED", "0"))
+
+_ELASTIC_ENV = ("MXNET_ELASTIC", "MXNET_ELASTIC_QUIESCE_DEADLINE",
+                "MXNET_ELASTIC_MIN_WORKERS", "MXNET_ELASTIC_MAX_WORKERS",
+                "MXNET_KVSTORE_HEARTBEAT_DEADLINE", "DMLC_WORKER_ID",
+                "DMLC_PS_ROOT_URI", "DMLC_PS_ROOT_PORT")
+
+
+@pytest.fixture(autouse=True)
+def _clean_env():
+    faults.disarm()
+    saved = {k: os.environ.get(k) for k in _ELASTIC_ENV}
+    yield
+    faults.disarm()
+    for k, v in saved.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+
+
+def _start_server(n, **kw):
+    kw.setdefault("elastic", True)
+    kw.setdefault("heartbeat_deadline", 1.0)
+    kw.setdefault("quiesce_deadline", 8.0)
+    srv = kvstore_server.KVStoreServer(n, **kw)
+    srv.start_background()
+    os.environ["MXNET_ELASTIC"] = "1"
+    os.environ["DMLC_PS_ROOT_URI"] = "127.0.0.1"
+    os.environ["DMLC_PS_ROOT_PORT"] = str(srv.port)
+    return srv
+
+
+def _connect(wid):
+    os.environ["DMLC_WORKER_ID"] = str(wid)
+    return kvstore.KVStoreDist("dist_sync")
+
+
+def _in_threads(fns, timeout=120):
+    errors = []
+
+    def wrap(fn):
+        try:
+            fn()
+        except Exception as e:  # noqa: BLE001 — surfaced via the list
+            errors.append(e)
+
+    ts = [threading.Thread(target=wrap, args=(fn,), daemon=True)
+          for fn in fns]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=timeout)
+    assert not any(t.is_alive() for t in ts), "worker hung/deadlocked"
+    return errors
+
+
+# -- pure reshard math -------------------------------------------------------
+
+def test_assign_keys_pure_and_balanced():
+    ranks = [0, 2, 5]
+    a1 = elastic.assign_keys(range(9), ranks, epoch=3)
+    a2 = elastic.assign_keys(list(reversed(range(9))), [5, 0, 2], epoch=3)
+    assert a1 == a2  # pure in (sorted keys, sorted ranks, epoch)
+    counts = {r: sum(1 for v in a1.values() if v == r) for r in ranks}
+    assert set(counts.values()) == {3}
+    assert elastic.assign_keys(range(9), ranks, 3) != \
+        elastic.assign_keys(range(9), ranks, 4)  # epoch rotates ownership
+
+
+def test_shard_records_partition_properties():
+    ids = list(range(23))
+    parts = elastic.shard_records(ids, [1, 4, 7], epoch=2)
+    got = sorted(i for p in parts.values() for i in p)
+    assert got == ids  # exact partition: no loss, no duplication
+    sizes = sorted(len(p) for p in parts.values())
+    assert sizes[-1] - sizes[0] <= 1
+    # pure: any arrival order of ids/ranks gives the identical partition
+    assert parts == elastic.shard_records(list(reversed(ids)), [7, 1, 4], 2)
+
+
+# -- the sharded data service ------------------------------------------------
+
+def _drain_ids(it, commit=True):
+    """Serve an iterator to exhaustion, returning non-pad ids per batch."""
+    out = []
+    while True:
+        try:
+            b = it.next()
+        except StopIteration:
+            return out
+        ids = list(np.asarray(b.index)[:len(b.index) - b.pad])
+        if commit:
+            it.commit(b.index, b.pad)
+        out.append(ids)
+
+
+def test_elastic_shard_iter_covers_exactly_once_static():
+    N, BS = 24, 4
+    x = np.arange(N * 2, dtype=np.float32).reshape(N, 2)
+    y = np.arange(N, dtype=np.float32)
+    its = [ElasticShardIter(x, y, batch_size=BS, rank=r, ranks=(0, 1, 2))
+           for r in range(3)]
+    served = [i for it in its for b in _drain_ids(it) for i in b]
+    assert sorted(served) == list(range(N))
+    for it in its:
+        assert it.ledger() == set(range(N))
+
+
+def test_iter_state_across_reassignment_ndarray():
+    """Satellite: capture state_dict on N workers mid-epoch, restore the
+    shard assignment onto N-1 and N+1 workers, and assert via the ledger
+    that the epoch's record set is covered exactly once."""
+    N, BS, W = 36, 3, 3
+    x = np.arange(N, dtype=np.float32).reshape(N, 1)
+    y = np.arange(N, dtype=np.float32)
+    for new_world in (W - 1, W + 1):
+        its = [ElasticShardIter(x, y, batch_size=BS, rank=r,
+                                ranks=range(W)) for r in range(W)]
+        # mid-epoch: every worker serves (and commits) 2 lockstep batches
+        for _ in range(2):
+            for it in its:
+                b = it.next()
+                it.commit(b.index, b.pad)
+        state = its[0].state_dict()  # ANY rank's state carries the
+        assert state["pos"] == 2     # GLOBAL ledger for its boundary
+        new_ranks = list(range(new_world))
+        new_its = [ElasticShardIter(x, y, batch_size=BS, rank=r,
+                                    ranks=new_ranks) for r in new_ranks]
+        for it in new_its:
+            it.reshard(it.rank, new_ranks, membership_epoch=5, state=state)
+        consumed_before = its[0].ledger()
+        assert len(consumed_before) == 2 * BS * W
+        served_after = [i for it in new_its
+                        for b in _drain_ids(it) for i in b]
+        # exactly once: pre-reshard ledger + post-reshard serves tile N
+        assert not (set(served_after) & consumed_before)
+        assert sorted(set(served_after) | consumed_before) == list(range(N))
+        assert sorted(served_after) == sorted(set(served_after))
+        for it in new_its:
+            assert it.ledger() == set(range(N))
+
+
+def test_iter_state_across_reassignment_recordio(tmp_path):
+    """Same exactness over an MXRecordIO-backed source: records live in
+    an indexed .rec file and are fetched by id through record_reader."""
+    from mxnet_tpu import recordio
+
+    N, BS = 18, 3
+    rec = str(tmp_path / "data.rec")
+    idx = str(tmp_path / "data.idx")
+    w = recordio.MXIndexedRecordIO(idx, rec, "w")
+    for i in range(N):
+        w.write_idx(i, np.full((4,), i, np.float32).tobytes())
+    w.close()
+    reader_store = recordio.MXIndexedRecordIO(idx, rec, "r")
+    lock = threading.Lock()
+
+    def record_reader(ids):
+        with lock:  # MXIndexedRecordIO seeks; serialize access
+            rows = [np.frombuffer(reader_store.read_idx(i), np.float32)
+                    for i in ids]
+        return [np.stack(rows)], [np.array([r[0] for r in rows])]
+
+    its = [ElasticShardIter(record_reader=record_reader, num_records=N,
+                            batch_size=BS, rank=r, ranks=(0, 1))
+           for r in range(2)]
+    for _ in range(2):
+        for it in its:
+            b = it.next()
+            # the batch payload really is the addressed records
+            np.testing.assert_array_equal(
+                np.asarray(b.data[0].asnumpy())[:, 0],
+                np.asarray(b.index, np.float32))
+            it.commit(b.index, b.pad)
+    state = its[1].state_dict()
+    grown = [ElasticShardIter(record_reader=record_reader, num_records=N,
+                              batch_size=BS, rank=r, ranks=(0, 1, 2))
+             for r in range(3)]
+    for it in grown:
+        it.reshard(it.rank, (0, 1, 2), membership_epoch=3, state=state)
+    served = [i for it in grown for b in _drain_ids(it) for i in b]
+    assert sorted(set(served) | its[0].ledger()) == list(range(N))
+    assert not (set(served) & its[0].ledger())
+    reader_store.close()
+
+
+def test_iter_state_dict_roundtrip_through_prefetch_wrapper():
+    N, BS = 16, 4
+    x = np.arange(N, dtype=np.float32).reshape(N, 1)
+    inner = ElasticShardIter(x, np.arange(N, dtype=np.float32),
+                             batch_size=BS, rank=0, ranks=(0,))
+    with PrefetchingIter(inner) as it:
+        first = it.next()
+        st = it.state_dict()
+        assert st["inner"][0]["type"] == "ElasticShardIter"
+        # pre-produce capture: the buffered batch is accounted, so the
+        # restored wrapper re-serves the batch after `first`
+        it.load_state_dict(st)
+        again = it.next()
+        assert list(np.asarray(again.index)) != list(np.asarray(first.index))
+
+
+def test_empty_shard_rank_serves_pad_only_batches():
+    """A late-epoch reshard can leave fewer remaining records than
+    ranks: the empty-shard rank must serve full-pad batches (staying in
+    sync-round lockstep, committing nothing) — not crash mid-training."""
+    N, BS = 4, 2
+    x = np.arange(N, dtype=np.float32).reshape(N, 1)
+    y = np.arange(N, dtype=np.float32)
+    # a 1-worker world consumed the first batch (records 0,1); reshard
+    # the remaining {2,3} over THREE ranks -> one rank owns nothing
+    state = {"type": "ElasticShardIter", "num_records": N,
+             "batch_size": BS, "data_epoch": 0, "membership_epoch": 0,
+             "ranks": [0], "rank": 0, "pos": 1, "base": []}
+    its = [ElasticShardIter(x, y, batch_size=BS, rank=r, ranks=(0, 1, 2))
+           for r in range(3)]
+    for it in its:
+        it.reshard(it.rank, (0, 1, 2), membership_epoch=1, state=state)
+    empty = [it for it in its if not it._owned]
+    assert len(empty) == 1  # the state above really produces one
+    served = {}
+    for it in its:
+        served[it.rank] = [i for b in _drain_ids(it) for i in b]
+    assert served[empty[0].rank] == []  # all-pad, nothing committed
+    got = sorted(i for ids in served.values() for i in ids)
+    assert got == [2, 3]  # the remainder, exactly once
+    for it in its:
+        assert it.ledger() == set(range(N))
+
+
+def test_prefetch_drain_parks_producers():
+    """Satellite: PrefetchingIter.drain() parks the producer threads so
+    the inner iterator is safe to mutate (the elastic reshard path) —
+    then load_state_dict re-arms onto the mutated state."""
+    N, BS = 12, 3
+    x = np.arange(N, dtype=np.float32).reshape(N, 1)
+    inner = ElasticShardIter(x, np.arange(N, dtype=np.float32),
+                             batch_size=BS, rank=0, ranks=(0,))
+    with PrefetchingIter(inner) as it:
+        b = it.next()
+        inner.commit(b.index, b.pad)  # fit commits once the update lands
+        it.drain()
+        assert all(e.is_set() for e in it.data_ready)
+        inner.reshard(0, (0, 1), membership_epoch=1)
+        it.load_state_dict({"type": "PrefetchingIter",
+                            "inner": [inner.state_dict()]})
+        ids = [i for b in _drain_ids(it, commit=False) for i in b]
+        # no snapshot generation: the reshard rolls the segment back to
+        # its start — the committed batch (0-2) and the BUFFERED batch
+        # (3-5) alike return to the pool, and the drain re-serves
+        # exactly rank 0's shard of the full record set under epoch 1
+        assert sorted(i for p in inner._parts.values() for i in p) \
+            == list(range(N))
+    assert sorted(ids) == sorted(inner._parts[0])
+
+
+# -- membership epochs on the coordinator ------------------------------------
+
+def test_stale_epoch_is_typed_and_counted():
+    srv = _start_server(2)
+    kv0, kv1 = _connect(0), _connect(1)
+    errs = _in_threads([lambda: kv0.reshard_sync(),
+                        lambda: kv1.reshard_sync()])
+    assert not errs
+    kv0.init(7, mx.nd.zeros((2,)))
+    kv1.deregister()  # membership change: kv0's world moved on
+    with pytest.raises(StaleEpoch) as ei:
+        kv0.push(7, mx.nd.ones((2,)))
+    assert ei.value.epoch == srv.epoch  # carries the current epoch
+    # the cycle recovers: resync adopts the new world and traffic flows
+    rep = kv0.reshard_sync()
+    assert rep["ranks"] == [0] and rep["num_workers"] == 1
+    kv0.push(7, mx.nd.ones((2,)))
+    out = mx.nd.zeros((2,))
+    kv0.pull(7, out=out)
+    np.testing.assert_allclose(out.asnumpy(), 1.0)
+    srv.close()
+
+
+def test_register_bumps_epoch_and_reconnect_does_not():
+    srv = _start_server(1)
+    kv0 = _connect(0)
+    e0 = srv.epoch
+    assert e0 >= 1  # the join bumped
+    kv0._close_socks()
+    kv0.reconnect()  # PR 1 transient recovery: same member, NO bump
+    assert srv.epoch == e0
+    _connect(1)      # a new member bumps
+    assert srv.epoch == e0 + 1
+    srv.close()
+
+
+def test_max_workers_rejects_overflow_typed():
+    srv = _start_server(1, max_workers=1)
+    _connect(0)
+    with pytest.raises(MXNetError, match="membership is full"):
+        _connect(1)
+    srv.close()
+
+
+def test_min_workers_floor_fails_reshard_typed():
+    srv = _start_server(2, min_workers=2, quiesce_deadline=1.0)
+    kv0, kv1 = _connect(0), _connect(1)
+    errs = _in_threads([lambda: kv0.reshard_sync(),
+                        lambda: kv1.reshard_sync()])
+    assert not errs
+    kv1.deregister()  # world drops below the floor
+    with pytest.raises(MXNetError,
+                       match="could not assemble a world of >= 2"):
+        kv0.reshard_sync()
+    srv.close()
+
+
+def test_heartbeat_death_evicts_and_unblocks_survivors():
+    """A member dying silently mid-round: survivors' blocked pulls get a
+    typed StaleEpoch after the eviction (never a hang), and the next
+    rendezvous releases with the survivors only."""
+    srv = _start_server(2, heartbeat_deadline=0.5)
+    kv0, kv1 = _connect(0), _connect(1)
+    errs = _in_threads([lambda: kv0.reshard_sync(),
+                        lambda: kv1.reshard_sync()])
+    assert not errs
+    kv0.init(3, mx.nd.zeros((2,)))
+    kv1.init(3, mx.nd.zeros((2,)))
+    kv1._close_socks()  # rank 1 dies without deregistering
+    kv0.push(3, mx.nd.ones((2,)))  # accepted: round of 2 stays open
+    with pytest.raises(StaleEpoch):
+        out = mx.nd.zeros((2,))
+        kv0.pull(3, out=out)  # blocks, then eviction bumps the epoch
+    rep = kv0.reshard_sync()
+    assert rep["ranks"] == [0]
+    srv.close()
+
+
+def test_server_close_wakes_parked_barrier_waiter():
+    """Satellite: KVStoreServer.close() while a worker is parked in a
+    barrier wait wakes it with the typed shutdown promptly — NOT after
+    the heartbeat deadline."""
+    srv = _start_server(2, heartbeat_deadline=60.0)
+    kv0, kv1 = _connect(0), _connect(1)
+    errs = _in_threads([lambda: kv0.reshard_sync(),
+                        lambda: kv1.reshard_sync()])
+    assert not errs
+    woke = []
+
+    def park():
+        t0 = time.monotonic()
+        try:
+            kv0.barrier()  # world is 2: parks until kv1 (which never comes)
+        except ConnectionLost:
+            woke.append(time.monotonic() - t0)
+
+    t = threading.Thread(target=park, daemon=True)
+    t.start()
+    time.sleep(0.4)
+    srv.close()
+    t.join(timeout=10)
+    assert not t.is_alive(), "close() left the barrier waiter parked"
+    assert woke and woke[0] < 5.0, woke
+
+
+def test_reshard_choice_rendezvous_and_voided_on_bump():
+    """The leader's adopted-generation announcement releases parked
+    followers with the exact choice; a membership bump voids the stored
+    choice and turns the old world's rendezvous traffic typed-stale."""
+    srv = _start_server(2)
+    kv0, kv1 = _connect(0), _connect(1)
+    assert not _in_threads([lambda: kv0.reshard_sync(),
+                            lambda: kv1.reshard_sync()])
+    got = []
+
+    def leader():
+        time.sleep(0.2)  # follower parks first
+        kv0.set_reshard_choice({"epoch": 1, "nbatch": 5})
+
+    def follower():
+        got.append(kv1.get_reshard_choice()["choice"])
+
+    assert not _in_threads([leader, follower])
+    assert got == [{"epoch": 1, "nbatch": 5}]
+    with srv.lock:
+        assert srv.reshard_choice["choice"] == {"epoch": 1, "nbatch": 5}
+    kv1.deregister()  # bump: the old world's choice is void
+    with srv.lock:
+        assert srv.reshard_choice is None
+    with pytest.raises(StaleEpoch):
+        kv0.get_reshard_choice()
+    srv.close()
+
+
+def test_reload_resets_round_bookkeeping():
+    srv = _start_server(1)
+    kv0 = _connect(0)
+    assert not _in_threads([lambda: kv0.reshard_sync()])
+    kv0.init(1, mx.nd.zeros((3,)))
+    kv0.push(1, mx.nd.ones((3,)))
+    kv0.reload(1, np.full((3,), 7.0, np.float32))
+    out = mx.nd.zeros((3,))
+    kv0.pull(1, out=out)
+    np.testing.assert_allclose(out.asnumpy(), 7.0)
+    srv.close()
+
+
+def test_evicted_live_worker_rereregisters_in_sync():
+    """An evicted-but-live worker (slow past the quiesce deadline while
+    its socket stayed up) must re-register inside the reshard cycle and
+    rejoin — not spin forever on not-a-member StaleEpoch replies."""
+    import logging
+
+    srv = _start_server(2, quiesce_deadline=2.0)
+    kv0, kv1 = _connect(0), _connect(1)
+    assert not _in_threads([lambda: kv0.reshard_sync(),
+                            lambda: kv1.reshard_sync()])
+    with srv.lock:
+        srv._evict(0, "test-evict")  # coordinator-side eviction, live socket
+    assert 0 not in srv.members
+
+    class Mod:
+        pass
+
+    out = {}
+
+    def drive(rank, kv):
+        run = elastic.ElasticFitRun(Mod(), kv, None, None, logging)
+        out[rank] = run.sync((0, None, None))
+
+    errs = _in_threads([lambda: drive(0, kv0), lambda: drive(1, kv1)],
+                       timeout=60)
+    assert not errs, errs
+    assert out[0] == (0, None, None)  # the evicted rank's sync RETURNED
+    assert 0 in srv.members  # ...because it re-registered
+    srv.close()
+
+
+def test_reshard_without_snapshot_rolls_back_to_segment_start():
+    """A membership change before any snapshot generation exists: the
+    SEGMENT START is the only rollback target every rank shares, so the
+    aborted in-flight batch AND this rank's local commits both return
+    to the remaining pool (with their ``applied`` counts retracted) —
+    per-rank committed views leaking into the base would give ranks
+    divergent shard ownership."""
+    X, Y = _toy_data(16)
+    it = ElasticShardIter(X, Y, batch_size=4, rank=0, ranks=(0,))
+    b1 = it.next()
+    it.commit(b1.index, b1.pad)
+    b2 = it.next()  # its update lands StaleEpoch: never committed
+    it.reshard(0, (0, 1), membership_epoch=3, state=None)
+    pool = sorted(i for p in it._parts.values() for i in p)
+    assert pool == list(range(16))  # uniform: everything back in play
+    for i in np.asarray(b1.index).ravel():
+        assert int(i) in pool  # committed-without-generation: retrained
+    for i in np.asarray(b2.index).ravel():
+        assert int(i) in pool  # aborted: back in the pool
+    assert not it.applied.get(it.data_epoch)  # retraction hit the ledger
+
+
+def test_non_elastic_resume_skips_server_state_marker():
+    """An elastic leader snapshot's .states carry coordinator-side
+    updater blobs; a NON-elastic resume must recognize the marker and
+    skip the local install instead of corrupting the updater tree."""
+    import pickle as _pickle
+
+    mod = _toy_module()
+
+    calls = []
+
+    class U:
+        def set_states(self, b):
+            calls.append(b)
+
+    mod._updater = U()
+    mod._dist_placed_states = set()
+    marker = _pickle.dumps({elastic.SERVER_STATES_KEY: [b"blob"]})
+    mod._restore_opt_snapshot(marker, None)
+    assert not calls  # marker recognized: no local install
+    plain = _pickle.dumps({0: np.zeros((2,))})
+    mod._restore_opt_snapshot(plain, None)
+    assert calls == [plain]  # a real updater tree still installs
+
+
+def test_reshard_rescale_grad_follows_derivation():
+    """A framework-derived rescale_grad is recomputed for the new world
+    size on reshard; a user-supplied one is honored (never clobbered) —
+    the same contract init_optimizer applies at launch."""
+    import logging
+
+    from mxnet_tpu.optimizer import SGD
+
+    class KV:
+        rank = 0
+
+        def set_optimizer(self, o):
+            pass
+
+    class Snap:
+        states_bytes = None
+
+    class Mod:
+        pass
+
+    for auto, expect in ((True, 1.0 / (4 * 3)), (False, 0.5)):
+        mod = Mod()
+        mod._optimizer = SGD(learning_rate=0.1, rescale_grad=0.5)
+        mod._data_shapes = [("data", (4, 6))]
+        mod._auto_rescale_grad = auto
+        run = elastic.ElasticFitRun(mod, KV(), None, None, logging)
+        run._reinstall_optimizer(Snap(), world=3)
+        assert mod._optimizer.rescale_grad == expect, (auto, expect)
+
+
+def test_reinstall_optimizer_rescales_oversubscribed_initial_cohort():
+    """The initial rendezvous (state=None) still re-commands the server
+    optimizer when the adopted world differs from the one
+    ``init_optimizer`` derived the gradient scale for (an
+    over-subscribed initial cohort) — and carries the server's updater
+    states across, since ``set_optimizer`` builds a fresh updater."""
+    import logging
+
+    from mxnet_tpu.optimizer import SGD
+
+    class KV:
+        rank = 0
+
+        def __init__(self):
+            self.calls = []
+
+        def get_updater_states(self):
+            self.calls.append("get")
+            return [b"blob"]
+
+        def set_optimizer(self, o):
+            self.calls.append("set_opt")
+
+        def set_updater_states(self, blobs):
+            self.calls.append(("set_states", blobs))
+
+    class Mod:
+        pass
+
+    mod = Mod()
+    mod._optimizer = SGD(learning_rate=0.1,
+                         rescale_grad=1.0 / (4 * 2))  # derived for 2
+    mod._data_shapes = [("data", (4, 6))]
+    mod._auto_rescale_grad = True
+    kv = KV()
+    run = elastic.ElasticFitRun(mod, kv, None, None, logging)
+    run._reinstall_optimizer(None, world=3)  # 3 workers actually joined
+    assert mod._optimizer.rescale_grad == 1.0 / (4 * 3)
+    assert kv.calls == ["get", "set_opt", ("set_states", [b"blob"])]
+    kv.calls.clear()
+    run._reinstall_optimizer(None, world=3)  # scale already right:
+    assert kv.calls == []                    # no redundant RPCs
+
+
+def test_find_elastic_iter_rejects_composite_wrapper():
+    """A prefetch wrapper combining SEVERAL sub-iterators is never
+    adopted as the elastic data service: the reshard protocol rewinds a
+    wrapper onto exactly one inner state, so a composite wrapper must
+    fall into the warned un-resharded mode instead of crashing the
+    reshard cycle mid-membership-change."""
+    X, Y = _toy_data(8)
+    single = PrefetchingIter(
+        ElasticShardIter(X, Y, batch_size=4, rank=0, ranks=(0,)))
+    try:
+        assert isinstance(elastic._find_elastic_iter(single),
+                          ElasticShardIter)
+    finally:
+        single.close()
+    composite = PrefetchingIter(
+        [ElasticShardIter(X, Y, batch_size=4, rank=0, ranks=(0,)),
+         ElasticShardIter(X, Y, batch_size=4, rank=0, ranks=(0,))])
+    try:
+        assert elastic._find_elastic_iter(composite) is None
+    finally:
+        composite.close()
+
+
+def test_graceful_leaver_socket_close_does_not_poison_waiters():
+    """After a graceful deregister the leaver's socket close re-records
+    it in ``dead_since`` — the dead-peer check must clean the departed
+    NON-member up instead of raising _DeadPeer at parked survivors."""
+    srv = _start_server(2, heartbeat_deadline=0.2)
+    kv0, kv1 = _connect(0), _connect(1)
+    assert not _in_threads([lambda: kv0.reshard_sync(),
+                            lambda: kv1.reshard_sync()])
+    kv1.deregister()
+    kv1._close_socks()
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline:  # wait for on_disconnect
+        with srv.lock:
+            if 1 in srv.dead_since:
+                break
+        time.sleep(0.05)
+    else:
+        pytest.fail("leaver's disconnect never recorded")
+    time.sleep(0.3)  # ride past the heartbeat deadline
+    with srv.lock:
+        srv._check_dead_peers(time.monotonic())  # must NOT raise
+        assert 1 not in srv.dead_since  # cleaned up, not poisoning waits
+    srv.close()
+
+
+def test_elastic_close_deregisters_gracefully():
+    """``close()`` on an elastic worker announces the leave: the
+    membership shrinks with an immediate epoch bump instead of parking
+    survivors until heartbeat-death eviction."""
+    srv = _start_server(2, heartbeat_deadline=60.0)
+    kv0, kv1 = _connect(0), _connect(1)
+    assert not _in_threads([lambda: kv0.reshard_sync(),
+                            lambda: kv1.reshard_sync()])
+    with srv.lock:
+        before = srv.epoch
+    kv1.close()  # deliberate departure, not a crash
+    with srv.lock:
+        assert srv.epoch == before + 1  # bumped NOW, no 60s stall
+        assert 1 not in srv.members
+    kv0.close()  # the last member leaving must not raise either
+    srv.close()
+
+
+def test_elastic_multi_server_rejected_typed():
+    """Membership epochs live on the coordinator; shard servers' epochs
+    would diverge — elastic + DMLC_NUM_SERVER>1 is a typed init error,
+    not a livelock discovered mid-job."""
+    srv = _start_server(1)
+    os.environ["DMLC_NUM_SERVER"] = "2"
+    try:
+        with pytest.raises(MXNetError, match="single kvstore server"):
+            _connect(0)
+    finally:
+        os.environ.pop("DMLC_NUM_SERVER", None)
+    srv.close()
+
+
+def test_poll_is_passive_on_epoch_stamped_replies():
+    """The coordinator stamps elastic success replies with its epoch:
+    the batch-boundary poll reads that passive observation — no
+    membership() RPC per batch — and still notices a bump carried home
+    by any later reply."""
+    import logging
+
+    srv = _start_server(1)
+    kv0 = _connect(0)
+    assert not _in_threads([lambda: kv0.reshard_sync()])
+    kv0.init(5, mx.nd.zeros((2,)))
+    kv0.push(5, mx.nd.ones((2,)))
+    assert kv0.observed_epoch == srv.epoch  # stamped on the push reply
+    run = elastic.ElasticFitRun(object(), kv0, None, None, logging)
+    rpc_calls = []
+    orig = kv0.membership
+    kv0.membership = lambda: rpc_calls.append(1) or orig()
+    run.poll(0, 0)  # steady state: no raise...
+    assert not rpc_calls  # ...and no RPC spent
+    with srv.lock:
+        srv._bump_epoch("test")
+    kv0.heartbeat()  # epoch-free RPC: observes the new epoch passively
+    with pytest.raises(elastic.MembershipChanged):
+        run.poll(0, 1)
+    assert not rpc_calls
+    srv.close()
+
+
+# -- retry jitter (satellite) ------------------------------------------------
+
+def test_retry_jitter_seeded_replay(monkeypatch):
+    from mxnet_tpu.retry import RetryPolicy
+
+    monkeypatch.setenv("MXNET_CHAOS_SEED", "13")
+    p = RetryPolicy(base_delay=0.1, max_delay=2.0, jitter=0.5)
+    a = [next(iter_) for iter_, _ in [(p.delays(), None)] for _ in range(6)]
+    g1, g2 = p.delays(), p.delays()
+    s1 = [next(g1) for _ in range(6)]
+    s2 = [next(g2) for _ in range(6)]
+    assert s1 == s2 == a  # chaos replays draw identical backoff schedules
+    monkeypatch.delenv("MXNET_CHAOS_SEED")
+    import random as _random
+
+    state = _random.getstate()
+    u1 = [next(p.delays()) for _ in range(4)]
+    _random.setstate(state)
+    u2 = [next(p.delays()) for _ in range(4)]
+    assert u1 == u2  # unseeded jitter still rides the global module
+    _random.setstate(state)
+
+
+# -- fault points ------------------------------------------------------------
+
+def test_membership_fault_point_severs_worker():
+    srv = _start_server(1)
+    kv0 = _connect(0)
+    assert not _in_threads([lambda: kv0.reshard_sync()])
+
+    class Mod:  # minimal module stand-in for the driver
+        pass
+
+    import logging
+
+    run = elastic.ElasticFitRun(Mod(), kv0, None, None, logging)
+    faults.arm("kvstore.membership", at=2)
+    run.poll(0, 0)  # first poll: clean
+    with pytest.raises(ConnectionLost, match="kvstore.membership"):
+        run.poll(0, 1)
+    srv.close()
+
+
+# -- fit(elastic=True) -------------------------------------------------------
+
+def _toy_module():
+    data = mx.sym.Variable("data")
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(data, num_hidden=3, name="fc"),
+        name="softmax")
+    return mx.mod.Module(net, context=mx.cpu())
+
+
+def _toy_data(n, seed=7):
+    rs = np.random.RandomState(seed)
+    return (rs.rand(n, 6).astype(np.float32),
+            rs.randint(0, 3, n).astype(np.float32))
+
+
+def _toy_init(seed=5):
+    """Deterministic initial params.  The in-process harness runs every
+    worker as a THREAD, so initializer draws would race on the process-
+    global RNG (real deployments are one process per worker, each with
+    its own stream) — explicit arg_params keep replays bit-identical."""
+    rs = np.random.RandomState(seed)
+    return {"fc_weight": mx.nd.array(
+                rs.normal(0, 0.5, (3, 6)).astype(np.float32)),
+            "fc_bias": mx.nd.zeros((3,))}
+
+
+def _fit_worker(rank, kv, X, Y, prefix, ranks_guess, num_epoch,
+                results, iters, batch_size=4, callback=None,
+                wrap_prefetch=False, errors=None):
+    try:
+        mx.random.seed(11)
+        np.random.seed(11)
+        mod = _toy_module()
+        it = ElasticShardIter(X, Y, batch_size=batch_size, rank=rank,
+                              ranks=ranks_guess, audit=True)
+        iters[rank] = it
+        fit_it = PrefetchingIter(it) if wrap_prefetch else it
+        mod.fit(fit_it, num_epoch=num_epoch, optimizer="sgd",
+                optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+                arg_params=_toy_init(),
+                kvstore=kv, elastic=True, checkpoint_prefix=prefix,
+                batch_end_callback=callback)
+        arg, _aux = mod.get_params()
+        results[rank] = {k: v.asnumpy() for k, v in arg.items()}
+    except ConnectionLost:
+        pass  # a deliberately-killed worker
+    except Exception as e:  # noqa: BLE001
+        import traceback
+
+        traceback.print_exc()
+        if errors is not None:
+            errors.append((rank, e))
+
+
+def test_fit_elastic_requires_prefix_and_dist_kvstore():
+    X, Y = _toy_data(8)
+    mod = _toy_module()
+    it = ElasticShardIter(X, Y, batch_size=4, rank=0, ranks=(0,))
+    with pytest.raises(MXNetError, match="checkpoint_prefix"):
+        mod.fit(it, num_epoch=1, elastic=True)
+    with pytest.raises(MXNetError, match="dist"):
+        mod.fit(it, num_epoch=1, elastic=True,
+                checkpoint_prefix="/tmp/_elastic_nope")
+
+
+def test_fit_elastic_steady_state_two_workers(tmp_path):
+    """No membership change: elastic fit trains normally and all ranks
+    end bit-identical (one initial rendezvous, zero reshards)."""
+    srv = _start_server(2)
+    kvs = {w: _connect(w) for w in range(2)}
+    X, Y = _toy_data(24)
+    results, iters, errors = {}, {}, []
+    errs = _in_threads(
+        [lambda r=r: _fit_worker(r, kvs[r], X, Y,
+                                 str(tmp_path / "ck"), (0, 1), 2,
+                                 results, iters, errors=errors)
+         for r in range(2)], timeout=240)
+    assert not errs and not errors
+    for k in results[0]:
+        np.testing.assert_array_equal(results[0][k], results[1][k])
+    for it in iters.values():
+        for e in range(2):
+            assert [h for h in it.history
+                    if h["data_epoch"] == e][-1]["covered"] == 24
+    srv.close()
+
+
+def _run_elastic_schedule(tmp_path, tag, num_epoch=3, n_records=40,
+                          kill_batch=0, seed=7):
+    """The acceptance schedule: 4 workers; rank 3 dies after committing
+    (epoch 0, kill_batch); while the survivors are paused at (epoch 1,
+    batch 0) two replacements register; training finishes on 5 workers.
+    Returns (rank0 final params, iters, survivors' results)."""
+    srv = _start_server(4)
+    kvs = {w: _connect(w) for w in range(4)}
+    X, Y = _toy_data(n_records, seed=seed)
+    prefix = str(tmp_path / ("ck_%s" % tag))
+    park = threading.Barrier(4, timeout=180)     # 3 survivors + main
+    release = threading.Barrier(4, timeout=180)
+    results, iters, errors = {}, {}, []
+
+    def cb(p):
+        rank = cb_rank.get(threading.get_ident())
+        if rank == 3 and p.epoch == 0 and p.nbatch == kill_batch:
+            kvs[3]._sever("test kill: worker 3 at epoch 0 batch %d"
+                          % kill_batch)
+        if rank in (0, 1, 2) and p.epoch == 1 and p.nbatch == 0:
+            park.wait()
+            release.wait()
+
+    cb_rank = {}
+
+    def spawn(rank, guess):
+        def body():
+            cb_rank[threading.get_ident()] = rank
+            _fit_worker(rank, kvs[rank], X, Y, prefix, guess, num_epoch,
+                        results, iters, callback=cb, errors=errors)
+
+        t = threading.Thread(target=body, daemon=True)
+        t.start()
+        return t
+
+    ts = {r: spawn(r, (0, 1, 2, 3)) for r in range(4)}
+    park.wait()  # survivors quiesced at the admission point
+    for w in (4, 5):
+        kvs[w] = _connect(w)  # two joins, two epoch bumps
+        ts[w] = spawn(w, (4, 5))
+    release.wait()
+    for t in ts.values():
+        t.join(timeout=300)
+    hung = [r for r, t in ts.items() if t.is_alive()]
+    assert not hung, "HUNG workers: %s" % hung
+    assert not errors, errors
+    srv.close()
+    return results, iters
+
+
+def _assert_exactly_once(iters, n_records, num_epoch, batch_size=4):
+    """Every record of every data epoch lands exactly once in the
+    surviving trajectory.  A worker that died abruptly cannot account
+    its final in-flight batch (the ledger still covers it — that is why
+    it is not retrained), so up to one batch per kill may be
+    unaccounted in the per-rank counters of the interrupted epoch."""
+    for e in range(num_epoch):
+        tot = {}
+        for it in iters.values():
+            for i, c in it.applied.get(e, {}).items():
+                tot[i] = tot.get(i, 0) + c
+        doubled = [i for i, c in tot.items() if c > 1]
+        assert not doubled, ("record trained twice", e, doubled)
+        missing = [i for i in range(n_records) if tot.get(i, 0) == 0]
+        if e == 0:
+            assert len(missing) <= batch_size, (e, missing)
+        else:
+            assert not missing, (e, missing)
+    live = [r for r in iters if r != 3]
+    for r in live:
+        hist = iters[r].history
+        for e in range(num_epoch):
+            # vacuous segments (a joiner's pre-adoption view: nothing
+            # served, nothing covered) carry no coverage information
+            segs = [h for h in hist if h["data_epoch"] == e
+                    and (h["pos"] or h["covered"])]
+            if not segs:  # a joiner never saw epoch 0
+                continue
+            assert segs[-1]["covered"] == n_records, (r, e, segs)
+            covs = [h["covered"] for h in segs]
+            assert covs == sorted(covs), ("ledger not monotonic", r, covs)
+
+
+def test_fit_elastic_acceptance_kill_and_admit(tmp_path):
+    """THE acceptance test: a 4-worker job loses rank 3 mid-epoch,
+    later admits two new workers, training continues without process
+    restart, every surviving rank ends bit-identical, and the sample
+    ledger covers the interrupted epoch exactly once."""
+    kill_batch = CHAOS_SEED % 2  # the chaos matrix rotates the kill point
+    results, iters = _run_elastic_schedule(
+        tmp_path, "accept", kill_batch=kill_batch, seed=7 + CHAOS_SEED)
+    live = [0, 1, 2, 4, 5]
+    assert sorted(results) == live
+    for r in live[1:]:
+        for k in results[live[0]]:
+            np.testing.assert_array_equal(results[live[0]][k],
+                                          results[r][k])
+    _assert_exactly_once(iters, 40, 3)
+    # the elasticity really happened: at least the loss-reshard and the
+    # admission-reshard beyond the initial rendezvous
+    reshards = [h for h in iters[0].history if h["why"] == "reshard"]
+    assert len(reshards) >= 3
+
+
+@pytest.mark.slow
+def test_fit_elastic_replays_bit_identical(tmp_path):
+    """Two replays of the same elasticity schedule under the same
+    MXNET_CHAOS_SEED produce bit-identical final parameters."""
+    kill_batch = CHAOS_SEED % 2
+    r1, _ = _run_elastic_schedule(tmp_path, "rep1",
+                                  kill_batch=kill_batch,
+                                  seed=7 + CHAOS_SEED)
+    r2, _ = _run_elastic_schedule(tmp_path, "rep2",
+                                  kill_batch=kill_batch,
+                                  seed=7 + CHAOS_SEED)
+    for k in r1[0]:
+        np.testing.assert_array_equal(r1[0][k], r2[0][k])
+
+
+def test_fit_elastic_kill_during_reshard(tmp_path):
+    """Chaos: the ``elastic.reshard`` fault kills a worker INSIDE the
+    reshard cycle.  The quiesce deadline evicts it, the surviving
+    worker's cycle restarts on the new epoch, and training completes —
+    resume-or-typed-error, never a hang."""
+    srv = _start_server(2, quiesce_deadline=3.0)
+    kvs = {w: _connect(w) for w in range(2)}
+    X, Y = _toy_data(24)
+    results, iters, errors = {}, {}, []
+    # the fault counter is process-global: the 3rd cycle entry across
+    # both workers (each runs one initial sync) dies mid-reshard.  The
+    # reshard that 3rd entry belongs to is triggered by rank 1 leaving.
+    faults.arm("elastic.reshard", at=3)
+    leave = {"done": False}
+
+    def cb(p):
+        if p.epoch == 1 and p.nbatch == 0 and not leave["done"] \
+                and threading.get_ident() == leaver_tid[0]:
+            leave["done"] = True
+            kvs[1]._sever("test: rank 1 leaves at epoch 1")
+
+    leaver_tid = [None]
+
+    def body(rank):
+        if rank == 1:
+            leaver_tid[0] = threading.get_ident()
+        _fit_worker(rank, kvs[rank], X, Y, str(tmp_path / "ckr"),
+                    (0, 1), 3, results, iters, callback=cb,
+                    errors=errors)
+
+    errs = _in_threads([lambda r=r: body(r) for r in range(2)],
+                       timeout=240)
+    faults.disarm()
+    assert not errs
+    # every outcome is resume-or-typed-error: either rank 0 finished
+    # training (the fault killed rank 1's cycle) or rank 0 itself was
+    # the one killed (ConnectionLost swallowed as a deliberate kill) —
+    # in no case does anything hang
+    assert not errors, errors
+    srv.close()
+
+
+def test_fit_elastic_graceful_leave_with_prefetch(tmp_path):
+    """A worker deregisters (graceful leave) mid-job under a prefetch
+    wrapper: the survivor drains-then-reshards the wrapper through the
+    pre-produce state protocol and finishes the epoch alone."""
+    srv = _start_server(2)
+    kvs = {w: _connect(w) for w in range(2)}
+    X, Y = _toy_data(24)
+    results, iters, errors = {}, {}, []
+    leaver_tid = [None]
+
+    def cb(p):
+        if p.epoch == 1 and p.nbatch == 0 \
+                and threading.get_ident() == leaver_tid[0]:
+            kvs[1].deregister()
+            kvs[1]._sever("test: rank 1 leaves gracefully")
+
+    def body(rank):
+        if rank == 1:
+            leaver_tid[0] = threading.get_ident()
+        _fit_worker(rank, kvs[rank], X, Y, str(tmp_path / "ckg"),
+                    (0, 1), 3, results, iters, callback=cb,
+                    wrap_prefetch=True, errors=errors)
+
+    errs = _in_threads([lambda r=r: body(r) for r in range(2)],
+                       timeout=240)
+    assert not errs and not errors
+    assert 0 in results  # the survivor finished all epochs
+    hist = iters[0].history
+    assert [h for h in hist if h["data_epoch"] == 2][-1]["covered"] == 24
+    srv.close()
+
+
+def test_applied_ledger_pruned_by_default_kept_under_audit():
+    """The per-epoch applied ledger is pruned past the rollback horizon
+    (current + previous data epoch) by default — O(records), not
+    O(records x epochs), over a long job; ``audit=True`` keeps the
+    whole-job trail the chaos acceptance assertions need."""
+    X, Y = _toy_data(8)
+    # after the final reset the current data epoch is 4 (no commits yet),
+    # so the default horizon — current + previous, matching _committed —
+    # keeps exactly epoch 3's entries
+    for audit, expect in ((False, {3}), (True, {0, 1, 2, 3})):
+        it = ElasticShardIter(X, Y, batch_size=4, rank=0, ranks=(0,),
+                              audit=audit)
+        for _epoch in range(4):
+            _drain_ids(it)
+            it.reset()
+        assert set(it.applied) == expect, (audit, sorted(it.applied))
+
+
+def test_fit_elastic_preempted_worker_deregisters(tmp_path):
+    """``TrainingPreempted`` escaping ``fit(elastic=True)`` announces the
+    leave (``kv.deregister``) on the way out, so survivors reshard at
+    their next batch boundary instead of stalling a full heartbeat
+    deadline in a sync round the departed rank can never complete."""
+    from mxnet_tpu.checkpoint import TrainingPreempted
+
+    # deadline deliberately far beyond the test budget: only the
+    # graceful deregister can shrink the membership in time
+    srv = _start_server(2, heartbeat_deadline=60.0)
+    kvs = {w: _connect(w) for w in range(2)}
+    X, Y = _toy_data(24)
+    results, iters, errors = {}, {}, []
+    preempt_tid = [None]
+
+    def cb(p):
+        if p.epoch == 0 and p.nbatch == 1 \
+                and threading.get_ident() == preempt_tid[0]:
+            raise TrainingPreempted("test: pod eviction", epoch=p.epoch,
+                                    nbatch=p.nbatch, signum=15)
+
+    def body(rank):
+        if rank == 1:
+            preempt_tid[0] = threading.get_ident()
+        _fit_worker(rank, kvs[rank], X, Y, str(tmp_path / "ckp"),
+                    (0, 1), 2, results, iters, callback=cb,
+                    errors=errors)
+
+    t0 = time.time()
+    errs = _in_threads([lambda r=r: body(r) for r in range(2)],
+                       timeout=240)
+    elapsed = time.time() - t0
+    assert not errs
+    assert [r for r, _e in errors] == [1]
+    assert isinstance(errors[0][1], TrainingPreempted)
+    assert 0 in results  # the survivor finished the job alone
+    assert elapsed < 30  # no 60s heartbeat-deadline stall
+    srv.close()
+
+
+def test_fit_elastic_crashed_worker_deregisters(tmp_path):
+    """ANY exception escaping ``fit(elastic=True)`` — not just
+    ``TrainingPreempted`` — announces the leave: a rank crashed by a
+    user-callback bug (or a NaN raise) frees survivors at their next
+    batch boundary instead of stalling them a full heartbeat deadline."""
+
+    class UserCallbackBug(RuntimeError):
+        pass
+
+    srv = _start_server(2, heartbeat_deadline=60.0)
+    kvs = {w: _connect(w) for w in range(2)}
+    X, Y = _toy_data(24)
+    results, iters, errors = {}, {}, []
+    crash_tid = [None]
+
+    def cb(p):
+        if p.epoch == 0 and p.nbatch == 1 \
+                and threading.get_ident() == crash_tid[0]:
+            raise UserCallbackBug("test: callback crash")
+
+    def body(rank):
+        if rank == 1:
+            crash_tid[0] = threading.get_ident()
+        _fit_worker(rank, kvs[rank], X, Y, str(tmp_path / "ckc"),
+                    (0, 1), 2, results, iters, callback=cb,
+                    errors=errors)
+
+    t0 = time.time()
+    errs = _in_threads([lambda r=r: body(r) for r in range(2)],
+                       timeout=240)
+    elapsed = time.time() - t0
+    assert not errs
+    assert [r for r, _e in errors] == [1]
+    assert isinstance(errors[0][1], UserCallbackBug)
+    assert 0 in results  # the survivor finished the job alone
+    assert elapsed < 30  # no 60s heartbeat-deadline stall
+    srv.close()
+
+
+def test_borrow_optimizer_carries_rescale_derivation(tmp_path):
+    """``borrow_optimizer`` carries ``_auto_rescale_grad``: fit's
+    ``init_optimizer`` early-returns on a borrowed optimizer, so without
+    the carry an elastic reshard would treat the lender's
+    framework-derived rescale_grad as user-supplied and keep the old
+    world's gradient scale."""
+    X, Y = _toy_data(8)
+    for params, expect in (({"learning_rate": 0.1}, True),
+                           ({"learning_rate": 0.1, "rescale_grad": 0.5},
+                            False)):
+        lender = _toy_module()
+        lender.bind([("data", (4, 6))], [("softmax_label", (4,))])
+        lender.init_params(arg_params=_toy_init(), allow_missing=False)
+        lender.init_optimizer(kvstore=None, optimizer="sgd",
+                              optimizer_params=params)
+        assert lender._auto_rescale_grad is expect
+        borrower = _toy_module()
+        borrower.bind([("data", (4, 6))], [("softmax_label", (4,))],
+                      shared_module=lender)
+        borrower.init_params(arg_params=_toy_init(), allow_missing=False)
+        borrower.borrow_optimizer(lender)
+        assert borrower._auto_rescale_grad is expect
+
+
+def test_sync_rejoin_cap_exits_typed_not_livelock():
+    """A rank evicted as wedged on EVERY cycle must exit with a typed
+    error after the rejoin cap — not thrash the job through
+    evict -> re-register -> epoch-bump forever."""
+    import logging
+
+    class ThrashKV:
+        rank = 1
+
+        def __init__(self):
+            self.reconnects = 0
+
+        def reshard_sync(self):
+            raise StaleEpoch("test: evicted again")
+
+        def membership(self):
+            return {"ranks": [0]}  # never a member
+
+        def reconnect(self):
+            self.reconnects += 1
+
+    class Mod:
+        pass
+
+    kv = ThrashKV()
+    run = elastic.ElasticFitRun(Mod(), kv, None, None, logging)
+    with pytest.raises(MXNetError, match="evicted from the membership"):
+        run.sync((0, None, None))
+    assert kv.reconnects == elastic._MAX_REJOINS_PER_SYNC
+
+
+def test_fit_elastic_ignores_explicit_async_writer(tmp_path, caplog):
+    """An explicit ``MXNET_CKPT_ASYNC=1`` is ignored (with a warning)
+    under ``fit(elastic=True)``: the async writer drops cadence
+    snapshots when busy, which would make the reshard rollback
+    generation timing-dependent — same treatment as
+    ``MXNET_CKPT_EVERY_N_BATCHES``."""
+    import logging
+
+    saved = os.environ.get("MXNET_CKPT_ASYNC")
+    os.environ["MXNET_CKPT_ASYNC"] = "1"
+    try:
+        srv = _start_server(1)
+        kv = _connect(0)
+        X, Y = _toy_data(8)
+        results, iters = {}, {}
+        with caplog.at_level(logging.WARNING):
+            _fit_worker(0, kv, X, Y, str(tmp_path / "cka"), (0,), 1,
+                        results, iters)
+        assert 0 in results
+        assert any("MXNET_CKPT_ASYNC=1 ignored" in r.message
+                   for r in caplog.records)
+        srv.close()
+    finally:
+        if saved is None:
+            os.environ.pop("MXNET_CKPT_ASYNC", None)
+        else:
+            os.environ["MXNET_CKPT_ASYNC"] = saved
+
+
+def test_freeze_states_pickles_view_captured_under_lock():
+    """``get_updater_states`` serializes OUTSIDE the coordinator lock;
+    the shallow clone taken under it must keep the captured view even
+    when a concurrent update rebinds the original wrappers' arrays."""
+    import pickle
+
+    states = {0: mx.nd.array(np.ones(3, np.float32)),
+              1: (None, mx.nd.array(np.full(2, 2.0, np.float32))),
+              2: None}
+    frozen = kvstore_server._freeze_states(states)
+    # a racing update rebinds the ORIGINAL wrappers
+    states[0]._jx = mx.nd.array(np.zeros(3, np.float32))._jx
+    states[1][1]._jx = mx.nd.array(np.zeros(2, np.float32))._jx
+    thawed = pickle.loads(pickle.dumps(frozen))
+    np.testing.assert_array_equal(thawed[0].asnumpy(), np.ones(3))
+    assert thawed[1][0] is None
+    np.testing.assert_array_equal(thawed[1][1].asnumpy(),
+                                  np.full(2, 2.0))
+    assert thawed[2] is None
+
+
+# -- lint pinning (satellite) ------------------------------------------------
+
+def test_mutation_stripping_epoch_lock_is_caught(tmp_path):
+    """Strip the lock from the coordinator's deregister/evict path: the
+    membership-epoch writes race every handler thread -> the graftlint
+    lock-discipline pass must fire (and the pristine file stays clean
+    with zero baseline entries)."""
+    sys.path.insert(0, str(ROOT))
+    from ci.graftlint import RunContext, by_id, run_pass
+
+    src = (ROOT / "mxnet_tpu" / "kvstore_server.py").read_text()
+    pristine = tmp_path / "server_ok.py"
+    pristine.write_text(src)
+    res0 = run_pass(by_id("lock-discipline")(),
+                    RunContext(roots=[pristine]))
+    assert not res0.active, [f.message for f in res0.active]
+    anchor = ("        if cmd == \"deregister\":\n"
+              "            # graceful leave: the worker announces it is "
+              "going away, so\n"
+              "            # the membership shrinks NOW instead of after "
+              "a heartbeat\n"
+              "            # deadline of blocked sync rounds\n"
+              "            with self.lock:\n")
+    assert anchor in src, "mutation anchor vanished from kvstore_server.py"
+    mutated = tmp_path / "server_mut.py"
+    mutated.write_text(src.replace(
+        anchor, anchor.replace("with self.lock:", "if True:"), 1))
+    res1 = run_pass(by_id("lock-discipline")(),
+                    RunContext(roots=[mutated]))
+    assert any(f.code == "unlocked-write" for f in res1.active), \
+        [f.message for f in res1.findings]
