@@ -945,13 +945,109 @@ def decode_score(loads=(4, 16, 48), slots=8, max_new=24,
     pool.close()
 
 
+def failover_score(load=24, max_new=24, slots=8, waves=3,
+                   vocab=256, embed=64, heads=4, layers=2, ffn=128,
+                   max_len=96):
+    """Decode-tier goodput under ROLLING REPLICA KILLS (docs/serving.md
+    "Session failover & fault domains"): each wave runs ``load``
+    concurrent mixed-length generations through a 2-replica pool and
+    hard-kills one replica mid-decode via ``serving.replica.kill`` —
+    every session must finish through migration (zero failed
+    generations is the acceptance bar, and this row enforces it by
+    raising on any error).  Records the goodput the pool sustains while
+    losing a replica per wave, TTFT/inter-token p99 (the migration
+    stall lands in the inter-token tail), mean recovery seconds per
+    migration, and re-prefilled tokens per failover — the prices of a
+    failover, persisted so the gate catches a recovery-path
+    regression."""
+    import threading
+
+    from mxnet_tpu import faults, telemetry
+    from mxnet_tpu.models import transformer_lm as tlm
+    from mxnet_tpu.serving.pool import lm_pool
+
+    cfg = tlm.LMConfig(vocab, embed, heads, layers, ffn, max_len,
+                       eos_id=vocab)  # unreachable EOS: exact lengths
+    params = tlm.init_params(cfg, seed=0)
+    rs = np.random.RandomState(0)
+    telemetry.enable()
+    ttfts, gaps = [], []
+    tokens_done = 0
+    migrations = 0
+    wall = 0.0
+    for wave in range(waves):
+        pool = lm_pool(cfg, params, n_replicas=2,
+                       name="bench-failover",
+                       engine_opts={"slots": slots,
+                                    "prefill_buckets": (8, 32),
+                                    "max_queue": 512})
+        # workload pre-drawn (RandomState is not thread-safe, and the
+        # gate compares runs); the kill step rotates per wave so it
+        # lands at different slot states
+        prompts = [[int(t) for t in
+                    rs.randint(0, vocab, size=1 + c % 8)]
+                   for c in range(load)]
+        seeds = [int(s) for s in rs.randint(0, 2 ** 31, size=load)]
+        lock = threading.Lock()
+        errors = []
+
+        def client(cid, pool=pool, prompts=prompts, seeds=seeds,
+                   lock=lock, errors=errors):
+            stamps = []
+            try:
+                sess = pool.generate(
+                    prompts[cid], max_new_tokens=1 + cid % max_new,
+                    temperature=0.7 * (cid % 2), seed=seeds[cid],
+                    on_token=lambda t: stamps.append(
+                        time.perf_counter()))
+                sess.result(300)
+            except Exception as e:
+                errors.append(e)
+                return
+            with lock:
+                ttfts.append(sess.ttft())
+                gaps.extend(b - a for a, b in zip(stamps, stamps[1:]))
+        faults.arm("serving.replica.kill", at=3 + 2 * wave)
+        threads = [threading.Thread(target=client, args=(c,))
+                   for c in range(load)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall += time.perf_counter() - t0
+        faults.disarm()
+        if errors:
+            raise errors[0]  # zero failed generations is the bar
+        tokens_done += sum(r.engine.tokens_out for r in pool.replicas)
+        migrations += pool.describe()["failovers"]
+        pool.close(drain=False)
+    snap = telemetry.snapshot()
+    rec = snap["histograms"].get("serving.failover.recovery_seconds",
+                                 {}).get("model=bench-failover")
+    repref = snap["counters"].get(
+        "serving.failover.reprefill_tokens.count", {})
+    reprefilled = sum(v for k, v in repref.items()
+                      if "model=bench-failover" in k)
+    row("failover_s%d_load%d" % (slots, load), tokens_done / wall,
+        "tok/sec",
+        waves=waves, kills=waves, migrations=migrations,
+        ttft_p99_ms=round(float(np.percentile(ttfts, 99)) * 1e3, 3),
+        intertoken_p99_ms=round(
+            float(np.percentile(gaps, 99)) * 1e3, 3) if gaps else None,
+        recovery_mean_ms=None if not rec or not rec["count"]
+        else round(rec["sum"] / rec["count"] * 1e3, 3),
+        reprefilled_tokens_per_failover=None if not migrations
+        else round(reprefilled / migrations, 2))
+
+
 def main():
     if len(sys.argv) > 1 and sys.argv[1] == "_compile_probe":
         _compile_probe(sys.argv[2])
         return
     which = set((sys.argv[1].split(",") if len(sys.argv) > 1 else
                  ["infer", "train", "fit", "lstm", "ssd", "io",
-                  "serving", "decode", "ckpt", "compile"]))
+                  "serving", "decode", "failover", "ckpt", "compile"]))
     if "io" in which:
         io_score()
     if "infer" in which:
@@ -983,6 +1079,8 @@ def main():
         serving_score()
     if "decode" in which:
         decode_score()
+    if "failover" in which:
+        failover_score()
     if "ckpt" in which:
         ckpt_score()
     if "compile" in which:

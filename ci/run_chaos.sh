@@ -33,6 +33,21 @@ while [ "$i" -lt "$runs" ]; do
     -k "sigterm_drain or drain_deadline"
   i=$((i + 1))
 done
+# rolling-replica-kill half (docs/serving.md "Session failover & fault
+# domains"): hard-kill a pool replica mid-decode via the
+# serving.replica.kill fault while mixed-length greedy+temperature
+# sessions are in flight — every generation must COMPLETE (migrated,
+# bit-identical to an unkilled replay) or shed typed; zero silent
+# drops.  The seed rotates prompt/output lengths, temperatures, session
+# seeds, and the kill step so the kill lands at different slot states.
+i=0
+while [ "$i" -lt "$runs" ]; do
+  echo "== rolling replica-kill chaos run $((i + 1))/$runs (MXNET_CHAOS_SEED=$i) =="
+  JAX_PLATFORMS=cpu MXNET_CHAOS_SEED="$i" \
+    python -m pytest tests/test_failover.py -q -p no:cacheprovider \
+    -k "rolling_kill or acceptance"
+  i=$((i + 1))
+done
 # elasticity half (docs/resilience.md "Elastic membership &
 # resharding"): kill one worker mid-epoch, admit replacements, and kill
 # a worker DURING the reshard itself via the kvstore.membership /

@@ -145,6 +145,13 @@ def _env_int(name, default):
         return default
 
 
+def _env_float(name, default):
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return float(default)
+
+
 def enable(directory=None, max_bytes=None):
     """Activate the two-tier compile cache.
 

@@ -55,6 +55,14 @@ point                  effect when it fires
                          DURING the reshard itself; the quiesce deadline
                          evicts it and the surviving members restart the
                          cycle on the new membership epoch
+``serving.replica.kill`` the Nth decode step HARD-KILLS its engine
+                         mid-generation (the engine closes permanently —
+                         a crashed replica process, not a transient step
+                         fault); the pool opens the replica's circuit
+                         instantly and MIGRATES every held session onto
+                         a healthy replica, resuming each stream
+                         bit-identically (docs/serving.md "Session
+                         failover & fault domains")
 =====================  =====================================================
 
 Arming — programmatic::
@@ -92,7 +100,8 @@ __all__ = ["POINTS", "FaultInjected", "arm", "disarm", "armed",
 POINTS = ("kvstore.push.socket", "checkpoint.write", "fit.batch",
           "recordio.read", "serving.dispatch", "serving.model.write",
           "fit.preempt", "compile_cache.read", "serving.decode",
-          "kvstore.membership", "elastic.reshard")
+          "kvstore.membership", "elastic.reshard",
+          "serving.replica.kill")
 
 
 class FaultInjected(MXNetError):

@@ -12,7 +12,9 @@ Five layers (see ``docs/serving.md``):
   packed host read per token, mid-flight admission into free slots;
 * :mod:`~mxnet_tpu.serving.pool` — N routed replicas over
   ``jax.devices()``: weighted least-outstanding routing, per-tenant
-  quotas, priority shedding, quarantine + background re-warm;
+  quotas, priority shedding, per-replica circuit breakers
+  (closed/open/half-open + background re-warm), and session failover —
+  mid-generation migration with per-tenant retry budgets;
 * :mod:`~mxnet_tpu.serving.registry` — versioned multi-model registry
   with atomic publish (checksummed manifest-last), atomic reload,
   per-bucket warm-up compilation, and pointer-flip ``register`` swaps
@@ -24,15 +26,18 @@ Five layers (see ``docs/serving.md``):
 
 from .batcher import (BATCH_SIZE_BUCKETS, LATENCY_BUCKETS, DeadlineExceeded,
                       DynamicBatcher, Future, InvalidRequest, Overloaded)
-from .decode import TTFT_BUCKETS, DecodeEngine, GenerateSession
+from .decode import (TTFT_BUCKETS, DecodeEngine, GenerateSession,
+                     ReplicaKilled)
 from .frontend import ServingHandle, ServingHTTPServer
-from .pool import QuotaExceeded, Replica, ReplicaPool, lm_pool
+from .pool import (QuotaExceeded, Replica, ReplicaPool,
+                   RetryBudgetExhausted, lm_pool)
 from .registry import (MANIFEST, ModelRegistry, ServedModel, UnknownModel,
                        save_model)
 
 __all__ = ["DynamicBatcher", "Future", "Overloaded", "DeadlineExceeded",
            "InvalidRequest", "LATENCY_BUCKETS", "BATCH_SIZE_BUCKETS",
            "TTFT_BUCKETS", "DecodeEngine", "GenerateSession",
-           "QuotaExceeded", "Replica", "ReplicaPool", "lm_pool",
+           "ReplicaKilled", "QuotaExceeded", "RetryBudgetExhausted",
+           "Replica", "ReplicaPool", "lm_pool",
            "ModelRegistry", "ServedModel", "UnknownModel", "save_model",
            "MANIFEST", "ServingHandle", "ServingHTTPServer"]

@@ -16,10 +16,24 @@ syncs beyond a single packed host read per token.
   the first token and arm the slot — all in-graph;
 * **decode step** (one per ``(S, max_len)``): advance ALL slots one
   token — scatter the incoming token's K/V, attend over each slot's
-  ``<= length`` horizon, sample (greedy or temperature, keys split
-  in-graph from :mod:`mxnet_tpu.random` seed material), retire
+  ``<= length`` horizon, sample (greedy or temperature), retire
   EOS/length-done slots — returning the packed ``(token, done,
   active)`` buffer whose single host read is the loop's only sync.
+
+**Sampling keys are position-derived, not sequential.**  Each session
+carries one host-side ``seed``; the token that will occupy absolute
+position ``i`` of the sequence is drawn with
+``fold_in(PRNGKey(seed), i)`` — in the prefill (``i = prompt length``)
+and in every decode step (``i = length + 1``) alike.  That makes a
+session's sample stream a pure function of ``(seed, transcript)``:
+independent of which slot it sits in, of its co-resident sessions, and
+of how many times it has been interrupted.  The session transcript
+(prompt, tokens emitted so far, seed) is therefore a sufficient
+checkpoint: re-prefilling ``prompt + generated-so-far`` on ANY replica
+resumes the exact stream an uninterrupted run would have produced —
+greedy and temperature — which is what
+:class:`~mxnet_tpu.serving.pool.ReplicaPool` failover relies on
+(docs/serving.md "Session failover & fault domains").
 
 Sequences are admitted into free slots BETWEEN steps (continuous
 batching: a late request joins the running batch instead of waiting for
@@ -54,7 +68,8 @@ from ..models import transformer_lm as _tlm
 from .batcher import (LATENCY_BUCKETS, DeadlineExceeded, Future,
                       InvalidRequest, Overloaded)
 
-__all__ = ["GenerateSession", "DecodeEngine", "TTFT_BUCKETS"]
+__all__ = ["GenerateSession", "DecodeEngine", "ReplicaKilled",
+           "TTFT_BUCKETS"]
 
 _log = logging.getLogger("mxnet_tpu.serving")
 
@@ -70,28 +85,56 @@ TTFT_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
 from ..compile_cache import _env_int  # noqa: E402
 
 
+class ReplicaKilled(MXNetError):
+    """The ``serving.replica.kill`` fault hard-killed this engine
+    mid-generation: the engine is permanently closed (a crashed replica
+    process, not a transient step fault) and its sessions must migrate
+    — the pool treats this as an instant circuit-open."""
+
+
 class GenerateSession:
     """One streaming generation request: queued -> active(slot) ->
-    done/shed.  ``result()`` blocks for the full token list (prompt NOT
-    included; EOS, when hit, is the last token); ``on_token`` streams
-    each token from the engine thread (must be cheap and non-blocking —
-    HTTP streaming hands it a queue put)."""
+    done/shed (or migrated to another replica in between — the session
+    object survives the move).  ``result()`` blocks for the full token
+    list (prompt NOT included; EOS, when hit, is the last token);
+    ``on_token`` streams each token from the engine thread (must be
+    cheap and non-blocking — HTTP streaming hands it a queue put).
+
+    The session IS its own failover checkpoint: ``prompt``, ``tokens``
+    (everything generated AND delivered so far — the engine appends
+    before it emits, and a failed dispatch emits nothing, so the list
+    never runs ahead of or behind the client stream), ``seed`` (the
+    position-keyed sampling seed) and ``max_new_tokens`` are exactly
+    what a healthy replica needs to resume the stream bit-identically.
+    """
 
     __slots__ = ("prompt", "max_new_tokens", "temperature", "deadline",
-                 "on_token", "tokens", "future", "t_submit", "t_first",
-                 "t_done", "slot", "admit_step", "done_step", "_finished",
-                 "_on_done")
+                 "on_token", "on_event", "tokens", "future", "seed",
+                 "tenant", "migrations", "migrate_t0", "t_submit",
+                 "t_first", "t_done", "slot", "admit_step", "done_step",
+                 "_finished", "_lock", "_on_done")
 
     def __init__(self, prompt, max_new_tokens, temperature, deadline_ms,
-                 on_token, on_done=None):
+                 on_token, on_done=None, seed=0, tenant=None,
+                 on_event=None):
         self.prompt = prompt
         self.max_new_tokens = int(max_new_tokens)
         self.temperature = float(temperature)
         self.deadline = None if deadline_ms is None \
             else time.monotonic() + float(deadline_ms) / 1e3
         self.on_token = on_token
+        self.on_event = on_event
         self.tokens = []
         self.future = Future()
+        self.seed = int(seed) & 0xFFFFFFFF
+        self.tenant = tenant
+        #: failure-driven migration attempts so far (the pool's retry
+        #: budget counts these; version-swap migrations are free)
+        self.migrations = 0
+        #: failure timestamp of an in-flight migration — the target
+        #: engine stamps ``serving.failover.recovery_seconds`` from it
+        #: when the re-prefill lands (true failure-to-resumed latency)
+        self.migrate_t0 = None
         self.t_submit = time.monotonic()
         self.t_first = None
         self.t_done = None
@@ -99,7 +142,40 @@ class GenerateSession:
         self.admit_step = None
         self.done_step = None
         self._finished = False
+        # session-level lock: completion must stay exactly-once across
+        # MIGRATION — engine A's forced stop can race engine B retiring
+        # the same (migrated) session, so the flag cannot live under
+        # either engine's lock
+        self._lock = threading.Lock()
         self._on_done = on_done
+
+    def _resolve(self, error=None):
+        """Exactly-once completion: resolve the future and fire the
+        pool's on_done hook.  Returns False when the session already
+        finished (the caller must then not double-count telemetry)."""
+        with self._lock:
+            if self._finished:
+                return False
+            self._finished = True
+        self.t_done = time.monotonic()
+        if error is not None:
+            self.future.set_error(error)
+        else:
+            self.future.set_result(list(self.tokens))
+        if self._on_done is not None:
+            try:
+                self._on_done(self)
+            except Exception:  # noqa: broad-except — pool accounting
+                # hooks must never kill the resolving thread
+                _log.warning("decode: on_done hook failed", exc_info=True)
+        return True
+
+    def finished(self):
+        """True once the session resolved (result or typed error) — the
+        migration path's filter: a session that resolved while waiting
+        to migrate must not be re-admitted."""
+        with self._lock:
+            return self._finished
 
     def cancel(self):
         """Abandon the request: queued sessions are dropped at the next
@@ -179,6 +255,7 @@ class DecodeEngine:
         self._params = jax.device_put(params, self._device)
         self._on_step_error = on_step_error
         self._on_step_ok = on_step_ok
+        self._on_migrate = None
 
         self._cond = threading.Condition(threading.Lock())
         self._queue = deque()
@@ -191,6 +268,10 @@ class DecodeEngine:
         self.steps = 0
         #: total generated tokens
         self.tokens_out = 0
+        #: sessions re-admitted here by failover (describe/healthz card)
+        self.resumed = 0
+        #: prompt+generated tokens re-prefilled for those resumes
+        self.reprefilled_tokens = 0
         self._rate_t0 = time.monotonic()
         self._rate_tokens = 0
 
@@ -203,6 +284,8 @@ class DecodeEngine:
         _telemetry.inc("serving.decode.steps.count", 0, **labels)
         _telemetry.set_gauge("serving.decode.slot_occupancy", 0.0, **labels)
         _telemetry.set_gauge("serving.decode.tokens_per_sec", 0.0, **labels)
+        _telemetry.inc("serving.failover.reprefill_tokens.count", 0,
+                       **labels)
         for reason in ("deadline", "overload", "abandoned", "drain"):
             _telemetry.inc("serving.shed.count", 0, model=name,
                            reason=reason)
@@ -221,26 +304,35 @@ class DecodeEngine:
         s, m = self.slots, cfg.max_len
         eos = np.int32(cfg.eos_id)
 
-        def sample(key, logits, temps):
+        def fold_key(seed, pos):
+            # the ONE key derivation (failover invariant): the token
+            # that will occupy absolute position ``pos`` of its
+            # sequence is drawn with fold_in(PRNGKey(seed), pos) — a
+            # pure function of the session transcript, never of slot
+            # index, co-residents, or interruption history
+            return jax.random.fold_in(jax.random.PRNGKey(seed), pos)
+
+        def sample(keys, logits, temps):
             # greedy when temperature == 0, else temperature sampling;
-            # per-slot keys split in-graph — the loop never touches the
-            # host RNG
+            # per-row position-derived keys — the loop never touches
+            # the host RNG
             greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-            skeys = jax.random.split(key, logits.shape[0])
             drawn = jax.vmap(
                 lambda kk, lg, tt: jax.random.categorical(
                     kk, lg / jnp.maximum(tt, 1e-6)))(
-                        skeys, logits, temps).astype(jnp.int32)
+                        keys, logits, temps).astype(jnp.int32)
             return jnp.where(temps > 0.0, drawn, greedy)
 
         def step(params, state, keep):
             cache_k, cache_v, last_tok, lengths, limits, active, temps, \
-                key = state
+                seeds = state
             active = active & keep
             logits, cache_k, cache_v = _tlm.decode_step_math(
                 cfg, params, cache_k, cache_v, last_tok, lengths)
-            key, sub = jax.random.split(key)
-            tok = sample(sub, logits, temps)
+            # last_tok sits at position ``lengths``; the sampled token
+            # will occupy ``lengths + 1``
+            keys = jax.vmap(fold_key)(seeds, lengths + 1)
+            tok = sample(keys, logits, temps)
             new_len = lengths + active.astype(jnp.int32)
             done = active & ((tok == eos) | (new_len >= limits))
             new_active = active & ~done
@@ -249,12 +341,12 @@ class DecodeEngine:
                                 done.astype(jnp.int32),
                                 new_active.astype(jnp.int32)])
             return (cache_k, cache_v, new_last, new_len, limits,
-                    new_active, temps, key), packed
+                    new_active, temps, seeds), packed
 
         def prefill(params, state, tokens, length, slot, limit, temp,
-                    activate):
+                    seed, activate):
             cache_k, cache_v, last_tok, lengths, limits, active, temps, \
-                key = state
+                seeds = state
             last_logits, ks, vs = _tlm.prefill_kv(cfg, params, tokens,
                                                   length)
             cache_k = tuple(
@@ -263,8 +355,11 @@ class DecodeEngine:
             cache_v = tuple(
                 jax.lax.dynamic_update_slice(cv, v[None], (slot, 0, 0, 0))
                 for cv, v in zip(cache_v, vs))
-            key, sub = jax.random.split(key)
-            tok = sample(sub, last_logits[None],
+            # the prompt holds positions 0..length-1; the sampled token
+            # occupies ``length`` — on a failover re-prefill of
+            # prompt+generated this is exactly the key the interrupted
+            # replica's next decode step would have used
+            tok = sample(fold_key(seed, length)[None], last_logits[None],
                          jnp.full((1,), temp))[0]
             first_done = (tok == eos) | (limit <= length)
             arm = activate & ~first_done
@@ -273,9 +368,10 @@ class DecodeEngine:
             limits = limits.at[slot].set(limit)
             temps = temps.at[slot].set(temp)
             active = active.at[slot].set(arm)
+            seeds = seeds.at[slot].set(seed)
             out = jnp.stack([tok, first_done.astype(jnp.int32)])
             return (cache_k, cache_v, last_tok, lengths, limits, active,
-                    temps, key), out
+                    temps, seeds), out
 
         self._step_fn = self._instrument(
             jax.jit(step, donate_argnums=(1,)), "decode_step",
@@ -342,7 +438,7 @@ class DecodeEngine:
                  jnp.zeros((s,), jnp.int32),        # limits
                  jnp.zeros((s,), bool),             # active
                  jnp.zeros((s,), jnp.float32),      # temps
-                 jnp.asarray(np.array(_random.next_key()), jnp.uint32))
+                 jnp.zeros((s,), jnp.uint32))       # per-slot seeds
         return jax.device_put(state, self._device)
 
     def _warm(self, state):
@@ -353,18 +449,23 @@ class DecodeEngine:
             state, _out = self._prefill_fns[b](
                 self._params, state, np.zeros((b,), np.int32),
                 np.int32(1), np.int32(0), np.int32(0), np.float32(0.0),
-                np.bool_(False))
+                np.uint32(0), np.bool_(False))
         state, _packed = self._step_fn(self._params, state,
                                        np.ones((self.slots,), bool))
         return state
 
-    def set_health_hooks(self, on_error=None, on_ok=None):
-        """Install the pool's replica-health hooks.  Call before
+    def set_health_hooks(self, on_error=None, on_ok=None,
+                         on_migrate=None):
+        """Install the pool's replica-health hooks (and its failover
+        hand-off: ``on_migrate(sessions, exc)`` receives the sessions a
+        failed dispatch was holding INSTEAD of them being shed — the
+        pool re-admits them elsewhere or sheds typed).  Call before
         :meth:`start` — plain attribute flips, deliberately outside the
         engine lock (the hooks take the POOL's lock; holding both here
         would order the locks both ways)."""
         self._on_step_error = on_error
         self._on_step_ok = on_ok
+        self._on_migrate = on_migrate
 
     def rewarm(self):
         """Recompile/reload every program (the pool's quarantine
@@ -386,11 +487,18 @@ class DecodeEngine:
 
     # -- client side -------------------------------------------------------
     def submit(self, prompt, *, max_new_tokens=16, temperature=0.0,
-               deadline_ms=None, on_token=None, on_done=None):
+               deadline_ms=None, on_token=None, on_done=None, seed=None,
+               tenant=None, on_event=None):
         """Queue a generation request; returns its
         :class:`GenerateSession`.  Raises :class:`Overloaded` past the
         queue bound and :class:`InvalidRequest` for malformed prompts
-        (the client's error, surfaced at submit)."""
+        (the client's error, surfaced at submit).
+
+        ``seed`` pins the session's sampling stream (temperature
+        replays and cross-replica failover are bit-identical for the
+        same seed); None draws one from :mod:`mxnet_tpu.random`, so
+        ``mx.random.seed(n)`` still makes single-stream runs
+        reproducible end to end."""
         prompt = np.array(prompt, np.int32).ravel()
         if prompt.size < 1:
             raise InvalidRequest("empty prompt")
@@ -410,8 +518,11 @@ class DecodeEngine:
             raise InvalidRequest("max_new_tokens must be >= 1")
         if float(temperature) < 0:
             raise InvalidRequest("temperature must be >= 0")
+        if seed is None:
+            seed = int(np.asarray(_random.next_key())[0])  # lint: ok[host-sync] tiny submit-time key-material read (one uint32 per session), not the per-step hot loop
         sess = GenerateSession(prompt, max_new_tokens, temperature,
-                               deadline_ms, on_token, on_done)
+                               deadline_ms, on_token, on_done, seed=seed,
+                               tenant=tenant, on_event=on_event)
         with self._cond:
             if self._closed:
                 raise MXNetError("decode engine %r is closed" % self.name)
@@ -444,6 +555,45 @@ class DecodeEngine:
             sess.cancel()
             raise
 
+    def resume(self, sess):
+        """Re-admit a migrated session (the pool's failover path): its
+        transcript — ``prompt + tokens generated so far`` — is
+        re-prefilled into a free slot and decoding continues with the
+        same position-derived keys, so the resumed stream is
+        bit-identical to what the interrupted replica would have
+        produced.  Raises :class:`InvalidRequest` when the combined
+        transcript no longer fits a prefill bucket (the caller sheds
+        typed) and the engine's closed/draining errors otherwise.
+
+        Deliberately NOT bounded by ``max_queue``: the session already
+        holds pool admission (its accounting moved with it) — bouncing
+        a migration off the queue bound would turn a survivable replica
+        loss into a shed.  Resumed sessions jump the queue: they have
+        already waited once."""
+        full = int(sess.prompt.size) + len(sess.tokens)
+        if full > self.prefill_buckets[-1]:
+            raise InvalidRequest(
+                "transcript of %d tokens (prompt %d + generated %d) "
+                "exceeds the largest prefill bucket %d: this session "
+                "cannot migrate" % (full, sess.prompt.size,
+                                    len(sess.tokens),
+                                    self.prefill_buckets[-1]))
+        if full >= self.cfg.max_len:
+            raise InvalidRequest(
+                "transcript of %d tokens leaves no room under "
+                "max_len=%d" % (full, self.cfg.max_len))
+        with self._cond:
+            if self._closed:
+                raise MXNetError("decode engine %r is closed" % self.name)
+            if self._draining:
+                raise Overloaded("decode engine %r is draining"
+                                 % self.name)
+            # not counted under sessions.count — the session was
+            # counted at its original admission
+            self._queue.appendleft(sess)
+            self._cond.notify()
+        return sess
+
     # -- introspection -----------------------------------------------------
     def pending_rows(self):
         """Queued plus active sessions — the graceful-drain quiescence
@@ -462,11 +612,15 @@ class DecodeEngine:
             queued = len(self._queue)
             steps = self.steps
             tokens = self.tokens_out
+            resumed = self.resumed
+            reprefilled = self.reprefilled_tokens
         return {"name": self.name, "kind": "generate",
                 "version": getattr(self, "version", None),
                 "replica": self.replica, "device": str(self._device),
                 "slots": self.slots, "active": active, "queued": queued,
                 "steps": steps, "tokens": tokens,
+                "sessions_resumed": resumed,
+                "reprefilled_tokens": reprefilled,
                 "prefill_buckets": list(self.prefill_buckets),
                 "max_len": self.cfg.max_len}
 
@@ -495,14 +649,18 @@ class DecodeEngine:
             self._thread.start()
         return self
 
-    def stop(self, drain=True, deadline=None):
+    def stop(self, drain=True, deadline=None, hand_off=None):
         """Stop the engine.  ``drain=True`` keeps stepping until every
         ACTIVE sequence finishes (new admissions stop; queued sessions
         are shed immediately with a typed error) under ``deadline``
         seconds (``MXNET_PREEMPT_DRAIN_DEADLINE``, default 30); past
         the deadline — or with ``drain=False`` — unfinished sessions
-        are shed, never silently dropped.  Returns True when the drain
-        completed cleanly."""
+        are shed, never silently dropped.  ``hand_off`` (a callable
+        taking a session list) is the failover alternative to
+        shedding: queued and slot-holding sessions are handed over
+        intact for the pool to re-admit elsewhere (quarantine takeover,
+        version-swap straggler migration) and do not mark the stop
+        unclean.  Returns True when the stop lost nothing."""
         if deadline is None:
             deadline = float(os.environ.get(
                 "MXNET_PREEMPT_DRAIN_DEADLINE", "30") or 30)
@@ -516,13 +674,17 @@ class DecodeEngine:
             self._cond.notify_all()
         err = MXNetError("decode engine %r stopped before this session "
                          "was served" % self.name)
-        clean = not shed
-        for sess in shed:
-            _telemetry.inc("serving.shed.count", model=self.name,
-                           reason="drain")
-            self._finish(sess, error=err)
+        clean = not shed or hand_off is not None
+        if hand_off is not None and shed:
+            hand_off(shed)
+        else:
+            for sess in shed:
+                _telemetry.inc("serving.shed.count", model=self.name,
+                               reason="drain")
+                self._finish(sess, error=err)
         with self._cond:
             t, self._thread = self._thread, None
+        worker_dead = True
         if t is not None:
             t.join(timeout=deadline if drain else 5.0)
             if t.is_alive():
@@ -531,18 +693,27 @@ class DecodeEngine:
                     self._running = False
                     self._cond.notify_all()
                 t.join(timeout=10.0)
-        # anything still holding a slot is shed with the typed error
+            worker_dead = not t.is_alive()
+        # anything still holding a slot is handed off or shed typed —
+        # but hand-off REQUIRES the worker to be provably gone: a
+        # wedged dispatch that eventually returns would keep appending
+        # tokens to a session another replica now owns, corrupting the
+        # stream.  The shed path stays safe either way (idempotent
+        # session-level resolve).
         leftovers = []
         with self._cond:
             for i, sess in enumerate(self._slot_sessions):
                 if sess is not None:
                     leftovers.append(sess)
                     self._slot_sessions[i] = None
-        for sess in leftovers:
-            clean = False
-            _telemetry.inc("serving.shed.count", model=self.name,
-                           reason="drain")
-            self._finish(sess, error=err)
+        if hand_off is not None and leftovers and worker_dead:
+            hand_off(leftovers)
+        else:
+            for sess in leftovers:
+                clean = False
+                _telemetry.inc("serving.shed.count", model=self.name,
+                               reason="drain")
+                self._finish(sess, error=err)
         self._occupancy_gauge()
         return clean
 
@@ -625,39 +796,68 @@ class DecodeEngine:
         """Prefill ``sess`` into its (already reserved) slot: one
         bucket-shaped dispatch + one tiny admission-time host read for
         the first token (TTFT); the hot loop's own budget is untouched.
-        Returns ``(state, aborted)`` — aborted=True means the dispatch
-        poisoned the donated state and :meth:`_fail_all` already
-        resolved every held session."""
+        A migrated session re-prefills its whole transcript (prompt +
+        generated-so-far) — the ``limit`` stays derived from the
+        ORIGINAL prompt length, so total generation length is unchanged
+        by any number of migrations.  Returns ``(state, aborted)`` —
+        aborted=True means the dispatch poisoned the donated state and
+        :meth:`_fail_all` already resolved every held session."""
         cfg = self.cfg
-        p = int(sess.prompt.size)
-        bucket = next(b for b in self.prefill_buckets if p <= b)
+        p0 = int(sess.prompt.size)
+        resumed = len(sess.tokens) > 0
+        if resumed:
+            gen = np.asarray(sess.tokens, np.int32)  # lint: ok[host-sync] host-list -> ndarray conversion of the transcript, no device value involved
+            full = np.concatenate([sess.prompt, gen])
+        else:
+            full = sess.prompt
+        n = int(full.size)
+        bucket = next(b for b in self.prefill_buckets if n <= b)
         tokens = np.zeros((bucket,), np.int32)
-        tokens[:p] = sess.prompt
-        limit = np.int32(min(p + sess.max_new_tokens - 1, cfg.max_len))
+        tokens[:n] = full
+        limit = np.int32(min(p0 + sess.max_new_tokens - 1, cfg.max_len))
         try:
             state, out = self._prefill_fns[bucket](
-                self._params, state, tokens, np.int32(p),
+                self._params, state, tokens, np.int32(n),
                 np.int32(sess.slot), limit,
-                np.float32(sess.temperature), np.bool_(True))
+                np.float32(sess.temperature), np.uint32(sess.seed),
+                np.bool_(True))
             out = np.asarray(out)  # lint: ok[host-sync] admission-time first-token read (TTFT), not the per-step hot loop
         except Exception as e:
             # a poisoned prefill poisons the whole donated state: fail
             # every session this engine holds and restart from zeros
             # (the queue is untouched)
             return self._fail_all(e, state), True
-        sess.t_first = time.monotonic()
+        now = time.monotonic()
         tok = int(out[0])
         sess.tokens.append(tok)
         self._emit(sess, tok)
-        _telemetry.observe("serving.decode.ttft_seconds",
-                           sess.t_first - sess.t_submit,
-                           buckets=TTFT_BUCKETS, model=self.name)
+        if sess.t_first is None:
+            # TTFT is first token EVER — a migrated session already
+            # paid (and recorded) its first-token latency
+            sess.t_first = now
+            _telemetry.observe("serving.decode.ttft_seconds",
+                               sess.t_first - sess.t_submit,
+                               buckets=TTFT_BUCKETS, model=self.name)
         _telemetry.inc("serving.decode.tokens.count", model=self.name,
                        replica=self.replica)
         with self._cond:
             sess.admit_step = self.steps
             self.tokens_out += 1
             self._rate_tokens += 1
+            if resumed:
+                self.resumed += 1
+                self.reprefilled_tokens += n
+        if resumed:
+            _telemetry.inc("serving.failover.reprefill_tokens.count", n,
+                           model=self.name, replica=self.replica)
+            if sess.migrate_t0 is not None:
+                # failure-to-resumed: stamped when the session left its
+                # failed replica, observed when it is DECODING again —
+                # queue wait and re-prefill included
+                _telemetry.observe("serving.failover.recovery_seconds",
+                                   now - sess.migrate_t0,
+                                   model=self.name)
+                sess.migrate_t0 = None
         if out[1]:  # EOS or max_new_tokens == 1: done at prefill
             self._retire(sess)
         self._occupancy_gauge()
@@ -683,6 +883,18 @@ class DecodeEngine:
                 raise _faults.FaultInjected(
                     "fault 'serving.decode': decode step of model %r "
                     "killed" % self.name)
+            if _faults.should_fire("serving.replica.kill"):
+                # a hard replica death, not a transient step fault: the
+                # engine closes permanently (the worker exits, submits
+                # fail fast, rewarm refuses) and every held session
+                # goes down the migration path
+                with self._cond:
+                    self._closed = True
+                    self._running = False
+                raise ReplicaKilled(
+                    "fault 'serving.replica.kill': replica %s of model "
+                    "%r hard-killed mid-generation"
+                    % (self.replica, self.name))
             state, packed = self._step_fn(self._params, state, keep)
             packed = np.asarray(packed)  # lint: ok[host-sync] THE one sanctioned host read per decode step (packed token/done/active buffer)
         except Exception as e:
@@ -736,18 +948,37 @@ class DecodeEngine:
 
     def _fail_all(self, exc, _poisoned_state):
         """A failed device dispatch poisons the donated state: every
-        held session gets the error (the batcher's batch-error
+        held session is handed to the pool's migration hook (or, with
+        no pool above, gets the error — the batcher's batch-error
         contract), the state restarts from zeros (same shapes — no
-        recompile), and the worker survives to serve the queue."""
+        recompile), and the worker survives to serve the queue (unless
+        a :class:`ReplicaKilled` closed it)."""
         _telemetry.inc("serving.error.count", model=self.name)
         with self._cond:
             held = [x for x in self._slot_sessions if x is not None]
             self._slot_sessions = [None] * self.slots
-        for sess in held:
-            self._finish(sess, error=exc)
-        self._occupancy_gauge()
+        # health first: the pool quarantines/opens the circuit BEFORE
+        # the migration hook picks a target, so a failing replica does
+        # not re-admit its own casualties
         if self._on_step_error is not None:
             self._on_step_error(exc)
+        if held:
+            migrate = self._on_migrate
+            if migrate is not None:
+                try:
+                    migrate(held, exc)
+                except Exception:  # noqa: broad-except — a broken
+                    # migration hook must not silently drop sessions:
+                    # fall back to the typed batch error
+                    _log.warning("decode: migration hook failed; "
+                                 "shedding held sessions",
+                                 exc_info=True)
+                    for sess in held:
+                        self._finish(sess, error=exc)
+            else:
+                for sess in held:
+                    self._finish(sess, error=exc)
+        self._occupancy_gauge()
         return self._fresh_state()
 
     # -- session completion ------------------------------------------------
@@ -771,28 +1002,12 @@ class DecodeEngine:
         self._finish(sess, error=error)
 
     def _finish(self, sess, error=None):
-        with self._cond:
-            # idempotent: a forced stop() that timed out its joins can
-            # race the still-running worker retiring the same session —
-            # the pool's on_done hook must fire exactly once per session
-            # or its outstanding accounting drifts
-            if sess._finished:
-                return
-            sess._finished = True
-        sess.t_done = time.monotonic()
-        if error is not None:
-            sess.future.set_error(error)
-        else:
-            sess.future.set_result(list(sess.tokens))
-        if sess._on_done is not None:
-            self._safe_done(sess)
-
-    def _safe_done(self, sess):
-        try:
-            sess._on_done(sess)
-        except Exception:  # noqa: broad-except — pool accounting hooks
-            # must never kill the engine thread
-            _log.warning("decode: on_done hook failed", exc_info=True)
+        # idempotent ACROSS ENGINES: a forced stop() that timed out its
+        # joins can race the still-running worker — or, after a
+        # migration, a different engine entirely — retiring the same
+        # session; the session's own lock makes the pool's on_done hook
+        # fire exactly once either way
+        sess._resolve(error=error)
 
     def _occupancy_gauge(self):
         with self._cond:

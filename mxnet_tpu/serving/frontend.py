@@ -15,9 +15,14 @@ repo's no-new-deps rule — with five routes:
   ``{"model", "prompt": [token ids], "max_new_tokens", "temperature",
   "stream", "tenant", "priority", "deadline_ms"}``.  With ``"stream":
   true`` the response is ``Transfer-Encoding: chunked`` ndjson — one
-  ``{"token": id}`` line per generated token as it lands, then a
-  ``{"done": true, "tokens": [...], "ttft_ms": ...}`` summary line;
-  without it, one JSON document after the sequence finishes.
+  ``{"token": id}`` line per generated token as it lands, an
+  ``{"event": "failover", ...}`` line wherever the pool migrated the
+  session to another replica mid-generation (the token stream itself
+  is seamless: no token is repeated or lost across the boundary), then
+  a ``{"done": true, "tokens": [...], "ttft_ms": ..., "migrations":
+  n}`` summary line; without it, one JSON document after the sequence
+  finishes.  Optional ``"seed"`` pins the sampling stream (temperature
+  replays are bit-identical for the same seed).
 * ``GET /models`` — every loaded servable's card (name, version,
   buckets, replica states, warm-up status).
 * ``GET /healthz`` — liveness + model/version table + per-model detail.
@@ -338,6 +343,9 @@ class _Handler(BaseHTTPRequestHandler):
             stream = bool(req.get("stream", False))
             tenant = req.get("tenant")
             priority = int(req.get("priority", 5))
+            seed = req.get("seed")
+            if seed is not None:
+                seed = int(seed)
             deadline_ms = req.get("deadline_ms")
             if deadline_ms is not None:
                 deadline_ms = float(deadline_ms)
@@ -354,9 +362,14 @@ class _Handler(BaseHTTPRequestHandler):
             handle = srv.serving_handle
             kw = {"max_new_tokens": max_new, "temperature": temperature,
                   "deadline_ms": deadline_ms, "tenant": tenant,
-                  "priority": priority}
+                  "priority": priority, "seed": seed}
             if stream:
-                kw["on_token"] = tok_q.put
+                # ONE ordered queue carries both tokens and failover
+                # notifications: the {"event": "failover"} line lands
+                # exactly at the migration boundary of the token stream
+                kw["on_token"] = lambda t: tok_q.put(("token", t))
+                kw["on_event"] = \
+                    lambda kind, info: tok_q.put(("event", kind, info))
             try:
                 # resolve ONCE (version-swap safety, as /predict) and
                 # dispatch through the ONE routing point
@@ -396,12 +409,26 @@ class _Handler(BaseHTTPRequestHandler):
         line = (json.dumps(payload) + "\n").encode()
         self.wfile.write(b"%x\r\n%s\r\n" % (len(line), line))
 
+    def _write_stream_item(self, item):
+        """One queue entry -> one ndjson line: ``("token", id)`` or
+        ``("event", kind, info)`` — a migration boundary becomes an
+        explicit ``{"event": "failover", ...}`` line so a consumer can
+        tell a mid-stream replica move from ordinary latency."""
+        if item[0] == "event":
+            _, kind, info = item
+            self._write_chunk(dict({"event": kind}, **(info or {})))
+        else:
+            self._write_chunk({"token": int(item[1])})
+
     def _stream_session(self, model, version, sess, tok_q, timeout):
         """Chunked ndjson streaming: one ``{"token": id}`` line per
         generated token AS IT LANDS (the engine's ``on_token`` callback
-        feeds the queue from its loop thread), then one summary line.
-        A vanished client cancels the session so its slot frees at the
-        next step boundary instead of decoding to nobody."""
+        feeds the queue from its loop thread), interleaved with
+        ``{"event": "failover"}`` lines at migration boundaries, then
+        one summary line.  A vanished client cancels the session — the
+        SAME session object rides every migration, so the cancel
+        reaches whichever replica currently holds it (no orphaned slot
+        on the new replica)."""
         self.send_response(200)
         self.send_header("Content-Type", "application/x-ndjson")
         self.send_header("Transfer-Encoding", "chunked")
@@ -410,8 +437,7 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             while True:
                 try:
-                    tok = tok_q.get(timeout=0.05)
-                    self._write_chunk({"token": int(tok)})
+                    self._write_stream_item(tok_q.get(timeout=0.05))
                     continue
                 except _queue.Empty:
                     pass
@@ -419,8 +445,7 @@ class _Handler(BaseHTTPRequestHandler):
                     # drain stragglers enqueued between Empty and done()
                     while True:
                         try:
-                            self._write_chunk(
-                                {"token": int(tok_q.get_nowait())})
+                            self._write_stream_item(tok_q.get_nowait())
                         except _queue.Empty:
                             break
                     break
@@ -435,6 +460,8 @@ class _Handler(BaseHTTPRequestHandler):
                 self._write_chunk({"done": True, "tokens": tokens,
                                    "n_tokens": len(tokens),
                                    "model": model, "version": version,
+                                   "migrations": getattr(sess,
+                                                         "migrations", 0),
                                    "ttft_ms": None if ttft is None
                                    else round(ttft * 1e3, 3)})
             except Exception as e:

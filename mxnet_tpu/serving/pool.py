@@ -18,31 +18,53 @@ afterthought), :class:`ReplicaPool` owns:
   priority watermark only requests with ``priority >=
   priority_floor`` are admitted), and per-tenant quotas
   (:class:`QuotaExceeded`, shed reason ``quota``);
-* **replica health** — ``quarantine_after`` consecutive dispatch
-  failures (``MXNET_POOL_QUARANTINE_AFTER``) quarantines the replica
-  (telemetry event, routing skips it) and a background thread re-warms
-  it through the PR 7 warm-up path (persistent-cache loads, zero cold
-  compiles on a healthy host) before flipping it back to ACTIVE;
+* **replica fault domains** — a per-replica CIRCUIT BREAKER over the
+  step-outcome stream: ``quarantine_after`` consecutive failures OR an
+  error rate past ``MXNET_POOL_CIRCUIT_THRESHOLD`` over the rolling
+  outcome window opens the circuit (replica quarantined, routing skips
+  it, telemetry event), recovery re-warms it through the PR 7 warm-up
+  path and — after the ``MXNET_POOL_CIRCUIT_COOLDOWN_MS`` cooldown —
+  returns it HALF-OPEN: one in-flight probe at a time until a clean
+  step closes the circuit (a failed probe re-opens it instantly);
+* **session failover** — an in-flight generation on a failing replica
+  is NOT shed: its engine hands the held sessions back
+  (:meth:`~mxnet_tpu.serving.decode.DecodeEngine.set_health_hooks`
+  ``on_migrate``) and the pool re-admits them on a healthy replica by
+  re-prefilling ``prompt + generated-so-far`` — bit-identical
+  continuation, greedy and temperature, because sampling keys are
+  position-derived (see decode.py).  Failure-driven migration attempts
+  are bounded by per-tenant RETRY BUDGETS (``MXNET_POOL_RETRY_BUDGET``
+  / the ``retry_budgets`` map); past the budget the session sheds
+  typed with reason ``retry_budget``;
 * **version swaps** — a pool is a registry servable: build the new
   version off-registry, then
   :meth:`~mxnet_tpu.serving.registry.ModelRegistry.register` pointer-
-  flips it in and drains the old one — no request ever sees a
-  half-swapped pool.
+  flips it in; the OLD pool's in-flight stragglers MIGRATE onto the
+  new servable (``close(successor=new)`` / :meth:`adopt`) instead of
+  being errored out — bit-identical continuation when the successor
+  serves the SAME params (a config/infra swap; position-derived keys
+  guarantee identity only for identical weights — with new weights
+  the continuation draws from the new version's logits, which is the
+  point of the deploy).  Version swaps are free for the session: they
+  never touch the retry budget.
 """
 
 from __future__ import annotations
 
 import logging
 import threading
+import time
+from collections import deque
 
 from .. import telemetry as _telemetry
 from ..base import MXNetError
-from ..compile_cache import _env_int
-from .batcher import Overloaded
-from .decode import DecodeEngine
+from ..compile_cache import _env_float, _env_int
+from .batcher import DeadlineExceeded, Overloaded
+from .decode import DecodeEngine, ReplicaKilled
 
-__all__ = ["QuotaExceeded", "Replica", "ReplicaPool", "lm_pool",
-           "ACTIVE", "QUARANTINED", "WARMING"]
+__all__ = ["QuotaExceeded", "RetryBudgetExhausted", "Replica",
+           "ReplicaPool", "lm_pool", "ACTIVE", "QUARANTINED", "WARMING",
+           "CIRCUIT_CLOSED", "CIRCUIT_OPEN", "CIRCUIT_HALF_OPEN"]
 
 _log = logging.getLogger("mxnet_tpu.serving")
 
@@ -52,12 +74,23 @@ WARMING = "warming"
 
 _STATE_GAUGE = {ACTIVE: 0, QUARANTINED: 1, WARMING: 2}
 
+CIRCUIT_CLOSED = "closed"
+CIRCUIT_OPEN = "open"
+CIRCUIT_HALF_OPEN = "half_open"
+
+_CIRCUIT_GAUGE = {CIRCUIT_CLOSED: 0, CIRCUIT_OPEN: 1,
+                  CIRCUIT_HALF_OPEN: 2}
+
 
 class QuotaExceeded(Overloaded):
     """The tenant's outstanding-request quota is exhausted (HTTP 429);
     other tenants are unaffected — that is the point of quotas."""
 
 
+class RetryBudgetExhausted(MXNetError):
+    """The session's failure-driven migration attempts exceeded its
+    tenant's retry budget: shed typed with reason ``retry_budget``
+    instead of bouncing between dying replicas forever."""
 
 
 class Replica:
@@ -86,10 +119,10 @@ class ReplicaPool:
     ----------
     factory : callable(device, replica_id) -> engine
         Builds one replica; the engine must expose ``submit(prompt,
-        ..., on_done=)``, ``pending_rows``, ``describe``, ``stop``,
-        ``rewarm``, ``start``, ``close`` and accept health hooks via
-        ``set_health_hooks`` (what :class:`DecodeEngine` provides —
-        see :func:`lm_pool`).
+        ..., on_done=)``, ``resume``, ``pending_rows``, ``describe``,
+        ``stop``, ``rewarm``, ``start``, ``close`` and accept health
+        hooks via ``set_health_hooks`` (what :class:`DecodeEngine`
+        provides — see :func:`lm_pool`).
     n_replicas : int
         Pool size; devices are assigned round-robin from ``devices``
         (default ``jax.devices()``).
@@ -108,14 +141,33 @@ class ReplicaPool:
         (reason ``priority``) so high-priority traffic keeps flowing
         under pressure.
     quarantine_after : int
-        Consecutive step failures before a replica is quarantined
+        Consecutive step failures before a replica's circuit opens
         (``MXNET_POOL_QUARANTINE_AFTER``, default 3).
+    retry_budgets : dict, optional
+        ``tenant -> max failure-driven migration attempts per
+        session``; key ``"*"`` is the default for unlisted tenants
+        (``MXNET_POOL_RETRY_BUDGET``, default 3).  Version-swap
+        migrations are free.
+    circuit_window / circuit_threshold / circuit_min_events :
+        Error-rate breaker: over the last ``circuit_window`` step
+        outcomes (``MXNET_POOL_CIRCUIT_WINDOW``, 20), a failure
+        fraction >= ``circuit_threshold``
+        (``MXNET_POOL_CIRCUIT_THRESHOLD``, 0.5) with at least
+        ``circuit_min_events`` outcomes recorded
+        (``MXNET_POOL_CIRCUIT_MIN_EVENTS``, 4) opens the circuit even
+        without ``quarantine_after`` consecutive failures.
+    circuit_cooldown : float, seconds
+        Minimum open time before the half-open probe
+        (``MXNET_POOL_CIRCUIT_COOLDOWN_MS``, 250ms; re-warm time
+        counts toward it).
     """
 
     def __init__(self, factory, n_replicas=2, devices=None, *, name="lm",
                  version=1, weights=None, quotas=None, max_outstanding=None,
                  priority_floor=5, priority_watermark=0.75,
-                 quarantine_after=None):
+                 quarantine_after=None, retry_budgets=None,
+                 circuit_window=None, circuit_threshold=None,
+                 circuit_min_events=None, circuit_cooldown=None):
         import jax
 
         if n_replicas < 1:
@@ -136,10 +188,34 @@ class ReplicaPool:
         self._quarantine_after = int(quarantine_after) \
             if quarantine_after is not None \
             else _env_int("MXNET_POOL_QUARANTINE_AFTER", 3)
+        self._retry_budgets = dict(retry_budgets or {})
+        self._retry_budgets.setdefault(
+            "*", _env_int("MXNET_POOL_RETRY_BUDGET", 3))
+        self._circuit_window = int(circuit_window) \
+            if circuit_window is not None \
+            else _env_int("MXNET_POOL_CIRCUIT_WINDOW", 20)
+        self._circuit_threshold = float(circuit_threshold) \
+            if circuit_threshold is not None \
+            else _env_float("MXNET_POOL_CIRCUIT_THRESHOLD", 0.5)
+        self._circuit_min_events = int(circuit_min_events) \
+            if circuit_min_events is not None \
+            else _env_int("MXNET_POOL_CIRCUIT_MIN_EVENTS", 4)
+        self._circuit_cooldown = float(circuit_cooldown) \
+            if circuit_cooldown is not None \
+            else _env_float("MXNET_POOL_CIRCUIT_COOLDOWN_MS", 250) / 1e3
         self._outstanding = {}
         self._tenant_out = {}
         self._total_outstanding = 0
         self._closed = False
+        # circuit-breaker state, all keyed by rid and guarded by the
+        # pool lock (the lock-discipline pass pins this — see
+        # tests/test_graftlint.py strip-the-lock mutation)
+        self._circuit = {}
+        self._cwindow = {}       # rid -> deque of step outcomes (bool)
+        self._opened_at = {}
+        self._migrations_out = {}
+        self._migrations_in = {}
+        self._failovers = 0
         if any(float(w) <= 0 for w in weights):
             # validate BEFORE building engines: a bad weight must not
             # cost k warmed-and-leaked replicas
@@ -155,9 +231,15 @@ class ReplicaPool:
                 if hasattr(engine, "set_health_hooks"):
                     engine.set_health_hooks(
                         on_error=self._make_error_hook(i),
-                        on_ok=self._make_ok_hook(i))
+                        on_ok=self._make_ok_hook(i),
+                        on_migrate=self._make_migrate_hook(i))
                 self.replicas.append(Replica(i, dev, engine, weights[i]))
                 self._outstanding[i] = 0
+                self._circuit[i] = CIRCUIT_CLOSED
+                self._cwindow[i] = deque(maxlen=self._circuit_window)
+                self._opened_at[i] = 0.0
+                self._migrations_out[i] = 0
+                self._migrations_in[i] = 0
         except Exception:
             # a replica k>0 failing to build (device OOM, ...) must not
             # leak the already-running earlier replicas' worker threads
@@ -188,8 +270,14 @@ class ReplicaPool:
             _telemetry.set_gauge("serving.pool.replica_state",
                                  _STATE_GAUGE[ACTIVE], model=name,
                                  replica=str(r.rid))
+            _telemetry.set_gauge("serving.pool.circuit_state",
+                                 _CIRCUIT_GAUGE[CIRCUIT_CLOSED],
+                                 model=name, replica=str(r.rid))
+            _telemetry.inc("serving.failover.migrations.count", 0,
+                           model=name, replica=str(r.rid))
         _telemetry.inc("serving.pool.quarantines.count", 0, model=name)
-        for reason in ("quota", "priority"):
+        _telemetry.inc("serving.failover.count", 0, model=name)
+        for reason in ("quota", "priority", "retry_budget", "failover"):
             _telemetry.inc("serving.shed.count", 0, model=name,
                            reason=reason)
 
@@ -199,9 +287,33 @@ class ReplicaPool:
     def _make_ok_hook(self, rid):
         return lambda: self._note_step_ok(rid)
 
+    def _make_migrate_hook(self, rid):
+        return lambda sessions, exc: self._migrate_sessions(
+            rid, sessions, exc)
+
     # -- routing -----------------------------------------------------------
+    def _pick_locked(self):
+        """Weighted least-outstanding choice over routable replicas
+        (pool lock held).  A HALF-OPEN replica is routable but admits
+        ONE in-flight probe at a time — the breaker's probe, carried by
+        real traffic.  Returns None when nothing is routable."""
+        cands = []
+        for r in self.replicas:
+            if r.state != ACTIVE:
+                continue
+            circuit = self._circuit[r.rid]  # lint: ok[lock-discipline] call-with-pool-lock-held helper; every call site (generate/adopt/_migrate_sessions) holds self._lock, the thread path included
+            busy = self._outstanding[r.rid]  # lint: ok[lock-discipline] call-with-pool-lock-held helper (see above)
+            if circuit == CIRCUIT_HALF_OPEN and busy >= 1:
+                continue
+            cands.append(r)
+        if not cands:
+            return None
+        return min(cands,
+                   key=lambda x: self._outstanding[x.rid] / x.weight)  # lint: ok[lock-discipline] call-with-pool-lock-held helper (see above)
+
     def generate(self, prompt, *, max_new_tokens=16, temperature=0.0,
-                 deadline_ms=None, on_token=None, tenant=None, priority=5):
+                 deadline_ms=None, on_token=None, tenant=None, priority=5,
+                 seed=None, on_event=None):
         """Admit + route one generation request; returns the replica
         engine's :class:`~mxnet_tpu.serving.decode.GenerateSession`.
 
@@ -209,7 +321,10 @@ class ReplicaPool:
         ``serving.shed.count{model=,reason=}``): pool ``Overloaded``
         past ``max_outstanding``; ``priority`` past the watermark for
         requests under the floor; ``quota`` for tenants at their bound;
-        then the chosen replica's own engine admission applies."""
+        then the chosen replica's own engine admission applies.
+        ``on_event`` (optional ``callable(kind, info)``) receives a
+        ``"failover"`` notification at every migration boundary — the
+        HTTP frontend turns it into the stream's failover line."""
         tenant_key = tenant if tenant is not None else "*"
         with self._lock:
             if self._closed:
@@ -238,14 +353,12 @@ class ReplicaPool:
                 raise QuotaExceeded(
                     "tenant %r at its quota of %d outstanding requests"
                     % (tenant_key, int(quota)))
-            healthy = [r for r in self.replicas if r.state == ACTIVE]
-            if not healthy:
+            r = self._pick_locked()
+            if r is None:
                 _telemetry.inc("serving.shed.count", model=self.name,
                                reason="overload")
                 raise Overloaded("pool %r has no healthy replicas "
                                  "(all quarantined/warming)" % self.name)
-            r = min(healthy,
-                    key=lambda x: self._outstanding[x.rid] / x.weight)
             self._outstanding[r.rid] += 1
             self._tenant_out[tenant_key] = \
                 self._tenant_out.get(tenant_key, 0) + 1
@@ -260,7 +373,8 @@ class ReplicaPool:
             sess = r.engine.submit(
                 prompt, max_new_tokens=max_new_tokens,
                 temperature=temperature, deadline_ms=deadline_ms,
-                on_token=on_token,
+                on_token=on_token, seed=seed, tenant=tenant_key,
+                on_event=on_event,
                 on_done=self._make_done_hook(r.rid, tenant_key))
         except Exception:
             self._settle(r.rid, tenant_key)
@@ -280,55 +394,108 @@ class ReplicaPool:
         _telemetry.set_gauge("serving.pool.outstanding", out,
                              model=self.name, replica=str(rid))
 
-    # -- replica health ----------------------------------------------------
+    # -- replica health / circuit breaker ----------------------------------
+    def _failure_rate_locked(self, rid):
+        window = self._cwindow[rid]
+        if not window:
+            return 0.0
+        return sum(1 for ok in window if not ok) / float(len(window))
+
     def _note_step_error(self, rid, exc):
-        rewarm = False
+        killed = isinstance(exc, ReplicaKilled)
         r = self.replicas[rid]
         with self._lock:
             r.failures += 1
-            if r.state == ACTIVE and r.failures >= self._quarantine_after:
+            self._cwindow[rid].append(False)
+            rate = self._failure_rate_locked(rid)
+            opened = r.state == ACTIVE and (
+                killed
+                or self._circuit[rid] == CIRCUIT_HALF_OPEN
+                or r.failures >= self._quarantine_after
+                or (len(self._cwindow[rid]) >= self._circuit_min_events
+                    and rate >= self._circuit_threshold))
+            if opened:
                 r.state = QUARANTINED
-                rewarm = True
-        if rewarm:
-            _telemetry.inc("serving.pool.quarantines.count",
-                           model=self.name)
-            _telemetry.set_gauge("serving.pool.replica_state",
-                                 _STATE_GAUGE[QUARANTINED],
-                                 model=self.name, replica=str(rid))
-            _telemetry.event("serving.pool.quarantine", model=self.name,
-                             replica=str(rid), failures=r.failures,
-                             error=str(exc))
-            _log.warning("pool %r: replica %d quarantined after %d "
-                         "consecutive step failures (%s); re-warming in "
-                         "the background", self.name, rid, r.failures,
-                         exc)
-            threading.Thread(target=self._rewarm, args=(rid,),
-                             name="pool-rewarm-%s-%d" % (self.name, rid),
-                             daemon=True).start()
+                self._circuit[rid] = CIRCUIT_OPEN
+                self._opened_at[rid] = time.monotonic()
+            failures = r.failures
+        if not opened:
+            return
+        _telemetry.inc("serving.pool.quarantines.count", model=self.name)
+        _telemetry.set_gauge("serving.pool.replica_state",
+                             _STATE_GAUGE[QUARANTINED],
+                             model=self.name, replica=str(rid))
+        _telemetry.set_gauge("serving.pool.circuit_state",
+                             _CIRCUIT_GAUGE[CIRCUIT_OPEN],
+                             model=self.name, replica=str(rid))
+        _telemetry.event("serving.pool.quarantine", model=self.name,
+                         replica=str(rid), failures=failures,
+                         error=str(exc))
+        _telemetry.event("serving.pool.circuit_open", model=self.name,
+                         replica=str(rid),
+                         failure_rate=round(rate, 3), killed=killed)
+        _log.warning("pool %r: replica %d circuit OPEN after %d "
+                     "consecutive failures / %.0f%% window error rate "
+                     "(%s)%s", self.name, rid, failures, rate * 100, exc,
+                     "; replica hard-killed, staying down" if killed
+                     else "; recovering in the background")
+        threading.Thread(target=self._recover, args=(rid, killed, exc),
+                         name="pool-recover-%s-%d" % (self.name, rid),
+                         daemon=True).start()
 
     def _note_step_ok(self, rid):
         r = self.replicas[rid]
         with self._lock:
             r.failures = 0
+            self._cwindow[rid].append(True)
+            closed = self._circuit[rid] == CIRCUIT_HALF_OPEN \
+                and r.state == ACTIVE
+            if closed:
+                self._circuit[rid] = CIRCUIT_CLOSED
+        if closed:
+            _telemetry.set_gauge("serving.pool.circuit_state",
+                                 _CIRCUIT_GAUGE[CIRCUIT_CLOSED],
+                                 model=self.name, replica=str(rid))
+            _telemetry.event("serving.pool.circuit_close",
+                             model=self.name, replica=str(rid))
+            _log.info("pool %r: replica %d half-open probe succeeded; "
+                      "circuit CLOSED", self.name, rid)
 
-    def _rewarm(self, rid):
-        """Background quarantine recovery: shed what the replica holds,
-        rebuild its compiled state through the warm-up path (persistent-
-        cache loads when the PR 7 cache is armed), then return it to
-        routing."""
+    def _recover(self, rid, killed, exc):
+        """Background circuit recovery: take over everything the
+        opened replica still holds (queued AND slot sessions migrate,
+        they are not shed), then — unless the replica was hard-killed —
+        re-warm it, sit out the cooldown, and return it HALF-OPEN."""
         r = self.replicas[rid]
         with self._lock:
             if self._closed:
-                # the pool was swapped out while the re-warm was
-                # pending; the engine-level closed guard catches the
-                # narrower race after this check
+                # the pool was swapped out while recovery was pending;
+                # the engine-level closed guard catches the narrower
+                # race after this check
                 return
+        orphans = []
+        try:
+            r.engine.stop(drain=False, hand_off=orphans.extend)
+        except Exception:  # noqa: broad-except — a dead engine's stop
+            # must not kill the recovery thread before migration
+            _log.warning("pool %r: stop of replica %d failed during "
+                         "recovery", self.name, rid, exc_info=True)
+        if orphans:
+            self._migrate_sessions(rid, orphans, exc)
+        if killed:
+            _telemetry.event("serving.pool.replica_dead",
+                             model=self.name, replica=str(rid),
+                             error=str(exc))
+            _log.error("pool %r: replica %d is dead (hard kill); "
+                       "serving continues on the survivors", self.name,
+                       rid)
+            return
+        with self._lock:
             r.state = WARMING
         _telemetry.set_gauge("serving.pool.replica_state",
                              _STATE_GAUGE[WARMING], model=self.name,
                              replica=str(rid))
         try:
-            r.engine.stop(drain=False)
             r.engine.rewarm()
             r.engine.start()
         except Exception as e:  # noqa: broad-except — a failed re-warm
@@ -346,16 +513,173 @@ class ReplicaPool:
             _log.error("pool %r: re-warm of replica %d failed: %s",
                        self.name, rid, e)
             return
+        # re-warm time counts toward the cooldown; sit out any rest so
+        # a fast re-warm cannot flap the breaker
+        with self._lock:
+            opened_at = self._opened_at[rid]
+        remaining = opened_at + self._circuit_cooldown - time.monotonic()
+        if remaining > 0:
+            time.sleep(remaining)
         with self._lock:
             r.state = ACTIVE
             r.failures = 0
+            self._cwindow[rid].clear()
+            self._circuit[rid] = CIRCUIT_HALF_OPEN
         _telemetry.set_gauge("serving.pool.replica_state",
                              _STATE_GAUGE[ACTIVE], model=self.name,
                              replica=str(rid))
+        _telemetry.set_gauge("serving.pool.circuit_state",
+                             _CIRCUIT_GAUGE[CIRCUIT_HALF_OPEN],
+                             model=self.name, replica=str(rid))
         _telemetry.event("serving.pool.rewarmed", model=self.name,
                          replica=str(rid))
-        _log.info("pool %r: replica %d re-warmed and back in routing",
-                  self.name, rid)
+        _telemetry.event("serving.pool.circuit_half_open",
+                         model=self.name, replica=str(rid))
+        _log.info("pool %r: replica %d re-warmed; circuit HALF-OPEN "
+                  "(one probe at a time)", self.name, rid)
+
+    # -- session failover ---------------------------------------------------
+    def _retry_budget(self, tenant_key):
+        budget = self._retry_budgets.get(
+            tenant_key, self._retry_budgets.get("*", 3))
+        return int(budget)
+
+    def _shed_session(self, sess, reason, err):
+        _telemetry.inc("serving.shed.count", model=self.name,
+                       reason=reason)
+        sess._resolve(error=err)
+
+    def _fire_failover_event(self, sess, info):
+        cb = sess.on_event
+        if cb is None:
+            return
+        try:
+            cb("failover", info)
+        except Exception:  # noqa: broad-except — a client callback must
+            # never kill the migration path
+            _log.warning("pool %r: on_event callback failed", self.name,
+                         exc_info=True)
+
+    def _migrate_sessions(self, rid, sessions, exc):
+        """Failure-driven migration (the engines' ``on_migrate`` hook
+        and the recovery takeover): re-admit each session on a healthy
+        replica — its accounting moves with it — or shed typed when it
+        is cancelled/expired, over its retry budget, or nothing is
+        routable.  Every session is resolved-or-readmitted; none is
+        ever silently dropped."""
+        tenant_of = lambda s: s.tenant if s.tenant is not None else "*"  # noqa: E731
+        for sess in sessions:
+            if sess.finished():
+                continue
+            if sess.cancelled():
+                self._shed_session(sess, "abandoned", MXNetError(
+                    "session abandoned by the client during failover"))
+                continue
+            if sess.deadline is not None \
+                    and time.monotonic() > sess.deadline:
+                self._shed_session(sess, "deadline", DeadlineExceeded(
+                    "session deadline expired during failover"))
+                continue
+            tenant_key = tenant_of(sess)
+            sess.migrations += 1
+            budget = self._retry_budget(tenant_key)
+            if sess.migrations > budget:
+                self._shed_session(sess, "retry_budget",
+                                   RetryBudgetExhausted(
+                    "session exceeded tenant %r retry budget of %d "
+                    "migration attempts (reason=retry_budget); last "
+                    "replica error: %s" % (tenant_key, budget, exc)))
+                continue
+            t0 = time.monotonic()
+            with self._lock:
+                target = None if self._closed else self._pick_locked()
+                if target is not None:
+                    # the accounting moves with the session: the source
+                    # replica sheds one outstanding row, the target
+                    # gains it (tenant/total are unchanged)
+                    self._outstanding[rid] = \
+                        max(0, self._outstanding[rid] - 1)
+                    self._outstanding[target.rid] += 1
+                    target.routed += 1
+                    self._migrations_out[rid] += 1
+                    self._migrations_in[target.rid] += 1
+                    self._failovers += 1
+                    out_src = self._outstanding[rid]
+                    out_dst = self._outstanding[target.rid]
+            if target is None:
+                self._shed_session(sess, "failover", MXNetError(
+                    "no healthy replica to migrate this session to; "
+                    "replica error: %s" % (exc,)))
+                continue
+            _telemetry.set_gauge("serving.pool.outstanding", out_src,
+                                 model=self.name, replica=str(rid))
+            _telemetry.set_gauge("serving.pool.outstanding", out_dst,
+                                 model=self.name, replica=str(target.rid))
+            sess._on_done = self._make_done_hook(target.rid, tenant_key)
+            # the stream's failover line goes out BEFORE resume(): the
+            # target worker can emit the first resumed token the moment
+            # the session is enqueued, and the event must precede it
+            self._fire_failover_event(sess, {
+                "from_replica": str(rid), "to_replica": str(target.rid),
+                "attempt": sess.migrations})
+            sess.migrate_t0 = t0
+            try:
+                target.engine.resume(sess)
+            except Exception as e:  # noqa: broad-except — a refused
+                # resume (transcript outgrew the buckets, target closing
+                # under a racing swap) sheds typed, never drops
+                sess.migrate_t0 = None
+                self._shed_session(sess, "failover", MXNetError(
+                    "failover re-admission on replica %d failed: %s"
+                    % (target.rid, e)))
+                continue
+            _telemetry.inc("serving.failover.count", model=self.name)
+            _telemetry.inc("serving.failover.migrations.count",
+                           model=self.name, replica=str(rid))
+            _telemetry.event("serving.failover.migrate",
+                             model=self.name, src=str(rid),
+                             dst=str(target.rid),
+                             attempt=sess.migrations,
+                             tokens_generated=len(sess.tokens))
+
+    def adopt(self, sess):
+        """Admit an in-flight session migrated from OUTSIDE this pool —
+        a version swap's straggler (``old.close(successor=new)``):
+        fresh accounting, no admission bounds (it was already admitted
+        once), no retry-budget charge (a version swap is not a
+        failure).  Raises when nothing is routable; the caller sheds
+        typed."""
+        tenant_key = sess.tenant if sess.tenant is not None else "*"
+        with self._lock:
+            if self._closed:
+                raise MXNetError("replica pool %r is closed" % self.name)
+            target = self._pick_locked()
+            if target is None:
+                raise Overloaded("pool %r has no healthy replicas to "
+                                 "adopt the migrated session"
+                                 % self.name)
+            self._outstanding[target.rid] += 1
+            self._tenant_out[tenant_key] = \
+                self._tenant_out.get(tenant_key, 0) + 1
+            self._total_outstanding += 1
+            target.routed += 1
+            self._migrations_in[target.rid] += 1
+            self._failovers += 1
+        sess._on_done = self._make_done_hook(target.rid, tenant_key)
+        # event before resume(), as in _migrate_sessions: the stream's
+        # failover line must precede the first successor-side token
+        self._fire_failover_event(sess, {
+            "to_replica": str(target.rid), "version_swap": True})
+        try:
+            target.engine.resume(sess)
+        except Exception:
+            self._settle(target.rid, tenant_key)
+            raise
+        _telemetry.inc("serving.failover.count", model=self.name)
+        _telemetry.event("serving.failover.adopt", model=self.name,
+                         dst=str(target.rid),
+                         tokens_generated=len(sess.tokens))
+        return sess
 
     # -- registry servable surface ----------------------------------------
     def pending_rows(self):
@@ -370,32 +694,84 @@ class ReplicaPool:
     def describe(self):
         with self._lock:
             reps = [dict(r.engine.describe(), state=r.state,
+                         circuit=self._circuit[r.rid],
+                         failure_rate=round(
+                             self._failure_rate_locked(r.rid), 3),
                          failures=r.failures, routed=r.routed,
+                         migrations_out=self._migrations_out[r.rid],
+                         migrations_in=self._migrations_in[r.rid],
                          outstanding=self._outstanding[r.rid],
                          weight=r.weight)
                     for r in self.replicas]
             total = self._total_outstanding
             tenants = dict(self._tenant_out)
+            failovers = self._failovers
         return {"name": self.name, "version": self.version,
                 "kind": "generate", "replicas": reps,
                 "outstanding": total,
                 "max_outstanding": self._max_outstanding,
                 "priority_floor": self._priority_floor,
                 "quotas": dict(self._quotas),
+                "retry_budgets": dict(self._retry_budgets),
+                "failovers": failovers,
                 "tenants_outstanding": tenants}
 
-    def close(self, drain=True):
+    def close(self, drain=True, successor=None):
         """Drain (by default) and permanently stop every replica — what
         the registry calls on the OLD pool after a pointer-flip swap.
-        Returns True when every replica drained cleanly (False when any
-        session was shed — shed sessions carry a typed error, they are
-        never silently dropped)."""
+        With ``successor`` (the newly registered servable), in-flight
+        stragglers are NOT errored: each one migrates onto the
+        successor (``adopt``/``resume``) and finishes there —
+        bit-identical to an uninterrupted run when the successor
+        serves the same params (see the class docstring for the
+        new-weights case).  Returns True when no session was lost
+        (migrated sessions are not losses; shed sessions carry a typed
+        error, they are never silently dropped)."""
         with self._lock:
             self._closed = True
         clean = True
+        adopt = None
+        if successor is not None:
+            adopt = getattr(successor, "adopt", None) \
+                or getattr(successor, "resume", None)
         for r in self.replicas:
+            if adopt is not None:
+                orphans = []
+                try:
+                    r.engine.stop(drain=False, hand_off=orphans.extend)
+                except Exception:  # noqa: broad-except — one dead
+                    # replica must not block the swap
+                    clean = False
+                    _log.warning("pool %r: stop of replica %d failed "
+                                 "during version swap", self.name, r.rid,
+                                 exc_info=True)
+                for sess in orphans:
+                    if sess.finished():
+                        continue
+                    if sess.cancelled():
+                        self._shed_session(sess, "abandoned", MXNetError(
+                            "session abandoned by the client during a "
+                            "version swap"))
+                        continue
+                    # release THIS pool's accounting; the successor
+                    # runs its own books from here on
+                    tenant_key = sess.tenant if sess.tenant is not None \
+                        else "*"
+                    self._settle(r.rid, tenant_key)
+                    sess._on_done = None
+                    try:
+                        adopt(sess)
+                    except Exception as e:  # noqa: broad-except — an
+                        # unadoptable straggler sheds typed, not lost
+                        clean = False
+                        self._shed_session(sess, "failover", MXNetError(
+                            "version-swap migration failed: %s" % (e,)))
+                        continue
+                    _telemetry.event("serving.failover.version_swap",
+                                     model=self.name, src=str(r.rid),
+                                     tokens_generated=len(sess.tokens))
             try:
-                if r.engine.close(drain=drain) is False:
+                if r.engine.close(drain=drain and adopt is None) is False:
                     clean = False
             except Exception:  # noqa: broad-except — closing one dead
                 # replica must not leak the others

@@ -345,7 +345,13 @@ class ModelRegistry:
         engines, compiled programs, everything — then this swaps it in
         under the registry lock and drains the old one.  No request
         ever sees a half-swapped model; stragglers holding the old
-        reference get its typed closed error, not a hang."""
+        reference get its typed closed error, not a hang — and a decode
+        POOL's in-flight generations MIGRATE onto the new servable
+        (``close(successor=...)``: each straggler session re-admits by
+        re-prefilling its transcript — bit-identical to an
+        uninterrupted run when the versions share params, sampling from
+        the new weights' logits otherwise) instead of being errored
+        out."""
         if version is not None:
             servable.version = int(version)
         # healthz/models key by servable.name: the registration name is
@@ -362,7 +368,14 @@ class ModelRegistry:
                     else int(getattr(servable, "version", 1))
             self._models[name] = servable
         if prev is not None:
-            prev.close()
+            if hasattr(prev, "replicas") and (
+                    hasattr(servable, "adopt")
+                    or hasattr(servable, "resume")):
+                # old decode pool -> new decode servable: migrate the
+                # stragglers instead of draining/erroring them
+                prev.close(successor=servable)
+            else:
+                prev.close()
         _telemetry.inc("serving.model.loads", model=name)
         _telemetry.event("serving.model.load", model=name,
                          version=servable.version)

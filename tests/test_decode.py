@@ -324,9 +324,13 @@ def test_pool_tenant_quotas_and_priority_shedding():
 
 
 def test_pool_quarantines_failing_replica_and_rewarms():
-    """quarantine_after consecutive step failures quarantine the
-    replica (routing skips it), a background re-warm brings it back,
-    and traffic succeeds end to end afterwards."""
+    """A sustained fault storm opens the failing replicas' circuits
+    (routing skips them), every caught session resolves TYPED — since
+    ISSUE 12 a step fault migrates the held sessions instead of
+    shedding them, so under an every-step storm the outcome is
+    RetryBudgetExhausted / no-healthy-replica rather than the raw
+    FaultInjected — a background re-warm brings the replicas back, and
+    traffic succeeds end to end afterwards."""
     pool = lm_pool(CFG_NO_EOS, PARAMS, n_replicas=2, name="lm",
                    engine_opts=ENGINE_OPTS)
     try:
@@ -338,13 +342,20 @@ def test_pool_quarantines_failing_replica_and_rewarms():
                 try:
                     sess.result(30)
                     outcomes.append("ok")
-                except Exception as e:
+                except MXNetError as e:
                     outcomes.append(type(e).__name__)
             except Overloaded:
                 outcomes.append("no-healthy-replica")
             time.sleep(0.05)
         faults.disarm()
-        assert "FaultInjected" in outcomes
+        # every outcome is typed: completed, typed shed, or typed
+        # admission refusal — never a hang or a silent drop (result(30)
+        # raising DeadlineExceeded would mean an unresolved session)
+        assert len(outcomes) == 8
+        assert set(outcomes) <= {"ok", "RetryBudgetExhausted",
+                                 "MXNetError", "FaultInjected",
+                                 "no-healthy-replica"}, outcomes
+        assert outcomes.count("ok") < 8, "the storm must bite"
         assert telemetry.counter_total(
             "serving.pool.quarantines.count") >= 1
         deadline = time.monotonic() + 60

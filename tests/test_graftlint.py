@@ -829,3 +829,59 @@ def test_mutation_removing_pool_routing_lock_is_caught(tmp_path):
                and "_total_outstanding" in f.message
                for f in active(res1)), \
         [f.message for f in res1.findings]
+
+
+def test_mutation_removing_circuit_breaker_lock_is_caught(tmp_path):
+    """Strip the pool lock from ReplicaPool._note_step_error: the
+    circuit-breaker state writes (circuit transition, opened_at stamp)
+    race the recovery thread and routing -> lock-discipline must fire
+    (ISSUE 12 satellite: the failover circuit/transcript state stays
+    lint-clean with zero baseline entries, and the pass provably
+    catches the stripped lock)."""
+    pristine = tmp_path / "pool_circuit_ok.py"
+    pristine.write_text(
+        (ROOT / "mxnet_tpu" / "serving" / "pool.py").read_text())
+    res0 = run_pass(by_id("lock-discipline")(),
+                    RunContext(roots=[pristine]))
+    assert not active(res0), [f.message for f in active(res0)]
+
+    mutated = _mutated_copy(
+        tmp_path, "mxnet_tpu/serving/pool.py",
+        "        with self._lock:\n"
+        "            r.failures += 1",
+        "        if True:\n"
+        "            r.failures += 1",
+        "pool_circuit_mut.py")
+    res1 = run_pass(by_id("lock-discipline")(),
+                    RunContext(roots=[mutated]))
+    assert any(f.code == "unlocked-write" and "_circuit" in f.message
+               for f in active(res1)), \
+        [f.message for f in res1.findings]
+
+
+def test_mutation_removing_session_transcript_lock_is_caught(tmp_path):
+    """Strip the session lock from GenerateSession._resolve: the
+    exactly-once completion flag — what keeps a migrated session from
+    double-firing the pool's accounting hook when two engines race to
+    retire it — loses its guard -> lock-discipline must fire."""
+    pristine = tmp_path / "decode_ok.py"
+    pristine.write_text(
+        (ROOT / "mxnet_tpu" / "serving" / "decode.py").read_text())
+    res0 = run_pass(by_id("lock-discipline")(),
+                    RunContext(roots=[pristine]))
+    assert not active(res0), [f.message for f in active(res0)]
+
+    mutated = _mutated_copy(
+        tmp_path, "mxnet_tpu/serving/decode.py",
+        "        with self._lock:\n"
+        "            if self._finished:\n"
+        "                return False",
+        "        if True:\n"
+        "            if self._finished:\n"
+        "                return False",
+        "decode_mut.py")
+    res1 = run_pass(by_id("lock-discipline")(),
+                    RunContext(roots=[mutated]))
+    assert any(f.code == "unlocked-write" and "_finished" in f.message
+               for f in active(res1)), \
+        [f.message for f in res1.findings]
