@@ -6,9 +6,11 @@ a pluggable pass registry; ``python -m ci.graftlint`` runs everything
 over ``mxnet_tpu/`` in seconds.  See docs/linting.md for the pass
 catalog, the suppression grammar, and the baseline workflow.
 
-The five historical ``ci/check_*.py`` lint scripts remain as thin shims
-over their migrated passes (:func:`shim_main` preserves their exact
-CLI, output, and exit semantics); ``check_bench_gate`` /
+The five historical ``ci/check_*.py`` lint scripts were removed after
+their deprecation cycle (graftlint v2): run the migrated passes with
+``--pass bare-except`` / ``print`` / ``env-docs`` / ``host-sync`` /
+``signal-restore`` instead.  Legacy suppression comments (``# noqa``,
+``# host-sync: ok``) are still honored forever.  ``check_bench_gate`` /
 ``check_compile_cache`` stay full scripts but are also exposed as
 orchestrated passes.
 """
@@ -22,29 +24,38 @@ from .passes import ALL_PASSES, DEFAULT_PASSES, by_id  # noqa: F401
 from .runner import run, run_pass  # noqa: F401
 
 
-def shim_main(pass_id, argv=(), out=None):
-    """Legacy ``ci/check_<x>.py`` entry semantics over a migrated pass:
-    positional args are scan roots (default: the pass's own), findings
-    print as ``path:line: message``, the summary keeps the historical
-    ``check_<x>: N <noun>`` line, exit status 1 iff violations.
+def changed_files(rev="HEAD", repo=None):
+    """Repo-relative ``*.py`` paths differing from ``rev`` (committed,
+    staged, or worktree) plus untracked ones — the ``--changed`` lane's
+    scope.  Returns None when git is unavailable (the caller falls back
+    to a full run rather than silently linting nothing)."""
+    import pathlib
+    import subprocess
 
-    Baselines do NOT apply here — the old scripts failed on any
-    violation, and the shims must be bit-compatible gates — but both
-    the legacy tags and the unified suppression grammar are honored."""
-    echo = (lambda s: print(s, file=out)) if out is not None \
-        else (lambda s: print(s))  # noqa: print is this tool's output
-    cls = by_id(pass_id)
-    roots = list(argv) or None
-    ctx = RunContext(roots=roots, literal_paths=True)
-    result = run_pass(cls(), ctx, baseline=None)
-    problems = result.active
-    for f in sorted(problems, key=lambda f: (f.path, f.line)):
-        echo("%s:%d: %s" % (f.path, f.line, f.message))
-    if problems:
-        echo("%s: %s" % (cls.legacy_script,
-                         cls.legacy_summary % len(problems)))
-        return 1
-    return 0
+    from .core import REPO
+
+    repo = pathlib.Path(repo) if repo else REPO
+    try:
+        diff = subprocess.run(
+            ["git", "diff", "--name-only", rev, "--", "*.py"],
+            cwd=str(repo), capture_output=True, text=True, timeout=30)
+        untracked = subprocess.run(
+            ["git", "ls-files", "--others", "--exclude-standard",
+             "--", "*.py"],
+            cwd=str(repo), capture_output=True, text=True, timeout=30)
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    if diff.returncode != 0 or untracked.returncode != 0:
+        # either listing failing must trigger the full-run fallback —
+        # a silently-empty untracked list would let a brand-new file
+        # sail through the pre-commit lane unlinted
+        return None
+    names = set()
+    for line in diff.stdout.splitlines() + untracked.stdout.splitlines():
+        line = line.strip()
+        if line.endswith(".py"):
+            names.add(line)
+    return names
 
 
 def main(argv=None):
@@ -62,6 +73,14 @@ def main(argv=None):
                         help="run only this pass (repeatable); "
                              "orchestrated passes (bench-gate, "
                              "compile-cache) only run when named here")
+    parser.add_argument("--changed", nargs="?", const="HEAD",
+                        metavar="REV",
+                        help="diff-scoped fast lane: only report on "
+                             "*.py files changed vs REV (default HEAD; "
+                             "includes staged/worktree/untracked). "
+                             "Per-file passes skip unchanged files; "
+                             "interprocedural passes still see the "
+                             "whole tree for call-graph context")
     parser.add_argument("--list", action="store_true",
                         help="list passes and exit")
     parser.add_argument("--json", metavar="PATH",
@@ -78,19 +97,33 @@ def main(argv=None):
                              "ci/graftlint/baseline.json)")
     parser.add_argument("--emit-telemetry", action="store_true",
                         help="export per-pass finding counts through "
-                             "mxnet_tpu.telemetry (lint.findings gauges)")
+                             "mxnet_tpu.telemetry (lint.findings "
+                             "gauges; lint.changed_run_seconds for "
+                             "--changed runs)")
     args = parser.parse_args(argv)
 
     if args.list:
         for cls in ALL_PASSES:
-            kind = "orchestrated" if cls.orchestrated else "analysis"
-            print("%-18s %-12s %s" % (cls.id, kind, cls.title))  # noqa: CLI output
+            kind = "orchestrated" if cls.orchestrated else (
+                "project" if cls.interprocedural else "analysis")
+            print("%-22s %-12s %s" % (cls.id, kind, cls.title))  # noqa: CLI output
         return 0
 
     if args.passes:
         passes = [by_id(p)() for p in args.passes]
     else:
         passes = [cls() for cls in DEFAULT_PASSES]
+
+    changed = None
+    if args.changed is not None:
+        changed = changed_files(args.changed)
+        if changed is None:
+            print("graftlint: --changed: git unavailable, falling back "
+                  "to a full run")  # noqa: CLI output
+        elif not changed:
+            print("graftlint: --changed: no *.py changes vs %s — "
+                  "nothing to lint" % args.changed)  # noqa: CLI output
+            return 0
 
     from . import baseline as _baseline
 
@@ -99,7 +132,7 @@ def main(argv=None):
         kwargs["baseline_path"] = args.baseline
     else:
         kwargs["baseline_path"] = _baseline.DEFAULT_PATH
-    ctx = RunContext(roots=args.roots or None)
+    ctx = RunContext(roots=args.roots or None, changed=changed)
     return run(passes, ctx=ctx, json_path=args.json,
                update_baseline=args.update_baseline,
                prune_baseline=args.prune_baseline,

@@ -166,6 +166,10 @@ class Pass:
     #: orchestrated passes run an external workload (subprocess bench /
     #: cache probes) instead of analyzing sources — opt-in only
     orchestrated = False
+    #: interprocedural passes analyze the whole collected tree at once
+    #: (project call graph); in ``--changed`` runs they still see every
+    #: source but only findings in changed files are reported
+    interprocedural = False
 
     def run(self, sources, ctx):
         findings = []
@@ -193,15 +197,20 @@ class RunContext:
     ``env_doc_path`` for the env-docs pass."""
 
     def __init__(self, repo=REPO, roots=None, env_doc_path=None,
-                 literal_paths=False):
+                 literal_paths=False, changed=None):
         self.repo = pathlib.Path(repo)
         self.roots = [pathlib.Path(r) for r in roots] if roots else None
         self.env_doc_path = pathlib.Path(env_doc_path) \
             if env_doc_path else self.repo / "docs" / "how_to" / "env_var.md"
-        #: report paths exactly as walked (the legacy check_*.py shims:
-        #: absolute for their default roots, as-given for CLI args)
-        #: instead of repo-relative
+        #: report paths exactly as walked (absolute for default roots,
+        #: as-given for CLI args) instead of repo-relative
         self.literal_paths = literal_paths
+        #: diff-scoped lane (``--changed [REV]``): the set of
+        #: repo-relative paths to REPORT on.  Per-file passes skip
+        #: unchanged sources entirely; interprocedural passes still
+        #: analyze the whole tree (the call graph needs it) but only
+        #: findings in changed files surface.  None = full run.
+        self.changed = set(changed) if changed is not None else None
         self._cache = {}
 
     def collect(self, lint_pass):

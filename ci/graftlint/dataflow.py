@@ -30,6 +30,21 @@ absorb the intentional sites; the fixture tests in
 from __future__ import annotations
 
 import ast
+import os
+
+
+def fixpoint_depth(default=5):
+    """Bound for every iterative summary solver in this package (the
+    lock-discipline helper inference and the interprocedural call-graph
+    summaries).  ``MXNET_LINT_FIXPOINT_DEPTH`` overrides the default —
+    each iteration can only ADD facts, so a larger depth never widens a
+    finding, it only lets deeper helper chains be proven safe."""
+    raw = os.environ.get("MXNET_LINT_FIXPOINT_DEPTH", "")
+    try:
+        depth = int(raw) if raw else default
+    except ValueError:
+        return default
+    return max(1, depth)
 
 #: roots that mark an expression as jax-side (producing traced values /
 #: allowed inside traced code)
@@ -477,3 +492,546 @@ class PurityScan:
 
         visit(expr)
         return hits
+
+
+# -- interprocedural layer (graftlint v2) ------------------------------------
+#
+# The per-module ``ModuleIndex`` stops at file boundaries, which is
+# exactly where SPMD bugs live: a collective's axis name is chosen three
+# calls away (``lm._stage_fn`` -> ``ring_attention`` via a ``partial``
+# built in ``ring_self_attention``), and whether a function ever runs
+# under ``shard_map`` depends on a wrapper in another module.  The
+# :class:`ProjectIndex` below builds ONE call graph over every collected
+# source: module-name resolution for relative/absolute imports,
+# ``functools.partial`` and conditional-alias tracking, a callers map,
+# reachability closure from spmd entries, and bounded-depth constant
+# resolution of parameters through their call sites.  All four
+# distributed-correctness passes share it (built once per run, like the
+# per-file Source cache), and every iterative solver is bounded by
+# :func:`fixpoint_depth`.
+
+#: cross-device collective primitives -> index of the axis-name argument
+COLLECTIVE_AXIS_ARG = {
+    "psum": 1, "pmean": 1, "pmax": 1, "pmin": 1, "psum_scatter": 1,
+    "all_gather": 1, "all_to_all": 1, "ppermute": 1, "pshuffle": 1,
+    "pbroadcast": 1, "axis_index": 0,
+}
+
+#: callables that establish an SPMD axis context for their function arg
+SPMD_ENTRY_NAMES = frozenset({"shard_map", "pmap", "xmap"})
+
+
+def _modname_for(rel):
+    """Dotted module name for a repo-relative path (``a/b/c.py`` ->
+    ``a.b.c``; ``a/b/__init__.py`` -> ``a.b``)."""
+    rel = str(rel)
+    if rel.endswith(".py"):
+        rel = rel[:-3]
+    parts = [p for p in rel.replace("\\", "/").split("/") if p]
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts)
+
+
+class FuncInfo:
+    """One function (or lambda) anywhere in the project."""
+
+    __slots__ = ("node", "source", "module", "qualname", "cls_node")
+
+    def __init__(self, node, source, module, qualname, cls_node=None):
+        self.node = node
+        self.source = source
+        self.module = module
+        self.qualname = qualname
+        self.cls_node = cls_node
+
+    @property
+    def name(self):
+        return getattr(self.node, "name", "<lambda>")
+
+    def __repr__(self):
+        return "FuncInfo(%s:%s)" % (self.module, self.qualname)
+
+
+class CallSite:
+    """One resolved call (or ``partial`` binding) of a project function."""
+
+    __slots__ = ("call", "caller", "source", "partial")
+
+    def __init__(self, call, caller, source, partial=False):
+        self.call = call          # the ast.Call node
+        self.caller = caller      # FuncInfo containing it (None = module)
+        self.source = source
+        self.partial = partial    # True when this is partial(f, ...)
+
+    def arg_expr(self, target, param):
+        """The expression bound to ``target``'s parameter ``param`` at
+        this site, or the parameter's default, or None (unknown).
+
+        Bound-method sites (``self.reduce(axis, v)`` /
+        ``partial(self.reduce, axis)``) pass the receiver implicitly,
+        so positional binding skips the leading ``self``/``cls``."""
+        params = func_params(target.node)
+        offset = 1 if self.partial else 0
+        fn_expr = self.call.args[0] if self.partial else self.call.func
+        skip_self = 1 if params and params[0] in ("self", "cls") \
+            and isinstance(fn_expr, ast.Attribute) else 0
+        for kw in self.call.keywords:
+            if kw.arg == param:
+                return kw.value
+        try:
+            pos = params.index(param) - skip_self
+        except ValueError:
+            return None
+        if pos < 0:
+            return None  # the receiver itself: not bound at the site
+        args = self.call.args[offset:]
+        if pos < len(args) and not any(
+                isinstance(a, ast.Starred) for a in args[:pos + 1]):
+            return args[pos]
+        return _param_default(target.node, param)
+
+
+def _param_default(func, param):
+    """The default-value expression of ``param`` on ``func``, or None."""
+    a = func.args
+    pos = getattr(a, "posonlyargs", []) + a.args
+    names = [p.arg for p in pos]
+    if param in names:
+        i = names.index(param)
+        ndef = len(a.defaults)
+        j = i - (len(names) - ndef)
+        if 0 <= j < ndef:
+            return a.defaults[j]
+        return None
+    kwnames = [p.arg for p in a.kwonlyargs]
+    if param in kwnames:
+        d = a.kw_defaults[kwnames.index(param)]
+        return d
+    return None
+
+
+def project_index_for(ctx, sources):
+    """The (cached) :class:`ProjectIndex` over ``sources`` — built once
+    per runner invocation and shared by every interprocedural pass."""
+    key = tuple(id(s) for s in sources)
+    cached = getattr(ctx, "_graftlint_project", None)
+    if cached is not None and cached[0] == key:
+        return cached[1]
+    idx = ProjectIndex(sources)
+    ctx._graftlint_project = (key, idx)
+    return idx
+
+
+class ProjectIndex:
+    """Repo-wide call graph + per-function summaries."""
+
+    def __init__(self, sources):
+        self.sources = [s for s in sources if s.tree is not None]
+        self.mod_of = {}          # Source -> dotted module name
+        self.by_module = {}       # module name -> Source
+        self.functions = {}       # (module, qualname) -> FuncInfo
+        self.by_node = {}         # ast node -> FuncInfo
+        self.imports = {}         # module -> {local name: (module, symbol)}
+        self.mod_aliases = {}     # module -> {local name: module name}
+        for src in self.sources:
+            mod = _modname_for(src.rel)
+            self.mod_of[src] = mod
+            self.by_module[mod] = src
+            self._index_module(src, mod)
+        self.callers = {}         # FuncInfo -> [CallSite, ...]
+        self._aliases = {}        # (module, scope-qualname) unused; see below
+        self._func_aliases = {}   # FuncInfo|Source -> {name: set(FuncInfo)}
+        for src in self.sources:
+            self._collect_calls(src)
+        self.spmd_seeds = self._spmd_seeds()
+        self.spmd_reachable = self._close_reachable(self.spmd_seeds)
+        self.declared_axes = self._declared_axes()
+
+    # -- module indexing ---------------------------------------------------
+    def _index_module(self, src, mod):
+        imports = self.imports.setdefault(mod, {})
+        aliases = self.mod_aliases.setdefault(mod, {})
+        pkg = mod.split(".")
+        is_pkg = src.rel.endswith("__init__.py")
+        base_pkg = pkg if is_pkg else pkg[:-1]
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    aliases[a.asname or a.name.split(".")[0]] = \
+                        a.name if a.asname else a.name.split(".")[0]
+            elif isinstance(node, ast.ImportFrom):
+                if node.level:
+                    anchor = base_pkg[:len(base_pkg) - (node.level - 1)]
+                    target = ".".join(anchor + (node.module.split(".")
+                                                if node.module else []))
+                else:
+                    target = node.module or ""
+                for a in node.names:
+                    local = a.asname or a.name
+                    imports[local] = (target, a.name)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual, cls = self._qualname(src, node)
+                info = FuncInfo(node, src, mod, qual, cls)
+                self.functions.setdefault((mod, qual), info)
+                self.by_node[node] = info
+            elif isinstance(node, ast.Lambda):
+                info = FuncInfo(node, src, mod,
+                                "<lambda:%d>" % node.lineno)
+                self.by_node[node] = info
+
+    def _qualname(self, src, node):
+        midx = index_for(src)
+        names, cls = [node.name], None
+        cur = midx.parents.get(node)
+        while cur is not None:
+            if isinstance(cur, ast.ClassDef):
+                if cls is None:
+                    cls = cur
+                names.append(cur.name)
+            elif isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                names.append(cur.name)
+            cur = midx.parents.get(cur)
+        return ".".join(reversed(names)), cls
+
+    # -- call collection ---------------------------------------------------
+    def resolve_ref(self, expr, src, at_node):
+        """FuncInfos an expression may refer to (empty set = unknown):
+        bare names (local defs, module defs, imports, partial/IfExp
+        aliases), ``self.meth`` within the enclosing class, and
+        ``mod.fn`` through module aliases."""
+        out = set()
+        midx = index_for(src)
+        if isinstance(expr, ast.Lambda):
+            info = self.by_node.get(expr)
+            return {info} if info else set()
+        if isinstance(expr, ast.Call) and _is_partial_call(expr) \
+                and expr.args:
+            return self.resolve_ref(expr.args[0], src, at_node)
+        if isinstance(expr, ast.IfExp):
+            return self.resolve_ref(expr.body, src, at_node) \
+                | self.resolve_ref(expr.orelse, src, at_node)
+        mod = self.mod_of[src]
+        if isinstance(expr, ast.Name):
+            got = midx.resolve_func(expr.id, at_node)
+            if got is not None and got in self.by_node:
+                return {self.by_node[got]}
+            # partial/conditional aliases recorded in the enclosing scope
+            for scope in enclosing_functions(at_node, midx.parents) \
+                    + [src]:
+                amap = self._func_aliases.get(
+                    self.by_node.get(scope, scope)
+                    if not isinstance(scope, type(src)) else scope)
+                if amap and expr.id in amap:
+                    return set(amap[expr.id])
+            imp = self.imports.get(mod, {}).get(expr.id)
+            if imp is not None:
+                target = self._resolve_module(imp[0])
+                if target is not None:
+                    info = self.functions.get((target, imp[1]))
+                    if info is not None:
+                        return {info}
+            return out
+        if isinstance(expr, ast.Attribute):
+            if isinstance(expr.value, ast.Name):
+                if expr.value.id == "self":
+                    chain = enclosing_functions(at_node, midx.parents)
+                    cls = None
+                    for fn in chain:
+                        info = self.by_node.get(fn)
+                        if info is not None and info.cls_node is not None:
+                            cls = info.cls_node
+                            break
+                    if cls is not None:
+                        info = self.functions.get(
+                            (mod, "%s.%s" % (cls.name, expr.attr)))
+                        if info is not None:
+                            return {info}
+                    return out
+                alias = self.mod_aliases.get(mod, {}).get(expr.value.id)
+                if alias is not None:
+                    target = self._resolve_module(alias)
+                    if target is not None:
+                        info = self.functions.get((target, expr.attr))
+                        if info is not None:
+                            return {info}
+                imp = self.imports.get(mod, {}).get(expr.value.id)
+                if imp is not None:
+                    # ``from . import faults`` -> module alias
+                    sub = "%s.%s" % (imp[0], imp[1]) if imp[1] else imp[0]
+                    target = self._resolve_module(sub)
+                    if target is not None:
+                        info = self.functions.get((target, expr.attr))
+                        if info is not None:
+                            return {info}
+        return out
+
+    def _resolve_module(self, target):
+        """Map an imported module name onto a collected module.  Exact
+        dotted match first; otherwise a UNIQUE collected module whose
+        dotted name ends with the target (snippets and CLI roots
+        outside the repo get path-derived names the import text cannot
+        know)."""
+        if not target:
+            return None
+        if target in self.by_module:
+            return target
+        hits = [m for m in self.by_module
+                if m.endswith("." + target)]
+        return hits[0] if len(hits) == 1 else None
+
+    def _collect_calls(self, src):
+        midx = index_for(src)
+
+        def record_alias(scope_key, name, targets):
+            amap = self._func_aliases.setdefault(scope_key, {})
+            amap.setdefault(name, set()).update(targets)
+
+        # two rounds: aliases recorded first, then calls resolved (an
+        # alias may be defined after first use textually inside a class)
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                targets = self.resolve_ref(node.value, src, node)
+                if targets:
+                    chain = enclosing_functions(node, midx.parents)
+                    scope = self.by_node.get(chain[0]) if chain else src
+                    if scope is not None:
+                        record_alias(scope, node.targets[0].id, targets)
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            caller = None
+            chain = enclosing_functions(node, midx.parents)
+            if chain:
+                caller = self.by_node.get(chain[0])
+            if _is_partial_call(node) and node.args:
+                for info in self.resolve_ref(node.args[0], src, node):
+                    self.callers.setdefault(info, []).append(
+                        CallSite(node, caller, src, partial=True))
+                continue
+            for info in self.resolve_ref(node.func, src, node):
+                self.callers.setdefault(info, []).append(
+                    CallSite(node, caller, src))
+
+    # -- spmd reachability -------------------------------------------------
+    def _is_spmd_entry(self, func_expr, src):
+        if isinstance(func_expr, ast.Attribute):
+            return func_expr.attr in SPMD_ENTRY_NAMES \
+                and (root_name(func_expr) in JAX_ROOTS
+                     or (root_name(func_expr) or "").startswith("_jax"))
+        if isinstance(func_expr, ast.Name):
+            mod = self.mod_of.get(src)
+            imp = self.imports.get(mod, {}).get(func_expr.id)
+            return func_expr.id in SPMD_ENTRY_NAMES and imp is not None \
+                and imp[0].split(".")[0] == "jax"
+        return False
+
+    def _spmd_seeds(self):
+        seeds = set()
+        for src in self.sources:
+            for node in ast.walk(src.tree):
+                if isinstance(node, ast.Call) \
+                        and self._is_spmd_entry(node.func, src) \
+                        and node.args:
+                    seeds |= self.resolve_ref(node.args[0], src, node)
+        return seeds
+
+    def _close_reachable(self, seeds):
+        """Transitive closure over calls AND function references passed
+        as arguments (higher-order: ``spmd_pipeline(stage, ...)`` runs
+        ``stage`` even though it never calls it by name).
+
+        Reachability must OVER-approximate — an unreachable verdict
+        feeds ``collective-outside-spmd``, and the pass's precision
+        contract is that unknowns stay silent.  An attribute call whose
+        receiver we cannot resolve (``r.step(x)`` on a local instance)
+        therefore reaches EVERY project method of that name (CHA-lite
+        name-based dispatch); widening the closure can only remove
+        findings, never add one."""
+        by_name = {}
+        for fi in self.by_node.values():
+            if fi.cls_node is not None:
+                by_name.setdefault(fi.name, set()).add(fi)
+        reached = set(seeds)
+        work = list(seeds)
+        while work:
+            info = work.pop()
+            src = info.source
+            for node in ast.walk(info.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                refs = set(self.resolve_ref(node.func, src, node))
+                if not refs and isinstance(node.func, ast.Attribute):
+                    refs = set(by_name.get(node.func.attr, ()))
+                for arg in list(node.args) + [k.value
+                                              for k in node.keywords]:
+                    exprs = arg.elts if isinstance(
+                        arg, (ast.Tuple, ast.List)) else [arg]
+                    for e in exprs:
+                        refs |= self.resolve_ref(e, src, node)
+                for ref in refs:
+                    if ref not in reached:
+                        reached.add(ref)
+                        work.append(ref)
+        return reached
+
+    # -- axis vocabulary ---------------------------------------------------
+    def _declared_axes(self):
+        """Every mesh-axis name DECLARED by a binding construct anywhere
+        in the project: ``PartitionSpec``/``P`` constant entries,
+        ``Mesh(..., axis_names)`` / ``make_mesh(axis_names=...)``
+        tuples, ``pmap(axis_name=...)``, ``mesh.shape["x"]`` lookups,
+        and constant defaults of ``*axis*``-named parameters.  NOT the
+        axis arguments of collectives themselves — that would make the
+        consistency check circular."""
+        axes = set()
+
+        def add_const(expr):
+            if isinstance(expr, ast.Constant) \
+                    and isinstance(expr.value, str):
+                axes.add(expr.value)
+            elif isinstance(expr, (ast.Tuple, ast.List)):
+                for e in expr.elts:
+                    add_const(e)
+
+        for src in self.sources:
+            for node in ast.walk(src.tree):
+                if isinstance(node, ast.Call):
+                    fname = node.func.attr \
+                        if isinstance(node.func, ast.Attribute) \
+                        else (node.func.id
+                              if isinstance(node.func, ast.Name) else "")
+                    if fname in ("PartitionSpec", "P"):
+                        for a in node.args:
+                            add_const(a)
+                    elif fname == "Mesh":
+                        if len(node.args) > 1:
+                            add_const(node.args[1])
+                        for kw in node.keywords:
+                            if kw.arg == "axis_names":
+                                add_const(kw.value)
+                    else:
+                        for kw in node.keywords:
+                            if kw.arg in ("axis_name", "axis_names") \
+                                    and fname in ("pmap", "make_mesh",
+                                                  "Mesh", "xmap"):
+                                add_const(kw.value)
+                elif isinstance(node, ast.Subscript) \
+                        and isinstance(node.value, ast.Attribute) \
+                        and node.value.attr == "shape":
+                    # mesh.shape["model"] — an axis lookup on a Mesh
+                    sl = node.slice
+                    if isinstance(sl, ast.Constant) \
+                            and isinstance(sl.value, str):
+                        axes.add(sl.value)
+                elif isinstance(node, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)):
+                    for p in func_params(node):
+                        if "axis" in p:
+                            d = _param_default(node, p)
+                            if d is not None:
+                                add_const(d)
+        return axes
+
+    # -- bounded constant resolution ---------------------------------------
+    def const_str_resolutions(self, expr, info, depth=None):
+        """Resolve ``expr`` (evaluated inside function ``info``) to the
+        constant strings it can take, chasing parameters through call
+        sites up to ``depth`` levels.  Returns a list of
+        ``(value_or_None, source, lineno)`` — one entry per resolution
+        path; ``None`` value = unknown (the passes stay silent on it).
+        The reporting location is where the concrete constant was
+        chosen, so a bad axis passed by a caller is flagged AT the
+        caller."""
+        if depth is None:
+            depth = fixpoint_depth()
+        out = []
+        self._resolve_const(expr, info, depth, out, set())
+        return out
+
+    def _resolve_const(self, expr, info, depth, out, seen):
+        src = info.source if info is not None else None
+        if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+            out.append((expr.value, src, expr.lineno))
+            return
+        if isinstance(expr, ast.Name) and info is not None:
+            # innermost-out scope walk: the name may be a local constant
+            # or a parameter of ANY enclosing function (closure capture —
+            # the ``step``/``seq_to_head`` nested-helper idiom)
+            midx = index_for(info.source)
+            scopes = [info]
+            for outer in enclosing_functions(info.node, midx.parents):
+                outer_info = self.by_node.get(outer)
+                if outer_info is not None:
+                    scopes.append(outer_info)
+            for scope in scopes:
+                nested = {n for fn in ast.walk(scope.node)
+                          if isinstance(fn, (ast.FunctionDef,
+                                             ast.AsyncFunctionDef,
+                                             ast.Lambda))
+                          and fn is not scope.node
+                          for n in ast.walk(fn)}
+                assigns = [n for n in ast.walk(scope.node)
+                           if isinstance(n, ast.Assign) and n not in nested
+                           and any(isinstance(t, ast.Name)
+                                   and t.id == expr.id
+                                   for t in n.targets)]
+                if len(assigns) == 1 and isinstance(assigns[0].value,
+                                                    ast.Constant):
+                    v = assigns[0].value.value
+                    if isinstance(v, str):
+                        out.append((v, src, assigns[0].lineno))
+                        return
+                if expr.id not in func_params(scope.node):
+                    continue
+                if depth > 0 and (scope, expr.id) not in seen:
+                    seen = seen | {(scope, expr.id)}
+                    sites = self.callers.get(scope, [])
+                    resolved_any = False
+                    for site in sites:
+                        bound = site.arg_expr(scope, expr.id)
+                        if bound is None:
+                            out.append((None, site.source,
+                                        site.call.lineno))
+                            resolved_any = True
+                            continue
+                        before = len(out)
+                        self._resolve_const(bound, site.caller, depth - 1,
+                                            out, seen)
+                        resolved_any = resolved_any or len(out) > before
+                    default = _param_default(scope.node, expr.id)
+                    if not sites and default is not None:
+                        self._resolve_const(default, scope, depth - 1,
+                                            out, seen)
+                        return
+                    if resolved_any:
+                        return
+                break  # a shadowing param with no resolution: unknown
+        out.append((None, src, getattr(expr, "lineno", 0)))
+
+    # -- collective helpers ------------------------------------------------
+    def is_collective(self, call, src):
+        """The collective's terminal name when ``call`` invokes a jax
+        cross-device collective, else None."""
+        f = call.func
+        if isinstance(f, ast.Attribute):
+            if f.attr in COLLECTIVE_AXIS_ARG \
+                    and root_name(f) in JAX_ROOTS:
+                return f.attr
+            return None
+        if isinstance(f, ast.Name) and f.id in COLLECTIVE_AXIS_ARG:
+            mod = self.mod_of.get(src)
+            imp = self.imports.get(mod, {}).get(f.id)
+            if imp is not None and imp[0].split(".")[0] == "jax":
+                return f.id
+        return None
+
+    def collective_axis_expr(self, call, name):
+        for kw in call.keywords:
+            if kw.arg == "axis_name":
+                return kw.value
+        pos = COLLECTIVE_AXIS_ARG[name]
+        if pos < len(call.args):
+            return call.args[pos]
+        return None

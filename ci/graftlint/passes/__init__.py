@@ -7,6 +7,7 @@ the default set; see their module docstring)."""
 from __future__ import annotations
 
 from .bare_except import BareExceptPass
+from .collective_consistency import CollectiveConsistencyPass
 from .donation import DonationPass
 from .env_docs import EnvDocsPass
 from .host_sync import HostSyncPass
@@ -14,7 +15,10 @@ from .lock_discipline import LockDisciplinePass
 from .orchestrated import BenchGatePass, CompileCachePass
 from .print_call import PrintPass
 from .recompile_hazard import RecompileHazardPass
+from .replica_divergence import ReplicaDivergencePass
 from .signal_restore import SignalRestorePass
+from .spec_shape import SpecShapePass
+from .state_protocol import StateProtocolPass
 from .tracer_purity import TracerPurityPass
 
 ALL_PASSES = (
@@ -27,6 +31,10 @@ ALL_PASSES = (
     RecompileHazardPass,
     DonationPass,
     LockDisciplinePass,
+    CollectiveConsistencyPass,
+    ReplicaDivergencePass,
+    SpecShapePass,
+    StateProtocolPass,
     BenchGatePass,
     CompileCachePass,
 )

@@ -27,8 +27,6 @@ class BareExceptPass(Pass):
     id = "bare-except"
     title = "no silently-swallowed exceptions"
     legacy_tags = ("# noqa",)
-    legacy_script = "check_bare_except"
-    legacy_summary = "%d violation(s)"
 
     def check_source(self, src, ctx):
         findings = []

@@ -1,0 +1,213 @@
+"""collective-consistency pass — every collective's axis is bound & safe.
+
+The north star replaces KVStore/NCCL allreduce with ICI ``psum`` under
+GSPMD; what makes those programs correct is invisible to any unit test
+on one host: an axis name must refer to an axis some enclosing
+``shard_map``/``pmap``/mesh context binds, every replica must execute
+the same collective sequence, and a collective behind a
+traced-value-dependent branch is a divergence/deadlock waiting for the
+first batch that splits the predicate across replicas.  Checked
+interprocedurally over the :class:`~ci.graftlint.dataflow.ProjectIndex`
+call graph (axis names are chosen calls away from the ``lax.psum`` that
+uses them — ``lm._stage_fn`` picks ``"model"`` for a psum three modules
+down):
+
+* **unknown-axis** — the axis-name argument of ``psum``/``pmean``/
+  ``all_gather``/``all_to_all``/``ppermute``/``axis_index``/...,
+  resolved through parameters and ``functools.partial`` bindings up to
+  the bounded fixpoint depth, names an axis NO binding construct in the
+  project declares (``PartitionSpec`` entries, ``Mesh``/``make_mesh``
+  axis tuples, ``pmap(axis_name=)``, ``mesh.shape["x"]`` lookups,
+  axis-parameter defaults).  Reported at the call site that chose the
+  constant, not at the collective.
+* **collective-outside-spmd** — the collective's enclosing function is
+  not reachable (calls + higher-order function references) from any
+  function handed to ``shard_map``/``pmap``: the axis can never be
+  bound at runtime and the first trace raises — or worse, the code only
+  works because a test wraps it manually and production never does.
+* **divergent-collective** — the collective executes under Python
+  control flow whose test involves *proven traced-array* values, or
+  inside a function used as a ``lax.cond``/``lax.switch`` branch: when
+  the predicate differs across replicas, some replicas enter the
+  collective and others do not — the canonical SPMD deadlock.
+
+Unknown resolutions stay silent (the precision contract): a dynamically
+computed axis name is someone's plumbing, not evidence of a bug.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Pass
+from ..dataflow import (PurityScan, enclosing_functions, fixpoint_depth,
+                        index_for, project_index_for, root_name)
+
+#: lax combinators whose function arguments run as predicate-selected
+#: branches — a collective inside one is replica-divergence-prone
+_BRANCH_ENTRY_ARGS = {"cond": (1, 2), "switch": None}
+
+
+class CollectiveConsistencyPass(Pass):
+    id = "collective-consistency"
+    title = "collective axes are bound, reachable from SPMD entries, " \
+            "and replica-uniform"
+    interprocedural = True
+
+    def run(self, sources, ctx):
+        findings = []
+        good = []
+        for src in sources:
+            if src.syntax_error is not None:
+                e = src.syntax_error
+                findings.append(self.find(src, e.lineno or 0,
+                                          "syntax-error",
+                                          "syntax error: %s" % e.msg))
+            else:
+                good.append(src)
+        idx = project_index_for(ctx, tuple(good))
+        branchy = self._branch_collective_funcs(idx)
+        for src in idx.sources:
+            findings.extend(self._check_source(src, idx, branchy))
+        return findings
+
+    # -- per-source checks -------------------------------------------------
+    def _check_source(self, src, idx, branchy):
+        findings = []
+        midx = index_for(src)
+        seen = set()
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            col = idx.is_collective(node, src)
+            if col is None:
+                continue
+            chain = enclosing_functions(node, midx.parents)
+            info = idx.by_node.get(chain[0]) if chain else None
+            fname = info.qualname if info is not None else "<module>"
+
+            # 1. reachability from an spmd entry
+            if info is None or info not in idx.spmd_reachable:
+                findings.append(self.find(
+                    src, node, "collective-outside-spmd",
+                    "%s(...) in %r is not reachable from any function "
+                    "passed to shard_map/pmap anywhere in the project — "
+                    "its axis can never be bound (wrap the entry point, "
+                    "or suppress if a caller outside the scanned tree "
+                    "provides the context)" % (col, fname),
+                    detail="%s:%s" % (fname, col)))
+
+            # 2. axis-name resolution against the declared vocabulary
+            ax = idx.collective_axis_expr(node, col)
+            if ax is not None:
+                for value, where, line in idx.const_str_resolutions(
+                        ax, info):
+                    if value is None or value in idx.declared_axes:
+                        continue
+                    rsrc = where if where is not None else src
+                    key = (rsrc.rel, line, value)
+                    if key in seen:
+                        continue
+                    seen.add(key)
+                    findings.append(self.find(
+                        rsrc, line, "unknown-axis",
+                        "axis %r reaches %s(...) in %r but no mesh/"
+                        "PartitionSpec/pmap construct in the project "
+                        "declares an axis with that name (declared: %s)"
+                        % (value, col, fname,
+                           ", ".join(sorted(idx.declared_axes)) or
+                           "none"),
+                        detail=value))
+
+            # 3. traced-value-dependent control flow around the call
+            findings.extend(self._check_divergence(src, midx, node, col,
+                                                   chain, info))
+
+            # 4. collective in a cond/switch branch (computed project-wide)
+            if info is not None and info in branchy:
+                findings.append(self.find(
+                    src, node, "divergent-collective",
+                    "%s(...) runs inside %r, which is used as a "
+                    "lax.cond/lax.switch branch: replicas whose "
+                    "predicate differs skip the collective and the "
+                    "program deadlocks — hoist the collective out of "
+                    "the branch" % (col, fname),
+                    detail="%s:branch" % fname))
+        return findings
+
+    def _check_divergence(self, src, midx, call, col, chain, info):
+        """Python ``if``/``while`` on traced arrays above the collective."""
+        findings = []
+        if not chain:
+            return findings
+        func = chain[0]
+        scan = PurityScan(func, midx, meta=midx.traced.get(func))
+        cur = midx.parents.get(call)
+        while cur is not None and cur is not func:
+            if isinstance(cur, (ast.If, ast.While)):
+                names = scan.array_names_in(cur.test)
+                if names:
+                    findings.append(self.find(
+                        src, call, "divergent-collective",
+                        "%s(...) executes under a Python %s whose test "
+                        "depends on traced value(s) %s — replicas that "
+                        "take different branches miss the collective "
+                        "and deadlock (use jnp.where/lax.cond on the "
+                        "VALUE, keep the collective unconditional)"
+                        % (col, "if" if isinstance(cur, ast.If)
+                           else "while", ", ".join(sorted(names))),
+                        detail=",".join(sorted(names))))
+            cur = midx.parents.get(cur)
+        return findings
+
+    # -- project-wide branch analysis --------------------------------------
+    def _branch_collective_funcs(self, idx):
+        """Functions used as ``lax.cond``/``lax.switch`` branches that
+        (transitively, bounded by the fixpoint depth) perform a
+        collective."""
+        performs = self._performs_collective(idx)
+        branchy = set()
+        for src in idx.sources:
+            for node in ast.walk(src.tree):
+                if not isinstance(node, ast.Call) \
+                        or not isinstance(node.func, ast.Attribute):
+                    continue
+                name = node.func.attr
+                if name not in _BRANCH_ENTRY_ARGS \
+                        or root_name(node.func) not in ("jax", "lax"):
+                    continue
+                positions = _BRANCH_ENTRY_ARGS[name]
+                args = [node.args[i] for i in positions
+                        if i < len(node.args)] \
+                    if positions is not None else node.args[1:]
+                for arg in args:
+                    exprs = arg.elts if isinstance(
+                        arg, (ast.Tuple, ast.List)) else [arg]
+                    for e in exprs:
+                        for ref in idx.resolve_ref(e, src, node):
+                            if ref in performs:
+                                branchy.add(ref)
+        return branchy
+
+    def _performs_collective(self, idx):
+        """{FuncInfo} that contain a collective directly or through
+        resolvable calls — propagated caller-ward over the prebuilt
+        callers map, bounded by the fixpoint depth."""
+        performs = set()
+        for src in idx.sources:
+            for node in ast.walk(src.tree):
+                if isinstance(node, ast.Call) \
+                        and idx.is_collective(node, src):
+                    midx = index_for(src)
+                    chain = enclosing_functions(node, midx.parents)
+                    if chain and idx.by_node.get(chain[0]) is not None:
+                        performs.add(idx.by_node[chain[0]])
+        for _ in range(fixpoint_depth()):
+            added = {site.caller for info in performs
+                     for site in idx.callers.get(info, ())
+                     if site.caller is not None
+                     and not site.partial} - performs
+            if not added:
+                break
+            performs |= added
+        return performs

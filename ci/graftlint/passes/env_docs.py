@@ -1,6 +1,6 @@
 """env-docs pass — every ``MXNET_*`` env var read must be documented.
 
-Migrated from ``ci/check_env_docs.py`` (thin shim remains).  Any whole
+Migrated from ``ci/check_env_docs.py`` (shim removed after its deprecation cycle).  Any whole
 string constant shaped like an env var name must appear verbatim in
 ``docs/how_to/env_var.md``; prose in docstrings/comments never counts
 (AST constants only).  Legacy ``# noqa`` honored."""
@@ -25,8 +25,6 @@ class EnvDocsPass(Pass):
     id = "env-docs"
     title = "MXNET_* env var reads are documented"
     legacy_tags = ("# noqa",)
-    legacy_script = "check_env_docs"
-    legacy_summary = "%d undocumented env var read(s)"
 
     def run(self, sources, ctx):
         doc = ctx.env_doc_path

@@ -1,6 +1,6 @@
 """host-sync pass — no host-synchronizing calls in the fit hot path.
 
-Migrated from ``ci/check_host_sync.py`` (thin shim remains).  The
+Migrated from ``ci/check_host_sync.py`` (shim removed after its deprecation cycle).  The
 sync-free fit loop (docs/how_to/perf.md) must never block the host on
 device results in steady state; one stray blocking device→host copy
 reintroduces a per-batch round trip no test catches.  Flagged shapes:
@@ -48,8 +48,6 @@ class HostSyncPass(Pass):
                      "mxnet_tpu/serving/decode.py")
     excluded_files = frozenset({"python_module.py"})
     legacy_tags = ("# host-sync: ok",)
-    legacy_script = "check_host_sync"
-    legacy_summary = "%d violation(s)"
 
     def check_source(self, src, ctx):
         findings = []

@@ -42,7 +42,7 @@ from __future__ import annotations
 import ast
 
 from ..core import Pass
-from ..dataflow import root_name
+from ..dataflow import fixpoint_depth, root_name
 
 LOCK_FACTORIES = frozenset({"Lock", "RLock", "Condition", "Semaphore",
                             "BoundedSemaphore"})
@@ -101,12 +101,13 @@ class _ClassScan:
         Thread entry points have no visible call sites and never
         qualify."""
         held = {}
-        # helpers calling helpers: small fixpoint.  Depth 5 covers the
-        # deepest real chain in-tree (KVStoreServer: locked dispatch ->
-        # _wait_interruptible -> _check_dead_peers -> _evict ->
-        # _bump_epoch); each iteration can only ADD held facts, so extra
-        # depth never widens a finding
-        for _ in range(5):
+        # helpers calling helpers: small fixpoint.  The default depth 5
+        # covers the deepest real chain in-tree (KVStoreServer: locked
+        # dispatch -> _wait_interruptible -> _check_dead_peers -> _evict
+        # -> _bump_epoch); MXNET_LINT_FIXPOINT_DEPTH raises it for
+        # deeper chains — each iteration can only ADD held facts, so
+        # extra depth never widens a finding (docs/how_to/env_var.md)
+        for _ in range(fixpoint_depth()):
             changed = False
             for name in self.methods:
                 if name in self.thread_bodies or name in held:
@@ -372,8 +373,9 @@ class LockDisciplinePass(Pass):
 
         # lock-held helper inference (module analog of the class rule):
         # a function whose every call site holds _lock runs with it held
+        # (same MXNET_LINT_FIXPOINT_DEPTH bound as the class solver)
         fn_held = {}
-        for _ in range(3):
+        for _ in range(fixpoint_depth()):
             changed = False
             for name in func_names:
                 if name in fn_held:

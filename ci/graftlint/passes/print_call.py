@@ -1,6 +1,6 @@
 """print pass — no bare ``print(`` in framework code.
 
-Migrated from ``ci/check_print.py`` (thin shim remains).  Framework
+Migrated from ``ci/check_print.py`` (shim removed after its deprecation cycle).  Framework
 output flows through logging or telemetry; a stray print pollutes
 stdout, which bench.py's one-JSON-line contract and launcher scrapers
 treat as machine-readable.  ``visualization.py`` is exempt wholesale
@@ -18,8 +18,6 @@ class PrintPass(Pass):
     title = "no bare print() in framework code"
     excluded_files = frozenset({"visualization.py"})
     legacy_tags = ("# noqa",)
-    legacy_script = "check_print"
-    legacy_summary = "%d violation(s)"
 
     def check_source(self, src, ctx):
         findings = []

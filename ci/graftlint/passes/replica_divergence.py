@@ -1,0 +1,348 @@
+"""replica-divergence pass — nondeterministic host values stay off the
+sync plane.
+
+PR 11's elasticity contract is that two replays of the same schedule are
+BIT-identical, and the in-graph ``psum`` path assumes every replica
+contributes the same program with the same inputs.  One
+``time.time()``-derived scale factor feeding a gradient psum, one
+``hash()``-routed shard key, and replicas diverge silently — no
+exception, just models that disagree.  This pass taints values produced
+by nondeterministic host sources and flags them when they flow into a
+replica-synchronization sink:
+
+* **sources** — ``time.time``/``perf_counter``/``monotonic`` (and
+  ``_ns`` variants), unseeded stdlib ``random.*``, ``os.urandom``,
+  ``uuid.uuid1``/``uuid4``, ``secrets.*``, ``id()``; plus **order**
+  taint from iterating/materializing a ``set`` (``PYTHONHASHSEED``
+  makes set order differ per process; ``sorted(...)`` cleanses it).
+  ``mxnet_tpu.random`` (the seeded stream registry, imported as
+  ``_random``) is deterministic by design and never a source.
+* **sinks** — arguments of jax collectives (``psum``/``pmean``/
+  ``all_gather``/...), KVStore ``.push(...)``, and the elastic
+  sync-round merge surface (``.reload(...)``,
+  ``.set_updater_states(...)``).
+* **interprocedural** — per-function *returns-nondet* summaries
+  propagate through the :class:`~ci.graftlint.dataflow.ProjectIndex`
+  call graph (bounded fixpoint), so a helper that returns
+  ``time.time()`` taints its callers across module boundaries.
+
+Separately, **unstable-hash** flags any builtin ``hash(...)`` call
+outside a ``__hash__`` method: with per-process ``PYTHONHASHSEED``,
+``hash(str)`` differs across workers, so using it for routing or
+sharding (the ``_server_of`` defect class) silently splits the world.
+
+Host-side logging/telemetry timing (``Speedometer``, push-latency
+histograms) never reaches a sink and stays silent — the precision
+contract.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Pass
+from ..dataflow import (COLLECTIVE_AXIS_ARG, fixpoint_depth, index_for,
+                        project_index_for, root_name)
+
+#: module roots whose attribute calls produce per-process values
+_TIME_ATTRS = frozenset({"time", "time_ns", "perf_counter",
+                         "perf_counter_ns", "monotonic", "monotonic_ns"})
+_RANDOM_ROOTS = frozenset({"random", "pyrandom"})
+_RANDOM_ATTRS = frozenset({"random", "randint", "randrange", "choice",
+                           "choices", "sample", "shuffle", "uniform",
+                           "gauss", "normalvariate", "getrandbits",
+                           "betavariate", "expovariate"})
+
+#: method names whose invocation is a replica-synchronization sink
+_SINK_METHODS = frozenset({"push", "reload", "set_updater_states"})
+
+
+def _source_reason(call):
+    """Why a call produces a per-process nondeterministic value."""
+    f = call.func
+    if isinstance(f, ast.Attribute):
+        root = root_name(f)
+        if root in ("time", "_time") and f.attr in _TIME_ATTRS:
+            return "%s.%s()" % (root, f.attr)
+        if root in ("os", "_os") and f.attr == "urandom":
+            return "os.urandom()"
+        if root == "uuid" and f.attr in ("uuid1", "uuid4"):
+            return "uuid.%s()" % f.attr
+        if root == "secrets":
+            return "secrets.%s()" % f.attr
+        if root in _RANDOM_ROOTS and f.attr in _RANDOM_ATTRS:
+            return "%s.%s()" % (root, f.attr)
+        return None
+    if isinstance(f, ast.Name) and f.id == "id" and call.args:
+        return "id()"
+    return None
+
+
+def _is_set_expr(expr, settyped):
+    if isinstance(expr, ast.Set):
+        return True
+    if isinstance(expr, ast.Name):
+        return expr.id in settyped
+    if isinstance(expr, ast.Call) and isinstance(expr.func, ast.Name) \
+            and expr.func.id in ("set", "frozenset"):
+        return True
+    if isinstance(expr, ast.BinOp) and isinstance(
+            expr.op, (ast.BitOr, ast.BitAnd, ast.Sub)):
+        return _is_set_expr(expr.left, settyped) \
+            or _is_set_expr(expr.right, settyped)
+    return None
+
+
+class _NondetScan:
+    """Forward nondet-taint over one function's locals.
+
+    ``tainted`` maps a name to ``(kind, why)`` with kind ``'value'``
+    (the number itself differs per process) or ``'order'`` (set-derived
+    sequence order).  ``sorted()`` cleanses order taint only."""
+
+    def __init__(self, func, idx, src, summaries):
+        self.func = func
+        self.idx = idx
+        self.src = src
+        self.summaries = summaries
+        self.tainted = {}
+        self.settyped = set()
+        for _ in range(2):
+            self._propagate()
+
+    def expr_taint(self, expr):
+        if isinstance(expr, ast.Name):
+            return self.tainted.get(expr.id)
+        if isinstance(expr, (ast.Attribute, ast.Subscript, ast.Starred)):
+            return self.expr_taint(expr.value)
+        if isinstance(expr, ast.Call):
+            reason = _source_reason(expr)
+            if reason is not None:
+                return ("value", reason)
+            f = expr.func
+            if isinstance(f, ast.Name):
+                if f.id == "sorted":
+                    inner = self.expr_taint(expr.args[0]) \
+                        if expr.args else None
+                    return inner if inner and inner[0] == "value" \
+                        else None
+                if f.id in ("list", "tuple") and expr.args \
+                        and _is_set_expr(expr.args[0], self.settyped):
+                    return ("order", "set iteration order")
+                if f.id == "len":
+                    return None
+            for ref in self.idx.resolve_ref(f, self.src, expr):
+                why = self.summaries.get(ref)
+                if why is not None:
+                    return ("value", "%s() -> %s" % (ref.name, why))
+            for a in list(expr.args) + [k.value for k in expr.keywords]:
+                t = self.expr_taint(a)
+                if t is not None:
+                    return t
+            return None
+        if isinstance(expr, (ast.BinOp, ast.UnaryOp, ast.BoolOp,
+                             ast.IfExp, ast.Compare, ast.Tuple,
+                             ast.List, ast.Dict)):
+            for child in ast.iter_child_nodes(expr):
+                if isinstance(child, ast.expr):
+                    t = self.expr_taint(child)
+                    if t is not None:
+                        return t
+        return None
+
+    def _propagate(self):
+        nested = {n for fn in ast.walk(self.func)
+                  if isinstance(fn, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef))
+                  and fn is not self.func for n in ast.walk(fn)}
+        for node in ast.walk(self.func):
+            if node in nested or not isinstance(
+                    node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                continue
+            value = node.value
+            if value is None:
+                continue
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            if _is_set_expr(value, self.settyped):
+                for t in targets:
+                    if isinstance(t, ast.Name):
+                        self.settyped.add(t.id)
+            taint = self.expr_taint(value)
+            if taint is None:
+                continue
+            for t in targets:
+                els = t.elts if isinstance(t, (ast.Tuple, ast.List)) \
+                    else [t]
+                for el in els:
+                    if isinstance(el, ast.Name):
+                        self.tainted[el.id] = taint
+
+    def returns_taint(self):
+        nested = {n for fn in ast.walk(self.func)
+                  if isinstance(fn, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef))
+                  and fn is not self.func for n in ast.walk(fn)}
+        for node in ast.walk(self.func):
+            if isinstance(node, ast.Return) and node not in nested \
+                    and node.value is not None:
+                t = self.expr_taint(node.value)
+                if t is not None and t[0] == "value":
+                    return t[1]
+        return None
+
+
+class ReplicaDivergencePass(Pass):
+    id = "replica-divergence"
+    title = "nondeterministic host values never reach collectives or " \
+            "the KVStore sync plane"
+    interprocedural = True
+
+    def run(self, sources, ctx):
+        findings = []
+        good = []
+        for src in sources:
+            if src.syntax_error is not None:
+                e = src.syntax_error
+                findings.append(self.find(src, e.lineno or 0,
+                                          "syntax-error",
+                                          "syntax error: %s" % e.msg))
+            else:
+                good.append(src)
+        idx = project_index_for(ctx, tuple(good))
+        summaries = self._summaries(idx)
+        for src in idx.sources:
+            findings.extend(self._check_source(src, idx, summaries))
+        return findings
+
+    #: bare names whose presence in a body makes a nondet source
+    #: *possible* — the cheap pre-filter before the full taint scan
+    _SOURCE_HINTS = frozenset({"time", "_time", "os", "_os", "uuid",
+                               "secrets", "id"}) | _RANDOM_ROOTS
+    _SINK_HINTS = _SINK_METHODS | frozenset({"hash"}) \
+        | frozenset(COLLECTIVE_AXIS_ARG)
+
+    def _names_in(self, func):
+        names = set()
+        for n in ast.walk(func):
+            if isinstance(n, ast.Name):
+                names.add(n.id)
+            elif isinstance(n, ast.Attribute):
+                names.add(n.attr)
+        return names
+
+    def _summaries(self, idx):
+        """FuncInfo -> reason, for functions returning nondet values.
+        Seeded from functions that syntactically mention a source root,
+        then propagated caller-ward over the prebuilt callers map."""
+        summaries = {}
+        for info in idx.by_node.values():
+            if isinstance(info.node, ast.Lambda):
+                continue
+            if not (self._SOURCE_HINTS & self._names_in(info.node)):
+                continue
+            scan = _NondetScan(info.node, idx, info.source, summaries)
+            why = scan.returns_taint()
+            if why is not None:
+                summaries[info] = why
+        for _ in range(fixpoint_depth()):
+            changed = False
+            for info in list(summaries):
+                for site in idx.callers.get(info, ()):
+                    caller = site.caller
+                    if caller is None or caller in summaries \
+                            or isinstance(caller.node, ast.Lambda):
+                        continue
+                    scan = _NondetScan(caller.node, idx, caller.source,
+                                       summaries)
+                    why = scan.returns_taint()
+                    if why is not None:
+                        summaries[caller] = why
+                        changed = True
+            if not changed:
+                break
+        return summaries
+
+    def _check_source(self, src, idx, summaries):
+        findings = []
+        midx = index_for(src)
+        for func in midx.all_funcs:
+            if not (self._SINK_HINTS & self._names_in(func)):
+                continue  # no sync sink / hash anywhere in the body
+            info = idx.by_node.get(func)
+            scan = _NondetScan(func, idx, src, summaries)
+            nested = {n for fn in ast.walk(func)
+                      if isinstance(fn, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef))
+                      and fn is not func for n in ast.walk(fn)}
+            fname = info.qualname if info is not None else func.name
+            for node in ast.walk(func):
+                if node in nested or not isinstance(node, ast.Call):
+                    continue
+                findings.extend(self._check_sink(src, midx, scan, node,
+                                                 fname))
+                findings.extend(self._check_hash(src, node, func, fname))
+        return findings
+
+    def _sink_name(self, idx, src, call):
+        col = idx.is_collective(call, src)
+        if col is not None:
+            return "collective %s(...)" % col, "nondet-collective"
+        f = call.func
+        if isinstance(f, ast.Attribute) and f.attr in _SINK_METHODS:
+            return ".%s(...)" % f.attr, "nondet-kvstore"
+        return None, None
+
+    def _check_sink(self, src, midx, scan, call, fname):
+        findings = []
+        sink, code = self._sink_name(scan.idx, src, call)
+        if sink is None:
+            return findings
+        for a in list(call.args) + [k.value for k in call.keywords]:
+            t = scan.expr_taint(a)
+            if t is not None:
+                kind, why = t
+                findings.append(self.find(
+                    src, call, code,
+                    "a value derived from %s (%s) flows into %s in %r "
+                    "— replicas compute different inputs to the same "
+                    "sync point and diverge bit-wise (hoist the nondet "
+                    "read out, or derive the value from the seeded "
+                    "mxnet_tpu.random streams)"
+                    % (why, "per-process value" if kind == "value"
+                       else "per-process order", sink, fname),
+                    detail=why))
+                break
+        # set-order iteration driving a sink: the sequence of sync
+        # rounds itself differs per process
+        cur = midx.parents.get(call)
+        while cur is not None:
+            if isinstance(cur, (ast.For, ast.AsyncFor)) \
+                    and _is_set_expr(cur.iter, scan.settyped):
+                findings.append(self.find(
+                    src, call, "nondet-order",
+                    "%s runs once per element of a set iterated in "
+                    "hash order in %r — with per-process "
+                    "PYTHONHASHSEED every replica issues its sync "
+                    "rounds in a different order (iterate "
+                    "sorted(...) instead)" % (sink, fname),
+                    detail="set-iteration"))
+                break
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                break
+            cur = midx.parents.get(cur)
+        return findings
+
+    def _check_hash(self, src, call, func, fname):
+        if not (isinstance(call.func, ast.Name)
+                and call.func.id == "hash" and call.args):
+            return []
+        if getattr(func, "name", "") == "__hash__":
+            return []
+        return [self.find(
+            src, call, "unstable-hash",
+            "builtin hash() in %r is PYTHONHASHSEED-dependent: its "
+            "value differs across worker processes, so any routing/"
+            "sharding derived from it splits the replicas (use "
+            "zlib.crc32 or hashlib for a stable digest)" % fname,
+            detail=fname)]

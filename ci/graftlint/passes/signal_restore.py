@@ -1,6 +1,6 @@
 """signal-restore pass — every handler install pairs with a restore.
 
-Migrated from ``ci/check_signal_restore.py`` (thin shim remains).  A
+Migrated from ``ci/check_signal_restore.py`` (shim removed after its deprecation cycle).  A
 ``signal.signal(...)`` install that sits outside every ``finally``
 block of its function must be balanced by at least as many restores in
 ``finally`` blocks of the same function; module-level installs have no
@@ -48,8 +48,6 @@ class SignalRestorePass(Pass):
     id = "signal-restore"
     title = "signal handlers restored in finally"
     legacy_tags = ("# noqa",)
-    legacy_script = "check_signal_restore"
-    legacy_summary = "%d violation(s)"
 
     def check_source(self, src, ctx):
         # legacy semantics note: '# noqa' installs were skipped BEFORE

@@ -1,0 +1,400 @@
+"""spec-shape pass — PartitionSpecs match the arrays and meshes they
+describe.
+
+A ``PartitionSpec`` that is longer than the array's rank, an
+``in_specs`` tuple that does not line up with the wrapped function's
+arguments, or a spec naming an axis the mesh does not have all fail —
+but only at trace time on a multi-device mesh, which CPU CI never
+exercises (MULTICHIP runs are where they wedge).  Statically checkable
+shapes, over the :class:`~ci.graftlint.dataflow.ProjectIndex` (the
+wrapped function is usually a ``functools.partial`` resolved across
+modules):
+
+* **spec-arity** — ``shard_map(fn, in_specs=(...))(a, b, c)``: the
+  ``in_specs`` tuple length must equal the invocation's argument count.
+* **spec-rank** — a spec entry with more dimensions than the
+  statically-known rank of the corresponding parameter (rank proven by
+  ``b, h, l, d = x.shape`` unpacking in the wrapped function; specs
+  SHORTER than the rank are legal prefix specs and stay silent).
+* **unknown-mesh-axis** — when the ``mesh=`` argument resolves to a
+  ``Mesh``/``make_mesh`` construction with constant axis names, every
+  axis named in ``in_specs``/``out_specs`` must be one of them.
+* **donated-static** — ``jax.jit(..., donate_argnums=, static_argnums=)``
+  naming the same index: a donated buffer cannot also be a hashed
+  static (XLA rejects or silently undonates).
+* **donate-range** — a ``donate_argnums`` index past the wrapped
+  function's parameter count (donation silently no-ops and the HBM
+  saving it promised never happens).
+
+Anything unresolvable (dynamic specs, meshes from parameters) stays
+silent — the precision contract.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Pass
+from ..dataflow import (_is_partial_call, _param_default,
+                        enclosing_functions, func_params, index_for,
+                        project_index_for, root_name)
+
+
+def _spec_entry(expr, scopes):
+    """``(n_dims, [axis consts])`` for a spec expression, or None.
+
+    Resolves direct ``P(...)``/``PartitionSpec(...)`` calls and names
+    with a single such assignment in an enclosing scope."""
+    if isinstance(expr, ast.Call):
+        f = expr.func
+        fname = f.attr if isinstance(f, ast.Attribute) else (
+            f.id if isinstance(f, ast.Name) else "")
+        if fname in ("P", "PartitionSpec"):
+            names = []
+            for a in expr.args:
+                if isinstance(a, ast.Constant) \
+                        and isinstance(a.value, str):
+                    names.append(a.value)
+                elif isinstance(a, (ast.Tuple, ast.List)):
+                    names.extend(e.value for e in a.elts
+                                 if isinstance(e, ast.Constant)
+                                 and isinstance(e.value, str))
+            return len(expr.args), names
+        return None
+    if isinstance(expr, ast.Name):
+        for scope in scopes:
+            assigns = [n for n in ast.walk(scope)
+                       if isinstance(n, ast.Assign)
+                       and any(isinstance(t, ast.Name)
+                               and t.id == expr.id
+                               for t in n.targets)]
+            if len(assigns) == 1:
+                return _spec_entry(assigns[0].value, scopes)
+            if assigns:
+                return None
+    return None
+
+
+def _param_rank(func, param):
+    """Rank of ``param`` proven by a bare ``a, b, c = param.shape``
+    unpack in ``func``'s body, or None."""
+    for node in ast.walk(func):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Tuple) \
+                and isinstance(node.value, ast.Attribute) \
+                and node.value.attr == "shape" \
+                and isinstance(node.value.value, ast.Name) \
+                and node.value.value.id == param \
+                and all(isinstance(e, ast.Name)
+                        for e in node.targets[0].elts):
+            return len(node.targets[0].elts)
+    return None
+
+
+def _int_consts(expr):
+    if isinstance(expr, ast.Constant) and isinstance(expr.value, int):
+        return [expr.value]
+    if isinstance(expr, (ast.Tuple, ast.List)):
+        return [e.value for e in expr.elts
+                if isinstance(e, ast.Constant)
+                and isinstance(e.value, int)]
+    return None
+
+
+class SpecShapePass(Pass):
+    id = "spec-shape"
+    title = "PartitionSpec rank/arity/axis names and donation indices " \
+            "are consistent"
+    interprocedural = True
+
+    def run(self, sources, ctx):
+        findings = []
+        good = []
+        for src in sources:
+            if src.syntax_error is not None:
+                e = src.syntax_error
+                findings.append(self.find(src, e.lineno or 0,
+                                          "syntax-error",
+                                          "syntax error: %s" % e.msg))
+            else:
+                good.append(src)
+        idx = project_index_for(ctx, tuple(good))
+        for src in idx.sources:
+            findings.extend(self._check_source(src, idx))
+        return findings
+
+    def _check_source(self, src, idx):
+        findings = []
+        midx = index_for(src)
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if idx._is_spmd_entry(node.func, src) and node.args:
+                findings.extend(self._check_shard_map(src, midx, idx,
+                                                      node))
+            findings.extend(self._check_jit_donation(src, midx, idx,
+                                                     node))
+        return findings
+
+    # -- shard_map ---------------------------------------------------------
+    def _resolve_callable(self, expr, src, idx, at):
+        """``(FuncInfo, n_bound_positional, bound_kwnames)`` for the
+        function expression handed to shard_map, or None."""
+        if isinstance(expr, ast.Call) and _is_partial_call(expr) \
+                and expr.args:
+            inner = self._resolve_callable(expr.args[0], src, idx, at)
+            if inner is None:
+                return None
+            info, npos, kw = inner
+            return (info, npos + len(expr.args) - 1,
+                    kw | {k.arg for k in expr.keywords if k.arg})
+        refs = idx.resolve_ref(expr, src, at)
+        if len(refs) != 1:
+            return None
+        info = next(iter(refs))
+        if isinstance(expr, ast.Name):
+            # a name bound to a partial: recover its bindings from the
+            # single assignment in an enclosing scope
+            midx = index_for(src)
+            for scope in enclosing_functions(at, midx.parents) \
+                    + [src.tree]:
+                assigns = [n for n in ast.walk(scope)
+                           if isinstance(n, ast.Assign)
+                           and any(isinstance(t, ast.Name)
+                                   and t.id == expr.id
+                                   for t in n.targets)]
+                if len(assigns) == 1 and isinstance(
+                        assigns[0].value, ast.Call) \
+                        and _is_partial_call(assigns[0].value):
+                    return self._resolve_callable(assigns[0].value, src,
+                                                  idx, at)
+                if assigns:
+                    break
+        return (info, 0, set())
+
+    def _unbound_params(self, resolved):
+        info, npos, kwnames = resolved
+        params = [p for p in func_params(info.node)
+                  if p not in ("self", "cls")]
+        a = info.node.args
+        vararg = a.vararg.arg if a.vararg else None
+        kwarg = a.kwarg.arg if a.kwarg else None
+        params = [p for p in params if p not in (vararg, kwarg)]
+        kwonly = {p.arg for p in a.kwonlyargs}
+        remaining = [p for p in params[npos:]
+                     if p not in kwnames and p not in kwonly]
+        return remaining
+
+    def _check_shard_map(self, src, midx, idx, node):
+        findings = []
+        kwargs = {k.arg: k.value for k in node.keywords if k.arg}
+        in_specs = kwargs.get("in_specs")
+        out_specs = kwargs.get("out_specs")
+        scopes = enclosing_functions(node, midx.parents) + [src.tree]
+        resolved = self._resolve_callable(node.args[0], src, idx, node)
+
+        spec_entries = None
+        if isinstance(in_specs, ast.Tuple):
+            spec_entries = in_specs.elts
+        elif in_specs is not None:
+            single = _spec_entry(in_specs, scopes)
+            spec_entries = [in_specs] if single is not None else None
+
+        # 1. arity vs the immediate invocation
+        parent = midx.parents.get(node)
+        invocation = parent if isinstance(parent, ast.Call) \
+            and parent.func is node else None
+        if spec_entries is not None and isinstance(in_specs, ast.Tuple) \
+                and invocation is not None \
+                and not any(isinstance(a, ast.Starred)
+                            for a in invocation.args) \
+                and not invocation.keywords:
+            if len(invocation.args) != len(spec_entries):
+                findings.append(self.find(
+                    src, node, "spec-arity",
+                    "shard_map in_specs has %d entr(ies) but the "
+                    "wrapped function is invoked with %d argument(s) — "
+                    "the spec-to-argument pairing is off by %d"
+                    % (len(spec_entries), len(invocation.args),
+                       abs(len(invocation.args) - len(spec_entries))),
+                    detail="in_specs"))
+
+        # 1b. arity vs the wrapped function's unbound parameters when
+        # the wrapper is not invoked in place (bound to a name instead)
+        if spec_entries is not None and isinstance(in_specs, ast.Tuple) \
+                and invocation is None and resolved is not None:
+            info = resolved[0]
+            a = info.node.args
+            if a.vararg is None and a.kwarg is None:
+                unbound = self._unbound_params(resolved)
+                required = [p for p in unbound
+                            if _param_default(info.node, p) is None]
+                n = len(spec_entries)
+                if n > len(unbound) or n < len(required):
+                    findings.append(self.find(
+                        src, node, "spec-arity",
+                        "shard_map in_specs has %d entr(ies) but %r "
+                        "takes %s unbound argument(s) — the "
+                        "spec-to-argument pairing cannot line up"
+                        % (n, info.qualname,
+                           len(required) if len(required) == len(unbound)
+                           else "%d-%d" % (len(required), len(unbound))),
+                        detail="in_specs"))
+
+        # 2. per-entry rank vs statically-known parameter rank
+        if spec_entries is not None and resolved is not None:
+            unbound = self._unbound_params(resolved)
+            info = resolved[0]
+            for i, entry in enumerate(spec_entries):
+                got = _spec_entry(entry, scopes)
+                if got is None or i >= len(unbound):
+                    continue
+                ndims, _names = got
+                rank = _param_rank(info.node, unbound[i])
+                if rank is not None and ndims > rank:
+                    findings.append(self.find(
+                        src, entry if hasattr(entry, "lineno") else node,
+                        "spec-rank",
+                        "in_specs[%d] has %d entries but %r (parameter "
+                        "%r of %s) is rank %d — the spec cannot apply "
+                        "and shard_map raises at trace time"
+                        % (i, ndims, unbound[i], unbound[i],
+                           info.qualname, rank),
+                        detail="%s[%d]" % (info.qualname, i)))
+
+        # 3. axis names vs a statically-known mesh
+        mesh_axes = self._mesh_axes(kwargs.get("mesh"), scopes)
+        if mesh_axes is not None:
+            for group, label in ((spec_entries or [], "in_specs"),
+                                 ([out_specs] if out_specs is not None
+                                  else [], "out_specs")):
+                for entry in group:
+                    entries = entry.elts if isinstance(
+                        entry, (ast.Tuple, ast.List)) else [entry]
+                    for e in entries:
+                        got = _spec_entry(e, scopes)
+                        if got is None:
+                            continue
+                        for name in got[1]:
+                            if name not in mesh_axes:
+                                findings.append(self.find(
+                                    src, node, "unknown-mesh-axis",
+                                    "%s names axis %r but the mesh "
+                                    "passed to this shard_map only has "
+                                    "axes %s"
+                                    % (label, name,
+                                       sorted(mesh_axes)),
+                                    detail=name))
+        return findings
+
+    def _mesh_axes(self, mesh_expr, scopes):
+        """Constant axis-name set when the mesh expression resolves to
+        a local ``Mesh(...)``/``make_mesh(...)`` construction."""
+        if mesh_expr is None:
+            return None
+        if isinstance(mesh_expr, ast.Name):
+            for scope in scopes:
+                assigns = [n for n in ast.walk(scope)
+                           if isinstance(n, ast.Assign)
+                           and any(isinstance(t, ast.Name)
+                                   and t.id == mesh_expr.id
+                                   for t in n.targets)]
+                if len(assigns) == 1:
+                    return self._mesh_axes(assigns[0].value, scopes)
+                return None
+        if isinstance(mesh_expr, ast.Call):
+            f = mesh_expr.func
+            fname = f.attr if isinstance(f, ast.Attribute) else (
+                f.id if isinstance(f, ast.Name) else "")
+            if fname not in ("Mesh", "make_mesh"):
+                return None
+            cand = None
+            if fname == "Mesh" and len(mesh_expr.args) > 1:
+                cand = mesh_expr.args[1]
+            for kw in mesh_expr.keywords:
+                if kw.arg == "axis_names":
+                    cand = kw.value
+            if isinstance(cand, (ast.Tuple, ast.List)) and all(
+                    isinstance(e, ast.Constant)
+                    and isinstance(e.value, str) for e in cand.elts):
+                return {e.value for e in cand.elts}
+            if isinstance(cand, ast.Constant) \
+                    and isinstance(cand.value, str):
+                return {cand.value}
+        return None
+
+    def _unique_binding(self, name, midx, at, src):
+        """True when ``name`` has exactly one def/assignment binding in
+        the innermost scope that binds it — conditional ``def f``
+        branches (the executor kind-dispatch idiom) make the reference
+        ambiguous and the pass stays silent."""
+        for scope in enclosing_functions(at, midx.parents) + [src.tree]:
+            nested = {n for fn in ast.walk(scope)
+                      if isinstance(fn, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef,
+                                         ast.Lambda)) and fn is not scope
+                      for n in ast.walk(fn) if n is not fn}
+            count = 0
+            for n in ast.walk(scope):
+                if n in nested:
+                    continue
+                if isinstance(n, (ast.FunctionDef,
+                                  ast.AsyncFunctionDef)) \
+                        and n is not scope and n.name == name:
+                    count += 1
+                elif isinstance(n, ast.Assign) and any(
+                        isinstance(t, ast.Name) and t.id == name
+                        for t in n.targets):
+                    count += 1
+            if count:
+                return count == 1
+        return True
+
+    # -- jit donation ------------------------------------------------------
+    def _check_jit_donation(self, src, midx, idx, node):
+        f = node.func
+        fname = f.attr if isinstance(f, ast.Attribute) else (
+            f.id if isinstance(f, ast.Name) else "")
+        if fname not in ("jit", "pjit") or not node.args:
+            return []
+        if isinstance(f, ast.Attribute) \
+                and root_name(f) not in ("jax", "jnp", "lax") \
+                and not (root_name(f) or "").startswith("_jax"):
+            return []
+        kwargs = {k.arg: k.value for k in node.keywords if k.arg}
+        donate = _int_consts(kwargs.get("donate_argnums")) \
+            if "donate_argnums" in kwargs else None
+        static = _int_consts(kwargs.get("static_argnums")) \
+            if "static_argnums" in kwargs else None
+        findings = []
+        if donate and static:
+            overlap = sorted(set(donate) & set(static))
+            if overlap:
+                findings.append(self.find(
+                    src, node, "donated-static",
+                    "argument index(es) %s appear in BOTH donate_argnums "
+                    "and static_argnums — a hashed static cannot be "
+                    "donated; the donation silently never happens"
+                    % overlap, detail=",".join(map(str, overlap))))
+        if donate:
+            refs = idx.resolve_ref(node.args[0], src, node)
+            if isinstance(node.args[0], ast.Name) \
+                    and not self._unique_binding(node.args[0].id, midx,
+                                                 node, src):
+                refs = set()  # conditional defs/aliases: ambiguous
+            if len(refs) == 1:
+                info = next(iter(refs))
+                a = info.node.args
+                if a.vararg is None and a.kwarg is None:
+                    nparams = len([p for p in func_params(info.node)
+                                   if p not in ("self", "cls")])
+                    bad = sorted(i for i in donate if i >= nparams)
+                    if bad:
+                        findings.append(self.find(
+                            src, node, "donate-range",
+                            "donate_argnums %s is past the last "
+                            "parameter of %r (%d parameter(s)) — the "
+                            "donation is a silent no-op"
+                            % (bad, info.qualname, nparams),
+                            detail=",".join(map(str, bad))))
+        return findings

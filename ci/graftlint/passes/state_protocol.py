@@ -1,0 +1,165 @@
+"""state-protocol pass — ``state_dict``/``load_state_dict`` symmetry.
+
+The PR 5 iterator-state protocol (``docs/resilience.md`` "exact
+resume") and PR 11's elastic reshard both round-trip the same contract:
+whatever ``state_dict()`` emits, ``load_state_dict()`` restores.  The
+failure modes are quiet: a key emitted but never consumed silently
+loses state on resume (the trajectory is no longer bit-identical — it
+just drifts); a key hard-read but never emitted raises ``KeyError`` on
+the first real restore, usually mid-incident.  Per class:
+
+* **half-protocol** — a class defines exactly one of the pair: the
+  other half raises ``AttributeError`` the first time fit/elastic tries
+  to round-trip it.
+* **missing-key** — ``load_state_dict`` reads ``state["k"]`` (the hard,
+  KeyError-raising form) for a key ``state_dict`` never emits.
+* **unconsumed-key** — ``state_dict`` emits a key ``load_state_dict``
+  never reads (neither ``state["k"]`` nor ``state.get("k")``): state
+  captured but silently dropped on restore.  ``"type"`` is exempt — it
+  is the protocol's dispatch tag, consumed by external dispatchers
+  (``ElasticFitRun._reshard_data``) and the type guard, not by the
+  restore itself.
+
+The protocol's tolerance rules are respected: ``state.get(...)`` with a
+default is the sanctioned missing-key form and counts as consumption;
+emission under a condition (``if ...: state["record"] = ...``) counts
+as emission.  A ``load_state_dict`` that forwards the whole ``state``
+object to another callable (delegation) skips the unconsumed-key check
+— the callee owns the contract."""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Pass
+from ..dataflow import func_params
+
+
+def _method(cls, name):
+    for n in cls.body:
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and n.name == name:
+            return n
+    return None
+
+
+def _only_raises(func):
+    body = [n for n in func.body
+            if not (isinstance(n, ast.Expr)
+                    and isinstance(n.value, ast.Constant))]
+    return all(isinstance(n, ast.Raise) for n in body) and body
+
+
+def _emitted_keys(func):
+    """Constant keys this ``state_dict`` emits: dict-literal keys plus
+    ``X["k"] = ...`` stores anywhere in the method."""
+    keys = set()
+    for node in ast.walk(func):
+        if isinstance(node, ast.Dict):
+            for k in node.keys:
+                if isinstance(k, ast.Constant) and isinstance(k.value,
+                                                              str):
+                    keys.add(k.value)
+        elif isinstance(node, ast.Subscript) \
+                and isinstance(node.ctx, ast.Store) \
+                and isinstance(node.slice, ast.Constant) \
+                and isinstance(node.slice.value, str):
+            keys.add(node.slice.value)
+    return keys
+
+
+def _consumed_keys(func):
+    """``(hard, soft, escapes)``: keys read via ``param["k"]`` (hard) /
+    ``param.get("k")``/``param.pop("k")`` (soft), and whether the state
+    param escapes whole (passed bare to a call, ``dict(state)``,
+    ``**state``, iterated)."""
+    params = [p for p in func_params(func) if p not in ("self", "cls")]
+    if not params:
+        return set(), set(), True
+    pname = params[0]
+    hard, soft = set(), set()
+    escapes = False
+
+    def is_param(expr):
+        return isinstance(expr, ast.Name) and expr.id == pname
+
+    for node in ast.walk(func):
+        if isinstance(node, ast.Subscript) and is_param(node.value) \
+                and isinstance(node.ctx, ast.Load):
+            if isinstance(node.slice, ast.Constant) \
+                    and isinstance(node.slice.value, str):
+                hard.add(node.slice.value)
+            else:
+                escapes = True  # dynamic key: consumption unknowable
+        elif isinstance(node, ast.Call):
+            f = node.func
+            if isinstance(f, ast.Attribute) and is_param(f.value):
+                if f.attr in ("get", "pop") and node.args \
+                        and isinstance(node.args[0], ast.Constant) \
+                        and isinstance(node.args[0].value, str):
+                    soft.add(node.args[0].value)
+                elif f.attr in ("items", "keys", "values", "update"):
+                    escapes = True
+            else:
+                if any(is_param(a) for a in node.args) \
+                        or any(k.arg is None and is_param(k.value)
+                               for k in node.keywords):
+                    escapes = True
+        elif isinstance(node, (ast.For, ast.comprehension)) \
+                and is_param(node.iter):
+            escapes = True
+    return hard, soft, escapes
+
+
+class StateProtocolPass(Pass):
+    id = "state-protocol"
+    title = "state_dict/load_state_dict pairs are symmetric"
+
+    def check_source(self, src, ctx):
+        findings = []
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.ClassDef):
+                findings.extend(self._check_class(src, node))
+        return findings
+
+    def _check_class(self, src, cls):
+        save = _method(cls, "state_dict")
+        load = _method(cls, "load_state_dict")
+        if save is None and load is None:
+            return []
+        findings = []
+        if save is None or load is None:
+            have, miss = ("state_dict", "load_state_dict") \
+                if load is None else ("load_state_dict", "state_dict")
+            present = save if load is None else load
+            findings.append(self.find(
+                src, present, "half-protocol",
+                "%s defines %s but not %s: the state protocol cannot "
+                "round-trip (resume/reshard will fail on the missing "
+                "half unless a base class provides it — suppress with "
+                "the inheriting class named if so)"
+                % (cls.name, have, miss), detail=cls.name))
+            return findings
+        if _only_raises(save) or _only_raises(load):
+            return findings  # the explicit not-implemented idiom
+        emitted = _emitted_keys(save)
+        hard, soft, escapes = _consumed_keys(load)
+        if emitted:
+            for key in sorted(hard - emitted):
+                findings.append(self.find(
+                    src, load, "missing-key",
+                    "%s.load_state_dict reads state[%r] (hard, "
+                    "KeyError-raising) but state_dict never emits that "
+                    "key — the first real restore dies (use "
+                    ".get(%r, default) if the key is optional)"
+                    % (cls.name, key, key), detail=key))
+        if emitted and not escapes:
+            for key in sorted(emitted - hard - soft - {"type"}):
+                findings.append(self.find(
+                    src, save, "unconsumed-key",
+                    "%s.state_dict emits %r but load_state_dict never "
+                    "reads it: that piece of state is captured and "
+                    "silently dropped on restore, so a resumed run is "
+                    "no longer bit-identical" % (cls.name, key),
+                    detail=key))
+        return findings

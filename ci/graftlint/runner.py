@@ -37,7 +37,12 @@ class PassResult:
 
 def run_pass(lint_pass, ctx, baseline=None):
     """Run one pass: collect sources (orchestrated passes take none),
-    apply the suppression grammar + legacy tags, then the baseline."""
+    apply the suppression grammar + legacy tags, then the baseline.
+
+    In a ``--changed`` run (``ctx.changed`` set), per-file passes only
+    analyze the changed sources; interprocedural passes analyze the
+    whole collected tree (their call graph needs the context) but
+    report only findings located in changed files."""
     if lint_pass.orchestrated:
         findings = lint_pass.run((), ctx)
         for f in findings:  # suppression comments have no file to live in
@@ -45,7 +50,12 @@ def run_pass(lint_pass, ctx, baseline=None):
         stale = {}
     else:
         sources = ctx.collect(lint_pass)
-        findings = lint_pass.run(sources, ctx)
+        analyzed = sources
+        if ctx.changed is not None and not lint_pass.interprocedural:
+            analyzed = [s for s in sources if s.rel in ctx.changed]
+        findings = lint_pass.run(analyzed, ctx)
+        if ctx.changed is not None:
+            findings = [f for f in findings if f.path in ctx.changed]
         by_rel = {s.rel: s for s in sources}
         apply_suppressions(findings, by_rel, lint_pass.legacy_tags)
         stale = {}
@@ -127,7 +137,8 @@ def run(passes, ctx=None, baseline_path=_baseline.DEFAULT_PATH,
             fh.write("\n")
 
     if emit_telemetry:
-        _export_telemetry(results, elapsed, echo)
+        _export_telemetry(results, elapsed, echo,
+                          changed=ctx.changed is not None)
 
     if failures:
         echo("graftlint: FAIL — %d unsuppressed, unbaselined finding(s) "
@@ -143,7 +154,7 @@ def run(passes, ctx=None, baseline_path=_baseline.DEFAULT_PATH,
     return 0
 
 
-def _export_telemetry(results, elapsed, echo):
+def _export_telemetry(results, elapsed, echo, changed=False):
     """Lint debt as telemetry gauges (``lint.findings{pass=,state=}`` +
     ``lint.run_seconds``) so PROGRESS/bench tooling can track it.  The
     registry lives in mxnet_tpu (jax import); failures to import must
@@ -174,7 +185,8 @@ def _export_telemetry(results, elapsed, echo):
                                "state": "suppressed"})
         telemetry.set_gauge("lint.findings", len(r.baselined),
                             **{"pass": r.lint_pass.id, "state": "baselined"})
-    telemetry.set_gauge("lint.run_seconds", round(elapsed, 3))
+    telemetry.set_gauge("lint.changed_run_seconds" if changed
+                        else "lint.run_seconds", round(elapsed, 3))
     dump_path = os.environ.get("MXNET_TELEMETRY_DUMP") \
         or "/tmp/graftlint-telemetry.json"
     try:

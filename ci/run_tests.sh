@@ -1,19 +1,39 @@
 #!/bin/sh
 # Local CI entry point (the reference's tests/travis/run_test.sh analog):
 # lint-lite -> native build -> unit suite -> multichip dryrun.
+#
+#   sh ci/run_tests.sh precommit   # fast lane: diff-scoped lint only
+#
 set -e
 cd "$(dirname "$0")/.."
+# pre-commit lane (docs/linting.md "The --changed lane"): lint ONLY the
+# *.py files that differ from PRECOMMIT_REV (default HEAD) — per-file
+# passes skip unchanged files, interprocedural passes keep whole-tree
+# call-graph context but report changed files only.  Budgeted <5s;
+# the run exports lint.changed_run_seconds through telemetry.
+if [ "${1:-}" = "precommit" ]; then
+  python -m ci.graftlint --changed "${PRECOMMIT_REV:-HEAD}" \
+    --emit-telemetry
+  exit 0
+fi
 python -m compileall -q mxnet_tpu tools example
 # unified static analysis (docs/linting.md): ONE invocation runs every
-# graftlint pass — the five migrated lints (bare-except, print,
-# env-docs, host-sync, signal-restore) plus the dataflow passes
-# (tracer-purity, recompile-hazard, donation, lock-discipline) — over
-# mxnet_tpu/, honoring the shared '# lint: ok[pass-id] <reason>'
-# suppression grammar and the per-pass baselines.  The JSON findings
-# report lands at /tmp/graftlint.json as a CI artifact, and per-pass
-# finding counts export through telemetry (lint.findings gauges) so
-# PROGRESS/bench tooling can track lint debt.
+# graftlint pass — the five migrated syntactic lints (bare-except,
+# print, env-docs, host-sync, signal-restore; their ci/check_*.py shims
+# were deleted after the deprecation cycle), the dataflow passes
+# (tracer-purity, recompile-hazard, donation, lock-discipline), and the
+# interprocedural SPMD/distributed-correctness passes
+# (collective-consistency, replica-divergence, spec-shape,
+# state-protocol) — over mxnet_tpu/, honoring the shared
+# '# lint: ok[pass-id] <reason>' suppression grammar and the per-pass
+# baselines.  The JSON findings report lands at /tmp/graftlint.json as
+# a CI artifact, and per-pass finding counts export through telemetry
+# (lint.findings gauges) so PROGRESS/bench tooling can track lint debt.
 python -m ci.graftlint --json /tmp/graftlint.json --emit-telemetry
+# baseline-debt guard: the ledger must be empty at HEAD unless every
+# entry carries a documented waiver (mirrors the bench-gate waiver
+# workflow) — baseline debt cannot silently accrete.
+python ci/check_lint_baseline.py
 if command -v g++ > /dev/null; then
   g++ -O2 -shared -fPIC -std=c++17 -o libmxnet_tpu_native.so \
       src/native.cc -lpthread

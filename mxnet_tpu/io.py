@@ -995,6 +995,13 @@ class ElasticShardIter(DataIter):
             self.data_epoch = int(state["data_epoch"])
             self.membership_epoch = int(state["membership_epoch"])
             self.ranks = sorted(state["ranks"])
+            # restore the captured rank too (found by the state-protocol
+            # lint pass: the key was emitted but silently dropped) — a
+            # same-rank resume is a no-op, but restoring a capture onto
+            # a differently-constructed iterator must land on the SAME
+            # shard assignment the capture described, or _recompute()
+            # below walks another rank's records
+            self.rank = int(state.get("rank", self.rank))
             self.base = set(int(i) for i in state["base"])
             self._pos = int(state["pos"])
             self._recompute()

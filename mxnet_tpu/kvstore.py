@@ -28,6 +28,7 @@ from __future__ import annotations
 import os
 import pickle
 import time as _time
+import zlib as _zlib
 
 from . import elastic as _elastic
 from . import faults as _faults
@@ -463,11 +464,17 @@ class KVStoreDist(KVStore):
         raise ConnectionLost(why)
 
     def _server_of(self, key):
-        """Small keys live whole on one server (round-robin by key)."""
+        """Small keys live whole on one server (round-robin by key).
+        String keys route by crc32, NOT builtin ``hash()``: with
+        per-process ``PYTHONHASHSEED``, ``hash(str)`` differs across
+        worker processes, so two workers would push the same key to
+        DIFFERENT servers and the merge round would never complete
+        (found by the replica-divergence lint pass)."""
         try:
             return int(key) % self._num_servers
         except (TypeError, ValueError):
-            return hash(str(key)) % self._num_servers
+            return _zlib.crc32(str(key).encode("utf-8")) \
+                % self._num_servers
 
     def _shards(self, key, size):
         """[(subkey, server, slice)] — arrays over the bigarray bound

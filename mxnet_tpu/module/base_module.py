@@ -61,8 +61,8 @@ def _as_metric(m):
 
 #: process-wide owner of the SIGTERM/SIGINT handlers: exactly ONE fit
 #: call may hold them — a nested fit (e.g. from a callback) refusing to
-#: double-install is the hygiene contract ci/check_signal_restore.py
-#: lints the restore half of
+#: double-install is the hygiene contract the graftlint signal-restore
+#: pass lints the restore half of
 _fit_signal_lock = threading.Lock()
 _fit_signal_owner = [None]
 
@@ -91,7 +91,7 @@ class _PreemptGuard:
 def _preempt_signals(guard, logger, enable=True):
     """Install ``guard`` as the SIGTERM/SIGINT handler for the scope,
     restoring the previous handlers on ANY exit path (the try/finally
-    is what ``ci/check_signal_restore.py`` enforces).  ``enable=False``
+    is what the graftlint signal-restore pass enforces).  ``enable=False``
     (fit without ``checkpoint_prefix``) leaves the process handlers
     untouched — a plain fit keeps its KeyboardInterrupt semantics.
     Outside the main thread Python forbids handler installation; fit

@@ -571,7 +571,7 @@ class ServingHTTPServer:
         """Serve until SIGTERM/SIGINT, then drain gracefully — the
         blocking entry point a container deployment calls.  Handlers are
         installed for the scope and restored on every exit path
-        (``ci/check_signal_restore.py`` lints this shape)."""
+        (the graftlint signal-restore pass lints this shape)."""
         if threading.current_thread() is not threading.main_thread():
             raise MXNetError("run_forever installs signal handlers and "
                              "must run on the main thread")

@@ -1,8 +1,8 @@
 """Sync-free ``Module.fit`` suite (docs/how_to/perf.md): device-resident
 metrics (exact-value parity with the host path), the fused in-graph NaN
 guard (all three policies, fused and two-phase, amortized cadence),
-device-side prefetch (numerical identity), and the ``ci/check_host_sync``
-lint that keeps the hot path honest."""
+device-side prefetch (numerical identity), and the graftlint
+``host-sync`` pass that keeps the hot path honest."""
 
 import os
 import subprocess
@@ -369,12 +369,13 @@ def test_device_prefetch_iter_places_batches():
     assert not any(t.is_alive() for t in it.prefetch_threads)
 
 
-# -- ci/check_host_sync lint ------------------------------------------------
+# -- host-sync lint (graftlint; the check_host_sync.py shim is gone) --------
 
 def _run_host_sync(*args):
     return subprocess.run(
-        [sys.executable, os.path.join(ROOT, "ci", "check_host_sync.py"),
-         *args], capture_output=True, text=True)
+        [sys.executable, "-m", "ci.graftlint", "--pass", "host-sync",
+         *[str(a) for a in args]],
+        capture_output=True, text=True, cwd=ROOT)
 
 
 def test_check_host_sync_hot_path_is_clean():

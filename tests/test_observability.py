@@ -1,7 +1,7 @@
 """The pre-existing observability trio — profiler spans/dump, Monitor
 pattern matching, log.get_logger formatting — plus the hardened
 ``profiler_set_state`` trace_dir semantics, the ProgressBar/Speedometer
-fixes, and the ci/check_print lint."""
+fixes, and the graftlint ``print``/``env-docs`` passes."""
 
 import json
 import logging
@@ -229,12 +229,12 @@ def test_speedometer_logs_smoothed_rate(caplog):
     assert "smoothed" in caplog.text
 
 
-# -- ci/check_print ----------------------------------------------------------
+# -- print lint (graftlint; the check_print.py shim is gone) -----------------
 
 def _run_check_print(path):
     return subprocess.run(
-        [sys.executable, os.path.join(ROOT, "ci", "check_print.py"),
-         str(path)], capture_output=True, text=True)
+        [sys.executable, "-m", "ci.graftlint", "--pass", "print",
+         str(path)], capture_output=True, text=True, cwd=ROOT)
 
 
 def test_check_print_flags_bare_print(tmp_path):
@@ -254,17 +254,18 @@ def test_check_print_honors_noqa_and_strings(tmp_path):
 
 def test_check_print_clean_on_framework_tree():
     proc = subprocess.run(
-        [sys.executable, os.path.join(ROOT, "ci", "check_print.py")],
-        capture_output=True, text=True)
+        [sys.executable, "-m", "ci.graftlint", "--pass", "print"],
+        capture_output=True, text=True, cwd=ROOT)
     assert proc.returncode == 0, proc.stdout
 
 
-# -- ci/check_env_docs --------------------------------------------------------
+# -- env-docs lint (graftlint; the check_env_docs.py shim is gone) -----------
 
 def _run_check_env_docs(*paths):
     return subprocess.run(
-        [sys.executable, os.path.join(ROOT, "ci", "check_env_docs.py")]
-        + [str(p) for p in paths], capture_output=True, text=True)
+        [sys.executable, "-m", "ci.graftlint", "--pass", "env-docs"]
+        + [str(p) for p in paths], capture_output=True, text=True,
+        cwd=ROOT)
 
 
 def test_check_env_docs_flags_undocumented_var(tmp_path):

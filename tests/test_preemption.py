@@ -413,14 +413,15 @@ def test_signal_restore_lint(tmp_path):
     import sys
 
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    lint = os.path.join(root, "ci", "check_signal_restore.py")
-    assert subprocess.run([sys.executable, lint]).returncode == 0
+    lint = [sys.executable, "-m", "ci.graftlint", "--pass",
+            "signal-restore"]
+    assert subprocess.run(lint, cwd=root).returncode == 0
     bad = tmp_path / "bad.py"
     bad.write_text("import signal\n"
                    "def f():\n"
                    "    signal.signal(signal.SIGTERM, None)\n")
-    proc = subprocess.run([sys.executable, lint, str(bad)],
-                          capture_output=True, text=True)
+    proc = subprocess.run(lint + [str(bad)], capture_output=True,
+                          text=True, cwd=root)
     assert proc.returncode == 1
     assert "without a matching restore" in proc.stdout
 
