@@ -438,6 +438,65 @@ def fit_score(network="resnet", num_layers=50, batch=32,
     row("fit_vs_bulk_%s_b%d" % (tag, batch), ratio, "ratio")
 
 
+def mesh_score(batch=256, nbatches=30, in_dim=512, hidden=1024,
+               classes=64):
+    """``fit(kvstore='mesh')`` rows (docs/how_to/multi_devices.md
+    "Sharded fit"): imgs/sec on the full device mesh, per-device
+    optimizer-state HBM bytes (the ZeRO attribution — sharded vs the
+    replicated total), and step-time vs an explicit 1-device mesh of
+    the same model.  MLP geometry with dims divisible by 8 so every
+    weight is ZeRO-eligible; synthetic host data through NDArrayIter so
+    the sharded H2D path (DevicePrefetchIter placing with the mesh
+    sharding) is real."""
+    os.environ.setdefault("MXNET_FUSE_TRAIN_STEP", "1")
+    from mxnet_tpu.kvstore_mesh import (KVStoreMesh, optimizer_state_hbm)
+    from mxnet_tpu.parallel.mesh import make_mesh
+
+    import jax
+
+    world = len(jax.devices())
+    rs = np.random.RandomState(0)
+    x = rs.rand(nbatches * batch, in_dim).astype(np.float32)
+    y = rs.randint(0, classes, nbatches * batch).astype(np.float32)
+
+    def net():
+        data = mx.sym.Variable("data")
+        h = mx.sym.FullyConnected(data, num_hidden=hidden, name="fc1")
+        h = mx.sym.Activation(h, act_type="relu")
+        h = mx.sym.FullyConnected(h, num_hidden=hidden, name="fc2")
+        h = mx.sym.Activation(h, act_type="relu")
+        return mx.sym.SoftmaxOutput(
+            mx.sym.FullyConnected(h, num_hidden=classes, name="fc3"),
+            name="softmax")
+
+    def one(kv):
+        it = mx.io.NDArrayIter(x, y, batch_size=batch,
+                               last_batch_handle="discard")
+        mod = mx.mod.Module(net(), context=mx.cpu())
+        kw = dict(num_epoch=1, kvstore=kv, optimizer="sgd",
+                  optimizer_params={"learning_rate": 0.05,
+                                    "momentum": 0.9},
+                  eval_metric="acc", prefetch_to_device=True)
+        mod.fit(it, **kw)            # epoch 0: trace + compile
+        it.reset()
+        t0 = time.time()
+        mod.fit(it, **kw)
+        _sync_param(mod)
+        return mod, nbatches * batch / (time.time() - t0)
+
+    mesh_mod, mesh_ips = one("mesh")
+    per_dev, total = optimizer_state_hbm(mesh_mod)
+    kv1 = KVStoreMesh(mesh=make_mesh(n_devices=1, axis_names=("data",)))
+    _one_mod, one_ips = one(kv1)
+    row("mesh_fit_b%d_w%d" % (batch, world), mesh_ips, "images/sec",
+        single_device_ips=round(one_ips, 2),
+        step_time_vs_single=round(one_ips / max(mesh_ips, 1e-9), 3),
+        opt_state_bytes_per_device=per_dev,
+        opt_state_bytes_total=total)
+    row("mesh_opt_state_shard_factor_b%d_w%d" % (batch, world),
+        total / max(per_dev, 1), "ratio", world=world)
+
+
 def ckpt_score(batch=4096, nbatches=40, in_dim=256, hidden=512,
                every_n=10, reps=3):
     """Checkpointing-overhead row: steps/sec with batch-granular
@@ -1046,7 +1105,7 @@ def main():
         _compile_probe(sys.argv[2])
         return
     which = set((sys.argv[1].split(",") if len(sys.argv) > 1 else
-                 ["infer", "train", "fit", "lstm", "ssd", "io",
+                 ["infer", "train", "fit", "mesh", "lstm", "ssd", "io",
                   "serving", "decode", "failover", "ckpt", "compile"]))
     if "io" in which:
         io_score()
@@ -1070,6 +1129,8 @@ def main():
             train_score("resnet", 45.5, num_layers=50)
     if "fit" in which:
         fit_score()
+    if "mesh" in which:
+        mesh_score()
     if "lstm" in which:
         lstm_score()
         lstm_batch_scaling()

@@ -64,4 +64,20 @@ while [ "$i" -lt "$runs" ]; do
         or fault_point or graceful_leave"
   i=$((i + 1))
 done
+# sharded-snapshot half (docs/how_to/multi_devices.md "Sharded fit"):
+# kill an 8-virtual-device fit(kvstore='mesh') mid-epoch while its
+# snapshot generations are per-shard payload files — resume must
+# restitch bit-identically, a corrupted shard must fall back one
+# generation, and a resume onto a SMALLER mesh must reassemble from
+# the stitching manifest.  The seed rotates the dataset, the init and
+# the kill batch so kills land at different shard-write states.
+i=0
+while [ "$i" -lt "$runs" ]; do
+  echo "== mesh sharded-snapshot chaos run $((i + 1))/$runs (MXNET_CHAOS_SEED=$i) =="
+  JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    MXNET_CHAOS_SEED="$i" \
+    python -m pytest tests/test_mesh_kvstore.py -q -p no:cacheprovider \
+    -k "kill_resume or different_mesh or corrupt_shard"
+  i=$((i + 1))
+done
 echo "CHAOS OK ($runs runs)"

@@ -75,6 +75,14 @@ if [ "${f64_skips:-0}" -ne 4 ]; then
   exit 1
 fi
 python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+# 8-virtual-device mesh smoke (docs/how_to/multi_devices.md "Sharded
+# fit"): fit(kvstore='mesh') trains with the in-graph gradient plane +
+# ZeRO-sharded updates, is killed mid-epoch, and resumes bit-identically
+# from its sharded snapshots — the kvstore='mesh' acceptance, explicit
+# even though the full suite above also runs it.
+JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+  python -m pytest tests/test_mesh_kvstore.py -q -p no:cacheprovider \
+  -k "zero_per_step or shards_optimizer_state or kill_resume"
 # compile-once effectiveness: a small fit+predict runs twice against a
 # temp persistent compile cache; the second run must perform ZERO XLA
 # compilations (every executable loads from the cache) — unstable cache

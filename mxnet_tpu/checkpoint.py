@@ -91,11 +91,11 @@ class Snapshot:
 
     __slots__ = ("epoch", "nbatch", "arg_params", "aux_params",
                  "opt_states", "opt_counts", "rng_state", "metric_state",
-                 "iter_state")
+                 "iter_state", "mesh_info")
 
     def __init__(self, epoch, nbatch, arg_params, aux_params,
                  opt_states=None, opt_counts=None, rng_state=None,
-                 metric_state=None, iter_state=None):
+                 metric_state=None, iter_state=None, mesh_info=None):
         self.epoch = int(epoch)
         self.nbatch = int(nbatch)
         self.arg_params = arg_params
@@ -105,6 +105,12 @@ class Snapshot:
         self.rng_state = rng_state
         self.metric_state = metric_state
         self.iter_state = iter_state
+        #: sharding descriptor from ``Module._snapshot_mesh_info`` (None
+        #: = single payload file): ``{"num_shards": W, "axis": ...,
+        #: "mesh_axes": [...], "mesh_shape": [...]}`` — the generation
+        #: is then written as W per-shard payload files stitched by the
+        #: manifest (docs/how_to/multi_devices.md "Sharded snapshots")
+        self.mesh_info = mesh_info
 
 
 class TrainingState:
@@ -158,31 +164,38 @@ def write_snapshot(prefix, snap, logger=logging, keep_last=None):
     from . import model as _model
 
     t0 = time.perf_counter()
-    params_path = snapshot_path(prefix, snap.epoch, snap.nbatch, "params")
-    save_dict = {("arg:%s" % k): v for k, v in snap.arg_params.items()}
-    save_dict.update({("aux:%s" % k): v
-                      for k, v in snap.aux_params.items()})
-    # durable=False: snapshot writes stay atomic against PROCESS death
-    # (the preemption threat model) but skip the fsync stalls; the
-    # fully-durable epoch checkpoint bounds power-loss exposure
-    atomic_write(params_path, lambda tmp: nd.save(tmp, save_dict),
-                 fault_point="checkpoint.write", durable=False)
-    entry = {
-        "epoch": snap.epoch, "nbatch": snap.nbatch,
-        "params": os.path.basename(params_path),
-        "sha256": _model._sha256_file(params_path),
-        "states": None, "states_sha256": None,
+    mesh_info = getattr(snap, "mesh_info", None)
+    if mesh_info:
+        params_path, entry = _write_sharded_payloads(prefix, snap,
+                                                     mesh_info)
+    else:
+        params_path = snapshot_path(prefix, snap.epoch, snap.nbatch,
+                                    "params")
+        save_dict = _snapshot_save_dict(snap)
+        # durable=False: snapshot writes stay atomic against PROCESS death
+        # (the preemption threat model) but skip the fsync stalls; the
+        # fully-durable epoch checkpoint bounds power-loss exposure
+        atomic_write(params_path, lambda tmp: nd.save(tmp, save_dict),
+                     fault_point="checkpoint.write", durable=False)
+        entry = {
+            "epoch": snap.epoch, "nbatch": snap.nbatch,
+            "params": os.path.basename(params_path),
+            "sha256": _model._sha256_file(params_path),
+            "states": None, "states_sha256": None,
+        }
+        if snap.opt_states is not None:
+            states_path = snapshot_path(prefix, snap.epoch, snap.nbatch,
+                                        "states")
+            states_blob = pickle.dumps(snap.opt_states)
+            atomic_write_bytes(states_path, states_blob, durable=False)
+            entry["states"] = os.path.basename(states_path)
+            # hash the in-memory blob — no second read of the file
+            entry["states_sha256"] = \
+                hashlib.sha256(states_blob).hexdigest()
+    entry.update({
         "opt_counts": snap.opt_counts, "rng_state": snap.rng_state,
         "metric_state": snap.metric_state, "iter_state": snap.iter_state,
-    }
-    if snap.opt_states is not None:
-        states_path = snapshot_path(prefix, snap.epoch, snap.nbatch,
-                                    "states")
-        states_blob = pickle.dumps(snap.opt_states)
-        atomic_write_bytes(states_path, states_blob, durable=False)
-        entry["states"] = os.path.basename(states_path)
-        # hash the in-memory blob — no second read of the file
-        entry["states_sha256"] = hashlib.sha256(states_blob).hexdigest()
+    })
     if snap.iter_state is not None:
         iter_blob = json.dumps(snap.iter_state).encode()
         if len(iter_blob) > ITER_STATE_INLINE_BYTES:
@@ -216,6 +229,88 @@ def write_snapshot(prefix, snap, logger=logging, keep_last=None):
     return params_path
 
 
+def _snapshot_save_dict(snap):
+    """The on-disk key scheme of a snapshot's arrays (``arg:<name>`` /
+    ``aux:<name>``) — one definition for both the single-file and the
+    per-shard writers, mirrored by the split in the load paths."""
+    save_dict = {("arg:%s" % k): v for k, v in snap.arg_params.items()}
+    save_dict.update({("aux:%s" % k): v
+                      for k, v in snap.aux_params.items()})
+    return save_dict
+
+
+def _write_sharded_payloads(prefix, snap, mesh_info):
+    """Sharded snapshot write (``kvstore='mesh'``, world > 1): every
+    array/state KEY is assigned to one of ``num_shards`` payload files
+    by :func:`mxnet_tpu.elastic.assign_keys` — the same pure ownership
+    math the elastic reshard uses — and each shard file is written
+    atomically on its own.  The returned manifest ``entry`` carries the
+    mesh shape plus each shard's filename + sha256 (the *stitching
+    manifest*); committing it LAST means a kill mid-sharded-write
+    leaves the previous generation fully loadable.  Resume reads every
+    shard named by the manifest and stitches, so a restart onto a
+    DIFFERENT mesh shape reassembles the identical state and simply
+    re-derives ownership with the new world size for its own writes.
+    Returns ``(shard0_path, entry)``."""
+    from . import ndarray as nd
+    from . import model as _model
+    from .elastic import assign_keys
+
+    num_shards = int(mesh_info["num_shards"])
+    save_dict = _snapshot_save_dict(snap)
+    owner = assign_keys(list(save_dict), list(range(num_shards)), 0)
+    state_owner = {}
+    if snap.opt_states is not None:
+        state_owner = assign_keys(list(snap.opt_states),
+                                  list(range(num_shards)), 0)
+    shards = []
+    first_path = None
+    for s in range(num_shards):
+        part = {k: v for k, v in save_dict.items() if owner[k] == s}
+        path = snapshot_path(prefix, snap.epoch, snap.nbatch,
+                             "shard%d.params" % s)
+        if first_path is None:
+            first_path = path
+        atomic_write(path, lambda tmp, part=part: nd.save(tmp, part),
+                     fault_point="checkpoint.write", durable=False)
+        ent = {"params": os.path.basename(path),
+               "sha256": _model._sha256_file(path),
+               "states": None, "states_sha256": None}
+        if snap.opt_states is not None:
+            spart = {i: st for i, st in snap.opt_states.items()
+                     if state_owner[i] == s}
+            blob = pickle.dumps(spart)
+            spath = snapshot_path(prefix, snap.epoch, snap.nbatch,
+                                  "shard%d.states" % s)
+            atomic_write_bytes(spath, blob, durable=False)
+            ent["states"] = os.path.basename(spath)
+            ent["states_sha256"] = hashlib.sha256(blob).hexdigest()
+        shards.append(ent)
+    entry = {
+        "epoch": snap.epoch, "nbatch": snap.nbatch,
+        "params": None, "sha256": None,
+        "states": None, "states_sha256": None,
+        "sharded": {"num_shards": num_shards,
+                    "axis": mesh_info.get("axis"),
+                    "mesh_axes": mesh_info.get("mesh_axes"),
+                    "mesh_shape": mesh_info.get("mesh_shape"),
+                    "shards": shards},
+    }
+    return first_path, entry
+
+
+def _entry_payload_names(entry):
+    """Every on-disk payload filename one manifest snapshot entry names
+    (single-file generations AND per-shard files of a sharded one) —
+    the unit the GC / rollback-discard passes unlink."""
+    names = [entry.get(k) for k in _PAYLOAD_KEYS if entry.get(k)]
+    for ent in (entry.get("sharded") or {}).get("shards", []):
+        for k in ("params", "states"):
+            if ent.get(k):
+                names.append(ent[k])
+    return names
+
+
 def gc_snapshots(prefix, keep_last=None, logger=logging):
     """Prune snapshot generations beyond ``keep_last`` (newest kept).
 
@@ -239,18 +334,14 @@ def gc_snapshots(prefix, keep_last=None, logger=logging):
     base_dir = os.path.dirname(os.path.abspath(prefix)) or "."
     victims = []
     for entry in pruned:
-        for key in _PAYLOAD_KEYS:
-            name = entry.get(key)
-            if name:
-                victims.append(os.path.join(base_dir, name))
+        for name in _entry_payload_names(entry):
+            victims.append(os.path.join(base_dir, name))
     # orphan sweep: -snap- payloads on disk but absent from the manifest
     # (a previous crash between manifest write and unlink)
     live = set()
     m = _model.checkpoint_manifest(prefix)
     for entry in (m or {}).get("snapshots", []):
-        for key in _PAYLOAD_KEYS:
-            if entry.get(key):
-                live.add(entry[key])
+        live.update(_entry_payload_names(entry))
     snap_marker = "%s-snap-" % os.path.basename(prefix)
     try:
         for name in os.listdir(base_dir):
@@ -305,8 +396,8 @@ def discard_snapshots_from(prefix, epoch, logger=logging):
 
     _model._manifest_mutate(prefix, _drop, durable=False)
     base_dir = os.path.dirname(os.path.abspath(prefix)) or "."
-    victims = [os.path.join(base_dir, s[key])
-               for s in doomed for key in _PAYLOAD_KEYS if s.get(key)]
+    victims = [os.path.join(base_dir, name)
+               for s in doomed for name in _entry_payload_names(s)]
     logger.info("rollback: discarded %d post-rollback snapshot "
                 "generation(s) under %r", len(doomed), prefix)
     return _unlink_victims(victims, prefix, logger)
@@ -387,20 +478,32 @@ def load_latest_state(prefix, logger=logging, want=None):
                 states_path=states if os.path.exists(states) else None,
                 path=params)
         entry = payload
-        params = os.path.join(base_dir, entry["params"])
-        if not _verified(params, entry.get("sha256"), logger,
-                         "snapshot payload"):
-            _telemetry.inc("resilience.checkpoint.corrupt_skipped")
-            continue
+        arg = aux = None
         states_bytes = None
-        if entry.get("states"):
-            states = os.path.join(base_dir, entry["states"])
-            if not _verified(states, entry.get("states_sha256"), logger,
-                             "snapshot optimizer states"):
+        params = None
+        if entry.get("sharded"):
+            # stitched generation: every shard file the manifest names
+            # must verify + load; any failure skips the whole generation
+            loaded = _load_sharded_payloads(base_dir, entry, logger)
+            if loaded is None:
                 _telemetry.inc("resilience.checkpoint.corrupt_skipped")
                 continue
-            with open(states, "rb") as f:
-                states_bytes = f.read()
+            arg, aux, states_bytes, params = loaded
+        else:
+            params = os.path.join(base_dir, entry["params"])
+            if not _verified(params, entry.get("sha256"), logger,
+                             "snapshot payload"):
+                _telemetry.inc("resilience.checkpoint.corrupt_skipped")
+                continue
+            if entry.get("states"):
+                states = os.path.join(base_dir, entry["states"])
+                if not _verified(states, entry.get("states_sha256"),
+                                 logger, "snapshot optimizer states"):
+                    _telemetry.inc(
+                        "resilience.checkpoint.corrupt_skipped")
+                    continue
+                with open(states, "rb") as f:
+                    states_bytes = f.read()
         iter_state = entry.get("iter_state")
         if entry.get("iter_state_file"):
             # big iterator state lives in a sidecar (see write_snapshot)
@@ -417,20 +520,21 @@ def load_latest_state(prefix, logger=logging, want=None):
                                "parse (%s); falling back", iter_path, e)
                 _telemetry.inc("resilience.checkpoint.corrupt_skipped")
                 continue
-        try:
-            save_dict = nd.load(params)
-        except (MXNetError, OSError, ValueError) as e:
-            logger.warning("snapshot %s failed load verification (%s); "
-                           "falling back", params, e)
-            _telemetry.inc("resilience.checkpoint.corrupt_skipped")
-            continue
-        arg, aux = {}, {}
-        for k, v in save_dict.items():
-            tp, name = k.split(":", 1)
-            if tp == "arg":
-                arg[name] = v
-            elif tp == "aux":
-                aux[name] = v
+        if arg is None:
+            try:
+                save_dict = nd.load(params)
+            except (MXNetError, OSError, ValueError) as e:
+                logger.warning("snapshot %s failed load verification "
+                               "(%s); falling back", params, e)
+                _telemetry.inc("resilience.checkpoint.corrupt_skipped")
+                continue
+            arg, aux = {}, {}
+            for k, v in save_dict.items():
+                tp, name = k.split(":", 1)
+                if tp == "arg":
+                    arg[name] = v
+                elif tp == "aux":
+                    aux[name] = v
         return TrainingState(
             epoch=int(entry["epoch"]), nbatch=int(entry["nbatch"]),
             arg_params=arg, aux_params=aux, states_bytes=states_bytes,
@@ -439,6 +543,60 @@ def load_latest_state(prefix, logger=logging, want=None):
             iter_state=iter_state,
             opt_counts=entry.get("opt_counts"), path=params)
     return None
+
+
+def _load_sharded_payloads(base_dir, entry, logger):
+    """Verify + stitch one sharded snapshot generation: every shard file
+    named by the manifest loads (sha256-verified first), the per-shard
+    key subsets union back into the full ``arg``/``aux`` dicts and one
+    merged optimizer-state tree.  The stitch is shard-count agnostic —
+    it reads whatever the manifest recorded, so a resume onto a
+    DIFFERENT mesh shape reassembles the identical state (the new run's
+    own snapshots then re-derive key ownership for its world size via
+    ``elastic.assign_keys``).  Returns ``(arg, aux, states_bytes,
+    first_params_path)`` or None when any shard fails verification."""
+    from . import ndarray as nd
+
+    info = entry["sharded"]
+    arg, aux = {}, {}
+    states = {}
+    have_states = False
+    first_path = None
+    for ent in info.get("shards", []):
+        path = os.path.join(base_dir, ent["params"])
+        if first_path is None:
+            first_path = path
+        if not _verified(path, ent.get("sha256"), logger,
+                         "sharded snapshot payload"):
+            return None
+        try:
+            save_dict = nd.load(path)
+        except (MXNetError, OSError, ValueError) as e:
+            logger.warning("sharded snapshot %s failed load verification "
+                           "(%s); falling back", path, e)
+            return None
+        for k, v in save_dict.items():
+            tp, name = k.split(":", 1)
+            if tp == "arg":
+                arg[name] = v
+            elif tp == "aux":
+                aux[name] = v
+        if ent.get("states"):
+            spath = os.path.join(base_dir, ent["states"])
+            if not _verified(spath, ent.get("states_sha256"), logger,
+                             "sharded snapshot optimizer states"):
+                return None
+            try:
+                with open(spath, "rb") as f:
+                    states.update(pickle.loads(f.read()))
+                have_states = True
+            except Exception as e:  # noqa: broad-except — a torn/
+                # foreign pickle must fall back, never abort resume
+                logger.warning("sharded snapshot states %s failed to "
+                               "unpickle (%s); falling back", spath, e)
+                return None
+    states_bytes = pickle.dumps(states) if have_states else None
+    return arg, aux, states_bytes, first_path
 
 
 class AsyncSnapshotWriter:

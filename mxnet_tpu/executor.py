@@ -430,6 +430,7 @@ class Executor:
         self._rng_cache = None
         self._seg_chain = None
         self._global_mesh = None  # set by Module in multi-process mode
+        self._spmd_mesh = None    # set by Module for single-process meshes
         # in-graph NaN guard (Module._install_nan_guard): train kinds fold
         # a logical-or reduction over outputs+grads into the step and
         # accumulate it here as a device scalar — read via
@@ -625,6 +626,81 @@ class Executor:
                             jnp.logical_or(nan_acc, flag), flag)
             else:
                 f = _step_core
+
+            fn = jax.jit(f, donate_argnums=(0, 4))
+        elif isinstance(kind, tuple) and kind[0] == "train_sgd_mesh":
+            # the ZeRO variant of train_sgd (kvstore='mesh', PAPERS.md
+            # "Automatic Cross-Replica Sharding of Weight Update"):
+            # eligible params' updates shard over the mesh batch axis —
+            # the batch-summed gradient is consumed row-sharded (GSPMD
+            # lowers the would-be all-reduce to a reduce-scatter), each
+            # device updates only its momentum/param rows, and the new
+            # rows all-gather back into the replicated parameter.  Full
+            # gradients are never materialized, so this kind returns no
+            # grad_list (grad_dict goes stale, like the scan kind).
+            (_, upd_names_t, zero_names_t, momentum, rescale, clip,
+             guard, axis) = kind
+            from .kvstore_mesh import mesh_param_step
+
+            mesh = self._spmd_mesh
+            if mesh is None:
+                raise MXNetError(
+                    "train_sgd_mesh requires a mesh-bound executor")
+            upd_names = list(upd_names_t)
+            zero_set = frozenset(zero_names_t)
+            other_names = [n for n in arg_names if n not in upd_names_t]
+            # the per-param dispatch + layout pinning is the SHARED
+            # helper, so this kind and Module's two-dispatch fused
+            # update can never diverge numerically
+            mstep = mesh_param_step(mesh, momentum, rescale, clip,
+                                    zero_names_t, guard=guard,
+                                    axis_name=axis)
+
+            def _mesh_core(upd_vals, other_vals, aux, rng, moms, lrs,
+                           wds):
+                amap = dict(zip(upd_names, upd_vals))
+                amap.update(zip(other_names, other_vals))
+                args = [amap[n] for n in arg_names]
+                outs, new_aux_list, vjp_fn = _vjp_parts(args, aux, rng)
+                (grads,) = vjp_fn(tuple(jnp.ones_like(o) for o in outs))
+                new_p, new_m, zflags, plain_grads = [], [], [], []
+                for i, n in enumerate(upd_names):
+                    m_in = moms[i] if momentum != 0.0 else None
+                    p, m, zf = mstep(n, amap[n], grads[n], m_in, lrs[i],
+                                     wds[i])
+                    if zf is not None:
+                        zflags.append(zf)
+                    elif n not in zero_set:
+                        plain_grads.append(grads[n])
+                    new_p.append(p)
+                    if m is not None:
+                        new_m.append(m)
+                return list(outs), new_aux_list, new_p, new_m, zflags, \
+                    plain_grads
+
+            if guard:
+                def f(upd_vals, other_vals, aux, rng, moms, lrs, wds,
+                      nan_acc):
+                    (outs, new_aux_list, new_p, new_m, zflags,
+                     plain_grads) = _mesh_core(upd_vals, other_vals, aux,
+                                               rng, moms, lrs, wds)
+                    # unsharded residue checks its full grads; the ZeRO
+                    # params' flags were psum'd from the scattered rows
+                    flag = _nonfinite_expr(outs + plain_grads)
+                    for zf in zflags:
+                        flag = jnp.logical_or(flag, zf)
+                    new_p = [jnp.where(flag, p0, p1)
+                             for p0, p1 in zip(upd_vals, new_p)]
+                    new_m = [jnp.where(flag, m0, m1)
+                             for m0, m1 in zip(moms, new_m)]
+                    return (outs, new_aux_list, new_p, new_m,
+                            jnp.logical_or(nan_acc, flag), flag)
+            else:
+                def f(upd_vals, other_vals, aux, rng, moms, lrs, wds):
+                    outs, new_aux_list, new_p, new_m, _zf, _pg = \
+                        _mesh_core(upd_vals, other_vals, aux, rng, moms,
+                                   lrs, wds)
+                    return outs, new_aux_list, new_p, new_m
 
             fn = jax.jit(f, donate_argnums=(0, 4))
         elif isinstance(kind, tuple) and kind[0] == "train_sgd_scan":
@@ -922,6 +998,18 @@ class Executor:
                     cot[k] = cot[k] + c if k in cot else c
         return grads
 
+    def _small_target(self):
+        """Placement for executor-owned smalls (rng key, guard scalar):
+        the executor's device — or, when the arrays are global over a
+        single-process mesh, replicated over that mesh (a device-0
+        committed scalar cannot enter a jit whose other arguments span
+        the mesh)."""
+        if self._spmd_mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            return NamedSharding(self._spmd_mesh, PartitionSpec())
+        return self._ctx.jax_device()
+
     # -- in-graph NaN guard ----------------------------------------------
     def _nan_acc_in(self):
         """The accumulator value to feed the next guarded dispatch."""
@@ -929,7 +1017,7 @@ class Executor:
             return self._nan_acc
         if self._nan_false is None:
             self._nan_false = jax.device_put(np.zeros((), np.bool_),
-                                             self._ctx.jax_device())
+                                             self._small_target())
         return self._nan_false
 
     def consume_nan_flag(self):
@@ -976,10 +1064,10 @@ class Executor:
             return self._rng_cache
         if self._needs_rng:
             return jax.device_put(_random.next_key(),
-                                  self._ctx.jax_device())
+                                  self._small_target())
         if self._rng_cache is None:
             self._rng_cache = jax.device_put(_random.next_key(),
-                                             self._ctx.jax_device())
+                                             self._small_target())
         return self._rng_cache
 
     # -- compile-once warm-up (docs/how_to/perf.md "Compile once") --------

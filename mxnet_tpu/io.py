@@ -620,19 +620,24 @@ class DevicePrefetchIter(PrefetchingIter):
     copy stayed on it until now).
 
     ``placer(name, array) -> NDArray`` does the placement; ``Module``
-    passes its ``_device_put_batch`` (bound-buffer sharding, so meshes
-    place the batch axis exactly as ``Module._shard`` did at bind).
-    Alternatively pass ``device`` (a jax device) for a plain single-device
-    put.  ``fit(prefetch_to_device=True)`` (or ``MXNET_DEVICE_PREFETCH=1``)
-    wires this in around ``train_data`` and closes it deterministically.
+    passes its ``_device_put_batch``, which recomputes the module's
+    mesh sharding per input — on a mesh-bound module (``context=Mesh``
+    or ``fit(kvstore='mesh')``) the batch lands pre-sharded over the
+    data axis, never on one device with the step left to re-lay it
+    out.  Alternatively pass ``device`` (a jax device) or ``sharding``
+    (a ``jax.sharding.Sharding``, e.g. a mesh ``NamedSharding``) for a
+    module-free placement target.  ``fit(prefetch_to_device=True)``
+    (or ``MXNET_DEVICE_PREFETCH=1``) wires this in around
+    ``train_data`` and closes it deterministically.
     """
 
-    def __init__(self, iters, placer=None, device=None, rename_data=None,
-                 rename_label=None):
+    def __init__(self, iters, placer=None, device=None, sharding=None,
+                 rename_data=None, rename_label=None):
         if placer is None:
-            if device is None:
-                raise MXNetError(
-                    "DevicePrefetchIter needs a placer or a device")
+            target = sharding if sharding is not None else device
+            if target is None:
+                raise MXNetError("DevicePrefetchIter needs a placer, a "
+                                 "device, or a sharding")
 
             def placer(_name, arr):
                 import jax
@@ -641,7 +646,7 @@ class DevicePrefetchIter(PrefetchingIter):
 
                 raw = arr._transfer_src() if isinstance(arr, NDArray) \
                     else np.asarray(arr)
-                return NDArray._from_jax(jax.device_put(raw, device))
+                return NDArray._from_jax(jax.device_put(raw, target))
 
         # set before super().__init__: the prefetch threads start inside
         # it and call _produce immediately

@@ -794,15 +794,22 @@ class KVStoreDist(KVStore):
 
 
 def create(name="local"):
-    """reference ``kvstore.cc:17-45`` type dispatch."""
+    """reference ``kvstore.cc:17-45`` type dispatch, plus the TPU-native
+    ``'mesh'`` device plane (``kvstore_mesh.KVStoreMesh``: the gradient
+    exchange dissolves into the jitted step as in-graph GSPMD
+    collectives over a device mesh — no server, no transport)."""
     if not isinstance(name, str):
         raise TypeError("name must be a string")
     valid = ("local", "local_allreduce_device", "device",
              "local_update_cpu", "local_allreduce_cpu",
              "dist_sync", "dist_async", "dist_sync_device",
-             "dist_async_device", "dist")
+             "dist_async_device", "dist", "mesh")
     if name not in valid:
         raise MXNetError("unknown kvstore type %r" % name)
+    if name == "mesh":
+        from .kvstore_mesh import KVStoreMesh
+
+        return KVStoreMesh()
     if name.startswith("dist"):
         return KVStoreDist(name)
     return KVStore(name)

@@ -58,7 +58,11 @@ def _create_kvstore(kvstore, num_device, arg_params):
     elif isinstance(kvstore, KVStore):
         kv = kvstore
     elif isinstance(kvstore, str):
-        if num_device == 1 and "dist" not in kvstore:
+        if kvstore == "mesh":
+            # the mesh device plane spans ALL jax devices regardless of
+            # the module's declared context count — never shortcut to None
+            kv = _create_kv("mesh")
+        elif num_device == 1 and "dist" not in kvstore:
             kv = None
         else:
             kv = _create_kv(kvstore)

@@ -194,11 +194,18 @@ class _FitRun:
             metric_state = eval_metric.get_state()
         except NotImplementedError:
             metric_state = None
+        mesh_info = None
+        get_info = getattr(module, "_snapshot_mesh_info", None)
+        if callable(get_info):
+            # kvstore='mesh' with world > 1: the generation writes as
+            # per-shard payload files + a stitching manifest entry
+            mesh_info = get_info()
         snap = _ckpt.Snapshot(epoch, nbatch, arg, aux,
                               opt_states=opt_states,
                               opt_counts=opt_counts, rng_state=rng,
                               metric_state=metric_state,
-                              iter_state=iter_state)
+                              iter_state=iter_state,
+                              mesh_info=mesh_info)
         if self.elastic is not None:
             # fold the coordinator-side optimizer states in: elastic
             # rehydration restores the server's momentum from the snapshot

@@ -168,6 +168,78 @@ class Module(BaseModule):
                 return spec
         return P()
 
+    def _mesh_zero_names(self, names):
+        """Parameters whose SGD update shards ZeRO-style over the mesh
+        batch axis (docs/how_to/multi_devices.md "Sharded fit"): active
+        only under ``kvstore='mesh'`` with a >1-device data axis, off
+        via ``MXNET_MESH_ZERO=0``.  Eligibility (leading dim divisible
+        by the world size, size floor) is
+        :func:`~mxnet_tpu.kvstore_mesh.zero_eligible_names`."""
+        import os
+
+        kv = self._kvstore
+        if self._mesh is None or kv is None \
+                or not getattr(kv, "is_mesh", False):
+            # clear, don't just skip: a re-init away from the mesh
+            # kvstore must not leave _place_opt_state row-sharding
+            # fresh states per a stale partition
+            self._zero_names = frozenset()
+            return ()
+        if self._shard_rules:
+            # ZeRO assumes dp-replicated params: a shard_rules module
+            # keeps its TP layout and the plain fused step
+            self._zero_names = frozenset()
+            return ()
+        env = (os.environ.get("MXNET_MESH_ZERO", "1"),
+               os.environ.get("MXNET_MESH_ZERO_MIN_ELEMS"))
+        # memoized: this runs on the per-batch dispatch path and the
+        # answer only changes with the kvstore/mesh/param-set/env.  The
+        # cache holds the kv/mesh objects (identity compare), so a
+        # re-init onto a new plane recomputes
+        cached = getattr(self, "_zero_names_cache", None)
+        if cached is not None and cached[0] is kv \
+                and cached[1] is self._mesh \
+                and cached[2] == tuple(names) and cached[3] == env:
+            return cached[4]
+        if env[0] in ("0", "", "false"):
+            zero = ()
+        else:
+            from ..kvstore_mesh import zero_eligible_names
+
+            world = int(self._mesh.shape[self._batch_axis_name()])
+            shapes = {n: tuple(self._exec.arg_dict[n].shape)
+                      for n in names}
+            zero = zero_eligible_names(names, shapes, world)
+        # _place_opt_state consults this when it commits the optimizer
+        # state arrays: ZeRO params' momentum rows shard with the update
+        self._zero_names = frozenset(zero)
+        self._zero_names_cache = (kv, self._mesh, tuple(names), env,
+                                  zero)
+        return zero
+
+    def _snapshot_mesh_info(self):
+        """Sharding descriptor for snapshot writes (None = unsharded):
+        under ``kvstore='mesh'`` with world > 1 each snapshot generation
+        is split into per-shard payload files stitched by a manifest
+        entry (``checkpoint.write_snapshot``); ``MXNET_MESH_SHARDED_
+        SNAPSHOT=0`` opts out."""
+        import os
+
+        kv = self._kvstore
+        if self._mesh is None or kv is None \
+                or not getattr(kv, "is_mesh", False):
+            return None
+        if os.environ.get("MXNET_MESH_SHARDED_SNAPSHOT", "1") \
+                in ("0", "", "false"):
+            return None
+        axis = self._batch_axis_name()
+        world = int(self._mesh.shape[axis])
+        if world <= 1:
+            return None
+        return {"num_shards": world, "axis": axis,
+                "mesh_axes": list(self._mesh.axis_names),
+                "mesh_shape": [int(s) for s in self._mesh.devices.shape]}
+
     def _shard(self, arr, batch_axis, name=None):
         """Place an NDArray globally over the module mesh.
 
@@ -283,6 +355,10 @@ class Module(BaseModule):
             shared_exec=shared_exec, **shapes)
         if self._dist_dp:
             self._exec._global_mesh = self._mesh
+        elif self._mesh is not None:
+            # single-process mesh: the executor needs the mesh to build
+            # sharded program kinds (the ZeRO train_sgd_mesh step)
+            self._exec._spmd_mesh = self._mesh
         # global placement over the mesh
         if self._mesh is not None:
             for n in self._symbol.list_arguments():
@@ -351,7 +427,30 @@ class Module(BaseModule):
         arg_params = {n: self._exec.arg_dict[n] for n in self._param_names}
         (kvstore, update_on_kvstore) = _create_kvstore(
             kvstore, len(self._context), arg_params)
-        if kvstore is not None and getattr(kvstore, "in_graph_sync", False) \
+        if kvstore is not None and getattr(kvstore, "is_mesh", False) \
+                and self._user_mesh is not kvstore.mesh \
+                and (self._user_mesh is None
+                     or getattr(self, "_kvstore_mesh_adopted", False)):
+            # kvstore='mesh': the KVStore IS a device plane — adopt its
+            # mesh and re-bind so every bound array becomes a global
+            # jax Array (batch sharded over the data axis, params
+            # replicated); GSPMD then compiles the gradient psum into
+            # the step and push/pull never run per step.  A mesh the
+            # USER passed as the module context is never clobbered
+            # (their layout — axes, shard_rules targets, device subset
+            # — wins; the mesh kvstore then just marks the in-graph
+            # plane), but a previously kvstore-ADOPTED mesh is
+            # re-adopted so re-initializing onto a new plane works
+            self._user_mesh = kvstore.mesh
+            self._kvstore_mesh_adopted = True
+            self.bind(self._data_shapes, self._label_shapes or None,
+                      for_training=self.for_training,
+                      inputs_need_grad=self.inputs_need_grad,
+                      force_rebind=True, grad_req=self._grad_req)
+            arg_params = {n: self._exec.arg_dict[n]
+                          for n in self._param_names}
+        elif kvstore is not None \
+                and getattr(kvstore, "in_graph_sync", False) \
                 and not self._dist_dp:
             # the process group came up with the kvstore (after bind):
             # re-bind onto the global mesh, preserving parameters (bind
@@ -392,6 +491,15 @@ class Module(BaseModule):
         self._kvstore = kvstore
         self._update_on_kvstore = update_on_kvstore
         self._updater = None
+        # a (re-)init starts a fresh updater/state generation: stale
+        # placed-state bookkeeping would make _place_opt_state skip the
+        # new states' mesh placement (single-device momentum entering a
+        # mesh jit), and a stale zero partition would row-shard a
+        # non-SGD optimizer's fresh states (whose update path never
+        # recomputes it) per the old SGD partition
+        self._dist_placed_states = set()
+        self._zero_names_cache = None
+        self._zero_names = frozenset()
         if kvstore:
             _initialize_kvstore(
                 kvstore=kvstore,
@@ -573,6 +681,9 @@ class Module(BaseModule):
         if not names:
             ex.forward(is_train=True)
             return
+        # ZeRO eligibility must be known BEFORE the states are placed:
+        # a sharded param's momentum commits row-sharded
+        zero = self._mesh_zero_names(names)
         for idx in range(len(names)):
             if idx not in updater.states:
                 updater.states[idx] = optimizer.create_state(
@@ -583,8 +694,16 @@ class Module(BaseModule):
         clip = optimizer.clip_gradient \
             if optimizer.clip_gradient is not None else -1.0
         guard = bool(getattr(ex, "_nan_guard", False))
-        fn = ex._get_fn(("train_sgd", tuple(names), optimizer.momentum,
-                         optimizer.rescale_grad, clip, guard))
+        if zero:
+            # ZeRO fused step: reduce-scatter grads, sharded update,
+            # all-gather params — no full-gradient materialization, so
+            # grad_dict is left stale (like run_bulk's on-chip grads)
+            fn = ex._get_fn(("train_sgd_mesh", tuple(names), tuple(zero),
+                             optimizer.momentum, optimizer.rescale_grad,
+                             clip, guard, self._batch_axis_name()))
+        else:
+            fn = ex._get_fn(("train_sgd", tuple(names), optimizer.momentum,
+                             optimizer.rescale_grad, clip, guard))
         names_set = set(names)
         other = [n for n in ex.arg_names if n not in names_set]
         upd_vals = [ex.arg_dict[n]._jx for n in names]
@@ -593,13 +712,24 @@ class Module(BaseModule):
         rng = ex.next_rng()
         moms = [updater.states[i]._jx for i in range(len(names))] \
             if optimizer.momentum != 0.0 else []
-        if guard:
+        grad_list = None
+        if guard and zero:
+            outs, new_aux, new_p, new_m, acc, batch_flag = fn(
+                upd_vals, other_vals, aux, rng, moms, lrs, wds,
+                ex._nan_acc_in())
+            ex._nan_acc = acc
+            ex._nan_batch = batch_flag
+            ex._nan_stale = False
+        elif guard:
             outs, new_aux, new_p, new_m, grad_list, acc, batch_flag = fn(
                 upd_vals, other_vals, aux, rng, moms, lrs, wds,
                 ex._nan_acc_in())
             ex._nan_acc = acc
             ex._nan_batch = batch_flag
             ex._nan_stale = False
+        elif zero:
+            outs, new_aux, new_p, new_m = fn(
+                upd_vals, other_vals, aux, rng, moms, lrs, wds)
         else:
             outs, new_aux, new_p, new_m, grad_list = fn(
                 upd_vals, other_vals, aux, rng, moms, lrs, wds)
@@ -611,9 +741,12 @@ class Module(BaseModule):
         for i, m in enumerate(new_m):
             updater.states[i]._jx = m
         # keep grad_dict observable exactly like the two-phase path
-        # (grad-norm logging etc. reads the current batch's gradients)
-        for n, g in zip(names, grad_list):
-            ex.grad_dict[n]._jx = g
+        # (grad-norm logging etc. reads the current batch's gradients).
+        # The ZeRO step never materializes full gradients (that is the
+        # point — reduce-scatter, not all-reduce): grad_dict goes stale
+        if grad_list is not None:
+            for n, g in zip(names, grad_list):
+                ex.grad_dict[n]._jx = g
         ex._pending_grads = None
 
     def run_bulk(self, batches, return_outputs=False):
@@ -673,6 +806,11 @@ class Module(BaseModule):
         names = [n for n in self._param_names
                  if ex.grad_dict.get(n) is not None]
         if not names:
+            return _per_batch_fallback()
+        if self._mesh_zero_names(names):
+            # the ZeRO-sharded update lands per step (train_sgd_mesh);
+            # the scan-bulked kind stays unsharded — fall back so the
+            # sharded state layout is consistent across the whole fit
             return _per_batch_fallback()
         self._pending_full = False
         for idx in range(len(names)):
@@ -949,11 +1087,16 @@ class Module(BaseModule):
                     self._mesh, np.asarray(arr._jx))  # host-sync: ok — dist init-time state placement
             else:
                 import jax
-                from jax.sharding import NamedSharding
+                from jax.sharding import NamedSharding, PartitionSpec as P
 
+                spec = self._param_spec(name)
+                if name is not None \
+                        and name in getattr(self, "_zero_names", ()):
+                    # ZeRO: the optimizer state stores row-sharded over
+                    # the batch axis — each device holds 1/world of it
+                    spec = P(self._batch_axis_name())
                 arr._jx = jax.device_put(
-                    arr._jx, NamedSharding(self._mesh,
-                                           self._param_spec(name)))
+                    arr._jx, NamedSharding(self._mesh, spec))
 
         # multi-array states (adam mean/var, rmsprop n/g/delta) place
         # every element alongside the parameter
@@ -977,27 +1120,57 @@ class Module(BaseModule):
         if not names:
             return True
         updater = self._updater
-        if self._fused_step is None:
+        zero = self._mesh_zero_names(names)
+        # the mesh rides the key: a module re-initialized onto a new
+        # device plane must not reuse a step whose shard_map/sharding
+        # closures captured the old mesh
+        step_key = (tuple(names), optimizer.momentum,
+                    optimizer.rescale_grad, optimizer.clip_gradient,
+                    tuple(zero), self._mesh)
+        # states are created + mesh-placed EVERY call, not just when the
+        # step compiles: _place_opt_state memoizes via
+        # _dist_placed_states, and a mid-fit set_states restore (NaN
+        # rollback, load_optimizer_states) re-commits host arrays AND
+        # clears that memo — the re-placement must happen even when the
+        # compiled step is cached.  Momentum lives in the Updater so
+        # save/load_optimizer_states keeps working
+        for idx, n in enumerate(names):
+            if idx not in updater.states:
+                updater.states[idx] = optimizer.create_state(
+                    idx, self._exec.arg_dict[n])
+            self._place_opt_state(idx, updater.states[idx], n)
+        if self._fused_step is None \
+                or getattr(self, "_fused_step_key", None) != step_key:
             momentum = optimizer.momentum
             rescale = optimizer.rescale_grad
             clip = optimizer.clip_gradient if optimizer.clip_gradient \
                 is not None else -1.0
-            # momentum state lives in the Updater so save/load_optimizer
-            # _states keeps working
-            for idx, n in enumerate(names):
-                if idx not in updater.states:
-                    updater.states[idx] = optimizer.create_state(
-                        idx, self._exec.arg_dict[n])
-                self._place_opt_state(idx, updater.states[idx], n)
 
             from ..executor import sgd_step_math
+
+            mstep = None
+            if zero:
+                # the shared per-param dispatch + layout pinning — the
+                # same helper train_sgd_mesh compiles, so the two fused
+                # paths cannot diverge numerically
+                from ..kvstore_mesh import mesh_param_step
+
+                mstep = mesh_param_step(
+                    self._mesh, momentum, rescale, clip, zero,
+                    axis_name=self._batch_axis_name())
+            step_names = list(names)
 
             def step(params, grads, moms, lrs, wds):
                 new_p, new_m = [], []
                 for i, (p, g) in enumerate(zip(params, grads)):
-                    np_, nm = sgd_step_math(
-                        p, g, moms[i] if momentum != 0.0 else None,
-                        lrs[i], wds[i], momentum, rescale, clip)
+                    m_in = moms[i] if momentum != 0.0 else None
+                    if mstep is not None:
+                        np_, nm, _flag = mstep(step_names[i], p, g,
+                                               m_in, lrs[i], wds[i])
+                    else:
+                        np_, nm = sgd_step_math(
+                            p, g, m_in, lrs[i], wds[i], momentum,
+                            rescale, clip)
                     new_p.append(np_)
                     if nm is not None:
                         new_m.append(nm)
@@ -1008,6 +1181,7 @@ class Module(BaseModule):
                     jax.jit(step, donate_argnums=(0, 2)),
                     self._exec._symbol_name(), "fused_update"),
                 self._exec._symbol_name(), "fused_update")
+            self._fused_step_key = step_key
         # per-index bookkeeping keeps num_update/scheduler semantics
         for idx in range(len(names)):
             optimizer._update_count(idx)
@@ -1068,24 +1242,41 @@ class Module(BaseModule):
 
     def _device_put_batch(self, name, arr):
         """Prefetch-thread H2D placer (``fit(prefetch_to_device=True)``):
-        move ONE input batch array onto the bound array's device — using
-        the bound buffer's sharding, so mesh contexts get the same
-        batch-axis placement ``Module._shard`` committed at bind — while
+        move ONE input batch array onto the bound array's device — with
+        the MODULE's sharding for that input, so mesh contexts get the
+        same batch-axis placement ``Module._shard`` committed — while
         the previous step's compute is still in flight.  Runs on the
         ``DevicePrefetchIter`` background thread; ``_load_io``'s
-        device_put then finds the data already resident (a no-op put)."""
+        device_put then finds the data already resident (a no-op put).
+
+        The sharding is recomputed from the mesh, NOT read off the
+        bound buffer: on a fresh bind the buffer can still carry its
+        single-device placement (allocation happens before ``_shard``
+        commits the mesh layout, and a rebind can race the background
+        producer), and a single-device put would force the step to
+        re-lay out every batch on the blocking path — the exact copy
+        the prefetch thread exists to hide.  Regression-pinned by
+        tests/test_mesh_kvstore.py."""
         import jax
 
         dst = self._exec.arg_dict.get(name) if self._exec is not None \
             else None
         if dst is None:
             return arr
+        sharding = dst._jx.sharding
+        if self._mesh is not None and not self._dist_dp:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            batch_axis = name in self._data_names \
+                or name in self._label_names
+            spec = P(self._batch_axis_name()) if batch_axis \
+                else self._param_spec(name)
+            sharding = NamedSharding(self._mesh, spec)
         raw = arr._transfer_src() if isinstance(arr, NDArray) \
             else np.asarray(arr)  # host-sync: ok — host iterator batch, not a device buffer
         if isinstance(raw, np.ndarray) and raw.dtype != dst._jx.dtype:
             raw = raw.astype(dst._jx.dtype)
-        return NDArray._from_jax(jax.device_put(raw, dst._jx.sharding),
-                                 dst._ctx)
+        return NDArray._from_jax(jax.device_put(raw, sharding), dst._ctx)
 
     def install_monitor(self, mon):
         assert self.binded

@@ -387,7 +387,17 @@ def create(name, **kwargs):
 
 class Updater:
     """reference ``optimizer.py`` get_updater closure, as a picklable class
-    (kvstore servers receive it)."""
+    (kvstore servers receive it).
+
+    Under a mesh module (``context=Mesh`` / ``fit(kvstore='mesh')``)
+    the state arrays are *global jax Arrays* — replicated, or
+    row-sharded over the batch axis for ZeRO-eligible params
+    (``Module._place_opt_state``).  The serialization contract is
+    sharding-agnostic: ``get_states`` pickles NDArrays, which gathers
+    each to one full host buffer, so the bytes are identical to a
+    single-device run's and a snapshot restores across any mesh shape
+    (the module re-places them on its own mesh after ``set_states`` —
+    unpickled arrays come back host-committed)."""
 
     def __init__(self, optimizer):
         self.optimizer = optimizer

@@ -1294,6 +1294,32 @@ def test_mutation_swapped_psum_axis_is_caught(tmp_path):
         [f.message for f in res1.findings]
 
 
+def test_mutation_swapped_mesh_update_psum_axis_is_caught(tmp_path):
+    """Swap the psum axis in kvstore_mesh's fused ZeRO update to an
+    undeclared name: collective-consistency must fire on the mutated
+    copy (ISSUE 14 satellite — the mesh plane lands lint-provable)."""
+    pristine = tmp_path / "kvstore_mesh_ok.py"
+    pristine.write_text(
+        (ROOT / "mxnet_tpu" / "kvstore_mesh.py").read_text())
+    res0 = run_pass(by_id("collective-consistency")(),
+                    RunContext(roots=[pristine]))
+    assert not active(res0), [f.message for f in active(res0)]
+    res0s = run_pass(by_id("spec-shape")(),
+                     RunContext(roots=[pristine]))
+    assert not active(res0s), [f.message for f in active(res0s)]
+
+    mutated = _mutated_copy(
+        tmp_path, "mxnet_tpu/kvstore_mesh.py",
+        "flag = jax.lax.psum(bad.astype(jnp.int32), axis_name) > 0",
+        "flag = jax.lax.psum(bad.astype(jnp.int32), \"dataa\") > 0",
+        "kvstore_mesh_mut.py")
+    res1 = run_pass(by_id("collective-consistency")(),
+                    RunContext(roots=[mutated]))
+    assert any(f.code == "unknown-axis" and f.detail == "dataa"
+               for f in active(res1)), \
+        [f.message for f in res1.findings]
+
+
 def test_mutation_time_into_trainer_collective_is_caught(tmp_path):
     """Insert time.time() into the lm train step's aux pmean:
     replica-divergence must fire on the mutated copy."""

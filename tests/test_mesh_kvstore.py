@@ -1,0 +1,592 @@
+"""kvstore='mesh': the GSPMD training plane (docs/how_to/multi_devices.md
+"Sharded fit").
+
+Pins the ISSUE-14 acceptance surface: ``fit(kvstore='mesh')`` trains
+with the gradient plane in-graph (zero per-step kvstore push/pull), a
+1-device mesh is bit-identical to plain ``fit``, an 8-virtual-device
+mesh tracks the single-device loss trajectory, ZeRO shards the
+optimizer state ~world-size, snapshots write per-shard payload files
+stitched by the manifest (kill mid-epoch → bit-identical resume, and a
+resume onto a DIFFERENT mesh shape), and ``DevicePrefetchIter``'s
+background placer lands batches with the mesh's data-axis sharding.
+
+The 8-device cases run under ``XLA_FLAGS=--xla_force_host_platform_
+device_count=8`` (ci/run_tests.sh sets it suite-wide) and skip on
+fewer devices.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+import mxnet_tpu as mx
+from mxnet_tpu import faults, telemetry
+from mxnet_tpu import io as mxio
+from mxnet_tpu.checkpoint import TrainingPreempted, load_latest_state
+from mxnet_tpu.kvstore_mesh import (KVStoreMesh, optimizer_state_hbm,
+                                    zero_eligible_names)
+from mxnet_tpu.model import checkpoint_manifest
+from mxnet_tpu.parallel.mesh import make_mesh
+
+CHAOS_SEED = int(os.environ.get("MXNET_CHAOS_SEED", "0"))
+
+#: toy geometry: batch 16 over up to 8 devices (2 rows each), dims
+#: divisible by 8 so the fc weights are ZeRO-eligible
+N, DIM, CLASSES, BATCH, EPOCHS = 64, 16, 8, 16, 2
+BATCHES_PER_EPOCH = N // BATCH
+
+_ENV = ("MXNET_MESH_ZERO", "MXNET_MESH_ZERO_MIN_ELEMS",
+        "MXNET_MESH_SHARDED_SNAPSHOT", "MXNET_MESH_DEVICES",
+        "MXNET_FUSE_TRAIN_STEP", "MXNET_CKPT_EVERY_N_BATCHES",
+        "MXNET_FAULT_SPEC")
+
+eight = pytest.mark.skipif(
+    jax.device_count() < 8,
+    reason="needs 8 virtual devices "
+           "(XLA_FLAGS=--xla_force_host_platform_device_count=8)")
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    faults.disarm()
+    telemetry.reset()
+    # every weight in the toy net shards (the HBM pin needs them all)
+    os.environ["MXNET_MESH_ZERO_MIN_ELEMS"] = "1"
+    # leave the global RNG streams exactly as found: these tests seed
+    # np/mx randomness for reproducibility, and downstream suite files
+    # (e.g. the module convergence test) are sensitive to the stream
+    # position they inherit
+    np_state = np.random.get_state()
+    from mxnet_tpu import random as _mx_random
+
+    mx_state = _mx_random.get_state()
+    yield
+    np.random.set_state(np_state)
+    _mx_random.set_state(mx_state)
+    faults.disarm()
+    telemetry.disable()
+    telemetry.reset()
+    for var in _ENV:
+        os.environ.pop(var, None)
+
+
+def _toy_net():
+    data = mx.sym.Variable("data")
+    h = mx.sym.FullyConnected(data, num_hidden=32, name="fc1")
+    h = mx.sym.Activation(h, act_type="relu")
+    return mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(h, num_hidden=CLASSES, name="fc2"),
+        name="softmax")
+
+
+def _toy_data(seed=7):
+    rs = np.random.RandomState(seed + CHAOS_SEED)
+    x = rs.rand(N, DIM).astype(np.float32)
+    y = rs.randint(0, CLASSES, N).astype(np.float32)
+    return x, y
+
+
+def _toy_iter(seed=7):
+    x, y = _toy_data(seed)
+    return mxio.NDArrayIter(x, y, batch_size=BATCH, shuffle=False)
+
+
+def _fit(kvstore, seed=3, metric_trace=None, num_epoch=EPOCHS, **kw):
+    mod = mx.mod.Module(_toy_net(), context=mx.cpu())
+    np.random.seed(seed + CHAOS_SEED)
+    cbs = None
+    if metric_trace is not None:
+        cbs = [lambda p: metric_trace.append(
+            (p.epoch, p.nbatch, dict(p.eval_metric.get_name_value())))]
+    mod.fit(_toy_iter(), num_epoch=num_epoch, kvstore=kvstore,
+            optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+            eval_metric="acc", batch_end_callback=cbs, **kw)
+    return mod
+
+
+def _params_np(mod):
+    arg, _aux = mod.get_params()
+    return {k: v.asnumpy() for k, v in arg.items()}
+
+
+def _assert_identical(a, b):
+    assert set(a) == set(b)
+    for k in a:
+        np.testing.assert_array_equal(a[k], b[k], err_msg=k)
+
+
+# -- KVStore API surface -----------------------------------------------------
+
+def test_create_mesh_kvstore():
+    kv = mx.kv.create("mesh")
+    assert isinstance(kv, KVStoreMesh)
+    assert kv.type == "mesh"
+    assert kv.in_graph_sync and kv.is_mesh
+    assert kv.world == len(kv.mesh.devices.flat)
+    a = mx.nd.array(np.arange(8, dtype=np.float32))
+    kv.init(3, a)
+    out = mx.nd.zeros((8,))
+    kv.pull(3, out)
+    np.testing.assert_array_equal(out.asnumpy(), a.asnumpy())
+    # push with no updater = assign of the device-merged value
+    kv.push(3, [mx.nd.ones((8,)), mx.nd.ones((8,))])
+    kv.pull(3, out)
+    np.testing.assert_array_equal(out.asnumpy(), np.full((8,), 2.0))
+
+
+def test_zero_eligibility_math():
+    shapes = {"w": (32, 16), "b": (32,), "odd": (3, 5), "tiny": (8,)}
+    got = zero_eligible_names(["w", "b", "odd", "tiny"], shapes, 8,
+                              min_elems=16)
+    assert got == ("w", "b")
+    assert zero_eligible_names(["w"], shapes, 1, min_elems=1) == ()
+
+
+# -- degenerate-mesh parity (satellite) --------------------------------------
+
+@pytest.mark.parametrize("fused", [False, True])
+def test_one_device_mesh_bit_identical_to_plain_fit(fused):
+    """fit(kvstore='mesh') on a 1-device mesh must be bit-identical to
+    plain fit — params AND the Accuracy trajectory."""
+    if fused:
+        os.environ["MXNET_FUSE_TRAIN_STEP"] = "1"
+    kv = KVStoreMesh(mesh=make_mesh(n_devices=1, axis_names=("data",)))
+    t_ref, t_mesh = [], []
+    ref = _fit("local", metric_trace=t_ref)
+    mesh = _fit(kv, metric_trace=t_mesh)
+    _assert_identical(_params_np(ref), _params_np(mesh))
+    assert t_ref == t_mesh
+
+
+@eight
+def test_eight_device_mesh_tracks_single_device_loss():
+    """An 8-device mesh run reduces gradients in a different order than
+    one device — the loss/accuracy trajectory must agree within
+    tolerance, not bit-exactly."""
+    kv1 = KVStoreMesh(mesh=make_mesh(n_devices=1, axis_names=("data",)))
+    kv8 = KVStoreMesh(mesh=make_mesh(n_devices=8, axis_names=("data",)))
+    t1, t8 = [], []
+    m1 = _fit(kv1, metric_trace=t1)
+    m8 = _fit(kv8, metric_trace=t8)
+    a1, a8 = _params_np(m1), _params_np(m8)
+    for k in a1:
+        np.testing.assert_allclose(a1[k], a8[k], rtol=1e-4, atol=1e-5,
+                                   err_msg=k)
+    for (e1, b1, v1), (e8, b8, v8) in zip(t1, t8):
+        assert (e1, b1) == (e8, b8)
+        assert abs(v1["accuracy"] - v8["accuracy"]) <= 1.0 / BATCH + 1e-9
+
+
+# -- in-graph gradient plane (THE tentpole invariant) ------------------------
+
+@eight
+def test_mesh_fit_has_zero_per_step_kvstore_traffic():
+    """The gradient plane is the in-graph psum: no kvstore push/pull
+    runs per step (the counters the PS/local planes bump stay zero)."""
+    telemetry.enable()
+    _fit("mesh")
+    snap = telemetry.snapshot()
+    counters = {k: v for k, v in snap.get("counters", {}).items()
+                if k.startswith("kvstore.push") or
+                k.startswith("kvstore.pull")}
+    assert not any(v for v in counters.values()), counters
+
+
+@eight
+@pytest.mark.parametrize("fused", [False, True])
+def test_zero_shards_optimizer_state_hbm(fused):
+    """ZeRO: per-device optimizer-state HBM ≥4x below the replicated
+    total at world size 8 (the fc biases stay replicated; the weights
+    dominate)."""
+    if fused:
+        os.environ["MXNET_FUSE_TRAIN_STEP"] = "1"
+    mod = _fit("mesh")
+    per_dev, total = optimizer_state_hbm(mod)
+    assert total > 0
+    assert per_dev * 4 <= total, (per_dev, total)
+    # momentum of the eligible params is row-sharded over 'data'
+    from jax.sharding import PartitionSpec as P
+
+    names = [n for n in mod._param_names
+             if mod._exec.grad_dict.get(n) is not None]
+    zero = set(mod._mesh_zero_names(names))
+    assert zero, "no ZeRO-eligible params in the toy net?"
+    for idx, n in enumerate(names):
+        st = mod._updater.states[idx]
+        spec = st._jx.sharding.spec
+        if n in zero:
+            assert tuple(spec) == ("data",), (n, spec)
+
+
+@eight
+def test_zero_memory_analysis_attribution():
+    """The PR 6 attribution tables pin the same claim from the compiled
+    program's side: the fused mesh step's per-partition argument bytes
+    (XLA ``memory_analysis()``) shrink vs the unsharded fused step —
+    sharded momentum/batch arguments instead of replicated ones."""
+    from mxnet_tpu import perfdebug
+
+    os.environ["MXNET_FUSE_TRAIN_STEP"] = "1"
+    perfdebug.enable()
+    try:
+        _fit("mesh")
+        os.environ["MXNET_MESH_ZERO"] = "0"
+        _fit("mesh")
+        by_kind = {e["kind"]: e for e in perfdebug.report()
+                   if e["kind"] in ("train_sgd", "train_sgd_mesh")}
+        assert set(by_kind) == {"train_sgd", "train_sgd_mesh"}
+        mesh_args = by_kind["train_sgd_mesh"]["hbm"].get("argument_bytes")
+        plain_args = by_kind["train_sgd"]["hbm"].get("argument_bytes")
+        if not mesh_args or not plain_args:
+            pytest.skip("backend exposes no memory_analysis")
+        assert mesh_args * 2 <= plain_args, (mesh_args, plain_args)
+    finally:
+        perfdebug.disable()
+
+
+@eight
+def test_mesh_zero_env_kill_switch():
+    os.environ["MXNET_MESH_ZERO"] = "0"
+    mod = _fit("mesh")
+    per_dev, total = optimizer_state_hbm(mod)
+    assert per_dev == total  # replicated everywhere
+
+
+@eight
+def test_mesh_fit_nan_guard_skip_batch():
+    """The in-graph NaN guard rides the mesh: a poisoned batch is
+    flagged and its update withheld."""
+    os.environ["MXNET_FUSE_TRAIN_STEP"] = "1"
+    faults.arm("fit.batch", at=2)
+    trips = []
+    mod = mx.mod.Module(_toy_net(), context=mx.cpu())
+    np.random.seed(3)
+    mod.fit(_toy_iter(), num_epoch=1, kvstore="mesh", optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+            eval_metric="acc", nan_policy="skip_batch",
+            batch_end_callback=lambda p: trips.append(p.nan_detected))
+    assert any(trips)
+    for v in _params_np(mod).values():
+        assert np.isfinite(v).all()
+
+
+@eight
+def test_reinit_onto_different_mesh_rebuilds_fused_step():
+    """Regression: a live module re-initialized onto a DIFFERENT mesh
+    must rebuild its fused update (the step's shard_map/sharding
+    closures captured the old mesh) and re-place fresh optimizer
+    states (stale placed-state bookkeeping left new momentum on one
+    device entering a mesh jit)."""
+    mod = mx.mod.Module(_toy_net(), context=mx.cpu())
+    it = _toy_iter()
+    np.random.seed(3)
+    kv8 = KVStoreMesh(mesh=make_mesh(n_devices=8, axis_names=("data",)))
+    mod.fit(it, num_epoch=1, kvstore=kv8, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+            eval_metric="acc")
+    per8, total = optimizer_state_hbm(mod)
+    assert per8 * 4 <= total
+    it.reset()
+    kv4 = KVStoreMesh(mesh=make_mesh(n_devices=4, axis_names=("data",)))
+    mod.init_optimizer(kvstore=kv4, optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.1),
+                                         ("momentum", 0.9)),
+                       force_init=True)
+    for _ in range(4):
+        mod.forward_backward(it.next())
+        mod.update()
+    per4, total4 = optimizer_state_hbm(mod)
+    assert per4 * 2 <= total4
+    for v in _params_np(mod).values():
+        assert np.isfinite(v).all()
+
+
+@eight
+def test_load_optimizer_states_mid_fit_replaces_on_mesh(tmp_path):
+    """Regression: restoring optimizer states AFTER the fused update
+    compiled re-commits them as host/single-device arrays — the next
+    update must re-place them on the mesh (the placement loop runs
+    every call, memoized), not crash with incompatible devices."""
+    from jax.sharding import PartitionSpec as P
+
+    mod = mx.mod.Module(_toy_net(), context=mx.cpu())
+    it = _toy_iter()
+    np.random.seed(3)
+    mod.fit(it, num_epoch=1, kvstore="mesh", optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+            eval_metric="acc")
+    states = str(tmp_path / "opt.states")
+    mod.save_optimizer_states(states)
+    mod.load_optimizer_states(states)  # host-committed arrays now
+    it.reset()
+    for _ in range(2):
+        mod.forward_backward(it.next())
+        mod.update()
+    names = [n for n in mod._param_names
+             if mod._exec.grad_dict.get(n) is not None]
+    zero = set(mod._mesh_zero_names(names))
+    assert zero
+    for idx, n in enumerate(names):
+        st = mod._updater.states[idx]
+        want = ("data",) if n in zero else ()
+        assert tuple(st._jx.sharding.spec) == want, (n, st._jx.sharding)
+
+
+@eight
+def test_user_mesh_with_shard_rules_survives_mesh_kvstore():
+    """Regression: a mesh the USER passed as the module context (with
+    TP shard_rules) must not be clobbered by kvstore='mesh' adoption —
+    the rules' 'model' axis only exists on the user's mesh."""
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(4, 2),
+                ("data", "model"))
+    mod = mx.mod.Module(_toy_net(), context=mesh,
+                        shard_rules=[("fc1_weight", P(None, "model"))])
+    np.random.seed(3)
+    mod.fit(_toy_iter(), num_epoch=1, kvstore="mesh", optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+            eval_metric="acc")
+    assert mod._mesh is mesh
+    spec = tuple(mod._exec.arg_dict["fc1_weight"]._jx.sharding.spec)
+    assert spec == (None, "model"), spec
+    for v in _params_np(mod).values():
+        assert np.isfinite(v).all()
+
+
+@eight
+def test_mesh_fit_non_sgd_and_eval():
+    """Non-SGD optimizers ride the mesh through the updater path
+    (replicated states — ZeRO is SGD-only), and the eval/score pass
+    runs on the sharded executor."""
+    x, y = _toy_data()
+    ev = mxio.NDArrayIter(x[:32], y[:32], batch_size=BATCH)
+    mod = mx.mod.Module(_toy_net(), context=mx.cpu())
+    np.random.seed(3)
+    mod.fit(_toy_iter(), eval_data=ev, num_epoch=1, kvstore="mesh",
+            optimizer="adam", optimizer_params={"learning_rate": 0.01},
+            eval_metric="acc")
+    for v in _params_np(mod).values():
+        assert np.isfinite(v).all()
+    per_dev, total = optimizer_state_hbm(mod)
+    assert per_dev == total  # Adam states stay replicated
+
+
+# -- DevicePrefetchIter mesh sharding (satellite bugfix) ---------------------
+
+@eight
+def test_device_prefetch_places_mesh_sharding_regression():
+    """Regression: the background placer must land batches with the
+    MODULE's mesh data-axis sharding even when the bound buffer still
+    carries its fresh-bind single-device placement (the bug: placing
+    with the stale buffer sharding put the whole batch on one device
+    and the step re-laid it out on the blocking path)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = make_mesh(n_devices=8, axis_names=("data",))
+    mod = mx.mod.Module(_toy_net(), context=mesh)
+    it = _toy_iter()
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params()
+    # simulate the fresh-bind state: bound data buffer on ONE device
+    dst = mod._exec.arg_dict["data"]
+    dst._jx = jax.device_put(np.asarray(dst._jx),
+                             jax.devices()[0])
+    batch = it.next()
+    placed = mod._device_put_batch("data", batch.data[0])
+    want = NamedSharding(mesh, P("data"))
+    assert placed._jx.sharding.is_equivalent_to(want, placed._jx.ndim), \
+        placed._jx.sharding
+    # and through the DevicePrefetchIter wrapper end to end
+    it.reset()
+    with mxio.DevicePrefetchIter(it,
+                                 placer=mod._device_put_batch) as dit:
+        b = dit.next()
+        assert b.data[0]._jx.sharding.is_equivalent_to(
+            want, b.data[0]._jx.ndim)
+
+
+# -- sharded snapshots (tentpole: kill/resume + mesh-shape change) -----------
+
+def _mesh_fit_ckpt(prefix, kv, metric_trace=None, **kw):
+    mod = mx.mod.Module(_toy_net(), context=mx.cpu())
+    np.random.seed(3 + CHAOS_SEED)
+    cbs = None
+    if metric_trace is not None:
+        cbs = [lambda p: metric_trace.append(
+            (p.epoch, p.nbatch, dict(p.eval_metric.get_name_value())))]
+    mod.fit(_toy_iter(), num_epoch=EPOCHS, kvstore=kv, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+            eval_metric="acc", checkpoint_prefix=prefix,
+            checkpoint_every_n_batches=1, batch_end_callback=cbs, **kw)
+    return mod
+
+
+@eight
+def test_sharded_snapshot_layout_and_stitching_manifest(tmp_path):
+    prefix = str(tmp_path / "mesh")
+    _mesh_fit_ckpt(prefix, "mesh")
+    m = checkpoint_manifest(prefix)
+    snaps = m["snapshots"]
+    assert snaps, "no snapshot generations retained"
+    for entry in snaps:
+        info = entry.get("sharded")
+        assert info, "mesh fit wrote an unsharded snapshot"
+        assert info["num_shards"] == 8
+        assert info["mesh_shape"] == [8]
+        assert len(info["shards"]) == 8
+        for ent in info["shards"]:
+            path = tmp_path / ent["params"]
+            assert path.exists(), ent["params"]
+            assert ent["sha256"]
+            assert ent["states"] and (tmp_path / ent["states"]).exists()
+    # the stitched state loads and covers every parameter (target the
+    # newest SNAPSHOT generation — the final epoch checkpoint outranks
+    # it in the recency order and is single-file by design)
+    newest = snaps[-1]
+    st = load_latest_state(prefix,
+                           want=(newest["epoch"], newest["nbatch"]))
+    assert st is not None
+    assert set(st.arg_params) == {"fc1_weight", "fc1_bias",
+                                  "fc2_weight", "fc2_bias"}
+    assert st.states_bytes is not None
+
+
+@eight
+def test_sharded_snapshot_kill_resume_bit_identical(tmp_path):
+    """SIGTERM mid-epoch under sharded snapshots: the resumed run ends
+    bit-identical to a never-killed run (params + metric trajectory) —
+    the mesh half of the preemption acceptance."""
+    # any batch hit except the last two, so the resumed leg is non-empty
+    # (the seed rotates it across epoch-0, the boundary, and epoch-1)
+    kill_at = 1 + (CHAOS_SEED % (EPOCHS * BATCHES_PER_EPOCH - 2))
+    ref_trace = []
+    ref = _mesh_fit_ckpt(str(tmp_path / "ref"), "mesh",
+                         metric_trace=ref_trace)
+    trace = []
+    faults.arm("fit.preempt", at=kill_at)
+    with pytest.raises(TrainingPreempted) as err:
+        _mesh_fit_ckpt(str(tmp_path / "victim"), "mesh",
+                       metric_trace=trace)
+    faults.disarm()
+    assert err.value.checkpoint_path is not None
+    assert os.path.exists(err.value.checkpoint_path)
+    # the drain snapshot is itself sharded
+    m = checkpoint_manifest(str(tmp_path / "victim"))
+    assert any(e.get("sharded") for e in m["snapshots"])
+    res = _mesh_fit_ckpt(str(tmp_path / "victim"), "mesh",
+                         metric_trace=trace, resume="auto")
+    _assert_identical(_params_np(ref), _params_np(res))
+    ref_by_pos = {(e, b): v for e, b, v in ref_trace}
+    resumed_leg = trace[kill_at:]
+    assert resumed_leg, "resumed run produced no batches"
+    for e, b, v in resumed_leg:
+        assert v == ref_by_pos[(e, b)], (e, b)
+
+
+@eight
+def test_sharded_snapshot_resumes_onto_different_mesh(tmp_path):
+    """A generation written at world 8 restores onto a 4-device (and a
+    1-device) mesh: the stitch reassembles the full state from the
+    manifest regardless of the writing mesh's shape, and the new world
+    re-derives shard ownership for its own writes."""
+    prefix = str(tmp_path / "mesh")
+    kill_at = BATCHES_PER_EPOCH + 1
+    faults.arm("fit.preempt", at=kill_at)
+    with pytest.raises(TrainingPreempted):
+        _mesh_fit_ckpt(prefix, "mesh")
+    faults.disarm()
+    st = load_latest_state(prefix)
+    assert st is not None
+
+    # manifest re-sharding is bit-exact: round-trip the stitched
+    # 8-shard generation through a 4-shard write and restitch
+    import pickle as _pickle
+
+    from mxnet_tpu.checkpoint import Snapshot, write_snapshot
+
+    reshard_prefix = str(tmp_path / "reshard")
+    write_snapshot(reshard_prefix, Snapshot(
+        st.epoch, st.nbatch, st.arg_params, {},
+        opt_states=_pickle.loads(st.states_bytes)
+        if st.states_bytes else None,
+        mesh_info={"num_shards": 4, "axis": "data",
+                   "mesh_axes": ["data"], "mesh_shape": [4]}))
+    st4 = load_latest_state(reshard_prefix)
+    assert st4 is not None
+    assert set(st4.arg_params) == set(st.arg_params)
+    for k in st.arg_params:
+        np.testing.assert_array_equal(st.arg_params[k].asnumpy(),
+                                      st4.arg_params[k].asnumpy(),
+                                      err_msg=k)
+
+    ref_trace = []
+    ref = _mesh_fit_ckpt(str(tmp_path / "ref"), "mesh",
+                         metric_trace=ref_trace)
+
+    kv4 = KVStoreMesh(mesh=make_mesh(n_devices=4, axis_names=("data",)))
+    res = _mesh_fit_ckpt(prefix, kv4, resume="auto")
+    a_ref, a_res = _params_np(ref), _params_np(res)
+    # trained onward on a different world: same keys/shapes, close
+    # trajectory (gradient reduction order differs across world sizes)
+    assert set(a_ref) == set(a_res)
+    for k in a_ref:
+        np.testing.assert_allclose(a_ref[k], a_res[k], rtol=1e-4,
+                                   atol=1e-5, err_msg=k)
+    # the 4-world run's own snapshots re-sharded to 4 files
+    m = checkpoint_manifest(prefix)
+    last = m["snapshots"][-1]
+    assert last["sharded"]["num_shards"] == 4
+
+
+@eight
+def test_sharded_snapshot_corrupt_shard_falls_back(tmp_path):
+    """A bit-flipped shard file invalidates ONLY its generation: resume
+    falls back to the previous (intact) one."""
+    prefix = str(tmp_path / "mesh")
+    # kill mid-epoch so the newest generation is a SNAPSHOT (an epoch
+    # checkpoint would outrank it and mask the fallback)
+    faults.arm("fit.preempt", at=BATCHES_PER_EPOCH + 2)
+    with pytest.raises(TrainingPreempted):
+        _mesh_fit_ckpt(prefix, "mesh")
+    faults.disarm()
+    m = checkpoint_manifest(prefix)
+    snaps = m["snapshots"]
+    assert len(snaps) >= 2
+    newest = snaps[-1]
+    victim = tmp_path / newest["sharded"]["shards"][3]["params"]
+    blob = bytearray(victim.read_bytes())
+    blob[len(blob) // 2] ^= 0xFF
+    victim.write_bytes(bytes(blob))
+    telemetry.enable()
+    st = load_latest_state(prefix)
+    assert st is not None
+    assert (st.epoch, st.nbatch) != (newest["epoch"], newest["nbatch"])
+    prev = snaps[-2]
+    assert (st.epoch, st.nbatch) == (prev["epoch"], prev["nbatch"])
+
+
+@eight
+def test_sharded_snapshot_gc_removes_shard_files(tmp_path):
+    prefix = str(tmp_path / "mesh")
+    os.environ["MXNET_CKPT_KEEP_LAST"] = "2"
+    try:
+        _mesh_fit_ckpt(prefix, "mesh")
+    finally:
+        os.environ.pop("MXNET_CKPT_KEEP_LAST", None)
+    m = checkpoint_manifest(prefix)
+    live = set()
+    for e in m["snapshots"]:
+        for ent in e["sharded"]["shards"]:
+            live.add(ent["params"])
+            if ent.get("states"):
+                live.add(ent["states"])
+    on_disk = {p.name for p in tmp_path.iterdir()
+               if "-snap-" in p.name and p.suffix in (".params",
+                                                      ".states")}
+    assert on_disk == live, on_disk ^ live
+    assert len(m["snapshots"]) == 2
