@@ -80,4 +80,35 @@ while [ "$i" -lt "$runs" ]; do
     -k "kill_resume or different_mesh or corrupt_shard"
   i=$((i + 1))
 done
+# sentinel half (docs/resilience.md "Watchdog, integrity audits &
+# supervised restarts"): wedge the training step at batch k via the
+# fit.wedge fault — the hang watchdog must dump + raise TrainingWedged,
+# the supervisor must restart, and the resumed run must end
+# bit-identical to a never-wedged one (kill -9 recovers the same way;
+# a crash loop must exhaust the restart budget into a typed failure,
+# never thrash).  The seed rotates the dataset and the wedge/kill
+# batch so the hang lands at different snapshot alignments.
+i=0
+while [ "$i" -lt "$runs" ]; do
+  echo "== sentinel wedge/restart chaos run $((i + 1))/$runs (MXNET_CHAOS_SEED=$i) =="
+  JAX_PLATFORMS=cpu MXNET_CHAOS_SEED="$i" \
+    python -m pytest tests/test_sentinel.py -q -p no:cacheprovider \
+    -k "supervised_restart or crash_loop or wedge_fault"
+  i=$((i + 1))
+done
+# integrity-audit half: flip one bit of one mesh replica via the
+# audit.bitflip fault on an 8-virtual-device fit(kvstore='mesh') — the
+# next cross-replica audit must catch it (typed ReplicaDivergence or a
+# clean rollback, per policy) and a clean run's audits must stay
+# silent.  The seed rotates the dataset and init so the flip lands on
+# different trained state.
+i=0
+while [ "$i" -lt "$runs" ]; do
+  echo "== sentinel bitflip/audit chaos run $((i + 1))/$runs (MXNET_CHAOS_SEED=$i) =="
+  JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    MXNET_CHAOS_SEED="$i" \
+    python -m pytest tests/test_sentinel.py -q -p no:cacheprovider \
+    -k "bitflip or audit_clean"
+  i=$((i + 1))
+done
 echo "CHAOS OK ($runs runs)"

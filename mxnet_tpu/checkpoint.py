@@ -56,7 +56,7 @@ from .base import MXNetError, atomic_write, atomic_write_bytes
 __all__ = ["TrainingPreempted", "Snapshot", "TrainingState",
            "AsyncSnapshotWriter", "snapshot_path", "write_snapshot",
            "gc_snapshots", "discard_snapshots_from", "load_latest_state",
-           "keep_last_default"]
+           "latest_generation_summary", "keep_last_default"]
 
 #: iterator states larger than this (JSON bytes) move to a per-
 #: generation sidecar file instead of the manifest — a shuffled
@@ -422,6 +422,50 @@ def _verified(path, want_sha, logger, what):
     return True
 
 
+def _generation_candidates(prefix, manifest):
+    """Every resumable generation under ``prefix`` as ``[(key, kind,
+    payload)]`` in the ONE recency convention shared by the verifying
+    resume scan and the supervisor's summary probe: an epoch checkpoint
+    E sits at key ``(E, -1)`` (so any mid-epoch snapshot of epoch E
+    sorts newer), snapshots at ``(epoch, nbatch)`` from the manifest
+    (malformed entries skipped)."""
+    from . import model as _model
+
+    candidates = []
+    for entry in manifest.get("snapshots", []):
+        try:
+            key = (int(entry["epoch"]), int(entry["nbatch"]))
+        except (KeyError, TypeError, ValueError):
+            continue
+        candidates.append((key, "snapshot", entry))
+    for epoch in _model.list_checkpoints(prefix):
+        candidates.append(((epoch, -1), "epoch", epoch))
+    return candidates
+
+
+def latest_generation_summary(prefix):
+    """Newest resumable generation under ``prefix`` from the MANIFEST
+    ALONE — ``{"epoch", "nbatch", "kind"}`` (``nbatch`` None for an
+    epoch checkpoint) or None.  No payload reads, no sha verification,
+    no array loads: this is the cheap "where would resume='auto' land"
+    probe the restart supervisor logs before each relaunch
+    (tools/supervise.py ``--prefix``); the authoritative, verifying
+    scan is :func:`load_latest_state` over the SAME candidate scan
+    (:func:`_generation_candidates`), so the two can't disagree about
+    recency."""
+    from . import model as _model
+
+    m = _model.checkpoint_manifest(prefix) or {}
+    candidates = _generation_candidates(prefix, m)
+    if not candidates:
+        return None
+    (epoch, nbatch), kind, _payload = max(candidates,
+                                          key=lambda c: c[0])
+    return {"epoch": epoch,
+            "nbatch": None if nbatch < 0 else nbatch,
+            "kind": "checkpoint" if kind == "epoch" else "snapshot"}
+
+
 def load_latest_state(prefix, logger=logging, want=None):
     """The richest verified training state under ``prefix``: mid-epoch
     snapshots and epoch-boundary checkpoints in ONE recency order
@@ -442,15 +486,7 @@ def load_latest_state(prefix, logger=logging, want=None):
 
     m = _model.checkpoint_manifest(prefix) or {}
     base_dir = os.path.dirname(os.path.abspath(prefix)) or "."
-    candidates = []
-    for entry in m.get("snapshots", []):
-        try:
-            key = (int(entry["epoch"]), int(entry["nbatch"]))
-        except (KeyError, TypeError, ValueError):
-            continue
-        candidates.append((key, "snapshot", entry))
-    for epoch in _model.list_checkpoints(prefix):
-        candidates.append(((epoch, -1), "epoch", epoch))
+    candidates = _generation_candidates(prefix, m)
     if want is not None:
         wkey = (int(want[0]), -1 if want[1] is None else int(want[1]))
         candidates = [c for c in candidates if c[0] == wkey]

@@ -372,6 +372,34 @@ def any_nonfinite(values):
     return bool(_ANY_NONFINITE_JIT(vals))
 
 
+def _global_norm_expr(values):
+    """Trace-time helper: one fused sum-of-squares over every floating
+    leaf → the global L2 norm as an f32 scalar.  Math in f32 so bf16
+    gradients don't overflow the square."""
+    total = jnp.zeros((), jnp.float32)
+    for v in values:
+        total = total + jnp.sum(jnp.square(v.astype(jnp.float32)))
+    return jnp.sqrt(total)
+
+
+_GLOBAL_NORM_JIT = None
+
+
+def global_norm(values):
+    """One jitted global-L2-norm reduction over ``values`` (device
+    arrays) → python float; single scalar transfer like
+    :func:`any_nonfinite`.  The statistic the training sentinel's
+    ``anomaly_policy`` z-scores (docs/resilience.md "Statistical
+    anomaly rollback")."""
+    vals = [v for v in values if jnp.issubdtype(v.dtype, jnp.floating)]
+    if not vals:
+        return 0.0
+    global _GLOBAL_NORM_JIT
+    if _GLOBAL_NORM_JIT is None:
+        _GLOBAL_NORM_JIT = jax.jit(_global_norm_expr)
+    return float(_GLOBAL_NORM_JIT(vals))
+
+
 def _kind_name(kind):
     """Human name of an executor program kind: the kind string itself,
     or a tuple kind's head (``("train_sgd", ...)`` -> ``"train_sgd"``,

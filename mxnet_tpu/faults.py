@@ -55,6 +55,20 @@ point                  effect when it fires
                          DURING the reshard itself; the quiesce deadline
                          evicts it and the surviving members restart the
                          cycle on the new membership epoch
+``fit.wedge``            the Nth training batch WEDGES: the step sleeps
+                         (in watchdog-interruptible slices) past the
+                         hang watchdog's deadline — a dead peer in a
+                         collective / stuck dispatch; the watchdog must
+                         dump the flight recorder + all-thread stacks
+                         and raise ``TrainingWedged`` instead of
+                         hanging forever (docs/resilience.md "Hang
+                         watchdog"); bounded by ``MXNET_WEDGE_FAULT_S``
+                         so an unwatched run still terminates
+``audit.bitflip``        ONE mesh replica of the first parameter gets a
+                         single bit flipped immediately before the Nth
+                         cross-replica integrity audit — a host/HBM
+                         bit-flip or bad collective; the audit must
+                         catch it (``ReplicaDivergence`` or rollback)
 ``serving.replica.kill`` the Nth decode step HARD-KILLS its engine
                          mid-generation (the engine closes permanently —
                          a crashed replica process, not a transient step
@@ -101,7 +115,7 @@ POINTS = ("kvstore.push.socket", "checkpoint.write", "fit.batch",
           "recordio.read", "serving.dispatch", "serving.model.write",
           "fit.preempt", "compile_cache.read", "serving.decode",
           "kvstore.membership", "elastic.reshard",
-          "serving.replica.kill")
+          "serving.replica.kill", "fit.wedge", "audit.bitflip")
 
 
 class FaultInjected(MXNetError):

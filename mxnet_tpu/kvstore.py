@@ -293,11 +293,14 @@ class KVStoreDist(KVStore):
         connection and ``reconnect()``.  ``MXNET_KVSTORE_CONNECT_DEADLINE``
         (seconds) bounds the whole sequence; the legacy
         ``MXNET_KVSTORE_CONNECT_TIMEOUT`` spelling is honored as a
-        fallback."""
+        fallback, and ``MXNET_RETRY_TOTAL_DEADLINE`` caps the cumulative
+        cross-attempt wall clock on top (RetryPolicy applies it) so a
+        flapping server can never compound the backoff into an unbounded
+        connect stall."""
         deadline = float(os.environ.get(
             "MXNET_KVSTORE_CONNECT_DEADLINE",
             os.environ.get("MXNET_KVSTORE_CONNECT_TIMEOUT", "120")))
-        return RetryPolicy(deadline=deadline, base_delay=0.2,
+        return RetryPolicy(deadline_s=deadline, base_delay=0.2,
                            max_delay=2.0, jitter=0.5)
 
     def _close_socks(self):

@@ -46,7 +46,8 @@ from .base import MXNetError
 from .kvstore import KVStore, _ctype_key_value
 
 __all__ = ["KVStoreMesh", "default_mesh", "zero_sgd_update",
-           "zero_eligible_names", "optimizer_state_hbm", "DATA_AXIS"]
+           "zero_eligible_names", "optimizer_state_hbm",
+           "build_replica_audit", "DATA_AXIS"]
 
 #: the mesh axis that shards the batch (and the ZeRO update rows)
 DATA_AXIS = "data"
@@ -251,6 +252,72 @@ def mesh_param_step(mesh, momentum, rescale_grad, clip_gradient,
         return new_p, new_m, flag
 
     return step
+
+
+# -- cross-replica integrity audit -------------------------------------------
+
+def _bit_checksum(x):
+    """uint32 wraparound sum of ``x``'s BIT PATTERN — not a float sum:
+    two replicas that differ by one flipped mantissa/exponent/sign bit
+    (or by a denormal/NaN payload a float compare would launder) always
+    produce different checksums, and -0.0 vs +0.0 — numerically equal,
+    bit-distinct — is flagged as the divergence it is.  Traced inside
+    the audit program; 8-byte dtypes bitcast to a (..., 2) uint32 view
+    (no uint64 dependence — jax's default x64-disabled mode would
+    silently truncate it)."""
+    import jax
+    import jax.numpy as jnp
+
+    if x.dtype == jnp.bool_:
+        u = x.astype(jnp.uint8)
+    elif jnp.issubdtype(x.dtype, jnp.integer) and x.dtype.itemsize <= 4:
+        u = x
+    else:
+        width = {1: jnp.uint8, 2: jnp.uint16, 4: jnp.uint32,
+                 8: jnp.uint32}[x.dtype.itemsize]
+        u = jax.lax.bitcast_convert_type(x, width)
+    return jnp.sum(u.astype(jnp.uint32))
+
+
+def build_replica_audit(mesh, axis_name=DATA_AXIS):
+    """ONE jitted program that verifies replica integrity in-graph.
+
+    Returns ``audit(arrays) -> jax array [mismatch_count, first_bad]``:
+    per mesh replica (shard along ``axis_name``), fold every input
+    array to its :func:`_bit_checksum`, ``all_gather`` the per-replica
+    checksum vectors over the axis, and count the arrays whose
+    checksums do NOT agree bit-exactly across replicas.  Replicated
+    params/aux MUST agree exactly — the cross-replica weight-update
+    sharding plane (Xu et al.) re-establishes replication every step
+    (ZeRO rows re-enter the replicated param through the update's
+    all-gather, which is how "ZeRO-owned rows checked post-gather"
+    falls out of auditing the params themselves) — so any difference
+    is silent divergence or corruption, not numerics.  The caller does
+    one small host read of the returned pair; everything else stays on
+    device (docs/resilience.md "Cross-replica integrity audits").
+
+    The per-replica view comes from ``shard_map`` with replicated
+    in-specs: each device contributes ITS OWN copy of every replicated
+    buffer, which is exactly what a bit-flip on one replica corrupts.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    def body(arrays):
+        local = jnp.stack([_bit_checksum(a) for a in arrays])
+        every = jax.lax.all_gather(local, axis_name)   # (world, n)
+        bad = jnp.any(every != every[0:1], axis=0)     # (n,)
+        count = jnp.sum(bad.astype(jnp.int32))
+        first = jnp.argmax(bad).astype(jnp.int32)      # 0 when clean
+        return jnp.stack([count, first])
+
+    # check_rep=False: the gathered comparison establishes the
+    # replicated output itself — same rationale as zero_sgd_update
+    sm = shard_map(body, mesh=mesh, in_specs=(P(),), out_specs=P(),
+                   check_rep=False)
+    return jax.jit(lambda arrays: sm(arrays))
 
 
 # -- accounting --------------------------------------------------------------

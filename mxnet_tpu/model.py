@@ -41,13 +41,18 @@ class BatchEndParam:
     callbacks and metrics can see when a batch tripped the policy."""
 
     def __init__(self, epoch, nbatch, eval_metric, locals=None,
-                 nan_detected=False, nan_action=None):
+                 nan_detected=False, nan_action=None,
+                 anomaly_detected=False, anomaly_action=None):
         self.epoch = epoch
         self.nbatch = nbatch
         self.eval_metric = eval_metric
         self.locals = locals
         self.nan_detected = nan_detected
         self.nan_action = nan_action
+        # statistical-anomaly observation fields (sentinel
+        # ``anomaly_policy``), mirroring the NaN pair
+        self.anomaly_detected = anomaly_detected
+        self.anomaly_action = anomaly_action
 
 
 def _create_kvstore(kvstore, num_device, arg_params):

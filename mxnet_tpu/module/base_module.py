@@ -23,6 +23,7 @@ from .. import faults as _faults
 from .. import metric as _metric
 from .. import perfdebug as _perfdebug
 from .. import random as _random
+from .. import sentinel as _sentinel
 from .. import telemetry as _telemetry
 from ..base import MXNetError
 from ..elastic import MembershipChanged, StaleEpoch, \
@@ -38,6 +39,11 @@ __all__ = ["BaseModule"]
 _ELASTIC_RESYNC = (StaleEpoch, MembershipChanged)
 
 _NAN_POLICIES = ("raise", "skip_batch", "rollback")
+#: ``anomaly_policy`` shares the nan_policy vocabulary: a statistical
+#: spike is handled exactly like a NaN is (docs/resilience.md
+#: "Statistical anomaly rollback")
+_ANOMALY_POLICIES = _NAN_POLICIES
+_AUDIT_POLICIES = ("raise", "rollback")
 
 #: end-of-iterator sentinel for the phase-timed batch loop (a data batch
 #: may legitimately be falsy, so ``None`` would be ambiguous)
@@ -123,6 +129,44 @@ def _preempt_signals(guard, logger, enable=True):
         _signal.signal(_signal.SIGTERM, prev_term)
         with _fit_signal_lock:
             _fit_signal_owner[0] = None
+
+
+@contextlib.contextmanager
+def _sigquit_dump(logger):
+    """Dump-on-demand for the fit scope: SIGQUIT (Ctrl-\\) writes a
+    flight-recorder + all-thread-stack dump WITHOUT killing the run —
+    the operator's "what is it doing right now" probe for a live job.
+    Same installer/finally-restore discipline as :func:`_preempt_signals`
+    (the graftlint signal-restore pass lints the restore half); the
+    handler only dumps, never raises, so training continues.  Main
+    thread only (Python forbids installs elsewhere); a nested fit just
+    replaces the outer fit's identical handler and restores it on
+    exit."""
+    sig = getattr(_signal, "SIGQUIT", None)
+    if sig is None or \
+            threading.current_thread() is not threading.main_thread():
+        yield
+        return
+
+    def handler(signum, frame):
+        # the dump runs on a SPAWNED thread, not inline: handlers
+        # execute between bytecodes of the interrupted frame, which may
+        # hold the (non-reentrant) telemetry/flight-recorder locks the
+        # dump needs — an inline dump would deadlock the training
+        # thread against itself.  Spawn-and-return lets the interrupted
+        # frame release its locks, and still works when the training
+        # thread is wedged in a C call (the usual reason to probe)
+        logger.warning("SIGQUIT: dumping flight recorder + thread "
+                       "stacks (run continues)")
+        threading.Thread(target=_sentinel.dump_on_demand,
+                         args=("sigquit",), name="sigquit-dump",
+                         daemon=True).start()
+
+    prev = _signal.signal(sig, handler)
+    try:
+        yield
+    finally:
+        _signal.signal(sig, prev)
 
 
 def _adapt_iter_state(state, target):
@@ -361,6 +405,10 @@ class BaseModule:
         for nbatch, eval_batch in enumerate(eval_data):
             if num_batch is not None and nbatch == num_batch:
                 break
+            # score emits no telemetry phases: tick the hang watchdog's
+            # liveness clock so a long validation pass inside an armed
+            # fit never reads as a wedged step (free when unarmed)
+            _sentinel.note_progress()
             self.forward(eval_batch, is_train=False)
             self.update_metric(eval_metric, eval_batch.label)
             if batch_end_callback is not None:
@@ -431,7 +479,8 @@ class BaseModule:
             monitor=None, checkpoint_prefix=None, checkpoint_period=1,
             resume=None, nan_policy=None, nan_check_period=None,
             prefetch_to_device=None, checkpoint_every_n_batches=None,
-            elastic=None):
+            elastic=None, anomaly_policy=None,
+            audit_every_n_batches=None):
         """reference ``base_module.py:369`` — THE training loop.
 
         Sync-free hot loop (docs/how_to/perf.md): eligible metrics are
@@ -484,6 +533,45 @@ class BaseModule:
             device-side reduction folded into the step; with
             ``nan_check_period=N`` the one-scalar flag read happens every
             N batches (amortized semantics: see docs/resilience.md).
+        ``anomaly_policy``
+            (default: the ``MXNET_ANOMALY_POLICY`` env var; None
+            disables)  Statistical anomaly guard generalizing
+            ``nan_policy``: the global gradient norm of every batch is
+            z-scored against a rolling window
+            (``MXNET_ANOMALY_WINDOW`` batches,
+            ``MXNET_ANOMALY_ZSCORE`` sigmas) and a finite spike trips
+            the same raise / skip_batch / rollback vocabulary — a loss
+            explosion is handled like a NaN is today, BEFORE the
+            poisoned update lands.  skip/rollback trips are bounded by
+            the consecutive ``MXNET_ROLLBACK_BUDGET`` (exhaustion
+            raises :class:`~mxnet_tpu.sentinel.AnomalyBudgetExhausted`).
+            Costs one scalar read per batch, and a staged fused step is
+            materialized two-phase (gradients must be inspectable) —
+            like ``monitor``, this is a diagnosis-over-fusion trade.
+        ``audit_every_n_batches``
+            (default: the ``MXNET_AUDIT_EVERY_N_BATCHES`` env var;
+            unset disables)  Cross-replica integrity audits for
+            ``kvstore='mesh'`` fits: every N batches ONE extra jitted
+            program folds per-param bit-pattern checksums per mesh
+            replica and compares them in-graph (replicated state must
+            agree EXACTLY; ZeRO-owned rows are covered post-gather
+            through the params they re-enter) — one tiny host read.
+            A mismatch emits ``reliability.divergence`` and, per
+            ``MXNET_AUDIT_POLICY``, raises
+            :class:`~mxnet_tpu.sentinel.ReplicaDivergence` or rolls
+            back to the last good checkpoint.
+        ``MXNET_WATCHDOG=1`` (env)
+            Arms the hang watchdog for the duration of the call: a
+            sentinel thread tracks per-batch progress against a
+            deadline auto-calibrated from the rolling median step time
+            and, on expiry, dumps the flight recorder + all-thread
+            stacks and raises
+            :class:`~mxnet_tpu.sentinel.TrainingWedged` in this thread
+            (``MXNET_WATCHDOG_ACTION``: raise/warn/exit) instead of
+            hanging forever.  Also maintains the
+            ``MXNET_HEARTBEAT_FILE`` heartbeat ``tools/supervise.py``
+            watches.  SIGQUIT during any fit writes the same dump
+            without killing the run.
         ``elastic``
             (default: the ``MXNET_ELASTIC`` env var) Elastic membership
             (docs/resilience.md "Elastic membership & resharding"): the
@@ -556,6 +644,34 @@ class BaseModule:
             raise MXNetError(
                 "nan_policy='rollback' needs checkpoint_prefix to know "
                 "what to roll back to")
+        if anomaly_policy is None:
+            anomaly_policy = os.environ.get("MXNET_ANOMALY_POLICY") or None
+        if anomaly_policy is not None \
+                and anomaly_policy not in _ANOMALY_POLICIES:
+            raise MXNetError("anomaly_policy must be one of %s, got %r"
+                             % (_ANOMALY_POLICIES, anomaly_policy))
+        if anomaly_policy == "rollback" and checkpoint_prefix is None:
+            raise MXNetError(
+                "anomaly_policy='rollback' needs checkpoint_prefix to "
+                "know what to roll back to")
+        if audit_every_n_batches is None:
+            audit_every_n_batches = int(os.environ.get(
+                "MXNET_AUDIT_EVERY_N_BATCHES", "0") or 0) or None
+        if audit_every_n_batches is not None \
+                and audit_every_n_batches < 1:
+            raise MXNetError(
+                "audit_every_n_batches must be >= 1, got %r"
+                % (audit_every_n_batches,))
+        audit_policy = os.environ.get("MXNET_AUDIT_POLICY") or "raise"
+        if audit_policy not in _AUDIT_POLICIES:
+            raise MXNetError("MXNET_AUDIT_POLICY must be one of %s, "
+                             "got %r" % (_AUDIT_POLICIES, audit_policy))
+        if audit_every_n_batches is not None \
+                and audit_policy == "rollback" \
+                and checkpoint_prefix is None:
+            raise MXNetError(
+                "MXNET_AUDIT_POLICY='rollback' needs checkpoint_prefix "
+                "to know what to roll back to")
         if resume not in (None, "auto"):
             raise MXNetError("resume must be None or 'auto', got %r"
                              % (resume,))
@@ -666,19 +782,25 @@ class BaseModule:
             # fit runs without a policy (stale accumulated flags would
             # otherwise leak into a later guarded run)
             self._install_nan_guard(nan_policy)
-        if nan_policy in ("skip_batch", "rollback"):
+        for pol_name, pol in (("nan_policy", nan_policy),
+                              ("anomaly_policy", anomaly_policy)):
+            if pol not in ("skip_batch", "rollback"):
+                continue
             kv = getattr(self, "_kvstore", None)
             if kv is not None and getattr(kv, "num_workers", 1) > 1 \
                     and not getattr(kv, "in_graph_sync", False):
-                # the NaN check sees only this rank's loss/grads, and
-                # skipping update() skips this rank's PS push — the other
-                # ranks still push, so sync rounds shift one step out of
-                # phase (and 'rollback' restores params on one rank only)
+                # the NaN/anomaly check sees only this rank's
+                # loss/grads (the anomaly z-score even judges against
+                # rank-LOCAL history), and skipping update() skips this
+                # rank's PS push — the other ranks still push, so sync
+                # rounds shift one step out of phase (and 'rollback'
+                # restores params on one rank only)
                 self.logger.warning(
-                    "nan_policy=%r is rank-local: skipping a batch in "
+                    "%s=%r is rank-local: skipping a batch in "
                     "multi-worker sync training desynchronizes parameter-"
-                    "server rounds across ranks; prefer nan_policy='raise' "
-                    "with resume='auto' for distributed runs", nan_policy)
+                    "server rounds across ranks; prefer %s='raise' "
+                    "with resume='auto' for distributed runs",
+                    pol_name, pol, pol_name)
         if validation_metric is None:
             validation_metric = eval_metric
         # materialize the validation metric ONCE so every epoch's score()
@@ -698,9 +820,15 @@ class BaseModule:
         # the fit.preempt fault ("deliver SIGTERM at batch k") needs the
         # per-batch loop for deterministic batch-k delivery, like
         # fit.batch does
+        # the sentinel's per-batch detectors (anomaly z-score, integrity
+        # audit cadence, the fit.wedge fault) need the per-batch loop —
+        # a scanned chunk has no batch boundaries to observe at
         use_bulk = bulk_k > 1 and monitor is None \
-            and nan_policy is None and not _faults.armed("fit.batch") \
+            and nan_policy is None and anomaly_policy is None \
+            and audit_every_n_batches is None \
+            and not _faults.armed("fit.batch") \
             and not _faults.armed("fit.preempt") \
+            and not _faults.armed("fit.wedge") \
             and not elastic and hasattr(self, "run_bulk")
         if use_bulk and hasattr(self, "_full_step_eligible") \
                 and not self._full_step_eligible():
@@ -740,6 +868,52 @@ class BaseModule:
                 self.logger.warning(
                     "NaN/Inf at epoch %d batch %d: skipping batch",
                     epoch, nbatch)
+
+        anomaly_detector = None
+        anomaly_budget = None
+        anomaly_consec = [0]  # consecutive skip/rollback trips
+        if anomaly_policy is not None:
+            anomaly_detector = _sentinel.AnomalyDetector()
+            anomaly_budget = int(os.environ.get(
+                "MXNET_ROLLBACK_BUDGET", "3") or 3)
+            _telemetry.declare("reliability.anomalies")
+
+        def _trip_anomaly(epoch, nbatch, value):
+            """Apply ``anomaly_policy`` to a z-score-flagged batch whose
+            update was WITHHELD (the grad-norm read happens before
+            ``update()``)."""
+            _telemetry.inc("reliability.anomalies", action=anomaly_policy)
+            _telemetry.event("reliability.anomaly", epoch=epoch,
+                             batch=nbatch, action=anomaly_policy,
+                             grad_norm=value)
+            _perfdebug.flight_dump("anomaly", epoch=epoch, nbatch=nbatch,
+                                   action=anomaly_policy, grad_norm=value)
+            if anomaly_policy == "raise":
+                raise MXNetError(
+                    "gradient-norm anomaly (%.4g) at epoch %d batch %d "
+                    "(anomaly_policy='raise')" % (value, epoch, nbatch))
+            anomaly_consec[0] += 1
+            if anomaly_consec[0] > anomaly_budget:
+                raise _sentinel.AnomalyBudgetExhausted(
+                    "anomaly_policy=%r tripped on %d consecutive batches "
+                    "(MXNET_ROLLBACK_BUDGET=%d): the spike is not "
+                    "transient — refusing to %s forever"
+                    % (anomaly_policy, anomaly_consec[0], anomaly_budget,
+                       anomaly_policy))
+            if anomaly_policy == "rollback":
+                self.logger.warning(
+                    "gradient-norm anomaly (%.4g) at epoch %d batch %d: "
+                    "rolling back to the last valid checkpoint and "
+                    "skipping the batch (%d/%d consecutive)",
+                    value, epoch, nbatch, anomaly_consec[0],
+                    anomaly_budget)
+                self._rollback_to_checkpoint(checkpoint_prefix)
+            else:
+                self.logger.warning(
+                    "gradient-norm anomaly (%.4g) at epoch %d batch %d: "
+                    "skipping batch (%d/%d consecutive)",
+                    value, epoch, nbatch, anomaly_consec[0],
+                    anomaly_budget)
 
         # device-side double-buffered prefetch: a background thread runs
         # each batch's host→device copy (honoring the module's sharding
@@ -825,12 +999,21 @@ class BaseModule:
         # visible to _rollback_to_checkpoint: a rollback must quiesce
         # the writer before discarding post-rollback snapshots
         self._active_ckpt_writer = writer
+        watchdog = None
+        if _sentinel.watchdog_enabled():
+            # the hang watchdog arms for exactly this fit's duration;
+            # start() runs HERE so the injection target is this thread
+            watchdog = _sentinel.Watchdog(logger=self.logger)
         try:
             # graceful preemption is tied to checkpointing: a fit that
             # never asked for a checkpoint_prefix keeps the process's
-            # own SIGTERM/SIGINT semantics (Ctrl-C still interrupts)
-            with _preempt_signals(guard, self.logger,
-                                  enable=checkpoint_prefix is not None):
+            # own SIGTERM/SIGINT semantics (Ctrl-C still interrupts);
+            # the SIGQUIT dump-on-demand probe is unconditional
+            with _sigquit_dump(self.logger), \
+                    _preempt_signals(guard, self.logger,
+                                     enable=checkpoint_prefix is not None):
+                if watchdog is not None:
+                    watchdog.start()
                 try:
                     if elastic_run is not None:
                         # initial rendezvous: adopt the membership epoch
@@ -853,7 +1036,13 @@ class BaseModule:
                                 nan_check_period, use_bulk, bulk_k,
                                 _trip_nan_policy, owns_iter, run=run,
                                 resume_nbatch=resume_nbatch,
-                                resume_metric_state=resume_metric_state)
+                                resume_metric_state=resume_metric_state,
+                                anomaly_policy=anomaly_policy,
+                                anomaly_detector=anomaly_detector,
+                                anomaly_consec=anomaly_consec,
+                                trip_anomaly=_trip_anomaly,
+                                audit_every=audit_every_n_batches,
+                                audit_policy=audit_policy)
                             break
                         except _ELASTIC_RESYNC as e:
                             if elastic_run is None:
@@ -869,13 +1058,15 @@ class BaseModule:
                                     (begin_epoch, resume_nbatch,
                                      resume_metric_state))
                 except Exception as e:
-                    # crash flight record: preemption and NaN trips
-                    # dumped at their own sites already (with richer
-                    # context); anything else dying out of fit gets the
-                    # generic crash dump before the exception escapes
+                    # crash flight record: preemption, NaN trips and
+                    # watchdog hangs dumped at their own sites already
+                    # (with richer context); anything else dying out of
+                    # fit gets the generic crash dump before the
+                    # exception escapes
                     from ..checkpoint import TrainingPreempted
 
-                    if not isinstance(e, TrainingPreempted):
+                    if not isinstance(e, (TrainingPreempted,
+                                          _sentinel.TrainingWedged)):
                         _perfdebug.flight_dump(
                             "crash",
                             error="%s: %s" % (type(e).__name__, e))
@@ -902,6 +1093,10 @@ class BaseModule:
                 train_data.reset()
         finally:
             self._active_ckpt_writer = None
+            if watchdog is not None:
+                # the monitor thread must never outlive its fit (a
+                # stale watchdog would inject into an innocent caller)
+                watchdog.stop()
             if writer is not None:
                 try:
                     writer.close()
@@ -921,7 +1116,10 @@ class BaseModule:
                     num_epoch, checkpoint_prefix, checkpoint_period,
                     nan_policy, nan_check_period, use_bulk, bulk_k,
                     _trip_nan_policy, owns_iter=False, run=None,
-                    resume_nbatch=None, resume_metric_state=None):
+                    resume_nbatch=None, resume_metric_state=None,
+                    anomaly_policy=None, anomaly_detector=None,
+                    anomaly_consec=None, trip_anomaly=None,
+                    audit_every=None, audit_policy="raise"):
         """The epoch/batch loop body of :meth:`fit` (split out so the
         device-prefetch wrapper can be closed deterministically).
 
@@ -1029,8 +1227,16 @@ class BaseModule:
                             "fault 'fit.batch': poisoning gradients with "
                             "NaN at epoch %d batch %d", epoch, nbatch)
                         self._poison_gradients_nan()
+                    if _faults.should_fire("fit.wedge"):
+                        self.logger.warning(
+                            "fault 'fit.wedge': wedging the step at "
+                            "epoch %d batch %d (the hang watchdog must "
+                            "trip)", epoch, nbatch)
+                        _sentinel.wedge_sleep()
                     nan_detected = False
                     nan_action = None
+                    anomaly_detected = False
+                    anomaly_action = None
                     staged = bool(getattr(self, "_pending_full", False))
                     window_all_staged = window_all_staged and staged
                     check_nan = nan_policy is not None and \
@@ -1044,7 +1250,21 @@ class BaseModule:
                     # never per-array host pulls.
                     tripped = check_nan and not staged \
                         and self._batch_has_nonfinite()
-                    if not tripped:
+                    anomaly_tripped = False
+                    anomaly_value = None
+                    if not tripped and anomaly_detector is not None:
+                        # grad-norm read BEFORE the update so a
+                        # skip/rollback trip really withholds the
+                        # poisoned step; a staged fused step is
+                        # materialized two-phase first (its gradients
+                        # must be inspectable — the monitor trade)
+                        with _telemetry.phase("sync"):
+                            anomaly_value = self._batch_grad_norm()
+                        anomaly_tripped = anomaly_detector.observe(
+                            anomaly_value)
+                        staged = bool(getattr(self, "_pending_full",
+                                              False))
+                    if not tripped and not anomaly_tripped:
                         with _telemetry.phase("update"):
                             self.update()
                         if check_nan and staged:
@@ -1054,13 +1274,28 @@ class BaseModule:
                         nan_action = nan_policy
                         _trip_nan_policy(epoch, nbatch,
                                          gated=window_all_staged)
+                    elif anomaly_tripped:
+                        anomaly_detected = True
+                        anomaly_action = anomaly_policy
+                        trip_anomaly(epoch, nbatch, anomaly_value)
                     else:
+                        if anomaly_consec is not None:
+                            anomaly_consec[0] = 0  # clean batch: budget
+                            # counts CONSECUTIVE trips only
                         with _telemetry.phase("metric"):
                             self.update_metric(eval_metric,
                                                data_batch.label)
                     if check_nan:
                         window_all_staged = True  # flag consumed: new window
                     _telemetry.inc("fit.batches")
+                    if audit_every is not None and \
+                            (nbatch + 1) % audit_every == 0:
+                        audit = getattr(self, "_run_integrity_audit",
+                                        None)
+                        if audit is not None:
+                            with _telemetry.phase("audit"):
+                                audit(audit_policy, checkpoint_prefix,
+                                      epoch, nbatch)
                     if monitor is not None:
                         monitor.toc_print()
                     if batch_end_callback is not None:
@@ -1068,7 +1303,9 @@ class BaseModule:
                             epoch=epoch, nbatch=nbatch,
                             eval_metric=eval_metric, locals=locals(),
                             nan_detected=nan_detected,
-                            nan_action=nan_action)
+                            nan_action=nan_action,
+                            anomaly_detected=anomaly_detected,
+                            anomaly_action=anomaly_action)
                         for callback in _as_list(batch_end_callback):
                             callback(batch_end_param)
                     if run is not None:
@@ -1081,10 +1318,12 @@ class BaseModule:
                             g=window_all_staged: self._drain_nan_window(
                                 nan_policy, nan_check_period, e, b, g,
                                 _trip_nan_policy),
-                            # a NaN-tripped batch's update never landed
-                            # (skipped or rolled back): it must not enter
-                            # the elastic data ledger as trained
-                            data_batch=None if nan_detected
+                            # a NaN- or anomaly-tripped batch's update
+                            # never landed (skipped or rolled back): it
+                            # must not enter the elastic data ledger as
+                            # trained
+                            data_batch=None
+                            if (nan_detected or anomaly_detected)
                             else data_batch)
                 # epoch-boundary drain: with nan_check_period > 1 the
                 # last window may not have been read yet — a NaN epoch
@@ -1117,6 +1356,9 @@ class BaseModule:
             if epoch_end_callback is not None:
                 for callback in _as_list(epoch_end_callback):
                     callback(epoch, self.symbol, arg_params_, aux_params_)
+                # user epoch-end work (uploads, evals) emits no phases:
+                # it is slow, not wedged — tick the watchdog
+                _sentinel.note_progress()
             if eval_data:
                 res = self.score(eval_data, validation_metric,
                                  score_end_callback=eval_end_callback,
@@ -1197,6 +1439,23 @@ class BaseModule:
                     if v.dtype.kind == "f" and not np.isfinite(v).all():
                         return True
                 return False
+
+    def _batch_grad_norm(self):
+        """Global L2 norm of the batch's parameter gradients as a python
+        float — the statistic ``anomaly_policy`` z-scores.  One jitted
+        sum-of-squares reduction + a single scalar transfer
+        (``executor.global_norm``); a staged fused step is materialized
+        first so the gradients exist to inspect."""
+        mat = getattr(self, "_materialize_pending", None)
+        if mat is not None:
+            mat()
+        ex = self._guard_exec()
+        if ex is None:
+            return 0.0
+        from ..executor import global_norm
+
+        return global_norm([g._jx for g in ex.grad_dict.values()
+                            if g is not None])
 
     def _poison_gradients_nan(self):
         """fault 'fit.batch': overwrite the first parameter gradient with

@@ -22,6 +22,7 @@ import time as _time_mod
 import numpy as np
 
 from .. import compile_cache as _compile_cache
+from .. import faults as _faults
 from .. import metric as _metric
 from .. import optimizer as opt
 from .. import perfdebug as _perfdebug
@@ -1281,6 +1282,117 @@ class Module(BaseModule):
     def install_monitor(self, mon):
         assert self.binded
         mon.install(self._exec)
+
+    # -- cross-replica integrity audit (docs/resilience.md) ---------------
+    def _audit_names(self):
+        """The replicated state the integrity audit fingerprints: every
+        parameter whose spec is fully replicated (TP-sharded
+        ``shard_rules`` params live intentionally split — there is no
+        cross-replica copy to compare) plus the aux states (BN stats).
+        ZeRO params ARE included: the update's all-gather re-enters
+        them replicated, which is how the ZeRO-owned rows get their
+        post-gather check."""
+        from jax.sharding import PartitionSpec as P
+
+        rep = P()
+        names = [n for n in self._param_names
+                 if self._param_spec(n) == rep
+                 and self._exec.arg_dict.get(n) is not None]
+        return names + list(self._aux_names)
+
+    def _audit_array(self, name):
+        d = self._exec.arg_dict.get(name)
+        return d if d is not None else self._exec.aux_dict[name]
+
+    def _bitflip_replica(self, name):
+        """fault 'audit.bitflip': rebuild ``name``'s replicated array
+        with ONE bit flipped on device 0's replica only — the observable
+        state of a host/HBM bit-flip or a corrupt collective that the
+        next audit must catch.  Uses per-device buffers under the same
+        replicated sharding, so nothing but the audited bit pattern
+        changes."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        arr = self._audit_array(name)
+        host = np.ascontiguousarray(np.asarray(arr._jx))  # host-sync: ok — fault-injection path, not the hot loop
+        bad = host.copy()
+        bad.view(np.uint8).flat[0] ^= 1
+        devs = list(self._mesh.devices.flat)
+        bufs = [jax.device_put(bad if i == 0 else host, d)
+                for i, d in enumerate(devs)]
+        arr._jx = jax.make_array_from_single_device_arrays(
+            host.shape, NamedSharding(self._mesh, P()), bufs)
+        self.logger.warning(
+            "fault 'audit.bitflip': flipped one bit of %r on replica 0",
+            name)
+
+    def _run_integrity_audit(self, policy, prefix, epoch, nbatch):
+        """One cross-replica integrity audit
+        (:func:`~mxnet_tpu.kvstore_mesh.build_replica_audit`): fold
+        per-param bit-pattern checksums per mesh replica, compare
+        in-graph, read ONE tiny result pair.  A mismatch is silent
+        divergence/corruption — replicated state must agree exactly —
+        and trips ``policy``: ``'raise'`` →
+        :class:`~mxnet_tpu.sentinel.ReplicaDivergence`, ``'rollback'``
+        → restore the last good checkpoint.  No-op (debug-logged once)
+        off the mesh plane or on a 1-device mesh, where there are no
+        replicas to disagree."""
+        kv = self._kvstore
+        if self._mesh is None or self._dist_dp or kv is None \
+                or not getattr(kv, "is_mesh", False) \
+                or int(self._mesh.shape[self._batch_axis_name()]) <= 1:
+            if not getattr(self, "_audit_skip_logged", False):
+                self._audit_skip_logged = True
+                self.logger.debug(
+                    "integrity audit skipped: needs fit(kvstore='mesh') "
+                    "with a >1-device data axis")
+            return None
+        names = self._audit_names()
+        if not names:
+            return None
+        if _faults.should_fire("audit.bitflip"):
+            self._bitflip_replica(names[0])
+        arrays = [self._audit_array(n)._jx for n in names]
+        key = (self._mesh,
+               tuple((a.shape, str(a.dtype)) for a in arrays))
+        cached = getattr(self, "_audit_fn_cache", None)
+        if cached is None or cached[0] != key:
+            from ..kvstore_mesh import build_replica_audit
+
+            cached = (key, _perfdebug.instrument(
+                build_replica_audit(self._mesh, self._batch_axis_name()),
+                self._exec._symbol_name(), "replica_audit"))
+            self._audit_fn_cache = cached
+        res = np.asarray(cached[1](arrays))  # host-sync: ok — the audit's one tiny result read
+        count, first = int(res[0]), int(res[1])
+        _telemetry.inc("reliability.audits")
+        if count == 0:
+            return 0
+        bad = names[first] if 0 <= first < len(names) else "?"
+        world = int(self._mesh.shape[self._batch_axis_name()])
+        _telemetry.inc("reliability.divergences")
+        _telemetry.event("reliability.divergence", epoch=epoch,
+                         batch=nbatch, arrays=count, first=bad,
+                         action=policy)
+        _perfdebug.flight_dump("divergence", epoch=epoch, nbatch=nbatch,
+                               arrays=count, first=bad)
+        if policy == "rollback":
+            self.logger.warning(
+                "integrity audit: %d replicated array(s) diverged "
+                "bit-wise across the %d-way mesh (first: %r); rolling "
+                "back to the last valid checkpoint", count, world, bad)
+            self._rollback_to_checkpoint(prefix)
+            return count
+        from ..sentinel import ReplicaDivergence
+
+        raise ReplicaDivergence(
+            "cross-replica integrity audit failed at epoch %d batch %d: "
+            "%d replicated array(s) diverged bit-wise across the %d-way "
+            "mesh (first: %r) — silent divergence or corruption "
+            "(replicated state must agree exactly; set "
+            "MXNET_AUDIT_POLICY=rollback to auto-recover)"
+            % (epoch, nbatch, count, world, bad))
 
     # -- compile-once warm-up (docs/how_to/perf.md "Compile once") --------
     def warm_from_manifest(self, manifest):

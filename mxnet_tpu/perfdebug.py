@@ -614,7 +614,7 @@ def _telemetry_phase_hook(family, phase, seconds):
                                  "seconds": round(seconds, 6)})
 
 
-_telemetry.set_phase_hook(_telemetry_phase_hook)
+_telemetry.add_phase_hook(_telemetry_phase_hook)
 
 
 def flight_dump(reason, **fields):

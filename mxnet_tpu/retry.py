@@ -15,7 +15,7 @@ import time
 
 from . import telemetry as _telemetry
 
-__all__ = ["RetryPolicy", "retry_call"]
+__all__ = ["RetryPolicy", "retry_call", "total_deadline_cap"]
 
 
 def _jitter_rng():
@@ -34,6 +34,25 @@ def _jitter_rng():
         return random.Random(seed)
 
 
+def total_deadline_cap():
+    """The process-wide cumulative retry ceiling
+    (``MXNET_RETRY_TOTAL_DEADLINE``, seconds; None when unset/invalid).
+    A fleet-wide guardrail: whatever per-site deadline a retry loop
+    picked, the CUMULATIVE wall clock across its attempts can never
+    exceed this — repeated transient failures (a flapping server that
+    accepts then drops every connect) otherwise compound per-attempt
+    backoff into an effectively unbounded stall that only the hang
+    watchdog would ever surface."""
+    raw = os.environ.get("MXNET_RETRY_TOTAL_DEADLINE")
+    if not raw:
+        return None
+    try:
+        v = float(raw)
+    except ValueError:
+        return None
+    return v if v > 0 else None
+
+
 class RetryPolicy:
     """Backoff schedule + deadline.
 
@@ -42,7 +61,10 @@ class RetryPolicy:
     deadline : float or None
         Wall-clock budget in seconds from the first attempt.  When the
         budget is exhausted the last exception propagates.  None retries
-        forever (callers should almost always set one).
+        forever (callers should almost always set one).  ``deadline_s``
+        is an accepted alias.  Either way the EFFECTIVE deadline is
+        capped by ``MXNET_RETRY_TOTAL_DEADLINE`` when that is set — the
+        cumulative cross-attempt ceiling no call site can opt out of.
     base_delay / max_delay : float
         First sleep and per-sleep cap (seconds); delays double each retry.
     jitter : float
@@ -54,7 +76,12 @@ class RetryPolicy:
     """
 
     def __init__(self, deadline=None, base_delay=0.1, max_delay=2.0,
-                 jitter=0.5, max_attempts=None):
+                 jitter=0.5, max_attempts=None, deadline_s=None):
+        if deadline is None:
+            deadline = deadline_s
+        cap = total_deadline_cap()
+        if cap is not None:
+            deadline = cap if deadline is None else min(deadline, cap)
         self.deadline = deadline
         self.base_delay = base_delay
         self.max_delay = max_delay

@@ -1,0 +1,618 @@
+"""Training sentinel — hang watchdog, anomaly detection, supervision.
+
+The stack survives crashes, SIGTERM preemptions, membership changes and
+replica kills (docs/resilience.md), but until this module three failure
+classes still defeated it:
+
+* a **wedged step** — a dead peer mid-collective, a stuck recordio
+  read, an XLA dispatch that never returns — hung the job forever with
+  no diagnosis;
+* **silent statistical divergence** — a loss/grad-norm spike that never
+  goes non-finite — trained garbage the NaN guard cannot see;
+* a **hard death** (kill -9, OOM) ended the job even though
+  ``resume="auto"`` could continue it.
+
+TensorFlow's design treats checkpoint/restore as the core
+fault-tolerance primitive (Abadi et al., 2016); the checkpoints exist —
+this module adds the *detection and supervision* that turns them into
+actual availability:
+
+:class:`Watchdog`
+    A monitor thread fed by the telemetry phase hook
+    (``telemetry.add_phase_hook``) tracks per-batch progress against a
+    deadline auto-calibrated from the rolling median step time
+    (``MXNET_STEP_DEADLINE_FACTOR`` x median, absolute floor
+    ``MXNET_STEP_DEADLINE_MS``).  On expiry it dumps the flight
+    recorder plus all-thread stacks, emits a ``reliability.hang``
+    event, and — per ``MXNET_WATCHDOG_ACTION`` — injects a typed
+    :class:`TrainingWedged` into the training thread (``raise``, the
+    default), logs and re-arms (``warn``), or hard-exits the process
+    with :data:`WEDGED_EXIT_CODE` for a supervisor to restart
+    (``exit``, the escape hatch for hangs stuck inside a C call that
+    an injected Python exception cannot unwind).  While armed it also
+    maintains the heartbeat file ``MXNET_HEARTBEAT_FILE`` that
+    :class:`Supervisor` watches.
+:class:`AnomalyDetector`
+    Rolling z-score over a scalar training statistic (fit feeds it the
+    global gradient norm, ``executor.global_norm``): a spike beyond
+    ``MXNET_ANOMALY_ZSCORE`` standard deviations of the
+    ``MXNET_ANOMALY_WINDOW``-batch window trips ``fit``'s
+    ``anomaly_policy`` — rollback-and-skip bounded by the consecutive
+    ``MXNET_ROLLBACK_BUDGET`` — so a finite loss spike is handled the
+    way a NaN is today.
+:class:`Supervisor`
+    Launches a training command, watches its exit code and the
+    sentinel-written heartbeat file, and restarts it (the command
+    resumes via ``resume="auto"``) with exponential backoff under
+    ``MXNET_RESTART_BUDGET``; a crash loop exhausts the budget into a
+    typed :class:`RestartBudgetExhausted` instead of thrashing.
+    ``tools/supervise.py`` is the CLI face.
+
+Cost model: everything here is OFF the hot loop.  A disabled watchdog
+is zero work (``fit`` never constructs one); an enabled one costs a
+timestamp store per timed phase on the phase-hook path and wakes its
+monitor thread a few times per deadline — no device syncs either way.
+The integrity-audit half of the sentinel lives where its collectives
+do (:func:`mxnet_tpu.kvstore_mesh.build_replica_audit`); ``fit`` wires
+both (docs/resilience.md "Watchdog, integrity audits & supervised
+restarts").
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import statistics
+import subprocess
+import sys
+import threading
+import time
+import traceback
+
+from . import perfdebug as _perfdebug
+from . import telemetry as _telemetry
+from .base import MXNetError
+from .compile_cache import _env_float, _env_int
+
+__all__ = ["TrainingWedged", "ReplicaDivergence", "AnomalyBudgetExhausted",
+           "RestartBudgetExhausted", "WEDGED_EXIT_CODE", "Watchdog",
+           "AnomalyDetector", "Supervisor", "watchdog_enabled",
+           "thread_stacks", "dump_on_demand", "wedge_sleep",
+           "note_progress"]
+
+#: exit code of a watchdog hard-exit (``MXNET_WATCHDOG_ACTION=exit``):
+#: distinct from Python's 1 and the shell's 126/127 so a supervisor can
+#: tell "wedged, restart me" from "broken command line"
+WEDGED_EXIT_CODE = 87
+
+
+class TrainingWedged(MXNetError):
+    """A training step exceeded the hang watchdog's deadline: the job
+    was making no per-batch progress (dead collective peer, stuck read,
+    dispatch that never returned).  The flight recorder + all-thread
+    stacks were dumped before this was raised."""
+
+
+class ReplicaDivergence(MXNetError):
+    """A cross-replica integrity audit found replicated state whose bit
+    patterns disagree across mesh replicas — silent divergence or
+    corruption (a bad all-gather, a host/HBM bit-flip), never float
+    noise: replicated arrays must agree exactly."""
+
+
+class AnomalyBudgetExhausted(MXNetError):
+    """``anomaly_policy`` tripped on more consecutive batches than the
+    rollback budget allows — the spike is not transient; refusing to
+    thrash rollback/skip forever."""
+
+
+class RestartBudgetExhausted(MXNetError):
+    """The supervisor's restart budget ran out: the command is crash-
+    looping, not recovering.  Carries ``restarts`` and ``last_exit``."""
+
+    def __init__(self, msg, restarts=0, last_exit=None):
+        super().__init__(msg)
+        self.restarts = restarts
+        self.last_exit = last_exit
+
+
+# -- knobs -------------------------------------------------------------------
+def watchdog_enabled():
+    """True when ``fit`` should arm the hang watchdog
+    (``MXNET_WATCHDOG=1``)."""
+    return os.environ.get("MXNET_WATCHDOG", "0") not in ("0", "", "false")
+
+
+# -- stack dumps -------------------------------------------------------------
+def thread_stacks():
+    """Every live thread's current stack as ``{thread_name: [frames]}``
+    — the "where is everyone stuck" half of a hang post-mortem (the
+    flight recorder's ring is the "what was it doing before" half)."""
+    names = {t.ident: t.name for t in threading.enumerate()}
+    out = {}
+    for tid, frame in sys._current_frames().items():
+        label = "%s (%d)" % (names.get(tid, "unknown"), tid)
+        out[label] = [ln.rstrip("\n")
+                      for ln in traceback.format_stack(frame)]
+    return out
+
+
+def dump_on_demand(reason="sigquit", **fields):
+    """Flight-recorder dump carrying all-thread stacks, without killing
+    anything — what the fit-scope SIGQUIT handler calls (and the
+    watchdog's trip path reuses).  Never raises; returns the dump path
+    or None (disabled recorder / write failure)."""
+    try:
+        stacks = thread_stacks()
+    except Exception:  # noqa: broad-except — diagnostics must not kill
+        stacks = {}
+    _telemetry.event("reliability.dump", reason=reason, **fields)
+    return _perfdebug.flight_dump(reason, stacks=stacks, **fields)
+
+
+def wedge_sleep():
+    """The ``fit.wedge`` fault body: hold the training step wedged in
+    20 ms slices — each slice boundary is a bytecode boundary, so the
+    watchdog's injected :class:`TrainingWedged` lands promptly (one
+    monolithic ``time.sleep`` would block the async exception until it
+    returned).  Bounded by ``MXNET_WEDGE_FAULT_S`` (default 30) so an
+    UNWATCHED run still terminates instead of trading a simulated hang
+    for a real one."""
+    limit = _env_float("MXNET_WEDGE_FAULT_S", 30.0)
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < limit:
+        time.sleep(0.02)
+
+
+# -- hang watchdog -----------------------------------------------------------
+
+#: watchdogs currently armed (normally 0 or 1) — module-level so
+#: phase-free loops can tick liveness without holding a reference
+_active_lock = threading.Lock()
+_active_watchdogs = []
+
+
+def note_progress():
+    """Refresh every armed watchdog's progress clock — the liveness
+    tick for work that emits no telemetry phases (the validation
+    ``score()`` pass, epoch-end callbacks).  One truthiness check when
+    no watchdog is armed."""
+    if not _active_watchdogs:
+        return
+    with _active_lock:
+        active = list(_active_watchdogs)
+    for wd in active:
+        wd.poke()
+
+
+class Watchdog:
+    """Per-batch-progress monitor for one ``fit`` call.
+
+    Fed by the telemetry phase hook: every timed ``fit``-family phase
+    exit refreshes the last-progress timestamp, and each ``data`` phase
+    exit (the start-of-batch marker) closes the previous step's wall
+    time into the rolling window the deadline is calibrated from —
+    ``max(floor_ms, factor x median(step))``, so a model with 10 s
+    steps and a model with 10 ms steps both get a deadline that means
+    "many steps late", never "one slow step".
+
+    ANY timed phase (any family) refreshes the progress clock — a
+    serving or bulk phase proves the process is alive too — and loops
+    that emit no phases at all (the validation ``score()`` pass,
+    epoch-end wrap-up) tick it through :func:`note_progress`.  Until
+    the first COMPLETED step, the deadline is 10x the floor: batch 0's
+    trace+compile must not read as a hang (see :meth:`deadline_s`).
+
+    The monitor thread wakes a few times per deadline, refreshes the
+    heartbeat file, and on expiry runs the trip sequence: flight dump +
+    stacks, ``reliability.hang``, then the configured action.  ``stop``
+    (in fit's ``finally``) unhooks and joins — the thread never
+    outlives its fit.
+    """
+
+    def __init__(self, action=None, factor=None, floor_ms=None,
+                 heartbeat_path=None, logger=None):
+        import logging
+
+        self.logger = logger or logging
+        self.action = action or os.environ.get(
+            "MXNET_WATCHDOG_ACTION", "raise")
+        if self.action not in ("raise", "warn", "exit"):
+            raise MXNetError(
+                "MXNET_WATCHDOG_ACTION must be raise/warn/exit, got %r"
+                % (self.action,))
+        self.factor = factor if factor is not None else _env_float(
+            "MXNET_STEP_DEADLINE_FACTOR", 10.0)
+        floor_ms = floor_ms if floor_ms is not None else _env_float(
+            "MXNET_STEP_DEADLINE_MS", 30000.0)
+        self.floor_s = max(0.01, floor_ms / 1000.0)
+        self.heartbeat_path = heartbeat_path if heartbeat_path is not None \
+            else (os.environ.get("MXNET_HEARTBEAT_FILE") or None)
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._steps = []          # rolling step-time window (bounded)
+        self._last_progress = None
+        self._batch_t0 = None
+        self._thread = None
+        self._target_tid = None
+        self._hook = None
+        self.tripped = 0
+
+    # -- feed (phase-hook thread = the training thread) -------------------
+    def _on_phase(self, family, phase, seconds):
+        now = time.monotonic()
+        with self._lock:
+            self._last_progress = now
+            # only the fit loop's data-phase exits feed the step-time
+            # calibration; every other phase is just proof of life
+            if family == "fit" and phase == "data":
+                if self._batch_t0 is not None:
+                    self._steps.append(now - self._batch_t0)
+                    if len(self._steps) > 64:
+                        del self._steps[0]
+                self._batch_t0 = now
+
+    def poke(self):
+        """Liveness tick for phase-free work (see
+        :func:`note_progress`)."""
+        with self._lock:
+            self._last_progress = time.monotonic()
+
+    def deadline_s(self):
+        """Current deadline: ``factor x median(step)`` once ≥5 steps
+        are observed, never below the floor — and 10x the floor until
+        the FIRST COMPLETED step (startup grace).  The grace keys on a
+        completed step, not on any phase: batch 0's fast ``data`` phase
+        exits milliseconds in, while the trace+compile that must not
+        read as a hang runs inside the subsequent ``forward_backward``
+        phase — only the next ``data`` exit proves a whole step really
+        finished."""
+        with self._lock:
+            steps = list(self._steps)
+        if not steps:
+            return self.floor_s * 10.0
+        if len(steps) >= 5:
+            return max(self.floor_s, self.factor * statistics.median(steps))
+        # warm-up (1-4 steps): the MAX observed step carries the full
+        # factor — a model whose steps are slower than the floor must
+        # not be killed right after batch 1 just because the median
+        # isn't trustworthy yet
+        return max(self.floor_s, self.factor * max(steps))
+
+    # -- lifecycle --------------------------------------------------------
+    def start(self):
+        """Arm: register the phase hook, remember the CALLING thread as
+        the injection target, start the monitor.  Forces telemetry ON
+        (the flight-recorder precedent): the phase hook IS the progress
+        feed, and disabled telemetry never reaches hooks — an armed
+        watchdog over dark telemetry would false-trip on a healthy
+        job."""
+        if self._thread is not None:
+            return self
+        _telemetry.enable()
+        self._target_tid = threading.get_ident()
+        with self._lock:
+            self._last_progress = time.monotonic()
+        self._hook = _telemetry.add_phase_hook(self._on_phase)
+        with _active_lock:
+            _active_watchdogs.append(self)
+        self._thread = threading.Thread(target=self._monitor,
+                                        name="sentinel-watchdog",
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        """Disarm: unhook, stop and join the monitor thread."""
+        if self._thread is None:
+            return
+        with _active_lock:
+            if self in _active_watchdogs:
+                _active_watchdogs.remove(self)
+        _telemetry.remove_phase_hook(self._hook)
+        self._stop.set()
+        self._thread.join(timeout=10)
+        self._thread = None
+        # final beat carries done=True: the supervisor must not treat
+        # "fit finished, post-fit tail running" (final eval, export) as
+        # a wedge just because the mtime froze — a later fit's fresh
+        # beats overwrite the marker
+        self._write_heartbeat(0.0, done=True)
+
+    # -- monitor thread ---------------------------------------------------
+    def _monitor(self):
+        while True:
+            deadline = self.deadline_s()
+            interval = min(1.0, max(0.02, deadline / 8.0))
+            if self._stop.wait(interval):
+                return
+            with self._lock:
+                last = self._last_progress
+            age = time.monotonic() - last
+            self._write_heartbeat(age)
+            if age > deadline:
+                self._trip(age, deadline)
+                if self._stop.wait(deadline):
+                    # post-trip grace: give the injected exception (or
+                    # the warn-only operator) a full deadline before
+                    # re-tripping, so one hang is one dump, not a storm
+                    return
+                with self._lock:
+                    self._last_progress = time.monotonic()
+
+    def _write_heartbeat(self, age, done=False):
+        if not self.heartbeat_path:
+            return
+        tmp = self.heartbeat_path + ".tmp"
+        try:
+            with open(tmp, "w") as f:
+                json.dump({"ts": round(time.time(), 3),
+                           "pid": os.getpid(),
+                           "progress_age_s": round(age, 3),
+                           "done": done}, f)
+            os.replace(tmp, self.heartbeat_path)
+        except OSError as e:
+            self.logger.debug("watchdog: heartbeat write failed: %s", e)
+
+    def _trip(self, age, deadline):
+        self.tripped += 1
+        _telemetry.inc("reliability.hangs")
+        _telemetry.event("reliability.hang", age_s=round(age, 3),
+                         deadline_s=round(deadline, 3),
+                         action=self.action)
+        dump_on_demand("hang", age_s=round(age, 3),
+                       deadline_s=round(deadline, 3))
+        self.logger.error(
+            "watchdog: no training progress for %.1fs (deadline %.1fs, "
+            "%s median-calibrated) — %s", age, deadline,
+            "floor" if deadline == self.floor_s else "step", self.action)
+        if self.action == "exit":
+            # for hangs wedged inside a C call: an injected Python
+            # exception cannot unwind those — die with the wedged code
+            # and let the supervisor restart from resume="auto"
+            os._exit(WEDGED_EXIT_CODE)
+        if self.action == "raise":
+            self._inject(TrainingWedged)
+
+    def _inject(self, exc_type):
+        """Raise ``exc_type`` asynchronously in the training thread (the
+        thread that called :meth:`start`).  Lands at the target's next
+        bytecode boundary — a hang in pure-C land needs
+        ``MXNET_WATCHDOG_ACTION=exit`` instead."""
+        import ctypes
+
+        res = ctypes.pythonapi.PyThreadState_SetAsyncExc(
+            ctypes.c_ulong(self._target_tid), ctypes.py_object(exc_type))
+        if res > 1:  # pragma: no cover - interpreter-level failure
+            ctypes.pythonapi.PyThreadState_SetAsyncExc(
+                ctypes.c_ulong(self._target_tid), None)
+            self.logger.error("watchdog: async exception injection "
+                              "failed (tid %s)", self._target_tid)
+
+
+# -- statistical anomaly detection -------------------------------------------
+class AnomalyDetector:
+    """One-sided ROBUST rolling z-score over a scalar training
+    statistic.
+
+    ``observe(value)`` returns True when ``value`` spikes more than
+    ``zscore`` robust standard deviations ABOVE the rolling window (a
+    collapse toward zero is convergence, not divergence).  The scale is
+    median/MAD, not mean/std: one outlier that slipped into the window
+    (e.g. during warm-up) would inflate a stdev enough to hide every
+    later spike behind it, while the median baseline shrugs it off.
+    An anomalous value is NOT folded into the window — a spike must not
+    poison the baseline it was judged against — and a non-finite value
+    is always anomalous (belt and suspenders under
+    ``nan_policy=None``).  The first ``min_samples`` observations only
+    warm the window."""
+
+    def __init__(self, window=None, zscore=None, min_samples=8):
+        self.window = window if window is not None else _env_int(
+            "MXNET_ANOMALY_WINDOW", 32)
+        if self.window < min_samples:
+            raise MXNetError(
+                "anomaly window must be >= %d, got %d"
+                % (min_samples, self.window))
+        self.zscore = zscore if zscore is not None else _env_float(
+            "MXNET_ANOMALY_ZSCORE", 6.0)
+        self.min_samples = min_samples
+        self._values = []
+
+    def observe(self, value):
+        value = float(value)
+        if not math.isfinite(value):
+            return True
+        if len(self._values) >= self.min_samples:
+            med = statistics.median(self._values)
+            mad = statistics.median(abs(v - med) for v in self._values)
+            # 1.4826: MAD -> stdev for a normal window.  Scale floor: a
+            # converged, near-constant window (MAD ~ 0) must not turn
+            # harmless jitter into 6-sigma events — the floor means a
+            # trip always needs at least zscore x 5% headroom over the
+            # median
+            scale = max(1.4826 * mad, 0.05 * abs(med), 1e-12)
+            if (value - med) / scale > self.zscore:
+                return True
+        self._values.append(value)
+        if len(self._values) > self.window:
+            del self._values[0]
+        return False
+
+
+# -- supervised auto-restart -------------------------------------------------
+class Supervisor:
+    """Launch-and-keep-alive harness for one training command.
+
+    Runs ``cmd`` as a child process with ``MXNET_HEARTBEAT_FILE``
+    pointed at ``heartbeat_path`` (the child's watchdog maintains it).
+    Exit 0 ends supervision; ANY other death — nonzero exit, signal,
+    the watchdog's :data:`WEDGED_EXIT_CODE`, or a live-but-heartbeat-
+    stale child (killed hard, counted as wedged) — is restarted with
+    exponential backoff, relying on the command's own
+    ``resume="auto"`` to continue from its newest checkpoint.  More
+    than ``budget`` restarts raises :class:`RestartBudgetExhausted`:
+    a crash loop is a bug report, not a retry schedule.  The budget
+    counts the CRASH LOOP, not the job's lifetime: a child that ran
+    healthy for ``healthy_reset_s`` (default 300) before dying resets
+    the counter — six preemptions across a week is availability
+    working, six deaths in two minutes is the bug report.
+
+    Heartbeat watching: a child that never writes a FRESH heartbeat
+    (startup deadlock — hung import, stuck rendezvous) is killed once
+    ``2 x heartbeat_timeout`` passes since launch (the 2x is startup
+    allowance: import + fit arming happen before the watchdog's first
+    write); after the first fresh write, plain ``heartbeat_timeout``
+    staleness applies."""
+
+    def __init__(self, cmd, budget=None, backoff_base=1.0,
+                 backoff_max=60.0, heartbeat_path=None,
+                 heartbeat_timeout=None, poll_s=0.2, logger=None,
+                 resume_prefix=None, healthy_reset_s=300.0):
+        import logging
+
+        self.cmd = list(cmd)
+        #: checkpoint prefix for the pre-restart "where will resume
+        #: land" log line (manifest-only probe; optional)
+        self.resume_prefix = resume_prefix
+        self.budget = budget if budget is not None else _env_int(
+            "MXNET_RESTART_BUDGET", 5)
+        self.backoff_base = backoff_base
+        self.backoff_max = backoff_max
+        self.heartbeat_path = heartbeat_path
+        self.heartbeat_timeout = heartbeat_timeout
+        self.poll_s = poll_s
+        self.logger = logger or logging
+        self.healthy_reset_s = healthy_reset_s
+        self.restarts = 0
+        self._launched_at = None
+        self._proc = None
+
+    def terminate(self):
+        """Stop supervising AND stop the child: terminate (then kill)
+        any live child process.  The CLI's interrupt path calls this so
+        Ctrl-C on the supervisor never leaves an orphaned training run
+        writing snapshots under the same prefix as a future
+        relaunch."""
+        proc = self._proc
+        if proc is None or proc.poll() is not None:
+            return
+        proc.terminate()
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait()
+
+    def _heartbeat_stale(self):
+        if not self.heartbeat_path or not self.heartbeat_timeout:
+            return False
+        try:
+            mtime = os.path.getmtime(self.heartbeat_path)
+        except OSError:
+            mtime = None  # never written at all
+        fresh = mtime is not None and (
+            self._launched_at is None or mtime >= self._launched_at)
+        if not fresh:
+            # no heartbeat from THIS incarnation yet (missing file, or
+            # a leftover from the previous one): startup grace — but a
+            # BOUNDED one, or a child wedged before arming its watchdog
+            # (hung import, stuck rendezvous) would be polled forever
+            return self._launched_at is not None and \
+                time.time() - self._launched_at > 2 * self.heartbeat_timeout
+        if time.time() - mtime <= self.heartbeat_timeout:
+            return False
+        # stale by mtime — but the watchdog's final beat marks a CLEAN
+        # disarm (fit finished; the child is in its post-fit tail:
+        # final eval, export).  Slow is not wedged; only read the
+        # payload on this already-stale path
+        try:
+            if json.load(open(self.heartbeat_path)).get("done"):
+                return False
+        except (OSError, ValueError):
+            pass  # torn/unreadable beat: treat as the stale it looks like
+        return True
+
+    def _run_once(self):
+        """One child lifetime; returns its exit code (negative on
+        signal), or :data:`WEDGED_EXIT_CODE` for a heartbeat-stale
+        kill."""
+        env = dict(os.environ)
+        if self.heartbeat_path:
+            env["MXNET_HEARTBEAT_FILE"] = self.heartbeat_path
+        self._launched_at = time.time()
+        proc = self._proc = subprocess.Popen(self.cmd, env=env)
+        _telemetry.event("reliability.supervise.launch", pid=proc.pid,
+                         restarts=self.restarts)
+        while True:
+            rc = proc.poll()
+            if rc is not None:
+                return rc
+            if self._heartbeat_stale():
+                self.logger.error(
+                    "supervise: heartbeat %s stale beyond %.1fs — "
+                    "killing wedged pid %d", self.heartbeat_path,
+                    self.heartbeat_timeout, proc.pid)
+                proc.kill()
+                proc.wait()
+                return WEDGED_EXIT_CODE
+            time.sleep(self.poll_s)
+
+    def run(self):
+        """Supervise until the command succeeds (returns 0) or the
+        restart budget is exhausted (raises
+        :class:`RestartBudgetExhausted`)."""
+        while True:
+            rc = self._run_once()
+            if rc == 0:
+                _telemetry.event("reliability.supervise.done",
+                                 restarts=self.restarts)
+                return 0
+            uptime = time.time() - self._launched_at
+            if self.restarts and self.healthy_reset_s \
+                    and uptime >= self.healthy_reset_s:
+                # the child ran healthy for a long stretch before this
+                # death: not a crash loop — the budget guards against
+                # thrash, not against a long job's lifetime misfortune
+                self.logger.info(
+                    "supervise: child was healthy for %.0fs — restart "
+                    "budget reset", uptime)
+                self.restarts = 0
+            self.restarts += 1
+            _telemetry.inc("reliability.restarts")
+            _telemetry.event("reliability.supervise.restart",
+                             exit_code=rc, restarts=self.restarts,
+                             wedged=rc == WEDGED_EXIT_CODE)
+            if self.restarts > self.budget:
+                raise RestartBudgetExhausted(
+                    "restart budget exhausted after %d restart(s); last "
+                    "exit code %s — the command is crash-looping, not "
+                    "recovering (fix the job; the newest checkpoint "
+                    "under its prefix is intact)"
+                    % (self.restarts - 1, rc),
+                    restarts=self.restarts - 1, last_exit=rc)
+            delay = min(self.backoff_max,
+                        self.backoff_base * (2.0 ** (self.restarts - 1)))
+            self.logger.warning(
+                "supervise: command exited %s (%s); restart %d/%d in "
+                "%.1fs (resume='auto' continues from the newest "
+                "checkpoint)", rc,
+                "wedged" if rc == WEDGED_EXIT_CODE else "crashed",
+                self.restarts, self.budget, delay)
+            if self.resume_prefix:
+                from .checkpoint import latest_generation_summary
+
+                gen = latest_generation_summary(self.resume_prefix)
+                if gen is None:
+                    self.logger.warning(
+                        "supervise: no resumable generation under %r "
+                        "yet — the restart begins from scratch",
+                        self.resume_prefix)
+                else:
+                    self.logger.info(
+                        "supervise: newest resumable generation: %s "
+                        "epoch %d%s", gen["kind"], gen["epoch"],
+                        "" if gen["nbatch"] is None
+                        else " batch %d" % gen["nbatch"])
+            time.sleep(delay)

@@ -47,7 +47,8 @@ __all__ = ["enabled", "enable", "disable", "inc", "declare", "set_gauge",
            "observe", "event", "phase", "snapshot", "dump", "dump_events",
            "prometheus_text", "write_prometheus", "reset", "sample_memory",
            "phase_totals", "counter_total", "gauge_value", "hist_quantile",
-           "events_recent", "set_phase_hook"]
+           "events_recent", "add_phase_hook", "remove_phase_hook",
+           "set_phase_hook"]
 
 #: default histogram bucket upper bounds (seconds-flavored; callers may
 #: pass their own on first ``observe`` of a metric)
@@ -69,7 +70,14 @@ _enabled = (os.environ.get("MXNET_TELEMETRY", "0")
             # exactly when the post-mortem needs them
             or os.environ.get("MXNET_FLIGHT_RECORDER", "")
             not in ("0", "", "false")
-            or bool(os.environ.get("MXNET_FLIGHT_RECORDER_DIR")))
+            or bool(os.environ.get("MXNET_FLIGHT_RECORDER_DIR"))
+            # an armed hang watchdog (sentinel) implies telemetry the
+            # same way: its whole progress feed is the phase hook, and
+            # phase exits only reach hooks while telemetry records — a
+            # watchdog without telemetry would see a healthy job as
+            # eternally stalled and false-trip at the deadline floor
+            or os.environ.get("MXNET_WATCHDOG", "")
+            not in ("0", "", "false"))
 
 
 def enabled():
@@ -176,17 +184,53 @@ def events_recent(n=100):
         return [dict(r) for r in list(_events)[-int(n):]]
 
 
-#: optional per-phase observer installed by :mod:`mxnet_tpu.perfdebug`:
-#: called as ``hook(family, phase_name, seconds)`` from an ENABLED
-#: phase's exit — the flight recorder's per-batch timing feed.  One
-#: attribute check when unset; disabled telemetry never reaches it.
-_phase_hook = None
+#: registered per-phase observers, each called as ``hook(family,
+#: phase_name, seconds)`` from an ENABLED phase's exit.  Two consumers
+#: exist today — the flight recorder's per-batch timing feed
+#: (:mod:`mxnet_tpu.perfdebug`) and the training watchdog's progress
+#: feed (:mod:`mxnet_tpu.sentinel`) — which is exactly why this is a
+#: LIST: the old single ``set_phase_hook`` slot meant whoever installed
+#: second silently evicted the other.  Stored as a tuple so the hot
+#: path iterates a stable snapshot (one truthiness check when empty);
+#: registration swaps the whole tuple under ``_lock``.
+_phase_hooks = ()
+#: the hook installed through the deprecated ``set_phase_hook`` alias
+#: (so a second ``set_phase_hook`` call keeps its replace semantics
+#: without evicting ``add_phase_hook`` registrations)
+_set_alias_hook = None
+
+
+def add_phase_hook(hook):
+    """Register a phase observer (``hook(family, phase, seconds)``);
+    duplicate registrations are ignored.  Returns ``hook`` so callers
+    can hold it for :func:`remove_phase_hook`."""
+    global _phase_hooks
+    with _lock:
+        if hook not in _phase_hooks:
+            _phase_hooks = _phase_hooks + (hook,)
+    return hook
+
+
+def remove_phase_hook(hook):
+    """Unregister a phase observer; unknown hooks are a no-op."""
+    global _phase_hooks
+    with _lock:
+        _phase_hooks = tuple(h for h in _phase_hooks if h is not hook)
 
 
 def set_phase_hook(hook):
-    """Install (or clear, with None) the phase observer."""
-    global _phase_hook
-    _phase_hook = hook
+    """Deprecated single-slot spelling: replaces only the hook a
+    previous ``set_phase_hook`` installed (or clears it with ``None``)
+    — registrations made through :func:`add_phase_hook` are never
+    evicted.  New code should use ``add_phase_hook`` /
+    ``remove_phase_hook``."""
+    global _phase_hooks, _set_alias_hook
+    with _lock:
+        hooks = tuple(h for h in _phase_hooks if h is not _set_alias_hook)
+        _set_alias_hook = hook
+        if hook is not None:
+            hooks = hooks + (hook,)
+        _phase_hooks = hooks
 
 
 class phase:
@@ -227,8 +271,9 @@ class phase:
                 end = _profiler._now_us()
                 _profiler.record("%s:%s" % (self._family, self._name),
                                  "phase", end - dt * 1e6, end)
-            if _phase_hook is not None:
-                _phase_hook(self._family, self._name, dt)
+            if _phase_hooks:
+                for hook in _phase_hooks:
+                    hook(self._family, self._name, dt)
         return False
 
 

@@ -1589,3 +1589,51 @@ def test_elastic_iter_restores_rank():
     b_other = other.next()
     assert np.array_equal(np.asarray(b_it.index),
                           np.asarray(b_other.index))
+
+
+# -- ISSUE 15: the sentinel's threads stay lock-discipline clean -------------
+
+def test_sentinel_lock_discipline_clean_no_baseline():
+    """The watchdog monitor / supervisor land with ZERO lock-discipline
+    baseline entries (and signal-restore stays clean over the fit-scope
+    SIGQUIT installer)."""
+    targets = [ROOT / "mxnet_tpu" / "sentinel.py",
+               ROOT / "tools" / "supervise.py",
+               ROOT / "mxnet_tpu" / "module" / "base_module.py"]
+    for pass_id in ("lock-discipline", "signal-restore"):
+        res = run_pass(by_id(pass_id)(), RunContext(roots=targets))
+        assert not active(res), (pass_id,
+                                 [f.message for f in active(res)])
+    baseline = glbaseline.load()
+    blob = json.dumps(baseline.get("passes", {}))
+    assert "sentinel" not in blob and "supervise" not in blob, \
+        "sentinel/supervisor must carry no baseline debt"
+
+
+def test_mutation_stripping_watchdog_progress_lock_is_caught(tmp_path):
+    """Strip the lock around the watchdog's last-progress timestamp
+    (the phase-hook write the monitor thread reads against the
+    deadline): lock-discipline must fire — an unlocked write there is
+    exactly the torn-read race that turns a healthy job into a false
+    hang trip (ISSUE 15 satellite)."""
+    pristine = tmp_path / "sentinel_ok.py"
+    pristine.write_text((ROOT / "mxnet_tpu" / "sentinel.py").read_text())
+    res0 = run_pass(by_id("lock-discipline")(),
+                    RunContext(roots=[pristine]))
+    assert not active(res0), [f.message for f in active(res0)]
+
+    mutated = _mutated_copy(
+        tmp_path, "mxnet_tpu/sentinel.py",
+        "        now = time.monotonic()\n"
+        "        with self._lock:\n"
+        "            self._last_progress = now",
+        "        now = time.monotonic()\n"
+        "        if True:\n"
+        "            self._last_progress = now",
+        "sentinel_mut.py")
+    res1 = run_pass(by_id("lock-discipline")(),
+                    RunContext(roots=[mutated]))
+    assert any(f.code == "unlocked-write"
+               and "_last_progress" in f.message
+               for f in active(res1)), \
+        [f.message for f in res1.findings]

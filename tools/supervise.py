@@ -1,0 +1,107 @@
+#!/usr/bin/env python
+"""Supervised auto-restart harness for training commands.
+
+The process half of the training sentinel (docs/resilience.md
+"Watchdog, integrity audits & supervised restarts"): launches a
+training command, watches its exit code AND the heartbeat file the
+in-process watchdog maintains (``MXNET_HEARTBEAT_FILE`` is exported to
+the child automatically), and restarts it with exponential backoff —
+the command's own ``resume="auto"`` continues from the newest
+checkpoint, so a kill -9, an OOM, or a watchdog hard-exit
+(:data:`~mxnet_tpu.sentinel.WEDGED_EXIT_CODE`) costs at most the work
+since the last snapshot.  A crash loop exhausts
+``MXNET_RESTART_BUDGET`` (``--budget``) into a typed
+:class:`~mxnet_tpu.sentinel.RestartBudgetExhausted` failure — exit
+code 75 (EX_TEMPFAIL) — instead of thrashing forever.
+
+Usage::
+
+    python tools/supervise.py [options] -- python train.py ...
+
+    --budget N              restarts allowed (default MXNET_RESTART_BUDGET / 5)
+    --backoff-base S        first restart delay, doubles per restart (1.0)
+    --backoff-max S         delay cap (60.0)
+    --heartbeat PATH        heartbeat file to export + watch
+    --heartbeat-timeout S   stale-heartbeat kill threshold (off unless set;
+                            needs --heartbeat and MXNET_WATCHDOG=1 in the
+                            child so something writes it)
+    --poll S                child poll interval (0.2)
+
+Exit status: the child's final 0 on success, 75 when the restart
+budget is exhausted (the last child exit code is printed).
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+
+# runnable as a script from anywhere: resolve the framework from the
+# repo this tool lives in (the tools/ convention)
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description="supervise a training command: restart on crash / "
+                    "wedge, resume via resume='auto'",
+        usage="supervise.py [options] -- command [args...]")
+    parser.add_argument("--budget", type=int, default=None,
+                        help="restarts allowed before the typed failure "
+                             "(default: MXNET_RESTART_BUDGET or 5)")
+    parser.add_argument("--backoff-base", type=float, default=1.0)
+    parser.add_argument("--backoff-max", type=float, default=60.0)
+    parser.add_argument("--heartbeat", default=None,
+                        help="heartbeat file exported to the child as "
+                             "MXNET_HEARTBEAT_FILE and watched here")
+    parser.add_argument("--heartbeat-timeout", type=float, default=None,
+                        help="kill -9 + restart when the heartbeat goes "
+                             "this many seconds stale")
+    parser.add_argument("--poll", type=float, default=0.2)
+    parser.add_argument("--prefix", default=None,
+                        help="checkpoint prefix: before each restart, "
+                             "log the newest resumable generation "
+                             "(manifest-only probe)")
+    parser.add_argument("cmd", nargs=argparse.REMAINDER,
+                        help="-- command [args...]")
+    args = parser.parse_args(argv)
+    cmd = args.cmd
+    if cmd and cmd[0] == "--":
+        cmd = cmd[1:]
+    if not cmd:
+        parser.error("no command given (put it after --)")
+    if args.heartbeat_timeout and not args.heartbeat:
+        parser.error("--heartbeat-timeout needs --heartbeat")
+
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s supervise %(levelname)s %(message)s")
+    log = logging.getLogger("supervise")
+
+    from mxnet_tpu.sentinel import RestartBudgetExhausted, Supervisor
+
+    sup = Supervisor(cmd, budget=args.budget,
+                     backoff_base=args.backoff_base,
+                     backoff_max=args.backoff_max,
+                     heartbeat_path=args.heartbeat,
+                     heartbeat_timeout=args.heartbeat_timeout,
+                     poll_s=args.poll, logger=log,
+                     resume_prefix=args.prefix)
+    try:
+        rc = sup.run()
+    except RestartBudgetExhausted as e:
+        log.error("%s: %s", type(e).__name__, e)
+        return 75  # EX_TEMPFAIL: crash loop, operator attention needed
+    except KeyboardInterrupt:
+        log.warning("interrupted; stopping the child and not restarting")
+        sup.terminate()
+        return 130
+    log.info("command succeeded after %d restart(s)", sup.restarts)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
