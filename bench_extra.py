@@ -1100,13 +1100,138 @@ def failover_score(load=24, max_new=24, slots=8, waves=3,
         else round(reprefilled / migrations, 2))
 
 
+def fleet_score(load=16, spike=4, max_new=16, slots=8, waves=3,
+                vocab=256, embed=64, heads=4, layers=2, ffn=128,
+                max_len=96, slo_ttft_ms=500.0):
+    """Fleet-control-plane goodput under CHAOS (docs/serving.md "Fleet
+    control plane"): a 2-model fleet under a live ``FleetController``
+    (30ms ticks) takes ``waves`` waves of concurrent mixed load, each
+    wave hard-killing one replica via ``serving.replica.kill``, then a
+    final ``spike``x offered-load wave with no faults.  Zero failed
+    generations is the bar (typed sheds are legal and PRICED); records
+    the goodput the supervised fleet sustains while losing and
+    replacing replicas, TTFT p99 against the SLO, mean SLO-recovery
+    milliseconds (the controller's breach stopwatch), controller
+    restarts, and sheds by reason — the control plane's prices,
+    persisted so the gate catches a supervision regression."""
+    import threading
+
+    import jax
+
+    from mxnet_tpu import faults, telemetry
+    from mxnet_tpu.models import transformer_lm as tlm
+    from mxnet_tpu.serving import (DeviceFleet, FleetController,
+                                   ModelRegistry, Overloaded)
+    from mxnet_tpu.serving.pool import lm_pool
+
+    cfg = tlm.LMConfig(vocab, embed, heads, layers, ffn, max_len,
+                       eos_id=vocab)  # unreachable EOS: exact lengths
+    params = tlm.init_params(cfg, seed=0)
+    rs = np.random.RandomState(0)
+    telemetry.enable()
+    pools = {name: lm_pool(cfg, params, n_replicas=2, name=name,
+                           engine_opts={"slots": slots,
+                                        "prefill_buckets": (8, 32),
+                                        "max_queue": 512})
+             for name in ("bench-fleet-a", "bench-fleet-b")}
+    reg = ModelRegistry()
+    for name, pool in pools.items():
+        reg.register(name, pool, version=1)
+    ctl = FleetController(
+        reg, fleet=DeviceFleet(devices=jax.devices(), per_device=16),
+        interval_ms=30, backoff_base=0.01,
+        policy_opts={"slo_ttft_ms": slo_ttft_ms, "breach_ticks": 3,
+                     "cooldown_s": 0.5}).start()
+    ttfts = []
+    tokens_done = [0]
+    sheds = 0
+    wall = 0.0
+    lock = threading.Lock()
+    names = sorted(pools)
+
+    def run_wave(n):
+        prompts = [[int(t) for t in
+                    rs.randint(0, vocab, size=1 + c % 8)]
+                   for c in range(n)]
+        seeds = [int(s) for s in rs.randint(0, 2 ** 31, size=n)]
+        errors = []
+
+        def client(cid):
+            stamps = []
+            try:
+                sess = pools[names[cid % 2]].generate(
+                    prompts[cid], max_new_tokens=1 + cid % max_new,
+                    temperature=0.7 * (cid % 2), seed=seeds[cid],
+                    priority=1 + cid % 9, tenant="t%d" % (cid % 3),
+                    on_token=lambda t: stamps.append(
+                        time.perf_counter()))
+                sess.result(300)
+            except Overloaded:
+                return  # typed shed: legal, priced below
+            except Exception as e:
+                errors.append(e)
+                return
+            with lock:
+                ttfts.append(sess.ttft())
+                tokens_done[0] += len(sess.tokens)
+        threads = [threading.Thread(target=client, args=(c,))
+                   for c in range(n)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errors:
+            raise errors[0]  # zero failed generations is the bar
+        return time.perf_counter() - t0
+
+    try:
+        for wave in range(waves):
+            faults.arm("serving.replica.kill", at=3 + 2 * wave)
+            wall += run_wave(load)
+            faults.disarm()
+            deadline = time.monotonic() + 60
+            while any(r.dead for pool in pools.values()
+                      for r in pool.replicas):
+                if time.monotonic() > deadline:
+                    raise RuntimeError("controller never replaced the "
+                                       "dead replica")
+                time.sleep(0.05)
+        wall += run_wave(spike * load)  # the no-fault load spike
+    finally:
+        faults.disarm()
+        ctl.close()
+        reg.close()
+    snap = telemetry.snapshot()
+    rec = [h for k, hs in snap["histograms"].items()
+           if k == "serving.fleet.slo_recovery_seconds"
+           for h in hs.values()]
+    rec_n = sum(h["count"] for h in rec)
+    rec_s = sum(h["sum"] for h in rec)
+    for k, by in snap["counters"].items():
+        if k == "serving.shed.count":
+            sheds += sum(v for lbl, v in by.items()
+                         if "bench-fleet" in lbl)
+    restarts = telemetry.counter_total("serving.fleet.restarts.count")
+    scale_ups = telemetry.counter_total("serving.fleet.scale_ups.count")
+    row("fleet_s%d_load%d_spike%d" % (slots, load, spike),
+        tokens_done[0] / wall, "tok/sec",
+        waves=waves, kills=waves, restarts=restarts,
+        scale_ups=scale_ups, sheds=sheds,
+        ttft_p99_ms=round(float(np.percentile(ttfts, 99)) * 1e3, 3),
+        slo_ttft_ms=slo_ttft_ms,
+        slo_recovery_mean_ms=None if not rec_n
+        else round(rec_s / rec_n * 1e3, 3))
+
+
 def main():
     if len(sys.argv) > 1 and sys.argv[1] == "_compile_probe":
         _compile_probe(sys.argv[2])
         return
     which = set((sys.argv[1].split(",") if len(sys.argv) > 1 else
                  ["infer", "train", "fit", "mesh", "lstm", "ssd", "io",
-                  "serving", "decode", "failover", "ckpt", "compile"]))
+                  "serving", "decode", "failover", "fleet", "ckpt",
+                  "compile"]))
     if "io" in which:
         io_score()
     if "infer" in which:
@@ -1142,6 +1267,8 @@ def main():
         decode_score()
     if "failover" in which:
         failover_score()
+    if "fleet" in which:
+        fleet_score()
     if "ckpt" in which:
         ckpt_score()
     if "compile" in which:

@@ -96,6 +96,23 @@ while [ "$i" -lt "$runs" ]; do
     -k "supervised_restart or crash_loop or wedge_fault"
   i=$((i + 1))
 done
+# fleet control-plane half (docs/serving.md "Fleet control plane"):
+# roll a serving.replica.kill through every replica of a supervised
+# 2-model fleet under concurrent mixed-tenant load, then spike offered
+# load 4x — every generation must complete or shed typed (zero failed
+# generations), the controller must replace every dead replica under
+# its restart budget, and the serving.fleet.* decision trail must be
+# visible.  The seed rotates prompt/output lengths, temperatures,
+# priorities, and the kill steps so kills land at different
+# slot/decision alignments.
+i=0
+while [ "$i" -lt "$runs" ]; do
+  echo "== fleet control-plane chaos run $((i + 1))/$runs (MXNET_CHAOS_SEED=$i) =="
+  JAX_PLATFORMS=cpu MXNET_CHAOS_SEED="$i" \
+    python -m pytest tests/test_fleet.py -q -p no:cacheprovider \
+    -k "chaos"
+  i=$((i + 1))
+done
 # integrity-audit half: flip one bit of one mesh replica via the
 # audit.bitflip fault on an 8-virtual-device fit(kvstore='mesh') — the
 # next cross-replica audit must catch it (typed ReplicaDivergence or a

@@ -77,9 +77,9 @@ from .compile_cache import _env_float, _env_int
 
 __all__ = ["TrainingWedged", "ReplicaDivergence", "AnomalyBudgetExhausted",
            "RestartBudgetExhausted", "WEDGED_EXIT_CODE", "Watchdog",
-           "AnomalyDetector", "Supervisor", "watchdog_enabled",
-           "thread_stacks", "dump_on_demand", "wedge_sleep",
-           "note_progress"]
+           "AnomalyDetector", "Supervisor", "FleetSupervisor",
+           "watchdog_enabled", "thread_stacks", "dump_on_demand",
+           "wedge_sleep", "note_progress"]
 
 #: exit code of a watchdog hard-exit (``MXNET_WATCHDOG_ACTION=exit``):
 #: distinct from Python's 1 and the shell's 126/127 so a supervisor can
@@ -488,6 +488,15 @@ class Supervisor:
         self.restarts = 0
         self._launched_at = None
         self._proc = None
+        self._stopping = False
+
+    def stop(self):
+        """Stop supervising WITHOUT counting it as a crash: the child is
+        terminated and :meth:`run` returns its exit code instead of
+        restarting.  The fleet supervisor's shutdown path — a deliberate
+        stop must never burn restart budget or wait out a backoff."""
+        self._stopping = True
+        self.terminate()
 
     def terminate(self):
         """Stop supervising AND stop the child: terminate (then kill)
@@ -569,6 +578,8 @@ class Supervisor:
                 _telemetry.event("reliability.supervise.done",
                                  restarts=self.restarts)
                 return 0
+            if self._stopping:
+                return rc  # deliberate stop(), not a crash to restart
             uptime = time.time() - self._launched_at
             if self.restarts and self.healthy_reset_s \
                     and uptime >= self.healthy_reset_s:
@@ -615,4 +626,113 @@ class Supervisor:
                         "epoch %d%s", gen["kind"], gen["epoch"],
                         "" if gen["nbatch"] is None
                         else " batch %d" % gen["nbatch"])
-            time.sleep(delay)
+            # interruptible backoff: a fleet shutdown mid-backoff must
+            # not wait out backoff_max before releasing the thread
+            deadline = time.time() + delay
+            while time.time() < deadline:
+                if self._stopping:
+                    return rc
+                time.sleep(min(0.2, self.poll_s))
+
+
+class FleetSupervisor:
+    """:class:`Supervisor` generalized from one training child to a
+    FLEET of processes: one Supervisor per command, each on its own
+    thread, each with its OWN heartbeat file under ``heartbeat_dir``
+    (``<name>.hb.json``) so two children can never confuse each
+    other's liveness — the bug class ``tools/supervise.py
+    --heartbeat-dir`` exists to close.
+
+    Restart budget, backoff, and healthy-reset are PER CHILD (each
+    wraps its own :class:`Supervisor`); a child that exhausts its
+    budget is QUARANTINED — recorded, its thread released, the rest of
+    the fleet supervised on — instead of taking the whole fleet down.
+    :meth:`run` blocks until every child ends and returns 0 only when
+    all of them exited 0 (quarantine counts as failure)."""
+
+    def __init__(self, cmds, names=None, heartbeat_dir=None, budget=None,
+                 backoff_base=1.0, backoff_max=60.0,
+                 heartbeat_timeout=None, poll_s=0.2, logger=None,
+                 healthy_reset_s=300.0):
+        import logging
+
+        cmds = [list(c) for c in cmds]
+        if not cmds:
+            raise MXNetError("FleetSupervisor needs >= 1 command")
+        if names is None:
+            names = ["child%d" % i for i in range(len(cmds))]
+        if len(names) != len(set(names)) or len(names) != len(cmds):
+            raise MXNetError("FleetSupervisor needs one unique name "
+                             "per command")
+        self.heartbeat_dir = heartbeat_dir
+        if heartbeat_dir:
+            os.makedirs(heartbeat_dir, exist_ok=True)
+        self.logger = logger or logging
+        self._sups = {}
+        for name, cmd in zip(names, cmds):
+            hb = os.path.join(heartbeat_dir, "%s.hb.json" % name) \
+                if heartbeat_dir else None
+            self._sups[name] = Supervisor(
+                cmd, budget=budget, backoff_base=backoff_base,
+                backoff_max=backoff_max, heartbeat_path=hb,
+                heartbeat_timeout=heartbeat_timeout, poll_s=poll_s,
+                logger=self.logger, healthy_reset_s=healthy_reset_s)
+        self._lock = threading.Lock()
+        self._results = {}   # name -> exit code (75 for budget spent)
+        self._threads = []
+
+    def _run_child(self, name, sup):
+        try:
+            rc = sup.run()
+        except RestartBudgetExhausted as e:
+            self.logger.error("supervise[%s]: %s — QUARANTINED, the "
+                              "rest of the fleet continues", name, e)
+            _telemetry.event("reliability.supervise.quarantine",
+                             child=name, restarts=e.restarts,
+                             last_exit=e.last_exit)
+            rc = 75  # EX_TEMPFAIL, the single-child CLI convention
+        except Exception:  # noqa: broad-except — one child's
+            # supervision bug must not strand the other threads'
+            # join() in run()
+            self.logger.exception("supervise[%s]: supervision failed",
+                                  name)
+            rc = 70  # EX_SOFTWARE
+        with self._lock:
+            self._results[name] = rc
+
+    def run(self):
+        """Supervise every child to completion; returns 0 iff all
+        exited 0."""
+        self._threads = [
+            threading.Thread(target=self._run_child, args=(name, sup),
+                             name="supervise-%s" % name, daemon=True)
+            for name, sup in sorted(self._sups.items())]
+        for t in self._threads:
+            t.start()
+        for t in self._threads:
+            t.join()
+        with self._lock:
+            results = dict(self._results)
+        failed = {n: rc for n, rc in results.items() if rc != 0}
+        _telemetry.event("reliability.supervise.fleet_done",
+                         children=len(self._sups), failed=len(failed))
+        if failed:
+            self.logger.error("supervise: fleet done, %d/%d child(ren) "
+                              "failed: %s", len(failed), len(self._sups),
+                              sorted(failed.items()))
+            return 75 if 75 in failed.values() else \
+                next(iter(sorted(failed.values())))
+        self.logger.info("supervise: fleet of %d finished clean",
+                         len(self._sups))
+        return 0
+
+    def results(self):
+        """Per-child exit codes recorded so far (name -> rc)."""
+        with self._lock:
+            return dict(self._results)
+
+    def terminate(self):
+        """Stop the whole fleet: every child is stopped without
+        restart (Ctrl-C path)."""
+        for sup in self._sups.values():
+            sup.stop()

@@ -2,7 +2,7 @@
 single-request :class:`~mxnet_tpu.predict.Predictor` plus a
 continuous-batching autoregressive tier.
 
-Five layers (see ``docs/serving.md``):
+Six layers (see ``docs/serving.md``):
 
 * :mod:`~mxnet_tpu.serving.batcher` — dynamic micro-batching with
   shape-bucket padding, per-request deadlines, and typed
@@ -19,13 +19,20 @@ Five layers (see ``docs/serving.md``):
   with atomic publish (checksummed manifest-last), atomic reload,
   per-bucket warm-up compilation, and pointer-flip ``register`` swaps
   of off-registry-built servables (pools included);
+* :mod:`~mxnet_tpu.serving.controller` — the fleet control plane: a
+  :class:`FleetController` closed loop (SLO-driven autoscaling with
+  hysteresis + cooldown, :class:`DeviceFleet` bin-packing placement
+  and rebalancing, per-replica supervision under restart budgets,
+  priority shedding when the fleet is exhausted);
 * :mod:`~mxnet_tpu.serving.frontend` — in-process handle + stdlib HTTP
   JSON endpoint (``/predict``, ``/generate`` with chunked streaming,
-  ``/models``, ``/healthz``, ``/metrics``).
+  ``/models``, ``/healthz``, ``/fleet``, ``/metrics``).
 """
 
 from .batcher import (BATCH_SIZE_BUCKETS, LATENCY_BUCKETS, DeadlineExceeded,
                       DynamicBatcher, Future, InvalidRequest, Overloaded)
+from .controller import (AutoscalePolicy, DeviceFleet, FleetController,
+                         Observation)
 from .decode import (TTFT_BUCKETS, DecodeEngine, GenerateSession,
                      ReplicaKilled)
 from .frontend import ServingHandle, ServingHTTPServer
@@ -40,4 +47,6 @@ __all__ = ["DynamicBatcher", "Future", "Overloaded", "DeadlineExceeded",
            "ReplicaKilled", "QuotaExceeded", "RetryBudgetExhausted",
            "Replica", "ReplicaPool", "lm_pool",
            "ModelRegistry", "ServedModel", "UnknownModel", "save_model",
-           "MANIFEST", "ServingHandle", "ServingHTTPServer"]
+           "MANIFEST", "ServingHandle", "ServingHTTPServer",
+           "AutoscalePolicy", "DeviceFleet", "FleetController",
+           "Observation"]

@@ -264,6 +264,7 @@ class DecodeEngine:
         self._draining = False
         self._closed = False
         self._thread = None
+        self._beat = time.monotonic()
         #: total decode steps (tests pin continuous admission on it)
         self.steps = 0
         #: total generated tokens
@@ -606,6 +607,17 @@ class DecodeEngine:
         """Same number the pool's least-outstanding routing reads."""
         return self.pending_rows()
 
+    def heartbeat_age(self):
+        """Seconds since the serve loop last proved liveness, or None
+        when no worker has been started.  The loop stamps every
+        iteration (idle included), so a stale age means a wedged
+        dispatch or a dead worker thread — the fleet controller's
+        per-replica liveness probe."""
+        with self._cond:
+            if self._thread is None:
+                return None
+            return time.monotonic() - self._beat
+
     def describe(self):
         with self._cond:
             active = sum(1 for x in self._slot_sessions if x is not None)
@@ -736,6 +748,12 @@ class DecodeEngine:
             with self._cond:
                 if not self._running:
                     return
+                # liveness heartbeat: stamped every loop iteration (the
+                # idle wait below is 20ms, so an IDLE engine still beats)
+                # — only a wedged dispatch or a dead worker goes stale.
+                # The fleet controller's per-replica supervision reads it
+                # through heartbeat_age().
+                self._beat = time.monotonic()
                 free = [i for i, x in enumerate(self._slot_sessions)
                         if x is None]
                 # walk the WHOLE queue every iteration: abandoned or

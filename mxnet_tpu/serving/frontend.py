@@ -3,7 +3,7 @@
 :class:`ServingHandle` is the zero-copy in-process surface (what an
 embedding application calls).  :class:`ServingHTTPServer` exposes the
 same registry over ``http.server`` — no web framework, matching the
-repo's no-new-deps rule — with five routes:
+repo's no-new-deps rule — with six routes:
 
 * ``POST /predict`` — ``{"model": name, "data": nested-list,
   "deadline_ms": optional}`` → ``{"model", "version", "shape",
@@ -25,7 +25,11 @@ repo's no-new-deps rule — with five routes:
   replays are bit-identical for the same seed).
 * ``GET /models`` — every loaded servable's card (name, version,
   buckets, replica states, warm-up status).
-* ``GET /healthz`` — liveness + model/version table + per-model detail.
+* ``GET /healthz`` — liveness + model/version table + per-model detail
+  (plus a fleet-controller summary block when one is attached).
+* ``GET /fleet`` — the fleet controller's card: per-model autoscale /
+  quarantine state, device placements, and the recent decision ring
+  (404 when no controller is attached to the registry).
 * ``GET /metrics`` — the process-wide telemetry registry in Prometheus
   text exposition (PR 2's ``telemetry.prometheus_text``), scrapable.
 """
@@ -105,6 +109,14 @@ class ServingHandle:
         return {"models": [self._describe(m)
                            for m in self.registry.models()]}
 
+    def fleet_payload(self):
+        """``GET /fleet``: the attached fleet controller's card, or
+        None when the registry runs uncontrolled."""
+        controller = getattr(self.registry, "controller", None)
+        if controller is None:
+            return None
+        return controller.describe()
+
     def healthz(self):
         payload = {"status": "ok",
                    "models": {m.name: m.version
@@ -120,6 +132,15 @@ class ServingHandle:
             payload["compile_cache"] = {
                 k: cc[k] for k in ("entries", "bytes", "hits", "misses",
                                    "evictions")}
+        fleet = self.fleet_payload()
+        if fleet is not None:
+            # the summary an operator triages from before opening
+            # /fleet: is the loop alive, who is shedding/quarantined,
+            # and the last few decisions
+            payload["fleet"] = {
+                "running": fleet["running"], "ticks": fleet["ticks"],
+                "models": fleet["models"],
+                "decisions": fleet["decisions"][-5:]}
         return payload
 
     def pending_rows(self):
@@ -166,7 +187,8 @@ class _Handler(BaseHTTPRequestHandler):
         # mint one permanent counter entry per distinct URL
         route = self.path if self.path in ("/predict", "/generate",
                                            "/models", "/healthz",
-                                           "/metrics") else "other"
+                                           "/fleet", "/metrics") \
+            else "other"
         _telemetry.inc("serving.http.requests", route=route)
 
     def do_GET(self):
@@ -183,6 +205,13 @@ class _Handler(BaseHTTPRequestHandler):
             self._send(200, payload)
         elif self.path == "/models":
             self._send(200, handle.models_payload())
+        elif self.path == "/fleet":
+            fleet = handle.fleet_payload()
+            if fleet is None:
+                self._send(404, {"error": "no fleet controller is "
+                                 "attached to this registry"})
+            else:
+                self._send(200, fleet)
         elif self.path == "/metrics":
             self._send(200, handle.metrics_text().encode(),
                        content_type="text/plain; version=0.0.4")

@@ -64,15 +64,19 @@ from .decode import DecodeEngine, ReplicaKilled
 
 __all__ = ["QuotaExceeded", "RetryBudgetExhausted", "Replica",
            "ReplicaPool", "lm_pool", "ACTIVE", "QUARANTINED", "WARMING",
-           "CIRCUIT_CLOSED", "CIRCUIT_OPEN", "CIRCUIT_HALF_OPEN"]
+           "RETIRING", "CIRCUIT_CLOSED", "CIRCUIT_OPEN",
+           "CIRCUIT_HALF_OPEN"]
 
 _log = logging.getLogger("mxnet_tpu.serving")
 
 ACTIVE = "active"
 QUARANTINED = "quarantined"
 WARMING = "warming"
+#: being drained out of the pool by a controller decision (scale-down /
+#: rebalance): unpublished from routing while its sessions migrate
+RETIRING = "retiring"
 
-_STATE_GAUGE = {ACTIVE: 0, QUARANTINED: 1, WARMING: 2}
+_STATE_GAUGE = {ACTIVE: 0, QUARANTINED: 1, WARMING: 2, RETIRING: 3}
 
 CIRCUIT_CLOSED = "closed"
 CIRCUIT_OPEN = "open"
@@ -98,7 +102,7 @@ class Replica:
     (all mutable fields guarded by the POOL lock)."""
 
     __slots__ = ("rid", "device", "engine", "weight", "state", "failures",
-                 "routed")
+                 "routed", "dead")
 
     def __init__(self, rid, device, engine, weight):
         self.rid = rid
@@ -110,6 +114,10 @@ class Replica:
         self.state = ACTIVE
         self.failures = 0
         self.routed = 0
+        #: hard-killed (ReplicaKilled): the engine is permanently gone;
+        #: the pool serves on the survivors and the FLEET CONTROLLER —
+        #: not the pool — decides whether to replace it
+        self.dead = False
 
 
 class ReplicaPool:
@@ -207,6 +215,10 @@ class ReplicaPool:
         self._tenant_out = {}
         self._total_outstanding = 0
         self._closed = False
+        #: fleet-exhausted admission pressure (the controller's typed-
+        #: shed lever): while set, the priority floor applies from the
+        #: FIRST outstanding request instead of from the watermark
+        self._pressure = False
         # circuit-breaker state, all keyed by rid and guarded by the
         # pool lock (the lock-discipline pass pins this — see
         # tests/test_graftlint.py strip-the-lock mutation)
@@ -221,9 +233,15 @@ class ReplicaPool:
             # cost k warmed-and-leaked replicas
             raise MXNetError("replica weights must be > 0, got %r"
                              % (weights,))
-        # replicas list is immutable after init (only their fields
-        # mutate, under the pool lock)
-        self.replicas = []
+        # replica membership is DYNAMIC (the fleet controller scales
+        # it): keyed by rid in _replicas, every mutation under the pool
+        # lock; the public .replicas property snapshots a rid-ordered
+        # list.  The factory and device ring are kept so add_replica
+        # can build new members.
+        self._factory = factory
+        self._devices = devices
+        self._next_rid = n_replicas
+        self._replicas = {}
         try:
             for i in range(n_replicas):
                 dev = devices[i % len(devices)]
@@ -233,7 +251,7 @@ class ReplicaPool:
                         on_error=self._make_error_hook(i),
                         on_ok=self._make_ok_hook(i),
                         on_migrate=self._make_migrate_hook(i))
-                self.replicas.append(Replica(i, dev, engine, weights[i]))
+                self._replicas[i] = Replica(i, dev, engine, weights[i])
                 self._outstanding[i] = 0
                 self._circuit[i] = CIRCUIT_CLOSED
                 self._cwindow[i] = deque(maxlen=self._circuit_window)
@@ -244,20 +262,23 @@ class ReplicaPool:
             # a replica k>0 failing to build (device OOM, ...) must not
             # leak the already-running earlier replicas' worker threads
             # and device-resident caches
-            for r in self.replicas:
+            for r in self._replicas.values():
                 try:
                     r.engine.close(drain=False)
                 except Exception:  # noqa: broad-except — best-effort
                     # cleanup on the failure path
                     pass
             raise
-        cap = sum(getattr(r.engine, "slots", 0)
-                  + getattr(r.engine, "max_queue", 0)
-                  for r in self.replicas)
+        self.replicas = [self._replicas[k] for k in sorted(self._replicas)]
         env_max = _env_int("MXNET_POOL_MAX_OUTSTANDING", 0)
+        # a caller-pinned (or env-pinned) admission bound stays fixed as
+        # the pool scales; a capacity-derived one is recomputed on every
+        # add/remove so scaling actually moves the admission surface
+        self._bound_fixed = max_outstanding is not None or bool(env_max)
+        self._watermark_frac = float(priority_watermark)
         self._max_outstanding = int(max_outstanding) \
             if max_outstanding is not None \
-            else (env_max or max(cap, n_replicas))
+            else (env_max or max(self._capacity_locked(), n_replicas))
         # never floor to 0: an idle tiny pool must not shed low-priority
         # traffic before a single request is outstanding
         self._watermark = max(1, int(priority_watermark
@@ -281,6 +302,188 @@ class ReplicaPool:
             _telemetry.inc("serving.shed.count", 0, model=name,
                            reason=reason)
 
+    # -- membership --------------------------------------------------------
+    def _publish_locked(self):
+        """Rebind the public ``replicas`` snapshot (pool lock held).
+        ``replicas`` is a rid-ordered IMMUTABLE-by-convention list that
+        is REPLACED wholesale on every membership change — readers
+        (routing hooks, describe callers, tests) grab the reference
+        lock-free and iterate a stable snapshot, exactly the pre-PR-16
+        fixed-list read behavior."""
+        self.replicas = [self._replicas[k] for k in sorted(self._replicas)]
+
+    def _capacity_locked(self):
+        return sum(getattr(r.engine, "slots", 0)
+                   + getattr(r.engine, "max_queue", 0)
+                   for r in self._replicas.values())
+
+    def _recompute_bounds_locked(self):
+        """Re-derive the admission bound + priority watermark after a
+        membership change (no-op when the bound was pinned by the
+        caller or ``MXNET_POOL_MAX_OUTSTANDING``)."""
+        if self._bound_fixed:
+            return
+        self._max_outstanding = max(self._capacity_locked(),
+                                    len(self._replicas), 1)
+        self._watermark = max(1, int(self._watermark_frac
+                                     * self._max_outstanding))
+
+    def add_replica(self, device=None, weight=1.0):
+        """Grow the pool by one replica — the fleet controller's
+        scale-up / replace actuator.  The engine is built and WARMED by
+        the factory BEFORE the pool publishes it to routing (the PR 7
+        warm-up manifests make that warm-up cache loads, not cold
+        compiles), so the new replica's first request never pays a
+        compile.  Returns the new rid."""
+        with self._lock:
+            if self._closed:
+                raise MXNetError("replica pool %r is closed" % self.name)
+            rid = self._next_rid
+            self._next_rid += 1
+            dev = device if device is not None \
+                else self._devices[rid % len(self._devices)]
+        engine = self._factory(dev, str(rid))
+        if hasattr(engine, "set_health_hooks"):
+            engine.set_health_hooks(
+                on_error=self._make_error_hook(rid),
+                on_ok=self._make_ok_hook(rid),
+                on_migrate=self._make_migrate_hook(rid))
+        r = Replica(rid, dev, engine, weight)
+        with self._lock:
+            closed = self._closed
+            if not closed:
+                self._replicas[rid] = r
+                self._outstanding[rid] = 0
+                self._circuit[rid] = CIRCUIT_CLOSED
+                self._cwindow[rid] = deque(maxlen=self._circuit_window)
+                self._opened_at[rid] = 0.0
+                self._migrations_out[rid] = 0
+                self._migrations_in[rid] = 0
+                self._recompute_bounds_locked()
+                self._publish_locked()
+        if closed:
+            # the pool was swapped out while the engine warmed: a
+            # replica nobody will ever route to must not leak a worker
+            try:
+                engine.close(drain=False)
+            except Exception:  # noqa: broad-except — best-effort
+                # cleanup on the lost-race path
+                pass
+            raise MXNetError("replica pool %r closed during add_replica"
+                             % self.name)
+        _telemetry.set_gauge("serving.pool.outstanding", 0,
+                             model=self.name, replica=str(rid))
+        _telemetry.set_gauge("serving.pool.replica_state",
+                             _STATE_GAUGE[ACTIVE], model=self.name,
+                             replica=str(rid))
+        _telemetry.set_gauge("serving.pool.circuit_state",
+                             _CIRCUIT_GAUGE[CIRCUIT_CLOSED],
+                             model=self.name, replica=str(rid))
+        _telemetry.event("serving.pool.replica_add", model=self.name,
+                         replica=str(rid), device=str(dev))
+        _log.info("pool %r: replica %d added on %s (warmed before "
+                  "routing)", self.name, rid, dev)
+        return rid
+
+    def remove_replica(self, rid, migrate=True):
+        """Shrink the pool by one replica — the scale-down / rebalance
+        actuator.  The replica is unpublished from routing (RETIRING),
+        its engine stopped with the live sessions HANDED OFF, and each
+        handed session re-admitted on a survivor through the failover
+        transport (``resume()``: re-prefill prompt + generated-so-far —
+        bit-identical continuation) WITHOUT charging the tenant's retry
+        budget: a controller decision is not a replica failure.
+        Returns True when no session was lost (migrated sessions are
+        not losses; shed sessions carry a typed error)."""
+        with self._lock:
+            r = self._replicas.get(rid)
+            if r is None:
+                raise MXNetError("pool %r has no replica %r"
+                                 % (self.name, rid))
+            if r.state == RETIRING:
+                return True  # a concurrent remove already owns it
+            was_dead = r.dead
+            r.state = RETIRING
+        _telemetry.set_gauge("serving.pool.replica_state",
+                             _STATE_GAUGE[RETIRING], model=self.name,
+                             replica=str(rid))
+        clean = True
+        orphans = []
+        try:
+            r.engine.stop(drain=False, hand_off=orphans.extend)
+        except Exception:  # noqa: broad-except — a dead engine's stop
+            # must not block the membership change
+            clean = False
+            _log.warning("pool %r: stop of replica %d failed during "
+                         "removal", self.name, rid, exc_info=True)
+        if orphans:
+            if migrate:
+                self._migrate_sessions(
+                    rid, orphans,
+                    MXNetError("replica %d retired by the fleet "
+                               "controller" % rid),
+                    charge_budget=False, reason="rebalance")
+            else:
+                for sess in orphans:
+                    clean = False
+                    self._shed_session(sess, "drain", MXNetError(
+                        "replica %d removed from pool %r before this "
+                        "session finished" % (rid, self.name)))
+        try:
+            r.engine.close(drain=False)
+        except Exception:  # noqa: broad-except — closing one dead
+            # replica must not block the membership change
+            if not was_dead:
+                clean = False
+            _log.warning("pool %r: close of replica %d failed during "
+                         "removal", self.name, rid, exc_info=True)
+        with self._lock:
+            self._replicas.pop(rid, None)
+            self._outstanding.pop(rid, None)
+            self._circuit.pop(rid, None)
+            self._cwindow.pop(rid, None)
+            self._opened_at.pop(rid, None)
+            self._migrations_out.pop(rid, None)
+            self._migrations_in.pop(rid, None)
+            self._recompute_bounds_locked()
+            self._publish_locked()
+        _telemetry.set_gauge("serving.pool.outstanding", 0,
+                             model=self.name, replica=str(rid))
+        _telemetry.event("serving.pool.replica_remove", model=self.name,
+                         replica=str(rid), migrated=len(orphans),
+                         clean=clean)
+        _log.info("pool %r: replica %d removed (%d live session(s) "
+                  "migrated)", self.name, rid,
+                  len(orphans) if migrate else 0)
+        return clean
+
+    def set_shed_pressure(self, on):
+        """Fleet-exhausted admission pressure — the controller's
+        priority-shedding lever (tentpole (d)): while on, requests
+        under the priority floor shed typed (reason ``priority``) from
+        the FIRST outstanding request instead of from the watermark.
+        In-flight generations are never touched — this is admission
+        control only.  Returns the previous setting."""
+        on = bool(on)
+        with self._lock:
+            prev, self._pressure = self._pressure, on
+        if prev != on:
+            _telemetry.set_gauge("serving.pool.shed_pressure", int(on),
+                                 model=self.name)
+            _telemetry.event("serving.pool.shed_pressure",
+                             model=self.name, on=on)
+            _log.warning("pool %r: shed pressure %s", self.name,
+                         "ON (priority floor applies from the first "
+                         "request)" if on else "off")
+        return prev
+
+    def admission_state(self):
+        """``(outstanding, max_outstanding, shed_pressure)`` — the
+        controller's cheap per-tick load read (no engine locks)."""
+        with self._lock:
+            return (self._total_outstanding, self._max_outstanding,
+                    self._pressure)
+
     def _make_error_hook(self, rid):
         return lambda exc: self._note_step_error(rid, exc)
 
@@ -296,20 +499,37 @@ class ReplicaPool:
         """Weighted least-outstanding choice over routable replicas
         (pool lock held).  A HALF-OPEN replica is routable but admits
         ONE in-flight probe at a time — the breaker's probe, carried by
-        real traffic.  Returns None when nothing is routable."""
-        cands = []
-        for r in self.replicas:
+        real traffic — and NEVER outbids a CLOSED-circuit replica just
+        by being idle: recovering capacity is unproven, so under
+        degradation the proven replica is preferred even at a higher
+        outstanding count.  The probe flows only when every closed-
+        circuit replica is already slot-saturated (real pressure) or
+        none is routable at all — prompt enough to close the breaker,
+        never the first choice.  Returns None when nothing is
+        routable."""
+        closed, probes = [], []
+        for r in self._replicas.values():  # lint: ok[lock-discipline] call-with-pool-lock-held helper; every call site (generate/adopt/_migrate_sessions) holds self._lock, the thread path included
             if r.state != ACTIVE:
                 continue
-            circuit = self._circuit[r.rid]  # lint: ok[lock-discipline] call-with-pool-lock-held helper; every call site (generate/adopt/_migrate_sessions) holds self._lock, the thread path included
+            circuit = self._circuit[r.rid]  # lint: ok[lock-discipline] call-with-pool-lock-held helper (see above)
             busy = self._outstanding[r.rid]  # lint: ok[lock-discipline] call-with-pool-lock-held helper (see above)
-            if circuit == CIRCUIT_HALF_OPEN and busy >= 1:
-                continue
-            cands.append(r)
-        if not cands:
-            return None
-        return min(cands,
-                   key=lambda x: self._outstanding[x.rid] / x.weight)  # lint: ok[lock-discipline] call-with-pool-lock-held helper (see above)
+            if circuit == CIRCUIT_HALF_OPEN:
+                if busy >= 1:
+                    continue  # the probe budget: one in flight
+                probes.append(r)
+            else:
+                closed.append(r)
+        key = lambda x: self._outstanding[x.rid] / x.weight  # noqa: E731  # lint: ok[lock-discipline] call-with-pool-lock-held helper (see above)
+        if closed:
+            if probes and all(
+                    self._outstanding[r.rid]  # lint: ok[lock-discipline] call-with-pool-lock-held helper (see above)
+                    >= max(1, getattr(r.engine, "slots", 1))
+                    for r in closed):
+                return min(probes, key=key)
+            return min(closed, key=key)
+        if probes:
+            return min(probes, key=key)
+        return None
 
     def generate(self, prompt, *, max_new_tokens=16, temperature=0.0,
                  deadline_ms=None, on_token=None, tenant=None, priority=5,
@@ -336,15 +556,18 @@ class ReplicaPool:
                     "pool %r overloaded: %d outstanding >= bound %d"
                     % (self.name, self._total_outstanding,
                        self._max_outstanding))
-            if self._total_outstanding >= self._watermark \
+            if (self._pressure
+                    or self._total_outstanding >= self._watermark) \
                     and int(priority) < self._priority_floor:
                 _telemetry.inc("serving.shed.count", model=self.name,
                                reason="priority")
                 raise Overloaded(
-                    "pool %r past its priority watermark (%d/%d): "
+                    "pool %r past its priority watermark (%d/%d)%s: "
                     "priority %d < floor %d shed"
                     % (self.name, self._total_outstanding,
-                       self._watermark, priority, self._priority_floor))
+                       self._watermark,
+                       " under fleet shed pressure" if self._pressure
+                       else "", priority, self._priority_floor))
             quota = self._quotas.get(tenant_key, self._quotas.get("*"))
             if quota is not None \
                     and self._tenant_out.get(tenant_key, 0) >= int(quota):
@@ -386,13 +609,20 @@ class ReplicaPool:
 
     def _settle(self, rid, tenant_key):
         with self._lock:
-            self._outstanding[rid] = max(0, self._outstanding[rid] - 1)
+            # the rid may have been removed by a controller scale-down
+            # while this session was finishing: tenant/total accounting
+            # still settles, the per-replica row is simply gone
+            out = None
+            if rid in self._outstanding:
+                self._outstanding[rid] = \
+                    max(0, self._outstanding[rid] - 1)
+                out = self._outstanding[rid]
             self._tenant_out[tenant_key] = \
                 max(0, self._tenant_out.get(tenant_key, 0) - 1)
             self._total_outstanding = max(0, self._total_outstanding - 1)
-            out = self._outstanding[rid]
-        _telemetry.set_gauge("serving.pool.outstanding", out,
-                             model=self.name, replica=str(rid))
+        if out is not None:
+            _telemetry.set_gauge("serving.pool.outstanding", out,
+                                 model=self.name, replica=str(rid))
 
     # -- replica health / circuit breaker ----------------------------------
     def _failure_rate_locked(self, rid):
@@ -403,7 +633,9 @@ class ReplicaPool:
 
     def _note_step_error(self, rid, exc):
         killed = isinstance(exc, ReplicaKilled)
-        r = self.replicas[rid]
+        r = self._replicas.get(rid)
+        if r is None:
+            return  # removed by a controller scale-down mid-flight
         with self._lock:
             r.failures += 1
             self._cwindow[rid].append(False)
@@ -444,7 +676,9 @@ class ReplicaPool:
                          daemon=True).start()
 
     def _note_step_ok(self, rid):
-        r = self.replicas[rid]
+        r = self._replicas.get(rid)
+        if r is None:
+            return  # removed by a controller scale-down mid-flight
         with self._lock:
             r.failures = 0
             self._cwindow[rid].append(True)
@@ -466,13 +700,15 @@ class ReplicaPool:
         opened replica still holds (queued AND slot sessions migrate,
         they are not shed), then — unless the replica was hard-killed —
         re-warm it, sit out the cooldown, and return it HALF-OPEN."""
-        r = self.replicas[rid]
         with self._lock:
             if self._closed:
                 # the pool was swapped out while recovery was pending;
                 # the engine-level closed guard catches the narrower
                 # race after this check
                 return
+            r = self._replicas.get(rid)
+        if r is None:
+            return  # removed by a controller scale-down mid-recovery
         orphans = []
         try:
             r.engine.stop(drain=False, hand_off=orphans.extend)
@@ -483,12 +719,15 @@ class ReplicaPool:
         if orphans:
             self._migrate_sessions(rid, orphans, exc)
         if killed:
+            with self._lock:
+                r.dead = True
             _telemetry.event("serving.pool.replica_dead",
                              model=self.name, replica=str(rid),
                              error=str(exc))
             _log.error("pool %r: replica %d is dead (hard kill); "
-                       "serving continues on the survivors", self.name,
-                       rid)
+                       "serving continues on the survivors — replace/"
+                       "quarantine is the fleet controller's call",
+                       self.name, rid)
             return
         with self._lock:
             r.state = WARMING
@@ -560,13 +799,18 @@ class ReplicaPool:
             _log.warning("pool %r: on_event callback failed", self.name,
                          exc_info=True)
 
-    def _migrate_sessions(self, rid, sessions, exc):
+    def _migrate_sessions(self, rid, sessions, exc, charge_budget=True,
+                          reason="failover"):
         """Failure-driven migration (the engines' ``on_migrate`` hook
         and the recovery takeover): re-admit each session on a healthy
         replica — its accounting moves with it — or shed typed when it
         is cancelled/expired, over its retry budget, or nothing is
         routable.  Every session is resolved-or-readmitted; none is
-        ever silently dropped."""
+        ever silently dropped.  ``charge_budget=False`` is the
+        controller-drain variant (scale-down / rebalance): like a
+        version swap, a planned migration is free for the session —
+        the retry budget guards against bouncing between DYING
+        replicas, not against operator decisions."""
         tenant_of = lambda s: s.tenant if s.tenant is not None else "*"  # noqa: E731
         for sess in sessions:
             if sess.finished():
@@ -581,15 +825,16 @@ class ReplicaPool:
                     "session deadline expired during failover"))
                 continue
             tenant_key = tenant_of(sess)
-            sess.migrations += 1
-            budget = self._retry_budget(tenant_key)
-            if sess.migrations > budget:
-                self._shed_session(sess, "retry_budget",
-                                   RetryBudgetExhausted(
-                    "session exceeded tenant %r retry budget of %d "
-                    "migration attempts (reason=retry_budget); last "
-                    "replica error: %s" % (tenant_key, budget, exc)))
-                continue
+            if charge_budget:
+                sess.migrations += 1
+                budget = self._retry_budget(tenant_key)
+                if sess.migrations > budget:
+                    self._shed_session(sess, "retry_budget",
+                                       RetryBudgetExhausted(
+                        "session exceeded tenant %r retry budget of %d "
+                        "migration attempts (reason=retry_budget); last "
+                        "replica error: %s" % (tenant_key, budget, exc)))
+                    continue
             t0 = time.monotonic()
             with self._lock:
                 target = None if self._closed else self._pick_locked()
@@ -607,7 +852,7 @@ class ReplicaPool:
                     out_src = self._outstanding[rid]
                     out_dst = self._outstanding[target.rid]
             if target is None:
-                self._shed_session(sess, "failover", MXNetError(
+                self._shed_session(sess, reason, MXNetError(
                     "no healthy replica to migrate this session to; "
                     "replica error: %s" % (exc,)))
                 continue
@@ -629,7 +874,7 @@ class ReplicaPool:
                 # resume (transcript outgrew the buckets, target closing
                 # under a racing swap) sheds typed, never drops
                 sess.migrate_t0 = None
-                self._shed_session(sess, "failover", MXNetError(
+                self._shed_session(sess, reason, MXNetError(
                     "failover re-admission on replica %d failed: %s"
                     % (target.rid, e)))
                 continue
@@ -638,7 +883,7 @@ class ReplicaPool:
                            model=self.name, replica=str(rid))
             _telemetry.event("serving.failover.migrate",
                              model=self.name, src=str(rid),
-                             dst=str(target.rid),
+                             dst=str(target.rid), reason=reason,
                              attempt=sess.migrations,
                              tokens_generated=len(sess.tokens))
 
@@ -698,19 +943,24 @@ class ReplicaPool:
                          failure_rate=round(
                              self._failure_rate_locked(r.rid), 3),
                          failures=r.failures, routed=r.routed,
+                         dead=r.dead,
                          migrations_out=self._migrations_out[r.rid],
                          migrations_in=self._migrations_in[r.rid],
                          outstanding=self._outstanding[r.rid],
                          weight=r.weight)
-                    for r in self.replicas]
+                    for k in sorted(self._replicas)
+                    for r in (self._replicas[k],)]
             total = self._total_outstanding
             tenants = dict(self._tenant_out)
             failovers = self._failovers
+            pressure = self._pressure
+            max_out = self._max_outstanding
         return {"name": self.name, "version": self.version,
                 "kind": "generate", "replicas": reps,
                 "outstanding": total,
-                "max_outstanding": self._max_outstanding,
+                "max_outstanding": max_out,
                 "priority_floor": self._priority_floor,
+                "shed_pressure": pressure,
                 "quotas": dict(self._quotas),
                 "retry_budgets": dict(self._retry_budgets),
                 "failovers": failovers,

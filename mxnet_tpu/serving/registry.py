@@ -299,6 +299,17 @@ class ModelRegistry:
                             "max_queue_depth": max_queue_depth}
         self._models = {}
         self._lock = threading.Lock()
+        #: the attached :class:`~mxnet_tpu.serving.controller.
+        #: FleetController` (None when the registry runs uncontrolled);
+        #: the frontend's /fleet route and healthz block read it
+        self.controller = None
+
+    def attach_controller(self, controller):
+        """Attach the fleet controller that manages this registry's
+        decode pools (the controller's constructor calls this); the
+        frontend resolves it through ``registry.controller``."""
+        self.controller = controller
+        return controller
 
     def load(self, name, symbol_json, param_blob, input_shape,
              data_name="data", buckets=(1, 8, 32), version=None,
@@ -379,6 +390,17 @@ class ModelRegistry:
         _telemetry.inc("serving.model.loads", model=name)
         _telemetry.event("serving.model.load", model=name,
                          version=servable.version)
+        controller = self.controller
+        if controller is not None:
+            # a pointer flip replaced the pool object: the controller
+            # must drop the old pool's autoscale/placement state and
+            # adopt the successor on its next tick (best-effort — a
+            # controller bug must not fail the swap)
+            try:
+                controller.on_register(name, servable)
+            except Exception:  # noqa: broad-except
+                logging.warning("serving: fleet controller on_register "
+                                "hook failed for %r", name, exc_info=True)
         logging.info("serving: servable %r v%d registered (%s)",
                      name, servable.version,
                      type(servable).__name__)

@@ -47,8 +47,8 @@ __all__ = ["enabled", "enable", "disable", "inc", "declare", "set_gauge",
            "observe", "event", "phase", "snapshot", "dump", "dump_events",
            "prometheus_text", "write_prometheus", "reset", "sample_memory",
            "phase_totals", "counter_total", "gauge_value", "hist_quantile",
-           "events_recent", "add_phase_hook", "remove_phase_hook",
-           "set_phase_hook"]
+           "hist_state", "quantile_from_counts", "events_recent",
+           "add_phase_hook", "remove_phase_hook", "set_phase_hook"]
 
 #: default histogram bucket upper bounds (seconds-flavored; callers may
 #: pass their own on first ``observe`` of a metric)
@@ -326,6 +326,48 @@ def hist_quantile(name, q, **labels):
             acc += c
             lo = max(lo, b)
         return h.max  # overflow bucket: cap at the observed max
+
+
+def hist_state(name, **labels):
+    """Raw histogram state — bucket bounds, per-bucket counts (the last
+    entry is the overflow bucket), total count/sum and observed min/max
+    — or None when unobserved.  Windowed-quantile readers (the fleet
+    controller's TTFT-p99 window) diff two snapshots' counts and feed
+    the delta to :func:`quantile_from_counts`; cumulative
+    :func:`hist_quantile` would smear the whole process history into
+    the estimate."""
+    with _lock:
+        h = _hists.get(_key(name, labels))
+        if h is None:
+            return None
+        return {"buckets": tuple(h.buckets), "counts": list(h.counts),
+                "count": h.count, "sum": h.sum,
+                "min": h.min, "max": h.max}
+
+
+def quantile_from_counts(buckets, counts, q, lo=None, hi=None):
+    """:func:`hist_quantile`'s estimator over caller-supplied bucket
+    counts (e.g. the delta of two :func:`hist_state` reads).  ``lo`` /
+    ``hi`` cap the first/overflow buckets the way the histogram's
+    observed min/max do; they default to 0 and the last finite bound.
+    None when the counts are empty."""
+    total = sum(counts)
+    if total <= 0:
+        return None
+    lo = 0.0 if lo is None else float(lo)
+    hi = float(buckets[-1]) if hi is None else float(hi)
+    target = q * total
+    acc = 0
+    cur = lo
+    for b, c in zip(buckets, counts):
+        if acc + c >= target:
+            if c == 0:
+                return min(cur, hi)
+            frac = (target - acc) / c
+            return min(cur + (min(b, hi) - cur) * max(0.0, frac), hi)
+        acc += c
+        cur = max(cur, b)
+    return hi  # overflow bucket: cap at hi
 
 
 # -- memory sampling --------------------------------------------------------

@@ -384,6 +384,40 @@ def test_half_open_probe_failure_reopens_and_probe_is_single_flight():
         pool.close(drain=False)
 
 
+def test_half_open_probe_never_outbids_closed_replica():
+    """Satellite regression (ISSUE 16): an idle HALF-OPEN replica used
+    to win the weighted least-outstanding pick over a busier
+    CLOSED-circuit one — the probe is unproven capacity and must never
+    be preferred just for being idle.  The probe flows only once every
+    closed replica is slot-saturated (or none is routable)."""
+    pool = _fake_pool()
+    try:
+        with pool._lock:
+            pool._circuit[0] = CIRCUIT_HALF_OPEN
+            pool._outstanding[0] = 0
+            pool._outstanding[1] = 3  # busier, but proven
+            picked = pool._pick_locked()
+            assert picked.rid == 1, \
+                "idle half-open probe outbid the closed replica"
+            # every closed replica slot-saturated (slots == 4): real
+            # pressure — now the probe may carry a request
+            pool._outstanding[1] = 4
+            assert pool._pick_locked().rid == 0
+            # ... but only ONE probe in flight
+            pool._outstanding[0] = 1
+            assert pool._pick_locked().rid == 1
+            # no closed-circuit replica routable at all: the probe is
+            # the only path and flows immediately
+            pool._outstanding[0] = 0
+            pool._circuit[1] = CIRCUIT_HALF_OPEN
+            pool._outstanding[1] = 1  # its probe is in flight
+            assert pool._pick_locked().rid == 0
+            pool._outstanding[0] = 0
+            pool._outstanding[1] = 0
+    finally:
+        pool.close(drain=False)
+
+
 def test_cooldown_holds_the_circuit_open():
     pool = _fake_pool(quarantine_after=1, circuit_cooldown=0.4)
     try:
@@ -462,8 +496,12 @@ def test_version_swap_migrates_stragglers_bit_identically():
     v2 = lm_pool(CFG, PARAMS, n_replicas=1, name="lm",
                  engine_opts=ENGINE_OPTS)
     events = []
+    # throttle token delivery (on_token runs on the engine thread) so
+    # the pointer flip reliably lands while the session is mid-flight —
+    # unthrottled, all 24 tokens can finish in ~6ms and beat register()
     sess = v1.generate(PROMPT, max_new_tokens=24, temperature=0.7,
-                       seed=31, on_event=lambda k, i: events.append(i))
+                       seed=31, on_event=lambda k, i: events.append(i),
+                       on_token=lambda _t: time.sleep(0.005))
     deadline = time.monotonic() + 60
     while len(sess.tokens) < 3:  # mid-generation when the swap lands
         assert time.monotonic() < deadline

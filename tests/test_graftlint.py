@@ -828,14 +828,52 @@ def test_mutation_removing_pool_routing_lock_is_caught(tmp_path):
     mutated = _mutated_copy(
         tmp_path, "mxnet_tpu/serving/pool.py",
         "        with self._lock:\n"
-        "            if self._closed:",
+        "            if self._closed:\n"
+        "                raise MXNetError(\"replica pool %r is closed\""
+        " % self.name)\n"
+        "            if self._total_outstanding",
         "        if True:\n"
-        "            if self._closed:",
+        "            if self._closed:\n"
+        "                raise MXNetError(\"replica pool %r is closed\""
+        " % self.name)\n"
+        "            if self._total_outstanding",
         "pool_mut.py")
     res1 = run_pass(by_id("lock-discipline")(),
                     RunContext(roots=[mutated]))
     assert any(f.code == "unlocked-write"
                and "_total_outstanding" in f.message
+               for f in active(res1)), \
+        [f.message for f in res1.findings]
+
+
+def test_mutation_removing_controller_tick_lock_is_caught(tmp_path):
+    """Strip the controller lock from FleetController.tick: the tick
+    counter and the managed-model map race the describe()/decisions()
+    readers -> lock-discipline must fire (ISSUE 16 satellite: the
+    controller ships with a zero-findings baseline, and the pass
+    provably catches the stripped lock)."""
+    pristine = tmp_path / "controller_ok.py"
+    pristine.write_text(
+        (ROOT / "mxnet_tpu" / "serving" / "controller.py").read_text())
+    res0 = run_pass(by_id("lock-discipline")(),
+                    RunContext(roots=[pristine]))
+    assert not active(res0), [f.message for f in active(res0)]
+
+    mutated = _mutated_copy(
+        tmp_path, "mxnet_tpu/serving/controller.py",
+        "        with self._lock:\n"
+        "            if self._closed:\n"
+        "                return\n"
+        "            self._ticks += 1",
+        "        if True:\n"
+        "            if self._closed:\n"
+        "                return\n"
+        "            self._ticks += 1",
+        "controller_mut.py")
+    res1 = run_pass(by_id("lock-discipline")(),
+                    RunContext(roots=[mutated]))
+    assert any(f.code == "unlocked-write"
+               and ("_ticks" in f.message or "_models" in f.message)
                for f in active(res1)), \
         [f.message for f in res1.findings]
 

@@ -22,13 +22,23 @@ Usage::
     --backoff-base S        first restart delay, doubles per restart (1.0)
     --backoff-max S         delay cap (60.0)
     --heartbeat PATH        heartbeat file to export + watch
+    --heartbeat-dir DIR     FLEET mode heartbeats: one file per child
+                            (<childN>.hb.json) so two children can never
+                            confuse each other's liveness
     --heartbeat-timeout S   stale-heartbeat kill threshold (off unless set;
-                            needs --heartbeat and MXNET_WATCHDOG=1 in the
-                            child so something writes it)
+                            needs --heartbeat/--heartbeat-dir and
+                            MXNET_WATCHDOG=1 in the child so something
+                            writes it)
     --poll S                child poll interval (0.2)
 
-Exit status: the child's final 0 on success, 75 when the restart
-budget is exhausted (the last child exit code is printed).
+Fleet mode: separate several commands with additional ``--`` tokens —
+``supervise.py --heartbeat-dir /tmp/hb -- python a.py -- python b.py``
+supervises both under one harness (per-child restart budget + backoff;
+a crash-looping child is quarantined, the rest continue).
+
+Exit status: the child's final 0 on success (all children in fleet
+mode), 75 when a restart budget is exhausted (the last child exit code
+is printed).
 """
 
 from __future__ import annotations
@@ -57,6 +67,10 @@ def main(argv=None):
     parser.add_argument("--heartbeat", default=None,
                         help="heartbeat file exported to the child as "
                              "MXNET_HEARTBEAT_FILE and watched here")
+    parser.add_argument("--heartbeat-dir", default=None,
+                        help="fleet heartbeats: directory holding ONE "
+                             "heartbeat file per supervised child "
+                             "(mutually exclusive with --heartbeat)")
     parser.add_argument("--heartbeat-timeout", type=float, default=None,
                         help="kill -9 + restart when the heartbeat goes "
                              "this many seconds stale")
@@ -68,22 +82,54 @@ def main(argv=None):
     parser.add_argument("cmd", nargs=argparse.REMAINDER,
                         help="-- command [args...]")
     args = parser.parse_args(argv)
-    cmd = args.cmd
-    if cmd and cmd[0] == "--":
-        cmd = cmd[1:]
-    if not cmd:
+    rest = args.cmd
+    if rest and rest[0] == "--":
+        rest = rest[1:]
+    # fleet mode: further "--" tokens separate additional commands
+    cmds = [[]]
+    for tok in rest:
+        if tok == "--":
+            cmds.append([])
+        else:
+            cmds[-1].append(tok)
+    cmds = [c for c in cmds if c]
+    if not cmds:
         parser.error("no command given (put it after --)")
-    if args.heartbeat_timeout and not args.heartbeat:
-        parser.error("--heartbeat-timeout needs --heartbeat")
+    if args.heartbeat and args.heartbeat_dir:
+        parser.error("--heartbeat and --heartbeat-dir are mutually "
+                     "exclusive")
+    if len(cmds) > 1 and args.heartbeat:
+        parser.error("several commands share one --heartbeat file; "
+                     "use --heartbeat-dir (one file per child)")
+    if args.heartbeat_timeout and not (args.heartbeat
+                                       or args.heartbeat_dir):
+        parser.error("--heartbeat-timeout needs --heartbeat or "
+                     "--heartbeat-dir")
 
     logging.basicConfig(
         level=logging.INFO,
         format="%(asctime)s supervise %(levelname)s %(message)s")
     log = logging.getLogger("supervise")
 
-    from mxnet_tpu.sentinel import RestartBudgetExhausted, Supervisor
+    from mxnet_tpu.sentinel import (FleetSupervisor,
+                                    RestartBudgetExhausted, Supervisor)
 
-    sup = Supervisor(cmd, budget=args.budget,
+    if len(cmds) > 1 or args.heartbeat_dir:
+        sup = FleetSupervisor(cmds, heartbeat_dir=args.heartbeat_dir,
+                              budget=args.budget,
+                              backoff_base=args.backoff_base,
+                              backoff_max=args.backoff_max,
+                              heartbeat_timeout=args.heartbeat_timeout,
+                              poll_s=args.poll, logger=log)
+        try:
+            return sup.run()
+        except KeyboardInterrupt:
+            log.warning("interrupted; stopping the fleet and not "
+                        "restarting")
+            sup.terminate()
+            return 130
+
+    sup = Supervisor(cmds[0], budget=args.budget,
                      backoff_base=args.backoff_base,
                      backoff_max=args.backoff_max,
                      heartbeat_path=args.heartbeat,
