@@ -1224,6 +1224,88 @@ def fleet_score(load=16, spike=4, max_new=16, slots=8, waves=3,
         else round(rec_s / rec_n * 1e3, 3))
 
 
+def trace_score(load=16, max_new=24, slots=8,
+                vocab=256, embed=64, heads=4, layers=2, ffn=128,
+                max_len=96, calls=20000):
+    """graftrace overhead pins (docs/observability.md "Distributed
+    tracing & fleet aggregation"): (a) with tracing DISABLED — the
+    default — the fit loop's span pair costs well under the 50µs/batch
+    budget; (b) with tracing ENABLED, decode-tier throughput holds
+    within ~2% of the disabled run (the gate watches the enabled row's
+    ``overhead_pct``)."""
+    import threading
+
+    from mxnet_tpu import tracing
+    from mxnet_tpu.models import transformer_lm as tlm
+    from mxnet_tpu.serving.pool import lm_pool
+
+    # (a) the pure per-batch instrumentation cost, tracing off
+    tracing.disable()
+    t0 = time.perf_counter()
+    for _ in range(calls):
+        tracing.start_span("fit.batch", epoch=0).end("ok")
+    per_batch_us = (time.perf_counter() - t0) / calls * 1e6
+    row("trace_disabled_fit_overhead", per_batch_us, "us/batch",
+        budget_us=50.0)
+
+    # (b) decode sweep, disabled vs enabled, identical workload
+    cfg = tlm.LMConfig(vocab, embed, heads, layers, ffn, max_len,
+                       eos_id=vocab)
+    params = tlm.init_params(cfg, seed=0)
+    rs = np.random.RandomState(0)
+    prompts = [[int(t) for t in rs.randint(0, vocab, size=1 + c % 8)]
+               for c in range(load)]
+
+    def sweep():
+        pool = lm_pool(cfg, params, n_replicas=1, name="bench-trace",
+                       engine_opts={"slots": slots,
+                                    "prefill_buckets": (8, 32),
+                                    "max_queue": 512})
+        eng = pool.replicas[0].engine
+        try:
+            # warm pass absorbs prefill/decode compiles so both
+            # measured runs see a hot cache
+            pool.generate(prompts[0],
+                          max_new_tokens=max_new).result(300)
+            errors = []
+
+            def client(cid):
+                try:
+                    pool.generate(prompts[cid],
+                                  max_new_tokens=max_new).result(300)
+                except Exception as e:  # pragma: no cover - fatal
+                    errors.append(e)
+
+            tokens0 = eng.tokens_out
+            threads = [threading.Thread(target=client, args=(c,))
+                       for c in range(load)]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            wall = time.perf_counter() - t0
+            if errors:
+                raise errors[0]
+            return (eng.tokens_out - tokens0) / wall
+        finally:
+            pool.close(drain=False)
+
+    tracing.reset()
+    tracing.disable()
+    base = sweep()
+    tracing.enable()
+    traced = sweep()
+    tracing.disable()
+    tracing.reset()
+    overhead_pct = (base - traced) / base * 100.0
+    row("trace_decode_s%d_load%d_disabled" % (slots, load), base,
+        "tok/sec")
+    row("trace_decode_s%d_load%d_enabled" % (slots, load), traced,
+        "tok/sec", overhead_pct=round(overhead_pct, 2),
+        budget_pct=2.0)
+
+
 def main():
     if len(sys.argv) > 1 and sys.argv[1] == "_compile_probe":
         _compile_probe(sys.argv[2])
@@ -1231,7 +1313,7 @@ def main():
     which = set((sys.argv[1].split(",") if len(sys.argv) > 1 else
                  ["infer", "train", "fit", "mesh", "lstm", "ssd", "io",
                   "serving", "decode", "failover", "fleet", "ckpt",
-                  "compile"]))
+                  "compile", "trace"]))
     if "io" in which:
         io_score()
     if "infer" in which:
@@ -1271,6 +1353,8 @@ def main():
         fleet_score()
     if "ckpt" in which:
         ckpt_score()
+    if "trace" in which:
+        trace_score()
     if "compile" in which:
         compile_score()
     print("done: %d rows this run (persisted incrementally)" % len(ROWS))

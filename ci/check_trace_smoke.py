@@ -1,0 +1,123 @@
+"""Trace smoke (ISSUE 17): with ``MXNET_TRACE=1``, a tiny ``fit`` and
+one HTTP ``/generate`` both leave rooted span trees — every span
+reaches a root, zero orphans — and ``GET /trace/<id>`` serves the
+request's tree back.  Exits non-zero on any broken tree; run by
+``ci/run_tests.sh`` after the mesh smoke."""
+
+import json
+import os
+import sys
+import time
+import urllib.request
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["MXNET_TRACE"] = "1"
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import tracing  # noqa: E402
+from mxnet_tpu.models import transformer_lm as tlm  # noqa: E402
+from mxnet_tpu.serving import (ModelRegistry, ServingHTTPServer,  # noqa: E402
+                               lm_pool)
+
+
+def fail(msg):
+    print("trace smoke: FAIL — %s" % msg)
+    sys.exit(1)
+
+
+def check_rooted(trace_id, what):
+    tr = tracing.tree(trace_id)
+    if tr is None:
+        fail("%s: unknown trace %s" % (what, trace_id))
+    if tr["root"] is None:
+        fail("%s: no root span" % what)
+    if tr["orphans"]:
+        fail("%s: %d orphan span(s): %s"
+             % (what, len(tr["orphans"]),
+                [o["name"] for o in tr["orphans"]]))
+    if tr["extra_roots"]:
+        fail("%s: %d extra root(s)" % (what, len(tr["extra_roots"])))
+    return tr
+
+
+def main():
+    # -- fit half: every batch roots its own fit.batch span -------------
+    rs = np.random.RandomState(0)
+    x = rs.uniform(size=(64, 4)).astype(np.float32)
+    y = (x.sum(axis=1) > 2).astype(np.float32)
+    train = mx.io.NDArrayIter(x, y, batch_size=16)
+    data = mx.sym.Variable("data")
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(data, num_hidden=2, name="fc"),
+        name="softmax")
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.fit(train, num_epoch=1, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1})
+    fit_spans = [r for r in tracing.spans_recent()
+                 if r["name"] == "fit.batch"]
+    if len(fit_spans) != 4:   # 64 rows / batch 16
+        fail("expected 4 fit.batch spans, got %d" % len(fit_spans))
+    for r in fit_spans:
+        check_rooted(r["trace_id"], "fit.batch")
+    print("trace smoke: fit — %d rooted fit.batch spans"
+          % len(fit_spans))
+
+    # -- serving half: one /generate, tree served over HTTP -------------
+    cfg = tlm.LMConfig(32, 16, 2, 2, 32, 32, eos_id=32)
+    pool = lm_pool(cfg, tlm.init_params(cfg, seed=3), n_replicas=1,
+                   name="lm", engine_opts={"slots": 4,
+                                           "prefill_buckets": (8, 32),
+                                           "max_queue": 64})
+    reg = ModelRegistry()
+    reg.register("lm", pool, version=1)
+    srv = ServingHTTPServer(reg, port=0).start()
+    try:
+        req = urllib.request.Request(
+            srv.url + "/generate",
+            json.dumps({"model": "lm", "prompt": [5, 7, 9, 2],
+                        "max_new_tokens": 8}).encode(),
+            {"Content-Type": "application/json"})
+        resp = json.load(urllib.request.urlopen(req, timeout=120))
+        tid = resp.get("trace_id")
+        if not tid:
+            fail("/generate response carries no trace_id")
+        # the HTTP span ends just after the response bytes leave
+        deadline = time.monotonic() + 30
+        while True:
+            tr = json.load(urllib.request.urlopen(
+                srv.url + "/trace/" + tid, timeout=30))
+            if tr["complete"] or time.monotonic() > deadline:
+                break
+            time.sleep(0.02)
+        if not tr["complete"]:
+            fail("/generate trace never settled complete: %s" % tr)
+        if tr["orphans"] or tr["extra_roots"]:
+            fail("/generate trace is not one rooted tree: %s" % tr)
+        if tr["root"]["name"] != "serving.http.request":
+            fail("unexpected root span %r" % tr["root"]["name"])
+        names = []
+
+        def walk(node):
+            names.append(node["name"])
+            for c in node["children"]:
+                walk(c)
+
+        walk(tr["root"])
+        for must in ("serving.generate", "serving.admit"):
+            if must not in names:
+                fail("span %r missing from the /generate tree (%s)"
+                     % (must, names))
+        print("trace smoke: serving — GET /trace/%s returned a "
+              "complete %d-span tree (%s)"
+              % (tid, tr["n_spans"], " > ".join(names)))
+    finally:
+        srv.stop()
+        reg.close()
+    print("trace smoke: OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -10,6 +10,7 @@ from .bare_except import BareExceptPass
 from .collective_consistency import CollectiveConsistencyPass
 from .donation import DonationPass
 from .env_docs import EnvDocsPass
+from .event_docs import EventDocsPass
 from .host_sync import HostSyncPass
 from .lock_discipline import LockDisciplinePass
 from .orchestrated import BenchGatePass, CompileCachePass
@@ -25,6 +26,7 @@ ALL_PASSES = (
     BareExceptPass,
     PrintPass,
     EnvDocsPass,
+    EventDocsPass,
     HostSyncPass,
     SignalRestorePass,
     TracerPurityPass,

@@ -1,0 +1,77 @@
+"""event-docs pass — every telemetry family emitted must be documented.
+
+The observability contract: an operator reading
+``docs/observability.md`` can grep any counter/gauge/histogram/event
+name the codebase can emit.  This pass walks every
+``telemetry.inc/observe/set_gauge/event/declare`` call whose family
+name is a string LITERAL and requires that name to appear verbatim in
+the doc; dynamically-built names (``"%s.phase_seconds" % family``) are
+out of scope — document the pattern, not the expansion.  The doc drift
+this closes is real: families added in a serving or resilience PR that
+never made it into the metrics table."""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from ..core import Pass
+
+#: telemetry registry methods whose FIRST string argument is a family
+#: name (declare takes several — every string positional arg counts)
+FAMILY_METHODS = frozenset({"inc", "observe", "set_gauge", "event",
+                            "declare"})
+
+#: a family name literal: dotted lowercase metric path ("fit.batches",
+#: "serving.shed.count").  Single bare words ("data", "update") are
+#: phase labels and event kinds from other registries' vocabularies —
+#: requiring a dot keeps prose-ish constants out
+FAMILY_RE = re.compile(r"^[a-z][a-z0-9_]*(\.[a-z0-9_]+)+$")
+
+
+def _is_telemetry_ref(node):
+    """True for ``_telemetry.inc(...)`` / ``telemetry.event(...)``-style
+    receivers — the module alias convention used across the tree."""
+    return isinstance(node, ast.Name) and \
+        node.id in ("telemetry", "_telemetry", "_tele", "_telemetry_mod")
+
+
+class EventDocsPass(Pass):
+    id = "event-docs"
+    title = "telemetry families emitted are documented"
+
+    def doc_path(self, ctx):
+        return ctx.repo / "docs" / "observability.md"
+
+    def run(self, sources, ctx):
+        doc = self.doc_path(ctx)
+        documented = doc.read_text() if doc.exists() else ""
+        findings = []
+        for src in sources:
+            if src.syntax_error is not None:
+                e = src.syntax_error
+                findings.append(self.find(
+                    src, e.lineno or 0, "syntax-error",
+                    "SYNTAX ERROR: %s" % e.msg))
+                continue
+            for node in ast.walk(src.tree):
+                if not (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr in FAMILY_METHODS
+                        and _is_telemetry_ref(node.func.value)):
+                    continue
+                names = [a.value for a in node.args
+                         if isinstance(a, ast.Constant)
+                         and isinstance(a.value, str)
+                         and FAMILY_RE.match(a.value)]
+                if node.func.attr != "declare":
+                    names = names[:1]
+                for name in names:
+                    if not re.search(r"\b%s\b" % re.escape(name),
+                                     documented):
+                        findings.append(self.find(
+                            src, node, "undocumented",
+                            "telemetry family %r is emitted here but "
+                            "missing from %s" % (name, doc),
+                            detail=name))
+        return findings

@@ -83,6 +83,11 @@ python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
   python -m pytest tests/test_mesh_kvstore.py -q -p no:cacheprovider \
   -k "zero_per_step or shards_optimizer_state or kill_resume"
+# trace smoke (docs/observability.md "Distributed tracing & fleet
+# aggregation"): MXNET_TRACE=1 over a tiny fit and one HTTP /generate —
+# every span tree must be rooted with zero orphans, and GET /trace/<id>
+# must serve the request's tree back.
+python ci/check_trace_smoke.py
 # compile-once effectiveness: a small fit+predict runs twice against a
 # temp persistent compile cache; the second run must perform ZERO XLA
 # compilations (every executable loads from the cache) — unstable cache

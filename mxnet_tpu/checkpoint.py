@@ -51,6 +51,7 @@ import threading
 import time
 
 from . import telemetry as _telemetry
+from . import tracing as _tracing
 from .base import MXNetError, atomic_write, atomic_write_bytes
 
 __all__ = ["TrainingPreempted", "Snapshot", "TrainingState",
@@ -164,6 +165,8 @@ def write_snapshot(prefix, snap, logger=logging, keep_last=None):
     from . import model as _model
 
     t0 = time.perf_counter()
+    csp = _tracing.start_span("checkpoint.write", stack=False,
+                              epoch=snap.epoch, nbatch=snap.nbatch)
     mesh_info = getattr(snap, "mesh_info", None)
     if mesh_info:
         params_path, entry = _write_sharded_payloads(prefix, snap,
@@ -226,6 +229,7 @@ def write_snapshot(prefix, snap, logger=logging, keep_last=None):
                        time.perf_counter() - t0)
     _telemetry.event("checkpoint.snapshot", epoch=snap.epoch,
                      nbatch=snap.nbatch, path=params_path)
+    csp.end("ok", path=os.path.basename(params_path))
     return params_path
 
 

@@ -46,6 +46,7 @@ import pickle
 
 from . import faults as _faults
 from . import telemetry as _telemetry
+from . import tracing as _tracing
 from .base import MXNetError
 
 __all__ = ["StaleEpoch", "MembershipChanged", "enabled", "quiesce_deadline",
@@ -285,9 +286,19 @@ class ElasticFitRun:
                     "the reshard cycle (worker death mid-reshard)")
                 self.kv._sever("fault 'elastic.reshard' killed this worker "
                                "mid-reshard")
+            # STACKED on this worker thread: the kvstore verbs the cycle
+            # issues (reshard_sync/choice/commit, pulls) stamp this
+            # span's context onto the wire, so the coordinator's
+            # kvstore.* spans stitch into the same trace
+            rsp = _tracing.start_span("elastic.reshard",
+                                      rank=str(self.kv.rank),
+                                      attempt=rejoins)
             try:
-                return self._cycle(fallback)
+                out = self._cycle(fallback)
+                rsp.end("ok")
+                return out
             except StaleEpoch as e:
+                rsp.end("retry", reason="stale_epoch")
                 # if WE are the one who was evicted (slow past the
                 # quiesce deadline while the socket stayed up), the
                 # coordinator never re-admits a rank on its own — the
@@ -324,6 +335,11 @@ class ElasticFitRun:
                 self.logger.info(
                     "elastic: membership moved during the reshard cycle "
                     "(%s); restarting the cycle", e)
+            except BaseException:
+                # the span is STACKED: every exit must pop it or the
+                # thread-local parent chain leaks into later spans
+                rsp.end("error")
+                raise
 
     def _cycle(self, fallback):
         kv, mod = self.kv, self.module

@@ -33,6 +33,7 @@ import zlib as _zlib
 from . import elastic as _elastic
 from . import faults as _faults
 from . import telemetry as _telemetry
+from . import tracing as _tracing
 from .base import MXNetError, atomic_write_bytes as _atomic_write_bytes
 from .elastic import StaleEpoch
 from .ndarray import NDArray, zeros
@@ -458,6 +459,20 @@ class KVStoreDist(KVStore):
             msg["epoch"] = self._epoch
         return msg
 
+    @staticmethod
+    def _with_trace(msg):
+        """Stamp an outgoing verb with the calling thread's span
+        context (``{"trace_id", "span_id"}``) so the server's dispatch
+        span parents on this worker's current span (the fit batch, a
+        reshard cycle) and worker↔coordinator spans stitch into one
+        tree.  One boolean check when tracing is off — the non-traced
+        push/pull hot path pays no clock read or allocation."""
+        if _tracing.enabled():
+            c = _tracing.ctx()
+            if c is not None:
+                msg["trace"] = c
+        return msg
+
     def _sever(self, why):
         """Close every server socket and raise :class:`ConnectionLost` —
         the observable state of this worker dying abruptly.  Used by the
@@ -549,10 +564,10 @@ class KVStoreDist(KVStore):
             tele = _telemetry.enabled()
             t0 = _time.perf_counter() if tele else 0.0
             try:
-                reply = self._rpc(self._with_epoch(
+                reply = self._rpc(self._with_trace(self._with_epoch(
                     {"cmd": "push", "key": k, "value": value,
                      "rank": self._rank,
-                     "round": self._push_seq.get(k, 0)}), sock=sock)
+                     "round": self._push_seq.get(k, 0)})), sock=sock)
             except (ConnectionLost, OSError):
                 self._acked_in_failed_push = acked
                 raise
@@ -590,17 +605,17 @@ class KVStoreDist(KVStore):
             t0 = _time.perf_counter() if tele else 0.0
             shards = self._shards(k, size)
             if shards is None:
-                reply = self._rpc(self._with_epoch(
+                reply = self._rpc(self._with_trace(self._with_epoch(
                     {"cmd": "pull", "key": k,
-                     "version": self._versions.get(k, 0)}),
+                     "version": self._versions.get(k, 0)})),
                     sock=self._socks[self._server_of(k)])
                 val = array(reply["value"])
             else:
                 flat = None
                 for sk, sid, sl in shards:
-                    reply = self._rpc(self._with_epoch(
+                    reply = self._rpc(self._with_trace(self._with_epoch(
                         {"cmd": "pull", "key": sk,
-                         "version": self._versions.get(sk, 0)}),
+                         "version": self._versions.get(sk, 0)})),
                         sock=self._socks[sid])
                     part = _np.asarray(reply["value"])
                     if flat is None:
@@ -636,8 +651,8 @@ class KVStoreDist(KVStore):
 
     def barrier(self):
         with _telemetry.phase("barrier", family="kvstore"):
-            self._rpc(self._with_epoch({"cmd": "barrier",
-                                        "rank": self._rank}))
+            self._rpc(self._with_trace(self._with_epoch(
+                {"cmd": "barrier", "rank": self._rank})))
 
     def heartbeat(self):
         """Liveness ping to the scheduler; returns its cluster view
@@ -683,7 +698,8 @@ class KVStoreDist(KVStore):
         epoch, the rank set, the new world size — and reset the per-key
         push/pull bookkeeping, which the coordinator restarted at zero
         when the epoch bumped."""
-        rep = self._rpc({"cmd": "reshard_sync", "rank": self._rank})
+        rep = self._rpc(self._with_trace(
+            {"cmd": "reshard_sync", "rank": self._rank}))
         self._epoch = rep["epoch"]
         self._num_workers = rep["num_workers"]
         self._versions = {}
@@ -698,21 +714,22 @@ class KVStoreDist(KVStore):
         for no-generation) the whole membership rolls back to, so
         followers load exactly that generation instead of each trusting
         its own possibly-lagging manifest read."""
-        return self._rpc(self._with_epoch(
-            {"cmd": "reshard_choice", "rank": self._rank, "set": choice}))
+        return self._rpc(self._with_trace(self._with_epoch(
+            {"cmd": "reshard_choice", "rank": self._rank,
+             "set": choice})))
 
     def get_reshard_choice(self):
         """Follower half: block until the leader's announcement lands
         (typed :class:`StaleEpoch` when membership moves mid-wait — the
         reshard cycle restarts)."""
-        return self._rpc(self._with_epoch(
-            {"cmd": "reshard_choice", "rank": self._rank}))
+        return self._rpc(self._with_trace(self._with_epoch(
+            {"cmd": "reshard_choice", "rank": self._rank})))
 
     def reshard_commit(self):
         """Post-rehydration barrier (epoch-checked): every member's
         snapshot reloads are visible before any member trains."""
-        return self._rpc(self._with_epoch({"cmd": "reshard_commit",
-                                           "rank": self._rank}))
+        return self._rpc(self._with_trace(self._with_epoch(
+            {"cmd": "reshard_commit", "rank": self._rank})))
 
     def reload(self, key, value):
         """Rehydration push: set ``key``'s coordinator value from the
@@ -721,8 +738,8 @@ class KVStoreDist(KVStore):
         theirs when they adopt the epoch at ``reshard_sync``)."""
         import numpy as _np
 
-        rep = self._rpc(self._with_epoch(
-            {"cmd": "reload", "key": key, "value": _np.asarray(value)}),
+        rep = self._rpc(self._with_trace(self._with_epoch(
+            {"cmd": "reload", "key": key, "value": _np.asarray(value)})),
             sock=self._socks[self._server_of(key)])
         self._versions.pop(key, None)
         self._push_seq.pop(key, None)

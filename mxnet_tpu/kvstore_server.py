@@ -102,6 +102,17 @@ def _tele():
     return sys.modules.get("%s.telemetry" % __package__)
 
 
+def _trace_mod():
+    """The tracing module via sys.modules (same import-lock rules as
+    :func:`_tele`); None when unavailable or tracing is disabled."""
+    if not __package__:
+        return None
+    tr = sys.modules.get("%s.tracing" % __package__)
+    if tr is None or not tr.enabled():
+        return None
+    return tr
+
+
 def _elastic_knobs():
     """``(enabled, min_workers, max_workers, quiesce_deadline)`` env
     defaults.  Delegates to ``mxnet_tpu.elastic`` — the single
@@ -285,7 +296,28 @@ class KVStoreServer:
                         msg = recv_msg(self.request)
                         if msg is None:
                             return
-                        reply = outer.dispatch(msg, conn=self)
+                        # worker↔coordinator span stitching: a verb
+                        # carrying a trace context gets a server-side
+                        # span parented on the sender's span (the
+                        # worker's fit batch / reshard cycle), so one
+                        # tree spans both processes.  No context, no
+                        # span — the non-traced hot path is unchanged.
+                        tr = _trace_mod()
+                        wire = msg.get("trace") if tr is not None else None
+                        sp = tr.start_span(
+                            "kvstore.%s" % msg.get("cmd"),
+                            trace_id=wire.get("trace_id"),
+                            parent_id=wire.get("span_id"),
+                            rank=msg.get("rank")) if wire else None
+                        reply = None
+                        try:
+                            reply = outer.dispatch(msg, conn=self)
+                        finally:
+                            if sp is not None:
+                                err = isinstance(reply, dict) \
+                                    and "error" in reply
+                                sp.end("error" if err or reply is None
+                                       else "ok")
                         send_msg(self.request, reply)
                         if msg["cmd"] == "stop":
                             return

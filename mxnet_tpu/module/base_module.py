@@ -25,6 +25,7 @@ from .. import perfdebug as _perfdebug
 from .. import random as _random
 from .. import sentinel as _sentinel
 from .. import telemetry as _telemetry
+from .. import tracing as _tracing
 from ..base import MXNetError
 from ..elastic import MembershipChanged, StaleEpoch, \
     enabled as _elastic_enabled
@@ -1145,6 +1146,10 @@ class BaseModule:
                 device_out = isinstance(eval_metric, _metric.DeviceMetric)
 
                 def _flush(chunk, nbatch):
+                    # one span per fused chunk — the bulk-mode analogue
+                    # of the per-batch span below
+                    bsp = _tracing.start_span("fit.batch", stack=False,
+                                              epoch=epoch, k=len(chunk))
                     with _telemetry.phase("bulk_step"):
                         # device metrics consume the stacked outputs
                         # without the (K, ...) host transfer
@@ -1161,6 +1166,7 @@ class BaseModule:
                                                locals=locals())
                             for callback in _as_list(batch_end_callback):
                                 callback(bp)
+                    bsp.end("ok", nbatch=nbatch)
                     return nbatch
 
                 train_iter = iter(fit_data)
@@ -1209,6 +1215,11 @@ class BaseModule:
                     if data_batch is _FIT_END:
                         break
                     nbatch += 1
+                    # per-batch trace span (data wait excluded — it sits
+                    # before the batch starts); disabled-mode cost is two
+                    # no-op calls, inside the fit overhead pin
+                    bsp = _tracing.start_span("fit.batch", stack=False,
+                                              epoch=epoch, nbatch=nbatch)
                     if _faults.should_fire("fit.preempt"):
                         # deterministic preemption: a REAL SIGTERM to
                         # this process — the handler sets the drain flag
@@ -1288,6 +1299,8 @@ class BaseModule:
                     if check_nan:
                         window_all_staged = True  # flag consumed: new window
                     _telemetry.inc("fit.batches")
+                    bsp.end("retry" if (nan_detected or anomaly_detected)
+                            else "ok")
                     if audit_every is not None and \
                             (nbatch + 1) % audit_every == 0:
                         audit = getattr(self, "_run_integrity_audit",

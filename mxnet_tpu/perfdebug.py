@@ -61,6 +61,7 @@ import time
 from collections import deque
 
 from . import telemetry as _telemetry
+from . import tracing as _tracing
 from .base import atomic_write
 
 __all__ = [
@@ -666,6 +667,19 @@ def _flight_dump_impl(reason, fields):
         },
     }
     safe_reason = re.sub(r"[^A-Za-z0-9_.-]", "_", str(reason))[:40]
+    spans = _tracing.spans_recent() if _tracing.enabled() else ()
+    if spans:
+        # the span ring rides every dump as ndjson (one span per line,
+        # joinable against the events' trace_id fields) — a post-mortem
+        # of a failover carries the request trees that crossed it
+        span_path = os.path.join(
+            directory, "spans-%d-%04d-%s.ndjson"
+            % (os.getpid(), seq, safe_reason))
+        span_blob = "".join(json.dumps(s, default=str) + "\n"
+                            for s in spans)
+        atomic_write(span_path, lambda tmp: _write_text(tmp, span_blob),
+                     durable=False)
+        payload["span_dump"] = span_path
     path = os.path.join(directory, "flightrec-%d-%04d-%s.json"
                         % (os.getpid(), seq, safe_reason))
     blob = json.dumps(payload, indent=1, default=str)

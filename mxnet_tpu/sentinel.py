@@ -469,10 +469,18 @@ class Supervisor:
     def __init__(self, cmd, budget=None, backoff_base=1.0,
                  backoff_max=60.0, heartbeat_path=None,
                  heartbeat_timeout=None, poll_s=0.2, logger=None,
-                 resume_prefix=None, healthy_reset_s=300.0):
+                 resume_prefix=None, healthy_reset_s=300.0,
+                 telemetry_dir=None, telemetry_proc=None):
         import logging
 
         self.cmd = list(cmd)
+        #: telemetry export plumbing, mirroring the heartbeat file: the
+        #: child gets MXNET_TELEMETRY_EXPORT_DIR/_PROC so its registry
+        #: snapshots land where telemetry.aggregate()/graftop look
+        self.telemetry_dir = telemetry_dir
+        self.telemetry_proc = telemetry_proc
+        if telemetry_dir:
+            os.makedirs(telemetry_dir, exist_ok=True)
         #: checkpoint prefix for the pre-restart "where will resume
         #: land" log line (manifest-only probe; optional)
         self.resume_prefix = resume_prefix
@@ -550,6 +558,10 @@ class Supervisor:
         env = dict(os.environ)
         if self.heartbeat_path:
             env["MXNET_HEARTBEAT_FILE"] = self.heartbeat_path
+        if self.telemetry_dir:
+            env["MXNET_TELEMETRY_EXPORT_DIR"] = self.telemetry_dir
+            if self.telemetry_proc:
+                env["MXNET_TELEMETRY_EXPORT_PROC"] = self.telemetry_proc
         self._launched_at = time.time()
         proc = self._proc = subprocess.Popen(self.cmd, env=env)
         _telemetry.event("reliability.supervise.launch", pid=proc.pid,
@@ -653,7 +665,7 @@ class FleetSupervisor:
     def __init__(self, cmds, names=None, heartbeat_dir=None, budget=None,
                  backoff_base=1.0, backoff_max=60.0,
                  heartbeat_timeout=None, poll_s=0.2, logger=None,
-                 healthy_reset_s=300.0):
+                 healthy_reset_s=300.0, telemetry_dir=None):
         import logging
 
         cmds = [list(c) for c in cmds]
@@ -667,6 +679,9 @@ class FleetSupervisor:
         self.heartbeat_dir = heartbeat_dir
         if heartbeat_dir:
             os.makedirs(heartbeat_dir, exist_ok=True)
+        self.telemetry_dir = telemetry_dir
+        if telemetry_dir:
+            os.makedirs(telemetry_dir, exist_ok=True)
         self.logger = logger or logging
         self._sups = {}
         for name, cmd in zip(names, cmds):
@@ -676,7 +691,11 @@ class FleetSupervisor:
                 cmd, budget=budget, backoff_base=backoff_base,
                 backoff_max=backoff_max, heartbeat_path=hb,
                 heartbeat_timeout=heartbeat_timeout, poll_s=poll_s,
-                logger=self.logger, healthy_reset_s=healthy_reset_s)
+                logger=self.logger, healthy_reset_s=healthy_reset_s,
+                telemetry_dir=telemetry_dir,
+                # the child NAME keys the merged view: graftop shows
+                # trainer0/trainer1 rows, not two anonymous pids
+                telemetry_proc=name if telemetry_dir else None)
         self._lock = threading.Lock()
         self._results = {}   # name -> exit code (75 for budget spent)
         self._threads = []

@@ -42,6 +42,7 @@ import numpy as np
 from .. import faults as _faults
 from .. import profiler as _profiler
 from .. import telemetry as _telemetry
+from .. import tracing as _tracing
 from ..base import MXNetError
 
 __all__ = ["Overloaded", "DeadlineExceeded", "InvalidRequest", "Future",
@@ -119,7 +120,7 @@ class Future:
 
 
 class _Request:
-    __slots__ = ("data", "n", "deadline", "future", "t_submit")
+    __slots__ = ("data", "n", "deadline", "future", "t_submit", "span")
 
     def __init__(self, data, n, deadline):
         self.data = data
@@ -127,6 +128,7 @@ class _Request:
         self.deadline = deadline
         self.future = Future()
         self.t_submit = time.monotonic()
+        self.span = _tracing.NULL_SPAN
 
 
 class DynamicBatcher:
@@ -217,8 +219,14 @@ class DynamicBatcher:
         deadline = None if deadline_ms is None \
             else time.monotonic() + float(deadline_ms) / 1e3
         req = _Request(data, n, deadline)
+        # opened on the CALLER's thread (parents under an in-flight
+        # HTTP span), closed from the worker thread at dispatch
+        req.span = _tracing.start_span("serving.batch.request",
+                                       stack=False, model=self.name,
+                                       rows=n)
         with self._cond:
             if self._closed:
+                req.span.end("error", reason="closed")
                 raise MXNetError("serving %r is closed" % self.name)
             # counted only once accepted-or-shed: closed-batcher rejects
             # must not show as phantom unaccounted requests
@@ -226,6 +234,7 @@ class DynamicBatcher:
             if self._depth + n > self.max_queue_depth:
                 _telemetry.inc("serving.shed.count", model=self.name,
                                reason="overload")
+                req.span.end("shed", reason="overload")
                 raise Overloaded(
                     "serving %r overloaded: queue %d rows + %d > bound %d"
                     % (self.name, self._depth, n, self.max_queue_depth))
@@ -288,6 +297,7 @@ class DynamicBatcher:
                     err = MXNetError("serving %r stopped before dispatch"
                                      % self.name)
                     for r in batch:
+                        r.span.end("shed", reason="stopped")
                         r.future.set_error(err)
             finally:
                 with self._cond:
@@ -355,6 +365,7 @@ class DynamicBatcher:
                 dropped += 1
                 _telemetry.inc("serving.shed.count", model=self.name,
                                reason="abandoned")
+                req.span.end("shed", reason="abandoned")
             if dropped:
                 _telemetry.set_gauge("serving.queue.depth", self._depth,
                                      model=self.name)
@@ -390,10 +401,12 @@ class DynamicBatcher:
                 # device slot
                 _telemetry.inc("serving.shed.count", model=self.name,
                                reason="abandoned")
+                r.span.end("shed", reason="abandoned")
                 continue
             if r.deadline is not None and now > r.deadline:
                 _telemetry.inc("serving.shed.count", model=self.name,
                                reason="deadline")
+                r.span.end("shed", reason="deadline")
                 r.future.set_error(DeadlineExceeded(
                     "deadline expired %.1fms before dispatch"
                     % ((now - r.deadline) * 1e3)))
@@ -441,6 +454,7 @@ class DynamicBatcher:
             # to serve the next batch
             _telemetry.inc("serving.error.count", model=self.name)
             for r in live:
+                r.span.end("error", error=type(e).__name__)
                 r.future.set_error(e)
             if prof:
                 _profiler.record("serving:%s:dispatch_error" % self.name,
@@ -456,6 +470,7 @@ class DynamicBatcher:
                            model=self.name)
         done_t = time.monotonic()
         for r, res in zip(live, results):
+            r.span.end("ok", rows=r.n, bucket=bucket)
             r.future.set_result(res)
             _telemetry.observe("serving.request.latency_seconds",
                                done_t - r.t_submit,

@@ -63,6 +63,7 @@ from .. import faults as _faults
 from .. import perfdebug as _perfdebug
 from .. import random as _random
 from .. import telemetry as _telemetry
+from .. import tracing as _tracing
 from ..base import MXNetError
 from ..models import transformer_lm as _tlm
 from .batcher import (LATENCY_BUCKETS, DeadlineExceeded, Future,
@@ -112,7 +113,7 @@ class GenerateSession:
                  "on_token", "on_event", "tokens", "future", "seed",
                  "tenant", "migrations", "migrate_t0", "t_submit",
                  "t_first", "t_done", "slot", "admit_step", "done_step",
-                 "_finished", "_lock", "_on_done")
+                 "trace", "_finished", "_lock", "_on_done")
 
     def __init__(self, prompt, max_new_tokens, temperature, deadline_ms,
                  on_token, on_done=None, seed=0, tenant=None,
@@ -141,6 +142,11 @@ class GenerateSession:
         self.slot = None
         self.admit_step = None
         self.done_step = None
+        #: the session's root span ("serving.generate") — RIDES every
+        #: migration with the session, so spans recorded on replica B
+        #: after a failover still parent into the same trace.  The
+        #: shared no-op span when tracing is off.
+        self.trace = _tracing.NULL_SPAN
         self._finished = False
         # session-level lock: completion must stay exactly-once across
         # MIGRATION — engine A's forced stop can race engine B retiring
@@ -158,6 +164,13 @@ class GenerateSession:
                 return False
             self._finished = True
         self.t_done = time.monotonic()
+        # idempotent: shed/migration paths that already ended the span
+        # with a more specific status win — this is the fallback close
+        self.trace.end(
+            "ok" if error is None else
+            ("shed" if isinstance(error, (Overloaded, DeadlineExceeded))
+             else "error"),
+            tokens=len(self.tokens), migrations=self.migrations)
         if error is not None:
             self.future.set_error(error)
         else:
@@ -524,17 +537,27 @@ class DecodeEngine:
         sess = GenerateSession(prompt, max_new_tokens, temperature,
                                deadline_ms, on_token, on_done, seed=seed,
                                tenant=tenant, on_event=on_event)
+        # root span for the session's whole lifetime — opened on the
+        # CALLER's thread so it parents under any in-flight request
+        # span (HTTP handler, batcher); stack=False because it outlives
+        # this call and is closed from the engine thread at _resolve
+        sess.trace = _tracing.start_span(
+            "serving.generate", stack=False, model=self.name,
+            prompt_tokens=int(prompt.size))
         with self._cond:
             if self._closed:
+                sess.trace.end("error", reason="closed")
                 raise MXNetError("decode engine %r is closed" % self.name)
             if self._draining:
                 _telemetry.inc("serving.shed.count", model=self.name,
                                reason="drain")
+                sess.trace.end("shed", reason="drain")
                 raise Overloaded("decode engine %r is draining"
                                  % self.name)
             if len(self._queue) >= self.max_queue:
                 _telemetry.inc("serving.shed.count", model=self.name,
                                reason="overload")
+                sess.trace.end("shed", reason="overload")
                 raise Overloaded(
                     "decode engine %r overloaded: %d sessions queued"
                     % (self.name, len(self._queue)))
@@ -796,6 +819,7 @@ class DecodeEngine:
                     if reason == "deadline" else \
                     MXNetError("session abandoned by the client while "
                                "queued")
+                sess.trace.end("shed", reason=reason, where="queued")
                 self._finish(sess, error=err)
             for sess in admits:
                 state, aborted = self._admit(sess, state)
@@ -833,6 +857,11 @@ class DecodeEngine:
         tokens = np.zeros((bucket,), np.int32)
         tokens[:n] = full
         limit = np.int32(min(p0 + sess.max_new_tokens - 1, cfg.max_len))
+        # runs on the ENGINE thread: parent explicitly off the session
+        # root (the thread-local stack belongs to whoever submitted)
+        asp = _tracing.start_span("serving.admit", parent=sess.trace,
+                                  stack=False, replica=self.replica,
+                                  resumed=resumed, bucket=bucket)
         try:
             state, out = self._prefill_fns[bucket](
                 self._params, state, tokens, np.int32(n),
@@ -844,7 +873,9 @@ class DecodeEngine:
             # a poisoned prefill poisons the whole donated state: fail
             # every session this engine holds and restart from zeros
             # (the queue is untouched)
+            asp.end("error", error=type(e).__name__)
             return self._fail_all(e, state), True
+        asp.end("ok", reprefilled=n if resumed else 0)
         now = time.monotonic()
         tok = int(out[0])
         sess.tokens.append(tok)
@@ -930,6 +961,7 @@ class DecodeEngine:
                                        "mid-generation") \
                     if reason == "deadline" else \
                     MXNetError("session abandoned by the client")
+                sess.trace.end("shed", reason=reason, where="active")
                 self._retire(sess, error=err)
                 continue
             tok = int(packed[0, i])

@@ -50,6 +50,7 @@ import numpy as np
 from .. import perfdebug as _perfdebug
 from .. import profiler as _profiler
 from .. import telemetry as _telemetry
+from .. import tracing as _tracing
 from ..base import MXNetError
 from .batcher import (DeadlineExceeded, DynamicBatcher, InvalidRequest,
                       Overloaded)
@@ -160,6 +161,14 @@ class ServingHandle:
         return total
 
     def metrics_text(self):
+        exp_dir = os.environ.get("MXNET_TELEMETRY_EXPORT_DIR")
+        if exp_dir:
+            # fleet mode: one scrape returns the MERGED view of every
+            # process exporting into the shared directory (this one
+            # included) — counters summed, gauges per-proc, histograms
+            # bucket-merged
+            return _telemetry.prometheus_text(
+                _telemetry.aggregate(exp_dir, include_local=True))
         return _telemetry.prometheus_text()
 
 
@@ -188,7 +197,8 @@ class _Handler(BaseHTTPRequestHandler):
         route = self.path if self.path in ("/predict", "/generate",
                                            "/models", "/healthz",
                                            "/fleet", "/metrics") \
-            else "other"
+            else ("/trace" if self.path.startswith("/trace/")
+                  else "other")
         _telemetry.inc("serving.http.requests", route=route)
 
     def do_GET(self):
@@ -215,6 +225,15 @@ class _Handler(BaseHTTPRequestHandler):
         elif self.path == "/metrics":
             self._send(200, handle.metrics_text().encode(),
                        content_type="text/plain; version=0.0.4")
+        elif self.path.startswith("/trace/"):
+            tid = self.path[len("/trace/"):]
+            tr = _tracing.tree(tid)
+            if tr is None:
+                self._send(404, {"error": "unknown trace %r (tracing "
+                                 "off, id never minted, or evicted "
+                                 "from the span ring)" % tid})
+            else:
+                self._send(200, tr)
         else:
             self._send(404, {"error": "unknown route %r" % self.path})
 
@@ -318,6 +337,11 @@ class _Handler(BaseHTTPRequestHandler):
         # batcher's dispatch span (and compile/fit spans)
         prof = _profiler.running()
         span_us = _profiler.now_us() if prof else 0.0
+        # distributed-trace ROOT for the request: stacked on this
+        # handler thread, so the batcher's submit-side span parents
+        # under it automatically
+        hsp = _tracing.start_span("serving.http.request",
+                                  route="/predict", model=model)
         try:
             handle = srv.serving_handle
             try:
@@ -354,6 +378,7 @@ class _Handler(BaseHTTPRequestHandler):
                              "shape": list(out.shape),
                              "output": out.tolist()})
         finally:
+            hsp.end("ok")
             with srv.admission_lock:
                 srv.admitted_requests -= 1
             if prof:
@@ -387,6 +412,11 @@ class _Handler(BaseHTTPRequestHandler):
             return
         srv = self.server
         tok_q = _queue.Queue() if stream else None
+        # request ROOT span: the session root opened inside
+        # engine.submit() (same thread) parents under it, so GET
+        # /trace/<id> shows HTTP -> generate -> admit/failover hops
+        hsp = _tracing.start_span("serving.http.request",
+                                  route="/generate", model=model)
         try:
             handle = srv.serving_handle
             kw = {"max_new_tokens": max_new, "temperature": temperature,
@@ -427,10 +457,13 @@ class _Handler(BaseHTTPRequestHandler):
                 return self._send(200, {
                     "model": model, "version": version,
                     "tokens": tokens, "n_tokens": len(tokens),
+                    "trace_id": hsp.trace_id if hsp else None,
                     "ttft_ms": None if ttft is None
                     else round(ttft * 1e3, 3)})
-            self._stream_session(model, version, sess, tok_q, timeout)
+            self._stream_session(model, version, sess, tok_q, timeout,
+                                 trace_id=hsp.trace_id if hsp else None)
         finally:
+            hsp.end("ok")
             with srv.admission_lock:
                 srv.admitted_requests -= 1
 
@@ -449,7 +482,8 @@ class _Handler(BaseHTTPRequestHandler):
         else:
             self._write_chunk({"token": int(item[1])})
 
-    def _stream_session(self, model, version, sess, tok_q, timeout):
+    def _stream_session(self, model, version, sess, tok_q, timeout,
+                        trace_id=None):
         """Chunked ndjson streaming: one ``{"token": id}`` line per
         generated token AS IT LANDS (the engine's ``on_token`` callback
         feeds the queue from its loop thread), interleaved with
@@ -491,6 +525,7 @@ class _Handler(BaseHTTPRequestHandler):
                                    "model": model, "version": version,
                                    "migrations": getattr(sess,
                                                          "migrations", 0),
+                                   "trace_id": trace_id,
                                    "ttft_ms": None if ttft is None
                                    else round(ttft * 1e3, 3)})
             except Exception as e:

@@ -57,6 +57,7 @@ import time
 from collections import deque
 
 from .. import telemetry as _telemetry
+from .. import tracing as _tracing
 from ..base import MXNetError
 from ..compile_cache import _env_float, _env_int
 from .batcher import DeadlineExceeded, Overloaded
@@ -552,6 +553,7 @@ class ReplicaPool:
             if self._total_outstanding >= self._max_outstanding:
                 _telemetry.inc("serving.shed.count", model=self.name,
                                reason="overload")
+                self._trace_shed("overload")
                 raise Overloaded(
                     "pool %r overloaded: %d outstanding >= bound %d"
                     % (self.name, self._total_outstanding,
@@ -561,6 +563,7 @@ class ReplicaPool:
                     and int(priority) < self._priority_floor:
                 _telemetry.inc("serving.shed.count", model=self.name,
                                reason="priority")
+                self._trace_shed("priority")
                 raise Overloaded(
                     "pool %r past its priority watermark (%d/%d)%s: "
                     "priority %d < floor %d shed"
@@ -573,6 +576,7 @@ class ReplicaPool:
                     and self._tenant_out.get(tenant_key, 0) >= int(quota):
                 _telemetry.inc("serving.shed.count", model=self.name,
                                reason="quota")
+                self._trace_shed("quota")
                 raise QuotaExceeded(
                     "tenant %r at its quota of %d outstanding requests"
                     % (tenant_key, int(quota)))
@@ -580,6 +584,7 @@ class ReplicaPool:
             if r is None:
                 _telemetry.inc("serving.shed.count", model=self.name,
                                reason="overload")
+                self._trace_shed("no_replica")
                 raise Overloaded("pool %r has no healthy replicas "
                                  "(all quarantined/warming)" % self.name)
             self._outstanding[r.rid] += 1
@@ -786,9 +791,19 @@ class ReplicaPool:
     def _shed_session(self, sess, reason, err):
         _telemetry.inc("serving.shed.count", model=self.name,
                        reason=reason)
+        sess.trace.end("shed", reason=reason)
         sess._resolve(error=err)
 
+    def _trace_shed(self, reason):
+        # pool-level sheds happen BEFORE a session (and its root span)
+        # exists: mint a zero-length shed span so rejected requests
+        # still show up in the caller's trace
+        _tracing.start_span("serving.generate", stack=False,
+                            model=self.name).end("shed", reason=reason)
+
     def _fire_failover_event(self, sess, info):
+        if sess.trace:
+            info.setdefault("trace_id", sess.trace.trace_id)
         cb = sess.on_event
         if cb is None:
             return
@@ -861,6 +876,13 @@ class ReplicaPool:
             _telemetry.set_gauge("serving.pool.outstanding", out_dst,
                                  model=self.name, replica=str(target.rid))
             sess._on_done = self._make_done_hook(target.rid, tenant_key)
+            # the hop itself is a span under the session root: the
+            # assembled trace shows replica A's admit, the failover
+            # hop, then replica B's re-admit — one rooted tree
+            fsp = _tracing.start_span(
+                "serving.failover", parent=sess.trace, stack=False,
+                from_replica=str(rid), to_replica=str(target.rid),
+                attempt=sess.migrations, reason=reason)
             # the stream's failover line goes out BEFORE resume(): the
             # target worker can emit the first resumed token the moment
             # the session is enqueued, and the event must precede it
@@ -874,10 +896,12 @@ class ReplicaPool:
                 # resume (transcript outgrew the buckets, target closing
                 # under a racing swap) sheds typed, never drops
                 sess.migrate_t0 = None
+                fsp.end("error", error=type(e).__name__)
                 self._shed_session(sess, reason, MXNetError(
                     "failover re-admission on replica %d failed: %s"
                     % (target.rid, e)))
                 continue
+            fsp.end("migrated")
             _telemetry.inc("serving.failover.count", model=self.name)
             _telemetry.inc("serving.failover.migrations.count",
                            model=self.name, replica=str(rid))
@@ -885,7 +909,9 @@ class ReplicaPool:
                              model=self.name, src=str(rid),
                              dst=str(target.rid), reason=reason,
                              attempt=sess.migrations,
-                             tokens_generated=len(sess.tokens))
+                             tokens_generated=len(sess.tokens),
+                             **({"trace_id": sess.trace.trace_id}
+                                if sess.trace else {}))
 
     def adopt(self, sess):
         """Admit an in-flight session migrated from OUTSIDE this pool —
@@ -911,19 +937,26 @@ class ReplicaPool:
             self._migrations_in[target.rid] += 1
             self._failovers += 1
         sess._on_done = self._make_done_hook(target.rid, tenant_key)
+        fsp = _tracing.start_span(
+            "serving.failover", parent=sess.trace, stack=False,
+            to_replica=str(target.rid), version_swap=True)
         # event before resume(), as in _migrate_sessions: the stream's
         # failover line must precede the first successor-side token
         self._fire_failover_event(sess, {
             "to_replica": str(target.rid), "version_swap": True})
         try:
             target.engine.resume(sess)
-        except Exception:
+        except Exception as e:
+            fsp.end("error", error=type(e).__name__)
             self._settle(target.rid, tenant_key)
             raise
+        fsp.end("migrated")
         _telemetry.inc("serving.failover.count", model=self.name)
         _telemetry.event("serving.failover.adopt", model=self.name,
                          dst=str(target.rid),
-                         tokens_generated=len(sess.tokens))
+                         tokens_generated=len(sess.tokens),
+                         **({"trace_id": sess.trace.trace_id}
+                            if sess.trace else {}))
         return sess
 
     # -- registry servable surface ----------------------------------------
@@ -1019,7 +1052,9 @@ class ReplicaPool:
                         continue
                     _telemetry.event("serving.failover.version_swap",
                                      model=self.name, src=str(r.rid),
-                                     tokens_generated=len(sess.tokens))
+                                     tokens_generated=len(sess.tokens),
+                                     **({"trace_id": sess.trace.trace_id}
+                                        if sess.trace else {}))
             try:
                 if r.engine.close(drain=drain and adopt is None) is False:
                     clean = False

@@ -48,7 +48,9 @@ __all__ = ["enabled", "enable", "disable", "inc", "declare", "set_gauge",
            "prometheus_text", "write_prometheus", "reset", "sample_memory",
            "phase_totals", "counter_total", "gauge_value", "hist_quantile",
            "hist_state", "quantile_from_counts", "events_recent",
-           "add_phase_hook", "remove_phase_hook", "set_phase_hook"]
+           "add_phase_hook", "remove_phase_hook", "set_phase_hook",
+           "aggregate", "start_exporter", "stop_exporter",
+           "exporter_running"]
 
 #: default histogram bucket upper bounds (seconds-flavored; callers may
 #: pass their own on first ``observe`` of a metric)
@@ -77,7 +79,11 @@ _enabled = (os.environ.get("MXNET_TELEMETRY", "0")
             # watchdog without telemetry would see a healthy job as
             # eternally stalled and false-trip at the deadline floor
             or os.environ.get("MXNET_WATCHDOG", "")
-            not in ("0", "", "false"))
+            not in ("0", "", "false")
+            # an armed fleet exporter implies telemetry: its whole
+            # output is this registry's snapshot, so an export dir over
+            # a disabled registry would publish empty files forever
+            or bool(os.environ.get("MXNET_TELEMETRY_EXPORT_DIR")))
 
 
 def enabled():
@@ -102,6 +108,14 @@ def _key(name, labels):
     return (name, tuple(sorted((k, str(v)) for k, v in labels.items())))
 
 
+#: counter keys declared at zero (``inc(name, 0)``) — remembered across
+#: :func:`reset` so an enabled-mode reset (the exporter keeps running,
+#: a test clears mid-run) re-seeds the declared families instead of
+#: silently dropping them from ``snapshot()``/Prometheus until their
+#: next increment
+_declared = set()
+
+
 # -- recording --------------------------------------------------------------
 def inc(name, value=1, **labels):
     """Add ``value`` to counter ``name`` (``inc(name, 0)`` declares it at
@@ -111,6 +125,8 @@ def inc(name, value=1, **labels):
         return
     k = _key(name, labels)
     with _lock:
+        if value == 0:
+            _declared.add(k)
         _counters[k] = _counters.get(k, 0) + value
 
 
@@ -486,41 +502,53 @@ def _prom_num(v):
     return "%d" % int(v) if v.is_integer() else repr(v)
 
 
-def prometheus_text():
-    """The registry in Prometheus text-exposition format (counter /
-    gauge / histogram types, cumulative ``le`` buckets)."""
-    with _lock:
-        counters = sorted(_counters.items())
-        gauges = sorted(_gauges.items())
-        hists = sorted(_hists.items())
+def _parse_label_str(s):
+    """Invert :func:`_label_str`: ``"a=1,b=x"`` -> ``[("a","1"),
+    ("b","x")]`` (the snapshot's label encoding, shared by
+    :func:`aggregate` and the Prometheus renderer)."""
+    if not s:
+        return []
+    out = []
+    for part in s.split(","):
+        k, _, v = part.partition("=")
+        out.append((k, v))
+    return out
+
+
+def _bucket_order(bound):
+    return float("inf") if bound == "+Inf" else float(bound)
+
+
+def prometheus_text(snap=None):
+    """The registry — or any :func:`snapshot`/:func:`aggregate`-shaped
+    dict passed as ``snap`` — in Prometheus text-exposition format
+    (counter / gauge / histogram types, cumulative ``le`` buckets)."""
+    if snap is None:
+        snap = snapshot()
     lines = []
-    for kind, store in (("counter", counters), ("gauge", gauges)):
-        seen = set()
-        for (name, labels), v in store:
+    for kind, store in (("counter", snap.get("counters", {})),
+                        ("gauge", snap.get("gauges", {}))):
+        for name in sorted(store):
             pname = _prom_name(name)
-            if pname not in seen:
-                seen.add(pname)
-                lines.append("# TYPE %s %s" % (pname, kind))
-            lines.append("%s%s %s" % (pname, _prom_labels(labels),
-                                      _prom_num(v)))
-    seen = set()
-    for (name, labels), h in hists:
+            lines.append("# TYPE %s %s" % (pname, kind))
+            for lstr in sorted(store[name]):
+                lines.append("%s%s %s" % (
+                    pname, _prom_labels(_parse_label_str(lstr)),
+                    _prom_num(store[name][lstr])))
+    for name in sorted(snap.get("histograms", {})):
         pname = _prom_name(name)
-        if pname not in seen:
-            seen.add(pname)
-            lines.append("# TYPE %s histogram" % pname)
-        acc = 0
-        for b, c in zip(h.buckets, h.counts):
-            acc += c
-            lines.append("%s_bucket%s %d" % (
-                pname, _prom_labels(labels, [("le", "%g" % b)]), acc))
-        lines.append("%s_bucket%s %d" % (
-            pname, _prom_labels(labels, [("le", "+Inf")]),
-            acc + h.counts[-1]))
-        lines.append("%s_sum%s %s" % (pname, _prom_labels(labels),
-                                      _prom_num(h.sum)))
-        lines.append("%s_count%s %d" % (pname, _prom_labels(labels),
-                                        h.count))
+        lines.append("# TYPE %s histogram" % pname)
+        for lstr in sorted(snap["histograms"][name]):
+            h = snap["histograms"][name][lstr]
+            labels = _parse_label_str(lstr)
+            for b in sorted(h["buckets"], key=_bucket_order):
+                lines.append("%s_bucket%s %d" % (
+                    pname, _prom_labels(labels, [("le", b)]),
+                    h["buckets"][b]))
+            lines.append("%s_sum%s %s" % (pname, _prom_labels(labels),
+                                          _prom_num(h["sum"])))
+            lines.append("%s_count%s %d" % (pname, _prom_labels(labels),
+                                            h["count"]))
     return "\n".join(lines) + "\n"
 
 
@@ -532,13 +560,234 @@ def write_prometheus(path):
     return path
 
 
+# -- fleet aggregation -------------------------------------------------------
+def _merge_hists(dicts):
+    """Merge several :func:`_hist_dict`-shaped histograms bucket-wise:
+    each cumulative bucket series is decomposed into per-bucket counts,
+    summed over the union of bounds, and re-accumulated — so a fleet
+    quantile comes from MERGED buckets, not an average of per-process
+    quantiles."""
+    bounds = sorted({_bucket_order(b) for d in dicts
+                     for b in d.get("buckets", {}) if b != "+Inf"})
+    idx = {b: i for i, b in enumerate(bounds)}
+    per = [0] * (len(bounds) + 1)   # +1: overflow
+    count, total = 0, 0.0
+    mn = mx = None
+    for d in dicts:
+        cum = d.get("buckets", {})
+        prev = 0
+        for b in sorted((b for b in cum if b != "+Inf"),
+                        key=_bucket_order):
+            per[idx[_bucket_order(b)]] += cum[b] - prev
+            prev = cum[b]
+        per[-1] += cum.get("+Inf", prev) - prev
+        count += d.get("count", 0)
+        total += d.get("sum", 0.0)
+        if d.get("min") is not None:
+            mn = d["min"] if mn is None else min(mn, d["min"])
+        if d.get("max") is not None:
+            mx = d["max"] if mx is None else max(mx, d["max"])
+    merged, acc = {}, 0
+    for b, c in zip(bounds, per[:-1]):
+        acc += c
+        merged["%g" % b] = acc
+    merged["+Inf"] = acc + per[-1]
+    return {"count": count, "sum": total, "min": mn, "max": mx,
+            "mean": (total / count) if count else 0.0, "buckets": merged}
+
+
+def aggregate(directory=None, snapshots=None, include_local=False):
+    """Merge several processes' registries into ONE snapshot-shaped
+    dict (renderable by ``prometheus_text(snap)``):
+
+    * **counters** are summed per (family, label set) — fleet totals;
+    * **gauges** keep one entry per process, the label set extended
+      with ``proc=<name>`` (a gauge is a state, not a flow: summing
+      two replicas' ``slot_occupancy`` would fabricate a third state);
+    * **histograms** merge bucket-wise (:func:`_merge_hists`) so fleet
+      quantiles come from combined buckets;
+    * **events** concatenate (each tagged with its ``proc``), newest
+      last, bounded to the per-process ring size.
+
+    Sources: every ``*.telemetry.json`` under ``directory`` (the
+    :func:`start_exporter` layout; torn or garbled files are skipped —
+    they lose one cadence, not the merge), plus any pre-loaded
+    ``snapshots`` dicts, plus this process's live registry when
+    ``include_local`` (tagged ``proc=local`` unless the exporter names
+    it).  Returns ``{"procs": [...], "counters", "gauges",
+    "histograms", "events"}``."""
+    snaps = list(snapshots or ())
+    local_proc = _exporter.proc if _exporter is not None else "local"
+    if directory:
+        try:
+            names = sorted(os.listdir(directory))
+        except OSError:
+            names = []
+        for fn in names:
+            if not fn.endswith(".telemetry.json"):
+                continue
+            # include_local reads THIS process from its live registry;
+            # its own (staler) export file must not double-count it
+            if include_local \
+                    and fn == "%s.telemetry.json" % local_proc:
+                continue
+            try:
+                with open(os.path.join(directory, fn)) as f:
+                    snaps.append(json.load(f))
+            except (OSError, ValueError):
+                continue
+    if include_local:
+        snaps.append(dict(snapshot(), proc=local_proc))
+    procs, counters, gauges, hist_parts = [], {}, {}, {}
+    events = []
+    for i, s in enumerate(snaps):
+        proc = str(s.get("proc") or "p%d" % i)
+        procs.append(proc)
+        for name, by_label in s.get("counters", {}).items():
+            dst = counters.setdefault(name, {})
+            for lstr, v in by_label.items():
+                dst[lstr] = dst.get(lstr, 0) + v
+        for name, by_label in s.get("gauges", {}).items():
+            dst = gauges.setdefault(name, {})
+            for lstr, v in by_label.items():
+                dst[(lstr + "," if lstr else "") + "proc=" + proc] = v
+        for name, by_label in s.get("histograms", {}).items():
+            dst = hist_parts.setdefault(name, {})
+            for lstr, h in by_label.items():
+                dst.setdefault(lstr, []).append(h)
+        recent = s.get("events", {}).get("recent", [])
+        events.extend(dict(r, proc=proc) for r in recent)
+    hists = {name: {lstr: _merge_hists(parts)
+                    for lstr, parts in by_label.items()}
+             for name, by_label in hist_parts.items()}
+    events.sort(key=lambda r: r.get("ts", 0))
+    events = events[-_events.maxlen:]
+    return {"enabled": True, "procs": procs, "counters": counters,
+            "gauges": gauges, "histograms": hists,
+            "events": {"count": len(events), "recent": events}}
+
+
+# -- fleet export ------------------------------------------------------------
+class _Exporter(threading.Thread):
+    """Cadence thread publishing this process's registry as an atomic
+    snapshot file ``<proc>.telemetry.json`` under the export dir — the
+    same one-file-per-member layout as ``tools/supervise.py``'s
+    heartbeat dir, so a supervised fleet's telemetry and liveness live
+    side by side."""
+
+    def __init__(self, directory, interval, proc):
+        super().__init__(name="telemetry-export", daemon=True)
+        self.directory = directory
+        self.interval = interval
+        self.proc = proc
+        self.path = os.path.join(directory, "%s.telemetry.json" % proc)
+        self._stop_ev = threading.Event()
+
+    def write_once(self):
+        """One atomic snapshot publish; never raises (a full disk
+        loses one cadence, not the process)."""
+        from .base import atomic_write
+
+        payload = dict(snapshot(), proc=self.proc, pid=os.getpid(),
+                       export_ts=round(time.time(), 6))
+        blob = json.dumps(payload, default=str)
+
+        def _w(tmp):
+            with open(tmp, "w") as f:
+                f.write(blob)
+
+        try:
+            # durable=False: the cadence republishes in seconds; an
+            # fsync stall on a loaded host must not back up the fleet
+            atomic_write(self.path, _w, durable=False)
+        except OSError:
+            pass
+
+    def run(self):
+        while not self._stop_ev.wait(self.interval):
+            self.write_once()
+        self.write_once()   # final publish: exit totals are visible
+
+    def stop(self, timeout=5.0):
+        self._stop_ev.set()
+        self.join(timeout)
+
+
+_exporter = None
+
+
+def start_exporter(directory=None, interval_s=None, proc=None):
+    """Arm the fleet export thread (idempotent: a live exporter is
+    returned as-is, so repeated arming — or a :func:`reset` — can
+    never stack cadence threads).  Defaults come from
+    ``MXNET_TELEMETRY_EXPORT_DIR`` / ``_INTERVAL_S`` / ``_PROC``;
+    implies :func:`enable` and writes the first snapshot immediately
+    (a just-launched worker is visible before its first cadence).
+    Also registers a final atexit publish."""
+    global _exporter
+    if _exporter is not None and _exporter.is_alive():
+        return _exporter
+    directory = directory or os.environ.get("MXNET_TELEMETRY_EXPORT_DIR")
+    if not directory:
+        raise ValueError("start_exporter needs a directory (or "
+                         "MXNET_TELEMETRY_EXPORT_DIR)")
+    if interval_s is None:
+        try:
+            interval_s = float(os.environ.get(
+                "MXNET_TELEMETRY_EXPORT_INTERVAL_S", "2.0") or 2.0)
+        except ValueError:
+            interval_s = 2.0
+    proc = proc or os.environ.get("MXNET_TELEMETRY_EXPORT_PROC") \
+        or "pid%d" % os.getpid()
+    enable()
+    os.makedirs(directory, exist_ok=True)
+    _exporter = _Exporter(directory, max(0.05, float(interval_s)), proc)
+    _exporter.write_once()
+    _exporter.start()
+    import atexit
+
+    atexit.register(_atexit_export)
+    return _exporter
+
+
+def _atexit_export():  # pragma: no cover - exercised via subprocess test
+    if _exporter is not None and _exporter.is_alive():
+        _exporter.stop()
+
+
+def stop_exporter():
+    """Stop the export thread (final snapshot included); no-op when
+    none is armed."""
+    global _exporter
+    exp, _exporter = _exporter, None
+    if exp is not None and exp.is_alive():
+        exp.stop()
+
+
+def exporter_running():
+    """True while the cadence thread is alive (the reset-audit test's
+    leak probe)."""
+    return _exporter is not None and _exporter.is_alive()
+
+
 def reset():
-    """Clear all metrics and events (tests; enablement is unchanged)."""
+    """Clear all metrics and events (tests; enablement is unchanged).
+
+    While ENABLED, counter families declared at zero (``inc(name,
+    0)``) are re-seeded rather than dropped — a mid-run reset under a
+    live exporter must not make declared families vanish from the
+    exposition until their next increment.  A disabled reset clears
+    everything (the test fixtures' teardown path).  The export thread,
+    if armed, is left running: it publishes whatever the registry
+    holds and is stopped only by :func:`stop_exporter`."""
     with _lock:
         _counters.clear()
         _gauges.clear()
         _hists.clear()
         _events.clear()
+        if _enabled:
+            for k in _declared:
+                _counters[k] = 0
 
 
 def _atexit_dump():  # pragma: no cover - exercised via subprocess test
@@ -559,3 +808,9 @@ if os.environ.get("MXNET_TELEMETRY_DUMP"):
     import atexit
 
     atexit.register(_atexit_dump)
+
+if os.environ.get("MXNET_TELEMETRY_EXPORT_DIR"):
+    # env-armed fleet export: the process publishes itself from import
+    # on, no call site needed (supervised children get the dir from
+    # tools/supervise.py --telemetry-dir)
+    start_exporter()

@@ -1,0 +1,351 @@
+"""graftrace — distributed request/step tracing.
+
+The stack is a distributed system — replica pools migrate generation
+sessions across engines (PR 12), elastic KVStore jobs reshard across
+worker processes (PR 11), supervised fleets restart members under a
+harness (PR 15/16) — but until now no identifier survived a hop: a
+generation that failed over mid-decode, or a reshard cycle spanning
+four workers, could not be reconstructed after the fact.  This module
+mints a ``trace_id``/``span_id`` at every entry point (HTTP request,
+batcher submit, decode session, ``fit`` batch, checkpoint write,
+elastic reshard) and carries it through routing → dispatch → failover
+→ resume, and over the KVStore wire (an optional ``trace`` field on
+push/pull/barrier/reshard verbs) so worker↔coordinator spans stitch
+into one tree.
+
+Span model (a deliberately small slice of the OpenTelemetry shape):
+
+* a **trace** is one request/step's causal tree, identified by a
+  16-hex ``trace_id``;
+* a **span** is one timed operation inside it — 8-hex ``span_id``,
+  ``parent_id`` link, wall-clock ``t0``/``t1``, measured ``dur_s``,
+  free-form ``attrs``, and a typed ``status``: ``ok`` / ``shed`` /
+  ``migrated`` / ``retry`` / ``error`` (``in_flight`` for live spans
+  in a :func:`tree` read);
+* parenting is implicit on one thread (a thread-local span stack) and
+  explicit across threads/processes (``parent=`` a :class:`Span`, or
+  ``trace_id=``/``parent_id=`` from a wire context).
+
+Finished spans land in a bounded ring (``MXNET_TRACE_RING``, default
+4096) that the flight recorder dumps as ndjson
+(``spans-<pid>-<seq>-<reason>.ndjson``) and ``GET /trace/<id>`` on the
+serving frontend assembles — live spans included — via :func:`tree`.
+When the chrome-trace profiler is running, every ended span is also a
+``profiler.record`` event on the same timeline as phase/dispatch
+spans.
+
+Cost model (the PR 2 discipline): tracing is OFF by default and
+:func:`start_span` checks one module bool first, returning the shared
+falsy :data:`NULL_SPAN` — a disabled entry point pays one call and one
+branch, no clock read, no allocation.  Enable with ``MXNET_TRACE=1``
+(or :func:`enable`); tests/test_tracing.py pins the disabled per-batch
+overhead.
+
+See docs/observability.md "Distributed tracing & fleet aggregation".
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+
+from . import profiler as _profiler
+
+__all__ = ["enabled", "enable", "disable", "start_span", "current",
+           "ctx", "tree", "spans_recent", "reset", "Span", "NULL_SPAN",
+           "STATUSES"]
+
+#: the typed span statuses (``in_flight`` is synthesized for live
+#: spans in :func:`tree` reads, never stored)
+STATUSES = ("ok", "shed", "migrated", "retry", "error")
+
+
+def _ring_size():
+    try:
+        return max(64, int(os.environ.get("MXNET_TRACE_RING", "") or 4096))
+    except ValueError:
+        return 4096
+
+
+_lock = threading.Lock()
+_ring = deque(maxlen=_ring_size())   # finished span dicts, oldest first
+_live = {}                           # span_id -> Span (in flight)
+_tls = threading.local()
+
+_enabled = os.environ.get("MXNET_TRACE", "0") not in ("0", "", "false")
+
+
+def enabled():
+    """True when spans record (``MXNET_TRACE=1`` or :func:`enable`);
+    the one check every entry point makes."""
+    return _enabled
+
+
+def enable():
+    global _enabled
+    _enabled = True
+
+
+def disable():
+    global _enabled
+    _enabled = False
+
+
+def _new_id(nbytes):
+    return os.urandom(nbytes).hex()
+
+
+def _stack():
+    st = getattr(_tls, "stack", None)
+    if st is None:
+        st = _tls.stack = []
+    return st
+
+
+class _NullSpan:
+    """The falsy no-op span a disabled :func:`start_span` returns:
+    every method is a pass, so instrumented code needs no enablement
+    branches of its own."""
+
+    __slots__ = ()
+    trace_id = None
+    span_id = None
+    parent_id = None
+
+    def __bool__(self):
+        return False
+
+    def annotate(self, **attrs):
+        pass
+
+    def end(self, status="ok", **attrs):
+        pass
+
+    def ctx(self):
+        return None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+#: the shared disabled-mode span (one allocation per process)
+NULL_SPAN = _NullSpan()
+
+
+class Span:
+    """One live span.  Create via :func:`start_span`; finish EXACTLY
+    once via :meth:`end` (idempotent — a second call is ignored, so a
+    failover path and a late resolve cannot double-record)."""
+
+    __slots__ = ("trace_id", "span_id", "parent_id", "name", "t0",
+                 "status", "attrs", "_pc0", "_stacked", "_ended")
+
+    def __init__(self, name, trace_id, parent_id):
+        self.name = name
+        self.trace_id = trace_id
+        self.span_id = _new_id(4)
+        self.parent_id = parent_id
+        self.t0 = time.time()
+        self.status = None
+        self.attrs = {}
+        self._pc0 = time.perf_counter()
+        self._stacked = False
+        self._ended = False
+
+    def __bool__(self):
+        return True
+
+    def annotate(self, **attrs):
+        """Attach attributes to a live span (last write per key wins)."""
+        self.attrs.update(attrs)
+
+    def ctx(self):
+        """The wire context: ``{"trace_id", "span_id"}`` — what a
+        KVStore message or a cross-process hand-off carries so the
+        remote side can parent its span here."""
+        return {"trace_id": self.trace_id, "span_id": self.span_id}
+
+    def _snapshot(self, live=False):
+        return {"trace_id": self.trace_id, "span_id": self.span_id,
+                "parent_id": self.parent_id, "name": self.name,
+                "t0": round(self.t0, 6),
+                "status": "in_flight" if live else self.status,
+                "attrs": dict(self.attrs)}
+
+    def end(self, status="ok", **attrs):
+        """Finish the span with a typed ``status``; moves it from the
+        live set into the bounded finished ring (and onto the
+        chrome-trace timeline when the profiler runs)."""
+        prof = _profiler.running()
+        end_us = _profiler.now_us() if prof else 0.0
+        dur = time.perf_counter() - self._pc0
+        with _lock:
+            if self._ended:
+                return
+            self._ended = True
+            self.status = status
+            if attrs:
+                self.attrs.update(attrs)
+            _live.pop(self.span_id, None)
+            rec = {"trace_id": self.trace_id, "span_id": self.span_id,
+                   "parent_id": self.parent_id, "name": self.name,
+                   "t0": round(self.t0, 6),
+                   "t1": round(self.t0 + dur, 6),
+                   "dur_s": round(dur, 6), "status": status,
+                   "attrs": dict(self.attrs)}
+            _ring.append(rec)
+        if self._stacked:
+            st = getattr(_tls, "stack", None)
+            # only pop when ending on the opening thread with this
+            # span on top — a cross-thread end (failover resolve) must
+            # not corrupt another thread's stack
+            if st and st[-1] is self:
+                st.pop()
+        if prof:
+            _profiler.record("trace:%s" % self.name, "trace",
+                             end_us - dur * 1e6, end_us)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if exc_type is not None:
+            self.end("error", error=str(exc))
+        else:
+            self.end("ok")
+        return False
+
+
+def start_span(name, parent=None, trace_id=None, parent_id=None,
+               stack=True, **attrs):
+    """Open a span.
+
+    Parent resolution, in order: an explicit ``parent`` :class:`Span`
+    (the cross-thread hand-off — an engine loop parents on the
+    session's root span), an explicit wire context
+    (``trace_id``/``parent_id`` from a KVStore message), else the
+    calling thread's current span; with none of those this span ROOTS
+    a fresh trace.  ``stack=False`` opts out of thread-local parenting
+    for spans that outlive their opening call (a session's root span
+    must not become the implicit parent of unrelated work on the
+    submitting thread).  Returns :data:`NULL_SPAN` when disabled."""
+    if not _enabled:
+        return NULL_SPAN
+    if parent is not None and parent:
+        tid, pid = parent.trace_id, parent.span_id
+    elif trace_id is not None:
+        tid, pid = trace_id, parent_id
+    else:
+        cur = _stack()
+        top = cur[-1] if cur else None
+        if top is not None:
+            tid, pid = top.trace_id, top.span_id
+        else:
+            tid, pid = _new_id(8), None
+    sp = Span(name, tid, pid)
+    if attrs:
+        sp.attrs.update(attrs)
+    with _lock:
+        # bound the live set too: a span that is never ended (a bug,
+        # or an abandoned session) must not leak forever — evict the
+        # oldest as force-ended
+        if len(_live) >= max(1024, _ring.maxlen):
+            oldest = next(iter(_live.values()))
+            _live.pop(oldest.span_id, None)
+            oldest._ended = True
+            _ring.append(oldest._snapshot(live=False) | {
+                "t1": None, "dur_s": None, "status": "error",
+                "attrs": dict(oldest.attrs, dropped="live-ring-full")})
+        _live[sp.span_id] = sp
+    if stack:
+        sp._stacked = True
+        _stack().append(sp)
+    return sp
+
+
+def current():
+    """The calling thread's innermost live span, or None."""
+    st = getattr(_tls, "stack", None)
+    return st[-1] if st else None
+
+
+def ctx():
+    """The calling thread's current wire context (``{"trace_id",
+    "span_id"}``), or None — what :meth:`KVStore._with_trace` stamps
+    onto outgoing verbs.  One attr read when disabled/absent."""
+    st = getattr(_tls, "stack", None)
+    return st[-1].ctx() if st else None
+
+
+def _trace_spans(trace_id):
+    """Every recorded span of one trace: finished (from the ring) plus
+    live (synthesized ``in_flight``), lock held by caller."""
+    out = [dict(r) for r in _ring if r["trace_id"] == trace_id]
+    out.extend(sp._snapshot(live=True) for sp in _live.values()
+               if sp.trace_id == trace_id)
+    return out
+
+
+def tree(trace_id):
+    """Assemble one trace into a nested tree:
+
+    ``{"trace_id", "n_spans", "root": {span..., "children": [...]},
+    "extra_roots": [...], "orphans": [...], "complete": bool}``
+
+    — ``orphans`` are spans whose parent is not in the trace (the
+    chaos acceptance asserts this stays empty across a replica kill),
+    ``extra_roots`` any parentless span beyond the first, and
+    ``complete`` is True when a root exists, nothing is orphaned, and
+    no span is still in flight.  Returns None for an unknown id."""
+    with _lock:
+        spans = _trace_spans(trace_id)
+    if not spans:
+        return None
+    spans.sort(key=lambda s: s["t0"])
+    ids = {s["span_id"] for s in spans}
+    children = {}
+    roots, orphans = [], []
+    for s in spans:
+        pid = s["parent_id"]
+        if pid is None:
+            roots.append(s)
+        elif pid in ids:
+            children.setdefault(pid, []).append(s)
+        else:
+            orphans.append(s)
+
+    def nest(s):
+        return dict(s, children=[nest(c)
+                                 for c in children.get(s["span_id"], [])])
+
+    in_flight = any(s["status"] == "in_flight" for s in spans)
+    return {"trace_id": trace_id, "n_spans": len(spans),
+            "root": nest(roots[0]) if roots else None,
+            "extra_roots": [nest(r) for r in roots[1:]],
+            "orphans": [dict(s) for s in orphans],
+            "complete": bool(roots) and not roots[1:] and not orphans
+            and not in_flight}
+
+
+def spans_recent(n=1000):
+    """The newest ``n`` FINISHED spans (copies, oldest first) — what
+    the flight recorder dumps as its ndjson span ring."""
+    with _lock:
+        return [dict(r) for r in list(_ring)[-int(n):]]
+
+
+def reset():
+    """Clear the finished ring and the live set (tests; enablement and
+    other threads' stacks are unchanged)."""
+    global _ring
+    with _lock:
+        _ring = deque(maxlen=_ring_size())
+        _live.clear()
+    st = getattr(_tls, "stack", None)
+    if st:
+        del st[:]

@@ -420,3 +420,222 @@ def test_disabled_overhead_is_negligible():
     per_batch = (time.perf_counter() - t0) / n
     assert per_batch < 50e-6, "disabled telemetry costs %.1fus/batch" \
         % (per_batch * 1e6)
+
+
+# -- fleet export & aggregation (ISSUE 17) ----------------------------------
+
+def _publish(tmp_path, proc, fill):
+    """Record ``fill()`` into a fresh registry and publish it as
+    ``<proc>.telemetry.json`` — one simulated fleet member."""
+    telemetry.reset()
+    fill()
+    snap = dict(telemetry.snapshot(), proc=proc, pid=os.getpid(),
+                export_ts=round(time.time(), 6))
+    path = tmp_path / ("%s.telemetry.json" % proc)
+    path.write_text(json.dumps(snap, default=str))
+    telemetry.reset()
+    return snap
+
+
+def test_exporter_reset_audit(tmp_path):
+    """Satellite 2: a ``reset()`` under an armed exporter neither kills
+    the cadence thread nor resurrects stale counters in the next
+    publish, and declared families stay visible at zero."""
+    telemetry.inc("resilience.rollbacks", 0)  # declared at zero
+    telemetry.inc("kvstore.push.count", 7, store="local")
+    exp = telemetry.start_exporter(str(tmp_path), interval_s=0.05,
+                                   proc="w0")
+    try:
+        assert telemetry.exporter_running()
+        path = tmp_path / "w0.telemetry.json"
+        assert path.exists(), "first snapshot publishes immediately"
+        first = json.loads(path.read_text())
+        assert first["proc"] == "w0" and first["pid"] == os.getpid()
+        assert first["counters"]["kvstore.push.count"]["store=local"] \
+            == 7
+
+        telemetry.reset()
+        # the audit: exporter survives the reset...
+        assert telemetry.exporter_running()
+        snap = telemetry.snapshot()
+        # ...declared families are re-seeded at zero, not dropped...
+        assert snap["counters"]["resilience.rollbacks"][""] == 0
+        # ...and the NEXT publish carries no stale pre-reset totals
+        deadline = time.monotonic() + 10
+        while True:
+            cur = json.loads(path.read_text())
+            if cur["export_ts"] > first["export_ts"]:
+                break
+            assert time.monotonic() < deadline
+            time.sleep(0.02)
+        assert "kvstore.push.count" not in cur["counters"]
+        assert cur["counters"]["resilience.rollbacks"][""] == 0
+
+        # idempotent arming: no second thread stacks up
+        assert telemetry.start_exporter(str(tmp_path)) is exp
+    finally:
+        telemetry.stop_exporter()
+    assert not telemetry.exporter_running()
+
+
+def test_aggregate_merges_a_three_process_fleet(tmp_path):
+    """ISSUE 17 acceptance: counter totals equal the sum over dumps,
+    gauges keep per-proc rows, and quantiles come from MERGED
+    buckets."""
+    lat = [0.004, 0.009, 0.030, 0.070, 0.200, 0.450]
+
+    def fill(k):
+        def _f():
+            telemetry.inc("fit.batches", 10 * (k + 1))
+            telemetry.inc("serving.request.count", k + 1, model="m")
+            telemetry.set_gauge("serving.queue.depth", float(k),
+                                model="m")
+            for v in lat[2 * k:2 * k + 2]:
+                telemetry.observe("serving.request.latency_seconds", v)
+        return _f
+
+    snaps = [_publish(tmp_path, "w%d" % k, fill(k)) for k in range(3)]
+    agg = telemetry.aggregate(str(tmp_path))
+    assert agg["procs"] == ["w0", "w1", "w2"]
+    # counters: fleet totals are the exact sum of the dumps
+    assert agg["counters"]["fit.batches"][""] == 10 + 20 + 30
+    assert agg["counters"]["serving.request.count"]["model=m"] == 6
+    for snap in snaps:
+        assert snap["counters"]["fit.batches"][""] in (10, 20, 30)
+    # gauges: one row per proc, never summed
+    g = agg["gauges"]["serving.queue.depth"]
+    assert g == {"model=m,proc=w0": 0.0, "model=m,proc=w1": 1.0,
+                 "model=m,proc=w2": 2.0}
+    # histograms: merged bucket-wise; count/sum are fleet-wide and the
+    # p50 estimate falls inside the observed range
+    h = agg["histograms"]["serving.request.latency_seconds"][""]
+    assert h["count"] == 6
+    assert abs(h["sum"] - sum(lat)) < 1e-9
+    assert h["min"] == min(lat) and h["max"] == max(lat)
+    bounds, counts = [], []
+    prev = 0
+    for b, c in sorted(h["buckets"].items(),
+                       key=lambda kv: float("inf") if kv[0] == "+Inf"
+                       else float(kv[0])):
+        bounds.append(float("inf") if b == "+Inf" else float(b))
+        counts.append(c - prev)
+        prev = c
+    assert prev == 6, "cumulative +Inf bucket holds every observation"
+    q50 = telemetry.quantile_from_counts(
+        [b for b in bounds if b != float("inf")], counts, 0.5,
+        lo=h["min"], hi=h["max"])
+    assert min(lat) <= q50 <= max(lat)
+    # a torn file loses one cadence, not the merge
+    (tmp_path / "torn.telemetry.json").write_text("{not json")
+    again = telemetry.aggregate(str(tmp_path))
+    assert again["counters"]["fit.batches"][""] == 60
+
+
+def test_prometheus_text_of_aggregate_is_strictly_well_formed(tmp_path):
+    """Satellite 3: every line of ``prometheus_text(aggregate(...))``
+    passes a strict exposition-format check — TYPE comments, metric
+    and label name charsets, parseable values, cumulative ascending
+    ``le`` buckets with ``+Inf`` == ``_count``."""
+    def fill(k):
+        def _f():
+            telemetry.inc("serving.request.count", k + 1, model="m")
+            telemetry.set_gauge("serving.queue.depth", k, model="m")
+            telemetry.observe("serving.request.latency_seconds",
+                              0.01 * (k + 1))
+        return _f
+
+    for k in range(2):
+        _publish(tmp_path, "w%d" % k, fill(k))
+    text = telemetry.prometheus_text(telemetry.aggregate(str(tmp_path)))
+    assert text.endswith("\n")
+    import re
+    name_re = r"[a-zA-Z_:][a-zA-Z0-9_:]*"
+    label_re = r'[a-zA-Z_][a-zA-Z0-9_]*="[^"\\]*"'
+    sample_re = re.compile(r"^(%s)(\{%s(,%s)*\})? (-?\d+(\.\d+)?([eE][+-]?\d+)?|\+Inf|-Inf|NaN)$"
+                           % (name_re, label_re, label_re))
+    type_re = re.compile(r"^# TYPE (%s) (counter|gauge|histogram)$"
+                         % name_re)
+    typed = {}
+    samples = []
+    for line in text.splitlines():
+        m = type_re.match(line)
+        if m:
+            assert m.group(1) not in typed, "one TYPE line per family"
+            typed[m.group(1)] = m.group(2)
+            continue
+        m = sample_re.match(line)
+        assert m, "malformed exposition line: %r" % line
+        samples.append(line)
+        base = re.sub(r"_(bucket|sum|count)$", "", m.group(1)) \
+            if m.group(1).endswith(("_bucket", "_sum", "_count")) \
+            else m.group(1)
+        assert m.group(1) in typed or base in typed, \
+            "sample %r precedes its TYPE" % line
+    # histogram series: le buckets cumulative ascending, +Inf == count
+    hist = [t for t, kind in typed.items() if kind == "histogram"]
+    assert hist, "the fixture recorded a histogram"
+    for fam in hist:
+        buckets = [s for s in samples
+                   if s.startswith(fam + "_bucket")]
+        assert buckets
+        values = [int(s.rsplit(" ", 1)[1]) for s in buckets]
+        assert values == sorted(values), "le buckets are cumulative"
+        assert 'le="+Inf"' in buckets[-1]
+        count = next(int(s.rsplit(" ", 1)[1]) for s in samples
+                     if s.startswith(fam + "_count"))
+        assert values[-1] == count
+    # counters carry fleet sums; gauges carry proc= labels
+    assert 'serving_request_count{model="m"} 3' in text
+    assert 'proc="w0"' in text and 'proc="w1"' in text
+
+
+def test_graftop_renders_the_fleet(tmp_path):
+    """tools/graftop.py --once over an export dir: proc table, summed
+    counters, merged-bucket latencies, per-proc gauges."""
+    from tools import graftop
+
+    def fill(k):
+        def _f():
+            telemetry.inc("serving.decode.tokens.count", 100 * (k + 1))
+            telemetry.set_gauge("serving.decode.slot_occupancy",
+                                0.25 * (k + 1), model="lm")
+            telemetry.observe("serving.decode.ttft_seconds",
+                              0.02 * (k + 1), model="lm")
+            telemetry.event("serving.model.load", model="lm", rep=k)
+        return _f
+
+    for k in range(2):
+        _publish(tmp_path, "w%d" % k, fill(k))
+    frame = graftop.render(str(tmp_path))
+    assert "2 proc(s)" in frame
+    assert "w0" in frame and "w1" in frame
+    assert "serving.decode.tokens.count" in frame
+    line = next(ln for ln in frame.splitlines()
+                if "serving.decode.tokens.count" in ln)
+    assert line.rstrip().endswith("300"), line
+    assert "LATENCIES" in frame and "serving.decode.ttft_seconds" in frame
+    assert "proc=w0" in frame and "proc=w1" in frame
+    assert "RECENT EVENTS" in frame and "serving.model.load" in frame
+    # --once prints one frame and exits 0
+    import contextlib
+    import io
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = graftop.main(["--dir", str(tmp_path), "--once"])
+    assert rc == 0 and "graftop" in buf.getvalue()
+
+
+def test_aggregate_include_local_never_double_counts_this_process(
+        tmp_path):
+    """An armed exporter's own file sits in the export dir; a merge
+    with ``include_local`` must read this process from its LIVE
+    registry only — not once from the file and once live."""
+    _publish(tmp_path, "other", lambda: telemetry.inc("fit.batches", 5))
+    telemetry.inc("fit.batches", 3)
+    telemetry.start_exporter(str(tmp_path), interval_s=30.0, proc="me")
+    try:
+        agg = telemetry.aggregate(str(tmp_path), include_local=True)
+        assert agg["procs"].count("me") == 1
+        assert agg["counters"]["fit.batches"][""] == 5 + 3
+    finally:
+        telemetry.stop_exporter()

@@ -1,0 +1,330 @@
+"""graftrace (docs/observability.md "Distributed tracing & fleet
+aggregation", ISSUE 17): span semantics (implicit thread parenting,
+wire contexts, idempotent typed ends, the bounded ring), tree
+assembly via ``tracing.tree`` and ``GET /trace/<id>``, the chaos
+acceptance (a replica kill mid-generation yields ONE rooted tree that
+crosses the killed replica with zero orphans), and the disabled-mode
+overhead pin."""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from mxnet_tpu import faults, telemetry, tracing
+from mxnet_tpu.models import transformer_lm as tlm
+from mxnet_tpu.serving import (DynamicBatcher, ModelRegistry,
+                               ServingHTTPServer, lm_pool)
+
+# the tiny LM of test_decode/test_failover: sub-second compiles on CPU
+VOCAB, EMBED, HEADS, LAYERS, FFN, MAX_LEN = 32, 16, 2, 2, 32, 32
+CFG = tlm.LMConfig(VOCAB, EMBED, HEADS, LAYERS, FFN, MAX_LEN,
+                   eos_id=VOCAB)
+PARAMS = tlm.init_params(CFG, seed=3)
+PROMPT = [5, 7, 9, 2]
+ENGINE_OPTS = {"slots": 4, "prefill_buckets": (8, 32), "max_queue": 64}
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    faults.disarm()
+    tracing.reset()
+    tracing.enable()
+    yield
+    faults.disarm()
+    tracing.disable()
+    tracing.reset()
+
+
+def _names(tr_node, acc=None):
+    acc = [] if acc is None else acc
+    acc.append(tr_node["name"])
+    for c in tr_node.get("children", ()):
+        _names(c, acc)
+    return acc
+
+
+# -- span semantics ---------------------------------------------------------
+
+def test_disabled_start_span_returns_falsy_null_span():
+    tracing.disable()
+    sp = tracing.start_span("x.y")
+    assert sp is tracing.NULL_SPAN and not sp
+    sp.annotate(a=1)
+    sp.end("error")
+    assert sp.ctx() is None
+    assert tracing.spans_recent() == []
+    assert tracing.ctx() is None
+
+
+def test_implicit_parenting_follows_the_thread_stack():
+    with tracing.start_span("outer") as outer:
+        assert tracing.current() is outer
+        assert tracing.ctx() == {"trace_id": outer.trace_id,
+                                 "span_id": outer.span_id}
+        with tracing.start_span("inner") as inner:
+            assert inner.trace_id == outer.trace_id
+            assert inner.parent_id == outer.span_id
+    assert tracing.current() is None
+    recs = tracing.spans_recent()
+    assert [r["name"] for r in recs] == ["inner", "outer"]
+    assert all(r["status"] == "ok" for r in recs)
+
+
+def test_explicit_parent_and_wire_context_parenting():
+    root = tracing.start_span("root", stack=False)
+    child = tracing.start_span("child", parent=root, stack=False)
+    assert (child.trace_id, child.parent_id) \
+        == (root.trace_id, root.span_id)
+    # the KVStore wire shape: a {"trace_id", "span_id"} dict crosses
+    # the process boundary and the remote side parents on it
+    wire = root.ctx()
+    remote = tracing.start_span("kvstore.push",
+                                trace_id=wire["trace_id"],
+                                parent_id=wire["span_id"], stack=False)
+    assert remote.trace_id == root.trace_id
+    assert remote.parent_id == root.span_id
+    child.end("ok")
+    remote.end("ok")
+    root.end("ok")
+    tr = tracing.tree(root.trace_id)
+    assert tr["n_spans"] == 3 and tr["complete"]
+    assert sorted(_names(tr["root"])) == ["child", "kvstore.push",
+                                          "root"]
+
+
+def test_end_is_idempotent_first_status_wins():
+    sp = tracing.start_span("serving.generate", stack=False)
+    sp.end("shed", reason="overload")
+    sp.end("ok", tokens=9)  # the late resolve fallback: a no-op
+    (rec,) = tracing.spans_recent()
+    assert rec["status"] == "shed"
+    assert rec["attrs"]["reason"] == "overload"
+    assert "tokens" not in rec["attrs"]
+
+
+def test_tree_reports_in_flight_orphans_and_unknown():
+    assert tracing.tree("deadbeefdeadbeef") is None
+    live = tracing.start_span("serving.generate", stack=False)
+    tr = tracing.tree(live.trace_id)
+    assert tr["root"]["status"] == "in_flight" and not tr["complete"]
+    # an orphan: its parent span was never recorded in this trace
+    tracing.start_span("lost", trace_id=live.trace_id,
+                       parent_id="ffffffff", stack=False).end("ok")
+    tr = tracing.tree(live.trace_id)
+    assert [o["name"] for o in tr["orphans"]] == ["lost"]
+    live.end("ok")
+    assert not tracing.tree(live.trace_id)["complete"]
+
+
+def test_ring_is_bounded_by_env(monkeypatch):
+    monkeypatch.setenv("MXNET_TRACE_RING", "64")
+    tracing.reset()  # re-reads the env for the new ring
+    for i in range(200):
+        tracing.start_span("s", stack=False).end("ok", i=i)
+    recs = tracing.spans_recent()
+    assert len(recs) == 64
+    assert recs[-1]["attrs"]["i"] == 199  # newest survive
+
+
+def test_statuses_vocabulary_is_pinned():
+    assert tracing.STATUSES == ("ok", "shed", "migrated", "retry",
+                                "error")
+
+
+# -- instrumented entry points ----------------------------------------------
+
+def test_batcher_spans_parent_under_the_submitting_thread():
+    telemetry.reset()
+    with tracing.start_span("serving.http.request") as hsp:
+        b = DynamicBatcher(lambda rows: rows * 2.0, buckets=(1, 8),
+                           max_queue_depth=8)
+        fut = b.submit(np.ones((1, 1), np.float32))
+        b.start()
+        fut.result(timeout=30)
+        b.stop()
+    tr = tracing.tree(hsp.trace_id)
+    assert tr["complete"] and not tr["orphans"]
+    assert _names(tr["root"]) == ["serving.http.request",
+                                  "serving.batch.request"]
+    (bat,) = tr["root"]["children"]
+    assert bat["status"] == "ok" and bat["attrs"]["rows"] == 1
+
+
+def test_batcher_shed_span_is_typed():
+    b = DynamicBatcher(lambda rows: rows, buckets=(8,),
+                       max_queue_depth=1)
+    b.submit(np.ones((1, 1), np.float32))
+    with pytest.raises(Exception):
+        for _ in range(8):  # second submit overflows the queue
+            b.submit(np.ones((1, 1), np.float32))
+    sheds = [r for r in tracing.spans_recent()
+             if r["name"] == "serving.batch.request"
+             and r["status"] == "shed"]
+    assert sheds and sheds[0]["attrs"]["reason"] == "overload"
+    b.stop(drain=False)
+
+
+# -- chaos acceptance: one tree across a replica kill -----------------------
+
+def test_acceptance_replica_kill_yields_single_rooted_tree():
+    """ISSUE 17 acceptance: kill a replica mid-generation with tracing
+    on — the trace is ONE rooted tree that crosses the killed replica
+    (admit on both, a ``migrated`` failover hop), zero orphans, and
+    ``GET /trace/<id>`` returns it."""
+    pool = lm_pool(CFG, PARAMS, n_replicas=2, name="lm",
+                   engine_opts=ENGINE_OPTS)
+    reg = ModelRegistry()
+    reg.register("lm", pool, version=1)
+    srv = ServingHTTPServer(reg, port=0).start()
+    try:
+        faults.arm("serving.replica.kill", at=3)
+        req = urllib.request.Request(
+            srv.url + "/generate",
+            json.dumps({"model": "lm", "prompt": PROMPT,
+                        "max_new_tokens": 10, "temperature": 0.8,
+                        "seed": 99}).encode(),
+            {"Content-Type": "application/json"})
+        resp = json.load(urllib.request.urlopen(req, timeout=120))
+        faults.disarm()
+        tid = resp["trace_id"]
+        assert tid and len(tid) == 16
+        assert resp["n_tokens"] == 10
+
+        # the HTTP span ends after the response bytes leave — poll the
+        # endpoint until the tree settles complete
+        deadline = time.monotonic() + 30
+        while True:
+            tr = json.load(urllib.request.urlopen(
+                srv.url + "/trace/" + tid, timeout=30))
+            if tr["complete"] or time.monotonic() > deadline:
+                break
+            time.sleep(0.02)
+        assert tr["trace_id"] == tid
+        assert tr["complete"], tr
+        assert tr["orphans"] == [] and tr["extra_roots"] == []
+        assert tr["root"]["name"] == "serving.http.request"
+        names = _names(tr["root"])
+        assert names.count("serving.admit") == 2, names
+        assert names.count("serving.failover") == 1
+        def _walk(node):
+            yield node
+            for c in node.get("children", ()):
+                yield from _walk(c)
+
+        spans = list(_walk(tr["root"]))
+        gen = next(s for s in spans if s["name"] == "serving.generate")
+        assert gen["attrs"]["migrations"] == 1
+        fo = next(s for s in spans if s["name"] == "serving.failover")
+        assert fo["status"] == "migrated"
+        assert fo["parent_id"] == gen["span_id"]
+        assert fo["attrs"]["from_replica"] != fo["attrs"]["to_replica"]
+        admits = [s for s in spans if s["name"] == "serving.admit"]
+        assert {a["attrs"]["resumed"] for a in admits} == {False, True}
+        resumed = next(a for a in admits if a["attrs"]["resumed"])
+        assert resumed["attrs"]["reprefilled"] > 0
+    finally:
+        faults.disarm()
+        srv.stop()
+        reg.close()
+
+
+def test_chaos_rolling_kills_every_completed_trace_is_rooted():
+    """The rolling-kill half of the acceptance: two sequential replica
+    kills under concurrent mixed traffic — EVERY resolved generation
+    (completed or typed-shed) leaves a single rooted tree with zero
+    orphans, migrated hops included."""
+    from mxnet_tpu.base import MXNetError
+
+    rs = np.random.RandomState(7)
+    pool = lm_pool(CFG, PARAMS, n_replicas=3, name="lm",
+                   engine_opts=ENGINE_OPTS)
+    sessions = []
+    try:
+        for wave in range(2):
+            faults.arm("serving.replica.kill",
+                       at=2 + int(rs.randint(0, 6)))
+            waved = []
+            for c in range(10):
+                prompt = [int(t) for t in rs.randint(0, VOCAB,
+                                                     size=1 + c % 6)]
+                try:
+                    waved.append(pool.generate(
+                        prompt, max_new_tokens=4 + c % 8,
+                        temperature=0.8, seed=100 * wave + c))
+                except MXNetError:
+                    pass  # typed admission refusal is a legal outcome
+            for s in waved:
+                try:
+                    s.result(300)
+                except MXNetError:
+                    pass  # typed shed is a legal outcome
+            faults.disarm()
+            sessions.extend(waved)
+        assert sessions
+        migrated_traces = 0
+        for s in sessions:
+            tr = tracing.tree(s.trace.trace_id)
+            assert tr is not None
+            assert tr["orphans"] == [], tr
+            assert tr["extra_roots"] == [], tr
+            assert tr["root"]["name"] == "serving.generate"
+            hops = [sp for sp in _names(tr["root"])
+                    if sp == "serving.failover"]
+            migrated_traces += bool(hops)
+        assert migrated_traces > 0, \
+            "the kills must migrate at least one traced session"
+    finally:
+        faults.disarm()
+        pool.close(drain=False)
+
+
+def test_trace_endpoint_404_for_unknown_id():
+    reg = ModelRegistry()
+    srv = ServingHTTPServer(reg, port=0).start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(srv.url + "/trace/0123456789abcdef",
+                                   timeout=30)
+        assert exc.value.code == 404
+    finally:
+        srv.stop()
+        reg.close()
+
+
+# -- shed paths mint typed shed spans ---------------------------------------
+
+def test_pool_overload_shed_records_a_shed_generate_span():
+    from mxnet_tpu.serving import Overloaded
+
+    pool = lm_pool(CFG, PARAMS, n_replicas=1, name="lm",
+                   engine_opts=ENGINE_OPTS)
+    try:
+        pool._max_outstanding = 0  # everything sheds immediately
+        with pytest.raises(Overloaded):
+            pool.generate(PROMPT, max_new_tokens=2)
+    finally:
+        pool.close(drain=False)
+    sheds = [r for r in tracing.spans_recent()
+             if r["name"] == "serving.generate"
+             and r["status"] == "shed"]
+    assert sheds, [r["name"] for r in tracing.spans_recent()]
+
+
+# -- overhead pin -----------------------------------------------------------
+
+def test_disabled_overhead_under_50us_per_call():
+    """ISSUE 17 overhead pin: a disabled entry point pays one call and
+    one branch — far under the 50µs/batch budget."""
+    tracing.disable()
+    n = 20000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        sp = tracing.start_span("fit.batch", epoch=0)
+        sp.end("ok")
+    per_call = (time.perf_counter() - t0) / n
+    assert per_call < 50e-6, per_call
+    assert tracing.spans_recent() == []

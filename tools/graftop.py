@@ -1,0 +1,184 @@
+#!/usr/bin/env python
+"""graftop — live text dashboard over a fleet's telemetry export dir.
+
+Every process started with ``MXNET_TELEMETRY_EXPORT_DIR`` (or under
+``tools/supervise.py --telemetry-dir``) publishes an atomic snapshot of
+its registry into the shared directory on a cadence.  graftop merges
+them with :func:`mxnet_tpu.telemetry.aggregate` — counters summed,
+gauges per process, histogram quantiles from COMBINED buckets — and
+redraws a top(1)-style view:
+
+    python tools/graftop.py --dir /tmp/fleet-telemetry
+    python tools/graftop.py --dir /tmp/fleet-telemetry --once  # one frame
+
+``--once`` prints a single frame and exits (scripts/tests); the default
+loop redraws every ``--interval`` seconds until Ctrl-C.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def _bucket_arrays(hist):
+    """Cumulative ``{"0.005": 3, ..., "+Inf": 9}`` -> (bounds, per-bucket
+    counts) sorted by bound, finite bounds only plus the overflow."""
+    items = sorted(hist.get("buckets", {}).items(),
+                   key=lambda kv: float("inf") if kv[0] == "+Inf"
+                   else float(kv[0]))
+    bounds, counts, prev = [], [], 0
+    for key, cum in items:
+        bounds.append(float("inf") if key == "+Inf" else float(key))
+        counts.append(max(0, cum - prev))
+        prev = max(prev, cum)
+    return bounds, counts
+
+
+def _quantile(hist, q):
+    from mxnet_tpu.telemetry import quantile_from_counts
+
+    bounds, counts = _bucket_arrays(hist)
+    finite = [b for b in bounds if b != float("inf")]
+    if not finite or not sum(counts):
+        return None
+    # counts may run one past the finite bounds (the +Inf overflow);
+    # the estimator's fall-through caps overflow mass at hi
+    return quantile_from_counts(finite, counts, q,
+                                lo=hist.get("min"), hi=hist.get("max"))
+
+
+def _fmt_val(v):
+    if v is None:
+        return "-"
+    if abs(v) >= 1000:
+        return "%.3g" % v
+    return "%.4g" % v
+
+
+def _proc_rows(directory):
+    """[(proc, pid, age_s)] straight from the export files — the
+    merged snapshot has no per-file freshness."""
+    rows = []
+    try:
+        names = sorted(os.listdir(directory))
+    except OSError:
+        return rows
+    now = time.time()
+    for fn in names:
+        if not fn.endswith(".telemetry.json"):
+            continue
+        path = os.path.join(directory, fn)
+        try:
+            with open(path) as f:
+                snap = json.load(f)
+            age = now - float(snap.get("export_ts") or
+                              os.path.getmtime(path))
+        except (OSError, ValueError, TypeError):
+            continue
+        rows.append((str(snap.get("proc") or fn), snap.get("pid"),
+                     max(0.0, age)))
+    return rows
+
+
+def render(directory):
+    """One dashboard frame as a string (pure: testable with --once)."""
+    from mxnet_tpu import telemetry as _telemetry
+
+    agg = _telemetry.aggregate(directory)
+    out = []
+    rows = _proc_rows(directory)
+    out.append("graftop — %s — %d proc(s) — %s"
+               % (directory, len(rows),
+                  time.strftime("%H:%M:%S")))
+    out.append("")
+    out.append("  %-24s %8s %10s" % ("PROC", "PID", "EXPORT AGE"))
+    for proc, pid, age in rows:
+        out.append("  %-24s %8s %9.1fs" % (proc, pid or "-", age))
+    if not rows:
+        out.append("  (no *.telemetry.json exports found yet)")
+
+    counters = agg.get("counters", {})
+    if counters:
+        out.append("")
+        out.append("  COUNTERS (fleet totals, summed across procs)")
+        for name in sorted(counters):
+            by_label = counters[name]
+            total = sum(by_label.values())
+            out.append("  %-44s %12s" % (name, _fmt_val(total)))
+            if len(by_label) > 1:
+                for lstr in sorted(by_label):
+                    if lstr:
+                        out.append("      %-40s %12s"
+                                   % ("{%s}" % lstr,
+                                      _fmt_val(by_label[lstr])))
+
+    hists = agg.get("histograms", {})
+    if hists:
+        out.append("")
+        out.append("  LATENCIES (quantiles from MERGED buckets)")
+        out.append("  %-44s %8s %8s %8s %8s" % ("HISTOGRAM", "n", "p50",
+                                                "p99", "max"))
+        for name in sorted(hists):
+            for lstr in sorted(hists[name]):
+                h = hists[name][lstr]
+                label = name + ("{%s}" % lstr if lstr else "")
+                out.append("  %-44s %8d %8s %8s %8s"
+                           % (label[:44], h.get("count", 0),
+                              _fmt_val(_quantile(h, 0.5)),
+                              _fmt_val(_quantile(h, 0.99)),
+                              _fmt_val(h.get("max"))))
+
+    gauges = agg.get("gauges", {})
+    if gauges:
+        out.append("")
+        out.append("  GAUGES (one row per proc — states, not flows)")
+        for name in sorted(gauges):
+            for lstr in sorted(gauges[name]):
+                out.append("  %-56s %12s"
+                           % ((name + "{%s}" % lstr)[:56],
+                              _fmt_val(gauges[name][lstr])))
+
+    events = agg.get("events", {}).get("recent", [])
+    if events:
+        out.append("")
+        out.append("  RECENT EVENTS (newest last)")
+        for ev in events[-8:]:
+            kind = ev.get("kind", "?")
+            extra = {k: v for k, v in ev.items()
+                     if k not in ("kind", "ts")}
+            out.append("  %-28s %s" % (kind, json.dumps(extra,
+                                                        default=str)))
+    return "\n".join(out)
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description="live text dashboard over a telemetry export dir")
+    parser.add_argument("--dir", required=True,
+                        help="MXNET_TELEMETRY_EXPORT_DIR of the fleet")
+    parser.add_argument("--interval", type=float, default=2.0,
+                        help="redraw cadence in seconds (default 2)")
+    parser.add_argument("--once", action="store_true",
+                        help="print one frame and exit (for scripts)")
+    args = parser.parse_args(argv)
+    if args.once:
+        print(render(args.dir))
+        return 0
+    try:
+        while True:
+            frame = render(args.dir)
+            # clear + home, then the frame: flicker-free enough for a
+            # text dashboard without a curses dependency
+            sys.stdout.write("\x1b[2J\x1b[H" + frame + "\n")
+            sys.stdout.flush()
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

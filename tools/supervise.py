@@ -74,6 +74,11 @@ def main(argv=None):
     parser.add_argument("--heartbeat-timeout", type=float, default=None,
                         help="kill -9 + restart when the heartbeat goes "
                              "this many seconds stale")
+    parser.add_argument("--telemetry-dir", default=None,
+                        help="telemetry export directory passed to every "
+                             "child as MXNET_TELEMETRY_EXPORT_DIR (fleet "
+                             "children export under their child name); "
+                             "point tools/graftop.py at the same dir")
     parser.add_argument("--poll", type=float, default=0.2)
     parser.add_argument("--prefix", default=None,
                         help="checkpoint prefix: before each restart, "
@@ -120,7 +125,8 @@ def main(argv=None):
                               backoff_base=args.backoff_base,
                               backoff_max=args.backoff_max,
                               heartbeat_timeout=args.heartbeat_timeout,
-                              poll_s=args.poll, logger=log)
+                              poll_s=args.poll, logger=log,
+                              telemetry_dir=args.telemetry_dir)
         try:
             return sup.run()
         except KeyboardInterrupt:
@@ -135,7 +141,8 @@ def main(argv=None):
                      heartbeat_path=args.heartbeat,
                      heartbeat_timeout=args.heartbeat_timeout,
                      poll_s=args.poll, logger=log,
-                     resume_prefix=args.prefix)
+                     resume_prefix=args.prefix,
+                     telemetry_dir=args.telemetry_dir)
     try:
         rc = sup.run()
     except RestartBudgetExhausted as e:
