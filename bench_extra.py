@@ -942,7 +942,13 @@ def decode_score(loads=(4, 16, 48), slots=8, max_new=24,
     level records sustained tokens/sec, TTFT p50/p99, the mean slot
     occupancy the engine actually achieved (decoded tokens per step /
     slots — the continuous-batching efficiency number) and sequences
-    per decode step.  The trajectory rows ``ci/check_bench_gate.py``
+    per decode step.  The sweep runs TWICE — dense KV layout and paged
+    (docs/serving.md "Paged KV & prefix cache") — so every paged row
+    carries a ``paged_vs_dense`` tok/sec ratio (the no-regression
+    check) next to ``sessions_per_hbm_gb`` (the capacity headline),
+    and ``decode_kv_capacity_2048`` prices the paged layout at
+    production context length with the pool-sizing arithmetic the
+    engine itself uses.  The trajectory rows ``ci/check_bench_gate.py``
     watches: a slot-lifecycle regression shows up as occupancy loss
     before it shows up as latency."""
     import threading
@@ -953,55 +959,90 @@ def decode_score(loads=(4, 16, 48), slots=8, max_new=24,
     cfg = tlm.LMConfig(vocab, embed, heads, layers, ffn, max_len,
                        eos_id=vocab)  # unreachable EOS: exact lengths
     params = tlm.init_params(cfg, seed=0)
-    rs = np.random.RandomState(0)
-    pool = lm_pool(cfg, params, n_replicas=1, name="bench-lm",
-                   engine_opts={"slots": slots,
-                                "prefill_buckets": (8, 32),
-                                "max_queue": 512})
-    eng = pool.replicas[0].engine
-    for load in loads:
-        ttfts = []
-        lock = threading.Lock()
-        errors = []
-        # prompts drawn BEFORE the threads start: RandomState is not
-        # thread-safe, and the gate compares runs — the workload must
-        # be identical every run
-        prompts = [[int(t) for t in rs.randint(0, vocab, size=1 + c % 8)]
-                   for c in range(load)]
+    dense_toks = {}
+    for layout in ("dense", "paged"):
+        rs = np.random.RandomState(0)
+        engine_opts = {"slots": slots, "prefill_buckets": (8, 32),
+                       "max_queue": 512}
+        if layout == "paged":
+            engine_opts.update(kv_layout="paged", kv_block_size=16)
+        pool = lm_pool(cfg, params, n_replicas=1, name="bench-lm",
+                       engine_opts=engine_opts)
+        eng = pool.replicas[0].engine
+        hbm_gb = eng.describe()["kv"]["hbm_bytes"] / float(1 << 30)
+        for load in loads:
+            ttfts = []
+            lock = threading.Lock()
+            errors = []
+            # prompts drawn BEFORE the threads start: RandomState is
+            # not thread-safe, and the gate compares runs — the
+            # workload must be identical every run
+            prompts = [[int(t) for t in
+                        rs.randint(0, vocab, size=1 + c % 8)]
+                       for c in range(load)]
 
-        def client(cid):
-            try:
-                sess = pool.generate(prompts[cid],
-                                     max_new_tokens=max_new)
-                sess.result(300)
-            except Exception as e:
-                errors.append(e)
-                return
-            with lock:
-                ttfts.append(sess.ttft())
+            def client(cid):
+                try:
+                    sess = pool.generate(prompts[cid],
+                                         max_new_tokens=max_new)
+                    sess.result(300)
+                except Exception as e:
+                    errors.append(e)
+                    return
+                with lock:
+                    ttfts.append(sess.ttft())
 
-        steps0, tokens0 = eng.steps, eng.tokens_out
-        threads = [threading.Thread(target=client, args=(c,))
-                   for c in range(load)]
-        t0 = time.perf_counter()
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join()
-        wall = time.perf_counter() - t0
-        if errors:
-            raise errors[0]
-        steps = eng.steps - steps0
-        tokens = eng.tokens_out - tokens0
-        decoded = tokens - load  # per-step tokens (prefill emits 1/seq)
-        row("decode_s%d_load%d" % (slots, load), tokens / wall,
-            "tok/sec",
-            ttft_p50_ms=round(float(np.percentile(ttfts, 50)) * 1e3, 3),
-            ttft_p99_ms=round(float(np.percentile(ttfts, 99)) * 1e3, 3),
-            steps=steps,
-            slot_occupancy=round(decoded / max(1, steps) / slots, 3),
-            seqs_per_step=round(load / max(1, steps), 3))
-    pool.close()
+            steps0, tokens0 = eng.steps, eng.tokens_out
+            threads = [threading.Thread(target=client, args=(c,))
+                       for c in range(load)]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            wall = time.perf_counter() - t0
+            if errors:
+                raise errors[0]
+            steps = eng.steps - steps0
+            tokens = eng.tokens_out - tokens0
+            decoded = tokens - load  # per-step (prefill emits 1/seq)
+            extra = {"sessions_per_hbm_gb":
+                     round(min(load, slots) / hbm_gb, 1)}
+            if layout == "dense":
+                dense_toks[load] = tokens / wall
+                tag = ""
+            else:
+                tag = "_paged"
+                extra["dense_tok_per_sec"] = round(dense_toks[load], 2)
+                extra["paged_vs_dense"] = round(
+                    (tokens / wall) / dense_toks[load], 3)
+                card = eng.describe()["kv"]
+                extra["prefix_hits"] = card["prefix_hits"]
+            row("decode_s%d_load%d%s" % (slots, load, tag),
+                tokens / wall, "tok/sec",
+                ttft_p50_ms=round(
+                    float(np.percentile(ttfts, 50)) * 1e3, 3),
+                ttft_p99_ms=round(
+                    float(np.percentile(ttfts, 99)) * 1e3, 3),
+                steps=steps,
+                slot_occupancy=round(decoded / max(1, steps) / slots, 3),
+                seqs_per_step=round(load / max(1, steps), 3),
+                **extra)
+        pool.close()
+
+    # capacity at production context length, from the pool-sizing
+    # arithmetic the engine enforces (ISSUE 18 acceptance: >= 4x
+    # concurrent sessions at FIXED HBM, max_len=2048): dense reserves
+    # ceil(2048/16)=128 block-equivalents per slot no matter how short
+    # the session; paged stores only what sessions actually write
+    bs2, ml2, transcript = 16, 2048, 256
+    per_dense = -(-ml2 // bs2)                     # 128 blocks/session
+    per_paged = transcript // bs2 + 1              # 17 blocks/session
+    total = slots * per_dense                      # the fixed HBM
+    ratio = (total // per_paged) / float(slots)
+    row("decode_kv_capacity_2048", ratio, "x_sessions_at_fixed_hbm",
+        dense_sessions=slots, paged_sessions=total // per_paged,
+        max_len=ml2, transcript_tokens=transcript, block_size=bs2)
 
 
 def failover_score(load=24, max_new=24, slots=8, waves=3,

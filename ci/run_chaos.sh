@@ -48,6 +48,23 @@ while [ "$i" -lt "$runs" ]; do
     -k "rolling_kill or acceptance"
   i=$((i + 1))
 done
+# paged-KV shared-prefix kill half (docs/serving.md "Paged KV & prefix
+# cache"): hard-kill a paged-layout replica whose sessions HOLD SHARED
+# PREFIX BLOCKS (a common system prompt, indexed in the prefix cache)
+# mid-decode — every session must complete (migrated, re-prefilled into
+# fresh blocks on the survivor, bit-identical to an unkilled replay) or
+# shed typed; the dead replica's shared blocks must die with it.  The
+# seed rotates the system prompt, tail lengths, temperatures, session
+# seeds, and the kill step so the kill lands at different block-table /
+# prefix-cache states.
+i=0
+while [ "$i" -lt "$runs" ]; do
+  echo "== paged-KV shared-prefix kill chaos run $((i + 1))/$runs (MXNET_CHAOS_SEED=$i) =="
+  JAX_PLATFORMS=cpu MXNET_CHAOS_SEED="$i" \
+    python -m pytest tests/test_kvblocks.py -q -p no:cacheprovider \
+    -k "chaos"
+  i=$((i + 1))
+done
 # elasticity half (docs/resilience.md "Elastic membership &
 # resharding"): kill one worker mid-epoch, admit replacements, and kill
 # a worker DURING the reshard itself via the kvstore.membership /

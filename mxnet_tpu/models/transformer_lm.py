@@ -37,7 +37,8 @@ import jax.numpy as jnp
 import numpy as np
 
 __all__ = ["LMConfig", "init_params", "forward_logits", "prefill_kv",
-           "decode_step_math", "params_to_blob", "params_from_blob"]
+           "decode_step_math", "prefill_kv_paged", "decode_step_paged",
+           "params_to_blob", "params_from_blob"]
 
 #: model hyperparameters; ``max_len`` bounds the KV cache (and therefore
 #: prompt + generated length), ``eos_id`` is the token that retires a
@@ -203,6 +204,135 @@ def decode_step_math(cfg, params, cache_k, cache_v, last_tok, lengths):
                            pl["down_w"])
         new_k.append(ck)
         new_v.append(cv)
+    x = _rmsnorm(x, params["ln_f"])
+    logits = jnp.einsum("se,ev->sv", x, params["head"]).astype(jnp.float32)
+    return logits, tuple(new_k), tuple(new_v)
+
+
+def prefill_kv_paged(cfg, params, pool_k, pool_v, table, tokens, start,
+                     length):
+    """Suffix prefill through a block table — the paged twin of
+    :func:`prefill_kv` (``mxnet_tpu.serving.kvblocks`` owns the block
+    bookkeeping; this is pure math).
+
+    ``pool_k``/``pool_v``: per-layer tuples of ``(num_blocks,
+    block_size, heads, head_dim)`` pool rows; ``table (max_blocks,)
+    int32`` maps the slot's logical block index to a pool row (0 = the
+    reserved scratch block, where unallocated entries point).
+    ``tokens (P,) int32`` is the bucket-padded transcript SUFFIX
+    occupying absolute positions ``start .. start+P-1``: ``start = 0``
+    is a cold prefill, ``start > 0`` is a prefix-cache hit that runs
+    ZERO compute for the shared positions — their K/V is already
+    resident in the table's blocks and is only gathered for attention.
+    ``length`` is the absolute transcript length.  Returns
+    ``(last_logits (vocab,), new_pool_k, new_pool_v)``.
+
+    Bit-identity with the dense path is by construction: K/V rows are
+    scattered into the pool, gathered back through the table and
+    statically sliced to ``max_len``, so scores, mask and softmax see
+    EXACTLY the shapes :func:`decode_step_math`'s attention sees; lanes
+    past a row's horizon are exact zeros under the ``-1e30`` mask, and
+    unallocated lanes read scratch garbage that the mask also zeroes.
+    Bucket-pad rows scatter to the scratch block or to not-yet-read
+    rows past ``length`` — the same never-read discipline as the dense
+    prefill's pad rows.
+    """
+    (p,) = tokens.shape
+    (mb,) = table.shape
+    bs = pool_k[0].shape[1]
+    m = cfg.max_len
+    hd = cfg.embed // cfg.heads
+    scale = 1.0 / np.sqrt(hd)
+    pos = start + jnp.arange(p)            # absolute positions
+    posc = jnp.clip(pos, 0, m - 1)         # only pad rows ever clamp
+    blk = table[posc // bs]
+    off = posc % bs
+    x = params["embed"][tokens] + params["pos"][posc]
+    kpos = jnp.arange(m)
+    mask = kpos[None, :] <= pos[:, None]
+    new_k, new_v = [], []
+    for l in range(cfg.layers):
+        pl = _layer(params["blocks"], l)
+        h = _rmsnorm(x, pl["ln1"])
+        qkv = jnp.einsum("te,ef->tf", h, pl["qkv_w"])
+        q, k, v = (a.reshape(p, cfg.heads, hd)
+                   for a in jnp.split(qkv, 3, axis=-1))
+        pk = pool_k[l].at[blk, off].set(k)
+        pv = pool_v[l].at[blk, off].set(v)
+        ck = pk[table].reshape(mb * bs, cfg.heads, hd)[:m]
+        cv = pv[table].reshape(mb * bs, cfg.heads, hd)[:m]
+        scores = jnp.einsum("qhd,khd->hqk", q, ck) * scale
+        att = jax.nn.softmax(
+            jnp.where(mask[None], scores, jnp.float32(-1e30)), axis=-1)
+        ctx = jnp.einsum("hqk,khd->qhd", att, cv)
+        x = x + jnp.einsum("te,ef->tf",
+                           ctx.reshape(p, cfg.embed), pl["out_w"])
+        h = _rmsnorm(x, pl["ln2"])
+        x = x + jnp.einsum("tf,fe->te",
+                           jax.nn.gelu(jnp.einsum("te,ef->tf", h,
+                                                  pl["up_w"])),
+                           pl["down_w"])
+        new_k.append(pk)
+        new_v.append(pv)
+    x = _rmsnorm(x, params["ln_f"])
+    logits = jnp.einsum("te,ev->tv", x, params["head"]).astype(jnp.float32)
+    last = jnp.take(logits, jnp.clip(length - 1 - start, 0, p - 1),
+                    axis=0)
+    return last, tuple(new_k), tuple(new_v)
+
+
+def decode_step_paged(cfg, params, pool_k, pool_v, tables, last_tok,
+                      lengths):
+    """One decode token for all ``S`` slots through per-slot block
+    tables — the paged twin of :func:`decode_step_math`.
+
+    ``tables (S, max_blocks) int32`` names each slot's pool rows; the
+    incoming token's K/V scatters into the block covering position
+    ``lengths`` (the engine allocates that block before dispatch), the
+    slot's whole table is gathered and statically sliced to
+    ``(S, max_len)``, and attention proceeds exactly as the dense
+    step's — same shapes, same mask, same floats.  Inactive slots hold
+    all-zero tables: their scatter lands in the scratch block and their
+    gathered lanes are mask-dead, the paged rendition of the dense
+    step's unreachable-row idiom.  Fixed shapes throughout — ONE
+    compile per ``(S, max_len, num_blocks, block_size)``, ever.
+    """
+    s, mb = tables.shape
+    bs = pool_k[0].shape[1]
+    m = cfg.max_len
+    hd = cfg.embed // cfg.heads
+    scale = 1.0 / np.sqrt(hd)
+    rows = jnp.arange(s)
+    kpos = jnp.arange(m)
+    pos = jnp.clip(lengths, 0, m - 1)
+    wblk = tables[rows, pos // bs]
+    woff = pos % bs
+    x = params["embed"][last_tok] + params["pos"][pos]
+    new_k, new_v = [], []
+    for l in range(cfg.layers):
+        pl = _layer(params["blocks"], l)
+        h = _rmsnorm(x, pl["ln1"])
+        qkv = jnp.einsum("se,ef->sf", h, pl["qkv_w"])
+        q, k, v = (a.reshape(s, cfg.heads, hd)
+                   for a in jnp.split(qkv, 3, axis=-1))
+        pk = pool_k[l].at[wblk, woff].set(k)
+        pv = pool_v[l].at[wblk, woff].set(v)
+        ck = pk[tables].reshape(s, mb * bs, cfg.heads, hd)[:, :m]
+        cv = pv[tables].reshape(s, mb * bs, cfg.heads, hd)[:, :m]
+        scores = jnp.einsum("shd,smhd->shm", q, ck) * scale
+        mask = kpos[None, None, :] <= pos[:, None, None]
+        att = jax.nn.softmax(
+            jnp.where(mask, scores, jnp.float32(-1e30)), axis=-1)
+        ctx = jnp.einsum("shm,smhd->shd", att, cv)
+        x = x + jnp.einsum("se,ef->sf",
+                           ctx.reshape(s, cfg.embed), pl["out_w"])
+        h = _rmsnorm(x, pl["ln2"])
+        x = x + jnp.einsum("sf,fe->se",
+                           jax.nn.gelu(jnp.einsum("se,ef->sf", h,
+                                                  pl["up_w"])),
+                           pl["down_w"])
+        new_k.append(pk)
+        new_v.append(pv)
     x = _rmsnorm(x, params["ln_f"])
     logits = jnp.einsum("se,ev->sv", x, params["head"]).astype(jnp.float32)
     return logits, tuple(new_k), tuple(new_v)

@@ -2,7 +2,7 @@
 single-request :class:`~mxnet_tpu.predict.Predictor` plus a
 continuous-batching autoregressive tier.
 
-Six layers (see ``docs/serving.md``):
+Seven layers (see ``docs/serving.md``):
 
 * :mod:`~mxnet_tpu.serving.batcher` — dynamic micro-batching with
   shape-bucket padding, per-request deadlines, and typed
@@ -10,6 +10,10 @@ Six layers (see ``docs/serving.md``):
 * :mod:`~mxnet_tpu.serving.decode` — slot-based continuous batching
   for autoregressive LMs: one fixed-shape jitted decode step, one
   packed host read per token, mid-flight admission into free slots;
+* :mod:`~mxnet_tpu.serving.kvblocks` — the paged KV memory subsystem
+  under the decode tier: device block pools, a refcounting
+  :class:`BlockAllocator`, per-slot block tables and a hash-keyed
+  prefix cache with admission-time copy-on-write;
 * :mod:`~mxnet_tpu.serving.pool` — N routed replicas over
   ``jax.devices()``: weighted least-outstanding routing, per-tenant
   quotas, priority shedding, per-replica circuit breakers
@@ -36,6 +40,8 @@ from .controller import (AutoscalePolicy, DeviceFleet, FleetController,
 from .decode import (TTFT_BUCKETS, DecodeEngine, GenerateSession,
                      ReplicaKilled)
 from .frontend import ServingHandle, ServingHTTPServer
+from .kvblocks import (BlockAllocator, KVBlockPool, KVBlocksExhausted,
+                       PrefixCache)
 from .pool import (QuotaExceeded, Replica, ReplicaPool,
                    RetryBudgetExhausted, lm_pool)
 from .registry import (MANIFEST, ModelRegistry, ServedModel, UnknownModel,
@@ -46,6 +52,8 @@ __all__ = ["DynamicBatcher", "Future", "Overloaded", "DeadlineExceeded",
            "TTFT_BUCKETS", "DecodeEngine", "GenerateSession",
            "ReplicaKilled", "QuotaExceeded", "RetryBudgetExhausted",
            "Replica", "ReplicaPool", "lm_pool",
+           "BlockAllocator", "PrefixCache", "KVBlockPool",
+           "KVBlocksExhausted",
            "ModelRegistry", "ServedModel", "UnknownModel", "save_model",
            "MANIFEST", "ServingHandle", "ServingHTTPServer",
            "AutoscalePolicy", "DeviceFleet", "FleetController",

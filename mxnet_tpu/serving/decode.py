@@ -68,6 +68,7 @@ from ..base import MXNetError
 from ..models import transformer_lm as _tlm
 from .batcher import (LATENCY_BUCKETS, DeadlineExceeded, Future,
                       InvalidRequest, Overloaded)
+from .kvblocks import KVBlockPool, KVBlocksExhausted
 
 __all__ = ["GenerateSession", "DecodeEngine", "ReplicaKilled",
            "TTFT_BUCKETS"]
@@ -243,11 +244,25 @@ class DecodeEngine:
     on_step_error / on_step_ok : callable, optional
         Replica-health hooks (the pool's quarantine counter); called
         outside the engine lock.
+    kv_layout : str, optional
+        ``"dense"`` (the classic ``(S, max_len)`` per-slot cache) or
+        ``"paged"`` (block-table storage through
+        :mod:`~mxnet_tpu.serving.kvblocks` — prefix reuse, COW,
+        oversubscription).  Defaults to ``MXNET_KV_LAYOUT`` (dense).
+        Both layouts produce bit-identical streams for the same
+        ``(seed, transcript)``.
+    kv_block_size / kv_blocks : int, optional
+        Paged sizing overrides (``MXNET_KV_BLOCK_SIZE`` /
+        ``MXNET_KV_BLOCKS`` defaults; see kvblocks.py).
+    kv_prefix_cache : bool, optional
+        Paged prefix reuse toggle (``MXNET_KV_PREFIX_CACHE`` default).
     """
 
     def __init__(self, cfg, params, *, slots=None, prefill_buckets=(8, 32),
                  max_queue=64, device=None, name="lm", replica="0",
-                 autostart=True, on_step_error=None, on_step_ok=None):
+                 autostart=True, on_step_error=None, on_step_ok=None,
+                 kv_layout=None, kv_block_size=None, kv_blocks=None,
+                 kv_prefix_cache=None):
         import jax
 
         self.cfg = cfg
@@ -289,6 +304,25 @@ class DecodeEngine:
         self._rate_t0 = time.monotonic()
         self._rate_tokens = 0
 
+        layout = kv_layout if kv_layout is not None \
+            else (os.environ.get("MXNET_KV_LAYOUT", "dense") or "dense")
+        layout = str(layout).strip().lower()
+        if layout not in ("dense", "paged"):
+            raise MXNetError(
+                "kv_layout/MXNET_KV_LAYOUT must be 'dense' or 'paged', "
+                "got %r" % layout)
+        self.kv_layout = layout
+        #: paged storage control plane (None under the dense layout)
+        self._kv = KVBlockPool(
+            cfg, self.slots, block_size=kv_block_size,
+            num_blocks=kv_blocks, prefix_cache=kv_prefix_cache,
+            model=name, replica=self.replica) \
+            if layout == "paged" else None
+        #: host mirror of each slot's device ``lengths`` — the paged
+        #: loop derives the next write position (and block-boundary
+        #: appends) from it without a device read
+        self._slot_len = [0] * self.slots
+
         self._step_fn = None       # built in _build()
         self._prefill_fns = {}
         self._boot_state = self._build()
@@ -300,7 +334,8 @@ class DecodeEngine:
         _telemetry.set_gauge("serving.decode.tokens_per_sec", 0.0, **labels)
         _telemetry.inc("serving.failover.reprefill_tokens.count", 0,
                        **labels)
-        for reason in ("deadline", "overload", "abandoned", "drain"):
+        for reason in ("deadline", "overload", "abandoned", "drain",
+                       "kv_blocks"):
             _telemetry.inc("serving.shed.count", 0, model=name,
                            reason=reason)
         if autostart:
@@ -337,12 +372,10 @@ class DecodeEngine:
                         keys, logits, temps).astype(jnp.int32)
             return jnp.where(temps > 0.0, drawn, greedy)
 
-        def step(params, state, keep):
-            cache_k, cache_v, last_tok, lengths, limits, active, temps, \
-                seeds = state
+        def finish_step(state_rest, logits, keep):
+            # shared sampling/retirement tail of both layouts
+            last_tok, lengths, limits, active, temps, seeds = state_rest
             active = active & keep
-            logits, cache_k, cache_v = _tlm.decode_step_math(
-                cfg, params, cache_k, cache_v, last_tok, lengths)
             # last_tok sits at position ``lengths``; the sampled token
             # will occupy ``lengths + 1``
             keys = jax.vmap(fold_key)(seeds, lengths + 1)
@@ -354,26 +387,18 @@ class DecodeEngine:
             packed = jnp.stack([jnp.where(active, tok, -1),
                                 done.astype(jnp.int32),
                                 new_active.astype(jnp.int32)])
-            return (cache_k, cache_v, new_last, new_len, limits,
-                    new_active, temps, seeds), packed
+            return (new_last, new_len, limits, new_active, temps,
+                    seeds), packed
 
-        def prefill(params, state, tokens, length, slot, limit, temp,
-                    seed, activate):
-            cache_k, cache_v, last_tok, lengths, limits, active, temps, \
-                seeds = state
-            last_logits, ks, vs = _tlm.prefill_kv(cfg, params, tokens,
-                                                  length)
-            cache_k = tuple(
-                jax.lax.dynamic_update_slice(ck, k[None], (slot, 0, 0, 0))
-                for ck, k in zip(cache_k, ks))
-            cache_v = tuple(
-                jax.lax.dynamic_update_slice(cv, v[None], (slot, 0, 0, 0))
-                for cv, v in zip(cache_v, vs))
-            # the prompt holds positions 0..length-1; the sampled token
+        def arm_slot(state_rest, slot, tok_logits, length, limit, temp,
+                     seed, activate):
+            # shared slot-arming tail of both prefill layouts: the
+            # prompt holds positions 0..length-1; the sampled token
             # occupies ``length`` — on a failover re-prefill of
             # prompt+generated this is exactly the key the interrupted
             # replica's next decode step would have used
-            tok = sample(fold_key(seed, length)[None], last_logits[None],
+            last_tok, lengths, limits, active, temps, seeds = state_rest
+            tok = sample(fold_key(seed, length)[None], tok_logits[None],
                          jnp.full((1,), temp))[0]
             first_done = (tok == eos) | (limit <= length)
             arm = activate & ~first_done
@@ -384,17 +409,81 @@ class DecodeEngine:
             active = active.at[slot].set(arm)
             seeds = seeds.at[slot].set(seed)
             out = jnp.stack([tok, first_done.astype(jnp.int32)])
-            return (cache_k, cache_v, last_tok, lengths, limits, active,
-                    temps, seeds), out
+            return (last_tok, lengths, limits, active, temps, seeds), out
 
-        self._step_fn = self._instrument(
-            jax.jit(step, donate_argnums=(1,)), "decode_step",
-            ("decode_step", s, m))
-        pf_jit = jax.jit(prefill, donate_argnums=(1,))
-        self._prefill_fns = {
-            b: self._instrument(pf_jit, "decode_prefill",
-                                ("decode_prefill", b, s, m))
-            for b in self.prefill_buckets}
+        if self._kv is not None:
+            nb, bs = self._kv.num_blocks, self._kv.block_size
+
+            def step(params, state, keep, tables):
+                pool_k, pool_v = state[0], state[1]
+                logits, pool_k, pool_v = _tlm.decode_step_paged(
+                    cfg, params, pool_k, pool_v, tables, state[2],
+                    state[3])
+                rest, packed = finish_step(state[2:], logits, keep)
+                return (pool_k, pool_v) + rest, packed
+
+            def prefill(params, state, tokens, start, length, slot,
+                        table, limit, temp, seed, activate, cow_src,
+                        cow_dst):
+                pool_k, pool_v = state[0], state[1]
+                # admission-time copy-on-write: duplicate the shared
+                # partial tail block before the suffix scatters into
+                # the copy; (0, 0) — scratch onto itself — is the
+                # no-COW case, so ONE compiled program covers cold,
+                # prefix-hit and COW admissions alike
+                pool_k = tuple(pk.at[cow_dst].set(pk[cow_src])
+                               for pk in pool_k)
+                pool_v = tuple(pv.at[cow_dst].set(pv[cow_src])
+                               for pv in pool_v)
+                last_logits, pool_k, pool_v = _tlm.prefill_kv_paged(
+                    cfg, params, pool_k, pool_v, table, tokens, start,
+                    length)
+                rest, out = arm_slot(state[2:], slot, last_logits,
+                                     length, limit, temp, seed, activate)
+                return (pool_k, pool_v) + rest, out
+
+            self._step_fn = self._instrument(
+                jax.jit(step, donate_argnums=(1,)), "decode_step",
+                ("decode_step_paged", s, m, nb, bs))
+            pf_jit = jax.jit(prefill, donate_argnums=(1,))
+            self._prefill_fns = {
+                b: self._instrument(pf_jit, "decode_prefill",
+                                    ("decode_prefill_paged", b, s, m,
+                                     nb, bs))
+                for b in self.prefill_buckets}
+        else:
+            def step(params, state, keep):
+                cache_k, cache_v = state[0], state[1]
+                logits, cache_k, cache_v = _tlm.decode_step_math(
+                    cfg, params, cache_k, cache_v, state[2], state[3])
+                rest, packed = finish_step(state[2:], logits, keep)
+                return (cache_k, cache_v) + rest, packed
+
+            def prefill(params, state, tokens, length, slot, limit,
+                        temp, seed, activate):
+                cache_k, cache_v = state[0], state[1]
+                last_logits, ks, vs = _tlm.prefill_kv(cfg, params,
+                                                      tokens, length)
+                cache_k = tuple(
+                    jax.lax.dynamic_update_slice(ck, k[None],
+                                                 (slot, 0, 0, 0))
+                    for ck, k in zip(cache_k, ks))
+                cache_v = tuple(
+                    jax.lax.dynamic_update_slice(cv, v[None],
+                                                 (slot, 0, 0, 0))
+                    for cv, v in zip(cache_v, vs))
+                rest, out = arm_slot(state[2:], slot, last_logits,
+                                     length, limit, temp, seed, activate)
+                return (cache_k, cache_v) + rest, out
+
+            self._step_fn = self._instrument(
+                jax.jit(step, donate_argnums=(1,)), "decode_step",
+                ("decode_step", s, m))
+            pf_jit = jax.jit(prefill, donate_argnums=(1,))
+            self._prefill_fns = {
+                b: self._instrument(pf_jit, "decode_prefill",
+                                    ("decode_prefill", b, s, m))
+                for b in self.prefill_buckets}
 
         state = self._fresh_state()
         with _compile_cache.recording_scope() as rec:
@@ -435,17 +524,26 @@ class DecodeEngine:
 
     def _fresh_state(self):
         """Zeroed device-resident slot state, committed to the replica
-        device."""
+        device.  Under the paged layout the K/V tensors are the BLOCK
+        POOLS, and rebuilding them from zeros invalidates every block —
+        the host control plane (allocator, tables, prefix cache) resets
+        in the same breath."""
         import jax
         import jax.numpy as jnp
 
         cfg = self.cfg
-        s, m = self.slots, cfg.max_len
+        s = self.slots
         hd = cfg.embed // cfg.heads
-        zeros_kv = tuple(jnp.zeros((s, m, cfg.heads, hd), jnp.float32)
-                         for _ in range(cfg.layers))
-        state = (zeros_kv,
-                 tuple(jnp.zeros((s, m, cfg.heads, hd), jnp.float32)
+        if self._kv is not None:
+            self._kv.reset()
+            kv_shape = (self._kv.num_blocks, self._kv.block_size,
+                        cfg.heads, hd)
+        else:
+            kv_shape = (s, cfg.max_len, cfg.heads, hd)
+        self._slot_len = [0] * s
+        state = (tuple(jnp.zeros(kv_shape, jnp.float32)
+                       for _ in range(cfg.layers)),
+                 tuple(jnp.zeros(kv_shape, jnp.float32)
                        for _ in range(cfg.layers)),
                  jnp.zeros((s,), jnp.int32),        # last_tok
                  jnp.zeros((s,), jnp.int32),        # lengths
@@ -458,7 +556,22 @@ class DecodeEngine:
     def _warm(self, state):
         """Compile the decode step and every prefill bucket against the
         real state buffers — ``activate=False`` leaves the slots
-        disarmed, so warm-up never corrupts serving state."""
+        disarmed, so warm-up never corrupts serving state.  The paged
+        warm-up runs with an all-zero table: every scatter lands in the
+        scratch block, which is exactly what makes it harmless."""
+        if self._kv is not None:
+            mb = self._kv.max_blocks
+            ztab = np.zeros((mb,), np.int32)
+            for b in self.prefill_buckets:
+                state, _out = self._prefill_fns[b](
+                    self._params, state, np.zeros((b,), np.int32),
+                    np.int32(0), np.int32(1), np.int32(0), ztab,
+                    np.int32(0), np.float32(0.0), np.uint32(0),
+                    np.bool_(False), np.int32(0), np.int32(0))
+            state, _packed = self._step_fn(
+                self._params, state, np.ones((self.slots,), bool),
+                np.zeros((self.slots, mb), np.int32))
+            return state
         for b in self.prefill_buckets:
             state, _out = self._prefill_fns[b](
                 self._params, state, np.zeros((b,), np.int32),
@@ -500,6 +613,35 @@ class DecodeEngine:
             self._draining = False
 
     # -- client side -------------------------------------------------------
+    def _validate_admission(self, n, what):
+        """THE transcript-length admission validator — ``submit`` and
+        ``resume`` used to carry drifting copies of the same two
+        checks; they now share this one, which also enforces the paged
+        block budget.  ``n`` is the transcript length that will be
+        (re-)prefilled; ``what`` names it in the client's error.
+        Raises :class:`InvalidRequest` for transcripts no engine of
+        this shape could ever hold, and typed
+        :class:`KVBlocksExhausted` (an :class:`Overloaded` — clients
+        retry it) when the block pool is sized too small for the
+        transcript even with every block free."""
+        if n > self.prefill_buckets[-1]:
+            raise InvalidRequest(
+                "%s of %d tokens exceeds the largest prefill bucket %d"
+                % (what, n, self.prefill_buckets[-1]))
+        if n >= self.cfg.max_len:
+            raise InvalidRequest(
+                "%s of %d tokens leaves no room under max_len=%d"
+                % (what, n, self.cfg.max_len))
+        if self._kv is not None and not self._kv.admissible(n):
+            _telemetry.inc("serving.shed.count", model=self.name,
+                           reason="kv_blocks")
+            raise KVBlocksExhausted(
+                "%s of %d tokens needs %d KV blocks but the pool holds "
+                "only %d allocatable (%d blocks x %d tokens)"
+                % (what, n, n // self._kv.block_size + 1,
+                   self._kv.num_blocks - 1, self._kv.num_blocks,
+                   self._kv.block_size))
+
     def submit(self, prompt, *, max_new_tokens=16, temperature=0.0,
                deadline_ms=None, on_token=None, on_done=None, seed=None,
                tenant=None, on_event=None):
@@ -516,14 +658,7 @@ class DecodeEngine:
         prompt = np.array(prompt, np.int32).ravel()
         if prompt.size < 1:
             raise InvalidRequest("empty prompt")
-        if prompt.size > self.prefill_buckets[-1]:
-            raise InvalidRequest(
-                "prompt of %d tokens exceeds the largest prefill bucket "
-                "%d" % (prompt.size, self.prefill_buckets[-1]))
-        if prompt.size >= self.cfg.max_len:
-            raise InvalidRequest(
-                "prompt of %d tokens leaves no room under max_len=%d"
-                % (prompt.size, self.cfg.max_len))
+        self._validate_admission(int(prompt.size), "prompt")
         if prompt.min() < 0 or prompt.max() >= self.cfg.vocab:
             raise InvalidRequest(
                 "prompt token ids must be in 0..vocab-1=%d"
@@ -595,17 +730,9 @@ class DecodeEngine:
         loss into a shed.  Resumed sessions jump the queue: they have
         already waited once."""
         full = int(sess.prompt.size) + len(sess.tokens)
-        if full > self.prefill_buckets[-1]:
-            raise InvalidRequest(
-                "transcript of %d tokens (prompt %d + generated %d) "
-                "exceeds the largest prefill bucket %d: this session "
-                "cannot migrate" % (full, sess.prompt.size,
-                                    len(sess.tokens),
-                                    self.prefill_buckets[-1]))
-        if full >= self.cfg.max_len:
-            raise InvalidRequest(
-                "transcript of %d tokens leaves no room under "
-                "max_len=%d" % (full, self.cfg.max_len))
+        self._validate_admission(
+            full, "migrated transcript (prompt %d + generated %d)"
+            % (sess.prompt.size, len(sess.tokens)))
         with self._cond:
             if self._closed:
                 raise MXNetError("decode engine %r is closed" % self.name)
@@ -649,6 +776,14 @@ class DecodeEngine:
             tokens = self.tokens_out
             resumed = self.resumed
             reprefilled = self.reprefilled_tokens
+        if self._kv is not None:
+            kv = self._kv.describe()
+        else:
+            hd = self.cfg.embed // self.cfg.heads
+            kv = {"layout": "dense",
+                  "hbm_bytes": (2 * self.cfg.layers * self.slots
+                                * self.cfg.max_len * self.cfg.heads
+                                * hd * 4)}
         return {"name": self.name, "kind": "generate",
                 "version": getattr(self, "version", None),
                 "replica": self.replica, "device": str(self._device),
@@ -657,7 +792,7 @@ class DecodeEngine:
                 "sessions_resumed": resumed,
                 "reprefilled_tokens": reprefilled,
                 "prefill_buckets": list(self.prefill_buckets),
-                "max_len": self.cfg.max_len}
+                "max_len": self.cfg.max_len, "kv": kv}
 
     # -- worker ------------------------------------------------------------
     def start(self):
@@ -853,21 +988,55 @@ class DecodeEngine:
         else:
             full = sess.prompt
         n = int(full.size)
-        bucket = next(b for b in self.prefill_buckets if n <= b)
-        tokens = np.zeros((bucket,), np.int32)
-        tokens[:n] = full
         limit = np.int32(min(p0 + sess.max_new_tokens - 1, cfg.max_len))
+        if self._kv is not None:
+            try:
+                plan = self._kv.admit(sess.slot, full)
+            except Overloaded as e:
+                # typed KV shed: even evicting the prefix cache cannot
+                # cover this transcript right now — nothing was
+                # dispatched (state unpoisoned, no blocks held), the
+                # session sheds typed and the engine keeps serving
+                _telemetry.inc("serving.shed.count", model=self.name,
+                               reason="kv_blocks")
+                sess.trace.end("shed", reason="kv_blocks",
+                               where="admit")
+                self._retire(sess, error=e)
+                self._occupancy_gauge()
+                return state, False
+            # prefix-hit admissions re-/prefill ONLY the unshared
+            # suffix: the bucket is chosen by suffix length, so a long
+            # shared prompt rides a small prefill program
+            suffix = n - plan.start
+            bucket = next(b for b in self.prefill_buckets
+                          if suffix <= b)
+            tokens = np.zeros((bucket,), np.int32)
+            tokens[:suffix] = full[plan.start:]
+        else:
+            plan = None
+            bucket = next(b for b in self.prefill_buckets if n <= b)
+            tokens = np.zeros((bucket,), np.int32)
+            tokens[:n] = full
         # runs on the ENGINE thread: parent explicitly off the session
         # root (the thread-local stack belongs to whoever submitted)
         asp = _tracing.start_span("serving.admit", parent=sess.trace,
                                   stack=False, replica=self.replica,
                                   resumed=resumed, bucket=bucket)
         try:
-            state, out = self._prefill_fns[bucket](
-                self._params, state, tokens, np.int32(n),
-                np.int32(sess.slot), limit,
-                np.float32(sess.temperature), np.uint32(sess.seed),
-                np.bool_(True))
+            if plan is not None:
+                state, out = self._prefill_fns[bucket](
+                    self._params, state, tokens, np.int32(plan.start),
+                    np.int32(n), np.int32(sess.slot),
+                    np.ascontiguousarray(self._kv.tables[sess.slot]),
+                    limit, np.float32(sess.temperature),
+                    np.uint32(sess.seed), np.bool_(True),
+                    np.int32(plan.cow_src), np.int32(plan.cow_dst))
+            else:
+                state, out = self._prefill_fns[bucket](
+                    self._params, state, tokens, np.int32(n),
+                    np.int32(sess.slot), limit,
+                    np.float32(sess.temperature), np.uint32(sess.seed),
+                    np.bool_(True))
             out = np.asarray(out)  # lint: ok[host-sync] admission-time first-token read (TTFT), not the per-step hot loop
         except Exception as e:
             # a poisoned prefill poisons the whole donated state: fail
@@ -875,7 +1044,13 @@ class DecodeEngine:
             # (the queue is untouched)
             asp.end("error", error=type(e).__name__)
             return self._fail_all(e, state), True
-        asp.end("ok", reprefilled=n if resumed else 0)
+        if plan is not None:
+            self._slot_len[sess.slot] = n
+            # index the (now device-resident) prompt prefix for future
+            # admissions — insertion AFTER a successful dispatch only
+            self._kv.offer(sess.slot, sess.prompt)
+        asp.end("ok", reprefilled=n if resumed else 0,
+                prefix_reused=plan.reused_tokens if plan else 0)
         now = time.monotonic()
         tok = int(out[0])
         sess.tokens.append(tok)
@@ -926,6 +1101,26 @@ class DecodeEngine:
                 keep[i] = False
             elif sess.deadline is not None and now > sess.deadline:
                 keep[i] = False
+        if self._kv is not None:
+            # block-boundary appends: the step scatters each live
+            # slot's K/V at position ``lengths`` — make sure that
+            # block exists BEFORE dispatch.  A dry pool (even after
+            # prefix-cache eviction) sheds the session typed instead
+            # of corrupting a shared scratch row.
+            for i, sess in enumerate(sessions):
+                if sess is None or not keep[i]:
+                    continue
+                try:
+                    self._kv.append(i, min(self._slot_len[i],
+                                           self.cfg.max_len - 1))
+                except Overloaded as e:
+                    keep[i] = False
+                    sessions[i] = None
+                    _telemetry.inc("serving.shed.count",
+                                   model=self.name, reason="kv_blocks")
+                    sess.trace.end("shed", reason="kv_blocks",
+                                   where="active")
+                    self._retire(sess, error=e)
         t0 = time.perf_counter()
         try:
             if _faults.should_fire("serving.decode"):
@@ -944,7 +1139,14 @@ class DecodeEngine:
                     "fault 'serving.replica.kill': replica %s of model "
                     "%r hard-killed mid-generation"
                     % (self.replica, self.name))
-            state, packed = self._step_fn(self._params, state, keep)
+            if self._kv is not None:
+                # the tables ride along as a tiny int32 H2D argument —
+                # fixed shape, no recompile, not a device read
+                state, packed = self._step_fn(
+                    self._params, state, keep,
+                    np.ascontiguousarray(self._kv.tables))
+            else:
+                state, packed = self._step_fn(self._params, state, keep)
             packed = np.asarray(packed)  # lint: ok[host-sync] THE one sanctioned host read per decode step (packed token/done/active buffer)
         except Exception as e:
             return self._fail_all(e, state)
@@ -969,6 +1171,10 @@ class DecodeEngine:
                 emitted += 1
                 sess.tokens.append(tok)
                 self._emit(sess, tok)
+                # host mirror of the device ``lengths`` advance
+                # (new_len = lengths + active): the next step's write
+                # position for this slot
+                self._slot_len[i] += 1
             if packed[1, i]:
                 self._retire(sess)
         with self._cond:
@@ -1044,11 +1250,18 @@ class DecodeEngine:
             sess.on_token = None
 
     def _retire(self, sess, error=None):
+        freed_slot = None
         with self._cond:
             if sess.slot is not None \
                     and self._slot_sessions[sess.slot] is sess:
                 self._slot_sessions[sess.slot] = None
+                freed_slot = sess.slot
             sess.done_step = self.steps
+        if freed_slot is not None and self._kv is not None:
+            # outside the engine lock (the allocator has its own); the
+            # slot cannot be re-admitted concurrently — admissions run
+            # on this same engine thread
+            self._kv.release(freed_slot)
         self._finish(sess, error=error)
 
     def _finish(self, sess, error=None):
@@ -1065,3 +1278,5 @@ class DecodeEngine:
         _telemetry.set_gauge("serving.decode.slot_occupancy",
                              active / float(self.slots), model=self.name,
                              replica=self.replica)
+        if self._kv is not None:
+            self._kv.note_sessions(active)

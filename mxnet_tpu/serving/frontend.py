@@ -133,6 +133,17 @@ class ServingHandle:
             payload["compile_cache"] = {
                 k: cc[k] for k in ("entries", "bytes", "hits", "misses",
                                    "evictions")}
+        # per-model KV-storage occupancy (paged decode tiers): the
+        # capacity number an operator reads before anything else —
+        # blocks_free hitting 0 is the "admissions will shed typed"
+        # early warning
+        kv = {}
+        for mname, card in payload["detail"].items():
+            k = card.get("kv") if isinstance(card, dict) else None
+            if k:
+                kv[mname] = k
+        if kv:
+            payload["kv"] = kv
         fleet = self.fleet_payload()
         if fleet is not None:
             # the summary an operator triages from before opening

@@ -988,8 +988,29 @@ class ReplicaPool:
             failovers = self._failovers
             pressure = self._pressure
             max_out = self._max_outstanding
+        # pool-level KV storage rollup (per-replica cards keep the
+        # detail): /healthz reads occupancy from here without walking
+        # replicas
+        kv_cards = [r.get("kv") for r in reps if r.get("kv")]
+        paged = [k for k in kv_cards if k.get("layout") == "paged"]
+        if paged:
+            kv = {"layout": "paged",
+                  "block_size": paged[0]["block_size"],
+                  "num_blocks": sum(k["num_blocks"] for k in paged),
+                  "blocks_used": sum(k["blocks_used"] for k in paged),
+                  "blocks_free": sum(k["blocks_free"] for k in paged),
+                  "prefix_hits": sum(k["prefix_hits"] for k in paged),
+                  "prefix_tokens_reused": sum(k["prefix_tokens_reused"]
+                                              for k in paged),
+                  "cow_copies": sum(k["cow_copies"] for k in paged),
+                  "hbm_bytes": sum(k["hbm_bytes"] for k in paged)}
+        elif kv_cards:
+            kv = {"layout": "dense",
+                  "hbm_bytes": sum(k["hbm_bytes"] for k in kv_cards)}
+        else:
+            kv = None
         return {"name": self.name, "version": self.version,
-                "kind": "generate", "replicas": reps,
+                "kind": "generate", "replicas": reps, "kv": kv,
                 "outstanding": total,
                 "max_outstanding": max_out,
                 "priority_floor": self._priority_floor,

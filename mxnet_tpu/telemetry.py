@@ -27,8 +27,10 @@ snapshot JSON to ``path`` plus the event log to
 ``<path-sans-ext>.events.jsonl``.
 
 Metric names are dotted families (``fit.*``, ``kvstore.*``, ``xla.*``,
-``resilience.*``, ``elastic.*``, ``memory.*``); labels are free-form
-keyword arguments (``inc("kvstore.push.count", server=0)``).
+``resilience.*``, ``elastic.*``, ``memory.*``, ``serving.*`` —
+including the paged-KV occupancy gauges under ``serving.kv.*``); labels
+are free-form keyword arguments (``inc("kvstore.push.count",
+server=0)``).
 """
 
 from __future__ import annotations

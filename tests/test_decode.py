@@ -155,17 +155,27 @@ def test_mid_decode_admission_joins_running_batch():
     the batch to complete."""
     eng = _engine(slots=2)
     try:
-        a = eng.submit(PROMPT, max_new_tokens=25)
-        deadline = time.monotonic() + 60
-        while len(a.tokens) < 5:
-            assert time.monotonic() < deadline, "A never started decoding"
-            time.sleep(0.005)
-        b = eng.submit([3, 4], max_new_tokens=3)
+        five = threading.Event()
+        a_tok = []
+
+        def on_a(t):
+            a_tok.append(t)
+            if len(a_tok) >= 5:
+                five.set()
+
+        a = eng.submit(PROMPT, max_new_tokens=25, on_token=on_a)
+        assert five.wait(60), "A never started decoding"
+        a_len_at_b_done = []
+        b = eng.submit([3, 4], max_new_tokens=3,
+                       on_done=lambda _s: a_len_at_b_done.append(
+                           len(a.tokens)))
         out_b = b.result(60)
         assert len(out_b) == 3
         # B completed while A was still decoding: it joined the running
-        # batch instead of queueing behind it
-        assert not a.done()
+        # batch instead of queueing behind it.  The snapshot is taken on
+        # the ENGINE thread at B's retirement, so the comparison cannot
+        # race wall-clock scheduling the way `not a.done()` did.
+        assert a_len_at_b_done and a_len_at_b_done[0] < 25
         out_a = a.result(120)
         assert len(out_a) == 25
         assert b.admit_step > a.admit_step > 0 or a.admit_step == 0
@@ -189,11 +199,18 @@ def test_streaming_callback_receives_every_token_in_order():
 def test_cancel_mid_generation_frees_the_slot():
     eng = _engine(slots=1)
     try:
-        a = eng.submit(PROMPT, max_new_tokens=200)
-        deadline = time.monotonic() + 60
-        while len(a.tokens) < 3:
-            assert time.monotonic() < deadline
-            time.sleep(0.005)
+        # event-driven mid-generation detection (no sleep polling —
+        # the token callback IS the signal)
+        mid = threading.Event()
+        seen = []
+
+        def on_tok(t):
+            seen.append(t)
+            if len(seen) >= 3:
+                mid.set()
+
+        a = eng.submit(PROMPT, max_new_tokens=200, on_token=on_tok)
+        assert mid.wait(60), "engine never produced 3 tokens"
         assert a.cancel() is True
         with pytest.raises(MXNetError):
             a.result(30)
@@ -665,11 +682,12 @@ def test_queued_cancel_released_while_all_slots_busy():
     for a slot to free."""
     eng = _engine(slots=1, max_queue=2)
     try:
-        a = eng.submit(PROMPT, max_new_tokens=27)  # occupies THE slot
-        deadline = time.monotonic() + 60
-        while not a.tokens:  # admitted (prefill done) == slot taken
-            assert time.monotonic() < deadline
-            time.sleep(0.005)
+        # admitted (prefill done) == slot taken; the first token
+        # callback signals it without sleep polling
+        admitted = threading.Event()
+        a = eng.submit(PROMPT, max_new_tokens=27,
+                       on_token=lambda _t: admitted.set())
+        assert admitted.wait(60), "session A was never admitted"
         q1 = eng.submit(PROMPT, max_new_tokens=27)
         q2 = eng.submit(PROMPT, max_new_tokens=27)
         with pytest.raises(Overloaded):

@@ -934,6 +934,31 @@ def test_mutation_removing_session_transcript_lock_is_caught(tmp_path):
         [f.message for f in res1.findings]
 
 
+def test_mutation_removing_kv_allocator_lock_is_caught(tmp_path):
+    """Strip the free-list lock from BlockAllocator.alloc (ISSUE 18):
+    the engine thread's pop races describe/healthz occupancy reads and
+    a concurrent prefix-cache eviction's decref — the free list and
+    refcount map lose their only guard -> lock-discipline must fire."""
+    pristine = tmp_path / "kvblocks_ok.py"
+    pristine.write_text(
+        (ROOT / "mxnet_tpu" / "serving" / "kvblocks.py").read_text())
+    res0 = run_pass(by_id("lock-discipline")(),
+                    RunContext(roots=[pristine]))
+    assert not active(res0), [f.message for f in active(res0)]
+
+    mutated = _mutated_copy(
+        tmp_path, "mxnet_tpu/serving/kvblocks.py",
+        "        with self._lock:\n"
+        "            if n > len(self._free):",
+        "        if True:\n"
+        "            if n > len(self._free):",
+        "kvblocks_mut.py")
+    res1 = run_pass(by_id("lock-discipline")(),
+                    RunContext(roots=[mutated]))
+    assert any(f.code == "unlocked-write" for f in active(res1)), \
+        [f.message for f in res1.findings]
+
+
 # -- collective-consistency ---------------------------------------------------
 
 def test_collective_unknown_axis(tmp_path):

@@ -1,0 +1,538 @@
+"""Paged KV-cache memory subsystem (docs/serving.md "Paged KV & prefix
+cache", ISSUE 18): the BlockAllocator free-list/refcount unit surface,
+PrefixCache longest-match + LRU eviction, paged-vs-dense BIT-IDENTITY
+(greedy and seeded temperature), prefix-hit admission that skips
+shared-block prefill compute, copy-on-write divergence, typed
+KVBlocksExhausted shedding when the pool is oversubscribed, block
+recycling across session lifetimes, compile arithmetic (one decode-step
+compile per engine shape, ZERO cold compiles during traffic), failover
+of a session holding shared prefix blocks, the /healthz occupancy
+surface, and the shared-prefix kill chaos half (``ci/run_chaos.sh``,
+MXNET_CHAOS_SEED rotates workload and kill step)."""
+
+import json
+import os
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from mxnet_tpu import faults, telemetry
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.models import transformer_lm as tlm
+from mxnet_tpu.serving import (BlockAllocator, DecodeEngine,
+                               GenerateSession, KVBlocksExhausted,
+                               ModelRegistry, Overloaded, PrefixCache,
+                               ServingHTTPServer, lm_pool)
+from mxnet_tpu.serving.kvblocks import KVBlockPool
+
+# tiny LM (the test_decode.py constants): every compile stays
+# sub-second on the CPU CI host; eos_id == vocab is unreachable so
+# generation lengths are deterministic
+VOCAB, EMBED, HEADS, LAYERS, FFN, MAX_LEN = 32, 16, 2, 2, 32, 32
+CFG = tlm.LMConfig(VOCAB, EMBED, HEADS, LAYERS, FFN, MAX_LEN,
+                   eos_id=VOCAB)
+PARAMS = tlm.init_params(CFG, seed=3)
+PROMPT = [5, 7, 9, 2]
+#: block_size 4 over max_len 32 -> 8-wide block tables: small enough
+#: that boundary appends, COW tails and exhaustion all fire within a
+#: handful of decode steps
+BS = 4
+ENGINE_OPTS = {"slots": 4, "prefill_buckets": (4, 8), "max_queue": 64,
+               "kv_layout": "paged", "kv_block_size": BS}
+#: resume/failover re-prefills prompt+generated — the ladder must fit
+#: the TRANSCRIPT (docs/serving.md "Bucket sizing guidance")
+FAILOVER_OPTS = {"slots": 4, "prefill_buckets": (8, 16), "max_queue": 64,
+                 "kv_layout": "paged", "kv_block_size": BS}
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    faults.disarm()
+    telemetry.reset()
+    telemetry.enable()
+    yield
+    faults.disarm()
+    telemetry.disable()
+    telemetry.reset()
+
+
+def _dense(**kw):
+    opts = {"slots": 4, "prefill_buckets": (4, 8), "max_queue": 64}
+    opts.update(kw)
+    return DecodeEngine(CFG, PARAMS, name="lm", **opts)
+
+
+def _paged(**kw):
+    opts = dict(ENGINE_OPTS)
+    opts.update(kw)
+    return DecodeEngine(CFG, PARAMS, name="lm", **opts)
+
+
+def _compiles():
+    c = telemetry.snapshot()["counters"].get("xla.compile.count", {})
+    return (c.get("kind=decode_prefill", 0), c.get("kind=decode_step", 0))
+
+
+# -- allocator unit surface -------------------------------------------------
+
+def test_allocator_refcounts_exhaustion_and_reuse():
+    """Free-list discipline: block 0 is never handed out, exhaustion is
+    a TYPED Overloaded that takes nothing, decref-to-zero recycles, and
+    refcounts keep shared blocks resident."""
+    a = BlockAllocator(num_blocks=6, block_size=4)  # 5 allocatable
+    got = a.alloc(3)
+    assert 0 not in got and len(set(got)) == 3
+    assert a.available() == 2 and a.used() == 3
+
+    with pytest.raises(KVBlocksExhausted) as err:
+        a.alloc(3)
+    assert isinstance(err.value, Overloaded), \
+        "pool exhaustion must shed like any admission-control refusal"
+    # the failed alloc was atomic: nothing leaked
+    assert a.available() == 2 and a.used() == 3
+
+    a.incref(got[:1])                      # a second owner appears
+    assert a.refcount(got[0]) == 2
+    assert a.decref(got[:1]) == []         # still held -> nothing freed
+    assert a.decref(got) == got            # last refs -> all recycled
+    assert a.available() == 5 and a.used() == 0
+
+    again = a.alloc(5)                     # full pool turns over
+    assert sorted(again) == [1, 2, 3, 4, 5]
+    a.reset()
+    assert a.available() == 5 and a.used() == 0
+
+
+def test_allocator_misuse_is_typed():
+    a = BlockAllocator(num_blocks=4, block_size=2)
+    (b,) = a.alloc(1)
+    a.decref([b])
+    with pytest.raises(MXNetError, match="double free"):
+        a.decref([b])
+    with pytest.raises(MXNetError, match="unallocated"):
+        a.incref([b])
+    with pytest.raises(MXNetError):
+        BlockAllocator(num_blocks=1, block_size=4)  # scratch-only pool
+
+
+# -- prefix cache unit surface ----------------------------------------------
+
+def test_prefix_cache_longest_match_lru_and_evict_for():
+    a = BlockAllocator(num_blocks=32, block_size=4)
+    cache = PrefixCache(a, capacity=4)
+    prompt = np.arange(10, dtype=np.int32)
+    row = np.zeros(8, np.int32)
+    blocks = a.alloc(3)                    # covers positions 0..11
+    row[:3] = blocks
+    cache.insert(prompt, row)              # indexed at 4, 8, 9, 10
+
+    # identical prompt: longest match is n-1 (the last token is always
+    # recomputed — its logits seed the first sample)
+    m, shared = cache.lookup(prompt)
+    assert m == 9 and shared == blocks[:3]
+    assert a.refcount(blocks[0]) > 1, "lookup increfs for the caller"
+    a.decref(shared)
+
+    # a prompt EXTENDING the cached one by a token matches its full
+    # length; longer extensions fall back to the aligned prefix (lookup
+    # probes n-1 and block-aligned lengths only)
+    m, shared = cache.lookup(np.arange(11, dtype=np.int32))
+    assert m == 10
+    a.decref(shared)
+    m, shared = cache.lookup(np.arange(16, dtype=np.int32))
+    assert m == 8
+    a.decref(shared)
+    # an unrelated prompt misses
+    assert cache.lookup(np.full(10, 31, np.int32)) == (0, [])
+
+    # capacity is LRU-bounded: inserting a second prompt evicts the
+    # oldest entries of the first
+    assert len(cache) == 4
+    cache.insert(np.full(6, 7, np.int32), row)
+    assert len(cache) == 4 and cache.evictions > 0
+
+    # evict_for drains entries until the allocator can serve: after the
+    # session's own refs drop, eviction is what actually frees rows
+    before = a.available()
+    cache.evict_for(before + 1)
+    assert a.available() >= before
+    assert cache.hits >= 2
+
+
+def test_pool_sizing_math_and_admissible():
+    pool = KVBlockPool(CFG, slots=4, block_size=BS, num_blocks=9,
+                       prefix_cache=False)
+    assert pool.max_blocks == 8            # ceil(32 / 4)
+    # worst-case (cold) budget: positions 0..n need n//bs + 1 blocks
+    assert pool.admissible(4 * 8 - 1)      # one max session fits
+    assert not pool.admissible(4 * 8)      # ... and nothing larger
+    hd = EMBED // HEADS
+    assert pool.hbm_bytes() == 2 * LAYERS * 9 * BS * HEADS * hd * 4
+    with pytest.raises(MXNetError):
+        # a pool that cannot hold ONE max_len session is a misconfig
+        KVBlockPool(CFG, slots=4, block_size=BS, num_blocks=8)
+    # dense-equivalent default sizing: slots * max_blocks + scratch
+    dflt = KVBlockPool(CFG, slots=4, block_size=BS)
+    assert dflt.num_blocks == 4 * 8 + 1
+
+
+# -- bit-identity versus the dense engine -----------------------------------
+
+def test_paged_greedy_bit_identical_to_dense():
+    """The tentpole bar: same (seed, transcript) in, same tokens out —
+    the paged gather/scatter is bit-compatible with the dense cache,
+    across prompts that end mid-block and on block boundaries."""
+    prompts = [PROMPT, [1], [3, 1, 4, 1, 5, 9, 2, 6], [0, 31, 16]]
+    dense = _dense()
+    try:
+        refs = [dense.generate(p, max_new_tokens=12, timeout=120)
+                for p in prompts]
+    finally:
+        dense.close()
+    paged = _paged()
+    try:
+        for p, ref in zip(prompts, refs):
+            assert paged.generate(p, max_new_tokens=12, timeout=120) \
+                == ref, "paged diverged on prompt %r" % (p,)
+        assert paged.describe()["kv"]["layout"] == "paged"
+    finally:
+        paged.close()
+
+
+def test_paged_temperature_bit_identical_to_dense():
+    """Position-derived sampling keys make the stochastic path exact
+    too: same seed, same temperature, same tokens."""
+    dense = _dense()
+    try:
+        ref = dense.generate(PROMPT, max_new_tokens=10, temperature=0.8,
+                             seed=99, timeout=120)
+        ref2 = dense.generate(PROMPT, max_new_tokens=10, temperature=0.8,
+                              seed=100, timeout=120)
+    finally:
+        dense.close()
+    assert ref != ref2, "seeds must matter for the test to mean anything"
+    paged = _paged()
+    try:
+        assert paged.generate(PROMPT, max_new_tokens=10, temperature=0.8,
+                              seed=99, timeout=120) == ref
+        assert paged.generate(PROMPT, max_new_tokens=10, temperature=0.8,
+                              seed=100, timeout=120) == ref2
+    finally:
+        paged.close()
+
+
+# -- prefix reuse -----------------------------------------------------------
+
+def test_prefix_hit_admission_skips_shared_prefill_compute():
+    """A resubmitted prompt admits BY REFERENCE: all but the last
+    prompt token ride cached blocks (zero prefill compute for them),
+    the stream stays bit-identical, and NO new XLA program is built."""
+    paged = _paged()
+    try:
+        first = paged.generate(PROMPT, max_new_tokens=8, timeout=120)
+        warm = _compiles()
+        card = paged.describe()["kv"]
+        assert card["prefix_hits"] == 0
+        again = paged.generate(PROMPT, max_new_tokens=8, timeout=120)
+        assert again == first
+        card = paged.describe()["kv"]
+        assert card["prefix_hits"] == 1
+        # everything except the last prompt token was NOT re-prefilled
+        assert card["prefix_tokens_reused"] == len(PROMPT) - 1
+        assert _compiles() == warm, \
+            "a prefix-hit admission must not build a new program"
+        kv = telemetry.snapshot()["counters"].get(
+            "serving.kv.prefix_hits", {})
+        assert sum(kv.values()) == 1
+    finally:
+        paged.close()
+
+
+def test_cow_divergence_stays_bit_identical():
+    """Two prompts sharing a NON-block-aligned prefix: the second
+    session copies the partial tail block on write, diverges freely,
+    and both streams match the dense engine bit-for-bit."""
+    sys_prompt = [2, 4, 6, 8, 1, 3]        # 6 tokens: block + 2-token tail
+    p_a, p_b = sys_prompt + [10], sys_prompt + [20]
+    dense = _dense()
+    try:
+        ref_a = dense.generate(p_a, max_new_tokens=8, timeout=120)
+        ref_b = dense.generate(p_b, max_new_tokens=8, timeout=120)
+    finally:
+        dense.close()
+    paged = _paged()
+    try:
+        assert paged.generate(p_a, max_new_tokens=8, timeout=120) == ref_a
+        out_b = paged.generate(p_b, max_new_tokens=8, timeout=120)
+        card = paged.describe()["kv"]
+        assert out_b == ref_b, \
+            "COW must isolate the divergent tail block"
+        assert card["cow_copies"] >= 1
+        assert card["prefix_hits"] >= 1
+        # replay A: its shared blocks were never rewritten by B
+        assert paged.generate(p_a, max_new_tokens=8, timeout=120) == ref_a
+    finally:
+        paged.close()
+
+
+def test_blocks_recycle_across_session_lifetimes():
+    """Retired sessions return their blocks; a pool sized for ONE
+    resident session serves many sequential ones (free-list reuse end
+    to end)."""
+    # 9 blocks = one max_len session + scratch; prefix cache off so
+    # occupancy must return to exactly zero between sessions
+    paged = _paged(kv_blocks=9, kv_prefix_cache=False)
+    try:
+        outs = [paged.generate(PROMPT, max_new_tokens=10, timeout=120)
+                for _ in range(5)]
+        assert all(o == outs[0] for o in outs)
+        card = paged.describe()["kv"]
+        assert card["blocks_used"] == 0
+        assert card["blocks_free"] == 8
+    finally:
+        paged.close()
+
+
+def test_kv_exhaustion_mid_generation_sheds_typed():
+    """Oversubscribed on purpose: four concurrent sessions whose block
+    demand exceeds the pool.  Sessions that cannot grow shed with the
+    TYPED KVBlocksExhausted (an Overloaded, reason ``kv_blocks``) —
+    never a hang, never a silent drop — and the survivors' streams are
+    still bit-identical to dense."""
+    prompts = [[5, 7, 9, 2], [1, 2, 3, 4], [9, 9, 1, 0], [3, 0, 8, 8]]
+    dense = _dense()
+    try:
+        refs = {tuple(p): dense.generate(p, max_new_tokens=8, timeout=120)
+                for p in prompts}
+    finally:
+        dense.close()
+    # 8 allocatable blocks; each session needs 2 at admission and a 3rd
+    # mid-generation (position 8) -> total demand 12 > 8
+    paged = _paged(kv_blocks=9, kv_prefix_cache=False)
+    try:
+        sessions = [paged.submit(p, max_new_tokens=8) for p in prompts]
+        done, shed = 0, 0
+        for p, s in zip(prompts, sessions):
+            try:
+                assert s.result(120) == refs[tuple(p)]
+                done += 1
+            except Overloaded:
+                shed += 1
+        assert done + shed == len(prompts)
+        assert shed >= 1, "12 blocks of demand cannot fit in 8"
+        assert done >= 1, "shedding must free blocks for the rest"
+        reasons = telemetry.snapshot()["counters"].get(
+            "serving.shed.count", {})
+        assert any("kv_blocks" in k and v >= 1
+                   for k, v in reasons.items()), reasons
+        # the engine is healthy afterwards: blocks recycled, serves on
+        assert paged.describe()["kv"]["blocks_used"] == 0
+        assert paged.generate(PROMPT, max_new_tokens=8, timeout=120) \
+            == refs[tuple(PROMPT)]
+    finally:
+        paged.close()
+
+
+# -- compile arithmetic -----------------------------------------------------
+
+def test_one_decode_step_compile_zero_cold_compiles_during_traffic():
+    """Acceptance arithmetic: warm-up builds one prefill program per
+    bucket plus ONE paged decode-step program; cold admissions, prefix
+    hits, COW admissions and temperature traffic then reuse them —
+    zero compiles during traffic."""
+    paged = _paged()
+    try:
+        assert _compiles() == (len(ENGINE_OPTS["prefill_buckets"]), 1)
+        warm = _compiles()
+        paged.generate(PROMPT, max_new_tokens=6, timeout=120)       # cold
+        paged.generate(PROMPT, max_new_tokens=6, timeout=120)       # hit
+        paged.generate(PROMPT + [11], max_new_tokens=6, timeout=120)  # cow
+        paged.generate([8, 6, 7], max_new_tokens=6, temperature=0.7,
+                       seed=1, timeout=120)
+        assert _compiles() == warm, \
+            "traffic after warm-up must never compile"
+    finally:
+        paged.close()
+
+
+# -- migration / failover ---------------------------------------------------
+
+def test_resume_bit_identity_paged():
+    """resume() re-prefills prompt+generated into FRESH blocks and the
+    continuation is bit-identical at every split point — the (seed,
+    transcript) checkpoint carries to the paged layout unchanged."""
+    eng = DecodeEngine(CFG, PARAMS, name="lm", **FAILOVER_OPTS)
+    try:
+        full = eng.generate(PROMPT, max_new_tokens=10, temperature=0.9,
+                            seed=4242, timeout=120)
+        assert len(full) == 10
+    finally:
+        eng.close()
+    eng2 = DecodeEngine(CFG, PARAMS, name="lm", **FAILOVER_OPTS)
+    try:
+        for g in (1, 4, 9):
+            sess = GenerateSession(np.array(PROMPT, np.int32), 10, 0.9,
+                                   None, None, seed=4242)
+            sess.tokens = list(full[:g])
+            eng2.resume(sess)
+            assert sess.result(120) == full, "split at g=%d diverged" % g
+        assert eng2.describe()["kv"]["layout"] == "paged"
+    finally:
+        eng2.close()
+
+
+def test_failover_of_session_holding_shared_prefix_blocks():
+    """serving.replica.kill lands on a replica whose victim session
+    holds blocks ALSO referenced by the prefix cache (its prompt was
+    indexed at admission): migration re-prefills on the survivor, the
+    stream is bit-identical to an uninterrupted run, and the dead
+    replica's shared blocks die with it — no cross-replica aliasing."""
+    pool = lm_pool(CFG, PARAMS, n_replicas=1, name="lm",
+                   engine_opts=FAILOVER_OPTS)
+    ref = pool.generate(PROMPT, max_new_tokens=10, temperature=0.8,
+                        seed=99).result(120)
+    pool.close()
+
+    telemetry.reset()
+    telemetry.enable()
+    pool = lm_pool(CFG, PARAMS, n_replicas=2, name="lm",
+                   engine_opts=FAILOVER_OPTS)
+    try:
+        # seed both replicas' prefix caches with the shared prompt so
+        # the victim — wherever it lands — admits against shared blocks
+        for _ in range(4):
+            pool.generate(PROMPT, max_new_tokens=2).result(60)
+        faults.arm("serving.replica.kill", at=3)
+        sess = pool.generate(PROMPT, max_new_tokens=10, temperature=0.8,
+                             seed=99)
+        out = sess.result(120)
+        faults.disarm()
+        assert out == ref
+        assert sess.migrations == 1
+        dead = [r for r in pool.replicas if r.state != "active"]
+        assert len(dead) == 1
+        assert telemetry.counter_total("serving.failover.count") >= 1
+        # the survivor serves the shared prompt, still bit-identically
+        assert pool.generate(PROMPT, max_new_tokens=10, temperature=0.8,
+                             seed=99).result(120) == ref
+        kv = pool.describe()["kv"]
+        assert kv and kv["layout"] == "paged" and kv["blocks_free"] > 0
+        deadline = time.monotonic() + 30
+        while pool.outstanding() != 0:
+            assert time.monotonic() < deadline, pool.describe()
+            time.sleep(0.01)
+    finally:
+        faults.disarm()
+        pool.close(drain=False)
+
+
+# -- observability ----------------------------------------------------------
+
+def test_healthz_and_describe_report_kv_occupancy():
+    """/healthz carries a per-model ``kv`` card (the blocks_free -> 0
+    early warning) and pool.describe() aggregates the replica cards."""
+    pool = lm_pool(CFG, PARAMS, n_replicas=1, name="lm",
+                   engine_opts=ENGINE_OPTS)
+    reg = ModelRegistry()
+    reg.register("lm", pool, version=1)
+    srv = ServingHTTPServer(reg, port=0).start()
+    try:
+        pool.generate(PROMPT, max_new_tokens=4).result(60)
+        health = json.load(urllib.request.urlopen(srv.url + "/healthz",
+                                                  timeout=30))
+        card = health["kv"]["lm"]
+        assert card["layout"] == "paged"
+        assert card["block_size"] == BS
+        assert card["blocks_used"] + card["blocks_free"] \
+            == card["num_blocks"] - 1
+        assert card["hbm_bytes"] > 0
+        agg = pool.describe()["kv"]
+        assert agg["layout"] == "paged"
+        assert agg["blocks_free"] == card["blocks_free"]
+        assert agg["hbm_bytes"] == card["hbm_bytes"]
+        g = telemetry.snapshot()["gauges"]
+        assert any(k.startswith("serving.kv.blocks_used") for k in g)
+        assert any(k.startswith("serving.kv.sessions_per_hbm_gb")
+                   for k in g)
+    finally:
+        srv.stop()
+        pool.close(drain=False)
+
+
+def test_dense_engine_still_reports_a_kv_card():
+    """The dense layout stays the default and describes itself, so
+    dashboards read one schema across the fleet."""
+    dense = _dense()
+    try:
+        card = dense.describe()["kv"]
+        assert card["layout"] == "dense"
+        hd = EMBED // HEADS
+        assert card["hbm_bytes"] == 2 * LAYERS * 4 * MAX_LEN * HEADS \
+            * hd * 4
+    finally:
+        dense.close()
+
+
+# -- chaos half (ci/run_chaos.sh) -------------------------------------------
+
+@pytest.mark.slow
+def test_chaos_kill_replica_holding_shared_prefix_blocks():
+    """ci/run_chaos.sh shared-prefix kill half: concurrent sessions
+    share a system prompt (so the killed replica ALWAYS holds shared
+    prefix blocks), MXNET_CHAOS_SEED rotates the workload and the kill
+    step.  Every session completes or sheds typed, and every completed
+    stream is bit-identical to an unkilled single-replica replay."""
+    seed = int(os.environ.get("MXNET_CHAOS_SEED", "0"))
+    rs = np.random.RandomState(seed)
+    sys_prompt = [int(t) for t in rs.randint(0, VOCAB, size=5)]
+    workload = []
+    for _ in range(12):
+        tail = [int(t) for t in
+                rs.randint(0, VOCAB, size=1 + int(rs.randint(0, 3)))]
+        workload.append((sys_prompt + tail, 3 + int(rs.randint(0, 5)),
+                         0.8 * float(rs.randint(0, 2)),
+                         int(rs.randint(0, 2 ** 31))))
+
+    pool = lm_pool(CFG, PARAMS, n_replicas=2, name="lm",
+                   engine_opts=FAILOVER_OPTS)
+    sessions = []
+    try:
+        faults.arm("serving.replica.kill", at=3 + int(rs.randint(0, 8)))
+        for prompt, max_new, temp, sseed in workload:
+            try:
+                sessions.append(pool.generate(
+                    prompt, max_new_tokens=max_new, temperature=temp,
+                    seed=sseed))
+            except (Overloaded, MXNetError):
+                sessions.append(None)  # typed refusal is a legal outcome
+        done = []
+        for w, s in zip(workload, sessions):
+            if s is None:
+                continue
+            try:
+                done.append((w, s.result(300)))
+            except MXNetError:
+                pass  # typed shed is a legal outcome
+        faults.disarm()
+        assert all(s.done() for s in sessions if s is not None), \
+            "no session may be left unresolved"
+        assert done, "the chaos wave must complete something"
+        dead = [r for r in pool.replicas if r.state != "active"]
+        assert len(dead) == 1
+    finally:
+        faults.disarm()
+        pool.close(drain=False)
+
+    replay = lm_pool(CFG, PARAMS, n_replicas=1, name="lm",
+                     engine_opts=FAILOVER_OPTS)
+    try:
+        for (prompt, max_new, temp, sseed), out in done:
+            assert replay.generate(
+                prompt, max_new_tokens=max_new, temperature=temp,
+                seed=sseed).result(120) == out, \
+                "killed run diverged from the unkilled replay"
+    finally:
+        replay.close()
